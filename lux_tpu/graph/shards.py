@@ -1,0 +1,203 @@
+"""Device-ready graph shards: static-shape padded CSC partitions.
+
+This is the TPU-native replacement for the reference's Legion logical
+regions/partitions + per-GPU `GraphPiece` (core/graph.h:53-98).  Where the
+reference carves one region into disjoint 1-D subregions and lets Legion
+materialize per-GPU instances, we build *stacked* `(P, ...)` NumPy arrays with
+every part padded to identical static shapes, ready to be:
+
+  * consumed whole on one chip (vmap over the leading axis), or
+  * dropped onto a 1-D `jax.sharding.Mesh` with the leading axis sharded and
+    used inside `shard_map` (lux_tpu.parallel).
+
+Key encodings:
+  * Vertices are split into contiguous edge-balanced ranges (partition.py).
+  * Per part, vertex count is padded to ``nv_pad`` and edge count to
+    ``e_pad`` (multiples of 128 — TPU lane width).
+  * ``src_pos`` pre-encodes each edge's source position in the *padded
+    all-gathered* state vector of shape (P * nv_pad,): for source s owned by
+    part q, ``src_pos = q * nv_pad + (s - cuts[q])``.  This makes the
+    per-iteration whole-state exchange (the analog of the reference's
+    whole-region zero-copy read, core/pull_model.inl:454-461) a plain
+    `all_gather` + vectorized gather with no runtime id remapping.
+  * CSC edges arrive sorted by destination, so per-part destination segment
+    boundaries are encoded once as ``row_ptr``/``head_flag`` and all
+    per-destination reductions run as segmented scans (lux_tpu.ops.segment)
+    instead of the reference's atomicAdd/Min/Max
+    (pagerank_gpu.cu:90, sssp_gpu.cu:59-77).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.graph.partition import edge_balanced_cuts, part_of_vertex
+
+LANE = 128  # TPU vector lane width; pad 1-D extents to multiples of this.
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """Static (hashable, jit-safe) shard geometry."""
+
+    num_parts: int
+    nv: int
+    ne: int
+    nv_pad: int  # per-part padded vertex count
+    e_pad: int  # per-part padded edge count
+    weighted: bool
+
+    @property
+    def gathered_size(self) -> int:
+        """Length of the padded all-gathered state vector."""
+        return self.num_parts * self.nv_pad
+
+
+class ShardArrays(NamedTuple):
+    """Stacked per-part arrays (leading axis = part).  A jax pytree.
+
+    Shapes (P = num_parts, V = nv_pad, E = e_pad):
+      row_ptr:   (P, V+1) int32  local CSC offsets into the part's edge slice;
+                 padded vertices get empty ranges.
+      src_pos:   (P, E)   int32  source position in the (P*V,) gathered state.
+      dst_local: (P, E)   int32  local destination index in [0, V); padding
+                 slots hold the out-of-range sentinel V (keeps the array
+                 sorted and makes XLA segment_* drop padding contributions).
+      head_flag: (P, E)   bool   True at the first edge of each destination's
+                 block (segment starts for segmented scans).
+      edge_mask: (P, E)   bool   True for real (non-padding) edges.
+      vtx_mask:  (P, V)   bool   True for real (non-padding) vertices.
+      degree:    (P, V)   int32  out-degree of each local vertex (equivalent
+                 of pull_scan_task_impl, core/pull_model.inl:322-345).
+      global_vid:(P, V)   int32  global vertex id of each local slot (clamped
+                 to nv-1 on padding slots; check vtx_mask).
+      weights:   (P, E)   float32 edge weights (zeros when unweighted).
+    """
+
+    row_ptr: np.ndarray
+    src_pos: np.ndarray
+    dst_local: np.ndarray
+    head_flag: np.ndarray
+    edge_mask: np.ndarray
+    vtx_mask: np.ndarray
+    degree: np.ndarray
+    global_vid: np.ndarray
+    weights: np.ndarray
+
+
+@dataclasses.dataclass
+class PullShards:
+    """Host bundle: spec + arrays + partition bookkeeping."""
+
+    spec: ShardSpec
+    arrays: ShardArrays
+    cuts: np.ndarray  # (P+1,) vertex cut points
+
+    def scatter_to_global(self, stacked: np.ndarray) -> np.ndarray:
+        """Collapse a (P, nv_pad, ...) stacked state back to (nv, ...) global
+        order, dropping padding."""
+        P = self.spec.num_parts
+        out = []
+        for p in range(P):
+            n = int(self.cuts[p + 1] - self.cuts[p])
+            out.append(np.asarray(stacked[p])[:n])
+        return np.concatenate(out, axis=0)
+
+    def global_to_stacked(self, full: np.ndarray) -> np.ndarray:
+        """Split a (nv, ...) global state into (P, nv_pad, ...) padded stacks.
+        Padding slots are filled with zeros."""
+        P, V = self.spec.num_parts, self.spec.nv_pad
+        out = np.zeros((P, V) + full.shape[1:], dtype=full.dtype)
+        for p in range(P):
+            lo, hi = int(self.cuts[p]), int(self.cuts[p + 1])
+            out[p, : hi - lo] = full[lo:hi]
+        return out
+
+
+def build_pull_shards(
+    g: HostGraph,
+    num_parts: int,
+    degrees: Optional[np.ndarray] = None,
+) -> PullShards:
+    """Partition + pad a HostGraph into device-ready pull-model shards."""
+    cuts = edge_balanced_cuts(g.row_ptr, num_parts)
+    P = num_parts
+    nv_counts = np.diff(cuts)
+    e_counts = g.row_ptr[cuts[1:]] - g.row_ptr[cuts[:-1]]
+    nv_pad = max(LANE, _round_up(int(nv_counts.max()), LANE))
+    e_pad = max(LANE, _round_up(int(e_counts.max()) or 1, LANE))
+    if degrees is None:
+        degrees = g.out_degrees()
+    # int32 device indices: per-part edge slices and the gathered-state extent
+    # must fit (global E_ID stays int64 on host, like the reference's
+    # uint64 E_ID / uint32 V_ID split, pagerank/app.h:21-22).
+    if int(e_counts.max()) >= 2**31:
+        raise ValueError(
+            f"a part holds {int(e_counts.max())} edges >= 2^31; "
+            f"increase num_parts (currently {num_parts})"
+        )
+    if num_parts * nv_pad >= 2**31:
+        raise ValueError("num_parts * nv_pad exceeds int32 gather range")
+    owner = part_of_vertex(cuts, g.col_idx)  # (ne,) owning part of each src
+    dst_of = g.dst_of_edges()
+
+    row_ptr = np.zeros((P, nv_pad + 1), dtype=np.int32)
+    src_pos = np.zeros((P, e_pad), dtype=np.int32)
+    dst_local = np.zeros((P, e_pad), dtype=np.int32)
+    head_flag = np.zeros((P, e_pad), dtype=bool)
+    edge_mask = np.zeros((P, e_pad), dtype=bool)
+    vtx_mask = np.zeros((P, nv_pad), dtype=bool)
+    degree = np.zeros((P, nv_pad), dtype=np.int32)
+    global_vid = np.zeros((P, nv_pad), dtype=np.int32)
+    weights = np.zeros((P, e_pad), dtype=np.float32)
+
+    for p in range(P):
+        vlo, vhi = int(cuts[p]), int(cuts[p + 1])
+        elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
+        n, m = vhi - vlo, ehi - elo
+        rp = (g.row_ptr[vlo : vhi + 1] - elo).astype(np.int32)
+        row_ptr[p, : n + 1] = rp
+        row_ptr[p, n + 1 :] = m  # padded vertices: empty ranges at the end
+        srcs = g.col_idx[elo:ehi].astype(np.int64)
+        own = owner[elo:ehi].astype(np.int64)
+        src_pos[p, :m] = (own * nv_pad + (srcs - cuts[own])).astype(np.int32)
+        dl = (dst_of[elo:ehi] - vlo).astype(np.int32)
+        dst_local[p, :m] = dl
+        dst_local[p, m:] = nv_pad
+        starts = rp[:-1][rp[:-1] < rp[1:]]
+        head_flag[p, starts] = True
+        edge_mask[p, :m] = True
+        vtx_mask[p, :n] = True
+        degree[p, :n] = degrees[vlo:vhi]
+        global_vid[p, :n] = np.arange(vlo, vhi, dtype=np.int32)
+        global_vid[p, n:] = g.nv - 1
+        if g.weights is not None:
+            weights[p, :m] = g.weights[elo:ehi].astype(np.float32)
+
+    spec = ShardSpec(
+        num_parts=P,
+        nv=g.nv,
+        ne=g.ne,
+        nv_pad=nv_pad,
+        e_pad=e_pad,
+        weighted=g.weights is not None,
+    )
+    arrays = ShardArrays(
+        row_ptr=row_ptr,
+        src_pos=src_pos,
+        dst_local=dst_local,
+        head_flag=head_flag,
+        edge_mask=edge_mask,
+        vtx_mask=vtx_mask,
+        degree=degree,
+        global_vid=global_vid,
+        weights=weights,
+    )
+    return PullShards(spec=spec, arrays=arrays, cuts=cuts)

@@ -1,0 +1,33 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY.md §4 — the
+substitute for the reference's missing multi-node fake backend; multi-chip
+logic is exercised without TPU hardware).
+
+Note: the environment may pre-import jax and point JAX_PLATFORMS at a real
+accelerator plugin; we override BOTH the env var and the live jax config here,
+before any backend is initialized, so tests never tunnel to hardware.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    assert jax.devices()[0].platform == "cpu", jax.devices()
+    assert jax.device_count() == 8, jax.devices()
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)

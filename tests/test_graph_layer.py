@@ -1,0 +1,135 @@
+"""Graph layer: .lux round-trip, converter semantics, partitioner, shards."""
+import numpy as np
+import pytest
+
+from lux_tpu.graph import generate
+from lux_tpu.graph.csc import HostGraph, from_edge_list
+from lux_tpu.graph.format import read_lux, write_lux
+from lux_tpu.graph.partition import edge_balanced_cuts, part_of_vertex
+from lux_tpu.graph.shards import build_pull_shards
+
+
+def tiny_graph():
+    # 0->1, 0->2, 1->2, 2->0, 3->2  (nv=4)
+    src = np.array([0, 0, 1, 2, 3])
+    dst = np.array([1, 2, 2, 0, 2])
+    return from_edge_list(src, dst, 4)
+
+
+def test_from_edge_list_csc():
+    g = tiny_graph()
+    assert g.nv == 4 and g.ne == 5
+    np.testing.assert_array_equal(g.row_ptr, [0, 1, 2, 5, 5])
+    # in-neighbors of 2 are {0, 1, 3} in stable input order
+    np.testing.assert_array_equal(np.sort(g.col_idx[2:5]), [0, 1, 3])
+    np.testing.assert_array_equal(g.col_idx[0:1], [2])  # in-nbr of 0
+    np.testing.assert_array_equal(g.out_degrees(), [2, 1, 1, 1])
+    g.validate()
+
+
+def test_lux_roundtrip(tmp_path):
+    g = generate.uniform_random(100, 500, seed=3)
+    p = str(tmp_path / "g.lux")
+    write_lux(p, g)
+    g2 = read_lux(p)
+    assert g2.nv == g.nv and g2.ne == g.ne
+    np.testing.assert_array_equal(g2.row_ptr, g.row_ptr)
+    np.testing.assert_array_equal(g2.col_idx, g.col_idx)
+    assert g2.weights is None
+
+
+def test_lux_roundtrip_weighted(tmp_path):
+    g = generate.uniform_random(50, 300, seed=4, weighted=True)
+    p = str(tmp_path / "gw.lux")
+    write_lux(p, g)
+    g2 = read_lux(p)
+    assert g2.weighted
+    np.testing.assert_array_equal(g2.weights, g.weights)
+    # explicit weighted=False must ignore the weight block
+    g3 = read_lux(p, weighted=False)
+    assert g3.weights is None
+
+
+def test_csr_roundtrip():
+    g = generate.uniform_random(64, 400, seed=5, weighted=True)
+    csr_row_ptr, csr_dst, perm = g.to_csr()
+    assert csr_row_ptr[-1] == g.ne
+    # every CSR edge (s, d) must exist in CSC
+    dst_of = g.dst_of_edges()
+    for s in [0, 7, 31]:
+        outs = np.sort(csr_dst[csr_row_ptr[s] : csr_row_ptr[s + 1]])
+        ins = np.sort(dst_of[g.col_idx == s])
+        np.testing.assert_array_equal(outs, ins)
+    # perm maps CSR slots to CSC edge ids: src must match
+    srcs_via_perm = g.col_idx[perm]
+    expect = np.repeat(np.arange(g.nv), np.diff(csr_row_ptr))
+    np.testing.assert_array_equal(srcs_via_perm, expect)
+
+
+@pytest.mark.parametrize("num_parts", [1, 2, 3, 8])
+def test_edge_balanced_cuts(num_parts):
+    g = generate.rmat(10, 8, seed=7)
+    cuts = edge_balanced_cuts(g.row_ptr, num_parts)
+    assert cuts[0] == 0 and cuts[-1] == g.nv
+    assert np.all(np.diff(cuts) >= 0)
+    e_cap = -(-g.ne // num_parts)
+    max_deg = int(np.diff(g.row_ptr).max())
+    e_counts = g.row_ptr[cuts[1:]] - g.row_ptr[cuts[:-1]]
+    assert e_counts.sum() == g.ne
+    # each part's edges bounded by cap + one vertex's worth of slack
+    assert np.all(e_counts <= e_cap + max_deg)
+
+
+def test_part_of_vertex():
+    g = generate.uniform_random(1000, 8000, seed=8)
+    cuts = edge_balanced_cuts(g.row_ptr, 4)
+    vids = np.arange(g.nv)
+    parts = part_of_vertex(cuts, vids)
+    for p in range(4):
+        sel = (vids >= cuts[p]) & (vids < cuts[p + 1])
+        assert np.all(parts[sel] == p)
+
+
+@pytest.mark.parametrize("num_parts", [1, 4])
+def test_build_pull_shards(num_parts):
+    g = generate.rmat(9, 8, seed=9, weighted=True)
+    sh = build_pull_shards(g, num_parts)
+    spec, arr = sh.spec, sh.arrays
+    assert arr.src_pos.shape == (num_parts, spec.e_pad)
+    assert int(arr.edge_mask.sum()) == g.ne
+    assert int(arr.vtx_mask.sum()) == g.nv
+    # Reconstruct every edge (src, dst) from the shards and compare.
+    got = []
+    dst_of = g.dst_of_edges()
+    for p in range(num_parts):
+        m = int(arr.edge_mask[p].sum())
+        rp = arr.row_ptr[p]
+        # dst_local from row_ptr must match stored dst_local
+        dl = np.repeat(np.arange(spec.nv_pad), np.diff(rp))
+        np.testing.assert_array_equal(dl[:m], arr.dst_local[p, :m])
+        assert np.all(arr.dst_local[p, m:] == spec.nv_pad)
+        # src_pos decodes back to the global src id
+        pos = arr.src_pos[p, :m]
+        owner = pos // spec.nv_pad
+        src_global = sh.cuts[owner] + pos % spec.nv_pad
+        dst_global = arr.dst_local[p, :m] + int(sh.cuts[p])
+        got.append(np.stack([src_global, dst_global], 1))
+    got = np.concatenate(got)
+    expect = np.stack([g.col_idx, dst_of], 1)
+    np.testing.assert_array_equal(
+        got[np.lexsort(got.T)], expect[np.lexsort(expect.T)]
+    )
+    # degrees land on the right global vertices
+    deg_global = sh.scatter_to_global(arr.degree)
+    np.testing.assert_array_equal(deg_global, g.out_degrees())
+    # weights preserved
+    total_w = sum(arr.weights[p, arr.edge_mask[p]].sum() for p in range(num_parts))
+    assert total_w == pytest.approx(g.weights.sum())
+
+
+def test_stacked_global_roundtrip():
+    g = generate.uniform_random(777, 5000, seed=11)
+    sh = build_pull_shards(g, 4)
+    x = np.random.default_rng(0).random(g.nv).astype(np.float32)
+    stacked = sh.global_to_stacked(x)
+    np.testing.assert_array_equal(sh.scatter_to_global(stacked), x)
