@@ -1,0 +1,94 @@
+"""Benchmark harness: PageRank GTEPS on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric derivation (BASELINE.md): GTEPS = iterations * ne / elapsed / 1e9 on
+a fixed-iteration PageRank run — the reference's headline workload
+(pagerank 10 iters, README.md:41; ELAPSED TIME timer at
+pagerank/pagerank.cc:108-118).  The reference repo publishes no numbers
+(BASELINE.md), so vs_baseline is computed against BASELINE_GTEPS_PER_CHIP,
+our documented estimate of the paper-era per-GPU rate.
+
+Env knobs:
+  LUX_BENCH_SCALE  (default 20)  RMAT scale, nv = 2**scale
+  LUX_BENCH_EF     (default 16)  edge factor, ne = nv * ef
+  LUX_BENCH_ITERS  (default 10)
+  LUX_BENCH_METHOD (default auto: race scan vs scatter, keep the winner)
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+# Paper-era Lux runs ~1 GTEPS/GPU-class-chip on PageRank per the PVLDB paper
+# family of results; the repo itself publishes nothing (BASELINE.md).
+BASELINE_GTEPS_PER_CHIP = 1.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.pagerank import PageRankProgram
+
+    scale = int(os.environ.get("LUX_BENCH_SCALE", "20"))
+    ef = int(os.environ.get("LUX_BENCH_EF", "16"))
+    iters = int(os.environ.get("LUX_BENCH_ITERS", "10"))
+    method_env = os.environ.get("LUX_BENCH_METHOD", "auto")
+
+    g = generate.rmat(scale, ef, seed=0)
+    shards = build_pull_shards(g, 1)
+    prog = PageRankProgram(nv=shards.spec.nv)
+    arrays = jax.tree.map(jnp.asarray, shards.arrays)
+    state0 = pull.init_state(prog, arrays)
+
+    def timed(method):
+        run = jax.jit(
+            lambda s: pull.run_pull_fixed(prog, shards.spec, arrays, s, iters, method)
+        )
+        run(state0).block_until_ready()  # compile + warm
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = run(state0)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / reps, out
+
+    methods = ["scan", "scatter"] if method_env == "auto" else [method_env]
+    results = {}
+    for m in methods:
+        try:
+            results[m] = timed(m)
+        except Exception as e:  # noqa: BLE001 — a method may be unsupported
+            print(f"# method {m} failed: {e}", flush=True)
+    if not results:
+        raise RuntimeError(f"all benchmark methods failed: {methods}")
+    method, (elapsed, out) = min(results.items(), key=lambda kv: kv[1][0])
+    gteps = iters * g.ne / elapsed / 1e9
+
+    platform = jax.devices()[0].platform
+    print(
+        f"# platform={platform} nv={g.nv} ne={g.ne} iters={iters} "
+        f"method={method} elapsed={elapsed:.4f}s",
+        flush=True,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"pagerank_gteps_rmat{scale}_1chip",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""PageRank on the pull engine.
+
+Math parity with the reference app (pagerank/pagerank_gpu.cu):
+  * ranks are stored PRE-DIVIDED by out-degree: the state holds r[v]/deg[v]
+    so the gather needs no degree lookup (init at pagerank_gpu.cu:256-259:
+    ``rank/degree`` with rank = 1/nv, undivided when degree == 0);
+  * one iteration: new[v] = (initRank + ALPHA * sum_{u->v} state[u]),
+    divided by deg[v] when deg[v] != 0 (pr_kernel tail,
+    pagerank_gpu.cu:97-100), with initRank = (1 - ALPHA)/nv
+    (pagerank/pagerank.cc:141-144) and ALPHA = 0.15 (pagerank/app.h:24);
+  * fixed iteration count, no convergence test (pagerank.cc:109-114).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine import pull
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.graph.shards import PullShards, ShardArrays, build_pull_shards
+
+ALPHA = 0.15
+
+
+@dataclasses.dataclass(frozen=True)
+class PageRankProgram:
+    nv: int
+    alpha: float = ALPHA
+
+    reduce: str = dataclasses.field(default="sum", init=False)
+
+    def init_state(self, global_vid, degree, vtx_mask):
+        rank = jnp.float32(1.0 / self.nv)
+        deg = degree.astype(jnp.float32)
+        state = jnp.where(degree > 0, rank / jnp.maximum(deg, 1.0), rank)
+        return jnp.where(vtx_mask, state, 0.0)
+
+    def edge_value(self, src_state, weight):
+        del weight
+        return src_state
+
+    def apply(self, old_local, acc, arrays: ShardArrays):
+        del old_local
+        init_rank = jnp.float32((1.0 - self.alpha) / self.nv)
+        pr = init_rank + jnp.float32(self.alpha) * acc
+        deg = arrays.degree.astype(jnp.float32)
+        pr = jnp.where(arrays.degree > 0, pr / jnp.maximum(deg, 1.0), pr)
+        return jnp.where(arrays.vtx_mask, pr, 0.0)
+
+
+def pagerank(
+    g: HostGraph | PullShards,
+    num_iters: int = 10,
+    num_parts: int = 1,
+    method: str = "scan",
+) -> np.ndarray:
+    """Run PageRank; returns the (nv,) pre-divided rank vector (same
+    semantics as the reference's final vertex state)."""
+    shards = g if isinstance(g, PullShards) else build_pull_shards(g, num_parts)
+    prog = PageRankProgram(nv=shards.spec.nv)
+    state0 = pull.init_state(prog, shards.arrays)
+    final = pull.run_pull_fixed(
+        prog, shards.spec, shards.arrays, state0, num_iters, method=method
+    )
+    return shards.scatter_to_global(np.asarray(final))
+
+
+def pagerank_reference(g: HostGraph, num_iters: int) -> np.ndarray:
+    """NumPy oracle implementing the identical recurrence (for tests)."""
+    deg = g.out_degrees().astype(np.float64)
+    nv = g.nv
+    state = np.where(deg > 0, (1.0 / nv) / np.maximum(deg, 1.0), 1.0 / nv)
+    dst = g.dst_of_edges()
+    for _ in range(num_iters):
+        acc = np.zeros(nv, np.float64)
+        np.add.at(acc, dst, state[g.col_idx])
+        pr = (1.0 - ALPHA) / nv + ALPHA * acc
+        state = np.where(deg > 0, pr / np.maximum(deg, 1.0), pr)
+    return state.astype(np.float32)

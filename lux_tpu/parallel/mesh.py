@@ -1,0 +1,47 @@
+"""Device mesh helpers.
+
+The TPU replacement for the reference's machine model + mapper
+(core/lux_mapper.cc): where LuxMapper discovers GPUs/framebuffers and slices
+index launches one point per GPU round-robin across nodes
+(lux_mapper.cc:102-140), we declare a 1-D `jax.sharding.Mesh` over all chips
+and let GSPMD/shard_map place one graph part per chip.  Memory placement
+(the FB vs zero-copy tags, core/graph.h:33-34) needs no analog: sharded
+arrays live in HBM; the all-gathered state is XLA-managed.
+
+Axis naming convention:
+  * ``parts`` — the graph partition axis (one contiguous vertex range per
+    chip; the sequence/context-parallel analog, SURVEY.md §2.5).
+  * ``feat``  — optional second axis for feature-dimension sharding of
+    wide vertex states (CF latent vectors; tensor-parallel analog).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PARTS_AXIS = "parts"
+FEAT_AXIS = "feat"
+
+
+def make_mesh(num_parts: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over ``num_parts`` devices (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_parts is None:
+        num_parts = len(devices)
+    assert len(devices) >= num_parts, (len(devices), num_parts)
+    return Mesh(np.asarray(devices[:num_parts]), (PARTS_AXIS,))
+
+
+def parts_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (part) axis; replicate the rest."""
+    return NamedSharding(mesh, P(PARTS_AXIS))
+
+
+def shard_stacked(mesh: Mesh, tree):
+    """Place a pytree of stacked (P, ...) arrays with axis 0 on the mesh."""
+    sh = parts_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
