@@ -46,8 +46,11 @@ class PullProgram(Protocol):
         """Per-vertex initial state for one part (padded slots included)."""
         ...
 
-    def edge_value(self, src_state: jnp.ndarray, weight: jnp.ndarray) -> jnp.ndarray:
-        """Per-edge value from the gathered source state (and weight)."""
+    def edge_value(self, src_state: jnp.ndarray, weight: jnp.ndarray,
+                   dst_state: jnp.ndarray = None) -> jnp.ndarray:
+        """Per-edge value from the gathered source state (and weight).
+        ``dst_state`` is the destination's CURRENT state gathered per edge
+        (needed by CF's error term; unused gathers are DCE'd by XLA)."""
         ...
 
     def apply(self, old_local: jnp.ndarray, acc: jnp.ndarray,
@@ -73,7 +76,8 @@ def local_pull_step(
     """One pull iteration for ONE part.  ``full_state`` is the (P*V, ...)
     concatenated padded state of all parts; ``local_state`` is (V, ...)."""
     src_state = full_state[arrays.src_pos]  # (E, ...) gather
-    vals = prog.edge_value(src_state, arrays.weights)
+    dst_state = local_state[jnp.clip(arrays.dst_local, 0, local_state.shape[0] - 1)]
+    vals = prog.edge_value(src_state, arrays.weights, dst_state)
     acc = _REDUCERS[prog.reduce](
         vals, arrays.row_ptr, arrays.head_flag, arrays.dst_local, method=method
     )

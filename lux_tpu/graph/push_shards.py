@@ -1,0 +1,146 @@
+"""Push-model shards: per-part CSR restricted to local destinations.
+
+The reference push engine materializes, per GPU, out-edge (CSR) structure
+over ALL nv sources but containing only the edges whose destination falls in
+that GPU's range — the replicated `nv * numParts` push row-ptr region
+(core/push_model.inl:321-324,449-465) built by init kernels
+(components_gpu.cu:550-607).  This lets every GPU scatter frontier updates
+exclusively into its OWN vertex slice: no cross-part writes, the frontier is
+the only thing exchanged.
+
+TPU-native twist: instead of replicating an nv-sized row array per part, we
+store only the part's *unique sources* (sorted) + their edge offsets, and
+resolve frontier vertex -> row by vectorized binary search.  This is the
+moral equivalent of the reference's unique in-vertex gather list
+(pagerank_gpu.cu:229-240) applied to the push direction, and keeps per-part
+memory O(part edges), not O(nv).
+
+Shapes (U = u_pad unique-source slots, E = e_pad edge slots):
+  uniq_src:      (P, U)   int32 sorted global source ids; INT32_MAX padding.
+  csr_row_ptr:   (P, U+1) int32 offsets into the CSR-ordered edge slots.
+  csr_dst_local: (P, E)   int32 local dst of each CSR-ordered edge;
+                          nv_pad sentinel on padding (drops scatters).
+  csr_weight:    (P, E)   float32.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.graph.shards import (
+    LANE,
+    PullShards,
+    _round_up,
+    build_pull_shards,
+)
+
+SRC_SENTINEL = np.iinfo(np.int32).max
+
+
+class PushArrays(NamedTuple):
+    uniq_src: np.ndarray
+    csr_row_ptr: np.ndarray
+    csr_dst_local: np.ndarray
+    csr_weight: np.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class PushSpec:
+    """Static geometry for the frontier path."""
+
+    u_pad: int  # padded unique-source count per part
+    f_cap: int  # sparse frontier queue capacity per part (vertices)
+    e_sp: int  # compacted sparse edge-buffer capacity per part
+    pull_threshold_den: int = 16  # frontier > nv/DEN => dense/pull mode
+    # (SPARSE_THRESHOLD = 16: queue sizing at core/push_model.inl:393-397
+    # and the pull/push switch at sssp_gpu.cu:414)
+
+
+@dataclasses.dataclass
+class PushShards:
+    """Pull shards (dense path) + CSR arrays (sparse frontier path)."""
+
+    pull: PullShards
+    pspec: PushSpec
+    parrays: PushArrays
+
+    @property
+    def spec(self):
+        return self.pull.spec
+
+    @property
+    def arrays(self):
+        return self.pull.arrays
+
+    @property
+    def cuts(self):
+        return self.pull.cuts
+
+    def scatter_to_global(self, stacked):
+        return self.pull.scatter_to_global(stacked)
+
+
+def build_push_shards(
+    g: HostGraph,
+    num_parts: int,
+    f_cap: Optional[int] = None,
+    e_sp: Optional[int] = None,
+) -> PushShards:
+    pull = build_pull_shards(g, num_parts)
+    spec = pull.spec
+    P, e_pad, nv_pad = num_parts, spec.e_pad, spec.nv_pad
+    cuts = pull.cuts
+    dst_of = g.dst_of_edges()
+
+    uniq_all, rp_all, dst_all, w_all = [], [], [], []
+    for p in range(P):
+        vlo, vhi = int(cuts[p]), int(cuts[p + 1])
+        elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
+        srcs = g.col_idx[elo:ehi]
+        order = np.argsort(srcs, kind="stable")
+        s_sorted = srcs[order]
+        uniq, counts = (
+            np.unique(s_sorted, return_counts=True)
+            if len(s_sorted)
+            else (np.array([], np.int32), np.array([], np.int64))
+        )
+        rp = np.zeros(len(uniq) + 1, np.int64)
+        np.cumsum(counts, out=rp[1:])
+        uniq_all.append(uniq.astype(np.int32))
+        rp_all.append(rp.astype(np.int32))
+        dst_all.append((dst_of[elo:ehi][order] - vlo).astype(np.int32))
+        if g.weights is not None:
+            w_all.append(g.weights[elo:ehi][order].astype(np.float32))
+
+    u_pad = max(LANE, _round_up(max(len(u) for u in uniq_all) or 1, LANE))
+    uniq_src = np.full((P, u_pad), SRC_SENTINEL, np.int32)
+    csr_row_ptr = np.zeros((P, u_pad + 1), np.int32)
+    csr_dst_local = np.full((P, e_pad), nv_pad, np.int32)
+    csr_weight = np.zeros((P, e_pad), np.float32)
+    for p in range(P):
+        u, rp, dl = uniq_all[p], rp_all[p], dst_all[p]
+        uniq_src[p, : len(u)] = u
+        csr_row_ptr[p, : len(rp)] = rp
+        csr_row_ptr[p, len(rp) :] = rp[-1] if len(rp) else 0
+        csr_dst_local[p, : len(dl)] = dl
+        if g.weights is not None:
+            csr_weight[p, : len(dl)] = w_all[p]
+
+    if f_cap is None:
+        # queue sized like the reference: part vertices / SPARSE_THRESHOLD
+        # + slack (core/push_model.inl:393-397)
+        f_cap = _round_up(nv_pad // 16 + 128, LANE)
+    if e_sp is None:
+        e_sp = _round_up(max(e_pad // 4, LANE) + LANE, LANE)
+
+    pspec = PushSpec(u_pad=u_pad, f_cap=int(f_cap), e_sp=int(e_sp))
+    parrays = PushArrays(
+        uniq_src=uniq_src,
+        csr_row_ptr=csr_row_ptr,
+        csr_dst_local=csr_dst_local,
+        csr_weight=csr_weight,
+    )
+    return PushShards(pull=pull, pspec=pspec, parrays=parrays)

@@ -27,21 +27,38 @@ from lux_tpu.graph.shards import PullShards, ShardArrays, build_pull_shards
 
 @dataclasses.dataclass(frozen=True)
 class MaxLabelProgram:
-    """Max-label propagation vertex program (the CC kernel)."""
+    """Max-label propagation vertex program (the CC kernel).
+
+    Implements BOTH engine contracts: the pull engine's edge_value/apply
+    (dense path) and the push engine's init_frontier/relax (frontier path).
+    """
 
     reduce: str = dataclasses.field(default="max", init=False)
 
     def init_state(self, global_vid, degree, vtx_mask):
+        del degree
         # padding slots get -1 so they never win a max
         return jnp.where(vtx_mask, global_vid, -1)
 
-    def edge_value(self, src_state, weight):
-        del weight
+    # --- pull engine contract ---
+    def edge_value(self, src_state, weight, dst_state=None):
+        del weight, dst_state
         return src_state
 
     def apply(self, old_local, acc, arrays: ShardArrays):
         new = jnp.maximum(old_local, acc)
         return jnp.where(jnp.asarray(arrays.vtx_mask), new, old_local)
+
+    # --- push engine contract ---
+    def init_frontier(self, global_vid, state, vtx_mask):
+        # everyone starts active: the reference seeds a DENSE all-ones
+        # bitmap (components_gpu.cu:733-737)
+        del global_vid, state
+        return vtx_mask
+
+    def relax(self, src_val, weight):
+        del weight
+        return src_val
 
 
 def active_count(old_local, new_local):
@@ -64,6 +81,29 @@ def connected_components(
         prog, shards.spec, shards.arrays, state0, max_iters,
         lambda old, new: jnp.sum(old != new, axis=-1), method=method,
     )
+    return shards.scatter_to_global(np.asarray(final))
+
+
+def connected_components_push(
+    g: HostGraph,
+    max_iters: int = 10_000,
+    num_parts: int = 1,
+    mesh=None,
+    method: str = "scan",
+) -> np.ndarray:
+    """CC on the frontier/push engine (direction-optimizing; what the
+    reference app actually runs)."""
+    from lux_tpu.engine import push as push_engine
+    from lux_tpu.graph.push_shards import build_push_shards
+
+    shards = build_push_shards(g, num_parts)
+    prog = MaxLabelProgram()
+    if mesh is None:
+        final, _ = push_engine.run_push(prog, shards, max_iters, method=method)
+    else:
+        final, _ = push_engine.run_push_dist(
+            prog, shards, mesh, max_iters, method=method
+        )
     return shards.scatter_to_global(np.asarray(final))
 
 

@@ -37,8 +37,8 @@ class PageRankProgram:
         state = jnp.where(degree > 0, rank / jnp.maximum(deg, 1.0), rank)
         return jnp.where(vtx_mask, state, 0.0)
 
-    def edge_value(self, src_state, weight):
-        del weight
+    def edge_value(self, src_state, weight, dst_state=None):
+        del weight, dst_state
         return src_state
 
     def apply(self, old_local, acc, arrays: ShardArrays):

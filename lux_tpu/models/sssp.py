@@ -1,0 +1,142 @@
+"""Single-source shortest paths (BFS flavor) on the push engine.
+
+Parity with the reference app (sssp/):
+  * UNWEIGHTED relaxation ``dist[dst] = min(dist[dst], dist[src] + 1)``
+    (sssp_gpu.cu:122,208,225) — the reference's "SSSP" is BFS with labels;
+    its app.h is literally the CC header and no EDGE_WEIGHT path exists
+    (SURVEY.md §2.2);
+  * dist is int with INF encoded as nv (init at sssp_gpu.cu:733-734);
+  * single-source sparse frontier at ``start`` (sssp_gpu.cu:735-744);
+  * direction-optimizing iteration + convergence on zero active vertices
+    (driver loop sssp/sssp.cc:110-137);
+  * `-check` invariant: dist[dst] <= dist[src] + 1 for every edge
+    (check_kernel, sssp_gpu.cu:773-798).
+
+A weighted delta-relaxation variant (`WeightedSSSPProgram`) is provided as
+an extension beyond the reference (BASELINE.json frames it as a target).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine import push
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.graph.push_shards import PushShards, build_push_shards
+from lux_tpu.parallel.mesh import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class SSSPProgram:
+    """BFS-SSSP vertex program: hop-count relaxation."""
+
+    nv: int
+    start: int = 0
+
+    reduce: str = dataclasses.field(default="min", init=False)
+
+    @property
+    def inf(self) -> int:
+        """Unreached sentinel: nv, reference parity (hop counts < nv)."""
+        return self.nv
+
+    def init_state(self, global_vid, degree, vtx_mask):
+        del degree
+        inf = jnp.int32(self.inf)
+        d = jnp.where(global_vid == self.start, jnp.int32(0), inf)
+        return jnp.where(vtx_mask, d, inf)
+
+    def init_frontier(self, global_vid, state, vtx_mask):
+        del state
+        return (global_vid == self.start) & vtx_mask
+
+    def relax(self, src_val, weight):
+        del weight
+        return src_val + jnp.int32(1)
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightedSSSPProgram(SSSPProgram):
+    """True weighted SSSP (chaotic relaxation; extension, not in the
+    reference code)."""
+
+    @property
+    def inf(self) -> int:
+        # weighted distances can exceed nv; use a large sentinel that still
+        # survives `inf + max_weight` in int32
+        return 1 << 30
+
+    def relax(self, src_val, weight):
+        # weights are integer ratings/costs (WeightType = int in the
+        # reference, col_filter/app.h:24); sssp() validates integrality
+        return src_val + weight.astype(jnp.int32)
+
+
+def sssp(
+    g: HostGraph | PushShards,
+    start: int = 0,
+    num_parts: int = 1,
+    mesh: Mesh | None = None,
+    max_iters: int = 10_000,
+    weighted: bool = False,
+    method: str = "scan",
+) -> np.ndarray:
+    """Run SSSP from ``start``; returns (nv,) int32 distances, nv == INF."""
+    shards = g if isinstance(g, PushShards) else build_push_shards(g, num_parts)
+    if not 0 <= start < shards.spec.nv:
+        raise ValueError(f"start vertex {start} out of range [0, {shards.spec.nv})")
+    if weighted:
+        if not shards.spec.weighted:
+            raise ValueError("weighted=True requires an edge-weighted graph")
+        if isinstance(g, HostGraph) and not np.issubdtype(
+            g.weights.dtype, np.integer
+        ):
+            raise ValueError(
+                "weighted SSSP uses integer edge costs (reference parity, "
+                "WeightType=int); got dtype " + str(g.weights.dtype)
+            )
+    cls = WeightedSSSPProgram if weighted else SSSPProgram
+    prog = cls(nv=shards.spec.nv, start=start)
+    if mesh is None:
+        final, _ = push.run_push(prog, shards, max_iters, method=method)
+    else:
+        final, _ = push.run_push_dist(prog, shards, mesh, max_iters, method=method)
+    return shards.scatter_to_global(np.asarray(final))
+
+
+def inf_value(nv: int, weighted: bool = False) -> int:
+    """The unreached-distance sentinel sssp() returns."""
+    return (
+        WeightedSSSPProgram(nv=nv).inf if weighted else SSSPProgram(nv=nv).inf
+    )
+
+
+def check_distances(g: HostGraph, dist: np.ndarray, weighted: bool = False) -> int:
+    """Host `-check` oracle: count of edges violating the triangle
+    inequality dist[dst] <= dist[src] + w (must be 0 at a fixpoint)."""
+    w = g.weights if (weighted and g.weights is not None) else np.ones(g.ne, np.int64)
+    dst = g.dst_of_edges()
+    lhs = dist[dst].astype(np.int64)
+    rhs = dist[g.col_idx].astype(np.int64) + w
+    # relaxations from unreached (INF) sources don't count
+    reached = dist[g.col_idx] < inf_value(g.nv, weighted)
+    return int(np.sum((lhs > rhs) & reached))
+
+
+def bfs_reference(g: HostGraph, start: int) -> np.ndarray:
+    """Host BFS oracle over the out-adjacency (CSR) view."""
+    from collections import deque
+
+    csr_row_ptr, csr_dst, _ = g.to_csr()
+    dist = np.full(g.nv, g.nv, np.int32)
+    dist[start] = 0
+    dq = deque([start])
+    while dq:
+        u = dq.popleft()
+        for v in csr_dst[csr_row_ptr[u] : csr_row_ptr[u + 1]]:
+            if dist[v] == g.nv:
+                dist[v] = dist[u] + 1
+                dq.append(v)
+    return dist

@@ -1,0 +1,37 @@
+"""Collaborative filtering vs the numpy recurrence oracle."""
+import numpy as np
+import pytest
+
+from lux_tpu.graph import generate
+from lux_tpu.models import colfilter as cf
+from lux_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.mark.parametrize("num_parts", [1, 4])
+def test_cf_matches_oracle(num_parts):
+    g = generate.bipartite_ratings(60, 40, 800, seed=50)
+    got = cf.colfilter(g, num_iters=5, num_parts=num_parts, gamma=1e-3)
+    want = cf.colfilter_reference(g, 5, gamma=1e-3)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=1e-7)
+
+
+def test_cf_training_reduces_rmse():
+    g = generate.bipartite_ratings(80, 50, 1500, seed=51, max_rating=5)
+    v0 = cf.colfilter(g, num_iters=0, gamma=2e-3)
+    v = cf.colfilter(g, num_iters=60, gamma=2e-3)
+    assert cf.rmse(g, v) < cf.rmse(g, v0) * 0.9
+
+
+def test_cf_distributed_matches_single():
+    g = generate.bipartite_ratings(100, 60, 1200, seed=52)
+    single = cf.colfilter(g, num_iters=4, num_parts=1, gamma=1e-3)
+    multi = cf.colfilter(
+        g, num_iters=4, num_parts=8, mesh=mesh_lib.make_mesh(8), gamma=1e-3
+    )
+    np.testing.assert_allclose(multi, single, rtol=2e-5, atol=1e-7)
+
+
+def test_cf_requires_weights():
+    g = generate.uniform_random(50, 200, seed=53)
+    with pytest.raises(AssertionError):
+        cf.colfilter(g, num_iters=1)

@@ -1,0 +1,145 @@
+"""Push engine: SSSP/CC vs host oracles, sparse/dense mode equivalence,
+overflow fallback, distributed equivalence."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine import push
+from lux_tpu.graph import generate
+from lux_tpu.graph.csc import from_edge_list
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.models import components, sssp
+from lux_tpu.parallel import mesh as mesh_lib
+
+
+def test_push_shards_csr_consistent():
+    g = generate.rmat(8, 6, seed=30, weighted=True)
+    sh = build_push_shards(g, 4)
+    dst_of = g.dst_of_edges()
+    for p in range(4):
+        vlo, vhi = int(sh.cuts[p]), int(sh.cuts[p + 1])
+        # every real CSR edge (uniq_src[row], dst) must be a real CSC edge
+        uniq = sh.parrays.uniq_src[p]
+        rp = sh.parrays.csr_row_ptr[p]
+        got = []
+        for r in range(sh.pspec.u_pad):
+            if uniq[r] == np.iinfo(np.int32).max:
+                continue
+            for e in range(rp[r], rp[r + 1]):
+                got.append((uniq[r], sh.parrays.csr_dst_local[p, e] + vlo))
+        sel = (dst_of >= vlo) & (dst_of < vhi)
+        expect = sorted(zip(g.col_idx[sel].tolist(), dst_of[sel].tolist()))
+        assert sorted(got) == expect
+
+
+@pytest.mark.parametrize("num_parts", [1, 3])
+def test_sssp_matches_bfs(num_parts):
+    g = generate.rmat(9, 8, seed=31)
+    got = sssp.sssp(g, start=0, num_parts=num_parts)
+    want = sssp.bfs_reference(g, 0)
+    np.testing.assert_array_equal(got, want)
+    assert sssp.check_distances(g, got) == 0
+
+
+def test_sssp_path_graph():
+    g = generate.path_graph(300)
+    got = sssp.sssp(g, start=0)
+    np.testing.assert_array_equal(got, np.arange(300))
+
+
+def test_sssp_unreachable():
+    # two disjoint chains; start in first — second stays INF (== nv)
+    n = 64
+    src = np.concatenate([np.arange(0, 31), np.arange(32, 63)])
+    dst = src + 1
+    g = from_edge_list(src, dst, n)
+    got = sssp.sssp(g, start=0)
+    np.testing.assert_array_equal(got[:32], np.arange(32))
+    assert np.all(got[32:] == n)
+
+
+def test_sssp_forced_sparse_and_dense_agree():
+    g = generate.rmat(9, 8, seed=33)
+    want = sssp.bfs_reference(g, 5)
+    # force-dense: threshold denominator so large frontier always > nv/den
+    sh_dense = build_push_shards(g, 1)
+    sh_dense.pspec = dataclasses.replace(sh_dense.pspec, pull_threshold_den=g.nv + 1)
+    prog = sssp.SSSPProgram(nv=g.nv, start=5)
+    dense_final, _ = push.run_push(prog, sh_dense)
+    np.testing.assert_array_equal(sh_dense.scatter_to_global(np.asarray(dense_final)), want)
+    # force-sparse: huge threshold denominator -> frontier never > nv/1;
+    # big queue and edge buffer so no overflow fallback
+    sh_sparse = build_push_shards(g, 1, f_cap=sh_dense.spec.nv_pad,
+                                  e_sp=sh_dense.spec.e_pad)
+    sh_sparse.pspec = dataclasses.replace(sh_sparse.pspec, pull_threshold_den=1)
+    sparse_final, _ = push.run_push(prog, sh_sparse)
+    np.testing.assert_array_equal(
+        sh_sparse.scatter_to_global(np.asarray(sparse_final)), want
+    )
+
+
+def test_sssp_overflow_falls_back_dense():
+    """Tiny queue capacity: frontier overflows, engine must stay correct."""
+    g = generate.rmat(9, 8, seed=34)
+    sh = build_push_shards(g, 1, f_cap=128, e_sp=256)
+    prog = sssp.SSSPProgram(nv=g.nv, start=0)
+    final, _ = push.run_push(prog, sh)
+    np.testing.assert_array_equal(
+        sh.scatter_to_global(np.asarray(final)), sssp.bfs_reference(g, 0)
+    )
+
+
+def test_cc_push_matches_pull():
+    g = generate.rmat(9, 6, seed=35)
+    pull_labels = components.connected_components(g)
+    push_labels = components.connected_components_push(g)
+    np.testing.assert_array_equal(push_labels, pull_labels)
+    assert components.check_labels(g, push_labels) == 0
+
+
+def test_cc_fixpoint_oracle():
+    """Labels must be the max-label fixpoint: label[v] = max(v, labels of
+    in-neighbors) iterated to convergence on the host."""
+    g = generate.uniform_random(200, 1500, seed=36)
+    labels = components.connected_components_push(g)
+    want = np.arange(g.nv)
+    dst = g.dst_of_edges()
+    while True:
+        new = want.copy()
+        np.maximum.at(new, dst, want[g.col_idx])
+        if np.array_equal(new, want):
+            break
+        want = new
+    np.testing.assert_array_equal(labels, want)
+
+
+def test_sssp_dist_matches_single():
+    g = generate.rmat(9, 8, seed=37)
+    mesh8 = mesh_lib.make_mesh(8)
+    single = sssp.sssp(g, start=0, num_parts=1)
+    multi = sssp.sssp(g, start=0, num_parts=8, mesh=mesh8)
+    np.testing.assert_array_equal(multi, single)
+
+
+def test_weighted_sssp_extension():
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    from scipy.sparse.csgraph import dijkstra
+
+    g = generate.uniform_random(128, 1024, seed=38, weighted=True, max_weight=9)
+    got = sssp.sssp(g, start=0, weighted=True)
+    # scipy sums duplicate (src, dst) entries; the engine relaxes each
+    # parallel edge independently (min wins) — dedupe to min for the oracle
+    dst = g.dst_of_edges()
+    order = np.lexsort((g.weights, g.col_idx, dst))
+    s, d, w = g.col_idx[order], dst[order], g.weights[order]
+    first = np.ones(g.ne, bool)
+    first[1:] = (s[1:] != s[:-1]) | (d[1:] != d[:-1])
+    A = scipy_sparse.csr_matrix(
+        (w[first], (s[first], d[first])), shape=(g.nv, g.nv)
+    )  # rows=src for dijkstra's directed traversal
+    want = dijkstra(A, directed=True, indices=0, unweighted=False)
+    finite = np.isfinite(want)
+    np.testing.assert_array_equal(got[finite], want[finite].astype(np.int64))
+    assert np.all(got[~finite] == sssp.inf_value(g.nv, weighted=True))
+    assert sssp.check_distances(g, got, weighted=True) == 0
