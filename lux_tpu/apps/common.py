@@ -1,0 +1,54 @@
+"""Shared app-driver scaffolding (the role of each reference app's
+top_level_task: load graph -> preflight -> init -> iterate -> report,
+e.g. pagerank/pagerank.cc:32-118)."""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from lux_tpu.graph import generate
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.graph.format import read_lux
+from lux_tpu.utils.config import RunConfig
+
+log = logging.getLogger("lux_tpu")
+
+
+def load_graph(cfg: RunConfig, weighted: bool = False) -> HostGraph:
+    if cfg.file:
+        g = read_lux(cfg.file)
+        if weighted and not g.weighted:
+            raise SystemExit(f"{cfg.file} has no edge weights")
+        log.info("loaded %s: nv=%d ne=%d", cfg.file, g.nv, g.ne)
+        return g
+    if weighted:
+        n_half = (1 << cfg.rmat_scale) // 2
+        g = generate.bipartite_ratings(
+            n_half, n_half, (1 << cfg.rmat_scale) * cfg.rmat_ef // 2,
+            seed=cfg.seed,
+        )
+    else:
+        g = generate.rmat(cfg.rmat_scale, cfg.rmat_ef, seed=cfg.seed)
+    log.info("synthetic graph: nv=%d ne=%d", g.nv, g.ne)
+    return g
+
+
+def make_mesh_if(cfg: RunConfig):
+    if not cfg.distributed:
+        return None
+    from lux_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(cfg.num_parts)
+
+
+def print_check(name: str, violations: int):
+    """Reference-parity [PASS]/[FAIL] verdict (sssp_gpu.cu:837-842)."""
+    verdict = "[PASS]" if violations == 0 else "[FAIL]"
+    print(f"{verdict} {name} check: {violations} violations")
+    return violations == 0
+
+
+def top_k(label: str, values: np.ndarray, k: int = 5):
+    idx = np.argsort(values)[::-1][:k]
+    print(f"top-{k} {label}: " + ", ".join(f"v{int(i)}={values[i]:.3e}" for i in idx))

@@ -1,0 +1,35 @@
+"""Connected Components CLI app (`python -m lux_tpu.apps.components`).
+
+Driver parity with components/components.cc: convergence-driven label
+propagation, -check label-dominance validation, -verbose per-iteration
+active counts.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from lux_tpu.apps import common
+from lux_tpu.apps.sssp import run_convergence_app
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.models import components as cc_model
+from lux_tpu.utils.config import parse_args
+
+
+def main(argv=None):
+    cfg = parse_args(argv, description=__doc__)
+    g = common.load_graph(cfg)
+    shards = build_push_shards(g, cfg.num_parts)
+    prog = cc_model.MaxLabelProgram()
+    labels = run_convergence_app(prog, shards, cfg, "components")
+    n_comp = len(np.unique(labels))
+    print(f"{n_comp} distinct labels")
+    if cfg.check:
+        ok = common.print_check("components", cc_model.check_labels(g, labels))
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

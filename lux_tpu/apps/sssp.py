@@ -1,0 +1,73 @@
+"""SSSP CLI app (`python -m lux_tpu.apps.sssp`).
+
+Driver parity with sssp/sssp.cc: -start source, convergence-driven loop,
+-check triangle-inequality validation, -verbose per-iteration active
+counts (the activeNodes/compTime breakdown of sssp_gpu.cu:516-518).
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from lux_tpu.apps import common
+from lux_tpu.engine import push
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.models import sssp as sssp_model
+from lux_tpu.utils import preflight
+from lux_tpu.utils.config import parse_args
+from lux_tpu.utils.timing import IterStats, Timer, report_elapsed
+
+
+def run_convergence_app(prog, shards, cfg, name: str):
+    """Shared driver for frontier apps (SSSP + CC)."""
+    est = preflight.estimate_push(shards.spec, shards.pspec)
+    print(est)
+    preflight.check_fits(est)
+    mesh = common.make_mesh_if(cfg)
+
+    timer = Timer()
+    if cfg.verbose and mesh is None:
+        arrays, parrays, carry = push.push_init(prog, shards)
+        step = push.compile_push_step(prog, shards.pspec, shards.spec, cfg.method)
+        stats = IterStats(verbose=True)
+        it = 0
+        while int(carry.active) > 0 and it < cfg.max_iters:
+            t = Timer()
+            carry = step(arrays, parrays, carry)
+            stats.record(it, int(carry.active), t.stop(carry.state))
+            it += 1
+        state, iters = carry.state, it
+    elif mesh is None:
+        state, iters = push.run_push(prog, shards, cfg.max_iters, cfg.method)
+    else:
+        state, iters = push.run_push_dist(
+            prog, shards, mesh, cfg.max_iters, cfg.method
+        )
+    elapsed = timer.stop(state)
+    iters = int(iters)
+    print(f"{name} converged in {iters} iterations")
+    # Frontier apps traverse each edge ~once over the whole run (BASELINE.md
+    # metric note): report GTEPS on ne, identically in all modes.
+    report_elapsed(elapsed, shards.spec.ne, iters, traversed=shards.spec.ne)
+    return shards.scatter_to_global(np.asarray(state))
+
+
+def main(argv=None):
+    cfg = parse_args(argv, description=__doc__, sssp=True)
+    g = common.load_graph(cfg)
+    shards = build_push_shards(g, cfg.num_parts)
+    prog = sssp_model.SSSPProgram(nv=shards.spec.nv, start=cfg.start)
+    dist_result = run_convergence_app(prog, shards, cfg, "sssp")
+    reached = int(np.sum(dist_result < g.nv))
+    print(f"reached {reached}/{g.nv} vertices from {cfg.start}")
+    if cfg.check:
+        ok = common.print_check(
+            "sssp", sssp_model.check_distances(g, dist_result)
+        )
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,25 @@ def init_state(prog: PullProgram, arrays: ShardArrays) -> jnp.ndarray:
     )
 
 
+def _pull_iteration(prog, spec: ShardSpec, method, arrays, state):
+    """One pull iteration over the whole (P, V, ...) shard stack."""
+    full = state.reshape((spec.gathered_size,) + state.shape[2:])
+    return jax.vmap(
+        lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
+    )(arrays, state)
+
+
+def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "scan"):
+    """Jitted SINGLE pull iteration over the whole shard stack (verbose
+    mode / step-wise drivers)."""
+
+    @jax.jit
+    def step(arrays, state):
+        return _pull_iteration(prog, spec, method, arrays, state)
+
+    return step
+
+
 def run_pull_fixed(
     prog: PullProgram,
     spec: ShardSpec,
@@ -109,10 +128,7 @@ def run_pull_fixed(
     arrays = jax.tree.map(jnp.asarray, arrays)
 
     def body(_, state):
-        full = state.reshape((spec.gathered_size,) + state.shape[2:])
-        return jax.vmap(
-            lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
-        )(arrays, state)
+        return _pull_iteration(prog, spec, method, arrays, state)
 
     return jax.lax.fori_loop(0, num_iters, body, state0)
 
@@ -141,10 +157,7 @@ def run_pull_until(
 
     def body(carry):
         state, it, _ = carry
-        full = state.reshape((spec.gathered_size,) + state.shape[2:])
-        new = jax.vmap(
-            lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
-        )(arrays, state)
+        new = _pull_iteration(prog, spec, method, arrays, state)
         active = jnp.sum(active_fn(state, new))
         return new, it + 1, active
 

@@ -150,11 +150,58 @@ def _init_carry(prog, pspec, arrays):
     return PushCarry(state0, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1))
 
 
+def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
+                    arrays, parrays, c: PushCarry) -> PushCarry:
+    """One direction-optimized iteration over all parts (single device)."""
+    P_, V = spec.num_parts, spec.nv_pad
+    g_cnt = jnp.sum(c.count)
+    overflow = jnp.any(c.count > pspec.f_cap)
+    q_vids_all = c.q_vid.reshape(P_ * pspec.f_cap)
+    q_vals_all = c.q_val.reshape(P_ * pspec.f_cap)
+    preps = [
+        sparse_prep(jax.tree.map(lambda a: a[p], parrays), q_vids_all)
+        for p in range(P_)
+    ]
+    edge_overflow = jnp.stack([t for (_, _, _, t) in preps]).max() > pspec.e_sp
+    use_dense = (
+        (g_cnt > spec.nv // pspec.pull_threshold_den)
+        | overflow
+        | edge_overflow
+    )
+    full = c.state.reshape((spec.gathered_size,) + c.state.shape[2:])
+    news = []
+    for p in range(P_):
+        arr = jax.tree.map(lambda a: a[p], arrays)
+        parr = jax.tree.map(lambda a: a[p], parrays)
+        rows, counts, incl, _ = preps[p]
+        new_p = jax.lax.cond(
+            use_dense,
+            lambda arr=arr, p=p: dense_part_step(
+                prog, arr, full, c.state[p], method
+            ),
+            lambda arr=arr, parr=parr, rows=rows, counts=counts, incl=incl, p=p: jnp.where(
+                arr.vtx_mask,
+                sparse_part_step(
+                    prog, pspec, parr, V, q_vids_all, q_vals_all,
+                    rows, counts, incl, c.state[p],
+                ),
+                c.state[p],
+            ),
+        )
+        news.append(new_p)
+    new = jnp.stack(news)
+    changed = (new != c.state) & arrays.vtx_mask
+    q_vid, q_val, cnt = jax.vmap(partial(build_queue, pspec))(
+        arrays, changed, new
+    )
+    active = jnp.sum(cnt)
+    return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active)
+
+
 @lru_cache(maxsize=64)
 def _compile_push_single(prog, pspec: PushSpec, spec: ShardSpec,
                          max_iters: int, method: str):
     """Build (once per config) the jitted single-device push loop."""
-    P_, V = spec.num_parts, spec.nv_pad
 
     @jax.jit
     def loop(arrays, parrays, carry: PushCarry):
@@ -162,52 +209,31 @@ def _compile_push_single(prog, pspec: PushSpec, spec: ShardSpec,
             return (c.active > 0) & (c.it < max_iters)
 
         def body(c):
-            g_cnt = jnp.sum(c.count)
-            overflow = jnp.any(c.count > pspec.f_cap)
-            q_vids_all = c.q_vid.reshape(P_ * pspec.f_cap)
-            q_vals_all = c.q_val.reshape(P_ * pspec.f_cap)
-            preps = [
-                sparse_prep(jax.tree.map(lambda a: a[p], parrays), q_vids_all)
-                for p in range(P_)
-            ]
-            edge_overflow = jnp.stack([t for (_, _, _, t) in preps]).max() > pspec.e_sp
-            use_dense = (
-                (g_cnt > spec.nv // pspec.pull_threshold_den)
-                | overflow
-                | edge_overflow
-            )
-            full = c.state.reshape((spec.gathered_size,) + c.state.shape[2:])
-            news = []
-            for p in range(P_):
-                arr = jax.tree.map(lambda a: a[p], arrays)
-                parr = jax.tree.map(lambda a: a[p], parrays)
-                rows, counts, incl, _ = preps[p]
-                new_p = jax.lax.cond(
-                    use_dense,
-                    lambda arr=arr: dense_part_step(
-                        prog, arr, full, c.state[p], method
-                    ),
-                    lambda arr=arr, parr=parr, rows=rows, counts=counts, incl=incl, p=p: jnp.where(
-                        arr.vtx_mask,
-                        sparse_part_step(
-                            prog, pspec, parr, V, q_vids_all, q_vals_all,
-                            rows, counts, incl, c.state[p],
-                        ),
-                        c.state[p],
-                    ),
-                )
-                news.append(new_p)
-            new = jnp.stack(news)
-            changed = (new != c.state) & arrays.vtx_mask
-            q_vid, q_val, cnt = jax.vmap(partial(build_queue, pspec))(
-                arrays, changed, new
-            )
-            active = jnp.sum(cnt)
-            return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active)
+            return _push_iteration(prog, pspec, spec, method, arrays, parrays, c)
 
         return jax.lax.while_loop(cond, body, carry)
 
     return loop
+
+
+@lru_cache(maxsize=64)
+def compile_push_step(prog, pspec: PushSpec, spec: ShardSpec, method: str = "scan"):
+    """Jitted SINGLE iteration (verbose mode / step-wise drivers — the
+    per-iteration observability the reference gets from -verbose kernel
+    timers, sssp_gpu.cu:513-518)."""
+
+    @jax.jit
+    def step(arrays, parrays, carry: PushCarry):
+        return _push_iteration(prog, pspec, spec, method, arrays, parrays, carry)
+
+    return step
+
+
+def push_init(prog, shards: PushShards):
+    """(arrays, parrays, carry0) device tuple for step-wise driving."""
+    arrays = jax.tree.map(jnp.asarray, shards.arrays)
+    parrays = jax.tree.map(jnp.asarray, shards.parrays)
+    return arrays, parrays, _init_carry(prog, shards.pspec, arrays)
 
 
 def run_push(

@@ -96,6 +96,51 @@ def write_lux(path: str, g: HostGraph) -> None:
             f.write(g.weights.astype("<i4").tobytes())
 
 
+def read_lux_range(path: str, row_lo: int, row_hi: int,
+                   weighted: Optional[bool] = None):
+    """Read one partition's slice of a `.lux` file: the per-host sharded
+    load (equivalent of pull_load_task_impl's partial fseeko/fread,
+    core/pull_model.inl:253-320 — every host reads only its vertex range).
+
+    Returns (row_ptr_local (n+1,) int64 rebased to 0, col_idx (m,) int32,
+    weights (m,) int32 | None) for vertices [row_lo, row_hi).
+
+    Uses the native pread loader (lux_tpu.native) when built, else mmap.
+    """
+    g_header = read_lux(path, weighted=weighted, mmap=True)
+    nv, ne = g_header.nv, g_header.ne
+    assert 0 <= row_lo <= row_hi <= nv
+    col_lo = int(g_header.row_ptr[row_lo])
+    col_hi = int(g_header.row_ptr[row_hi])
+    if weighted is None:
+        weighted = g_header.weighted
+
+    try:
+        from lux_tpu import native
+
+        rng = native.read_range(
+            path, nv, ne, row_lo, row_hi, col_lo, col_hi, weighted
+        )
+    except OSError:
+        raise
+    except Exception:
+        rng = None
+    if rng is not None:
+        rows_end, cols, w = rng
+        row_ptr = np.empty(row_hi - row_lo + 1, np.int64)
+        row_ptr[0] = 0
+        row_ptr[1:] = rows_end.astype(np.int64) - col_lo
+        return row_ptr, cols.astype(np.int32), w
+    row_ptr = (g_header.row_ptr[row_lo : row_hi + 1] - col_lo).astype(np.int64)
+    cols = np.asarray(g_header.col_idx[col_lo:col_hi])
+    w = (
+        np.asarray(g_header.weights[col_lo:col_hi])
+        if weighted and g_header.weights is not None
+        else None
+    )
+    return row_ptr, cols, w
+
+
 def read_edge_list_text(path: str, weighted: bool = False):
     """Parse a whitespace text edge list ("src dst [weight]" per line) —
     converter input format (tools/converter.cc:80-97)."""

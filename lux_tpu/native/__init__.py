@@ -1,0 +1,148 @@
+"""ctypes bindings to the native I/O library (lux_io.cc).
+
+Auto-builds with `make` on first use if a toolchain is present; every entry
+point degrades gracefully to the pure-NumPy path when the library is
+unavailable (no compiler, no make), so the framework never hard-depends on
+the native layer.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_LIB_PATH = os.path.join(_DIR, "build", "liblux_io.so")
+CONVERTER_PATH = os.path.join(_DIR, "build", "lux-convert")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _try_build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, "all"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def get_lib(build: bool = True) -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried:
+        # one failed attempt (missing toolchain / failed make) is final for
+        # the process — don't re-pay the compile timeout per call
+        return None
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and (not build or not _try_build()):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.lux_read_header.argtypes = [ctypes.c_char_p, u32p, u64p]
+    lib.lux_read_header.restype = ctypes.c_int
+    lib.lux_read_rows.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_uint64, u64p]
+    lib.lux_read_rows.restype = ctypes.c_int
+    lib.lux_read_cols.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                  ctypes.c_uint64, ctypes.c_uint64, u32p]
+    lib.lux_read_cols.restype = ctypes.c_int
+    lib.lux_read_weights.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                     ctypes.c_uint64, ctypes.c_uint64,
+                                     ctypes.c_uint64, i32p]
+    lib.lux_read_weights.restype = ctypes.c_int
+    lib.lux_write_from_edges.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                         ctypes.c_uint64, u32p, u32p, i32p]
+    lib.lux_write_from_edges.restype = ctypes.c_int
+    lib.lux_count_degrees.argtypes = [u32p, ctypes.c_uint64, ctypes.c_uint32,
+                                      u32p]
+    lib.lux_count_degrees.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def read_header(path: str):
+    lib = get_lib()
+    if lib is None:
+        return None
+    nv = ctypes.c_uint32()
+    ne = ctypes.c_uint64()
+    rc = lib.lux_read_header(path.encode(), ctypes.byref(nv), ctypes.byref(ne))
+    if rc != 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+    return int(nv.value), int(ne.value)
+
+
+def read_range(path: str, nv: int, ne: int, row_lo: int, row_hi: int,
+               col_lo: int, col_hi: int, weighted: bool):
+    """Partial-range native read (the pull_load_task_impl equivalent).
+    Returns (row_end u64[row_hi-row_lo], cols u32, weights i32|None)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = np.empty(row_hi - row_lo, np.uint64)
+    cols = np.empty(col_hi - col_lo, np.uint32)
+    rc = lib.lux_read_rows(path.encode(), row_lo, row_hi,
+                           _ptr(rows, ctypes.c_uint64))
+    if rc == 0:
+        rc = lib.lux_read_cols(path.encode(), nv, col_lo, col_hi,
+                               _ptr(cols, ctypes.c_uint32))
+    w = None
+    if rc == 0 and weighted:
+        w = np.empty(col_hi - col_lo, np.int32)
+        rc = lib.lux_read_weights(path.encode(), nv, ne, col_lo, col_hi,
+                                  _ptr(w, ctypes.c_int32))
+    if rc != 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+    return rows, cols, w
+
+
+def write_from_edges(path: str, nv: int, src: np.ndarray, dst: np.ndarray,
+                     weights: Optional[np.ndarray] = None) -> bool:
+    """Native counting-sort converter; returns False if lib unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    src = np.ascontiguousarray(src, np.uint32)
+    dst = np.ascontiguousarray(dst, np.uint32)
+    wp = None
+    if weights is not None:
+        weights = np.ascontiguousarray(weights, np.int32)
+        wp = _ptr(weights, ctypes.c_int32)
+    rc = lib.lux_write_from_edges(
+        path.encode(), nv, len(src), _ptr(src, ctypes.c_uint32),
+        _ptr(dst, ctypes.c_uint32), wp,
+    )
+    if rc != 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+    return True
+
+
+def count_degrees(col_idx: np.ndarray, nv: int):
+    """Native out-degree histogram; None if lib unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    col = np.ascontiguousarray(col_idx, np.uint32)
+    deg = np.zeros(nv, np.uint32)
+    rc = lib.lux_count_degrees(_ptr(col, ctypes.c_uint32), len(col), nv,
+                               _ptr(deg, ctypes.c_uint32))
+    if rc != 0:
+        raise ValueError("source id out of range")
+    return deg.astype(np.int32)

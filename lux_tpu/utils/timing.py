@@ -1,0 +1,69 @@
+"""Timers + per-iteration stats.
+
+Equivalent of the reference's Realm::Clock wall timers and ELAPSED TIME
+print (pagerank/pagerank.cc:108-118) and the -verbose per-iteration
+activeNodes/loadTime/compTime/updateTime breakdown (sssp_gpu.cu:513-518).
+On TPU, `block_until_ready` is the quiescing fence (the analog of the
+execution fence + TimingLauncher at sssp/sssp.cc:132-135).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import List, Optional
+
+import jax
+
+log = logging.getLogger("lux_tpu")
+
+
+class Timer:
+    """Wall-clock timer with a device fence on stop."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.elapsed = 0.0
+
+    def stop(self, *fence_on) -> float:
+        for x in fence_on:
+            jax.block_until_ready(x)
+        self.elapsed = time.perf_counter() - self.t0
+        return self.elapsed
+
+
+@dataclasses.dataclass
+class IterStat:
+    it: int
+    active: int
+    seconds: float
+
+
+class IterStats:
+    """Collects and prints per-iteration stats in verbose mode."""
+
+    def __init__(self, verbose: bool = False):
+        self.verbose = verbose
+        self.stats: List[IterStat] = []
+
+    def record(self, it: int, active: int, seconds: float):
+        self.stats.append(IterStat(it, active, seconds))
+        if self.verbose:
+            print(f"iter {it:4d}: activeNodes({active}) time({seconds*1e3:.3f} ms)")
+
+    @property
+    def total_active(self) -> int:
+        return sum(s.active for s in self.stats)
+
+
+def report_elapsed(seconds: float, ne: int, iters: int,
+                   traversed: Optional[int] = None) -> float:
+    """Print the end-of-run summary; returns GTEPS (BASELINE.md metric:
+    fixed-iteration apps use iters*ne, frontier apps use actually-traversed
+    edge counts)."""
+    edges = traversed if traversed is not None else iters * ne
+    gteps = edges / seconds / 1e9 if seconds > 0 else float("nan")
+    print(f"ELAPSED TIME = {seconds:.7f} s")
+    print(f"ITERATIONS   = {iters}")
+    print(f"GTEPS        = {gteps:.4f}")
+    return gteps

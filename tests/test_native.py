@@ -1,0 +1,98 @@
+"""Native C++ I/O layer: build, converter equivalence, partial reads."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lux_tpu import native
+from lux_tpu.graph import generate
+from lux_tpu.graph.csc import from_edge_list
+from lux_tpu.graph.format import read_lux, read_lux_range, write_lux
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    native.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+def test_native_header_and_ranges(tmp_path):
+    g = generate.rmat(8, 8, seed=60, weighted=True)
+    p = str(tmp_path / "g.lux")
+    write_lux(p, g)
+    assert native.read_header(p) == (g.nv, g.ne)
+    rows, cols, w = native.read_range(
+        p, g.nv, g.ne, 10, 20, int(g.row_ptr[10]), int(g.row_ptr[20]), True
+    )
+    np.testing.assert_array_equal(rows.astype(np.int64), g.row_ptr[11:21])
+    np.testing.assert_array_equal(
+        cols.astype(np.int32), g.col_idx[g.row_ptr[10] : g.row_ptr[20]]
+    )
+    np.testing.assert_array_equal(w, g.weights[g.row_ptr[10] : g.row_ptr[20]])
+
+
+def test_native_write_matches_python(tmp_path):
+    rng = np.random.default_rng(61)
+    nv, ne = 200, 2000
+    src = rng.integers(0, nv, ne).astype(np.uint32)
+    dst = rng.integers(0, nv, ne).astype(np.uint32)
+    w = rng.integers(1, 100, ne).astype(np.int32)
+    py = from_edge_list(src, dst, nv, weights=w)
+    p = str(tmp_path / "native.lux")
+    assert native.write_from_edges(p, nv, src, dst, w)
+    gn = read_lux(p)
+    np.testing.assert_array_equal(gn.row_ptr, py.row_ptr)
+    np.testing.assert_array_equal(gn.col_idx, py.col_idx)
+    np.testing.assert_array_equal(gn.weights, py.weights)
+
+
+def test_native_degrees():
+    g = generate.uniform_random(100, 900, seed=62)
+    deg = native.count_degrees(g.col_idx, g.nv)
+    np.testing.assert_array_equal(deg, g.out_degrees())
+
+
+def test_read_lux_range(tmp_path):
+    g = generate.rmat(8, 6, seed=63)
+    p = str(tmp_path / "r.lux")
+    write_lux(p, g)
+    row_ptr, cols, w = read_lux_range(p, 30, 70)
+    np.testing.assert_array_equal(
+        row_ptr, g.row_ptr[30:71] - g.row_ptr[30]
+    )
+    np.testing.assert_array_equal(cols, g.col_idx[g.row_ptr[30] : g.row_ptr[70]])
+    assert w is None
+
+
+def test_converter_cli_roundtrip(tmp_path):
+    rng = np.random.default_rng(64)
+    nv, ne = 50, 400
+    src = rng.integers(0, nv, ne)
+    dst = rng.integers(0, nv, ne)
+    txt = tmp_path / "edges.txt"
+    np.savetxt(txt, np.stack([src, dst], 1), fmt="%d")
+    out = str(tmp_path / "cli.lux")
+    rc = subprocess.call(
+        [sys.executable, os.path.join(REPO, "tools", "converter.py"),
+         "-nv", str(nv), "-ne", str(ne), "-input", str(txt), "-output", out],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert rc == 0
+    g = read_lux(out)
+    want = from_edge_list(src, dst, nv)
+    np.testing.assert_array_equal(g.row_ptr, want.row_ptr)
+    np.testing.assert_array_equal(g.col_idx, want.col_idx)
+
+
+def test_converter_cli_bad_count(tmp_path):
+    txt = tmp_path / "edges.txt"
+    txt.write_text("0 1\n1 2\n")
+    rc = subprocess.call(
+        [sys.executable, os.path.join(REPO, "tools", "converter.py"),
+         "-nv", "3", "-ne", "5", "-input", str(txt), "-output",
+         str(tmp_path / "x.lux")],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert rc != 0

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""lux_tpu graph converter CLI — text edge list -> `.lux` CSC binary.
+
+Flag parity with the reference converter (tools/converter.cc: -nv -ne
+-input -output), plus -weighted.  Prefers the native C++ counting-sort
+converter (lux_tpu/native/build/lux-convert); falls back to NumPy.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-nv", type=int, required=True, help="number of vertices")
+    ap.add_argument("-ne", type=int, required=True, help="number of edges")
+    ap.add_argument("-input", required=True, help="text edge list path")
+    ap.add_argument("-output", required=True, help="output .lux path")
+    ap.add_argument("-weighted", action="store_true")
+    ap.add_argument(
+        "--python", action="store_true", help="force the NumPy fallback path"
+    )
+    args = ap.parse_args(argv)
+
+    if not args.python:
+        from lux_tpu import native
+
+        native.get_lib()  # triggers a build if needed
+        if os.path.exists(native.CONVERTER_PATH):
+            cmd = [
+                native.CONVERTER_PATH, "-nv", str(args.nv), "-ne", str(args.ne),
+                "-input", args.input, "-output", args.output,
+            ] + (["-weighted"] if args.weighted else [])
+            return subprocess.call(cmd)
+
+    from lux_tpu.graph.csc import from_edge_list
+    from lux_tpu.graph.format import read_edge_list_text, write_lux
+
+    src, dst, w = read_edge_list_text(args.input, weighted=args.weighted)
+    if len(src) != args.ne:
+        print(f"expected {args.ne} edges, parsed {len(src)}", file=sys.stderr)
+        return 1
+    g = from_edge_list(src, dst, args.nv, weights=w)
+    write_lux(args.output, g)
+    print(f"wrote {args.output}: nv={g.nv} ne={g.ne}"
+          + (" (weighted)" if args.weighted else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
