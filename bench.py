@@ -49,6 +49,8 @@ def main():
     state0 = pull.init_state(prog, arrays)
 
     def timed(method):
+        if method == "pallas":
+            return timed_pallas()
         run = jax.jit(
             lambda s: pull.run_pull_fixed(prog, shards.spec, arrays, s, iters, method)
         )
@@ -60,7 +62,23 @@ def main():
         out.block_until_ready()
         return (time.perf_counter() - t0) / reps, out
 
-    methods = ["scan", "scatter"] if method_env == "auto" else [method_env]
+    def timed_pallas():
+        from lux_tpu.models.pagerank import make_pallas_runner
+
+        run, ps0 = make_pallas_runner(g)
+        run(ps0, iters).block_until_ready()  # compile + warm
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = run(ps0, iters)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / reps, out
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if method_env == "auto":
+        methods = ["scan", "scatter"] + (["pallas"] if on_tpu else [])
+    else:
+        methods = [method_env]
     results = {}
     for m in methods:
         try:

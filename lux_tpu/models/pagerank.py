@@ -13,6 +13,7 @@ Math parity with the reference app (pagerank/pagerank_gpu.cu):
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax.numpy as jnp
 import numpy as np
@@ -65,6 +66,71 @@ def pagerank(
         prog, shards.spec, shards.arrays, state0, num_iters, method=method
     )
     return shards.scatter_to_global(np.asarray(final))
+
+
+def make_pallas_runner(
+    g: HostGraph,
+    interpret: bool = False,
+    v_blk: int | None = None,
+    t_chunk: int | None = None,
+):
+    """Build the block-CSR layout once; return (run, state0) where
+    run(state, num_iters) executes the full on-device loop on the fused
+    Pallas kernel (lux_tpu.ops.pallas_spmv) — the pr_kernel-equivalent
+    hot path."""
+    import jax
+
+    from lux_tpu.ops import pallas_spmv as ps
+
+    kw = {}
+    if v_blk:
+        kw["v_blk"] = v_blk
+    if t_chunk:
+        kw["t_chunk"] = t_chunk
+    bc = ps.build_blockcsr(g, **kw)
+    nvp = bc.num_vblocks * bc.v_blk
+    deg = g.out_degrees()
+    degree = np.zeros(nvp, np.int32)
+    degree[: g.nv] = deg
+    state0 = np.zeros(nvp, np.float32)
+    state0[: g.nv] = np.where(
+        deg > 0, (1.0 / g.nv) / np.maximum(deg, 1), 1.0 / g.nv
+    )
+    degree_d = jnp.asarray(degree)
+    e_src = jnp.asarray(bc.e_src_pos)
+    e_dst = jnp.asarray(bc.e_dst_rel)
+    cb = jnp.asarray(bc.chunk_block)
+    cf = jnp.asarray(bc.chunk_first)
+
+    @functools.partial(jax.jit, static_argnames="num_iters")
+    def run(state, num_iters):
+        def body(_, s):
+            vals = s[e_src]
+            acc = ps.spmv_blockcsr(
+                vals, e_dst, cb, cf, op="sum", v_blk=bc.v_blk,
+                num_vblocks=bc.num_vblocks, interpret=interpret,
+            )
+            init_rank = jnp.float32((1.0 - ALPHA) / g.nv)
+            pr_new = init_rank + jnp.float32(ALPHA) * acc
+            deg_f = degree_d.astype(jnp.float32)
+            pr_new = jnp.where(degree_d > 0, pr_new / jnp.maximum(deg_f, 1.0), pr_new)
+            return pr_new
+
+        return jax.lax.fori_loop(0, num_iters, body, state)
+
+    return run, jnp.asarray(state0)
+
+
+def pagerank_pallas(
+    g: HostGraph,
+    num_iters: int = 10,
+    interpret: bool = False,
+    v_blk: int | None = None,
+    t_chunk: int | None = None,
+) -> np.ndarray:
+    """Single-chip PageRank on the fused Pallas kernel; returns (nv,)."""
+    run, state0 = make_pallas_runner(g, interpret, v_blk, t_chunk)
+    return np.asarray(run(state0, num_iters))[: g.nv]
 
 
 def pagerank_reference(g: HostGraph, num_iters: int) -> np.ndarray:

@@ -1,0 +1,221 @@
+"""Pallas TPU kernel: fused segmented reduction over CSC edge blocks.
+
+The hot loop of every pull iteration is gather(state) -> reduce-by-dst —
+the role of the reference's pr_kernel block-scan edge sweep
+(pagerank_gpu.cu:49-102).  XLA's options are a scatter-add (serializes on
+TPU) or a log-depth segmented scan (multiple passes over the edge array).
+This kernel does it in ONE pass using the MXU:
+
+  * edges are re-laid out on the host into the static "block-CSR" form:
+    each VERTEX block's edge span is padded to a multiple of the chunk
+    size T, so every grid step i processes edge chunk i and accumulates
+    into exactly one output vertex block (``chunk_block[i]``, a prefetched
+    scalar that routes the output BlockSpec);
+  * inside a chunk, reduction-by-destination is a one-hot contraction:
+    onehot[v, t] = (dst_rel[t] == v), contrib = onehot @ vals — an
+    (V_BLK, T) x (T, 1) matmul on the systolic array instead of atomics;
+  * the grid is sequential ("arbitrary"), so chunks of the same vertex
+    block accumulate in VMEM; ``chunk_first`` zero-initializes each block.
+
+The gather itself (vals = state[src_pos]) stays in XLA where the HLO
+gather is already efficient — Mosaic has no vector gather primitive.
+
+min/max variants use a masked VPU reduce over the same one-hot mask
+(no matmul identity for min), same layout.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.graph.csc import HostGraph
+
+V_BLK = 512  # output vertex block (lanes: multiple of 128)
+T_CHUNK = 512  # edges per grid step
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+@dataclasses.dataclass
+class BlockCSR:
+    """Host-precomputed static block-CSR layout for one part.
+
+    Arrays:
+      e_src_pos: (C, T) int32   gather positions (padding -> 0)
+      e_dst_rel: (C, T) int32   dst - block_base, in [0, V_BLK); padding
+                                holds V_BLK (matches no one-hot row)
+      e_weight:  (C, T) float32
+      chunk_block: (C,) int32   output vertex-block of each chunk
+      chunk_first: (C,) int32   1 on the first chunk of each block
+    """
+
+    nv: int
+    num_vblocks: int
+    num_chunks: int
+    e_src_pos: np.ndarray
+    e_dst_rel: np.ndarray
+    e_weight: np.ndarray
+    chunk_block: np.ndarray
+    chunk_first: np.ndarray
+    v_blk: int = V_BLK
+    t_chunk: int = T_CHUNK
+
+
+def build_blockcsr(
+    g: HostGraph,
+    src_pos: Optional[np.ndarray] = None,
+    v_blk: int = V_BLK,
+    t_chunk: int = T_CHUNK,
+) -> BlockCSR:
+    """Re-lay out a CSC graph into chunk-aligned vertex blocks.
+
+    ``src_pos`` defaults to the raw source ids (single-part layout); pass
+    shard positions for the distributed gathered-state layout.
+    """
+    if src_pos is None:
+        src_pos = g.col_idx.astype(np.int32)
+    dst = g.dst_of_edges()
+    num_vblocks = _round_up(g.nv, v_blk) // v_blk
+    chunks_per_block = np.empty(num_vblocks, np.int64)
+    spans = []
+    for b in range(num_vblocks):
+        lo = int(g.row_ptr[b * v_blk])
+        hi = int(g.row_ptr[min((b + 1) * v_blk, g.nv)])
+        spans.append((lo, hi))
+        chunks_per_block[b] = max(1, -(-(hi - lo) // t_chunk))
+    num_chunks = int(chunks_per_block.sum())
+
+    e_src_pos = np.zeros((num_chunks, t_chunk), np.int32)
+    e_dst_rel = np.full((num_chunks, t_chunk), v_blk, np.int32)
+    e_weight = np.zeros((num_chunks, t_chunk), np.float32)
+    chunk_block = np.empty(num_chunks, np.int32)
+    chunk_first = np.zeros(num_chunks, np.int32)
+    c = 0
+    for b in range(num_vblocks):
+        lo, hi = spans[b]
+        chunk_first[c] = 1
+        for k in range(int(chunks_per_block[b])):
+            chunk_block[c] = b
+            e0 = lo + k * t_chunk
+            e1 = min(e0 + t_chunk, hi)
+            n = e1 - e0
+            if n > 0:
+                e_src_pos[c, :n] = src_pos[e0:e1]
+                e_dst_rel[c, :n] = dst[e0:e1] - b * v_blk
+                if g.weights is not None:
+                    e_weight[c, :n] = g.weights[e0:e1]
+            c += 1
+    assert c == num_chunks
+    return BlockCSR(
+        nv=g.nv,
+        num_vblocks=num_vblocks,
+        num_chunks=num_chunks,
+        e_src_pos=e_src_pos,
+        e_dst_rel=e_dst_rel,
+        e_weight=e_weight,
+        chunk_block=chunk_block,
+        chunk_first=chunk_first,
+        v_blk=v_blk,
+        t_chunk=t_chunk,
+    )
+
+
+def _spmv_kernel(op: str, v_blk: int,
+                 chunk_block_ref, chunk_first_ref, vals_ref, dst_ref,
+                 out_ref):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(chunk_first_ref[i] == 1)
+    def _():
+        if op == "sum":
+            out_ref[:] = jnp.zeros_like(out_ref)
+        elif op == "min":
+            out_ref[:] = jnp.full_like(out_ref, jnp.inf)
+        else:
+            out_ref[:] = jnp.full_like(out_ref, -jnp.inf)
+
+    dst = dst_ref[:]  # (1, T)
+    vals = vals_ref[:]  # (1, T)
+    t = dst.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (v_blk, t), 0)
+    onehot = iota == dst  # (V_BLK, T); padding dst==v_blk matches nothing
+    if op == "sum":
+        contrib = jax.lax.dot_general(
+            onehot.astype(jnp.float32),
+            vals.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (V_BLK, 1)
+        out_ref[0, :] = out_ref[0, :] + contrib[:, 0]
+    elif op == "min":
+        masked = jnp.where(onehot, jnp.broadcast_to(vals, onehot.shape), jnp.inf)
+        out_ref[0, :] = jnp.minimum(out_ref[0, :], jnp.min(masked, axis=1))
+    else:
+        masked = jnp.where(onehot, jnp.broadcast_to(vals, onehot.shape), -jnp.inf)
+        out_ref[0, :] = jnp.maximum(out_ref[0, :], jnp.max(masked, axis=1))
+
+
+@functools.partial(jax.jit, static_argnames=("op", "v_blk", "num_vblocks", "interpret"))
+def spmv_blockcsr(
+    edge_vals: jnp.ndarray,  # (C, T) float32 — gathered+weighted per edge
+    e_dst_rel: jnp.ndarray,  # (C, T) int32
+    chunk_block: jnp.ndarray,  # (C,) int32
+    chunk_first: jnp.ndarray,  # (C,) int32
+    op: str = "sum",
+    v_blk: int = V_BLK,
+    num_vblocks: int = 0,
+    interpret: bool = False,
+):
+    """Segmented reduction -> (num_vblocks * v_blk,) via the Pallas kernel."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_chunks, t = edge_vals.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(num_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, t), lambda i, cb, cf: (i, 0)),
+            pl.BlockSpec((1, t), lambda i, cb, cf: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, v_blk), lambda i, cb, cf: (cb[i], 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_spmv_kernel, op, v_blk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_vblocks, v_blk), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(chunk_block, chunk_first, edge_vals, e_dst_rel)
+    return out.reshape(num_vblocks * v_blk)
+
+
+def pagerank_step_pallas(bc: BlockCSR, state, degree, nv, alpha=0.15,
+                         interpret: bool = False):
+    """One PageRank iteration using the kernel (single part).
+
+    state: (nv_pad,) pre-divided ranks where nv_pad >= nv (gather source);
+    degree: (num_vblocks*v_blk,) int32.  Returns same-shaped new state.
+    """
+    vals = state[jnp.asarray(bc.e_src_pos)]
+    acc = spmv_blockcsr(
+        vals, jnp.asarray(bc.e_dst_rel), jnp.asarray(bc.chunk_block),
+        jnp.asarray(bc.chunk_first), op="sum", v_blk=bc.v_blk,
+        num_vblocks=bc.num_vblocks, interpret=interpret,
+    )
+    init_rank = jnp.float32((1.0 - alpha) / nv)
+    pr = init_rank + jnp.float32(alpha) * acc
+    deg_f = degree.astype(jnp.float32)
+    pr = jnp.where(degree > 0, pr / jnp.maximum(deg_f, 1.0), pr)
+    return pr[: state.shape[0]]
