@@ -74,7 +74,8 @@ def main():
         out.block_until_ready()
         return (time.perf_counter() - t0) / reps, out
 
-    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    # pallas path is TPU-only (axon is the tunneled TPU plugin)
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     if method_env == "auto":
         methods = ["scan", "scatter"] + (["pallas"] if on_tpu else [])
     else:

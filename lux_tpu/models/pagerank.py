@@ -25,6 +25,15 @@ from lux_tpu.graph.shards import PullShards, ShardArrays, build_pull_shards
 ALPHA = 0.15
 
 
+def apply_rank_update(acc, degree, nv, alpha=ALPHA):
+    """The shared PageRank recurrence tail: (initRank + alpha*acc),
+    pre-divided by out-degree when nonzero (pr_kernel, pagerank_gpu.cu:97-100)."""
+    init_rank = jnp.float32((1.0 - alpha) / nv)
+    pr = init_rank + jnp.float32(alpha) * acc
+    deg = degree.astype(jnp.float32)
+    return jnp.where(degree > 0, pr / jnp.maximum(deg, 1.0), pr)
+
+
 @dataclasses.dataclass(frozen=True)
 class PageRankProgram:
     nv: int
@@ -44,10 +53,7 @@ class PageRankProgram:
 
     def apply(self, old_local, acc, arrays: ShardArrays):
         del old_local
-        init_rank = jnp.float32((1.0 - self.alpha) / self.nv)
-        pr = init_rank + jnp.float32(self.alpha) * acc
-        deg = arrays.degree.astype(jnp.float32)
-        pr = jnp.where(arrays.degree > 0, pr / jnp.maximum(deg, 1.0), pr)
+        pr = apply_rank_update(acc, arrays.degree, self.nv, self.alpha)
         return jnp.where(arrays.vtx_mask, pr, 0.0)
 
 
@@ -110,11 +116,7 @@ def make_pallas_runner(
                 vals, e_dst, cb, cf, op="sum", v_blk=bc.v_blk,
                 num_vblocks=bc.num_vblocks, interpret=interpret,
             )
-            init_rank = jnp.float32((1.0 - ALPHA) / g.nv)
-            pr_new = init_rank + jnp.float32(ALPHA) * acc
-            deg_f = degree_d.astype(jnp.float32)
-            pr_new = jnp.where(degree_d > 0, pr_new / jnp.maximum(deg_f, 1.0), pr_new)
-            return pr_new
+            return apply_rank_update(acc, degree_d, g.nv)
 
         return jax.lax.fori_loop(0, num_iters, body, state)
 

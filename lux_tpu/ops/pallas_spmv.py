@@ -51,7 +51,7 @@ class BlockCSR:
       e_src_pos: (C, T) int32   gather positions (padding -> 0)
       e_dst_rel: (C, T) int32   dst - block_base, in [0, V_BLK); padding
                                 holds V_BLK (matches no one-hot row)
-      e_weight:  (C, T) float32
+      e_weight:  (C, T) float32 | None — only for weighted graphs
       chunk_block: (C,) int32   output vertex-block of each chunk
       chunk_first: (C,) int32   1 on the first chunk of each block
     """
@@ -61,7 +61,7 @@ class BlockCSR:
     num_chunks: int
     e_src_pos: np.ndarray
     e_dst_rel: np.ndarray
-    e_weight: np.ndarray
+    e_weight: Optional[np.ndarray]
     chunk_block: np.ndarray
     chunk_first: np.ndarray
     v_blk: int = V_BLK
@@ -94,7 +94,11 @@ def build_blockcsr(
 
     e_src_pos = np.zeros((num_chunks, t_chunk), np.int32)
     e_dst_rel = np.full((num_chunks, t_chunk), v_blk, np.int32)
-    e_weight = np.zeros((num_chunks, t_chunk), np.float32)
+    e_weight = (
+        np.zeros((num_chunks, t_chunk), np.float32)
+        if g.weights is not None
+        else None
+    )
     chunk_block = np.empty(num_chunks, np.int32)
     chunk_first = np.zeros(num_chunks, np.int32)
     c = 0
@@ -109,7 +113,7 @@ def build_blockcsr(
             if n > 0:
                 e_src_pos[c, :n] = src_pos[e0:e1]
                 e_dst_rel[c, :n] = dst[e0:e1] - b * v_blk
-                if g.weights is not None:
+                if e_weight is not None:
                     e_weight[c, :n] = g.weights[e0:e1]
             c += 1
     assert c == num_chunks
@@ -172,13 +176,15 @@ def spmv_blockcsr(
     chunk_first: jnp.ndarray,  # (C,) int32
     op: str = "sum",
     v_blk: int = V_BLK,
-    num_vblocks: int = 0,
+    num_vblocks: int | None = None,
     interpret: bool = False,
 ):
     """Segmented reduction -> (num_vblocks * v_blk,) via the Pallas kernel."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if not num_vblocks:
+        raise ValueError("num_vblocks is required (use BlockCSR.num_vblocks)")
     num_chunks, t = edge_vals.shape
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -208,14 +214,13 @@ def pagerank_step_pallas(bc: BlockCSR, state, degree, nv, alpha=0.15,
     state: (nv_pad,) pre-divided ranks where nv_pad >= nv (gather source);
     degree: (num_vblocks*v_blk,) int32.  Returns same-shaped new state.
     """
+    from lux_tpu.models.pagerank import apply_rank_update
+
     vals = state[jnp.asarray(bc.e_src_pos)]
     acc = spmv_blockcsr(
         vals, jnp.asarray(bc.e_dst_rel), jnp.asarray(bc.chunk_block),
         jnp.asarray(bc.chunk_first), op="sum", v_blk=bc.v_blk,
         num_vblocks=bc.num_vblocks, interpret=interpret,
     )
-    init_rank = jnp.float32((1.0 - alpha) / nv)
-    pr = init_rank + jnp.float32(alpha) * acc
-    deg_f = degree.astype(jnp.float32)
-    pr = jnp.where(degree > 0, pr / jnp.maximum(deg_f, 1.0), pr)
+    pr = apply_rank_update(acc, degree, nv, alpha)
     return pr[: state.shape[0]]
