@@ -59,11 +59,7 @@ class PullProgram(Protocol):
         ...
 
 
-_REDUCERS: dict[str, Callable] = {
-    "sum": segment.segment_sum_csc,
-    "min": segment.segment_min_csc,
-    "max": segment.segment_max_csc,
-}
+_REDUCERS: dict[str, Callable] = segment.reducers()
 
 
 def local_pull_step(

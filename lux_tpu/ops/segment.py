@@ -93,6 +93,16 @@ def _segment_minmax(vals, row_ptr, head_flag, dst_local, op, neutral, method):
     raise ValueError(method)
 
 
+def reducers():
+    """Public reduce-name -> segment-function table (shared by the pull
+    engine and the ring driver; keep in one place)."""
+    return {
+        "sum": segment_sum_csc,
+        "min": segment_min_csc,
+        "max": segment_max_csc,
+    }
+
+
 def segment_min_csc(vals, row_ptr, head_flag, dst_local=None, method="scan"):
     """Min of ``vals`` per destination; empty rows get the dtype max."""
     neutral = jnp.asarray(jnp.iinfo(vals.dtype).max if jnp.issubdtype(vals.dtype, jnp.integer) else jnp.inf, vals.dtype)

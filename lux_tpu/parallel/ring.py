@@ -1,0 +1,230 @@
+"""Ring-streamed state exchange: the large-graph alternative to all_gather.
+
+The all_gather drivers (lux_tpu.parallel.dist) materialize the WHOLE vertex
+state on every chip per iteration — the reference's own exchange model
+(whole-region zero-copy reads, core/pull_model.inl:454-461), fine for
+Twitter-scale state (~170 MB) but not for RMAT27 CF-style wide state
+(SURVEY.md §7.3).  This module streams instead: each chip keeps only one
+part-sized block resident, passing blocks around the ring with
+`lax.ppermute` and folding in each block's edge contributions as it
+arrives — the ring-attention communication shape applied to vertex state
+(SURVEY.md §5 long-context analog).  Peak per-chip state memory drops from
+O(nv) to O(nv / P), and XLA overlaps the neighbor transfer with the
+current block's compute.
+
+Host-side, each part's edges are bucketed by the SOURCE's owning part
+(P buckets, padded to the largest bucket).  Power-law skew can inflate
+padding up to the largest bucket size; the edge-balanced partitioner keeps
+per-part totals even, which bounds the common case.
+
+Supports the full PullProgram contract including destination-state gathers
+(CF's error term) — destinations are always local, so dst state comes from
+the resident local block, never the ring.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache, partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from lux_tpu.engine.pull import PullProgram
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.graph.shards import LANE, PullShards, _round_up, build_pull_shards
+from lux_tpu.ops import segment
+from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
+
+
+class RingArrays(NamedTuple):
+    """Per-part, per-source-bucket edge structure.  Shapes (P parts,
+    B = e_bucket_pad):
+      src_local: (P, P, B) int32  source index WITHIN the streamed block
+      dst_local: (P, P, B) int32  local destination (for dst-state gathers
+                 and the scatter reduce strategy); padding holds V
+      row_ptr:   (P, P, V+1) int32  per-bucket CSC offsets (dst-local)
+      head_flag: (P, P, B) bool
+      weights:   (P, P, B) float32
+    """
+
+    src_local: np.ndarray
+    dst_local: np.ndarray
+    row_ptr: np.ndarray
+    head_flag: np.ndarray
+    weights: np.ndarray
+
+
+@dataclasses.dataclass
+class RingShards:
+    pull: PullShards
+    rarrays: RingArrays
+    e_bucket_pad: int
+
+    @property
+    def spec(self):
+        return self.pull.spec
+
+    @property
+    def arrays(self):
+        return self.pull.arrays
+
+    def scatter_to_global(self, stacked):
+        return self.pull.scatter_to_global(stacked)
+
+
+def build_ring_shards(g: HostGraph, num_parts: int) -> RingShards:
+    pull = build_pull_shards(g, num_parts)
+    spec, cuts = pull.spec, pull.cuts
+    Pn, V = num_parts, spec.nv_pad
+    dst_of = g.dst_of_edges()
+    owner_of = np.searchsorted(cuts, g.col_idx, side="right") - 1
+
+    # bucket (part p, source-owner q) -> edge lists, CSC order preserved
+    buckets = {}
+    max_b = 1
+    for p in range(Pn):
+        vlo, vhi = int(cuts[p]), int(cuts[p + 1])
+        elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
+        own = owner_of[elo:ehi]
+        for q in range(Pn):
+            sel = np.nonzero(own == q)[0]
+            buckets[p, q] = sel + elo
+            max_b = max(max_b, len(sel))
+    B = _round_up(max_b, LANE)
+
+    src_local = np.zeros((Pn, Pn, B), np.int32)
+    dst_local = np.full((Pn, Pn, B), V, np.int32)
+    row_ptr = np.zeros((Pn, Pn, V + 1), np.int32)
+    head_flag = np.zeros((Pn, Pn, B), bool)
+    weights = np.zeros((Pn, Pn, B), np.float32)
+    for p in range(Pn):
+        vlo = int(cuts[p])
+        for q in range(Pn):
+            eids = buckets[p, q]
+            m = len(eids)
+            src_local[p, q, :m] = (g.col_idx[eids] - cuts[q]).astype(np.int32)
+            dl = (dst_of[eids] - vlo).astype(np.int64)
+            dst_local[p, q, :m] = dl
+            counts = np.bincount(dl, minlength=V)
+            np.cumsum(counts, out=row_ptr[p, q, 1:])
+            starts = row_ptr[p, q, :-1][row_ptr[p, q, :-1] < row_ptr[p, q, 1:]]
+            head_flag[p, q, starts] = True
+            if g.weights is not None:
+                weights[p, q, :m] = g.weights[eids].astype(np.float32)
+    return RingShards(
+        pull=pull,
+        rarrays=RingArrays(src_local, dst_local, row_ptr, head_flag, weights),
+        e_bucket_pad=B,
+    )
+
+
+_FOLD = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+_SEG = segment.reducers()
+
+
+def _neutral_like(local, reduce):
+    """Neutral-element accumulator with local's dtype AND varying type
+    (must be derived from `local` so the shard_map loop carry matches)."""
+    if reduce == "sum":
+        return jnp.zeros_like(local)
+    if jnp.issubdtype(local.dtype, jnp.integer):
+        v = (
+            jnp.iinfo(local.dtype).max
+            if reduce == "min"
+            else jnp.iinfo(local.dtype).min
+        )
+    else:
+        v = jnp.inf if reduce == "min" else -jnp.inf
+    return jnp.full_like(local, v)
+
+
+@lru_cache(maxsize=64)
+def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int, method: str):
+    perm = [(i, (i - 1) % num_parts) for i in range(num_parts)]
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            RingArrays(*([P(PARTS_AXIS)] * len(RingArrays._fields))),
+            P(PARTS_AXIS),  # vtx_mask
+            P(PARTS_AXIS),  # degree
+            P(PARTS_AXIS),  # state
+        ),
+        out_specs=P(PARTS_AXIS),
+    )
+    def run(rarr_blk, vtx_mask_blk, degree_blk, state_blk):
+        rarr = jax.tree.map(lambda a: a[0], rarr_blk)
+        vtx_mask, degree = vtx_mask_blk[0], degree_blk[0]
+        my = jax.lax.axis_index(PARTS_AXIS)
+
+        def iteration(_, local):
+            V = local.shape[0]
+
+            def fold(k, acc, block):
+                q = (my + k) % num_parts  # owner of the resident block
+                dst_state = local[jnp.clip(rarr.dst_local[q], 0, V - 1)]
+                vals = prog.edge_value(
+                    block[rarr.src_local[q]], rarr.weights[q], dst_state
+                )
+                part = _SEG[prog.reduce](
+                    vals, rarr.row_ptr[q], rarr.head_flag[q],
+                    rarr.dst_local[q], method=method,
+                )
+                return _FOLD[prog.reduce](acc, part)
+
+            def fold_block(k, carry):
+                acc, block = carry
+                acc = fold(k, acc, block)
+                # pass the block to the next chip while compute proceeds
+                return acc, jax.lax.ppermute(block, PARTS_AXIS, perm)
+
+            acc0 = _neutral_like(local, prog.reduce)
+            # P-1 folds with transfers; the last resident block is folded
+            # without the (dead) final ppermute
+            acc, block = jax.lax.fori_loop(
+                0, num_parts - 1, fold_block, (acc0, local)
+            )
+            acc = fold(num_parts - 1, acc, block)
+            return _apply(prog, local, acc, vtx_mask, degree)
+
+        return jax.lax.fori_loop(0, num_iters, iteration, state_blk[0])[None]
+
+    return run
+
+
+class _RingArrView(NamedTuple):
+    """Duck-typed ShardArrays view for PullProgram.apply inside the ring
+    driver (only the fields apply() implementations read)."""
+
+    vtx_mask: jnp.ndarray
+    degree: jnp.ndarray
+
+
+def _apply(prog, local, acc, vtx_mask, degree):
+    return prog.apply(local, acc, _RingArrView(vtx_mask=vtx_mask, degree=degree))
+
+
+def run_pull_fixed_ring(
+    prog: PullProgram,
+    shards: RingShards,
+    state0,
+    num_iters: int,
+    mesh: Mesh,
+    method: str = "scan",
+):
+    """Distributed fixed-iteration pull with ring-streamed state blocks.
+    Signature-compatible with dist.run_pull_fixed_dist: pass the stacked
+    (P, V, ...) initial state (e.g. from engine.pull.init_state)."""
+    spec = shards.spec
+    assert spec.num_parts == mesh.devices.size
+    rarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.rarrays))
+    vtx_mask = shard_stacked(mesh, jnp.asarray(shards.arrays.vtx_mask))
+    degree = shard_stacked(mesh, jnp.asarray(shards.arrays.degree))
+    state0 = shard_stacked(mesh, state0)
+    run = _compile_ring_fixed(prog, mesh, spec.num_parts, num_iters, method)
+    return run(rarrays, vtx_mask, degree, state0)
