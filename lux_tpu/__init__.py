@@ -9,7 +9,18 @@ XLA collectives over ICI) rather than Legion/GASNet/CUDA.
 """
 
 from lux_tpu.graph.csc import HostGraph, from_edge_list
-from lux_tpu.graph.format import read_lux, write_lux
+from lux_tpu.graph.format import read_lux, read_lux_range, write_lux
+from lux_tpu.graph.push_shards import build_push_shards
 from lux_tpu.graph.shards import build_pull_shards
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy subpackage access: lux_tpu.models / apps / parallel / ops / utils
+    if name in ("models", "apps", "parallel", "ops", "utils", "graph",
+                "engine", "native"):
+        import importlib
+
+        return importlib.import_module(f"lux_tpu.{name}")
+    raise AttributeError(name)

@@ -32,26 +32,29 @@ def main(argv=None):
     state = pull.init_state(prog, arrays)
     mesh = common.make_mesh_if(cfg)
 
-    timer = Timer()
-    if cfg.verbose and mesh is None:
-        step = pull.compile_pull_step(prog, shards.spec, cfg.method)
-        stats = IterStats(verbose=True)
-        for it in range(cfg.num_iters):
-            t = Timer()
-            state = step(arrays, state)
-            stats.record(it, g.nv, t.stop(state))
-    elif mesh is None:
-        state = pull.run_pull_fixed(
-            prog, shards.spec, arrays, state, cfg.num_iters, cfg.method
-        )
-    else:
-        from lux_tpu.parallel import dist
+    from lux_tpu.utils import profiling
 
-        state = dist.run_pull_fixed_dist(
-            prog, shards.spec, shards.arrays, state, cfg.num_iters, mesh,
-            cfg.method,
-        )
-    elapsed = timer.stop(state)
+    with profiling.trace(cfg.profile_dir):
+        timer = Timer()
+        if cfg.verbose and mesh is None:
+            step = pull.compile_pull_step(prog, shards.spec, cfg.method)
+            stats = IterStats(verbose=True)
+            for it in range(cfg.num_iters):
+                t = Timer()
+                state = step(arrays, state)
+                stats.record(it, g.nv, t.stop(state))
+        elif mesh is None:
+            state = pull.run_pull_fixed(
+                prog, shards.spec, arrays, state, cfg.num_iters, cfg.method
+            )
+        else:
+            from lux_tpu.parallel import dist
+
+            state = dist.run_pull_fixed_dist(
+                prog, shards.spec, shards.arrays, state, cfg.num_iters, mesh,
+                cfg.method,
+            )
+        elapsed = timer.stop(state)
     report_elapsed(elapsed, g.ne, cfg.num_iters)
     v = shards.scatter_to_global(jax.device_get(state))
     print(f"training RMSE = {cf_model.rmse(g, v):.4f}")

@@ -42,37 +42,40 @@ def main(argv=None):
             state = jax.numpy.asarray(saved)
             print(f"resumed from {prev} at iteration {start_it}")
 
-    timer = Timer()
-    if (cfg.verbose or cfg.ckpt_every) and mesh is None:
-        from lux_tpu.utils import checkpoint
+    from lux_tpu.utils import profiling
 
-        step = pull.compile_pull_step(prog, shards.spec, cfg.method)
-        stats = IterStats(verbose=cfg.verbose)
-        for it in range(start_it, cfg.num_iters):
-            t = Timer()
-            state = step(arrays, state)
-            stats.record(it, g.nv, t.stop(state))
-            if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
-                import os
+    with profiling.trace(cfg.profile_dir):
+        timer = Timer()
+        if (cfg.verbose or cfg.ckpt_every) and mesh is None:
+            from lux_tpu.utils import checkpoint
 
-                os.makedirs(cfg.ckpt_dir, exist_ok=True)
-                checkpoint.save(
-                    os.path.join(cfg.ckpt_dir, f"ckpt_{it + 1}.npz"),
-                    jax.device_get(state), it + 1, {"app": "pagerank"},
-                )
-    elif mesh is None:
-        state = pull.run_pull_fixed(
-            prog, shards.spec, arrays, state, cfg.num_iters - start_it,
-            cfg.method,
-        )
-    else:
-        from lux_tpu.parallel import dist
+            step = pull.compile_pull_step(prog, shards.spec, cfg.method)
+            stats = IterStats(verbose=cfg.verbose)
+            for it in range(start_it, cfg.num_iters):
+                t = Timer()
+                state = step(arrays, state)
+                stats.record(it, g.nv, t.stop(state))
+                if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
+                    import os
 
-        state = dist.run_pull_fixed_dist(
-            prog, shards.spec, shards.arrays, state,
-            cfg.num_iters - start_it, mesh, cfg.method,
-        )
-    elapsed = timer.stop(state)
+                    os.makedirs(cfg.ckpt_dir, exist_ok=True)
+                    checkpoint.save(
+                        os.path.join(cfg.ckpt_dir, f"ckpt_{it + 1}.npz"),
+                        jax.device_get(state), it + 1, {"app": "pagerank"},
+                    )
+        elif mesh is None:
+            state = pull.run_pull_fixed(
+                prog, shards.spec, arrays, state, cfg.num_iters - start_it,
+                cfg.method,
+            )
+        else:
+            from lux_tpu.parallel import dist
+
+            state = dist.run_pull_fixed_dist(
+                prog, shards.spec, shards.arrays, state,
+                cfg.num_iters - start_it, mesh, cfg.method,
+            )
+        elapsed = timer.stop(state)
     report_elapsed(elapsed, g.ne, cfg.num_iters)
     ranks = shards.scatter_to_global(jax.device_get(state))
     common.top_k("rank (pre-divided)", ranks)

@@ -26,25 +26,30 @@ def run_convergence_app(prog, shards, cfg, name: str):
     preflight.check_fits(est)
     mesh = common.make_mesh_if(cfg)
 
-    timer = Timer()
-    if cfg.verbose and mesh is None:
-        arrays, parrays, carry = push.push_init(prog, shards)
-        step = push.compile_push_step(prog, shards.pspec, shards.spec, cfg.method)
-        stats = IterStats(verbose=True)
-        it = 0
-        while int(carry.active) > 0 and it < cfg.max_iters:
-            t = Timer()
-            carry = step(arrays, parrays, carry)
-            stats.record(it, int(carry.active), t.stop(carry.state))
-            it += 1
-        state, iters = carry.state, it
-    elif mesh is None:
-        state, iters = push.run_push(prog, shards, cfg.max_iters, cfg.method)
-    else:
-        state, iters = push.run_push_dist(
-            prog, shards, mesh, cfg.max_iters, cfg.method
-        )
-    elapsed = timer.stop(state)
+    from lux_tpu.utils import profiling
+
+    with profiling.trace(cfg.profile_dir):
+        timer = Timer()
+        if cfg.verbose and mesh is None:
+            arrays, parrays, carry = push.push_init(prog, shards)
+            step = push.compile_push_step(
+                prog, shards.pspec, shards.spec, cfg.method
+            )
+            stats = IterStats(verbose=True)
+            it = 0
+            while int(carry.active) > 0 and it < cfg.max_iters:
+                t = Timer()
+                carry = step(arrays, parrays, carry)
+                stats.record(it, int(carry.active), t.stop(carry.state))
+                it += 1
+            state, iters = carry.state, it
+        elif mesh is None:
+            state, iters = push.run_push(prog, shards, cfg.max_iters, cfg.method)
+        else:
+            state, iters = push.run_push_dist(
+                prog, shards, mesh, cfg.max_iters, cfg.method
+            )
+        elapsed = timer.stop(state)
     iters = int(iters)
     print(f"{name} converged in {iters} iterations")
     # Frontier apps traverse each edge ~once over the whole run (BASELINE.md

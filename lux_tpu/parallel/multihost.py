@@ -1,0 +1,87 @@
+"""Multi-host (multi-process) initialization.
+
+The reference scales across nodes through GASNet underneath Realm — no
+code in Lux itself touches the network; launching N processes with
+`-ll:gpu` per node is the whole story (README.md:33-37, SURVEY.md §2.4).
+The TPU equivalent: one Python process per host, `jax.distributed`
+bootstraps the cross-host runtime, and the SAME shard_map programs then
+run with a global mesh whose axes span hosts — XLA routes all_gather /
+psum / ppermute over ICI within a slice and DCN across slices.  No
+lux_tpu code changes between single-host and multi-host.
+
+Per-host data loading: each host builds only its own parts
+(`read_lux_range` does the partial file read, the pull_load_task_impl
+equivalent) and `jax.make_array_from_process_local_data` assembles the
+globally-sharded stacked arrays.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+log = logging.getLogger("lux_tpu")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Bootstrap the multi-host runtime (no-op when single-host or already
+    initialized).
+
+    On TPU pods the three arguments auto-detect from the environment;
+    elsewhere pass them explicitly.  Returns the process index.
+    """
+    # guard with a module flag, NOT jax.process_count(): querying the
+    # backend would initialize it and forbid jax.distributed.initialize
+    if getattr(initialize, "_done", False):
+        return jax.process_index()
+    initialize._done = True
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address=coordinator_address)
+    log.info(
+        "multihost: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return jax.process_index()
+
+
+def global_parts_mesh():
+    """1-D mesh over ALL devices of ALL hosts (parts axis)."""
+    from lux_tpu.parallel.mesh import PARTS_AXIS
+
+    return jax.sharding.Mesh(np.asarray(jax.devices()), (PARTS_AXIS,))
+
+
+def local_part_range(num_parts: int) -> Sequence[int]:
+    """The part indices this host owns under a one-part-per-device layout
+    (the analog of the mapper's node-major slice placement,
+    lux_mapper.cc:112-121).  Balanced split: the first ``num_parts %
+    process_count`` hosts take one extra part, so every part has exactly
+    one owner regardless of divisibility."""
+    n_hosts, me = jax.process_count(), jax.process_index()
+    base, extra = divmod(num_parts, n_hosts)
+    lo = me * base + min(me, extra)
+    hi = lo + base + (1 if me < extra else 0)
+    return range(lo, hi)
+
+
+def assemble_global(mesh, stacked_local: np.ndarray, num_parts: int):
+    """Build a globally-sharded stacked (P, ...) array from this host's
+    local parts (host-sharded loading path)."""
+    return jax.make_array_from_process_local_data(
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(mesh.axis_names[0])),
+        stacked_local,
+        (num_parts,) + stacked_local.shape[1:],
+    )

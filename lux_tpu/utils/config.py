@@ -31,6 +31,7 @@ class RunConfig:
     seed: int = 0
     ckpt_dir: Optional[str] = None  # checkpoint/resume directory
     ckpt_every: int = 0  # save every N iterations (0 = off)
+    profile_dir: Optional[str] = None  # jax.profiler trace output
 
 
 def parse_args(argv=None, description: str = "", sssp: bool = False) -> RunConfig:
@@ -54,6 +55,8 @@ def parse_args(argv=None, description: str = "", sssp: bool = False) -> RunConfi
     ap.add_argument("--ckpt-dir", help="checkpoint directory (resume if present)")
     ap.add_argument("--ckpt-every", type=int, default=0,
                     help="save state every N iterations")
+    ap.add_argument("--profile-dir",
+                    help="write a jax.profiler trace (XProf/Perfetto) here")
     ns = ap.parse_args(argv)
     if ns.ckpt_every and not ns.ckpt_dir:
         ap.error("--ckpt-every requires --ckpt-dir")
@@ -72,4 +75,5 @@ def parse_args(argv=None, description: str = "", sssp: bool = False) -> RunConfi
         seed=ns.seed,
         ckpt_dir=ns.ckpt_dir,
         ckpt_every=ns.ckpt_every,
+        profile_dir=ns.profile_dir,
     )

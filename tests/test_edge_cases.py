@@ -1,0 +1,66 @@
+"""Degenerate and adversarial graph shapes."""
+import numpy as np
+import pytest
+
+from lux_tpu.graph.csc import from_edge_list
+from lux_tpu.graph.partition import edge_balanced_cuts
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models import components, pagerank as pr, sssp
+
+
+def test_single_vertex_no_edges():
+    g = from_edge_list(np.array([], np.int64), np.array([], np.int64), 1)
+    ranks = pr.pagerank(g, num_iters=3)
+    # no edges: rank = initRank each iteration (deg 0, undivided)
+    np.testing.assert_allclose(ranks, [(1 - 0.15) / 1], rtol=1e-6)
+    labels = components.connected_components(g)
+    np.testing.assert_array_equal(labels, [0])
+
+
+def test_edgeless_many_vertices():
+    g = from_edge_list(np.array([], np.int64), np.array([], np.int64), 500)
+    d = sssp.sssp(g, start=3)
+    assert d[3] == 0 and np.all(np.delete(d, 3) == 500)
+
+
+def test_self_loops_and_duplicates():
+    src = np.array([0, 0, 1, 1, 1, 2])
+    dst = np.array([0, 0, 1, 2, 2, 2])  # self loops + parallel edges
+    g = from_edge_list(src, dst, 3)
+    d = sssp.sssp(g, start=1)
+    np.testing.assert_array_equal(d, [3, 0, 1])
+    labels = components.connected_components(g)
+    assert components.check_labels(g, labels) == 0
+
+
+def test_more_parts_than_vertices():
+    g = from_edge_list(np.array([0, 1]), np.array([1, 2]), 3)
+    cuts = edge_balanced_cuts(g.row_ptr, 8)
+    assert cuts[-1] == 3 and np.all(np.diff(cuts) >= 0)
+    sh = build_pull_shards(g, 8)
+    assert int(sh.arrays.vtx_mask.sum()) == 3
+    ranks = pr.pagerank(g, num_iters=2, num_parts=8)
+    want = pr.pagerank_reference(g, 2)
+    np.testing.assert_allclose(ranks, want, rtol=1e-5)
+
+
+def test_hub_vertex_skew():
+    """One vertex receives almost all edges (extreme power-law)."""
+    n = 256
+    src = np.arange(1, n)
+    dst = np.zeros(n - 1, np.int64)  # everyone -> 0
+    g = from_edge_list(np.concatenate([src, [0]]), np.concatenate([dst, [1]]), n)
+    sh = build_push_shards(g, 4)
+    d = sssp.sssp(g, start=5, num_parts=4)
+    assert d[5] == 0 and d[0] == 1 and d[1] == 2
+    ranks = pr.pagerank(g, num_iters=3, num_parts=4)
+    np.testing.assert_allclose(ranks, pr.pagerank_reference(g, 3), rtol=1e-5)
+
+
+def test_lazy_subpackage_access():
+    import lux_tpu
+
+    assert hasattr(lux_tpu.models, "__path__")
+    with pytest.raises(AttributeError):
+        lux_tpu.nonexistent_thing
