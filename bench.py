@@ -13,7 +13,8 @@ Env knobs:
   LUX_BENCH_SCALE  (default 20)  RMAT scale, nv = 2**scale
   LUX_BENCH_EF     (default 16)  edge factor, ne = nv * ef
   LUX_BENCH_ITERS  (default 10)
-  LUX_BENCH_METHOD (default auto: race scan vs scatter, keep the winner)
+  LUX_BENCH_METHOD (default auto: race scan vs scatter [vs pallas on TPU])
+  LUX_BENCH_DTYPE  (default float32; bfloat16 halves state bandwidth)
 """
 from __future__ import annotations
 
@@ -42,9 +43,10 @@ def main():
     iters = int(os.environ.get("LUX_BENCH_ITERS", "10"))
     method_env = os.environ.get("LUX_BENCH_METHOD", "auto")
 
+    dtype = os.environ.get("LUX_BENCH_DTYPE", "float32")
     g = generate.rmat(scale, ef, seed=0)
     shards = build_pull_shards(g, 1)
-    prog = PageRankProgram(nv=shards.spec.nv)
+    prog = PageRankProgram(nv=shards.spec.nv, dtype=dtype)
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     state0 = pull.init_state(prog, arrays)
 
@@ -65,7 +67,7 @@ def main():
     def timed_pallas():
         from lux_tpu.models.pagerank import make_pallas_runner
 
-        run, ps0 = make_pallas_runner(g)
+        run, ps0 = make_pallas_runner(g, dtype=dtype)
         run(ps0, iters).block_until_ready()  # compile + warm
         reps = 3
         t0 = time.perf_counter()
@@ -94,7 +96,7 @@ def main():
     platform = jax.devices()[0].platform
     print(
         f"# platform={platform} nv={g.nv} ne={g.ne} iters={iters} "
-        f"method={method} elapsed={elapsed:.4f}s",
+        f"method={method} dtype={dtype} elapsed={elapsed:.4f}s",
         flush=True,
     )
     print(

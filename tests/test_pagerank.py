@@ -59,3 +59,11 @@ def test_pagerank_methods_agree(method):
     base = pr.pagerank(g, num_iters=5, method="scan")
     got = pr.pagerank(g, num_iters=5, method=method)
     np.testing.assert_allclose(got, base, rtol=1e-6)
+
+
+def test_pagerank_bf16_close_to_f32():
+    g = generate.rmat(9, 8, seed=12)
+    f32 = pr.pagerank(g, num_iters=8)
+    bf16 = pr.pagerank(g, num_iters=8, dtype="bfloat16")
+    # bf16 state storage: ~3 decimal digits; accumulate stays f32
+    np.testing.assert_allclose(bf16, f32, rtol=2e-2)
