@@ -207,6 +207,71 @@ def spmv_blockcsr(
     return out.reshape(num_vblocks * v_blk)
 
 
+def _spmv2d_kernel(v_blk: int,
+                   chunk_block_ref, chunk_first_ref, vals_ref, dst_ref,
+                   out_ref):
+    """2-D value variant: per-chunk (T, K) values, (V_BLK, K) output block.
+    The contraction onehot(V_BLK, T) @ vals(T, K) is a true MXU matmul —
+    this is the CF accumulation (err * srcVec summed by destination,
+    colfilter_gpu.cu:88-89) in one pass."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(chunk_first_ref[i] == 1)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    dst = dst_ref[:]  # (1, T)
+    vals = vals_ref[0]  # (T, K)
+    t = dst.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (out_ref.shape[1], t), 0)
+    onehot = (iota == dst).astype(jnp.float32)
+    contrib = jax.lax.dot_general(
+        onehot, vals.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (V_BLK, K)
+    out_ref[0] = out_ref[0] + contrib
+
+
+@functools.partial(jax.jit, static_argnames=("v_blk", "num_vblocks", "interpret"))
+def spmv_blockcsr_2d(
+    edge_vals: jnp.ndarray,  # (C, T, K) float32
+    e_dst_rel: jnp.ndarray,  # (C, T) int32
+    chunk_block: jnp.ndarray,
+    chunk_first: jnp.ndarray,
+    v_blk: int = V_BLK,
+    num_vblocks: int | None = None,
+    interpret: bool = False,
+):
+    """Segmented SUM of (C, T, K) values -> (num_vblocks * v_blk, K)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not num_vblocks:
+        raise ValueError("num_vblocks is required (use BlockCSR.num_vblocks)")
+    num_chunks, t, k = edge_vals.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(num_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, t, k), lambda i, cb, cf: (i, 0, 0)),
+            pl.BlockSpec((1, t), lambda i, cb, cf: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, v_blk, k), lambda i, cb, cf: (cb[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_spmv2d_kernel, v_blk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_vblocks, v_blk, k), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(chunk_block, chunk_first, edge_vals, e_dst_rel)
+    return out.reshape(num_vblocks * v_blk, k)
+
+
 def pagerank_step_pallas(bc: BlockCSR, state, degree, nv, alpha=0.15,
                          interpret: bool = False):
     """One PageRank iteration using the kernel (single part).
