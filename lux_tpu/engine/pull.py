@@ -25,6 +25,7 @@ round-trip to hide at all.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Callable, Protocol
 
 import jax
@@ -99,9 +100,11 @@ def _pull_iteration(prog, spec: ShardSpec, method, arrays, state):
 
 def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "scan"):
     """Jitted SINGLE pull iteration over the whole shard stack (verbose
-    mode / step-wise drivers)."""
+    mode / step-wise drivers).  The state buffer is donated — the ping-pong
+    double buffer of the reference (dist_lr[2], core/graph.h:83) without
+    holding both copies."""
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=1)
     def step(arrays, state):
         return _pull_iteration(prog, spec, method, arrays, state)
 

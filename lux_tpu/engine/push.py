@@ -220,9 +220,10 @@ def _compile_push_single(prog, pspec: PushSpec, spec: ShardSpec,
 def compile_push_step(prog, pspec: PushSpec, spec: ShardSpec, method: str = "scan"):
     """Jitted SINGLE iteration (verbose mode / step-wise drivers — the
     per-iteration observability the reference gets from -verbose kernel
-    timers, sssp_gpu.cu:513-518)."""
+    timers, sssp_gpu.cu:513-518).  The carry is donated (state/queue
+    double buffers reuse HBM)."""
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=2)
     def step(arrays, parrays, carry: PushCarry):
         return _push_iteration(prog, pspec, spec, method, arrays, parrays, carry)
 

@@ -2,8 +2,6 @@
 external downloads, README.md:77-86).  Used for tests and benchmarks."""
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from lux_tpu.graph.csc import HostGraph, from_edge_list

@@ -20,7 +20,6 @@ All take static-shape padded inputs from lux_tpu.graph.shards.
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax

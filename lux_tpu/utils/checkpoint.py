@@ -2,9 +2,9 @@
 
 The reference has NO checkpointing (SURVEY.md §5: the USE_HDF knob exists
 but is unused) — this is a capability extension: vertex-state arrays are
-small relative to the graph, so saving (state, iteration, config digest)
-per iteration range is cheap.  NumPy .npz is the always-available format;
-orbax is used when importable (multi-host friendly).
+small relative to the graph, so saving (state, iteration, metadata) per
+iteration range is cheap.  Format: NumPy .npz with atomic rename (no extra
+dependencies; multi-host runs save per-host part slices via the same API).
 """
 from __future__ import annotations
 

@@ -8,7 +8,6 @@ determinism test the reference could never pass") — so byte equality is a
 hard invariant here, including across the distributed paths.
 """
 import numpy as np
-import pytest
 
 from lux_tpu.graph import generate
 from lux_tpu.models import colfilter as cf, components, pagerank as pr, sssp

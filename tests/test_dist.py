@@ -1,6 +1,5 @@
 """Multi-chip execution on the virtual 8-device CPU mesh: distributed
 results must be bitwise-identical in math to the single-device engine."""
-import jax
 import numpy as np
 import pytest
 

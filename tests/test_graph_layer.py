@@ -3,7 +3,7 @@ import numpy as np
 import pytest
 
 from lux_tpu.graph import generate
-from lux_tpu.graph.csc import HostGraph, from_edge_list
+from lux_tpu.graph.csc import from_edge_list
 from lux_tpu.graph.format import read_lux, write_lux
 from lux_tpu.graph.partition import edge_balanced_cuts, part_of_vertex
 from lux_tpu.graph.shards import build_pull_shards
