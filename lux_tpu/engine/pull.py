@@ -111,6 +111,14 @@ def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "scan"):
     return step
 
 
+@partial(jax.jit, static_argnames=("prog", "spec", "num_iters", "method"))
+def _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0):
+    def body(_, state):
+        return _pull_iteration(prog, spec, method, arrays, state)
+
+    return jax.lax.fori_loop(0, num_iters, body, state0)
+
+
 def run_pull_fixed(
     prog: PullProgram,
     spec: ShardSpec,
@@ -120,16 +128,13 @@ def run_pull_fixed(
     method: str = "scan",
 ):
     """Single-device driver: fixed iteration count (PageRank/CF style,
-    pagerank/pagerank.cc:109-114).  Whole loop stays on device.
+    pagerank/pagerank.cc:109-114).  Whole loop stays on device; the
+    compiled program is cached on (prog, spec, num_iters, method).
 
     Returns the final stacked (P, V, ...) state.
     """
     arrays = jax.tree.map(jnp.asarray, arrays)
-
-    def body(_, state):
-        return _pull_iteration(prog, spec, method, arrays, state)
-
-    return jax.lax.fori_loop(0, num_iters, body, state0)
+    return _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0)
 
 
 def run_pull_until(
@@ -145,11 +150,19 @@ def run_pull_until(
     convergence contract — total active count == 0, sssp/sssp.cc:115-129 —
     but with the test on-device instead of 4 iterations behind on the host).
 
-    active_fn(old_stacked, new_stacked) -> per-part active counts (P,).
+    active_fn(old_stacked, new_stacked) -> per-part active counts (P,);
+    pass a top-level function (hashable) so the compiled loop caches.
     Returns (final_state, num_iters_run).
     """
     arrays = jax.tree.map(jnp.asarray, arrays)
+    return _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays, state0)
 
+
+@partial(
+    jax.jit,
+    static_argnames=("prog", "spec", "max_iters", "active_fn", "method"),
+)
+def _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays, state0):
     def cond(carry):
         _, it, active = carry
         return (active > 0) & (it < max_iters)

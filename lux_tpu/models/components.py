@@ -67,6 +67,12 @@ def active_count(old_local, new_local):
     return jnp.sum(old_local != new_local)
 
 
+def active_count_stacked(old_stacked, new_stacked):
+    """(P, V) stacked variant -> (P,) counts (top-level function so jitted
+    convergence loops cache on it)."""
+    return jnp.sum(old_stacked != new_stacked, axis=-1)
+
+
 def connected_components(
     g: HostGraph | PullShards,
     max_iters: int = 10_000,
@@ -79,7 +85,7 @@ def connected_components(
     state0 = pull.init_state(prog, shards.arrays)
     final, _ = pull.run_pull_until(
         prog, shards.spec, shards.arrays, state0, max_iters,
-        lambda old, new: jnp.sum(old != new, axis=-1), method=method,
+        active_count_stacked, method=method,
     )
     return shards.scatter_to_global(np.asarray(final))
 

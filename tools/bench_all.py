@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Full benchmark table: all four apps on synthetic workloads.
+
+Fills the BASELINE.md table (the per-app GTEPS derivations of SURVEY.md §6).
+Unlike bench.py (ONE JSON line for the driver), this prints a markdown
+table.  Usage:
+
+    python tools/bench_all.py [--scale 18] [--parts 1] [--iters 10]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=18)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--parts", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from lux_tpu.graph import generate
+    from lux_tpu.models import colfilter as cf, components, pagerank as pr, sssp
+
+    rows = []
+
+    def timed(name, fn, edges, iters_hint=None):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out) if hasattr(out, "block_until_ready") else None
+        dt = time.perf_counter() - t0
+        gteps = edges / dt / 1e9
+        rows.append((name, dt, gteps))
+        print(f"{name}: {dt:.3f}s  {gteps:.3f} GTEPS", flush=True)
+        return out
+
+    g = generate.rmat(args.scale, args.ef, seed=0)
+    print(f"# graph: rmat{args.scale} nv={g.nv} ne={g.ne} "
+          f"platform={jax.devices()[0].platform} parts={args.parts}")
+
+    # warm with IDENTICAL args: num_iters is a static compile-cache key
+    pr.pagerank(g, args.iters, args.parts)
+    timed("pagerank", lambda: pr.pagerank(g, args.iters, args.parts),
+          args.iters * g.ne)
+    sssp.sssp(g, start=0, num_parts=args.parts)  # warm
+    timed("sssp", lambda: sssp.sssp(g, start=0, num_parts=args.parts), g.ne)
+    components.connected_components_push(g, num_parts=args.parts)  # warm
+    timed("components",
+          lambda: components.connected_components_push(g, num_parts=args.parts),
+          g.ne)
+
+    gw = generate.bipartite_ratings(
+        (1 << args.scale) // 2, (1 << args.scale) // 2,
+        (1 << args.scale) * args.ef // 2, seed=0,
+    )
+    cf.colfilter(gw, args.iters, args.parts)  # warm (same static args)
+    timed("colfilter", lambda: cf.colfilter(gw, args.iters, args.parts),
+          args.iters * gw.ne)
+
+    print("\n| app | seconds | GTEPS |")
+    print("|---|---|---|")
+    for name, dt, gteps in rows:
+        print(f"| {name} | {dt:.3f} | {gteps:.3f} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
