@@ -39,6 +39,9 @@ class CFProgram:
     gamma: float = GAMMA
 
     reduce: str = dataclasses.field(default="sum", init=False)
+    #: the error term reads the destination's current vector per edge, so
+    #: exchanges that pre-combine remotely (reduce_scatter) can't run CF
+    needs_dst_state: bool = dataclasses.field(default=True, init=False)
 
     def init_state(self, global_vid, degree, vtx_mask):
         del degree

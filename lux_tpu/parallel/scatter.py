@@ -1,0 +1,192 @@
+"""Reduce-scatter state exchange: push-partials + psum_scatter.
+
+Third exchange strategy of the communication backend (SURVEY.md §5 plan:
+"reduce_scatter where updates can be pre-combined"), complementing
+all_gather (parallel/dist.py) and the ppermute ring (parallel/ring.py):
+
+  * each chip keeps only its OWN state block resident (like the ring);
+  * chip q computes, from its local sources, partial per-destination
+    accumulations for EVERY destination part p — using the transposed
+    bucket layout (bucket (p, q) = edges from q's sources into p's
+    destinations, the same host build as the ring, distributed by q);
+  * one `lax.psum_scatter` sums partials across chips and hands each chip
+    exactly its own destination block.
+
+Only SUM-reducible programs qualify (PageRank, CF): XLA's fused
+reduce-scatter is addition.  min/max programs use the ring or all_gather.
+
+Compared to all_gather: same wire volume, but no nv-sized gathered buffer
+is ever materialized (peak state O(nv/P + nv partials... the (P, V)
+partial stack is the transient), and the reduction happens inside the
+collective where XLA can fuse it with the transfer.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache, partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from lux_tpu.engine.pull import PullProgram
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.graph.shards import LANE, PullShards, _round_up, build_pull_shards
+from lux_tpu.ops import segment
+from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
+from lux_tpu.parallel.ring import _RingArrView
+
+
+class ScatterArrays(NamedTuple):
+    """Chip q's view: for each destination part p, the edges from q's own
+    sources into p.  Shapes (B = e_bucket_pad):
+      src_local: (P, P, B) int32  source index within MY resident block
+                 (leading axis = destination part p)
+      row_ptr:   (P, P, V+1) int32  per-bucket offsets over p-local dsts
+      head_flag: (P, P, B) bool
+      weights:   (P, P, B) float32
+    """
+
+    src_local: np.ndarray
+    row_ptr: np.ndarray
+    head_flag: np.ndarray
+    weights: np.ndarray
+
+
+@dataclasses.dataclass
+class ScatterShards:
+    pull: PullShards
+    sarrays: ScatterArrays
+    e_bucket_pad: int
+
+    @property
+    def spec(self):
+        return self.pull.spec
+
+    @property
+    def arrays(self):
+        return self.pull.arrays
+
+    def scatter_to_global(self, stacked):
+        return self.pull.scatter_to_global(stacked)
+
+
+def build_scatter_shards(g: HostGraph, num_parts: int) -> ScatterShards:
+    """Transposed bucket build: axis 0 = SOURCE owner q (the chip that
+    stores and computes the bucket), axis 1 = destination part p."""
+    pull = build_pull_shards(g, num_parts)
+    spec, cuts = pull.spec, pull.cuts
+    Pn, V = num_parts, spec.nv_pad
+    dst_of = g.dst_of_edges()
+    owner_of = np.searchsorted(cuts, g.col_idx, side="right") - 1
+
+    buckets = {}
+    max_b = 1
+    for p in range(Pn):  # destination part
+        vlo, vhi = int(cuts[p]), int(cuts[p + 1])
+        elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
+        own = owner_of[elo:ehi]
+        for q in range(Pn):  # source owner
+            sel = np.nonzero(own == q)[0]
+            buckets[q, p] = sel + elo
+            max_b = max(max_b, len(sel))
+    B = _round_up(max_b, LANE)
+
+    src_local = np.zeros((Pn, Pn, B), np.int32)
+    row_ptr = np.zeros((Pn, Pn, V + 1), np.int32)
+    head_flag = np.zeros((Pn, Pn, B), bool)
+    weights = np.zeros((Pn, Pn, B), np.float32)
+    for q in range(Pn):
+        for p in range(Pn):
+            eids = buckets[q, p]
+            m = len(eids)
+            src_local[q, p, :m] = (g.col_idx[eids] - cuts[q]).astype(np.int32)
+            dl = (dst_of[eids] - cuts[p]).astype(np.int64)
+            counts = np.bincount(dl, minlength=V)
+            np.cumsum(counts, out=row_ptr[q, p, 1:])
+            starts = row_ptr[q, p, :-1][row_ptr[q, p, :-1] < row_ptr[q, p, 1:]]
+            head_flag[q, p, starts] = True
+            if g.weights is not None:
+                weights[q, p, :m] = g.weights[eids].astype(np.float32)
+    return ScatterShards(
+        pull=pull,
+        sarrays=ScatterArrays(src_local, row_ptr, head_flag, weights),
+        e_bucket_pad=B,
+    )
+
+
+@lru_cache(maxsize=64)
+def _compile_scatter_fixed(prog, mesh, num_parts: int, num_iters: int,
+                           method: str):
+    assert prog.reduce == "sum", (
+        "reduce_scatter exchange requires a sum-reducible program; "
+        "use the ring or all_gather drivers for min/max"
+    )
+    assert not getattr(prog, "needs_dst_state", False), (
+        "program reads destination state per edge (e.g. CF's error term); "
+        "pre-combined reduce_scatter cannot supply it — use ring/all_gather"
+    )
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            ScatterArrays(*([P(PARTS_AXIS)] * len(ScatterArrays._fields))),
+            P(PARTS_AXIS),  # vtx_mask
+            P(PARTS_AXIS),  # degree
+            P(PARTS_AXIS),  # state
+        ),
+        out_specs=P(PARTS_AXIS),
+    )
+    def run(sarr_blk, vtx_mask_blk, degree_blk, state_blk):
+        sarr = jax.tree.map(lambda a: a[0], sarr_blk)
+        vtx_mask, degree = vtx_mask_blk[0], degree_blk[0]
+
+        def iteration(_, local):
+            V = local.shape[0]
+
+            def partial_for(p):
+                src_state = local[sarr.src_local[p]]
+                # dst_state unavailable pre-combination (remote); sum
+                # programs don't use it
+                vals = prog.edge_value(src_state, sarr.weights[p], None)
+                return segment.segment_sum_csc(
+                    vals, sarr.row_ptr[p], sarr.head_flag[p], method=method
+                )
+
+            partials = jnp.stack(
+                [partial_for(p) for p in range(num_parts)]
+            )  # (P, V, ...)
+            flat = partials.reshape((num_parts * V,) + partials.shape[2:])
+            acc = jax.lax.psum_scatter(
+                flat, PARTS_AXIS, scatter_dimension=0, tiled=True
+            )  # (V, ...): summed partials for MY destinations
+            return prog.apply(
+                local, acc, _RingArrView(vtx_mask=vtx_mask, degree=degree)
+            )
+
+        return jax.lax.fori_loop(0, num_iters, iteration, state_blk[0])[None]
+
+    return run
+
+
+def run_pull_fixed_scatter(
+    prog: PullProgram,
+    shards: ScatterShards,
+    state0,
+    num_iters: int,
+    mesh: Mesh,
+    method: str = "scan",
+):
+    """Distributed fixed-iteration pull with reduce_scatter exchange."""
+    spec = shards.spec
+    assert spec.num_parts == mesh.devices.size
+    sarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.sarrays))
+    vtx_mask = shard_stacked(mesh, jnp.asarray(shards.arrays.vtx_mask))
+    degree = shard_stacked(mesh, jnp.asarray(shards.arrays.degree))
+    state0 = shard_stacked(mesh, state0)
+    run = _compile_scatter_fixed(prog, mesh, spec.num_parts, num_iters, method)
+    return run(sarrays, vtx_mask, degree, state0)
