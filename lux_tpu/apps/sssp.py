@@ -42,19 +42,22 @@ def run_convergence_app(prog, shards, cfg, name: str):
                 carry = step(arrays, parrays, carry)
                 stats.record(it, int(carry.active), t.stop(carry.state))
                 it += 1
-            state, iters = carry.state, it
+            state, iters, edges = carry.state, it, carry.edges
         elif mesh is None:
-            state, iters = push.run_push(prog, shards, cfg.max_iters, cfg.method)
+            state, iters, edges = push.run_push(
+                prog, shards, cfg.max_iters, cfg.method
+            )
         else:
-            state, iters = push.run_push_dist(
+            state, iters, edges = push.run_push_dist(
                 prog, shards, mesh, cfg.max_iters, cfg.method
             )
         elapsed = timer.stop(state)
     iters = int(iters)
     print(f"{name} converged in {iters} iterations")
-    # Frontier apps traverse each edge ~once over the whole run (BASELINE.md
-    # metric note): report GTEPS on ne, identically in all modes.
-    report_elapsed(elapsed, shards.spec.ne, iters, traversed=shards.spec.ne)
+    # GTEPS on edges ACTUALLY traversed (dense rounds walk every edge,
+    # sparse rounds only the frontier's) — the reference's per-iteration
+    # traversal accounting, SURVEY.md §6.
+    report_elapsed(elapsed, shards.spec.ne, iters, traversed=int(edges))
     return shards.scatter_to_global(np.asarray(state))
 
 

@@ -134,6 +134,9 @@ class PushCarry(NamedTuple):
     count: Any
     it: Any
     active: Any
+    #: edges actually traversed so far (float32: metrics only — the
+    #: reference's per-iteration traversal accounting, SURVEY.md §6)
+    edges: Any
 
 
 def _init_carry(prog, pspec, arrays):
@@ -147,7 +150,10 @@ def _init_carry(prog, pspec, arrays):
     q_vid, q_val, cnt = jax.vmap(partial(build_queue, pspec))(
         arrays, mask0, state0
     )
-    return PushCarry(state0, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1))
+    return PushCarry(
+        state0, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1),
+        jnp.float32(0.0),
+    )
 
 
 def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
@@ -195,7 +201,13 @@ def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
         arrays, changed, new
     )
     active = jnp.sum(cnt)
-    return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active)
+    # traversal accounting (SURVEY.md §6): dense walks every real edge,
+    # sparse walks the frontier's out-edges (the preps totals)
+    sparse_edges = jnp.stack([t for (_, _, _, t) in preps]).sum()
+    edges = c.edges + jnp.where(
+        use_dense, jnp.float32(spec.ne), sparse_edges.astype(jnp.float32)
+    )
+    return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active, edges)
 
 
 @lru_cache(maxsize=64)
@@ -253,7 +265,7 @@ def run_push(
     carry0 = _init_carry(prog, pspec, arrays)
     loop = _compile_push_single(prog, pspec, spec, max_iters, method)
     out = loop(arrays, parrays, carry0)
-    return out.state, out.it
+    return out.state, out.it, out.edges
 
 
 @lru_cache(maxsize=64)
@@ -261,14 +273,14 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                        max_iters: int, method: str):
     arr_specs = ShardArrays(*([P(PARTS_AXIS)] * len(ShardArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
-    carry_specs = PushCarry(*([P(PARTS_AXIS)] * 4), P(), P())
+    carry_specs = PushCarry(*([P(PARTS_AXIS)] * 4), P(), P(), P())
 
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(arr_specs, parr_specs, carry_specs),
-        out_specs=(P(PARTS_AXIS), P()),
+        out_specs=(P(PARTS_AXIS), P(), P()),
     )
     def run(arr_blk, parr_blk, carry_blk):
         arr = jax.tree.map(lambda a: a[0], arr_blk)
@@ -319,14 +331,19 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
             changed = (new != local) & arr.vtx_mask
             q_vid, q_val, cnt = build_queue(pspec, arr, changed, new)
             active = jax.lax.psum(cnt, PARTS_AXIS)
-            return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active)
+            g_total = jax.lax.psum(total.astype(jnp.float32), PARTS_AXIS)
+            edges = c.edges + jnp.where(
+                use_dense, jnp.float32(spec.ne), g_total
+            )
+            return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active, edges)
 
         c0 = PushCarry(
             carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
             carry_blk.count[0], carry_blk.it, carry_blk.active,
+            carry_blk.edges,
         )
         out = jax.lax.while_loop(cond, body, c0)
-        return out.state[None], out.it
+        return out.state[None], out.it, out.edges
 
     return run
 
@@ -346,7 +363,8 @@ def run_push_dist(
     parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
     carry0 = _init_carry(prog, pspec, jax.tree.map(jnp.asarray, shards.arrays))
     carry0 = PushCarry(
-        *shard_stacked(mesh, tuple(carry0[:4])), carry0.it, carry0.active
+        *shard_stacked(mesh, tuple(carry0[:4])), carry0.it, carry0.active,
+        carry0.edges,
     )
     run = _compile_push_dist(prog, mesh, pspec, spec, max_iters, method)
     return run(arrays, parrays, carry0)

@@ -105,9 +105,9 @@ def connected_components_push(
     shards = build_push_shards(g, num_parts)
     prog = MaxLabelProgram()
     if mesh is None:
-        final, _ = push_engine.run_push(prog, shards, max_iters, method=method)
+        final, _, _ = push_engine.run_push(prog, shards, max_iters, method=method)
     else:
-        final, _ = push_engine.run_push_dist(
+        final, _, _ = push_engine.run_push_dist(
             prog, shards, mesh, max_iters, method=method
         )
     return shards.scatter_to_global(np.asarray(final))

@@ -100,9 +100,9 @@ def sssp(
     cls = WeightedSSSPProgram if weighted else SSSPProgram
     prog = cls(nv=shards.spec.nv, start=start)
     if mesh is None:
-        final, _ = push.run_push(prog, shards, max_iters, method=method)
+        final, _, _ = push.run_push(prog, shards, max_iters, method=method)
     else:
-        final, _ = push.run_push_dist(prog, shards, mesh, max_iters, method=method)
+        final, _, _ = push.run_push_dist(prog, shards, mesh, max_iters, method=method)
     return shards.scatter_to_global(np.asarray(final))
 
 

@@ -66,14 +66,14 @@ def test_sssp_forced_sparse_and_dense_agree():
     sh_dense = build_push_shards(g, 1)
     sh_dense.pspec = dataclasses.replace(sh_dense.pspec, pull_threshold_den=g.nv + 1)
     prog = sssp.SSSPProgram(nv=g.nv, start=5)
-    dense_final, _ = push.run_push(prog, sh_dense)
+    dense_final, _, _ = push.run_push(prog, sh_dense)
     np.testing.assert_array_equal(sh_dense.scatter_to_global(np.asarray(dense_final)), want)
     # force-sparse: huge threshold denominator -> frontier never > nv/1;
     # big queue and edge buffer so no overflow fallback
     sh_sparse = build_push_shards(g, 1, f_cap=sh_dense.spec.nv_pad,
                                   e_sp=sh_dense.spec.e_pad)
     sh_sparse.pspec = dataclasses.replace(sh_sparse.pspec, pull_threshold_den=1)
-    sparse_final, _ = push.run_push(prog, sh_sparse)
+    sparse_final, _, _ = push.run_push(prog, sh_sparse)
     np.testing.assert_array_equal(
         sh_sparse.scatter_to_global(np.asarray(sparse_final)), want
     )
@@ -84,7 +84,7 @@ def test_sssp_overflow_falls_back_dense():
     g = generate.rmat(9, 8, seed=34)
     sh = build_push_shards(g, 1, f_cap=128, e_sp=256)
     prog = sssp.SSSPProgram(nv=g.nv, start=0)
-    final, _ = push.run_push(prog, sh)
+    final, _, _ = push.run_push(prog, sh)
     np.testing.assert_array_equal(
         sh.scatter_to_global(np.asarray(final)), sssp.bfs_reference(g, 0)
     )

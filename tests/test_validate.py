@@ -13,7 +13,7 @@ def test_device_sssp_check_clean():
     from lux_tpu.engine import push
 
     prog = sssp.SSSPProgram(nv=g.nv, start=0)
-    state, _ = push.run_push(prog, shards)
+    state, _, _ = push.run_push(prog, shards)
     n = validate.count_violations(
         shards.pull, state, validate.sssp_violation(inf=prog.inf)
     )
@@ -28,7 +28,7 @@ def test_device_sssp_check_detects_corruption():
     from lux_tpu.engine import push
 
     prog = sssp.SSSPProgram(nv=g.nv, start=0)
-    state, _ = push.run_push(prog, shards)
+    state, _, _ = push.run_push(prog, shards)
     bad = np.asarray(state).copy()
     # corrupt: claim some far vertex is at distance 0 while its in-nbrs are far
     dist_g = shards.scatter_to_global(bad)
@@ -54,7 +54,7 @@ def test_device_cc_check():
     from lux_tpu.engine import push
 
     prog = components.MaxLabelProgram()
-    state, _ = push.run_push(prog, shards)
+    state, _, _ = push.run_push(prog, shards)
     assert validate.count_violations(shards.pull, state, validate.cc_violation()) == 0
     # corrupt one label downward -> violations appear and counts match host
     bad = np.asarray(state).copy()
