@@ -82,16 +82,20 @@ def build_scatter_shards(g: HostGraph, num_parts: int) -> ScatterShards:
     dst_of = g.dst_of_edges()
     owner_of = np.searchsorted(cuts, g.col_idx, side="right") - 1
 
+    # single stable argsort by source owner per destination slice (not a
+    # P-fold re-scan)
     buckets = {}
     max_b = 1
     for p in range(Pn):  # destination part
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
         own = owner_of[elo:ehi]
+        order = np.argsort(own, kind="stable")
+        counts = np.bincount(own, minlength=Pn)
+        splits = np.split(order, np.cumsum(counts)[:-1])
         for q in range(Pn):  # source owner
-            sel = np.nonzero(own == q)[0]
-            buckets[q, p] = sel + elo
-            max_b = max(max_b, len(sel))
+            buckets[q, p] = splits[q] + elo
+            max_b = max(max_b, len(splits[q]))
     B = _round_up(max_b, LANE)
 
     src_local = np.zeros((Pn, Pn, B), np.int32)
@@ -184,6 +188,10 @@ def run_pull_fixed_scatter(
     """Distributed fixed-iteration pull with reduce_scatter exchange."""
     spec = shards.spec
     assert spec.num_parts == mesh.devices.size
+    assert method in ("scan", "cumsum"), (
+        "scatter-shard buckets carry no dst_local ids; "
+        "use method='scan' (default) or 'cumsum'"
+    )
     sarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.sarrays))
     vtx_mask = shard_stacked(mesh, jnp.asarray(shards.arrays.vtx_mask))
     degree = shard_stacked(mesh, jnp.asarray(shards.arrays.degree))

@@ -2,7 +2,7 @@ import os, sys
 pid = int(sys.argv[1]); nproc = int(sys.argv[2])
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from lux_tpu.parallel import multihost
 me = multihost.initialize("127.0.0.1:29517", nproc, pid)
 import jax
@@ -32,7 +32,9 @@ arrays = jax.tree.map(
     lambda a: mh.assemble_global(mesh, a[mine], P), shards.arrays
 )
 out = dist.run_pull_fixed_dist(prog, shards.spec, arrays, state0, 5, mesh)
-local = np.concatenate([np.asarray(s.data)[0][None] for s in out.addressable_shards])
+# addressable_shards order is not guaranteed to follow the parts axis
+shards_sorted = sorted(out.addressable_shards, key=lambda s: s.index[0].start)
+local = np.concatenate([np.asarray(s.data)[0][None] for s in shards_sorted])
 # verify my local parts against the oracle
 want = pagerank_reference(g, 5)
 for i, p in enumerate(mine):

@@ -23,9 +23,16 @@ def test_two_process_distributed_pagerank():
         for pid in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=220)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=220)
+            outs.append(out)
+    finally:
+        # never leak workers: a deadlocked pair would keep the coordinator
+        # port bound and wedge every later run
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert f"process {pid}: multihost pagerank OK" in out
