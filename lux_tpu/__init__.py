@@ -11,6 +11,7 @@ XLA collectives over ICI) rather than Legion/GASNet/CUDA.
 from lux_tpu.graph.csc import HostGraph, from_edge_list
 from lux_tpu.graph.format import read_lux, read_lux_range, write_lux
 from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.graph.sharded_load import load_pull_shards
 from lux_tpu.graph.shards import build_pull_shards
 
 __version__ = "0.1.0"

@@ -78,7 +78,10 @@ def read_lux(path: str, weighted: Optional[bool] = None, mmap: bool = True) -> H
         nv=nv,
         ne=ne,
         row_ptr=row_ptr,
-        col_idx=np.asarray(col_idx).astype(np.int32),
+        # zero-copy reinterpret (u4 -> i4, same itemsize): with mmap=True
+        # the O(ne) arrays stay file-backed — the streaming loaders depend
+        # on this never materializing
+        col_idx=np.asarray(col_idx).view(np.int32),
         weights=None if weights is None else np.asarray(weights),
     )
 
@@ -97,7 +100,8 @@ def write_lux(path: str, g: HostGraph) -> None:
 
 
 def read_lux_range(path: str, row_lo: int, row_hi: int,
-                   weighted: Optional[bool] = None):
+                   weighted: Optional[bool] = None,
+                   header: Optional[HostGraph] = None):
     """Read one partition's slice of a `.lux` file: the per-host sharded
     load (equivalent of pull_load_task_impl's partial fseeko/fread,
     core/pull_model.inl:253-320 — every host reads only its vertex range).
@@ -105,9 +109,13 @@ def read_lux_range(path: str, row_lo: int, row_hi: int,
     Returns (row_ptr_local (n+1,) int64 rebased to 0, col_idx (m,) int32,
     weights (m,) int32 | None) for vertices [row_lo, row_hi).
 
-    Uses the native pread loader (lux_tpu.native) when built, else mmap.
+    Pass ``header`` (a prior mmap read_lux result) to avoid re-reading the
+    header/offsets per call.  Uses the native pread loader (lux_tpu.native)
+    when built, else mmap.
     """
-    g_header = read_lux(path, weighted=weighted, mmap=True)
+    g_header = header if header is not None else read_lux(
+        path, weighted=weighted, mmap=True
+    )
     nv, ne = g_header.nv, g_header.ne
     assert 0 <= row_lo <= row_hi <= nv
     col_lo = int(g_header.row_ptr[row_lo])

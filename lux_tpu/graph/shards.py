@@ -34,7 +34,7 @@ from typing import NamedTuple, Optional
 import numpy as np
 
 from lux_tpu.graph.csc import HostGraph
-from lux_tpu.graph.partition import edge_balanced_cuts, part_of_vertex
+from lux_tpu.graph.partition import edge_balanced_cuts
 
 LANE = 128  # TPU vector lane width; pad 1-D extents to multiples of this.
 
@@ -121,23 +121,15 @@ class PullShards:
         return out
 
 
-def build_pull_shards(
-    g: HostGraph,
-    num_parts: int,
-    degrees: Optional[np.ndarray] = None,
-) -> PullShards:
-    """Partition + pad a HostGraph into device-ready pull-model shards."""
-    cuts = edge_balanced_cuts(g.row_ptr, num_parts)
-    P = num_parts
+def shard_geometry(row_ptr_global: np.ndarray, num_parts: int, nv: int):
+    """(cuts, nv_pad, e_pad) for edge-balanced padded shards, with the
+    int32-range guards (global E_ID stays int64 on host, like the
+    reference's uint64 E_ID / uint32 V_ID split, pagerank/app.h:21-22)."""
+    cuts = edge_balanced_cuts(row_ptr_global, num_parts)
     nv_counts = np.diff(cuts)
-    e_counts = g.row_ptr[cuts[1:]] - g.row_ptr[cuts[:-1]]
+    e_counts = row_ptr_global[cuts[1:]] - row_ptr_global[cuts[:-1]]
     nv_pad = max(LANE, _round_up(int(nv_counts.max()), LANE))
     e_pad = max(LANE, _round_up(int(e_counts.max()) or 1, LANE))
-    if degrees is None:
-        degrees = g.out_degrees()
-    # int32 device indices: per-part edge slices and the gathered-state extent
-    # must fit (global E_ID stays int64 on host, like the reference's
-    # uint64 E_ID / uint32 V_ID split, pagerank/app.h:21-22).
     if int(e_counts.max()) >= 2**31:
         raise ValueError(
             f"a part holds {int(e_counts.max())} edges >= 2^31; "
@@ -145,59 +137,92 @@ def build_pull_shards(
         )
     if num_parts * nv_pad >= 2**31:
         raise ValueError("num_parts * nv_pad exceeds int32 gather range")
-    owner = part_of_vertex(cuts, g.col_idx)  # (ne,) owning part of each src
-    dst_of = g.dst_of_edges()
+    del nv
+    return cuts, nv_pad, e_pad
 
-    row_ptr = np.zeros((P, nv_pad + 1), dtype=np.int32)
-    src_pos = np.zeros((P, e_pad), dtype=np.int32)
-    dst_local = np.zeros((P, e_pad), dtype=np.int32)
-    head_flag = np.zeros((P, e_pad), dtype=bool)
-    edge_mask = np.zeros((P, e_pad), dtype=bool)
-    vtx_mask = np.zeros((P, nv_pad), dtype=bool)
-    degree = np.zeros((P, nv_pad), dtype=np.int32)
-    global_vid = np.zeros((P, nv_pad), dtype=np.int32)
-    weights = np.zeros((P, e_pad), dtype=np.float32)
 
-    for p in range(P):
+def alloc_arrays(num_rows: int, nv_pad: int, e_pad: int) -> ShardArrays:
+    """Zeroed stacked arrays for ``num_rows`` parts."""
+    return ShardArrays(
+        row_ptr=np.zeros((num_rows, nv_pad + 1), np.int32),
+        src_pos=np.zeros((num_rows, e_pad), np.int32),
+        dst_local=np.full((num_rows, e_pad), nv_pad, np.int32),
+        head_flag=np.zeros((num_rows, e_pad), bool),
+        edge_mask=np.zeros((num_rows, e_pad), bool),
+        vtx_mask=np.zeros((num_rows, nv_pad), bool),
+        degree=np.zeros((num_rows, nv_pad), np.int32),
+        global_vid=np.zeros((num_rows, nv_pad), np.int32),
+        weights=np.zeros((num_rows, e_pad), np.float32),
+    )
+
+
+def fill_part(
+    arrays: ShardArrays,
+    i: int,
+    vlo: int,
+    vhi: int,
+    rp_local: np.ndarray,
+    srcs: np.ndarray,
+    w: Optional[np.ndarray],
+    cuts: np.ndarray,
+    nv_pad: int,
+    nv: int,
+    degrees_slice: np.ndarray,
+) -> None:
+    """Fill stacked-row ``i`` with one part's data.
+
+    rp_local: (n+1,) local offsets with leading 0; srcs: (m,) global source
+    ids; degrees_slice: (n,) out-degrees of [vlo, vhi).  Shared by the
+    in-memory builder and the streaming file loader so the encodings can
+    never diverge.
+    """
+    n, m = vhi - vlo, len(srcs)
+    rp = np.asarray(rp_local, np.int32)
+    arrays.row_ptr[i, : n + 1] = rp
+    arrays.row_ptr[i, n + 1 :] = m  # padded vertices: empty tail ranges
+    srcs64 = np.asarray(srcs, np.int64)
+    own = (np.searchsorted(cuts, srcs64, side="right") - 1).astype(np.int64)
+    arrays.src_pos[i, :m] = (own * nv_pad + (srcs64 - cuts[own])).astype(np.int32)
+    arrays.dst_local[i, :m] = np.repeat(
+        np.arange(n, dtype=np.int32), np.diff(rp[: n + 1])
+    )
+    starts = rp[:n][rp[:n] < rp[1 : n + 1]]
+    arrays.head_flag[i, starts] = True
+    arrays.edge_mask[i, :m] = True
+    arrays.vtx_mask[i, :n] = True
+    arrays.degree[i, :n] = degrees_slice
+    arrays.global_vid[i, :n] = np.arange(vlo, vhi, dtype=np.int32)
+    arrays.global_vid[i, n:] = nv - 1
+    if w is not None:
+        arrays.weights[i, :m] = np.asarray(w, np.float32)
+
+
+def build_pull_shards(
+    g: HostGraph,
+    num_parts: int,
+    degrees: Optional[np.ndarray] = None,
+) -> PullShards:
+    """Partition + pad a HostGraph into device-ready pull-model shards."""
+    cuts, nv_pad, e_pad = shard_geometry(g.row_ptr, num_parts, g.nv)
+    if degrees is None:
+        degrees = g.out_degrees()
+    arrays = alloc_arrays(num_parts, nv_pad, e_pad)
+    for p in range(num_parts):
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
-        n, m = vhi - vlo, ehi - elo
-        rp = (g.row_ptr[vlo : vhi + 1] - elo).astype(np.int32)
-        row_ptr[p, : n + 1] = rp
-        row_ptr[p, n + 1 :] = m  # padded vertices: empty ranges at the end
-        srcs = g.col_idx[elo:ehi].astype(np.int64)
-        own = owner[elo:ehi].astype(np.int64)
-        src_pos[p, :m] = (own * nv_pad + (srcs - cuts[own])).astype(np.int32)
-        dl = (dst_of[elo:ehi] - vlo).astype(np.int32)
-        dst_local[p, :m] = dl
-        dst_local[p, m:] = nv_pad
-        starts = rp[:-1][rp[:-1] < rp[1:]]
-        head_flag[p, starts] = True
-        edge_mask[p, :m] = True
-        vtx_mask[p, :n] = True
-        degree[p, :n] = degrees[vlo:vhi]
-        global_vid[p, :n] = np.arange(vlo, vhi, dtype=np.int32)
-        global_vid[p, n:] = g.nv - 1
-        if g.weights is not None:
-            weights[p, :m] = g.weights[elo:ehi].astype(np.float32)
-
+        fill_part(
+            arrays, p, vlo, vhi,
+            g.row_ptr[vlo : vhi + 1] - elo,
+            g.col_idx[elo:ehi],
+            None if g.weights is None else g.weights[elo:ehi],
+            cuts, nv_pad, g.nv, degrees[vlo:vhi],
+        )
     spec = ShardSpec(
-        num_parts=P,
+        num_parts=num_parts,
         nv=g.nv,
         ne=g.ne,
         nv_pad=nv_pad,
         e_pad=e_pad,
         weighted=g.weights is not None,
-    )
-    arrays = ShardArrays(
-        row_ptr=row_ptr,
-        src_pos=src_pos,
-        dst_local=dst_local,
-        head_flag=head_flag,
-        edge_mask=edge_mask,
-        vtx_mask=vtx_mask,
-        degree=degree,
-        global_vid=global_vid,
-        weights=weights,
     )
     return PullShards(spec=spec, arrays=arrays, cuts=cuts)
