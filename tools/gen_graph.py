@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Generate a synthetic graph as a `.lux` file (RMAT / uniform / bipartite
+ratings).  The reference points at externally-hosted datasets
+(README.md:77-86) that a sealed environment cannot fetch; this tool makes
+workload-shaped substitutes.
+
+    python tools/gen_graph.py rmat --scale 20 --ef 16 -o rmat20.lux
+    python tools/gen_graph.py ratings --users 500000 --items 18000 \
+        --ratings 2000000 -o netflixish.lux
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="kind", required=True)
+    r = sub.add_parser("rmat")
+    r.add_argument("--scale", type=int, required=True)
+    r.add_argument("--ef", type=int, default=16)
+    r.add_argument("--weighted", action="store_true")
+    u = sub.add_parser("uniform")
+    u.add_argument("--nv", type=int, required=True)
+    u.add_argument("--ne", type=int, required=True)
+    u.add_argument("--weighted", action="store_true")
+    b = sub.add_parser("ratings")
+    b.add_argument("--users", type=int, required=True)
+    b.add_argument("--items", type=int, required=True)
+    b.add_argument("--ratings", type=int, required=True)
+    for p in (r, u, b):
+        p.add_argument("-o", "--output", required=True)
+        p.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.format import write_lux
+
+    if args.kind == "rmat":
+        g = generate.rmat(args.scale, args.ef, seed=args.seed,
+                          weighted=args.weighted)
+    elif args.kind == "uniform":
+        g = generate.uniform_random(args.nv, args.ne, seed=args.seed,
+                                    weighted=args.weighted)
+    else:
+        g = generate.bipartite_ratings(args.users, args.items, args.ratings,
+                                       seed=args.seed)
+    write_lux(args.output, g)
+    print(f"wrote {args.output}: nv={g.nv} ne={g.ne}"
+          + (" (weighted)" if g.weighted else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
