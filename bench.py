@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -120,16 +121,18 @@ def main():
         try:
             results[m] = timed(m)
         except Exception as e:  # noqa: BLE001 — a method may be unsupported
-            print(f"# method {m} failed: {e}", flush=True)
+            print(f"# method {m} failed: {e}", file=sys.stderr, flush=True)
     if not results:
         raise RuntimeError(f"all benchmark methods failed: {methods}")
     method, (elapsed, out) = min(results.items(), key=lambda kv: kv[1][0])
     gteps = iters * g.ne / elapsed / 1e9
 
     platform = jax.devices()[0].platform
+    # diagnostics on stderr: stdout carries EXACTLY one JSON line
     print(
         f"# platform={platform} nv={g.nv} ne={g.ne} iters={iters} "
         f"method={method} dtype={dtype} elapsed={elapsed:.4f}s",
+        file=sys.stderr,
         flush=True,
     )
     print(
