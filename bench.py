@@ -23,8 +23,6 @@ import os
 import sys
 import time
 
-import numpy as np
-
 # Paper-era Lux runs ~1 GTEPS/GPU-class-chip on PageRank per the PVLDB paper
 # family of results; the repo itself publishes nothing (BASELINE.md).
 BASELINE_GTEPS_PER_CHIP = 1.0
