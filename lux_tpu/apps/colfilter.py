@@ -16,7 +16,7 @@ from lux_tpu.graph.shards import build_pull_shards
 from lux_tpu.models import colfilter as cf_model
 from lux_tpu.utils import preflight
 from lux_tpu.utils.config import parse_args
-from lux_tpu.utils.timing import IterStats, Timer, report_elapsed
+from lux_tpu.utils.timing import Timer, report_elapsed
 
 
 def main(argv=None):
@@ -37,12 +37,9 @@ def main(argv=None):
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
         if cfg.verbose and mesh is None:
-            step = pull.compile_pull_step(prog, shards.spec, cfg.method)
-            stats = IterStats(verbose=True)
-            for it in range(cfg.num_iters):
-                t = Timer()
-                state = step(arrays, state)
-                stats.record(it, g.nv, t.stop(state))
+            state, _ = common.run_pull_stepwise(
+                prog, shards.spec, arrays, state, 0, cfg.num_iters, cfg, g.nv
+            )
         elif mesh is None:
             state = pull.run_pull_fixed(
                 prog, shards.spec, arrays, state, cfg.num_iters, cfg.method

@@ -42,6 +42,41 @@ def make_mesh_if(cfg: RunConfig):
     return make_mesh(cfg.num_parts)
 
 
+def run_pull_stepwise(prog, spec, arrays, state, start_it, num_iters, cfg,
+                      nv, on_iter=None):
+    """Step-wise pull loop for -verbose / -ckpt-every runs.  Verbose mode
+    fences each iteration into load/comp/update sub-steps (the reference's
+    per-phase kernel timers, sssp_gpu.cu:513-518); otherwise the iteration
+    runs as one jitted step.  Returns (final_state, IterStats)."""
+    from lux_tpu.engine import pull
+    from lux_tpu.utils.timing import IterStats, Timer
+
+    stats = IterStats(verbose=cfg.verbose)
+    if cfg.verbose:
+        load, comp, update = pull.compile_pull_phases(prog, spec, cfg.method)
+    else:
+        step = pull.compile_pull_step(prog, spec, cfg.method)
+    for it in range(start_it, num_iters):
+        if cfg.verbose:
+            t = Timer()
+            gath = load(arrays, state)
+            lt = t.stop(gath)
+            t = Timer()
+            acc = comp(arrays, gath)
+            ct = t.stop(acc)
+            t = Timer()
+            state = update(arrays, state, acc)
+            ut = t.stop(state)
+            stats.record_phases(it, nv, lt, ct, ut)
+        else:
+            t = Timer()
+            state = step(arrays, state)
+            stats.record(it, nv, t.stop(state))
+        if on_iter is not None:
+            on_iter(it, state)
+    return state, stats
+
+
 def print_check(name: str, violations: int):
     """Reference-parity [PASS]/[FAIL] verdict (sssp_gpu.cu:837-842)."""
     verdict = "[PASS]" if violations == 0 else "[FAIL]"

@@ -16,7 +16,7 @@ from lux_tpu.graph.shards import build_pull_shards
 from lux_tpu.models.pagerank import PageRankProgram
 from lux_tpu.utils import preflight
 from lux_tpu.utils.config import parse_args
-from lux_tpu.utils.timing import IterStats, Timer, report_elapsed
+from lux_tpu.utils.timing import Timer, report_elapsed
 
 
 def main(argv=None):
@@ -49,20 +49,20 @@ def main(argv=None):
         if (cfg.verbose or cfg.ckpt_every) and mesh is None:
             from lux_tpu.utils import checkpoint
 
-            step = pull.compile_pull_step(prog, shards.spec, cfg.method)
-            stats = IterStats(verbose=cfg.verbose)
-            for it in range(start_it, cfg.num_iters):
-                t = Timer()
-                state = step(arrays, state)
-                stats.record(it, g.nv, t.stop(state))
+            def on_iter(it, st):
                 if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
                     import os
 
                     os.makedirs(cfg.ckpt_dir, exist_ok=True)
                     checkpoint.save(
                         os.path.join(cfg.ckpt_dir, f"ckpt_{it + 1}.npz"),
-                        jax.device_get(state), it + 1, {"app": "pagerank"},
+                        jax.device_get(st), it + 1, {"app": "pagerank"},
                     )
+
+            state, _ = common.run_pull_stepwise(
+                prog, shards.spec, arrays, state, start_it, cfg.num_iters,
+                cfg, g.nv, on_iter,
+            )
         elif mesh is None:
             state = pull.run_pull_fixed(
                 prog, shards.spec, arrays, state, cfg.num_iters - start_it,

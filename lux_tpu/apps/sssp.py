@@ -32,15 +32,22 @@ def run_convergence_app(prog, shards, cfg, name: str):
         timer = Timer()
         if cfg.verbose and mesh is None:
             arrays, parrays, carry = push.push_init(prog, shards)
-            step = push.compile_push_step(
+            load, comp, update = push.compile_push_phases(
                 prog, shards.pspec, shards.spec, cfg.method
             )
             stats = IterStats(verbose=True)
             it = 0
             while int(carry.active) > 0 and it < cfg.max_iters:
                 t = Timer()
-                carry = step(arrays, parrays, carry)
-                stats.record(it, int(carry.active), t.stop(carry.state))
+                plan = load(parrays, carry)
+                lt = t.stop(plan)
+                t = Timer()
+                new = comp(arrays, parrays, carry, plan)
+                ct = t.stop(new)
+                t = Timer()
+                carry = update(arrays, carry, new, plan)
+                ut = t.stop(carry)
+                stats.record_phases(it, int(carry.active), lt, ct, ut)
                 it += 1
             state, iters, edges = carry.state, it, carry.edges
         elif mesh is None:
@@ -57,7 +64,7 @@ def run_convergence_app(prog, shards, cfg, name: str):
     # GTEPS on edges ACTUALLY traversed (dense rounds walk every edge,
     # sparse rounds only the frontier's) — the reference's per-iteration
     # traversal accounting, SURVEY.md §6.
-    report_elapsed(elapsed, shards.spec.ne, iters, traversed=int(edges))
+    report_elapsed(elapsed, shards.spec.ne, iters, traversed=push.edges_total(edges))
     return shards.scatter_to_global(np.asarray(state))
 
 

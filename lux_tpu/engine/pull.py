@@ -111,6 +111,53 @@ def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "scan"):
     return step
 
 
+def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "scan"):
+    """One pull iteration as THREE separately-jitted, fence-able sub-steps
+    — the per-phase observability of the reference's -verbose kernel timers
+    (loadTime/compTime/updateTime, sssp_gpu.cu:513-518):
+
+      load(arrays, state)          -> per-edge gathered (src, dst) states
+                                      (the replicated-state HBM read phase)
+      comp(arrays, gath)           -> per-destination reduced accumulators
+                                      (edge_value + segmented reduction)
+      update(arrays, state, acc)   -> new state (apply)
+
+    Fencing between phases costs dispatch latency and blocks cross-phase
+    fusion — this is the observability path; run_pull_fixed is the perf
+    path.  Returns (load, comp, update).
+    """
+
+    @jax.jit
+    def load(arrays, state):
+        full = state.reshape((spec.gathered_size,) + state.shape[2:])
+
+        def f(arr: ShardArrays, local):
+            src_state = full[arr.src_pos]
+            dst_state = local[jnp.clip(arr.dst_local, 0, local.shape[0] - 1)]
+            return src_state, dst_state
+
+        return jax.vmap(f)(arrays, state)
+
+    @jax.jit
+    def comp(arrays, gathered):
+        def f(arr: ShardArrays, gath):
+            src_state, dst_state = gath
+            vals = prog.edge_value(src_state, arr.weights, dst_state)
+            return _REDUCERS[prog.reduce](
+                vals, arr.row_ptr, arr.head_flag, arr.dst_local, method=method
+            )
+
+        return jax.vmap(f)(arrays, gathered)
+
+    @partial(jax.jit, donate_argnums=1)
+    def update(arrays, state, acc):
+        return jax.vmap(lambda arr, local, a: prog.apply(local, a, arr))(
+            arrays, state, acc
+        )
+
+    return load, comp, update
+
+
 @partial(jax.jit, static_argnames=("prog", "spec", "num_iters", "method"))
 def _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0):
     def body(_, state):

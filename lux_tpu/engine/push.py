@@ -134,9 +134,38 @@ class PushCarry(NamedTuple):
     count: Any
     it: Any
     active: Any
-    #: edges actually traversed so far (float32: metrics only — the
-    #: reference's per-iteration traversal accounting, SURVEY.md §6)
+    #: edges actually traversed so far, EXACT: a (2,) uint32 [hi, lo] pair
+    #: (x64 is disabled under jit and float32 absorbs increments past 2^24;
+    #: the reference's per-iteration traversal accounting, SURVEY.md §6)
     edges: Any
+
+
+def _acc_edges(edges, dense_ne: int, sparse_total, use_dense):
+    """64-bit add into the [hi, lo] uint32 pair.  ``dense_ne`` is the static
+    whole-graph edge count (may exceed 2^32: split at trace time);
+    ``sparse_total`` is this round's traversed count, < 2^32 by construction
+    (any part whose frontier out-edges exceed e_sp forces the dense mode)."""
+    d_hi = jnp.where(use_dense, jnp.uint32(dense_ne >> 32), jnp.uint32(0))
+    d_lo = jnp.where(
+        use_dense,
+        jnp.uint32(dense_ne & 0xFFFFFFFF),
+        sparse_total.astype(jnp.uint32),
+    )
+    lo = edges[1] + d_lo  # wraps mod 2^32
+    hi = edges[0] + d_hi + (lo < edges[1]).astype(jnp.uint32)
+    return jnp.stack([hi, lo])
+
+
+def _zero_edges():
+    return jnp.zeros((2,), jnp.uint32)
+
+
+def edges_total(edges) -> int:
+    """Exact Python int from the device-side [hi, lo] accumulator."""
+    import numpy as np
+
+    hi, lo = np.asarray(edges).astype(np.uint64)
+    return int((hi << np.uint64(32)) | lo)
 
 
 def _init_carry(prog, pspec, arrays):
@@ -152,50 +181,69 @@ def _init_carry(prog, pspec, arrays):
     )
     return PushCarry(
         state0, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1),
-        jnp.float32(0.0),
+        _zero_edges(),
     )
 
 
-def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
-                    arrays, parrays, c: PushCarry) -> PushCarry:
-    """One direction-optimized iteration over all parts (single device)."""
-    P_, V = spec.num_parts, spec.nv_pad
-    g_cnt = jnp.sum(c.count)
-    overflow = jnp.any(c.count > pspec.f_cap)
+def _push_prep(pspec: PushSpec, spec: ShardSpec, parrays, c: PushCarry):
+    """LOAD phase: flatten the frontier queues and plan each part's sparse
+    out-edge walk (vmap over parts); decide the global direction.  Returns
+    (q_vids_all, q_vals_all, (rows, counts, incl, totals) stacked (P, ...),
+    use_dense)."""
+    P_ = spec.num_parts
     q_vids_all = c.q_vid.reshape(P_ * pspec.f_cap)
     q_vals_all = c.q_val.reshape(P_ * pspec.f_cap)
-    preps = [
-        sparse_prep(jax.tree.map(lambda a: a[p], parrays), q_vids_all)
-        for p in range(P_)
-    ]
-    edge_overflow = jnp.stack([t for (_, _, _, t) in preps]).max() > pspec.e_sp
+    preps = jax.vmap(lambda parr: sparse_prep(parr, q_vids_all))(parrays)
+    totals = preps[3]
+    overflow = jnp.any(c.count > pspec.f_cap)
+    edge_overflow = totals.max() > pspec.e_sp
     use_dense = (
-        (g_cnt > spec.nv // pspec.pull_threshold_den)
+        (jnp.sum(c.count) > spec.nv // pspec.pull_threshold_den)
         | overflow
         | edge_overflow
     )
+    return q_vids_all, q_vals_all, preps, use_dense
+
+
+def _push_relax(prog, pspec: PushSpec, spec: ShardSpec, method, arrays,
+                parrays, c: PushCarry, q_vids_all, q_vals_all, preps,
+                use_dense):
+    """COMP phase: dense (pull over all in-edges) or sparse (scatter the
+    frontier's out-edges) relaxation -> new stacked state.
+
+    ``use_dense`` is GLOBAL (identical for every part), so the direction
+    switch is ONE `lax.cond` whose branches vmap over parts — a genuine
+    branch (only the taken mode executes) with compile size O(1) in P,
+    not the P-fold Python unroll of round 1."""
+    V = spec.nv_pad
     full = c.state.reshape((spec.gathered_size,) + c.state.shape[2:])
-    news = []
-    for p in range(P_):
-        arr = jax.tree.map(lambda a: a[p], arrays)
-        parr = jax.tree.map(lambda a: a[p], parrays)
-        rows, counts, incl, _ = preps[p]
-        new_p = jax.lax.cond(
-            use_dense,
-            lambda arr=arr, p=p: dense_part_step(
-                prog, arr, full, c.state[p], method
-            ),
-            lambda arr=arr, parr=parr, rows=rows, counts=counts, incl=incl, p=p: jnp.where(
+    rows, counts, incl, _ = preps
+
+    def dense_all():
+        return jax.vmap(
+            lambda arr, loc: dense_part_step(prog, arr, full, loc, method)
+        )(arrays, c.state)
+
+    def sparse_all():
+        def f(arr, parr, r, cn, inc, loc):
+            return jnp.where(
                 arr.vtx_mask,
                 sparse_part_step(
                     prog, pspec, parr, V, q_vids_all, q_vals_all,
-                    rows, counts, incl, c.state[p],
+                    r, cn, inc, loc,
                 ),
-                c.state[p],
-            ),
-        )
-        news.append(new_p)
-    new = jnp.stack(news)
+                loc,
+            )
+
+        return jax.vmap(f)(arrays, parrays, rows, counts, incl, c.state)
+
+    return jax.lax.cond(use_dense, dense_all, sparse_all)
+
+
+def _push_requeue(prog, pspec: PushSpec, spec: ShardSpec, arrays,
+                  c: PushCarry, new, preps, use_dense) -> PushCarry:
+    """UPDATE phase: rebuild the frontier queues from changed vertices and
+    account traversed edges."""
     changed = (new != c.state) & arrays.vtx_mask
     q_vid, q_val, cnt = jax.vmap(partial(build_queue, pspec))(
         arrays, changed, new
@@ -203,11 +251,19 @@ def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
     active = jnp.sum(cnt)
     # traversal accounting (SURVEY.md §6): dense walks every real edge,
     # sparse walks the frontier's out-edges (the preps totals)
-    sparse_edges = jnp.stack([t for (_, _, _, t) in preps]).sum()
-    edges = c.edges + jnp.where(
-        use_dense, jnp.float32(spec.ne), sparse_edges.astype(jnp.float32)
-    )
+    edges = _acc_edges(c.edges, spec.ne, preps[3].sum(), use_dense)
     return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active, edges)
+
+
+def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
+                    arrays, parrays, c: PushCarry) -> PushCarry:
+    """One direction-optimized iteration over all parts (single device)."""
+    q_vids_all, q_vals_all, preps, use_dense = _push_prep(pspec, spec, parrays, c)
+    new = _push_relax(
+        prog, pspec, spec, method, arrays, parrays, c,
+        q_vids_all, q_vals_all, preps, use_dense,
+    )
+    return _push_requeue(prog, pspec, spec, arrays, c, new, preps, use_dense)
 
 
 @lru_cache(maxsize=64)
@@ -226,6 +282,40 @@ def _compile_push_single(prog, pspec: PushSpec, spec: ShardSpec,
         return jax.lax.while_loop(cond, body, carry)
 
     return loop
+
+
+@lru_cache(maxsize=64)
+def compile_push_phases(prog, pspec: PushSpec, spec: ShardSpec,
+                        method: str = "scan"):
+    """One push iteration as THREE separately-jitted sub-steps for the
+    -verbose phase breakdown (the reference's per-iteration
+    loadTime/compTime/updateTime, sssp_gpu.cu:513-518):
+
+      load(parrays, carry)                 -> (qv, qw, preps, use_dense)
+      comp(arrays, parrays, carry, plan)   -> new stacked state
+      update(arrays, carry, new, plan)     -> next PushCarry
+
+    Observability path (fences between phases); run_push is the perf path.
+    """
+
+    @jax.jit
+    def load(parrays, carry: PushCarry):
+        return _push_prep(pspec, spec, parrays, carry)
+
+    @jax.jit
+    def comp(arrays, parrays, carry: PushCarry, plan):
+        q_vids_all, q_vals_all, preps, use_dense = plan
+        return _push_relax(
+            prog, pspec, spec, method, arrays, parrays, carry,
+            q_vids_all, q_vals_all, preps, use_dense,
+        )
+
+    @jax.jit
+    def update(arrays, carry: PushCarry, new, plan):
+        _, _, preps, use_dense = plan
+        return _push_requeue(prog, pspec, spec, arrays, carry, new, preps, use_dense)
+
+    return load, comp, update
 
 
 @lru_cache(maxsize=64)
@@ -255,9 +345,10 @@ def run_push(
     max_iters: int = 10_000,
     method: str = "scan",
 ):
-    """Single-device driver.  Parts are unrolled in Python so the
-    direction switch stays a genuine `lax.cond` (vmap would turn it into a
-    select that executes both modes).  Returns (final stacked state, iters).
+    """Single-device driver.  The direction switch is one global `lax.cond`
+    over vmapped per-part branches — a genuine branch (only the taken mode
+    executes; the global predicate makes this legal) with compile size O(1)
+    in the part count.  Returns (final stacked state, iters, edge counter).
     """
     spec, pspec = shards.spec, shards.pspec
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
@@ -331,10 +422,10 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
             changed = (new != local) & arr.vtx_mask
             q_vid, q_val, cnt = build_queue(pspec, arr, changed, new)
             active = jax.lax.psum(cnt, PARTS_AXIS)
-            g_total = jax.lax.psum(total.astype(jnp.float32), PARTS_AXIS)
-            edges = c.edges + jnp.where(
-                use_dense, jnp.float32(spec.ne), g_total
-            )
+            # uint32 psum is exact: a sparse round's global total is bounded
+            # by sum_p e_sp_p ≈ ne/4 < 2^32 (bigger frontiers force dense)
+            g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
+            edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
             return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active, edges)
 
         c0 = PushCarry(

@@ -52,6 +52,18 @@ def read_lux(path: str, weighted: Optional[bool] = None, mmap: bool = True) -> H
     w_off = cols_off + 4 * ne
     base_size = w_off
     if weighted is None:
+        if ne == nv and ne > 0 and size == base_size + 4 * ne:
+            # weighted (base + 4*ne) and unweighted-with-degree-array
+            # (base + 4*nv) are byte-identical sizes when nv == ne; a wrong
+            # guess silently drops real weights (ADVICE r1)
+            import warnings
+
+            warnings.warn(
+                f"{path}: nv == ne makes the weighted and unweighted+degrees "
+                "layouts the same size; assuming unweighted — pass weighted= "
+                "explicitly to silence or override",
+                stacklevel=2,
+            )
         if ne == 0 or size in (base_size, base_size + 4 * nv):
             weighted = False
         elif size in (base_size + 4 * ne, base_size + 4 * ne + 4 * nv):

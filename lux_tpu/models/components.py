@@ -91,18 +91,18 @@ def connected_components(
 
 
 def connected_components_push(
-    g: HostGraph,
+    g,
     max_iters: int = 10_000,
     num_parts: int = 1,
     mesh=None,
     method: str = "scan",
 ) -> np.ndarray:
     """CC on the frontier/push engine (direction-optimizing; what the
-    reference app actually runs)."""
+    reference app actually runs).  ``g``: HostGraph or pre-built PushShards."""
     from lux_tpu.engine import push as push_engine
-    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.graph.push_shards import PushShards, build_push_shards
 
-    shards = build_push_shards(g, num_parts)
+    shards = g if isinstance(g, PushShards) else build_push_shards(g, num_parts)
     prog = MaxLabelProgram()
     if mesh is None:
         final, _, _ = push_engine.run_push(prog, shards, max_iters, method=method)

@@ -170,17 +170,32 @@ int lux_write_from_edges(const char* path, uint32_t nv, uint64_t ne,
 // Parse a whitespace text edge list ("src dst [weight]" per line) into
 // preallocated arrays; returns the number of edges parsed or a negative
 // error. Pass weights == null for unweighted files.
+//
+// Line-by-line (fgets + sscanf), NOT a stream-wide fscanf: an unweighted
+// parse of a 3-column file must ignore the trailing column instead of
+// desynchronizing (reading the weight as the next line's src) — this keeps
+// the native path consistent with the NumPy fallback, which reads columns
+// 0/1 per line. Blank lines and '#' comments are skipped like np.loadtxt.
 int64_t lux_parse_edge_text(const char* path, uint64_t cap, uint32_t* src,
                             uint32_t* dst, int32_t* weights) {
   FILE* f = fopen(path, "r");
   if (!f) return -errno;
   uint64_t n = 0;
-  while (n < cap) {
+  char line[512];
+  while (fgets(line, sizeof line, f)) {
+    // a line longer than the buffer cannot be a valid edge line
+    size_t len = strlen(line);
+    if (len + 1 == sizeof line && line[len - 1] != '\n') {
+      fclose(f);
+      return -EINVAL;
+    }
+    const char* p = line;
+    while (*p == ' ' || *p == '\t') p++;
+    if (*p == '\0' || *p == '\n' || *p == '\r' || *p == '#') continue;
+    if (n >= cap) break;
     unsigned long s, d;
     long w = 0;
-    int got = weights ? fscanf(f, "%lu %lu %ld", &s, &d, &w)
-                      : fscanf(f, "%lu %lu", &s, &d);
-    if (got == EOF) break;
+    int got = sscanf(p, "%lu %lu %ld", &s, &d, &w);
     if (got < (weights ? 3 : 2)) {
       fclose(f);
       return -EINVAL;
@@ -190,8 +205,9 @@ int64_t lux_parse_edge_text(const char* path, uint64_t cap, uint32_t* src,
     if (weights) weights[n] = (int32_t)w;
     n++;
   }
+  int rc = ferror(f) ? -EIO : 0;
   fclose(f);
-  return (int64_t)n;
+  return rc != 0 ? rc : (int64_t)n;
 }
 
 // Out-degree histogram over an edge-source array (the native equivalent of

@@ -40,7 +40,6 @@ def initialize(
     # backend would initialize it and forbid jax.distributed.initialize
     if getattr(initialize, "_done", False):
         return jax.process_index()
-    initialize._done = True
     if num_processes is not None and num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -49,6 +48,9 @@ def initialize(
         )
     elif coordinator_address is not None:
         jax.distributed.initialize(coordinator_address=coordinator_address)
+    # mark done only after a successful bootstrap so a transient failure
+    # (coordinator not yet listening) stays retryable
+    initialize._done = True
     log.info(
         "multihost: process %d/%d, %d local / %d global devices",
         jax.process_index(), jax.process_count(),

@@ -37,6 +37,12 @@ class IterStat:
     it: int
     active: int
     seconds: float
+    #: per-phase wall times (s), when the driver steps the iteration as
+    #: fenced load/comp/update sub-steps (the reference's verbose kernel
+    #: timer split, sssp_gpu.cu:513-518); None on whole-iteration records
+    load_s: Optional[float] = None
+    comp_s: Optional[float] = None
+    update_s: Optional[float] = None
 
 
 class IterStats:
@@ -51,9 +57,29 @@ class IterStats:
         if self.verbose:
             print(f"iter {it:4d}: activeNodes({active}) time({seconds*1e3:.3f} ms)")
 
+    def record_phases(self, it: int, active: int, load_s: float,
+                      comp_s: float, update_s: float):
+        total = load_s + comp_s + update_s
+        self.stats.append(IterStat(it, active, total, load_s, comp_s, update_s))
+        if self.verbose:
+            print(
+                f"iter {it:4d}: activeNodes({active}) "
+                f"loadTime({load_s*1e3:.3f} ms) "
+                f"compTime({comp_s*1e3:.3f} ms) "
+                f"updateTime({update_s*1e3:.3f} ms)"
+            )
+
     @property
     def total_active(self) -> int:
         return sum(s.active for s in self.stats)
+
+    def phase_totals(self):
+        """(load, comp, update) sums in seconds over recorded iterations."""
+        return (
+            sum(s.load_s or 0.0 for s in self.stats),
+            sum(s.comp_s or 0.0 for s in self.stats),
+            sum(s.update_s or 0.0 for s in self.stats),
+        )
 
 
 def report_elapsed(seconds: float, ne: int, iters: int,
