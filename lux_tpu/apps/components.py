@@ -22,11 +22,21 @@ def main(argv=None):
     g = common.load_graph(cfg)
     shards = build_push_shards(g, cfg.num_parts)
     prog = cc_model.MaxLabelProgram()
-    labels = run_convergence_app(prog, shards, cfg, "components")
+    labels, state = run_convergence_app(prog, shards, cfg, "components")
     n_comp = len(np.unique(labels))
     print(f"{n_comp} distinct labels")
     if cfg.check:
-        ok = common.print_check("components", cc_model.check_labels(g, labels))
+        if cfg.distributed:
+            # on-device label-dominance walk (CHECK_TASK_ID analog,
+            # components_gpu.cu:768-792) — no host gather needed
+            from lux_tpu.engine import validate
+
+            violations = validate.count_violations(
+                shards.pull, state, validate.cc_violation()
+            )
+        else:
+            violations = cc_model.check_labels(g, labels)
+        ok = common.print_check("components", violations)
         return 0 if ok else 1
     return 0
 

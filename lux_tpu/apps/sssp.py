@@ -65,7 +65,9 @@ def run_convergence_app(prog, shards, cfg, name: str):
     # sparse rounds only the frontier's) — the reference's per-iteration
     # traversal accounting, SURVEY.md §6.
     report_elapsed(elapsed, shards.spec.ne, iters, traversed=push.edges_total(edges))
-    return shards.scatter_to_global(np.asarray(state))
+    # return the stacked device state too: distributed -check validates it
+    # on device (CHECK_TASK_ID analog) without a host gather
+    return shards.scatter_to_global(np.asarray(state)), state
 
 
 def main(argv=None):
@@ -73,13 +75,22 @@ def main(argv=None):
     g = common.load_graph(cfg)
     shards = build_push_shards(g, cfg.num_parts)
     prog = sssp_model.SSSPProgram(nv=shards.spec.nv, start=cfg.start)
-    dist_result = run_convergence_app(prog, shards, cfg, "sssp")
+    dist_result, state = run_convergence_app(prog, shards, cfg, "sssp")
     reached = int(np.sum(dist_result < g.nv))
     print(f"reached {reached}/{g.nv} vertices from {cfg.start}")
     if cfg.check:
-        ok = common.print_check(
-            "sssp", sssp_model.check_distances(g, dist_result)
-        )
+        if cfg.distributed:
+            # on-device edge walk over the sharded state — validates graphs
+            # too large for a host gather (the reference's CHECK_TASK_ID
+            # GPU task, core/graph.h:46 + sssp_gpu.cu:773-798)
+            from lux_tpu.engine import validate
+
+            violations = validate.count_violations(
+                shards.pull, state, validate.sssp_violation(prog.inf)
+            )
+        else:
+            violations = sssp_model.check_distances(g, dist_result)
+        ok = common.print_check("sssp", violations)
         return 0 if ok else 1
     return 0
 

@@ -37,6 +37,11 @@ class CFProgram:
     k: int = K
     lam: float = LAMBDA
     gamma: float = GAMMA
+    #: state storage dtype.  "bfloat16" halves the (V, K) latent-state HBM
+    #: footprint and per-iteration exchange volume — the wide-state memory
+    #: case SURVEY.md §7.3 flags (10.7 GB f32 at RMAT27).  Per-edge error
+    #: terms and the segmented accumulation stay float32.
+    dtype: str = "float32"
 
     reduce: str = dataclasses.field(default="sum", init=False)
     #: the error term reads the destination's current vector per edge, so
@@ -48,18 +53,24 @@ class CFProgram:
         v0 = jnp.full(
             (global_vid.shape[0], self.k), np.sqrt(1.0 / self.k), jnp.float32
         )
-        return jnp.where(vtx_mask[:, None], v0, 0.0)
+        return jnp.where(vtx_mask[:, None], v0, 0.0).astype(self.dtype)
 
     def edge_value(self, src_state, weight, dst_state=None):
-        # err = rating - <v_src, v_dst>; value pushed to dst = err * v_src
-        err = weight - jnp.sum(src_state * dst_state, axis=-1)
-        return err[:, None] * src_state
+        # err = rating - <v_src, v_dst>; value pushed to dst = err * v_src.
+        # gathers arrive in the storage dtype; compute + reduce in f32
+        src = src_state.astype(jnp.float32)
+        dst = dst_state.astype(jnp.float32)
+        err = weight - jnp.sum(src * dst, axis=-1)
+        return err[:, None] * src
 
     def apply(self, old_local, acc, arrays: ShardArrays):
-        new = old_local + jnp.float32(self.gamma) * (
-            acc - jnp.float32(self.lam) * old_local
+        old = old_local.astype(jnp.float32)
+        new = old + jnp.float32(self.gamma) * (
+            acc - jnp.float32(self.lam) * old
         )
-        return jnp.where(jnp.asarray(arrays.vtx_mask)[:, None], new, old_local)
+        return jnp.where(
+            jnp.asarray(arrays.vtx_mask)[:, None], new, old
+        ).astype(self.dtype)
 
 
 def colfilter(
@@ -71,11 +82,12 @@ def colfilter(
     lam: float = LAMBDA,
     gamma: float = GAMMA,
     method: str = "scan",
+    dtype: str = "float32",
 ) -> np.ndarray:
     """Run CF; returns the (nv, k) latent-vector matrix."""
     shards = g if isinstance(g, PullShards) else build_pull_shards(g, num_parts)
     assert shards.spec.weighted, "CF requires a weighted (rating) graph"
-    prog = CFProgram(k=k, lam=lam, gamma=gamma)
+    prog = CFProgram(k=k, lam=lam, gamma=gamma, dtype=dtype)
     state0 = pull.init_state(prog, shards.arrays)
     if mesh is None:
         final = pull.run_pull_fixed(

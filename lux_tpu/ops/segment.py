@@ -92,6 +92,84 @@ def _segment_minmax(vals, row_ptr, head_flag, dst_local, op, neutral, method):
     raise ValueError(method)
 
 
+def segment_reduce_by_ends(
+    vals: jnp.ndarray,
+    head_flag: jnp.ndarray,
+    dst_local: jnp.ndarray,
+    num_segments: int,
+    reduce: str = "sum",
+    method: str = "scan",
+) -> jnp.ndarray:
+    """Per-destination reduction WITHOUT a (V+1) row_ptr: segment ends are
+    the positions where ``dst_local`` changes, and each end's scanned value
+    is scattered into the (num_segments, ...) output.
+
+    This is the compressed encoding for the O(P^2)-bucket exchange layouts
+    (ring/reduce_scatter): a dense per-bucket row_ptr would cost
+    O(P^2 * V) host+device memory (~35 GB at the RMAT27/P=64 target,
+    SURVEY.md §7.3) while head_flag/dst_local are already edge-aligned —
+    so per-bucket cost stays O(bucket edges).  Padding slots must carry
+    ``dst_local == num_segments`` (dropped by the scatter).  Empty
+    destinations get the reduce's neutral element, matching the
+    *_csc reducers.
+    """
+    if reduce == "sum":
+        op, neutral = jnp.add, jnp.zeros((), vals.dtype)
+    elif reduce == "min":
+        op = jnp.minimum
+        neutral = jnp.asarray(
+            jnp.iinfo(vals.dtype).max
+            if jnp.issubdtype(vals.dtype, jnp.integer)
+            else jnp.inf,
+            vals.dtype,
+        )
+    elif reduce == "max":
+        op = jnp.maximum
+        neutral = jnp.asarray(
+            jnp.iinfo(vals.dtype).min
+            if jnp.issubdtype(vals.dtype, jnp.integer)
+            else -jnp.inf,
+            vals.dtype,
+        )
+    else:
+        raise ValueError(reduce)
+
+    if method == "scatter":
+        seg = {
+            "sum": jax.ops.segment_sum,
+            "min": jax.ops.segment_min,
+            "max": jax.ops.segment_max,
+        }[reduce]
+        # ids are sorted within a bucket (CSC order); padding ids ==
+        # num_segments fall outside and are dropped
+        return seg(
+            vals, dst_local, num_segments=num_segments,
+            indices_are_sorted=True,
+        )
+    if method != "scan":
+        raise ValueError(
+            f"method {method!r}: bucketed (row_ptr-free) reductions support "
+            "'scan' and 'scatter' only"
+        )
+    flag = head_flag.reshape(head_flag.shape + (1,) * (vals.ndim - 1))
+    scanned = _segmented_scan(vals, jnp.broadcast_to(flag, vals.shape), op)
+    # an edge is its segment's end iff the next slot starts a new segment
+    # (head_flag is True at position 0 of every segment, including the
+    # first padding slot after the real edges)
+    is_end = jnp.concatenate(
+        [head_flag[1:], jnp.ones((1,), head_flag.dtype)]
+    )
+    # non-end slots are redirected to num_segments and dropped, so only one
+    # value per segment lands in the output (sum stays exact)
+    idx = jnp.where(is_end, dst_local, num_segments)
+    out = jnp.full((num_segments,) + vals.shape[1:], neutral, vals.dtype)
+    if reduce == "sum":
+        return out.at[idx].add(scanned, mode="drop")
+    if reduce == "min":
+        return out.at[idx].min(scanned, mode="drop")
+    return out.at[idx].max(scanned, mode="drop")
+
+
 def reducers():
     """Public reduce-name -> segment-function table (shared by the pull
     engine and the ring driver; keep in one place)."""

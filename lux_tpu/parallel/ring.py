@@ -40,19 +40,23 @@ from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
 
 
 class RingArrays(NamedTuple):
-    """Per-part, per-source-bucket edge structure.  Shapes (P parts,
-    B = e_bucket_pad):
-      src_local: (P, P, B) int32  source index WITHIN the streamed block
-      dst_local: (P, P, B) int32  local destination (for dst-state gathers
-                 and the scatter reduce strategy); padding holds V
-      row_ptr:   (P, P, V+1) int32  per-bucket CSC offsets (dst-local)
-      head_flag: (P, P, B) bool
-      weights:   (P, P, B) float32
+    """Per-part, per-source-bucket edge structure.  Shapes (R = number of
+    built parts — all P, or this host's subset; B = e_bucket_pad):
+      src_local: (R, P, B) int32  source index WITHIN the streamed block
+      dst_local: (R, P, B) int32  local destination (for dst-state gathers
+                 and segment-end scatters); padding holds V
+      head_flag: (R, P, B) bool   segment starts by destination; the first
+                 padding slot is also flagged so the last real edge reads
+                 as a segment END (ops.segment.segment_reduce_by_ends)
+      weights:   (R, P, B) float32
+
+    Deliberately NO per-bucket (V+1) row_ptr: dense offsets would cost
+    O(P^2 * V) (~35 GB at the RMAT27/P=64 target, SURVEY.md §7.3); every
+    array here is edge-aligned, so total bucket memory is O(part edges).
     """
 
     src_local: np.ndarray
     dst_local: np.ndarray
-    row_ptr: np.ndarray
     head_flag: np.ndarray
     weights: np.ndarray
 
@@ -62,6 +66,9 @@ class RingShards:
     pull: PullShards
     rarrays: RingArrays
     e_bucket_pad: int
+    #: part indices materialized in rarrays' leading axis (multi-host
+    #: builds give each host multihost.local_part_range(P))
+    parts_subset: list
 
     @property
     def spec(self):
@@ -75,58 +82,74 @@ class RingShards:
         return self.pull.scatter_to_global(stacked)
 
 
-def build_ring_shards(g: HostGraph, num_parts: int) -> RingShards:
+def bucket_counts(g: HostGraph, cuts, num_parts: int):
+    """(P, P) bucket edge counts: [p, q] = edges into part p's destinations
+    from part q's sources.  O(ne) total; every host computes this so padded
+    bucket shapes agree globally."""
+    owner_of = np.searchsorted(cuts, g.col_idx, side="right") - 1
+    counts = np.zeros((num_parts, num_parts), np.int64)
+    for p in range(num_parts):
+        elo = int(g.row_ptr[cuts[p]])
+        ehi = int(g.row_ptr[cuts[p + 1]])
+        counts[p] = np.bincount(owner_of[elo:ehi], minlength=num_parts)
+    return counts, owner_of
+
+
+def mark_bucket_heads(hf_row: np.ndarray, dl: np.ndarray) -> None:
+    """Destination-segment starts for one bucket (edges CSC-ordered).  The
+    first padding slot is flagged too, so segment_reduce_by_ends sees the
+    last real edge as an end."""
+    m = len(dl)
+    if m:
+        hf_row[0] = True
+        hf_row[1:m] = dl[1:] != dl[:-1]
+    if m < hf_row.shape[0]:
+        hf_row[m] = True
+
+
+def build_ring_shards(
+    g: HostGraph, num_parts: int, parts_subset=None
+) -> RingShards:
+    """Bucket the graph for ring streaming.  ``parts_subset`` builds only
+    those parts' (P, B) bucket rows (the sharded_load pattern: each host
+    materializes O(its edges), not O(ne))."""
     pull = build_pull_shards(g, num_parts)
     spec, cuts = pull.spec, pull.cuts
     Pn, V = num_parts, spec.nv_pad
     dst_of = g.dst_of_edges()
-    owner_of = np.searchsorted(cuts, g.col_idx, side="right") - 1
+    counts, owner_of = bucket_counts(g, cuts, Pn)
+    B = _round_up(max(1, int(counts.max())), LANE)
 
-    # bucket (part p, source-owner q) -> edge lists, CSC order preserved.
-    # One stable argsort by owner per destination slice: O(ne log ne)
-    # total, independent of P (not O(P*ne) re-scans).
-    buckets = {}
-    max_b = 1
-    for p in range(Pn):
+    rows = list(range(Pn) if parts_subset is None else parts_subset)
+    src_local = np.zeros((len(rows), Pn, B), np.int32)
+    dst_local = np.full((len(rows), Pn, B), V, np.int32)
+    head_flag = np.zeros((len(rows), Pn, B), bool)
+    weights = np.zeros((len(rows), Pn, B), np.float32)
+    for i, p in enumerate(rows):
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
-        own = owner_of[elo:ehi]
-        order = np.argsort(own, kind="stable")
-        counts = np.bincount(own, minlength=Pn)
-        splits = np.split(order, np.cumsum(counts)[:-1])
+        # one stable argsort by source owner per destination slice keeps
+        # CSC (by-destination) order within each bucket
+        order = np.argsort(owner_of[elo:ehi], kind="stable")
+        splits = np.split(order, np.cumsum(counts[p])[:-1])
         for q in range(Pn):
-            buckets[p, q] = splits[q] + elo
-            max_b = max(max_b, len(splits[q]))
-    B = _round_up(max_b, LANE)
-
-    src_local = np.zeros((Pn, Pn, B), np.int32)
-    dst_local = np.full((Pn, Pn, B), V, np.int32)
-    row_ptr = np.zeros((Pn, Pn, V + 1), np.int32)
-    head_flag = np.zeros((Pn, Pn, B), bool)
-    weights = np.zeros((Pn, Pn, B), np.float32)
-    for p in range(Pn):
-        vlo = int(cuts[p])
-        for q in range(Pn):
-            eids = buckets[p, q]
+            eids = splits[q] + elo
             m = len(eids)
-            src_local[p, q, :m] = (g.col_idx[eids] - cuts[q]).astype(np.int32)
-            dl = (dst_of[eids] - vlo).astype(np.int64)
-            dst_local[p, q, :m] = dl
-            counts = np.bincount(dl, minlength=V)
-            np.cumsum(counts, out=row_ptr[p, q, 1:])
-            starts = row_ptr[p, q, :-1][row_ptr[p, q, :-1] < row_ptr[p, q, 1:]]
-            head_flag[p, q, starts] = True
+            src_local[i, q, :m] = (g.col_idx[eids] - cuts[q]).astype(np.int32)
+            dl = (dst_of[eids] - vlo).astype(np.int32)
+            dst_local[i, q, :m] = dl
+            mark_bucket_heads(head_flag[i, q], dl)
             if g.weights is not None:
-                weights[p, q, :m] = g.weights[eids].astype(np.float32)
+                weights[i, q, :m] = g.weights[eids].astype(np.float32)
     return RingShards(
         pull=pull,
-        rarrays=RingArrays(src_local, dst_local, row_ptr, head_flag, weights),
+        rarrays=RingArrays(src_local, dst_local, head_flag, weights),
         e_bucket_pad=B,
+        parts_subset=rows,
     )
 
 
 _FOLD = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
-_SEG = segment.reducers()
 
 
 def _neutral_like(local, reduce):
@@ -175,9 +198,9 @@ def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int, method: str)
                 vals = prog.edge_value(
                     block[rarr.src_local[q]], rarr.weights[q], dst_state
                 )
-                part = _SEG[prog.reduce](
-                    vals, rarr.row_ptr[q], rarr.head_flag[q],
-                    rarr.dst_local[q], method=method,
+                part = segment.segment_reduce_by_ends(
+                    vals, rarr.head_flag[q], rarr.dst_local[q], V,
+                    reduce=prog.reduce, method=method,
                 )
                 return _FOLD[prog.reduce](acc, part)
 
@@ -226,6 +249,13 @@ def run_pull_fixed_ring(
     (P, V, ...) initial state (e.g. from engine.pull.init_state)."""
     spec = shards.spec
     assert spec.num_parts == mesh.devices.size
+    assert len(shards.parts_subset) == spec.num_parts, (
+        "subset-built ring shards: assemble the full stacked arrays across "
+        "hosts (multihost.assemble_global) before driving"
+    )
+    assert method in ("scan", "scatter"), (
+        "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
+    )
     rarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.rarrays))
     vtx_mask = shard_stacked(mesh, jnp.asarray(shards.arrays.vtx_mask))
     degree = shard_stacked(mesh, jnp.asarray(shards.arrays.degree))

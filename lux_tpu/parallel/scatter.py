@@ -41,16 +41,22 @@ from lux_tpu.parallel.ring import _RingArrView
 
 class ScatterArrays(NamedTuple):
     """Chip q's view: for each destination part p, the edges from q's own
-    sources into p.  Shapes (B = e_bucket_pad):
-      src_local: (P, P, B) int32  source index within MY resident block
-                 (leading axis = destination part p)
-      row_ptr:   (P, P, V+1) int32  per-bucket offsets over p-local dsts
-      head_flag: (P, P, B) bool
-      weights:   (P, P, B) float32
+    sources into p.  Shapes (R = number of built chips, all P or a host's
+    subset; B = e_bucket_pad):
+      src_local: (R, P, B) int32  source index within MY resident block
+                 (axis 1 = destination part p)
+      dst_local: (R, P, B) int32  p-LOCAL destination index; padding holds V
+      head_flag: (R, P, B) bool   destination-segment starts (first padding
+                 slot flagged, see ring.mark_bucket_heads)
+      weights:   (R, P, B) float32
+
+    No per-bucket (V+1) row_ptr — dense offsets are O(P^2 * V)
+    (SURVEY.md §7.3); dst_local + head_flag give the same segmentation in
+    O(bucket edges) via segment_reduce_by_ends.
     """
 
     src_local: np.ndarray
-    row_ptr: np.ndarray
+    dst_local: np.ndarray
     head_flag: np.ndarray
     weights: np.ndarray
 
@@ -60,6 +66,8 @@ class ScatterShards:
     pull: PullShards
     sarrays: ScatterArrays
     e_bucket_pad: int
+    #: chip (source-owner) indices materialized in sarrays' leading axis
+    parts_subset: list
 
     @property
     def spec(self):
@@ -73,51 +81,48 @@ class ScatterShards:
         return self.pull.scatter_to_global(stacked)
 
 
-def build_scatter_shards(g: HostGraph, num_parts: int) -> ScatterShards:
+def build_scatter_shards(
+    g: HostGraph, num_parts: int, parts_subset=None
+) -> ScatterShards:
     """Transposed bucket build: axis 0 = SOURCE owner q (the chip that
-    stores and computes the bucket), axis 1 = destination part p."""
+    stores and computes the bucket), axis 1 = destination part p.
+    ``parts_subset`` selects which chips' rows to materialize (per-host
+    builds hold O(their edges), not O(ne))."""
+    from lux_tpu.parallel.ring import bucket_counts, mark_bucket_heads
+
     pull = build_pull_shards(g, num_parts)
     spec, cuts = pull.spec, pull.cuts
     Pn, V = num_parts, spec.nv_pad
     dst_of = g.dst_of_edges()
-    owner_of = np.searchsorted(cuts, g.col_idx, side="right") - 1
+    counts, owner_of = bucket_counts(g, cuts, Pn)
+    B = _round_up(max(1, int(counts.max())), LANE)
 
-    # single stable argsort by source owner per destination slice (not a
-    # P-fold re-scan)
-    buckets = {}
-    max_b = 1
-    for p in range(Pn):  # destination part
+    rows = list(range(Pn) if parts_subset is None else parts_subset)
+    row_of = {q: i for i, q in enumerate(rows)}
+    src_local = np.zeros((len(rows), Pn, B), np.int32)
+    dst_local = np.full((len(rows), Pn, B), V, np.int32)
+    head_flag = np.zeros((len(rows), Pn, B), bool)
+    weights = np.zeros((len(rows), Pn, B), np.float32)
+    for p in range(Pn):  # destination part: one slice scan, split by owner
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
-        own = owner_of[elo:ehi]
-        order = np.argsort(own, kind="stable")
-        counts = np.bincount(own, minlength=Pn)
-        splits = np.split(order, np.cumsum(counts)[:-1])
-        for q in range(Pn):  # source owner
-            buckets[q, p] = splits[q] + elo
-            max_b = max(max_b, len(splits[q]))
-    B = _round_up(max_b, LANE)
-
-    src_local = np.zeros((Pn, Pn, B), np.int32)
-    row_ptr = np.zeros((Pn, Pn, V + 1), np.int32)
-    head_flag = np.zeros((Pn, Pn, B), bool)
-    weights = np.zeros((Pn, Pn, B), np.float32)
-    for q in range(Pn):
-        for p in range(Pn):
-            eids = buckets[q, p]
+        order = np.argsort(owner_of[elo:ehi], kind="stable")
+        splits = np.split(order, np.cumsum(counts[p])[:-1])
+        for q in rows:  # source owner — only this host's chips materialize
+            i = row_of[q]
+            eids = splits[q] + elo
             m = len(eids)
-            src_local[q, p, :m] = (g.col_idx[eids] - cuts[q]).astype(np.int32)
-            dl = (dst_of[eids] - cuts[p]).astype(np.int64)
-            counts = np.bincount(dl, minlength=V)
-            np.cumsum(counts, out=row_ptr[q, p, 1:])
-            starts = row_ptr[q, p, :-1][row_ptr[q, p, :-1] < row_ptr[q, p, 1:]]
-            head_flag[q, p, starts] = True
+            src_local[i, p, :m] = (g.col_idx[eids] - cuts[q]).astype(np.int32)
+            dl = (dst_of[eids] - vlo).astype(np.int32)
+            dst_local[i, p, :m] = dl
+            mark_bucket_heads(head_flag[i, p], dl)
             if g.weights is not None:
-                weights[q, p, :m] = g.weights[eids].astype(np.float32)
+                weights[i, p, :m] = g.weights[eids].astype(np.float32)
     return ScatterShards(
         pull=pull,
-        sarrays=ScatterArrays(src_local, row_ptr, head_flag, weights),
+        sarrays=ScatterArrays(src_local, dst_local, head_flag, weights),
         e_bucket_pad=B,
+        parts_subset=rows,
     )
 
 
@@ -157,8 +162,9 @@ def _compile_scatter_fixed(prog, mesh, num_parts: int, num_iters: int,
                 # dst_state unavailable pre-combination (remote); sum
                 # programs don't use it
                 vals = prog.edge_value(src_state, sarr.weights[p], None)
-                return segment.segment_sum_csc(
-                    vals, sarr.row_ptr[p], sarr.head_flag[p], method=method
+                return segment.segment_reduce_by_ends(
+                    vals, sarr.head_flag[p], sarr.dst_local[p], V,
+                    reduce="sum", method=method,
                 )
 
             partials = jnp.stack(
@@ -188,9 +194,12 @@ def run_pull_fixed_scatter(
     """Distributed fixed-iteration pull with reduce_scatter exchange."""
     spec = shards.spec
     assert spec.num_parts == mesh.devices.size
-    assert method in ("scan", "cumsum"), (
-        "scatter-shard buckets carry no dst_local ids; "
-        "use method='scan' (default) or 'cumsum'"
+    assert len(shards.parts_subset) == spec.num_parts, (
+        "subset-built scatter shards: assemble the full stacked arrays "
+        "across hosts (multihost.assemble_global) before driving"
+    )
+    assert method in ("scan", "scatter"), (
+        "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
     )
     sarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.sarrays))
     vtx_mask = shard_stacked(mesh, jnp.asarray(shards.arrays.vtx_mask))

@@ -59,6 +59,34 @@ def estimate_push(spec: ShardSpec, pspec: PushSpec,
     )
 
 
+def estimate_ring(spec: ShardSpec, e_bucket_pad: int, state_width: int = 1,
+                  state_dtype_bytes: int = 4) -> MemoryEstimate:
+    """Per-chip footprint of the ring-streamed exchange driver: P buckets of
+    edge-aligned arrays (src/dst int32, head bool, weight f32 = 13 B/slot —
+    no (V+1) row_ptr per bucket by design), plus the resident state block,
+    the in-flight ppermute block, and the fold accumulator.  The whole point
+    of the ring is gathered_bytes == 0 (no nv-sized exchange buffer)."""
+    Pn, V = spec.num_parts, spec.nv_pad
+    shard = Pn * e_bucket_pad * 13 + V * 5  # buckets + vtx_mask/degree
+    blk = V * state_width * state_dtype_bytes
+    state = 4 * blk  # local + in-flight block + accumulator + new state
+    return MemoryEstimate(shard, state, 0, shard + state)
+
+
+def estimate_scatter(spec: ShardSpec, e_bucket_pad: int, state_width: int = 1,
+                     state_dtype_bytes: int = 4) -> MemoryEstimate:
+    """Per-chip footprint of the reduce_scatter exchange driver.  Same
+    bucket layout as the ring; the transient is the (P, V, ...) partials
+    stack consumed by psum_scatter (reported as gathered_bytes — it is the
+    O(nv) term this strategy still pays, unlike the ring)."""
+    Pn, V = spec.num_parts, spec.nv_pad
+    shard = Pn * e_bucket_pad * 13 + V * 5
+    blk = V * state_width * state_dtype_bytes
+    state = 2 * blk
+    partials = Pn * blk
+    return MemoryEstimate(shard, state, partials, shard + state + partials)
+
+
 def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None) -> bool:
     """Warn (returns False) if the estimate exceeds the device HBM."""
     if hbm_bytes is None:

@@ -1,4 +1,5 @@
 """Collaborative filtering vs the numpy recurrence oracle."""
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -35,3 +36,35 @@ def test_cf_requires_weights():
     g = generate.uniform_random(50, 200, seed=53)
     with pytest.raises(AssertionError):
         cf.colfilter(g, num_iters=1)
+
+def test_cf_bfloat16_state():
+    """bf16 storage dtype: runs end-to-end, tracks the f32 result within
+    bf16 resolution, and training still reduces RMSE (the SURVEY.md §7.3
+    wide-state memory case)."""
+    g = generate.bipartite_ratings(60, 40, 900, seed=54, max_rating=5)
+    f32 = cf.colfilter(g, num_iters=5, gamma=1e-3)
+    bf16 = cf.colfilter(g, num_iters=5, gamma=1e-3, dtype="bfloat16")
+    assert bf16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        bf16.astype(np.float32), f32, rtol=2e-2, atol=2e-3
+    )
+    v0 = cf.colfilter(g, num_iters=0, gamma=2e-3, dtype="bfloat16")
+    v = cf.colfilter(g, num_iters=60, gamma=2e-3, dtype="bfloat16")
+    assert cf.rmse(g, v.astype(np.float32)) < cf.rmse(g, v0.astype(np.float32)) * 0.9
+
+
+def test_cf_bf16_accumulates_in_f32():
+    """The per-edge error products and their segmented reduction must be
+    float32 even when the state is stored bf16."""
+    prog = cf.CFProgram(dtype="bfloat16")
+    src = jnp.ones((6, cf.K), jnp.bfloat16)
+    dst = jnp.ones((6, cf.K), jnp.bfloat16)
+    w = jnp.ones((6,), jnp.float32)
+    assert prog.edge_value(src, w, dst).dtype == jnp.float32
+
+
+def test_cf_bf16_deterministic():
+    g = generate.bipartite_ratings(50, 30, 600, seed=55)
+    a = cf.colfilter(g, num_iters=4, gamma=1e-3, dtype="bfloat16")
+    b = cf.colfilter(g, num_iters=4, gamma=1e-3, dtype="bfloat16")
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes()

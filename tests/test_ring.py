@@ -15,13 +15,35 @@ def mesh8():
 def test_ring_bucket_layout():
     g = generate.rmat(8, 6, seed=90)
     rs = ring.build_ring_shards(g, 4)
-    # every edge appears in exactly one bucket
-    total = 0
-    for p in range(4):
-        for q in range(4):
-            rp = rs.rarrays.row_ptr[p, q]
-            total += int(rp[-1])
+    # every edge appears in exactly one bucket (dst_local < V marks real)
+    V = rs.spec.nv_pad
+    total = int((rs.rarrays.dst_local < V).sum())
     assert total == g.ne
+
+
+def test_ring_arrays_have_no_dense_rowptr():
+    """The bucket layout must stay O(part edges): no field may carry a
+    per-bucket V-sized axis (the O(P^2*V) blowup of SURVEY.md §7.3)."""
+    g = generate.rmat(8, 6, seed=96)
+    rs = ring.build_ring_shards(g, 4)
+    for name, arr in rs.rarrays._asdict().items():
+        assert arr.shape == (4, 4, rs.e_bucket_pad), name
+    est_bytes = sum(a.nbytes for a in rs.rarrays)
+    dense_rowptr_bytes = 4 * 4 * (rs.spec.nv_pad + 1) * 4
+    assert est_bytes < dense_rowptr_bytes + 13 * 4 * 4 * rs.e_bucket_pad
+
+
+def test_ring_subset_build_matches_full():
+    """Per-host subset rows must equal the same rows of the full build."""
+    g = generate.rmat(8, 6, seed=97, weighted=True)
+    full = ring.build_ring_shards(g, 4)
+    sub = ring.build_ring_shards(g, 4, parts_subset=[1, 3])
+    assert sub.e_bucket_pad == full.e_bucket_pad  # global geometry agrees
+    assert sub.parts_subset == [1, 3]
+    for name, a_full in full.rarrays._asdict().items():
+        a_sub = sub.rarrays._asdict()[name]
+        np.testing.assert_array_equal(a_sub[0], a_full[1], err_msg=name)
+        np.testing.assert_array_equal(a_sub[1], a_full[3], err_msg=name)
 
 
 def _state0(prog, rs):

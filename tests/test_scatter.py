@@ -20,10 +20,21 @@ def _state0(prog, ss):
 def test_scatter_bucket_layout():
     g = generate.rmat(8, 6, seed=120)
     ss = scatter.build_scatter_shards(g, 4)
-    total = sum(
-        int(ss.sarrays.row_ptr[q, p, -1]) for q in range(4) for p in range(4)
-    )
-    assert total == g.ne
+    V = ss.spec.nv_pad
+    assert int((ss.sarrays.dst_local < V).sum()) == g.ne
+    for name, arr in ss.sarrays._asdict().items():
+        assert arr.shape == (4, 4, ss.e_bucket_pad), name  # no V-sized axis
+
+
+def test_scatter_subset_build_matches_full():
+    g = generate.rmat(8, 6, seed=124, weighted=True)
+    full = scatter.build_scatter_shards(g, 4)
+    sub = scatter.build_scatter_shards(g, 4, parts_subset=[0, 2])
+    assert sub.e_bucket_pad == full.e_bucket_pad
+    for name, a_full in full.sarrays._asdict().items():
+        a_sub = sub.sarrays._asdict()[name]
+        np.testing.assert_array_equal(a_sub[0], a_full[0], err_msg=name)
+        np.testing.assert_array_equal(a_sub[1], a_full[2], err_msg=name)
 
 
 def test_scatter_pagerank_matches_oracle(mesh8):

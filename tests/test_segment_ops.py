@@ -76,3 +76,61 @@ def test_segment_sum_2d():
     expect = np.zeros((g.nv, K), np.float32)
     np.add.at(expect, dst, vals[: g.ne])
     np.testing.assert_allclose(np.asarray(out)[: g.nv], expect, rtol=2e-5)
+
+
+@pytest.mark.parametrize("method", ["scan", "scatter"])
+@pytest.mark.parametrize("reduce", ["sum", "min", "max"])
+def test_segment_reduce_by_ends(method, reduce):
+    """Row_ptr-free bucketed reduction (ring/scatter layouts) vs oracle,
+    including empty rows, padding slots, and a wide (E, K) value axis."""
+    from lux_tpu.parallel.ring import mark_bucket_heads
+
+    rng = np.random.default_rng(7)
+    V, m, B = 37, 60, 128
+    dl = np.sort(rng.integers(0, V, size=m)).astype(np.int32)
+    dst = np.full(B, V, np.int32)
+    dst[:m] = dl
+    head = np.zeros(B, bool)
+    mark_bucket_heads(head, dl)
+    vals = np.zeros(B, np.float32)
+    vals[:m] = rng.random(m).astype(np.float32) + 0.5
+
+    ops = {"sum": np.add, "min": np.minimum, "max": np.maximum}
+    neutral = {"sum": 0.0, "min": np.inf, "max": -np.inf}[reduce]
+    want = np.full(V, neutral, np.float32)
+    for j in range(m):
+        want[dl[j]] = ops[reduce](want[dl[j]], vals[j])
+
+    got = segment.segment_reduce_by_ends(
+        jnp.asarray(vals), jnp.asarray(head), jnp.asarray(dst), V,
+        reduce=reduce, method=method,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    if reduce == "sum":  # wide value axis (CF's (E, K) case)
+        vk = np.zeros((B, 3), np.float32)
+        vk[:m] = rng.random((m, 3)).astype(np.float32)
+        want_k = np.zeros((V, 3), np.float32)
+        np.add.at(want_k, dl, vk[:m])
+        got_k = segment.segment_reduce_by_ends(
+            jnp.asarray(vk), jnp.asarray(head), jnp.asarray(dst), V,
+            reduce="sum", method=method,
+        )
+        np.testing.assert_allclose(np.asarray(got_k), want_k, rtol=1e-5)
+
+
+def test_segment_reduce_by_ends_full_bucket():
+    """No padding slot after the last edge: the appended end flag must
+    close the final segment."""
+    from lux_tpu.parallel.ring import mark_bucket_heads
+
+    V, B = 5, 8
+    dl = np.array([0, 0, 1, 1, 1, 3, 4, 4], np.int32)  # m == B
+    head = np.zeros(B, bool)
+    mark_bucket_heads(head, dl)
+    vals = np.arange(1, 9, dtype=np.float32)
+    got = segment.segment_reduce_by_ends(
+        jnp.asarray(vals), jnp.asarray(head), jnp.asarray(dl), V,
+        reduce="sum", method="scan",
+    )
+    np.testing.assert_allclose(np.asarray(got), [3, 12, 0, 6, 15])
