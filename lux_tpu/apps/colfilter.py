@@ -12,7 +12,6 @@ import jax
 
 from lux_tpu.apps import common
 from lux_tpu.engine import pull
-from lux_tpu.graph.shards import build_pull_shards
 from lux_tpu.models import colfilter as cf_model
 from lux_tpu.utils import preflight
 from lux_tpu.utils.config import parse_args
@@ -20,17 +19,24 @@ from lux_tpu.utils.timing import Timer, report_elapsed
 
 
 def main(argv=None):
-    cfg = parse_args(argv, description=__doc__)
-    g = common.load_graph(cfg, weighted=True)
-    shards = build_pull_shards(g, cfg.num_parts)
-    est = preflight.estimate_pull(shards.spec, state_width=cf_model.K)
+    cfg = parse_args(argv, description=__doc__, pull=True)
+    g = common.load_graph(cfg, weighted=True, bipartite=True)
+    prog = cf_model.CFProgram(dtype=cfg.dtype)
+    common.validate_exchange(cfg, prog)
+    shards = common.build_exchange_shards(g, cfg)
+    est = common.estimate_exchange(shards, cfg, state_width=cf_model.K)
     print(est)
     preflight.check_fits(est)
 
-    prog = cf_model.CFProgram()
-    arrays = jax.tree.map(jax.numpy.asarray, shards.arrays)
-    state = pull.init_state(prog, arrays)
     mesh = common.make_mesh_if(cfg)
+    # single-device paths use device-placed arrays; distributed drivers
+    # shard host arrays themselves (see apps/pagerank.py)
+    arrays = (
+        jax.tree.map(jax.numpy.asarray, shards.arrays)
+        if mesh is None
+        else shards.arrays
+    )
+    state = pull.init_state(prog, arrays)
 
     from lux_tpu.utils import profiling
 
@@ -45,15 +51,12 @@ def main(argv=None):
                 prog, shards.spec, arrays, state, cfg.num_iters, cfg.method
             )
         else:
-            from lux_tpu.parallel import dist
-
-            state = dist.run_pull_fixed_dist(
-                prog, shards.spec, shards.arrays, state, cfg.num_iters, mesh,
-                cfg.method,
+            state = common.run_fixed_dist(
+                prog, shards, state, cfg.num_iters, mesh, cfg
             )
         elapsed = timer.stop(state)
     report_elapsed(elapsed, g.ne, cfg.num_iters)
-    v = shards.scatter_to_global(jax.device_get(state))
+    v = shards.scatter_to_global(jax.device_get(state)).astype("float32")
     print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
     return 0
 

@@ -15,21 +15,26 @@ from lux_tpu.utils.config import RunConfig
 log = logging.getLogger("lux_tpu")
 
 
-def load_graph(cfg: RunConfig, weighted: bool = False) -> HostGraph:
+def load_graph(cfg: RunConfig, weighted: bool = False,
+               bipartite: bool = False) -> HostGraph:
+    """``weighted`` requires/generates edge weights; ``bipartite`` shapes
+    the synthetic graph as a rating graph (CF)."""
     if cfg.file:
         g = read_lux(cfg.file)
         if weighted and not g.weighted:
             raise SystemExit(f"{cfg.file} has no edge weights")
         log.info("loaded %s: nv=%d ne=%d", cfg.file, g.nv, g.ne)
         return g
-    if weighted:
+    if bipartite:
         n_half = (1 << cfg.rmat_scale) // 2
         g = generate.bipartite_ratings(
             n_half, n_half, (1 << cfg.rmat_scale) * cfg.rmat_ef // 2,
             seed=cfg.seed,
         )
     else:
-        g = generate.rmat(cfg.rmat_scale, cfg.rmat_ef, seed=cfg.seed)
+        g = generate.rmat(
+            cfg.rmat_scale, cfg.rmat_ef, seed=cfg.seed, weighted=weighted
+        )
     log.info("synthetic graph: nv=%d ne=%d", g.nv, g.ne)
     return g
 
@@ -40,6 +45,83 @@ def make_mesh_if(cfg: RunConfig):
     from lux_tpu.parallel.mesh import make_mesh
 
     return make_mesh(cfg.num_parts)
+
+
+def validate_exchange(cfg: RunConfig, prog) -> None:
+    """Reject incompatible --exchange combinations BEFORE the O(ne) shard
+    build, with a CLI-level message (not a deep driver assert)."""
+    if cfg.exchange == "allgather":
+        return
+    if not cfg.distributed:
+        raise SystemExit(f"--exchange {cfg.exchange} requires --distributed")
+    if cfg.method == "cumsum":
+        raise SystemExit(
+            "--exchange ring/scatter supports --method scan or scatter "
+            "(bucketed reductions carry no row_ptr for cumsum)"
+        )
+    if cfg.exchange == "scatter" and (
+        prog.reduce != "sum" or getattr(prog, "needs_dst_state", False)
+    ):
+        raise SystemExit(
+            "--exchange scatter needs a sum-reducible program without "
+            "per-edge destination reads; use --exchange ring or allgather"
+        )
+
+
+def build_exchange_shards(g: HostGraph, cfg: RunConfig):
+    """Shard builder for the selected --exchange strategy (SURVEY.md §2.5).
+    ring/scatter bucket the graph for their collectives; allgather uses the
+    plain pull layout."""
+    from lux_tpu.graph.shards import build_pull_shards
+
+    if cfg.exchange == "allgather":
+        return build_pull_shards(g, cfg.num_parts)
+    if not cfg.distributed:
+        raise SystemExit(f"--exchange {cfg.exchange} requires --distributed")
+    if cfg.exchange == "ring":
+        from lux_tpu.parallel.ring import build_ring_shards
+
+        return build_ring_shards(g, cfg.num_parts)
+    from lux_tpu.parallel.scatter import build_scatter_shards
+
+    return build_scatter_shards(g, cfg.num_parts)
+
+
+def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
+    """Preflight estimate matching the selected exchange strategy."""
+    from lux_tpu.utils import preflight
+
+    sbytes = 2 if cfg.dtype == "bfloat16" else 4
+    if cfg.exchange == "ring":
+        return preflight.estimate_ring(
+            shards.spec, shards.e_bucket_pad, state_width, sbytes
+        )
+    if cfg.exchange == "scatter":
+        return preflight.estimate_scatter(
+            shards.spec, shards.e_bucket_pad, state_width, sbytes
+        )
+    return preflight.estimate_pull(shards.spec, state_width, sbytes)
+
+
+def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
+    """Distributed fixed-iteration driver for the selected exchange."""
+    if cfg.exchange == "ring":
+        from lux_tpu.parallel import ring
+
+        return ring.run_pull_fixed_ring(
+            prog, shards, state, num_iters, mesh, cfg.method
+        )
+    if cfg.exchange == "scatter":
+        from lux_tpu.parallel import scatter
+
+        return scatter.run_pull_fixed_scatter(
+            prog, shards, state, num_iters, mesh, cfg.method
+        )
+    from lux_tpu.parallel import dist
+
+    return dist.run_pull_fixed_dist(
+        prog, shards.spec, shards.arrays, state, num_iters, mesh, cfg.method
+    )
 
 
 def run_pull_stepwise(prog, spec, arrays, state, start_it, num_iters, cfg,
@@ -86,4 +168,8 @@ def print_check(name: str, violations: int):
 
 def top_k(label: str, values: np.ndarray, k: int = 5):
     idx = np.argsort(values)[::-1][:k]
-    print(f"top-{k} {label}: " + ", ".join(f"v{int(i)}={values[i]:.3e}" for i in idx))
+    # float() so non-native dtypes (bfloat16) format cleanly
+    print(
+        f"top-{k} {label}: "
+        + ", ".join(f"v{int(i)}={float(values[i]):.3e}" for i in idx)
+    )

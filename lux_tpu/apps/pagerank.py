@@ -12,7 +12,6 @@ import jax
 
 from lux_tpu.apps import common
 from lux_tpu.engine import pull
-from lux_tpu.graph.shards import build_pull_shards
 from lux_tpu.models.pagerank import PageRankProgram
 from lux_tpu.utils import preflight
 from lux_tpu.utils.config import parse_args
@@ -20,17 +19,26 @@ from lux_tpu.utils.timing import Timer, report_elapsed
 
 
 def main(argv=None):
-    cfg = parse_args(argv, description=__doc__)
+    cfg = parse_args(argv, description=__doc__, pull=True)
     g = common.load_graph(cfg)
-    shards = build_pull_shards(g, cfg.num_parts)
-    est = preflight.estimate_pull(shards.spec)
+    prog = PageRankProgram(nv=g.nv, dtype=cfg.dtype)
+    common.validate_exchange(cfg, prog)
+    shards = common.build_exchange_shards(g, cfg)
+    est = common.estimate_exchange(shards, cfg)
     print(est)
     preflight.check_fits(est)
 
-    prog = PageRankProgram(nv=shards.spec.nv)
-    arrays = jax.tree.map(jax.numpy.asarray, shards.arrays)
-    state = pull.init_state(prog, arrays)
     mesh = common.make_mesh_if(cfg)
+    # device-place the pull arrays only on the single-device paths: the
+    # distributed drivers shard host arrays themselves, and ring/scatter
+    # must never commit the O(E) pull layout to one device (their memory
+    # model — and the preflight above — accounts buckets only)
+    arrays = (
+        jax.tree.map(jax.numpy.asarray, shards.arrays)
+        if mesh is None
+        else shards.arrays
+    )
+    state = pull.init_state(prog, arrays)
 
     start_it = 0
     if cfg.ckpt_dir:
@@ -69,11 +77,8 @@ def main(argv=None):
                 cfg.method,
             )
         else:
-            from lux_tpu.parallel import dist
-
-            state = dist.run_pull_fixed_dist(
-                prog, shards.spec, shards.arrays, state,
-                cfg.num_iters - start_it, mesh, cfg.method,
+            state = common.run_fixed_dist(
+                prog, shards, state, cfg.num_iters - start_it, mesh, cfg
             )
         elapsed = timer.stop(state)
     report_elapsed(elapsed, g.ne, cfg.num_iters)

@@ -72,11 +72,23 @@ def run_convergence_app(prog, shards, cfg, name: str):
 
 def main(argv=None):
     cfg = parse_args(argv, description=__doc__, sssp=True)
-    g = common.load_graph(cfg)
+    g = common.load_graph(cfg, weighted=cfg.weighted)
+    if cfg.weighted and not np.issubdtype(g.weights.dtype, np.integer):
+        # same contract the sssp() library entry enforces: int costs
+        # (reference WeightType=int); silent truncation would corrupt
+        # distances AND the -check oracle consistently
+        raise SystemExit(
+            "weighted SSSP uses integer edge costs; got dtype "
+            + str(g.weights.dtype)
+        )
     shards = build_push_shards(g, cfg.num_parts)
-    prog = sssp_model.SSSPProgram(nv=shards.spec.nv, start=cfg.start)
+    cls = (
+        sssp_model.WeightedSSSPProgram if cfg.weighted
+        else sssp_model.SSSPProgram
+    )
+    prog = cls(nv=shards.spec.nv, start=cfg.start)
     dist_result, state = run_convergence_app(prog, shards, cfg, "sssp")
-    reached = int(np.sum(dist_result < g.nv))
+    reached = int(np.sum(dist_result < prog.inf))
     print(f"reached {reached}/{g.nv} vertices from {cfg.start}")
     if cfg.check:
         if cfg.distributed:
@@ -86,10 +98,13 @@ def main(argv=None):
             from lux_tpu.engine import validate
 
             violations = validate.count_violations(
-                shards.pull, state, validate.sssp_violation(prog.inf)
+                shards.pull, state,
+                validate.sssp_violation(prog.inf, weighted=cfg.weighted),
             )
         else:
-            violations = sssp_model.check_distances(g, dist_result)
+            violations = sssp_model.check_distances(
+                g, dist_result, weighted=cfg.weighted
+            )
         ok = common.print_check("sssp", violations)
         return 0 if ok else 1
     return 0

@@ -49,13 +49,14 @@ def count_violations(
     return int(np.sum(np.asarray(run(arrays, state), dtype=np.int64)))
 
 
-def sssp_violation(inf: int):
-    """dist[dst] <= dist[src] + 1 for every edge with a reached source
-    (triangle inequality, sssp check_kernel semantics)."""
+def sssp_violation(inf: int, weighted: bool = False):
+    """dist[dst] <= dist[src] + w for every edge with a reached source
+    (triangle inequality, sssp check_kernel semantics; w == 1 for the
+    BFS flavor, the edge weight for the Dijkstra-style extension)."""
 
     def fn(src_state, dst_state, weight):
-        del weight
-        return (dst_state > src_state + 1) & (src_state < inf)
+        w = weight.astype(src_state.dtype) if weighted else 1
+        return (dst_state > src_state + w) & (src_state < inf)
 
     return fn
 

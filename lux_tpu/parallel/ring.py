@@ -153,19 +153,25 @@ _FOLD = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
 
 
 def _neutral_like(local, reduce):
-    """Neutral-element accumulator with local's dtype AND varying type
-    (must be derived from `local` so the shard_map loop carry matches)."""
+    """Neutral-element fold accumulator.  Dtype = the REDUCTION dtype, not
+    the storage dtype: programs storing bf16 state still reduce in f32
+    (e.g. PageRankProgram.edge_value casts), and the fori_loop carry must
+    keep one dtype across folds.  Integer programs reduce in their own
+    dtype."""
+    dt = (
+        local.dtype
+        if jnp.issubdtype(local.dtype, jnp.integer)
+        else jnp.promote_types(local.dtype, jnp.float32)
+    )
+    # *_like keeps `local`'s varying-axes type (shard_map VMA): a fresh
+    # constant would be unvarying and break the fori_loop carry
     if reduce == "sum":
-        return jnp.zeros_like(local)
-    if jnp.issubdtype(local.dtype, jnp.integer):
-        v = (
-            jnp.iinfo(local.dtype).max
-            if reduce == "min"
-            else jnp.iinfo(local.dtype).min
-        )
+        return jnp.zeros_like(local, dtype=dt)
+    if jnp.issubdtype(dt, jnp.integer):
+        v = jnp.iinfo(dt).max if reduce == "min" else jnp.iinfo(dt).min
     else:
         v = jnp.inf if reduce == "min" else -jnp.inf
-    return jnp.full_like(local, v)
+    return jnp.full_like(local, v, dtype=dt)
 
 
 @lru_cache(maxsize=64)

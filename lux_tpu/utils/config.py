@@ -32,9 +32,20 @@ class RunConfig:
     ckpt_dir: Optional[str] = None  # checkpoint/resume directory
     ckpt_every: int = 0  # save every N iterations (0 = off)
     profile_dir: Optional[str] = None  # jax.profiler trace output
+    #: distributed state-exchange strategy (SURVEY.md §2.5): allgather
+    #: (replicated state, the reference's model), ring (ppermute-streamed
+    #: O(nv/P) blocks), scatter (reduce_scatter pre-combined partials;
+    #: sum programs only)
+    exchange: str = "allgather"
+    weighted: bool = False  # SSSP: relax with edge weights (Dijkstra-style)
+    dtype: str = "float32"  # state storage dtype (pagerank/CF)
 
 
-def parse_args(argv=None, description: str = "", sssp: bool = False) -> RunConfig:
+def parse_args(argv=None, description: str = "", sssp: bool = False,
+               pull: bool = False) -> RunConfig:
+    """``sssp`` adds -start/--weighted; ``pull`` adds --exchange/--dtype
+    (only the fixed-iteration pull apps consume them — a silently-ignored
+    flag would misreport what was benchmarked)."""
     ap = argparse.ArgumentParser(description=description)
     ap.add_argument("-file", help=".lux graph file (default: synthetic RMAT)")
     ap.add_argument("-ng", "--num-parts", type=int, default=1,
@@ -57,6 +68,16 @@ def parse_args(argv=None, description: str = "", sssp: bool = False) -> RunConfi
                     help="save state every N iterations")
     ap.add_argument("--profile-dir",
                     help="write a jax.profiler trace (XProf/Perfetto) here")
+    if pull:
+        ap.add_argument("--exchange", default="allgather",
+                        choices=["allgather", "ring", "scatter"],
+                        help="distributed state-exchange strategy")
+        ap.add_argument("--dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="state storage dtype")
+    if sssp:
+        ap.add_argument("--weighted", action="store_true",
+                        help="relax with edge weights (Dijkstra-style)")
     ns = ap.parse_args(argv)
     if ns.ckpt_every and not ns.ckpt_dir:
         ap.error("--ckpt-every requires --ckpt-dir")
@@ -76,4 +97,7 @@ def parse_args(argv=None, description: str = "", sssp: bool = False) -> RunConfi
         ckpt_dir=ns.ckpt_dir,
         ckpt_every=ns.ckpt_every,
         profile_dir=ns.profile_dir,
+        exchange=getattr(ns, "exchange", "allgather"),
+        weighted=getattr(ns, "weighted", False),
+        dtype=getattr(ns, "dtype", "float32"),
     )
