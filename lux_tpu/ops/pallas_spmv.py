@@ -134,6 +134,10 @@ def build_blockcsr(
 def _spmv_kernel(op: str, v_blk: int,
                  chunk_block_ref, chunk_first_ref, vals_ref, dst_ref,
                  out_ref):
+    """Out block is a COLUMN (v_blk, 1): the MXU contraction result
+    (V_BLK, 1) and the lane-reduced min/max (keepdims) are both
+    sublane-major, so accumulation never needs a sublane<->lane relayout
+    (the transposes Mosaic would otherwise insert per grid step)."""
     import jax.experimental.pallas as pl
 
     i = pl.program_id(0)
@@ -159,13 +163,13 @@ def _spmv_kernel(op: str, v_blk: int,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (V_BLK, 1)
-        out_ref[0, :] = out_ref[0, :] + contrib[:, 0]
+        out_ref[:] = out_ref[:] + contrib
     elif op == "min":
         masked = jnp.where(onehot, jnp.broadcast_to(vals, onehot.shape), jnp.inf)
-        out_ref[0, :] = jnp.minimum(out_ref[0, :], jnp.min(masked, axis=1))
+        out_ref[:] = jnp.minimum(out_ref[:], jnp.min(masked, axis=1, keepdims=True))
     else:
         masked = jnp.where(onehot, jnp.broadcast_to(vals, onehot.shape), -jnp.inf)
-        out_ref[0, :] = jnp.maximum(out_ref[0, :], jnp.max(masked, axis=1))
+        out_ref[:] = jnp.maximum(out_ref[:], jnp.max(masked, axis=1, keepdims=True))
 
 
 @functools.partial(jax.jit, static_argnames=("op", "v_blk", "num_vblocks", "interpret"))
@@ -193,12 +197,13 @@ def spmv_blockcsr(
             pl.BlockSpec((1, t), lambda i, cb, cf: (i, 0)),
             pl.BlockSpec((1, t), lambda i, cb, cf: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, v_blk), lambda i, cb, cf: (cb[i], 0)),
+        # column block: row-block cb[i] of the (num_vblocks*v_blk, 1) output
+        out_specs=pl.BlockSpec((v_blk, 1), lambda i, cb, cf: (cb[i], 0)),
     )
     out = pl.pallas_call(
         functools.partial(_spmv_kernel, op, v_blk),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((num_vblocks, v_blk), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((num_vblocks * v_blk, 1), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
