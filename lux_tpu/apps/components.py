@@ -11,16 +11,15 @@ import sys
 import numpy as np
 
 from lux_tpu.apps import common
-from lux_tpu.apps.sssp import run_convergence_app
-from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.apps.sssp import build_push_app_shards, run_convergence_app
 from lux_tpu.models import components as cc_model
 from lux_tpu.utils.config import parse_args
 
 
 def main(argv=None):
-    cfg = parse_args(argv, description=__doc__)
+    cfg = parse_args(argv, description=__doc__, push=True)
     g = common.load_graph(cfg)
-    shards = build_push_shards(g, cfg.num_parts)
+    shards = build_push_app_shards(g, cfg)
     prog = cc_model.MaxLabelProgram()
     labels, state = run_convergence_app(prog, shards, cfg, "components")
     n_comp = len(np.unique(labels))

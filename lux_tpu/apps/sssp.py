@@ -19,9 +19,25 @@ from lux_tpu.utils.config import parse_args
 from lux_tpu.utils.timing import IterStats, Timer, report_elapsed
 
 
+def build_push_app_shards(g, cfg):
+    """Push shards for the selected dense-round --exchange strategy."""
+    if cfg.exchange == "ring":
+        if not cfg.distributed:
+            raise SystemExit("--exchange ring requires --distributed")
+        from lux_tpu.parallel.ring import build_push_ring_shards
+
+        return build_push_ring_shards(g, cfg.num_parts)
+    return build_push_shards(g, cfg.num_parts)
+
+
 def run_convergence_app(prog, shards, cfg, name: str):
     """Shared driver for frontier apps (SSSP + CC)."""
-    est = preflight.estimate_push(shards.spec, shards.pspec)
+    if cfg.exchange == "ring":
+        est = preflight.estimate_push_ring(
+            shards.spec, shards.pspec, shards.e_bucket_pad
+        )
+    else:
+        est = preflight.estimate_push(shards.spec, shards.pspec)
     print(est)
     preflight.check_fits(est)
     mesh = common.make_mesh_if(cfg)
@@ -54,6 +70,10 @@ def run_convergence_app(prog, shards, cfg, name: str):
             state, iters, edges = push.run_push(
                 prog, shards, cfg.max_iters, cfg.method
             )
+        elif cfg.exchange == "ring":
+            state, iters, edges = push.run_push_ring(
+                prog, shards, mesh, cfg.max_iters, cfg.method
+            )
         else:
             state, iters, edges = push.run_push_dist(
                 prog, shards, mesh, cfg.max_iters, cfg.method
@@ -71,7 +91,7 @@ def run_convergence_app(prog, shards, cfg, name: str):
 
 
 def main(argv=None):
-    cfg = parse_args(argv, description=__doc__, sssp=True)
+    cfg = parse_args(argv, description=__doc__, sssp=True, push=True)
     g = common.load_graph(cfg, weighted=cfg.weighted)
     if cfg.weighted and not np.issubdtype(g.weights.dtype, np.integer):
         # same contract the sssp() library entry enforces: int costs
@@ -81,7 +101,7 @@ def main(argv=None):
             "weighted SSSP uses integer edge costs; got dtype "
             + str(g.weights.dtype)
         )
-    shards = build_push_shards(g, cfg.num_parts)
+    shards = build_push_app_shards(g, cfg)
     cls = (
         sssp_model.WeightedSSSPProgram if cfg.weighted
         else sssp_model.SSSPProgram

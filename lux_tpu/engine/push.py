@@ -127,6 +127,20 @@ def build_queue(pspec: PushSpec, arr: ShardArrays, changed, values):
     return q_vid, q_val, count
 
 
+class VertexView(NamedTuple):
+    """Slim (P, V) per-vertex arrays — everything the queue/carry logic
+    reads from ShardArrays, without the O(E) edge arrays (the push-ring
+    driver must never device-place those)."""
+
+    global_vid: Any
+    degree: Any
+    vtx_mask: Any
+
+
+def vertex_view(arrays) -> VertexView:
+    return VertexView(arrays.global_vid, arrays.degree, arrays.vtx_mask)
+
+
 class PushCarry(NamedTuple):
     state: Any
     q_vid: Any
@@ -437,6 +451,143 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         return out.state[None], out.it, out.edges
 
     return run
+
+
+@lru_cache(maxsize=64)
+def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
+                       e_bucket_pad: int, max_iters: int, method: str):
+    """Direction-optimizing push with the RING dense exchange: sparse
+    rounds exchange (vid, value) queues exactly like _compile_push_dist;
+    dense rounds fold ppermute-streamed state blocks through the ring
+    buckets (min/max end-reductions) instead of all-gathering the whole
+    state — per-chip resident state stays O(nv/P), so CC/SSSP scale past
+    the replicated-state ceiling (SURVEY.md §7.3)."""
+    from lux_tpu.parallel.ring import RingArrays, _neutral_like
+
+    num_parts = spec.num_parts
+    perm = [(i, (i - 1) % num_parts) for i in range(num_parts)]
+    rarr_specs = RingArrays(*([P(PARTS_AXIS)] * len(RingArrays._fields)))
+    parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
+    view_specs = VertexView(*([P(PARTS_AXIS)] * len(VertexView._fields)))
+    carry_specs = PushCarry(*([P(PARTS_AXIS)] * 4), P(), P(), P())
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(rarr_specs, parr_specs, view_specs, carry_specs),
+        out_specs=(P(PARTS_AXIS), P(), P()),
+    )
+    def run(rarr_blk, parr_blk, view_blk, carry_blk):
+        rarr = jax.tree.map(lambda a: a[0], rarr_blk)
+        parr = jax.tree.map(lambda a: a[0], parr_blk)
+        view = jax.tree.map(lambda a: a[0], view_blk)
+        V = spec.nv_pad
+        my = jax.lax.axis_index(PARTS_AXIS)
+        op = _op(prog)
+
+        def cond(c):
+            return (c.active > 0) & (c.it < max_iters)
+
+        def body(c):
+            local = c.state
+            q_vids_all = jax.lax.all_gather(c.q_vid, PARTS_AXIS, tiled=True)
+            q_vals_all = jax.lax.all_gather(c.q_val, PARTS_AXIS, tiled=True)
+            rows, counts, incl, total = sparse_prep(parr, q_vids_all)
+            g_cnt = jax.lax.psum(c.count, PARTS_AXIS)
+            flags = jax.lax.psum(
+                jnp.stack(
+                    [
+                        (c.count > pspec.f_cap).astype(jnp.int32),
+                        (total > pspec.e_sp).astype(jnp.int32),
+                    ]
+                ),
+                PARTS_AXIS,
+            )
+            use_dense = (
+                (g_cnt > spec.nv // pspec.pull_threshold_den)
+                | (flags.max() > 0)
+            )
+
+            def dense_branch():
+                def fold(k, acc, block):
+                    q = (my + k) % num_parts  # owner of the resident block
+                    vals = prog.relax(block[rarr.src_local[q]], rarr.weights[q])
+                    part = segment.segment_reduce_by_ends(
+                        vals, rarr.head_flag[q], rarr.dst_local[q], V,
+                        reduce=prog.reduce, method=method,
+                    )
+                    return op(acc, part)
+
+                def fold_block(k, carry2):
+                    acc, block = carry2
+                    acc = fold(k, acc, block)
+                    return acc, jax.lax.ppermute(block, PARTS_AXIS, perm)
+
+                acc0 = _neutral_like(local, prog.reduce)
+                acc, block = jax.lax.fori_loop(
+                    0, num_parts - 1, fold_block, (acc0, local)
+                )
+                acc = fold(num_parts - 1, acc, block)
+                return jnp.where(view.vtx_mask, op(local, acc), local)
+
+            def sparse_branch():
+                return jnp.where(
+                    view.vtx_mask,
+                    sparse_part_step(
+                        prog, pspec, parr, V, q_vids_all, q_vals_all,
+                        rows, counts, incl, local,
+                    ),
+                    local,
+                )
+
+            new = jax.lax.cond(use_dense, dense_branch, sparse_branch)
+            changed = (new != local) & view.vtx_mask
+            q_vid, q_val, cnt = build_queue(pspec, view, changed, new)
+            active = jax.lax.psum(cnt, PARTS_AXIS)
+            g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
+            edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
+            return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active, edges)
+
+        c0 = PushCarry(
+            carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
+            carry_blk.count[0], carry_blk.it, carry_blk.active,
+            carry_blk.edges,
+        )
+        out = jax.lax.while_loop(cond, body, c0)
+        return out.state[None], out.it, out.edges
+
+    return run
+
+
+def run_push_ring(
+    prog: PushProgram,
+    shards,  # parallel.ring.PushRingShards
+    mesh: Mesh,
+    max_iters: int = 10_000,
+    method: str = "scan",
+):
+    """Distributed push driver with the ring dense exchange.  Only the
+    O(part edges) CSR/bucket arrays and O(V) vertex arrays touch the
+    devices — never the pull layout's O(E) stacked arrays."""
+    spec, pspec = shards.spec, shards.pspec
+    assert spec.num_parts == mesh.devices.size
+    assert method in ("scan", "scatter"), (
+        "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
+    )
+    rarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.rarrays))
+    parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
+    view_host = vertex_view(shards.arrays)
+    view = shard_stacked(mesh, jax.tree.map(jnp.asarray, view_host))
+    carry0 = _init_carry(prog, pspec, jax.tree.map(jnp.asarray, view_host))
+    carry0 = PushCarry(
+        *shard_stacked(mesh, tuple(carry0[:4])), carry0.it, carry0.active,
+        carry0.edges,
+    )
+    run = _compile_push_ring(
+        prog, mesh, pspec, spec, shards.e_bucket_pad, max_iters, method
+    )
+    return run(rarrays, parrays, view, carry0)
 
 
 def run_push_dist(

@@ -108,12 +108,13 @@ def mark_bucket_heads(hf_row: np.ndarray, dl: np.ndarray) -> None:
 
 
 def build_ring_shards(
-    g: HostGraph, num_parts: int, parts_subset=None
+    g: HostGraph, num_parts: int, parts_subset=None, pull=None
 ) -> RingShards:
     """Bucket the graph for ring streaming.  ``parts_subset`` builds only
     those parts' (P, B) bucket rows (the sharded_load pattern: each host
-    materializes O(its edges), not O(ne))."""
-    pull = build_pull_shards(g, num_parts)
+    materializes O(its edges), not O(ne)).  Pass an existing ``pull``
+    build to avoid repartitioning."""
+    pull = pull if pull is not None else build_pull_shards(g, num_parts)
     spec, cuts = pull.spec, pull.cuts
     Pn, V = num_parts, spec.nv_pad
     dst_of = g.dst_of_edges()
@@ -240,6 +241,54 @@ class _RingArrView(NamedTuple):
 
 def _apply(prog, local, acc, vtx_mask, degree):
     return prog.apply(local, acc, _RingArrView(vtx_mask=vtx_mask, degree=degree))
+
+
+@dataclasses.dataclass
+class PushRingShards:
+    """Push-engine shards with the RING dense exchange: frontier CSR
+    buckets (sparse rounds exchange queues) + per-source-owner ring
+    buckets (dense rounds fold ppermute-streamed state blocks instead of
+    all-gathering the whole state).  The O(E) pull arrays inside ``push``
+    stay host-side; the push-ring driver never device-places them."""
+
+    push: "object"  # PushShards (engine-facing; avoids a circular import)
+    rarrays: RingArrays
+    e_bucket_pad: int
+
+    @property
+    def spec(self):
+        return self.push.spec
+
+    @property
+    def pspec(self):
+        return self.push.pspec
+
+    @property
+    def parrays(self):
+        return self.push.parrays
+
+    @property
+    def arrays(self):
+        return self.push.arrays
+
+    @property
+    def pull(self):
+        return self.push.pull
+
+    def scatter_to_global(self, stacked):
+        return self.push.scatter_to_global(stacked)
+
+
+def build_push_ring_shards(
+    g: HostGraph, num_parts: int, parts_subset=None
+) -> PushRingShards:
+    """Push shards + ring buckets over the SAME partition (one build)."""
+    from lux_tpu.graph.push_shards import build_push_shards
+
+    push = build_push_shards(g, num_parts)
+    rs = build_ring_shards(g, num_parts, parts_subset, pull=push.pull)
+    return PushRingShards(push=push, rarrays=rs.rarrays,
+                          e_bucket_pad=rs.e_bucket_pad)
 
 
 def run_pull_fixed_ring(

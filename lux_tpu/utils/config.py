@@ -42,10 +42,13 @@ class RunConfig:
 
 
 def parse_args(argv=None, description: str = "", sssp: bool = False,
-               pull: bool = False) -> RunConfig:
-    """``sssp`` adds -start/--weighted; ``pull`` adds --exchange/--dtype
-    (only the fixed-iteration pull apps consume them — a silently-ignored
-    flag would misreport what was benchmarked)."""
+               pull: bool = False, push: bool = False) -> RunConfig:
+    """``sssp`` adds -start/--weighted; ``pull`` adds --exchange
+    {allgather,ring,scatter}/--dtype; ``push`` adds --exchange
+    {allgather,ring} (frontier apps: dense rounds can ring-stream, but
+    reduce_scatter can't pre-combine min/max).  Flags appear only on apps
+    that consume them — a silently-ignored flag would misreport what was
+    benchmarked."""
     ap = argparse.ArgumentParser(description=description)
     ap.add_argument("-file", help=".lux graph file (default: synthetic RMAT)")
     ap.add_argument("-ng", "--num-parts", type=int, default=1,
@@ -75,6 +78,10 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         ap.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"],
                         help="state storage dtype")
+    elif push:
+        ap.add_argument("--exchange", default="allgather",
+                        choices=["allgather", "ring"],
+                        help="dense-round state-exchange strategy")
     if sssp:
         ap.add_argument("--weighted", action="store_true",
                         help="relax with edge weights (Dijkstra-style)")

@@ -59,6 +59,25 @@ def estimate_push(spec: ShardSpec, pspec: PushSpec,
     )
 
 
+def estimate_push_ring(spec: ShardSpec, pspec: PushSpec, e_bucket_pad: int,
+                       state_dtype_bytes: int = 4) -> MemoryEstimate:
+    """Per-chip footprint of the push engine with the RING dense exchange:
+    frontier CSR + queues + sparse buffer (like estimate_push) plus the P
+    ring buckets, but NO O(E) pull arrays and NO gathered state buffer —
+    dense rounds stream O(nv/P) blocks."""
+    U, E, F = pspec.u_pad, spec.e_pad, pspec.f_cap
+    Pn, V = spec.num_parts, spec.nv_pad
+    csr = 4 * U + 4 * (U + 1) + 4 * E + 4 * E  # uniq, rp, dst, weight
+    buckets = Pn * e_bucket_pad * 13
+    view = V * (4 + 4 + 1)  # global_vid, degree, vtx_mask
+    shard = csr + buckets + view
+    queues = 2 * 4 * F * 2 + 2 * 4 * Pn * F
+    sparse_buf = 4 * pspec.e_sp * 3
+    blk = V * state_dtype_bytes
+    state = 4 * blk + queues + sparse_buf  # local + in-flight + acc + new
+    return MemoryEstimate(shard, state, 0, shard + state)
+
+
 def estimate_ring(spec: ShardSpec, e_bucket_pad: int, state_width: int = 1,
                   state_dtype_bytes: int = 4) -> MemoryEstimate:
     """Per-chip footprint of the ring-streamed exchange driver: P buckets of

@@ -109,13 +109,30 @@ def test_pagerank_cli_ckpt_resume(tmp_path, capsys):
     assert np.array_equal(line1, line2)
 
 
-def test_push_apps_reject_exchange_flag():
-    """--exchange/--dtype are pull-app flags; push apps must not silently
-    ignore them."""
+def test_push_apps_flag_gating():
+    """Push apps take --exchange {allgather,ring} (ring needs
+    --distributed); scatter and --dtype are pull-only and rejected."""
     with pytest.raises(SystemExit):
-        sssp_app.main(SMALL + ["--exchange", "ring"])
+        sssp_app.main(SMALL + ["--exchange", "scatter"])
     with pytest.raises(SystemExit):
         cc_app.main(SMALL + ["--dtype", "bfloat16"])
+    with pytest.raises(SystemExit, match="requires --distributed"):
+        sssp_app.main(SMALL + ["--exchange", "ring"])
+
+
+def test_sssp_cli_ring_exchange(capsys):
+    """Frontier app with ring-streamed dense rounds + on-device -check."""
+    args = SMALL + ["-ng", "8", "--distributed", "--exchange", "ring",
+                    "-check"]
+    assert sssp_app.main(args) == 0
+    assert "[PASS] sssp" in capsys.readouterr().out
+
+
+def test_components_cli_ring_exchange(capsys):
+    args = SMALL + ["-ng", "8", "--distributed", "--exchange", "ring",
+                    "-check"]
+    assert cc_app.main(args) == 0
+    assert "[PASS] components" in capsys.readouterr().out
 
 
 def test_colfilter_rejects_scatter_exchange_upfront():
