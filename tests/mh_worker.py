@@ -41,3 +41,26 @@ for i, p in enumerate(mine):
     lo, hi = int(shards.cuts[p]), int(shards.cuts[p + 1])
     np.testing.assert_allclose(local[i][: hi - lo], want[lo:hi], rtol=5e-5)
 print(f"process {pid}: multihost pagerank OK over {P} devices / {nproc} procs", flush=True)
+
+# --- ring exchange with PER-HOST SUBSET bucket builds: each process
+# materializes only its parts' (P, B) bucket rows (the RMAT27 load plan,
+# SURVEY.md §7.3) and assemble_global stitches the global stacked arrays
+from lux_tpu.parallel import ring
+
+rs_local = ring.build_ring_shards(g, P, parts_subset=mine, pull=shards)
+rarr_global = jax.tree.map(
+    lambda a: mh.assemble_global(mesh, a, P), rs_local.rarrays
+)
+rs = ring.RingShards(
+    pull=shards, rarrays=rarr_global,
+    e_bucket_pad=rs_local.e_bucket_pad, parts_subset=list(range(P)),
+)
+ring_out = ring.run_pull_fixed_ring(prog, rs, state0, 5, mesh)
+rshards_sorted = sorted(
+    ring_out.addressable_shards, key=lambda s: s.index[0].start
+)
+rlocal = np.concatenate([np.asarray(s.data)[0][None] for s in rshards_sorted])
+for i, p in enumerate(mine):
+    lo, hi = int(shards.cuts[p]), int(shards.cuts[p + 1])
+    np.testing.assert_allclose(rlocal[i][: hi - lo], want[lo:hi], rtol=5e-5)
+print(f"process {pid}: multihost ring OK (subset-built buckets)", flush=True)

@@ -25,7 +25,7 @@ def test_two_process_distributed_pagerank():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=220)
+            out, _ = p.communicate(timeout=320)
             outs.append(out)
     finally:
         # never leak workers: a deadlocked pair would keep the coordinator
@@ -36,3 +36,4 @@ def test_two_process_distributed_pagerank():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert f"process {pid}: multihost pagerank OK" in out
+        assert f"process {pid}: multihost ring OK" in out
