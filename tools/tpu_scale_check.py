@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Single-chip scale test: find the HBM ceiling and validate preflight.
+
+Runs PageRank on growing RMAT graphs (2^22 .. 2^26 edges by default) on
+the real chip, comparing `utils.preflight.estimate_pull` against the
+device's actual `memory_stats()`, and exercising buffer donation at
+scale (VERDICT r1 #7; reference dataset-scale table README.md:77-86).
+
+Each size runs in a SUBPROCESS so an OOM kills the child, not the
+harness; the parent records the last size that fit.  Results go to
+stdout as a markdown table for BASELINE.md.
+
+Usage (on TPU):  python tools/tpu_scale_check.py [--max-scale 23]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def child(scale: int, ef: int, iters: int) -> int:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.utils import preflight
+
+    g = generate.rmat(scale, ef, seed=0)
+    shards = build_pull_shards(g, 1)
+    est = preflight.estimate_pull(shards.spec)
+    prog = PageRankProgram(nv=shards.spec.nv)
+    arrays = jax.tree.map(jnp.asarray, shards.arrays)
+    state0 = pull.init_state(prog, arrays)
+    run = lambda s: pull.run_pull_fixed(  # noqa: E731
+        prog, shards.spec, arrays, s, iters, "scan"
+    )
+    run(state0).block_until_ready()
+    t0 = time.perf_counter()
+    out = run(state0)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    stats = jax.devices()[0].memory_stats() or {}
+    print(
+        json.dumps(
+            {
+                "scale": scale,
+                "ne": g.ne,
+                "est_bytes": est.total_bytes,
+                "peak_bytes": stats.get("peak_bytes_in_use", 0),
+                "limit_bytes": stats.get("bytes_limit", 0),
+                "gteps": iters * g.ne / dt / 1e9,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--min-scale", type=int, default=18)
+    ap.add_argument("--max-scale", type=int, default=23)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--child-scale", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child_scale is not None:
+        return child(args.child_scale, args.ef, args.iters)
+
+    rows = []
+    for scale in range(args.min_scale, args.max_scale + 1):
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--child-scale", str(scale), "--ef", str(args.ef),
+             "--iters", str(args.iters)],
+            capture_output=True, text=True, timeout=3600,
+        )
+        line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        if r.returncode != 0 or not line:
+            print(f"# scale {scale}: FAILED (rc={r.returncode}) — "
+                  f"{r.stderr.strip().splitlines()[-1] if r.stderr.strip() else 'no output'}",
+                  flush=True)
+            break
+        rows.append(json.loads(line[0]))
+        d = rows[-1]
+        print(f"# scale {scale}: est {d['est_bytes']/2**30:.2f} GiB, "
+              f"peak {d['peak_bytes']/2**30:.2f} GiB, "
+              f"{d['gteps']:.3f} GTEPS", flush=True)
+
+    print("\n| scale | ne | preflight est | device peak | GTEPS |")
+    print("|---|---|---|---|---|")
+    for d in rows:
+        print(f"| 2^{d['scale']} | {d['ne']:,} | "
+              f"{d['est_bytes']/2**30:.2f} GiB | "
+              f"{d['peak_bytes']/2**30:.2f} GiB | {d['gteps']:.3f} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
