@@ -25,8 +25,10 @@ Env knobs:
   LUX_BENCH_METHOD (default auto: race scan vs scatter [vs pallas on TPU])
   LUX_BENCH_DTYPE  (default float32; bfloat16 halves state bandwidth)
   LUX_BENCH_WATCHDOG_S (default 900) total wall budget for the orchestrator
-  LUX_BENCH_TPU_S  (default 60% of watchdog) how long to wait for the TPU
-                   worker before starting the CPU fallback
+                   (0 = unbounded)
+  LUX_BENCH_TPU_S  (default budget-120) how long to wait for the TPU worker
+  LUX_BENCH_CPU_SCALE (default min(scale, 18)) fallback worker's RMAT scale
+                   — a 1-core CPU needs a smaller graph to finish in budget
 """
 from __future__ import annotations
 
@@ -160,13 +162,14 @@ def worker_main():
     )
 
 
-def _spawn_worker(env, out_path):
+def _spawn_worker(env, out_path, nice=0):
     # stderr goes to a FILE, not our fd: an abandoned (stuck) worker must
     # not hold the orchestrator's stderr pipe open past our exit, or a
     # driver reading it to EOF hangs.  start_new_session keeps a group-kill
     # of the orchestrator from SIGKILLing a tunnel-claim-holder.
     out = open(out_path, "wb")
     err = open(out_path + ".err", "wb")
+    preexec = (lambda: os.nice(nice)) if nice else None
     return subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--worker"],
         stdout=out,
@@ -174,6 +177,7 @@ def _spawn_worker(env, out_path):
         env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)),
         start_new_session=True,
+        preexec_fn=preexec,
     )
 
 
@@ -211,7 +215,7 @@ def main():
         budget = 1 << 30
     t_start = time.monotonic()
     scale = int(os.environ.get("LUX_BENCH_SCALE", "20"))
-    tpu_wait = int(os.environ.get("LUX_BENCH_TPU_S", str(int(budget * 0.6))))
+    tpu_wait = int(os.environ.get("LUX_BENCH_TPU_S", str(budget - 120)))
 
     # unique per-run paths: an abandoned worker from a PREVIOUS run still
     # holds its old fd and may eventually write its (differently-configured)
@@ -219,7 +223,39 @@ def main():
     tag = f"{os.getpid()}_{int(time.time())}"
     tpu_out = f"/tmp/lux_bench_tpu_worker_{tag}.json"
     tpu_proc = _spawn_worker(dict(os.environ), tpu_out)
+
+    # CPU insurance starts IMMEDIATELY (niced, smaller graph): a stuck TPU
+    # worker sleeps in device init, so the single host core is effectively
+    # free — by the TPU deadline the fallback number is already banked
+    # rather than just starting.  A 1-core CPU needs a smaller graph to
+    # finish inside the budget at all.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LUX_BENCH_SCALE"] = os.environ.get(
+        "LUX_BENCH_CPU_SCALE", str(min(scale, 18))
+    )
+    # strip the axon sitecustomize: when the relay is wedged it can hang
+    # even CPU interpreters at startup
+    env["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    ) or os.path.dirname(os.path.abspath(__file__))
+    cpu_out = f"/tmp/lux_bench_cpu_worker_{tag}.json"
+    # no insurance needed when the primary is already CPU-targeted (it
+    # would only contend for the single host core)
+    cpu_proc = (
+        None
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        else _spawn_worker(env, cpu_out, nice=15)
+    )
+
     if _wait(tpu_proc, t_start + tpu_wait) and tpu_proc.returncode == 0 and _relay(tpu_out):
+        if cpu_proc is not None:
+            try:
+                cpu_proc.kill()  # insurance unneeded; holds no tunnel claim
+            except OSError:
+                pass
         return
 
     if tpu_proc.poll() is None:
@@ -228,7 +264,7 @@ def main():
         # if the grant ever arrives it finishes and exits on its own.
         print(
             f"# TPU worker still stuck after {tpu_wait}s; "
-            "falling back to CPU (worker left running, not killed)",
+            "using CPU insurance result (worker left running, not killed)",
             file=sys.stderr,
             flush=True,
         )
@@ -240,17 +276,8 @@ def main():
             flush=True,
         )
 
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    # strip the axon sitecustomize: when the relay is wedged it can hang
-    # even CPU interpreters at startup
-    env["PYTHONPATH"] = os.pathsep.join(
-        p
-        for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon" not in p
-    ) or os.path.dirname(os.path.abspath(__file__))
-    cpu_out = f"/tmp/lux_bench_cpu_worker_{tag}.json"
-    cpu_proc = _spawn_worker(env, cpu_out)
+    if cpu_proc is None:
+        cpu_proc = _spawn_worker(env, cpu_out)  # primary WAS cpu and failed
     # leave ~60s of the budget for this parent's own bookkeeping
     if _wait(cpu_proc, t_start + budget - 60) and cpu_proc.returncode == 0 and _relay(cpu_out):
         return
