@@ -76,6 +76,25 @@ def main(argv=None):
                 prog, shards.spec, arrays, state, cfg.num_iters - start_it,
                 cfg.method,
             )
+        elif cfg.ckpt_every:
+            # distributed checkpointing: run the on-device loop in
+            # ckpt_every-sized chunks, saving the gathered state between
+            # chunks (the loop itself stays fused on device within a chunk)
+            from lux_tpu.utils import checkpoint
+
+            it = start_it
+            while it < cfg.num_iters:
+                n = min(cfg.ckpt_every, cfg.num_iters - it)
+                state = common.run_fixed_dist(prog, shards, state, n, mesh, cfg)
+                it += n
+                if it < cfg.num_iters or cfg.num_iters % cfg.ckpt_every == 0:
+                    import os
+
+                    os.makedirs(cfg.ckpt_dir, exist_ok=True)
+                    checkpoint.save(
+                        os.path.join(cfg.ckpt_dir, f"ckpt_{it}.npz"),
+                        jax.device_get(state), it, {"app": "pagerank"},
+                    )
         else:
             state = common.run_fixed_dist(
                 prog, shards, state, cfg.num_iters - start_it, mesh, cfg

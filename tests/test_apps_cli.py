@@ -147,3 +147,23 @@ def test_pagerank_rejects_cumsum_with_ring():
     with pytest.raises(SystemExit, match="scan or scatter"):
         pr_app.main(SMALL + ["-ng", "8", "--distributed",
                              "--exchange", "ring", "--method", "cumsum"])
+
+
+def test_pagerank_cli_distributed_ckpt_resume(tmp_path, capsys):
+    """Distributed runs checkpoint in on-device chunks and resume."""
+    d = str(tmp_path / "ckd")
+    base = SMALL + ["-ng", "8", "--distributed", "-ni", "4",
+                    "--ckpt-dir", d]
+    assert pr_app.main(base + ["--ckpt-every", "2"]) == 0
+    line1 = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("top-5")][0]
+    import os
+
+    assert sorted(os.listdir(d)) == ["ckpt_2.npz", "ckpt_4.npz"]
+    # wipe the final checkpoint; resume from iteration 2
+    os.remove(os.path.join(d, "ckpt_4.npz"))
+    assert pr_app.main(base) == 0
+    out2 = capsys.readouterr().out
+    assert "resumed from" in out2
+    line2 = [ln for ln in out2.splitlines() if ln.startswith("top-5")][0]
+    assert line1 == line2
