@@ -38,23 +38,50 @@ def main(argv=None):
     )
     state = pull.init_state(prog, arrays)
 
+    start_it = 0
+    if cfg.ckpt_dir:
+        from lux_tpu.utils import checkpoint
+
+        prev = checkpoint.latest(cfg.ckpt_dir)
+        if prev:
+            saved, start_it, _ = checkpoint.load(prev)
+            state = jax.numpy.asarray(saved)
+            print(f"resumed from {prev} at iteration {start_it}")
+
     from lux_tpu.utils import profiling
 
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
-        if cfg.verbose and mesh is None:
+        elapsed = None
+        if (cfg.verbose or cfg.ckpt_every) and mesh is None:
+            from lux_tpu.utils import checkpoint
+
+            def on_iter(it, st):
+                if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
+                    checkpoint.save_iteration(
+                        cfg.ckpt_dir, it + 1, jax.device_get(st), "colfilter"
+                    )
+
             state, _ = common.run_pull_stepwise(
-                prog, shards.spec, arrays, state, 0, cfg.num_iters, cfg, g.nv
+                prog, shards.spec, arrays, state, start_it, cfg.num_iters,
+                cfg, g.nv, on_iter,
             )
         elif mesh is None:
             state = pull.run_pull_fixed(
-                prog, shards.spec, arrays, state, cfg.num_iters, cfg.method
+                prog, shards.spec, arrays, state, cfg.num_iters - start_it,
+                cfg.method,
+            )
+        elif cfg.ckpt_every:
+            state, elapsed = common.run_fixed_dist_chunked(
+                prog, shards, state, start_it, cfg.num_iters, mesh, cfg,
+                "colfilter",
             )
         else:
             state = common.run_fixed_dist(
-                prog, shards, state, cfg.num_iters, mesh, cfg
+                prog, shards, state, cfg.num_iters - start_it, mesh, cfg
             )
-        elapsed = timer.stop(state)
+        if elapsed is None:
+            elapsed = timer.stop(state)
     report_elapsed(elapsed, g.ne, cfg.num_iters)
     v = shards.scatter_to_global(jax.device_get(state)).astype("float32")
     print(f"training RMSE = {cf_model.rmse(g, v):.4f}")

@@ -103,6 +103,33 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
     return preflight.estimate_pull(shards.spec, state_width, sbytes)
 
 
+def run_fixed_dist_chunked(prog, shards, state, start_it, num_iters, mesh,
+                           cfg: RunConfig, app: str):
+    """Distributed fixed-iteration run in --ckpt-every-sized on-device
+    chunks with a checkpoint between chunks.  Returns (state,
+    compute_seconds) where compute_seconds EXCLUDES the host-side
+    checkpoint I/O (device_get + disk) so reported GTEPS stays an engine
+    number."""
+    import jax
+
+    from lux_tpu.utils import checkpoint
+    from lux_tpu.utils.timing import Timer
+
+    compute = 0.0
+    it = start_it
+    while it < num_iters:
+        n = min(cfg.ckpt_every, num_iters - it)
+        t = Timer()
+        state = run_fixed_dist(prog, shards, state, n, mesh, cfg)
+        compute += t.stop(state)
+        it += n
+        if it < num_iters or num_iters % cfg.ckpt_every == 0:
+            checkpoint.save_iteration(
+                cfg.ckpt_dir, it, jax.device_get(state), app
+            )
+    return state, compute
+
+
 def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
     """Distributed fixed-iteration driver for the selected exchange."""
     if cfg.exchange == "ring":

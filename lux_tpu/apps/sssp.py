@@ -32,6 +32,13 @@ def build_push_app_shards(g, cfg):
 
 def run_convergence_app(prog, shards, cfg, name: str):
     """Shared driver for frontier apps (SSSP + CC)."""
+    if cfg.ckpt_every or cfg.ckpt_dir:
+        # honest gating beats silent ignoring: the frontier carry (queues +
+        # counts) is not serialized; fixed-iteration apps own checkpointing
+        raise SystemExit(
+            "checkpoint/resume is supported for the fixed-iteration apps "
+            "(pagerank, colfilter); convergence apps restart from scratch"
+        )
     if cfg.exchange == "ring":
         est = preflight.estimate_push_ring(
             shards.spec, shards.pspec, shards.e_bucket_pad

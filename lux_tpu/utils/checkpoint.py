@@ -35,6 +35,15 @@ def load(path: str) -> Tuple[np.ndarray, int, Dict[str, Any]]:
         )
 
 
+def save_iteration(directory: str, iteration: int, state, app: str) -> str:
+    """Save under the canonical name ``ckpt_<iteration>.npz`` (the format
+    ``latest`` scans for); creates the directory on first use."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"ckpt_{iteration}.npz")
+    save(path, state, iteration, {"app": app})
+    return path
+
+
 def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
     """Most recent checkpoint file in a directory (by iteration suffix)."""
     if not os.path.isdir(directory):

@@ -167,3 +167,25 @@ def test_pagerank_cli_distributed_ckpt_resume(tmp_path, capsys):
     assert "resumed from" in out2
     line2 = [ln for ln in out2.splitlines() if ln.startswith("top-5")][0]
     assert line1 == line2
+
+
+def test_colfilter_cli_distributed_ckpt_resume(tmp_path, capsys):
+    d = str(tmp_path / "cfck")
+    base = SMALL + ["-ng", "8", "--distributed", "-ni", "4",
+                    "--ckpt-dir", d]
+    assert cf_app.main(base + ["--ckpt-every", "2"]) == 0
+    out1 = capsys.readouterr().out
+    rmse1 = [ln for ln in out1.splitlines() if "RMSE" in ln][0]
+    import os
+
+    os.remove(os.path.join(d, "ckpt_4.npz"))
+    assert cf_app.main(base) == 0
+    out2 = capsys.readouterr().out
+    assert "resumed from" in out2
+    rmse2 = [ln for ln in out2.splitlines() if "RMSE" in ln][0]
+    assert rmse1 == rmse2
+
+
+def test_push_apps_reject_ckpt_flags(tmp_path):
+    with pytest.raises(SystemExit, match="fixed-iteration"):
+        sssp_app.main(SMALL + ["--ckpt-dir", str(tmp_path)])
