@@ -84,15 +84,27 @@ class RingShards:
 
 def bucket_counts(g: HostGraph, cuts, num_parts: int):
     """(P, P) bucket edge counts: [p, q] = edges into part p's destinations
-    from part q's sources.  O(ne) total; every host computes this so padded
-    bucket shapes agree globally."""
-    owner_of = np.searchsorted(cuts, g.col_idx, side="right") - 1
+    from part q's sources.  One O(slice) pass per part — nothing ne-sized
+    is ever materialized (col_idx may be an mmap view; slicing reads only
+    that byte range), so subset builds on big graphs stay O(local edges)
+    resident.  Every host computes this so padded bucket shapes agree
+    globally."""
     counts = np.zeros((num_parts, num_parts), np.int64)
     for p in range(num_parts):
         elo = int(g.row_ptr[cuts[p]])
         ehi = int(g.row_ptr[cuts[p + 1]])
-        counts[p] = np.bincount(owner_of[elo:ehi], minlength=num_parts)
-    return counts, owner_of
+        own = np.searchsorted(cuts, g.col_idx[elo:ehi], side="right") - 1
+        counts[p] = np.bincount(own, minlength=num_parts)
+    return counts
+
+
+def _slice_dst_local(g: HostGraph, vlo: int, vhi: int) -> np.ndarray:
+    """Part-local destination ids for edge slice [row_ptr[vlo], row_ptr[vhi])
+    derived from row_ptr alone — no global dst_of_edges() materialization."""
+    rp = np.asarray(g.row_ptr[vlo : vhi + 1])
+    return np.repeat(
+        np.arange(vhi - vlo, dtype=np.int32), np.diff(rp).astype(np.int64)
+    )
 
 
 def mark_bucket_heads(hf_row: np.ndarray, dl: np.ndarray) -> None:
@@ -117,8 +129,7 @@ def build_ring_shards(
     pull = pull if pull is not None else build_pull_shards(g, num_parts)
     spec, cuts = pull.spec, pull.cuts
     Pn, V = num_parts, spec.nv_pad
-    dst_of = g.dst_of_edges()
-    counts, owner_of = bucket_counts(g, cuts, Pn)
+    counts = bucket_counts(g, cuts, Pn)
     B = _round_up(max(1, int(counts.max())), LANE)
 
     rows = list(range(Pn) if parts_subset is None else parts_subset)
@@ -129,19 +140,22 @@ def build_ring_shards(
     for i, p in enumerate(rows):
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
+        srcs = np.asarray(g.col_idx[elo:ehi]).astype(np.int64)
+        dl_slice = _slice_dst_local(g, vlo, vhi)
         # one stable argsort by source owner per destination slice keeps
         # CSC (by-destination) order within each bucket
-        order = np.argsort(owner_of[elo:ehi], kind="stable")
+        own = np.searchsorted(cuts, srcs, side="right") - 1
+        order = np.argsort(own, kind="stable")
         splits = np.split(order, np.cumsum(counts[p])[:-1])
         for q in range(Pn):
-            eids = splits[q] + elo
+            eids = splits[q]
             m = len(eids)
-            src_local[i, q, :m] = (g.col_idx[eids] - cuts[q]).astype(np.int32)
-            dl = (dst_of[eids] - vlo).astype(np.int32)
+            src_local[i, q, :m] = (srcs[eids] - cuts[q]).astype(np.int32)
+            dl = dl_slice[eids]
             dst_local[i, q, :m] = dl
             mark_bucket_heads(head_flag[i, q], dl)
             if g.weights is not None:
-                weights[i, q, :m] = g.weights[eids].astype(np.float32)
+                weights[i, q, :m] = g.weights[elo:ehi][eids].astype(np.float32)
     return RingShards(
         pull=pull,
         rarrays=RingArrays(src_local, dst_local, head_flag, weights),

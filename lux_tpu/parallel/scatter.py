@@ -88,13 +88,16 @@ def build_scatter_shards(
     stores and computes the bucket), axis 1 = destination part p.
     ``parts_subset`` selects which chips' rows to materialize (per-host
     builds hold O(their edges), not O(ne))."""
-    from lux_tpu.parallel.ring import bucket_counts, mark_bucket_heads
+    from lux_tpu.parallel.ring import (
+        _slice_dst_local,
+        bucket_counts,
+        mark_bucket_heads,
+    )
 
     pull = build_pull_shards(g, num_parts)
     spec, cuts = pull.spec, pull.cuts
     Pn, V = num_parts, spec.nv_pad
-    dst_of = g.dst_of_edges()
-    counts, owner_of = bucket_counts(g, cuts, Pn)
+    counts = bucket_counts(g, cuts, Pn)
     B = _round_up(max(1, int(counts.max())), LANE)
 
     rows = list(range(Pn) if parts_subset is None else parts_subset)
@@ -106,18 +109,21 @@ def build_scatter_shards(
     for p in range(Pn):  # destination part: one slice scan, split by owner
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
-        order = np.argsort(owner_of[elo:ehi], kind="stable")
+        srcs = np.asarray(g.col_idx[elo:ehi]).astype(np.int64)
+        dl_slice = _slice_dst_local(g, vlo, vhi)
+        own = np.searchsorted(cuts, srcs, side="right") - 1
+        order = np.argsort(own, kind="stable")
         splits = np.split(order, np.cumsum(counts[p])[:-1])
         for q in rows:  # source owner — only this host's chips materialize
             i = row_of[q]
-            eids = splits[q] + elo
+            eids = splits[q]
             m = len(eids)
-            src_local[i, p, :m] = (g.col_idx[eids] - cuts[q]).astype(np.int32)
-            dl = (dst_of[eids] - vlo).astype(np.int32)
+            src_local[i, p, :m] = (srcs[eids] - cuts[q]).astype(np.int32)
+            dl = dl_slice[eids]
             dst_local[i, p, :m] = dl
             mark_bucket_heads(head_flag[i, p], dl)
             if g.weights is not None:
-                weights[i, p, :m] = g.weights[eids].astype(np.float32)
+                weights[i, p, :m] = g.weights[elo:ehi][eids].astype(np.float32)
     return ScatterShards(
         pull=pull,
         sarrays=ScatterArrays(src_local, dst_local, head_flag, weights),
