@@ -1,0 +1,218 @@
+"""2-D (parts x edge) parallelism: edge-dim sharding WITHIN a part.
+
+The reference binds one part to one GPU (`MAX_NUM_PARTS=64`,
+core/graph.h:31) — a part whose in-edge slice exceeds one device's memory
+simply cannot run.  On a TPU mesh the natural fix is a second mesh axis
+(SURVEY.md §2.5: "optional edge-dim sharding within a part", the
+tensor-parallel analog of this workload): the 1-D edge-balanced partition
+assigns each part a contiguous destination range, and each part's CSC edge
+slice is split edge-wise over the ``edge`` axis.  Every edge-shard computes
+a PARTIAL per-destination reduction for the same destination range (its
+chunk may start/stop mid-destination — partial sums/mins are exactly what
+`psum`/`pmin`/`pmax` combine), and `apply` runs replicated across the edge
+axis on the combined accumulator.
+
+Layout (P parts, EP edge-shards, E2 = padded chunk edges):
+  src_pos:   (P, EP, E2) int32  positions in the (P*V,) gathered state
+  dst_local: (P, EP, E2) int32  part-local destination; padding holds V
+  head_flag: (P, EP, E2) bool   per-chunk destination-segment starts
+  weights:   (P, EP, E2) float32
+plus per-part vertex arrays replicated over EP.  Reductions use the
+row_ptr-free end-scatter encoding (ops.segment.segment_reduce_by_ends).
+
+Exchange: `all_gather` of the part-sharded state over the ``parts`` axis
+(each edge-column holds a replica), then one `psum`/`pmin`/`pmax` over
+``edge`` per iteration — both ride ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache, partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from lux_tpu.engine.pull import PullProgram
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.graph.shards import LANE, PullShards, _round_up, build_pull_shards
+from lux_tpu.ops import segment
+from lux_tpu.parallel.ring import _slice_dst_local, mark_bucket_heads
+
+PARTS_AXIS = "parts"
+EDGE_AXIS = "edge"
+
+
+class Edge2DArrays(NamedTuple):
+    src_pos: np.ndarray
+    dst_local: np.ndarray
+    head_flag: np.ndarray
+    weights: np.ndarray
+    #: per-part vertex arrays, shared by every edge-shard of the part
+    vtx_mask: np.ndarray  # (P, V)
+    degree: np.ndarray  # (P, V)
+    global_vid: np.ndarray  # (P, V)
+
+
+@dataclasses.dataclass
+class Edge2DShards:
+    pull: PullShards
+    arrays2d: Edge2DArrays
+    num_edge_shards: int
+    e2_pad: int
+
+    @property
+    def spec(self):
+        return self.pull.spec
+
+    def scatter_to_global(self, stacked):
+        return self.pull.scatter_to_global(stacked)
+
+
+def make_mesh2d(num_parts: int, num_edge_shards: int) -> Mesh:
+    """(parts, edge) mesh over num_parts * num_edge_shards devices."""
+    n = num_parts * num_edge_shards
+    devs = np.asarray(jax.devices()[:n]).reshape(num_parts, num_edge_shards)
+    return Mesh(devs, (PARTS_AXIS, EDGE_AXIS))
+
+
+def build_edge2d_shards(
+    g: HostGraph, num_parts: int, num_edge_shards: int
+) -> Edge2DShards:
+    """Split each part's CSC edge slice into ``num_edge_shards`` contiguous
+    chunks (chunk boundaries may fall mid-destination — the partial
+    reductions are psum-combined)."""
+    pull = build_pull_shards(g, num_parts)
+    spec, cuts = pull.spec, pull.cuts
+    Pn, EP, V = num_parts, num_edge_shards, spec.nv_pad
+
+    # global padded chunk size from per-part edge counts
+    e_counts = np.asarray(g.row_ptr)[cuts[1:]] - np.asarray(g.row_ptr)[cuts[:-1]]
+    chunk_max = int(-(-int(e_counts.max()) // EP)) if len(e_counts) else 1
+    E2 = _round_up(max(1, chunk_max), LANE)
+
+    src_pos = np.zeros((Pn, EP, E2), np.int32)
+    dst_local = np.full((Pn, EP, E2), V, np.int32)
+    head_flag = np.zeros((Pn, EP, E2), bool)
+    weights = np.zeros((Pn, EP, E2), np.float32)
+    for p in range(Pn):
+        vlo, vhi = int(cuts[p]), int(cuts[p + 1])
+        elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
+        m_part = ehi - elo
+        srcs = np.asarray(g.col_idx[elo:ehi]).astype(np.int64)
+        own = np.searchsorted(cuts, srcs, side="right") - 1
+        spos = (own * V + (srcs - cuts[own])).astype(np.int32)
+        dl_slice = _slice_dst_local(g, vlo, vhi)
+        step = -(-m_part // EP) if m_part else 0
+        for e in range(EP):
+            lo = min(e * step, m_part)
+            hi = min(lo + step, m_part)
+            m = hi - lo
+            src_pos[p, e, :m] = spos[lo:hi]
+            dl = dl_slice[lo:hi]
+            dst_local[p, e, :m] = dl
+            mark_bucket_heads(head_flag[p, e], dl)
+            if g.weights is not None:
+                weights[p, e, :m] = g.weights[elo + lo : elo + hi].astype(
+                    np.float32
+                )
+    return Edge2DShards(
+        pull=pull,
+        arrays2d=Edge2DArrays(
+            src_pos, dst_local, head_flag, weights,
+            pull.arrays.vtx_mask, pull.arrays.degree, pull.arrays.global_vid,
+        ),
+        num_edge_shards=EP,
+        e2_pad=E2,
+    )
+
+
+_PCOMBINE = {
+    "sum": jax.lax.psum,
+    "min": jax.lax.pmin,
+    "max": jax.lax.pmax,
+}
+
+
+@lru_cache(maxsize=64)
+def _compile_edge2d_fixed(prog, mesh, num_parts: int, num_iters: int,
+                          method: str):
+    edge_specs = P(PARTS_AXIS, EDGE_AXIS)
+    vtx_specs = P(PARTS_AXIS)  # replicated over the edge axis
+    in_specs = Edge2DArrays(
+        edge_specs, edge_specs, edge_specs, edge_specs,
+        vtx_specs, vtx_specs, vtx_specs,
+    )
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(in_specs, P(PARTS_AXIS)),
+        out_specs=P(PARTS_AXIS),
+    )
+    def run(arr_blk, state_blk):
+        src_pos = arr_blk.src_pos[0, 0]
+        dst_loc = arr_blk.dst_local[0, 0]
+        head = arr_blk.head_flag[0, 0]
+        w = arr_blk.weights[0, 0]
+        vtx_mask = arr_blk.vtx_mask[0]
+        degree = arr_blk.degree[0]
+        V = vtx_mask.shape[0]
+
+        def iteration(_, local):
+            full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
+            dst_state = local[jnp.clip(dst_loc, 0, V - 1)]
+            vals = prog.edge_value(full[src_pos], w, dst_state)
+            part = segment.segment_reduce_by_ends(
+                vals, head, dst_loc, V, reduce=prog.reduce, method=method
+            )
+            # combine the edge-shards' partial reductions; the result is
+            # replicated over EDGE_AXIS, so apply runs identically on
+            # every replica and the out_specs stay parts-only
+            acc = _PCOMBINE[prog.reduce](part, EDGE_AXIS)
+            from lux_tpu.parallel.ring import _RingArrView
+
+            return prog.apply(
+                local, acc, _RingArrView(vtx_mask=vtx_mask, degree=degree)
+            )
+
+        return jax.lax.fori_loop(0, num_iters, iteration, state_blk[0])[None]
+
+    return run
+
+
+def run_pull_fixed_2d(
+    prog: PullProgram,
+    shards: Edge2DShards,
+    state0,
+    num_iters: int,
+    mesh: Mesh,
+    method: str = "scan",
+):
+    """Fixed-iteration pull over the 2-D (parts, edge) mesh.  ``state0`` is
+    the stacked (P, V, ...) state (engine.pull.init_state)."""
+    spec = shards.spec
+    assert mesh.axis_names == (PARTS_AXIS, EDGE_AXIS)
+    assert mesh.shape[PARTS_AXIS] == spec.num_parts
+    assert mesh.shape[EDGE_AXIS] == shards.num_edge_shards
+    assert method in ("scan", "scatter"), (
+        "edge-sharded chunks carry no row_ptr; use 'scan' or 'scatter'"
+    )
+    edge_sh = NamedSharding(mesh, P(PARTS_AXIS, EDGE_AXIS))
+    vtx_sh = NamedSharding(mesh, P(PARTS_AXIS))
+    a = shards.arrays2d
+    arrays = Edge2DArrays(
+        jax.device_put(a.src_pos, edge_sh),
+        jax.device_put(a.dst_local, edge_sh),
+        jax.device_put(a.head_flag, edge_sh),
+        jax.device_put(a.weights, edge_sh),
+        jax.device_put(np.asarray(a.vtx_mask), vtx_sh),
+        jax.device_put(np.asarray(a.degree), vtx_sh),
+        jax.device_put(np.asarray(a.global_vid), vtx_sh),
+    )
+    state0 = jax.device_put(np.asarray(state0), vtx_sh)
+    run = _compile_edge2d_fixed(prog, mesh, spec.num_parts, num_iters, method)
+    return run(arrays, state0)
