@@ -42,6 +42,10 @@ def load_graph(cfg: RunConfig, weighted: bool = False,
 def make_mesh_if(cfg: RunConfig):
     if not cfg.distributed:
         return None
+    if cfg.edge_shards > 1:
+        from lux_tpu.parallel.edge2d import make_mesh2d
+
+        return make_mesh2d(cfg.num_parts, cfg.edge_shards)
     from lux_tpu.parallel.mesh import make_mesh
 
     return make_mesh(cfg.num_parts)
@@ -50,6 +54,20 @@ def make_mesh_if(cfg: RunConfig):
 def validate_exchange(cfg: RunConfig, prog) -> None:
     """Reject incompatible --exchange combinations BEFORE the O(ne) shard
     build, with a CLI-level message (not a deep driver assert)."""
+    if cfg.edge_shards > 1:
+        if not cfg.distributed:
+            raise SystemExit("--edge-shards requires --distributed")
+        if cfg.exchange != "allgather":
+            raise SystemExit(
+                "--edge-shards (2-D mesh) has its own exchange; it cannot "
+                "combine with --exchange ring/scatter"
+            )
+        if cfg.method == "cumsum":
+            raise SystemExit(
+                "--edge-shards supports --method scan or scatter "
+                "(edge chunks carry no row_ptr for cumsum)"
+            )
+        return
     if cfg.exchange == "allgather":
         return
     if not cfg.distributed:
@@ -74,6 +92,10 @@ def build_exchange_shards(g: HostGraph, cfg: RunConfig):
     plain pull layout."""
     from lux_tpu.graph.shards import build_pull_shards
 
+    if cfg.edge_shards > 1:
+        from lux_tpu.parallel.edge2d import build_edge2d_shards
+
+        return build_edge2d_shards(g, cfg.num_parts, cfg.edge_shards)
     if cfg.exchange == "allgather":
         return build_pull_shards(g, cfg.num_parts)
     if not cfg.distributed:
@@ -92,6 +114,10 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
     from lux_tpu.utils import preflight
 
     sbytes = 2 if cfg.dtype == "bfloat16" else 4
+    if cfg.edge_shards > 1:
+        return preflight.estimate_edge2d(
+            shards.spec, shards.e2_pad, state_width, sbytes
+        )
     if cfg.exchange == "ring":
         return preflight.estimate_ring(
             shards.spec, shards.e_bucket_pad, state_width, sbytes
@@ -132,6 +158,12 @@ def run_fixed_dist_chunked(prog, shards, state, start_it, num_iters, mesh,
 
 def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
     """Distributed fixed-iteration driver for the selected exchange."""
+    if cfg.edge_shards > 1:
+        from lux_tpu.parallel import edge2d
+
+        return edge2d.run_pull_fixed_2d(
+            prog, shards, state, num_iters, mesh, cfg.method
+        )
     if cfg.exchange == "ring":
         from lux_tpu.parallel import ring
 

@@ -67,6 +67,12 @@ class Edge2DShards:
     def spec(self):
         return self.pull.spec
 
+    @property
+    def arrays(self):
+        """Host pull arrays (CLI init_state path; never device-placed
+        wholesale by the 2-D driver)."""
+        return self.pull.arrays
+
     def scatter_to_global(self, stacked):
         return self.pull.scatter_to_global(stacked)
 
@@ -184,16 +190,82 @@ def _compile_edge2d_fixed(prog, mesh, num_parts: int, num_iters: int,
     return run
 
 
-def run_pull_fixed_2d(
+@lru_cache(maxsize=64)
+def _compile_edge2d_until(prog, mesh, max_iters: int, active_fn, method: str):
+    edge_specs = P(PARTS_AXIS, EDGE_AXIS)
+    vtx_specs = P(PARTS_AXIS)
+    in_specs = Edge2DArrays(
+        edge_specs, edge_specs, edge_specs, edge_specs,
+        vtx_specs, vtx_specs, vtx_specs,
+    )
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(in_specs, P(PARTS_AXIS)),
+        out_specs=(P(PARTS_AXIS), P()),
+    )
+    def run(arr_blk, state_blk):
+        src_pos = arr_blk.src_pos[0, 0]
+        dst_loc = arr_blk.dst_local[0, 0]
+        head = arr_blk.head_flag[0, 0]
+        w = arr_blk.weights[0, 0]
+        vtx_mask = arr_blk.vtx_mask[0]
+        degree = arr_blk.degree[0]
+        V = vtx_mask.shape[0]
+        from lux_tpu.parallel.ring import _RingArrView
+
+        def cond(carry):
+            _, it, active = carry
+            return (active > 0) & (it < max_iters)
+
+        def body(carry):
+            local, it, _ = carry
+            full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
+            dst_state = local[jnp.clip(dst_loc, 0, V - 1)]
+            vals = prog.edge_value(full[src_pos], w, dst_state)
+            part = segment.segment_reduce_by_ends(
+                vals, head, dst_loc, V, reduce=prog.reduce, method=method
+            )
+            acc = _PCOMBINE[prog.reduce](part, EDGE_AXIS)
+            new = prog.apply(
+                local, acc, _RingArrView(vtx_mask=vtx_mask, degree=degree)
+            )
+            # each part's count is replicated over EDGE after the combine;
+            # psum over PARTS alone gives the global count everywhere
+            active = jax.lax.psum(
+                active_fn(local, new).astype(jnp.int32), PARTS_AXIS
+            )
+            return new, it + 1, active
+
+        local, iters, _ = jax.lax.while_loop(
+            cond, body, (state_blk[0], jnp.int32(0), jnp.int32(1))
+        )
+        return local[None], iters
+
+    return run
+
+
+def run_pull_until_2d(
     prog: PullProgram,
     shards: Edge2DShards,
     state0,
-    num_iters: int,
+    max_iters: int,
+    active_fn,
     mesh: Mesh,
     method: str = "scan",
 ):
-    """Fixed-iteration pull over the 2-D (parts, edge) mesh.  ``state0`` is
-    the stacked (P, V, ...) state (engine.pull.init_state)."""
+    """Convergence-driven pull over the 2-D mesh (CC-style): iterate until
+    the global active count reaches zero.  active_fn must be a hashable
+    top-level function (compiled-program cache key)."""
+    arrays, state0 = _place_edge2d(shards, state0, mesh, method)
+    run = _compile_edge2d_until(prog, mesh, max_iters, active_fn, method)
+    return run(arrays, state0)
+
+
+def _place_edge2d(shards: Edge2DShards, state0, mesh: Mesh, method: str):
+    """Validate geometry and device_put the 2-D arrays + stacked state."""
     spec = shards.spec
     assert mesh.axis_names == (PARTS_AXIS, EDGE_AXIS)
     assert mesh.shape[PARTS_AXIS] == spec.num_parts
@@ -213,6 +285,21 @@ def run_pull_fixed_2d(
         jax.device_put(np.asarray(a.degree), vtx_sh),
         jax.device_put(np.asarray(a.global_vid), vtx_sh),
     )
-    state0 = jax.device_put(np.asarray(state0), vtx_sh)
-    run = _compile_edge2d_fixed(prog, mesh, spec.num_parts, num_iters, method)
+    return arrays, jax.device_put(np.asarray(state0), vtx_sh)
+
+
+def run_pull_fixed_2d(
+    prog: PullProgram,
+    shards: Edge2DShards,
+    state0,
+    num_iters: int,
+    mesh: Mesh,
+    method: str = "scan",
+):
+    """Fixed-iteration pull over the 2-D (parts, edge) mesh.  ``state0`` is
+    the stacked (P, V, ...) state (engine.pull.init_state)."""
+    arrays, state0 = _place_edge2d(shards, state0, mesh, method)
+    run = _compile_edge2d_fixed(
+        prog, mesh, shards.spec.num_parts, num_iters, method
+    )
     return run(arrays, state0)

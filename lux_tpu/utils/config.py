@@ -39,6 +39,9 @@ class RunConfig:
     exchange: str = "allgather"
     weighted: bool = False  # SSSP: relax with edge weights (Dijkstra-style)
     dtype: str = "float32"  # state storage dtype (pagerank/CF)
+    #: >1 = 2-D (parts x edge) mesh: each part's edges split over this many
+    #: chips, partial reductions psum'd (for parts too big for one chip)
+    edge_shards: int = 1
 
 
 def parse_args(argv=None, description: str = "", sssp: bool = False,
@@ -78,6 +81,10 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         ap.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"],
                         help="state storage dtype")
+        ap.add_argument("--edge-shards", type=int, default=1,
+                        help="split each part's edges over N chips "
+                             "(2-D parts x edge mesh; total chips = "
+                             "num_parts * N)")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],
@@ -107,4 +114,5 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         exchange=getattr(ns, "exchange", "allgather"),
         weighted=getattr(ns, "weighted", False),
         dtype=getattr(ns, "dtype", "float32"),
+        edge_shards=getattr(ns, "edge_shards", 1),
     )

@@ -59,6 +59,20 @@ def estimate_push(spec: ShardSpec, pspec: PushSpec,
     )
 
 
+def estimate_edge2d(spec: ShardSpec, e2_pad: int, state_width: int = 1,
+                    state_dtype_bytes: int = 4) -> MemoryEstimate:
+    """Per-chip footprint on the 2-D (parts x edge) mesh: one edge chunk
+    (13 B/slot) + the part's vertex view + state, plus the all-gathered
+    whole state (the 2-D driver still replicates state across parts; its
+    win is splitting the EDGE arrays)."""
+    V = spec.nv_pad
+    shard = e2_pad * 13 + V * 9
+    blk = V * state_width * state_dtype_bytes
+    state = 3 * blk  # local + new + combined accumulator
+    gathered = spec.gathered_size * state_width * state_dtype_bytes
+    return MemoryEstimate(shard, state, gathered, shard + state + gathered)
+
+
 def estimate_push_ring(spec: ShardSpec, pspec: PushSpec, e_bucket_pad: int,
                        state_dtype_bytes: int = 4) -> MemoryEstimate:
     """Per-chip footprint of the push engine with the RING dense exchange:

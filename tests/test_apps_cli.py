@@ -189,3 +189,24 @@ def test_colfilter_cli_distributed_ckpt_resume(tmp_path, capsys):
 def test_push_apps_reject_ckpt_flags(tmp_path):
     with pytest.raises(SystemExit, match="fixed-iteration"):
         sssp_app.main(SMALL + ["--ckpt-dir", str(tmp_path)])
+
+
+def test_pagerank_cli_edge_shards(capsys):
+    """2-D (parts x edge) mesh from the CLI: 4 parts x 2 edge-shards on
+    the 8-device test mesh; ranks must match the 1-D distributed run."""
+    args = SMALL + ["-ni", "3", "-ng", "4", "--distributed",
+                    "--edge-shards", "2"]
+    assert pr_app.main(args) == 0
+    t2d = _parse_top5(capsys.readouterr().out)
+    assert pr_app.main(SMALL + ["-ni", "3", "-ng", "8", "--distributed"]) == 0
+    t1d = _parse_top5(capsys.readouterr().out)
+    for vid in set(t2d) & set(t1d):
+        np.testing.assert_allclose(t2d[vid], t1d[vid], rtol=1e-4)
+
+
+def test_edge_shards_flag_gating():
+    with pytest.raises(SystemExit, match="requires --distributed"):
+        pr_app.main(SMALL + ["--edge-shards", "2"])
+    with pytest.raises(SystemExit, match="own exchange"):
+        pr_app.main(SMALL + ["-ng", "4", "--distributed",
+                             "--edge-shards", "2", "--exchange", "ring"])

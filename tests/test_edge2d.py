@@ -71,3 +71,20 @@ def test_edge2d_bitwise_deterministic():
     a = edge2d.run_pull_fixed_2d(prog, shards, s0, 4, mesh)
     b = edge2d.run_pull_fixed_2d(prog, shards, s0, 4, mesh)
     assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_edge2d_until_cc():
+    """Convergence-driven 2-D driver (CC label propagation to fixpoint)."""
+    from lux_tpu.models import components
+
+    g = generate.uniform_random(400, 3000, seed=135)
+    shards = edge2d.build_edge2d_shards(g, 4, 2)
+    mesh = edge2d.make_mesh2d(4, 2)
+    prog = components.MaxLabelProgram()
+    out, iters = edge2d.run_pull_until_2d(
+        prog, shards, _state0(prog, shards), 200, components.active_count,
+        mesh,
+    )
+    labels = shards.scatter_to_global(np.asarray(out))
+    assert components.check_labels(g, labels) == 0
+    assert 1 <= int(iters) < 200
