@@ -82,7 +82,8 @@ def main(argv=None):
             )
         if elapsed is None:
             elapsed = timer.stop(state)
-    report_elapsed(elapsed, g.ne, cfg.num_iters)
+    # GTEPS over the iterations THIS run executed (resume runs fewer)
+    report_elapsed(elapsed, g.ne, cfg.num_iters - start_it)
     v = shards.scatter_to_global(jax.device_get(state)).astype("float32")
     print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
     return 0

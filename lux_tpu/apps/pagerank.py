@@ -86,7 +86,8 @@ def main(argv=None):
             )
         if elapsed is None:
             elapsed = timer.stop(state)
-    report_elapsed(elapsed, g.ne, cfg.num_iters)
+    # GTEPS over the iterations THIS run executed (resume runs fewer)
+    report_elapsed(elapsed, g.ne, cfg.num_iters - start_it)
     ranks = shards.scatter_to_global(jax.device_get(state))
     common.top_k("rank (pre-divided)", ranks)
     return 0

@@ -69,6 +69,9 @@ def get_lib(build: bool = True) -> Optional[ctypes.CDLL]:
     lib.lux_count_degrees.argtypes = [u32p, ctypes.c_uint64, ctypes.c_uint32,
                                       u32p]
     lib.lux_count_degrees.restype = ctypes.c_int
+    lib.lux_bucket_split.argtypes = [u32p, ctypes.c_uint64, u32p,
+                                     ctypes.c_uint32, u64p, u64p]
+    lib.lux_bucket_split.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -132,6 +135,28 @@ def write_from_edges(path: str, nv: int, src: np.ndarray, dst: np.ndarray,
     if rc != 0:
         raise OSError(-rc, os.strerror(-rc), path)
     return True
+
+
+def bucket_split(srcs: np.ndarray, cuts: np.ndarray):
+    """Stable owner-bucketing of an edge slice (counting sort, native).
+    Returns (order int64, counts int64) or None if the lib is unavailable.
+    Semantics match np.argsort(searchsorted(cuts, srcs, 'right') - 1,
+    kind='stable')."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    srcs = np.ascontiguousarray(srcs, np.uint32)
+    cuts = np.ascontiguousarray(cuts, np.uint32)
+    num_parts = len(cuts) - 1
+    order = np.empty(len(srcs), np.uint64)
+    counts = np.zeros(num_parts, np.uint64)
+    rc = lib.lux_bucket_split(
+        _ptr(srcs, ctypes.c_uint32), len(srcs), _ptr(cuts, ctypes.c_uint32),
+        num_parts, _ptr(order, ctypes.c_uint64), _ptr(counts, ctypes.c_uint64),
+    )
+    if rc != 0:
+        raise ValueError("source id beyond the last cut")
+    return order.astype(np.int64), counts.astype(np.int64)
 
 
 def count_degrees(col_idx: np.ndarray, nv: int):

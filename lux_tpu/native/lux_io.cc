@@ -210,6 +210,38 @@ int64_t lux_parse_edge_text(const char* path, uint64_t cap, uint32_t* src,
   return rc != 0 ? rc : (int64_t)n;
 }
 
+// Stable split of one part's edge slice by source-owner part — the host
+// hot path of the ring / reduce_scatter / 2-D bucket builders (the role
+// the reference's native Graph::Graph partition build plays,
+// core/pull_model.inl:105-189, but keyed by source owner).  Counting sort
+// with a binary search per edge: O(m log P + m), no comparison sort.
+//   order[m]:  stable permutation grouping edge indices by owner
+//   counts[P]: edges per owner
+int lux_bucket_split(const uint32_t* srcs, uint64_t m, const uint32_t* cuts,
+                     uint32_t num_parts, uint64_t* order, uint64_t* counts) {
+  memset(counts, 0, 8 * (size_t)num_parts);
+  std::vector<uint32_t> owner(m);
+  for (uint64_t j = 0; j < m; j++) {
+    const uint32_t s = srcs[j];
+    uint32_t lo = 0, hi = num_parts;  // owner = last p with cuts[p] <= s
+    while (lo < hi) {
+      uint32_t mid = (lo + hi) / 2;
+      if (cuts[mid + 1] <= s) lo = mid + 1; else hi = mid;
+    }
+    if (lo >= num_parts) return -EINVAL;  // src beyond cuts[num_parts]
+    owner[j] = lo;
+    counts[lo]++;
+  }
+  std::vector<uint64_t> cursor(num_parts);
+  uint64_t run = 0;
+  for (uint32_t p = 0; p < num_parts; p++) {
+    cursor[p] = run;
+    run += counts[p];
+  }
+  for (uint64_t j = 0; j < m; j++) order[cursor[owner[j]]++] = j;
+  return 0;
+}
+
 // Out-degree histogram over an edge-source array (the native equivalent of
 // pull_scan_task_impl's degree count, core/pull_model.inl:322-345).
 int lux_count_degrees(const uint32_t* col, uint64_t ne, uint32_t nv,

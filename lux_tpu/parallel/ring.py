@@ -107,6 +107,21 @@ def _slice_dst_local(g: HostGraph, vlo: int, vhi: int) -> np.ndarray:
     )
 
 
+def _owner_split(srcs: np.ndarray, cuts) -> tuple:
+    """Stable owner-bucketing of an edge slice: (order, counts).  Native
+    counting sort (lux_io.lux_bucket_split, O(m log P)) when the library
+    is built; NumPy argsort fallback otherwise — identical permutations
+    (both stable by owner, original order within a bucket)."""
+    from lux_tpu import native
+
+    res = native.bucket_split(srcs, cuts)
+    if res is not None:
+        return res
+    own = np.searchsorted(cuts, srcs, side="right") - 1
+    counts = np.bincount(own, minlength=len(cuts) - 1)
+    return np.argsort(own, kind="stable"), counts
+
+
 def mark_bucket_heads(hf_row: np.ndarray, dl: np.ndarray) -> None:
     """Destination-segment starts for one bucket (edges CSC-ordered).  The
     first padding slot is flagged too, so segment_reduce_by_ends sees the
@@ -142,10 +157,9 @@ def build_ring_shards(
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
         srcs = np.asarray(g.col_idx[elo:ehi]).astype(np.int64)
         dl_slice = _slice_dst_local(g, vlo, vhi)
-        # one stable argsort by source owner per destination slice keeps
-        # CSC (by-destination) order within each bucket
-        own = np.searchsorted(cuts, srcs, side="right") - 1
-        order = np.argsort(own, kind="stable")
+        # stable owner-bucketing keeps CSC (by-destination) order within
+        # each bucket
+        order, _ = _owner_split(srcs, cuts)
         splits = np.split(order, np.cumsum(counts[p])[:-1])
         for q in range(Pn):
             eids = splits[q]

@@ -89,6 +89,7 @@ def build_scatter_shards(
     ``parts_subset`` selects which chips' rows to materialize (per-host
     builds hold O(their edges), not O(ne))."""
     from lux_tpu.parallel.ring import (
+        _owner_split,
         _slice_dst_local,
         bucket_counts,
         mark_bucket_heads,
@@ -111,8 +112,7 @@ def build_scatter_shards(
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
         srcs = np.asarray(g.col_idx[elo:ehi]).astype(np.int64)
         dl_slice = _slice_dst_local(g, vlo, vhi)
-        own = np.searchsorted(cuts, srcs, side="right") - 1
-        order = np.argsort(own, kind="stable")
+        order, _ = _owner_split(srcs, cuts)
         splits = np.split(order, np.cumsum(counts[p])[:-1])
         for q in rows:  # source owner — only this host's chips materialize
             i = row_of[q]

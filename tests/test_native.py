@@ -96,3 +96,20 @@ def test_converter_cli_bad_count(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert rc != 0
+
+def test_bucket_split_matches_numpy():
+    """Native counting-sort bucketing == the NumPy stable-argsort path."""
+    from lux_tpu import native
+
+    rng = np.random.default_rng(60)
+    cuts = np.array([0, 7, 7, 20, 33], np.int64)  # includes an empty part
+    srcs = rng.integers(0, 33, size=500).astype(np.int64)
+    res = native.bucket_split(srcs, cuts)
+    if res is None:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    order, counts = res
+    own = np.searchsorted(cuts, srcs, side="right") - 1
+    np.testing.assert_array_equal(counts, np.bincount(own, minlength=4))
+    np.testing.assert_array_equal(order, np.argsort(own, kind="stable"))
