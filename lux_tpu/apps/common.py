@@ -57,6 +57,14 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
     if cfg.edge_shards > 1:
         if not cfg.distributed:
             raise SystemExit("--edge-shards requires --distributed")
+        import jax
+
+        need = cfg.num_parts * cfg.edge_shards
+        if len(jax.devices()) < need:
+            raise SystemExit(
+                f"--edge-shards: {cfg.num_parts} x {cfg.edge_shards} = "
+                f"{need} devices needed, {len(jax.devices())} available"
+            )
         if cfg.exchange != "allgather":
             raise SystemExit(
                 "--edge-shards (2-D mesh) has its own exchange; it cannot "

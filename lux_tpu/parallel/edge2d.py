@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from lux_tpu.engine.pull import PullProgram
 from lux_tpu.graph.csc import HostGraph
-from lux_tpu.graph.shards import LANE, PullShards, _round_up, build_pull_shards
+from lux_tpu.graph.shards import LANE, ShardSpec, _round_up, shard_geometry
 from lux_tpu.ops import segment
 from lux_tpu.parallel.ring import _slice_dst_local, mark_bucket_heads
 
@@ -58,29 +58,40 @@ class Edge2DArrays(NamedTuple):
 
 @dataclasses.dataclass
 class Edge2DShards:
-    pull: PullShards
+    spec: ShardSpec
+    cuts: np.ndarray
     arrays2d: Edge2DArrays
     num_edge_shards: int
     e2_pad: int
 
     @property
-    def spec(self):
-        return self.pull.spec
-
-    @property
     def arrays(self):
-        """Host pull arrays (CLI init_state path; never device-placed
-        wholesale by the 2-D driver)."""
-        return self.pull.arrays
+        """Vertex-array view for engine.pull.init_state (which reads only
+        global_vid/degree/vtx_mask — all present on arrays2d).  The 1-D
+        pull layout's O(E) edge arrays are deliberately NOT kept: the
+        whole point of edge sharding is parts whose edge slice doesn't
+        fit one device, so the host must not hold a second edge copy."""
+        return self.arrays2d
 
     def scatter_to_global(self, stacked):
-        return self.pull.scatter_to_global(stacked)
+        P_ = self.spec.num_parts
+        out = []
+        for p in range(P_):
+            n = int(self.cuts[p + 1] - self.cuts[p])
+            out.append(np.asarray(stacked[p])[:n])
+        return np.concatenate(out, axis=0)
 
 
 def make_mesh2d(num_parts: int, num_edge_shards: int) -> Mesh:
     """(parts, edge) mesh over num_parts * num_edge_shards devices."""
     n = num_parts * num_edge_shards
-    devs = np.asarray(jax.devices()[:n]).reshape(num_parts, num_edge_shards)
+    devs = jax.devices()
+    if len(devs) < n:
+        raise ValueError(
+            f"2-D mesh needs {num_parts} x {num_edge_shards} = {n} devices; "
+            f"only {len(devs)} available"
+        )
+    devs = np.asarray(devs[:n]).reshape(num_parts, num_edge_shards)
     return Mesh(devs, (PARTS_AXIS, EDGE_AXIS))
 
 
@@ -89,9 +100,15 @@ def build_edge2d_shards(
 ) -> Edge2DShards:
     """Split each part's CSC edge slice into ``num_edge_shards`` contiguous
     chunks (chunk boundaries may fall mid-destination — the partial
-    reductions are psum-combined)."""
-    pull = build_pull_shards(g, num_parts)
-    spec, cuts = pull.spec, pull.cuts
+    reductions are psum-combined).  Never materializes the 1-D pull
+    layout's O(E) arrays."""
+    cuts, nv_pad, e_pad = shard_geometry(
+        np.asarray(g.row_ptr), num_parts, g.nv
+    )
+    spec = ShardSpec(
+        num_parts=num_parts, nv=g.nv, ne=g.ne, nv_pad=nv_pad, e_pad=e_pad,
+        weighted=g.weights is not None,
+    )
     Pn, EP, V = num_parts, num_edge_shards, spec.nv_pad
 
     # global padded chunk size from per-part edge counts
@@ -103,8 +120,16 @@ def build_edge2d_shards(
     dst_local = np.full((Pn, EP, E2), V, np.int32)
     head_flag = np.zeros((Pn, EP, E2), bool)
     weights = np.zeros((Pn, EP, E2), np.float32)
+    vtx_mask = np.zeros((Pn, V), bool)
+    degree = np.zeros((Pn, V), np.int32)
+    global_vid = np.full((Pn, V), g.nv - 1, np.int32)
+    degrees = g.out_degrees()
     for p in range(Pn):
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
+        n = vhi - vlo
+        vtx_mask[p, :n] = True
+        degree[p, :n] = degrees[vlo:vhi]
+        global_vid[p, :n] = np.arange(vlo, vhi, dtype=np.int32)
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
         m_part = ehi - elo
         srcs = np.asarray(g.col_idx[elo:ehi]).astype(np.int64)
@@ -125,10 +150,11 @@ def build_edge2d_shards(
                     np.float32
                 )
     return Edge2DShards(
-        pull=pull,
+        spec=spec,
+        cuts=cuts,
         arrays2d=Edge2DArrays(
             src_pos, dst_local, head_flag, weights,
-            pull.arrays.vtx_mask, pull.arrays.degree, pull.arrays.global_vid,
+            vtx_mask, degree, global_vid,
         ),
         num_edge_shards=EP,
         e2_pad=E2,
@@ -143,8 +169,7 @@ _PCOMBINE = {
 
 
 @lru_cache(maxsize=64)
-def _compile_edge2d_fixed(prog, mesh, num_parts: int, num_iters: int,
-                          method: str):
+def _compile_edge2d_fixed(prog, mesh, num_iters: int, method: str):
     edge_specs = P(PARTS_AXIS, EDGE_AXIS)
     vtx_specs = P(PARTS_AXIS)  # replicated over the edge axis
     in_specs = Edge2DArrays(
@@ -299,7 +324,5 @@ def run_pull_fixed_2d(
     """Fixed-iteration pull over the 2-D (parts, edge) mesh.  ``state0`` is
     the stacked (P, V, ...) state (engine.pull.init_state)."""
     arrays, state0 = _place_edge2d(shards, state0, mesh, method)
-    run = _compile_edge2d_fixed(
-        prog, mesh, shards.spec.num_parts, num_iters, method
-    )
+    run = _compile_edge2d_fixed(prog, mesh, num_iters, method)
     return run(arrays, state0)

@@ -1,6 +1,5 @@
 """2-D (parts x edge) parallelism: edge-sharded partial reductions must be
 exact for sum/min/max programs."""
-import jax
 import numpy as np
 import pytest
 
@@ -11,7 +10,7 @@ from lux_tpu.parallel import edge2d
 
 
 def _state0(prog, shards):
-    return pull.init_state(prog, jax.tree.map(np.asarray, shards.pull.arrays))
+    return pull.init_state(prog, shards.arrays)
 
 
 @pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
