@@ -116,11 +116,13 @@ def make_pallas_runner(
     @functools.partial(jax.jit, static_argnames="num_iters")
     def run(state, num_iters):
         def body(_, s):
-            # state stored in `dtype`; kernel reduces in f32
-            vals = s[e_src].astype(jnp.float32)
+            # state stored in `dtype`; bf16 state also feeds the MXU at
+            # the bf16 rate (kernel accumulates f32 either way)
+            vals = s[e_src]
             acc = ps.spmv_blockcsr(
                 vals, e_dst, cb, cf, op="sum", v_blk=bc.v_blk,
                 num_vblocks=bc.num_vblocks, interpret=interpret,
+                compute_dtype=dtype,
             )
             return apply_rank_update(acc, degree_d, g.nv).astype(dtype)
 

@@ -131,7 +131,7 @@ def build_blockcsr(
     )
 
 
-def _spmv_kernel(op: str, v_blk: int,
+def _spmv_kernel(op: str, v_blk: int, compute_dtype,
                  chunk_block_ref, chunk_first_ref, vals_ref, dst_ref,
                  out_ref):
     """Out block is a COLUMN (v_blk, 1): the MXU contraction result
@@ -157,9 +157,12 @@ def _spmv_kernel(op: str, v_blk: int,
     iota = jax.lax.broadcasted_iota(jnp.int32, (v_blk, t), 0)
     onehot = iota == dst  # (V_BLK, T); padding dst==v_blk matches nothing
     if op == "sum":
+        # compute_dtype=bfloat16 doubles the MXU rate; the one-hot matrix
+        # is exact in bf16 and accumulation stays f32 (preferred type) —
+        # only the per-edge values quantize, matching a bf16 state anyway
         contrib = jax.lax.dot_general(
-            onehot.astype(jnp.float32),
-            vals.astype(jnp.float32),
+            onehot.astype(compute_dtype),
+            vals.astype(compute_dtype),
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (V_BLK, 1)
@@ -172,7 +175,10 @@ def _spmv_kernel(op: str, v_blk: int,
         out_ref[:] = jnp.maximum(out_ref[:], jnp.max(masked, axis=1, keepdims=True))
 
 
-@functools.partial(jax.jit, static_argnames=("op", "v_blk", "num_vblocks", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("op", "v_blk", "num_vblocks", "interpret", "compute_dtype"),
+)
 def spmv_blockcsr(
     edge_vals: jnp.ndarray,  # (C, T) float32 — gathered+weighted per edge
     e_dst_rel: jnp.ndarray,  # (C, T) int32
@@ -182,6 +188,7 @@ def spmv_blockcsr(
     v_blk: int = V_BLK,
     num_vblocks: int | None = None,
     interpret: bool = False,
+    compute_dtype: str = "float32",
 ):
     """Segmented reduction -> (num_vblocks * v_blk,) via the Pallas kernel."""
     import jax.experimental.pallas as pl
@@ -201,7 +208,7 @@ def spmv_blockcsr(
         out_specs=pl.BlockSpec((v_blk, 1), lambda i, cb, cf: (cb[i], 0)),
     )
     out = pl.pallas_call(
-        functools.partial(_spmv_kernel, op, v_blk),
+        functools.partial(_spmv_kernel, op, v_blk, jnp.dtype(compute_dtype)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_vblocks * v_blk, 1), jnp.float32),
         compiler_params=pltpu.CompilerParams(

@@ -95,3 +95,16 @@ def test_colfilter_pallas_matches_reference():
                               v_blk=128, t_chunk=128)
     want = cf.colfilter_reference(g, 4, gamma=1e-3)
     np.testing.assert_allclose(got, want, rtol=3e-5, atol=1e-7)
+
+
+def test_pallas_pagerank_bf16(interpret_only=True):
+    """bf16 state + bf16 MXU inputs (f32 accumulation) tracks the f32
+    kernel within bf16 resolution."""
+    from lux_tpu.models.pagerank import make_pallas_runner
+
+    g = generate.rmat(8, 6, seed=40)
+    run32, s32 = make_pallas_runner(g, interpret=True)
+    run16, s16 = make_pallas_runner(g, interpret=True, dtype="bfloat16")
+    a = np.asarray(run32(s32, 3))[: g.nv]
+    b = np.asarray(run16(s16, 3)).astype(np.float32)[: g.nv]
+    np.testing.assert_allclose(b, a, rtol=2e-2, atol=1e-5)
