@@ -73,6 +73,22 @@ def run_convergence_app(prog, shards, cfg, name: str):
                 stats.record_phases(it, int(carry.active), lt, ct, ut)
                 it += 1
             state, iters, edges = carry.state, it, carry.edges
+        elif cfg.verbose and cfg.exchange == "allgather":
+            # step-wise DISTRIBUTED observability: one shard_map iteration
+            # per step, host fence between (reference prints -verbose on
+            # multi-GPU runs too)
+            arrays, parrays, carry = push.push_init_dist(prog, shards, mesh)
+            step = push.compile_push_step_dist(
+                prog, mesh, shards.pspec, shards.spec, cfg.method
+            )
+            stats = IterStats(verbose=True)
+            it = 0
+            while int(carry.active) > 0 and it < cfg.max_iters:
+                t = Timer()
+                carry = step(arrays, parrays, carry)
+                stats.record(it, int(carry.active), t.stop(carry.state))
+                it += 1
+            state, iters, edges = carry.state, it, carry.edges
         elif mesh is None:
             state, iters, edges = push.run_push(
                 prog, shards, cfg.max_iters, cfg.method

@@ -454,6 +454,92 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
 
 
 @lru_cache(maxsize=64)
+def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
+                           method: str = "scan"):
+    """ONE distributed direction-optimized iteration (the body of
+    _compile_push_dist without the on-device while_loop) — step-wise
+    observability for `-verbose --distributed`.  Takes/returns the sharded
+    stacked carry; the host reads carry.active between steps."""
+    arr_specs = ShardArrays(*([P(PARTS_AXIS)] * len(ShardArrays._fields)))
+    parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
+    carry_specs = PushCarry(*([P(PARTS_AXIS)] * 4), P(), P(), P())
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(arr_specs, parr_specs, carry_specs),
+        out_specs=carry_specs,
+    )
+    def step(arr_blk, parr_blk, carry_blk):
+        arr = jax.tree.map(lambda a: a[0], arr_blk)
+        parr = jax.tree.map(lambda a: a[0], parr_blk)
+        V = spec.nv_pad
+        c = PushCarry(
+            carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
+            carry_blk.count[0], carry_blk.it, carry_blk.active,
+            carry_blk.edges,
+        )
+        local = c.state
+        q_vids_all = jax.lax.all_gather(c.q_vid, PARTS_AXIS, tiled=True)
+        q_vals_all = jax.lax.all_gather(c.q_val, PARTS_AXIS, tiled=True)
+        rows, counts, incl, total = sparse_prep(parr, q_vids_all)
+        g_cnt = jax.lax.psum(c.count, PARTS_AXIS)
+        flags = jax.lax.psum(
+            jnp.stack(
+                [
+                    (c.count > pspec.f_cap).astype(jnp.int32),
+                    (total > pspec.e_sp).astype(jnp.int32),
+                ]
+            ),
+            PARTS_AXIS,
+        )
+        use_dense = (
+            (g_cnt > spec.nv // pspec.pull_threshold_den) | (flags.max() > 0)
+        )
+
+        def dense_branch():
+            full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
+            return dense_part_step(prog, arr, full, local, method)
+
+        def sparse_branch():
+            return jnp.where(
+                arr.vtx_mask,
+                sparse_part_step(
+                    prog, pspec, parr, V, q_vids_all, q_vals_all,
+                    rows, counts, incl, local,
+                ),
+                local,
+            )
+
+        new = jax.lax.cond(use_dense, dense_branch, sparse_branch)
+        changed = (new != local) & arr.vtx_mask
+        q_vid, q_val, cnt = build_queue(pspec, arr, changed, new)
+        active = jax.lax.psum(cnt, PARTS_AXIS)
+        g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
+        edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
+        return PushCarry(
+            new[None], q_vid[None], q_val[None], cnt[None], c.it + 1,
+            active, edges,
+        )
+
+    return step
+
+
+def push_init_dist(prog, shards: PushShards, mesh: Mesh):
+    """(arrays, parrays, carry0) sharded over the mesh for step-wise
+    distributed driving."""
+    arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.arrays))
+    parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
+    carry0 = _init_carry(prog, shards.pspec, jax.tree.map(jnp.asarray, shards.arrays))
+    carry0 = PushCarry(
+        *shard_stacked(mesh, tuple(carry0[:4])), carry0.it, carry0.active,
+        carry0.edges,
+    )
+    return arrays, parrays, carry0
+
+
+@lru_cache(maxsize=64)
 def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                        e_bucket_pad: int, max_iters: int, method: str):
     """Direction-optimizing push with the RING dense exchange: sparse

@@ -82,6 +82,29 @@ def run_pull_fixed_dist(
 
 
 @lru_cache(maxsize=64)
+def compile_pull_step_dist(prog, mesh, method: str = "scan"):
+    """ONE distributed pull iteration (all_gather + local step) — the
+    step-wise observability mode for `-verbose --distributed`: the host
+    fences per iteration (like the reference's per-iteration kernel
+    timers), trading the fused on-device loop for stats."""
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(_arrays_specs(), P(PARTS_AXIS)),
+        out_specs=P(PARTS_AXIS),
+    )
+    def step(arr_blk, state_blk):
+        arr = _squeeze0(arr_blk)
+        local = state_blk[0]
+        full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
+        return local_pull_step(prog, arr, full, local, method)[None]
+
+    return step
+
+
+@lru_cache(maxsize=64)
 def _compile_until(prog, mesh, max_iters: int, active_fn, method: str):
     @jax.jit
     @partial(

@@ -212,3 +212,19 @@ def test_edge_shards_flag_gating():
     with pytest.raises(SystemExit, match="own exchange"):
         pr_app.main(SMALL + ["-ng", "4", "--distributed",
                              "--edge-shards", "2", "--exchange", "ring"])
+
+
+def test_sssp_cli_distributed_verbose(capsys):
+    """Distributed -verbose: per-iteration activeNodes stats from the
+    step-wise shard_map driver (reference parity on multi-GPU runs)."""
+    args = SMALL + ["-ng", "8", "--distributed", "-verbose", "-check"]
+    assert sssp_app.main(args) == 0
+    out = capsys.readouterr().out
+    assert "activeNodes(" in out and "[PASS] sssp" in out
+
+
+def test_pagerank_cli_distributed_verbose(capsys):
+    args = SMALL + ["-ni", "3", "-ng", "8", "--distributed", "-verbose"]
+    assert pr_app.main(args) == 0
+    out = capsys.readouterr().out
+    assert out.count("activeNodes(") == 3 and "top-5" in out
