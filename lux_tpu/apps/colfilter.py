@@ -71,6 +71,22 @@ def main(argv=None):
                 prog, shards.spec, arrays, state, cfg.num_iters - start_it,
                 cfg.method,
             )
+        elif cfg.verbose and cfg.exchange == "allgather" and cfg.edge_shards == 1:
+            # step-wise distributed observability (see apps/pagerank.py)
+            from lux_tpu.parallel import dist
+            from lux_tpu.parallel.mesh import shard_stacked
+            from lux_tpu.utils.timing import IterStats
+
+            d_arrays = shard_stacked(
+                mesh, jax.tree.map(jax.numpy.asarray, shards.arrays)
+            )
+            state = shard_stacked(mesh, state)
+            step = dist.compile_pull_step_dist(prog, mesh, cfg.method)
+            stats = IterStats(verbose=True)
+            for it in range(start_it, cfg.num_iters):
+                t = Timer()
+                state = step(d_arrays, state)
+                stats.record(it, g.nv, t.stop(state))
         elif cfg.ckpt_every:
             state, elapsed = common.run_fixed_dist_chunked(
                 prog, shards, state, start_it, cfg.num_iters, mesh, cfg,

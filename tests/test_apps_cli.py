@@ -228,3 +228,10 @@ def test_pagerank_cli_distributed_verbose(capsys):
     assert pr_app.main(args) == 0
     out = capsys.readouterr().out
     assert out.count("activeNodes(") == 3 and "top-5" in out
+
+
+def test_colfilter_cli_distributed_verbose(capsys):
+    args = SMALL + ["-ni", "2", "-ng", "8", "--distributed", "-verbose"]
+    assert cf_app.main(args) == 0
+    out = capsys.readouterr().out
+    assert out.count("activeNodes(") == 2 and "training RMSE" in out
