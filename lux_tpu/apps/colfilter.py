@@ -48,20 +48,18 @@ def main(argv=None):
             state = jax.numpy.asarray(saved)
             print(f"resumed from {prev} at iteration {start_it}")
 
-    from lux_tpu.utils import profiling
+    from lux_tpu.utils import checkpoint, profiling
+
+    def on_iter(it, st):
+        if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
+            checkpoint.save_iteration(
+                cfg.ckpt_dir, it + 1, jax.device_get(st), "colfilter"
+            )
 
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
         elapsed = None
         if (cfg.verbose or cfg.ckpt_every) and mesh is None:
-            from lux_tpu.utils import checkpoint
-
-            def on_iter(it, st):
-                if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
-                    checkpoint.save_iteration(
-                        cfg.ckpt_dir, it + 1, jax.device_get(st), "colfilter"
-                    )
-
             state, _ = common.run_pull_stepwise(
                 prog, shards.spec, arrays, state, start_it, cfg.num_iters,
                 cfg, g.nv, on_iter,
@@ -72,21 +70,12 @@ def main(argv=None):
                 cfg.method,
             )
         elif cfg.verbose and cfg.exchange == "allgather" and cfg.edge_shards == 1:
-            # step-wise distributed observability (see apps/pagerank.py)
-            from lux_tpu.parallel import dist
-            from lux_tpu.parallel.mesh import shard_stacked
-            from lux_tpu.utils.timing import IterStats
-
-            d_arrays = shard_stacked(
-                mesh, jax.tree.map(jax.numpy.asarray, shards.arrays)
+            # step-wise distributed observability (see apps/pagerank.py);
+            # checkpointing composes via the same on_iter hook
+            state, _ = common.run_pull_stepwise_dist(
+                prog, shards, state, start_it, cfg.num_iters, mesh, cfg,
+                g.nv, on_iter,
             )
-            state = shard_stacked(mesh, state)
-            step = dist.compile_pull_step_dist(prog, mesh, cfg.method)
-            stats = IterStats(verbose=True)
-            for it in range(start_it, cfg.num_iters):
-                t = Timer()
-                state = step(d_arrays, state)
-                stats.record(it, g.nv, t.stop(state))
         elif cfg.ckpt_every:
             state, elapsed = common.run_fixed_dist_chunked(
                 prog, shards, state, start_it, cfg.num_iters, mesh, cfg,

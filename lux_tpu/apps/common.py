@@ -137,6 +137,31 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
     return preflight.estimate_pull(shards.spec, state_width, sbytes)
 
 
+def run_pull_stepwise_dist(prog, shards, state, start_it, num_iters, mesh,
+                           cfg: RunConfig, nv, on_iter=None):
+    """Step-wise DISTRIBUTED pull loop (-verbose --distributed): one
+    shard_map iteration per host step with whole-iteration stats (the
+    phase split stays a single-device mode); same on_iter hook as
+    run_pull_stepwise so checkpointing composes with verbose."""
+    import jax
+
+    from lux_tpu.parallel import dist
+    from lux_tpu.parallel.mesh import shard_stacked
+    from lux_tpu.utils.timing import IterStats, Timer
+
+    arrays = shard_stacked(mesh, jax.tree.map(jax.numpy.asarray, shards.arrays))
+    state = shard_stacked(mesh, state)
+    step = dist.compile_pull_step_dist(prog, mesh, cfg.method)
+    stats = IterStats(verbose=cfg.verbose)
+    for it in range(start_it, num_iters):
+        t = Timer()
+        state = step(arrays, state)
+        stats.record(it, nv, t.stop(state))
+        if on_iter is not None:
+            on_iter(it, state)
+    return state, stats
+
+
 def run_fixed_dist_chunked(prog, shards, state, start_it, num_iters, mesh,
                            cfg: RunConfig, app: str):
     """Distributed fixed-iteration run in --ckpt-every-sized on-device

@@ -459,12 +459,13 @@ def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     """ONE distributed direction-optimized iteration (the body of
     _compile_push_dist without the on-device while_loop) — step-wise
     observability for `-verbose --distributed`.  Takes/returns the sharded
-    stacked carry; the host reads carry.active between steps."""
+    stacked carry (donated: state/queue double buffers reuse HBM like
+    compile_push_step); the host reads carry.active between steps."""
     arr_specs = ShardArrays(*([P(PARTS_AXIS)] * len(ShardArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
     carry_specs = PushCarry(*([P(PARTS_AXIS)] * 4), P(), P(), P())
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=2)
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -687,12 +688,6 @@ def run_push_dist(
     rounds) exchanged over ICI inside the on-device loop."""
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts == mesh.devices.size
-    arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.arrays))
-    parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
-    carry0 = _init_carry(prog, pspec, jax.tree.map(jnp.asarray, shards.arrays))
-    carry0 = PushCarry(
-        *shard_stacked(mesh, tuple(carry0[:4])), carry0.it, carry0.active,
-        carry0.edges,
-    )
+    arrays, parrays, carry0 = push_init_dist(prog, shards, mesh)
     run = _compile_push_dist(prog, mesh, pspec, spec, max_iters, method)
     return run(arrays, parrays, carry0)

@@ -86,9 +86,11 @@ def compile_pull_step_dist(prog, mesh, method: str = "scan"):
     """ONE distributed pull iteration (all_gather + local step) — the
     step-wise observability mode for `-verbose --distributed`: the host
     fences per iteration (like the reference's per-iteration kernel
-    timers), trading the fused on-device loop for stats."""
+    timers), trading the fused on-device loop for stats.  The state is
+    donated — ping-pong double buffering like the single-device
+    compile_pull_step."""
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=1)
     @partial(
         jax.shard_map,
         mesh=mesh,

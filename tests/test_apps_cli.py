@@ -235,3 +235,16 @@ def test_colfilter_cli_distributed_verbose(capsys):
     assert cf_app.main(args) == 0
     out = capsys.readouterr().out
     assert out.count("activeNodes(") == 2 and "training RMSE" in out
+
+
+def test_pagerank_cli_distributed_verbose_with_ckpt(tmp_path, capsys):
+    """-verbose --distributed composes with --ckpt-every (on_iter hook)."""
+    d = str(tmp_path / "vck")
+    args = SMALL + ["-ni", "4", "-ng", "8", "--distributed", "-verbose",
+                    "--ckpt-dir", d, "--ckpt-every", "2"]
+    assert pr_app.main(args) == 0
+    out = capsys.readouterr().out
+    assert out.count("activeNodes(") == 4
+    import os
+
+    assert sorted(os.listdir(d)) == ["ckpt_2.npz", "ckpt_4.npz"]

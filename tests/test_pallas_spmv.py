@@ -97,7 +97,7 @@ def test_colfilter_pallas_matches_reference():
     np.testing.assert_allclose(got, want, rtol=3e-5, atol=1e-7)
 
 
-def test_pallas_pagerank_bf16(interpret_only=True):
+def test_pallas_pagerank_bf16():
     """bf16 state + bf16 MXU inputs (f32 accumulation) tracks the f32
     kernel within bf16 resolution."""
     from lux_tpu.models.pagerank import make_pallas_runner
