@@ -93,7 +93,6 @@ def build_push_shards(
     spec = pull.spec
     P, e_pad, nv_pad = num_parts, spec.e_pad, spec.nv_pad
     cuts = pull.cuts
-    dst_of = g.dst_of_edges()
 
     uniq_all, rp_all, dst_all, w_all = [], [], [], []
     for p in range(P):
@@ -111,7 +110,13 @@ def build_push_shards(
         np.cumsum(counts, out=rp[1:])
         uniq_all.append(uniq.astype(np.int32))
         rp_all.append(rp.astype(np.int32))
-        dst_all.append((dst_of[elo:ehi][order] - vlo).astype(np.int32))
+        # part-local dst per edge straight from the row_ptr slice — no
+        # global O(ne) dst_of_edges materialization (mmap-friendly)
+        dl_slice = np.repeat(
+            np.arange(vhi - vlo, dtype=np.int32),
+            np.diff(np.asarray(g.row_ptr[vlo : vhi + 1])).astype(np.int64),
+        )
+        dst_all.append(dl_slice[order])
         if g.weights is not None:
             w_all.append(g.weights[elo:ehi][order].astype(np.float32))
 
