@@ -14,6 +14,16 @@ from lux_tpu.graph.push_shards import build_push_shards
 from lux_tpu.graph.sharded_load import load_pull_shards
 from lux_tpu.graph.shards import build_pull_shards
 
+__all__ = [
+    "HostGraph", "from_edge_list", "read_lux", "read_lux_range",
+    "write_lux", "build_push_shards", "load_pull_shards",
+    "build_pull_shards",
+    # exchange-layout builders (lazy subpackages carry the drivers):
+    #   parallel.ring.build_ring_shards / build_push_ring_shards
+    #   parallel.scatter.build_scatter_shards
+    #   parallel.edge2d.build_edge2d_shards
+]
+
 __version__ = "0.1.0"
 
 

@@ -20,7 +20,10 @@ def load_graph(cfg: RunConfig, weighted: bool = False,
     """``weighted`` requires/generates edge weights; ``bipartite`` shapes
     the synthetic graph as a rating graph (CF)."""
     if cfg.file:
-        g = read_lux(cfg.file)
+        try:
+            g = read_lux(cfg.file)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"cannot read {cfg.file}: {e}")
         if weighted and not g.weighted:
             raise SystemExit(f"{cfg.file} has no edge weights")
         log.info("loaded %s: nv=%d ne=%d", cfg.file, g.nv, g.ne)
