@@ -96,21 +96,21 @@ def connected_components_push(
     num_parts: int = 1,
     mesh=None,
     method: str = "scan",
+    exchange: str = "allgather",
 ) -> np.ndarray:
     """CC on the frontier/push engine (direction-optimizing; what the
-    reference app actually runs).  ``g``: HostGraph or pre-built PushShards."""
-    from lux_tpu.engine import push as push_engine
+    reference app actually runs).  ``g``: HostGraph or pre-built shards;
+    ``exchange="ring"`` (with a mesh) streams dense rounds."""
     from lux_tpu.graph.push_shards import PushShards, build_push_shards
+    from lux_tpu.models.sssp import _push_run
+    from lux_tpu.parallel.ring import PushRingShards
 
-    shards = g if isinstance(g, PushShards) else build_push_shards(g, num_parts)
+    shards = (
+        g if isinstance(g, (PushShards, PushRingShards))
+        else build_push_shards(g, num_parts)
+    )
     prog = MaxLabelProgram()
-    if mesh is None:
-        final, _, _ = push_engine.run_push(prog, shards, max_iters, method=method)
-    else:
-        final, _, _ = push_engine.run_push_dist(
-            prog, shards, mesh, max_iters, method=method
-        )
-    return shards.scatter_to_global(np.asarray(final))
+    return _push_run(prog, g, shards, mesh, max_iters, method, exchange, num_parts)
 
 
 def check_labels(g: HostGraph, labels: np.ndarray) -> int:

@@ -74,6 +74,37 @@ class WeightedSSSPProgram(SSSPProgram):
         return src_val + weight.astype(jnp.int32)
 
 
+def _push_run(prog, g, shards, mesh, max_iters, method, exchange,
+              num_parts):
+    """Shared dispatch for the frontier-model wrappers: single-device,
+    all_gather-distributed, or ring-dense distributed."""
+    from lux_tpu.parallel.ring import PushRingShards, build_push_ring_shards
+
+    if mesh is None:
+        if isinstance(shards, PushRingShards):
+            shards = shards.push  # ring buckets are a distributed layout
+        final, _, _ = push.run_push(prog, shards, max_iters, method=method)
+    elif exchange == "ring":
+        if isinstance(shards, PushRingShards):
+            rshards = shards
+        elif isinstance(g, HostGraph):
+            rshards = build_push_ring_shards(g, num_parts)
+        else:
+            raise ValueError(
+                "exchange='ring' needs a HostGraph or pre-built PushRingShards"
+            )
+        final, _, _ = push.run_push_ring(
+            prog, rshards, mesh, max_iters, method=method
+        )
+    else:
+        if isinstance(shards, PushRingShards):
+            shards = shards.push
+        final, _, _ = push.run_push_dist(
+            prog, shards, mesh, max_iters, method=method
+        )
+    return shards.scatter_to_global(np.asarray(final))
+
+
 def sssp(
     g: HostGraph | PushShards,
     start: int = 0,
@@ -82,9 +113,17 @@ def sssp(
     max_iters: int = 10_000,
     weighted: bool = False,
     method: str = "scan",
+    exchange: str = "allgather",
 ) -> np.ndarray:
-    """Run SSSP from ``start``; returns (nv,) int32 distances, nv == INF."""
-    shards = g if isinstance(g, PushShards) else build_push_shards(g, num_parts)
+    """Run SSSP from ``start``; returns (nv,) int32 distances, nv == INF.
+    ``exchange="ring"`` (with a mesh) streams dense rounds instead of
+    all-gathering the state."""
+    from lux_tpu.parallel.ring import PushRingShards
+
+    shards = (
+        g if isinstance(g, (PushShards, PushRingShards))
+        else build_push_shards(g, num_parts)
+    )
     if not 0 <= start < shards.spec.nv:
         raise ValueError(f"start vertex {start} out of range [0, {shards.spec.nv})")
     if weighted:
@@ -99,11 +138,7 @@ def sssp(
             )
     cls = WeightedSSSPProgram if weighted else SSSPProgram
     prog = cls(nv=shards.spec.nv, start=start)
-    if mesh is None:
-        final, _, _ = push.run_push(prog, shards, max_iters, method=method)
-    else:
-        final, _, _ = push.run_push_dist(prog, shards, mesh, max_iters, method=method)
-    return shards.scatter_to_global(np.asarray(final))
+    return _push_run(prog, g, shards, mesh, max_iters, method, exchange, num_parts)
 
 
 def inf_value(nv: int, weighted: bool = False) -> int:

@@ -155,3 +155,21 @@ def test_push_ring_weighted_sssp(mesh8):
     got = prs.scatter_to_global(np.asarray(state))
     want = sssp_model.sssp(g, start=0, weighted=True)
     np.testing.assert_array_equal(got, want)
+
+
+def test_model_wrappers_ring_exchange(mesh8):
+    """Library-level exchange='ring' on the sssp/CC wrappers (the CLI path
+    is tested separately)."""
+    from lux_tpu.models import components, sssp as sssp_model
+
+    g = generate.uniform_random(300, 2200, seed=101)
+    a = sssp_model.sssp(g, start=0, num_parts=8, mesh=mesh8, exchange="ring")
+    np.testing.assert_array_equal(a, sssp_model.bfs_reference(g, 0))
+    labels = components.connected_components_push(
+        g, num_parts=8, mesh=mesh8, exchange="ring"
+    )
+    assert components.check_labels(g, labels) == 0
+    # pre-built PushRingShards also accepted, incl. on the 1-device path
+    prs = ring.build_push_ring_shards(g, 8)
+    b = sssp_model.sssp(prs, start=0, mesh=mesh8, exchange="ring")
+    np.testing.assert_array_equal(b, a)
