@@ -58,6 +58,11 @@ def _zero(metric):
 
 def worker_main():
     """The actual benchmark; runs on whatever platform the env selects."""
+    if os.environ.get("LUX_BENCH_FAKE_HANG") == "1":
+        # test hook: emulate the tunnel's claim-leg hang (a C-level block
+        # the orchestrator must route around without killing this process)
+        while True:
+            time.sleep(3600)
     import jax
     import jax.numpy as jnp
 
@@ -247,6 +252,8 @@ def main():
     # finish inside the budget at all.
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env.pop("LUX_BENCH_FAKE_HANG", None)  # the hang hook targets the
+    # primary worker only (tests of the insurance path)
     env["LUX_BENCH_SCALE"] = os.environ.get(
         "LUX_BENCH_CPU_SCALE", str(min(scale, 18))
     )
@@ -279,8 +286,9 @@ def main():
         # for every later process (docs/NOTES_ROUND1.md).  Leave it running;
         # if the grant ever arrives it finishes and exits on its own.
         print(
-            f"# TPU worker still stuck after {tpu_wait}s; "
-            "using CPU insurance result (worker left running, not killed)",
+            f"# TPU worker (pid {tpu_proc.pid}) still stuck after "
+            f"{tpu_wait}s; using CPU insurance result "
+            "(worker left running, not killed)",
             file=sys.stderr,
             flush=True,
         )
