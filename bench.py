@@ -67,9 +67,13 @@ def worker_main():
     import jax.numpy as jnp
 
     try:  # persistent compile cache: repeat bench runs skip the 20-40s
-        # compile.  Keyed by platform — a TPU-side AOT entry must never be
-        # loaded by the CPU fallback worker (SIGILL risk on feature mismatch).
-        platform0 = jax.default_backend()
+        # compile.  Keyed by the TARGET platform env (not
+        # jax.default_backend(), which would force backend init right here
+        # and turn a slow tunnel into a pre-benchmark hang) — a TPU-side
+        # AOT entry must never be loaded by the CPU fallback worker.
+        platform0 = (
+            os.environ.get("JAX_PLATFORMS", "default").split(",")[0] or "default"
+        )
         jax.config.update(
             "jax_compilation_cache_dir", f"/tmp/lux_jax_cache_{platform0}"
         )
