@@ -63,6 +63,10 @@ def worker_main():
         # the orchestrator must route around without killing this process)
         while True:
             time.sleep(3600)
+    # the orchestrator staggers the primary behind the CPU insurance so
+    # the insurance's CPU-bound timed region runs on a quiet machine
+    # (measured: concurrent graph gen halves the fallback GTEPS)
+    time.sleep(int(os.environ.get("LUX_BENCH_PRIMARY_DELAY_S", "0")))
     import jax
     import jax.numpy as jnp
 
@@ -247,17 +251,23 @@ def main():
     # JSON there — it must never be mistaken for this run's result
     tag = f"{os.getpid()}_{int(time.time())}"
     tpu_out = f"/tmp/lux_bench_tpu_worker_{tag}.json"
-    tpu_proc = _spawn_worker(dict(os.environ), tpu_out)
+    # the TPU worker is the niced + staggered one: its CPU-bound phase
+    # (graph gen) is not its timed region (device-bound), while the CPU
+    # insurance's timed region IS CPU-bound and must not share the core
+    env_primary = dict(os.environ)
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        env_primary.setdefault("LUX_BENCH_PRIMARY_DELAY_S", "180")
+    tpu_proc = _spawn_worker(env_primary, tpu_out, nice=10)
 
-    # CPU insurance starts IMMEDIATELY (niced, smaller graph): a stuck TPU
-    # worker sleeps in device init, so the single host core is effectively
-    # free — by the TPU deadline the fallback number is already banked
-    # rather than just starting.  A 1-core CPU needs a smaller graph to
-    # finish inside the budget at all.
+    # CPU insurance starts IMMEDIATELY (smaller graph): a stuck TPU worker
+    # sleeps in device init, so the single host core is effectively free —
+    # by the TPU deadline the fallback number is already banked rather
+    # than just starting.  A 1-core CPU needs a smaller graph to finish
+    # inside the budget at all.
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env.pop("LUX_BENCH_FAKE_HANG", None)  # the hang hook targets the
-    # primary worker only (tests of the insurance path)
+    env.pop("LUX_BENCH_FAKE_HANG", None)  # the hang hook and the stagger
+    env.pop("LUX_BENCH_PRIMARY_DELAY_S", None)  # target the primary only
     env["LUX_BENCH_SCALE"] = os.environ.get(
         "LUX_BENCH_CPU_SCALE", str(min(scale, 18))
     )
@@ -274,7 +284,7 @@ def main():
     cpu_proc = (
         None
         if os.environ.get("JAX_PLATFORMS", "") == "cpu"
-        else _spawn_worker(env, cpu_out, nice=15)
+        else _spawn_worker(env, cpu_out)
     )
 
     if _wait(tpu_proc, t_start + tpu_wait) and tpu_proc.returncode == 0 and _relay(tpu_out):
