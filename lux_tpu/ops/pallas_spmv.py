@@ -151,8 +151,8 @@ def _spmv_kernel(op: str, v_blk: int, compute_dtype,
         else:
             out_ref[:] = jnp.full_like(out_ref, -jnp.inf)
 
-    dst = dst_ref[:]  # (1, T)
-    vals = vals_ref[:]  # (1, T)
+    dst = dst_ref[0]  # (1, T)
+    vals = vals_ref[0]  # (1, T)
     t = dst.shape[1]
     iota = jax.lax.broadcasted_iota(jnp.int32, (v_blk, t), 0)
     onehot = iota == dst  # (V_BLK, T); padding dst==v_blk matches nothing
@@ -197,12 +197,19 @@ def spmv_blockcsr(
     if not num_vblocks:
         raise ValueError("num_vblocks is required (use BlockCSR.num_vblocks)")
     num_chunks, t = edge_vals.shape
+    # Mosaic block rule: a block's last two dims must be sublane/lane
+    # aligned (8/128) OR equal the array's.  A (1, t) block over (C, t)
+    # fails the sublane leg, so chunk arrays carry a unit sublane dim —
+    # (C, 1, t) with (1, 1, t) blocks — which is layout-identical (the
+    # trailing dim is unchanged; the reshape is free).
+    edge_vals3 = edge_vals.reshape(num_chunks, 1, t)
+    e_dst_rel3 = e_dst_rel.reshape(num_chunks, 1, t)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(num_chunks,),
         in_specs=[
-            pl.BlockSpec((1, t), lambda i, cb, cf: (i, 0)),
-            pl.BlockSpec((1, t), lambda i, cb, cf: (i, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, cb, cf: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, cb, cf: (i, 0, 0)),
         ],
         # column block: row-block cb[i] of the (num_vblocks*v_blk, 1) output
         out_specs=pl.BlockSpec((v_blk, 1), lambda i, cb, cf: (cb[i], 0)),
@@ -215,7 +222,7 @@ def spmv_blockcsr(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(chunk_block, chunk_first, edge_vals, e_dst_rel)
+    )(chunk_block, chunk_first, edge_vals3, e_dst_rel3)
     return out.reshape(num_vblocks * v_blk)
 
 
@@ -234,7 +241,7 @@ def _spmv2d_kernel(v_blk: int,
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    dst = dst_ref[:]  # (1, T)
+    dst = dst_ref[0]  # (1, T)
     vals = vals_ref[0]  # (T, K)
     t = dst.shape[1]
     iota = jax.lax.broadcasted_iota(jnp.int32, (out_ref.shape[1], t), 0)
@@ -263,12 +270,16 @@ def spmv_blockcsr_2d(
     if not num_vblocks:
         raise ValueError("num_vblocks is required (use BlockCSR.num_vblocks)")
     num_chunks, t, k = edge_vals.shape
+    # unit sublane dim on the dst chunks (same Mosaic block rule as the 1-D
+    # variant; the (1, t, k) values block already satisfies it since t is
+    # sublane-aligned and k equals the array's lane dim)
+    e_dst_rel3 = e_dst_rel.reshape(num_chunks, 1, t)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(num_chunks,),
         in_specs=[
             pl.BlockSpec((1, t, k), lambda i, cb, cf: (i, 0, 0)),
-            pl.BlockSpec((1, t), lambda i, cb, cf: (i, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, cb, cf: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, v_blk, k), lambda i, cb, cf: (cb[i], 0, 0)),
     )
@@ -280,7 +291,7 @@ def spmv_blockcsr_2d(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(chunk_block, chunk_first, edge_vals, e_dst_rel)
+    )(chunk_block, chunk_first, edge_vals, e_dst_rel3)
     return out.reshape(num_vblocks * v_blk, k)
 
 

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Honest per-component timing of the pull hot loop on the real chip.
+
+The pull iteration is gather(state by src) -> segmented-reduce(by dst) ->
+apply.  This probe times each component in isolation with fetch-based
+timing (device->host transfer of a scalar derived from the result — the
+only timing the axon tunnel cannot fake; see tools/tpu_timing_probe.py),
+so we learn WHICH primitive is slow on TPU instead of guessing:
+
+  gather      vals = state[src_pos]                (HLO gather)
+  scan        segmented associative_scan reduce    (log-depth, vectorized)
+  scatter     jax.ops.segment_sum                  (HLO scatter)
+  pallas      spmv_blockcsr one-hot MXU kernel     (Mosaic)
+  pallas+g    gather feeding the pallas kernel     (the full comp phase)
+
+Each row reports ms per repetition from a linear fit over rep counts
+(intercept absorbs the constant tunnel latency).  Numerics of the Mosaic
+kernel are checked against the scatter result first.
+
+Usage: python tools/tpu_component_probe.py [--scale 20] [--ef 16]
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _fit(xs, ys):
+    """Least-squares slope/intercept."""
+    n = len(xs)
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    num = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    den = sum((x - mx) ** 2 for x in xs)
+    return num / den, my - (num / den) * mx
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=20)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--reps", type=int, nargs="+", default=[1, 4, 16])
+    ap.add_argument("--skip", nargs="*", default=[],
+                    help="component names to skip")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lux_tpu.graph import generate
+    from lux_tpu.ops import pallas_spmv as ps
+    from lux_tpu.ops import segment
+
+    print(f"# platform={jax.devices()[0].platform}", flush=True)
+    g = generate.rmat(args.scale, args.ef, seed=0)
+    print(f"# nv={g.nv} ne={g.ne}", flush=True)
+
+    rng = np.random.default_rng(0)
+    state = jnp.asarray(rng.random(g.nv, np.float32))
+    src_pos = jnp.asarray(g.col_idx.astype(np.int32))
+    row_ptr = jnp.asarray(g.row_ptr.astype(np.int32))
+    head = np.zeros(g.ne, np.int32)
+    head[g.row_ptr[:-1][g.row_ptr[:-1] < g.ne]] = 1
+    head_flag = jnp.asarray(head.astype(bool))
+    dst_local = jnp.asarray(g.dst_of_edges().astype(np.int32))
+    vals_fixed = jnp.asarray(rng.random(g.ne, np.float32))
+
+    bc = ps.build_blockcsr(g)
+    bc_dst = jnp.asarray(bc.e_dst_rel)
+    bc_cb = jnp.asarray(bc.chunk_block)
+    bc_cf = jnp.asarray(bc.chunk_first)
+    bc_src = jnp.asarray(bc.e_src_pos)
+    bc_vals = jnp.asarray(rng.random(bc.e_src_pos.shape, np.float32))
+    jax.block_until_ready((state, src_pos, row_ptr, head_flag, dst_local,
+                           vals_fixed, bc_dst, bc_cb, bc_cf, bc_src, bc_vals))
+
+    # rep-loop: x_{k+1} = f(x_k)-style chaining so XLA cannot collapse reps
+    def chain(f, seed_like):
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def run(x0, n):
+            def body(_, x):
+                return f(x)
+            return jax.lax.fori_loop(0, n, body, x0)
+        return run
+
+    # each component maps a state-shaped (nv,) vector to another one
+    def c_gather(x):
+        # fold the gathered edge vector back to (nv,) with a lane-dim sum —
+        # consumes every gathered element (nothing for XLA to DCE) but is
+        # bandwidth-trivial next to the ne random reads
+        return x[src_pos].reshape(g.nv, args.ef).sum(axis=1) * 1e-3
+
+    def c_scan(x):
+        vals = vals_fixed * x[0]
+        acc = segment.segment_sum_csc(vals, row_ptr, head_flag, dst_local,
+                                      method="scan")
+        return acc * 0.999
+
+    def c_scatter(x):
+        vals = vals_fixed * x[0]
+        acc = segment.segment_sum_csc(vals, row_ptr, head_flag, dst_local,
+                                      method="scatter")
+        return acc * 0.999
+
+    npad = bc.num_vblocks * bc.v_blk
+
+    def c_pallas(x):
+        vals = bc_vals * x[0]
+        acc = ps.spmv_blockcsr(vals, bc_dst, bc_cb, bc_cf, op="sum",
+                               v_blk=bc.v_blk, num_vblocks=bc.num_vblocks)
+        return acc[: g.nv] * 0.999
+
+    def c_pallas_g(x):
+        xp = jnp.pad(x, (0, max(0, npad - g.nv)))
+        vals = xp[bc_src]
+        acc = ps.spmv_blockcsr(vals, bc_dst, bc_cb, bc_cf, op="sum",
+                               v_blk=bc.v_blk, num_vblocks=bc.num_vblocks)
+        return acc[: g.nv] * 0.999
+
+    # numerics first: pallas vs scatter on identical inputs
+    if "pallas" not in args.skip:
+        ref = segment.segment_sum_csc(
+            state[src_pos], row_ptr, head_flag, dst_local, method="scan")
+        got = ps.spmv_blockcsr(
+            state[jnp.asarray(bc.e_src_pos)], bc_dst, bc_cb, bc_cf,
+            op="sum", v_blk=bc.v_blk, num_vblocks=bc.num_vblocks)[: g.nv]
+        err = float(jnp.max(jnp.abs(ref - got)))
+        print(f"# pallas-vs-scan max abs err: {err:.3e}", flush=True)
+
+    comps = {
+        "gather": c_gather,
+        "scan": c_scan,
+        "scatter": c_scatter,
+        "pallas": c_pallas,
+        "pallas+g": c_pallas_g,
+    }
+    for name, f in comps.items():
+        if name in args.skip:
+            continue
+        try:
+            run = chain(f, state)
+            for n in args.reps:  # warm-compile each rep count
+                float(jax.device_get(run(state, n).ravel()[0]))
+            xs, ts = [], []
+            for n in args.reps:
+                t0 = time.perf_counter()
+                float(jax.device_get(run(state, n).ravel()[0]))
+                ts.append(time.perf_counter() - t0)
+                xs.append(n)
+            slope, icpt = _fit(xs, ts)
+            gteps = g.ne / slope / 1e9 if slope > 0 else float("nan")
+            print(
+                f"{name:9s} {slope*1e3:10.3f} ms/rep  ({gteps:8.2f} GTEPS-equiv)"
+                f"  [intercept {icpt*1e3:.1f} ms; raw "
+                + " ".join(f"{n}:{t*1e3:.1f}" for n, t in zip(xs, ts)) + "]",
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001 — print and keep probing
+            print(f"{name:9s} FAILED: {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

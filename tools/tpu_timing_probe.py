@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Measure whether device timing is trustworthy on this backend.
+
+On a healthy PJRT backend, `block_until_ready()` returns only after the
+computation has finished, so elapsed wall time scales linearly with the
+iteration count.  Through the axon tunnel we observed the opposite (100
+fori_loop iterations "finishing" faster than 10), i.e. readiness is acked
+before execution.  A device->host transfer of the RESULT cannot lie: the
+bytes exist only after the computation ran.  This probe times
+run(N iters) + 4-byte fetch for several N and prints the per-iteration
+slope — the honest number — next to the naive block_until_ready time.
+
+Usage: python tools/tpu_timing_probe.py [--scale 20] [--ef 16] [--method scatter]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=20)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--method", default="scatter")
+    ap.add_argument("--iters", type=int, nargs="+", default=[10, 50, 100, 200])
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.pagerank import PageRankProgram
+
+    print(f"# platform={jax.devices()[0].platform}", flush=True)
+    g = generate.rmat(args.scale, args.ef, seed=0)
+    if args.method == "pallas":
+        from lux_tpu.models.pagerank import make_pallas_runner
+
+        prun, ps0 = make_pallas_runner(g, dtype="float32")
+
+        def run(n):
+            return prun(ps0, n)
+    else:
+        shards = build_pull_shards(g, 1)
+        arrays = jax.tree.map(jnp.asarray, shards.arrays)
+        jax.block_until_ready(arrays)
+        prog = PageRankProgram(nv=shards.spec.nv, dtype="float32")
+        s0 = pull.init_state(prog, arrays)
+
+        def run(n):
+            return pull.run_pull_fixed(
+                prog, shards.spec, arrays, s0, n, args.method
+            )
+
+    # warm-compile every N first so the timed region is execute-only
+    for n in args.iters:
+        np.asarray(jax.device_get(run(n).ravel()[0]))
+
+    rows = []
+    for n in args.iters:
+        t0 = time.perf_counter()
+        out = run(n)
+        out.block_until_ready()
+        t_block = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out2 = run(n)
+        val = float(jax.device_get(out2.ravel()[0]))  # 4-byte fetch: can't lie
+        t_fetch = time.perf_counter() - t0
+        rows.append((n, t_block, t_fetch, val))
+        print(
+            f"iters={n:5d}  block_until_ready={t_block*1e3:9.3f} ms"
+            f"  fetch={t_fetch*1e3:9.3f} ms  out[0,0]={val:.3e}",
+            flush=True,
+        )
+
+    if len(rows) >= 2:
+        (n0, _, f0, _), (n1, _, f1, _) = rows[0], rows[-1]
+        per_iter = (f1 - f0) / (n1 - n0)
+        gteps = g.ne / per_iter / 1e9 if per_iter > 0 else float("nan")
+        print(
+            f"# slope: {per_iter*1e3:.3f} ms/iter -> {gteps:.2f} GTEPS "
+            f"(ne={g.ne}); fetch-intercept ~{f0 - n0*per_iter:.4f}s",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
