@@ -88,8 +88,9 @@ def build_push_shards(
     num_parts: int,
     f_cap: Optional[int] = None,
     e_sp: Optional[int] = None,
+    cuts: Optional[np.ndarray] = None,
 ) -> PushShards:
-    pull = build_pull_shards(g, num_parts)
+    pull = build_pull_shards(g, num_parts, cuts=cuts)
     spec = pull.spec
     P, e_pad, nv_pad = num_parts, spec.e_pad, spec.nv_pad
     cuts = pull.cuts

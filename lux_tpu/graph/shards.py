@@ -121,11 +121,17 @@ class PullShards:
         return out
 
 
-def shard_geometry(row_ptr_global: np.ndarray, num_parts: int, nv: int):
+def shard_geometry(row_ptr_global: np.ndarray, num_parts: int, nv: int,
+                   cuts: Optional[np.ndarray] = None):
     """(cuts, nv_pad, e_pad) for edge-balanced padded shards, with the
     int32-range guards (global E_ID stays int64 on host, like the
-    reference's uint64 E_ID / uint32 V_ID split, pagerank/app.h:21-22)."""
-    cuts = edge_balanced_cuts(row_ptr_global, num_parts)
+    reference's uint64 E_ID / uint32 V_ID split, pagerank/app.h:21-22).
+
+    ``cuts`` overrides the static edge-balanced sweep with caller-chosen
+    contiguous bounds (the dynamic-repartitioning path feeds
+    partition.weighted_cuts here)."""
+    if cuts is None:
+        cuts = edge_balanced_cuts(row_ptr_global, num_parts)
     nv_counts = np.diff(cuts)
     e_counts = row_ptr_global[cuts[1:]] - row_ptr_global[cuts[:-1]]
     nv_pad = max(LANE, _round_up(int(nv_counts.max()), LANE))
@@ -201,9 +207,14 @@ def build_pull_shards(
     g: HostGraph,
     num_parts: int,
     degrees: Optional[np.ndarray] = None,
+    cuts: Optional[np.ndarray] = None,
 ) -> PullShards:
-    """Partition + pad a HostGraph into device-ready pull-model shards."""
-    cuts, nv_pad, e_pad = shard_geometry(g.row_ptr, num_parts, g.nv)
+    """Partition + pad a HostGraph into device-ready pull-model shards.
+
+    ``cuts`` (optional (P+1,) bounds) selects a custom contiguous
+    partition — used by dynamic repartitioning to rebalance on measured
+    work instead of static in-degree."""
+    cuts, nv_pad, e_pad = shard_geometry(g.row_ptr, num_parts, g.nv, cuts)
     if degrees is None:
         degrees = g.out_degrees()
     arrays = alloc_arrays(num_parts, nv_pad, e_pad)
