@@ -37,14 +37,23 @@ def main(argv=None):
 
     rows = []
 
-    def timed(name, fn, edges):
+    def timed(name, fn, edges, base=0.0):
+        """The model wrappers end in scatter_to_global(np.asarray(...)) — a
+        full device->host transfer, so this timing is honest even where
+        block_until_ready is not (the axon tunnel acks readiness early;
+        see tools/tpu_timing_probe.py).  ``base`` is a measured 0-iteration
+        run of the same app: compile-free dispatch + the same transfer,
+        subtracted so GTEPS reflects iteration work, not tunnel latency."""
         t0 = time.perf_counter()
         out = fn()
-        jax.block_until_ready(out) if hasattr(out, "block_until_ready") else None
         dt = time.perf_counter() - t0
-        gteps = edges / dt / 1e9
-        rows.append((name, dt, gteps))
-        print(f"{name}: {dt:.3f}s  {gteps:.3f} GTEPS", flush=True)
+        # floor at 10% of raw: when base ~= dt the difference is noise, and
+        # an honest-but-noisy number must not explode into a absurd GTEPS
+        net = max(dt - base, 0.1 * dt)
+        gteps = edges / net / 1e9
+        rows.append((name, dt, net, gteps))
+        print(f"{name}: {dt:.3f}s raw, {net:.3f}s net  {gteps:.3f} GTEPS",
+              flush=True)
         return out
 
     def device_pull(shards):
@@ -71,14 +80,21 @@ def main(argv=None):
 
     # warm with IDENTICAL args: num_iters is a static compile-cache key
     pr.pagerank(pull_sh, args.iters, args.parts)
+    pr.pagerank(pull_sh, 0, args.parts)  # warm the 0-iter baseline program
+    t0 = time.perf_counter()
+    pr.pagerank(pull_sh, 0, args.parts)
+    base = time.perf_counter() - t0  # dispatch + full-state D2H, no work
+    print(f"# 0-iteration baseline (dispatch + state transfer): {base:.3f}s",
+          flush=True)
     timed("pagerank", lambda: pr.pagerank(pull_sh, args.iters, args.parts),
-          args.iters * g.ne)
+          args.iters * g.ne, base)
     sssp.sssp(push_sh, start=0, num_parts=args.parts)  # warm
-    timed("sssp", lambda: sssp.sssp(push_sh, start=0, num_parts=args.parts), g.ne)
+    timed("sssp", lambda: sssp.sssp(push_sh, start=0, num_parts=args.parts),
+          g.ne, base)
     components.connected_components_push(push_sh, num_parts=args.parts)  # warm
     timed("components",
           lambda: components.connected_components_push(push_sh, num_parts=args.parts),
-          g.ne)
+          g.ne, base)
 
     gw = generate.bipartite_ratings(
         (1 << args.scale) // 2, (1 << args.scale) // 2,
@@ -86,13 +102,17 @@ def main(argv=None):
     )
     cf_sh = device_pull(build_pull_shards(gw, args.parts))
     cf.colfilter(cf_sh, args.iters, args.parts)  # warm (same static args)
+    cf.colfilter(cf_sh, 0, args.parts)
+    t0 = time.perf_counter()
+    cf.colfilter(cf_sh, 0, args.parts)
+    cf_base = time.perf_counter() - t0  # CF state is (V, K): own baseline
     timed("colfilter", lambda: cf.colfilter(cf_sh, args.iters, args.parts),
-          args.iters * gw.ne)
+          args.iters * gw.ne, cf_base)
 
-    print("\n| app | seconds | GTEPS |")
-    print("|---|---|---|")
-    for name, dt, gteps in rows:
-        print(f"| {name} | {dt:.3f} | {gteps:.3f} |")
+    print("\n| app | raw s | net s | GTEPS |")
+    print("|---|---|---|---|")
+    for name, dt, net, gteps in rows:
+        print(f"| {name} | {dt:.3f} | {net:.3f} | {gteps:.3f} |")
     return 0
 
 

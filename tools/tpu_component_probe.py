@@ -80,9 +80,12 @@ def main(argv=None):
     jax.block_until_ready((state, src_pos, row_ptr, head_flag, dst_local,
                            vals_fixed, bc_dst, bc_cb, bc_cf, bc_src, bc_vals))
 
-    # rep-loop: x_{k+1} = f(x_k)-style chaining so XLA cannot collapse reps
+    # rep-loop: x_{k+1} = f(x_k)-style chaining so XLA cannot collapse reps.
+    # n is TRACED (dynamic trip count) — one compile per component total;
+    # over the tunnel each compile costs minutes, so this matters more than
+    # the marginally better static-loop codegen.
     def chain(f, seed_like):
-        @functools.partial(jax.jit, static_argnames=("n",))
+        @jax.jit
         def run(x0, n):
             def body(_, x):
                 return f(x)
@@ -145,7 +148,7 @@ def main(argv=None):
             continue
         try:
             run = chain(f, state)
-            for n in args.reps:  # warm-compile each rep count
+            for n in args.reps:  # one compile (n is traced); warm the path
                 float(jax.device_get(run(state, n).ravel()[0]))
             xs, ts = [], []
             for n in args.reps:
