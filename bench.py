@@ -153,59 +153,62 @@ def worker_main():
     platform = jax.devices()[0].platform
     on_tpu = platform in ("tpu", "axon")
     if method_env == "auto":
-        methods = ["scan", "scatter"] + (["pallas"] if on_tpu else [])
+        # scatter first (known to complete on the chip), scan LAST: the
+        # only chip hang observed so far was inside a scan-method program
+        # (server-side wedge, 30+ min; tools/tpu_timing_probe.py).  Each
+        # result is emitted the moment it exists, so if a later method
+        # wedges this worker the orchestrator still harvests the banked
+        # lines from the output file.
+        methods = (["scatter", "pallas"] if on_tpu else ["scan", "scatter"])
+        risky_tail = ["scan"] if on_tpu else []
     else:
         methods = [method_env]
+        risky_tail = []
     results = {}
+
+    def measure(m, dt):
+        elapsed, _ = timed(m, dt)
+        results[(m, dt)] = elapsed
+        gteps = iters * g.ne / elapsed / 1e9
+        suffix = "" if on_tpu else f"_{platform}_fallback"
+        if dt == "bfloat16":
+            suffix = "_bf16" + suffix
+        print(
+            f"# method {m} ({dt}): {elapsed:.4f}s -> {gteps:.4f} GTEPS",
+            file=sys.stderr,
+            flush=True,
+        )
+        _emit(
+            {
+                "metric": f"pagerank_gteps_rmat{scale}_1chip{suffix}",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
+                "dtype": dt,
+            }
+        )
+
     for m in methods:
         try:
-            results[(m, dtype)] = timed(m, dtype)
-            print(
-                f"# method {m} ({dtype}): {results[(m, dtype)][0]:.4f}s",
-                file=sys.stderr,
-                flush=True,
-            )
+            measure(m, dtype)
         except Exception as e:  # noqa: BLE001 — a method may be unsupported
             print(f"# method {m} failed: {e}", file=sys.stderr, flush=True)
     if results and on_tpu and dtype_env is None:
-        # one extra datapoint on real hardware: the winning method with
-        # bf16 state (halved HBM gather + exchange traffic)
-        best_m = min(results.items(), key=lambda kv: kv[1][0])[0][0]
+        # bf16 datapoint on the best method BEFORE the risky tail: halved
+        # HBM gather + exchange traffic is the interesting hardware number
+        best_m = min(results.items(), key=lambda kv: kv[1])[0][0]
         try:
-            results[(best_m, "bfloat16")] = timed(best_m, "bfloat16")
-            print(
-                f"# method {best_m} (bfloat16): "
-                f"{results[(best_m, 'bfloat16')][0]:.4f}s",
-                file=sys.stderr,
-                flush=True,
-            )
+            measure(best_m, "bfloat16")
         except Exception as e:  # noqa: BLE001
             print(f"# bf16 variant failed: {e}", file=sys.stderr, flush=True)
+    for m in risky_tail:
+        try:
+            measure(m, dtype)
+        except Exception as e:  # noqa: BLE001
+            print(f"# method {m} failed: {e}", file=sys.stderr, flush=True)
     if not results:
         raise RuntimeError(f"all benchmark methods failed: {methods}")
-    (method, dtype), (elapsed, out) = min(
-        results.items(), key=lambda kv: kv[1][0]
-    )
-    gteps = iters * g.ne / elapsed / 1e9
-
-    # diagnostics on stderr: stdout carries EXACTLY one JSON line
-    print(
-        f"# platform={platform} nv={g.nv} ne={g.ne} iters={iters} "
-        f"method={method} dtype={dtype} elapsed={elapsed:.4f}s",
-        file=sys.stderr,
-        flush=True,
-    )
-    suffix = "" if on_tpu else f"_{platform}_fallback"
-    if dtype == "bfloat16":
-        suffix = "_bf16" + suffix
-    _emit(
-        {
-            "metric": f"pagerank_gteps_rmat{scale}_1chip{suffix}",
-            "value": round(gteps, 4),
-            "unit": "GTEPS",
-            "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
-        }
-    )
 
 
 def _spawn_worker(env, out_path, nice=0):
@@ -236,22 +239,36 @@ def _wait(proc, deadline):
 
 
 def _relay(out_path) -> bool:
-    """Forward the worker's JSON line to stdout (and its stderr diagnostics
-    to ours); True if a JSON line was found."""
+    """Forward the BEST of the worker's JSON lines to stdout (and its
+    stderr diagnostics to ours); True if any line was found.
+
+    The worker emits one line per measured (method, dtype) as soon as it
+    exists, best-effort: even a worker that later wedged inside a risky
+    method has its completed measurements harvested here — stdout still
+    carries exactly one JSON line, the highest-GTEPS one."""
     try:
         with open(out_path + ".err", "rb") as f:
             sys.stderr.write(f.read().decode(errors="replace"))
             sys.stderr.flush()
     except OSError:
         pass
+    best = None
     try:
         with open(out_path, "rb") as f:
             for line in f.read().decode(errors="replace").splitlines():
-                if line.startswith("{"):
-                    print(line, flush=True)
-                    return True
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if best is None or obj.get("value", 0.0) > best.get("value", 0.0):
+                    best = obj
     except OSError:
         pass
+    if best is not None:
+        print(json.dumps(best), flush=True)
+        return True
     return False
 
 
@@ -323,13 +340,29 @@ def main():
             file=sys.stderr,
             flush=True,
         )
+        if _relay(tpu_out):
+            # methods completed BEFORE the wedge are real chip numbers —
+            # strictly better than any CPU insurance value
+            if cpu_proc is not None:
+                try:
+                    cpu_proc.kill()
+                except OSError:
+                    pass
+            return
     else:
-        _relay(tpu_out)  # surface its stderr even on failure
         print(
-            f"# TPU worker exited rc={tpu_proc.returncode}; CPU fallback",
+            f"# TPU worker exited rc={tpu_proc.returncode}; "
+            "harvesting any banked lines",
             file=sys.stderr,
             flush=True,
         )
+        if _relay(tpu_out):  # partial results survive a late crash too
+            if cpu_proc is not None:
+                try:
+                    cpu_proc.kill()
+                except OSError:
+                    pass
+            return
 
     if cpu_proc is None:
         cpu_proc = _spawn_worker(env, cpu_out)  # primary WAS cpu and failed

@@ -136,12 +136,16 @@ def main(argv=None):
         err = float(jnp.max(jnp.abs(ref - got)))
         print(f"# pallas-vs-scan max abs err: {err:.3e}", flush=True)
 
+    # scan LAST: the one chip-session hang so far happened inside a
+    # scan-method program (tools/tpu_timing_probe.py --method scan wedged
+    # the server side for 30+ min); keep the safe components' data banked
+    # before risking it
     comps = {
         "gather": c_gather,
-        "scan": c_scan,
         "scatter": c_scatter,
         "pallas": c_pallas,
         "pallas+g": c_pallas_g,
+        "scan": c_scan,
     }
     for name, f in comps.items():
         if name in args.skip:
