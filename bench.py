@@ -58,9 +58,19 @@ def _zero(metric):
 
 def worker_main():
     """The actual benchmark; runs on whatever platform the env selects."""
-    if os.environ.get("LUX_BENCH_FAKE_HANG") == "1":
+    fake = os.environ.get("LUX_BENCH_FAKE_HANG")
+    if fake == "1":
         # test hook: emulate the tunnel's claim-leg hang (a C-level block
         # the orchestrator must route around without killing this process)
+        while True:
+            time.sleep(3600)
+    if fake == "emit":
+        # test hook: bank one measurement, then wedge (the mid-run
+        # server-side hang observed with the scan method) — the
+        # orchestrator must harvest the banked line, not fall to insurance
+        _emit({"metric": "pagerank_gteps_fake_banked", "value": 123.0,
+               "unit": "GTEPS", "vs_baseline": 123.0, "method": "scatter",
+               "dtype": "float32"})
         while True:
             time.sleep(3600)
     # the orchestrator staggers the primary behind the CPU insurance so

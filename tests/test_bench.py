@@ -54,3 +54,26 @@ def test_bench_insurance_survives_hung_primary():
     pid = int(r.stderr.split("TPU worker (pid ")[1].split(")")[0])
     os.kill(pid, 0)  # raises if the orchestrator wrongly killed it
     os.kill(pid, signal.SIGKILL)
+
+
+def test_bench_harvests_banked_lines_from_wedged_primary():
+    """A primary that measured something and THEN wedged (the observed
+    scan-method server hang) must have its banked chip number win over
+    the CPU insurance, and must still be left running."""
+    r = _run(
+        {
+            "LUX_BENCH_FAKE_HANG": "emit",
+            "JAX_PLATFORMS": "bogus_tpu",
+            "LUX_BENCH_WATCHDOG_S": "240",
+            "LUX_BENCH_TPU_S": "15",
+        },
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "pagerank_gteps_fake_banked"
+    assert line["value"] == 123.0
+    assert "left running, not killed" in r.stderr
+    pid = int(r.stderr.split("TPU worker (pid ")[1].split(")")[0])
+    os.kill(pid, 0)
+    os.kill(pid, signal.SIGKILL)
