@@ -84,11 +84,16 @@ def make_pallas_runner(
     v_blk: int | None = None,
     t_chunk: int | None = None,
     dtype: str = "float32",
+    dynamic_iters: bool = False,
 ):
     """Build the block-CSR layout once; return (run, state0) where
     run(state, num_iters) executes the full on-device loop on the fused
     Pallas kernel (lux_tpu.ops.pallas_spmv) — the pr_kernel-equivalent
-    hot path."""
+    hot path.
+
+    ``dynamic_iters`` traces the iteration count instead of specializing
+    on it: one compile serves every count — what the tunnel-side sweep
+    harness needs, where each compile costs minutes."""
     import jax
 
     from lux_tpu.ops import pallas_spmv as ps
@@ -113,20 +118,25 @@ def make_pallas_runner(
     cb = jnp.asarray(bc.chunk_block)
     cf = jnp.asarray(bc.chunk_first)
 
-    @functools.partial(jax.jit, static_argnames="num_iters")
-    def run(state, num_iters):
-        def body(_, s):
-            # state stored in `dtype`; bf16 state also feeds the MXU at
-            # the bf16 rate (kernel accumulates f32 either way)
-            vals = s[e_src]
-            acc = ps.spmv_blockcsr(
-                vals, e_dst, cb, cf, op="sum", v_blk=bc.v_blk,
-                num_vblocks=bc.num_vblocks, interpret=interpret,
-                compute_dtype=dtype,
-            )
-            return apply_rank_update(acc, degree_d, g.nv).astype(dtype)
+    def body(_, s):
+        # state stored in `dtype`; bf16 state also feeds the MXU at
+        # the bf16 rate (kernel accumulates f32 either way)
+        vals = s[e_src]
+        acc = ps.spmv_blockcsr(
+            vals, e_dst, cb, cf, op="sum", v_blk=bc.v_blk,
+            num_vblocks=bc.num_vblocks, interpret=interpret,
+            compute_dtype=dtype,
+        )
+        return apply_rank_update(acc, degree_d, g.nv).astype(dtype)
 
-        return jax.lax.fori_loop(0, num_iters, body, state)
+    if dynamic_iters:
+        @jax.jit
+        def run(state, num_iters):
+            return jax.lax.fori_loop(0, num_iters, body, state)
+    else:
+        @functools.partial(jax.jit, static_argnames="num_iters")
+        def run(state, num_iters):
+            return jax.lax.fori_loop(0, num_iters, body, state)
 
     return run, jnp.asarray(state0).astype(dtype)
 

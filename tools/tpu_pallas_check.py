@@ -79,15 +79,29 @@ def main(argv=None):
     for v_blk in (256, 512, 1024):
         for t_chunk in (256, 512, 1024):
             try:
-                run, s0 = make_pallas_runner(g, v_blk=v_blk, t_chunk=t_chunk)
-                run(s0, args.iters).block_until_ready()  # compile+warm
-                t0 = time.perf_counter()
-                run(s0, args.iters).block_until_ready()
-                dt = time.perf_counter() - t0
+                # dynamic_iters: ONE compile per config (tunnel compiles
+                # cost minutes).  Timing ends in a 4-byte fetch and uses
+                # the 1-vs-N slope — block_until_ready lies through the
+                # tunnel (tools/tpu_timing_probe.py).
+                run, s0 = make_pallas_runner(
+                    g, v_blk=v_blk, t_chunk=t_chunk, dynamic_iters=True
+                )
+
+                def fetch(n):
+                    t0 = time.perf_counter()
+                    float(jax.device_get(run(s0, n).ravel()[0]))
+                    return time.perf_counter() - t0
+
+                fetch(1)  # compile + warm
+                t1 = min(fetch(1), fetch(1))
+                tn = min(fetch(args.iters), fetch(args.iters))
+                per_iter = max((tn - t1) / max(args.iters - 1, 1), 1e-9)
+                dt = per_iter * args.iters
                 gteps = args.iters * g.ne / dt / 1e9
                 rows.append((v_blk, t_chunk, dt, gteps))
                 print(f"v_blk={v_blk:5d} t_chunk={t_chunk:5d} "
-                      f"{dt:.4f}s {gteps:.3f} GTEPS", flush=True)
+                      f"{per_iter*1e3:.2f} ms/iter {gteps:.3f} GTEPS",
+                      flush=True)
             except Exception as e:  # noqa: BLE001 — record and continue
                 print(f"v_blk={v_blk} t_chunk={t_chunk} FAILED: {e}",
                       flush=True)

@@ -23,7 +23,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def child(scale: int, ef: int, iters: int) -> int:
+def child(scale: int, ef: int, iters: int, method: str) -> int:
     import time
 
     import jax
@@ -41,14 +41,23 @@ def child(scale: int, ef: int, iters: int) -> int:
     prog = PageRankProgram(nv=shards.spec.nv)
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     state0 = pull.init_state(prog, arrays)
-    run = lambda s: pull.run_pull_fixed(  # noqa: E731
-        prog, shards.spec, arrays, s, iters, "scan"
-    )
-    run(state0).block_until_ready()
-    t0 = time.perf_counter()
-    out = run(state0)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
+
+    # method default is scatter, NOT scan: the one observed chip hang was
+    # a scan-method program, and memory numbers are this tool's point.
+    # Timing ends in a scalar fetch (block_until_ready lies through the
+    # tunnel); 1-vs-N slope removes the constant dispatch+fetch latency.
+    def timed(n):
+        t0 = time.perf_counter()
+        out = pull.run_pull_fixed(prog, shards.spec, arrays, state0, n, method)
+        float(jax.device_get(out.ravel()[0]))
+        return time.perf_counter() - t0, out
+
+    timed(1)  # compile + warm both programs
+    timed(iters)
+    t1, _ = timed(1)
+    tn, out = timed(iters)
+    per_iter = max((tn - t1) / max(iters - 1, 1), 1e-9)
+    dt = per_iter * iters
     stats = jax.devices()[0].memory_stats() or {}
     print(
         json.dumps(
@@ -72,18 +81,19 @@ def main(argv=None):
     ap.add_argument("--max-scale", type=int, default=23)
     ap.add_argument("--ef", type=int, default=16)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--method", default="scatter")
     ap.add_argument("--child-scale", type=int, default=None,
                     help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.child_scale is not None:
-        return child(args.child_scale, args.ef, args.iters)
+        return child(args.child_scale, args.ef, args.iters, args.method)
 
     rows = []
     for scale in range(args.min_scale, args.max_scale + 1):
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
              "--child-scale", str(scale), "--ef", str(args.ef),
-             "--iters", str(args.iters)],
+             "--iters", str(args.iters), "--method", args.method],
             capture_output=True, text=True, timeout=3600,
         )
         line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
