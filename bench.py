@@ -169,7 +169,9 @@ def worker_main():
         # result is emitted the moment it exists, so if a later method
         # wedges this worker the orchestrator still harvests the banked
         # lines from the output file.
-        methods = (["scatter", "pallas"] if on_tpu else ["scan", "scatter"])
+        methods = (
+            ["scatter", "cumsum", "pallas"] if on_tpu else ["scan", "scatter"]
+        )
         risky_tail = ["scan"] if on_tpu else []
     else:
         methods = [method_env]

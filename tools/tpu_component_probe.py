@@ -111,6 +111,12 @@ def main(argv=None):
                                       method="scatter")
         return acc * 0.999
 
+    def c_cumsum(x):
+        vals = vals_fixed * x[0]
+        acc = segment.segment_sum_csc(vals, row_ptr, head_flag, dst_local,
+                                      method="cumsum")
+        return acc * 0.999
+
     npad = bc.num_vblocks * bc.v_blk
 
     def c_pallas(x):
@@ -143,6 +149,7 @@ def main(argv=None):
     comps = {
         "gather": c_gather,
         "scatter": c_scatter,
+        "cumsum": c_cumsum,
         "pallas": c_pallas,
         "pallas+g": c_pallas_g,
         "scan": c_scan,
