@@ -94,11 +94,13 @@ def sparse_prep(parr: PushArrays, q_vids):
 
 
 def sparse_part_step(prog, pspec: PushSpec, parr: PushArrays, nv_pad,
-                     q_vids, q_vals, rows, counts, incl, local):
+                     q_vids, q_vals, rows, counts, incl, local,
+                     cap: int | None = None):
     """Push-mode: compact the frontier's out-edges (restricted to this
-    part's dsts) into an e_sp buffer, then scatter-combine."""
+    part's dsts) into a ``cap``-sized buffer (default the full e_sp
+    tier), then scatter-combine."""
     del counts
-    j = jnp.arange(pspec.e_sp, dtype=jnp.int32)
+    j = jnp.arange(cap or pspec.e_sp, dtype=jnp.int32)
     entry = jnp.searchsorted(incl, j, side="right")
     entry_c = jnp.clip(entry, 0, q_vids.shape[0] - 1)
     prev = jnp.where(entry_c > 0, incl[entry_c - 1], 0)
@@ -239,17 +241,29 @@ def _push_relax(prog, pspec: PushSpec, spec: ShardSpec, method, arrays,
         )(arrays, c.state)
 
     def sparse_all():
-        def f(arr, parr, r, cn, inc, loc):
-            return jnp.where(
-                arr.vtx_mask,
-                sparse_part_step(
-                    prog, pspec, parr, V, q_vids_all, q_vals_all,
-                    r, cn, inc, loc,
-                ),
-                loc,
-            )
+        def run(cap):
+            def f(arr, parr, r, cn, inc, loc):
+                return jnp.where(
+                    arr.vtx_mask,
+                    sparse_part_step(
+                        prog, pspec, parr, V, q_vids_all, q_vals_all,
+                        r, cn, inc, loc, cap,
+                    ),
+                    loc,
+                )
 
-        return jax.vmap(f)(arrays, parrays, rows, counts, incl, c.state)
+            return jax.vmap(f)(arrays, parrays, rows, counts, incl, c.state)
+
+        small = pspec.e_sp_small
+        if not small:
+            return run(pspec.e_sp)
+        # two-tier walk: a round whose largest per-part out-edge total fits
+        # the small buffer pays O(e_sp_small), not O(e_sp) — the SSSP/CC
+        # late-round tail is many tiny frontiers
+        fits = preps[3].max() <= small
+        return jax.lax.cond(
+            fits, lambda: run(small), lambda: run(pspec.e_sp)
+        )
 
     return jax.lax.cond(use_dense, dense_all, sparse_all)
 
@@ -409,13 +423,15 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                     [
                         (c.count > pspec.f_cap).astype(jnp.int32),
                         (total > pspec.e_sp).astype(jnp.int32),
+                        # tier vote: any part too big for the small buffer?
+                        (total > pspec.e_sp_small).astype(jnp.int32),
                     ]
                 ),
                 PARTS_AXIS,
             )
             use_dense = (
                 (g_cnt > spec.nv // pspec.pull_threshold_den)
-                | (flags.max() > 0)
+                | (flags[:2].max() > 0)
             )
 
             def dense_branch():
@@ -423,13 +439,24 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                 return dense_part_step(prog, arr, full, local, method)
 
             def sparse_branch():
-                return jnp.where(
-                    arr.vtx_mask,
-                    sparse_part_step(
-                        prog, pspec, parr, V, q_vids_all, q_vals_all,
-                        rows, counts, incl, local,
-                    ),
-                    local,
+                def run(cap):
+                    return jnp.where(
+                        arr.vtx_mask,
+                        sparse_part_step(
+                            prog, pspec, parr, V, q_vids_all, q_vals_all,
+                            rows, counts, incl, local, cap,
+                        ),
+                        local,
+                    )
+
+                if not pspec.e_sp_small:
+                    return run(pspec.e_sp)
+                # globally-agreed tier (flags[2] is a psum) — identical
+                # branch on every device, collective-free branches
+                return jax.lax.cond(
+                    flags[2] == 0,
+                    lambda: run(pspec.e_sp_small),
+                    lambda: run(pspec.e_sp),
                 )
 
             new = jax.lax.cond(use_dense, dense_branch, sparse_branch)
@@ -491,12 +518,14 @@ def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                 [
                     (c.count > pspec.f_cap).astype(jnp.int32),
                     (total > pspec.e_sp).astype(jnp.int32),
+                    (total > pspec.e_sp_small).astype(jnp.int32),
                 ]
             ),
             PARTS_AXIS,
         )
         use_dense = (
-            (g_cnt > spec.nv // pspec.pull_threshold_den) | (flags.max() > 0)
+            (g_cnt > spec.nv // pspec.pull_threshold_den)
+            | (flags[:2].max() > 0)
         )
 
         def dense_branch():
@@ -504,13 +533,22 @@ def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
             return dense_part_step(prog, arr, full, local, method)
 
         def sparse_branch():
-            return jnp.where(
-                arr.vtx_mask,
-                sparse_part_step(
-                    prog, pspec, parr, V, q_vids_all, q_vals_all,
-                    rows, counts, incl, local,
-                ),
-                local,
+            def run(cap):
+                return jnp.where(
+                    arr.vtx_mask,
+                    sparse_part_step(
+                        prog, pspec, parr, V, q_vids_all, q_vals_all,
+                        rows, counts, incl, local, cap,
+                    ),
+                    local,
+                )
+
+            if not pspec.e_sp_small:
+                return run(pspec.e_sp)
+            return jax.lax.cond(
+                flags[2] == 0,
+                lambda: run(pspec.e_sp_small),
+                lambda: run(pspec.e_sp),
             )
 
         new = jax.lax.cond(use_dense, dense_branch, sparse_branch)
@@ -587,13 +625,14 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                     [
                         (c.count > pspec.f_cap).astype(jnp.int32),
                         (total > pspec.e_sp).astype(jnp.int32),
+                        (total > pspec.e_sp_small).astype(jnp.int32),
                     ]
                 ),
                 PARTS_AXIS,
             )
             use_dense = (
                 (g_cnt > spec.nv // pspec.pull_threshold_den)
-                | (flags.max() > 0)
+                | (flags[:2].max() > 0)
             )
 
             def dense_branch():
@@ -619,13 +658,22 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                 return jnp.where(view.vtx_mask, op(local, acc), local)
 
             def sparse_branch():
-                return jnp.where(
-                    view.vtx_mask,
-                    sparse_part_step(
-                        prog, pspec, parr, V, q_vids_all, q_vals_all,
-                        rows, counts, incl, local,
-                    ),
-                    local,
+                def run(cap):
+                    return jnp.where(
+                        view.vtx_mask,
+                        sparse_part_step(
+                            prog, pspec, parr, V, q_vids_all, q_vals_all,
+                            rows, counts, incl, local, cap,
+                        ),
+                        local,
+                    )
+
+                if not pspec.e_sp_small:
+                    return run(pspec.e_sp)
+                return jax.lax.cond(
+                    flags[2] == 0,
+                    lambda: run(pspec.e_sp_small),
+                    lambda: run(pspec.e_sp),
                 )
 
             new = jax.lax.cond(use_dense, dense_branch, sparse_branch)
