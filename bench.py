@@ -170,7 +170,9 @@ def worker_main():
         # wedges this worker the orchestrator still harvests the banked
         # lines from the output file.
         methods = (
-            ["scatter", "cumsum", "pallas"] if on_tpu else ["scan", "scatter"]
+            ["scatter", "cumsum", "mxsum", "pallas"]
+            if on_tpu
+            else ["scan", "scatter"]
         )
         risky_tail = ["scan"] if on_tpu else []
     else:

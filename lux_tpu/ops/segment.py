@@ -14,6 +14,12 @@ atomics):
   * ``cumsum``  — plain cumsum + gather-diff at row boundaries (sum only).
                   Cheapest, but the global prefix magnitude costs float32
                   precision on large graphs.
+  * ``mxsum``   — cumsum computed as blocked lower-triangular MATMULS
+                  (the tensor-core scan construction of arXiv:1811.09736)
+                  + the same gather-diff (sum only).  Rides the MXU: one
+                  (B,T)x(T,T) contraction + a recursive block-offset scan
+                  instead of a log-depth elementwise ladder.  Same global-
+                  prefix precision caveat as ``cumsum``.
   * ``scatter`` — `segment_sum/min/max` with sorted ids (XLA scatter).
 
 All take static-shape padded inputs from lux_tpu.graph.shards.
@@ -47,6 +53,36 @@ def _ends_gather(scanned, row_ptr, neutral):
     return jnp.where(nonempty, scanned[safe], neutral)
 
 
+MX_BLOCK = 512  # triangular-matmul tile for the mxsum cumsum
+
+
+def matmul_cumsum(x: jnp.ndarray, block: int = MX_BLOCK) -> jnp.ndarray:
+    """Inclusive 1-D cumsum as blocked triangular matmuls (MXU-friendly;
+    arXiv:1811.09736 construction): per-block prefix = x2 @ L^T with L
+    lower-triangular ones, block offsets by recursing on the block sums.
+    f32 accumulation throughout."""
+    n = x.shape[0]
+    if n == 0:
+        return x
+    pad = (-n) % block
+    xp = jnp.pad(x, (0, pad))
+    nb = xp.shape[0] // block
+    x2 = xp.reshape(nb, block)
+    tri = jnp.tril(jnp.ones((block, block), jnp.float32))
+    intra = jax.lax.dot_general(
+        x2.astype(jnp.float32), tri,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (nb, block): intra[b, i] = sum_{j<=i} x2[b, j]
+    tots = intra[:, -1]
+    if nb > block:
+        incl = matmul_cumsum(tots, block)
+    else:
+        incl = jnp.cumsum(tots)
+    offs = incl - tots  # exclusive block offsets
+    return (intra + offs[:, None]).reshape(-1)[:n].astype(x.dtype)
+
+
 def segment_sum_csc(
     vals: jnp.ndarray,
     row_ptr: jnp.ndarray,
@@ -61,8 +97,11 @@ def segment_sum_csc(
             flag = head_flag[:, None]
         scanned = _segmented_scan(vals, jnp.broadcast_to(flag, vals.shape), jnp.add)
         return _ends_gather(scanned, row_ptr, jnp.zeros((), vals.dtype))
-    if method == "cumsum":
-        c = jnp.cumsum(vals, axis=0)
+    if method in ("cumsum", "mxsum"):
+        if method == "mxsum" and vals.ndim == 1:
+            c = matmul_cumsum(vals)
+        else:
+            c = jnp.cumsum(vals, axis=0)
         zero = jnp.zeros((1,) + vals.shape[1:], vals.dtype)
         c = jnp.concatenate([zero, c], axis=0)
         return c[row_ptr[1:]] - c[row_ptr[:-1]]

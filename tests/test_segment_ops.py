@@ -134,3 +134,47 @@ def test_segment_reduce_by_ends_full_bucket():
         reduce="sum", method="scan",
     )
     np.testing.assert_allclose(np.asarray(got), [3, 12, 0, 6, 15])
+
+
+def test_mxsum_matches_cumsum():
+    import numpy as np
+    import jax.numpy as jnp
+    from lux_tpu.ops.segment import matmul_cumsum, segment_sum_csc
+    rng = np.random.default_rng(11)
+    for n in (1, 7, 512, 513, 5000, 300_000):
+        x = jnp.asarray(rng.random(n, np.float32))
+        got = np.asarray(matmul_cumsum(x))
+        want = np.cumsum(np.asarray(x, np.float64))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-4)
+
+
+def test_mxsum_segment_matches_scan():
+    import numpy as np
+    import jax.numpy as jnp
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.ops import segment
+    g = generate.rmat(9, 8, seed=13)
+    sh = build_pull_shards(g, 1)
+    a = sh.arrays
+    rng = np.random.default_rng(3)
+    vals = jnp.asarray(rng.random(a.src_pos.shape[1], np.float32))
+    rp = jnp.asarray(a.row_ptr[0])
+    hf = jnp.asarray(a.head_flag[0])
+    dl = jnp.asarray(a.dst_local[0])
+    want = np.asarray(segment.segment_sum_csc(vals, rp, hf, dl, method="scan"))
+    got = np.asarray(segment.segment_sum_csc(vals, rp, hf, dl, method="mxsum"))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_pagerank_mxsum_method():
+    import numpy as np
+    from lux_tpu.graph import generate
+    from lux_tpu.models import pagerank as pr
+    g = generate.rmat(8, 8, seed=15)
+    base = pr.pagerank(g, num_iters=5, method="scan")
+    got = pr.pagerank(g, num_iters=5, method="mxsum")
+    np.testing.assert_allclose(
+        np.asarray(got, np.float64), np.asarray(base, np.float64),
+        rtol=1e-4, atol=1e-7,
+    )

@@ -117,6 +117,12 @@ def main(argv=None):
                                       method="cumsum")
         return acc * 0.999
 
+    def c_mxsum(x):
+        vals = vals_fixed * x[0]
+        acc = segment.segment_sum_csc(vals, row_ptr, head_flag, dst_local,
+                                      method="mxsum")
+        return acc * 0.999
+
     npad = bc.num_vblocks * bc.v_blk
 
     def c_pallas(x):
@@ -150,6 +156,7 @@ def main(argv=None):
         "gather": c_gather,
         "scatter": c_scatter,
         "cumsum": c_cumsum,
+        "mxsum": c_mxsum,
         "pallas": c_pallas,
         "pallas+g": c_pallas_g,
         "scan": c_scan,
