@@ -57,6 +57,11 @@ def make_mesh_if(cfg: RunConfig):
 def validate_exchange(cfg: RunConfig, prog) -> None:
     """Reject incompatible --exchange combinations BEFORE the O(ne) shard
     build, with a CLI-level message (not a deep driver assert)."""
+    if cfg.method in ("cumsum", "mxsum") and prog.reduce != "sum":
+        raise SystemExit(
+            f"--method {cfg.method} is a prefix-diff strategy: sum-reduce "
+            f"programs only (this app reduces with {prog.reduce})"
+        )
     if cfg.edge_shards > 1:
         if not cfg.distributed:
             raise SystemExit("--edge-shards requires --distributed")
@@ -73,20 +78,20 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "--edge-shards (2-D mesh) has its own exchange; it cannot "
                 "combine with --exchange ring/scatter"
             )
-        if cfg.method == "cumsum":
+        if cfg.method in ("cumsum", "mxsum"):
             raise SystemExit(
                 "--edge-shards supports --method scan or scatter "
-                "(edge chunks carry no row_ptr for cumsum)"
+                "(edge chunks carry no row_ptr for prefix-diff reduces)"
             )
         return
     if cfg.exchange == "allgather":
         return
     if not cfg.distributed:
         raise SystemExit(f"--exchange {cfg.exchange} requires --distributed")
-    if cfg.method == "cumsum":
+    if cfg.method in ("cumsum", "mxsum"):
         raise SystemExit(
             "--exchange ring/scatter supports --method scan or scatter "
-            "(bucketed reductions carry no row_ptr for cumsum)"
+            "(bucketed reductions carry no row_ptr for prefix-diff reduces)"
         )
     if cfg.exchange == "scatter" and (
         prog.reduce != "sum" or getattr(prog, "needs_dst_state", False)

@@ -32,6 +32,11 @@ def build_push_app_shards(g, cfg):
 
 def run_convergence_app(prog, shards, cfg, name: str):
     """Shared driver for frontier apps (SSSP + CC)."""
+    if cfg.method in ("cumsum", "mxsum"):
+        raise SystemExit(
+            f"--method {cfg.method} is a prefix-diff strategy: sum-reduce "
+            f"programs only (this app reduces with {prog.reduce})"
+        )
     if cfg.ckpt_every or cfg.ckpt_dir:
         # honest gating beats silent ignoring: the frontier carry (queues +
         # counts) is not serialized; fixed-iteration apps own checkpointing
