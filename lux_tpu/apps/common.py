@@ -62,6 +62,17 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             f"--method {cfg.method} is a prefix-diff strategy: sum-reduce "
             f"programs only (this app reduces with {prog.reduce})"
         )
+    if cfg.method == "pallas":
+        if prog.reduce != "sum" or getattr(prog, "needs_dst_state", False):
+            raise SystemExit(
+                "--method pallas supports sum-reduce programs without "
+                "destination-state edge terms (pagerank); CF keeps its "
+                "dedicated 2-D kernel, min/max apps use scan/scatter"
+            )
+        if cfg.exchange != "allgather" or cfg.edge_shards > 1:
+            raise SystemExit(
+                "--method pallas runs on the allgather exchange, 1-D mesh"
+            )
     if cfg.edge_shards > 1:
         if not cfg.distributed:
             raise SystemExit("--edge-shards requires --distributed")

@@ -18,11 +18,67 @@ from lux_tpu.utils.config import parse_args
 from lux_tpu.utils.timing import Timer, report_elapsed
 
 
+def _run_pallas(cfg, g, prog):
+    """--method pallas: block-CSR one-hot MXU reduce (single-chip runner or
+    the distributed pallas_dist engine).  Interpret mode off-TPU so CPU
+    smoke runs work; Mosaic on hardware."""
+    import numpy as np
+
+    if cfg.verbose or cfg.ckpt_every or cfg.ckpt_dir:
+        raise SystemExit(
+            "--method pallas: -verbose/checkpointing are not wired to the "
+            "kernel path; use --method scan/scatter for those"
+        )
+    interp = jax.devices()[0].platform not in ("tpu", "axon")
+    from lux_tpu.utils import profiling
+
+    with profiling.trace(cfg.profile_dir):
+        if cfg.distributed:
+            from lux_tpu.parallel import pallas_dist as pd
+
+            pp = pd.build_pallas_parts(g, cfg.num_parts)
+            est = preflight.estimate_pallas_pull(
+                pp.arrays.e_src_pos.shape[1], pp.t_chunk, pp.spec.nv_pad,
+                pp.spec.gathered_size, pp.spec.weighted,
+                2 if cfg.dtype == "bfloat16" else 4,
+            )
+            print(est)
+            preflight.check_fits(est)
+            mesh = common.make_mesh_if(cfg)
+            s0 = pd.init_state_pallas(prog, pp)
+            # timer starts AFTER the host-side block-CSR build, like main()
+            # starts it after the shard build — GTEPS measures iterations
+            timer = Timer()
+            out = pd.run_pull_fixed_pallas_dist(
+                prog, pp, s0, cfg.num_iters, mesh, interpret=interp
+            )
+            elapsed = timer.stop(out)
+            ranks = pp.scatter_to_global(jax.device_get(out))
+        else:
+            if cfg.num_parts != 1:
+                raise SystemExit(
+                    "--method pallas single-device runs one part (-ng 1); "
+                    "use --distributed for multi-part"
+                )
+            from lux_tpu.models.pagerank import make_pallas_runner
+
+            run, s0 = make_pallas_runner(g, interpret=interp, dtype=cfg.dtype)
+            timer = Timer()
+            out = run(s0, cfg.num_iters)
+            elapsed = timer.stop(out)
+            ranks = np.asarray(jax.device_get(out))[: g.nv]
+    report_elapsed(elapsed, g.ne, cfg.num_iters)
+    common.top_k("rank (pre-divided)", ranks)
+    return 0
+
+
 def main(argv=None):
     cfg = parse_args(argv, description=__doc__, pull=True)
     g = common.load_graph(cfg)
     prog = PageRankProgram(nv=g.nv, dtype=cfg.dtype)
     common.validate_exchange(cfg, prog)
+    if cfg.method == "pallas":
+        return _run_pallas(cfg, g, prog)
     shards = common.build_exchange_shards(g, cfg)
     est = common.estimate_exchange(shards, cfg)
     print(est)

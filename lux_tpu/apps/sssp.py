@@ -37,6 +37,11 @@ def run_convergence_app(prog, shards, cfg, name: str):
             f"--method {cfg.method} is a prefix-diff strategy: sum-reduce "
             f"programs only (this app reduces with {prog.reduce})"
         )
+    if cfg.method == "pallas":
+        raise SystemExit(
+            "--method pallas is wired to the pull engine (pagerank); "
+            "frontier apps use scan/scatter"
+        )
     if cfg.ckpt_every or cfg.ckpt_dir:
         # honest gating beats silent ignoring: the frontier carry (queues +
         # counts) is not serialized; fixed-iteration apps own checkpointing

@@ -103,12 +103,7 @@ class PullShards:
     def scatter_to_global(self, stacked: np.ndarray) -> np.ndarray:
         """Collapse a (P, nv_pad, ...) stacked state back to (nv, ...) global
         order, dropping padding."""
-        P = self.spec.num_parts
-        out = []
-        for p in range(P):
-            n = int(self.cuts[p + 1] - self.cuts[p])
-            out.append(np.asarray(stacked[p])[:n])
-        return np.concatenate(out, axis=0)
+        return stacked_to_global(self.cuts, stacked)
 
     def global_to_stacked(self, full: np.ndarray) -> np.ndarray:
         """Split a (nv, ...) global state into (P, nv_pad, ...) padded stacks.
@@ -119,6 +114,16 @@ class PullShards:
             lo, hi = int(self.cuts[p]), int(self.cuts[p + 1])
             out[p, : hi - lo] = full[lo:hi]
         return out
+
+
+def stacked_to_global(cuts: np.ndarray, stacked: np.ndarray) -> np.ndarray:
+    """De-pad a (P, nv_pad, ...) stacked state into (nv, ...) global order
+    under ``cuts`` (shared by every engine's shard bundle)."""
+    out = []
+    for p in range(cuts.shape[0] - 1):
+        n = int(cuts[p + 1] - cuts[p])
+        out.append(np.asarray(stacked[p])[:n])
+    return np.concatenate(out, axis=0)
 
 
 def shard_geometry(row_ptr_global: np.ndarray, num_parts: int, nv: int,

@@ -63,7 +63,7 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
     ap.add_argument("-check", "-c", action="store_true")
     ap.add_argument("--max-iters", type=int, default=10_000)
     ap.add_argument("--method", default="scan",
-                    choices=["scan", "cumsum", "mxsum", "scatter"])
+                    choices=["scan", "cumsum", "mxsum", "scatter", "pallas"])
     ap.add_argument("--distributed", action="store_true",
                     help="shard parts over the device mesh")
     ap.add_argument("--rmat-scale", type=int, default=16)

@@ -139,3 +139,16 @@ def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None) -> bool:
         )
         return False
     return True
+
+
+def estimate_pallas_pull(num_chunks: int, t_chunk: int, nv_pad: int,
+                         gathered_size: int, weighted: bool = False,
+                         state_dtype_bytes: int = 4) -> MemoryEstimate:
+    """Per-chip footprint of the distributed Pallas pull (block-CSR chunk
+    arrays instead of the CSC shard layout)."""
+    ct = num_chunks * t_chunk
+    shard = 4 * ct * 2 + (4 * ct if weighted else 0) + 4 * num_chunks * 2
+    shard += 4 * nv_pad * 2 + nv_pad  # degree, global_vid, vtx_mask
+    state = 2 * nv_pad * state_dtype_bytes
+    gathered = gathered_size * state_dtype_bytes + 4 * ct  # + edge values
+    return MemoryEstimate(shard, state, gathered, shard + state + gathered)
