@@ -18,11 +18,37 @@ from lux_tpu.utils.config import parse_args
 from lux_tpu.utils.timing import Timer, report_elapsed
 
 
+def _run_pallas(cfg, g):
+    """--method pallas: the fused 2-D MXU kernel (err·srcVec accumulation
+    as (V_BLK,T)x(T,K) matmuls, colfilter_gpu.cu:85-101's role)."""
+    import numpy as np
+
+    if cfg.verbose or cfg.ckpt_every or cfg.ckpt_dir:
+        raise SystemExit(
+            "--method pallas: -verbose/checkpointing are not wired to the "
+            "kernel path; use --method scan/scatter for those"
+        )
+    interp = jax.devices()[0].platform not in ("tpu", "axon")
+    from lux_tpu.utils import profiling
+
+    with profiling.trace(cfg.profile_dir):
+        run, s0 = cf_model.make_pallas_runner(g, interpret=interp)
+        timer = Timer()
+        out = run(s0, cfg.num_iters)
+        elapsed = timer.stop(out)
+    report_elapsed(elapsed, g.ne, cfg.num_iters)
+    v = np.asarray(jax.device_get(out))[: g.nv].astype("float32")
+    print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
+    return 0
+
+
 def main(argv=None):
     cfg = parse_args(argv, description=__doc__, pull=True)
     g = common.load_graph(cfg, weighted=True, bipartite=True)
     prog = cf_model.CFProgram(dtype=cfg.dtype)
     common.validate_exchange(cfg, prog)
+    if cfg.method == "pallas":
+        return _run_pallas(cfg, g)
     shards = common.build_exchange_shards(g, cfg)
     est = common.estimate_exchange(shards, cfg, state_width=cf_model.K)
     print(est)

@@ -63,11 +63,16 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             f"programs only (this app reduces with {prog.reduce})"
         )
     if cfg.method == "pallas":
-        if prog.reduce != "sum" or getattr(prog, "needs_dst_state", False):
+        if prog.reduce != "sum":
             raise SystemExit(
-                "--method pallas supports sum-reduce programs without "
-                "destination-state edge terms (pagerank); CF keeps its "
-                "dedicated 2-D kernel, min/max apps use scan/scatter"
+                "--method pallas: sum-reduce programs only; min/max apps "
+                "use scan/scatter"
+            )
+        if getattr(prog, "needs_dst_state", False) and cfg.distributed:
+            raise SystemExit(
+                "--method pallas --distributed supports programs without "
+                "destination-state edge terms; CF's 2-D kernel runs "
+                "single-chip (drop --distributed)"
             )
         if cfg.exchange != "allgather" or cfg.edge_shards > 1:
             raise SystemExit(
