@@ -32,12 +32,33 @@ def _run_pallas(cfg, g):
     from lux_tpu.utils import profiling
 
     with profiling.trace(cfg.profile_dir):
-        run, s0 = cf_model.make_pallas_runner(g, interpret=interp)
-        timer = Timer()
-        out = run(s0, cfg.num_iters)
-        elapsed = timer.stop(out)
+        if cfg.distributed:
+            from lux_tpu.parallel import pallas_dist as pd
+
+            prog = cf_model.CFProgram(dtype=cfg.dtype)
+            pp = pd.build_pallas_parts(g, cfg.num_parts)
+            est = preflight.estimate_pallas_pull(
+                pp.arrays.e_src_pos.shape[1], pp.t_chunk, pp.spec.nv_pad,
+                pp.spec.gathered_size * cf_model.K, True,
+                2 if cfg.dtype == "bfloat16" else 4,
+            )
+            print(est)
+            preflight.check_fits(est)
+            mesh = common.make_mesh_if(cfg)
+            s0 = pd.init_state_pallas(prog, pp)
+            timer = Timer()
+            out = pd.run_cf_pallas_dist(
+                prog, pp, s0, cfg.num_iters, mesh, interpret=interp
+            )
+            elapsed = timer.stop(out)
+            v = pp.scatter_to_global(jax.device_get(out)).astype("float32")
+        else:
+            run, s0 = cf_model.make_pallas_runner(g, interpret=interp)
+            timer = Timer()
+            out = run(s0, cfg.num_iters)
+            elapsed = timer.stop(out)
+            v = np.asarray(jax.device_get(out))[: g.nv].astype("float32")
     report_elapsed(elapsed, g.ne, cfg.num_iters)
-    v = np.asarray(jax.device_get(out))[: g.nv].astype("float32")
     print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
     return 0
 

@@ -68,12 +68,6 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "--method pallas: sum-reduce programs only; min/max apps "
                 "use scan/scatter"
             )
-        if getattr(prog, "needs_dst_state", False) and cfg.distributed:
-            raise SystemExit(
-                "--method pallas --distributed supports programs without "
-                "destination-state edge terms; CF's 2-D kernel runs "
-                "single-chip (drop --distributed)"
-            )
         if cfg.exchange != "allgather" or cfg.edge_shards > 1:
             raise SystemExit(
                 "--method pallas runs on the allgather exchange, 1-D mesh"

@@ -61,7 +61,9 @@ class CFProgram:
         src = src_state.astype(jnp.float32)
         dst = dst_state.astype(jnp.float32)
         err = weight - jnp.sum(src * dst, axis=-1)
-        return err[:, None] * src
+        # [..., None]: edge values arrive as (E, K) from the CSC engines or
+        # (C, T, K) chunk tiles from the distributed Pallas path
+        return err[..., None] * src
 
     def apply(self, old_local, acc, arrays: ShardArrays):
         old = old_local.astype(jnp.float32)

@@ -227,3 +227,70 @@ def run_pull_fixed_pallas_dist(
         prog, mesh, num_iters, pp.num_vblocks, pp.v_blk, pp.spec.nv_pad,
         interpret, compute_dtype,
     )(arrays, state0)
+
+
+@lru_cache(maxsize=64)
+def _compile_fixed_pallas_2d(prog, mesh, num_iters: int, num_vblocks: int,
+                             v_blk: int, nv_pad: int, interpret: bool):
+    arr_specs = PallasArrays(*([P(PARTS_AXIS)] * len(PallasArrays._fields)))
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(arr_specs, P(PARTS_AXIS)),
+        out_specs=P(PARTS_AXIS),
+        check_vma=False,  # pallas out_shape carries no vma (see above)
+    )
+    def run(arr_blk, state_blk):
+        arr = jax.tree.map(lambda a: a[0], arr_blk)
+        # per-edge destination within THIS part's padded row: dsts are
+        # always local in the pull layout, so the error term's dst vector
+        # gathers from the resident slice, never the exchanged buffer
+        dst_local = jnp.clip(
+            arr.chunk_block[:, None] * v_blk + arr.e_dst_rel, 0, nv_pad - 1
+        )
+
+        def body(_, local):
+            full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
+            src_vec = full[arr.e_src_pos]  # (C, T, K)
+            dst_vec = local[dst_local]
+            vals = prog.edge_value(src_vec, arr.e_weight, dst_vec)
+            acc = ps.spmv_blockcsr_2d(
+                vals, arr.e_dst_rel, arr.chunk_block, arr.chunk_first,
+                v_blk=v_blk, num_vblocks=num_vblocks, interpret=interpret,
+            )[:nv_pad]
+            return prog.apply(local, acc, arr)
+
+        out = jax.lax.fori_loop(0, num_iters, body, state_blk[0])
+        return out[None]
+
+    return run
+
+
+def run_cf_pallas_dist(
+    prog,
+    pp: PallasParts,
+    state0: jnp.ndarray,
+    num_iters: int,
+    mesh: Mesh,
+    interpret: bool = False,
+):
+    """Distributed CF on the 2-D Pallas kernel: the err·srcVec
+    accumulation is a (V_BLK, T) x (T, K) MXU matmul per chunk
+    (colfilter_gpu.cu:85-101's role), with the (V, K) latent state
+    sharded over the mesh and all-gathered per iteration."""
+    if prog.reduce != "sum" or not getattr(prog, "needs_dst_state", False):
+        raise ValueError(
+            "pallas 2-D distributed pull is the CF shape: sum-reduce with "
+            "a destination-state edge term"
+        )
+    if not pp.spec.weighted:
+        raise ValueError("CF requires a weighted graph")
+    assert pp.spec.num_parts == mesh.devices.size
+    arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, pp.arrays))
+    state0 = shard_stacked(mesh, state0)
+    return _compile_fixed_pallas_2d(
+        prog, mesh, num_iters, pp.num_vblocks, pp.v_blk, pp.spec.nv_pad,
+        interpret,
+    )(arrays, state0)

@@ -61,3 +61,21 @@ def test_pallas_dist_rejects_min_programs():
         pd.run_pull_fixed_pallas_dist(
             MaxLabelProgram(), pp, None, 1, make_mesh(2)
         )
+
+
+def test_cf_pallas_dist_matches_scan():
+    from lux_tpu.graph import generate as gen
+    from lux_tpu.models import colfilter as cf
+
+    gw = gen.bipartite_ratings(128, 128, 2048, seed=31)
+    base = cf.colfilter(gw, num_iters=4, num_parts=2)
+
+    pp = pd.build_pallas_parts(gw, 2, v_blk=128, t_chunk=128)
+    prog = cf.CFProgram()
+    s0 = pd.init_state_pallas(prog, pp)
+    out = pd.run_cf_pallas_dist(prog, pp, s0, 4, make_mesh(2), interpret=True)
+    got = pp.scatter_to_global(np.asarray(out))
+    np.testing.assert_allclose(
+        got.astype(np.float64), np.asarray(base, np.float64),
+        rtol=2e-4, atol=1e-6,
+    )
