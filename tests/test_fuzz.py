@@ -67,3 +67,27 @@ def test_fuzz_push_ring_vs_allgather(seed):
     assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
     got = prs.scatter_to_global(np.asarray(a))
     np.testing.assert_array_equal(got, sssp.bfs_reference(g, start))
+
+
+@pytest.mark.parametrize("seed", SEEDS[:3])
+def test_fuzz_pallas_dist_pagerank(seed):
+    """Randomized: the distributed Pallas engine agrees with the oracle
+    across graph shapes / part counts / tile sizes (interpret mode)."""
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.parallel import pallas_dist as pd
+    from lux_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(seed + 3000)
+    scale = int(rng.integers(6, 9))
+    ef = int(rng.integers(2, 10))
+    parts = int(rng.choice([2, 4]))
+    v_blk = int(rng.choice([128, 256]))
+    g = generate.rmat(scale, ef, seed=seed)
+    pp = pd.build_pallas_parts(g, parts, v_blk=v_blk, t_chunk=128)
+    prog = PageRankProgram(nv=pp.spec.nv)
+    s0 = pd.init_state_pallas(prog, pp)
+    out = pd.run_pull_fixed_pallas_dist(
+        prog, pp, s0, 4, make_mesh(parts), interpret=True
+    )
+    got = pp.scatter_to_global(np.asarray(out))
+    np.testing.assert_allclose(got, pr.pagerank_reference(g, 4), rtol=5e-5)
