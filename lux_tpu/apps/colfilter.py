@@ -53,7 +53,9 @@ def _run_pallas(cfg, g):
             elapsed = timer.stop(out)
             v = pp.scatter_to_global(jax.device_get(out)).astype("float32")
         else:
-            run, s0 = cf_model.make_pallas_runner(g, interpret=interp)
+            run, s0 = cf_model.make_pallas_runner(
+                g, interpret=interp, dtype=cfg.dtype
+            )
             timer = Timer()
             out = run(s0, cfg.num_iters)
             elapsed = timer.stop(out)

@@ -107,7 +107,8 @@ def colfilter(
 
 def make_pallas_runner(g: HostGraph, k: int = K, lam: float = LAMBDA,
                        gamma: float = GAMMA, interpret: bool = False,
-                       v_blk: int | None = None, t_chunk: int | None = None):
+                       v_blk: int | None = None, t_chunk: int | None = None,
+                       dtype: str = "float32"):
     """Single-chip CF on the fused 2-D Pallas kernel: the err·srcVec
     accumulation becomes a (V_BLK, T) x (T, K) MXU matmul per chunk.
     Returns (run(state, num_iters), state0)."""
@@ -140,19 +141,24 @@ def make_pallas_runner(g: HostGraph, k: int = K, lam: float = LAMBDA,
     @functools.partial(jax.jit, static_argnames="num_iters")
     def run(state, num_iters):
         def body(_, s):
-            src_vec = s[e_src]  # (C, T, K)
-            dst_vec = s[dst_global]
+            # state stored in `dtype` (bf16 halves the (V,K) HBM footprint,
+            # SURVEY.md §7.3's memory case); error math + reduce stay f32
+            src_vec = s[e_src].astype(jnp.float32)  # (C, T, K)
+            dst_vec = s[dst_global].astype(jnp.float32)
             err = w - jnp.sum(src_vec * dst_vec, axis=-1)  # (C, T)
             vals = err[..., None] * src_vec
             acc = ps.spmv_blockcsr_2d(
                 vals, e_dst, cb, cf, v_blk=bc.v_blk,
                 num_vblocks=bc.num_vblocks, interpret=interpret,
             )
-            return s + jnp.float32(gamma) * (acc - jnp.float32(lam) * s)
+            new = s.astype(jnp.float32) + jnp.float32(gamma) * (
+                acc - jnp.float32(lam) * s.astype(jnp.float32)
+            )
+            return new.astype(dtype)
 
         return jax.lax.fori_loop(0, num_iters, body, state)
 
-    return run, jnp.asarray(state0)
+    return run, jnp.asarray(state0).astype(dtype)
 
 
 def colfilter_pallas(g: HostGraph, num_iters: int = 10, interpret: bool = False,
