@@ -83,40 +83,48 @@ def build_blockcsr(
         src_pos = g.col_idx.astype(np.int32)
     dst = g.dst_of_edges()
     num_vblocks = _round_up(g.nv, v_blk) // v_blk
-    chunks_per_block = np.empty(num_vblocks, np.int64)
-    spans = []
-    for b in range(num_vblocks):
-        lo = int(g.row_ptr[b * v_blk])
-        hi = int(g.row_ptr[min((b + 1) * v_blk, g.nv)])
-        spans.append((lo, hi))
-        chunks_per_block[b] = max(1, -(-(hi - lo) // t_chunk))
+    ne = int(g.row_ptr[-1])
+
+    # fully vectorized host build (a per-chunk Python loop is O(ne/T)
+    # iterations — hours at RMAT27 scale): every edge's chunk and slot are
+    # computed array-wise, then placed with one flat scatter per array.
+    block_lo = np.asarray(
+        g.row_ptr[np.minimum(np.arange(num_vblocks) * v_blk, g.nv)],
+        np.int64,
+    )
+    block_hi = np.asarray(
+        g.row_ptr[np.minimum((np.arange(num_vblocks) + 1) * v_blk, g.nv)],
+        np.int64,
+    )
+    chunks_per_block = np.maximum(1, -(-(block_hi - block_lo) // t_chunk))
     num_chunks = int(chunks_per_block.sum())
+    chunk_start = np.zeros(num_vblocks + 1, np.int64)
+    np.cumsum(chunks_per_block, out=chunk_start[1:])
+
+    # per-edge block (edges are CSC-ordered, blocks are contiguous spans)
+    e_block = np.repeat(
+        np.arange(num_vblocks, dtype=np.int64), block_hi - block_lo
+    )
+    within = np.arange(ne, dtype=np.int64) - block_lo[e_block]
+    e_chunk = chunk_start[e_block] + within // t_chunk
+    e_slot = within % t_chunk
+    flat = e_chunk * t_chunk + e_slot
 
     e_src_pos = np.zeros((num_chunks, t_chunk), np.int32)
     e_dst_rel = np.full((num_chunks, t_chunk), v_blk, np.int32)
-    e_weight = (
-        np.zeros((num_chunks, t_chunk), np.float32)
-        if g.weights is not None
-        else None
+    e_src_pos.reshape(-1)[flat] = src_pos[:ne]
+    e_dst_rel.reshape(-1)[flat] = (
+        dst[:ne].astype(np.int64) - e_block * v_blk
+    ).astype(np.int32)
+    e_weight = None
+    if g.weights is not None:
+        e_weight = np.zeros((num_chunks, t_chunk), np.float32)
+        e_weight.reshape(-1)[flat] = g.weights[:ne]
+    chunk_block = np.repeat(
+        np.arange(num_vblocks, dtype=np.int32), chunks_per_block
     )
-    chunk_block = np.empty(num_chunks, np.int32)
     chunk_first = np.zeros(num_chunks, np.int32)
-    c = 0
-    for b in range(num_vblocks):
-        lo, hi = spans[b]
-        chunk_first[c] = 1
-        for k in range(int(chunks_per_block[b])):
-            chunk_block[c] = b
-            e0 = lo + k * t_chunk
-            e1 = min(e0 + t_chunk, hi)
-            n = e1 - e0
-            if n > 0:
-                e_src_pos[c, :n] = src_pos[e0:e1]
-                e_dst_rel[c, :n] = dst[e0:e1] - b * v_blk
-                if e_weight is not None:
-                    e_weight[c, :n] = g.weights[e0:e1]
-            c += 1
-    assert c == num_chunks
+    chunk_first[chunk_start[:-1]] = 1
     return BlockCSR(
         nv=g.nv,
         num_vblocks=num_vblocks,
