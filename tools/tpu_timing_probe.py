@@ -44,7 +44,7 @@ def main(argv=None):
     if args.method == "pallas":
         from lux_tpu.models.pagerank import make_pallas_runner
 
-        prun, ps0 = make_pallas_runner(g, dtype="float32")
+        prun, ps0 = make_pallas_runner(g, dtype="float32", dynamic_iters=True)
 
         def run(n):
             return prun(ps0, n)
