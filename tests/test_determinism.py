@@ -53,3 +53,26 @@ def test_cf_bitwise_deterministic():
     a = cf.colfilter(g, num_iters=8, gamma=1e-3)
     b = cf.colfilter(g, num_iters=8, gamma=1e-3)
     assert bits(a) == bits(b)
+
+def test_pallas_dist_bitwise_deterministic():
+    """The distributed Pallas engines rerun bitwise-identically (the MXU
+    one-hot reduce has a fixed accumulation order, like every other
+    engine — no atomics anywhere)."""
+    from lux_tpu.parallel import pallas_dist as pd
+
+    g = generate.rmat(8, 8, seed=104)
+    mesh = mesh_lib.make_mesh(4)
+    pp = pd.build_pallas_parts(g, 4, v_blk=128, t_chunk=128)
+    prog = pr.PageRankProgram(nv=pp.spec.nv)
+    s0 = pd.init_state_pallas(prog, pp)
+    a = pd.run_pull_fixed_pallas_dist(prog, pp, s0, 5, mesh, interpret=True)
+    b = pd.run_pull_fixed_pallas_dist(prog, pp, s0, 5, mesh, interpret=True)
+    assert bits(a) == bits(b)
+
+    gw = generate.bipartite_ratings(64, 64, 800, seed=105)
+    ppw = pd.build_pallas_parts(gw, 4, v_blk=128, t_chunk=128)
+    cprog = cf.CFProgram()
+    cs0 = pd.init_state_pallas(cprog, ppw)
+    ca = pd.run_cf_pallas_dist(cprog, ppw, cs0, 5, mesh, interpret=True)
+    cb = pd.run_cf_pallas_dist(cprog, ppw, cs0, 5, mesh, interpret=True)
+    assert bits(ca) == bits(cb)
