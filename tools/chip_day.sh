@@ -1,0 +1,45 @@
+#!/bin/bash
+# Full chip-session measurement battery, in dependency order, each step
+# logged separately and continuing on failure.  Run when the axon tunnel
+# is up (a quick probe gate aborts early if it is not).  See
+# docs/NOTES_ROUND2.md "First things when a chip IS reachable".
+#
+# Usage: bash tools/chip_day.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/lux_chip_day_$(date +%H%M)}
+mkdir -p "$LOG"
+echo "logs -> $LOG"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) timeout ${to}s"
+  timeout "$to" "$@" > "$LOG/$name.out" 2> "$LOG/$name.err"
+  local rc=$?
+  echo "    rc=$rc; tail:"; tail -3 "$LOG/$name.out" | sed 's/^/    /'
+  return $rc
+}
+
+# 0) gate: per-component probe doubles as the tunnel check (small scale
+#    first so a dead tunnel costs one claim wait, not a full battery)
+run probe_components 5400 python tools/tpu_component_probe.py \
+    --scale 20 --ef 16 --reps 1 4 16 || {
+  grep -q "GTEPS-equiv" "$LOG/probe_components.out" || {
+    echo "tunnel dead (no component rows) — aborting battery"; exit 1; }
+}
+
+# 1) Mosaic compile check + tile sweep (VERDICT r1 #3)
+run pallas_sweep 5400 python tools/tpu_pallas_check.py --scale 18 --sweep
+
+# 2) the driver-format bench race (scatter/cumsum/mxsum/pallas + bf16,
+#    scan quarantined last; partial results harvested either way)
+LUX_BENCH_WATCHDOG_S=3600 LUX_BENCH_TPU_S=3300 \
+  run bench_race 3700 python bench.py
+
+# 3) single-chip HBM ceiling vs preflight (VERDICT r1 #7)
+run scale_check 5400 python tools/tpu_scale_check.py --min-scale 18 --max-scale 24
+
+# 4) four-app table
+run bench_all 3600 python tools/bench_all.py --scale 18 --iters 10
+
+echo "battery done ($(date +%H:%M:%S)); fold results into BASELINE.md"
