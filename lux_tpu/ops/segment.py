@@ -210,14 +210,17 @@ def segment_reduce_by_ends(
         [head_flag[1:], jnp.ones((1,), head_flag.dtype)]
     )
     # non-end slots are redirected to num_segments and dropped, so only one
-    # value per segment lands in the output (sum stays exact)
+    # value per segment lands in the output (sum stays exact).  The end
+    # scatter is widened like every other scatter path: a bf16 .at[].op
+    # hits the same serialized TPU emulation (_scatter_dtype).
     idx = jnp.where(is_end, dst_local, num_segments)
-    out = jnp.full((num_segments,) + vals.shape[1:], neutral, vals.dtype)
+    scanned_w = _scatter_dtype(scanned)
+    out = jnp.full((num_segments,) + vals.shape[1:], neutral, scanned_w.dtype)
     if reduce == "sum":
-        return out.at[idx].add(scanned, mode="drop")
+        return out.at[idx].add(scanned_w, mode="drop").astype(vals.dtype)
     if reduce == "min":
-        return out.at[idx].min(scanned, mode="drop")
-    return out.at[idx].max(scanned, mode="drop")
+        return out.at[idx].min(scanned_w, mode="drop").astype(vals.dtype)
+    return out.at[idx].max(scanned_w, mode="drop").astype(vals.dtype)
 
 
 def reducers():
