@@ -178,3 +178,17 @@ def test_pagerank_mxsum_method():
         np.asarray(got, np.float64), np.asarray(base, np.float64),
         rtol=1e-4, atol=1e-7,
     )
+
+
+def test_pagerank_mxsum_multipart():
+    """mxsum under vmap (multi-part single device)."""
+    import numpy as np
+    from lux_tpu.graph import generate
+    from lux_tpu.models import pagerank as pr
+    g = generate.rmat(8, 8, seed=15)
+    base = pr.pagerank(g, num_iters=5, method="scan", num_parts=3)
+    got = pr.pagerank(g, num_iters=5, method="mxsum", num_parts=3)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float64), np.asarray(base, np.float64),
+        rtol=1e-4, atol=1e-7,
+    )
