@@ -21,7 +21,9 @@ def main(argv=None):
     g = common.load_graph(cfg)
     shards = build_push_app_shards(g, cfg)
     prog = cc_model.MaxLabelProgram()
-    labels, state = run_convergence_app(prog, shards, cfg, "components")
+    labels, state, shards = run_convergence_app(
+        prog, shards, cfg, "components", g=g
+    )
     n_comp = len(np.unique(labels))
     print(f"{n_comp} distinct labels")
     if cfg.check:

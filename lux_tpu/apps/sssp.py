@@ -30,8 +30,11 @@ def build_push_app_shards(g, cfg):
     return build_push_shards(g, cfg.num_parts)
 
 
-def run_convergence_app(prog, shards, cfg, name: str):
-    """Shared driver for frontier apps (SSSP + CC)."""
+def run_convergence_app(prog, shards, cfg, name: str, g=None):
+    """Shared driver for frontier apps (SSSP + CC).  Returns
+    (global_state, stacked_device_state, effective_shards) — the shard
+    layout can change mid-run under --repartition-every, so validation
+    must use the returned layout, not the one passed in."""
     if cfg.method in ("cumsum", "mxsum"):
         raise SystemExit(
             f"--method {cfg.method} is a prefix-diff strategy: sum-reduce "
@@ -49,6 +52,19 @@ def run_convergence_app(prog, shards, cfg, name: str):
             "checkpoint/resume is supported for the fixed-iteration apps "
             "(pagerank, colfilter); convergence apps restart from scratch"
         )
+    if cfg.repartition_every:
+        if cfg.repartition_every < 0:
+            raise SystemExit("--repartition-every must be positive")
+        if cfg.exchange != "allgather":
+            raise SystemExit(
+                "--repartition-every rebuilds the allgather-exchange "
+                "layout; it cannot combine with --exchange ring"
+            )
+        if cfg.verbose:
+            raise SystemExit(
+                "--repartition-every runs the engine in windows; the "
+                "per-iteration -verbose fence is not available"
+            )
     if cfg.exchange == "ring":
         est = preflight.estimate_push_ring(
             shards.spec, shards.pspec, shards.e_bucket_pad
@@ -63,7 +79,27 @@ def run_convergence_app(prog, shards, cfg, name: str):
 
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
-        if cfg.verbose and mesh is None:
+        if cfg.repartition_every:
+            from lux_tpu.engine import repartition
+
+            def note(it, old_cuts, new_cuts, work):
+                moved = int(np.abs(new_cuts - old_cuts).max())
+                print(
+                    f"iter {it}: repartition (imbalance "
+                    f"{repartition.imbalance(work):.2f}, max boundary "
+                    f"move {moved} vertices)"
+                )
+
+            res = repartition.run_push_adaptive(
+                prog, g, cfg.num_parts, chunk=cfg.repartition_every,
+                threshold=cfg.repartition_threshold,
+                max_iters=cfg.max_iters, method=cfg.method, mesh=mesh,
+                on_repartition=note, shards=shards,
+            )
+            state, iters, edges = res.stacked, res.iters, res.edges
+            shards = res.shards
+            print(f"{res.reparts} repartition(s)")
+        elif cfg.verbose and mesh is None:
             arrays, parrays, carry = push.push_init(prog, shards)
             load, comp, update = push.compile_push_phases(
                 prog, shards.pspec, shards.spec, cfg.method
@@ -120,7 +156,7 @@ def run_convergence_app(prog, shards, cfg, name: str):
     report_elapsed(elapsed, shards.spec.ne, iters, traversed=push.edges_total(edges))
     # return the stacked device state too: distributed -check validates it
     # on device (CHECK_TASK_ID analog) without a host gather
-    return shards.scatter_to_global(np.asarray(state)), state
+    return shards.scatter_to_global(np.asarray(state)), state, shards
 
 
 def main(argv=None):
@@ -140,7 +176,9 @@ def main(argv=None):
         else sssp_model.SSSPProgram
     )
     prog = cls(nv=shards.spec.nv, start=cfg.start)
-    dist_result, state = run_convergence_app(prog, shards, cfg, "sssp")
+    dist_result, state, shards = run_convergence_app(
+        prog, shards, cfg, "sssp", g=g
+    )
     reached = int(np.sum(dist_result < prog.inf))
     print(f"reached {reached}/{g.nv} vertices from {cfg.start}")
     if cfg.check:

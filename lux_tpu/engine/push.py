@@ -154,6 +154,14 @@ class PushCarry(NamedTuple):
     #: (x64 is disabled under jit and float32 absorbs increments past 2^24;
     #: the reference's per-iteration traversal accounting, SURVEY.md §6)
     edges: Any
+    #: per-part sparse-round walked out-edge totals since the last driver
+    #: checkpoint, float32 (P,) — a load ESTIMATE for the repartition
+    #: policy (engine/repartition.py), not an exact counter like `edges`.
+    #: Dense-round work is `dense_rounds * static part edge count`, kept
+    #: out of the carry (the host derives it from the cuts).
+    sp_work: Any
+    #: dense rounds since the last driver checkpoint, int32 scalar.
+    dense_rounds: Any
 
 
 def _acc_edges(edges, dense_ne: int, sparse_total, use_dense):
@@ -195,9 +203,10 @@ def _init_carry(prog, pspec, arrays):
     q_vid, q_val, cnt = jax.vmap(partial(build_queue, pspec))(
         arrays, mask0, state0
     )
+    num_parts = arrays.global_vid.shape[0]
     return PushCarry(
         state0, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1),
-        _zero_edges(),
+        _zero_edges(), jnp.zeros((num_parts,), jnp.float32), jnp.int32(0),
     )
 
 
@@ -280,7 +289,14 @@ def _push_requeue(prog, pspec: PushSpec, spec: ShardSpec, arrays,
     # traversal accounting (SURVEY.md §6): dense walks every real edge,
     # sparse walks the frontier's out-edges (the preps totals)
     edges = _acc_edges(c.edges, spec.ne, preps[3].sum(), use_dense)
-    return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active, edges)
+    sp_work = c.sp_work + jnp.where(
+        use_dense, 0.0, preps[3].astype(jnp.float32)
+    )
+    dense_rounds = c.dense_rounds + use_dense.astype(jnp.int32)
+    return PushCarry(
+        new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
+        dense_rounds,
+    )
 
 
 def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
@@ -295,14 +311,15 @@ def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
 
 
 @lru_cache(maxsize=64)
-def _compile_push_single(prog, pspec: PushSpec, spec: ShardSpec,
-                         max_iters: int, method: str):
-    """Build (once per config) the jitted single-device push loop."""
+def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec, method: str):
+    """Single-device push loop with a DYNAMIC iteration stop (one compile
+    serves every run length and every adaptive-repartition window; the
+    driver inspects the carry's load stats between windows)."""
 
     @jax.jit
-    def loop(arrays, parrays, carry: PushCarry):
+    def loop(arrays, parrays, carry: PushCarry, it_stop):
         def cond(c):
-            return (c.active > 0) & (c.it < max_iters)
+            return (c.active > 0) & (c.it < it_stop)
 
         def body(c):
             return _push_iteration(prog, pspec, spec, method, arrays, parrays, c)
@@ -382,32 +399,41 @@ def run_push(
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     parrays = jax.tree.map(jnp.asarray, shards.parrays)
     carry0 = _init_carry(prog, pspec, arrays)
-    loop = _compile_push_single(prog, pspec, spec, max_iters, method)
-    out = loop(arrays, parrays, carry0)
+    loop = compile_push_chunk(prog, pspec, spec, method)
+    out = loop(arrays, parrays, carry0, jnp.int32(max_iters))
     return out.state, out.it, out.edges
+
+
+def _carry_specs():
+    """shard_map PartitionSpecs for the stacked PushCarry: state/queues/
+    count/sp_work live on the parts axis; it/active/edges/dense_rounds are
+    replicated scalars (psum'd or identical on every device)."""
+    return PushCarry(
+        *([P(PARTS_AXIS)] * 4), P(), P(), P(), P(PARTS_AXIS), P()
+    )
 
 
 @lru_cache(maxsize=64)
 def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
-                       max_iters: int, method: str):
+                       method: str):
     arr_specs = ShardArrays(*([P(PARTS_AXIS)] * len(ShardArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
-    carry_specs = PushCarry(*([P(PARTS_AXIS)] * 4), P(), P(), P())
+    carry_specs = _carry_specs()
 
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(arr_specs, parr_specs, carry_specs),
-        out_specs=(P(PARTS_AXIS), P(), P()),
+        in_specs=(arr_specs, parr_specs, carry_specs, P()),
+        out_specs=carry_specs,
     )
-    def run(arr_blk, parr_blk, carry_blk):
+    def run(arr_blk, parr_blk, carry_blk, it_stop):
         arr = jax.tree.map(lambda a: a[0], arr_blk)
         parr = jax.tree.map(lambda a: a[0], parr_blk)
         V = spec.nv_pad
 
         def cond(c):
-            return (c.active > 0) & (c.it < max_iters)
+            return (c.active > 0) & (c.it < it_stop)
 
         def body(c):
             local = c.state
@@ -467,15 +493,26 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
             # by sum_p e_sp_p ≈ ne/4 < 2^32 (bigger frontiers force dense)
             g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
             edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
-            return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active, edges)
+            sp_work = c.sp_work + jnp.where(
+                use_dense, 0.0, total.astype(jnp.float32)
+            )
+            dense_rounds = c.dense_rounds + use_dense.astype(jnp.int32)
+            return PushCarry(
+                new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
+                dense_rounds,
+            )
 
         c0 = PushCarry(
             carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
             carry_blk.count[0], carry_blk.it, carry_blk.active,
-            carry_blk.edges,
+            carry_blk.edges, carry_blk.sp_work[0], carry_blk.dense_rounds,
         )
         out = jax.lax.while_loop(cond, body, c0)
-        return out.state[None], out.it, out.edges
+        return PushCarry(
+            out.state[None], out.q_vid[None], out.q_val[None],
+            out.count[None], out.it, out.active, out.edges,
+            out.sp_work[None], out.dense_rounds,
+        )
 
     return run
 
@@ -490,7 +527,7 @@ def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     compile_push_step); the host reads carry.active between steps."""
     arr_specs = ShardArrays(*([P(PARTS_AXIS)] * len(ShardArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
-    carry_specs = PushCarry(*([P(PARTS_AXIS)] * 4), P(), P(), P())
+    carry_specs = _carry_specs()
 
     @partial(jax.jit, donate_argnums=2)
     @partial(
@@ -506,7 +543,7 @@ def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         c = PushCarry(
             carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
             carry_blk.count[0], carry_blk.it, carry_blk.active,
-            carry_blk.edges,
+            carry_blk.edges, carry_blk.sp_work[0], carry_blk.dense_rounds,
         )
         local = c.state
         q_vids_all = jax.lax.all_gather(c.q_vid, PARTS_AXIS, tiled=True)
@@ -557,9 +594,13 @@ def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         active = jax.lax.psum(cnt, PARTS_AXIS)
         g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
         edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
+        sp_work = c.sp_work + jnp.where(
+            use_dense, 0.0, total.astype(jnp.float32)
+        )
+        dense_rounds = c.dense_rounds + use_dense.astype(jnp.int32)
         return PushCarry(
             new[None], q_vid[None], q_val[None], cnt[None], c.it + 1,
-            active, edges,
+            active, edges, sp_work[None], dense_rounds,
         )
 
     return step
@@ -571,11 +612,18 @@ def push_init_dist(prog, shards: PushShards, mesh: Mesh):
     arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.arrays))
     parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
     carry0 = _init_carry(prog, shards.pspec, jax.tree.map(jnp.asarray, shards.arrays))
-    carry0 = PushCarry(
-        *shard_stacked(mesh, tuple(carry0[:4])), carry0.it, carry0.active,
-        carry0.edges,
+    return arrays, parrays, shard_carry(mesh, carry0)
+
+
+def shard_carry(mesh: Mesh, c: PushCarry) -> PushCarry:
+    """Place a host/stacked PushCarry onto the mesh (parts-axis fields
+    sharded, scalars replicated)."""
+    sharded = shard_stacked(
+        mesh, (c.state, c.q_vid, c.q_val, c.count, c.sp_work)
     )
-    return arrays, parrays, carry0
+    return PushCarry(
+        *sharded[:4], c.it, c.active, c.edges, sharded[4], c.dense_rounds
+    )
 
 
 @lru_cache(maxsize=64)
@@ -594,7 +642,7 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     rarr_specs = RingArrays(*([P(PARTS_AXIS)] * len(RingArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
     view_specs = VertexView(*([P(PARTS_AXIS)] * len(VertexView._fields)))
-    carry_specs = PushCarry(*([P(PARTS_AXIS)] * 4), P(), P(), P())
+    carry_specs = _carry_specs()
 
     @jax.jit
     @partial(
@@ -682,12 +730,19 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
             active = jax.lax.psum(cnt, PARTS_AXIS)
             g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
             edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
-            return PushCarry(new, q_vid, q_val, cnt, c.it + 1, active, edges)
+            sp_work = c.sp_work + jnp.where(
+                use_dense, 0.0, total.astype(jnp.float32)
+            )
+            dense_rounds = c.dense_rounds + use_dense.astype(jnp.int32)
+            return PushCarry(
+                new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
+                dense_rounds,
+            )
 
         c0 = PushCarry(
             carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
             carry_blk.count[0], carry_blk.it, carry_blk.active,
-            carry_blk.edges,
+            carry_blk.edges, carry_blk.sp_work[0], carry_blk.dense_rounds,
         )
         out = jax.lax.while_loop(cond, body, c0)
         return out.state[None], out.it, out.edges
@@ -714,10 +769,8 @@ def run_push_ring(
     parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
     view_host = vertex_view(shards.arrays)
     view = shard_stacked(mesh, jax.tree.map(jnp.asarray, view_host))
-    carry0 = _init_carry(prog, pspec, jax.tree.map(jnp.asarray, view_host))
-    carry0 = PushCarry(
-        *shard_stacked(mesh, tuple(carry0[:4])), carry0.it, carry0.active,
-        carry0.edges,
+    carry0 = shard_carry(
+        mesh, _init_carry(prog, pspec, jax.tree.map(jnp.asarray, view_host))
     )
     run = _compile_push_ring(
         prog, mesh, pspec, spec, shards.e_bucket_pad, max_iters, method
@@ -737,5 +790,6 @@ def run_push_dist(
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts == mesh.devices.size
     arrays, parrays, carry0 = push_init_dist(prog, shards, mesh)
-    run = _compile_push_dist(prog, mesh, pspec, spec, max_iters, method)
-    return run(arrays, parrays, carry0)
+    run = _compile_push_dist(prog, mesh, pspec, spec, method)
+    out = run(arrays, parrays, carry0, jnp.int32(max_iters))
+    return out.state, out.it, out.edges

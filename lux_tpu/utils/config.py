@@ -42,6 +42,12 @@ class RunConfig:
     #: >1 = 2-D (parts x edge) mesh: each part's edges split over this many
     #: chips, partial reductions psum'd (for parts too big for one chip)
     edge_shards: int = 1
+    #: >0 = adaptive dynamic repartitioning (push apps): every N iterations
+    #: rebalance the vertex cuts from the measured per-part load (the Lux
+    #: paper's runtime repartitioning, absent from the reference code)
+    repartition_every: int = 0
+    #: recut when the window's max/mean per-part load exceeds this
+    repartition_threshold: float = 1.25
 
 
 def parse_args(argv=None, description: str = "", sssp: bool = False,
@@ -89,6 +95,12 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],
                         help="dense-round state-exchange strategy")
+        ap.add_argument("--repartition-every", type=int, default=0,
+                        help="rebalance vertex cuts from measured per-part "
+                             "load every N iterations (0 = static cuts)")
+        ap.add_argument("--repartition-threshold", type=float, default=1.25,
+                        help="recut when the window's max/mean per-part "
+                             "load exceeds this ratio")
     if sssp:
         ap.add_argument("--weighted", action="store_true",
                         help="relax with edge weights (Dijkstra-style)")
@@ -115,4 +127,6 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         weighted=getattr(ns, "weighted", False),
         dtype=getattr(ns, "dtype", "float32"),
         edge_shards=getattr(ns, "edge_shards", 1),
+        repartition_every=getattr(ns, "repartition_every", 0),
+        repartition_threshold=getattr(ns, "repartition_threshold", 1.25),
     )

@@ -248,3 +248,35 @@ def test_pagerank_cli_distributed_verbose_with_ckpt(tmp_path, capsys):
     import os
 
     assert sorted(os.listdir(d)) == ["ckpt_2.npz", "ckpt_4.npz"]
+
+
+def test_sssp_cli_repartition(capsys):
+    """--repartition-every with a tight threshold: at least one recut
+    actually fires end-to-end, and the result still validates (-check)."""
+    # scale 10: the SMALL graph's BFS from 0 dies after one hop, leaving
+    # no window for the policy to act on
+    args = ["--rmat-scale", "10", "--rmat-ef", "8", "-ng", "4",
+            "-start", "0", "-check", "--repartition-every", "2",
+            "--repartition-threshold", "1.01"]
+    assert sssp_app.main(args) == 0
+    out = capsys.readouterr().out
+    assert "[PASS]" in out
+    n_line = [ln for ln in out.splitlines() if "repartition(s)" in ln][0]
+    assert int(n_line.split()[0]) >= 1, out
+    assert "iter " in out and "imbalance" in out
+
+
+def test_cc_cli_repartition_distributed(capsys):
+    args = SMALL + ["-ng", "8", "--distributed", "-check",
+                    "--repartition-every", "2"]
+    assert cc_app.main(args) == 0
+    out = capsys.readouterr().out
+    assert "repartition(s)" in out and "[PASS]" in out
+
+
+def test_repartition_flag_rejections(capsys):
+    with pytest.raises(SystemExit):
+        sssp_app.main(SMALL + ["--repartition-every", "2", "-verbose"])
+    with pytest.raises(SystemExit):
+        sssp_app.main(SMALL + ["-ng", "8", "--distributed", "--exchange",
+                               "ring", "--repartition-every", "2"])

@@ -79,3 +79,109 @@ def test_custom_cuts_push_same_result():
     shards = build_push_shards(g, 3, cuts=weighted_cuts(w, 3))
     custom = ss.sssp(shards, start=0)
     np.testing.assert_array_equal(np.asarray(base), np.asarray(custom))
+
+
+# ---------------------------------------------------------------------------
+# Adaptive driver (the POLICY on top of the mechanism): the engine's carry
+# accumulates per-part load (sp_work/dense_rounds); run_push_adaptive recuts
+# between windows and remaps the in-flight state + frontier.
+
+from lux_tpu.engine import push, repartition
+from lux_tpu.parallel.mesh import make_mesh
+
+
+def _static_global(prog, g, num_parts, mesh=None):
+    shards = build_push_shards(g, num_parts)
+    if mesh is None:
+        st, _, e = push.run_push(prog, shards)
+    else:
+        st, _, e = push.run_push_dist(prog, shards, mesh)
+    return shards.scatter_to_global(np.asarray(st)), e
+
+
+def test_adaptive_sssp_matches_static():
+    g = generate.rmat(11, 8, seed=3)
+    prog = ss.SSSPProgram(nv=g.nv, start=0)
+    ref, _ = _static_global(prog, g, 4)
+    events = []
+    res = repartition.run_push_adaptive(
+        prog, g, 4, chunk=2, threshold=1.01,
+        on_repartition=lambda it, oc, nc, w: events.append((it, oc, nc)),
+    )
+    np.testing.assert_array_equal(res.state, ref)
+    # the tight threshold + sparse BFS tail must actually trigger recuts
+    assert res.reparts >= 1 and res.reparts == len(events)
+    for _, old_cuts, new_cuts in events:
+        assert not np.array_equal(old_cuts, new_cuts)
+        assert np.all(np.diff(new_cuts) >= 0)
+        assert new_cuts[0] == 0 and new_cuts[-1] == g.nv
+
+
+def test_adaptive_distributed_matches_static():
+    g = generate.rmat(11, 8, seed=5)
+    prog = ss.SSSPProgram(nv=g.nv, start=0)
+    mesh = make_mesh(8)
+    ref, _ = _static_global(prog, g, 8, mesh)
+    res = repartition.run_push_adaptive(
+        prog, g, 8, chunk=2, threshold=1.01, mesh=mesh
+    )
+    np.testing.assert_array_equal(res.state, ref)
+    assert res.iters > 0
+
+
+def test_adaptive_cc_overflow_defers_then_matches():
+    """CC starts with EVERY vertex in the frontier — counts far beyond
+    f_cap at the first window boundary, exercising the truncated-queue
+    deferral path — and must still reach the static fixpoint."""
+    from lux_tpu.models.components import MaxLabelProgram
+
+    g = generate.rmat(10, 8, seed=9)
+    prog = MaxLabelProgram()
+    ref, _ = _static_global(prog, g, 4)
+    res = repartition.run_push_adaptive(prog, g, 4, chunk=1, threshold=1.0)
+    np.testing.assert_array_equal(res.state, ref)
+
+
+def test_adaptive_rerun_deterministic():
+    g = generate.rmat(10, 8, seed=11)
+    prog = ss.SSSPProgram(nv=g.nv, start=2)
+    a = repartition.run_push_adaptive(prog, g, 4, chunk=2, threshold=1.05)
+    b = repartition.run_push_adaptive(prog, g, 4, chunk=2, threshold=1.05)
+    np.testing.assert_array_equal(a.state, b.state)
+    assert a.reparts == b.reparts and a.iters == b.iters
+    assert push.edges_total(a.edges) == push.edges_total(b.edges)
+
+
+def test_part_work_and_weights():
+    row_ptr = np.array([0, 4, 6, 6, 10], np.int64)  # nv=4
+    cuts = np.array([0, 2, 4], np.int64)  # 2 parts: edges [6, 4]
+    work = repartition.part_work(
+        np.array([10.0, 0.0], np.float32), 2, cuts, row_ptr
+    )
+    np.testing.assert_allclose(work, [10.0 + 2 * 6, 2 * 4])
+    assert repartition.imbalance(np.array([1.0, 1.0])) == 1.0
+    assert repartition.imbalance(np.array([3.0, 1.0])) == 1.5
+    w = repartition.vertex_weights(work, cuts, row_ptr)
+    assert w.shape == (4,) and np.all(w > 0)
+    # part 0 is hotter per edge -> its vertices weigh more per unit degree
+    assert w[0] / 4 > w[3] / 4
+
+
+def test_sparse_work_accumulates_in_carry():
+    """Window stats: sparse rounds add per-part walked totals; dense
+    rounds bump the round counter."""
+    import jax
+
+    g = generate.rmat(9, 6, seed=13)
+    shards = build_push_shards(g, 4)
+    prog = ss.SSSPProgram(nv=g.nv, start=0)
+    arrays, parrays, carry = push.push_init(prog, shards)
+    loop = push.compile_push_chunk(prog, shards.pspec, shards.spec, "scan")
+    out = loop(arrays, parrays, carry, jnp.int32(1000))
+    sp = np.asarray(out.sp_work)
+    dr = int(out.dense_rounds)
+    assert sp.shape == (4,) and np.all(sp >= 0)
+    assert 0 <= dr <= int(out.it)
+    # a BFS from a single source must have at least one sparse round, and
+    # its walked totals land in sp_work
+    assert sp.sum() > 0
