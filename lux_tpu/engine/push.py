@@ -184,6 +184,26 @@ def _zero_edges():
     return jnp.zeros((2,), jnp.uint32)
 
 
+def _acc_load(c: "PushCarry", total, use_dense):
+    """Window load stats for the repartition policy: sparse rounds add the
+    walked out-edge totals (per part, or this part's scalar in the SPMD
+    bodies); dense rounds bump the shared round counter."""
+    sp_work = c.sp_work + jnp.where(
+        use_dense, 0.0, jnp.asarray(total, jnp.float32)
+    )
+    return sp_work, c.dense_rounds + use_dense.astype(jnp.int32)
+
+
+def _carry_local(carry_blk: "PushCarry") -> "PushCarry":
+    """Drop the leading parts axis from a shard_map carry block (each
+    device sees its own (1, ...) slice of the sharded fields)."""
+    return PushCarry(
+        carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
+        carry_blk.count[0], carry_blk.it, carry_blk.active,
+        carry_blk.edges, carry_blk.sp_work[0], carry_blk.dense_rounds,
+    )
+
+
 def edges_total(edges) -> int:
     """Exact Python int from the device-side [hi, lo] accumulator."""
     import numpy as np
@@ -289,10 +309,7 @@ def _push_requeue(prog, pspec: PushSpec, spec: ShardSpec, arrays,
     # traversal accounting (SURVEY.md §6): dense walks every real edge,
     # sparse walks the frontier's out-edges (the preps totals)
     edges = _acc_edges(c.edges, spec.ne, preps[3].sum(), use_dense)
-    sp_work = c.sp_work + jnp.where(
-        use_dense, 0.0, preps[3].astype(jnp.float32)
-    )
-    dense_rounds = c.dense_rounds + use_dense.astype(jnp.int32)
+    sp_work, dense_rounds = _acc_load(c, preps[3], use_dense)
     return PushCarry(
         new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
         dense_rounds,
@@ -493,21 +510,13 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
             # by sum_p e_sp_p ≈ ne/4 < 2^32 (bigger frontiers force dense)
             g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
             edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
-            sp_work = c.sp_work + jnp.where(
-                use_dense, 0.0, total.astype(jnp.float32)
-            )
-            dense_rounds = c.dense_rounds + use_dense.astype(jnp.int32)
+            sp_work, dense_rounds = _acc_load(c, total, use_dense)
             return PushCarry(
                 new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
                 dense_rounds,
             )
 
-        c0 = PushCarry(
-            carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
-            carry_blk.count[0], carry_blk.it, carry_blk.active,
-            carry_blk.edges, carry_blk.sp_work[0], carry_blk.dense_rounds,
-        )
-        out = jax.lax.while_loop(cond, body, c0)
+        out = jax.lax.while_loop(cond, body, _carry_local(carry_blk))
         return PushCarry(
             out.state[None], out.q_vid[None], out.q_val[None],
             out.count[None], out.it, out.active, out.edges,
@@ -540,11 +549,7 @@ def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         arr = jax.tree.map(lambda a: a[0], arr_blk)
         parr = jax.tree.map(lambda a: a[0], parr_blk)
         V = spec.nv_pad
-        c = PushCarry(
-            carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
-            carry_blk.count[0], carry_blk.it, carry_blk.active,
-            carry_blk.edges, carry_blk.sp_work[0], carry_blk.dense_rounds,
-        )
+        c = _carry_local(carry_blk)
         local = c.state
         q_vids_all = jax.lax.all_gather(c.q_vid, PARTS_AXIS, tiled=True)
         q_vals_all = jax.lax.all_gather(c.q_val, PARTS_AXIS, tiled=True)
@@ -594,10 +599,7 @@ def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         active = jax.lax.psum(cnt, PARTS_AXIS)
         g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
         edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
-        sp_work = c.sp_work + jnp.where(
-            use_dense, 0.0, total.astype(jnp.float32)
-        )
-        dense_rounds = c.dense_rounds + use_dense.astype(jnp.int32)
+        sp_work, dense_rounds = _acc_load(c, total, use_dense)
         return PushCarry(
             new[None], q_vid[None], q_val[None], cnt[None], c.it + 1,
             active, edges, sp_work[None], dense_rounds,
@@ -730,21 +732,13 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
             active = jax.lax.psum(cnt, PARTS_AXIS)
             g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
             edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
-            sp_work = c.sp_work + jnp.where(
-                use_dense, 0.0, total.astype(jnp.float32)
-            )
-            dense_rounds = c.dense_rounds + use_dense.astype(jnp.int32)
+            sp_work, dense_rounds = _acc_load(c, total, use_dense)
             return PushCarry(
                 new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
                 dense_rounds,
             )
 
-        c0 = PushCarry(
-            carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
-            carry_blk.count[0], carry_blk.it, carry_blk.active,
-            carry_blk.edges, carry_blk.sp_work[0], carry_blk.dense_rounds,
-        )
-        out = jax.lax.while_loop(cond, body, c0)
+        out = jax.lax.while_loop(cond, body, _carry_local(carry_blk))
         return out.state[None], out.it, out.edges
 
     return run

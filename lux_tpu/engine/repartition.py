@@ -97,8 +97,8 @@ def vertex_weights(work: np.ndarray, cuts: np.ndarray,
 
 def _changed_mask_from_queues(q_vid: np.ndarray, counts: np.ndarray,
                               f_cap: int, nv: int) -> np.ndarray:
-    """Global changed-vertex mask from the per-part (vid, value) queues.
-    Caller guarantees counts <= f_cap (no truncation)."""
+    """Global changed-vertex mask from the per-part (vid, value) queues."""
+    assert counts.max() <= f_cap, "truncated queue: frontier unrecoverable"
     mask = np.zeros(nv, dtype=bool)
     for p in range(q_vid.shape[0]):
         n = int(counts[p])

@@ -97,10 +97,13 @@ def connected_components_push(
     mesh=None,
     method: str = "scan",
     exchange: str = "allgather",
+    repartition_every: int = 0,
+    repartition_threshold: float = 1.25,
 ) -> np.ndarray:
     """CC on the frontier/push engine (direction-optimizing; what the
     reference app actually runs).  ``g``: HostGraph or pre-built shards;
-    ``exchange="ring"`` (with a mesh) streams dense rounds."""
+    ``exchange="ring"`` (with a mesh) streams dense rounds;
+    ``repartition_every > 0`` enables adaptive dynamic repartitioning."""
     from lux_tpu.graph.push_shards import PushShards, build_push_shards
     from lux_tpu.models.sssp import _push_run
     from lux_tpu.parallel.ring import PushRingShards
@@ -110,7 +113,10 @@ def connected_components_push(
         else build_push_shards(g, num_parts)
     )
     prog = MaxLabelProgram()
-    return _push_run(prog, g, shards, mesh, max_iters, method, exchange, num_parts)
+    return _push_run(
+        prog, g, shards, mesh, max_iters, method, exchange, num_parts,
+        repartition_every, repartition_threshold,
+    )
 
 
 def check_labels(g: HostGraph, labels: np.ndarray) -> int:

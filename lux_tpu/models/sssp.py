@@ -75,11 +75,33 @@ class WeightedSSSPProgram(SSSPProgram):
 
 
 def _push_run(prog, g, shards, mesh, max_iters, method, exchange,
-              num_parts):
+              num_parts, repartition_every=0, repartition_threshold=1.25):
     """Shared dispatch for the frontier-model wrappers: single-device,
-    all_gather-distributed, or ring-dense distributed."""
+    all_gather-distributed, or ring-dense distributed; a positive
+    ``repartition_every`` selects the adaptive dynamic-repartitioning
+    driver (allgather exchange, needs the HostGraph for rebuilds)."""
     from lux_tpu.parallel.ring import PushRingShards, build_push_ring_shards
 
+    if repartition_every > 0:
+        if exchange != "allgather":
+            raise ValueError(
+                "repartition_every rebuilds the allgather-exchange layout; "
+                "it cannot combine with exchange='ring'"
+            )
+        if not isinstance(g, HostGraph):
+            raise ValueError(
+                "repartition_every needs the HostGraph (shard rebuilds)"
+            )
+        from lux_tpu.engine import repartition
+
+        if isinstance(shards, PushRingShards):
+            shards = shards.push
+        res = repartition.run_push_adaptive(
+            prog, g, shards.spec.num_parts, chunk=repartition_every,
+            threshold=repartition_threshold, max_iters=max_iters,
+            method=method, mesh=mesh, shards=shards,
+        )
+        return res.state
     if mesh is None:
         if isinstance(shards, PushRingShards):
             shards = shards.push  # ring buckets are a distributed layout
@@ -114,10 +136,14 @@ def sssp(
     weighted: bool = False,
     method: str = "scan",
     exchange: str = "allgather",
+    repartition_every: int = 0,
+    repartition_threshold: float = 1.25,
 ) -> np.ndarray:
     """Run SSSP from ``start``; returns (nv,) int32 distances, nv == INF.
     ``exchange="ring"`` (with a mesh) streams dense rounds instead of
-    all-gathering the state."""
+    all-gathering the state.  ``repartition_every > 0`` rebalances the
+    vertex cuts from measured per-part load every N iterations (the Lux
+    paper's dynamic repartitioning; engine/repartition.py)."""
     from lux_tpu.parallel.ring import PushRingShards
 
     shards = (
@@ -138,7 +164,10 @@ def sssp(
             )
     cls = WeightedSSSPProgram if weighted else SSSPProgram
     prog = cls(nv=shards.spec.nv, start=start)
-    return _push_run(prog, g, shards, mesh, max_iters, method, exchange, num_parts)
+    return _push_run(
+        prog, g, shards, mesh, max_iters, method, exchange, num_parts,
+        repartition_every, repartition_threshold,
+    )
 
 
 def inf_value(nv: int, weighted: bool = False) -> int:

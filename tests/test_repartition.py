@@ -185,3 +185,23 @@ def test_sparse_work_accumulates_in_carry():
     # a BFS from a single source must have at least one sparse round, and
     # its walked totals land in sp_work
     assert sp.sum() > 0
+
+
+def test_library_wrappers_adaptive():
+    """sssp()/connected_components_push() expose the policy."""
+    from lux_tpu.models.components import connected_components_push
+
+    g = generate.rmat(10, 8, seed=4)
+    base = ss.sssp(g, start=0, num_parts=4)
+    adapt = ss.sssp(
+        g, start=0, num_parts=4, repartition_every=2,
+        repartition_threshold=1.01,
+    )
+    np.testing.assert_array_equal(base, adapt)
+    base_cc = connected_components_push(g, num_parts=4)
+    adapt_cc = connected_components_push(
+        g, num_parts=4, repartition_every=2, repartition_threshold=1.01
+    )
+    np.testing.assert_array_equal(base_cc, adapt_cc)
+    with pytest.raises(ValueError):
+        ss.sssp(g, start=0, repartition_every=2, exchange="ring")
