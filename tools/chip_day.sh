@@ -20,6 +20,15 @@ run() {  # run <name> <timeout_s> <cmd...>
   return $rc
 }
 
+# -1) fast relay gate: the axon remote_compile endpoint is a local HTTP
+#     server (127.0.0.1:8083).  Connection-refused = relay down — a plain
+#     TCP connect detects that in milliseconds, where a jax probe burns
+#     its whole timeout in C-level claim retries (observed: 59 min).
+if ! timeout 3 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8083' 2>/dev/null; then
+  echo "relay down (127.0.0.1:8083 refused) — aborting battery"; exit 1
+fi
+echo "relay gate: 8083 accepts"
+
 # 0) gate: per-component probe doubles as the tunnel check (small scale
 #    first so a dead tunnel costs one claim wait, not a full battery)
 run probe_components 5400 python tools/tpu_component_probe.py \
