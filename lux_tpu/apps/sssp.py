@@ -55,11 +55,6 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
     if cfg.repartition_every:
         if cfg.repartition_every < 0:
             raise SystemExit("--repartition-every must be positive")
-        if cfg.exchange != "allgather":
-            raise SystemExit(
-                "--repartition-every rebuilds the allgather-exchange "
-                "layout; it cannot combine with --exchange ring"
-            )
         if cfg.verbose:
             raise SystemExit(
                 "--repartition-every runs the engine in windows; the "
@@ -94,7 +89,7 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 prog, g, cfg.num_parts, chunk=cfg.repartition_every,
                 threshold=cfg.repartition_threshold,
                 max_iters=cfg.max_iters, method=cfg.method, mesh=mesh,
-                on_repartition=note, shards=shards,
+                on_repartition=note, shards=shards, exchange=cfg.exchange,
             )
             state, iters, edges = res.stacked, res.iters, res.edges
             shards = res.shards

@@ -630,7 +630,7 @@ def shard_carry(mesh: Mesh, c: PushCarry) -> PushCarry:
 
 @lru_cache(maxsize=64)
 def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
-                       e_bucket_pad: int, max_iters: int, method: str):
+                       e_bucket_pad: int, method: str):
     """Direction-optimizing push with the RING dense exchange: sparse
     rounds exchange (vid, value) queues exactly like _compile_push_dist;
     dense rounds fold ppermute-streamed state blocks through the ring
@@ -650,10 +650,10 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(rarr_specs, parr_specs, view_specs, carry_specs),
-        out_specs=(P(PARTS_AXIS), P(), P()),
+        in_specs=(rarr_specs, parr_specs, view_specs, carry_specs, P()),
+        out_specs=carry_specs,
     )
-    def run(rarr_blk, parr_blk, view_blk, carry_blk):
+    def run(rarr_blk, parr_blk, view_blk, carry_blk, it_stop):
         rarr = jax.tree.map(lambda a: a[0], rarr_blk)
         parr = jax.tree.map(lambda a: a[0], parr_blk)
         view = jax.tree.map(lambda a: a[0], view_blk)
@@ -662,7 +662,7 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         op = _op(prog)
 
         def cond(c):
-            return (c.active > 0) & (c.it < max_iters)
+            return (c.active > 0) & (c.it < it_stop)
 
         def body(c):
             local = c.state
@@ -739,9 +739,39 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
             )
 
         out = jax.lax.while_loop(cond, body, _carry_local(carry_blk))
-        return out.state[None], out.it, out.edges
+        return PushCarry(
+            out.state[None], out.q_vid[None], out.q_val[None],
+            out.count[None], out.it, out.active, out.edges,
+            out.sp_work[None], out.dense_rounds,
+        )
 
     return run
+
+
+def place_ring_statics(shards, mesh: Mesh):
+    """Device-place the ring push engine's static arrays: only O(part
+    edges) buckets/CSR and the O(V) vertex view — never the pull layout's
+    O(E) stacked arrays.  Returns (rarrays, parrays, view)."""
+    rarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.rarrays))
+    parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
+    view = shard_stacked(
+        mesh, jax.tree.map(jnp.asarray, vertex_view(shards.arrays))
+    )
+    return rarrays, parrays, view
+
+
+def ring_init_dist(prog, shards, mesh: Mesh):
+    """(rarrays, parrays, view, carry0) sharded tuple for driving the
+    ring push engine."""
+    rarrays, parrays, view = place_ring_statics(shards, mesh)
+    carry0 = shard_carry(
+        mesh,
+        _init_carry(
+            prog, shards.pspec,
+            jax.tree.map(jnp.asarray, vertex_view(shards.arrays)),
+        ),
+    )
+    return rarrays, parrays, view, carry0
 
 
 def run_push_ring(
@@ -759,17 +789,12 @@ def run_push_ring(
     assert method in ("scan", "scatter"), (
         "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
     )
-    rarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.rarrays))
-    parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
-    view_host = vertex_view(shards.arrays)
-    view = shard_stacked(mesh, jax.tree.map(jnp.asarray, view_host))
-    carry0 = shard_carry(
-        mesh, _init_carry(prog, pspec, jax.tree.map(jnp.asarray, view_host))
-    )
+    rarrays, parrays, view, carry0 = ring_init_dist(prog, shards, mesh)
     run = _compile_push_ring(
-        prog, mesh, pspec, spec, shards.e_bucket_pad, max_iters, method
+        prog, mesh, pspec, spec, shards.e_bucket_pad, method
     )
-    return run(rarrays, parrays, view, carry0)
+    out = run(rarrays, parrays, view, carry0, jnp.int32(max_iters))
+    return out.state, out.it, out.edges
 
 
 def run_push_dist(

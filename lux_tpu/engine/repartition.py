@@ -144,6 +144,46 @@ def _reset_window(carry: push.PushCarry) -> push.PushCarry:
     )
 
 
+def _place_statics(prog, shards, mesh, method, exchange):
+    """Device-place a layout's static arrays and fetch the compiled window
+    loop.  Returns (statics, loop) with loop(*statics, carry, it_stop)."""
+    if mesh is None:
+        arrays = jax.tree.map(jnp.asarray, shards.arrays)
+        parrays = jax.tree.map(jnp.asarray, shards.parrays)
+        loop = push.compile_push_chunk(
+            prog, shards.pspec, shards.spec, method
+        )
+        return (arrays, parrays), loop
+    from lux_tpu.parallel.mesh import shard_stacked
+
+    if exchange == "ring":
+        loop = push._compile_push_ring(
+            prog, mesh, shards.pspec, shards.spec, shards.e_bucket_pad,
+            method,
+        )
+        return push.place_ring_statics(shards, mesh), loop
+    arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.arrays))
+    parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
+    loop = push._compile_push_dist(
+        prog, mesh, shards.pspec, shards.spec, method
+    )
+    return (arrays, parrays), loop
+
+
+def _preflight_recut(shards, exchange):
+    """A recut can concentrate edges and grow e_pad/e_sp/buckets past what
+    the startup preflight validated — re-check before allocating."""
+    from lux_tpu.utils import preflight
+
+    if exchange == "ring":
+        est = preflight.estimate_push_ring(
+            shards.spec, shards.pspec, shards.e_bucket_pad
+        )
+    else:
+        est = preflight.estimate_push(shards.spec, shards.pspec)
+    preflight.check_fits(est)
+
+
 def run_push_adaptive(
     prog,
     g: HostGraph,
@@ -155,13 +195,17 @@ def run_push_adaptive(
     mesh=None,
     on_repartition=None,
     shards=None,
+    exchange: str = "allgather",
 ):
     """Direction-optimized push with window-based dynamic repartitioning.
 
     Runs ``chunk`` iterations at a time; between windows, if the measured
     per-part load imbalance (max/mean) exceeds ``threshold``, recuts with
     weighted_cuts and resumes on the rebuilt layout.  ``mesh`` selects the
-    distributed (all-gather exchange) engine; None runs single-device.
+    distributed engine; None runs single-device.  ``exchange`` picks the
+    dense-round strategy: "allgather" (replicated state) or "ring"
+    (ppermute-streamed O(nv/P) blocks — needs a mesh; the composition for
+    graphs that are both big AND skewed).
     ``on_repartition(it, old_cuts, new_cuts, work)`` observes each recut;
     ``shards`` optionally supplies a pre-built initial layout.
 
@@ -172,26 +216,33 @@ def run_push_adaptive(
     """
     if chunk <= 0:
         raise ValueError(f"chunk must be positive, got {chunk}")
+    if exchange not in ("allgather", "ring"):
+        raise ValueError(f"unsupported exchange {exchange!r}")
+    if exchange == "ring" and mesh is None:
+        raise ValueError("exchange='ring' needs a mesh")
+
+    def build(cuts=None):
+        if exchange == "ring":
+            from lux_tpu.parallel.ring import build_push_ring_shards
+
+            return build_push_ring_shards(g, num_parts, cuts=cuts)
+        return build_push_shards(g, num_parts, cuts=cuts)
+
     if shards is None:
-        shards = build_push_shards(g, num_parts)
-    if mesh is None:
-        arrays, parrays, carry = push.push_init(prog, shards)
-    else:
+        shards = build()
+    if mesh is not None:
         assert num_parts == mesh.devices.size
-        arrays, parrays, carry = push.push_init_dist(prog, shards, mesh)
+    statics, loop = _place_statics(prog, shards, mesh, method, exchange)
+    carry = push._init_carry(
+        prog, shards.pspec,
+        jax.tree.map(jnp.asarray, push.vertex_view(shards.arrays)),
+    )
+    if mesh is not None:
+        carry = push.shard_carry(mesh, carry)
     reparts = 0
     while True:
         it_stop = jnp.int32(min(int(carry.it) + chunk, max_iters))
-        if mesh is None:
-            loop = push.compile_push_chunk(
-                prog, shards.pspec, shards.spec, method
-            )
-            carry = loop(arrays, parrays, carry, it_stop)
-        else:
-            loop = push._compile_push_dist(
-                prog, mesh, shards.pspec, shards.spec, method
-            )
-            carry = loop(arrays, parrays, carry, it_stop)
+        carry = loop(*statics, carry, it_stop)
         it, active = int(carry.it), int(carry.active)
         if active == 0 or it >= max_iters:
             break
@@ -220,30 +271,14 @@ def run_push_adaptive(
         )
         if on_repartition is not None:
             on_repartition(it, shards.cuts, new_cuts, work)
-        shards = build_push_shards(g, num_parts, cuts=new_cuts)
-        # a recut can concentrate edges and grow e_pad/e_sp past what the
-        # startup preflight validated — re-check before allocating
-        from lux_tpu.utils import preflight
-
-        preflight.check_fits(
-            preflight.estimate_push(shards.spec, shards.pspec)
-        )
+        shards = build(cuts=new_cuts)
+        _preflight_recut(shards, exchange)
         carry = _rebuild_carry(
             prog, shards, state_g, changed_g, it, np.asarray(carry.edges)
         )
-        if mesh is None:
-            arrays = jax.tree.map(jnp.asarray, shards.arrays)
-            parrays = jax.tree.map(jnp.asarray, shards.parrays)
-        else:
-            from lux_tpu.parallel.mesh import shard_stacked
-
-            arrays = shard_stacked(
-                mesh, jax.tree.map(jnp.asarray, shards.arrays)
-            )
-            parrays = shard_stacked(
-                mesh, jax.tree.map(jnp.asarray, shards.parrays)
-            )
+        if mesh is not None:
             carry = push.shard_carry(mesh, carry)
+        statics, loop = _place_statics(prog, shards, mesh, method, exchange)
         reparts += 1
     state_g = shards.scatter_to_global(np.asarray(carry.state))
     return AdaptiveResult(

@@ -83,23 +83,33 @@ def _push_run(prog, g, shards, mesh, max_iters, method, exchange,
     from lux_tpu.parallel.ring import PushRingShards, build_push_ring_shards
 
     if repartition_every > 0:
-        if exchange != "allgather":
-            raise ValueError(
-                "repartition_every rebuilds the allgather-exchange layout; "
-                "it cannot combine with exchange='ring'"
-            )
         if not isinstance(g, HostGraph):
             raise ValueError(
                 "repartition_every needs the HostGraph (shard rebuilds)"
             )
         from lux_tpu.engine import repartition
 
-        if isinstance(shards, PushRingShards):
-            shards = shards.push
+        if exchange == "ring":
+            if isinstance(shards, PushRingShards):
+                init = shards
+            else:
+                # wrap the already-built push layout with ring buckets on
+                # the SAME partition — no second O(E) push build
+                from lux_tpu.parallel.ring import build_ring_shards
+
+                rs = build_ring_shards(
+                    g, shards.spec.num_parts, pull=shards.pull
+                )
+                init = PushRingShards(
+                    push=shards, rarrays=rs.rarrays,
+                    e_bucket_pad=rs.e_bucket_pad,
+                )
+        else:
+            init = shards.push if isinstance(shards, PushRingShards) else shards
         res = repartition.run_push_adaptive(
             prog, g, shards.spec.num_parts, chunk=repartition_every,
             threshold=repartition_threshold, max_iters=max_iters,
-            method=method, mesh=mesh, shards=shards,
+            method=method, mesh=mesh, shards=init, exchange=exchange,
         )
         return res.state
     if mesh is None:

@@ -303,17 +303,23 @@ class PushRingShards:
     def pull(self):
         return self.push.pull
 
+    @property
+    def cuts(self):
+        return self.push.cuts
+
     def scatter_to_global(self, stacked):
         return self.push.scatter_to_global(stacked)
 
 
 def build_push_ring_shards(
-    g: HostGraph, num_parts: int, parts_subset=None
+    g: HostGraph, num_parts: int, parts_subset=None, cuts=None
 ) -> PushRingShards:
-    """Push shards + ring buckets over the SAME partition (one build)."""
+    """Push shards + ring buckets over the SAME partition (one build).
+    ``cuts`` selects a custom contiguous partition (adaptive
+    repartitioning rebuilds, engine/repartition.py)."""
     from lux_tpu.graph.push_shards import build_push_shards
 
-    push = build_push_shards(g, num_parts)
+    push = build_push_shards(g, num_parts, cuts=cuts)
     rs = build_ring_shards(g, num_parts, parts_subset, pull=push.pull)
     return PushRingShards(push=push, rarrays=rs.rarrays,
                           e_bucket_pad=rs.e_bucket_pad)

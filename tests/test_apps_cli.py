@@ -278,5 +278,15 @@ def test_repartition_flag_rejections(capsys):
     with pytest.raises(SystemExit):
         sssp_app.main(SMALL + ["--repartition-every", "2", "-verbose"])
     with pytest.raises(SystemExit):
-        sssp_app.main(SMALL + ["-ng", "8", "--distributed", "--exchange",
-                               "ring", "--repartition-every", "2"])
+        sssp_app.main(SMALL + ["--repartition-every", "-3"])
+
+
+def test_sssp_cli_repartition_ring(capsys):
+    """Adaptive repartitioning composed with the ring dense exchange —
+    the big-AND-skewed configuration."""
+    args = ["--rmat-scale", "10", "--rmat-ef", "8", "-ng", "8",
+            "--distributed", "--exchange", "ring", "-start", "0", "-check",
+            "--repartition-every", "2", "--repartition-threshold", "1.01"]
+    assert sssp_app.main(args) == 0
+    out = capsys.readouterr().out
+    assert "[PASS]" in out

@@ -205,3 +205,35 @@ def test_library_wrappers_adaptive():
     np.testing.assert_array_equal(base_cc, adapt_cc)
     with pytest.raises(ValueError):
         ss.sssp(g, start=0, repartition_every=2, exchange="ring")
+
+
+def test_adaptive_ring_matches_static():
+    """Ring-exchange adaptive run: recuts rebuild the ring buckets AND
+    the frontier CSR; fixpoint equals the static all-gather run."""
+    from lux_tpu.parallel.ring import build_push_ring_shards
+
+    g = generate.rmat(11, 8, seed=3)
+    prog = ss.SSSPProgram(nv=g.nv, start=0)
+    mesh = make_mesh(8)
+    ref, _ = _static_global(prog, g, 8, mesh)
+    events = []
+    res = repartition.run_push_adaptive(
+        prog, g, 8, chunk=2, threshold=1.01, mesh=mesh, exchange="ring",
+        on_repartition=lambda it, oc, nc, w: events.append(it),
+    )
+    np.testing.assert_array_equal(res.state, ref)
+    assert res.reparts >= 1
+    # the final layout is a ring layout on the recut partition
+    assert hasattr(res.shards, "rarrays")
+    assert not np.array_equal(
+        res.shards.cuts, build_push_ring_shards(g, 8).cuts
+    )
+
+
+def test_adaptive_ring_requires_mesh():
+    g = generate.rmat(8, 6, seed=1)
+    prog = ss.SSSPProgram(nv=g.nv, start=0)
+    with pytest.raises(ValueError):
+        repartition.run_push_adaptive(prog, g, 4, exchange="ring")
+    with pytest.raises(ValueError):
+        repartition.run_push_adaptive(prog, g, 4, exchange="scatter")
