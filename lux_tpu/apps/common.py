@@ -155,6 +155,38 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
     return preflight.estimate_pull(shards.spec, state_width, sbytes)
 
 
+def resume_or_init(cfg: RunConfig, app: str, shards, state, nv):
+    """Elastic resume: restack the latest global checkpoint (any previous
+    -ng/--exchange) onto THIS run's layout; returns (state, start_it)."""
+    if not cfg.ckpt_dir:
+        return state, 0
+    import jax.numpy as jnp
+
+    from lux_tpu.graph.shards import global_to_stacked
+    from lux_tpu.utils import checkpoint
+
+    saved, start_it, prev = checkpoint.load_resume(cfg.ckpt_dir, app, nv)
+    if saved is None:
+        return state, 0
+    stacked = global_to_stacked(shards.cuts, shards.spec.nv_pad, saved)
+    print(f"resumed from {prev} at iteration {start_it}")
+    # cast to THIS run's state dtype (a resume may change --dtype)
+    return jnp.asarray(stacked).astype(state.dtype), start_it
+
+
+def save_global(cfg: RunConfig, app: str, shards, iteration: int, state):
+    """Checkpoint the stacked device state as the layout-independent
+    global vector (elastic: any later -ng/--exchange can resume it)."""
+    import jax
+
+    from lux_tpu.utils import checkpoint
+
+    checkpoint.save_iteration(
+        cfg.ckpt_dir, iteration, shards.scatter_to_global(jax.device_get(state)),
+        app,
+    )
+
+
 def run_pull_stepwise_dist(prog, shards, state, start_it, num_iters, mesh,
                            cfg: RunConfig, nv, on_iter=None):
     """Step-wise DISTRIBUTED pull loop (-verbose --distributed): one
@@ -187,9 +219,6 @@ def run_fixed_dist_chunked(prog, shards, state, start_it, num_iters, mesh,
     compute_seconds) where compute_seconds EXCLUDES the host-side
     checkpoint I/O (device_get + disk) so reported GTEPS stays an engine
     number."""
-    import jax
-
-    from lux_tpu.utils import checkpoint
     from lux_tpu.utils.timing import Timer
 
     compute = 0.0
@@ -201,9 +230,7 @@ def run_fixed_dist_chunked(prog, shards, state, start_it, num_iters, mesh,
         compute += t.stop(state)
         it += n
         if it < num_iters or num_iters % cfg.ckpt_every == 0:
-            checkpoint.save_iteration(
-                cfg.ckpt_dir, it, jax.device_get(state), app
-            )
+            save_global(cfg, app, shards, it, state)
     return state, compute
 
 

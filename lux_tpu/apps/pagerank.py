@@ -96,23 +96,13 @@ def main(argv=None):
     )
     state = pull.init_state(prog, arrays)
 
-    start_it = 0
-    if cfg.ckpt_dir:
-        from lux_tpu.utils import checkpoint
+    state, start_it = common.resume_or_init(cfg, "pagerank", shards, state, g.nv)
 
-        prev = checkpoint.latest(cfg.ckpt_dir)
-        if prev:
-            saved, start_it, _ = checkpoint.load(prev)
-            state = jax.numpy.asarray(saved)
-            print(f"resumed from {prev} at iteration {start_it}")
-
-    from lux_tpu.utils import checkpoint, profiling
+    from lux_tpu.utils import profiling
 
     def on_iter(it, st):
         if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
-            checkpoint.save_iteration(
-                cfg.ckpt_dir, it + 1, jax.device_get(st), "pagerank"
-            )
+            common.save_global(cfg, "pagerank", shards, it + 1, st)
 
     with profiling.trace(cfg.profile_dir):
         timer = Timer()

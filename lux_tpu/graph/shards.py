@@ -108,12 +108,21 @@ class PullShards:
     def global_to_stacked(self, full: np.ndarray) -> np.ndarray:
         """Split a (nv, ...) global state into (P, nv_pad, ...) padded stacks.
         Padding slots are filled with zeros."""
-        P, V = self.spec.num_parts, self.spec.nv_pad
-        out = np.zeros((P, V) + full.shape[1:], dtype=full.dtype)
-        for p in range(P):
-            lo, hi = int(self.cuts[p]), int(self.cuts[p + 1])
-            out[p, : hi - lo] = full[lo:hi]
-        return out
+        return global_to_stacked(self.cuts, self.spec.nv_pad, full)
+
+
+def global_to_stacked(cuts: np.ndarray, nv_pad: int,
+                      full: np.ndarray) -> np.ndarray:
+    """Split a (nv, ...) global state into (P, nv_pad, ...) zero-padded
+    stacks under ``cuts`` — the inverse of ``stacked_to_global``; any
+    shard bundle (pull/push/ring/scatter/edge2d) restacks an elastic
+    checkpoint with its own cuts through this."""
+    P = cuts.shape[0] - 1
+    out = np.zeros((P, nv_pad) + full.shape[1:], dtype=full.dtype)
+    for p in range(P):
+        lo, hi = int(cuts[p]), int(cuts[p + 1])
+        out[p, : hi - lo] = full[lo:hi]
+    return out
 
 
 def stacked_to_global(cuts: np.ndarray, stacked: np.ndarray) -> np.ndarray:

@@ -78,6 +78,10 @@ class RingShards:
     def arrays(self):
         return self.pull.arrays
 
+    @property
+    def cuts(self):
+        return self.pull.cuts
+
     def scatter_to_global(self, stacked):
         return self.pull.scatter_to_global(stacked)
 

@@ -3,8 +3,15 @@
 The reference has NO checkpointing (SURVEY.md §5: the USE_HDF knob exists
 but is unused) — this is a capability extension: vertex-state arrays are
 small relative to the graph, so saving (state, iteration, metadata) per
-iteration range is cheap.  Format: NumPy .npz with atomic rename (no extra
-dependencies; multi-host runs save per-host part slices via the same API).
+iteration range is cheap.  Format: NumPy .npz with atomic rename (no
+extra dependencies).
+
+Checkpoints are ELASTIC: the saved state is the GLOBAL (nv, ...) vertex
+vector, de-padded from whatever shard layout produced it, so a resume may
+use a different part count, exchange strategy, or device mesh than the
+run that saved it (the app restacks onto its current layout).  bfloat16
+state is stored widened to float32 (the .npy format has no bf16 descr;
+the cast is value-exact) and narrowed back on resume.
 """
 from __future__ import annotations
 
@@ -35,13 +42,56 @@ def load(path: str) -> Tuple[np.ndarray, int, Dict[str, Any]]:
         )
 
 
-def save_iteration(directory: str, iteration: int, state, app: str) -> str:
-    """Save under the canonical name ``ckpt_<iteration>.npz`` (the format
-    ``latest`` scans for); creates the directory on first use."""
+def save_iteration(directory: str, iteration: int, state_global, app: str) -> str:
+    """Save the GLOBAL (nv, ...) state under the canonical name
+    ``ckpt_<iteration>.npz`` (the format ``latest`` scans for); creates
+    the directory on first use."""
     os.makedirs(directory, exist_ok=True)
+    state_global = np.asarray(state_global)
+    meta = {
+        "app": app,
+        "layout": "global",
+        "nv": int(state_global.shape[0]),
+        "dtype": str(state_global.dtype),
+    }
+    if state_global.dtype.name == "bfloat16":
+        state_global = state_global.astype(np.float32)
     path = os.path.join(directory, f"ckpt_{iteration}.npz")
-    save(path, state, iteration, {"app": app})
+    save(path, state_global, iteration, meta)
     return path
+
+
+def load_resume(directory: str, app: str, nv: int):
+    """Validated elastic resume: the latest checkpoint in ``directory``
+    for this app/graph, as (state_global, start_iteration, path) — or
+    (None, 0, None) when the directory has no checkpoint yet.  The state
+    comes back in its original dtype (bf16 is narrowed back from the
+    widened on-disk f32)."""
+    prev = latest(directory)
+    if prev is None:
+        return None, 0, None
+    state, it, meta = load(prev)
+    if meta.get("layout") != "global":
+        raise SystemExit(
+            f"{prev}: layout-specific checkpoint from an older format; "
+            "elastic resume needs global-layout checkpoints — delete the "
+            "directory and re-run"
+        )
+    if meta.get("app") != app:
+        raise SystemExit(
+            f"{prev}: checkpoint is from app {meta.get('app')!r}, "
+            f"refusing to resume {app!r}"
+        )
+    if int(meta.get("nv", -1)) != nv:
+        raise SystemExit(
+            f"{prev}: checkpoint is for nv={meta.get('nv')}, "
+            f"this graph has nv={nv}"
+        )
+    if meta.get("dtype") == "bfloat16":
+        import ml_dtypes
+
+        state = state.astype(ml_dtypes.bfloat16)
+    return state, it, prev
 
 
 def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:

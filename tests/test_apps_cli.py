@@ -290,3 +290,33 @@ def test_sssp_cli_repartition_ring(capsys):
     assert sssp_app.main(args) == 0
     out = capsys.readouterr().out
     assert "[PASS]" in out
+
+
+def test_elastic_resume_across_part_counts(tmp_path, capsys):
+    """Elastic restart: checkpoints are global-layout, so a run saved at
+    -ng 2 single-device resumes at -ng 8 --distributed (different part
+    count, padding, AND exchange) and matches the uninterrupted run."""
+    d = str(tmp_path / "ck")
+    assert pr_app.main(SMALL + ["-ni", "6"]) == 0
+    ref = _parse_top5(capsys.readouterr().out)
+    assert pr_app.main(SMALL + ["-ni", "4", "-ng", "2", "--ckpt-dir", d,
+                                "--ckpt-every", "2"]) == 0
+    capsys.readouterr()
+    assert pr_app.main(SMALL + ["-ni", "6", "-ng", "8", "--distributed",
+                                "--exchange", "ring", "--ckpt-dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "resumed from" in out and "iteration 4" in out
+    got = _parse_top5(out)
+    shared = set(ref) & set(got)
+    assert len(shared) >= 4, (ref, got)
+    for vid in shared:
+        np.testing.assert_allclose(got[vid], ref[vid], rtol=1e-4)
+
+
+def test_elastic_resume_rejects_wrong_app(tmp_path, capsys):
+    d = str(tmp_path / "ck")
+    assert pr_app.main(SMALL + ["-ni", "2", "--ckpt-dir", d,
+                                "--ckpt-every", "2"]) == 0
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        cf_app.main(SMALL + ["-ni", "4", "--ckpt-dir", d])

@@ -64,10 +64,43 @@ def test_pagerank_app_checkpoint_resume(tmp_path):
     rc = app.main(g_args + ["-ni", "6", "--ckpt-dir", ck, "--ckpt-every", "2"])
     assert rc == 0
     assert checkpoint.latest(ck).endswith("ckpt_6.npz")
+    # checkpoints store the GLOBAL (nv,) state (elastic layout)
     state, it, _ = checkpoint.load(checkpoint.latest(ck))
     from lux_tpu.graph import generate as gen
 
     g = gen.rmat(8, 4, seed=3)
     want = pr_run(g, num_iters=6)
-    sh = build_pull_shards(g, 1)
-    np.testing.assert_allclose(sh.scatter_to_global(state), want, rtol=1e-6)
+    assert state.shape == (g.nv,)
+    np.testing.assert_allclose(state, want, rtol=1e-6)
+
+def test_checkpoint_elastic_meta_and_bf16(tmp_path):
+    """save_iteration stores the global layout + app/nv/dtype meta;
+    load_resume validates it and round-trips bf16 through the widened
+    on-disk f32."""
+    import ml_dtypes
+
+    d = str(tmp_path / "ck")
+    g16 = np.arange(64, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    checkpoint.save_iteration(d, 3, g16, "pagerank")
+    state, it, prev = checkpoint.load_resume(d, "pagerank", 64)
+    assert it == 3 and prev.endswith("ckpt_3.npz")
+    assert state.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(state, g16)
+    # wrong app / wrong nv refuse
+    import pytest
+
+    with pytest.raises(SystemExit):
+        checkpoint.load_resume(d, "colfilter", 64)
+    with pytest.raises(SystemExit):
+        checkpoint.load_resume(d, "pagerank", 128)
+    # empty dir resumes from scratch
+    assert checkpoint.load_resume(str(tmp_path / "none"), "x", 1)[0] is None
+    # legacy (layout-less) checkpoints are refused, not misread
+    import os
+
+    os.makedirs(str(tmp_path / "ck2"))
+    checkpoint.save(
+        str(tmp_path / "ck2" / "ckpt_1.npz"), g16.astype(np.float32), 1, {}
+    )
+    with pytest.raises(SystemExit):
+        checkpoint.load_resume(str(tmp_path / "ck2"), "pagerank", 64)
