@@ -286,6 +286,19 @@ def _relay(out_path) -> bool:
     return False
 
 
+def _relay_listening(port=8083, timeout=3.0) -> bool:
+    """TCP probe of the axon relay's remote_compile endpoint.  Refused =
+    relay down: a jax client would burn ~55 min of C-level retries to
+    learn the same thing (docs/NOTES_ROUND2.md tunnel diagnostics #5)."""
+    import socket
+
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
 def main():
     budget = int(os.environ.get("LUX_BENCH_WATCHDOG_S", "900"))
     if budget <= 0:  # 0 = unbounded (documented knob semantics)
@@ -293,6 +306,25 @@ def main():
     t_start = time.monotonic()
     scale = int(os.environ.get("LUX_BENCH_SCALE", "20"))
     tpu_wait = int(os.environ.get("LUX_BENCH_TPU_S", str(budget - 120)))
+    # relay gate: only meaningful when the primary actually targets the
+    # tunnel — a pure-CPU run (tests, CI, dev hosts) has no relay and must
+    # not have its wait shortened
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        assume = os.environ.get("LUX_BENCH_ASSUME_RELAY")  # test hook
+        relay_up = assume != "down" and (assume == "up" or _relay_listening())
+        if not relay_up:
+            # still spawn the TPU worker (a warm AOT cache could dodge
+            # remote_compile), but stop waiting on it early — leaving the
+            # budget (less the insurance-wait headroom) to the CPU number
+            cap = int(os.environ.get("LUX_BENCH_RELAY_CAP_S", "240"))
+            tpu_wait = max(0, min(tpu_wait, cap, budget - 180))
+            why = "assumed down (test hook)" if assume == "down" else "not listening"
+            print(
+                f"# relay 127.0.0.1:8083 {why} — TPU wait capped at "
+                f"{tpu_wait}s, insurance favored",
+                file=sys.stderr,
+                flush=True,
+            )
 
     # unique per-run paths: an abandoned worker from a PREVIOUS run still
     # holds its old fd and may eventually write its (differently-configured)

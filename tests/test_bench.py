@@ -77,3 +77,28 @@ def test_bench_harvests_banked_lines_from_wedged_primary():
     pid = int(r.stderr.split("TPU worker (pid ")[1].split(")")[0])
     os.kill(pid, 0)
     os.kill(pid, signal.SIGKILL)
+
+
+def test_bench_relay_gate_caps_tpu_wait():
+    """A dead relay endpoint (the 55-min jax retry trap) caps the TPU
+    wait so the insurance result still lands within budget."""
+    r = _run(
+        {
+            "LUX_BENCH_FAKE_HANG": "1",
+            "JAX_PLATFORMS": "bogus_tpu",
+            "LUX_BENCH_WATCHDOG_S": "240",
+            "LUX_BENCH_TPU_S": "9999",  # would exceed budget un-capped...
+            "LUX_BENCH_ASSUME_RELAY": "down",  # ...but the gate caps it
+            "LUX_BENCH_RELAY_CAP_S": "10",
+        },
+        timeout=300,
+    )
+    assert "assumed down (test hook)" in r.stderr
+    assert "TPU wait capped at 10s" in r.stderr
+    # and the insurance number actually lands
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["value"] > 0 and "_cpu_fallback" in line["metric"]
+    pid = int(r.stderr.split("TPU worker (pid ")[1].split(")")[0])
+    os.kill(pid, 0)
+    os.kill(pid, signal.SIGKILL)
