@@ -628,6 +628,25 @@ def shard_carry(mesh: Mesh, c: PushCarry) -> PushCarry:
     )
 
 
+def assemble_carry(c_local: PushCarry, assemble) -> PushCarry:
+    """Multihost analog of shard_carry: stitch a per-host LOCAL-parts
+    carry into the globally-sharded one.  ``assemble(host_stacked) ->
+    global jax.Array`` (e.g. multihost.assemble_global bound to the mesh).
+    Keeps the sharded-vs-replicated field split in ONE place with
+    shard_carry/_carry_specs; the scalar fields are process-identical by
+    construction of _init_carry."""
+    import numpy as np
+
+    def sh(a):
+        return assemble(np.asarray(a))
+
+    return PushCarry(
+        sh(c_local.state), sh(c_local.q_vid), sh(c_local.q_val),
+        sh(c_local.count), c_local.it, c_local.active, c_local.edges,
+        sh(c_local.sp_work), c_local.dense_rounds,
+    )
+
+
 @lru_cache(maxsize=64)
 def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                        e_bucket_pad: int, method: str):

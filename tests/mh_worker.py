@@ -1,10 +1,14 @@
 import os, sys
 pid = int(sys.argv[1]); nproc = int(sys.argv[2])
+mode = sys.argv[3] if len(sys.argv) > 3 else "pull"
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from lux_tpu.parallel import multihost
-me = multihost.initialize("127.0.0.1:29517", nproc, pid)
+# distinct coordinator port per mode: the pull and push tests may run
+# back-to-back and a lingering TIME_WAIT port would wedge the second
+port = {"pull": 29517, "push": 29518}[mode]
+me = multihost.initialize(f"127.0.0.1:{port}", nproc, pid)
 import jax
 import numpy as np
 assert jax.process_count() == nproc, jax.process_count()
@@ -17,6 +21,52 @@ from lux_tpu.parallel import multihost as mh, dist
 mesh = mh.global_parts_mesh()
 P = jax.device_count()
 g = generate.rmat(9, 8, seed=55)
+
+
+def check_local(arr, cuts, mine, want, assert_fn):
+    """Validate THIS process's parts of a (P, V)-sharded result against
+    the global oracle (addressable shard order is not the parts order)."""
+    got = sorted(arr.addressable_shards, key=lambda s: s.index[0].start)
+    for i, p in enumerate(mine):
+        lo, hi = int(cuts[p]), int(cuts[p + 1])
+        assert_fn(np.asarray(got[i].data)[0][: hi - lo], want[lo:hi])
+
+
+if mode == "push":
+    # --- push engine across REAL processes: frontier (vid, value) queue
+    # all_gathers, the psum'd direction-switch flags, and the dense-branch
+    # state all_gather inside lax.cond — the riskiest collective pattern
+    # in the framework, here exercised over an actual process boundary
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import push
+    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.models.sssp import SSSPProgram, bfs_reference
+
+    psh = build_push_shards(g, P)
+    sp = SSSPProgram(nv=psh.spec.nv, start=0)
+    mine = list(mh.local_part_range(P))
+    arrays_p = jax.tree.map(
+        lambda a: mh.assemble_global(mesh, a[mine], P), psh.arrays
+    )
+    parrays_p = jax.tree.map(
+        lambda a: mh.assemble_global(mesh, a[mine], P), psh.parrays
+    )
+    # per-host carry init on the local parts, stitched like the arrays
+    view_local = jax.tree.map(
+        lambda a: jnp.asarray(a[mine]), push.vertex_view(psh.arrays)
+    )
+    c_local = push._init_carry(sp, psh.pspec, view_local)
+    carry = push.assemble_carry(
+        c_local, lambda a: mh.assemble_global(mesh, a, P)
+    )
+    run = push._compile_push_dist(sp, mesh, psh.pspec, psh.spec, "scan")
+    out = run(arrays_p, parrays_p, carry, jnp.int32(1000))
+    check_local(out.state, psh.cuts, mine, bfs_reference(g, 0),
+                np.testing.assert_array_equal)
+    print(f"process {pid}: multihost push OK over {P} devices", flush=True)
+    sys.exit(0)
+
 shards = build_pull_shards(g, P)
 prog = PageRankProgram(nv=shards.spec.nv)
 # host-sharded load: this host materializes only its own parts
@@ -32,14 +82,11 @@ arrays = jax.tree.map(
     lambda a: mh.assemble_global(mesh, a[mine], P), shards.arrays
 )
 out = dist.run_pull_fixed_dist(prog, shards.spec, arrays, state0, 5, mesh)
-# addressable_shards order is not guaranteed to follow the parts axis
-shards_sorted = sorted(out.addressable_shards, key=lambda s: s.index[0].start)
-local = np.concatenate([np.asarray(s.data)[0][None] for s in shards_sorted])
-# verify my local parts against the oracle
+import functools
+
+close = functools.partial(np.testing.assert_allclose, rtol=5e-5)
 want = pagerank_reference(g, 5)
-for i, p in enumerate(mine):
-    lo, hi = int(shards.cuts[p]), int(shards.cuts[p + 1])
-    np.testing.assert_allclose(local[i][: hi - lo], want[lo:hi], rtol=5e-5)
+check_local(out, shards.cuts, mine, want, close)
 print(f"process {pid}: multihost pagerank OK over {P} devices / {nproc} procs", flush=True)
 
 # --- ring exchange with PER-HOST SUBSET bucket builds: each process
@@ -56,11 +103,5 @@ rs = ring.RingShards(
     e_bucket_pad=rs_local.e_bucket_pad, parts_subset=list(range(P)),
 )
 ring_out = ring.run_pull_fixed_ring(prog, rs, state0, 5, mesh)
-rshards_sorted = sorted(
-    ring_out.addressable_shards, key=lambda s: s.index[0].start
-)
-rlocal = np.concatenate([np.asarray(s.data)[0][None] for s in rshards_sorted])
-for i, p in enumerate(mine):
-    lo, hi = int(shards.cuts[p]), int(shards.cuts[p + 1])
-    np.testing.assert_allclose(rlocal[i][: hi - lo], want[lo:hi], rtol=5e-5)
+check_local(ring_out, shards.cuts, mine, want, close)
 print(f"process {pid}: multihost ring OK (subset-built buckets)", flush=True)

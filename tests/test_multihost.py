@@ -11,12 +11,12 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "mh_worker.py")
 
 
-def test_two_process_distributed_pagerank():
+def _run_pair(mode: str, timeout: int = 320):
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(pid), "2"],
+            [sys.executable, WORKER, str(pid), "2", mode],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd="/tmp",
         )
@@ -25,7 +25,7 @@ def test_two_process_distributed_pagerank():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=320)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
         # never leak workers: a deadlocked pair would keep the coordinator
@@ -35,5 +35,20 @@ def test_two_process_distributed_pagerank():
                 p.kill()
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+    return outs
+
+
+def test_two_process_distributed_pagerank():
+    outs = _run_pair("pull")
+    for pid, out in enumerate(outs):
         assert f"process {pid}: multihost pagerank OK" in out
         assert f"process {pid}: multihost ring OK" in out
+
+
+def test_two_process_distributed_push():
+    """The direction-optimizing push engine (queue all_gathers + psum'd
+    switch flags + dense all_gather inside lax.cond) over two real OS
+    processes — SSSP to convergence, validated against the BFS oracle."""
+    outs = _run_pair("push", timeout=420)
+    for pid, out in enumerate(outs):
+        assert f"process {pid}: multihost push OK" in out
