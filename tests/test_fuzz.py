@@ -91,3 +91,25 @@ def test_fuzz_pallas_dist_pagerank(seed):
     )
     got = pp.scatter_to_global(np.asarray(out))
     np.testing.assert_allclose(got, pr.pagerank_reference(g, 4), rtol=5e-5)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:3])
+def test_fuzz_adaptive_repartition(seed):
+    """Random graphs/windows/thresholds: the adaptive driver must reach
+    the static fixpoint exactly, whatever recut schedule it takes."""
+    from lux_tpu.engine import repartition
+
+    rng = np.random.default_rng(seed + 5000)
+    nv = int(rng.integers(100, 700))
+    ne = int(rng.integers(nv, nv * 8))
+    parts = int(rng.integers(2, 5))
+    chunk = int(rng.integers(1, 4))
+    threshold = float(rng.uniform(1.0, 1.3))
+    start = int(rng.integers(0, nv))
+    g = generate.uniform_random(nv, ne, seed=seed)
+    prog = sssp.SSSPProgram(nv=g.nv, start=start)
+    res = repartition.run_push_adaptive(
+        prog, g, parts, chunk=chunk, threshold=threshold
+    )
+    np.testing.assert_array_equal(res.state, sssp.bfs_reference(g, start))
+    assert sssp.check_distances(g, res.state) == 0
