@@ -133,6 +133,12 @@ def main(argv=None):
                 "pagerank",
             )
         else:
+            if cfg.verbose:
+                print(
+                    "note: -verbose per-iteration stepping is an "
+                    "allgather-exchange 1-D-mesh mode; this run stays "
+                    "fused on device"
+                )
             state = common.run_fixed_dist(
                 prog, shards, state, cfg.num_iters - start_it, mesh, cfg
             )

@@ -135,6 +135,11 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 prog, shards, cfg.max_iters, cfg.method
             )
         elif cfg.exchange == "ring":
+            if cfg.verbose:
+                print(
+                    "note: -verbose per-iteration stepping is an "
+                    "allgather-exchange mode; ring runs fused on device"
+                )
             state, iters, edges = push.run_push_ring(
                 prog, shards, mesh, cfg.max_iters, cfg.method
             )

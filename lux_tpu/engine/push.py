@@ -430,6 +430,91 @@ def _carry_specs():
     )
 
 
+def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr, qarr,
+                    dense_fn, c: PushCarry) -> PushCarry:
+    """ONE direction-optimized iteration from a device's perspective
+    inside shard_map — the single source of truth for the dist, step-dist,
+    and ring engines (their only difference is ``dense_fn``).
+
+    * frontier (vid, value) queues are all_gathered unconditionally (they
+      are small: O(P * f_cap));
+    * the mode decision is GLOBAL (psum'd count + overflow/tier flags) so
+      the dense branch's collectives sit inside `lax.cond` without
+      divergence;
+    * ``qarr`` carries the per-vertex arrays (vtx_mask/global_vid) for
+      the sparse mask and queue rebuild — ShardArrays on the all-gather
+      engines, the slim VertexView on the ring engine;
+    * ``dense_fn(local)`` is the engine-specific dense relaxation: the
+      all-gathered segmented reduce, or the ppermute ring fold.
+    """
+    local = c.state
+    V = spec.nv_pad
+    q_vids_all = jax.lax.all_gather(c.q_vid, PARTS_AXIS, tiled=True)
+    q_vals_all = jax.lax.all_gather(c.q_val, PARTS_AXIS, tiled=True)
+    rows, counts, incl, total = sparse_prep(parr, q_vids_all)
+    g_cnt = jax.lax.psum(c.count, PARTS_AXIS)
+    flags = jax.lax.psum(
+        jnp.stack(
+            [
+                (c.count > pspec.f_cap).astype(jnp.int32),
+                (total > pspec.e_sp).astype(jnp.int32),
+                # tier vote: any part too big for the small buffer?
+                (total > pspec.e_sp_small).astype(jnp.int32),
+            ]
+        ),
+        PARTS_AXIS,
+    )
+    use_dense = (
+        (g_cnt > spec.nv // pspec.pull_threshold_den)
+        | (flags[:2].max() > 0)
+    )
+
+    def sparse_branch():
+        def run(cap):
+            return jnp.where(
+                qarr.vtx_mask,
+                sparse_part_step(
+                    prog, pspec, parr, V, q_vids_all, q_vals_all,
+                    rows, counts, incl, local, cap,
+                ),
+                local,
+            )
+
+        if not pspec.e_sp_small:
+            return run(pspec.e_sp)
+        # globally-agreed tier (flags[2] is a psum) — identical branch on
+        # every device, collective-free branches
+        return jax.lax.cond(
+            flags[2] == 0, lambda: run(pspec.e_sp_small),
+            lambda: run(pspec.e_sp),
+        )
+
+    new = jax.lax.cond(use_dense, lambda: dense_fn(local), sparse_branch)
+    changed = (new != local) & qarr.vtx_mask
+    q_vid, q_val, cnt = build_queue(pspec, qarr, changed, new)
+    active = jax.lax.psum(cnt, PARTS_AXIS)
+    # uint32 psum is exact: a sparse round's global total is bounded by
+    # sum_p e_sp_p ≈ ne/4 < 2^32 (bigger frontiers force dense)
+    g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
+    edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
+    sp_work, dense_rounds = _acc_load(c, total, use_dense)
+    return PushCarry(
+        new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
+        dense_rounds,
+    )
+
+
+def _allgather_dense_fn(prog, arr, method):
+    """Dense relaxation for the all-gather engines: whole state over ICI,
+    then the segmented reduce over the part's in-edges."""
+
+    def dense_fn(local):
+        full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
+        return dense_part_step(prog, arr, full, local, method)
+
+    return dense_fn
+
+
 @lru_cache(maxsize=64)
 def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                        method: str):
@@ -447,73 +532,14 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     def run(arr_blk, parr_blk, carry_blk, it_stop):
         arr = jax.tree.map(lambda a: a[0], arr_blk)
         parr = jax.tree.map(lambda a: a[0], parr_blk)
-        V = spec.nv_pad
 
         def cond(c):
             return (c.active > 0) & (c.it < it_stop)
 
         def body(c):
-            local = c.state
-            # exchange the sparse frontier queues (small) unconditionally
-            q_vids_all = jax.lax.all_gather(c.q_vid, PARTS_AXIS, tiled=True)
-            q_vals_all = jax.lax.all_gather(c.q_val, PARTS_AXIS, tiled=True)
-            rows, counts, incl, total = sparse_prep(parr, q_vids_all)
-            # global mode decision so the dense branch's all_gather is
-            # collective-safe under lax.cond
-            g_cnt = jax.lax.psum(c.count, PARTS_AXIS)
-            flags = jax.lax.psum(
-                jnp.stack(
-                    [
-                        (c.count > pspec.f_cap).astype(jnp.int32),
-                        (total > pspec.e_sp).astype(jnp.int32),
-                        # tier vote: any part too big for the small buffer?
-                        (total > pspec.e_sp_small).astype(jnp.int32),
-                    ]
-                ),
-                PARTS_AXIS,
-            )
-            use_dense = (
-                (g_cnt > spec.nv // pspec.pull_threshold_den)
-                | (flags[:2].max() > 0)
-            )
-
-            def dense_branch():
-                full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
-                return dense_part_step(prog, arr, full, local, method)
-
-            def sparse_branch():
-                def run(cap):
-                    return jnp.where(
-                        arr.vtx_mask,
-                        sparse_part_step(
-                            prog, pspec, parr, V, q_vids_all, q_vals_all,
-                            rows, counts, incl, local, cap,
-                        ),
-                        local,
-                    )
-
-                if not pspec.e_sp_small:
-                    return run(pspec.e_sp)
-                # globally-agreed tier (flags[2] is a psum) — identical
-                # branch on every device, collective-free branches
-                return jax.lax.cond(
-                    flags[2] == 0,
-                    lambda: run(pspec.e_sp_small),
-                    lambda: run(pspec.e_sp),
-                )
-
-            new = jax.lax.cond(use_dense, dense_branch, sparse_branch)
-            changed = (new != local) & arr.vtx_mask
-            q_vid, q_val, cnt = build_queue(pspec, arr, changed, new)
-            active = jax.lax.psum(cnt, PARTS_AXIS)
-            # uint32 psum is exact: a sparse round's global total is bounded
-            # by sum_p e_sp_p ≈ ne/4 < 2^32 (bigger frontiers force dense)
-            g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
-            edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
-            sp_work, dense_rounds = _acc_load(c, total, use_dense)
-            return PushCarry(
-                new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
-                dense_rounds,
+            return _spmd_push_iter(
+                prog, pspec, spec, parr, arr,
+                _allgather_dense_fn(prog, arr, method), c,
             )
 
         out = jax.lax.while_loop(cond, body, _carry_local(carry_blk))
@@ -548,61 +574,14 @@ def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     def step(arr_blk, parr_blk, carry_blk):
         arr = jax.tree.map(lambda a: a[0], arr_blk)
         parr = jax.tree.map(lambda a: a[0], parr_blk)
-        V = spec.nv_pad
-        c = _carry_local(carry_blk)
-        local = c.state
-        q_vids_all = jax.lax.all_gather(c.q_vid, PARTS_AXIS, tiled=True)
-        q_vals_all = jax.lax.all_gather(c.q_val, PARTS_AXIS, tiled=True)
-        rows, counts, incl, total = sparse_prep(parr, q_vids_all)
-        g_cnt = jax.lax.psum(c.count, PARTS_AXIS)
-        flags = jax.lax.psum(
-            jnp.stack(
-                [
-                    (c.count > pspec.f_cap).astype(jnp.int32),
-                    (total > pspec.e_sp).astype(jnp.int32),
-                    (total > pspec.e_sp_small).astype(jnp.int32),
-                ]
-            ),
-            PARTS_AXIS,
+        out = _spmd_push_iter(
+            prog, pspec, spec, parr, arr,
+            _allgather_dense_fn(prog, arr, method), _carry_local(carry_blk),
         )
-        use_dense = (
-            (g_cnt > spec.nv // pspec.pull_threshold_den)
-            | (flags[:2].max() > 0)
-        )
-
-        def dense_branch():
-            full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
-            return dense_part_step(prog, arr, full, local, method)
-
-        def sparse_branch():
-            def run(cap):
-                return jnp.where(
-                    arr.vtx_mask,
-                    sparse_part_step(
-                        prog, pspec, parr, V, q_vids_all, q_vals_all,
-                        rows, counts, incl, local, cap,
-                    ),
-                    local,
-                )
-
-            if not pspec.e_sp_small:
-                return run(pspec.e_sp)
-            return jax.lax.cond(
-                flags[2] == 0,
-                lambda: run(pspec.e_sp_small),
-                lambda: run(pspec.e_sp),
-            )
-
-        new = jax.lax.cond(use_dense, dense_branch, sparse_branch)
-        changed = (new != local) & arr.vtx_mask
-        q_vid, q_val, cnt = build_queue(pspec, arr, changed, new)
-        active = jax.lax.psum(cnt, PARTS_AXIS)
-        g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
-        edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
-        sp_work, dense_rounds = _acc_load(c, total, use_dense)
         return PushCarry(
-            new[None], q_vid[None], q_val[None], cnt[None], c.it + 1,
-            active, edges, sp_work[None], dense_rounds,
+            out.state[None], out.q_vid[None], out.q_val[None],
+            out.count[None], out.it, out.active, out.edges,
+            out.sp_work[None], out.dense_rounds,
         )
 
     return step
@@ -683,78 +662,31 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         def cond(c):
             return (c.active > 0) & (c.it < it_stop)
 
+        def ring_dense_fn(local):
+            def fold(k, acc, block):
+                q = (my + k) % num_parts  # owner of the resident block
+                vals = prog.relax(block[rarr.src_local[q]], rarr.weights[q])
+                part = segment.segment_reduce_by_ends(
+                    vals, rarr.head_flag[q], rarr.dst_local[q], V,
+                    reduce=prog.reduce, method=method,
+                )
+                return op(acc, part)
+
+            def fold_block(k, carry2):
+                acc, block = carry2
+                acc = fold(k, acc, block)
+                return acc, jax.lax.ppermute(block, PARTS_AXIS, perm)
+
+            acc0 = _neutral_like(local, prog.reduce)
+            acc, block = jax.lax.fori_loop(
+                0, num_parts - 1, fold_block, (acc0, local)
+            )
+            acc = fold(num_parts - 1, acc, block)
+            return jnp.where(view.vtx_mask, op(local, acc), local)
+
         def body(c):
-            local = c.state
-            q_vids_all = jax.lax.all_gather(c.q_vid, PARTS_AXIS, tiled=True)
-            q_vals_all = jax.lax.all_gather(c.q_val, PARTS_AXIS, tiled=True)
-            rows, counts, incl, total = sparse_prep(parr, q_vids_all)
-            g_cnt = jax.lax.psum(c.count, PARTS_AXIS)
-            flags = jax.lax.psum(
-                jnp.stack(
-                    [
-                        (c.count > pspec.f_cap).astype(jnp.int32),
-                        (total > pspec.e_sp).astype(jnp.int32),
-                        (total > pspec.e_sp_small).astype(jnp.int32),
-                    ]
-                ),
-                PARTS_AXIS,
-            )
-            use_dense = (
-                (g_cnt > spec.nv // pspec.pull_threshold_den)
-                | (flags[:2].max() > 0)
-            )
-
-            def dense_branch():
-                def fold(k, acc, block):
-                    q = (my + k) % num_parts  # owner of the resident block
-                    vals = prog.relax(block[rarr.src_local[q]], rarr.weights[q])
-                    part = segment.segment_reduce_by_ends(
-                        vals, rarr.head_flag[q], rarr.dst_local[q], V,
-                        reduce=prog.reduce, method=method,
-                    )
-                    return op(acc, part)
-
-                def fold_block(k, carry2):
-                    acc, block = carry2
-                    acc = fold(k, acc, block)
-                    return acc, jax.lax.ppermute(block, PARTS_AXIS, perm)
-
-                acc0 = _neutral_like(local, prog.reduce)
-                acc, block = jax.lax.fori_loop(
-                    0, num_parts - 1, fold_block, (acc0, local)
-                )
-                acc = fold(num_parts - 1, acc, block)
-                return jnp.where(view.vtx_mask, op(local, acc), local)
-
-            def sparse_branch():
-                def run(cap):
-                    return jnp.where(
-                        view.vtx_mask,
-                        sparse_part_step(
-                            prog, pspec, parr, V, q_vids_all, q_vals_all,
-                            rows, counts, incl, local, cap,
-                        ),
-                        local,
-                    )
-
-                if not pspec.e_sp_small:
-                    return run(pspec.e_sp)
-                return jax.lax.cond(
-                    flags[2] == 0,
-                    lambda: run(pspec.e_sp_small),
-                    lambda: run(pspec.e_sp),
-                )
-
-            new = jax.lax.cond(use_dense, dense_branch, sparse_branch)
-            changed = (new != local) & view.vtx_mask
-            q_vid, q_val, cnt = build_queue(pspec, view, changed, new)
-            active = jax.lax.psum(cnt, PARTS_AXIS)
-            g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
-            edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
-            sp_work, dense_rounds = _acc_load(c, total, use_dense)
-            return PushCarry(
-                new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
-                dense_rounds,
+            return _spmd_push_iter(
+                prog, pspec, spec, parr, view, ring_dense_fn, c
             )
 
         out = jax.lax.while_loop(cond, body, _carry_local(carry_blk))
