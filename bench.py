@@ -286,12 +286,16 @@ def _relay(out_path) -> bool:
     return False
 
 
-def _relay_listening(port=8083, timeout=3.0) -> bool:
+def _relay_listening(port=None, timeout=3.0) -> bool:
     """TCP probe of the axon relay's remote_compile endpoint.  Refused =
     relay down: a jax client would burn ~55 min of C-level retries to
-    learn the same thing (docs/NOTES_ROUND2.md tunnel diagnostics #5)."""
+    learn the same thing (docs/NOTES_ROUND2.md tunnel diagnostics #5).
+    The port is configurable (LUX_BENCH_RELAY_PORT) so an unrelated local
+    service on 8083 can't fake a 'relay up' forever — move the probe."""
     import socket
 
+    if port is None:
+        port = int(os.environ.get("LUX_BENCH_RELAY_PORT", "8083"))
     try:
         with socket.create_connection(("127.0.0.1", port), timeout=timeout):
             return True

@@ -56,7 +56,12 @@ def make_mesh_if(cfg: RunConfig):
 
 def validate_exchange(cfg: RunConfig, prog) -> None:
     """Reject incompatible --exchange combinations BEFORE the O(ne) shard
-    build, with a CLI-level message (not a deep driver assert)."""
+    build, with a CLI-level message (not a deep driver assert).  Resolves
+    ``--method auto`` to the platform's measured winner first, so every
+    later check (and the run itself) sees a concrete strategy."""
+    from lux_tpu.engine import methods
+
+    cfg.method = methods.resolve(cfg.method, prog.reduce)
     if cfg.method in ("cumsum", "mxsum") and prog.reduce != "sum":
         raise SystemExit(
             f"--method {cfg.method} is a prefix-diff strategy: sum-reduce "

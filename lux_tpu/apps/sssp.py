@@ -35,6 +35,9 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
     (global_state, stacked_device_state, effective_shards) — the shard
     layout can change mid-run under --repartition-every, so validation
     must use the returned layout, not the one passed in."""
+    from lux_tpu.engine import methods
+
+    cfg.method = methods.resolve(cfg.method, prog.reduce)
     if cfg.method in ("cumsum", "mxsum"):
         raise SystemExit(
             f"--method {cfg.method} is a prefix-diff strategy: sum-reduce "

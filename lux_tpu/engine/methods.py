@@ -1,0 +1,72 @@
+"""Measured per-platform segment-reduction method defaults.
+
+The reference hard-codes its reduction strategy (CUB block-scan + atomics,
+pagerank_gpu.cu:59-95) because it targets exactly one architecture.  Here
+four interchangeable strategies exist (lux_tpu.ops.segment) and the right
+one depends on where the program runs, so the engine-wide default is
+``"auto"``: resolved once at driver entry from the runtime platform and
+the measured winners below.  Defaults follow measurements, not tradition:
+
+  * **1-core CPU host** (BASELINE.md round-2 phase table): ``scatter``
+    beats ``scan`` ~2x on the dominating comp phase — XLA:CPU lowers the
+    sorted segment-sum to a tight sequential accumulation, while the
+    log-depth associative scan makes multiple passes over the edge array.
+  * **TPU** (PERF.md round-2 chip session): XLA ``scatter`` SERIALIZES
+    on-chip — measured 264 ms/iter = 0.06 GTEPS at rmat20/ef16, 6x slower
+    than the same code on the CPU fallback.  ``scan`` is the vectorized
+    default until the Pallas sweep (tools/tpu_pallas_check.py --sweep)
+    records a faster winner; update WINNERS when it does.
+
+``resolve`` is pure/host-side: it runs before any trace, so the concrete
+string participates in jit static arguments and compile caches as usual.
+"""
+from __future__ import annotations
+
+import os
+
+#: Concrete strategies a resolution may produce.  ("cumsum"/"mxsum" are
+#: sum-only prefix-diff strategies and "pallas" needs the block-CSR
+#: layout — none is safe as a blanket default, so winners stay within
+#: the universally-valid {scan, scatter} set.)
+CONCRETE = ("scan", "cumsum", "mxsum", "scatter")
+
+#: (platform, reduce) -> measured winner.  The chip battery
+#: (tools/chip_day.sh) is the only sanctioned way to change a tpu row.
+WINNERS = {
+    ("cpu", "sum"): "scatter",
+    ("cpu", "min"): "scatter",
+    ("cpu", "max"): "scatter",
+    ("tpu", "sum"): "scan",
+    ("tpu", "min"): "scan",
+    ("tpu", "max"): "scan",
+}
+
+#: Unknown platform (gpu via XLA, interpreters): the portable choice.
+FALLBACK = "scan"
+
+_platform_cache: str | None = None
+
+
+def default_platform() -> str:
+    """The jax default backend, overridable via LUX_METHOD_PLATFORM (so
+    resolution never has to touch a possibly-wedged device tunnel just to
+    pick a strategy string)."""
+    global _platform_cache
+    env = os.environ.get("LUX_METHOD_PLATFORM")
+    if env:
+        return env
+    if _platform_cache is None:
+        import jax
+
+        _platform_cache = jax.default_backend()
+    return _platform_cache
+
+
+def resolve(method: str, reduce: str = "sum",
+            platform: str | None = None) -> str:
+    """``"auto"`` -> the measured winner for (platform, reduce); concrete
+    methods pass through unchanged (explicit user choice always wins)."""
+    if method != "auto":
+        return method
+    plat = platform if platform is not None else default_platform()
+    return WINNERS.get((plat, reduce), FALLBACK)

@@ -31,6 +31,7 @@ from typing import Any, Callable, Protocol
 import jax
 import jax.numpy as jnp
 
+from lux_tpu.engine import methods
 from lux_tpu.graph.shards import ShardArrays, ShardSpec
 from lux_tpu.ops import segment
 
@@ -98,11 +99,12 @@ def _pull_iteration(prog, spec: ShardSpec, method, arrays, state):
     )(arrays, state)
 
 
-def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "scan"):
+def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "auto"):
     """Jitted SINGLE pull iteration over the whole shard stack (verbose
     mode / step-wise drivers).  The state buffer is donated — the ping-pong
     double buffer of the reference (dist_lr[2], core/graph.h:83) without
     holding both copies."""
+    method = methods.resolve(method, prog.reduce)
 
     @partial(jax.jit, donate_argnums=1)
     def step(arrays, state):
@@ -111,7 +113,7 @@ def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "scan"):
     return step
 
 
-def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "scan"):
+def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "auto"):
     """One pull iteration as THREE separately-jitted, fence-able sub-steps
     — the per-phase observability of the reference's -verbose kernel timers
     (loadTime/compTime/updateTime, sssp_gpu.cu:513-518):
@@ -126,6 +128,7 @@ def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "scan"
     fusion — this is the observability path; run_pull_fixed is the perf
     path.  Returns (load, comp, update).
     """
+    method = methods.resolve(method, prog.reduce)
 
     @jax.jit
     def load(arrays, state):
@@ -172,14 +175,15 @@ def run_pull_fixed(
     arrays: ShardArrays,
     state0: jnp.ndarray,
     num_iters: int,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Single-device driver: fixed iteration count (PageRank/CF style,
     pagerank/pagerank.cc:109-114).  Whole loop stays on device; the
     compiled program is cached on (prog, spec, num_iters, method).
-
-    Returns the final stacked (P, V, ...) state.
+    ``method="auto"`` resolves to the platform's measured winner
+    (engine.methods).  Returns the final stacked (P, V, ...) state.
     """
+    method = methods.resolve(method, prog.reduce)
     arrays = jax.tree.map(jnp.asarray, arrays)
     return _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0)
 
@@ -191,7 +195,7 @@ def run_pull_until(
     state0: jnp.ndarray,
     max_iters: int,
     active_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Single-device driver: iterate until no vertex is active (the push-app
     convergence contract — total active count == 0, sssp/sssp.cc:115-129 —
@@ -201,6 +205,7 @@ def run_pull_until(
     pass a top-level function (hashable) so the compiled loop caches.
     Returns (final_state, num_iters_run).
     """
+    method = methods.resolve(method, prog.reduce)
     arrays = jax.tree.map(jnp.asarray, arrays)
     return _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays, state0)
 

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from lux_tpu.engine import methods
 from lux_tpu.graph.push_shards import PushArrays, PushShards, PushSpec, SRC_SENTINEL
 from lux_tpu.graph.shards import ShardArrays, ShardSpec
 from lux_tpu.ops import segment
@@ -157,6 +158,10 @@ class PushCarry(NamedTuple):
     #: per-part sparse-round walked out-edge totals since the last driver
     #: checkpoint, float32 (P,) — a load ESTIMATE for the repartition
     #: policy (engine/repartition.py), not an exact counter like `edges`.
+    #: float32 absorbs increments once a part's window total passes 2^24
+    #: (~16.7M edges), degrading toward UNDERestimating hot parts — keep
+    #: --repartition-every windows short on big graphs (the policy only
+    #: needs the imbalance ratio, not absolute totals).
     #: Dense-round work is `dense_rounds * static part edge count`, kept
     #: out of the carry (the host derives it from the cuts).
     sp_work: Any
@@ -327,11 +332,23 @@ def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
     return _push_requeue(prog, pspec, spec, arrays, c, new, preps, use_dense)
 
 
-@lru_cache(maxsize=64)
-def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec, method: str):
+def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
+                       method: str = "auto"):
     """Single-device push loop with a DYNAMIC iteration stop (one compile
     serves every run length and every adaptive-repartition window; the
-    driver inspects the carry's load stats between windows)."""
+    driver inspects the carry's load stats between windows).
+
+    Resolution happens OUTSIDE the compile cache: caching on "auto" would
+    pin the first platform resolution for the process and split the cache
+    between "auto" and its concrete equivalent."""
+    return _compile_push_chunk_cached(
+        prog, pspec, spec, methods.resolve(method, prog.reduce)
+    )
+
+
+@lru_cache(maxsize=64)
+def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
+                               method: str):
 
     @jax.jit
     def loop(arrays, parrays, carry: PushCarry, it_stop):
@@ -346,9 +363,17 @@ def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec, method: str):
     return loop
 
 
-@lru_cache(maxsize=64)
 def compile_push_phases(prog, pspec: PushSpec, spec: ShardSpec,
-                        method: str = "scan"):
+                        method: str = "auto"):
+    """Uncached resolution shim — see compile_push_chunk."""
+    return _compile_push_phases_cached(
+        prog, pspec, spec, methods.resolve(method, prog.reduce)
+    )
+
+
+@lru_cache(maxsize=64)
+def _compile_push_phases_cached(prog, pspec: PushSpec, spec: ShardSpec,
+                                method: str):
     """One push iteration as THREE separately-jitted sub-steps for the
     -verbose phase breakdown (the reference's per-iteration
     loadTime/compTime/updateTime, sssp_gpu.cu:513-518):
@@ -380,12 +405,19 @@ def compile_push_phases(prog, pspec: PushSpec, spec: ShardSpec,
     return load, comp, update
 
 
-@lru_cache(maxsize=64)
-def compile_push_step(prog, pspec: PushSpec, spec: ShardSpec, method: str = "scan"):
+def compile_push_step(prog, pspec: PushSpec, spec: ShardSpec, method: str = "auto"):
     """Jitted SINGLE iteration (verbose mode / step-wise drivers — the
     per-iteration observability the reference gets from -verbose kernel
     timers, sssp_gpu.cu:513-518).  The carry is donated (state/queue
     double buffers reuse HBM)."""
+    return _compile_push_step_cached(
+        prog, pspec, spec, methods.resolve(method, prog.reduce)
+    )
+
+
+@lru_cache(maxsize=64)
+def _compile_push_step_cached(prog, pspec: PushSpec, spec: ShardSpec,
+                              method: str):
 
     @partial(jax.jit, donate_argnums=2)
     def step(arrays, parrays, carry: PushCarry):
@@ -405,13 +437,14 @@ def run_push(
     prog: PushProgram,
     shards: PushShards,
     max_iters: int = 10_000,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Single-device driver.  The direction switch is one global `lax.cond`
     over vmapped per-part branches — a genuine branch (only the taken mode
     executes; the global predicate makes this legal) with compile size O(1)
     in the part count.  Returns (final stacked state, iters, edge counter).
     """
+    method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     parrays = jax.tree.map(jnp.asarray, shards.parrays)
@@ -552,9 +585,17 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     return run
 
 
-@lru_cache(maxsize=64)
 def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
-                           method: str = "scan"):
+                           method: str = "auto"):
+    """Uncached resolution shim — see compile_push_chunk."""
+    return _compile_push_step_dist_cached(
+        prog, mesh, pspec, spec, methods.resolve(method, prog.reduce)
+    )
+
+
+@lru_cache(maxsize=64)
+def _compile_push_step_dist_cached(prog, mesh, pspec: PushSpec,
+                                   spec: ShardSpec, method: str):
     """ONE distributed direction-optimized iteration (the body of
     _compile_push_dist without the on-device while_loop) — step-wise
     observability for `-verbose --distributed`.  Takes/returns the sharded
@@ -730,11 +771,12 @@ def run_push_ring(
     shards,  # parallel.ring.PushRingShards
     mesh: Mesh,
     max_iters: int = 10_000,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Distributed push driver with the ring dense exchange.  Only the
     O(part edges) CSR/bucket arrays and O(V) vertex arrays touch the
     devices — never the pull layout's O(E) stacked arrays."""
+    method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts == mesh.devices.size
     assert method in ("scan", "scatter"), (
@@ -753,10 +795,11 @@ def run_push_dist(
     shards: PushShards,
     mesh: Mesh,
     max_iters: int = 10_000,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Distributed driver: queues (sparse rounds) or whole state (dense
     rounds) exchanged over ICI inside the on-device loop."""
+    method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts == mesh.devices.size
     arrays, parrays, carry0 = push_init_dist(prog, shards, mesh)

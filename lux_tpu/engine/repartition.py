@@ -16,6 +16,9 @@ capability:
     load split between windows, and when the estimated imbalance exceeds a
     threshold recuts with partition.weighted_cuts, rebuilds the shards,
     remaps the in-flight state + frontier to the new layout, and resumes.
+    sp_work accumulates in float32, which saturates past ~2^24 edges per
+    part per window — on big graphs keep windows short enough that no
+    part walks more than ~16M sparse out-edges between checks.
 
 Correctness: min/max label relaxation is confluent — the fixpoint is
 unique regardless of the iteration/mode schedule — so the adaptive run
@@ -97,14 +100,16 @@ def vertex_weights(work: np.ndarray, cuts: np.ndarray,
 
 def _changed_mask_from_queues(q_vid: np.ndarray, counts: np.ndarray,
                               f_cap: int, nv: int) -> np.ndarray:
-    """Global changed-vertex mask from the per-part (vid, value) queues."""
+    """Global changed-vertex mask from the per-part (vid, value) queues.
+    One vectorized gather over all parts (a per-part Python loop adds
+    O(P) host latency to every recut)."""
     assert counts.max() <= f_cap, "truncated queue: frontier unrecoverable"
+    q = np.asarray(q_vid)
+    slot = np.arange(q.shape[1])
+    vids = q[slot[None, :] < np.asarray(counts)[:, None]]
+    vids = vids[vids != SRC_SENTINEL]
     mask = np.zeros(nv, dtype=bool)
-    for p in range(q_vid.shape[0]):
-        n = int(counts[p])
-        vids = np.asarray(q_vid[p, :n])
-        vids = vids[vids != SRC_SENTINEL]
-        mask[vids] = True
+    mask[vids] = True
     return mask
 
 
@@ -191,7 +196,7 @@ def run_push_adaptive(
     chunk: int = 32,
     threshold: float = 1.25,
     max_iters: int = 10_000,
-    method: str = "scan",
+    method: str = "auto",
     mesh=None,
     on_repartition=None,
     shards=None,
@@ -214,6 +219,9 @@ def run_push_adaptive(
     enough to amortize (the policy exists for skewed long runs, not
     5-iteration BFS tails).
     """
+    from lux_tpu.engine import methods
+
+    method = methods.resolve(method, prog.reduce)
     if chunk <= 0:
         raise ValueError(f"chunk must be positive, got {chunk}")
     if exchange not in ("allgather", "ring"):

@@ -83,7 +83,7 @@ def colfilter(
     k: int = K,
     lam: float = LAMBDA,
     gamma: float = GAMMA,
-    method: str = "scan",
+    method: str = "auto",
     dtype: str = "float32",
 ) -> np.ndarray:
     """Run CF; returns the (nv, k) latent-vector matrix."""

@@ -77,7 +77,7 @@ def connected_components(
     g: HostGraph | PullShards,
     max_iters: int = 10_000,
     num_parts: int = 1,
-    method: str = "scan",
+    method: str = "auto",
 ) -> np.ndarray:
     """Run CC to convergence; returns (nv,) int32 labels."""
     shards = g if isinstance(g, PullShards) else build_pull_shards(g, num_parts)
@@ -95,7 +95,7 @@ def connected_components_push(
     max_iters: int = 10_000,
     num_parts: int = 1,
     mesh=None,
-    method: str = "scan",
+    method: str = "auto",
     exchange: str = "allgather",
     repartition_every: int = 0,
     repartition_threshold: float = 1.25,

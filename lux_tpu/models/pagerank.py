@@ -64,7 +64,7 @@ def pagerank(
     g: HostGraph | PullShards,
     num_iters: int = 10,
     num_parts: int = 1,
-    method: str = "scan",
+    method: str = "auto",
     dtype: str = "float32",
 ) -> np.ndarray:
     """Run PageRank; returns the (nv,) pre-divided rank vector (same

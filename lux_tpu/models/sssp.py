@@ -144,7 +144,7 @@ def sssp(
     mesh: Mesh | None = None,
     max_iters: int = 10_000,
     weighted: bool = False,
-    method: str = "scan",
+    method: str = "auto",
     exchange: str = "allgather",
     repartition_every: int = 0,
     repartition_threshold: float = 1.25,

@@ -70,25 +70,39 @@ def run_pull_fixed_dist(
     state0: jnp.ndarray,
     num_iters: int,
     mesh: Mesh,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Fixed-iteration distributed pull (PageRank/CF).  ``arrays`` and
     ``state0`` are stacked (P, ...) with P == mesh size; returns the final
     stacked state (sharded)."""
+    from lux_tpu.engine import methods
+
+    method = methods.resolve(method, prog.reduce)
     assert spec.num_parts == mesh.devices.size, (spec.num_parts, mesh.shape)
     arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, arrays))
     state0 = shard_stacked(mesh, state0)
     return _compile_fixed(prog, mesh, num_iters, method)(arrays, state0)
 
 
-@lru_cache(maxsize=64)
-def compile_pull_step_dist(prog, mesh, method: str = "scan"):
+def compile_pull_step_dist(prog, mesh, method: str = "auto"):
     """ONE distributed pull iteration (all_gather + local step) — the
     step-wise observability mode for `-verbose --distributed`: the host
     fences per iteration (like the reference's per-iteration kernel
     timers), trading the fused on-device loop for stats.  The state is
     donated — ping-pong double buffering like the single-device
-    compile_pull_step."""
+    compile_pull_step.
+
+    Resolution happens OUTSIDE the compile cache: caching on "auto" would
+    pin the first platform resolution for the process."""
+    from lux_tpu.engine import methods
+
+    return _compile_step_dist_cached(
+        prog, mesh, methods.resolve(method, prog.reduce)
+    )
+
+
+@lru_cache(maxsize=64)
+def _compile_step_dist_cached(prog, mesh, method: str):
 
     @partial(jax.jit, donate_argnums=1)
     @partial(
@@ -147,7 +161,7 @@ def run_pull_until_dist(
     max_iters: int,
     active_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
     mesh: Mesh,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Convergence-driven distributed pull (CC/SSSP): iterate until the
     global active count (psum over parts) reaches zero.
@@ -157,6 +171,9 @@ def run_pull_until_dist(
     compiled program can be cached).
     Returns (final stacked state, iterations run).
     """
+    from lux_tpu.engine import methods
+
+    method = methods.resolve(method, prog.reduce)
     assert spec.num_parts == mesh.devices.size, (spec.num_parts, mesh.shape)
     arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, arrays))
     state0 = shard_stacked(mesh, state0)

@@ -279,11 +279,14 @@ def run_pull_until_2d(
     max_iters: int,
     active_fn,
     mesh: Mesh,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Convergence-driven pull over the 2-D mesh (CC-style): iterate until
     the global active count reaches zero.  active_fn must be a hashable
     top-level function (compiled-program cache key)."""
+    from lux_tpu.engine import methods
+
+    method = methods.resolve(method, prog.reduce)
     arrays, state0 = _place_edge2d(shards, state0, mesh, method)
     run = _compile_edge2d_until(prog, mesh, max_iters, active_fn, method)
     return run(arrays, state0)
@@ -319,10 +322,13 @@ def run_pull_fixed_2d(
     state0,
     num_iters: int,
     mesh: Mesh,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Fixed-iteration pull over the 2-D (parts, edge) mesh.  ``state0`` is
     the stacked (P, V, ...) state (engine.pull.init_state)."""
+    from lux_tpu.engine import methods
+
+    method = methods.resolve(method, prog.reduce)
     arrays, state0 = _place_edge2d(shards, state0, mesh, method)
     run = _compile_edge2d_fixed(prog, mesh, num_iters, method)
     return run(arrays, state0)

@@ -335,11 +335,14 @@ def run_pull_fixed_ring(
     state0,
     num_iters: int,
     mesh: Mesh,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Distributed fixed-iteration pull with ring-streamed state blocks.
     Signature-compatible with dist.run_pull_fixed_dist: pass the stacked
     (P, V, ...) initial state (e.g. from engine.pull.init_state)."""
+    from lux_tpu.engine import methods
+
+    method = methods.resolve(method, prog.reduce)
     spec = shards.spec
     assert spec.num_parts == mesh.devices.size
     assert len(shards.parts_subset) == spec.num_parts, (

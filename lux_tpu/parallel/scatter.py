@@ -199,9 +199,12 @@ def run_pull_fixed_scatter(
     state0,
     num_iters: int,
     mesh: Mesh,
-    method: str = "scan",
+    method: str = "auto",
 ):
     """Distributed fixed-iteration pull with reduce_scatter exchange."""
+    from lux_tpu.engine import methods
+
+    method = methods.resolve(method, prog.reduce)
     spec = shards.spec
     assert spec.num_parts == mesh.devices.size
     assert len(shards.parts_subset) == spec.num_parts, (

@@ -24,7 +24,9 @@ class RunConfig:
     verbose: bool = False  # -verbose/-v: per-iteration stats
     check: bool = False  # -check/-c: run the invariant validator
     max_iters: int = 10_000  # convergence-app safety bound
-    method: str = "scan"  # segment-reduction strategy
+    #: segment-reduction strategy; "auto" resolves to the platform's
+    #: measured winner at driver entry (lux_tpu.engine.methods)
+    method: str = "auto"
     distributed: bool = False  # place parts on a device mesh
     rmat_scale: int = 16  # synthetic graph size when file is None
     rmat_ef: int = 8
@@ -68,8 +70,11 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
     ap.add_argument("-verbose", "-v", action="store_true")
     ap.add_argument("-check", "-c", action="store_true")
     ap.add_argument("--max-iters", type=int, default=10_000)
-    ap.add_argument("--method", default="scan",
-                    choices=["scan", "cumsum", "mxsum", "scatter", "pallas"])
+    ap.add_argument("--method", default="auto",
+                    choices=["auto", "scan", "cumsum", "mxsum", "scatter",
+                             "pallas"],
+                    help="segment-reduction strategy; auto = the measured "
+                         "per-platform winner (engine.methods)")
     ap.add_argument("--distributed", action="store_true",
                     help="shard parts over the device mesh")
     ap.add_argument("--rmat-scale", type=int, default=16)

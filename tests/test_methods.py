@@ -1,0 +1,69 @@
+"""Platform-aware method resolution (engine.methods): defaults follow
+measured winners (PERF.md), explicit choices pass through untouched."""
+import numpy as np
+
+from lux_tpu.engine import methods
+
+
+def test_explicit_method_passes_through():
+    assert methods.resolve("scatter", "sum", "tpu") == "scatter"
+    assert methods.resolve("mxsum", "sum", "cpu") == "mxsum"
+    assert methods.resolve("scan", "min", "cpu") == "scan"
+
+
+def test_measured_winners():
+    # CPU: scatter beats scan ~2x on the comp phase (BASELINE.md r2 table)
+    assert methods.resolve("auto", "sum", "cpu") == "scatter"
+    assert methods.resolve("auto", "min", "cpu") == "scatter"
+    assert methods.resolve("auto", "max", "cpu") == "scatter"
+    # TPU: XLA scatter serializes on-chip (PERF.md r2: 0.06 GTEPS)
+    assert methods.resolve("auto", "sum", "tpu") == "scan"
+    assert methods.resolve("auto", "min", "tpu") == "scan"
+    assert methods.resolve("auto", "max", "tpu") == "scan"
+
+
+def test_unknown_platform_falls_back_portable():
+    assert methods.resolve("auto", "sum", "gpu") == methods.FALLBACK
+
+
+def test_resolution_is_always_concrete_and_universally_valid():
+    # the winner set must stay within {scan, scatter}: cumsum/mxsum are
+    # sum-only and pallas needs the block-CSR layout
+    for plat in ("cpu", "tpu", "gpu", "weird"):
+        for red in ("sum", "min", "max"):
+            m = methods.resolve("auto", red, plat)
+            assert m in ("scan", "scatter")
+
+
+def test_platform_env_override(monkeypatch):
+    monkeypatch.setenv("LUX_METHOD_PLATFORM", "tpu")
+    assert methods.resolve("auto") == "scan"
+    monkeypatch.setenv("LUX_METHOD_PLATFORM", "cpu")
+    assert methods.resolve("auto") == "scatter"
+
+
+def test_default_platform_detects_cpu_harness(monkeypatch):
+    monkeypatch.delenv("LUX_METHOD_PLATFORM", raising=False)
+    # the test harness pins JAX_PLATFORMS=cpu (conftest)
+    assert methods.default_platform() == "cpu"
+    assert methods.resolve("auto") == "scatter"
+
+
+def test_cli_default_is_auto():
+    from lux_tpu.utils.config import parse_args
+
+    cfg = parse_args([])
+    assert cfg.method == "auto"
+
+
+def test_auto_runs_and_matches_resolved_concrete():
+    # engine-level: method="auto" must produce bitwise the same result as
+    # passing the resolved concrete method explicitly
+    from lux_tpu.models import pagerank as pr
+    from lux_tpu.graph import generate
+
+    g = generate.rmat(8, 4, seed=3)
+    concrete = methods.resolve("auto", "sum")
+    a = pr.pagerank(g, num_iters=4, method="auto")
+    b = pr.pagerank(g, num_iters=4, method=concrete)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
