@@ -20,7 +20,22 @@ from lux_tpu.utils.timing import IterStats, Timer, report_elapsed
 
 
 def build_push_app_shards(g, cfg):
-    """Push shards for the selected dense-round --exchange strategy."""
+    """Push shards for the selected dense-round --exchange strategy (or
+    the block-CSR layout when the dense rounds run the Pallas kernel)."""
+    if cfg.method == "pallas":
+        if cfg.exchange != "allgather":
+            raise SystemExit(
+                "--method pallas has its own dense path; it cannot combine "
+                "with --exchange ring"
+            )
+        if not cfg.distributed:
+            raise SystemExit(
+                "--method pallas (push) runs on a device mesh: add "
+                "--distributed (single chip = -ng 1 --distributed)"
+            )
+        from lux_tpu.parallel.pallas_dist import build_push_pallas_shards
+
+        return build_push_pallas_shards(g, cfg.num_parts)
     if cfg.exchange == "ring":
         if not cfg.distributed:
             raise SystemExit("--exchange ring requires --distributed")
@@ -44,10 +59,11 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
             f"programs only (this app reduces with {prog.reduce})"
         )
     if cfg.method == "pallas":
-        raise SystemExit(
-            "--method pallas is wired to the pull engine (pagerank); "
-            "frontier apps use scan/scatter"
-        )
+        if cfg.verbose or cfg.repartition_every:
+            raise SystemExit(
+                "--method pallas: -verbose/--repartition-every are not "
+                "wired to the kernel path; use --method scan/scatter"
+            )
     if cfg.ckpt_every or cfg.ckpt_dir:
         # honest gating beats silent ignoring: the frontier carry (queues +
         # counts) is not serialized; fixed-iteration apps own checkpointing
@@ -63,7 +79,12 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 "--repartition-every runs the engine in windows; the "
                 "per-iteration -verbose fence is not available"
             )
-    if cfg.exchange == "ring":
+    if cfg.method == "pallas":
+        est = preflight.estimate_push_pallas(
+            shards.spec, shards.pspec, shards.pl.e_src_pos.shape[1],
+            shards.t_chunk,
+        )
+    elif cfg.exchange == "ring":
         est = preflight.estimate_push_ring(
             shards.spec, shards.pspec, shards.e_bucket_pad
         )
@@ -133,6 +154,16 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 stats.record(it, int(carry.active), t.stop(carry.state))
                 it += 1
             state, iters, edges = carry.state, it, carry.edges
+        elif cfg.method == "pallas":
+            import jax
+
+            from lux_tpu.parallel import pallas_dist as pd
+
+            # interpret mode off-TPU so CPU smoke runs work; Mosaic on chip
+            interp = jax.devices()[0].platform not in ("tpu", "axon")
+            state, iters, edges = pd.run_push_pallas_dist(
+                prog, shards, mesh, cfg.max_iters, interpret=interp
+            )
         elif mesh is None:
             state, iters, edges = push.run_push(
                 prog, shards, cfg.max_iters, cfg.method

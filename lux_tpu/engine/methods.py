@@ -62,11 +62,20 @@ def default_platform() -> str:
     return _platform_cache
 
 
+def _normalize(platform: str) -> str:
+    """'axon' is this environment's tunneled-TPU PJRT plugin — the chip
+    behind it IS a TPU, so it must take the tpu rows (not FALLBACK, which
+    would silently diverge the moment a tpu row changes)."""
+    return "tpu" if platform == "axon" else platform
+
+
 def resolve(method: str, reduce: str = "sum",
             platform: str | None = None) -> str:
     """``"auto"`` -> the measured winner for (platform, reduce); concrete
     methods pass through unchanged (explicit user choice always wins)."""
     if method != "auto":
         return method
-    plat = platform if platform is not None else default_platform()
-    return WINNERS.get((plat, reduce), FALLBACK)
+    plat = _normalize(platform if platform is not None else default_platform())
+    chosen = WINNERS.get((plat, reduce), FALLBACK)
+    assert chosen in CONCRETE, (chosen, plat, reduce)
+    return chosen

@@ -139,13 +139,28 @@ def build_blockcsr(
     )
 
 
+def reduce_neutral(op: str, dtype) -> jnp.ndarray:
+    """min/max identity for ``dtype`` (ints: the iinfo bound — the push
+    apps' labels/distances are int32, where +-inf does not exist and a
+    float detour would lose exactness past 2^24)."""
+    d = jnp.dtype(dtype)
+    if jnp.issubdtype(d, jnp.integer):
+        info = jnp.iinfo(d)
+        return jnp.asarray(info.max if op == "min" else info.min, d)
+    return jnp.asarray(jnp.inf if op == "min" else -jnp.inf, d)
+
+
 def _spmv_kernel(op: str, v_blk: int, compute_dtype,
                  chunk_block_ref, chunk_first_ref, vals_ref, dst_ref,
                  out_ref):
     """Out block is a COLUMN (v_blk, 1): the MXU contraction result
     (V_BLK, 1) and the lane-reduced min/max (keepdims) are both
     sublane-major, so accumulation never needs a sublane<->lane relayout
-    (the transposes Mosaic would otherwise insert per grid step)."""
+    (the transposes Mosaic would otherwise insert per grid step).
+
+    sum rides the MXU (one-hot contraction); min/max are masked VPU lane
+    reductions over the same one-hot mask, dtype-preserving (int32 labels
+    stay int32 — no float roundtrip)."""
     import jax.experimental.pallas as pl
 
     i = pl.program_id(0)
@@ -154,10 +169,10 @@ def _spmv_kernel(op: str, v_blk: int, compute_dtype,
     def _():
         if op == "sum":
             out_ref[:] = jnp.zeros_like(out_ref)
-        elif op == "min":
-            out_ref[:] = jnp.full_like(out_ref, jnp.inf)
         else:
-            out_ref[:] = jnp.full_like(out_ref, -jnp.inf)
+            out_ref[:] = jnp.full_like(
+                out_ref, reduce_neutral(op, out_ref.dtype)
+            )
 
     dst = dst_ref[0]  # (1, T)
     vals = vals_ref[0]  # (1, T)
@@ -175,12 +190,17 @@ def _spmv_kernel(op: str, v_blk: int, compute_dtype,
             preferred_element_type=jnp.float32,
         )  # (V_BLK, 1)
         out_ref[:] = out_ref[:] + contrib
-    elif op == "min":
-        masked = jnp.where(onehot, jnp.broadcast_to(vals, onehot.shape), jnp.inf)
-        out_ref[:] = jnp.minimum(out_ref[:], jnp.min(masked, axis=1, keepdims=True))
     else:
-        masked = jnp.where(onehot, jnp.broadcast_to(vals, onehot.shape), -jnp.inf)
-        out_ref[:] = jnp.maximum(out_ref[:], jnp.max(masked, axis=1, keepdims=True))
+        neutral = reduce_neutral(op, vals.dtype)
+        masked = jnp.where(onehot, jnp.broadcast_to(vals, onehot.shape), neutral)
+        if op == "min":
+            out_ref[:] = jnp.minimum(
+                out_ref[:], jnp.min(masked, axis=1, keepdims=True)
+            )
+        else:
+            out_ref[:] = jnp.maximum(
+                out_ref[:], jnp.max(masked, axis=1, keepdims=True)
+            )
 
 
 @functools.partial(
@@ -198,12 +218,15 @@ def spmv_blockcsr(
     interpret: bool = False,
     compute_dtype: str = "float32",
 ):
-    """Segmented reduction -> (num_vblocks * v_blk,) via the Pallas kernel."""
+    """Segmented reduction -> (num_vblocks * v_blk,) via the Pallas kernel.
+    sum accumulates/returns float32; min/max preserve the input dtype
+    (int32 labels stay exact)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if not num_vblocks:
         raise ValueError("num_vblocks is required (use BlockCSR.num_vblocks)")
+    out_dtype = jnp.float32 if op == "sum" else edge_vals.dtype
     num_chunks, t = edge_vals.shape
     # Mosaic block rule: a block's last two dims must be sublane/lane
     # aligned (8/128) OR equal the array's.  A (1, t) block over (C, t)
@@ -225,7 +248,7 @@ def spmv_blockcsr(
     out = pl.pallas_call(
         functools.partial(_spmv_kernel, op, v_blk, jnp.dtype(compute_dtype)),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((num_vblocks * v_blk, 1), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((num_vblocks * v_blk, 1), out_dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),

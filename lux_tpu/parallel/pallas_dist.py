@@ -85,12 +85,17 @@ def build_pallas_parts(
     num_parts: int,
     v_blk: Optional[int] = None,
     t_chunk: Optional[int] = None,
+    base=None,
 ) -> PallasParts:
     """Partition + block-CSR re-layout for the distributed Pallas pull.
 
     Reuses the edge-balanced shard geometry (same cuts/padding as
-    build_pull_shards, so states are interchangeable across engines)."""
-    base = build_pull_shards(g, num_parts)
+    build_pull_shards, so states are interchangeable across engines).
+    ``base`` optionally supplies already-built pull shards (the push
+    variant shares them with its CSR layout instead of re-partitioning).
+    """
+    if base is None:
+        base = build_pull_shards(g, num_parts)
     spec, cuts, arr = base.spec, base.cuts, base.arrays
     kw = {}
     if v_blk:
@@ -266,6 +271,154 @@ def _compile_fixed_pallas_2d(prog, mesh, num_iters: int, num_vblocks: int,
         return out[None]
 
     return run
+
+
+@dataclasses.dataclass
+class PushPallasShards:
+    """Push-engine layout whose DENSE rounds reduce on the Pallas kernel:
+    the sparse-round CSR/queues come from build_push_shards, the dense
+    rounds use the block-CSR chunk arrays (gathered-coordinate sources)
+    instead of the pull layout's O(E) stacked arrays — the per-part hot
+    loop the reference tunes in components_gpu.cu:85-130, on the VPU/MXU.
+    """
+
+    push: Any  # PushShards (pspec, spec, cuts, parrays, arrays)
+    pl: PallasArrays
+    num_vblocks: int
+    v_blk: int
+    t_chunk: int
+
+    @property
+    def spec(self):
+        return self.push.spec
+
+    @property
+    def pspec(self):
+        return self.push.pspec
+
+    @property
+    def cuts(self):
+        return self.push.cuts
+
+    @property
+    def pull(self):
+        return self.push.pull
+
+    def scatter_to_global(self, stacked: np.ndarray) -> np.ndarray:
+        return self.push.scatter_to_global(stacked)
+
+
+def build_push_pallas_shards(
+    g: HostGraph,
+    num_parts: int,
+    v_blk: Optional[int] = None,
+    t_chunk: Optional[int] = None,
+    cuts=None,
+) -> PushPallasShards:
+    """Push shards + the block-CSR dense-round layout, sharing one
+    edge-balanced partitioning (states interchangeable with every other
+    push engine)."""
+    from lux_tpu.graph.push_shards import build_push_shards
+
+    push_sh = build_push_shards(g, num_parts, cuts=cuts)
+    pp = build_pallas_parts(
+        g, num_parts, v_blk=v_blk, t_chunk=t_chunk, base=push_sh.pull
+    )
+    return PushPallasShards(
+        push=push_sh, pl=pp.arrays, num_vblocks=pp.num_vblocks,
+        v_blk=pp.v_blk, t_chunk=pp.t_chunk,
+    )
+
+
+@lru_cache(maxsize=64)
+def _compile_push_pallas(prog, mesh, pspec, spec, num_vblocks: int,
+                         v_blk: int, interpret: bool):
+    """Direction-optimizing push whose dense rounds run the Pallas min/max
+    reduce: same sparse-round queue exchange + global mode predicate as
+    push._compile_push_dist (the shared _spmd_push_iter body); the dense
+    branch all_gathers the state and reduces each part's in-edges with the
+    masked-VPU one-hot kernel instead of an XLA segmented reduce."""
+    from lux_tpu.engine import push as pe
+    from lux_tpu.graph.push_shards import PushArrays
+
+    pl_specs = PallasArrays(*([P(PARTS_AXIS)] * len(PallasArrays._fields)))
+    parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
+    view_specs = pe.VertexView(*([P(PARTS_AXIS)] * len(pe.VertexView._fields)))
+    carry_specs = pe._carry_specs()
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(pl_specs, parr_specs, view_specs, carry_specs, P()),
+        out_specs=carry_specs,
+        check_vma=False,  # pallas out_shape carries no vma (see above)
+    )
+    def run(pl_blk, parr_blk, view_blk, carry_blk, it_stop):
+        pl = jax.tree.map(lambda a: a[0], pl_blk)
+        parr = jax.tree.map(lambda a: a[0], parr_blk)
+        view = jax.tree.map(lambda a: a[0], view_blk)
+        op = jnp.minimum if prog.reduce == "min" else jnp.maximum
+
+        def dense_fn(local):
+            full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
+            # (C, T) gather + relax in XLA; dtype-preserving kernel reduce
+            vals = prog.relax(full[pl.e_src_pos], pl.e_weight)
+            acc = ps.spmv_blockcsr(
+                vals, pl.e_dst_rel, pl.chunk_block, pl.chunk_first,
+                op=prog.reduce, v_blk=v_blk, num_vblocks=num_vblocks,
+                interpret=interpret,
+            )[: spec.nv_pad]
+            return jnp.where(view.vtx_mask, op(local, acc), local)
+
+        def cond(c):
+            return (c.active > 0) & (c.it < it_stop)
+
+        def body(c):
+            return pe._spmd_push_iter(prog, pspec, spec, parr, view, dense_fn, c)
+
+        out = jax.lax.while_loop(cond, body, pe._carry_local(carry_blk))
+        return pe.PushCarry(
+            out.state[None], out.q_vid[None], out.q_val[None],
+            out.count[None], out.it, out.active, out.edges,
+            out.sp_work[None], out.dense_rounds,
+        )
+
+    return run
+
+
+def run_push_pallas_dist(
+    prog,
+    shards: PushPallasShards,
+    mesh: Mesh,
+    max_iters: int = 10_000,
+    interpret: bool = False,
+):
+    """Distributed push driver with Pallas dense rounds (min/max frontier
+    programs: SSSP/CC).  Only the block-CSR chunks, the sparse CSR, and
+    the O(V) vertex view touch the devices — never the pull layout's O(E)
+    stacked arrays.  Returns (stacked state, iters, edge counter)."""
+    from lux_tpu.engine import push as pe
+
+    if prog.reduce not in ("min", "max"):
+        raise ValueError(
+            "pallas push drives min/max frontier programs; sum programs "
+            "use the pull engines"
+        )
+    spec, pspec = shards.spec, shards.pspec
+    assert spec.num_parts == mesh.devices.size
+    pl = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.pl))
+    parrays = shard_stacked(
+        mesh, jax.tree.map(jnp.asarray, shards.push.parrays)
+    )
+    view_h = jax.tree.map(jnp.asarray, pe.vertex_view(shards.push.arrays))
+    view = shard_stacked(mesh, view_h)
+    carry0 = pe.shard_carry(mesh, pe._init_carry(prog, pspec, view_h))
+    run = _compile_push_pallas(
+        prog, mesh, pspec, spec, shards.num_vblocks, shards.v_blk, interpret
+    )
+    out = run(pl, parrays, view, carry0, jnp.int32(max_iters))
+    return out.state, out.it, out.edges
 
 
 def run_cf_pallas_dist(

@@ -120,6 +120,26 @@ def estimate_scatter(spec: ShardSpec, e_bucket_pad: int, state_width: int = 1,
     return MemoryEstimate(shard, state, partials, shard + state + partials)
 
 
+def estimate_push_pallas(spec: ShardSpec, pspec: PushSpec, num_chunks: int,
+                         t_chunk: int,
+                         state_dtype_bytes: int = 4) -> MemoryEstimate:
+    """Per-chip footprint of the push engine with Pallas dense rounds:
+    block-CSR chunk arrays + frontier CSR/queues/sparse buffer; no O(E)
+    pull-layout arrays on device (the dense reduce reads the chunks)."""
+    U, E, F = pspec.u_pad, spec.e_pad, pspec.f_cap
+    Pn, V = spec.num_parts, spec.nv_pad
+    ct = num_chunks * t_chunk
+    blockcsr = 4 * ct * 2 + (4 * ct if spec.weighted else 0) + 4 * num_chunks * 2
+    csr = 4 * U + 4 * (U + 1) + 4 * E + 4 * E  # uniq, rp, dst, weight
+    view = V * 9  # global_vid, degree, vtx_mask
+    shard = blockcsr + csr + view
+    queues = 2 * 4 * F * 2 + 2 * 4 * Pn * F
+    sparse_buf = 4 * pspec.e_sp * 3
+    state = 2 * V * state_dtype_bytes + queues + sparse_buf
+    gathered = spec.gathered_size * state_dtype_bytes + 4 * ct  # + edge vals
+    return MemoryEstimate(shard, state, gathered, shard + state + gathered)
+
+
 def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None) -> bool:
     """Warn (returns False) if the estimate exceeds the device HBM."""
     if hbm_bytes is None:

@@ -32,7 +32,16 @@ def test_resolution_is_always_concrete_and_universally_valid():
     for plat in ("cpu", "tpu", "gpu", "weird"):
         for red in ("sum", "min", "max"):
             m = methods.resolve("auto", red, plat)
+            assert m in methods.CONCRETE
             assert m in ("scan", "scatter")
+
+
+def test_axon_platform_takes_tpu_rows():
+    # 'axon' is the tunneled-TPU plugin: it must resolve exactly like tpu
+    for red in ("sum", "min", "max"):
+        assert methods.resolve("auto", red, "axon") == methods.resolve(
+            "auto", red, "tpu"
+        )
 
 
 def test_platform_env_override(monkeypatch):
