@@ -1,13 +1,20 @@
-"""Benchmark harness: PageRank GTEPS on one chip.
+"""Benchmark harness: PageRank + SSSP + CF on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON metric line per app family, HEADLINE LAST: the final
+stdout line is always the PageRank number ({"metric", "value", "unit",
+"vs_baseline"}) the driver records; the preceding lines carry the SSSP
+(traversed-edges GTEPS) and CF (edge-update GTEPS + per-iteration ms +
+RMSE) datapoints so all four reference apps but CC (structurally the
+same engine as SSSP) have tracked perf signals (VERDICT r2 #4).
 
 Metric derivation (BASELINE.md): GTEPS = iterations * ne / elapsed / 1e9 on
 a fixed-iteration PageRank run — the reference's headline workload
 (pagerank 10 iters, README.md:41; ELAPSED TIME timer at
-pagerank/pagerank.cc:108-118).  The reference repo publishes no numbers
-(BASELINE.md), so vs_baseline is computed against BASELINE_GTEPS_PER_CHIP,
-our documented estimate of the paper-era per-GPU rate.
+pagerank/pagerank.cc:108-118); SSSP divides edges ACTUALLY traversed
+(the engine's exact on-device counter) by elapsed.  The reference repo
+publishes no numbers (BASELINE.md), so vs_baseline is computed against
+BASELINE_GTEPS_PER_CHIP, our documented estimate of the paper-era
+per-GPU rate.
 
 Process architecture (docs/NOTES_ROUND1.md hard lessons): the TPU tunnel in
 this environment can hang INSIDE PJRT C++ device init, where a same-process
@@ -29,6 +36,8 @@ Env knobs:
   LUX_BENCH_TPU_S  (default budget-120) how long to wait for the TPU worker
   LUX_BENCH_CPU_SCALE (default min(scale, 18)) fallback worker's RMAT scale
                    — a 1-core CPU needs a smaller graph to finish in budget
+  LUX_BENCH_APPS   (default pagerank,sssp,colfilter) which app metrics to
+                   measure; pagerank is the headline and always prints last
 """
 from __future__ import annotations
 
@@ -95,6 +104,7 @@ def worker_main():
         pass
 
     from lux_tpu.engine import pull
+    from lux_tpu.engine.methods import resolve as resolve_method
     from lux_tpu.graph import generate
     from lux_tpu.graph.shards import build_pull_shards
     from lux_tpu.models.pagerank import PageRankProgram
@@ -203,26 +213,177 @@ def worker_main():
             }
         )
 
-    for m in methods:
+    apps = [
+        a.strip()
+        for a in os.environ.get(
+            "LUX_BENCH_APPS", "pagerank,sssp,colfilter"
+        ).split(",")
+        if a.strip()
+    ]
+    suffix = "" if on_tpu else f"_{platform}_fallback"
+
+    def measure_sssp():
+        """Convergence-driven BFS-SSSP; GTEPS over edges ACTUALLY
+        traversed (the engine's exact [hi, lo] counter — dense rounds walk
+        every edge, sparse rounds only the frontier's; SURVEY.md §6).
+        Timing uses the same fetch-differencing discipline: the chunk loop
+        takes a DYNAMIC it_stop, so t(full) - t(1) is the honest marginal
+        cost of the remaining iterations under one compiled program."""
+        import numpy as np
+
+        from lux_tpu.engine import push as push_eng
+        from lux_tpu.graph.push_shards import build_push_shards
+        from lux_tpu.models.sssp import SSSPProgram
+
+        m = resolve_method("auto", "min", platform)
+        pshards = build_push_shards(g, 1)
+        # start at the max-out-degree vertex: a fixed start (the CLI's
+        # default 0) can have zero out-edges on an RMAT draw, making the
+        # metric a meaningless 0.0/traversed=0 line
+        start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
+        sp = SSSPProgram(nv=pshards.spec.nv, start=start)
+        arrays_p, parrays_p, carry0 = push_eng.push_init(sp, pshards)
+        loop = push_eng.compile_push_chunk(sp, pshards.pspec, pshards.spec, m)
+
+        def run(n):
+            # the chunk loop does not donate its arguments: one carry0 is
+            # safely reusable across timed runs
+            return loop(arrays_p, parrays_p, carry0, jnp.int32(n))
+
+        full = run(10_000)  # warm + converge
+        float(jax.device_get(full.state.ravel()[0]))
+        n_iters = int(full.it)
+        traversed = push_eng.edges_total(jax.device_get(full.edges))
+        float(jax.device_get(run(1).state.ravel()[0]))  # warm the 1-stop
+
+        def once(n):
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = run(n)
+                float(jax.device_get(out.state.ravel()[0]))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        if n_iters > 1:
+            per_iter = max((once(n_iters) - once(1)) / (n_iters - 1), 1e-9)
+            elapsed = per_iter * n_iters
+        else:
+            elapsed = once(n_iters)
+        gteps = traversed / elapsed / 1e9
+        _emit(
+            {
+                "metric": f"sssp_gteps_rmat{scale}_1chip{suffix}",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
+                "start": start,
+                "iters": n_iters,
+                "traversed_edges": traversed,
+            }
+        )
+
+    def measure_cf(m):
+        """Fixed-iteration CF (K=20 latent state): edge-update GTEPS +
+        per-iteration ms + final RMSE (the reference's CF quality metric,
+        colfilter_gpu.cu:85-101 math)."""
+        from lux_tpu.models.colfilter import CFProgram
+
+        n_half = (1 << scale) // 2
+        gw = generate.bipartite_ratings(
+            n_half, n_half, (1 << scale) * ef // 2, seed=1
+        )
+        wshards = build_pull_shards(gw, 1)
+        prog = CFProgram()
+        arrays_w = jax.tree.map(jnp.asarray, wshards.arrays)
+        s0 = pull.init_state(prog, arrays_w)
+
+        def run(n):
+            return pull.run_pull_fixed(
+                prog, wshards.spec, arrays_w, s0, n, m
+            )
+
+        elapsed, out = fetch_timed(run)
+        gteps = iters * gw.ne / elapsed / 1e9
+
+        @jax.jit
+        def rmse(state):
+            full = state.reshape((wshards.spec.gathered_size,) + state.shape[2:])
+            u = full[arrays_w.src_pos]  # (P, E, K)
+            dstc = jnp.clip(arrays_w.dst_local, 0, state.shape[1] - 1)
+            v = jnp.take_along_axis(
+                state, dstc[..., None], axis=1
+            )
+            err = arrays_w.weights - jnp.sum(u * v, axis=-1)
+            # padding edges carry weight 0 and garbage vectors: the shard
+            # layout's own edge_mask excludes them (shard-correct at any P)
+            return jnp.sqrt(
+                jnp.sum(jnp.where(arrays_w.edge_mask, err * err, 0.0)) / gw.ne
+            )
+
+        rm = float(jax.device_get(rmse(out)))
+        _emit(
+            {
+                "metric": f"colfilter_gteps_rmat{scale}_1chip{suffix}",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
+                # 6 decimals: toy-scale CPU runs measure sub-microsecond
+                # per-iteration costs that a 3-decimal round floors to 0
+                "iter_ms": round(elapsed / iters * 1e3, 6),
+                "rmse": round(rm, 6),
+            }
+        )
+
+    if "pagerank" in apps:
+        for m in methods:
+            try:
+                measure(m, dtype)
+            except Exception as e:  # noqa: BLE001 — a method may be unsupported
+                print(f"# method {m} failed: {e}", file=sys.stderr, flush=True)
+        if results and on_tpu and dtype_env is None:
+            # bf16 datapoint on the best method BEFORE the risky tail:
+            # halved HBM gather + exchange traffic is the interesting
+            # hardware number
+            best_m = min(results.items(), key=lambda kv: kv[1])[0][0]
+            try:
+                measure(best_m, "bfloat16")
+            except Exception as e:  # noqa: BLE001
+                print(f"# bf16 variant failed: {e}", file=sys.stderr, flush=True)
+    # secondary apps run AFTER the headline race banks its lines (each is
+    # emitted the moment it exists) and BEFORE the risky tail, so a tail
+    # wedge cannot cost the multi-app signal
+    if "colfilter" in apps:
         try:
-            measure(m, dtype)
-        except Exception as e:  # noqa: BLE001 — a method may be unsupported
-            print(f"# method {m} failed: {e}", file=sys.stderr, flush=True)
-    if results and on_tpu and dtype_env is None:
-        # bf16 datapoint on the best method BEFORE the risky tail: halved
-        # HBM gather + exchange traffic is the interesting hardware number
-        best_m = min(results.items(), key=lambda kv: kv[1])[0][0]
-        try:
-            measure(best_m, "bfloat16")
+            best_m = (
+                min(results.items(), key=lambda kv: kv[1])[0][0]
+                if results else None
+            )
+            from lux_tpu.engine.methods import CONCRETE
+
+            cf_m = (
+                best_m
+                if best_m in CONCRETE
+                else resolve_method("auto", "sum", platform)
+            )
+            measure_cf(cf_m)
         except Exception as e:  # noqa: BLE001
-            print(f"# bf16 variant failed: {e}", file=sys.stderr, flush=True)
-    for m in risky_tail:
+            print(f"# colfilter failed: {e}", file=sys.stderr, flush=True)
+    if "sssp" in apps:
         try:
-            measure(m, dtype)
+            measure_sssp()
         except Exception as e:  # noqa: BLE001
-            print(f"# method {m} failed: {e}", file=sys.stderr, flush=True)
-    if not results:
-        raise RuntimeError(f"all benchmark methods failed: {methods}")
+            print(f"# sssp failed: {e}", file=sys.stderr, flush=True)
+    if "pagerank" in apps:
+        for m in risky_tail:
+            try:
+                measure(m, dtype)
+            except Exception as e:  # noqa: BLE001
+                print(f"# method {m} failed: {e}", file=sys.stderr, flush=True)
+        if not results:
+            raise RuntimeError(f"all benchmark methods failed: {methods}")
 
 
 def _spawn_worker(env, out_path, nice=0):
@@ -253,20 +414,21 @@ def _wait(proc, deadline):
 
 
 def _relay(out_path) -> bool:
-    """Forward the BEST of the worker's JSON lines to stdout (and its
-    stderr diagnostics to ours); True if any line was found.
-
-    The worker emits one line per measured (method, dtype) as soon as it
-    exists, best-effort: even a worker that later wedged inside a risky
-    method has its completed measurements harvested here — stdout still
-    carries exactly one JSON line, the highest-GTEPS one."""
+    """Forward the BEST of the worker's JSON lines PER APP FAMILY to
+    stdout (and its stderr diagnostics to ours); True if any line was
+    found.  The worker emits one line per measured (app, method, dtype)
+    as soon as it exists, best-effort: even a worker that later wedged
+    inside a risky method has its completed measurements harvested here.
+    One line per family (pagerank/sssp/colfilter), each the
+    highest-GTEPS one; the pagerank HEADLINE prints LAST — the driver
+    and the tests read the final stdout line."""
     try:
         with open(out_path + ".err", "rb") as f:
             sys.stderr.write(f.read().decode(errors="replace"))
             sys.stderr.flush()
     except OSError:
         pass
-    best = None
+    best = {}
     try:
         with open(out_path, "rb") as f:
             for line in f.read().decode(errors="replace").splitlines():
@@ -276,14 +438,21 @@ def _relay(out_path) -> bool:
                     obj = json.loads(line)
                 except ValueError:
                     continue
-                if best is None or obj.get("value", 0.0) > best.get("value", 0.0):
-                    best = obj
+                fam = str(obj.get("metric", "")).split("_")[0]
+                if fam not in best or obj.get("value", 0.0) > best[fam].get(
+                    "value", 0.0
+                ):
+                    best[fam] = obj
     except OSError:
         pass
-    if best is not None:
-        print(json.dumps(best), flush=True)
-        return True
-    return False
+    if not best:
+        return False
+    headline = "pagerank" if "pagerank" in best else max(best)
+    for fam in sorted(best):
+        if fam != headline:
+            print(json.dumps(best[fam]), flush=True)
+    print(json.dumps(best[headline]), flush=True)
+    return True
 
 
 def _relay_listening(port=None, timeout=3.0) -> bool:
@@ -423,7 +592,11 @@ def main():
         cpu_proc.kill()  # CPU worker holds no tunnel claim; safe to kill
     except OSError:
         pass
-    _relay(cpu_out)
+    if _relay(cpu_out):
+        # banked partial lines ARE the result; appending the zero line
+        # after them would put 0.0 in the headline (last-line) slot the
+        # driver records
+        return
     _emit(_zero(f"pagerank_gteps_rmat{scale}_all_workers_failed"))
 
 

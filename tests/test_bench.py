@@ -24,18 +24,32 @@ def _run(env_extra, timeout):
     )
 
 
-def test_bench_happy_path():
-    r = _run({}, timeout=300)
+def test_bench_happy_path_multi_app():
+    r = _run({}, timeout=540)
     assert r.returncode == 0, r.stderr[-2000:]
-    line = json.loads(r.stdout.strip().splitlines()[-1])
-    assert line["value"] > 0
-    assert line["unit"] == "GTEPS"
+    lines = [
+        json.loads(s) for s in r.stdout.strip().splitlines()
+        if s.startswith("{")
+    ]
+    # >=3 metric lines: one per app family, headline (pagerank) LAST
+    fams = [ln["metric"].split("_")[0] for ln in lines]
+    assert set(fams) >= {"pagerank", "sssp", "colfilter"}, fams
+    assert fams[-1] == "pagerank"
+    assert len(fams) == len(set(fams))  # exactly one line per family
+    for ln in lines:
+        assert ln["unit"] == "GTEPS"
+        assert ln["value"] > 0
+    cf = next(ln for ln in lines if ln["metric"].startswith("colfilter"))
+    assert cf["rmse"] > 0 and cf["iter_ms"] > 0
+    sp = next(ln for ln in lines if ln["metric"].startswith("sssp"))
+    assert sp["traversed_edges"] > 0 and sp["iters"] > 0
 
 
 def test_bench_insurance_survives_hung_primary():
     r = _run(
         {
             "LUX_BENCH_FAKE_HANG": "1",
+            "LUX_BENCH_APPS": "pagerank",
             # primary targets a non-cpu platform so the insurance spawns
             "JAX_PLATFORMS": "bogus_tpu",
             "LUX_BENCH_WATCHDOG_S": "240",
@@ -63,6 +77,7 @@ def test_bench_harvests_banked_lines_from_wedged_primary():
     r = _run(
         {
             "LUX_BENCH_FAKE_HANG": "emit",
+            "LUX_BENCH_APPS": "pagerank",
             "JAX_PLATFORMS": "bogus_tpu",
             "LUX_BENCH_WATCHDOG_S": "240",
             "LUX_BENCH_TPU_S": "15",
@@ -85,6 +100,7 @@ def test_bench_relay_gate_caps_tpu_wait():
     r = _run(
         {
             "LUX_BENCH_FAKE_HANG": "1",
+            "LUX_BENCH_APPS": "pagerank",
             "JAX_PLATFORMS": "bogus_tpu",
             "LUX_BENCH_WATCHDOG_S": "240",
             "LUX_BENCH_TPU_S": "9999",  # would exceed budget un-capped...
