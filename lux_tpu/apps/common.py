@@ -49,9 +49,24 @@ def make_mesh_if(cfg: RunConfig):
         from lux_tpu.parallel.edge2d import make_mesh2d
 
         return make_mesh2d(cfg.num_parts, cfg.edge_shards)
-    from lux_tpu.parallel.mesh import make_mesh
+    from lux_tpu.parallel.mesh import make_mesh_for_parts
 
-    return make_mesh(cfg.num_parts)
+    # -ng may exceed the device count: k = parts/mesh-size parts stay
+    # resident per device (the reference mapper's slicing analog)
+    return make_mesh_for_parts(cfg.num_parts)
+
+
+def require_parts_fit_devices(cfg: RunConfig, what: str) -> None:
+    """One part per device: the pallas and reduce_scatter engines don't
+    support k resident parts (allgather/ring do)."""
+    import jax
+
+    if cfg.num_parts > len(jax.devices()):
+        raise SystemExit(
+            f"{what} keeps one part per device; -ng must not exceed the "
+            f"device count ({len(jax.devices())} available; allgather/ring "
+            "support multiple resident parts per device)"
+        )
 
 
 def validate_exchange(cfg: RunConfig, prog) -> None:
@@ -77,6 +92,8 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             raise SystemExit(
                 "--method pallas runs on the allgather exchange, 1-D mesh"
             )
+        if cfg.distributed:
+            require_parts_fit_devices(cfg, "--method pallas")
     if cfg.edge_shards > 1:
         if not cfg.distributed:
             raise SystemExit("--edge-shards requires --distributed")
@@ -108,13 +125,13 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             "--exchange ring/scatter supports --method scan or scatter "
             "(bucketed reductions carry no row_ptr for prefix-diff reduces)"
         )
-    if cfg.exchange == "scatter" and (
-        prog.reduce != "sum" or getattr(prog, "needs_dst_state", False)
-    ):
-        raise SystemExit(
-            "--exchange scatter needs a sum-reducible program without "
-            "per-edge destination reads; use --exchange ring or allgather"
-        )
+    if cfg.exchange == "scatter":
+        if prog.reduce != "sum" or getattr(prog, "needs_dst_state", False):
+            raise SystemExit(
+                "--exchange scatter needs a sum-reducible program without "
+                "per-edge destination reads; use --exchange ring or allgather"
+            )
+        require_parts_fit_devices(cfg, "--exchange scatter")
 
 
 def build_exchange_shards(g: HostGraph, cfg: RunConfig):

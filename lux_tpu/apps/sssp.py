@@ -33,6 +33,7 @@ def build_push_app_shards(g, cfg):
                 "--method pallas (push) runs on a device mesh: add "
                 "--distributed (single chip = -ng 1 --distributed)"
             )
+        common.require_parts_fit_devices(cfg, "--method pallas")
         from lux_tpu.parallel.pallas_dist import build_push_pallas_shards
 
         return build_push_pallas_shards(g, cfg.num_parts)

@@ -39,7 +39,7 @@ from lux_tpu.engine import methods
 from lux_tpu.graph.push_shards import PushArrays, PushShards, PushSpec, SRC_SENTINEL
 from lux_tpu.graph.shards import ShardArrays, ShardSpec
 from lux_tpu.ops import segment
-from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
+from lux_tpu.parallel.mesh import PARTS_AXIS, flatten_gather, shard_stacked
 
 
 class PushProgram(Protocol):
@@ -199,14 +199,6 @@ def _acc_load(c: "PushCarry", total, use_dense):
     return sp_work, c.dense_rounds + use_dense.astype(jnp.int32)
 
 
-def _carry_local(carry_blk: "PushCarry") -> "PushCarry":
-    """Drop the leading parts axis from a shard_map carry block (each
-    device sees its own (1, ...) slice of the sharded fields)."""
-    return PushCarry(
-        carry_blk.state[0], carry_blk.q_vid[0], carry_blk.q_val[0],
-        carry_blk.count[0], carry_blk.it, carry_blk.active,
-        carry_blk.edges, carry_blk.sp_work[0], carry_blk.dense_rounds,
-    )
 
 
 def edges_total(edges) -> int:
@@ -463,36 +455,51 @@ def _carry_specs():
     )
 
 
-def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr, qarr,
-                    dense_fn, c: PushCarry) -> PushCarry:
+def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
+                    qarr_blk, dense_fn, c: PushCarry) -> PushCarry:
     """ONE direction-optimized iteration from a device's perspective
     inside shard_map — the single source of truth for the dist, step-dist,
-    and ring engines (their only difference is ``dense_fn``).
+    ring, and pallas engines (their only difference is ``dense_fn``).
+
+    Each device holds k = P / mesh_size resident parts as the leading axis
+    of every blocked field (k == 1 when parts == devices); per-part work
+    vmaps over the resident lanes — the mapper-slicing analog
+    (core/lux_mapper.cc:102-122).
 
     * frontier (vid, value) queues are all_gathered unconditionally (they
       are small: O(P * f_cap));
     * the mode decision is GLOBAL (psum'd count + overflow/tier flags) so
       the dense branch's collectives sit inside `lax.cond` without
       divergence;
-    * ``qarr`` carries the per-vertex arrays (vtx_mask/global_vid) for
+    * ``qarr_blk`` carries the per-vertex arrays (vtx_mask/global_vid) for
       the sparse mask and queue rebuild — ShardArrays on the all-gather
       engines, the slim VertexView on the ring engine;
-    * ``dense_fn(local)`` is the engine-specific dense relaxation: the
-      all-gathered segmented reduce, or the ppermute ring fold.
+    * ``dense_fn(block)`` is the engine-specific dense relaxation over the
+      (k, V, ...) resident block: the all-gathered segmented reduce, or
+      the ppermute ring fold.
     """
-    local = c.state
+    local = c.state  # (k, V)
     V = spec.nv_pad
-    q_vids_all = jax.lax.all_gather(c.q_vid, PARTS_AXIS, tiled=True)
-    q_vals_all = jax.lax.all_gather(c.q_val, PARTS_AXIS, tiled=True)
-    rows, counts, incl, total = sparse_prep(parr, q_vids_all)
-    g_cnt = jax.lax.psum(c.count, PARTS_AXIS)
+    # device order x resident order == global part order (shard_stacked
+    # gives device d parts [d*k, (d+1)*k)), so the tiled gather flattens
+    # straight into the (P * f_cap,) global queue view
+    q_vids_all = jax.lax.all_gather(
+        c.q_vid, PARTS_AXIS, tiled=True
+    ).reshape(-1)
+    q_vals_all = jax.lax.all_gather(
+        c.q_val, PARTS_AXIS, tiled=True
+    ).reshape(-1)
+    rows, counts, incl, totals = jax.vmap(
+        lambda parr: sparse_prep(parr, q_vids_all)
+    )(parr_blk)
+    g_cnt = jax.lax.psum(jnp.sum(c.count), PARTS_AXIS)
     flags = jax.lax.psum(
         jnp.stack(
             [
-                (c.count > pspec.f_cap).astype(jnp.int32),
-                (total > pspec.e_sp).astype(jnp.int32),
+                jnp.sum((c.count > pspec.f_cap).astype(jnp.int32)),
+                jnp.sum((totals > pspec.e_sp).astype(jnp.int32)),
                 # tier vote: any part too big for the small buffer?
-                (total > pspec.e_sp_small).astype(jnp.int32),
+                jnp.sum((totals > pspec.e_sp_small).astype(jnp.int32)),
             ]
         ),
         PARTS_AXIS,
@@ -504,14 +511,17 @@ def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr, qarr,
 
     def sparse_branch():
         def run(cap):
-            return jnp.where(
-                qarr.vtx_mask,
-                sparse_part_step(
-                    prog, pspec, parr, V, q_vids_all, q_vals_all,
-                    rows, counts, incl, local, cap,
-                ),
-                local,
-            )
+            def f(qarr, parr, r, cn, inc, loc):
+                return jnp.where(
+                    qarr.vtx_mask,
+                    sparse_part_step(
+                        prog, pspec, parr, V, q_vids_all, q_vals_all,
+                        r, cn, inc, loc, cap,
+                    ),
+                    loc,
+                )
+
+            return jax.vmap(f)(qarr_blk, parr_blk, rows, counts, incl, local)
 
         if not pspec.e_sp_small:
             return run(pspec.e_sp)
@@ -523,27 +533,31 @@ def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr, qarr,
         )
 
     new = jax.lax.cond(use_dense, lambda: dense_fn(local), sparse_branch)
-    changed = (new != local) & qarr.vtx_mask
-    q_vid, q_val, cnt = build_queue(pspec, qarr, changed, new)
-    active = jax.lax.psum(cnt, PARTS_AXIS)
+    changed = (new != local) & qarr_blk.vtx_mask
+    q_vid, q_val, cnt = jax.vmap(partial(build_queue, pspec))(
+        qarr_blk, changed, new
+    )
+    active = jax.lax.psum(jnp.sum(cnt), PARTS_AXIS)
     # uint32 psum is exact: a sparse round's global total is bounded by
     # sum_p e_sp_p ≈ ne/4 < 2^32 (bigger frontiers force dense)
-    g_total = jax.lax.psum(total.astype(jnp.uint32), PARTS_AXIS)
+    g_total = jax.lax.psum(jnp.sum(totals.astype(jnp.uint32)), PARTS_AXIS)
     edges = _acc_edges(c.edges, spec.ne, g_total, use_dense)
-    sp_work, dense_rounds = _acc_load(c, total, use_dense)
+    sp_work, dense_rounds = _acc_load(c, totals, use_dense)
     return PushCarry(
         new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
         dense_rounds,
     )
 
 
-def _allgather_dense_fn(prog, arr, method):
+def _allgather_dense_fn(prog, arr_blk, method):
     """Dense relaxation for the all-gather engines: whole state over ICI,
-    then the segmented reduce over the part's in-edges."""
+    then the segmented reduce over each resident part's in-edges."""
 
-    def dense_fn(local):
-        full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
-        return dense_part_step(prog, arr, full, local, method)
+    def dense_fn(block):
+        full = flatten_gather(block)
+        return jax.vmap(
+            lambda arr, loc: dense_part_step(prog, arr, full, loc, method)
+        )(arr_blk, block)
 
     return dense_fn
 
@@ -563,24 +577,17 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         out_specs=carry_specs,
     )
     def run(arr_blk, parr_blk, carry_blk, it_stop):
-        arr = jax.tree.map(lambda a: a[0], arr_blk)
-        parr = jax.tree.map(lambda a: a[0], parr_blk)
 
         def cond(c):
             return (c.active > 0) & (c.it < it_stop)
 
         def body(c):
             return _spmd_push_iter(
-                prog, pspec, spec, parr, arr,
-                _allgather_dense_fn(prog, arr, method), c,
+                prog, pspec, spec, parr_blk, arr_blk,
+                _allgather_dense_fn(prog, arr_blk, method), c,
             )
 
-        out = jax.lax.while_loop(cond, body, _carry_local(carry_blk))
-        return PushCarry(
-            out.state[None], out.q_vid[None], out.q_val[None],
-            out.count[None], out.it, out.active, out.edges,
-            out.sp_work[None], out.dense_rounds,
-        )
+        return jax.lax.while_loop(cond, body, carry_blk)
 
     return run
 
@@ -613,16 +620,9 @@ def _compile_push_step_dist_cached(prog, mesh, pspec: PushSpec,
         out_specs=carry_specs,
     )
     def step(arr_blk, parr_blk, carry_blk):
-        arr = jax.tree.map(lambda a: a[0], arr_blk)
-        parr = jax.tree.map(lambda a: a[0], parr_blk)
-        out = _spmd_push_iter(
-            prog, pspec, spec, parr, arr,
-            _allgather_dense_fn(prog, arr, method), _carry_local(carry_blk),
-        )
-        return PushCarry(
-            out.state[None], out.q_vid[None], out.q_val[None],
-            out.count[None], out.it, out.active, out.edges,
-            out.sp_work[None], out.dense_rounds,
+        return _spmd_push_iter(
+            prog, pspec, spec, parr_blk, arr_blk,
+            _allgather_dense_fn(prog, arr_blk, method), carry_blk,
         )
 
     return step
@@ -679,7 +679,9 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     from lux_tpu.parallel.ring import RingArrays, _neutral_like
 
     num_parts = spec.num_parts
-    perm = [(i, (i - 1) % num_parts) for i in range(num_parts)]
+    D = mesh.devices.size
+    k = num_parts // D
+    perm = [(i, (i - 1) % D) for i in range(D)]
     rarr_specs = RingArrays(*([P(PARTS_AXIS)] * len(RingArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
     view_specs = VertexView(*([P(PARTS_AXIS)] * len(VertexView._fields)))
@@ -693,9 +695,6 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         out_specs=carry_specs,
     )
     def run(rarr_blk, parr_blk, view_blk, carry_blk, it_stop):
-        rarr = jax.tree.map(lambda a: a[0], rarr_blk)
-        parr = jax.tree.map(lambda a: a[0], parr_blk)
-        view = jax.tree.map(lambda a: a[0], view_blk)
         V = spec.nv_pad
         my = jax.lax.axis_index(PARTS_AXIS)
         op = _op(prog)
@@ -703,39 +702,47 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         def cond(c):
             return (c.active > 0) & (c.it < it_stop)
 
-        def ring_dense_fn(local):
-            def fold(k, acc, block):
-                q = (my + k) % num_parts  # owner of the resident block
-                vals = prog.relax(block[rarr.src_local[q]], rarr.weights[q])
-                part = segment.segment_reduce_by_ends(
-                    vals, rarr.head_flag[q], rarr.dst_local[q], V,
-                    reduce=prog.reduce, method=method,
-                )
-                return op(acc, part)
+        def ring_dense_fn(block):  # (k, V) resident parts
+            def fold(s, acc, stream):
+                # the in-flight stream holds the k parts resident on device
+                # (my + s) % D; fold each streamed lane's bucket into every
+                # resident lane (j is a static unroll: k is a compile-time
+                # geometry constant, typically small)
+                dev = (my + s) % D
+                for j in range(k):
+                    q = dev * k + j  # global part id of streamed lane j
 
-            def fold_block(k, carry2):
-                acc, block = carry2
-                acc = fold(k, acc, block)
-                return acc, jax.lax.ppermute(block, PARTS_AXIS, perm)
+                    def one(rarr_i, acc_i, q=q):
+                        vals = prog.relax(
+                            stream[j][rarr_i.src_local[q]], rarr_i.weights[q]
+                        )
+                        part = segment.segment_reduce_by_ends(
+                            vals, rarr_i.head_flag[q], rarr_i.dst_local[q],
+                            V, reduce=prog.reduce, method=method,
+                        )
+                        return op(acc_i, part)
 
-            acc0 = _neutral_like(local, prog.reduce)
-            acc, block = jax.lax.fori_loop(
-                0, num_parts - 1, fold_block, (acc0, local)
+                    acc = jax.vmap(one)(rarr_blk, acc)
+                return acc
+
+            def fold_block(s, carry2):
+                acc, stream = carry2
+                acc = fold(s, acc, stream)
+                return acc, jax.lax.ppermute(stream, PARTS_AXIS, perm)
+
+            acc0 = _neutral_like(block, prog.reduce)
+            acc, stream = jax.lax.fori_loop(
+                0, D - 1, fold_block, (acc0, block)
             )
-            acc = fold(num_parts - 1, acc, block)
-            return jnp.where(view.vtx_mask, op(local, acc), local)
+            acc = fold(D - 1, acc, stream)
+            return jnp.where(view_blk.vtx_mask, op(block, acc), block)
 
         def body(c):
             return _spmd_push_iter(
-                prog, pspec, spec, parr, view, ring_dense_fn, c
+                prog, pspec, spec, parr_blk, view_blk, ring_dense_fn, c
             )
 
-        out = jax.lax.while_loop(cond, body, _carry_local(carry_blk))
-        return PushCarry(
-            out.state[None], out.q_vid[None], out.q_val[None],
-            out.count[None], out.it, out.active, out.edges,
-            out.sp_work[None], out.dense_rounds,
-        )
+        return jax.lax.while_loop(cond, body, carry_blk)
 
     return run
 
@@ -778,7 +785,7 @@ def run_push_ring(
     devices — never the pull layout's O(E) stacked arrays."""
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
-    assert spec.num_parts == mesh.devices.size
+    assert spec.num_parts % mesh.devices.size == 0
     assert method in ("scan", "scatter"), (
         "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
     )
@@ -801,7 +808,7 @@ def run_push_dist(
     rounds) exchanged over ICI inside the on-device loop."""
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
-    assert spec.num_parts == mesh.devices.size
+    assert spec.num_parts % mesh.devices.size == 0
     arrays, parrays, carry0 = push_init_dist(prog, shards, mesh)
     run = _compile_push_dist(prog, mesh, pspec, spec, method)
     out = run(arrays, parrays, carry0, jnp.int32(max_iters))

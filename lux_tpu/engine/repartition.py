@@ -239,7 +239,7 @@ def run_push_adaptive(
     if shards is None:
         shards = build()
     if mesh is not None:
-        assert num_parts == mesh.devices.size
+        assert num_parts % mesh.devices.size == 0
     statics, loop = _place_statics(prog, shards, mesh, method, exchange)
     carry = push._init_carry(
         prog, shards.pspec,

@@ -27,11 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from lux_tpu.engine.pull import PullProgram, local_pull_step
 from lux_tpu.graph.shards import ShardArrays, ShardSpec
-from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
-
-
-def _squeeze0(tree):
-    return jax.tree.map(lambda a: a[0], tree)
+from lux_tpu.parallel.mesh import PARTS_AXIS, flatten_gather, shard_stacked
 
 
 def _arrays_specs():
@@ -51,14 +47,16 @@ def _compile_fixed(prog, mesh, num_iters: int, method: str):
         out_specs=P(PARTS_AXIS),
     )
     def run(arr_blk, state_blk):
-        arr = _squeeze0(arr_blk)
+        # each device holds k = P/D resident parts (k == 1 when P == D);
+        # the per-part step vmaps over the resident lanes — the mapper-
+        # slicing analog (core/lux_mapper.cc:102-122)
+        def body(_, block):
+            full = flatten_gather(block)
+            return jax.vmap(
+                lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
+            )(arr_blk, block)
 
-        def body(_, local):
-            full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
-            return local_pull_step(prog, arr, full, local, method)
-
-        out = jax.lax.fori_loop(0, num_iters, body, state_blk[0])
-        return out[None]
+        return jax.lax.fori_loop(0, num_iters, body, state_blk)
 
     return run
 
@@ -74,11 +72,12 @@ def run_pull_fixed_dist(
 ):
     """Fixed-iteration distributed pull (PageRank/CF).  ``arrays`` and
     ``state0`` are stacked (P, ...) with P == mesh size; returns the final
-    stacked state (sharded)."""
+    stacked state (sharded).  P may be any multiple of the mesh size
+    (k parts resident per device)."""
     from lux_tpu.engine import methods
 
     method = methods.resolve(method, prog.reduce)
-    assert spec.num_parts == mesh.devices.size, (spec.num_parts, mesh.shape)
+    assert spec.num_parts % mesh.devices.size == 0, (spec.num_parts, mesh.shape)
     arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, arrays))
     state0 = shard_stacked(mesh, state0)
     return _compile_fixed(prog, mesh, num_iters, method)(arrays, state0)
@@ -112,10 +111,10 @@ def _compile_step_dist_cached(prog, mesh, method: str):
         out_specs=P(PARTS_AXIS),
     )
     def step(arr_blk, state_blk):
-        arr = _squeeze0(arr_blk)
-        local = state_blk[0]
-        full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
-        return local_pull_step(prog, arr, full, local, method)[None]
+        full = flatten_gather(state_blk)
+        return jax.vmap(
+            lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
+        )(arr_blk, state_blk)
 
     return step
 
@@ -130,25 +129,28 @@ def _compile_until(prog, mesh, max_iters: int, active_fn, method: str):
         out_specs=(P(PARTS_AXIS), P()),
     )
     def run(arr_blk, state_blk):
-        arr = _squeeze0(arr_blk)
 
         def cond(carry):
             _, it, active = carry
             return (active > 0) & (it < max_iters)
 
         def body(carry):
-            local, it, _ = carry
-            full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
-            new = local_pull_step(prog, arr, full, local, method)
+            block, it, _ = carry
+            full = flatten_gather(block)
+            new = jax.vmap(
+                lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
+            )(arr_blk, block)
+            # per-lane counts summed locally, then one psum over devices
+            counts = jax.vmap(active_fn)(block, new)
             active = jax.lax.psum(
-                active_fn(local, new).astype(jnp.int32), PARTS_AXIS
+                jnp.sum(counts.astype(jnp.int32)), PARTS_AXIS
             )
             return new, it + 1, active
 
-        local, iters, _ = jax.lax.while_loop(
-            cond, body, (state_blk[0], jnp.int32(0), jnp.int32(1))
+        block, iters, _ = jax.lax.while_loop(
+            cond, body, (state_blk, jnp.int32(0), jnp.int32(1))
         )
-        return local[None], iters
+        return block, iters
 
     return run
 
@@ -174,7 +176,7 @@ def run_pull_until_dist(
     from lux_tpu.engine import methods
 
     method = methods.resolve(method, prog.reduce)
-    assert spec.num_parts == mesh.devices.size, (spec.num_parts, mesh.shape)
+    assert spec.num_parts % mesh.devices.size == 0, (spec.num_parts, mesh.shape)
     arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, arrays))
     state0 = shard_stacked(mesh, state0)
     return _compile_until(prog, mesh, max_iters, active_fn, method)(

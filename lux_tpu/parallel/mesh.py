@@ -16,11 +16,14 @@ Axis naming convention:
 """
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("lux_tpu")
 
 PARTS_AXIS = "parts"
 FEAT_AXIS = "feat"
@@ -36,6 +39,28 @@ def make_mesh(num_parts: Optional[int] = None, devices: Optional[Sequence] = Non
     return Mesh(np.asarray(devices[:num_parts]), (PARTS_AXIS,))
 
 
+def make_mesh_for_parts(num_parts: int, devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh sized for ``num_parts`` graph parts on however many devices
+    exist: if parts exceed devices, pick the largest mesh size that
+    divides the part count, leaving k = parts/size parts RESIDENT per
+    device — the analog of the reference mapper slicing up to
+    MAX_NUM_PARTS=64 parts across whatever processors exist
+    (core/graph.h:31, core/lux_mapper.cc:102-122)."""
+    if devices is None:
+        devices = jax.devices()
+    d = min(len(devices), num_parts)
+    while num_parts % d:
+        d -= 1
+    if num_parts > len(devices) and d < len(devices):
+        log.warning(
+            "num_parts=%d shares no divisor with the %d available devices"
+            " above %d: running a %d-device mesh (%d idle). Pick -ng as a"
+            " multiple of the device count to use every chip.",
+            num_parts, len(devices), d, d, len(devices) - d,
+        )
+    return make_mesh(d, devices)
+
+
 def parts_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (part) axis; replicate the rest."""
     return NamedSharding(mesh, P(PARTS_AXIS))
@@ -45,3 +70,13 @@ def shard_stacked(mesh: Mesh, tree):
     """Place a pytree of stacked (P, ...) arrays with axis 0 on the mesh."""
     sh = parts_sharding(mesh)
     return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
+
+
+def flatten_gather(block):
+    """all_gather a (k, V, ...) resident block over the parts axis and
+    flatten to the (P*V, ...) gathered-coordinate state.  Lives next to
+    shard_stacked because that placement IS the ordering invariant:
+    device d holds parts [d*k, (d+1)*k), and tiled=True concatenates in
+    device order, so the flattened axis is in global part order."""
+    full = jax.lax.all_gather(block, PARTS_AXIS, tiled=True)
+    return full.reshape((-1,) + full.shape[2:])

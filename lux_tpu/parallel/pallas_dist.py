@@ -35,7 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from lux_tpu.graph.csc import HostGraph
 from lux_tpu.graph.shards import build_pull_shards, ShardSpec, stacked_to_global
 from lux_tpu.ops import pallas_spmv as ps
-from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
+from lux_tpu.parallel.mesh import PARTS_AXIS, flatten_gather, shard_stacked
 
 
 class PallasArrays(NamedTuple):
@@ -355,13 +355,14 @@ def _compile_push_pallas(prog, mesh, pspec, spec, num_vblocks: int,
         check_vma=False,  # pallas out_shape carries no vma (see above)
     )
     def run(pl_blk, parr_blk, view_blk, carry_blk, it_stop):
+        # the pallas push engine keeps one part per device (driver asserts
+        # P == mesh size): blocks carry a unit lane axis
         pl = jax.tree.map(lambda a: a[0], pl_blk)
-        parr = jax.tree.map(lambda a: a[0], parr_blk)
-        view = jax.tree.map(lambda a: a[0], view_blk)
         op = jnp.minimum if prog.reduce == "min" else jnp.maximum
 
-        def dense_fn(local):
-            full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
+        def dense_fn(block):  # (1, V)
+            local = block[0]
+            full = flatten_gather(block)
             # (C, T) gather + relax in XLA; dtype-preserving kernel reduce
             vals = prog.relax(full[pl.e_src_pos], pl.e_weight)
             acc = ps.spmv_blockcsr(
@@ -369,20 +370,18 @@ def _compile_push_pallas(prog, mesh, pspec, spec, num_vblocks: int,
                 op=prog.reduce, v_blk=v_blk, num_vblocks=num_vblocks,
                 interpret=interpret,
             )[: spec.nv_pad]
-            return jnp.where(view.vtx_mask, op(local, acc), local)
+            mask = view_blk.vtx_mask[0]
+            return jnp.where(mask, op(local, acc), local)[None]
 
         def cond(c):
             return (c.active > 0) & (c.it < it_stop)
 
         def body(c):
-            return pe._spmd_push_iter(prog, pspec, spec, parr, view, dense_fn, c)
+            return pe._spmd_push_iter(
+                prog, pspec, spec, parr_blk, view_blk, dense_fn, c
+            )
 
-        out = jax.lax.while_loop(cond, body, pe._carry_local(carry_blk))
-        return pe.PushCarry(
-            out.state[None], out.q_vid[None], out.q_val[None],
-            out.count[None], out.it, out.active, out.edges,
-            out.sp_work[None], out.dense_rounds,
-        )
+        return jax.lax.while_loop(cond, body, carry_blk)
 
     return run
 

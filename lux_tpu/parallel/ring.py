@@ -209,7 +209,9 @@ def _neutral_like(local, reduce):
 
 @lru_cache(maxsize=64)
 def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int, method: str):
-    perm = [(i, (i - 1) % num_parts) for i in range(num_parts)]
+    D = mesh.devices.size
+    k = num_parts // D
+    perm = [(i, (i - 1) % D) for i in range(D)]
 
     @jax.jit
     @partial(
@@ -224,41 +226,55 @@ def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int, method: str)
         out_specs=P(PARTS_AXIS),
     )
     def run(rarr_blk, vtx_mask_blk, degree_blk, state_blk):
-        rarr = jax.tree.map(lambda a: a[0], rarr_blk)
-        vtx_mask, degree = vtx_mask_blk[0], degree_blk[0]
+        # k = P/D resident parts per device (k == 1 when parts == devices);
+        # the ring circulates (k, V, ...) blocks over the D devices, and
+        # each arriving block's k streamed lanes fold into every resident
+        # lane (static unroll over j: compile-time geometry)
         my = jax.lax.axis_index(PARTS_AXIS)
 
-        def iteration(_, local):
-            V = local.shape[0]
+        def iteration(_, block):
+            V = block.shape[1]
 
-            def fold(k, acc, block):
-                q = (my + k) % num_parts  # owner of the resident block
-                dst_state = local[jnp.clip(rarr.dst_local[q], 0, V - 1)]
-                vals = prog.edge_value(
-                    block[rarr.src_local[q]], rarr.weights[q], dst_state
-                )
-                part = segment.segment_reduce_by_ends(
-                    vals, rarr.head_flag[q], rarr.dst_local[q], V,
-                    reduce=prog.reduce, method=method,
-                )
-                return _FOLD[prog.reduce](acc, part)
+            def fold(s, acc, stream):
+                dev = (my + s) % D
+                for j in range(k):
+                    q = dev * k + j  # global part id of streamed lane j
 
-            def fold_block(k, carry):
-                acc, block = carry
-                acc = fold(k, acc, block)
+                    def one(rarr_i, local_i, acc_i, q=q):
+                        dst_state = local_i[
+                            jnp.clip(rarr_i.dst_local[q], 0, V - 1)
+                        ]
+                        vals = prog.edge_value(
+                            stream[j][rarr_i.src_local[q]],
+                            rarr_i.weights[q], dst_state,
+                        )
+                        part = segment.segment_reduce_by_ends(
+                            vals, rarr_i.head_flag[q], rarr_i.dst_local[q],
+                            V, reduce=prog.reduce, method=method,
+                        )
+                        return _FOLD[prog.reduce](acc_i, part)
+
+                    acc = jax.vmap(one)(rarr_blk, block, acc)
+                return acc
+
+            def fold_block(s, carry):
+                acc, stream = carry
+                acc = fold(s, acc, stream)
                 # pass the block to the next chip while compute proceeds
-                return acc, jax.lax.ppermute(block, PARTS_AXIS, perm)
+                return acc, jax.lax.ppermute(stream, PARTS_AXIS, perm)
 
-            acc0 = _neutral_like(local, prog.reduce)
-            # P-1 folds with transfers; the last resident block is folded
+            acc0 = _neutral_like(block, prog.reduce)
+            # D-1 folds with transfers; the last resident block is folded
             # without the (dead) final ppermute
-            acc, block = jax.lax.fori_loop(
-                0, num_parts - 1, fold_block, (acc0, local)
+            acc, stream = jax.lax.fori_loop(
+                0, D - 1, fold_block, (acc0, block)
             )
-            acc = fold(num_parts - 1, acc, block)
-            return _apply(prog, local, acc, vtx_mask, degree)
+            acc = fold(D - 1, acc, stream)
+            return jax.vmap(
+                lambda loc, a, vm, dg: _apply(prog, loc, a, vm, dg)
+            )(block, acc, vtx_mask_blk, degree_blk)
 
-        return jax.lax.fori_loop(0, num_iters, iteration, state_blk[0])[None]
+        return jax.lax.fori_loop(0, num_iters, iteration, state_blk)
 
     return run
 
@@ -344,7 +360,7 @@ def run_pull_fixed_ring(
 
     method = methods.resolve(method, prog.reduce)
     spec = shards.spec
-    assert spec.num_parts == mesh.devices.size
+    assert spec.num_parts % mesh.devices.size == 0
     assert len(shards.parts_subset) == spec.num_parts, (
         "subset-built ring shards: assemble the full stacked arrays across "
         "hosts (multihost.assemble_global) before driving"
