@@ -46,6 +46,73 @@ def build_push_app_shards(g, cfg):
     return build_push_shards(g, cfg.num_parts)
 
 
+def _save_frontier_ckpt(cfg, name, shards, carry):
+    """One elastic frontier checkpoint from the in-flight carry: global
+    state + changed-vertex mask + exact edge counter."""
+    from lux_tpu.engine import repartition
+    from lux_tpu.utils import checkpoint as ckpt
+
+    state_g = shards.scatter_to_global(np.asarray(carry.state))
+    counts = np.asarray(carry.count)
+    f_cap = shards.pspec.f_cap
+    if counts.max() > f_cap:
+        # overflowed queues are truncated; the exact frontier is not
+        # recoverable — save the dense superset (min/max relaxation is
+        # confluent: extra active vertices cost work, never correctness)
+        changed_g = np.ones(shards.spec.nv, bool)
+    else:
+        changed_g = repartition._changed_mask_from_queues(
+            np.asarray(carry.q_vid), counts, f_cap, shards.spec.nv
+        )
+    ckpt.save_frontier(
+        cfg.ckpt_dir, int(carry.it), state_g, changed_g,
+        np.asarray(carry.edges), name,
+    )
+
+
+def run_push_checkpointed(prog, shards, cfg, mesh, name: str):
+    """Windowed push run with an elastic frontier checkpoint between
+    windows (--ckpt-every iterations), resuming from cfg.ckpt_dir when a
+    checkpoint exists — any part count / exchange / mesh can resume any
+    other's checkpoint (the queues rebuild from the saved changed mask,
+    engine.repartition._rebuild_carry).  Returns (stacked_state, iters,
+    edges, compute_seconds); compute EXCLUDES the host-side checkpoint
+    I/O so reported GTEPS stays an engine number (same contract as
+    common.run_fixed_dist_chunked)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import push, repartition
+    from lux_tpu.utils import checkpoint as ckpt
+    from lux_tpu.utils.timing import Timer
+
+    nv = shards.spec.nv
+    statics, loop = repartition._place_statics(
+        prog, shards, mesh, cfg.method, cfg.exchange
+    )
+    s_g, c_g, e_acc, it0, prev = ckpt.load_resume_frontier(
+        cfg.ckpt_dir, name, nv
+    )
+    if s_g is not None:
+        carry = repartition._rebuild_carry(prog, shards, s_g, c_g, it0, e_acc)
+        print(f"resumed from {prev} at iteration {it0}")
+    else:
+        carry = push._init_carry(
+            prog, shards.pspec,
+            jax.tree.map(jnp.asarray, push.vertex_view(shards.arrays)),
+        )
+    if mesh is not None:
+        carry = push.shard_carry(mesh, carry)
+    compute = 0.0
+    while int(carry.active) > 0 and int(carry.it) < cfg.max_iters:
+        it_stop = min(int(carry.it) + cfg.ckpt_every, cfg.max_iters)
+        t = Timer()
+        carry = loop(*statics, carry, jnp.int32(it_stop))
+        compute += t.stop(carry.state)
+        _save_frontier_ckpt(cfg, name, shards, carry)
+    return carry.state, int(carry.it), carry.edges, compute
+
+
 def run_convergence_app(prog, shards, cfg, name: str, g=None):
     """Shared driver for frontier apps (SSSP + CC).  Returns
     (global_state, stacked_device_state, effective_shards) — the shard
@@ -66,12 +133,17 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 "wired to the kernel path; use --method scan/scatter"
             )
     if cfg.ckpt_every or cfg.ckpt_dir:
-        # honest gating beats silent ignoring: the frontier carry (queues +
-        # counts) is not serialized; fixed-iteration apps own checkpointing
-        raise SystemExit(
-            "checkpoint/resume is supported for the fixed-iteration apps "
-            "(pagerank, colfilter); convergence apps restart from scratch"
-        )
+        if not (cfg.ckpt_every and cfg.ckpt_dir):
+            raise SystemExit(
+                "frontier-app checkpointing runs in windows: pass BOTH "
+                "--ckpt-dir and --ckpt-every"
+            )
+        if cfg.verbose or cfg.repartition_every or cfg.method == "pallas":
+            raise SystemExit(
+                "--ckpt-every (frontier apps) is a windowed driver; it "
+                "does not combine with -verbose, --repartition-every, or "
+                "--method pallas"
+            )
     if cfg.repartition_every:
         if cfg.repartition_every < 0:
             raise SystemExit("--repartition-every must be positive")
@@ -97,9 +169,14 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
 
     from lux_tpu.utils import profiling
 
+    ckpt_compute = None
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
-        if cfg.repartition_every:
+        if cfg.ckpt_every:
+            state, iters, edges, ckpt_compute = run_push_checkpointed(
+                prog, shards, cfg, mesh, name
+            )
+        elif cfg.repartition_every:
             from lux_tpu.engine import repartition
 
             def note(it, old_cuts, new_cuts, work):
@@ -183,6 +260,9 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 prog, shards, mesh, cfg.max_iters, cfg.method
             )
         elapsed = timer.stop(state)
+    if ckpt_compute is not None:
+        # checkpoint I/O (device_get + disk) is not engine time
+        elapsed = ckpt_compute
     iters = int(iters)
     print(f"{name} converged in {iters} iterations")
     # GTEPS on edges ACTUALLY traversed (dense rounds walk every edge,

@@ -94,6 +94,63 @@ def load_resume(directory: str, app: str, nv: int):
     return state, it, prev
 
 
+def save_frontier(directory: str, iteration: int, state_global,
+                  changed_global, edges, app: str) -> str:
+    """Frontier-app (push engine) checkpoint: the GLOBAL (nv,) state, the
+    GLOBAL changed-vertex mask (the frontier, layout-free), and the exact
+    traversed-edge accumulator ((2,) uint32 [hi, lo]).  Elastic like
+    save_iteration: any later part count / exchange / mesh rebuilds its
+    queues from the mask (engine.repartition._rebuild_carry machinery)."""
+    os.makedirs(directory, exist_ok=True)
+    state_global = np.asarray(state_global)
+    changed_global = np.asarray(changed_global, bool)
+    meta = {
+        "app": app,
+        "layout": "global-frontier",
+        "nv": int(state_global.shape[0]),
+        "dtype": str(state_global.dtype),
+    }
+    path = os.path.join(directory, f"ckpt_{iteration}.npz")
+    tmp = path + ".tmp"
+    np.savez(
+        tmp, state=state_global, changed=changed_global,
+        edges=np.asarray(edges, np.uint32), iteration=np.int64(iteration),
+        meta=json.dumps(meta),
+    )
+    os.replace(tmp + ".npz", path)
+    return path
+
+
+def load_resume_frontier(directory: str, app: str, nv: int):
+    """Latest frontier checkpoint as (state_global, changed_global,
+    edges, start_iteration, path); (None, None, None, 0, None) when the
+    directory holds none."""
+    prev = latest(directory)
+    if prev is None:
+        return None, None, None, 0, None
+    with np.load(prev, allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+        if meta.get("layout") != "global-frontier":
+            raise SystemExit(
+                f"{prev}: not a frontier checkpoint (layout "
+                f"{meta.get('layout')!r}); fixed-iteration apps and "
+                "frontier apps use separate directories"
+            )
+        if meta.get("app") != app:
+            raise SystemExit(
+                f"{prev}: checkpoint is from app {meta.get('app')!r}, "
+                f"refusing to resume {app!r}"
+            )
+        if int(meta.get("nv", -1)) != nv:
+            raise SystemExit(
+                f"{prev}: checkpoint is for nv={meta.get('nv')}, "
+                f"this graph has nv={nv}"
+            )
+        return (
+            z["state"], z["changed"], z["edges"], int(z["iteration"]), prev
+        )
+
+
 def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
     """Most recent checkpoint file in a directory (by iteration suffix)."""
     if not os.path.isdir(directory):

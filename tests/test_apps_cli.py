@@ -186,8 +186,10 @@ def test_colfilter_cli_distributed_ckpt_resume(tmp_path, capsys):
     assert rmse1 == rmse2
 
 
-def test_push_apps_reject_ckpt_flags(tmp_path):
-    with pytest.raises(SystemExit, match="fixed-iteration"):
+def test_push_apps_require_both_ckpt_flags(tmp_path):
+    # frontier apps checkpoint in windows: --ckpt-dir alone is rejected
+    # (tests/test_push_ckpt.py covers the working dir+every combination)
+    with pytest.raises(SystemExit, match="BOTH"):
         sssp_app.main(SMALL + ["--ckpt-dir", str(tmp_path)])
 
 
