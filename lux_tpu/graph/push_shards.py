@@ -100,11 +100,34 @@ def build_push_shards(
     P, e_pad, nv_pad = num_parts, spec.e_pad, spec.nv_pad
     cuts = pull.cuts
 
-    uniq_all, rp_all, dst_all, w_all = [], [], [], []
+    csr_dst_local = np.full((P, e_pad), nv_pad, np.int32)
+    csr_weight = np.zeros((P, e_pad), np.float32)
+    # native hot path: per-part counting sort by source, O(E + U log U)
+    # writing the dst/weight rows in place (lux_io.lux_push_part_build);
+    # the NumPy argsort path below is the fallback and the oracle
+    from lux_tpu import native
+
+    use_native = native.get_lib() is not None and (
+        g.weights is None or np.can_cast(g.weights.dtype, np.int32)
+    )
+    counts_scratch = np.zeros(g.nv, np.uint32) if use_native else None
+
+    uniq_all, rp_all = [], []
     for p in range(P):
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
         srcs = g.col_idx[elo:ehi]
+        if use_native:
+            uniq, rp = native.push_part_build(
+                srcs, g.row_ptr[vlo : vhi + 1],
+                g.weights[elo:ehi] if g.weights is not None else None,
+                g.nv, counts_scratch, csr_dst_local[p, : ehi - elo],
+                csr_weight[p, : ehi - elo] if g.weights is not None
+                else None,
+            )
+            uniq_all.append(uniq)
+            rp_all.append(rp)
+            continue
         order = np.argsort(srcs, kind="stable")
         s_sorted = srcs[order]
         uniq, counts = (
@@ -122,23 +145,20 @@ def build_push_shards(
             np.arange(vhi - vlo, dtype=np.int32),
             np.diff(np.asarray(g.row_ptr[vlo : vhi + 1])).astype(np.int64),
         )
-        dst_all.append(dl_slice[order])
+        csr_dst_local[p, : ehi - elo] = dl_slice[order]
         if g.weights is not None:
-            w_all.append(g.weights[elo:ehi][order].astype(np.float32))
+            csr_weight[p, : ehi - elo] = (
+                g.weights[elo:ehi][order].astype(np.float32)
+            )
 
     u_pad = max(LANE, _round_up(max(len(u) for u in uniq_all) or 1, LANE))
     uniq_src = np.full((P, u_pad), SRC_SENTINEL, np.int32)
     csr_row_ptr = np.zeros((P, u_pad + 1), np.int32)
-    csr_dst_local = np.full((P, e_pad), nv_pad, np.int32)
-    csr_weight = np.zeros((P, e_pad), np.float32)
     for p in range(P):
-        u, rp, dl = uniq_all[p], rp_all[p], dst_all[p]
+        u, rp = uniq_all[p], rp_all[p]
         uniq_src[p, : len(u)] = u
         csr_row_ptr[p, : len(rp)] = rp
         csr_row_ptr[p, len(rp) :] = rp[-1] if len(rp) else 0
-        csr_dst_local[p, : len(dl)] = dl
-        if g.weights is not None:
-            csr_weight[p, : len(dl)] = w_all[p]
 
     if f_cap is None:
         # queue sized like the reference: part vertices / SPARSE_THRESHOLD

@@ -200,9 +200,14 @@ def fill_part(
     rp = np.asarray(rp_local, np.int32)
     arrays.row_ptr[i, : n + 1] = rp
     arrays.row_ptr[i, n + 1 :] = m  # padded vertices: empty tail ranges
-    srcs64 = np.asarray(srcs, np.int64)
-    own = (np.searchsorted(cuts, srcs64, side="right") - 1).astype(np.int64)
-    arrays.src_pos[i, :m] = (own * nv_pad + (srcs64 - cuts[own])).astype(np.int32)
+    from lux_tpu import native
+
+    if native.fill_src_pos(srcs, cuts, nv_pad, arrays.src_pos[i, :m]) is None:
+        srcs64 = np.asarray(srcs, np.int64)
+        own = (np.searchsorted(cuts, srcs64, side="right") - 1).astype(np.int64)
+        arrays.src_pos[i, :m] = (
+            own * nv_pad + (srcs64 - cuts[own])
+        ).astype(np.int32)
     arrays.dst_local[i, :m] = np.repeat(
         np.arange(n, dtype=np.int32), np.diff(rp[: n + 1])
     )

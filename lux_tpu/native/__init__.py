@@ -45,9 +45,24 @@ def get_lib(build: bool = True) -> Optional[ctypes.CDLL]:
         # the process — don't re-pay the compile timeout per call
         return None
     _tried = True
-    if not os.path.exists(_LIB_PATH) and (not build or not _try_build()):
+    if not os.path.exists(_LIB_PATH):
+        if not build or not _try_build():
+            return None
+    elif build:
+        # refresh a stale prebuilt .so (make is a no-op when current);
+        # failure is fine if the existing lib still has every symbol
+        _try_build()
+    try:
+        lib = _bind(ctypes.CDLL(_LIB_PATH))
+    except (OSError, AttributeError):
+        # unloadable or stale .so missing a symbol (and make couldn't
+        # refresh it): degrade to the NumPy paths, never crash
         return None
-    lib = ctypes.CDLL(_LIB_PATH)
+    _lib = lib
+    return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     u32p = ctypes.POINTER(ctypes.c_uint32)
     u64p = ctypes.POINTER(ctypes.c_uint64)
     i32p = ctypes.POINTER(ctypes.c_int32)
@@ -72,8 +87,17 @@ def get_lib(build: bool = True) -> Optional[ctypes.CDLL]:
     lib.lux_bucket_split.argtypes = [u32p, ctypes.c_uint64, u32p,
                                      ctypes.c_uint32, u64p, u64p]
     lib.lux_bucket_split.restype = ctypes.c_int
-    _lib = lib
-    return _lib
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.lux_push_part_build.argtypes = [
+        i32p, i64p, i32p, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+        u32p, u32p, i32p, i32p, i32p, f32p, u64p,
+    ]
+    lib.lux_push_part_build.restype = ctypes.c_int
+    lib.lux_fill_src_pos.argtypes = [i32p, ctypes.c_uint64, u32p,
+                                     ctypes.c_uint32, ctypes.c_uint32, i32p]
+    lib.lux_fill_src_pos.restype = ctypes.c_int
+    return lib
 
 
 def _ptr(a: np.ndarray, ctype):
@@ -157,6 +181,69 @@ def bucket_split(srcs: np.ndarray, cuts: np.ndarray):
     if rc != 0:
         raise ValueError("source id beyond the last cut")
     return order.astype(np.int64), counts.astype(np.int64)
+
+
+def push_part_build(srcs: np.ndarray, row_ptr_slice: np.ndarray,
+                    weights: Optional[np.ndarray], nv: int,
+                    counts: np.ndarray, dst_row: np.ndarray,
+                    w_row: Optional[np.ndarray]):
+    """Native per-part push-CSR group-by-source (graph/push_shards.py hot
+    path).  Writes the CSR-ordered local dst (and weights) into the
+    caller's padded rows in place; returns (uniq int32[n_uniq],
+    rp int32[n_uniq+1]) or None if the lib is unavailable.  `counts` is
+    an nv-sized uint32 scratch that must arrive zeroed and is returned
+    zeroed, so one allocation serves every part."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    srcs = np.ascontiguousarray(srcs, np.int32)
+    row_ptr_slice = np.ascontiguousarray(row_ptr_slice, np.int64)
+    assert dst_row.flags.c_contiguous and dst_row.dtype == np.int32
+    wp = None
+    if weights is not None:
+        assert w_row is not None and w_row.flags.c_contiguous
+        weights = np.ascontiguousarray(weights, np.int32)
+        wp = _ptr(weights, ctypes.c_int32)
+    n_e = len(srcs)
+    cap_u = max(1, min(n_e, nv))
+    touched = np.empty(cap_u, np.uint32)
+    uniq = np.empty(cap_u, np.int32)
+    rp = np.empty(cap_u + 1, np.int32)
+    n_uniq = ctypes.c_uint64()
+    rc = lib.lux_push_part_build(
+        _ptr(srcs, ctypes.c_int32), _ptr(row_ptr_slice, ctypes.c_int64), wp,
+        n_e, len(row_ptr_slice) - 1, nv,
+        _ptr(counts, ctypes.c_uint32), _ptr(touched, ctypes.c_uint32),
+        _ptr(uniq, ctypes.c_int32), _ptr(rp, ctypes.c_int32),
+        _ptr(dst_row, ctypes.c_int32),
+        _ptr(w_row, ctypes.c_float) if w_row is not None else None,
+        ctypes.byref(n_uniq),
+    )
+    if rc != 0:
+        raise ValueError("inconsistent part slice (src out of range or "
+                         "row_ptr/n_e mismatch)")
+    nt = int(n_uniq.value)
+    return uniq[:nt], rp[: nt + 1]
+
+
+def fill_src_pos(srcs: np.ndarray, cuts: np.ndarray, nv_pad: int,
+                 out_row: np.ndarray):
+    """Native gathered-state source-position fill (graph/shards.fill_part
+    hot path); writes in place into the caller's row slice.  Returns True,
+    or None if the lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    srcs = np.ascontiguousarray(srcs, np.int32)
+    cuts = np.ascontiguousarray(cuts, np.uint32)
+    assert out_row.flags.c_contiguous and out_row.dtype == np.int32
+    rc = lib.lux_fill_src_pos(
+        _ptr(srcs, ctypes.c_int32), len(srcs), _ptr(cuts, ctypes.c_uint32),
+        len(cuts) - 1, nv_pad, _ptr(out_row, ctypes.c_int32),
+    )
+    if rc != 0:
+        raise ValueError("source id beyond the last cut")
+    return True
 
 
 def count_degrees(col_idx: np.ndarray, nv: int):

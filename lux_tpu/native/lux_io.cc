@@ -16,6 +16,7 @@
 // .lux layout (reference README.md:56-75):
 //   u32 nv | u64 ne | u64 row_end[nv] | u32 col_src[ne] | i32 weight[ne]?
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -30,6 +31,17 @@
 namespace {
 
 constexpr int64_t kHeaderBytes = 12;
+
+// owner = last q with cuts[q] <= s, or num_parts when s is beyond the
+// final cut (callers treat that as -EINVAL)
+uint32_t owner_of(uint32_t s, const uint32_t* cuts, uint32_t num_parts) {
+  uint32_t lo = 0, hi = num_parts;
+  while (lo < hi) {
+    uint32_t mid = (lo + hi) / 2;
+    if (cuts[mid + 1] <= s) lo = mid + 1; else hi = mid;
+  }
+  return lo;
+}
 
 int64_t file_size(int fd) {
   struct stat st;
@@ -222,12 +234,7 @@ int lux_bucket_split(const uint32_t* srcs, uint64_t m, const uint32_t* cuts,
   memset(counts, 0, 8 * (size_t)num_parts);
   std::vector<uint32_t> owner(m);
   for (uint64_t j = 0; j < m; j++) {
-    const uint32_t s = srcs[j];
-    uint32_t lo = 0, hi = num_parts;  // owner = last p with cuts[p] <= s
-    while (lo < hi) {
-      uint32_t mid = (lo + hi) / 2;
-      if (cuts[mid + 1] <= s) lo = mid + 1; else hi = mid;
-    }
+    const uint32_t lo = owner_of(srcs[j], cuts, num_parts);
     if (lo >= num_parts) return -EINVAL;  // src beyond cuts[num_parts]
     owner[j] = lo;
     counts[lo]++;
@@ -239,6 +246,90 @@ int lux_bucket_split(const uint32_t* srcs, uint64_t m, const uint32_t* cuts,
     run += counts[p];
   }
   for (uint64_t j = 0; j < m; j++) order[cursor[owner[j]]++] = j;
+  return 0;
+}
+
+// Per-part push-CSR build: group one part's edge slice by SOURCE vertex
+// (stable), emitting the part's sorted unique sources, per-source edge
+// offsets, and the CSR-ordered local-destination / weight arrays — the
+// host hot path of graph/push_shards.py (the role the reference's
+// unique-vertex init kernels play, components_gpu.cu:550-607, built on
+// host here because the structure is static per partitioning).
+//
+// Counting sort keyed by source: two O(E) passes + an O(U log U) sort of
+// the touched-source list, replacing the NumPy per-part stable argsort
+// (O(E log E) with temporary index arrays).  The counts array is an
+// nv-sized caller-owned scratch that must arrive zeroed; it leaves
+// zeroed (only touched entries are reset), so one allocation serves all
+// parts without O(P * nv) clearing.
+//
+//   srcs[n_e]:      slice col_idx[row_ptr[vlo] : row_ptr[vhi]]
+//   row_ptr[n_v+1]: absolute offsets row_ptr[vlo..vhi]
+//   weights[n_e]:   optional (null = unweighted); int32 in, float out
+//   counts[nv]:     zeroed scratch (see above)
+//   touched[cap_u]: scratch, cap_u >= min(n_e, nv)
+//   uniq[cap_u], rp[cap_u+1], dst_out[n_e], w_out[n_e]: outputs;
+//     dst_out/w_out are written as rows of the padded (P, e_pad) arrays
+//   *n_uniq: number of distinct sources written to uniq/rp
+int lux_push_part_build(const int32_t* srcs, const int64_t* row_ptr,
+                        const int32_t* weights, uint64_t n_e, uint32_t n_v,
+                        uint32_t nv, uint32_t* counts, uint32_t* touched,
+                        int32_t* uniq, int32_t* rp, int32_t* dst_out,
+                        float* w_out, uint64_t* n_uniq) {
+  uint64_t nt = 0;
+  for (uint64_t e = 0; e < n_e; e++) {
+    const uint32_t s = (uint32_t)srcs[e];
+    if (s >= nv) return -EINVAL;
+    if (counts[s]++ == 0) touched[nt++] = s;
+  }
+  std::sort(touched, touched + nt);
+  // prefix the sorted counts into rp; repurpose counts[] as insertion
+  // cursors for the scatter pass
+  uint32_t off = 0;
+  rp[0] = 0;
+  for (uint64_t i = 0; i < nt; i++) {
+    const uint32_t s = touched[i];
+    uniq[i] = (int32_t)s;
+    const uint32_t c = counts[s];
+    counts[s] = off;
+    off += c;
+    rp[i + 1] = (int32_t)off;
+  }
+  // scatter edges to their CSR slots, walking row_ptr so each edge's
+  // part-local destination comes from its position in the slice (the
+  // slice is dst-grouped CSC order, so this pass is also stable)
+  const int64_t base = row_ptr[0];
+  uint64_t e = 0;
+  for (uint32_t v = 0; v < n_v; v++) {
+    const uint64_t hi = (uint64_t)(row_ptr[v + 1] - base);
+    if (hi > n_e) return -EINVAL;
+    for (; e < hi; e++) {
+      const uint32_t pos = counts[(uint32_t)srcs[e]]++;
+      dst_out[pos] = (int32_t)v;
+      if (weights) w_out[pos] = (float)weights[e];
+    }
+  }
+  if (e != n_e) return -EINVAL;  // row_ptr slice inconsistent with n_e
+  for (uint64_t i = 0; i < nt; i++) counts[touched[i]] = 0;
+  *n_uniq = nt;
+  return 0;
+}
+
+// Gathered-state source positions for one part's edge slice: for source
+// s owned by part q (cuts[q] <= s < cuts[q+1]),
+//   src_pos = q * nv_pad + (s - cuts[q])
+// — the pull layout's padded all-gather addressing (graph/shards.py
+// fill_part).  One O(m log P) pass in int32, replacing NumPy's
+// searchsorted + int64 owner/offset temporaries (3 full-size
+// intermediates on the host build hot path).
+int lux_fill_src_pos(const int32_t* srcs, uint64_t m, const uint32_t* cuts,
+                     uint32_t num_parts, uint32_t nv_pad, int32_t* out) {
+  for (uint64_t j = 0; j < m; j++) {
+    const uint32_t s = (uint32_t)srcs[j];
+    const uint32_t lo = owner_of(s, cuts, num_parts);
+    if (lo >= num_parts) return -EINVAL;
+    out[j] = (int32_t)(lo * nv_pad + (s - cuts[lo]));
+  }
   return 0;
 }
 
