@@ -65,6 +65,43 @@ def _run_pallas(cfg, g):
     return 0
 
 
+def _run_feat(cfg, g, prog):
+    """--feat-shards N: CF on the 2-D (parts x feat) mesh — the latent K
+    dim split over FEAT_AXIS, per-chip state and exchange volume /N, one
+    (E,)-sized error-dot psum per iteration (parallel/feat.py)."""
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.parallel import feat
+
+    if cfg.verbose or cfg.ckpt_every or cfg.ckpt_dir:
+        raise SystemExit(
+            "--feat-shards: -verbose/checkpointing are not wired to the "
+            "2-D feat mesh; drop --feat-shards for those"
+        )
+    shards = build_pull_shards(g, cfg.num_parts)
+    # the gathered exchange carries K/F features per chip
+    est = common.estimate_exchange(
+        shards, cfg, state_width=cf_model.K // cfg.feat_shards
+    )
+    print(est)
+    preflight.check_fits(est)
+    mesh = feat.make_mesh_feat(cfg.num_parts, cfg.feat_shards)
+    # state is born sharded on the 2-D mesh: no chip ever holds (V, K)
+    state = feat.init_state_feat(prog, shards.arrays, mesh)
+    from lux_tpu.utils import profiling
+
+    with profiling.trace(cfg.profile_dir):
+        timer = Timer()
+        state = feat.run_cf_feat_dist(
+            prog, shards.spec, shards.arrays, state, cfg.num_iters, mesh,
+            cfg.method,
+        )
+        elapsed = timer.stop(state)
+    report_elapsed(elapsed, g.ne, cfg.num_iters)
+    v = shards.scatter_to_global(jax.device_get(state)).astype("float32")
+    print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
+    return 0
+
+
 def main(argv=None):
     cfg = parse_args(argv, description=__doc__, pull=True)
     g = common.load_graph(cfg, weighted=True, bipartite=True)
@@ -72,6 +109,8 @@ def main(argv=None):
     common.validate_exchange(cfg, prog)
     if cfg.method == "pallas":
         return _run_pallas(cfg, g)
+    if cfg.feat_shards > 1:
+        return _run_feat(cfg, g, prog)
     shards = common.build_exchange_shards(g, cfg)
     est = common.estimate_exchange(shards, cfg, state_width=cf_model.K)
     print(est)

@@ -94,6 +94,39 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             )
         if cfg.distributed:
             require_parts_fit_devices(cfg, "--method pallas")
+    if cfg.feat_shards > 1:
+        if getattr(prog, "k", 1) <= 1:
+            raise SystemExit(
+                "--feat-shards shards a wide (V, K) latent state; this "
+                "app's state has no feature dim (colfilter only)"
+            )
+        if not cfg.distributed:
+            raise SystemExit("--feat-shards requires --distributed")
+        if cfg.exchange != "allgather" or cfg.edge_shards > 1:
+            raise SystemExit(
+                "--feat-shards (2-D parts x feat mesh) runs on the "
+                "allgather exchange; it cannot combine with --exchange "
+                "ring/scatter or --edge-shards"
+            )
+        if cfg.method == "pallas":
+            raise SystemExit(
+                "--feat-shards supports --method scan/scatter/cumsum/"
+                "mxsum (the kernel path has its own distribution)"
+            )
+        if prog.k % cfg.feat_shards:
+            raise SystemExit(
+                f"--feat-shards {cfg.feat_shards} must divide the latent "
+                f"dim K={prog.k}"
+            )
+        import jax
+
+        need = cfg.num_parts * cfg.feat_shards
+        if len(jax.devices()) < need:
+            raise SystemExit(
+                f"--feat-shards: {cfg.num_parts} x {cfg.feat_shards} = "
+                f"{need} devices needed, {len(jax.devices())} available"
+            )
+        return
     if cfg.edge_shards > 1:
         if not cfg.distributed:
             raise SystemExit("--edge-shards requires --distributed")

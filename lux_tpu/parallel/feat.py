@@ -1,0 +1,151 @@
+"""Feature-dimension (tensor-parallel) sharding for wide vertex states.
+
+CF's latent state is (V, K): the one app state SURVEY.md §7.3 flags for
+memory (10.7 GB f32 at RMAT27 K=20).  The 1-D engines shard V over
+``parts`` and replicate K; this module adds the second mesh axis
+promised in parallel/mesh.py — ``feat`` — and runs CF on a 2-D
+(parts × feat) mesh with K split across FEAT_AXIS, the tensor-parallel
+analog of the reference's one-axis GPU slicing (SURVEY.md §2.5):
+
+  * every device holds a (k_parts, V, K/F) state block: per-chip HBM for
+    the state AND the per-iteration all-gathered exchange shrink ×F
+    (the all_gather rides only the parts axis, within a feat column);
+  * the one cross-feat term in CF's math is the K-dim error dot product
+      err = w - <v_src, v_dst>
+    which becomes a local partial dot + one (E,)-sized
+    ``lax.psum(..., FEAT_AXIS)`` per iteration — O(E) wire instead of
+    O(E·K) gradient traffic, because the err·srcVec outer product and
+    the segmented per-destination reduction are feat-local;
+  * apply (GAMMA/LAMBDA update) is elementwise over K: feat-local.
+
+Math parity: identical recurrence to models/colfilter.CFProgram
+(col_filter/colfilter_gpu.cu:85-101); the only reassociation is the
+K-sum splitting into F partial sums, so results match the 1-D engines
+to float addition-order tolerance (exact when F divides the dot's
+addition tree evenly — tests compare allclose + RMSE).
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from lux_tpu.graph.shards import ShardArrays, ShardSpec
+from lux_tpu.ops import segment
+from lux_tpu.parallel.mesh import FEAT_AXIS, PARTS_AXIS, flatten_gather
+
+_REDUCERS = segment.reducers()
+
+
+def make_mesh_feat(num_parts: int, feat_shards: int, devices=None) -> Mesh:
+    """2-D (parts × feat) mesh over num_parts * feat_shards devices.
+    Feat is the MINOR axis so a feat column's all_gather stays between
+    mesh-adjacent devices (ICI-neighbor rings, like edge2d's layout)."""
+    if devices is None:
+        devices = jax.devices()
+    need = num_parts * feat_shards
+    assert len(devices) >= need, (len(devices), need)
+    devs = np.asarray(devices[:need]).reshape(num_parts, feat_shards)
+    return Mesh(devs, (PARTS_AXIS, FEAT_AXIS))
+
+
+def _arrays_specs():
+    return ShardArrays(*([P(PARTS_AXIS)] * len(ShardArrays._fields)))
+
+
+def shard_feat(mesh: Mesh, arrays: ShardArrays, state0):
+    """Place stacked arrays (parts-sharded, feat-replicated) and the
+    (P, V, K) state (parts × feat sharded) on the 2-D mesh.  device_put
+    straight from host per leaf — no default-device staging; an
+    already-correctly-sharded state passes through copy-free."""
+    arr_sh = NamedSharding(mesh, P(PARTS_AXIS))
+    st_sh = NamedSharding(mesh, P(PARTS_AXIS, None, FEAT_AXIS))
+    arrays = jax.tree.map(lambda a: jax.device_put(a, arr_sh), arrays)
+    return arrays, jax.device_put(state0, st_sh)
+
+
+def init_state_feat(prog, arrays: ShardArrays, mesh: Mesh):
+    """(P, V, K) initial latent state created DIRECTLY sharded over the
+    2-D mesh: only the small (P, V) vertex inputs ever exist whole; the
+    K-wide state is born (parts × feat)-sharded, so no single chip holds
+    the full (V, K) matrix — the point of feat sharding at the RMAT27
+    scale the module docstring cites."""
+    arr_sh = NamedSharding(mesh, P(PARTS_AXIS))
+    st_sh = NamedSharding(mesh, P(PARTS_AXIS, None, FEAT_AXIS))
+    gv = jax.device_put(np.asarray(arrays.global_vid), arr_sh)
+    dg = jax.device_put(np.asarray(arrays.degree), arr_sh)
+    vm = jax.device_put(np.asarray(arrays.vtx_mask), arr_sh)
+    return jax.jit(jax.vmap(prog.init_state), out_shardings=st_sh)(gv, dg, vm)
+
+
+@lru_cache(maxsize=64)
+def _compile_cf_feat(prog, mesh, num_iters: int, method: str):
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(_arrays_specs(), P(PARTS_AXIS, None, FEAT_AXIS)),
+        out_specs=P(PARTS_AXIS, None, FEAT_AXIS),
+    )
+    def run(arr_blk, state_blk):
+        # block: (k_parts, V, Kf).  One iteration = parts-axis gather of
+        # the LOCAL feat slice, partial dots, one cross-feat psum for the
+        # error term, then feat-local accumulate + apply (module docstring;
+        # math from models/colfilter.CFProgram.edge_value/apply).
+        def body(_, block):
+            full = flatten_gather(block)  # (P*V, Kf) over parts only
+
+            def gather(arr, loc):
+                src = full[arr.src_pos].astype(jnp.float32)  # (E, Kf)
+                dst = loc[
+                    jnp.clip(arr.dst_local, 0, loc.shape[0] - 1)
+                ].astype(jnp.float32)
+                return src, jnp.sum(src * dst, axis=-1)
+
+            src_vecs, part_dot = jax.vmap(gather)(arr_blk, block)
+            # the ONLY cross-feat exchange: (k_parts, E) error dots
+            err = arr_blk.weights - jax.lax.psum(part_dot, FEAT_AXIS)
+            vals = err[..., None] * src_vecs  # (k_parts, E, Kf)
+
+            def reduce_apply(arr, v, loc):
+                acc = _REDUCERS[prog.reduce](
+                    v, arr.row_ptr, arr.head_flag, arr.dst_local,
+                    method=method,
+                )
+                return prog.apply(loc, acc, arr)
+
+            return jax.vmap(reduce_apply)(arr_blk, vals, block)
+
+        return jax.lax.fori_loop(0, num_iters, body, state_blk)
+
+    return run
+
+
+def run_cf_feat_dist(
+    prog,
+    spec: ShardSpec,
+    arrays: ShardArrays,
+    state0,
+    num_iters: int,
+    mesh: Mesh,
+    method: str = "auto",
+):
+    """Fixed-iteration CF on the (parts × feat) mesh.  ``state0`` is the
+    stacked (P, V, K) latent state; K must divide by the feat extent and
+    P by the parts extent (k resident parts per device).  Returns the
+    final stacked state (sharded)."""
+    from lux_tpu.engine import methods
+
+    method = methods.resolve(method, prog.reduce)
+    assert mesh.axis_names == (PARTS_AXIS, FEAT_AXIS), mesh.axis_names
+    d_parts = mesh.shape[PARTS_AXIS]
+    d_feat = mesh.shape[FEAT_AXIS]
+    assert spec.num_parts % d_parts == 0, (spec.num_parts, d_parts)
+    k = state0.shape[-1]
+    assert k % d_feat == 0, (k, d_feat)
+    assert prog.reduce == "sum", "feat sharding is CF's sum-reduce path"
+    arrays, state0 = shard_feat(mesh, arrays, state0)
+    return _compile_cf_feat(prog, mesh, num_iters, method)(arrays, state0)

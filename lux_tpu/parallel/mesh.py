@@ -12,7 +12,8 @@ Axis naming convention:
   * ``parts`` — the graph partition axis (one contiguous vertex range per
     chip; the sequence/context-parallel analog, SURVEY.md §2.5).
   * ``feat``  — optional second axis for feature-dimension sharding of
-    wide vertex states (CF latent vectors; tensor-parallel analog).
+    wide vertex states (CF latent vectors; tensor-parallel analog) —
+    see parallel/feat.py.
 """
 from __future__ import annotations
 

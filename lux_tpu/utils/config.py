@@ -44,6 +44,7 @@ class RunConfig:
     #: >1 = 2-D (parts x edge) mesh: each part's edges split over this many
     #: chips, partial reductions psum'd (for parts too big for one chip)
     edge_shards: int = 1
+    feat_shards: int = 1
     #: >0 = adaptive dynamic repartitioning (push apps): every N iterations
     #: rebalance the vertex cuts from the measured per-part load (the Lux
     #: paper's runtime repartitioning, absent from the reference code)
@@ -96,6 +97,10 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                         help="split each part's edges over N chips "
                              "(2-D parts x edge mesh; total chips = "
                              "num_parts * N)")
+        ap.add_argument("--feat-shards", type=int, default=1,
+                        help="split the latent feature dim over N chips "
+                             "(2-D parts x feat mesh, CF only; total "
+                             "chips = num_parts * N)")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],
@@ -132,6 +137,7 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         weighted=getattr(ns, "weighted", False),
         dtype=getattr(ns, "dtype", "float32"),
         edge_shards=getattr(ns, "edge_shards", 1),
+        feat_shards=getattr(ns, "feat_shards", 1),
         repartition_every=getattr(ns, "repartition_every", 0),
         repartition_threshold=getattr(ns, "repartition_threshold", 1.25),
     )

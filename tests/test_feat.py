@@ -1,0 +1,149 @@
+"""Feature-dimension (tensor-parallel) CF sharding: the 2-D
+(parts × feat) mesh engine (parallel/feat.py) — the FEAT_AXIS promised
+in parallel/mesh.py.  Parity vs the 1-D engines, k resident parts,
+bf16 state, CLI routing, and flag validation."""
+import numpy as np
+import pytest
+
+import jax
+
+from lux_tpu.apps import colfilter as cf_app
+from lux_tpu.engine import pull
+from lux_tpu.graph import generate
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models import colfilter as cf
+from lux_tpu.parallel import feat
+
+
+@pytest.fixture(scope="module")
+def g():
+    return generate.rmat(10, 8, seed=9, weighted=True)
+
+
+@pytest.fixture(scope="module")
+def setup(g):
+    shards = build_pull_shards(g, 4)
+    prog = cf.CFProgram()
+    s0 = pull.init_state(prog, jax.tree.map(np.asarray, shards.arrays))
+    ref = shards.scatter_to_global(
+        np.asarray(
+            pull.run_pull_fixed(
+                prog, shards.spec, shards.arrays, s0, 5, method="scan"
+            )
+        )
+    )
+    return shards, prog, s0, ref
+
+
+def test_feat_matches_single_device(setup):
+    shards, prog, s0, ref = setup
+    mesh = feat.make_mesh_feat(4, 2)
+    out = feat.run_cf_feat_dist(
+        prog, shards.spec, shards.arrays, s0, 5, mesh, method="scan"
+    )
+    np.testing.assert_allclose(
+        shards.scatter_to_global(np.asarray(out)), ref, rtol=1e-6, atol=1e-7
+    )
+
+
+def test_feat_resident_parts(setup):
+    """P=4 parts on a 2x2 mesh: k=2 resident parts per device."""
+    shards, prog, s0, ref = setup
+    mesh = feat.make_mesh_feat(2, 2)
+    out = feat.run_cf_feat_dist(
+        prog, shards.spec, shards.arrays, s0, 5, mesh, method="scan"
+    )
+    np.testing.assert_allclose(
+        shards.scatter_to_global(np.asarray(out)), ref, rtol=1e-6, atol=1e-7
+    )
+
+
+def test_feat_four_way_split(setup):
+    """K=20 over 4 feat shards (Kf=5), 2 parts."""
+    shards2 = build_pull_shards(
+        generate.rmat(10, 8, seed=9, weighted=True), 2
+    )
+    prog = cf.CFProgram()
+    s0 = pull.init_state(prog, jax.tree.map(np.asarray, shards2.arrays))
+    ref = shards2.scatter_to_global(
+        np.asarray(
+            pull.run_pull_fixed(
+                prog, shards2.spec, shards2.arrays, s0, 4, method="scan"
+            )
+        )
+    )
+    mesh = feat.make_mesh_feat(2, 4)
+    out = feat.run_cf_feat_dist(
+        prog, shards2.spec, shards2.arrays, s0, 4, mesh, method="scan"
+    )
+    np.testing.assert_allclose(
+        shards2.scatter_to_global(np.asarray(out)), ref, rtol=1e-6,
+        atol=1e-7,
+    )
+
+
+def test_feat_bf16_state(setup):
+    """bf16 storage composes with feat sharding (f32 error math)."""
+    shards, _, _, _ = setup
+    prog = cf.CFProgram(dtype="bfloat16")
+    s0 = pull.init_state(prog, jax.tree.map(np.asarray, shards.arrays))
+    mesh = feat.make_mesh_feat(4, 2)
+    out = feat.run_cf_feat_dist(
+        prog, shards.spec, shards.arrays, s0, 5, mesh, method="scan"
+    )
+    ref = pull.run_pull_fixed(
+        prog, shards.spec, shards.arrays, s0, 5, method="scan"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32)
+    )
+
+
+def test_feat_rerun_bitwise(setup):
+    shards, prog, s0, _ = setup
+    mesh = feat.make_mesh_feat(4, 2)
+    a = feat.run_cf_feat_dist(
+        prog, shards.spec, shards.arrays, s0, 5, mesh, method="scan"
+    )
+    b = feat.run_cf_feat_dist(
+        prog, shards.spec, shards.arrays, s0, 5, mesh, method="scan"
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+CLI = ["--rmat-scale", "9", "--seed", "4", "-ni", "4"]
+
+
+def test_cli_feat_matches_1d(capsys):
+    assert cf_app.main(CLI + ["-ng", "4", "--distributed",
+                              "--feat-shards", "2"]) == 0
+    rmse_2d = [ln for ln in capsys.readouterr().out.splitlines()
+               if "RMSE" in ln]
+    assert cf_app.main(CLI + ["-ng", "4", "--distributed"]) == 0
+    rmse_1d = [ln for ln in capsys.readouterr().out.splitlines()
+               if "RMSE" in ln]
+    assert rmse_2d == rmse_1d
+
+
+@pytest.mark.parametrize(
+    "extra,match",
+    [
+        (["--feat-shards", "2"], "requires --distributed"),
+        (["--feat-shards", "2", "--distributed", "--exchange", "ring"],
+         "allgather"),
+        (["--feat-shards", "3", "--distributed"], "must divide"),
+        (["--feat-shards", "4", "-ng", "4", "--distributed"],
+         "devices needed"),
+    ],
+)
+def test_cli_feat_rejections(extra, match):
+    with pytest.raises(SystemExit, match=match):
+        cf_app.main(CLI + extra)
+
+
+def test_cli_feat_rejected_for_scalar_state_apps():
+    from lux_tpu.apps import pagerank as pr_app
+
+    with pytest.raises(SystemExit, match="colfilter only"):
+        pr_app.main(["--rmat-scale", "8", "-ng", "2", "--distributed",
+                     "--feat-shards", "2"])
