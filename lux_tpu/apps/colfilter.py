@@ -177,6 +177,11 @@ def main(argv=None):
     report_elapsed(elapsed, g.ne, cfg.num_iters - start_it)
     v = shards.scatter_to_global(jax.device_get(state)).astype("float32")
     print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
+    if cfg.check:
+        # reference parity: col_filter ships no check task; the RMSE line
+        # above IS the training signal (oracle: tests/test_colfilter.py)
+        print("note: colfilter has no check task (reference parity); the "
+              "RMSE line is the training metric")
     return 0
 
 

@@ -148,6 +148,13 @@ def main(argv=None):
     report_elapsed(elapsed, g.ne, cfg.num_iters - start_it)
     ranks = shards.scatter_to_global(jax.device_get(state))
     common.top_k("rank (pre-divided)", ranks)
+    if cfg.check:
+        # reference parity: pagerank ships no check task (unlike
+        # sssp/components' triangle/dominance oracles); say so instead of
+        # silently swallowing the flag — numeric parity lives in the
+        # numpy/scipy oracle tests (tests/test_pagerank.py)
+        print("note: pagerank has no residual check task (reference "
+              "parity); oracle coverage: tests/test_pagerank.py")
     return 0
 
 

@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: the suite is compile-dominated on this
+# 1-core host (multihost engine programs take minutes); caching programs
+# that cost >1 s to build makes repeat runs cheap.  Same-machine only
+# (/tmp), atomic writes, load errors degrade to a recompile.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("LUX_JAX_CACHE", "/tmp/lux_jax_cache"))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -10,6 +10,11 @@ from lux_tpu.parallel import multihost
 port = {"pull": 29517, "push": 29518}[mode]
 me = multihost.initialize(f"127.0.0.1:{port}", nproc, pid)
 import jax
+
+# share the suite's persistent compile cache (tests/conftest.py): the
+# pair's engine compiles dominate its 300+ s budget on the 1-core host
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("LUX_JAX_CACHE", "/tmp/lux_jax_cache"))
 import numpy as np
 assert jax.process_count() == nproc, jax.process_count()
 assert jax.device_count() == 4 * nproc
