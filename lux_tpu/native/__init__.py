@@ -97,6 +97,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.lux_fill_src_pos.argtypes = [i32p, ctypes.c_uint64, u32p,
                                      ctypes.c_uint32, ctypes.c_uint32, i32p]
     lib.lux_fill_src_pos.restype = ctypes.c_int
+    lib.lux_blockcsr_fill.argtypes = [
+        i64p, ctypes.c_uint32, i32p, f32p, ctypes.c_uint64,
+        ctypes.c_uint32, ctypes.c_uint32, i64p, i32p, i32p, f32p,
+    ]
+    lib.lux_blockcsr_fill.restype = ctypes.c_int
     return lib
 
 
@@ -243,6 +248,39 @@ def fill_src_pos(srcs: np.ndarray, cuts: np.ndarray, nv_pad: int,
     )
     if rc != 0:
         raise ValueError("source id beyond the last cut")
+    return True
+
+
+def blockcsr_fill(row_ptr: np.ndarray, src_pos: np.ndarray,
+                  weights: Optional[np.ndarray], v_blk: int, t_chunk: int,
+                  chunk_start: np.ndarray, e_src: np.ndarray,
+                  e_dst: np.ndarray, e_w: Optional[np.ndarray]):
+    """Native block-CSR chunk fill (ops/pallas_spmv.build_blockcsr hot
+    path); writes the (C, T) chunk arrays in place.  Returns True, or
+    None if the lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    row_ptr = np.ascontiguousarray(row_ptr, np.int64)
+    src_pos = np.ascontiguousarray(src_pos, np.int32)
+    chunk_start = np.ascontiguousarray(chunk_start, np.int64)
+    wp = None
+    if weights is not None:
+        assert e_w is not None and e_w.dtype == np.float32
+        assert e_w.flags.c_contiguous
+        weights = np.ascontiguousarray(weights, np.float32)
+        wp = _ptr(weights, ctypes.c_float)
+    assert e_src.flags.c_contiguous and e_src.dtype == np.int32
+    assert e_dst.flags.c_contiguous and e_dst.dtype == np.int32
+    rc = lib.lux_blockcsr_fill(
+        _ptr(row_ptr, ctypes.c_int64), len(row_ptr) - 1,
+        _ptr(src_pos, ctypes.c_int32), wp, len(src_pos), v_blk, t_chunk,
+        _ptr(chunk_start, ctypes.c_int64), _ptr(e_src, ctypes.c_int32),
+        _ptr(e_dst, ctypes.c_int32),
+        _ptr(e_w, ctypes.c_float) if e_w is not None else None,
+    )
+    if rc != 0:
+        raise ValueError("inconsistent row_ptr for block-CSR fill")
     return True
 
 

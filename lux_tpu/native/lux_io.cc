@@ -333,6 +333,42 @@ int lux_fill_src_pos(const int32_t* srcs, uint64_t m, const uint32_t* cuts,
   return 0;
 }
 
+// Block-CSR chunk fill for the Pallas kernel layout
+// (ops/pallas_spmv.build_blockcsr): every edge lands at
+//   flat = (chunk_start[block(dst)] + within/t_chunk) * t_chunk
+//          + within % t_chunk
+// where within = e - row_ptr[block_base].  One sequential O(ne) pass
+// walking row_ptr (dst is implied by CSC position — never
+// materialized), replacing the NumPy build's four O(ne) int64
+// temporaries + three flat scatters.  Within a block the layout is
+// slice-ordered, so the pass is forward-only and cache-friendly.
+//   row_ptr[nv+1], src_pos[ne], w[ne] (nullable, pre-cast f32)
+//   chunk_start[num_vblocks]: first chunk id of each vertex block
+//   e_src[C*T] (pre-zeroed), e_dst[C*T] (pre-filled v_blk), e_w[C*T]
+int lux_blockcsr_fill(const int64_t* row_ptr, uint32_t nv,
+                      const int32_t* src_pos, const float* w, uint64_t ne,
+                      uint32_t v_blk, uint32_t t_chunk,
+                      const int64_t* chunk_start, int32_t* e_src,
+                      int32_t* e_dst, float* e_w) {
+  for (uint32_t v = 0; v < nv; v++) {
+    const uint32_t b = v / v_blk;
+    const int64_t block_lo = row_ptr[(uint64_t)b * v_blk];  // <= v < nv
+    const int32_t dst_rel = (int32_t)(v - b * v_blk);
+    const uint64_t lo = (uint64_t)row_ptr[v], hi = (uint64_t)row_ptr[v + 1];
+    if (hi > ne || lo > hi) return -EINVAL;
+    for (uint64_t e = lo; e < hi; e++) {
+      const uint64_t within = e - (uint64_t)block_lo;
+      const uint64_t flat =
+          ((uint64_t)chunk_start[b] + within / t_chunk) * t_chunk
+          + within % t_chunk;
+      e_src[flat] = src_pos[e];
+      e_dst[flat] = dst_rel;
+      if (w) e_w[flat] = w[e];
+    }
+  }
+  return 0;
+}
+
 // Out-degree histogram over an edge-source array (the native equivalent of
 // pull_scan_task_impl's degree count, core/pull_model.inl:322-345).
 int lux_count_degrees(const uint32_t* col, uint64_t ne, uint32_t nv,

@@ -81,13 +81,9 @@ def build_blockcsr(
     """
     if src_pos is None:
         src_pos = g.col_idx.astype(np.int32)
-    dst = g.dst_of_edges()
     num_vblocks = _round_up(g.nv, v_blk) // v_blk
     ne = int(g.row_ptr[-1])
 
-    # fully vectorized host build (a per-chunk Python loop is O(ne/T)
-    # iterations — hours at RMAT27 scale): every edge's chunk and slot are
-    # computed array-wise, then placed with one flat scatter per array.
     block_lo = np.asarray(
         g.row_ptr[np.minimum(np.arange(num_vblocks) * v_blk, g.nv)],
         np.int64,
@@ -101,25 +97,40 @@ def build_blockcsr(
     chunk_start = np.zeros(num_vblocks + 1, np.int64)
     np.cumsum(chunks_per_block, out=chunk_start[1:])
 
-    # per-edge block (edges are CSC-ordered, blocks are contiguous spans)
-    e_block = np.repeat(
-        np.arange(num_vblocks, dtype=np.int64), block_hi - block_lo
-    )
-    within = np.arange(ne, dtype=np.int64) - block_lo[e_block]
-    e_chunk = chunk_start[e_block] + within // t_chunk
-    e_slot = within % t_chunk
-    flat = e_chunk * t_chunk + e_slot
-
     e_src_pos = np.zeros((num_chunks, t_chunk), np.int32)
     e_dst_rel = np.full((num_chunks, t_chunk), v_blk, np.int32)
-    e_src_pos.reshape(-1)[flat] = src_pos[:ne]
-    e_dst_rel.reshape(-1)[flat] = (
-        dst[:ne].astype(np.int64) - e_block * v_blk
-    ).astype(np.int32)
     e_weight = None
     if g.weights is not None:
         e_weight = np.zeros((num_chunks, t_chunk), np.float32)
-        e_weight.reshape(-1)[flat] = g.weights[:ne]
+
+    from lux_tpu import native
+
+    if native.blockcsr_fill(
+        g.row_ptr, src_pos[:ne],
+        g.weights[:ne] if g.weights is not None else None,
+        v_blk, t_chunk, chunk_start[:-1],
+        e_src_pos.reshape(-1), e_dst_rel.reshape(-1),
+        e_weight.reshape(-1) if e_weight is not None else None,
+    ) is None:
+        # NumPy fallback (and the oracle): fully vectorized — a per-chunk
+        # Python loop is O(ne/T) iterations, hours at RMAT27 scale; every
+        # edge's chunk and slot are computed array-wise, then placed with
+        # one flat scatter per array
+        dst = g.dst_of_edges()
+        # per-edge block (edges are CSC-ordered, blocks are contiguous)
+        e_block = np.repeat(
+            np.arange(num_vblocks, dtype=np.int64), block_hi - block_lo
+        )
+        within = np.arange(ne, dtype=np.int64) - block_lo[e_block]
+        e_chunk = chunk_start[e_block] + within // t_chunk
+        e_slot = within % t_chunk
+        flat = e_chunk * t_chunk + e_slot
+        e_src_pos.reshape(-1)[flat] = src_pos[:ne]
+        e_dst_rel.reshape(-1)[flat] = (
+            dst[:ne].astype(np.int64) - e_block * v_blk
+        ).astype(np.int32)
+        if e_weight is not None:
+            e_weight.reshape(-1)[flat] = g.weights[:ne]
     chunk_block = np.repeat(
         np.arange(num_vblocks, dtype=np.int32), chunks_per_block
     )

@@ -86,6 +86,34 @@ def test_elastic_resume_across_parts_and_exchange(g, start, tmp_path):
     assert push.edges_total(edges) == push.edges_total(want_e)
 
 
+def test_elastic_resume_k_resident_parts(g, start, tmp_path):
+    """Resume a P=2 save on P=16 over the 8-device mesh: two parts
+    RESIDENT per device (the mapper-slicing analog) through the
+    checkpointed windowed driver."""
+    sh2 = build_push_shards(g, 2)
+    prog = SSSPProgram(nv=sh2.spec.nv, start=start)
+    cfg = RunConfig(
+        ckpt_dir=str(tmp_path), ckpt_every=3, max_iters=3, method="scan"
+    )
+    app.run_push_checkpointed(prog, sh2, cfg, None, "sssp")
+
+    mesh8 = make_mesh(8)
+    sh16 = build_push_shards(g, 16)
+    cfg2 = RunConfig(
+        ckpt_dir=str(tmp_path), ckpt_every=5, method="scan",
+        distributed=True, num_parts=16,
+    )
+    st, it, edges, _ = app.run_push_checkpointed(
+        prog, sh16, cfg2, mesh8, "sssp"
+    )
+    np.testing.assert_array_equal(
+        sh16.scatter_to_global(np.asarray(st)), bfs_reference(g, start)
+    )
+    _, want_it, want_e = push.run_push(prog, sh2, 1000, method="scan")
+    assert it == int(want_it)
+    assert push.edges_total(edges) == push.edges_total(want_e)
+
+
 def test_cli_ckpt_and_resume(g, tmp_path, capsys):
     args = [
         "--rmat-scale", "9", "--rmat-ef", "8", "--seed", "7",
