@@ -96,7 +96,10 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         ap.add_argument("--edge-shards", type=int, default=1,
                         help="split each part's edges over N chips "
                              "(2-D parts x edge mesh; total chips = "
-                             "num_parts * N)")
+                             "num_parts * N). Capacity feature for parts "
+                             "bigger than one chip: state is replicated "
+                             "per edge shard, so exchange wire volume "
+                             "scales xN")
         ap.add_argument("--feat-shards", type=int, default=1,
                         help="split the latent feature dim over N chips "
                              "(2-D parts x feat mesh, CF only; total "

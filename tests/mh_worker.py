@@ -7,7 +7,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from lux_tpu.parallel import multihost
 # distinct coordinator port per mode: the pull and push tests may run
 # back-to-back and a lingering TIME_WAIT port would wedge the second
-port = {"pull": 29517, "push": 29518}[mode]
+port = {"pull": 29517, "push": 29518, "feat": 29519}[mode]
 me = multihost.initialize(f"127.0.0.1:{port}", nproc, pid)
 import jax
 
@@ -36,6 +36,38 @@ def check_local(arr, cuts, mine, want, assert_fn):
         lo, hi = int(cuts[p]), int(cuts[p + 1])
         assert_fn(np.asarray(got[i].data)[0][: hi - lo], want[lo:hi])
 
+
+if mode == "feat":
+    # --- 2-D (parts x feat) CF across REAL processes: the parts-axis
+    # all_gather AND the cross-feat error-dot psum both cross the process
+    # boundary (4 parts x 2 feat shards over 2 hosts' 8 devices)
+    from lux_tpu.models import colfilter as cf_model
+    from lux_tpu.parallel import feat
+
+    gw = generate.bipartite_ratings(96, 64, 800, seed=5)
+    fsh = build_pull_shards(gw, 4)
+    fmesh = feat.make_mesh_feat(4, 2)
+    # gamma=1e-3 (not the app default 3.5e-7) so the 3-iteration signal
+    # exceeds the comparison tolerance — same convention as every CF
+    # oracle test; at the default gamma the unmodified initial state
+    # would pass rtol=5e-4
+    cfp = cf_model.CFProgram(gamma=1e-3)
+    s0 = feat.init_state_feat(cfp, fsh.arrays, fmesh)
+    out = feat.run_cf_feat_dist(
+        cfp, fsh.spec, fsh.arrays, s0, 3, fmesh
+    )
+    want = cf_model.colfilter_reference(gw, 3, gamma=1e-3)
+    for shard in out.addressable_shards:
+        p = shard.index[0].start
+        ks = shard.index[2]
+        lo, hi = int(fsh.cuts[p]), int(fsh.cuts[p + 1])
+        np.testing.assert_allclose(
+            np.asarray(shard.data)[0][: hi - lo], want[lo:hi, ks],
+            rtol=5e-4, atol=1e-6,
+        )
+    print(f"process {pid}: multihost feat-CF OK ({len(out.addressable_shards)}"
+          f" local shards)", flush=True)
+    sys.exit(0)
 
 if mode == "push":
     # --- push engine across REAL processes: frontier (vid, value) queue

@@ -45,6 +45,15 @@ def test_two_process_distributed_pagerank():
         assert f"process {pid}: multihost ring OK" in out
 
 
+def test_two_process_feat_cf():
+    """The 2-D (parts x feat) CF engine across two real OS processes:
+    both the parts all_gather and the cross-feat error-dot psum cross the
+    process boundary."""
+    outs = _run_pair("feat")
+    for pid, out in enumerate(outs):
+        assert f"process {pid}: multihost feat-CF OK" in out
+
+
 def test_two_process_distributed_push():
     """The direction-optimizing push engine (queue all_gathers + psum'd
     switch flags + dense all_gather inside lax.cond) over two real OS
