@@ -1,11 +1,11 @@
-"""Benchmark harness: PageRank + SSSP + CF on one chip.
+"""Benchmark harness: all four reference apps on one chip.
 
 Prints one JSON metric line per app family, HEADLINE LAST: the final
 stdout line is always the PageRank number ({"metric", "value", "unit",
 "vs_baseline"}) the driver records; the preceding lines carry the SSSP
-(traversed-edges GTEPS) and CF (edge-update GTEPS + per-iteration ms +
-RMSE) datapoints so all four reference apps but CC (structurally the
-same engine as SSSP) have tracked perf signals (VERDICT r2 #4).
+and CC (traversed-edges GTEPS) and CF (edge-update GTEPS +
+per-iteration ms + RMSE) datapoints so every reference app has a
+tracked perf signal (VERDICT r2 #4).
 
 Metric derivation (BASELINE.md): GTEPS = iterations * ne / elapsed / 1e9 on
 a fixed-iteration PageRank run — the reference's headline workload
@@ -36,8 +36,9 @@ Env knobs:
   LUX_BENCH_TPU_S  (default budget-120) how long to wait for the TPU worker
   LUX_BENCH_CPU_SCALE (default min(scale, 18)) fallback worker's RMAT scale
                    — a 1-core CPU needs a smaller graph to finish in budget
-  LUX_BENCH_APPS   (default pagerank,sssp,colfilter) which app metrics to
-                   measure; pagerank is the headline and always prints last
+  LUX_BENCH_APPS   (default pagerank,sssp,components,colfilter) which app
+                   metrics to measure; pagerank is the headline and
+                   always prints last
 """
 from __future__ import annotations
 
@@ -216,34 +217,31 @@ def worker_main():
     apps = [
         a.strip()
         for a in os.environ.get(
-            "LUX_BENCH_APPS", "pagerank,sssp,colfilter"
+            "LUX_BENCH_APPS", "pagerank,sssp,components,colfilter"
         ).split(",")
         if a.strip()
     ]
     suffix = "" if on_tpu else f"_{platform}_fallback"
 
-    def measure_sssp():
-        """Convergence-driven BFS-SSSP; GTEPS over edges ACTUALLY
-        traversed (the engine's exact [hi, lo] counter — dense rounds walk
-        every edge, sparse rounds only the frontier's; SURVEY.md §6).
-        Timing uses the same fetch-differencing discipline: the chunk loop
-        takes a DYNAMIC it_stop, so t(full) - t(1) is the honest marginal
-        cost of the remaining iterations under one compiled program."""
-        import numpy as np
+    push_shards_cache = []
 
+    def _timed_push_convergence(prog, m):
+        """Run a frontier app to convergence on the push chunk loop and
+        time it with the fetch-differencing discipline: the chunk loop
+        takes a DYNAMIC it_stop, so t(full) - t(1) is the honest marginal
+        cost of the remaining iterations under one compiled program.
+        Returns (n_iters, traversed_edges, elapsed_s)."""
         from lux_tpu.engine import push as push_eng
         from lux_tpu.graph.push_shards import build_push_shards
-        from lux_tpu.models.sssp import SSSPProgram
 
-        m = resolve_method("auto", "min", platform)
-        pshards = build_push_shards(g, 1)
-        # start at the max-out-degree vertex: a fixed start (the CLI's
-        # default 0) can have zero out-edges on an RMAT draw, making the
-        # metric a meaningless 0.0/traversed=0 line
-        start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
-        sp = SSSPProgram(nv=pshards.spec.nv, start=start)
-        arrays_p, parrays_p, carry0 = push_eng.push_init(sp, pshards)
-        loop = push_eng.compile_push_chunk(sp, pshards.pspec, pshards.spec, m)
+        if not push_shards_cache:
+            # program-independent O(ne) host build: shared by sssp + CC
+            push_shards_cache.append(build_push_shards(g, 1))
+        pshards = push_shards_cache[0]
+        arrays_p, parrays_p, carry0 = push_eng.push_init(prog, pshards)
+        loop = push_eng.compile_push_chunk(
+            prog, pshards.pspec, pshards.spec, m
+        )
 
         def run(n):
             # the chunk loop does not donate its arguments: one carry0 is
@@ -270,6 +268,24 @@ def worker_main():
             elapsed = per_iter * n_iters
         else:
             elapsed = once(n_iters)
+        return n_iters, traversed, elapsed
+
+    def measure_sssp():
+        """Convergence-driven BFS-SSSP; GTEPS over edges ACTUALLY
+        traversed (the engine's exact [hi, lo] counter — dense rounds walk
+        every edge, sparse rounds only the frontier's; SURVEY.md §6)."""
+        import numpy as np
+
+        from lux_tpu.models.sssp import SSSPProgram
+
+        m = resolve_method("auto", "min", platform)
+        # start at the max-out-degree vertex: a fixed start (the CLI's
+        # default 0) can have zero out-edges on an RMAT draw, making the
+        # metric a meaningless 0.0/traversed=0 line
+        start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
+        n_iters, traversed, elapsed = _timed_push_convergence(
+            SSSPProgram(nv=g.nv, start=start), m
+        )
         gteps = traversed / elapsed / 1e9
         _emit(
             {
@@ -279,6 +295,28 @@ def worker_main():
                 "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
                 "method": m,
                 "start": start,
+                "iters": n_iters,
+                "traversed_edges": traversed,
+            }
+        )
+
+    def measure_components(m):
+        """Max-label CC on the push engine (dense all-active start, the
+        reference's components_gpu.cu:733-739 contract); traversed-edges
+        GTEPS like sssp."""
+        from lux_tpu.models.components import MaxLabelProgram
+
+        n_iters, traversed, elapsed = _timed_push_convergence(
+            MaxLabelProgram(), m
+        )
+        gteps = traversed / elapsed / 1e9
+        _emit(
+            {
+                "metric": f"components_gteps_rmat{scale}_1chip{suffix}",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
                 "iters": n_iters,
                 "traversed_edges": traversed,
             }
@@ -376,6 +414,11 @@ def worker_main():
             measure_sssp()
         except Exception as e:  # noqa: BLE001
             print(f"# sssp failed: {e}", file=sys.stderr, flush=True)
+    if "components" in apps:
+        try:
+            measure_components(resolve_method("auto", "max", platform))
+        except Exception as e:  # noqa: BLE001
+            print(f"# components failed: {e}", file=sys.stderr, flush=True)
     if "pagerank" in apps:
         for m in risky_tail:
             try:
@@ -419,7 +462,7 @@ def _relay(out_path) -> bool:
     found.  The worker emits one line per measured (app, method, dtype)
     as soon as it exists, best-effort: even a worker that later wedged
     inside a risky method has its completed measurements harvested here.
-    One line per family (pagerank/sssp/colfilter), each the
+    One line per family (pagerank/sssp/components/colfilter), each the
     highest-GTEPS one; the pagerank HEADLINE prints LAST — the driver
     and the tests read the final stdout line."""
     try:
