@@ -113,3 +113,48 @@ def test_fuzz_adaptive_repartition(seed):
     )
     np.testing.assert_array_equal(res.state, sssp.bfs_reference(g, start))
     assert sssp.check_distances(g, res.state) == 0
+
+
+@pytest.mark.parametrize("seed", SEEDS[:3])
+def test_fuzz_frontier_ckpt_elastic(seed, tmp_path):
+    """Randomized kill-and-resume: interrupt SSSP at a random iteration
+    on a random layout, resume on ANOTHER random layout; the global
+    state, total iteration count, and exact traversed-edge counter must
+    match the uninterrupted run bitwise."""
+    import dataclasses
+
+    from lux_tpu.apps import sssp as sssp_app
+    from lux_tpu.engine import push
+    from lux_tpu.utils.config import RunConfig
+
+    rng = np.random.default_rng(seed + 7000)
+    g = generate.rmat(int(rng.integers(8, 10)), int(rng.integers(4, 10)),
+                      seed=seed)
+    start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
+    p1 = int(rng.integers(1, 5))
+    p2 = p1 % 4 + 1  # always a DIFFERENT part count: cross-layout resume
+    sh1 = build_push_shards(g, p1)
+    prog = sssp.SSSPProgram(nv=sh1.spec.nv, start=start)
+    want_st, want_it, want_e = push.run_push(prog, sh1, 1000, method="scan")
+    if int(want_it) < 2:
+        pytest.skip("instant convergence — nothing to interrupt")
+
+    cut = int(rng.integers(1, int(want_it)))
+    cfg = RunConfig(ckpt_dir=str(tmp_path), ckpt_every=1, max_iters=cut,
+                    method="scan")
+    sssp_app.run_push_checkpointed(prog, sh1, cfg, None, "sssp")
+
+    sh2 = build_push_shards(g, p2)
+    cfg2 = dataclasses.replace(
+        cfg, max_iters=10_000,
+        ckpt_every=int(rng.integers(1, 4)),
+    )
+    st, it, e, _ = sssp_app.run_push_checkpointed(
+        prog, sh2, cfg2, None, "sssp"
+    )
+    assert it == int(want_it)
+    np.testing.assert_array_equal(
+        sh2.scatter_to_global(np.asarray(st)),
+        sh1.scatter_to_global(np.asarray(want_st)),
+    )
+    assert push.edges_total(e) == push.edges_total(want_e)
