@@ -68,7 +68,10 @@ def _run_pallas(cfg, g):
 def _run_feat(cfg, g, prog):
     """--feat-shards N: CF on the 2-D (parts x feat) mesh — the latent K
     dim split over FEAT_AXIS, per-chip state and exchange volume /N, one
-    (E,)-sized error-dot psum per iteration (parallel/feat.py)."""
+    (E,)-sized error-dot psum per iteration (parallel/feat.py).  With
+    --exchange ring the parts axis streams state blocks instead of
+    all-gathering: per-chip state O(nv/P x K/F) — both big axes sharded
+    at once (the RMAT27 K=20 case, SURVEY.md §7.3)."""
     from lux_tpu.graph.shards import build_pull_shards
     from lux_tpu.parallel import feat
 
@@ -78,7 +81,11 @@ def _run_feat(cfg, g, prog):
             "2-D feat mesh; drop --feat-shards for those"
         )
     shards = build_pull_shards(g, cfg.num_parts)
-    # the gathered exchange carries K/F features per chip
+    if cfg.exchange == "ring":
+        from lux_tpu.parallel import ring
+
+        shards = ring.build_ring_shards(g, cfg.num_parts, pull=shards)
+    # the exchange carries K/F features per chip
     est = common.estimate_exchange(
         shards, cfg, state_width=cf_model.K // cfg.feat_shards
     )
@@ -91,10 +98,15 @@ def _run_feat(cfg, g, prog):
 
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
-        state = feat.run_cf_feat_dist(
-            prog, shards.spec, shards.arrays, state, cfg.num_iters, mesh,
-            cfg.method,
-        )
+        if cfg.exchange == "ring":
+            state = feat.run_cf_feat_ring(
+                prog, shards, state, cfg.num_iters, mesh, cfg.method
+            )
+        else:
+            state = feat.run_cf_feat_dist(
+                prog, shards.spec, shards.arrays, state, cfg.num_iters,
+                mesh, cfg.method,
+            )
         elapsed = timer.stop(state)
     report_elapsed(elapsed, g.ne, cfg.num_iters)
     v = shards.scatter_to_global(jax.device_get(state)).astype("float32")

@@ -102,11 +102,16 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             )
         if not cfg.distributed:
             raise SystemExit("--feat-shards requires --distributed")
-        if cfg.exchange != "allgather" or cfg.edge_shards > 1:
+        if cfg.exchange not in ("allgather", "ring") or cfg.edge_shards > 1:
             raise SystemExit(
                 "--feat-shards (2-D parts x feat mesh) runs on the "
-                "allgather exchange; it cannot combine with --exchange "
-                "ring/scatter or --edge-shards"
+                "allgather or ring exchange; it cannot combine with "
+                "--exchange scatter or --edge-shards"
+            )
+        if cfg.exchange == "ring" and cfg.method not in ("scan", "scatter"):
+            raise SystemExit(
+                "--feat-shards --exchange ring supports --method "
+                "scan/scatter (bucketed reductions carry no row_ptr)"
             )
         if cfg.method == "pallas":
             raise SystemExit(

@@ -676,12 +676,11 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     buckets (min/max end-reductions) instead of all-gathering the whole
     state — per-chip resident state stays O(nv/P), so CC/SSSP scale past
     the replicated-state ceiling (SURVEY.md §7.3)."""
-    from lux_tpu.parallel.ring import RingArrays, _neutral_like
+    from lux_tpu.parallel.ring import RingArrays, neutral_like, ring_sweep
 
     num_parts = spec.num_parts
     D = mesh.devices.size
     k = num_parts // D
-    perm = [(i, (i - 1) % D) for i in range(D)]
     rarr_specs = RingArrays(*([P(PARTS_AXIS)] * len(RingArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
     view_specs = VertexView(*([P(PARTS_AXIS)] * len(VertexView._fields)))
@@ -725,16 +724,7 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                     acc = jax.vmap(one)(rarr_blk, acc)
                 return acc
 
-            def fold_block(s, carry2):
-                acc, stream = carry2
-                acc = fold(s, acc, stream)
-                return acc, jax.lax.ppermute(stream, PARTS_AXIS, perm)
-
-            acc0 = _neutral_like(block, prog.reduce)
-            acc, stream = jax.lax.fori_loop(
-                0, D - 1, fold_block, (acc0, block)
-            )
-            acc = fold(D - 1, acc, stream)
+            acc = ring_sweep(block, neutral_like(block, prog.reduce), fold, D)
             return jnp.where(view_blk.vtx_mask, op(block, acc), block)
 
         def body(c):

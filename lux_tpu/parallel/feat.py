@@ -124,6 +124,131 @@ def _compile_cf_feat(prog, mesh, num_iters: int, method: str):
     return run
 
 
+@lru_cache(maxsize=64)
+def _compile_cf_feat_ring(prog, mesh, num_parts: int, num_iters: int,
+                          method: str):
+    """CF on the (parts × feat) mesh with the RING dense exchange: the
+    largest-config composition (SURVEY.md §7.3 — RMAT27 K=20 state too
+    big for replication on BOTH axes).  Each feat column circulates
+    (k, V, K/F) state blocks over the parts ring (O(nv/P · K/F) resident
+    per chip); the cross-feat error-dot psum happens per fold step on
+    (k, B)-sized partial dots — O(part edges) wire per iteration, never
+    O(E·K)."""
+    from lux_tpu.parallel.ring import RingArrays, neutral_like, ring_sweep
+
+    D = mesh.shape[PARTS_AXIS]
+    k = num_parts // D
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            RingArrays(*([P(PARTS_AXIS)] * len(RingArrays._fields))),
+            P(PARTS_AXIS),  # vtx_mask
+            P(PARTS_AXIS, None, FEAT_AXIS),  # state
+        ),
+        out_specs=P(PARTS_AXIS, None, FEAT_AXIS),
+    )
+    def run(rarr_blk, vtx_mask_blk, state_blk):
+        my = jax.lax.axis_index(PARTS_AXIS)
+
+        def iteration(_, block):
+            V = block.shape[1]
+
+            def fold(s, acc, stream):
+                dev = (my + s) % D
+                qs = [dev * k + j for j in range(k)]  # streamed lane ids
+
+                def dots(rarr_i, local_i):
+                    # (k_stream, B, Kf) src vectors and (k_stream, B)
+                    # partial dots for ONE resident lane, all streamed
+                    # lanes stacked — so the cross-feat exchange below is
+                    # one psum per fold step, not one per lane
+                    src = jnp.stack(
+                        [stream[j][rarr_i.src_local[q]] for j, q in
+                         enumerate(qs)]
+                    ).astype(jnp.float32)
+                    dst = jnp.stack(
+                        [local_i[jnp.clip(rarr_i.dst_local[q], 0, V - 1)]
+                         for q in qs]
+                    ).astype(jnp.float32)
+                    return src, jnp.sum(src * dst, axis=-1)
+
+                srcs, part_dot = jax.vmap(dots)(rarr_blk, block)
+                # the ONE cross-feat exchange: (k_res, k_stream, B) dots
+                w = jnp.stack([rarr_blk.weights[:, q] for q in qs], axis=1)
+                err = w - jax.lax.psum(part_dot, FEAT_AXIS)
+                vals = err[..., None] * srcs  # (k_res, k_stream, B, Kf)
+
+                def red(rarr_i, v, acc_i):
+                    for j, q in enumerate(qs):
+                        part = segment.segment_reduce_by_ends(
+                            v[j], rarr_i.head_flag[q], rarr_i.dst_local[q],
+                            V, reduce="sum", method=method,
+                        )
+                        acc_i = acc_i + part
+                    return acc_i
+
+                return jax.vmap(red)(rarr_blk, vals, acc)
+
+            acc = ring_sweep(block, neutral_like(block, "sum"), fold, D)
+
+            def apply_one(loc, a, vm):
+                return prog.apply(loc, a, _FeatArrView(vtx_mask=vm))
+
+            return jax.vmap(apply_one)(block, acc, vtx_mask_blk)
+
+        return jax.lax.fori_loop(0, num_iters, iteration, state_blk)
+
+    return run
+
+
+class _FeatArrView:
+    """Duck-typed ShardArrays view for CFProgram.apply (reads vtx_mask
+    only)."""
+
+    def __init__(self, vtx_mask):
+        self.vtx_mask = vtx_mask
+
+
+def run_cf_feat_ring(
+    prog,
+    shards,
+    state0,
+    num_iters: int,
+    mesh: Mesh,
+    method: str = "auto",
+):
+    """Fixed-iteration CF on the (parts × feat) mesh with ring-streamed
+    state blocks (``shards`` from ring.build_ring_shards).  Per-chip
+    state: O(nv/P × K/F) — both big-axes compositions at once."""
+    from lux_tpu.engine import methods
+
+    method = methods.resolve(method, prog.reduce)
+    spec = shards.spec
+    assert mesh.axis_names == (PARTS_AXIS, FEAT_AXIS), mesh.axis_names
+    d_parts = mesh.shape[PARTS_AXIS]
+    assert spec.num_parts % d_parts == 0, (spec.num_parts, d_parts)
+    assert state0.shape[-1] % mesh.shape[FEAT_AXIS] == 0
+    assert prog.reduce == "sum"
+    assert len(shards.parts_subset) == spec.num_parts
+    assert method in ("scan", "scatter"), (
+        "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
+    )
+    arr_sh = NamedSharding(mesh, P(PARTS_AXIS))
+    st_sh = NamedSharding(mesh, P(PARTS_AXIS, None, FEAT_AXIS))
+    rarrays = jax.tree.map(
+        lambda a: jax.device_put(a, arr_sh), shards.rarrays
+    )
+    vtx_mask = jax.device_put(np.asarray(shards.arrays.vtx_mask), arr_sh)
+    state0 = jax.device_put(state0, st_sh)
+    run = _compile_cf_feat_ring(
+        prog, mesh, spec.num_parts, num_iters, method
+    )
+    return run(rarrays, vtx_mask, state0)
+
+
 def run_cf_feat_dist(
     prog,
     spec: ShardSpec,

@@ -185,7 +185,25 @@ def build_ring_shards(
 _FOLD = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
 
 
-def _neutral_like(local, reduce):
+def ring_sweep(block, acc0, fold, D: int):
+    """The ring schedule shared by every streaming engine (this module's
+    pull/push dense rounds and parallel/feat's ring × feat CF): D-1 fold
+    steps each overlapped with a ppermute of the stream to the next chip,
+    then the final resident fold without the (dead) last transfer.
+    ``fold(s, acc, stream) -> acc`` consumes the block that started s
+    hops clockwise; D is the parts-axis extent (compile-time)."""
+    perm = [(i, (i - 1) % D) for i in range(D)]
+
+    def fold_block(s, carry):
+        acc, stream = carry
+        acc = fold(s, acc, stream)
+        return acc, jax.lax.ppermute(stream, PARTS_AXIS, perm)
+
+    acc, stream = jax.lax.fori_loop(0, D - 1, fold_block, (acc0, block))
+    return fold(D - 1, acc, stream)
+
+
+def neutral_like(local, reduce):
     """Neutral-element fold accumulator.  Dtype = the REDUCTION dtype, not
     the storage dtype: programs storing bf16 state still reduce in f32
     (e.g. PageRankProgram.edge_value casts), and the fori_loop carry must
@@ -211,7 +229,6 @@ def _neutral_like(local, reduce):
 def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int, method: str):
     D = mesh.devices.size
     k = num_parts // D
-    perm = [(i, (i - 1) % D) for i in range(D)]
 
     @jax.jit
     @partial(
@@ -257,19 +274,7 @@ def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int, method: str)
                     acc = jax.vmap(one)(rarr_blk, block, acc)
                 return acc
 
-            def fold_block(s, carry):
-                acc, stream = carry
-                acc = fold(s, acc, stream)
-                # pass the block to the next chip while compute proceeds
-                return acc, jax.lax.ppermute(stream, PARTS_AXIS, perm)
-
-            acc0 = _neutral_like(block, prog.reduce)
-            # D-1 folds with transfers; the last resident block is folded
-            # without the (dead) final ppermute
-            acc, stream = jax.lax.fori_loop(
-                0, D - 1, fold_block, (acc0, block)
-            )
-            acc = fold(D - 1, acc, stream)
+            acc = ring_sweep(block, neutral_like(block, prog.reduce), fold, D)
             return jax.vmap(
                 lambda loc, a, vm, dg: _apply(prog, loc, a, vm, dg)
             )(block, acc, vtx_mask_blk, degree_blk)

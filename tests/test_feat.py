@@ -111,6 +111,52 @@ def test_feat_rerun_bitwise(setup):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_feat_ring_matches_allgather_feat():
+    """The ring × feat composition (both big axes sharded): bitwise-level
+    agreement with the 1-D engine on a ratings graph."""
+    from lux_tpu.parallel import ring
+
+    g = generate.bipartite_ratings(256, 256, 4096, seed=9)
+    shards = build_pull_shards(g, 4)
+    rs = ring.build_ring_shards(g, 4, pull=shards)
+    prog = cf.CFProgram(gamma=1e-3)
+    s0 = pull.init_state(prog, jax.tree.map(np.asarray, shards.arrays))
+    ref = shards.scatter_to_global(
+        np.asarray(
+            pull.run_pull_fixed(
+                prog, shards.spec, shards.arrays, s0, 4, method="scan"
+            )
+        )
+    )
+    # signal guard: the recurrence must move the state beyond tolerance
+    assert np.abs(ref - np.sqrt(1 / 20)).max() > 1e-3
+    for mesh in (feat.make_mesh_feat(4, 2), feat.make_mesh_feat(2, 2)):
+        out = feat.run_cf_feat_ring(prog, rs, s0, 4, mesh, method="scan")
+        np.testing.assert_allclose(
+            shards.scatter_to_global(np.asarray(out)), ref,
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_feat_ring_bf16_matches_single_device():
+    from lux_tpu.parallel import ring
+
+    g = generate.bipartite_ratings(256, 256, 4096, seed=9)
+    shards = build_pull_shards(g, 4)
+    rs = ring.build_ring_shards(g, 4, pull=shards)
+    prog = cf.CFProgram(gamma=1e-3, dtype="bfloat16")
+    s0 = pull.init_state(prog, jax.tree.map(np.asarray, shards.arrays))
+    out = feat.run_cf_feat_ring(
+        prog, rs, s0, 3, feat.make_mesh_feat(4, 2), method="scan"
+    )
+    ref = pull.run_pull_fixed(
+        prog, shards.spec, shards.arrays, s0, 3, method="scan"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32)
+    )
+
+
 CLI = ["--rmat-scale", "9", "--seed", "4", "-ni", "4"]
 
 
@@ -123,14 +169,21 @@ def test_cli_feat_matches_1d(capsys):
     rmse_1d = [ln for ln in capsys.readouterr().out.splitlines()
                if "RMSE" in ln]
     assert rmse_2d == rmse_1d
+    # ring x feat from the CLI reports the same training metric
+    assert cf_app.main(CLI + ["-ng", "4", "--distributed",
+                              "--feat-shards", "2",
+                              "--exchange", "ring"]) == 0
+    rmse_ring = [ln for ln in capsys.readouterr().out.splitlines()
+                 if "RMSE" in ln]
+    assert rmse_ring == rmse_1d
 
 
 @pytest.mark.parametrize(
     "extra,match",
     [
         (["--feat-shards", "2"], "requires --distributed"),
-        (["--feat-shards", "2", "--distributed", "--exchange", "ring"],
-         "allgather"),
+        (["--feat-shards", "2", "--distributed", "--exchange", "scatter"],
+         "--exchange scatter"),
         (["--feat-shards", "3", "--distributed"], "must divide"),
         (["--feat-shards", "4", "-ng", "4", "--distributed"],
          "devices needed"),
