@@ -44,7 +44,46 @@ WINNERS = {
 #: Unknown platform (gpu via XLA, interpreters): the portable choice.
 FALLBACK = "scan"
 
+#: Measured-winners overlay file: written by an (unattended) TPU bench
+#: race (bench.py) so a chip window updates defaults WITHOUT a code
+#: edit.  Format: {"tpu:sum": "mxsum", ...}; entries must be in
+#: CONCRETE.  Overridable via LUX_METHOD_WINNERS; missing file = no-op.
+WINNERS_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    ".lux_winners.json",
+)
+
+_file_winners_cache: dict | None = None
 _platform_cache: str | None = None
+
+
+def _file_winners() -> dict:
+    """The overlay winners, loaded once per process.  Malformed files and
+    non-CONCRETE entries are ignored (a half-written file must never
+    break every driver)."""
+    global _file_winners_cache
+    if _file_winners_cache is None:
+        path = os.environ.get("LUX_METHOD_WINNERS", WINNERS_FILE)
+        winners = {}
+        try:
+            import json
+
+            with open(path) as f:
+                raw = json.load(f)
+            if not isinstance(raw, dict):
+                raw = {}
+            for key, val in raw.items():
+                plat, _, red = str(key).partition(":")
+                # non-sum reduces can only take the universally-valid
+                # strategies (cumsum/mxsum are sum-only prefix-diff)
+                ok = CONCRETE if red == "sum" else ("scan", "scatter")
+                if plat and red and val in ok:
+                    winners[(plat, red)] = val
+        except (OSError, ValueError):
+            pass
+        _file_winners_cache = winners
+    return _file_winners_cache
 
 
 def default_platform() -> str:
@@ -76,6 +115,8 @@ def resolve(method: str, reduce: str = "sum",
     if method != "auto":
         return method
     plat = _normalize(platform if platform is not None else default_platform())
-    chosen = WINNERS.get((plat, reduce), FALLBACK)
+    chosen = _file_winners().get(
+        (plat, reduce), WINNERS.get((plat, reduce), FALLBACK)
+    )
     assert chosen in CONCRETE, (chosen, plat, reduce)
     return chosen

@@ -76,3 +76,48 @@ def test_auto_runs_and_matches_resolved_concrete():
     a = pr.pagerank(g, num_iters=4, method="auto")
     b = pr.pagerank(g, num_iters=4, method=concrete)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_winners_file_overlay(monkeypatch, tmp_path):
+    """A measured-winners file (written by the TPU bench race) overrides
+    the hard-coded table; malformed entries are ignored."""
+    import json
+
+    path = tmp_path / "winners.json"
+    path.write_text(json.dumps({"tpu:sum": "mxsum", "tpu:min": "pallas"}))
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(path))
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    assert methods.resolve("auto", "sum", platform="tpu") == "mxsum"
+    # "pallas" is not a CONCRETE blanket default: entry dropped
+    assert methods.resolve("auto", "min", platform="tpu") == "scan"
+    # untouched rows still come from the static table
+    assert methods.resolve("auto", "sum", platform="cpu") == "scatter"
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+
+
+def test_winners_file_malformed_is_noop(monkeypatch, tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text("{not json")
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(path))
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    assert methods.resolve("auto", "sum", platform="tpu") == "scan"
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+
+
+def test_winners_file_non_dict_and_sum_only_guard(monkeypatch, tmp_path):
+    import json
+
+    # valid JSON but not a dict: ignored, never raises
+    bad = tmp_path / "list.json"
+    bad.write_text(json.dumps(["tpu:sum"]))
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(bad))
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    assert methods.resolve("auto", "sum", platform="tpu") == "scan"
+    # sum-only strategies cannot become min/max defaults via the overlay
+    mix = tmp_path / "mix.json"
+    mix.write_text(json.dumps({"tpu:min": "mxsum", "tpu:max": "scatter"}))
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(mix))
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    assert methods.resolve("auto", "min", platform="tpu") == "scan"
+    assert methods.resolve("auto", "max", platform="tpu") == "scatter"
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
