@@ -437,13 +437,27 @@ def _record_winner(results):
     .lux_winners.json) — an unattended chip window updates the default
     without a code edit.  Only the sum row: the race is PageRank; min/max
     rows change via the chip battery + PERF.md."""
-    from lux_tpu.engine.methods import CONCRETE, WINNERS_FILE
+    from lux_tpu.engine.methods import WINNERS_FILE
 
-    f32 = {m: t for (m, dt), t in results.items()
-           if dt == "float32" and m in CONCRETE}
+    f32 = {m: t for (m, dt), t in results.items() if dt == "float32"}
     if not f32:
         return
-    best = min(f32, key=f32.get)
+    overall = min(f32, key=f32.get)
+    # a blanket default must hold on every engine path (bucketed ring /
+    # edge2d layouts run scan/scatter only), so only those are ever
+    # recorded; a faster sum-only winner is reported for the human +
+    # PERF.md instead
+    safe = {m: t for m, t in f32.items() if m in ("scan", "scatter")}
+    if not safe:
+        return
+    best = min(safe, key=safe.get)
+    if overall != best:
+        print(
+            f"# NOTE: {overall} won the sum race outright but is not a "
+            f"safe blanket default; recording {best} — consider a PERF.md "
+            f"row + explicit --method {overall} for allgather runs",
+            file=sys.stderr, flush=True,
+        )
     try:
         prev = {}
         if os.path.exists(WINNERS_FILE):

@@ -91,7 +91,9 @@ def _run_feat(cfg, g, prog):
     )
     print(est)
     preflight.check_fits(est)
-    mesh = feat.make_mesh_feat(cfg.num_parts, cfg.feat_shards)
+    # k-resident parts when num_parts exceeds the available parts slots
+    # (the mapper-slicing analog, same as every other distributed driver)
+    mesh = feat.make_mesh_feat_for_parts(cfg.num_parts, cfg.feat_shards)
     # state is born sharded on the 2-D mesh: no chip ever holds (V, K)
     state = feat.init_state_feat(prog, shards.arrays, mesh)
     from lux_tpu.utils import profiling

@@ -125,11 +125,12 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             )
         import jax
 
-        need = cfg.num_parts * cfg.feat_shards
-        if len(jax.devices()) < need:
+        if len(jax.devices()) < cfg.feat_shards:
+            # the parts axis shrinks to k-resident layouts, but each feat
+            # shard needs its own chip column
             raise SystemExit(
-                f"--feat-shards: {cfg.num_parts} x {cfg.feat_shards} = "
-                f"{need} devices needed, {len(jax.devices())} available"
+                f"--feat-shards {cfg.feat_shards}: needs at least that "
+                f"many devices, {len(jax.devices())} available"
             )
         return
     if cfg.edge_shards > 1:
@@ -195,8 +196,28 @@ def build_exchange_shards(g: HostGraph, cfg: RunConfig):
     return build_scatter_shards(g, cfg.num_parts)
 
 
+def _residency(cfg: RunConfig) -> int:
+    """k = parts RESIDENT per device for this config (1 when every part
+    gets its own chip).  Mirrors make_mesh_for_parts /
+    make_mesh_feat_for_parts slot arithmetic."""
+    if not cfg.distributed or cfg.edge_shards > 1:
+        return 1  # single-device drivers place all parts; edge2d is exact
+    import jax
+
+    slots = len(jax.devices())
+    if cfg.feat_shards > 1:
+        slots //= cfg.feat_shards
+    d = min(slots, cfg.num_parts)
+    while cfg.num_parts % d:
+        d -= 1
+    return cfg.num_parts // d
+
+
 def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
-    """Preflight estimate matching the selected exchange strategy."""
+    """Preflight estimate matching the selected exchange strategy.
+    Per-part estimates are scaled by the residency factor k (k parts
+    resident per chip when num_parts exceeds the parts slots) — the
+    gathered/exchange buffer is global-sized and does not scale."""
     from lux_tpu.utils import preflight
 
     sbytes = 2 if cfg.dtype == "bfloat16" else 4
@@ -205,14 +226,16 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
             shards.spec, shards.e2_pad, state_width, sbytes
         )
     if cfg.exchange == "ring":
-        return preflight.estimate_ring(
+        est = preflight.estimate_ring(
             shards.spec, shards.e_bucket_pad, state_width, sbytes
         )
-    if cfg.exchange == "scatter":
-        return preflight.estimate_scatter(
+    elif cfg.exchange == "scatter":
+        est = preflight.estimate_scatter(
             shards.spec, shards.e_bucket_pad, state_width, sbytes
         )
-    return preflight.estimate_pull(shards.spec, state_width, sbytes)
+    else:
+        est = preflight.estimate_pull(shards.spec, state_width, sbytes)
+    return preflight.scale_residency(est, _residency(cfg))
 
 
 def resume_or_init(cfg: RunConfig, app: str, shards, state, nv):

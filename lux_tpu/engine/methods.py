@@ -75,10 +75,11 @@ def _file_winners() -> dict:
                 raw = {}
             for key, val in raw.items():
                 plat, _, red = str(key).partition(":")
-                # non-sum reduces can only take the universally-valid
-                # strategies (cumsum/mxsum are sum-only prefix-diff)
-                ok = CONCRETE if red == "sum" else ("scan", "scatter")
-                if plat and red and val in ok:
+                # blanket defaults must hold on EVERY engine path: the
+                # bucketed (row_ptr-free) ring/edge2d layouts only run
+                # scan/scatter, and cumsum/mxsum are sum-only anyway —
+                # so the overlay is restricted exactly like WINNERS
+                if plat and red and val in ("scan", "scatter"):
                     winners[(plat, red)] = val
         except (OSError, ValueError):
             pass

@@ -26,6 +26,7 @@ addition tree evenly — tests compare allclose + RMSE).
 """
 from __future__ import annotations
 
+import logging
 from functools import lru_cache, partial
 
 import jax
@@ -38,6 +39,7 @@ from lux_tpu.ops import segment
 from lux_tpu.parallel.mesh import FEAT_AXIS, PARTS_AXIS, flatten_gather
 
 _REDUCERS = segment.reducers()
+log = logging.getLogger("lux_tpu")
 
 
 def make_mesh_feat(num_parts: int, feat_shards: int, devices=None) -> Mesh:
@@ -50,6 +52,32 @@ def make_mesh_feat(num_parts: int, feat_shards: int, devices=None) -> Mesh:
     assert len(devices) >= need, (len(devices), need)
     devs = np.asarray(devices[:need]).reshape(num_parts, feat_shards)
     return Mesh(devs, (PARTS_AXIS, FEAT_AXIS))
+
+
+def make_mesh_feat_for_parts(num_parts: int, feat_shards: int,
+                             devices=None) -> Mesh:
+    """(parts × feat) mesh for ``num_parts`` graph parts on however many
+    devices exist: the parts extent is the largest divisor of num_parts
+    that fits devices // feat_shards, leaving k = parts/extent parts
+    RESIDENT per device — mesh.make_mesh_for_parts extended to the 2-D
+    feat mesh."""
+    if devices is None:
+        devices = jax.devices()
+    assert len(devices) >= feat_shards, (len(devices), feat_shards)
+    slots = len(devices) // feat_shards
+    d = min(slots, num_parts)
+    while num_parts % d:
+        d -= 1
+    if d < slots and num_parts > slots:
+        log.warning(
+            "num_parts=%d shares no divisor with the %d parts slots "
+            "(%d devices / %d feat shards) above %d: running a %dx%d "
+            "mesh (%d devices idle). Pick -ng as a multiple of the "
+            "slot count to use every chip.",
+            num_parts, slots, len(devices), feat_shards, d, d,
+            feat_shards, len(devices) - d * feat_shards,
+        )
+    return make_mesh_feat(d, feat_shards, devices)
 
 
 def _arrays_specs():

@@ -32,6 +32,22 @@ class MemoryEstimate:
         )
 
 
+def scale_residency(est: MemoryEstimate, k: int) -> MemoryEstimate:
+    """Per-chip estimate with k parts RESIDENT per device (mapper-slicing
+    layouts): the per-part graph arrays and state scale by k; the
+    gathered/exchange buffer is global-sized and does not.  (For the
+    ring exchange the streamed block also scales ~k; its blk term lives
+    in gathered_bytes, so this is a slight underestimate there — the
+    resident arrays dominate.)"""
+    if k <= 1:
+        return est
+    shard, state = est.shard_bytes * k, est.state_bytes * k
+    return MemoryEstimate(
+        shard, state, est.gathered_bytes,
+        shard + state + est.gathered_bytes,
+    )
+
+
 def estimate_pull(spec: ShardSpec, state_width: int = 1,
                   state_dtype_bytes: int = 4) -> MemoryEstimate:
     """Per-chip footprint of the pull engine with one part per chip."""

@@ -9,6 +9,12 @@ before any backend is initialized, so tests never tunnel to hardware.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# hermetic method resolution: a TPU bench run records .lux_winners.json
+# at the repo root (by design — engine/methods overlay); the suite's
+# expectations are about the STATIC table, so point the overlay at a
+# path that never exists (tests that exercise the overlay monkeypatch
+# this env var themselves)
+os.environ.setdefault("LUX_METHOD_WINNERS", "/nonexistent-lux-winners")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

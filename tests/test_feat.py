@@ -185,13 +185,26 @@ def test_cli_feat_matches_1d(capsys):
         (["--feat-shards", "2", "--distributed", "--exchange", "scatter"],
          "--exchange scatter"),
         (["--feat-shards", "3", "--distributed"], "must divide"),
-        (["--feat-shards", "4", "-ng", "4", "--distributed"],
-         "devices needed"),
+        (["--feat-shards", "10", "-ng", "4", "--distributed"],
+         "at least that many devices"),
     ],
 )
 def test_cli_feat_rejections(extra, match):
     with pytest.raises(SystemExit, match=match):
         cf_app.main(CLI + extra)
+
+
+def test_cli_feat_k_resident_parts(capsys):
+    """-ng 8 --feat-shards 2 on 8 devices: 4 parts slots x 2 feat, two
+    parts resident per device — same RMSE as the 1-D run."""
+    assert cf_app.main(CLI + ["-ng", "8", "--distributed",
+                              "--feat-shards", "2"]) == 0
+    rmse_k = [ln for ln in capsys.readouterr().out.splitlines()
+              if "RMSE" in ln]
+    assert cf_app.main(CLI + ["-ng", "8", "--distributed"]) == 0
+    rmse_1d = [ln for ln in capsys.readouterr().out.splitlines()
+               if "RMSE" in ln]
+    assert rmse_k == rmse_1d
 
 
 def test_cli_feat_rejected_for_scalar_state_apps():

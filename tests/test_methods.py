@@ -84,11 +84,11 @@ def test_winners_file_overlay(monkeypatch, tmp_path):
     import json
 
     path = tmp_path / "winners.json"
-    path.write_text(json.dumps({"tpu:sum": "mxsum", "tpu:min": "pallas"}))
+    path.write_text(json.dumps({"tpu:sum": "scatter", "tpu:min": "pallas"}))
     monkeypatch.setenv("LUX_METHOD_WINNERS", str(path))
     monkeypatch.setattr(methods, "_file_winners_cache", None)
-    assert methods.resolve("auto", "sum", platform="tpu") == "mxsum"
-    # "pallas" is not a CONCRETE blanket default: entry dropped
+    assert methods.resolve("auto", "sum", platform="tpu") == "scatter"
+    # "pallas" is not a safe blanket default: entry dropped
     assert methods.resolve("auto", "min", platform="tpu") == "scan"
     # untouched rows still come from the static table
     assert methods.resolve("auto", "sum", platform="cpu") == "scatter"
@@ -113,11 +113,12 @@ def test_winners_file_non_dict_and_sum_only_guard(monkeypatch, tmp_path):
     monkeypatch.setenv("LUX_METHOD_WINNERS", str(bad))
     monkeypatch.setattr(methods, "_file_winners_cache", None)
     assert methods.resolve("auto", "sum", platform="tpu") == "scan"
-    # sum-only strategies cannot become min/max defaults via the overlay
+    # prefix-diff strategies cannot become blanket defaults for ANY row
+    # (the bucketed ring/edge2d layouts only run scan/scatter)
     mix = tmp_path / "mix.json"
-    mix.write_text(json.dumps({"tpu:min": "mxsum", "tpu:max": "scatter"}))
+    mix.write_text(json.dumps({"tpu:sum": "mxsum", "tpu:max": "scatter"}))
     monkeypatch.setenv("LUX_METHOD_WINNERS", str(mix))
     monkeypatch.setattr(methods, "_file_winners_cache", None)
-    assert methods.resolve("auto", "min", platform="tpu") == "scan"
+    assert methods.resolve("auto", "sum", platform="tpu") == "scan"
     assert methods.resolve("auto", "max", platform="tpu") == "scatter"
     monkeypatch.setattr(methods, "_file_winners_cache", None)
