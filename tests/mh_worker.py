@@ -38,15 +38,33 @@ def check_local(arr, cuts, mine, want, assert_fn):
 
 
 if mode == "feat":
-    # --- 2-D (parts x feat) CF across REAL processes: the parts-axis
-    # all_gather AND the cross-feat error-dot psum both cross the process
-    # boundary (4 parts x 2 feat shards over 2 hosts' 8 devices)
+    # --- 2-D (parts x feat) CF across REAL processes.  Two meshes, so
+    # that BOTH composed collectives get a process boundary: in the
+    # default layout (feat minor) the feat columns are intra-process and
+    # the parts-axis all_gather/ppermute crosses hosts; the interleaved
+    # layout pairs device i of process 0 with device i of process 1 in
+    # each feat column, so the cross-feat error-dot psum crosses hosts.
+    from jax.sharding import Mesh
+
     from lux_tpu.models import colfilter as cf_model
     from lux_tpu.parallel import feat
+    from lux_tpu.parallel.mesh import FEAT_AXIS, PARTS_AXIS
 
     gw = generate.bipartite_ratings(96, 64, 800, seed=5)
     fsh = build_pull_shards(gw, 4)
     fmesh = feat.make_mesh_feat(4, 2)
+
+    def check_feat_shards(out, want):
+        """Validate THIS process's (part, feat) shards of a (P, V, K)
+        result against the global oracle."""
+        for shard in out.addressable_shards:
+            p = shard.index[0].start
+            ks = shard.index[2]
+            lo, hi = int(fsh.cuts[p]), int(fsh.cuts[p + 1])
+            np.testing.assert_allclose(
+                np.asarray(shard.data)[0][: hi - lo], want[lo:hi, ks],
+                rtol=5e-4, atol=1e-6,
+            )
     # gamma=1e-3 (not the app default 3.5e-7) so the 3-iteration signal
     # exceeds the comparison tolerance — same convention as every CF
     # oracle test; at the default gamma the unmodified initial state
@@ -57,16 +75,29 @@ if mode == "feat":
         cfp, fsh.spec, fsh.arrays, s0, 3, fmesh
     )
     want = cf_model.colfilter_reference(gw, 3, gamma=1e-3)
-    for shard in out.addressable_shards:
-        p = shard.index[0].start
-        ks = shard.index[2]
-        lo, hi = int(fsh.cuts[p]), int(fsh.cuts[p + 1])
-        np.testing.assert_allclose(
-            np.asarray(shard.data)[0][: hi - lo], want[lo:hi, ks],
-            rtol=5e-4, atol=1e-6,
-        )
+    check_feat_shards(out, want)
     print(f"process {pid}: multihost feat-CF OK ({len(out.addressable_shards)}"
           f" local shards)", flush=True)
+    # interleaved mesh: feat pairs (dev i of proc 0, dev i of proc 1) —
+    # the cross-feat psum now crosses the process boundary
+    devs = np.asarray(jax.devices())
+    imesh = Mesh(
+        np.stack([devs[:4], devs[4:]], axis=1), (PARTS_AXIS, FEAT_AXIS)
+    )
+    i_s0 = feat.init_state_feat(cfp, fsh.arrays, imesh)
+    i_out = feat.run_cf_feat_dist(
+        cfp, fsh.spec, fsh.arrays, i_s0, 3, imesh
+    )
+    check_feat_shards(i_out, want)
+    print(f"process {pid}: multihost feat-CF cross-host-psum OK", flush=True)
+    # ring x feat on the default mesh: the parts-axis ppermute ring
+    # crosses hosts under the composed engine
+    from lux_tpu.parallel import ring as ring_mod
+
+    frs = ring_mod.build_ring_shards(gw, 4, pull=fsh)
+    r_out = feat.run_cf_feat_ring(cfp, frs, s0, 3, fmesh)
+    check_feat_shards(r_out, want)
+    print(f"process {pid}: multihost ring-feat-CF OK", flush=True)
     sys.exit(0)
 
 if mode == "push":

@@ -46,12 +46,15 @@ def test_two_process_distributed_pagerank():
 
 
 def test_two_process_feat_cf():
-    """The 2-D (parts x feat) CF engine across two real OS processes:
-    both the parts all_gather and the cross-feat error-dot psum cross the
-    process boundary."""
+    """The 2-D (parts x feat) CF engine across two real OS processes, on
+    two mesh layouts so BOTH composed collectives get a process
+    boundary: parts all_gather/ppermute (default feat-minor mesh) and
+    the cross-feat error-dot psum (interleaved mesh)."""
     outs = _run_pair("feat")
     for pid, out in enumerate(outs):
         assert f"process {pid}: multihost feat-CF OK" in out
+        assert f"process {pid}: multihost feat-CF cross-host-psum OK" in out
+        assert f"process {pid}: multihost ring-feat-CF OK" in out
 
 
 def test_two_process_distributed_push():
