@@ -43,3 +43,10 @@ def pytest_configure(config):
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(1234)
+
+
+def hub_vertex(g) -> int:
+    """Max-out-degree start vertex for frontier-app tests: a fixed start
+    (e.g. 0) can have zero out-edges on an RMAT draw and converge
+    instantly, leaving nothing to exercise."""
+    return int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))

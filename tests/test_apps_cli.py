@@ -322,3 +322,44 @@ def test_elastic_resume_rejects_wrong_app(tmp_path, capsys):
     capsys.readouterr()
     with pytest.raises(SystemExit):
         cf_app.main(SMALL + ["-ni", "4", "--ckpt-dir", d])
+
+
+def test_cli_file_loading_end_to_end(tmp_path, capsys):
+    """-file: the reference's primary input path (-file graph.lux) driven
+    end-to-end — write a .lux, run sssp -check and distributed pagerank
+    from it, and confirm results match the in-memory graph."""
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.format import write_lux
+    from lux_tpu.models import sssp as sssp_model
+
+    g = generate.rmat(8, 6, seed=11)
+    path = str(tmp_path / "g.lux")
+    write_lux(path, g)
+
+    from conftest import hub_vertex
+
+    start = hub_vertex(g)
+    assert sssp_app.main(["-file", path, "-start", str(start),
+                          "-check"]) == 0
+    out = capsys.readouterr().out
+    assert "[PASS]" in out
+    want = sssp_model.bfs_reference(g, start)
+    reached = [ln for ln in out.splitlines() if ln.startswith("reached")][0]
+    assert reached.startswith(f"reached {int((want < g.nv).sum())}/")
+
+    assert pr_app.main(["-file", path, "-ni", "3", "-ng", "4",
+                        "--distributed"]) == 0
+    assert "top-5" in capsys.readouterr().out
+
+
+def test_cli_file_errors(tmp_path):
+    with pytest.raises(SystemExit, match="cannot read"):
+        sssp_app.main(["-file", str(tmp_path / "missing.lux")])
+    # an unweighted file refuses apps that need ratings/weights
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.format import write_lux
+
+    path = str(tmp_path / "unweighted.lux")
+    write_lux(path, generate.rmat(7, 4, seed=2))
+    with pytest.raises(SystemExit, match="no edge weights"):
+        cf_app.main(["-file", path, "-ni", "2"])

@@ -24,9 +24,9 @@ def g():
 
 @pytest.fixture(scope="module")
 def start(g):
-    # vertex 0 can have zero out-edges on an RMAT draw (instant
-    # convergence); start from the max-out-degree vertex instead
-    return int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
+    from conftest import hub_vertex
+
+    return hub_vertex(g)
 
 
 def test_interrupt_and_resume_matches_uninterrupted(g, start, tmp_path):
