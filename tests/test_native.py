@@ -66,24 +66,37 @@ def test_read_lux_range(tmp_path):
     assert w is None
 
 
-def test_converter_cli_roundtrip(tmp_path):
+@pytest.mark.parametrize("weighted", [False, True])
+@pytest.mark.parametrize("fallback", [False, True])
+def test_converter_cli_roundtrip(tmp_path, weighted, fallback):
+    """Text edge list -> .lux -> read_lux matches the from_edge_list
+    oracle, on BOTH converter paths (native lux-convert and the
+    --python NumPy fallback), weighted and not."""
     rng = np.random.default_rng(64)
     nv, ne = 50, 400
     src = rng.integers(0, nv, ne)
     dst = rng.integers(0, nv, ne)
+    w = rng.integers(1, 50, ne) if weighted else None
+    cols = [src, dst] + ([w] if weighted else [])
     txt = tmp_path / "edges.txt"
-    np.savetxt(txt, np.stack([src, dst], 1), fmt="%d")
+    np.savetxt(txt, np.stack(cols, 1), fmt="%d")
     out = str(tmp_path / "cli.lux")
     rc = subprocess.call(
         [sys.executable, os.path.join(REPO, "tools", "converter.py"),
-         "-nv", str(nv), "-ne", str(ne), "-input", str(txt), "-output", out],
+         "-nv", str(nv), "-ne", str(ne), "-input", str(txt), "-output", out]
+        + (["-weighted"] if weighted else [])
+        + (["--python"] if fallback else []),
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert rc == 0
     g = read_lux(out)
-    want = from_edge_list(src, dst, nv)
+    want = from_edge_list(src, dst, nv, weights=w)
     np.testing.assert_array_equal(g.row_ptr, want.row_ptr)
     np.testing.assert_array_equal(g.col_idx, want.col_idx)
+    if weighted:
+        np.testing.assert_array_equal(g.weights, want.weights)
+    else:
+        assert g.weights is None
 
 
 def test_converter_cli_bad_count(tmp_path):
