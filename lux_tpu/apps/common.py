@@ -200,8 +200,13 @@ def _residency(cfg: RunConfig) -> int:
     """k = parts RESIDENT per device for this config (1 when every part
     gets its own chip).  Mirrors make_mesh_for_parts /
     make_mesh_feat_for_parts slot arithmetic."""
-    if not cfg.distributed or cfg.edge_shards > 1:
-        return 1  # single-device drivers place all parts; edge2d is exact
+    if cfg.edge_shards > 1:
+        return 1  # edge2d estimate already counts the whole footprint
+    if not cfg.distributed:
+        # single-device drivers place ALL parts on the one device: the
+        # stacked (P, ...) shard arrays and per-part state are all
+        # resident at once, so the per-part estimate scales by P.
+        return cfg.num_parts
     import jax
 
     slots = len(jax.devices())

@@ -163,6 +163,7 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
         )
     else:
         est = preflight.estimate_push(shards.spec, shards.pspec)
+    est = preflight.scale_residency(est, common._residency(cfg))
     print(est)
     preflight.check_fits(est)
     mesh = common.make_mesh_if(cfg)

@@ -46,6 +46,7 @@ from lux_tpu.engine import push
 from lux_tpu.graph.csc import HostGraph
 from lux_tpu.graph.partition import part_of_vertex, weighted_cuts
 from lux_tpu.graph.push_shards import SRC_SENTINEL, build_push_shards
+from lux_tpu.parallel.mesh import PARTS_AXIS
 
 
 class AdaptiveResult(NamedTuple):
@@ -175,9 +176,11 @@ def _place_statics(prog, shards, mesh, method, exchange):
     return (arrays, parrays), loop
 
 
-def _preflight_recut(shards, exchange):
+def _preflight_recut(shards, exchange, k: int = 1):
     """A recut can concentrate edges and grow e_pad/e_sp/buckets past what
-    the startup preflight validated — re-check before allocating."""
+    the startup preflight validated — re-check before allocating.  ``k``
+    is the resident-parts-per-device factor (parts on a single device, or
+    num_parts / mesh size when parts exceed the mesh)."""
     from lux_tpu.utils import preflight
 
     if exchange == "ring":
@@ -186,7 +189,7 @@ def _preflight_recut(shards, exchange):
         )
     else:
         est = preflight.estimate_push(shards.spec, shards.pspec)
-    preflight.check_fits(est)
+    preflight.check_fits(preflight.scale_residency(est, k))
 
 
 def run_push_adaptive(
@@ -280,7 +283,9 @@ def run_push_adaptive(
         if on_repartition is not None:
             on_repartition(it, shards.cuts, new_cuts, work)
         shards = build(cuts=new_cuts)
-        _preflight_recut(shards, exchange)
+        k_res = (num_parts // mesh.shape[PARTS_AXIS]) if mesh is not None \
+            else num_parts
+        _preflight_recut(shards, exchange, k_res)
         carry = _rebuild_carry(
             prog, shards, state_g, changed_g, it, np.asarray(carry.edges)
         )

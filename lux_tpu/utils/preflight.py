@@ -35,10 +35,12 @@ class MemoryEstimate:
 def scale_residency(est: MemoryEstimate, k: int) -> MemoryEstimate:
     """Per-chip estimate with k parts RESIDENT per device (mapper-slicing
     layouts): the per-part graph arrays and state scale by k; the
-    gathered/exchange buffer is global-sized and does not.  (For the
-    ring exchange the streamed block also scales ~k; its blk term lives
-    in gathered_bytes, so this is a slight underestimate there — the
-    resident arrays dominate.)"""
+    gathered/exchange buffer is global-sized and does not.  The ring
+    estimates (estimate_ring / estimate_push_ring) keep every streamed
+    (k, V)-block term in state_bytes with gathered_bytes == 0, so the
+    streamed blocks scale with k here too —
+    tests/test_utils.py::test_preflight_ring_k_resident_exact pins the
+    scaled estimate against the exact k-resident array bytes."""
     if k <= 1:
         return est
     shard, state = est.shard_bytes * k, est.state_bytes * k

@@ -104,3 +104,50 @@ def test_checkpoint_elastic_meta_and_bf16(tmp_path):
     )
     with pytest.raises(SystemExit):
         checkpoint.load_resume(str(tmp_path / "ck2"), "pagerank", 64)
+
+
+def test_residency_single_device_counts_all_parts():
+    """ADVICE r3: a non-distributed -ng N run holds all N parts on the one
+    device — the preflight residency factor must be N, not 1 (otherwise
+    estimate_exchange underestimates by ~N x and could pass a run that
+    OOMs on a real chip)."""
+    from lux_tpu.apps.common import _residency
+    from lux_tpu.utils.config import RunConfig
+
+    assert _residency(RunConfig(num_parts=4)) == 4
+    assert _residency(RunConfig(num_parts=1)) == 1
+    # edge2d's estimate already counts the whole footprint: stays 1
+    assert _residency(RunConfig(num_parts=4, edge_shards=2)) == 1
+    # distributed on the 8-device test mesh: 16 parts -> k = 2
+    assert _residency(RunConfig(num_parts=16, distributed=True)) == 2
+    assert _residency(RunConfig(num_parts=8, distributed=True)) == 1
+
+
+def test_preflight_ring_k_resident_exact():
+    """VERDICT r3 weak #6: pin the k-resident ring estimate against the
+    EXACT per-device array bytes.  The ring driver with k = P/D resident
+    parts per device holds k parts' bucket arrays + vertex views and
+    circulates (k, V)-blocks (4 state-block terms: local, in-flight,
+    accumulator, new — parallel/ring.py run()).  scale_residency must
+    cover that footprint, with zero gathered term (the ring's point)."""
+    from lux_tpu.graph import generate
+    from lux_tpu.parallel.ring import build_ring_shards
+
+    g = generate.rmat(10, 8, seed=71)
+    P, k = 4, 2  # e.g. 4 parts on 2 devices
+    rs = build_ring_shards(g, P)
+    est = preflight.scale_residency(
+        preflight.estimate_ring(rs.spec, rs.e_bucket_pad), k
+    )
+    V, B = rs.spec.nv_pad, rs.e_bucket_pad
+    # exact per-part bytes, from the shapes the driver actually places:
+    per_part_buckets = sum(
+        a.nbytes // a.shape[0] for a in rs.rarrays
+    )  # (R, P, B) arrays -> P*B*(4+4+1+4) bytes per part
+    assert per_part_buckets == P * B * 13
+    per_part_view = V * (1 + 4)  # vtx_mask uint8 + degree int32
+    per_part_state = 4 * V * 4  # 4 f32 (V,) blocks per resident part
+    actual = k * (per_part_buckets + per_part_view + per_part_state)
+    assert est.gathered_bytes == 0
+    assert est.total_bytes >= actual  # no underestimate at k > 1
+    assert est.total_bytes <= 1.05 * actual  # and stays tight
