@@ -156,12 +156,12 @@ class PushCarry(NamedTuple):
     #: the reference's per-iteration traversal accounting, SURVEY.md §6)
     edges: Any
     #: per-part sparse-round walked out-edge totals since the last driver
-    #: checkpoint, float32 (P,) — a load ESTIMATE for the repartition
-    #: policy (engine/repartition.py), not an exact counter like `edges`.
-    #: float32 absorbs increments once a part's window total passes 2^24
-    #: (~16.7M edges), degrading toward UNDERestimating hot parts — keep
-    #: --repartition-every windows short on big graphs (the policy only
-    #: needs the imbalance ratio, not absolute totals).
+    #: checkpoint, SATURATING uint32 (P,) — a load signal for the
+    #: repartition policy (engine/repartition.py): exact to 2^32 edges
+    #: per part per window, pinned at UINT32_MAX beyond (a saturated hot
+    #: part still reads hot; the policy needs the imbalance ratio, not
+    #: absolute totals — tests/test_repartition.py pins the saturation
+    #: behavior).
     #: Dense-round work is `dense_rounds * static part edge count`, kept
     #: out of the carry (the host derives it from the cuts).
     sp_work: Any
@@ -192,10 +192,12 @@ def _zero_edges():
 def _acc_load(c: "PushCarry", total, use_dense):
     """Window load stats for the repartition policy: sparse rounds add the
     walked out-edge totals (per part, or this part's scalar in the SPMD
-    bodies); dense rounds bump the shared round counter."""
-    sp_work = c.sp_work + jnp.where(
-        use_dense, 0.0, jnp.asarray(total, jnp.float32)
-    )
+    bodies) into a SATURATING uint32; dense rounds bump the shared round
+    counter.  Saturation (not wrap) on overflow: a wrapped counter would
+    make the window's hottest part read cold and invert the recut."""
+    inc = jnp.where(use_dense, 0, jnp.asarray(total)).astype(jnp.uint32)
+    added = c.sp_work + inc  # wraps mod 2^32 ...
+    sp_work = jnp.where(added < c.sp_work, jnp.uint32(0xFFFFFFFF), added)
     return sp_work, c.dense_rounds + use_dense.astype(jnp.int32)
 
 
@@ -223,7 +225,7 @@ def _init_carry(prog, pspec, arrays):
     num_parts = arrays.global_vid.shape[0]
     return PushCarry(
         state0, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1),
-        _zero_edges(), jnp.zeros((num_parts,), jnp.float32), jnp.int32(0),
+        _zero_edges(), jnp.zeros((num_parts,), jnp.uint32), jnp.int32(0),
     )
 
 
@@ -455,31 +457,15 @@ def _carry_specs():
     )
 
 
-def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
-                    qarr_blk, dense_fn, c: PushCarry) -> PushCarry:
-    """ONE direction-optimized iteration from a device's perspective
-    inside shard_map — the single source of truth for the dist, step-dist,
-    ring, and pallas engines (their only difference is ``dense_fn``).
-
-    Each device holds k = P / mesh_size resident parts as the leading axis
-    of every blocked field (k == 1 when parts == devices); per-part work
-    vmaps over the resident lanes — the mapper-slicing analog
-    (core/lux_mapper.cc:102-122).
-
-    * frontier (vid, value) queues are all_gathered unconditionally (they
-      are small: O(P * f_cap));
-    * the mode decision is GLOBAL (psum'd count + overflow/tier flags) so
-      the dense branch's collectives sit inside `lax.cond` without
-      divergence;
-    * ``qarr_blk`` carries the per-vertex arrays (vtx_mask/global_vid) for
-      the sparse mask and queue rebuild — ShardArrays on the all-gather
-      engines, the slim VertexView on the ring engine;
-    * ``dense_fn(block)`` is the engine-specific dense relaxation over the
-      (k, V, ...) resident block: the all-gathered segmented reduce, or
-      the ppermute ring fold.
-    """
-    local = c.state  # (k, V)
-    V = spec.nv_pad
+def _spmd_push_prep(pspec: PushSpec, spec: ShardSpec, parr_blk,
+                    c: PushCarry):
+    """LOAD phase from a device's perspective inside shard_map: all_gather
+    the frontier (vid, value) queues (they are small: O(P * f_cap)), plan
+    each resident part's sparse out-edge walk, and psum the GLOBAL
+    direction/tier votes.  Returns the plan
+    (q_vids_all, q_vals_all, (rows, counts, incl, totals), use_dense,
+    flags) — q/use_dense/flags are replicated across devices (gather/psum
+    results), the preps are per-resident-lane."""
     # device order x resident order == global part order (shard_stacked
     # gives device d parts [d*k, (d+1)*k)), so the tiled gather flattens
     # straight into the (P * f_cap,) global queue view
@@ -508,6 +494,17 @@ def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
         (g_cnt > spec.nv // pspec.pull_threshold_den)
         | (flags[:2].max() > 0)
     )
+    return q_vids_all, q_vals_all, (rows, counts, incl, totals), use_dense, flags
+
+
+def _spmd_push_relax(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
+                     qarr_blk, dense_fn, c: PushCarry, plan):
+    """COMP phase from a device's perspective: one GLOBAL `lax.cond`
+    between the engine-specific dense relaxation and the sparse frontier
+    scatter over the resident lanes."""
+    q_vids_all, q_vals_all, (rows, counts, incl, _), use_dense, flags = plan
+    local = c.state  # (k, V)
+    V = spec.nv_pad
 
     def sparse_branch():
         def run(cap):
@@ -532,8 +529,16 @@ def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
             lambda: run(pspec.e_sp),
         )
 
-    new = jax.lax.cond(use_dense, lambda: dense_fn(local), sparse_branch)
-    changed = (new != local) & qarr_blk.vtx_mask
+    return jax.lax.cond(use_dense, lambda: dense_fn(local), sparse_branch)
+
+
+def _spmd_push_requeue(prog, pspec: PushSpec, spec: ShardSpec, qarr_blk,
+                       c: PushCarry, new, plan) -> PushCarry:
+    """UPDATE phase from a device's perspective: rebuild the frontier
+    queues from changed vertices, psum the global active count, and
+    account traversed edges."""
+    (_, _, (_, _, _, totals), use_dense, _) = plan
+    changed = (new != c.state) & qarr_blk.vtx_mask
     q_vid, q_val, cnt = jax.vmap(partial(build_queue, pspec))(
         qarr_blk, changed, new
     )
@@ -547,6 +552,37 @@ def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
         new, q_vid, q_val, cnt, c.it + 1, active, edges, sp_work,
         dense_rounds,
     )
+
+
+def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
+                    qarr_blk, dense_fn, c: PushCarry) -> PushCarry:
+    """ONE direction-optimized iteration from a device's perspective
+    inside shard_map — the single source of truth for the dist, step-dist,
+    ring, and pallas engines (their only difference is ``dense_fn``), and
+    for the -verbose phase split (compile_push_phases_dist jits the three
+    sub-phases separately).
+
+    Each device holds k = P / mesh_size resident parts as the leading axis
+    of every blocked field (k == 1 when parts == devices); per-part work
+    vmaps over the resident lanes — the mapper-slicing analog
+    (core/lux_mapper.cc:102-122).
+
+    * frontier (vid, value) queues are all_gathered unconditionally;
+    * the mode decision is GLOBAL (psum'd count + overflow/tier flags) so
+      the dense branch's collectives sit inside `lax.cond` without
+      divergence;
+    * ``qarr_blk`` carries the per-vertex arrays (vtx_mask/global_vid) for
+      the sparse mask and queue rebuild — ShardArrays on the all-gather
+      engines, the slim VertexView on the ring engine;
+    * ``dense_fn(block)`` is the engine-specific dense relaxation over the
+      (k, V, ...) resident block: the all-gathered segmented reduce, or
+      the ppermute ring fold.
+    """
+    plan = _spmd_push_prep(pspec, spec, parr_blk, c)
+    new = _spmd_push_relax(
+        prog, pspec, spec, parr_blk, qarr_blk, dense_fn, c, plan
+    )
+    return _spmd_push_requeue(prog, pspec, spec, qarr_blk, c, new, plan)
 
 
 def _allgather_dense_fn(prog, arr_blk, method):
@@ -592,40 +628,87 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     return run
 
 
-def compile_push_step_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
-                           method: str = "auto"):
-    """Uncached resolution shim — see compile_push_chunk."""
-    return _compile_push_step_dist_cached(
+def compile_push_phases_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
+                             method: str = "auto"):
+    """One DISTRIBUTED push iteration as THREE separately-jitted,
+    fence-able shard_map sub-steps — the multi-GPU `-verbose` breakdown
+    of the reference (per-GPU loadTime/compTime/updateTime printed on
+    multi-GPU runs, sssp_gpu.cu:513-518):
+
+      load(parrays, carry)                -> plan (queue all_gather + walk
+                                             planning + psum'd direction)
+      comp(arrays, parrays, carry, plan)  -> new stacked state (the dense
+                                             branch's state all_gather
+                                             happens here, as it does in
+                                             the single-device split)
+      update(arrays, carry, new, plan)    -> next PushCarry (queue rebuild
+                                             + active/edges psums)
+
+    The phase bodies are the SAME _spmd_push_* the fused engines use.
+    Observability path; _compile_push_dist is the perf path."""
+    return _compile_push_phases_dist_cached(
         prog, mesh, pspec, spec, methods.resolve(method, prog.reduce)
     )
 
 
 @lru_cache(maxsize=64)
-def _compile_push_step_dist_cached(prog, mesh, pspec: PushSpec,
-                                   spec: ShardSpec, method: str):
-    """ONE distributed direction-optimized iteration (the body of
-    _compile_push_dist without the on-device while_loop) — step-wise
-    observability for `-verbose --distributed`.  Takes/returns the sharded
-    stacked carry (donated: state/queue double buffers reuse HBM like
-    compile_push_step); the host reads carry.active between steps."""
+def _compile_push_phases_dist_cached(prog, mesh, pspec: PushSpec,
+                                     spec: ShardSpec, method: str):
     arr_specs = ShardArrays(*([P(PARTS_AXIS)] * len(ShardArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
     carry_specs = _carry_specs()
+    Pp = P(PARTS_AXIS)
+    # The gathered queue views are value-replicated but shard_map cannot
+    # statically infer all_gather outputs as such, so each device carries
+    # its copy as a (1, P*f_cap) lane under the parts spec (global shape
+    # (D, P*f_cap) — exactly the per-device replicated queue view the
+    # fused engines hold internally); psum'd votes ARE inferred
+    # replicated; walk plans are per-resident-lane.
+    plan_specs = (Pp, Pp, (Pp, Pp, Pp, Pp), P(), P())
 
-    @partial(jax.jit, donate_argnums=2)
+    @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(arr_specs, parr_specs, carry_specs),
-        out_specs=carry_specs,
+        in_specs=(parr_specs, carry_specs),
+        out_specs=plan_specs,
     )
-    def step(arr_blk, parr_blk, carry_blk):
-        return _spmd_push_iter(
+    def load(parr_blk, c):
+        qv, qw, preps, use_dense, flags = _spmd_push_prep(
+            pspec, spec, parr_blk, c
+        )
+        return qv[None], qw[None], preps, use_dense, flags
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(arr_specs, parr_specs, carry_specs, plan_specs),
+        out_specs=Pp,
+    )
+    def comp(arr_blk, parr_blk, c, plan):
+        qv, qw, preps, use_dense, flags = plan
+        return _spmd_push_relax(
             prog, pspec, spec, parr_blk, arr_blk,
-            _allgather_dense_fn(prog, arr_blk, method), carry_blk,
+            _allgather_dense_fn(prog, arr_blk, method), c,
+            (qv[0], qw[0], preps, use_dense, flags),
         )
 
-    return step
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(arr_specs, carry_specs, Pp, plan_specs),
+        out_specs=carry_specs,
+    )
+    def update(arr_blk, c, new, plan):
+        qv, qw, preps, use_dense, flags = plan
+        return _spmd_push_requeue(
+            prog, pspec, spec, arr_blk, c, new,
+            (qv[0], qw[0], preps, use_dense, flags),
+        )
+
+    return load, comp, update
 
 
 def push_init_dist(prog, shards: PushShards, mesh: Mesh):

@@ -16,9 +16,9 @@ capability:
     load split between windows, and when the estimated imbalance exceeds a
     threshold recuts with partition.weighted_cuts, rebuilds the shards,
     remaps the in-flight state + frontier to the new layout, and resumes.
-    sp_work accumulates in float32, which saturates past ~2^24 edges per
-    part per window — on big graphs keep windows short enough that no
-    part walks more than ~16M sparse out-edges between checks.
+    sp_work accumulates in a SATURATING uint32 — exact to 2^32 walked
+    edges per part per window, pinned at UINT32_MAX beyond, so a hot part
+    can never read cold however long the window runs.
 
 Correctness: min/max label relaxation is confluent — the fixpoint is
 unique regardless of the iteration/mode schedule — so the adaptive run
@@ -133,7 +133,7 @@ def _rebuild_carry(prog, shards_new, state_g: np.ndarray,
     num_parts = shards_new.spec.num_parts
     return push.PushCarry(
         state_st, q_vid, q_val, cnt, jnp.int32(it), jnp.sum(cnt),
-        jnp.asarray(edges), jnp.zeros((num_parts,), jnp.float32),
+        jnp.asarray(edges), jnp.zeros((num_parts,), jnp.uint32),
         jnp.int32(0),
     )
 
@@ -142,7 +142,7 @@ def _reset_window(carry: push.PushCarry) -> push.PushCarry:
     """Zero the window load stats without touching state/frontier."""
     return carry._replace(
         sp_work=jax.device_put(
-            np.zeros(carry.sp_work.shape, np.float32), carry.sp_work.sharding
+            np.zeros(carry.sp_work.shape, np.uint32), carry.sp_work.sharding
         ),
         dense_rounds=jax.device_put(
             np.int32(0), carry.dense_rounds.sharding

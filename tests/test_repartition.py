@@ -237,3 +237,39 @@ def test_adaptive_ring_requires_mesh():
         repartition.run_push_adaptive(prog, g, 4, exchange="ring")
     with pytest.raises(ValueError):
         repartition.run_push_adaptive(prog, g, 4, exchange="scatter")
+
+
+def test_sp_work_saturates_instead_of_wrapping():
+    """VERDICT r3 weak #6: the per-part load accumulator near its 2^32
+    ceiling must SATURATE (hot stays hot), never wrap to small (hot reads
+    cold and the recut inverts).  Drives _acc_load directly with window
+    totals that cross the ceiling."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine.push import PushCarry, _acc_load
+
+    def carry_with(sp):
+        return PushCarry(None, None, None, None, None, None, None,
+                         jnp.asarray(sp, jnp.uint32), jnp.int32(0))
+
+    step = jax.jit(
+        lambda sp, t, d: _acc_load(carry_with(sp), t, d)[0]
+    )
+    near = np.uint32(0xFFFF_FF00)
+    # part 0 crosses the ceiling, part 1 stays small
+    out = np.asarray(step(np.array([near, 1000], np.uint32),
+                          jnp.int32(0x200), jnp.bool_(False)))
+    assert out[0] == 0xFFFF_FFFF  # saturated, not wrapped to ~0x100
+    assert out[1] == 1000 + 0x200
+    # saturation is absorbing
+    out2 = np.asarray(step(out, jnp.int32(12345), jnp.bool_(False)))
+    assert out2[0] == 0xFFFF_FFFF
+    # dense rounds add nothing to sp_work
+    out3 = np.asarray(step(out, jnp.int32(777), jnp.bool_(True)))
+    assert out3[1] == out[1]
+    # the policy input stays exact far past float32's 2^24 absorb point
+    big = np.uint32(20_000_000)
+    out4 = np.asarray(step(np.array([big, 0], np.uint32),
+                           jnp.int32(3), jnp.bool_(False)))
+    assert out4[0] == 20_000_003  # float32 would have absorbed the +3
