@@ -277,10 +277,12 @@ def save_global(cfg: RunConfig, app: str, shards, iteration: int, state):
 
 def run_pull_stepwise_dist(prog, shards, state, start_it, num_iters, mesh,
                            cfg: RunConfig, nv, on_iter=None):
-    """Step-wise DISTRIBUTED pull loop (-verbose --distributed): one
-    shard_map iteration per host step with whole-iteration stats (the
-    phase split stays a single-device mode); same on_iter hook as
-    run_pull_stepwise so checkpointing composes with verbose."""
+    """Step-wise DISTRIBUTED pull loop (-verbose --distributed only):
+    each shard_map iteration fences into load/comp/update sub-steps —
+    the reference prints the per-GPU phase timers on multi-GPU runs too
+    (sssp_gpu.cu:513-518).  Same on_iter hook as run_pull_stepwise so
+    checkpointing composes with verbose.  (Non-verbose distributed runs
+    use the fused run_fixed_dist/run_fixed_dist_chunked paths.)"""
     import jax
 
     from lux_tpu.parallel import dist
@@ -289,12 +291,21 @@ def run_pull_stepwise_dist(prog, shards, state, start_it, num_iters, mesh,
 
     arrays = shard_stacked(mesh, jax.tree.map(jax.numpy.asarray, shards.arrays))
     state = shard_stacked(mesh, state)
-    step = dist.compile_pull_step_dist(prog, mesh, cfg.method)
     stats = IterStats(verbose=cfg.verbose)
+    load, comp, update = dist.compile_pull_phases_dist(
+        prog, mesh, cfg.method
+    )
     for it in range(start_it, num_iters):
         t = Timer()
-        state = step(arrays, state)
-        stats.record(it, nv, t.stop(state))
+        gath = load(arrays, state)
+        lt = t.stop(gath)
+        t = Timer()
+        acc = comp(arrays, gath)
+        ct = t.stop(acc)
+        t = Timer()
+        state = update(arrays, state, acc)
+        ut = t.stop(state)
+        stats.record_phases(it, nv, lt, ct, ut)
         if on_iter is not None:
             on_iter(it, state)
     return state, stats

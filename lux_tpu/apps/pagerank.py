@@ -118,9 +118,9 @@ def main(argv=None):
                 cfg.method,
             )
         elif cfg.verbose and cfg.exchange == "allgather" and cfg.edge_shards == 1:
-            # step-wise DISTRIBUTED observability (whole-iteration times;
-            # the phase split stays a single-device mode); checkpointing
-            # composes via the same on_iter hook
+            # step-wise DISTRIBUTED observability with the 3-phase
+            # load/comp/update fence; checkpointing composes via the same
+            # on_iter hook
             state, _ = common.run_pull_stepwise_dist(
                 prog, shards, state, start_it, cfg.num_iters, mesh, cfg,
                 g.nv, on_iter,

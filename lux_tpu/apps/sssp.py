@@ -218,19 +218,27 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 it += 1
             state, iters, edges = carry.state, it, carry.edges
         elif cfg.verbose and cfg.exchange == "allgather":
-            # step-wise DISTRIBUTED observability: one shard_map iteration
-            # per step, host fence between (reference prints -verbose on
-            # multi-GPU runs too)
+            # step-wise DISTRIBUTED observability with the SAME 3-phase
+            # load/comp/update fence as the single-device split — the
+            # reference prints per-GPU loadTime/compTime/updateTime on
+            # multi-GPU runs too (sssp_gpu.cu:513-518)
             arrays, parrays, carry = push.push_init_dist(prog, shards, mesh)
-            step = push.compile_push_step_dist(
+            load, comp, update = push.compile_push_phases_dist(
                 prog, mesh, shards.pspec, shards.spec, cfg.method
             )
             stats = IterStats(verbose=True)
             it = 0
             while int(carry.active) > 0 and it < cfg.max_iters:
                 t = Timer()
-                carry = step(arrays, parrays, carry)
-                stats.record(it, int(carry.active), t.stop(carry.state))
+                plan = load(parrays, carry)
+                lt = t.stop(plan)
+                t = Timer()
+                new = comp(arrays, parrays, carry, plan)
+                ct = t.stop(new)
+                t = Timer()
+                carry = update(arrays, carry, new, plan)
+                ut = t.stop(carry)
+                stats.record_phases(it, int(carry.active), lt, ct, ut)
                 it += 1
             state, iters, edges = carry.state, it, carry.edges
         elif cfg.method == "pallas":

@@ -64,6 +64,29 @@ class PullProgram(Protocol):
 _REDUCERS: dict[str, Callable] = segment.reducers()
 
 
+def pull_gather_part(arrays: ShardArrays, full_state: jnp.ndarray,
+                     local_state: jnp.ndarray):
+    """LOAD phase for ONE part: the per-edge (src, dst) state gather —
+    the replicated-state read the reference's load_kernel does ZC→FB
+    (pagerank_gpu.cu:34-47).  Shared by the fused step and the -verbose
+    phase split (single-device AND distributed) so the phase boundary
+    can never drift from the fused math."""
+    src_state = full_state[arrays.src_pos]  # (E, ...) gather
+    dst_state = local_state[jnp.clip(arrays.dst_local, 0, local_state.shape[0] - 1)]
+    return src_state, dst_state
+
+
+def pull_reduce_part(prog: PullProgram, arrays: ShardArrays, gath,
+                     method: str):
+    """COMP phase for ONE part: per-edge values + segmented reduce by
+    destination (the pr_kernel hot loop, pagerank_gpu.cu:49-102)."""
+    src_state, dst_state = gath
+    vals = prog.edge_value(src_state, arrays.weights, dst_state)
+    return _REDUCERS[prog.reduce](
+        vals, arrays.row_ptr, arrays.head_flag, arrays.dst_local, method=method
+    )
+
+
 def local_pull_step(
     prog: PullProgram,
     arrays: ShardArrays,
@@ -73,12 +96,8 @@ def local_pull_step(
 ) -> jnp.ndarray:
     """One pull iteration for ONE part.  ``full_state`` is the (P*V, ...)
     concatenated padded state of all parts; ``local_state`` is (V, ...)."""
-    src_state = full_state[arrays.src_pos]  # (E, ...) gather
-    dst_state = local_state[jnp.clip(arrays.dst_local, 0, local_state.shape[0] - 1)]
-    vals = prog.edge_value(src_state, arrays.weights, dst_state)
-    acc = _REDUCERS[prog.reduce](
-        vals, arrays.row_ptr, arrays.head_flag, arrays.dst_local, method=method
-    )
+    gath = pull_gather_part(arrays, full_state, local_state)
+    acc = pull_reduce_part(prog, arrays, gath, method)
     return prog.apply(local_state, acc, arrays)
 
 
@@ -133,24 +152,15 @@ def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "auto"
     @jax.jit
     def load(arrays, state):
         full = state.reshape((spec.gathered_size,) + state.shape[2:])
-
-        def f(arr: ShardArrays, local):
-            src_state = full[arr.src_pos]
-            dst_state = local[jnp.clip(arr.dst_local, 0, local.shape[0] - 1)]
-            return src_state, dst_state
-
-        return jax.vmap(f)(arrays, state)
+        return jax.vmap(
+            lambda arr, loc: pull_gather_part(arr, full, loc)
+        )(arrays, state)
 
     @jax.jit
     def comp(arrays, gathered):
-        def f(arr: ShardArrays, gath):
-            src_state, dst_state = gath
-            vals = prog.edge_value(src_state, arr.weights, dst_state)
-            return _REDUCERS[prog.reduce](
-                vals, arr.row_ptr, arr.head_flag, arr.dst_local, method=method
-            )
-
-        return jax.vmap(f)(arrays, gathered)
+        return jax.vmap(
+            lambda arr, gath: pull_reduce_part(prog, arr, gath, method)
+        )(arrays, gathered)
 
     @partial(jax.jit, donate_argnums=1)
     def update(arrays, state, acc):

@@ -25,7 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from lux_tpu.engine.pull import PullProgram, local_pull_step
+from lux_tpu.engine.pull import (
+    PullProgram, local_pull_step, pull_gather_part, pull_reduce_part,
+)
 from lux_tpu.graph.shards import ShardArrays, ShardSpec
 from lux_tpu.parallel.mesh import PARTS_AXIS, flatten_gather, shard_stacked
 
@@ -83,40 +85,72 @@ def run_pull_fixed_dist(
     return _compile_fixed(prog, mesh, num_iters, method)(arrays, state0)
 
 
-def compile_pull_step_dist(prog, mesh, method: str = "auto"):
-    """ONE distributed pull iteration (all_gather + local step) — the
-    step-wise observability mode for `-verbose --distributed`: the host
-    fences per iteration (like the reference's per-iteration kernel
-    timers), trading the fused on-device loop for stats.  The state is
-    donated — ping-pong double buffering like the single-device
-    compile_pull_step.
+def compile_pull_phases_dist(prog, mesh, method: str = "auto"):
+    """One DISTRIBUTED pull iteration as THREE separately-jitted,
+    fence-able shard_map sub-steps — the multi-GPU `-verbose` phase
+    breakdown of the reference (per-GPU loadTime/compTime/updateTime,
+    sssp_gpu.cu:513-518, printed on multi-GPU runs too):
 
-    Resolution happens OUTSIDE the compile cache: caching on "auto" would
-    pin the first platform resolution for the process."""
+      load(arrays, state)        -> per-edge gathered (src, dst) states;
+                                    carries THE exchange (all_gather of
+                                    every part's state over ICI — the
+                                    Legion/GASNet whole-region read,
+                                    core/pull_model.inl:454-461)
+      comp(arrays, gathered)     -> per-destination reduced accumulators
+      update(arrays, state, acc) -> new state (apply; state donated)
+
+    The per-part bodies are the SAME pull_gather_part/pull_reduce_part
+    the fused engines use.  Observability path: fencing between phases
+    costs dispatch latency; run_pull_fixed_dist is the perf path."""
     from lux_tpu.engine import methods
 
-    return _compile_step_dist_cached(
+    return _compile_phases_dist_cached(
         prog, mesh, methods.resolve(method, prog.reduce)
     )
 
 
 @lru_cache(maxsize=64)
-def _compile_step_dist_cached(prog, mesh, method: str):
+def _compile_phases_dist_cached(prog, mesh, method: str):
+    Pp = P(PARTS_AXIS)
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(_arrays_specs(), Pp),
+        out_specs=(Pp, Pp),
+    )
+    def load(arr_blk, state_blk):
+        full = flatten_gather(state_blk)  # the ICI exchange
+        return jax.vmap(
+            lambda arr, loc: pull_gather_part(arr, full, loc)
+        )(arr_blk, state_blk)
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(_arrays_specs(), (Pp, Pp)),
+        out_specs=Pp,
+    )
+    def comp(arr_blk, gath_blk):
+        return jax.vmap(
+            lambda arr, gath: pull_reduce_part(prog, arr, gath, method)
+        )(arr_blk, gath_blk)
 
     @partial(jax.jit, donate_argnums=1)
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(_arrays_specs(), P(PARTS_AXIS)),
-        out_specs=P(PARTS_AXIS),
+        in_specs=(_arrays_specs(), Pp, Pp),
+        out_specs=Pp,
     )
-    def step(arr_blk, state_blk):
-        full = flatten_gather(state_blk)
-        return jax.vmap(
-            lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
-        )(arr_blk, state_blk)
+    def update(arr_blk, state_blk, acc_blk):
+        return jax.vmap(lambda arr, loc, a: prog.apply(loc, a, arr))(
+            arr_blk, state_blk, acc_blk
+        )
 
-    return step
+    return load, comp, update
 
 
 @lru_cache(maxsize=64)

@@ -217,12 +217,15 @@ def test_edge_shards_flag_gating():
 
 
 def test_sssp_cli_distributed_verbose(capsys):
-    """Distributed -verbose: per-iteration activeNodes stats from the
-    step-wise shard_map driver (reference parity on multi-GPU runs)."""
+    """Distributed -verbose: the SAME 3-phase load/comp/update breakdown
+    as single-device (the reference prints per-GPU
+    loadTime/compTime/updateTime on multi-GPU runs, sssp_gpu.cu:513-518),
+    and the result still validates (-check)."""
     args = SMALL + ["-ng", "8", "--distributed", "-verbose", "-check"]
     assert sssp_app.main(args) == 0
     out = capsys.readouterr().out
     assert "activeNodes(" in out and "[PASS] sssp" in out
+    assert "loadTime(" in out and "compTime(" in out and "updateTime(" in out
 
 
 def test_pagerank_cli_distributed_verbose(capsys):
@@ -230,6 +233,7 @@ def test_pagerank_cli_distributed_verbose(capsys):
     assert pr_app.main(args) == 0
     out = capsys.readouterr().out
     assert out.count("activeNodes(") == 3 and "top-5" in out
+    assert out.count("loadTime(") == 3 and out.count("updateTime(") == 3
 
 
 def test_colfilter_cli_distributed_verbose(capsys):
