@@ -57,15 +57,16 @@ def make_mesh_if(cfg: RunConfig):
 
 
 def require_parts_fit_devices(cfg: RunConfig, what: str) -> None:
-    """One part per device: the pallas and reduce_scatter engines don't
-    support k resident parts (allgather/ring do)."""
+    """One part per device: the pallas engines (pull and push) don't
+    support k resident parts (allgather/ring/scatter do)."""
     import jax
 
     if cfg.num_parts > len(jax.devices()):
         raise SystemExit(
             f"{what} keeps one part per device; -ng must not exceed the "
-            f"device count ({len(jax.devices())} available; allgather/ring "
-            "support multiple resident parts per device)"
+            f"device count ({len(jax.devices())} available; "
+            "allgather/ring/scatter support multiple resident parts per "
+            "device)"
         )
 
 
@@ -170,7 +171,6 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "--exchange scatter needs a sum-reducible program without "
                 "per-edge destination reads; use --exchange ring or allgather"
             )
-        require_parts_fit_devices(cfg, "--exchange scatter")
 
 
 def build_exchange_shards(g: HostGraph, cfg: RunConfig):

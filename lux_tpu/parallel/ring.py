@@ -139,16 +139,18 @@ def mark_bucket_heads(hf_row: np.ndarray, dl: np.ndarray) -> None:
 
 
 def build_ring_shards(
-    g: HostGraph, num_parts: int, parts_subset=None, pull=None
+    g: HostGraph, num_parts: int, parts_subset=None, pull=None,
+    counts=None,
 ) -> RingShards:
     """Bucket the graph for ring streaming.  ``parts_subset`` builds only
     those parts' (P, B) bucket rows (the sharded_load pattern: each host
     materializes O(its edges), not O(ne)).  Pass an existing ``pull``
-    build to avoid repartitioning."""
+    build to avoid repartitioning, and/or precomputed ``bucket_counts``
+    to avoid an extra O(ne) pass (tools/biggraph_check.py does both)."""
     pull = pull if pull is not None else build_pull_shards(g, num_parts)
     spec, cuts = pull.spec, pull.cuts
     Pn, V = num_parts, spec.nv_pad
-    counts = bucket_counts(g, cuts, Pn)
+    counts = counts if counts is not None else bucket_counts(g, cuts, Pn)
     B = _round_up(max(1, int(counts.max())), LANE)
 
     rows = list(range(Pn) if parts_subset is None else parts_subset)

@@ -86,12 +86,15 @@ class ScatterShards:
 
 
 def build_scatter_shards(
-    g: HostGraph, num_parts: int, parts_subset=None
+    g: HostGraph, num_parts: int, parts_subset=None, pull=None,
+    counts=None,
 ) -> ScatterShards:
     """Transposed bucket build: axis 0 = SOURCE owner q (the chip that
     stores and computes the bucket), axis 1 = destination part p.
     ``parts_subset`` selects which chips' rows to materialize (per-host
-    builds hold O(their edges), not O(ne))."""
+    builds hold O(their edges), not O(ne)).  Pass an existing ``pull``
+    build (e.g. sharded_load.load_pull_shards) to avoid repartitioning,
+    and/or precomputed ``bucket_counts`` to skip an extra O(ne) pass."""
     from lux_tpu.parallel.ring import (
         _owner_split,
         _slice_dst_local,
@@ -99,10 +102,10 @@ def build_scatter_shards(
         mark_bucket_heads,
     )
 
-    pull = build_pull_shards(g, num_parts)
+    pull = pull if pull is not None else build_pull_shards(g, num_parts)
     spec, cuts = pull.spec, pull.cuts
     Pn, V = num_parts, spec.nv_pad
-    counts = bucket_counts(g, cuts, Pn)
+    counts = counts if counts is not None else bucket_counts(g, cuts, Pn)
     B = _round_up(max(1, int(counts.max())), LANE)
 
     rows = list(range(Pn) if parts_subset is None else parts_subset)
@@ -161,34 +164,48 @@ def _compile_scatter_fixed(prog, mesh, num_parts: int, num_iters: int,
         out_specs=P(PARTS_AXIS),
     )
     def run(sarr_blk, vtx_mask_blk, degree_blk, state_blk):
-        sarr = jax.tree.map(lambda a: a[0], sarr_blk)
-        vtx_mask, degree = vtx_mask_blk[0], degree_blk[0]
+        # k = P/D resident source parts per device (k == 1 when parts ==
+        # devices) — the leading axis of every block, like the ring/dist
+        # engines.  Lane j holds global source part dev*k + j.
+        k = state_blk.shape[0]
 
-        def iteration(_, local):
-            V = local.shape[0]
+        def iteration(_, local):  # local: (k, V, ...)
+            V = local.shape[1]
 
             def partial_for(p):
-                src_state = local[sarr.src_local[p]]
-                # dst_state unavailable pre-combination (remote); sum
-                # programs don't use it
-                vals = prog.edge_value(src_state, sarr.weights[p], None)
-                return segment.segment_reduce_by_ends(
-                    vals, sarr.head_flag[p], sarr.dst_local[p], V,
-                    reduce="sum", method=method,
-                )
+                # partials into destination part p from ALL my resident
+                # source parts, pre-summed before the collective (legal:
+                # sum programs only — the assert above)
+                def lane(loc, src, w, hf, dl):
+                    # dst_state unavailable pre-combination (remote);
+                    # sum programs don't use it
+                    vals = prog.edge_value(loc[src], w, None)
+                    return segment.segment_reduce_by_ends(
+                        vals, hf, dl, V, reduce="sum", method=method,
+                    )
+
+                return jax.vmap(lane)(
+                    local, sarr_blk.src_local[:, p], sarr_blk.weights[:, p],
+                    sarr_blk.head_flag[:, p], sarr_blk.dst_local[:, p],
+                ).sum(axis=0)
 
             partials = jnp.stack(
                 [partial_for(p) for p in range(num_parts)]
             )  # (P, V, ...)
             flat = partials.reshape((num_parts * V,) + partials.shape[2:])
+            # tiled psum_scatter over D devices hands device d the
+            # contiguous [d*k*V, (d+1)*k*V) slice = its k resident parts'
+            # summed destinations (shard_stacked ordering)
             acc = jax.lax.psum_scatter(
                 flat, PARTS_AXIS, scatter_dimension=0, tiled=True
-            )  # (V, ...): summed partials for MY destinations
-            return prog.apply(
-                local, acc, _RingArrView(vtx_mask=vtx_mask, degree=degree)
-            )
+            ).reshape((k, V) + partials.shape[2:])
+            return jax.vmap(
+                lambda loc, a, vm, dg: prog.apply(
+                    loc, a, _RingArrView(vtx_mask=vm, degree=dg)
+                )
+            )(local, acc, vtx_mask_blk, degree_blk)
 
-        return jax.lax.fori_loop(0, num_iters, iteration, state_blk[0])[None]
+        return jax.lax.fori_loop(0, num_iters, iteration, state_blk)
 
     return run
 
@@ -201,12 +218,16 @@ def run_pull_fixed_scatter(
     mesh: Mesh,
     method: str = "auto",
 ):
-    """Distributed fixed-iteration pull with reduce_scatter exchange."""
+    """Distributed fixed-iteration pull with reduce_scatter exchange.
+    P may be any multiple of the mesh size (k parts resident per device,
+    like the ring/dist drivers)."""
     from lux_tpu.engine import methods
 
     method = methods.resolve(method, prog.reduce)
     spec = shards.spec
-    assert spec.num_parts == mesh.devices.size
+    assert spec.num_parts % mesh.devices.size == 0, (
+        spec.num_parts, mesh.shape,
+    )
     assert len(shards.parts_subset) == spec.num_parts, (
         "subset-built scatter shards: assemble the full stacked arrays "
         "across hosts (multihost.assemble_global) before driving"

@@ -55,3 +55,49 @@ def test_loaded_shards_run_pagerank(lux_file):
     shards = sharded_load.load_pull_shards(path, 2)
     got = pr.pagerank(shards, num_iters=5)
     np.testing.assert_allclose(got, pr.pagerank_reference(g, 5), rtol=3e-5)
+
+def test_subset_load_is_o_local_edges(lux_file, monkeypatch):
+    """VERDICT r3 #4: a parts_subset load must be O(local edges) resident —
+    it allocates only the subset's stacked rows AND reads only the
+    subset's byte ranges from the file (the reference's per-node partial
+    reads, core/pull_model.inl:253-320).  Pinned by (a) exact allocation
+    accounting and (b) spying the range reads; mmap keeps the header
+    column array unmaterialized."""
+    from lux_tpu.graph import format as fmt
+
+    path, g = lux_file
+    P, subset = 8, [2, 5]
+    full = sharded_load.load_pull_shards(path, P)
+    calls = []
+    real = fmt.read_lux_range
+
+    def spy(path_, vlo, vhi, **kw):
+        calls.append((vlo, vhi))
+        return real(path_, vlo, vhi, **kw)
+
+    monkeypatch.setattr(fmt, "read_lux_range", spy)
+    sub = sharded_load.load_pull_shards(path, P, parts_subset=subset)
+    # (a) allocation: exactly len(subset)/P of the full stacked bytes
+    sub_b = sum(a.nbytes for a in sub.arrays)
+    full_b = sum(a.nbytes for a in full.arrays)
+    assert sub_b * P == full_b * len(subset)
+    # (b) file reads: exactly the subset parts' vertex ranges, no more
+    cuts = full.cuts
+    assert calls == [(int(cuts[p]), int(cuts[p + 1])) for p in subset]
+    # the shared header/offset pass stays file-backed (a zero-copy view
+    # chain ending in the memmap — never an O(ne) materialization)
+    hdr = fmt.read_lux(path, mmap=True)
+    b = hdr.col_idx
+    assert not b.flags.owndata
+    while isinstance(b, np.ndarray) and b.base is not None:
+        b = b.base
+    import mmap as _mmap
+
+    assert isinstance(b, (np.memmap, _mmap.mmap))
+    # and the subset rows equal the full build's same-part rows
+    for name in sub.arrays._fields:
+        np.testing.assert_array_equal(
+            getattr(sub.arrays, name),
+            getattr(full.arrays, name)[subset],
+            err_msg=name,
+        )

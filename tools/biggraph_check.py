@@ -1,0 +1,189 @@
+"""Big-graph-from-disk proof (VERDICT r3 #4).
+
+Generates an rmat{scale}/ef{ef} `.lux` on disk (once; ~1.3 GB at
+scale 24), then drives the FILE-BASED pipeline the reference exercises
+with Twitter-2010 (partial per-part reads, core/pull_model.inl:253-320):
+
+  1. streaming out-degree scan + `sharded_load.load_pull_shards` (all
+     parts AND a parts_subset residency demo),
+  2. per-exchange preflight estimates (with the k-resident scaling),
+  3. PageRank on the 8-device virtual mesh via the ring and
+     reduce_scatter exchanges (k = P/8 resident parts per device),
+  4. SSSP (direction-optimized push, allgather exchange) to convergence,
+  5. peak-RSS checkpoints after every phase vs the preflight estimates.
+
+Run on the 1-core CPU host (no chip needed):
+
+  env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tools/biggraph_check.py --scale 24 --parts 16
+
+Results are recorded in docs/BIGGRAPH.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+
+def rss_gib() -> float:
+    """Peak RSS of this process so far, GiB (ru_maxrss is KiB on Linux)."""
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scale", type=int, default=24)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--parts", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=2, help="pagerank iters")
+    ap.add_argument("--file", default=None, help=".lux path (default /tmp)")
+    ap.add_argument("--skip-sssp", action="store_true")
+    ap.add_argument(
+        "--bucket-cap-gib", type=float, default=40.0,
+        help="skip a bucket exchange whose padded arrays would exceed this",
+    )
+    args = ap.parse_args(argv)
+    t_all = time.monotonic()
+
+    def note(phase, **kw):
+        print(json.dumps({"phase": phase, "rss_gib": round(rss_gib(), 2),
+                          "t_s": round(time.monotonic() - t_all, 1), **kw}),
+              flush=True)
+
+    import numpy as np
+
+    from lux_tpu.graph import format as fmt
+    from lux_tpu.graph import generate, sharded_load
+
+    path = args.file or f"/tmp/lux_rmat{args.scale}_ef{args.ef}.lux"
+    if not os.path.exists(path):
+        t0 = time.monotonic()
+        g = generate.rmat(args.scale, args.ef, seed=0)
+        note("generated", gen_s=round(time.monotonic() - t0, 1),
+             nv=g.nv, ne=g.ne)
+        t0 = time.monotonic()
+        fmt.write_lux(path, g)
+        note("written", write_s=round(time.monotonic() - t0, 1),
+             file_gib=round(os.path.getsize(path) / (1 << 30), 3))
+        del g
+    else:
+        note("reusing", file=path,
+             file_gib=round(os.path.getsize(path) / (1 << 30), 3))
+
+    P = args.parts
+    header = fmt.read_lux(path, mmap=True)
+    nv, ne = header.nv, header.ne
+
+    # --- streaming degree scan (the pull_scan_task analog) ---
+    t0 = time.monotonic()
+    degrees = sharded_load.out_degrees_from_file(path, header=header)
+    note("degree_scan", scan_s=round(time.monotonic() - t0, 1))
+
+    # --- O(local edges) residency demo: load only 2 of P parts ---
+    t0 = time.monotonic()
+    sub = sharded_load.load_pull_shards(
+        path, P, parts_subset=[0, 1], degrees=degrees
+    )
+    sub_bytes = sum(a.nbytes for a in sub.arrays)
+    note("subset_load", parts=2, sub_gib=round(sub_bytes / (1 << 30), 3),
+         load_s=round(time.monotonic() - t0, 1))
+    del sub
+
+    # --- full load from file (every part via partial range reads) ---
+    t0 = time.monotonic()
+    pull = sharded_load.load_pull_shards(path, P, degrees=degrees)
+    full_bytes = sum(a.nbytes for a in pull.arrays)
+    note("full_load", parts=P, full_gib=round(full_bytes / (1 << 30), 3),
+         load_s=round(time.monotonic() - t0, 1),
+         subset_frac=round(sub_bytes / full_bytes, 4))
+
+    import jax
+
+    from lux_tpu.engine import pull as pull_eng
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.parallel.mesh import make_mesh_for_parts
+    from lux_tpu.utils import preflight
+
+    mesh = make_mesh_for_parts(P)
+    k = P // mesh.devices.size
+    prog = PageRankProgram(nv=nv)
+    note("mesh", devices=int(mesh.devices.size), k_resident=k)
+
+    # --- ring + scatter exchanges (bucket builds reuse the pull build) ---
+    from lux_tpu.parallel.ring import bucket_counts
+
+    counts = bucket_counts(header, pull.cuts, P)
+    B_est = int(counts.max())
+    bucket_gib = P * P * B_est * 13 / (1 << 30)
+    note("bucket_geometry", max_bucket=B_est,
+         pad_inflation=round(P * P * B_est / max(ne, 1), 2),
+         bucket_gib=round(bucket_gib, 2))
+
+    # both bucket exchanges run the SAME P (k = P/8 resident parts per
+    # device) and share the streamed pull build
+    for kind in ("ring", "scatter"):
+        if bucket_gib > args.bucket_cap_gib:
+            note(f"{kind}_skipped", reason="bucket padding exceeds cap",
+                 bucket_gib=round(bucket_gib, 2))
+            continue
+        t0 = time.monotonic()
+        if kind == "ring":
+            from lux_tpu.parallel.ring import (
+                build_ring_shards, run_pull_fixed_ring,
+            )
+
+            sh = build_ring_shards(header, P, pull=pull, counts=counts)
+            est = preflight.estimate_ring(sh.spec, sh.e_bucket_pad)
+        else:
+            from lux_tpu.parallel.scatter import (
+                build_scatter_shards, run_pull_fixed_scatter,
+            )
+
+            sh = build_scatter_shards(header, P, pull=pull, counts=counts)
+            est = preflight.estimate_scatter(sh.spec, sh.e_bucket_pad)
+        est = preflight.scale_residency(est, k)
+        note(f"{kind}_built", parts=P, k_resident=k,
+             build_s=round(time.monotonic() - t0, 1),
+             preflight_gib=round(est.total_bytes / (1 << 30), 3))
+        t0 = time.monotonic()
+        state0 = pull_eng.init_state(prog, jax.tree.map(np.asarray, pull.arrays))
+        run = run_pull_fixed_ring if kind == "ring" else run_pull_fixed_scatter
+        out = run(prog, sh, state0, args.iters, mesh)
+        out = jax.device_get(out)
+        dt = time.monotonic() - t0
+        top = float(np.max(out))
+        note(f"pagerank_{kind}", iters=args.iters,
+             run_s=round(dt, 1),
+             gteps=round(args.iters * ne / dt / 1e9, 4), top_rank=top)
+        del sh, out, state0
+
+    if not args.skip_sssp:
+        from lux_tpu.graph.push_shards import build_push_shards
+        from lux_tpu.models.sssp import inf_value, sssp
+
+        t0 = time.monotonic()
+        psh = build_push_shards(header, P)
+        pest = preflight.scale_residency(
+            preflight.estimate_push(psh.spec, psh.pspec), k
+        )
+        note("push_built", build_s=round(time.monotonic() - t0, 1),
+             preflight_gib=round(pest.total_bytes / (1 << 30), 3))
+        start = int(np.argmax(degrees))
+        t0 = time.monotonic()
+        dist = sssp(psh, start=start, mesh=mesh)
+        dt = time.monotonic() - t0
+        reached = int((np.asarray(dist) < inf_value(nv)).sum())
+        note("sssp_allgather", start=start, reached=reached,
+             run_s=round(dt, 1))
+
+    note("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
