@@ -157,19 +157,41 @@ want = pagerank_reference(g, 5)
 check_local(out, shards.cuts, mine, want, close)
 print(f"process {pid}: multihost pagerank OK over {P} devices / {nproc} procs", flush=True)
 
-# --- ring exchange with PER-HOST SUBSET bucket builds: each process
-# materializes only its parts' (P, B) bucket rows (the RMAT27 load plan,
-# SURVEY.md §7.3) and assemble_global stitches the global stacked arrays
+# --- bucket exchanges (ring, reduce_scatter) with PER-HOST SUBSET
+# builds: each process materializes only its parts' bucket rows (the
+# RMAT27 load plan, SURVEY.md §7.3); assemble_global stitches the
+# global stacked arrays; ring's ppermute and scatter's fused
+# psum_scatter each cross the real process boundary
 from lux_tpu.parallel import ring
+from lux_tpu.parallel import scatter as scatter_mod
+from lux_tpu.parallel.ring import bucket_counts
 
-rs_local = ring.build_ring_shards(g, P, parts_subset=mine, pull=shards)
-rarr_global = jax.tree.map(
-    lambda a: mh.assemble_global(mesh, a, P), rs_local.rarrays
+counts = bucket_counts(g, shards.cuts, P)  # shared O(ne) pass
+
+
+def run_bucket_exchange(build, shards_cls, field, run):
+    """Subset-build -> assemble -> reconstruct-global -> run -> check,
+    identical for every bucket-layout exchange."""
+    local = build(g, P, parts_subset=mine, pull=shards, counts=counts)
+    arr_global = jax.tree.map(
+        lambda a: mh.assemble_global(mesh, a, P), getattr(local, field)
+    )
+    full = shards_cls(
+        pull=shards, e_bucket_pad=local.e_bucket_pad,
+        parts_subset=list(range(P)), **{field: arr_global},
+    )
+    out = run(prog, full, state0, 5, mesh)
+    check_local(out, shards.cuts, mine, want, close)
+
+
+run_bucket_exchange(
+    ring.build_ring_shards, ring.RingShards, "rarrays",
+    ring.run_pull_fixed_ring,
 )
-rs = ring.RingShards(
-    pull=shards, rarrays=rarr_global,
-    e_bucket_pad=rs_local.e_bucket_pad, parts_subset=list(range(P)),
-)
-ring_out = ring.run_pull_fixed_ring(prog, rs, state0, 5, mesh)
-check_local(ring_out, shards.cuts, mine, want, close)
 print(f"process {pid}: multihost ring OK (subset-built buckets)", flush=True)
+run_bucket_exchange(
+    scatter_mod.build_scatter_shards, scatter_mod.ScatterShards, "sarrays",
+    scatter_mod.run_pull_fixed_scatter,
+)
+print(f"process {pid}: multihost scatter OK (cross-host psum_scatter)",
+      flush=True)
