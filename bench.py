@@ -87,6 +87,9 @@ def worker_main():
     # the insurance's CPU-bound timed region runs on a quiet machine
     # (measured: concurrent graph gen halves the fallback GTEPS)
     time.sleep(int(os.environ.get("LUX_BENCH_PRIMARY_DELAY_S", "0")))
+    # the scale-up budget clock starts AFTER the stagger sleep: the gate
+    # compares work time against the orchestrator's wait-for-us budget
+    t_worker0 = time.monotonic()
     import jax
     import jax.numpy as jnp
 
@@ -217,6 +220,41 @@ def worker_main():
                 "method": m,
                 "dtype": dt,
                 **roofline.summarize(model, elapsed, iters * g.ne),
+            }
+        )
+
+    def measure_scaleup(m):
+        """One pagerank line at scale+2 (4x the edges) on the winning
+        method — distinguishes a dispatch-dominated small-graph number
+        from a bandwidth-bound one (compare the two scales'
+        achieved_GBps; docs/PERF.md roofline)."""
+        s2 = scale + 2
+        g2 = generate.rmat(s2, ef, seed=0)
+        sh2 = build_pull_shards(g2, 1)
+        prog2 = PageRankProgram(nv=sh2.spec.nv, dtype=dtype)
+        arr2 = jax.tree.map(jnp.asarray, sh2.arrays)
+        s0 = pull.init_state(prog2, arr2)
+
+        def run(n):
+            return pull.run_pull_fixed(prog2, sh2.spec, arr2, s0, n, m)
+
+        elapsed, _ = fetch_timed(run)
+        gteps = iters * g2.ne / elapsed / 1e9
+        model = roofline.pull_iter_model(
+            g2.ne, g2.nv, m, state_bytes=2 if dtype == "bfloat16" else 4
+        ).scale(iters)
+        _emit(
+            {
+                "metric": f"pagerank_gteps_rmat{s2}_1chip",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
+                "dtype": dtype,
+                # pass-through marker: _relay must not let this line
+                # compete with (and hijack) the rmat{scale} headline
+                "scale_up": True,
+                **roofline.summarize(model, elapsed, iters * g2.ne),
             }
         )
 
@@ -444,6 +482,25 @@ def worker_main():
             measure_components(resolve_method("auto", "max", platform))
         except Exception as e:  # noqa: BLE001
             print(f"# components failed: {e}", file=sys.stderr, flush=True)
+    if "pagerank" in apps and results and (
+        on_tpu or os.environ.get("LUX_BENCH_FORCE_SCALEUP") == "1"
+    ):
+        # scale-up datapoint (VERDICT r3 weak #4: a small headline graph
+        # risks a dispatch-dominated number): one more pagerank line at
+        # scale+2 on the race winner, only while less than half the TPU
+        # budget is spent, and BEFORE the risky tail (a scan wedge must
+        # not cost it)
+        tpu_budget = int(os.environ.get("LUX_BENCH_TPU_S", "600"))
+        if time.monotonic() - t_worker0 < 0.5 * tpu_budget:
+            try:
+                measure_scaleup(
+                    min(results.items(), key=lambda kv: kv[1])[0][0]
+                )
+            except Exception as e:  # noqa: BLE001
+                print(f"# scale-up failed: {e}", file=sys.stderr, flush=True)
+        else:
+            print("# scale-up skipped: budget half-spent", file=sys.stderr,
+                  flush=True)
     if "pagerank" in apps:
         for m in risky_tail:
             try:
@@ -545,7 +602,7 @@ def _relay(out_path) -> bool:
             sys.stderr.flush()
     except OSError:
         pass
-    best = {}
+    best, extras = {}, []
     try:
         with open(out_path, "rb") as f:
             for line in f.read().decode(errors="replace").splitlines():
@@ -555,6 +612,12 @@ def _relay(out_path) -> bool:
                     obj = json.loads(line)
                 except ValueError:
                     continue
+                if obj.get("scale_up"):
+                    # pass-through datapoints (the rmat{scale+2} line):
+                    # must neither hijack the headline nor be dropped by
+                    # the best-per-family contest
+                    extras.append(obj)
+                    continue
                 fam = str(obj.get("metric", "")).split("_")[0]
                 if fam not in best or obj.get("value", 0.0) > best[fam].get(
                     "value", 0.0
@@ -562,8 +625,12 @@ def _relay(out_path) -> bool:
                     best[fam] = obj
     except OSError:
         pass
-    if not best:
+    if not best and not extras:
         return False
+    for obj in extras:
+        print(json.dumps(obj), flush=True)
+    if not best:
+        return True
     # fixed fallback priority (not max(): that picks the lexicographically
     # largest family — an arbitrary headline when pagerank is excluded)
     for fam in ("pagerank", "sssp", "components", "colfilter"):
@@ -632,6 +699,9 @@ def main():
     # (graph gen) is not its timed region (device-bound), while the CPU
     # insurance's timed region IS CPU-bound and must not share the core
     env_primary = dict(os.environ)
+    # export the ACTUAL wait (possibly relay-capped) so the worker's
+    # scale-up budget gate reasons about the real deadline, not a default
+    env_primary["LUX_BENCH_TPU_S"] = str(tpu_wait)
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
         env_primary.setdefault("LUX_BENCH_PRIMARY_DELAY_S", "180")
     tpu_proc = _spawn_worker(env_primary, tpu_out, nice=10)

@@ -102,6 +102,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_uint32, ctypes.c_uint32, i64p, i32p, i32p, f32p,
     ]
     lib.lux_blockcsr_fill.restype = ctypes.c_int
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.lux_bucket_fill.argtypes = [
+        u32p, i64p, i32p, ctypes.c_uint64, ctypes.c_uint32,
+        u32p, ctypes.c_uint32, ctypes.c_uint64, i64p, ctypes.c_uint64,
+        i32p, i32p, u8p, f32p,
+    ]
+    lib.lux_bucket_fill.restype = ctypes.c_int
     return lib
 
 
@@ -296,3 +303,42 @@ def count_degrees(col_idx: np.ndarray, nv: int):
     if rc != 0:
         raise ValueError("source id out of range")
     return deg.astype(np.int32)
+
+
+def bucket_fill(srcs, row_ptr_slice, weights, cuts, B: int,
+                row_map, row_stride: int,
+                src_flat, dst_flat, hf_flat, w_flat):
+    """One-pass owner-bucket fill for the ring/scatter layouts
+    (lux_bucket_fill): writes src_local/dst_local/head_flag/weights for
+    every materialized bucket of one part slice.  ``*_flat`` are
+    C-contiguous flat int32/int32/uint8-view/float32 target views whose
+    origin is the part's (or column's) base slot; ``row_map[q]`` is the
+    target row for owner q (-1 = skip).  Returns True, or None if the
+    lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    srcs = np.ascontiguousarray(srcs, np.uint32)
+    rp = np.ascontiguousarray(row_ptr_slice, np.int64)
+    cuts = np.ascontiguousarray(cuts, np.uint32)
+    row_map = np.ascontiguousarray(row_map, np.int64)
+    wp = None
+    if weights is not None:
+        assert w_flat is not None and w_flat.dtype == np.float32
+        weights = np.ascontiguousarray(weights, np.int32)
+        wp = _ptr(weights, ctypes.c_int32)
+    for a, dt in ((src_flat, np.int32), (dst_flat, np.int32),
+                  (hf_flat, np.uint8)):
+        assert a.dtype == dt and a.flags.c_contiguous, (a.dtype, dt)
+    rc = lib.lux_bucket_fill(
+        _ptr(srcs, ctypes.c_uint32), _ptr(rp, ctypes.c_int64), wp,
+        len(srcs), len(rp) - 1, _ptr(cuts, ctypes.c_uint32),
+        len(cuts) - 1, B, _ptr(row_map, ctypes.c_int64), row_stride,
+        _ptr(src_flat, ctypes.c_int32), _ptr(dst_flat, ctypes.c_int32),
+        _ptr(hf_flat, ctypes.c_uint8),
+        _ptr(w_flat, ctypes.c_float) if w_flat is not None else None,
+    )
+    if rc != 0:
+        raise ValueError(f"bucket fill failed (rc={rc}): bad cuts/row_ptr "
+                         "or bucket overflow")
+    return True

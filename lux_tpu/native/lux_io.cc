@@ -381,4 +381,58 @@ int lux_count_degrees(const uint32_t* col, uint64_t ne, uint32_t nv,
   return 0;
 }
 
+// One part-slice pass filling ALL owner buckets of the ring /
+// reduce_scatter layouts (src_local, dst_local, head_flag, weights) —
+// replaces the per-bucket Python fancy-indexing loop (O(P) array
+// round-trips per part; 50-139 s at rmat24/P=16) with a single O(slice)
+// scatter.  Works for both layouts via row_map/row_stride:
+//   ring:    row_map[q] = q,                row_stride = B   (one (P,B) block)
+//   scatter: row_map[q] = host row or -1,   row_stride = P*B (column p of the
+//            (R,P,B) stack; caller offsets the base pointers by p*B)
+// Edges arrive CSC-ordered (by destination); the stable per-owner cursor
+// preserves that order inside each bucket, so head flags are computed on
+// the fly against the bucket's previous destination.  The first padding
+// slot of every materialized bucket is head-flagged (the
+// segment_reduce_by_ends end-marker contract, parallel/ring.py
+// mark_bucket_heads).  Outputs must arrive pre-padded (dst_local = V,
+// src_local/weights = 0) — only real slots and the one pad flag are
+// written.
+int lux_bucket_fill(const uint32_t* srcs, const int64_t* row_ptr,
+                    const int32_t* weights_in, uint64_t n_e, uint32_t n_v,
+                    const uint32_t* cuts, uint32_t num_parts, uint64_t B,
+                    const int64_t* row_map, uint64_t row_stride,
+                    int32_t* src_local, int32_t* dst_local,
+                    uint8_t* head_flag, float* w_out) {
+  std::vector<uint64_t> cursor(num_parts, 0);
+  std::vector<int32_t> prev(num_parts, -1);
+  const int64_t base = row_ptr[0];
+  uint64_t e = 0;
+  for (uint32_t v = 0; v < n_v; v++) {
+    const int64_t hi64 = row_ptr[v + 1] - base;
+    if (hi64 < (int64_t)e || (uint64_t)hi64 > n_e) return -EINVAL;
+    for (const uint64_t hi = (uint64_t)hi64; e < hi; e++) {
+      const uint32_t s = srcs[e];
+      const uint32_t q = owner_of(s, cuts, num_parts);
+      if (q >= num_parts) return -EINVAL;
+      const int64_t row = row_map[q];
+      if (row < 0) continue;  // bucket not materialized on this host
+      const uint64_t c = cursor[q]++;
+      if (c >= B) return -EOVERFLOW;
+      const size_t at = (size_t)row * row_stride + c;
+      src_local[at] = (int32_t)(s - cuts[q]);
+      dst_local[at] = (int32_t)v;
+      head_flag[at] = (c == 0) || (prev[q] != (int32_t)v);
+      prev[q] = (int32_t)v;
+      if (weights_in) w_out[at] = (float)weights_in[e];
+    }
+  }
+  if (e != n_e) return -EINVAL;
+  for (uint32_t q = 0; q < num_parts; q++) {
+    const int64_t row = row_map[q];
+    if (row >= 0 && cursor[q] < B)
+      head_flag[(size_t)row * row_stride + cursor[q]] = 1;
+  }
+  return 0;
+}
+
 }  // extern "C"

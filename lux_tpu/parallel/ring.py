@@ -126,6 +126,22 @@ def _owner_split(srcs: np.ndarray, cuts) -> tuple:
     return np.argsort(own, kind="stable"), counts
 
 
+def _native_bucket_fill_ok(w_in) -> bool:
+    """The native fill consumes int32 weights (reference WeightType=int);
+    any dtype that an int32 cast could truncate (floats, int64, uint32+)
+    takes the NumPy path so both paths stay bit-identical."""
+    return w_in is None or w_in.dtype in (
+        np.int8, np.int16, np.int32, np.uint8, np.uint16,
+    )
+
+
+def native_bucket_fill(*args):
+    """Shim so the builders read as one call; see native.bucket_fill."""
+    from lux_tpu import native
+
+    return native.bucket_fill(*args)
+
+
 def mark_bucket_heads(hf_row: np.ndarray, dl: np.ndarray) -> None:
     """Destination-segment starts for one bucket (edges CSC-ordered).  The
     first padding slot is flagged too, so segment_reduce_by_ends sees the
@@ -158,9 +174,22 @@ def build_ring_shards(
     dst_local = np.full((len(rows), Pn, B), V, np.int32)
     head_flag = np.zeros((len(rows), Pn, B), bool)
     weights = np.zeros((len(rows), Pn, B), np.float32)
+    identity = np.arange(Pn, dtype=np.int64)
+    blk = Pn * B
     for i, p in enumerate(rows):
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
+        w_in = None if g.weights is None else np.asarray(g.weights[elo:ehi])
+        if _native_bucket_fill_ok(w_in) and native_bucket_fill(
+            np.asarray(g.col_idx[elo:ehi]),
+            np.asarray(g.row_ptr[vlo : vhi + 1]), w_in, cuts, B,
+            identity, B,
+            src_local.reshape(-1)[i * blk : (i + 1) * blk],
+            dst_local.reshape(-1)[i * blk : (i + 1) * blk],
+            head_flag.view(np.uint8).reshape(-1)[i * blk : (i + 1) * blk],
+            weights.reshape(-1)[i * blk : (i + 1) * blk],
+        ):
+            continue
         srcs = np.asarray(g.col_idx[elo:ehi]).astype(np.int64)
         dl_slice = _slice_dst_local(g, vlo, vhi)
         # stable owner-bucketing keeps CSC (by-destination) order within
@@ -174,8 +203,8 @@ def build_ring_shards(
             dl = dl_slice[eids]
             dst_local[i, q, :m] = dl
             mark_bucket_heads(head_flag[i, q], dl)
-            if g.weights is not None:
-                weights[i, q, :m] = g.weights[elo:ehi][eids].astype(np.float32)
+            if w_in is not None:
+                weights[i, q, :m] = w_in[eids].astype(np.float32)
     return RingShards(
         pull=pull,
         rarrays=RingArrays(src_local, dst_local, head_flag, weights),

@@ -114,9 +114,27 @@ def build_scatter_shards(
     dst_local = np.full((len(rows), Pn, B), V, np.int32)
     head_flag = np.zeros((len(rows), Pn, B), bool)
     weights = np.zeros((len(rows), Pn, B), np.float32)
+    from lux_tpu.parallel.ring import _native_bucket_fill_ok, native_bucket_fill
+
+    row_map = np.full(Pn, -1, np.int64)
+    for q in rows:
+        row_map[q] = row_of[q]
     for p in range(Pn):  # destination part: one slice scan, split by owner
         vlo, vhi = int(cuts[p]), int(cuts[p + 1])
         elo, ehi = int(g.row_ptr[vlo]), int(g.row_ptr[vhi])
+        w_in = None if g.weights is None else np.asarray(g.weights[elo:ehi])
+        if _native_bucket_fill_ok(w_in) and native_bucket_fill(
+            np.asarray(g.col_idx[elo:ehi]),
+            np.asarray(g.row_ptr[vlo : vhi + 1]), w_in, cuts, B,
+            # transposed layout: owner q's bucket for destination p lives
+            # at flat row_of[q]*(Pn*B) + p*B — base the views at column p
+            row_map, Pn * B,
+            src_local.reshape(-1)[p * B :],
+            dst_local.reshape(-1)[p * B :],
+            head_flag.view(np.uint8).reshape(-1)[p * B :],
+            weights.reshape(-1)[p * B :],
+        ):
+            continue
         srcs = np.asarray(g.col_idx[elo:ehi]).astype(np.int64)
         dl_slice = _slice_dst_local(g, vlo, vhi)
         order, _ = _owner_split(srcs, cuts)
@@ -129,8 +147,8 @@ def build_scatter_shards(
             dl = dl_slice[eids]
             dst_local[i, p, :m] = dl
             mark_bucket_heads(head_flag[i, p], dl)
-            if g.weights is not None:
-                weights[i, p, :m] = g.weights[elo:ehi][eids].astype(np.float32)
+            if w_in is not None:
+                weights[i, p, :m] = w_in[eids].astype(np.float32)
     return ScatterShards(
         pull=pull,
         sarrays=ScatterArrays(src_local, dst_local, head_flag, weights),

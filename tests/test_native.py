@@ -214,3 +214,35 @@ def test_blockcsr_fill_matches_numpy(weighted):
             assert y is None
             continue
         np.testing.assert_array_equal(x, y, err_msg=f)
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+def test_bucket_fill_matches_numpy_ring(weighted):
+    """Native one-pass bucket fill == the NumPy per-bucket path, bitwise,
+    on every RingArrays field (incl. padding and head flags)."""
+    from lux_tpu.parallel.ring import build_ring_shards
+
+    g = generate.rmat(10, 8, seed=66, weighted=weighted)
+    a, b = _with_fallback(lambda: build_ring_shards(g, 4))
+    assert a.e_bucket_pad == b.e_bucket_pad
+    for name in a.rarrays._fields:
+        np.testing.assert_array_equal(
+            getattr(a.rarrays, name), getattr(b.rarrays, name), err_msg=name
+        )
+
+
+@pytest.mark.parametrize("subset", [None, [1, 2]])
+def test_bucket_fill_matches_numpy_scatter(subset):
+    """Same for the transposed reduce_scatter layout, incl. a parts_subset
+    build (row_map skips, per-host residency)."""
+    from lux_tpu.parallel.scatter import build_scatter_shards
+
+    g = generate.rmat(10, 8, seed=67, weighted=True)
+    a, b = _with_fallback(
+        lambda: build_scatter_shards(g, 4, parts_subset=subset)
+    )
+    assert a.parts_subset == b.parts_subset
+    for name in a.sarrays._fields:
+        np.testing.assert_array_equal(
+            getattr(a.sarrays, name), getattr(b.sarrays, name), err_msg=name
+        )
