@@ -83,13 +83,14 @@ def worker_main():
                "dtype": "float32"})
         while True:
             time.sleep(3600)
+    # scale-up budget clock: from worker entry — the stagger sleep below
+    # counts against it, because the orchestrator's tpu_wait deadline
+    # started at spawn time
+    t_worker0 = time.monotonic()
     # the orchestrator staggers the primary behind the CPU insurance so
     # the insurance's CPU-bound timed region runs on a quiet machine
     # (measured: concurrent graph gen halves the fallback GTEPS)
     time.sleep(int(os.environ.get("LUX_BENCH_PRIMARY_DELAY_S", "0")))
-    # the scale-up budget clock starts AFTER the stagger sleep: the gate
-    # compares work time against the orchestrator's wait-for-us budget
-    t_worker0 = time.monotonic()
     import jax
     import jax.numpy as jnp
 
@@ -121,7 +122,11 @@ def worker_main():
     dtype_env = os.environ.get("LUX_BENCH_DTYPE")
     dtype = dtype_env or "float32"
     g = generate.rmat(scale, ef, seed=0)
-    shards = build_pull_shards(g, 1)
+    # LUX_BENCH_SORT_SEGMENTS=1: A/B the gather-locality relayout
+    # (docs/PERF.md gather-amplification band); pagerank metric names
+    # gain a _sortseg suffix so the two layouts never mix in _relay
+    sort_seg = os.environ.get("LUX_BENCH_SORT_SEGMENTS") == "1"
+    shards = build_pull_shards(g, 1, sort_segments=sort_seg)
     print(f"# worker: graph ready nv={g.nv} ne={g.ne}", file=sys.stderr, flush=True)
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     jax.block_until_ready(arrays)
@@ -203,6 +208,8 @@ def worker_main():
         suffix = "" if on_tpu else f"_{platform}_fallback"
         if dt == "bfloat16":
             suffix = "_bf16" + suffix
+        if sort_seg:
+            suffix = "_sortseg" + suffix
         print(
             f"# method {m} ({dt}): {elapsed:.4f}s -> {gteps:.4f} GTEPS",
             file=sys.stderr,
@@ -223,15 +230,17 @@ def worker_main():
             }
         )
 
-    def measure_scaleup(m):
+    def measure_scaleup(m, dt):
         """One pagerank line at scale+2 (4x the edges) on the winning
         method — distinguishes a dispatch-dominated small-graph number
         from a bandwidth-bound one (compare the two scales'
-        achieved_GBps; docs/PERF.md roofline)."""
+        achieved_GBps; docs/PERF.md roofline).  Same layout (sort_seg)
+        and suffix composition as the headline so the cross-scale
+        comparison is like-for-like."""
         s2 = scale + 2
         g2 = generate.rmat(s2, ef, seed=0)
-        sh2 = build_pull_shards(g2, 1)
-        prog2 = PageRankProgram(nv=sh2.spec.nv, dtype=dtype)
+        sh2 = build_pull_shards(g2, 1, sort_segments=sort_seg)
+        prog2 = PageRankProgram(nv=sh2.spec.nv, dtype=dt)
         arr2 = jax.tree.map(jnp.asarray, sh2.arrays)
         s0 = pull.init_state(prog2, arr2)
 
@@ -240,17 +249,22 @@ def worker_main():
 
         elapsed, _ = fetch_timed(run)
         gteps = iters * g2.ne / elapsed / 1e9
+        suffix = "" if on_tpu else f"_{platform}_fallback"
+        if dt == "bfloat16":
+            suffix = "_bf16" + suffix
+        if sort_seg:
+            suffix = "_sortseg" + suffix
         model = roofline.pull_iter_model(
-            g2.ne, g2.nv, m, state_bytes=2 if dtype == "bfloat16" else 4
+            g2.ne, g2.nv, m, state_bytes=2 if dt == "bfloat16" else 4
         ).scale(iters)
         _emit(
             {
-                "metric": f"pagerank_gteps_rmat{s2}_1chip",
+                "metric": f"pagerank_gteps_rmat{s2}_1chip{suffix}",
                 "value": round(gteps, 4),
                 "unit": "GTEPS",
                 "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
                 "method": m,
-                "dtype": dtype,
+                "dtype": dt,
                 # pass-through marker: _relay must not let this line
                 # compete with (and hijack) the rmat{scale} headline
                 "scale_up": True,
@@ -493,9 +507,17 @@ def worker_main():
         tpu_budget = int(os.environ.get("LUX_BENCH_TPU_S", "600"))
         if time.monotonic() - t_worker0 < 0.5 * tpu_budget:
             try:
-                measure_scaleup(
-                    min(results.items(), key=lambda kv: kv[1])[0][0]
-                )
+                from lux_tpu.engine.methods import CONCRETE
+
+                # run_pull_fixed needs a segment-reduce method; a pallas
+                # race winner (separate runner) falls back to the best
+                # concrete method, like the colfilter block does
+                concrete = {
+                    k: v for k, v in results.items() if k[0] in CONCRETE
+                }
+                if concrete:
+                    m_up, dt_up = min(concrete, key=concrete.get)
+                    measure_scaleup(m_up, dt_up)
             except Exception as e:  # noqa: BLE001
                 print(f"# scale-up failed: {e}", file=sys.stderr, flush=True)
         else:

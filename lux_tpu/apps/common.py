@@ -165,6 +165,15 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             "--exchange ring/scatter supports --method scan or scatter "
             "(bucketed reductions carry no row_ptr for prefix-diff reduces)"
         )
+    if cfg.sort_segments and (
+        cfg.exchange != "allgather" or cfg.edge_shards > 1
+        or cfg.method == "pallas"
+    ):
+        raise SystemExit(
+            "--sort-segments relays out the allgather pull layout; the "
+            "bucket (ring/scatter/edge2d) and block-CSR (pallas) layouts "
+            "have their own edge orders"
+        )
     if cfg.exchange == "scatter":
         if prog.reduce != "sum" or getattr(prog, "needs_dst_state", False):
             raise SystemExit(
@@ -184,7 +193,9 @@ def build_exchange_shards(g: HostGraph, cfg: RunConfig):
 
         return build_edge2d_shards(g, cfg.num_parts, cfg.edge_shards)
     if cfg.exchange == "allgather":
-        return build_pull_shards(g, cfg.num_parts)
+        return build_pull_shards(
+            g, cfg.num_parts, sort_segments=cfg.sort_segments
+        )
     if not cfg.distributed:
         raise SystemExit(f"--exchange {cfg.exchange} requires --distributed")
     if cfg.exchange == "ring":

@@ -222,17 +222,44 @@ def fill_part(
         arrays.weights[i, :m] = np.asarray(w, np.float32)
 
 
+def sort_segments_inplace(arrays: ShardArrays) -> None:
+    """Reorder edges WITHIN each destination segment by ascending gather
+    index (src_pos) — the gather-locality relayout.
+
+    Every shipped combiner is commutative, so per-segment order is
+    semantically free (float sums round differently than the unsorted
+    layout, but the relayout is a fixed deterministic choice — reruns
+    stay bitwise identical; tests/test_determinism.py).  The payoff is
+    on TPU: `state[src_pos]` is the roofline's dominant unknown
+    (docs/PERF.md gather-amplification band), and ascending in-segment
+    gather indices cluster hub sources so consecutive reads hit the
+    same HBM tiles.  The reference cannot reorder this way — its
+    atomicAdd scatter order is already arbitrary (pr_kernel,
+    pagerank_gpu.cu:86-95); here the relayout is explicit and testable.
+
+    Only src_pos and weights move: the lexsort's primary key is
+    dst_local, so the dst sequence (and with it row_ptr, head_flag,
+    edge_mask, and the padding tail at dst_local == V) is unchanged.
+    """
+    for r in range(arrays.src_pos.shape[0]):
+        order = np.lexsort((arrays.src_pos[r], arrays.dst_local[r]))
+        arrays.src_pos[r] = arrays.src_pos[r][order]
+        arrays.weights[r] = arrays.weights[r][order]
+
+
 def build_pull_shards(
     g: HostGraph,
     num_parts: int,
     degrees: Optional[np.ndarray] = None,
     cuts: Optional[np.ndarray] = None,
+    sort_segments: bool = False,
 ) -> PullShards:
     """Partition + pad a HostGraph into device-ready pull-model shards.
 
     ``cuts`` (optional (P+1,) bounds) selects a custom contiguous
     partition — used by dynamic repartitioning to rebalance on measured
-    work instead of static in-degree."""
+    work instead of static in-degree.  ``sort_segments`` applies the
+    gather-locality relayout (sort_segments_inplace)."""
     cuts, nv_pad, e_pad = shard_geometry(g.row_ptr, num_parts, g.nv, cuts)
     if degrees is None:
         degrees = g.out_degrees()
@@ -247,6 +274,8 @@ def build_pull_shards(
             None if g.weights is None else g.weights[elo:ehi],
             cuts, nv_pad, g.nv, degrees[vlo:vhi],
         )
+    if sort_segments:
+        sort_segments_inplace(arrays)
     spec = ShardSpec(
         num_parts=num_parts,
         nv=g.nv,

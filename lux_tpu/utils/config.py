@@ -45,6 +45,9 @@ class RunConfig:
     #: chips, partial reductions psum'd (for parts too big for one chip)
     edge_shards: int = 1
     feat_shards: int = 1
+    #: gather-locality relayout: sort edges within each destination
+    #: segment by src_pos (graph/shards.sort_segments_inplace)
+    sort_segments: bool = False
     #: >0 = adaptive dynamic repartitioning (push apps): every N iterations
     #: rebalance the vertex cuts from the measured per-part load (the Lux
     #: paper's runtime repartitioning, absent from the reference code)
@@ -104,6 +107,12 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                         help="split the latent feature dim over N chips "
                              "(2-D parts x feat mesh, CF only; total "
                              "chips = num_parts * N)")
+        ap.add_argument("--sort-segments", action="store_true",
+                        help="reorder edges within each destination "
+                             "segment by gather index (HBM gather "
+                             "locality; commutative reduces only — "
+                             "semantically free, float sums round "
+                             "differently than the unsorted layout)")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],
@@ -141,6 +150,7 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         dtype=getattr(ns, "dtype", "float32"),
         edge_shards=getattr(ns, "edge_shards", 1),
         feat_shards=getattr(ns, "feat_shards", 1),
+        sort_segments=getattr(ns, "sort_segments", False),
         repartition_every=getattr(ns, "repartition_every", 0),
         repartition_threshold=getattr(ns, "repartition_threshold", 1.25),
     )

@@ -143,7 +143,8 @@ def test_bench_worker_scaleup_line():
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [json.loads(s) for s in r.stdout.strip().splitlines()
              if s.startswith("{")]
-    up = [ln for ln in lines if ln["metric"] == "pagerank_gteps_rmat11_1chip"]
+    up = [ln for ln in lines
+          if ln["metric"] == "pagerank_gteps_rmat11_1chip_cpu_fallback"]
     assert up, [ln["metric"] for ln in lines]
     assert up[0]["achieved_GBps"] > 0 and up[0]["bytes_per_edge"] > 0
     # budget-half-spent gate: no scale-up line
@@ -153,7 +154,7 @@ def test_bench_worker_scaleup_line():
         env=env, capture_output=True, text=True, timeout=420, cwd="/tmp",
     )
     assert "scale-up skipped" in r2.stderr
-    assert "rmat11_1chip" not in r2.stdout
+    assert "rmat11_1chip" not in r2.stdout  # (any suffix)
 
 
 def test_relay_passes_scaleup_without_hijacking_headline(tmp_path, capsys):

@@ -49,6 +49,12 @@ LUX_BENCH_WATCHDOG_S=3600 LUX_BENCH_TPU_S=3300 \
   LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
   run bench_race 3700 python bench.py
 
+# 2b) gather-locality A/B: the same component battery on the
+#     sort-segments relayout — the roofline's gather-amplification lever
+#     (docs/PERF.md); compare the gather/spmv rows against step 0
+run probe_sortseg 3600 python tools/tpu_component_probe.py \
+    --scale 20 --ef 16 --reps 1 4 16 --sort-segments
+
 # 3) single-chip HBM ceiling vs preflight (VERDICT r1 #7)
 run scale_check 5400 python tools/tpu_scale_check.py --min-scale 18 --max-scale 24
 

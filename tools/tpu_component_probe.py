@@ -47,6 +47,11 @@ def main(argv=None):
     ap.add_argument("--reps", type=int, nargs="+", default=[1, 4, 16])
     ap.add_argument("--skip", nargs="*", default=[],
                     help="component names to skip")
+    ap.add_argument("--sort-segments", action="store_true",
+                    help="apply the gather-locality relayout (sort edges "
+                         "within each dst segment by gather index) before "
+                         "probing — measures the docs/PERF.md "
+                         "gather-amplification lever")
     args = ap.parse_args(argv)
 
     import jax
@@ -63,7 +68,13 @@ def main(argv=None):
 
     rng = np.random.default_rng(0)
     state = jnp.asarray(rng.random(g.nv, np.float32))
-    src_pos = jnp.asarray(g.col_idx.astype(np.int32))
+    col = np.asarray(g.col_idx)
+    if args.sort_segments:
+        # dst sequence is the lexsort's primary key, so only the gather
+        # indices move (graph/shards.sort_segments_inplace semantics)
+        col = col[np.lexsort((col, g.dst_of_edges()))]
+        print("# layout: sort-segments (gather-locality)", flush=True)
+    src_pos = jnp.asarray(col.astype(np.int32))
     row_ptr = jnp.asarray(g.row_ptr.astype(np.int32))
     head = np.zeros(g.ne, np.int32)
     head[g.row_ptr[:-1][g.row_ptr[:-1] < g.ne]] = 1
