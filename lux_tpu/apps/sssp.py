@@ -22,6 +22,14 @@ from lux_tpu.utils.timing import IterStats, Timer, report_elapsed
 def build_push_app_shards(g, cfg):
     """Push shards for the selected dense-round --exchange strategy (or
     the block-CSR layout when the dense rounds run the Pallas kernel)."""
+    if cfg.sort_segments and (
+        cfg.exchange != "allgather" or cfg.method == "pallas"
+    ):
+        raise SystemExit(
+            "--sort-segments relays out the allgather dense-round pull "
+            "layout; the ring-bucket and block-CSR (pallas) layouts have "
+            "their own edge orders"
+        )
     if cfg.method == "pallas":
         if cfg.exchange != "allgather":
             raise SystemExit(
@@ -43,7 +51,9 @@ def build_push_app_shards(g, cfg):
         from lux_tpu.parallel.ring import build_push_ring_shards
 
         return build_push_ring_shards(g, cfg.num_parts)
-    return build_push_shards(g, cfg.num_parts)
+    return build_push_shards(
+        g, cfg.num_parts, sort_segments=cfg.sort_segments
+    )
 
 
 def _save_frontier_ckpt(cfg, name, shards, carry):
@@ -193,6 +203,7 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 threshold=cfg.repartition_threshold,
                 max_iters=cfg.max_iters, method=cfg.method, mesh=mesh,
                 on_repartition=note, shards=shards, exchange=cfg.exchange,
+                sort_segments=cfg.sort_segments,
             )
             state, iters, edges = res.stacked, res.iters, res.edges
             shards = res.shards

@@ -204,6 +204,7 @@ def run_push_adaptive(
     on_repartition=None,
     shards=None,
     exchange: str = "allgather",
+    sort_segments: bool = False,
 ):
     """Direction-optimized push with window-based dynamic repartitioning.
 
@@ -231,13 +232,21 @@ def run_push_adaptive(
         raise ValueError(f"unsupported exchange {exchange!r}")
     if exchange == "ring" and mesh is None:
         raise ValueError("exchange='ring' needs a mesh")
+    if sort_segments and exchange != "allgather":
+        raise ValueError(
+            "sort_segments relays out the allgather dense-round layout; "
+            "the ring bucket layout has its own edge order"
+        )
 
     def build(cuts=None):
         if exchange == "ring":
             from lux_tpu.parallel.ring import build_push_ring_shards
 
             return build_push_ring_shards(g, num_parts, cuts=cuts)
-        return build_push_shards(g, num_parts, cuts=cuts)
+        # recuts keep the caller's gather-locality relayout choice
+        return build_push_shards(
+            g, num_parts, cuts=cuts, sort_segments=sort_segments
+        )
 
     if shards is None:
         shards = build()

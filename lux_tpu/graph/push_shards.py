@@ -94,8 +94,15 @@ def build_push_shards(
     f_cap: Optional[int] = None,
     e_sp: Optional[int] = None,
     cuts: Optional[np.ndarray] = None,
+    sort_segments: bool = False,
 ) -> PushShards:
-    pull = build_pull_shards(g, num_parts, cuts=cuts)
+    # sort_segments: gather-locality relayout of the embedded pull
+    # layout — the push engine's DENSE rounds gather full[src_pos]
+    # exactly like the pull engine (min/max relaxation is order-free,
+    # so this is bitwise-invariant for the frontier apps)
+    pull = build_pull_shards(
+        g, num_parts, cuts=cuts, sort_segments=sort_segments
+    )
     spec = pull.spec
     P, e_pad, nv_pad = num_parts, spec.e_pad, spec.nv_pad
     cuts = pull.cuts

@@ -123,6 +123,11 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         ap.add_argument("--repartition-threshold", type=float, default=1.25,
                         help="recut when the window's max/mean per-part "
                              "load exceeds this ratio")
+        ap.add_argument("--sort-segments", action="store_true",
+                        help="reorder the dense-round pull layout's edges "
+                             "within each destination segment by gather "
+                             "index (HBM gather locality; bitwise-free "
+                             "for min/max relaxation)")
     if sssp:
         ap.add_argument("--weighted", action="store_true",
                         help="relax with edge weights (Dijkstra-style)")

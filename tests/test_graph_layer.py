@@ -210,3 +210,22 @@ def test_sort_segments_cli(capsys):
     with pytest.raises(SystemExit, match="sort-segments"):
         pr_app.main(args + ["--sort-segments", "-ng", "8", "--distributed",
                             "--exchange", "ring"])
+
+
+def test_sort_segments_push_bitwise():
+    """Push apps (min/max relaxation) are BITWISE invariant under the
+    relayout — sssp distances identical, sorted vs not, incl. -check."""
+    from lux_tpu.apps import sssp as sssp_app
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.models.sssp import sssp
+
+    g = generate.rmat(10, 8, seed=79)
+    plain = sssp(build_push_shards(g, 4), start=1)
+    sorted_ = sssp(build_push_shards(g, 4, sort_segments=True), start=1)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(sorted_))
+    args = ["--rmat-scale", "9", "--rmat-ef", "4", "-start", "1", "-check"]
+    assert sssp_app.main(args + ["--sort-segments"]) == 0
+    with pytest.raises(SystemExit, match="sort-segments"):
+        sssp_app.main(args + ["--sort-segments", "--method", "pallas",
+                              "-ng", "2", "--distributed"])
