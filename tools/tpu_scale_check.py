@@ -59,6 +59,9 @@ def child(scale: int, ef: int, iters: int, method: str) -> int:
     per_iter = max((tn - t1) / max(iters - 1, 1), 1e-9)
     dt = per_iter * iters
     stats = jax.devices()[0].memory_stats() or {}
+    from lux_tpu.utils import roofline
+
+    model = roofline.pull_iter_model(g.ne, g.nv, method).scale(iters)
     print(
         json.dumps(
             {
@@ -68,6 +71,10 @@ def child(scale: int, ef: int, iters: int, method: str) -> int:
                 "peak_bytes": stats.get("peak_bytes_in_use", 0),
                 "limit_bytes": stats.get("bytes_limit", 0),
                 "gteps": iters * g.ne / dt / 1e9,
+                # flat achieved_GBps across scales = bandwidth-bound;
+                # rising with scale = the small sizes were
+                # dispatch-dominated (docs/PERF.md roofline)
+                **roofline.summarize(model, dt, iters * g.ne),
             }
         ),
         flush=True,
@@ -108,12 +115,18 @@ def main(argv=None):
               f"peak {d['peak_bytes']/2**30:.2f} GiB, "
               f"{d['gteps']:.3f} GTEPS", flush=True)
 
-    print("\n| scale | ne | preflight est | device peak | GTEPS |")
-    print("|---|---|---|---|---|")
+    print("\n| scale | ne | preflight est | device peak | GTEPS | GB/s |")
+    print("|---|---|---|---|---|---|")
     for d in rows:
         print(f"| 2^{d['scale']} | {d['ne']:,} | "
               f"{d['est_bytes']/2**30:.2f} GiB | "
-              f"{d['peak_bytes']/2**30:.2f} GiB | {d['gteps']:.3f} |")
+              f"{d['peak_bytes']/2**30:.2f} GiB | {d['gteps']:.3f} | "
+              f"{d.get('achieved_GBps', 0):.2f} |")
+    print("# flat GB/s across scales = bandwidth-bound; rising = small "
+          "sizes dispatch-dominated (docs/PERF.md roofline)", flush=True)
+    # raw rows for the chip-day artifact
+    for d in rows:
+        print(json.dumps(d), flush=True)
     return 0
 
 
