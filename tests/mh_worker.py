@@ -137,17 +137,56 @@ if mode == "push":
 
 shards = build_pull_shards(g, P)
 prog = PageRankProgram(nv=shards.spec.nv)
-# host-sharded load: this host materializes only its own parts
 mine = list(mh.local_part_range(P))
 assert len(mine) == 4
+
+# host-sharded FILE load: every process reads ONLY its parts' byte
+# ranges from the SHARED .lux — the reference's per-node partial reads
+# (pull_load_task_impl, core/pull_model.inl:253-320) across real OS
+# processes.  Process 0 publishes the file atomically; the graph is
+# deterministic so a pre-existing file from an earlier run is identical.
+import time as _time
+
+from lux_tpu.graph import format as fmt
+from lux_tpu.graph import sharded_load
+
+import hashlib
+
+# content-keyed path: a layout/generator change produces a new file
+# instead of poisoning runs with a stale cache
+tag = hashlib.md5(
+    np.ascontiguousarray(g.col_idx).tobytes()
+    + np.ascontiguousarray(g.row_ptr).tobytes()
+).hexdigest()[:10]
+lux_path = f"/tmp/lux_mh_pull_{tag}_{nproc}.lux"
+if pid == 0 and not os.path.exists(lux_path):
+    tmp = f"{lux_path}.tmp{os.getpid()}"
+    fmt.write_lux(tmp, g)
+    os.replace(tmp, lux_path)
+for _ in range(150):
+    if os.path.exists(lux_path):
+        break
+    _time.sleep(0.2)
+else:
+    raise AssertionError(
+        f"timed out waiting for pid 0 to publish {lux_path}"
+    )
+pull_local = sharded_load.load_pull_shards(lux_path, P, parts_subset=mine)
+# the streamed subset must equal the in-memory build's same-part rows
+for name in pull_local.arrays._fields:
+    np.testing.assert_array_equal(
+        getattr(pull_local.arrays, name),
+        getattr(shards.arrays, name)[mine], err_msg=name,
+    )
 state0_local = np.stack([
     np.asarray(prog.init_state(
-        shards.arrays.global_vid[p], shards.arrays.degree[p], shards.arrays.vtx_mask[p]
-    )) for p in mine
+        pull_local.arrays.global_vid[i], pull_local.arrays.degree[i],
+        pull_local.arrays.vtx_mask[i],
+    )) for i in range(len(mine))
 ])
 state0 = mh.assemble_global(mesh, state0_local, P)
 arrays = jax.tree.map(
-    lambda a: mh.assemble_global(mesh, a[mine], P), shards.arrays
+    lambda a: mh.assemble_global(mesh, a, P), pull_local.arrays
 )
 out = dist.run_pull_fixed_dist(prog, shards.spec, arrays, state0, 5, mesh)
 import functools

@@ -76,3 +76,45 @@ def test_pallas_dist_bitwise_deterministic():
     ca = pd.run_cf_pallas_dist(cprog, ppw, cs0, 5, mesh, interpret=True)
     cb = pd.run_cf_pallas_dist(cprog, ppw, cs0, 5, mesh, interpret=True)
     assert bits(ca) == bits(cb)
+
+
+def test_sorted_layout_bitwise_deterministic():
+    """The sort-segments relayout is a FIXED deterministic ordering:
+    reruns on the sorted layout are bitwise identical (the float sums
+    may differ from the unsorted layout — that is a layout choice, not
+    nondeterminism)."""
+    import jax
+
+    from lux_tpu.engine import pull
+    from lux_tpu.graph.shards import build_pull_shards
+
+    g = generate.rmat(9, 8, seed=104)
+    outs = []
+    for _ in range(2):
+        sh = build_pull_shards(g, 2, sort_segments=True)
+        prog = pr.PageRankProgram(nv=sh.spec.nv)
+        arr = jax.tree.map(np.asarray, sh.arrays)
+        s0 = pull.init_state(prog, arr)
+        outs.append(
+            sh.scatter_to_global(
+                np.asarray(pull.run_pull_fixed(prog, sh.spec, arr, s0, 6))
+            )
+        )
+    assert bits(outs[0]) == bits(outs[1])
+
+
+def test_scatter_k_resident_bitwise_deterministic():
+    """k-resident reduce_scatter (lane pre-sum + tiled psum_scatter):
+    bitwise-identical reruns — the collective's reduction order is fixed
+    by the mesh, not by a race."""
+    from lux_tpu.engine import pull
+    from lux_tpu.parallel import scatter as scatter_mod
+
+    g = generate.rmat(9, 8, seed=105)
+    mesh = mesh_lib.make_mesh(8)
+    ss = scatter_mod.build_scatter_shards(g, 16)
+    prog = pr.PageRankProgram(nv=ss.spec.nv)
+    s0 = pull.init_state(prog, ss.pull.arrays)
+    a = scatter_mod.run_pull_fixed_scatter(prog, ss, s0, 6, mesh)
+    b = scatter_mod.run_pull_fixed_scatter(prog, ss, s0, 6, mesh)
+    assert bits(np.asarray(a)) == bits(np.asarray(b))
