@@ -37,17 +37,20 @@ run probe_components 5400 python tools/tpu_component_probe.py \
     echo "tunnel dead (no component rows) — aborting battery"; exit 1; }
 }
 
-# 1) Mosaic compile check + tile sweep (VERDICT r1 #3)
-run pallas_sweep 5400 python tools/tpu_pallas_check.py --scale 18 --sweep
-
-# 2) the driver-format bench race (scatter/cumsum/mxsum/pallas + bf16,
-#    scan quarantined last; partial results harvested either way).
+# 1) the driver-format bench race FIRST after the gate (VERDICT r3 #1:
+#    the no-suffix TPU datapoint is the top ask — a short window must
+#    bank it before the long Pallas sweep).  scatter/cumsum/mxsum/pallas
+#    + bf16 + the scale-up line; scan quarantined last; partial results
+#    harvested either way.
 #    LUX_PEAK_GBPS: the tunnel hides the chip model; 819 GB/s (v5e-class
 #    spec) makes frac_bw_roof a lower-bound honesty figure — rescale
 #    against docs/PERF.md's roofline table if the chip is bigger.
 LUX_BENCH_WATCHDOG_S=3600 LUX_BENCH_TPU_S=3300 \
   LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
   run bench_race 3700 python bench.py
+
+# 2) Mosaic compile check + tile sweep (VERDICT r1 #3)
+run pallas_sweep 5400 python tools/tpu_pallas_check.py --scale 18 --sweep
 
 # 2b) gather-locality A/B: the same component battery on the
 #     sort-segments relayout — the roofline's gather-amplification lever
