@@ -108,3 +108,64 @@ def test_pallas_pagerank_bf16():
     a = np.asarray(run32(s32, 3))[: g.nv]
     b = np.asarray(run16(s16, 3)).astype(np.float32)[: g.nv]
     np.testing.assert_allclose(b, a, rtol=2e-2, atol=1e-5)
+
+
+@pytest.mark.parametrize("v_blk,t_chunk", [
+    (128, 256), (256, 128), (512, 512), (128, 1024), (256, 512),
+])
+@pytest.mark.parametrize("op", ["sum", "min"])
+def test_spmv_tile_shape_sweep(v_blk, t_chunk, op):
+    """The exact (v_blk, t_chunk) grid the chip battery sweeps
+    (tpu_pallas_check --sweep), semantics-validated in interpret mode so
+    a Mosaic run can only differ by lowering, never by math.  Graph
+    includes a hub vertex and empty rows (the power-law shapes of
+    SURVEY.md §7.3)."""
+    g = generate.rmat(9, 4, seed=84)
+    bc = ps.build_blockcsr(g, v_blk=v_blk, t_chunk=t_chunk)
+    rng = np.random.default_rng(85)
+    state = rng.random(g.nv).astype(np.float32)
+    vals = state[bc.e_src_pos]
+    out = ps.spmv_blockcsr(
+        jnp.asarray(vals), jnp.asarray(bc.e_dst_rel),
+        jnp.asarray(bc.chunk_block), jnp.asarray(bc.chunk_first),
+        op=op, v_blk=bc.v_blk, num_vblocks=bc.num_vblocks, interpret=True,
+    )
+    neutral = {"sum": 0.0, "min": np.inf}[op]
+    expect = np.full(bc.num_vblocks * bc.v_blk, neutral, np.float32)
+    dst = g.dst_of_edges()
+    np_fn = {"sum": "add", "min": "minimum"}[op]
+    getattr(np, np_fn).at(expect, dst, state[g.col_idx])
+    np.testing.assert_allclose(
+        np.asarray(out)[: g.nv], expect[: g.nv], rtol=2e-5
+    )
+
+
+def test_spmv_hub_and_empty_rows():
+    """Degenerate shapes: one vertex owning most in-edges (a chunk run
+    crossing many T boundaries) and zero-degree vertices — the ragged
+    cases the reference's block-scan trick handles (SURVEY.md §7.3)."""
+    nv = 300
+    src = np.concatenate([
+        np.arange(250, dtype=np.int64),          # hub: 250 edges -> v7
+        np.array([1, 2, 3], dtype=np.int64),     # a few scattered edges
+    ])
+    dst = np.concatenate([
+        np.full(250, 7, dtype=np.int64),
+        np.array([100, 100, 299], dtype=np.int64),
+    ])
+    from lux_tpu.graph.csc import from_edge_list
+
+    g = from_edge_list(src, dst, nv)
+    bc = ps.build_blockcsr(g, v_blk=128, t_chunk=128)
+    state = np.arange(1, nv + 1, dtype=np.float32)
+    vals = state[bc.e_src_pos]
+    out = ps.spmv_blockcsr(
+        jnp.asarray(vals), jnp.asarray(bc.e_dst_rel),
+        jnp.asarray(bc.chunk_block), jnp.asarray(bc.chunk_first),
+        op="sum", v_blk=bc.v_blk, num_vblocks=bc.num_vblocks,
+        interpret=True,
+    )
+    expect = np.zeros(bc.num_vblocks * bc.v_blk, np.float32)
+    np.add.at(expect, g.dst_of_edges(), state[g.col_idx])
+    np.testing.assert_allclose(np.asarray(out)[:nv], expect[:nv], rtol=2e-5)
+    assert expect[7] == state[:250].sum()  # the hub really crossed chunks
