@@ -160,3 +160,56 @@ def test_fuzz_frontier_ckpt_elastic(seed, tmp_path):
         sh1.scatter_to_global(np.asarray(want_st)),
     )
     assert push.edges_total(e) == push.edges_total(want_e)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:3])
+def test_fuzz_all_pull_exchanges_agree(seed):
+    """One random graph through EVERY pull exchange layout — allgather
+    (random k residency + random sort-segments relayout), ring,
+    reduce_scatter, and the 2-D edge-sharded mesh — all within float
+    tolerance of the host oracle, hence of each other."""
+    import jax
+
+    from lux_tpu.engine import pull
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.parallel import dist, edge2d, ring, scatter
+    from lux_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(seed + 4000)
+    scale = int(rng.integers(7, 10))
+    ef = int(rng.integers(2, 8))
+    P = int(rng.choice([8, 16]))  # k = 1 or 2 on the 8-device mesh
+    iters = int(rng.integers(2, 6))
+    g = generate.rmat(scale, ef, seed=seed)
+    want = pr.pagerank_reference(g, iters)
+    mesh = make_mesh(8)
+
+    sh = build_pull_shards(g, P, sort_segments=bool(rng.integers(2)))
+    prog = pr.PageRankProgram(nv=sh.spec.nv)
+    s0 = pull.init_state(prog, sh.arrays)
+    outs = {
+        "allgather": sh.scatter_to_global(np.asarray(
+            dist.run_pull_fixed_dist(prog, sh.spec, sh.arrays, s0, iters, mesh)
+        )),
+    }
+    rs = ring.build_ring_shards(g, P, pull=sh)
+    outs["ring"] = rs.scatter_to_global(np.asarray(
+        ring.run_pull_fixed_ring(prog, rs, pull.init_state(prog, sh.arrays),
+                                 iters, mesh)
+    ))
+    ss = scatter.build_scatter_shards(g, P, pull=sh)
+    outs["scatter"] = ss.scatter_to_global(np.asarray(
+        scatter.run_pull_fixed_scatter(
+            prog, ss, pull.init_state(prog, sh.arrays), iters, mesh
+        )
+    ))
+    e2 = edge2d.build_edge2d_shards(g, 4, 2)
+    p2 = pr.PageRankProgram(nv=e2.spec.nv)
+    outs["edge2d"] = e2.scatter_to_global(np.asarray(
+        edge2d.run_pull_fixed_2d(
+            p2, e2, pull.init_state(p2, e2.arrays), iters,
+            edge2d.make_mesh2d(4, 2),
+        )
+    ))
+    for name, got in outs.items():
+        np.testing.assert_allclose(got, want, rtol=5e-5, err_msg=name)
