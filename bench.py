@@ -541,8 +541,6 @@ def _record_winner(results):
     .lux_winners.json) — an unattended chip window updates the default
     without a code edit.  Only the sum row: the race is PageRank; min/max
     rows change via the chip battery + PERF.md."""
-    from lux_tpu.engine.methods import WINNERS_FILE
-
     f32 = {m: t for (m, dt), t in results.items() if dt == "float32"}
     if not f32:
         return
@@ -562,24 +560,9 @@ def _record_winner(results):
             f"row + explicit --method {overall} for allgather runs",
             file=sys.stderr, flush=True,
         )
-    try:
-        prev = {}
-        if os.path.exists(WINNERS_FILE):
-            with open(WINNERS_FILE) as f:
-                prev = json.load(f)
-        if not isinstance(prev, dict):
-            prev = {}
-        prev["tpu:sum"] = best
-        tmp = WINNERS_FILE + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(prev, f, indent=1)
-        os.replace(tmp, WINNERS_FILE)
-        print(f"# recorded tpu:sum winner -> {best} ({WINNERS_FILE})",
-              file=sys.stderr, flush=True)
-    except (OSError, ValueError) as e:
-        # a corrupt existing file must not fail an otherwise-complete run
-        print(f"# winners file not written: {e}", file=sys.stderr,
-              flush=True)
+    from lux_tpu.engine.methods import record_overlay_entry
+
+    record_overlay_entry("tpu:sum", best)
 
 
 def _spawn_worker(env, out_path, nice=0):

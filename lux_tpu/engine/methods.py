@@ -54,37 +54,110 @@ WINNERS_FILE = os.path.join(
     ".lux_winners.json",
 )
 
+_overlay_raw_cache: dict | None = None
 _file_winners_cache: dict | None = None
 _platform_cache: str | None = None
 
 
-def _file_winners() -> dict:
-    """The overlay winners, loaded once per process.  Malformed files and
-    non-CONCRETE entries are ignored (a half-written file must never
-    break every driver)."""
-    global _file_winners_cache
-    if _file_winners_cache is None:
-        path = os.environ.get("LUX_METHOD_WINNERS", WINNERS_FILE)
-        winners = {}
+def overlay_path() -> str:
+    """The measured-winners overlay path (LUX_METHOD_WINNERS override) —
+    shared by every reader AND writer so a recorded measurement always
+    lands where the readers look."""
+    return os.environ.get("LUX_METHOD_WINNERS", WINNERS_FILE)
+
+
+def _overlay_raw() -> dict:
+    """The overlay file as a raw dict, loaded once per process; malformed
+    or missing files read as empty (a half-written file must never break
+    every driver)."""
+    global _overlay_raw_cache
+    if _overlay_raw_cache is None:
+        raw: dict = {}
         try:
             import json
 
-            with open(path) as f:
-                raw = json.load(f)
-            if not isinstance(raw, dict):
-                raw = {}
-            for key, val in raw.items():
-                plat, _, red = str(key).partition(":")
-                # blanket defaults must hold on EVERY engine path: the
-                # bucketed (row_ptr-free) ring/edge2d layouts only run
-                # scan/scatter, and cumsum/mxsum are sum-only anyway —
-                # so the overlay is restricted exactly like WINNERS
-                if plat and red and val in ("scan", "scatter"):
-                    winners[(plat, red)] = val
+            with open(overlay_path()) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict):
+                raw = loaded
         except (OSError, ValueError):
             pass
+        _overlay_raw_cache = raw
+    return _overlay_raw_cache
+
+
+def record_overlay_entry(key: str, value) -> None:
+    """Atomic read-modify-write of ONE overlay entry — the single writer
+    for unattended chip measurements (bench.py's method winner, the
+    Pallas sweep's tile winner).  A corrupt existing file is replaced,
+    not fatal: readers already treat it as empty, and losing a chip
+    window's measurement to a bad old file would be strictly worse."""
+    import json
+
+    path = overlay_path()
+    try:
+        prev = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    prev = json.load(f)
+            except ValueError:
+                prev = {}  # corrupt: start fresh rather than drop the win
+        if not isinstance(prev, dict):
+            prev = {}
+        prev[key] = value
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(prev, f, indent=1)
+        os.replace(tmp, path)
+        print(f"# recorded {key} -> {value!r} ({path})", flush=True)
+    except OSError as e:
+        print(f"# winners file not written: {e}", flush=True)
+
+
+def _file_winners() -> dict:
+    """The method-winner view of the overlay.  Non-CONCRETE entries are
+    ignored; blanket defaults must hold on EVERY engine path (the
+    bucketed row_ptr-free ring/edge2d layouts only run scan/scatter, and
+    cumsum/mxsum are sum-only anyway), so the overlay is restricted
+    exactly like WINNERS."""
+    global _file_winners_cache
+    if _file_winners_cache is None:
+        winners = {}
+        for key, val in _overlay_raw().items():
+            plat, _, red = str(key).partition(":")
+            if plat and red and val in ("scan", "scatter"):
+                winners[(plat, red)] = val
         _file_winners_cache = winners
     return _file_winners_cache
+
+
+_tiles_cache: tuple | None = None
+
+
+def pallas_tiles() -> tuple | None:
+    """Measured (v_blk, t_chunk) Pallas tile winner from the overlay
+    (key ``"tpu:pallas_tiles"``, written by an unattended
+    `tools/tpu_pallas_check --sweep`); None while unmeasured — the
+    kernels then use their compiled-in defaults (ops/pallas_spmv
+    V_BLK/T_CHUNK).  Malformed entries are ignored, and v_blk must keep
+    the 128-lane alignment the kernel grid assumes."""
+    global _tiles_cache
+    if _tiles_cache is None:
+        tiles: tuple = ()
+        t = _overlay_raw().get("tpu:pallas_tiles")
+        if (
+            isinstance(t, dict)
+            and isinstance(t.get("v_blk"), int)
+            and 0 < t["v_blk"] <= 4096 and t["v_blk"] % 128 == 0
+            and isinstance(t.get("t_chunk"), int)
+            # sublane-aligned: the 2-D CF kernel's (1, t, k) BlockSpec
+            # requires t a multiple of 8 (ops/pallas_spmv.py)
+            and 0 < t["t_chunk"] <= 8192 and t["t_chunk"] % 8 == 0
+        ):
+            tiles = (t["v_blk"], t["t_chunk"])
+        _tiles_cache = tiles
+    return _tiles_cache or None
 
 
 def default_platform() -> str:

@@ -71,14 +71,27 @@ class BlockCSR:
 def build_blockcsr(
     g: HostGraph,
     src_pos: Optional[np.ndarray] = None,
-    v_blk: int = V_BLK,
-    t_chunk: int = T_CHUNK,
+    v_blk: Optional[int] = None,
+    t_chunk: Optional[int] = None,
 ) -> BlockCSR:
     """Re-lay out a CSC graph into chunk-aligned vertex blocks.
 
     ``src_pos`` defaults to the raw source ids (single-part layout); pass
     shard positions for the distributed gathered-state layout.
+    ``v_blk``/``t_chunk`` default to the MEASURED tile winner when the
+    chip sweep has recorded one (.lux_winners.json "tpu:pallas_tiles",
+    engine.methods.pallas_tiles), else the compiled-in V_BLK/T_CHUNK —
+    an unattended chip window updates every later build's tiles without
+    a code edit, like the method-winner overlay.
     """
+    if v_blk is None or t_chunk is None:
+        from lux_tpu.engine.methods import pallas_tiles
+
+        meas = pallas_tiles()
+        if v_blk is None:
+            v_blk = meas[0] if meas else V_BLK
+        if t_chunk is None:
+            t_chunk = meas[1] if meas else T_CHUNK
     if src_pos is None:
         src_pos = g.col_idx.astype(np.int32)
     num_vblocks = _round_up(g.nv, v_blk) // v_blk

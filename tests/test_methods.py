@@ -86,22 +86,30 @@ def test_winners_file_overlay(monkeypatch, tmp_path):
     path = tmp_path / "winners.json"
     path.write_text(json.dumps({"tpu:sum": "scatter", "tpu:min": "pallas"}))
     monkeypatch.setenv("LUX_METHOD_WINNERS", str(path))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
     monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
     assert methods.resolve("auto", "sum", platform="tpu") == "scatter"
     # "pallas" is not a safe blanket default: entry dropped
     assert methods.resolve("auto", "min", platform="tpu") == "scan"
     # untouched rows still come from the static table
     assert methods.resolve("auto", "sum", platform="cpu") == "scatter"
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
     monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
 
 
 def test_winners_file_malformed_is_noop(monkeypatch, tmp_path):
     path = tmp_path / "bad.json"
     path.write_text("{not json")
     monkeypatch.setenv("LUX_METHOD_WINNERS", str(path))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
     monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
     assert methods.resolve("auto", "sum", platform="tpu") == "scan"
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
     monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
 
 
 def test_winners_file_non_dict_and_sum_only_guard(monkeypatch, tmp_path):
@@ -111,14 +119,76 @@ def test_winners_file_non_dict_and_sum_only_guard(monkeypatch, tmp_path):
     bad = tmp_path / "list.json"
     bad.write_text(json.dumps(["tpu:sum"]))
     monkeypatch.setenv("LUX_METHOD_WINNERS", str(bad))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
     monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
     assert methods.resolve("auto", "sum", platform="tpu") == "scan"
     # prefix-diff strategies cannot become blanket defaults for ANY row
     # (the bucketed ring/edge2d layouts only run scan/scatter)
     mix = tmp_path / "mix.json"
     mix.write_text(json.dumps({"tpu:sum": "mxsum", "tpu:max": "scatter"}))
     monkeypatch.setenv("LUX_METHOD_WINNERS", str(mix))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
     monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
     assert methods.resolve("auto", "sum", platform="tpu") == "scan"
     assert methods.resolve("auto", "max", platform="tpu") == "scatter"
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
     monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
+
+
+def test_pallas_tiles_overlay(tmp_path, monkeypatch):
+    """The sweep-recorded tile winner flows into build_blockcsr defaults;
+    malformed/misaligned entries are ignored; explicit args always win."""
+    import json
+
+    import lux_tpu.engine.methods as methods
+    from lux_tpu.graph import generate
+    from lux_tpu.ops import pallas_spmv as ps
+
+    g = generate.rmat(8, 4, seed=90)
+    f = tmp_path / "w.json"
+    f.write_text(json.dumps(
+        {"tpu:pallas_tiles": {"v_blk": 256, "t_chunk": 1024}}
+    ))
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(f))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
+    assert methods.pallas_tiles() == (256, 1024)
+    bc = ps.build_blockcsr(g)
+    assert (bc.v_blk, bc.t_chunk) == (256, 1024)
+    # explicit args override the overlay
+    bc2 = ps.build_blockcsr(g, v_blk=128, t_chunk=128)
+    assert (bc2.v_blk, bc2.t_chunk) == (128, 128)
+    # misaligned v_blk (not a lane multiple) is ignored
+    f.write_text(json.dumps({"tpu:pallas_tiles": {"v_blk": 100,
+                                                  "t_chunk": 512}}))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
+    assert methods.pallas_tiles() is None
+    bc3 = ps.build_blockcsr(g)
+    assert (bc3.v_blk, bc3.t_chunk) == (ps.V_BLK, ps.T_CHUNK)
+
+
+def test_record_overlay_entry_survives_corrupt_file(monkeypatch, tmp_path):
+    """The single overlay writer replaces a corrupt file instead of
+    dropping an expensive chip measurement, honors LUX_METHOD_WINNERS,
+    and round-trips through the readers."""
+    import json
+
+    f = tmp_path / "w.json"
+    f.write_text("{ not json !!")
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(f))
+    methods.record_overlay_entry("tpu:sum", "scatter")
+    methods.record_overlay_entry(
+        "tpu:pallas_tiles", {"v_blk": 128, "t_chunk": 256}
+    )
+    saved = json.loads(f.read_text())
+    assert saved == {"tpu:sum": "scatter",
+                     "tpu:pallas_tiles": {"v_blk": 128, "t_chunk": 256}}
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
+    assert methods.resolve("auto", "sum", platform="tpu") == "scatter"
+    assert methods.pallas_tiles() == (128, 256)

@@ -4,8 +4,10 @@
 Run ON the TPU (default env): compiles every kernel with interpret=False,
 checks numerics against interpret=True (the CPU-validated reference), then
 sweeps (v_blk, t_chunk) on a PageRank iteration and prints a timing table.
-This is the hardware-proof step VERDICT r1 #3 asks for; keep the winning
-tile sizes in ops/pallas_spmv.py's V_BLK/T_CHUNK defaults.
+This is the hardware-proof step VERDICT r1 #3 asks for; the sweep winner
+is auto-recorded to the measured-winners overlay ("tpu:pallas_tiles" in
+.lux_winners.json) and becomes every later build_blockcsr's default —
+do NOT hand-edit ops/pallas_spmv.py's V_BLK/T_CHUNK constants.
 
 Usage:
     python tools/tpu_pallas_check.py [--scale 18] [--ef 16] [--sweep]
@@ -108,6 +110,15 @@ def main(argv=None):
     if rows:
         best = max(rows, key=lambda r: r[3])
         print(f"# best: v_blk={best[0]} t_chunk={best[1]} {best[3]:.3f} GTEPS")
+        # persist so every later build_blockcsr defaults to the measured
+        # tiles — an unattended chip window updates the Pallas defaults
+        # without a code edit (same contract as bench.py's method winner)
+        from lux_tpu.engine.methods import record_overlay_entry
+
+        record_overlay_entry(
+            "tpu:pallas_tiles",
+            {"v_blk": int(best[0]), "t_chunk": int(best[1])},
+        )
     return 0
 
 
