@@ -62,7 +62,21 @@ def _run_pallas(cfg, g):
             v = np.asarray(jax.device_get(out))[: g.nv].astype("float32")
     report_elapsed(elapsed, g.ne, cfg.num_iters)
     print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
-    return 0
+    return _check_tail(cfg, g, v)
+
+
+def _check_tail(cfg, g, v) -> int:
+    """-check verdict shared by EVERY colfilter path (incl. pallas and
+    feat-sharded) — EXTENSION: the reference ships no CF check task; we
+    validate training progress anyway (float64 RMSE must not regress
+    above the untrained closed form; finite state)."""
+    if not cfg.check:
+        return 0
+    ok = common.print_check(
+        "colfilter (training progress; extension — no reference "
+        "check task)", cf_model.check_training(g, v),
+    )
+    return 0 if ok else 1
 
 
 def _run_feat(cfg, g, prog):
@@ -113,7 +127,7 @@ def _run_feat(cfg, g, prog):
     report_elapsed(elapsed, g.ne, cfg.num_iters)
     v = shards.scatter_to_global(jax.device_get(state)).astype("float32")
     print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
-    return 0
+    return _check_tail(cfg, g, v)
 
 
 def main(argv=None):
@@ -191,12 +205,7 @@ def main(argv=None):
     report_elapsed(elapsed, g.ne, cfg.num_iters - start_it)
     v = shards.scatter_to_global(jax.device_get(state)).astype("float32")
     print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
-    if cfg.check:
-        # reference parity: col_filter ships no check task; the RMSE line
-        # above IS the training signal (oracle: tests/test_colfilter.py)
-        print("note: colfilter has no check task (reference parity); the "
-              "RMSE line is the training metric")
-    return 0
+    return _check_tail(cfg, g, v)
 
 
 if __name__ == "__main__":

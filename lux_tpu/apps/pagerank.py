@@ -69,7 +69,25 @@ def _run_pallas(cfg, g, prog):
             ranks = np.asarray(jax.device_get(out))[: g.nv]
     report_elapsed(elapsed, g.ne, cfg.num_iters)
     common.top_k("rank (pre-divided)", ranks)
-    return 0
+    return _check_tail(cfg, g, ranks)
+
+
+def _check_tail(cfg, g, ranks) -> int:
+    """-check verdict shared by EVERY pagerank path (incl. pallas) —
+    EXTENSION: the reference ships no pagerank check task (only
+    sssp/components have CHECK_TASK_ID); we validate the fixed point
+    anyway with one exact host iteration, tolerance scaled to the run's
+    iteration count and state dtype."""
+    if not cfg.check:
+        return 0
+    from lux_tpu.models.pagerank import check_ranks
+
+    ok = common.print_check(
+        "pagerank (fixed-point residual; extension — no reference "
+        "check task)",
+        check_ranks(g, ranks, num_iters=cfg.num_iters, dtype=cfg.dtype),
+    )
+    return 0 if ok else 1
 
 
 def main(argv=None):
@@ -148,14 +166,7 @@ def main(argv=None):
     report_elapsed(elapsed, g.ne, cfg.num_iters - start_it)
     ranks = shards.scatter_to_global(jax.device_get(state))
     common.top_k("rank (pre-divided)", ranks)
-    if cfg.check:
-        # reference parity: pagerank ships no check task (unlike
-        # sssp/components' triangle/dominance oracles); say so instead of
-        # silently swallowing the flag — numeric parity lives in the
-        # numpy/scipy oracle tests (tests/test_pagerank.py)
-        print("note: pagerank has no residual check task (reference "
-              "parity); oracle coverage: tests/test_pagerank.py")
-    return 0
+    return _check_tail(cfg, g, ranks)
 
 
 if __name__ == "__main__":

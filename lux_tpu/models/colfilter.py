@@ -189,3 +189,27 @@ def rmse(g: HostGraph, v: np.ndarray) -> float:
     dst = g.dst_of_edges()
     pred = np.sum(v[g.col_idx] * v[dst], axis=-1)
     return float(np.sqrt(np.mean((g.weights - pred) ** 2)))
+
+
+def init_rmse(g: HostGraph) -> float:
+    """Closed-form RMSE of the untrained state: every latent vector is
+    sqrt(1/K) (colfilter_gpu.cu:260-264), so every prediction is exactly
+    K * (1/K) = 1."""
+    return float(np.sqrt(np.mean((np.asarray(g.weights, np.float64) - 1.0) ** 2)))
+
+
+def check_training(g: HostGraph, v: np.ndarray) -> int:
+    """Training-progress validation for `-check` — an EXTENSION (the
+    reference ships no CF check task): gradient descent on the factor
+    model must not move the training RMSE ABOVE the untrained closed
+    form, and the state must stay finite.  Both sides are computed in
+    float64 and the band is 1e-4 relative: at the app-default
+    GAMMA=3.5e-7 the true improvement after a few iterations is tiny,
+    so the check catches divergence/corruption, not slow progress.
+    Returns a violation count in the [PASS]/[FAIL] contract: 1 if RMSE
+    regressed (diverged), plus the number of non-finite entries."""
+    v = np.asarray(v)
+    bad = int((~np.isfinite(v)).sum())
+    if rmse(g, v.astype(np.float64)) > init_rmse(g) * (1 + 1e-4):
+        bad += 1
+    return bad

@@ -153,15 +153,47 @@ def pagerank_pallas(
     return np.asarray(run(state0, num_iters))[: g.nv]
 
 
+def _host_iteration(g: HostGraph, stored: np.ndarray,
+                    deg: np.ndarray) -> np.ndarray:
+    """One exact float64 host application of the recurrence
+    (pagerank_gpu.cu:97-100 math) — the single source of truth shared by
+    the test oracle and the -check validator."""
+    acc = np.zeros(g.nv, np.float64)
+    np.add.at(acc, g.dst_of_edges(), stored[g.col_idx])
+    pr = (1.0 - ALPHA) / g.nv + ALPHA * acc
+    return np.where(deg > 0, pr / np.maximum(deg, 1.0), pr)
+
+
 def pagerank_reference(g: HostGraph, num_iters: int) -> np.ndarray:
     """NumPy oracle implementing the identical recurrence (for tests)."""
     deg = g.out_degrees().astype(np.float64)
     nv = g.nv
     state = np.where(deg > 0, (1.0 / nv) / np.maximum(deg, 1.0), 1.0 / nv)
-    dst = g.dst_of_edges()
     for _ in range(num_iters):
-        acc = np.zeros(nv, np.float64)
-        np.add.at(acc, dst, state[g.col_idx])
-        pr = (1.0 - ALPHA) / nv + ALPHA * acc
-        state = np.where(deg > 0, pr / np.maximum(deg, 1.0), pr)
+        state = _host_iteration(g, state, deg)
     return state.astype(np.float32)
+
+
+def check_ranks(g: HostGraph, stored: np.ndarray,
+                num_iters: int | None = None,
+                dtype: str = "float32") -> int:
+    """Fixed-point validation for `-check` — an EXTENSION: the reference
+    ships no check task for its pull apps (only sssp/components have
+    CHECK_TASK_ID, core/graph.h:46).  Re-applies one exact host
+    iteration of the recurrence (_host_iteration — the same code the
+    test oracle runs) and counts vertices whose stored pre-divided rank
+    moved beyond tolerance.  The tolerance tracks what a CORRECT engine
+    can deliver: the true residual contracts like ALPHA^num_iters (so
+    few-iteration runs get a proportionally loose band) and a bfloat16
+    state carries ~2^-8 relative quantization per rank; it is applied
+    per vertex against max(|rank|, mean) so hub ranks are judged
+    relative to themselves.  Non-finite ranks always count."""
+    stored = np.asarray(stored, np.float64)
+    deg = g.out_degrees().astype(np.float64)
+    new = _host_iteration(g, stored, deg)
+    base = 2e-2 if dtype == "bfloat16" else 1e-3
+    tol = base if num_iters is None else max(base, 3.0 * ALPHA ** num_iters)
+    scale = max(float(np.mean(np.abs(stored))), 1e-30)
+    thresh = tol * np.maximum(np.abs(stored), scale)
+    bad = ~np.isfinite(stored) | (np.abs(new - stored) > thresh)
+    return int(bad.sum())

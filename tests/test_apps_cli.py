@@ -367,3 +367,41 @@ def test_cli_file_errors(tmp_path):
     write_lux(path, generate.rmat(7, 4, seed=2))
     with pytest.raises(SystemExit, match="no edge weights"):
         cf_app.main(["-file", path, "-ni", "2"])
+
+
+def test_pagerank_cli_check_extension(capsys):
+    """-check on pagerank: the fixed-point residual validator (extension
+    — the reference ships no pull-app check task) passes on a healthy
+    run, and the unit validator rejects a corrupted state."""
+    import numpy as np
+
+    from lux_tpu.graph import generate
+    from lux_tpu.models.pagerank import check_ranks, pagerank
+
+    assert pr_app.main(SMALL + ["-ni", "12", "-check"]) == 0
+    out = capsys.readouterr().out
+    assert "[PASS] pagerank" in out
+    g = generate.rmat(9, 4, seed=11)
+    good = np.asarray(pagerank(g, num_iters=15))
+    assert check_ranks(g, good) == 0
+    bad = good.copy()
+    bad[::7] *= 3.0  # a broken engine's ranks violate the fixed point
+    assert check_ranks(g, bad) > 0
+    nan = good.copy()
+    nan[3] = np.nan
+    assert check_ranks(g, nan) > 0
+
+
+def test_colfilter_cli_check_extension(capsys):
+    """-check on colfilter: training-progress validator (extension)."""
+    import numpy as np
+
+    from lux_tpu.graph import generate
+    from lux_tpu.models.colfilter import check_training
+
+    assert cf_app.main(SMALL + ["-ni", "2", "-check"]) == 0
+    out = capsys.readouterr().out
+    assert "[PASS] colfilter" in out
+    gw = generate.bipartite_ratings(60, 40, 300, seed=12)
+    diverged = np.full((gw.nv, 20), 1e6, np.float32)
+    assert check_training(gw, diverged) > 0
