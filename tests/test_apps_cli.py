@@ -405,3 +405,15 @@ def test_colfilter_cli_check_extension(capsys):
     gw = generate.bipartite_ratings(60, 40, 300, seed=12)
     diverged = np.full((gw.nv, 20), 1e6, np.float32)
     assert check_training(gw, diverged) > 0
+
+
+def test_pagerank_cli_profile_trace(tmp_path, capsys):
+    """--profile-dir captures a jax.profiler trace around the run (the
+    tracing aux subsystem, SURVEY.md §5 — Legion Prof's role)."""
+    import os
+
+    d = str(tmp_path / "trace")
+    assert pr_app.main(SMALL + ["-ni", "2", "--profile-dir", d]) == 0
+    assert "profiler trace written" in capsys.readouterr().out
+    found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+    assert found, "no trace files written"
