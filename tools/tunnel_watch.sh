@@ -1,24 +1,33 @@
 #!/bin/bash
 # Poll the axon relay's remote_compile endpoint (127.0.0.1:8083) and launch
-# the chip-day battery once it accepts connections.  One battery per watch;
-# cheap TCP connects only (no jax, no claim) while waiting.
+# the chip-day battery each time it accepts connections.  The watch
+# RE-ARMS after every battery (round-2 evidence: windows can last ~7 min
+# and flap — one battery attempt per round would waste later windows);
+# per-window logdirs keep partial artifacts separate.  Cheap TCP connects
+# only (no jax, no claim) while waiting.
 # Usage: bash tools/tunnel_watch.sh [max_wait_s] [logdir]
 set -u
 cd "$(dirname "$0")/.."
 MAX=${1:-36000}
 LOG=${2:-/tmp/lux_chip_day_watch}
 t0=$(date +%s)
+n=0
 while :; do
   if timeout 3 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8083' 2>/dev/null; then
     echo "$(date +%H:%M:%S) relay up — settling 60s then launching battery"
     sleep 60
     # re-check: a flapping relay should not trigger a battery
     if timeout 3 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8083' 2>/dev/null; then
-      bash tools/chip_day.sh "$LOG"
-      exit $?
+      n=$((n + 1))
+      bash tools/chip_day.sh "${LOG}_w${n}"
+      echo "$(date +%H:%M:%S) battery #${n} done (rc=$?); re-arming watch"
+      # quiesce before re-probing: the battery's last client must release
+      # its claim, and a dying relay needs time to settle
+      sleep 600
+    else
+      echo "$(date +%H:%M:%S) relay flapped back down; resuming watch"
     fi
-    echo "$(date +%H:%M:%S) relay flapped back down; resuming watch"
   fi
-  [ $(( $(date +%s) - t0 )) -ge "$MAX" ] && { echo "watch expired"; exit 1; }
+  [ $(( $(date +%s) - t0 )) -ge "$MAX" ] && { echo "watch expired ($n batteries ran)"; exit $(( n == 0 )); }
   sleep 300
 done
