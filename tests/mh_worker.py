@@ -133,6 +133,28 @@ if mode == "push":
     check_local(out.state, psh.cuts, mine, bfs_reference(g, 0),
                 np.testing.assert_array_equal)
     print(f"process {pid}: multihost push OK over {P} devices", flush=True)
+    # --- the 3-phase -verbose split across processes: the same
+    # load/comp/update shard_map programs the CLI fences must converge to
+    # the same BFS fixpoint when every collective (queue all_gather,
+    # direction psums, dense-branch state all_gather) crosses a real
+    # process boundary
+    c_local2 = push._init_carry(sp, psh.pspec, view_local)
+    carry2 = push.assemble_carry(
+        c_local2, lambda a: mh.assemble_global(mesh, a, P)
+    )
+    pl, pc, pu = push.compile_push_phases_dist(
+        sp, mesh, psh.pspec, psh.spec, "scan"
+    )
+    it = 0
+    while int(carry2.active) > 0 and it < 64:
+        plan = pl(parrays_p, carry2)
+        carry2 = pu(arrays_p, carry2, pc(arrays_p, parrays_p, carry2, plan),
+                    plan)
+        it += 1
+    check_local(carry2.state, psh.cuts, mine, bfs_reference(g, 0),
+                np.testing.assert_array_equal)
+    print(f"process {pid}: multihost push phase-split OK ({it} its)",
+          flush=True)
     sys.exit(0)
 
 shards = build_pull_shards(g, P)

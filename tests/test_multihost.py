@@ -67,3 +67,4 @@ def test_two_process_distributed_push():
     outs = _run_pair("push", timeout=420)
     for pid, out in enumerate(outs):
         assert f"process {pid}: multihost push OK" in out
+        assert f"process {pid}: multihost push phase-split OK" in out
