@@ -43,6 +43,9 @@ def main(argv=None):
     ap.add_argument("--iters", type=int, default=2, help="pagerank iters")
     ap.add_argument("--file", default=None, help=".lux path (default /tmp)")
     ap.add_argument("--skip-sssp", action="store_true")
+    ap.add_argument("--sssp-exchange", default="allgather",
+                    choices=["allgather", "ring"],
+                    help="dense-round exchange for the SSSP phase")
     ap.add_argument(
         "--bucket-cap-gib", type=float, default=40.0,
         help="skip a bucket exchange whose padded arrays would exceed this",
@@ -163,22 +166,32 @@ def main(argv=None):
         del sh, out, state0
 
     if not args.skip_sssp:
-        from lux_tpu.graph.push_shards import build_push_shards
         from lux_tpu.models.sssp import inf_value, sssp
 
         t0 = time.monotonic()
-        psh = build_push_shards(header, P)
-        pest = preflight.scale_residency(
-            preflight.estimate_push(psh.spec, psh.pspec), k
-        )
-        note("push_built", build_s=round(time.monotonic() - t0, 1),
+        if args.sssp_exchange == "ring":
+            from lux_tpu.parallel.ring import build_push_ring_shards
+
+            psh = build_push_ring_shards(header, P)
+            pest = preflight.estimate_push_ring(
+                psh.spec, psh.pspec, psh.e_bucket_pad
+            )
+        else:
+            from lux_tpu.graph.push_shards import build_push_shards
+
+            psh = build_push_shards(header, P)
+            pest = preflight.estimate_push(psh.spec, psh.pspec)
+        pest = preflight.scale_residency(pest, k)
+        note("push_built", exchange=args.sssp_exchange,
+             build_s=round(time.monotonic() - t0, 1),
              preflight_gib=round(pest.total_bytes / (1 << 30), 3))
         start = int(np.argmax(degrees))
         t0 = time.monotonic()
-        dist = sssp(psh, start=start, mesh=mesh)
+        dist = sssp(psh, start=start, mesh=mesh,
+                    exchange=args.sssp_exchange)
         dt = time.monotonic() - t0
         reached = int((np.asarray(dist) < inf_value(nv)).sum())
-        note("sssp_allgather", start=start, reached=reached,
+        note(f"sssp_{args.sssp_exchange}", start=start, reached=reached,
              run_s=round(dt, 1))
 
     note("done")
