@@ -230,13 +230,13 @@ def run_push_adaptive(
         raise ValueError(f"chunk must be positive, got {chunk}")
     if exchange not in ("allgather", "ring"):
         raise ValueError(f"unsupported exchange {exchange!r}")
-    if exchange == "ring" and mesh is None:
-        raise ValueError("exchange='ring' needs a mesh")
     if sort_segments and exchange != "allgather":
         raise ValueError(
             "sort_segments relays out the allgather dense-round layout; "
             "the ring bucket layout has its own edge order"
         )
+    if exchange == "ring" and mesh is None:
+        raise ValueError("exchange='ring' needs a mesh")
 
     def build(cuts=None):
         if exchange == "ring":

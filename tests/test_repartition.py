@@ -273,3 +273,33 @@ def test_sp_work_saturates_instead_of_wrapping():
     out4 = np.asarray(step(np.array([big, 0], np.uint32),
                            jnp.int32(3), jnp.bool_(False)))
     assert out4[0] == 20_000_003  # float32 would have absorbed the +3
+
+
+def test_adaptive_recut_keeps_sort_segments():
+    """run_push_adaptive(sort_segments=True): the recut rebuild keeps the
+    gather-locality relayout (per-segment nondecreasing src_pos in the
+    rebuilt pull layout) and still converges to the BFS fixpoint."""
+    from lux_tpu.engine import repartition
+    from lux_tpu.models import sssp as ss
+
+    g = generate.rmat(9, 6, seed=14)
+    res = repartition.run_push_adaptive(
+        ss.SSSPProgram(nv=g.nv, start=0), g, 4, chunk=2, threshold=1.01,
+        sort_segments=True,
+    )
+    assert res.reparts >= 1  # a recut actually happened
+    np.testing.assert_array_equal(res.state, ss.bfs_reference(g, 0))
+    arr = res.shards.arrays
+    for p in range(arr.src_pos.shape[0]):
+        dl = arr.dst_local[p]
+        sp = arr.src_pos[p]
+        # within every dst segment the gather indices are nondecreasing
+        same_seg = dl[1:] == dl[:-1]
+        assert (sp[1:][same_seg] >= sp[:-1][same_seg]).all()
+    import pytest
+
+    with pytest.raises(ValueError, match="sort_segments"):
+        repartition.run_push_adaptive(
+            ss.SSSPProgram(nv=g.nv, start=0), g, 4, exchange="ring",
+            mesh=None, sort_segments=True,
+        )
