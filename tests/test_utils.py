@@ -151,3 +151,39 @@ def test_preflight_ring_k_resident_exact():
     assert est.gathered_bytes == 0
     assert est.total_bytes >= actual  # no underestimate at k > 1
     assert est.total_bytes <= 1.05 * actual  # and stays tight
+
+
+def test_iterstats_and_report(capsys):
+    """Metrics/logging subsystem (SURVEY.md §5): the verbose line formats
+    (reference parity: activeNodes/loadTime/compTime/updateTime,
+    sssp_gpu.cu:513-518), phase totals, and the GTEPS derivation."""
+    from lux_tpu.utils.timing import IterStats, report_elapsed
+
+    st = IterStats(verbose=True)
+    st.record(0, 42, 0.002)
+    st.record_phases(1, 7, 0.001, 0.003, 0.0005)
+    out = capsys.readouterr().out
+    assert "activeNodes(42) time(2.000 ms)" in out
+    assert "loadTime(1.000 ms)" in out and "updateTime(0.500 ms)" in out
+    assert st.total_active == 49
+    lt, ct, ut = st.phase_totals()
+    assert (lt, ct, ut) == (0.001, 0.003, 0.0005)
+    # fixed-iteration GTEPS: iters * ne / s / 1e9
+    g = report_elapsed(2.0, 1_000_000, 10)
+    assert abs(g - 0.005) < 1e-12
+    # frontier apps: traversed-edge count wins over iters * ne
+    g2 = report_elapsed(1.0, 1_000_000, 10, traversed=3_000_000)
+    assert abs(g2 - 0.003) < 1e-12
+    out = capsys.readouterr().out
+    assert "ELAPSED TIME" in out and "GTEPS" in out
+
+
+def test_timer_fences_device_values(capsys):
+    import jax.numpy as jnp
+
+    from lux_tpu.utils.timing import Timer
+
+    t = Timer()
+    x = jnp.arange(8) * 2
+    dt = t.stop(x)
+    assert dt >= 0.0 and t.elapsed == dt
