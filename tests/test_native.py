@@ -246,3 +246,41 @@ def test_bucket_fill_matches_numpy_scatter(subset):
         np.testing.assert_array_equal(
             getattr(a.sarrays, name), getattr(b.sarrays, name), err_msg=name
         )
+
+
+@pytest.mark.skipif(native.get_lib() is None,
+                    reason="native toolchain unavailable")
+def test_bucket_fill_error_contract():
+    """lux_bucket_fill's C error paths: bucket overflow (B too small)
+    and out-of-cuts sources raise; row_map -1 skips cleanly."""
+    rp = np.array([0, 2, 4], np.int64)       # 2 vertices, 2 edges each
+    srcs = np.array([0, 1, 0, 1], np.uint32)  # owners: 0,1,0,1 (cuts 0|1|2)
+    cuts = np.array([0, 1, 2], np.uint32)
+    P, B = 2, 8
+    src_l = np.zeros(P * B, np.int32)
+    dst_l = np.full(P * B, 2, np.int32)
+    hf = np.zeros(P * B, np.uint8)
+    row_map = np.arange(P, dtype=np.int64)
+    assert native.bucket_fill(srcs, rp, None, cuts, B, row_map, B,
+                              src_l, dst_l, hf, None)
+    # owner 0 bucket: edges 0,2 -> dst 0,1 ; heads at 0,1 ; pad head at 2
+    assert list(dst_l[:2]) == [0, 1] and list(hf[:3]) == [1, 1, 1]
+    # overflow: B=1 cannot hold 2 edges per bucket
+    with pytest.raises(ValueError, match="bucket fill failed"):
+        native.bucket_fill(srcs, rp, None, cuts, 1, row_map, 1,
+                           np.zeros(2, np.int32), np.zeros(2, np.int32),
+                           np.zeros(2, np.uint8), None)
+    # source beyond the last cut
+    with pytest.raises(ValueError, match="bucket fill failed"):
+        native.bucket_fill(np.array([5], np.uint32),
+                           np.array([0, 1], np.int64), None, cuts, B,
+                           row_map, B, src_l, dst_l, hf, None)
+    # row_map -1: owner-1 edges dropped, no slots consumed, no error
+    src_l2 = np.zeros(P * B, np.int32)
+    dst_l2 = np.full(P * B, 2, np.int32)
+    hf2 = np.zeros(P * B, np.uint8)
+    skip_map = np.array([0, -1], np.int64)
+    assert native.bucket_fill(srcs, rp, None, cuts, B, skip_map, B,
+                              src_l2, dst_l2, hf2, None)
+    assert list(dst_l2[:2]) == [0, 1]          # owner-0 bucket filled
+    assert (dst_l2[B:] == 2).all()             # owner-1 row untouched
