@@ -541,6 +541,13 @@ def _record_winner(results):
     .lux_winners.json) — an unattended chip window updates the default
     without a code edit.  Only the sum row: the race is PageRank; min/max
     rows change via the chip battery + PERF.md."""
+    if os.environ.get("LUX_BENCH_SORT_SEGMENTS") == "1":
+        # an A/B run under the non-default layout must not mutate the
+        # default-layout winner (it would silently change every later
+        # allgather run); the human folds A/B results in via PERF.md
+        print("# sort-segments A/B run: winner NOT recorded",
+              file=sys.stderr, flush=True)
+        return
     f32 = {m: t for (m, dt), t in results.items() if dt == "float32"}
     if not f32:
         return

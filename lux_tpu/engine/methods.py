@@ -91,25 +91,45 @@ def record_overlay_entry(key: str, value) -> None:
     for unattended chip measurements (bench.py's method winner, the
     Pallas sweep's tile winner).  A corrupt existing file is replaced,
     not fatal: readers already treat it as empty, and losing a chip
-    window's measurement to a bad old file would be strictly worse."""
+    window's measurement to a bad old file would be strictly worse.
+
+    The read-modify-write holds an ``fcntl`` lock on a sidecar lockfile:
+    the re-arming tunnel_watch can overlap two recorders (micro race +
+    bench race of consecutive windows), and an unlocked RMW would lose
+    one window's entry.  On success the module's read caches reset so
+    the recording process itself sees what it just wrote."""
     import json
 
     path = overlay_path()
     try:
-        prev = {}
-        if os.path.exists(path):
-            try:
-                with open(path) as f:
-                    prev = json.load(f)
-            except ValueError:
-                prev = {}  # corrupt: start fresh rather than drop the win
-        if not isinstance(prev, dict):
+        lock = open(path + ".lock", "a+")
+        try:
+            import fcntl
+
+            fcntl.flock(lock, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            pass  # no flock (non-POSIX): degraded to the old racy RMW
+        try:
             prev = {}
-        prev[key] = value
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(prev, f, indent=1)
-        os.replace(tmp, path)
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        prev = json.load(f)
+                except ValueError:
+                    prev = {}  # corrupt: start fresh, don't drop the win
+            if not isinstance(prev, dict):
+                prev = {}
+            prev[key] = value
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(prev, f, indent=1)
+            os.replace(tmp, path)
+        finally:
+            lock.close()  # releases the flock
+        global _overlay_raw_cache, _file_winners_cache, _tiles_cache
+        _overlay_raw_cache = None
+        _file_winners_cache = None
+        _tiles_cache = None
         print(f"# recorded {key} -> {value!r} ({path})", flush=True)
     except OSError as e:
         print(f"# winners file not written: {e}", flush=True)

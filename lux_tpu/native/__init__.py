@@ -318,6 +318,14 @@ def bucket_fill(srcs, row_ptr_slice, weights, cuts, B: int,
     lib = get_lib()
     if lib is None:
         return None
+    srcs = np.asarray(srcs)
+    if srcs.size and srcs.dtype.kind in "iu" and (
+        int(srcs.max()) >= 2**32 or int(srcs.min()) < 0
+    ):
+        # ascontiguousarray(.., uint32) would silently wrap a wider or
+        # negative id into a VALID bucket; the C error contract is
+        # strict everywhere else, so reject here too
+        raise ValueError("source id out of uint32 range")
     srcs = np.ascontiguousarray(srcs, np.uint32)
     rp = np.ascontiguousarray(row_ptr_slice, np.int64)
     cuts = np.ascontiguousarray(cuts, np.uint32)

@@ -180,3 +180,21 @@ def test_relay_passes_scaleup_without_hijacking_headline(tmp_path, capsys):
     assert got[-1]["metric"] == "pagerank_gteps_rmat20_1chip"  # headline kept
     assert any(o["metric"] == "pagerank_gteps_rmat22_1chip" for o in got)
     assert any(o["metric"] == "sssp_gteps_rmat20_1chip" for o in got)
+
+
+def test_record_winner_skips_sortseg_ab(tmp_path, monkeypatch):
+    """A sort-segments A/B run must never mutate the default-layout
+    tpu:sum winner (ADVICE r4): the overlay would silently change every
+    later allgather run's method."""
+    sys.path.insert(0, os.path.dirname(BENCH))
+    import bench
+
+    f = tmp_path / "w.json"
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(f))
+    results = {("scan", "float32"): 1.0, ("scatter", "float32"): 2.0}
+    monkeypatch.setenv("LUX_BENCH_SORT_SEGMENTS", "1")
+    bench._record_winner(results)
+    assert not f.exists()
+    monkeypatch.delenv("LUX_BENCH_SORT_SEGMENTS")
+    bench._record_winner(results)
+    assert json.loads(f.read_text())["tpu:sum"] == "scan"

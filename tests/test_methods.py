@@ -192,3 +192,22 @@ def test_record_overlay_entry_survives_corrupt_file(monkeypatch, tmp_path):
     monkeypatch.setattr(methods, "_tiles_cache", None)
     assert methods.resolve("auto", "sum", platform="tpu") == "scatter"
     assert methods.pallas_tiles() == (128, 256)
+
+
+def test_record_overlay_entry_invalidates_caches(monkeypatch, tmp_path):
+    """A process that records then reads must see its own write (ADVICE
+    r4: the old writer left _overlay_raw_cache/_file_winners_cache/
+    _tiles_cache stale) — no manual cache resets here on purpose."""
+    f = tmp_path / "w.json"
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(f))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
+    # prime the caches with the (empty) pre-write state
+    assert methods.resolve("auto", "sum", platform="tpu") == "scan"
+    assert methods.pallas_tiles() is None
+    methods.record_overlay_entry("tpu:sum", "scatter")
+    assert methods.resolve("auto", "sum", platform="tpu") == "scatter"
+    methods.record_overlay_entry(
+        "tpu:pallas_tiles", {"v_blk": 256, "t_chunk": 512})
+    assert methods.pallas_tiles() == (256, 512)

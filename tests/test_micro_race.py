@@ -1,0 +1,34 @@
+"""The chip-window insurance micro race (tools/tpu_micro_race.py),
+driven as a real process on CPU: both method rows must appear, the
+winner must be announced, and the overlay must NOT be written off-TPU
+(only a chip measurement may change TPU defaults)."""
+import json
+import os
+import subprocess
+import sys
+
+TOOL = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "tools", "tpu_micro_race.py")
+
+
+def test_micro_race_cpu(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LUX_METHOD_WINNERS"] = str(tmp_path / "w.json")
+    r = subprocess.run(
+        [sys.executable, TOOL, "--scale", "10", "--reps", "1", "2", "4",
+         "--outdir", str(tmp_path / "out")],
+        env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(s) for s in r.stdout.splitlines()
+            if s.startswith("{")]
+    assert {row["method"] for row in rows} == {"mxsum", "scan"}
+    for row in rows:
+        assert row["micro"] == "segment_sum"
+        # toy scale: slope noise may go negative; the field must exist
+        assert isinstance(row["ms_per_rep"], float)
+    assert "# micro race winner:" in r.stdout
+    # off-TPU: the tpu:micro_sum overlay entry must not be recorded
+    assert "not on tpu" in r.stdout
+    assert not (tmp_path / "w.json").exists()

@@ -284,3 +284,24 @@ def test_bucket_fill_error_contract():
                               src_l2, dst_l2, hf2, None)
     assert list(dst_l2[:2]) == [0, 1]          # owner-0 bucket filled
     assert (dst_l2[B:] == 2).all()             # owner-1 row untouched
+    # int64 sources >= 2^32 must raise, not truncate into a valid
+    # bucket (ADVICE r4: the uint32 cast was silent)
+    with pytest.raises(ValueError, match="uint32 range"):
+        native.bucket_fill(np.array([2**32], np.int64),
+                           np.array([0, 1], np.int64), None, cuts, B,
+                           row_map, B, src_l, dst_l, hf, None)
+    # negative ids (int64 OR int32) must raise too, not wrap to a
+    # plausible bucket
+    for dt in (np.int64, np.int32):
+        with pytest.raises(ValueError, match="uint32 range"):
+            native.bucket_fill(np.array([-(2**32 - 5)], dt)
+                               if dt == np.int64 else np.array([-3], dt),
+                               np.array([0, 1], np.int64), None, cuts, B,
+                               row_map, B, src_l, dst_l, hf, None)
+    # int64 sources that DO fit pass through unchanged
+    src_l3 = np.zeros(P * B, np.int32)
+    dst_l3 = np.full(P * B, 2, np.int32)
+    hf3 = np.zeros(P * B, np.uint8)
+    assert native.bucket_fill(srcs.astype(np.int64), rp, None, cuts, B,
+                              row_map, B, src_l3, dst_l3, hf3, None)
+    assert list(dst_l3[:2]) == [0, 1]

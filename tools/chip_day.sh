@@ -29,13 +29,15 @@ if ! timeout 3 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8083' 2>/dev/null; then
 fi
 echo "relay gate: 8083 accepts"
 
-# 0) gate: per-component probe doubles as the tunnel check (small scale
-#    first so a dead tunnel costs one claim wait, not a full battery)
-run probe_components 5400 python tools/tpu_component_probe.py \
-    --scale 20 --ef 16 --reps 1 4 16 || {
-  grep -q "GTEPS-equiv" "$LOG/probe_components.out" || {
-    echo "tunnel dead (no component rows) — aborting battery"; exit 1; }
-}
+# 0) chip-window insurance (VERDICT r4 #8): sub-minute scan-vs-mxsum
+#    micro race at scale 17 — one tiny compile per method, mxsum banked
+#    before scan is risked, result auto-recorded to the winners overlay
+#    ("tpu:micro_sum").  Doubles as the tunnel gate: a live tunnel
+#    produces the mxsum row in minutes where the old scale-20 probe
+#    gate could burn 90 min of a 7-min window.
+run micro_race 900 python tools/tpu_micro_race.py --outdir "$LOG/micro"
+grep -q '"ms_per_rep"' "$LOG/micro_race.out" || {
+  echo "tunnel dead (no micro rows) — aborting battery"; exit 1; }
 
 # 1) the driver-format bench race FIRST after the gate (VERDICT r3 #1:
 #    the no-suffix TPU datapoint is the top ask — a short window must
@@ -49,7 +51,12 @@ LUX_BENCH_WATCHDOG_S=3600 LUX_BENCH_TPU_S=3300 \
   LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
   run bench_race 3700 python bench.py
 
-# 2) Mosaic compile check + tile sweep (VERDICT r1 #3)
+# 2) per-component timing at headline scale (the old gate, now after
+#    the short-window essentials are banked)
+run probe_components 5400 python tools/tpu_component_probe.py \
+    --scale 20 --ef 16 --reps 1 4 16
+
+# 2a) Mosaic compile check + tile sweep (VERDICT r1 #3)
 run pallas_sweep 5400 python tools/tpu_pallas_check.py --scale 18 --sweep
 
 # 2b) gather-locality A/B: the same component battery on the

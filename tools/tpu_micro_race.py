@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Chip-window insurance: a sub-minute scan-vs-mxsum segment-sum race.
+
+Round 2's only chip window lasted ~7 minutes; the full battery
+(tools/chip_day.sh) needs hours.  This step-0 microbench banks a usable
+sum-method decision in the first minute of ANY window:
+
+  * ONE tiny graph (rmat17/ef16 by default — 131k vertices, 2.1M edges)
+    so each worker is a single small compile (rep count n is TRACED, so
+    slope-timing costs no extra compiles).
+  * Each method runs in its OWN subprocess, mxsum first: its line is
+    banked on disk the moment it exists, before scan — the one method
+    that has ever wedged the tunnel (docs/PERF.md pitfall 3) — is risked
+    at all.  A wedged worker is abandoned (never killed: it may hold the
+    tunnel claim and must release it cleanly), exactly like bench.py's
+    watchdog.
+  * The parent imports no jax (a dead relay must cost milliseconds, not
+    a C-level claim-retry hour) and auto-records the measurements under
+    ``"tpu:micro_sum"`` in the winners overlay
+    (lux_tpu.engine.methods.record_overlay_entry), so even a window that
+    dies 90 seconds in leaves a measured artifact behind.
+
+The race is sum-only on purpose: the headline app (PageRank) is a pure
+segment-sum, mxsum is its fastest sum-only candidate, and scan is the
+shipped blanket TPU default that has NEVER been timed on a chip
+(engine/methods.WINNERS).  The full bench race still owns the
+``"tpu:sum"`` blanket-default row; this tool only banks raw numbers plus
+a ``winner`` field for the human / next-round fold-in.
+
+Usage: python tools/tpu_micro_race.py [--scale 17] [--methods mxsum scan]
+       (worker mode: --worker --method M, spawned internally)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _fit(xs, ys):
+    n = len(xs)
+    mx, my = sum(xs) / n, sum(ys) / n
+    num = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    den = sum((x - mx) ** 2 for x in xs)
+    return num / den, my - (num / den) * mx
+
+
+def worker_main(args) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lux_tpu.graph import generate
+    from lux_tpu.ops import segment
+
+    t_setup = time.perf_counter()
+    g = generate.rmat(args.scale, args.ef, seed=0)
+    rng = np.random.default_rng(0)
+    state = jnp.asarray(rng.random(g.nv, np.float32))
+    row_ptr = jnp.asarray(g.row_ptr.astype(np.int32))
+    head = np.zeros(g.ne, np.int32)
+    head[g.row_ptr[:-1][g.row_ptr[:-1] < g.ne]] = 1
+    head_flag = jnp.asarray(head.astype(bool))
+    dst_local = jnp.asarray(g.dst_of_edges().astype(np.int32))
+    vals_fixed = jnp.asarray(rng.random(g.ne, np.float32))
+    jax.block_until_ready((state, row_ptr, head_flag, dst_local, vals_fixed))
+    platform = jax.devices()[0].platform
+    print(f"# micro worker: platform={platform} method={args.method} "
+          f"nv={g.nv} ne={g.ne} setup={time.perf_counter()-t_setup:.1f}s",
+          flush=True)
+
+    # x_{k+1} = f(x_k) chaining (XLA cannot collapse reps); n traced ->
+    # exactly one compile; fetch-based timing (device_get of a scalar is
+    # the only timing the tunnel cannot fake, tools/tpu_timing_probe.py)
+    @jax.jit
+    def run(x0, n):
+        def body(_, x):
+            vals = vals_fixed * x[0]
+            acc = segment.segment_sum_csc(
+                vals, row_ptr, head_flag, dst_local, method=args.method)
+            return acc * 0.999
+        return jax.lax.fori_loop(0, n, body, x0)
+
+    t_c = time.perf_counter()
+    for n in args.reps:  # warm: compile once, touch every rep count
+        float(jax.device_get(run(state, jnp.int32(n)).ravel()[0]))
+    compile_s = time.perf_counter() - t_c
+    xs, ts = [], []
+    for n in args.reps:
+        t0 = time.perf_counter()
+        float(jax.device_get(run(state, jnp.int32(n)).ravel()[0]))
+        ts.append(time.perf_counter() - t0)
+        xs.append(n)
+    slope, icpt = _fit(xs, ts)
+    gteps = g.ne / slope / 1e9 if slope > 0 else float("nan")
+    print(json.dumps({
+        "micro": "segment_sum", "method": args.method,
+        "platform": platform, "scale": args.scale, "ne": int(g.ne),
+        "ms_per_rep": round(slope * 1e3, 4), "gteps": round(gteps, 4),
+        "intercept_ms": round(icpt * 1e3, 2),
+        "compile_s": round(compile_s, 1),
+        "raw": {str(n): round(t, 4) for n, t in zip(xs, ts)},
+    }), flush=True)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=17)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--reps", type=int, nargs="+", default=[1, 8, 32])
+    ap.add_argument("--methods", nargs="+", default=["mxsum", "scan"],
+                    help="race order; the risky method belongs LAST")
+    ap.add_argument("--method", help="(worker mode) single method to time")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--per-method-s", type=int,
+                    default=int(os.environ.get("LUX_MICRO_METHOD_S", "240")),
+                    help="abandon a worker after this long (wedge bound)")
+    ap.add_argument("--outdir", default="/tmp/lux_micro_race")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return worker_main(args)
+
+    # parent: no jax anywhere.  Relay gate first (milliseconds, not a
+    # claim-retry hour) unless we're deliberately on CPU.
+    on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if not on_cpu:
+        import socket
+
+        try:
+            socket.create_connection(("127.0.0.1", 8083), timeout=3).close()
+        except OSError:
+            print("relay down (127.0.0.1:8083) — nothing to race", flush=True)
+            return 1
+    os.makedirs(args.outdir, exist_ok=True)
+    rows: dict[str, dict] = {}
+    for m in args.methods:
+        out_path = os.path.join(args.outdir, f"micro_{m}.out")
+        out = open(out_path, "wb")
+        err = open(out_path + ".err", "wb")
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               "--method", m, "--scale", str(args.scale),
+               "--ef", str(args.ef), "--reps",
+               *[str(n) for n in args.reps]]
+        t0 = time.monotonic()
+        proc = subprocess.Popen(cmd, stdout=out, stderr=err,
+                                cwd=os.path.dirname(os.path.abspath(__file__)),
+                                start_new_session=True)
+        while time.monotonic() - t0 < args.per_method_s:
+            if proc.poll() is not None:
+                break
+            time.sleep(1)
+        abandoned = proc.poll() is None
+        out.close()
+        err.close()
+        for line in open(out_path, "rb").read().decode("utf8", "replace").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows[m] = json.loads(line)
+                except ValueError:
+                    pass
+            elif line:
+                print(line, flush=True)
+        if m in rows:
+            print(json.dumps(rows[m]), flush=True)
+        if abandoned:
+            # never kill: a wedged worker may still hold the tunnel claim
+            # and must release it cleanly on its own (bench.py watchdog
+            # semantics).  Stop racing — the tunnel is suspect now.
+            print(f"# {m} ABANDONED after {args.per_method_s}s (pid "
+                  f"{proc.pid} left to unwind); stopping race", flush=True)
+            break
+        if m not in rows:
+            print(f"# {m} produced no measurement (rc={proc.returncode}; "
+                  f"see {out_path}.err)", flush=True)
+    if not rows:
+        print("micro race: no measurements", flush=True)
+        return 1
+    timed = {m: r["ms_per_rep"] for m, r in rows.items()
+             if r.get("ms_per_rep", 0) > 0}
+    winner = min(timed, key=timed.get) if timed else None
+    platforms = {r.get("platform") for r in rows.values()}
+    record = {
+        "winner": winner, "scale": args.scale,
+        "ms_per_rep": {m: r["ms_per_rep"] for m, r in rows.items()},
+        "gteps": {m: r["gteps"] for m, r in rows.items()},
+    }
+    print(f"# micro race winner: {winner} ({record['ms_per_rep']})",
+          flush=True)
+    if platforms & {"tpu", "axon"}:
+        from lux_tpu.engine import methods  # no-jax import (os/json only)
+
+        methods.record_overlay_entry("tpu:micro_sum", record)
+    else:
+        print(f"# not on tpu ({platforms}); overlay not recorded", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
