@@ -66,6 +66,18 @@ def _zero(metric):
     }
 
 
+def _total_unique(shards) -> int:
+    """TOTAL real unique in-sources over all parts (roofline's
+    compact_unique contract) — NOT the LANE-padded mirror width."""
+    import numpy as np
+
+    a = shards.arrays
+    return sum(
+        int(np.unique(a.src_pos[p][a.edge_mask[p]]).size)
+        for p in range(a.src_pos.shape[0])
+    )
+
+
 def worker_main():
     """The actual benchmark; runs on whatever platform the env selects."""
     fake = os.environ.get("LUX_BENCH_FAKE_HANG")
@@ -126,7 +138,12 @@ def worker_main():
     # (docs/PERF.md gather-amplification band); pagerank metric names
     # gain a _sortseg suffix so the two layouts never mix in _relay
     sort_seg = os.environ.get("LUX_BENCH_SORT_SEGMENTS") == "1"
-    shards = build_pull_shards(g, 1, sort_segments=sort_seg)
+    # LUX_BENCH_COMPACT_GATHER=1: A/B the unique-in-source mirror layout
+    # (reference load_kernel staging); metrics gain a _compact suffix
+    compact = os.environ.get("LUX_BENCH_COMPACT_GATHER") == "1"
+    shards = build_pull_shards(g, 1, sort_segments=sort_seg,
+                               compact_gather=compact)
+    compact_unique = _total_unique(shards) if compact else 0
     print(f"# worker: graph ready nv={g.nv} ne={g.ne}", file=sys.stderr, flush=True)
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     jax.block_until_ready(arrays)
@@ -210,13 +227,16 @@ def worker_main():
             suffix = "_bf16" + suffix
         if sort_seg:
             suffix = "_sortseg" + suffix
+        if compact:
+            suffix = "_compact" + suffix
         print(
             f"# method {m} ({dt}): {elapsed:.4f}s -> {gteps:.4f} GTEPS",
             file=sys.stderr,
             flush=True,
         )
         model = roofline.pull_iter_model(
-            g.ne, g.nv, m, state_bytes=2 if dt == "bfloat16" else 4
+            g.ne, g.nv, m, state_bytes=2 if dt == "bfloat16" else 4,
+            compact_unique=compact_unique,
         ).scale(iters)
         _emit(
             {
@@ -239,7 +259,9 @@ def worker_main():
         comparison is like-for-like."""
         s2 = scale + 2
         g2 = generate.rmat(s2, ef, seed=0)
-        sh2 = build_pull_shards(g2, 1, sort_segments=sort_seg)
+        sh2 = build_pull_shards(g2, 1, sort_segments=sort_seg,
+                                compact_gather=compact)
+        cu2 = _total_unique(sh2) if compact else 0
         prog2 = PageRankProgram(nv=sh2.spec.nv, dtype=dt)
         arr2 = jax.tree.map(jnp.asarray, sh2.arrays)
         s0 = pull.init_state(prog2, arr2)
@@ -254,8 +276,11 @@ def worker_main():
             suffix = "_bf16" + suffix
         if sort_seg:
             suffix = "_sortseg" + suffix
+        if compact:
+            suffix = "_compact" + suffix
         model = roofline.pull_iter_model(
-            g2.ne, g2.nv, m, state_bytes=2 if dt == "bfloat16" else 4
+            g2.ne, g2.nv, m, state_bytes=2 if dt == "bfloat16" else 4,
+            compact_unique=cu2,
         ).scale(iters)
         _emit(
             {
@@ -541,11 +566,12 @@ def _record_winner(results):
     .lux_winners.json) — an unattended chip window updates the default
     without a code edit.  Only the sum row: the race is PageRank; min/max
     rows change via the chip battery + PERF.md."""
-    if os.environ.get("LUX_BENCH_SORT_SEGMENTS") == "1":
-        # an A/B run under the non-default layout must not mutate the
+    if (os.environ.get("LUX_BENCH_SORT_SEGMENTS") == "1"
+            or os.environ.get("LUX_BENCH_COMPACT_GATHER") == "1"):
+        # an A/B run under a non-default layout must not mutate the
         # default-layout winner (it would silently change every later
         # allgather run); the human folds A/B results in via PERF.md
-        print("# sort-segments A/B run: winner NOT recorded",
+        print("# layout A/B run: winner NOT recorded",
               file=sys.stderr, flush=True)
         return
     f32 = {m: t for (m, dt), t in results.items() if dt == "float32"}

@@ -95,6 +95,27 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             )
         if cfg.distributed:
             require_parts_fit_devices(cfg, "--method pallas")
+    # layout relayouts bind to the allgather pull layout's src_pos; check
+    # BEFORE the allgather early-return so pallas combos are caught too
+    if cfg.sort_segments and (
+        cfg.exchange != "allgather" or cfg.edge_shards > 1
+        or cfg.feat_shards > 1 or cfg.method == "pallas"
+    ):
+        raise SystemExit(
+            "--sort-segments relays out the allgather pull layout; the "
+            "bucket (ring/scatter/edge2d), feat-sharded, and block-CSR "
+            "(pallas) layouts have their own edge orders"
+        )
+    if cfg.compact_gather and (
+        cfg.exchange != "allgather" or cfg.edge_shards > 1
+        or cfg.feat_shards > 1 or cfg.method == "pallas"
+    ):
+        raise SystemExit(
+            "--compact-gather mirrors the allgather pull layout's "
+            "src_pos; the bucket (ring/scatter/edge2d) and feat-sharded "
+            "layouts ship their own slices and pallas has its own "
+            "block-CSR gather"
+        )
     if cfg.feat_shards > 1:
         if getattr(prog, "k", 1) <= 1:
             raise SystemExit(
@@ -165,15 +186,6 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             "--exchange ring/scatter supports --method scan or scatter "
             "(bucketed reductions carry no row_ptr for prefix-diff reduces)"
         )
-    if cfg.sort_segments and (
-        cfg.exchange != "allgather" or cfg.edge_shards > 1
-        or cfg.method == "pallas"
-    ):
-        raise SystemExit(
-            "--sort-segments relays out the allgather pull layout; the "
-            "bucket (ring/scatter/edge2d) and block-CSR (pallas) layouts "
-            "have their own edge orders"
-        )
     if cfg.exchange == "scatter":
         if prog.reduce != "sum" or getattr(prog, "needs_dst_state", False):
             raise SystemExit(
@@ -194,7 +206,8 @@ def build_exchange_shards(g: HostGraph, cfg: RunConfig):
         return build_edge2d_shards(g, cfg.num_parts, cfg.edge_shards)
     if cfg.exchange == "allgather":
         return build_pull_shards(
-            g, cfg.num_parts, sort_segments=cfg.sort_segments
+            g, cfg.num_parts, sort_segments=cfg.sort_segments,
+            compact_gather=cfg.compact_gather,
         )
     if not cfg.distributed:
         raise SystemExit(f"--exchange {cfg.exchange} requires --distributed")

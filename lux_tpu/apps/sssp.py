@@ -30,6 +30,13 @@ def build_push_app_shards(g, cfg):
             "layout; the ring-bucket and block-CSR (pallas) layouts have "
             "their own edge orders"
         )
+    if cfg.compact_gather and (
+        cfg.exchange != "allgather" or cfg.method == "pallas"
+    ):
+        raise SystemExit(
+            "--compact-gather mirrors the allgather dense-round pull "
+            "layout's src_pos; ring and pallas have their own layouts"
+        )
     if cfg.method == "pallas":
         if cfg.exchange != "allgather":
             raise SystemExit(
@@ -52,7 +59,8 @@ def build_push_app_shards(g, cfg):
 
         return build_push_ring_shards(g, cfg.num_parts)
     return build_push_shards(
-        g, cfg.num_parts, sort_segments=cfg.sort_segments
+        g, cfg.num_parts, sort_segments=cfg.sort_segments,
+        compact_gather=cfg.compact_gather,
     )
 
 
@@ -204,6 +212,7 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 max_iters=cfg.max_iters, method=cfg.method, mesh=mesh,
                 on_repartition=note, shards=shards, exchange=cfg.exchange,
                 sort_segments=cfg.sort_segments,
+                compact_gather=cfg.compact_gather,
             )
             state, iters, edges = res.stacked, res.iters, res.edges
             shards = res.shards

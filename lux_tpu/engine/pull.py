@@ -70,8 +70,20 @@ def pull_gather_part(arrays: ShardArrays, full_state: jnp.ndarray,
     the replicated-state read the reference's load_kernel does ZC→FB
     (pagerank_gpu.cu:34-47).  Shared by the fused step and the -verbose
     phase split (single-device AND distributed) so the phase boundary
-    can never drift from the fused math."""
-    src_state = full_state[arrays.src_pos]  # (E, ...) gather
+    can never drift from the fused math.
+
+    With the compact-gather layout (nonzero mirror width — a STATIC
+    shape, so this branch resolves at trace time), the per-edge read is
+    two-stage like the reference's load_kernel: one O(U) ascending
+    gather fills the part's unique-in-source mirror, then the O(E)
+    per-edge gather indexes the U-sized mirror instead of the (P*V,)
+    state.  mirror_pos[mirror_rel] == src_pos exactly, so results are
+    bitwise identical to the direct layout."""
+    if arrays.mirror_pos.shape[-1] > 0:
+        mirror = full_state[arrays.mirror_pos]  # (U, ...) compact stage
+        src_state = mirror[arrays.mirror_rel]   # (E, ...) from U, not P*V
+    else:
+        src_state = full_state[arrays.src_pos]  # (E, ...) direct gather
     dst_state = local_state[jnp.clip(arrays.dst_local, 0, local_state.shape[0] - 1)]
     return src_state, dst_state
 

@@ -71,7 +71,12 @@ def _seg_reduce(prog):
 def dense_part_step(prog, arr: ShardArrays, full_state, local, method="scan"):
     """Pull-mode relaxation over ALL in-edges (sssp_pull_kernel semantics:
     new[v] = op(old[v], op over in-edges relax(state[src]))."""
-    vals = prog.relax(full_state[arr.src_pos], arr.weights)
+    if arr.mirror_pos.shape[-1] > 0:
+        # compact-gather mirror (engine/pull.pull_gather_part semantics)
+        src = full_state[arr.mirror_pos][arr.mirror_rel]
+    else:
+        src = full_state[arr.src_pos]
+    vals = prog.relax(src, arr.weights)
     acc = _seg_reduce(prog)(
         vals, arr.row_ptr, arr.head_flag, arr.dst_local, method=method
     )

@@ -205,6 +205,7 @@ def run_push_adaptive(
     shards=None,
     exchange: str = "allgather",
     sort_segments: bool = False,
+    compact_gather: bool = False,
 ):
     """Direction-optimized push with window-based dynamic repartitioning.
 
@@ -235,6 +236,11 @@ def run_push_adaptive(
             "sort_segments relays out the allgather dense-round layout; "
             "the ring bucket layout has its own edge order"
         )
+    if compact_gather and exchange != "allgather":
+        raise ValueError(
+            "compact_gather mirrors the allgather dense-round layout; "
+            "the ring bucket layout ships only owned slices"
+        )
     if exchange == "ring" and mesh is None:
         raise ValueError("exchange='ring' needs a mesh")
 
@@ -243,9 +249,10 @@ def run_push_adaptive(
             from lux_tpu.parallel.ring import build_push_ring_shards
 
             return build_push_ring_shards(g, num_parts, cuts=cuts)
-        # recuts keep the caller's gather-locality relayout choice
+        # recuts keep the caller's gather-layout choices
         return build_push_shards(
-            g, num_parts, cuts=cuts, sort_segments=sort_segments
+            g, num_parts, cuts=cuts, sort_segments=sort_segments,
+            compact_gather=compact_gather,
         )
 
     if shards is None:

@@ -95,13 +95,17 @@ def build_push_shards(
     e_sp: Optional[int] = None,
     cuts: Optional[np.ndarray] = None,
     sort_segments: bool = False,
+    compact_gather: bool = False,
 ) -> PushShards:
     # sort_segments: gather-locality relayout of the embedded pull
     # layout — the push engine's DENSE rounds gather full[src_pos]
     # exactly like the pull engine (min/max relaxation is order-free,
-    # so this is bitwise-invariant for the frontier apps)
+    # so this is bitwise-invariant for the frontier apps).
+    # compact_gather: dense rounds gather through the unique-in-source
+    # mirror instead (engine/push.dense_part_step)
     pull = build_pull_shards(
-        g, num_parts, cuts=cuts, sort_segments=sort_segments
+        g, num_parts, cuts=cuts, sort_segments=sort_segments,
+        compact_gather=compact_gather,
     )
     spec = pull.spec
     P, e_pad, nv_pad = num_parts, spec.e_pad, spec.nv_pad

@@ -79,6 +79,20 @@ class ShardArrays(NamedTuple):
       global_vid:(P, V)   int32  global vertex id of each local slot (clamped
                  to nv-1 on padding slots; check vtx_mask).
       weights:   (P, E)   float32 edge weights (zeros when unweighted).
+      mirror_pos:(P, U)   int32  compact-gather mirror: the part's UNIQUE
+                 in-source positions in the gathered state, sorted
+                 ascending (U = 0 when the layout is disabled — the
+                 zero width is static, so jitted engines pick the gather
+                 path at trace time with no extra plumbing).
+      mirror_rel:(P, E)   int32  per-edge index into the part's mirror
+                 (mirror_pos[mirror_rel] == src_pos on real edges).
+
+    The mirror pair is the TPU answer to the reference's per-GPU unique
+    in-vertex list + load_kernel FB staging (pagerank_gpu.cu:229-240,
+    34-47): the per-edge gather's working set drops from O(P*V) to
+    O(unique in-sources), and the O(U) mirror fill reads ASCENDING
+    positions — sequential-friendly HBM traffic where src_pos gathers
+    are random.
     """
 
     row_ptr: np.ndarray
@@ -90,6 +104,8 @@ class ShardArrays(NamedTuple):
     degree: np.ndarray
     global_vid: np.ndarray
     weights: np.ndarray
+    mirror_pos: np.ndarray
+    mirror_rel: np.ndarray
 
 
 @dataclasses.dataclass
@@ -173,6 +189,8 @@ def alloc_arrays(num_rows: int, nv_pad: int, e_pad: int) -> ShardArrays:
         degree=np.zeros((num_rows, nv_pad), np.int32),
         global_vid=np.zeros((num_rows, nv_pad), np.int32),
         weights=np.zeros((num_rows, e_pad), np.float32),
+        mirror_pos=np.zeros((num_rows, 0), np.int32),
+        mirror_rel=np.zeros((num_rows, 0), np.int32),
     )
 
 
@@ -247,19 +265,56 @@ def sort_segments_inplace(arrays: ShardArrays) -> None:
         arrays.weights[r] = arrays.weights[r][order]
 
 
+def build_compact_mirror(arrays: ShardArrays) -> ShardArrays:
+    """Attach the compact-gather mirror to filled pull-layout arrays.
+
+    Per part: ``mirror_pos`` = sorted unique src_pos of the real edges
+    (padded to a shared lane-aligned width U by repeating position 0 —
+    harmless extra gathers of a valid slot), and ``mirror_rel`` remaps
+    every edge's src_pos to its mirror index via binary search (padding
+    edges map to 0; their contributions are already dropped by the
+    dst_local sentinel).  The remap is exact, so engine results are
+    bitwise identical to the direct layout — only the gather traffic
+    shape changes.  Host-side one-time cost, like the reference's
+    init-time in-vertex sort (pagerank_gpu.cu:229-240).
+
+    Composes with sort_segments_inplace (call it first: the mirror is
+    order-insensitive per segment, and src_pos->mirror_rel is a monotone
+    remap, so the relayout's in-segment ascending order survives).
+    """
+    P = arrays.src_pos.shape[0]
+    uniqs = []
+    for p in range(P):
+        uniqs.append(np.unique(arrays.src_pos[p][arrays.edge_mask[p]]))
+    u_pad = max(LANE, _round_up(max((len(u) for u in uniqs), default=1) or 1,
+                                LANE))
+    mirror_pos = np.zeros((P, u_pad), np.int32)
+    mirror_rel = np.zeros_like(arrays.src_pos)
+    for p in range(P):
+        u = uniqs[p]
+        mirror_pos[p, : len(u)] = u
+        rel = np.searchsorted(u, arrays.src_pos[p])
+        # padding edges hold src_pos 0; searchsorted keeps them in range
+        # unless the part is empty, where clip pins them to slot 0
+        mirror_rel[p] = np.clip(rel, 0, u_pad - 1).astype(np.int32)
+    return arrays._replace(mirror_pos=mirror_pos, mirror_rel=mirror_rel)
+
+
 def build_pull_shards(
     g: HostGraph,
     num_parts: int,
     degrees: Optional[np.ndarray] = None,
     cuts: Optional[np.ndarray] = None,
     sort_segments: bool = False,
+    compact_gather: bool = False,
 ) -> PullShards:
     """Partition + pad a HostGraph into device-ready pull-model shards.
 
     ``cuts`` (optional (P+1,) bounds) selects a custom contiguous
     partition — used by dynamic repartitioning to rebalance on measured
     work instead of static in-degree.  ``sort_segments`` applies the
-    gather-locality relayout (sort_segments_inplace)."""
+    gather-locality relayout (sort_segments_inplace); ``compact_gather``
+    attaches the unique-in-source mirror (build_compact_mirror)."""
     cuts, nv_pad, e_pad = shard_geometry(g.row_ptr, num_parts, g.nv, cuts)
     if degrees is None:
         degrees = g.out_degrees()
@@ -276,6 +331,8 @@ def build_pull_shards(
         )
     if sort_segments:
         sort_segments_inplace(arrays)
+    if compact_gather:
+        arrays = build_compact_mirror(arrays)
     spec = ShardSpec(
         num_parts=num_parts,
         nv=g.nv,

@@ -48,6 +48,9 @@ class RunConfig:
     #: gather-locality relayout: sort edges within each destination
     #: segment by src_pos (graph/shards.sort_segments_inplace)
     sort_segments: bool = False
+    #: compact-gather layout: per-part unique-in-source mirror, the
+    #: reference's load_kernel FB staging (graph/shards.build_compact_mirror)
+    compact_gather: bool = False
     #: >0 = adaptive dynamic repartitioning (push apps): every N iterations
     #: rebalance the vertex cuts from the measured per-part load (the Lux
     #: paper's runtime repartitioning, absent from the reference code)
@@ -113,6 +116,11 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "locality; commutative reduces only — "
                              "semantically free, float sums round "
                              "differently than the unsorted layout)")
+        ap.add_argument("--compact-gather", action="store_true",
+                        help="two-stage gather through a per-part "
+                             "unique-in-source mirror (working set "
+                             "O(unique srcs) instead of O(nv); bitwise-"
+                             "identical results)")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],
@@ -128,6 +136,10 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "within each destination segment by gather "
                              "index (HBM gather locality; bitwise-free "
                              "for min/max relaxation)")
+        ap.add_argument("--compact-gather", action="store_true",
+                        help="dense rounds gather through a per-part "
+                             "unique-in-source mirror (working set "
+                             "O(unique srcs); bitwise-identical)")
     if sssp:
         ap.add_argument("--weighted", action="store_true",
                         help="relax with edge weights (Dijkstra-style)")
@@ -156,6 +168,7 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         edge_shards=getattr(ns, "edge_shards", 1),
         feat_shards=getattr(ns, "feat_shards", 1),
         sort_segments=getattr(ns, "sort_segments", False),
+        compact_gather=getattr(ns, "compact_gather", False),
         repartition_every=getattr(ns, "repartition_every", 0),
         repartition_threshold=getattr(ns, "repartition_threshold", 1.25),
     )

@@ -111,6 +111,7 @@ def pull_iter_model(
     weighted: bool = False,
     needs_dst: bool = False,
     apply_flops_per_vertex: int = 3,
+    compact_unique: int = 0,
 ) -> TrafficModel:
     """One pull-engine iteration over the whole graph (engine/pull.py
     gather -> reduce -> apply; the pr_kernel envelope,
@@ -119,14 +120,27 @@ def pull_iter_model(
     ``needs_dst``: the program's edge_value reads the destination state
     (CF's error term) — pagerank's dst gather is DCE'd by XLA.
     ``apply_flops_per_vertex``: per-vertex update cost in FLOP-lanes
-    (pagerank: mul+add+div = 3; CF: ~3 per lane)."""
+    (pagerank: mul+add+div = 3; CF: ~3 per lane).
+    ``compact_unique``: total unique in-sources over all parts when the
+    compact-gather mirror is on (graph/shards.build_compact_mirror; the
+    reference's load_kernel staging, pagerank_gpu.cu:34-47).  In this
+    COALESCED-MIN model the mirror costs extra: per unique source one
+    mirror_pos read + state read + mirror write on top of the per-edge
+    read — the win it buys is off-model (it shrinks the per-edge
+    gather's working set from P*nv_pad*v to U*v bytes, attacking the
+    8-128x random-gather amplification this model excludes by
+    construction).  The A/B on hardware decides."""
     v = state_bytes * width
     gather = 4 + v + (4 if weighted else 0) + ((4 + v) if needs_dst else 0)
+    if compact_unique:
+        gather_extra = compact_unique * (4 + 2 * v)
+    else:
+        gather_extra = 0
     reduce_b = _reduce_bytes_per_edge(method, state_bytes, width)
     # apply: read old state + write new (+ degree int32 when the program
     # uses it — folded in as 4B: every shipped pull program reads it)
     vertex = 2 * v + 4
-    bytes_moved = ne * int(gather + reduce_b) + nv * vertex
+    bytes_moved = ne * int(gather + reduce_b) + nv * vertex + gather_extra
     # useful: 1 combine per edge lane (+ edge_value arithmetic for
     # weighted/dst programs: err = w - <u,v> is 2w FLOPs, err*vec is w)
     edge_flops = width + (3 * width if needs_dst else 0)
@@ -159,13 +173,16 @@ def push_run_model(
     method: str = "scan",
     state_bytes: int = 4,
     weighted: bool = False,
+    compact_unique: int = 0,
 ) -> TrafficModel:
     """A whole frontier-app run: ``dense_rounds`` full pull-style sweeps
     (direction-optimized dense mode walks every in-edge) + the remaining
     ``traversed - dense_rounds*ne`` sparse frontier edges.  Matches the
-    engine's exact accounting (PushCarry.edges / dense_rounds)."""
+    engine's exact accounting (PushCarry.edges / dense_rounds).
+    ``compact_unique``: see pull_iter_model (dense rounds only)."""
     dense = pull_iter_model(
-        ne, nv, method, state_bytes, 1, weighted, False, 1
+        ne, nv, method, state_bytes, 1, weighted, False, 1,
+        compact_unique=compact_unique,
     ).scale(dense_rounds)
     sparse_edges = max(0, traversed - dense_rounds * ne)
     sparse = push_sparse_edge_model(state_bytes, weighted).scale(sparse_edges)

@@ -1,0 +1,189 @@
+"""Compact-gather layout (graph/shards.build_compact_mirror): the
+unique-in-source mirror must reconstruct src_pos exactly, so every
+engine path (pull fixed, push dense rounds, distributed, adaptive
+recuts) is BITWISE identical to the direct layout — only the gather
+traffic shape changes.  Reference parity: the per-GPU unique in-vertex
+list + load_kernel FB staging (pagerank_gpu.cu:229-240, 34-47)."""
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lux_tpu.engine import pull
+from lux_tpu.graph import generate
+from lux_tpu.graph.shards import build_compact_mirror, build_pull_shards
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.models import sssp as sssp_model
+from lux_tpu.models.pagerank import PageRankProgram
+from lux_tpu.parallel import mesh as mesh_lib
+
+
+def _shards_pair(g, P, **kw):
+    return (build_pull_shards(g, P, **kw),
+            build_pull_shards(g, P, compact_gather=True, **kw))
+
+
+@pytest.mark.parametrize("P", [1, 4])
+def test_mirror_reconstructs_src_pos(P):
+    g = generate.rmat(11, 8, seed=7)
+    _, sh = _shards_pair(g, P)
+    a = sh.arrays
+    assert a.mirror_pos.shape[1] % 128 == 0
+    for p in range(P):
+        m = a.edge_mask[p]
+        assert (a.mirror_pos[p][a.mirror_rel[p]][m] == a.src_pos[p][m]).all()
+        u = np.unique(a.src_pos[p][m])
+        # sorted unique prefix, padded with zeros
+        assert (a.mirror_pos[p][: len(u)] == u).all()
+        # the whole point: per-part unique in-sources < the gathered size
+        assert len(u) < sh.spec.gathered_size
+
+
+def test_pull_fixed_bitwise_equal():
+    g = generate.rmat(11, 8, seed=8)
+    for P in (1, 4):
+        sh_a, sh_b = _shards_pair(g, P)
+        prog = PageRankProgram(nv=g.nv)
+        for method in ("scan", "scatter"):
+            outs = []
+            for sh in (sh_a, sh_b):
+                arr = jax.tree.map(jnp.asarray, sh.arrays)
+                s0 = pull.init_state(prog, arr)
+                outs.append(np.asarray(pull.run_pull_fixed(
+                    prog, sh.spec, sh.arrays, s0, 4, method=method)))
+            assert (outs[0] == outs[1]).all(), (P, method)
+
+
+def test_compact_composes_with_sort_segments():
+    g = generate.rmat(11, 8, seed=9)
+    sh_sorted = build_pull_shards(g, 3, sort_segments=True)
+    sh_both = build_pull_shards(g, 3, sort_segments=True,
+                                compact_gather=True)
+    # the mirror remap is monotone, so the sorted relayout survives
+    assert (sh_sorted.arrays.src_pos == sh_both.arrays.src_pos).all()
+    prog = PageRankProgram(nv=g.nv)
+    outs = []
+    for sh in (sh_sorted, sh_both):
+        arr = jax.tree.map(jnp.asarray, sh.arrays)
+        s0 = pull.init_state(prog, arr)
+        outs.append(np.asarray(pull.run_pull_fixed(
+            prog, sh.spec, sh.arrays, s0, 4, method="scan")))
+    assert (outs[0] == outs[1]).all()
+
+
+def test_push_dense_rounds_bitwise_equal():
+    """SSSP (direction-optimized; dense rounds carry the mirror) agrees
+    bitwise with the direct layout and the BFS oracle."""
+    g = generate.rmat(10, 8, seed=10)
+    sh_a = build_push_shards(g, 3)
+    sh_b = build_push_shards(g, 3, compact_gather=True)
+    assert sh_b.pull.arrays.mirror_pos.shape[1] > 0
+    d_a = sssp_model.sssp(sh_a, start=1)
+    d_b = sssp_model.sssp(sh_b, start=1)
+    assert (d_a == d_b).all()
+    assert (d_b == sssp_model.bfs_reference(g, 1)).all()
+
+
+def test_pull_dist_bitwise_equal():
+    """Distributed pull (shard_map all_gather exchange) with the mirror
+    equals the direct distributed run bitwise."""
+    from lux_tpu.parallel import dist
+
+    g = generate.rmat(11, 8, seed=11)
+    P = 8
+    msh = mesh_lib.make_mesh(P)
+    prog = PageRankProgram(nv=g.nv)
+    outs = []
+    for compact in (False, True):
+        sh = build_pull_shards(g, P, compact_gather=compact)
+        arr = jax.tree.map(jnp.asarray, sh.arrays)
+        s0 = pull.init_state(prog, arr)
+        outs.append(np.asarray(dist.run_pull_fixed_dist(
+            prog, sh.spec, sh.arrays, s0, 4, msh, method="scan")))
+    assert (outs[0] == outs[1]).all()
+
+
+def test_adaptive_recut_keeps_compact():
+    """run_push_adaptive(compact_gather=True): recut rebuilds keep the
+    mirror; ring exchange rejects it."""
+    from lux_tpu.engine import repartition
+
+    g = generate.rmat(10, 8, seed=12)
+    prog = sssp_model.SSSPProgram(nv=g.nv, start=1)
+    res = repartition.run_push_adaptive(
+        prog, g, 2, chunk=2, threshold=1.0, compact_gather=True,
+    )
+    assert res.shards.pull.arrays.mirror_pos.shape[1] > 0
+    base = sssp_model.sssp(g, start=1, num_parts=2)
+    got = res.shards.pull.scatter_to_global(np.asarray(res.stacked))
+    assert (got[: g.nv] == base).all()
+    with pytest.raises(ValueError, match="compact_gather"):
+        repartition.run_push_adaptive(
+            prog, g, 2, chunk=2, mesh=None, compact_gather=True,
+            exchange="ring",
+        )
+
+
+def test_cli_compact_gather():
+    """--compact-gather on a pull app (end-to-end CLI) and the ring
+    rejection."""
+    import os
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "lux_tpu.apps.pagerank", "--rmat-scale", "9",
+         "-ni", "5", "--compact-gather", "-check"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "[PASS]" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, "-m", "lux_tpu.apps.pagerank", "--rmat-scale", "9",
+         "-ng", "8", "--distributed", "--exchange", "ring",
+         "--compact-gather"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r2.returncode != 0
+    assert "--compact-gather" in r2.stderr
+    # feat-sharded CF has its own layout: the flag must be rejected, not
+    # silently dropped
+    r3 = subprocess.run(
+        [sys.executable, "-m", "lux_tpu.apps.colfilter", "--rmat-scale", "9",
+         "-ng", "2", "--distributed", "--feat-shards", "2",
+         "--compact-gather"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r3.returncode != 0
+    assert "--compact-gather" in r3.stderr
+
+
+def test_empty_part_mirror():
+    """A part with zero edges gets a valid all-zeros mirror row (clip
+    path) and the engine still runs."""
+    # star graph: all edges into vertex 0 -> later parts can be edge-free
+    edges = np.array([[i, 0] for i in range(1, 64)], np.int64)
+    from lux_tpu.graph.csc import from_edge_list
+
+    g = from_edge_list(edges[:, 0], edges[:, 1], nv=64)
+    sh = build_pull_shards(g, 4, compact_gather=True)
+    empty = [p for p in range(4) if not sh.arrays.edge_mask[p].any()]
+    assert empty, "expected at least one edge-free part"
+    prog = PageRankProgram(nv=g.nv)
+    arr = jax.tree.map(jnp.asarray, sh.arrays)
+    s0 = pull.init_state(prog, arr)
+    out = pull.run_pull_fixed(prog, sh.spec, sh.arrays, s0, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_build_compact_mirror_idempotent_width():
+    """Re-attaching the mirror to already-compact arrays reproduces it
+    (unique of src_pos is stable)."""
+    g = generate.rmat(10, 6, seed=13)
+    sh = build_pull_shards(g, 2, compact_gather=True)
+    again = build_compact_mirror(sh.arrays)
+    assert (again.mirror_pos == sh.arrays.mirror_pos).all()
+    assert (again.mirror_rel == sh.arrays.mirror_rel).all()

@@ -65,6 +65,18 @@ run pallas_sweep 5400 python tools/tpu_pallas_check.py --scale 18 --sweep
 run probe_sortseg 3600 python tools/tpu_component_probe.py \
     --scale 20 --ef 16 --reps 1 4 16 --sort-segments
 
+# 2c) compact-gather A/B (VERDICT r4 #3): the unique-in-source mirror
+#     vs the direct gather, same method both sides (scatter completes
+#     reliably on-chip; probe rows gather vs gather_c give the
+#     component-level answer, this gives the end-to-end one).  Pagerank
+#     only: the other bench apps ignore the compact env and would just
+#     re-measure default-layout numbers on A/B time.
+LUX_BENCH_WATCHDOG_S=1100 LUX_BENCH_TPU_S=900 \
+  LUX_BENCH_COMPACT_GATHER=1 LUX_BENCH_APPS=pagerank \
+  LUX_BENCH_METHOD=${LUX_COMPACT_AB_METHOD:-scatter} \
+  LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
+  run bench_compact 1200 python bench.py
+
 # 3) single-chip HBM ceiling vs preflight (VERDICT r1 #7)
 run scale_check 5400 python tools/tpu_scale_check.py --min-scale 18 --max-scale 24
 

@@ -110,6 +110,21 @@ def main(argv=None):
         # bandwidth-trivial next to the ne random reads
         return x[src_pos].reshape(g.nv, args.ef).sum(axis=1) * 1e-3
 
+    # compact-gather A/B (graph/shards.build_compact_mirror semantics,
+    # whole graph as one part): sorted unique sources + per-edge remap —
+    # the two-stage load_kernel staging vs the direct random gather
+    uniq = np.unique(col)
+    mirror_pos = jnp.asarray(uniq.astype(np.int32))
+    mirror_rel = jnp.asarray(
+        np.searchsorted(uniq, col).astype(np.int32))
+    jax.block_until_ready((mirror_pos, mirror_rel))
+    print(f"# compact mirror: U={len(uniq)} ({len(uniq)/g.nv:.2f} of nv)",
+          flush=True)
+
+    def c_gather_c(x):
+        mirror = x[mirror_pos]
+        return mirror[mirror_rel].reshape(g.nv, args.ef).sum(axis=1) * 1e-3
+
     def c_scan(x):
         vals = vals_fixed * x[0]
         acc = segment.segment_sum_csc(vals, row_ptr, head_flag, dst_local,
@@ -165,6 +180,7 @@ def main(argv=None):
     # before risking it
     comps = {
         "gather": c_gather,
+        "gather_c": c_gather_c,
         "scatter": c_scatter,
         "cumsum": c_cumsum,
         "mxsum": c_mxsum,
