@@ -170,6 +170,21 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 "--repartition-every runs the engine in windows; the "
                 "per-iteration -verbose fence is not available"
             )
+    if getattr(cfg, "delta", 0):
+        if not getattr(cfg, "weighted", False):
+            raise SystemExit(
+                "--delta orders WEIGHTED distances into buckets; "
+                "unweighted BFS already expands one hop-bucket per "
+                "iteration — add --weighted"
+            )
+        if (cfg.distributed or cfg.exchange != "allgather"
+                or cfg.method == "pallas" or cfg.verbose
+                or cfg.ckpt_every or cfg.repartition_every):
+            raise SystemExit(
+                "--delta is the single-device bucketed driver; it does "
+                "not combine with --distributed/--exchange/--method "
+                "pallas/-verbose/--ckpt-every/--repartition-every"
+            )
     if cfg.method == "pallas":
         est = preflight.estimate_push_pallas(
             shards.spec, shards.pspec, shards.pl.e_src_pos.shape[1],
@@ -271,6 +286,12 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
             state, iters, edges = pd.run_push_pallas_dist(
                 prog, shards, mesh, cfg.max_iters, interpret=interp
             )
+        elif getattr(cfg, "delta", 0) and mesh is None:
+            from lux_tpu.engine import delta as delta_mod
+
+            state, iters, edges = delta_mod.run_push_delta(
+                prog, shards, cfg.delta, cfg.max_iters, cfg.method
+            )
         elif mesh is None:
             state, iters, edges = push.run_push(
                 prog, shards, cfg.max_iters, cfg.method
@@ -314,6 +335,9 @@ def main(argv=None):
             "weighted SSSP uses integer edge costs; got dtype "
             + str(g.weights.dtype)
         )
+    if cfg.delta and cfg.weighted and int(g.weights.min()) < 0:
+        raise SystemExit("--delta needs non-negative edge weights "
+                         "(bucket order breaks under negative costs)")
     shards = build_push_app_shards(g, cfg)
     cls = (
         sssp_model.WeightedSSSPProgram if cfg.weighted

@@ -1,0 +1,264 @@
+"""Host-offload edge streaming: pull iterations for graphs whose edge
+arrays exceed one chip's HBM.
+
+The reference's capacity story is zero-copy host memory: whole-region
+state lives in ZC and the mapper stages slices into framebuffer on
+demand (core/lux_mapper.cc:146-165), so one GPU can process a partition
+bigger than its FB.  The TPU analog here: the O(nv) vertex state stays
+device-resident (it is small), the O(ne) edge arrays stay in HOST
+memory, and each iteration streams them through the device in
+fixed-size chunks:
+
+    for chunk in part: device_put(next chunk)   # async, overlaps ...
+                       partial = gather+reduce(current chunk)  # ... this
+    acc = combine(partials); state = apply(acc)
+
+Chunks are CSC edge ranges, so a chunk is a contiguous run of
+destination segments (possibly splitting one segment at each border).
+Per chunk the GLOBAL row_ptr is re-based and clipped to the chunk
+(`np.clip(row_ptr - lo, 0, chunk_e)`), head flags are rebuilt from the
+re-based pointers, and the standard segmented reduce
+(ops/segment.reducers) runs unchanged; cross-chunk combination is the
+reduce's own op (add / minimum / maximum), so min/max results are
+BITWISE identical to the monolithic engine and sums differ only in
+association order.  One (prog, method, shapes) compile serves every
+chunk of every iteration — chunks share a static padded shape.
+
+`jax.device_put` is dispatched asynchronously: the next chunk's
+host->device transfer is issued BEFORE the current chunk's compute is
+consumed, double-buffering the stream (2 chunks resident, the
+`dist_lr[2]` ping-pong of core/graph.h:83 but across the host link).
+Peak resident edge bytes are `streamed_hbm_bytes(...)` — the capacity
+contract tests/biggraph assert against the configured budget.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine import methods
+from lux_tpu.graph.shards import (
+    LANE, PullShards, ShardArrays, ShardSpec, alloc_arrays,
+)
+from lux_tpu.ops import segment
+
+_REDUCERS = segment.reducers()
+_COMBINE = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+
+
+class StreamChunk(NamedTuple):
+    """Edge arrays for ONE chunk of one part — the unit that is
+    device_put per step.  A jax pytree (device_put maps it).
+
+    Host storage holds only the O(chunk_e) fields; the (V+1,) re-based
+    row_ptr is DERIVED at device_put time from the part's single global
+    row_ptr (`_rebased_row_ptr`) — storing it per chunk would cost
+    O(P * n_chunks * V) host bytes, which at the RMAT27 target is GiBs
+    of row_ptr copies on the very machine the capacity feature exists
+    to relieve."""
+
+    row_ptr: Any    # (V+1,) int32 re-based to the chunk, clipped
+    src_pos: Any    # (chunk_e,) int32 gather positions
+    dst_local: Any  # (chunk_e,) int32 (padding -> nv_pad sentinel)
+    head_flag: Any  # (chunk_e,) bool rebuilt from the re-based row_ptr
+    weights: Any    # (chunk_e,) float32
+
+
+class _HostChunk(NamedTuple):
+    """Stored form of a chunk: edge arrays + the chunk's base offset."""
+
+    lo: int
+    src_pos: Any
+    dst_local: Any
+    head_flag: Any
+    weights: Any
+
+
+@dataclasses.dataclass
+class StreamedPullShards:
+    """Host bundle: chunked edge arrays + device-residable vertex side."""
+
+    spec: ShardSpec
+    cuts: np.ndarray
+    chunk_e: int
+    #: chunks[p][c] — per part, _HostChunk for edge range [c*chunk_e, ...)
+    chunks: list
+    #: row_ptrs[p] — the part's ONE global (V+1,) int64 row_ptr; chunks
+    #: re-base from it at device_put time
+    row_ptrs: list
+    #: vertex-side ShardArrays (P, V) with ZERO-width edge arrays — all
+    #: any program's init_state/apply reads (degree/vtx_mask/global_vid)
+    varrays: ShardArrays
+
+    def scatter_to_global(self, stacked):
+        from lux_tpu.graph.shards import stacked_to_global
+
+        return stacked_to_global(self.cuts, stacked)
+
+
+def streamed_hbm_bytes(spec: ShardSpec, chunk_e: int,
+                       state_bytes: int = 4) -> int:
+    """Peak device bytes of the streamed engine: full state + gathered
+    copy + accumulator + TWO resident chunks (double buffer)."""
+    per_chunk = chunk_e * (4 + 4 + 1 + 4) + (spec.nv_pad + 1) * 4
+    state = spec.num_parts * spec.nv_pad * state_bytes
+    return 2 * per_chunk + 3 * state
+
+
+def edge_bytes_total(spec: ShardSpec) -> int:
+    """Monolithic-engine device edge bytes (what streaming avoids)."""
+    return spec.num_parts * spec.e_pad * (4 + 4 + 1 + 1 + 4)
+
+
+def chunk_edges_for_budget(spec: ShardSpec, budget_bytes: int,
+                           state_bytes: int = 4) -> int:
+    """Largest LANE-aligned chunk_e whose streamed footprint fits the
+    budget (>= one LANE; raises if even that cannot fit)."""
+    fixed = streamed_hbm_bytes(spec, 0, state_bytes)  # state + 2 row_ptrs
+    per_edge = 2 * (4 + 4 + 1 + 4)  # double-buffered src/dst/head/weight
+    chunk_e = max(0, budget_bytes - fixed) // per_edge // LANE * LANE
+    if chunk_e <= 0:
+        raise ValueError(
+            f"HBM budget {budget_bytes} cannot hold even one {LANE}-edge "
+            f"chunk plus the state ({fixed} fixed bytes)"
+        )
+    return min(chunk_e, spec.e_pad)
+
+
+def build_streamed_pull(shards: PullShards, chunk_e: int
+                        ) -> StreamedPullShards:
+    """Chunk an in-memory pull layout for streaming.  ``chunk_e`` is the
+    static per-chunk edge capacity (LANE-aligned; from
+    chunk_edges_for_budget for a byte budget)."""
+    if chunk_e % LANE:
+        raise ValueError(f"chunk_e must be a multiple of {LANE}")
+    spec, arrays = shards.spec, shards.arrays
+    P, V, E = spec.num_parts, spec.nv_pad, spec.e_pad
+    n_chunks = -(-E // chunk_e)
+    chunks: list = []
+    row_ptrs: list = []
+    for p in range(P):
+        rp = arrays.row_ptr[p].astype(np.int64)
+        row_ptrs.append(rp)
+        part_chunks = []
+        for c in range(n_chunks):
+            lo, hi = c * chunk_e, min((c + 1) * chunk_e, E)
+            m = hi - lo
+            rp_c = _rebased_row_ptr(rp, lo, chunk_e)
+            head = np.zeros(chunk_e, bool)
+            starts = rp_c[:V][rp_c[:V] < rp_c[1 : V + 1]]
+            head[starts] = True
+            dst = np.full(chunk_e, V, np.int32)
+            dst[:m] = arrays.dst_local[p, lo:hi]
+            src = np.zeros(chunk_e, np.int32)
+            src[:m] = arrays.src_pos[p, lo:hi]
+            w = np.zeros(chunk_e, np.float32)
+            w[:m] = arrays.weights[p, lo:hi]
+            part_chunks.append(_HostChunk(lo, src, dst, head, w))
+        chunks.append(part_chunks)
+    varrays = alloc_arrays(P, V, 0)._replace(
+        vtx_mask=arrays.vtx_mask.copy(),
+        degree=arrays.degree.copy(),
+        global_vid=arrays.global_vid.copy(),
+    )
+    return StreamedPullShards(
+        spec=spec, cuts=shards.cuts, chunk_e=chunk_e, chunks=chunks,
+        row_ptrs=row_ptrs, varrays=varrays,
+    )
+
+
+def _rebased_row_ptr(rp: np.ndarray, lo: int, chunk_e: int) -> np.ndarray:
+    """The chunk-local (V+1,) int32 row_ptr: a pure function of the
+    part's global row_ptr and the chunk base (derived per transfer, not
+    stored per chunk)."""
+    return np.clip(rp - lo, 0, chunk_e).astype(np.int32)
+
+
+def _put_chunk(sh: StreamedPullShards, p: int, c: int):
+    """Assemble and (async) transfer one chunk's device pytree."""
+    hc = sh.chunks[p][c]
+    return jax.device_put(StreamChunk(
+        _rebased_row_ptr(sh.row_ptrs[p], hc.lo, sh.chunk_e),
+        hc.src_pos, hc.dst_local, hc.head_flag, hc.weights,
+    ))
+
+
+@lru_cache(maxsize=64)
+def _compiled_chunk_partial(prog, method: str):
+    @jax.jit
+    def f(chunk: StreamChunk, full_state, local_state):
+        src_state = full_state[chunk.src_pos]
+        dst_state = local_state[
+            jnp.clip(chunk.dst_local, 0, local_state.shape[0] - 1)
+        ]
+        vals = prog.edge_value(src_state, chunk.weights, dst_state)
+        return _REDUCERS[prog.reduce](
+            vals, chunk.row_ptr, chunk.head_flag, chunk.dst_local,
+            method=method,
+        )
+
+    return f
+
+
+@lru_cache(maxsize=64)
+def _compiled_apply(prog):
+    @jax.jit
+    def f(local_state, acc, varr_p):
+        return prog.apply(local_state, acc, varr_p)
+
+    return f
+
+
+def run_pull_fixed_streamed(
+    prog,
+    sh: StreamedPullShards,
+    state0,
+    num_iters: int,
+    method: str = "auto",
+    prefetch: bool = True,
+):
+    """Fixed-iteration pull with host-resident edges.  ``prefetch=False``
+    disables the double buffer (serial transfer->compute; the A/B knob
+    for measuring the overlap win).  Returns the final (P, V, ...)
+    stacked state (device)."""
+    method = methods.resolve(method, prog.reduce)
+    spec = sh.spec
+    P = spec.num_parts
+    step = _compiled_chunk_partial(prog, method)
+    apply_f = _compiled_apply(prog)
+    varr = jax.tree.map(jnp.asarray, sh.varrays)
+    state = jnp.asarray(state0)
+    for _ in range(num_iters):
+        full = state.reshape((spec.gathered_size,) + state.shape[2:])
+        new_parts = []
+        dev = _put_chunk(sh, 0, 0)
+        for p in range(P):
+            acc = None
+            n_chunks = len(sh.chunks[p])
+            for c in range(n_chunks):
+                cur = dev
+                nxt = (p, c + 1) if c + 1 < n_chunks else (
+                    (p + 1, 0) if p + 1 < P else None
+                )
+                if prefetch and nxt is not None:
+                    # issue the next transfer BEFORE consuming this
+                    # chunk's compute: XLA executes the enqueued step
+                    # while the host link moves the next chunk
+                    dev = _put_chunk(sh, *nxt)
+                part = step(cur, full, state[p])
+                acc = part if acc is None else _COMBINE[prog.reduce](acc, part)
+                if not prefetch:
+                    jax.block_until_ready(acc)  # finish compute ...
+                    if nxt is not None:  # ... before the next transfer
+                        dev = _put_chunk(sh, *nxt)
+                        jax.block_until_ready(dev)
+            new_parts.append(apply_f(
+                state[p], acc, jax.tree.map(lambda a: a[p], varr)
+            ))
+        state = jnp.stack(new_parts)
+    return state

@@ -148,12 +148,16 @@ def sssp(
     exchange: str = "allgather",
     repartition_every: int = 0,
     repartition_threshold: float = 1.25,
+    delta: int = 0,
 ) -> np.ndarray:
     """Run SSSP from ``start``; returns (nv,) int32 distances, nv == INF.
     ``exchange="ring"`` (with a mesh) streams dense rounds instead of
     all-gathering the state.  ``repartition_every > 0`` rebalances the
     vertex cuts from measured per-part load every N iterations (the Lux
-    paper's dynamic repartitioning; engine/repartition.py)."""
+    paper's dynamic repartitioning; engine/repartition.py).
+    ``delta > 0`` selects the delta-stepping bucketed-priority driver
+    (weighted single-device runs; engine/delta.py) — same distances,
+    far fewer relaxed edges than chaotic relaxation."""
     from lux_tpu.parallel.ring import PushRingShards
 
     shards = (
@@ -174,6 +178,25 @@ def sssp(
             )
     cls = WeightedSSSPProgram if weighted else SSSPProgram
     prog = cls(nv=shards.spec.nv, start=start)
+    if delta > 0:
+        if not weighted:
+            raise ValueError("delta-stepping orders WEIGHTED distances; "
+                             "unweighted BFS buckets are the iterations")
+        if mesh is not None or exchange != "allgather" or repartition_every:
+            raise ValueError(
+                "delta-stepping is a single-device allgather driver"
+            )
+        # check the SHARDS' weights (covers pre-built PushShards too —
+        # bucket order silently finalizes too early under negative
+        # costs; padding slots are 0.0 so only real negatives trip)
+        if float(np.asarray(shards.arrays.weights).min()) < 0:
+            raise ValueError("delta-stepping needs non-negative weights")
+        from lux_tpu.engine import delta as delta_mod
+
+        final, _, _ = delta_mod.run_push_delta(
+            prog, shards, delta, max_iters, method=method
+        )
+        return shards.scatter_to_global(np.asarray(final))
     return _push_run(
         prog, g, shards, mesh, max_iters, method, exchange, num_parts,
         repartition_every, repartition_threshold,

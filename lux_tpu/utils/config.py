@@ -40,6 +40,8 @@ class RunConfig:
     #: sum programs only)
     exchange: str = "allgather"
     weighted: bool = False  # SSSP: relax with edge weights (Dijkstra-style)
+    #: >0 = delta-stepping bucket width for weighted SSSP (engine/delta.py)
+    delta: int = 0
     dtype: str = "float32"  # state storage dtype (pagerank/CF)
     #: >1 = 2-D (parts x edge) mesh: each part's edges split over this many
     #: chips, partial reductions psum'd (for parts too big for one chip)
@@ -143,6 +145,12 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
     if sssp:
         ap.add_argument("--weighted", action="store_true",
                         help="relax with edge weights (Dijkstra-style)")
+        ap.add_argument("--delta", type=int, default=0,
+                        help="delta-stepping bucket width (weighted "
+                             "single-device runs): expand only pending "
+                             "vertices with dist < current bucket — "
+                             "near-Dijkstra edge counts (0 = chaotic "
+                             "relaxation)")
     ns = ap.parse_args(argv)
     if ns.ckpt_every and not ns.ckpt_dir:
         ap.error("--ckpt-every requires --ckpt-dir")
@@ -164,6 +172,7 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         profile_dir=ns.profile_dir,
         exchange=getattr(ns, "exchange", "allgather"),
         weighted=getattr(ns, "weighted", False),
+        delta=getattr(ns, "delta", 0),
         dtype=getattr(ns, "dtype", "float32"),
         edge_shards=getattr(ns, "edge_shards", 1),
         feat_shards=getattr(ns, "feat_shards", 1),

@@ -132,8 +132,13 @@ def test_cli_compact_gather():
     rejection."""
     import os
 
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    # forced-CPU child env: PYTHONPATH pinned to the repo root (NOT the
+    # inherited path — the axon sitecustomize would register the TPU
+    # plugin at interpreter start and hang when the relay is wedged)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run(
         [sys.executable, "-m", "lux_tpu.apps.pagerank", "--rmat-scale", "9",
          "-ni", "5", "--compact-gather", "-check"],

@@ -12,7 +12,11 @@ TOOL = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 
 
 def test_micro_race_cpu(tmp_path):
-    env = dict(os.environ)
+    # forced-CPU child env: PYTHONPATH pinned to the repo root so the
+    # axon sitecustomize can never hang the workers on a wedged relay
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["PYTHONPATH"] = repo
     env["JAX_PLATFORMS"] = "cpu"
     env["LUX_METHOD_WINNERS"] = str(tmp_path / "w.json")
     r = subprocess.run(

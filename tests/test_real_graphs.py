@@ -116,6 +116,24 @@ def test_lesmis_weighted_sssp_vs_dijkstra(lesmis_lux):
         assert got[i] == int(want[n]), (i, n)
 
 
+def test_lesmis_delta_stepping(lesmis_lux):
+    """Delta-stepping on the real coappearance weights: identical
+    distances, strictly fewer traversed edges than chaotic relaxation
+    (VERDICT r4 #4 done-criterion on a non-synthetic graph)."""
+    from lux_tpu.engine import delta as delta_mod
+    from lux_tpu.engine import push
+    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.models.sssp import WeightedSSSPProgram
+
+    g = read_lux(lesmis_lux)
+    shards = build_push_shards(g, 2)
+    prog = WeightedSSSPProgram(nv=shards.spec.nv, start=0)
+    st_c, _, e_c = push.run_push(prog, shards)
+    st_d, _, e_d = delta_mod.run_push_delta(prog, shards, delta=2)
+    assert (np.asarray(st_c) == np.asarray(st_d)).all()
+    assert push.edges_total(e_d) < push.edges_total(e_c)
+
+
 def test_lesmis_cli_apps_with_check(lesmis_lux, karate_lux, capsys):
     """The four app CLIs on real files: -check passes where the
     reference ships a checker (sssp/components), and the weighted CF

@@ -50,6 +50,12 @@ def main(argv=None):
         "--bucket-cap-gib", type=float, default=40.0,
         help="skip a bucket exchange whose padded arrays would exceed this",
     )
+    ap.add_argument(
+        "--stream-hbm-gib", type=float, default=0.0,
+        help="also run single-device host-offload streamed PageRank "
+             "under this device-byte budget (must be below the edge "
+             "arrays' total; engine/stream.py)",
+    )
     args = ap.parse_args(argv)
     t_all = time.monotonic()
 
@@ -96,6 +102,60 @@ def main(argv=None):
     note("subset_load", parts=2, sub_gib=round(sub_bytes / (1 << 30), 3),
          load_s=round(time.monotonic() - t0, 1))
     del sub
+
+    if args.stream_hbm_gib:
+        # --- host-offload streaming: ONE device whose edge arrays exceed
+        #     the configured HBM budget (the ZC-memory analog,
+        #     core/lux_mapper.cc:146-165; engine/stream.py).  Runs BEFORE
+        #     the P-part full load so the single-part copy + chunk copies
+        #     never coexist with the monolithic arrays (peak-RSS honesty).
+        import jax
+
+        from lux_tpu.engine import pull as pull_eng
+        from lux_tpu.engine import stream as stream_eng
+        from lux_tpu.models.pagerank import PageRankProgram
+
+        t0 = time.monotonic()
+        p1 = sharded_load.load_pull_shards(path, 1, degrees=degrees)
+        budget = int(args.stream_hbm_gib * (1 << 30))
+        total_edge = stream_eng.edge_bytes_total(p1.spec)
+        chunk_e = stream_eng.chunk_edges_for_budget(p1.spec, budget)
+        resident = stream_eng.streamed_hbm_bytes(p1.spec, chunk_e)
+        if not resident <= budget < total_edge:
+            raise SystemExit(
+                f"--stream-hbm-gib {args.stream_hbm_gib}: budget "
+                f"({budget} B) must sit between the streamed footprint "
+                f"({resident} B at chunk_e={chunk_e}) and the full edge "
+                f"arrays ({total_edge} B) for the capacity proof to "
+                f"mean anything — pick a smaller budget or bigger scale"
+            )
+        ssh = stream_eng.build_streamed_pull(p1, chunk_e)
+        prog1 = PageRankProgram(nv=nv)
+        state0 = pull_eng.init_state(
+            prog1, jax.tree.map(np.asarray, p1.arrays))
+        del p1  # chunks hold copies; drop the monolithic edge arrays
+        note("stream_built", chunk_e=chunk_e,
+             n_chunks=len(ssh.chunks[0]),
+             resident_gib=round(resident / (1 << 30), 3),
+             edge_total_gib=round(total_edge / (1 << 30), 3),
+             build_s=round(time.monotonic() - t0, 1))
+        # warm the compiles so the A/B times transfers, not tracing
+        jax.block_until_ready(stream_eng.run_pull_fixed_streamed(
+            prog1, ssh, state0, 1))
+        times = {}
+        for prefetch in (True, False):
+            t0 = time.monotonic()
+            out = stream_eng.run_pull_fixed_streamed(
+                prog1, ssh, state0, args.iters, prefetch=prefetch)
+            out = jax.device_get(out)
+            times[prefetch] = time.monotonic() - t0
+            note("stream_pagerank", prefetch=prefetch, iters=args.iters,
+                 run_s=round(times[prefetch], 1),
+                 gteps=round(args.iters * ne / times[prefetch] / 1e9, 4),
+                 top_rank=float(np.max(out)))
+        note("stream_overlap",
+             speedup=round(times[False] / max(times[True], 1e-9), 3))
+        del ssh, state0, out
 
     # --- full load from file (every part via partial range reads) ---
     t0 = time.monotonic()

@@ -83,4 +83,11 @@ run scale_check 5400 python tools/tpu_scale_check.py --min-scale 18 --max-scale 
 # 4) four-app table
 run bench_all 3600 python tools/bench_all.py --scale 18 --iters 10
 
+# 5) host-offload streaming on the real chip (capacity feature: edge
+#    arrays exceed the budget, streamed through HBM in chunks; the
+#    host->device link through the tunnel is the unknown being measured
+#    — kept last and small: scale 20 with a budget forcing ~4 chunks)
+run stream_check 2400 python tools/biggraph_check.py --scale 20 \
+    --parts 8 --iters 2 --skip-sssp --stream-hbm-gib 0.15
+
 echo "battery done ($(date +%H:%M:%S)); fold results into BASELINE.md"
