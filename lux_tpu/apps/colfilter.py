@@ -103,8 +103,9 @@ def _run_feat(cfg, g, prog):
     est = common.estimate_exchange(
         shards, cfg, state_width=cf_model.K // cfg.feat_shards
     )
-    print(est)
-    preflight.check_fits(est)
+    common.report_preflight(
+        est, cfg, shards, state_width=cf_model.K // cfg.feat_shards
+    )
     # k-resident parts when num_parts exceeds the available parts slots
     # (the mapper-slicing analog, same as every other distributed driver)
     mesh = feat.make_mesh_feat_for_parts(cfg.num_parts, cfg.feat_shards)
@@ -141,8 +142,7 @@ def main(argv=None):
         return _run_feat(cfg, g, prog)
     shards = common.build_exchange_shards(g, cfg)
     est = common.estimate_exchange(shards, cfg, state_width=cf_model.K)
-    print(est)
-    preflight.check_fits(est)
+    common.report_preflight(est, cfg, shards, state_width=cf_model.K)
 
     mesh = common.make_mesh_if(cfg)
     # single-device paths use device-placed arrays; distributed drivers

@@ -267,6 +267,31 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
     return preflight.scale_residency(est, _residency(cfg))
 
 
+def report_preflight(est, cfg: RunConfig, shards, state_width: int = 1):
+    """Print the estimate and warn if it exceeds device HBM — with the
+    --edge-shards hint when (and only when) a 2-D run could actually
+    execute here: 1-D allgather pull layout, non-pallas, and enough
+    devices for num_parts * EP part-columns (edge2d has no
+    k-residency).  One implementation for every pull app, so the hint
+    can't drift per driver."""
+    from lux_tpu.utils import preflight
+
+    print(est)
+    spec = None
+    max_ep = 0
+    if (cfg.exchange == "allgather" and cfg.edge_shards == 1
+            and cfg.feat_shards == 1 and cfg.method != "pallas"):
+        import jax
+
+        spec = shards.spec
+        max_ep = len(jax.devices()) // max(cfg.num_parts, 1)
+    return preflight.check_fits(
+        est, spec=spec, state_width=state_width,
+        state_dtype_bytes=2 if cfg.dtype == "bfloat16" else 4,
+        max_edge_shards=max_ep,
+    )
+
+
 def resume_or_init(cfg: RunConfig, app: str, shards, state, nv):
     """Elastic resume: restack the latest global checkpoint (any previous
     -ng/--exchange) onto THIS run's layout; returns (state, start_it)."""

@@ -99,8 +99,7 @@ def main(argv=None):
         return _run_pallas(cfg, g, prog)
     shards = common.build_exchange_shards(g, cfg)
     est = common.estimate_exchange(shards, cfg)
-    print(est)
-    preflight.check_fits(est)
+    common.report_preflight(est, cfg, shards)
 
     mesh = common.make_mesh_if(cfg)
     # device-place the pull arrays only on the single-device paths: the

@@ -111,10 +111,12 @@ def build_edge2d_shards(
     )
     Pn, EP, V = num_parts, num_edge_shards, spec.nv_pad
 
-    # global padded chunk size from per-part edge counts
+    # global padded chunk size from per-part edge counts (formula shared
+    # with the preflight hint, graph/shards.edge2d_chunk_pad)
+    from lux_tpu.graph.shards import edge2d_chunk_pad
+
     e_counts = np.asarray(g.row_ptr)[cuts[1:]] - np.asarray(g.row_ptr)[cuts[:-1]]
-    chunk_max = int(-(-int(e_counts.max()) // EP)) if len(e_counts) else 1
-    E2 = _round_up(max(1, chunk_max), LANE)
+    E2 = edge2d_chunk_pad(int(e_counts.max()) if len(e_counts) else 1, EP)
 
     src_pos = np.zeros((Pn, EP, E2), np.int32)
     dst_local = np.full((Pn, EP, E2), V, np.int32)

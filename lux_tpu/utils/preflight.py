@@ -158,8 +158,40 @@ def estimate_push_pallas(spec: ShardSpec, pspec: PushSpec, num_chunks: int,
     return MemoryEstimate(shard, state, gathered, shard + state + gathered)
 
 
-def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None) -> bool:
-    """Warn (returns False) if the estimate exceeds the device HBM."""
+def suggest_edge_shards(spec: ShardSpec, hbm_bytes: int,
+                        state_width: int = 1, state_dtype_bytes: int = 4,
+                        max_shards: int = 64) -> Optional[int]:
+    """Smallest edge-shard count EP >= 2 whose 2-D per-chip footprint
+    fits ``hbm_bytes`` — the auto-selection hint for a part whose edge
+    slice exceeds one device (the layout's reason to exist; the
+    reference simply cannot run this case, core/graph.h:31 one part ==
+    one GPU).  None if no EP <= max_shards fits (the gathered-state
+    replica is the irreducible floor: edge sharding divides only the
+    EDGE arrays).  Pass the RUN's state width/dtype (a bf16 estimate
+    judged with f32 candidates would over-reject).  ``max_shards``
+    should be capped by the caller at devices // num_parts — edge2d
+    keeps one part-column slot per device, no k-residency."""
+    from lux_tpu.graph.shards import edge2d_chunk_pad
+
+    for ep in range(2, max_shards + 1):
+        # conservative: e_pad >= the raw per-part max the builder uses,
+        # so a suggested EP always fits (formula shared with the builder)
+        e2 = edge2d_chunk_pad(spec.e_pad, ep)
+        est = estimate_edge2d(spec, e2, state_width, state_dtype_bytes)
+        if est.total_bytes <= hbm_bytes:
+            return ep
+    return None
+
+
+def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None,
+               spec: Optional[ShardSpec] = None, state_width: int = 1,
+               state_dtype_bytes: int = 4,
+               max_edge_shards: int = 64) -> bool:
+    """Warn (returns False) if the estimate exceeds the device HBM.
+    With ``spec`` (1-D pull layouts), the warning also names the
+    smallest --edge-shards that WOULD fit (suggest_edge_shards), sized
+    with the run's state width/dtype and capped at ``max_edge_shards``
+    (pass devices // num_parts; apps/common.report_preflight does)."""
     if hbm_bytes is None:
         try:
             import jax
@@ -171,9 +203,18 @@ def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None) -> bool:
     if hbm_bytes is None:
         return True
     if est.total_bytes > hbm_bytes:
+        hint = "increase num_parts"
+        if spec is not None and max_edge_shards >= 2:
+            ep = suggest_edge_shards(
+                spec, hbm_bytes, state_width, state_dtype_bytes,
+                max_shards=max_edge_shards,
+            )
+            if ep is not None:
+                hint = (f"increase num_parts, or split the edge arrays "
+                        f"with --edge-shards {ep}")
         print(
             f"WARNING: estimated {est.total_bytes/(1<<30):.2f} GiB exceeds "
-            f"device HBM {hbm_bytes/(1<<30):.2f} GiB — increase num_parts"
+            f"device HBM {hbm_bytes/(1<<30):.2f} GiB — {hint}"
         )
         return False
     return True
