@@ -177,13 +177,14 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 "unweighted BFS already expands one hop-bucket per "
                 "iteration — add --weighted"
             )
-        if (cfg.distributed or cfg.exchange != "allgather"
-                or cfg.method == "pallas" or cfg.verbose
-                or cfg.ckpt_every or cfg.repartition_every):
+        if (cfg.exchange != "allgather" or cfg.method == "pallas"
+                or cfg.verbose or cfg.ckpt_every
+                or cfg.repartition_every):
             raise SystemExit(
-                "--delta is the single-device bucketed driver; it does "
-                "not combine with --distributed/--exchange/--method "
-                "pallas/-verbose/--ckpt-every/--repartition-every"
+                "--delta is the allgather bucketed driver (single-device "
+                "or --distributed); it does not combine with --exchange "
+                "ring/--method pallas/-verbose/--ckpt-every/"
+                "--repartition-every"
             )
     if cfg.method == "pallas":
         est = preflight.estimate_push_pallas(
@@ -286,12 +287,18 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
             state, iters, edges = pd.run_push_pallas_dist(
                 prog, shards, mesh, cfg.max_iters, interpret=interp
             )
-        elif getattr(cfg, "delta", 0) and mesh is None:
+        elif getattr(cfg, "delta", 0):
             from lux_tpu.engine import delta as delta_mod
 
-            state, iters, edges = delta_mod.run_push_delta(
-                prog, shards, cfg.delta, cfg.max_iters, cfg.method
-            )
+            if mesh is None:
+                state, iters, edges = delta_mod.run_push_delta(
+                    prog, shards, cfg.delta, cfg.max_iters, cfg.method
+                )
+            else:
+                state, iters, edges = delta_mod.run_push_delta_dist(
+                    prog, shards, cfg.delta, mesh, cfg.max_iters,
+                    cfg.method
+                )
         elif mesh is None:
             state, iters, edges = push.run_push(
                 prog, shards, cfg.max_iters, cfg.method

@@ -125,6 +125,127 @@ def _compile_delta_loop(prog, pspec: PushSpec, spec: ShardSpec,
     return loop
 
 
+def _spmd_delta_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
+                          delta: int, arr_blk, parr_blk, c: DeltaCarry
+                          ) -> DeltaCarry:
+    """One delta round from a device's perspective inside shard_map
+    (k resident parts as the leading axis).  The bucket decision, like
+    the push engine's direction switch, is GLOBAL (one psum) so both
+    branches are collective-divergence-free; expansion reuses the push
+    engine's OWN SPMD prep/relax bodies via a synthesized PushCarry."""
+    import jax.lax as lax
+
+    in_bucket = c.pending & (c.state < c.thr)
+    n_in = lax.psum(jnp.sum(in_bucket.astype(jnp.int32)), push.PARTS_AXIS)
+
+    def expand(c: DeltaCarry) -> DeltaCarry:
+        q_vid, q_val, cnt = jax.vmap(partial(push.build_queue, pspec))(
+            arr_blk, in_bucket, c.state
+        )
+        k = arr_blk.global_vid.shape[0]
+        tmp = push.PushCarry(
+            c.state, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1),
+            push._zero_edges(), jnp.zeros((k,), jnp.uint32), jnp.int32(0),
+        )
+        plan = push._spmd_push_prep(pspec, spec, parr_blk, tmp)
+        new = push._spmd_push_relax(
+            prog, pspec, spec, parr_blk, arr_blk,
+            push._allgather_dense_fn(prog, arr_blk, method), tmp, plan,
+        )
+        use_dense = plan[3]
+        changed = (new != c.state) & arr_blk.vtx_mask
+        kept = jnp.where(use_dense, False, c.pending & ~in_bucket)
+        pending = kept | changed
+        active = lax.psum(
+            jnp.sum(pending.astype(jnp.int32)), push.PARTS_AXIS
+        )
+        totals = plan[2][3]
+        g_total = lax.psum(
+            jnp.sum(totals.astype(jnp.uint32)), push.PARTS_AXIS
+        )
+        edges = push._acc_edges(c.edges, spec.ne, g_total, use_dense)
+        return DeltaCarry(new, pending, c.thr, c.it + 1, active, edges)
+
+    def advance(c: DeltaCarry) -> DeltaCarry:
+        inf = jnp.int32(prog.inf)
+        local_min = jnp.min(jnp.where(c.pending, c.state, inf))
+        min_pend = lax.pmin(local_min, push.PARTS_AXIS)
+        thr = (min_pend // jnp.int32(delta) + 1) * jnp.int32(delta)
+        return DeltaCarry(c.state, c.pending, thr, c.it + 1,
+                          c.active, c.edges)
+
+    return jax.lax.cond(n_in > 0, expand, advance, c)
+
+
+@lru_cache(maxsize=64)
+def _compile_delta_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
+                        method: str, delta: int):
+    from jax.sharding import PartitionSpec as P
+
+    from lux_tpu.graph.shards import ShardArrays
+    from lux_tpu.graph.push_shards import PushArrays
+
+    Pp = P(push.PARTS_AXIS)
+    arr_specs = ShardArrays(*([Pp] * len(ShardArrays._fields)))
+    parr_specs = PushArrays(*([Pp] * len(PushArrays._fields)))
+    carry_specs = DeltaCarry(Pp, Pp, P(), P(), P(), P())
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(arr_specs, parr_specs, carry_specs, P()),
+        out_specs=carry_specs,
+    )
+    def run(arr_blk, parr_blk, c_blk, max_iters):
+        def cond(c):
+            return (c.active > 0) & (c.it < max_iters)
+
+        def body(c):
+            return _spmd_delta_iteration(
+                prog, pspec, spec, method, delta, arr_blk, parr_blk, c
+            )
+
+        return jax.lax.while_loop(cond, body, c_blk)
+
+    return run
+
+
+def run_push_delta_dist(
+    prog,
+    shards: PushShards,
+    delta: int,
+    mesh,
+    max_iters: int = 100_000,
+    method: str = "auto",
+):
+    """Distributed delta-stepping over a parts mesh (k resident parts
+    per device supported): the same bucket discipline with ONE psum for
+    the bucket-occupancy vote and ONE pmin for the threshold advance —
+    both ride ICI, the loop stays on device end to end."""
+    from lux_tpu.parallel.mesh import shard_stacked
+
+    if delta <= 0:
+        raise ValueError(f"delta must be positive, got {delta}")
+    if prog.reduce != "min":
+        raise ValueError("delta-stepping is a min-relaxation driver")
+    method = methods.resolve(method, prog.reduce)
+    spec, pspec = shards.spec, shards.pspec
+    assert spec.num_parts % mesh.devices.size == 0
+    arrays_h = jax.tree.map(jnp.asarray, shards.arrays)
+    c0 = _init_carry(prog, pspec, arrays_h, delta)
+    arrays = shard_stacked(mesh, arrays_h)
+    parrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.parrays))
+    c0 = DeltaCarry(
+        *shard_stacked(mesh, (c0.state, c0.pending)),
+        c0.thr, c0.it, c0.active, c0.edges,
+    )
+    out = _compile_delta_dist(prog, mesh, pspec, spec, method, delta)(
+        arrays, parrays, c0, jnp.int32(max_iters)
+    )
+    return out.state, out.it, out.edges
+
+
 def run_push_delta(
     prog,
     shards: PushShards,

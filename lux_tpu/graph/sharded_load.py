@@ -19,11 +19,15 @@ import numpy as np
 from lux_tpu.graph import format as fmt
 from lux_tpu.graph.csc import HostGraph
 from lux_tpu.graph.shards import (
+    LANE,
     PullShards,
     ShardSpec,
+    _round_up,
     alloc_arrays,
+    build_compact_mirror,
     fill_part,
     shard_geometry,
+    sort_segments_inplace,
 )
 
 
@@ -43,11 +47,36 @@ def out_degrees_from_file(
     return deg.astype(np.int32)
 
 
+def compact_width_from_file(path: str, num_parts: int,
+                            header: Optional[HostGraph] = None) -> int:
+    """GLOBAL compact-mirror width U_pad for a file-loaded graph: max
+    unique in-source count over ALL parts, LANE-padded.  One streaming
+    pass of per-part range reads; deterministic, so every multi-host
+    process computes the same width and subset loads keep identical
+    block shapes (the same contract shard_geometry provides for
+    nv_pad/e_pad)."""
+    if header is None:
+        header = fmt.read_lux(path, mmap=True)
+    cuts, _, _ = shard_geometry(
+        np.asarray(header.row_ptr), num_parts, header.nv
+    )
+    u_max = 1
+    for p in range(num_parts):
+        _, srcs, _ = fmt.read_lux_range(
+            path, int(cuts[p]), int(cuts[p + 1]), header=header
+        )
+        u_max = max(u_max, int(np.unique(srcs).size) if len(srcs) else 1)
+    return max(LANE, _round_up(u_max, LANE))
+
+
 def load_pull_shards(
     path: str,
     num_parts: int,
     parts_subset: Optional[Sequence[int]] = None,
     degrees: Optional[np.ndarray] = None,
+    sort_segments: bool = False,
+    compact_gather: bool = False,
+    compact_u_pad: Optional[int] = None,
 ) -> PullShards:
     """Build pull shards from a `.lux` file with per-part partial reads.
 
@@ -58,6 +87,13 @@ def load_pull_shards(
     produces identically-shaped blocks.  The header/offsets are read once
     and reused for every per-part range read; only the selected parts'
     edges ever enter host memory.
+
+    ``sort_segments`` / ``compact_gather``: the gather relayouts of
+    build_pull_shards, applied to the loaded rows.  A SUBSET load with
+    compact_gather needs the GLOBAL mirror width for cross-host shape
+    consistency: pass ``compact_u_pad`` (every host calling
+    compact_width_from_file(path, num_parts) gets the same value), or
+    leave it None to pay one extra streaming pass here.
     """
     header = fmt.read_lux(path, mmap=True)
     nv, ne = header.nv, header.ne
@@ -77,6 +113,14 @@ def load_pull_shards(
             degrees[vlo:vhi],
         )
 
+    if sort_segments:
+        sort_segments_inplace(arrays)
+    if compact_gather:
+        if compact_u_pad is None and len(parts_subset) < num_parts:
+            compact_u_pad = compact_width_from_file(
+                path, num_parts, header=header
+            )
+        arrays = build_compact_mirror(arrays, u_pad=compact_u_pad)
     spec = ShardSpec(
         num_parts=num_parts, nv=nv, ne=ne, nv_pad=nv_pad, e_pad=e_pad,
         weighted=header.weighted,

@@ -274,7 +274,8 @@ def sort_segments_inplace(arrays: ShardArrays) -> None:
         arrays.weights[r] = arrays.weights[r][order]
 
 
-def build_compact_mirror(arrays: ShardArrays) -> ShardArrays:
+def build_compact_mirror(arrays: ShardArrays,
+                         u_pad: Optional[int] = None) -> ShardArrays:
     """Attach the compact-gather mirror to filled pull-layout arrays.
 
     Per part: ``mirror_pos`` = sorted unique src_pos of the real edges
@@ -290,13 +291,19 @@ def build_compact_mirror(arrays: ShardArrays) -> ShardArrays:
     Composes with sort_segments_inplace (call it first: the mirror is
     order-insensitive per segment, and src_pos->mirror_rel is a monotone
     remap, so the relayout's in-segment ascending order survives).
-    """
+
+    ``u_pad`` overrides the width (multi-host subset loads pass the
+    GLOBAL width from sharded_load.compact_width_from_file so every
+    host's blocks keep identical shapes)."""
     P = arrays.src_pos.shape[0]
     uniqs = []
     for p in range(P):
         uniqs.append(np.unique(arrays.src_pos[p][arrays.edge_mask[p]]))
-    u_pad = max(LANE, _round_up(max((len(u) for u in uniqs), default=1) or 1,
-                                LANE))
+    need = max((len(u) for u in uniqs), default=1) or 1
+    if u_pad is None:
+        u_pad = max(LANE, _round_up(need, LANE))
+    elif u_pad < need:
+        raise ValueError(f"compact u_pad {u_pad} < required width {need}")
     mirror_pos = np.zeros((P, u_pad), np.int32)
     mirror_rel = np.zeros_like(arrays.src_pos)
     for p in range(P):

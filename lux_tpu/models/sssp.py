@@ -182,9 +182,9 @@ def sssp(
         if not weighted:
             raise ValueError("delta-stepping orders WEIGHTED distances; "
                              "unweighted BFS buckets are the iterations")
-        if mesh is not None or exchange != "allgather" or repartition_every:
+        if exchange != "allgather" or repartition_every:
             raise ValueError(
-                "delta-stepping is a single-device allgather driver"
+                "delta-stepping is an allgather-exchange driver"
             )
         # check the SHARDS' weights (covers pre-built PushShards too —
         # bucket order silently finalizes too early under negative
@@ -193,9 +193,14 @@ def sssp(
             raise ValueError("delta-stepping needs non-negative weights")
         from lux_tpu.engine import delta as delta_mod
 
-        final, _, _ = delta_mod.run_push_delta(
-            prog, shards, delta, max_iters, method=method
-        )
+        if mesh is not None:
+            final, _, _ = delta_mod.run_push_delta_dist(
+                prog, shards, delta, mesh, max_iters, method=method
+            )
+        else:
+            final, _, _ = delta_mod.run_push_delta(
+                prog, shards, delta, max_iters, method=method
+            )
         return shards.scatter_to_global(np.asarray(final))
     return _push_run(
         prog, g, shards, mesh, max_iters, method, exchange, num_parts,

@@ -146,8 +146,9 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         ap.add_argument("--weighted", action="store_true",
                         help="relax with edge weights (Dijkstra-style)")
         ap.add_argument("--delta", type=int, default=0,
-                        help="delta-stepping bucket width (weighted "
-                             "single-device runs): expand only pending "
+                        help="delta-stepping bucket width (weighted, "
+                             "allgather exchange; single-device or "
+                             "--distributed): expand only pending "
                              "vertices with dist < current bucket — "
                              "near-Dijkstra edge counts (0 = chaotic "
                              "relaxation)")

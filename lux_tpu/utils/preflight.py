@@ -210,8 +210,11 @@ def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None,
                 max_shards=max_edge_shards,
             )
             if ep is not None:
+                # name the FULL runnable combination (edge2d always
+                # needs --distributed; redundant-but-correct when the
+                # run already passed it)
                 hint = (f"increase num_parts, or split the edge arrays "
-                        f"with --edge-shards {ep}")
+                        f"with --distributed --edge-shards {ep}")
         print(
             f"WARNING: estimated {est.total_bytes/(1<<30):.2f} GiB exceeds "
             f"device HBM {hbm_bytes/(1<<30):.2f} GiB — {hint}"
