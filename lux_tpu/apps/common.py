@@ -267,7 +267,8 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
     return preflight.scale_residency(est, _residency(cfg))
 
 
-def report_preflight(est, cfg: RunConfig, shards, state_width: int = 1):
+def report_preflight(est, cfg: RunConfig, shards, state_width: int = 1,
+                     stream_hint: bool = False):
     """Print the estimate and warn if it exceeds device HBM — with the
     --edge-shards hint when (and only when) a 2-D run could actually
     execute here: 1-D allgather pull layout, non-pallas, and enough
@@ -289,6 +290,8 @@ def report_preflight(est, cfg: RunConfig, shards, state_width: int = 1):
         est, spec=spec, state_width=state_width,
         state_dtype_bytes=2 if cfg.dtype == "bfloat16" else 4,
         max_edge_shards=max_ep,
+        # only apps that EXPOSE --stream-hbm-gib may advertise it
+        stream_hint=stream_hint,
     )
 
 

@@ -42,6 +42,9 @@ class RunConfig:
     weighted: bool = False  # SSSP: relax with edge weights (Dijkstra-style)
     #: >0 = delta-stepping bucket width for weighted SSSP (engine/delta.py)
     delta: int = 0
+    #: >0 = host-offload streaming under this device-byte budget in GiB
+    #: (engine/stream.py; pagerank only — the -ll:zsize analog)
+    stream_hbm_gib: float = 0.0
     dtype: str = "float32"  # state storage dtype (pagerank/CF)
     #: >1 = 2-D (parts x edge) mesh: each part's edges split over this many
     #: chips, partial reductions psum'd (for parts too big for one chip)
@@ -62,7 +65,8 @@ class RunConfig:
 
 
 def parse_args(argv=None, description: str = "", sssp: bool = False,
-               pull: bool = False, push: bool = False) -> RunConfig:
+               pull: bool = False, push: bool = False,
+               stream: bool = False) -> RunConfig:
     """``sssp`` adds -start/--weighted; ``pull`` adds --exchange
     {allgather,ring,scatter}/--dtype; ``push`` adds --exchange
     {allgather,ring} (frontier apps: dense rounds can ring-stream, but
@@ -123,6 +127,14 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "unique-in-source mirror (working set "
                              "O(unique srcs) instead of O(nv); bitwise-"
                              "identical results)")
+        if stream:
+            ap.add_argument("--stream-hbm-gib", type=float, default=0.0,
+                            help="host-offload streaming: keep the edge "
+                                 "arrays in host RAM and stream double-"
+                                 "buffered chunks through this device-"
+                                 "byte budget per iteration — runs "
+                                 "graphs whose edges exceed one chip's "
+                                 "HBM (the zero-copy-memory analog)")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],
@@ -174,6 +186,7 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         exchange=getattr(ns, "exchange", "allgather"),
         weighted=getattr(ns, "weighted", False),
         delta=getattr(ns, "delta", 0),
+        stream_hbm_gib=getattr(ns, "stream_hbm_gib", 0.0),
         dtype=getattr(ns, "dtype", "float32"),
         edge_shards=getattr(ns, "edge_shards", 1),
         feat_shards=getattr(ns, "feat_shards", 1),

@@ -186,7 +186,8 @@ def suggest_edge_shards(spec: ShardSpec, hbm_bytes: int,
 def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None,
                spec: Optional[ShardSpec] = None, state_width: int = 1,
                state_dtype_bytes: int = 4,
-               max_edge_shards: int = 64) -> bool:
+               max_edge_shards: int = 64,
+               stream_hint: bool = False) -> bool:
     """Warn (returns False) if the estimate exceeds the device HBM.
     With ``spec`` (1-D pull layouts), the warning also names the
     smallest --edge-shards that WOULD fit (suggest_edge_shards), sized
@@ -204,6 +205,11 @@ def check_fits(est: MemoryEstimate, hbm_bytes: Optional[int] = None,
         return True
     if est.total_bytes > hbm_bytes:
         hint = "increase num_parts"
+        if stream_hint and spec is not None and max_edge_shards < 2:
+            # one device: more parts can't help either — stream instead
+            # (only when the calling app actually exposes the flag)
+            hint = ("stream the edges from host RAM "
+                    "(--stream-hbm-gib; engine/stream.py)")
         if spec is not None and max_edge_shards >= 2:
             ep = suggest_edge_shards(
                 spec, hbm_bytes, state_width, state_dtype_bytes,

@@ -86,8 +86,18 @@ def test_suggest_edge_shards_floor():
     buf3 = io.StringIO()
     with redirect_stdout(buf3):
         preflight.check_fits(est, hbm_bytes=est.total_bytes - 1,
-                             spec=sh.spec, max_edge_shards=1)
+                             spec=sh.spec, max_edge_shards=1,
+                             stream_hint=True)
     assert "--edge-shards" not in buf3.getvalue()
+    # ... and points at host-offload streaming instead (more parts on
+    # the same single device cannot help a pull-layout overflow)
+    assert "--stream-hbm-gib" in buf3.getvalue()
+    # apps without the flag (colfilter) must NOT advertise it
+    buf4 = io.StringIO()
+    with redirect_stdout(buf4):
+        preflight.check_fits(est, hbm_bytes=est.total_bytes - 1,
+                             spec=sh.spec, max_edge_shards=1)
+    assert "--stream-hbm-gib" not in buf4.getvalue()
 
 
 def test_edge2d_roofline_model():

@@ -93,6 +93,35 @@ def test_capacity_contract():
         stream.chunk_edges_for_budget(sh.spec, 1000)
 
 
+def test_cli_streamed_pagerank():
+    """--stream-hbm-gib on the pagerank app: end-to-end under a budget
+    forcing multiple chunks, -check verdict, and the combination
+    rejections."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "lux_tpu.apps.pagerank", "--rmat-scale",
+         "10", "-ni", "4", "--stream-hbm-gib", "0.002", "-check"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "[PASS]" in r.stdout
+    assert "chunk(s)" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, "-m", "lux_tpu.apps.pagerank", "--rmat-scale",
+         "10", "--stream-hbm-gib", "0.002", "--compact-gather"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r2.returncode != 0
+    assert "--stream-hbm-gib" in r2.stderr
+
+
 def test_chunk_head_flags_rebuilt():
     """A destination segment split across a chunk border gets a fresh
     head at the border (the re-based row_ptr encodes it); padding stays
