@@ -155,6 +155,48 @@ if mode == "push":
                 np.testing.assert_array_equal)
     print(f"process {pid}: multihost push phase-split OK ({it} its)",
           flush=True)
+    # --- distributed delta-stepping across processes: the bucket
+    # occupancy psum and the pmin threshold advance each cross a real
+    # process boundary; validated against the single-device bucket run
+    from lux_tpu.engine import delta as delta_mod
+    from lux_tpu.models.sssp import WeightedSSSPProgram
+
+    DW = 4
+    gd = generate.rmat(9, 8, seed=57, weighted=True, max_weight=15)
+    dsh = build_push_shards(gd, P)
+    dp = WeightedSSSPProgram(nv=dsh.spec.nv, start=1)
+    d_arrays = jax.tree.map(
+        lambda a: mh.assemble_global(mesh, a[mine], P), dsh.arrays
+    )
+    d_parrays = jax.tree.map(
+        lambda a: mh.assemble_global(mesh, a[mine], P), dsh.parrays
+    )
+    c_loc = delta_mod._init_carry(
+        dp, dsh.pspec,
+        jax.tree.map(lambda a: jnp.asarray(a[mine]), dsh.arrays), DW,
+    )
+    # global pending count from a full-arrays init (what
+    # run_push_delta_dist does) — never a hardcoded constant
+    c_full = delta_mod._init_carry(
+        dp, dsh.pspec, jax.tree.map(jnp.asarray, dsh.arrays), DW
+    )
+    d_carry = delta_mod.DeltaCarry(
+        mh.assemble_global(mesh, np.asarray(c_loc.state), P),
+        mh.assemble_global(mesh, np.asarray(c_loc.pending), P),
+        c_loc.thr, c_loc.it, c_full.active, c_loc.edges,
+    )
+    d_run = delta_mod._compile_delta_dist(
+        dp, mesh, dsh.pspec, dsh.spec, "scan", DW
+    )
+    d_out = d_run(d_arrays, d_parrays, d_carry, jnp.int32(100000))
+    st_s, _, e_s = delta_mod.run_push_delta(dp, dsh, DW, method="scan")
+    check_local(
+        d_out.state, dsh.cuts, mine,
+        dsh.scatter_to_global(np.asarray(st_s)),
+        np.testing.assert_array_equal,
+    )
+    assert push.edges_total(d_out.edges) == push.edges_total(e_s)
+    print(f"process {pid}: multihost delta-stepping OK", flush=True)
     sys.exit(0)
 
 shards = build_pull_shards(g, P)

@@ -135,6 +135,32 @@ def test_delta_distributed_matches_single():
     assert (got == base).all()
 
 
+def test_delta_composes_with_compact_gather():
+    """Delta's dense rounds carry the compact mirror (dense_part_step);
+    results and edge counts are bitwise-unchanged by the relayout."""
+    g = generate.rmat(10, 8, seed=11, weighted=True, max_weight=15)
+    a = build_push_shards(g, 2)
+    b = build_push_shards(g, 2, compact_gather=True)
+    prog = sssp_model.WeightedSSSPProgram(nv=a.spec.nv, start=1)
+    # a large bucket forces at least one dense round through the mirror
+    st_a, it_a, e_a = delta_mod.run_push_delta(prog, a, 10**6)
+    st_b, it_b, e_b = delta_mod.run_push_delta(prog, b, 10**6)
+    assert (np.asarray(st_a) == np.asarray(st_b)).all()
+    assert (int(it_a), push.edges_total(e_a)) == (
+        int(it_b), push.edges_total(e_b))
+
+
+def test_delta_rerun_bitwise():
+    """Two runs of the same delta program are bitwise identical (the
+    determinism contract every engine carries, tests/test_determinism)."""
+    g = generate.rmat(10, 8, seed=12, weighted=True, max_weight=15)
+    shards = build_push_shards(g, 3)
+    prog = sssp_model.WeightedSSSPProgram(nv=shards.spec.nv, start=1)
+    outs = [delta_mod.run_push_delta(prog, shards, 4) for _ in range(2)]
+    assert (np.asarray(outs[0][0]) == np.asarray(outs[1][0])).all()
+    assert push.edges_total(outs[0][2]) == push.edges_total(outs[1][2])
+
+
 def test_cli_delta():
     # forced-CPU child env: PYTHONPATH pinned to the repo root (NOT the
     # inherited path — the axon sitecustomize would register the TPU

@@ -64,7 +64,8 @@ def test_two_process_distributed_push():
     """The direction-optimizing push engine (queue all_gathers + psum'd
     switch flags + dense all_gather inside lax.cond) over two real OS
     processes — SSSP to convergence, validated against the BFS oracle."""
-    outs = _run_pair("push", timeout=420)
+    outs = _run_pair("push", timeout=480)
     for pid, out in enumerate(outs):
         assert f"process {pid}: multihost push OK" in out
         assert f"process {pid}: multihost push phase-split OK" in out
+        assert f"process {pid}: multihost delta-stepping OK" in out

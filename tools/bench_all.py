@@ -23,6 +23,8 @@ def main(argv=None):
     ap.add_argument("--ef", type=int, default=16)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--parts", type=int, default=1)
+    ap.add_argument("--delta", type=int, default=8,
+                    help="bucket width for the weighted-SSSP delta row")
     args = ap.parse_args(argv)
 
     import dataclasses
@@ -95,6 +97,33 @@ def main(argv=None):
     timed("components",
           lambda: components.connected_components_push(push_sh, num_parts=args.parts),
           g.ne, base)
+
+    # weighted SSSP: chaotic relaxation vs delta-stepping on the SAME
+    # graph/layout — GTEPS over edges ACTUALLY traversed (the engines'
+    # exact counter), so the delta row shows the algorithmic win, not
+    # just wall time
+    from lux_tpu.engine import delta as delta_mod
+    from lux_tpu.engine import push as push_eng
+
+    import numpy as np
+
+    gd = generate.rmat(args.scale, args.ef, seed=0, weighted=True,
+                       max_weight=100)
+    wpush = device_push(build_push_shards(gd, args.parts))
+    wprog = sssp.WeightedSSSPProgram(nv=wpush.spec.nv, start=0)
+    for name, run in (
+        ("sssp-w-chaotic",
+         lambda: push_eng.run_push(wprog, wpush)),
+        (f"sssp-w-delta{args.delta}",
+         lambda: delta_mod.run_push_delta(wprog, wpush, args.delta)),
+    ):
+        _, _, ed = run()  # warm; the exact edge counter is deterministic
+        traversed = push_eng.edges_total(ed)
+        # same full-state D2H ending as every other row, so subtracting
+        # the shared `base` stays honest and the rows are comparable
+        timed(f"{name} ({traversed} edges)",
+              lambda run=run: wpush.scatter_to_global(np.asarray(run()[0])),
+              traversed, base)
 
     gw = generate.bipartite_ratings(
         (1 << args.scale) // 2, (1 << args.scale) // 2,
