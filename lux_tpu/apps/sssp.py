@@ -131,6 +131,68 @@ def run_push_checkpointed(prog, shards, cfg, mesh, name: str):
     return carry.state, int(carry.it), carry.edges, compute
 
 
+def run_delta_checkpointed(prog, shards, cfg, mesh, name: str):
+    """Windowed delta-stepping with elastic checkpoints between windows:
+    GLOBAL state + pending mask + exact edge counter + the bucket
+    threshold (utils/checkpoint.save_delta).  A resume restacks onto ANY
+    part count, single-device or distributed — same contract as the
+    frontier checkpoints."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import delta as delta_mod
+    from lux_tpu.utils import checkpoint as ckpt
+    from lux_tpu.utils.timing import Timer
+
+    if cfg.delta <= 0:  # same guard as run_push_delta (direct callers)
+        raise ValueError(f"delta must be positive, got {cfg.delta}")
+    spec, pspec = shards.spec, shards.pspec
+    arrays = jax.tree.map(jnp.asarray, shards.arrays)
+    parrays = jax.tree.map(jnp.asarray, shards.parrays)
+    s_g, p_g, e_acc, thr, it0, prev = ckpt.load_resume_delta(
+        cfg.ckpt_dir, name, spec.nv
+    )
+    if s_g is None:
+        carry = delta_mod._init_carry(prog, pspec, arrays, cfg.delta)
+    else:
+        st = jnp.asarray(shards.pull.global_to_stacked(s_g))
+        pend = jnp.asarray(shards.pull.global_to_stacked(p_g))
+        carry = delta_mod.DeltaCarry(
+            st, pend, jnp.int32(thr), jnp.int32(it0),
+            jnp.sum(pend.astype(jnp.int32)), jnp.asarray(e_acc),
+        )
+        print(f"resumed from {prev} at iteration {it0}")
+    if mesh is not None:
+        from lux_tpu.parallel.mesh import shard_stacked
+
+        arrays = shard_stacked(mesh, arrays)
+        parrays = shard_stacked(mesh, parrays)
+        carry = delta_mod.DeltaCarry(
+            *shard_stacked(mesh, (carry.state, carry.pending)),
+            carry.thr, carry.it, carry.active, carry.edges,
+        )
+        loop = delta_mod._compile_delta_dist(
+            prog, mesh, pspec, spec, cfg.method, cfg.delta
+        )
+    else:
+        loop = delta_mod._compile_delta_loop(
+            prog, pspec, spec, cfg.method, cfg.delta
+        )
+    compute = 0.0
+    while int(carry.active) > 0 and int(carry.it) < cfg.max_iters:
+        it_stop = min(int(carry.it) + cfg.ckpt_every, cfg.max_iters)
+        t = Timer()
+        carry = loop(arrays, parrays, carry, jnp.int32(it_stop))
+        compute += t.stop(carry.state)
+        ckpt.save_delta(
+            cfg.ckpt_dir, int(carry.it),
+            shards.scatter_to_global(np.asarray(carry.state)),
+            shards.scatter_to_global(np.asarray(carry.pending)),
+            np.asarray(carry.edges), int(carry.thr), name,
+        )
+    return carry.state, int(carry.it), carry.edges, compute
+
+
 def run_convergence_app(prog, shards, cfg, name: str, g=None):
     """Shared driver for frontier apps (SSSP + CC).  Returns
     (global_state, stacked_device_state, effective_shards) — the shard
@@ -171,6 +233,8 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 "per-iteration -verbose fence is not available"
             )
     if getattr(cfg, "delta", 0):
+        if cfg.delta < 0:
+            raise SystemExit("--delta must be positive")
         if not getattr(cfg, "weighted", False):
             raise SystemExit(
                 "--delta orders WEIGHTED distances into buckets; "
@@ -178,12 +242,11 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                 "iteration — add --weighted"
             )
         if (cfg.exchange != "allgather" or cfg.method == "pallas"
-                or cfg.verbose or cfg.ckpt_every
-                or cfg.repartition_every):
+                or cfg.verbose or cfg.repartition_every):
             raise SystemExit(
                 "--delta is the allgather bucketed driver (single-device "
-                "or --distributed); it does not combine with --exchange "
-                "ring/--method pallas/-verbose/--ckpt-every/"
+                "or --distributed; --ckpt-every composes): it does not "
+                "combine with --exchange ring/--method pallas/-verbose/"
                 "--repartition-every"
             )
     if cfg.method == "pallas":
@@ -207,7 +270,11 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
     ckpt_compute = None
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
-        if cfg.ckpt_every:
+        if cfg.ckpt_every and getattr(cfg, "delta", 0):
+            state, iters, edges, ckpt_compute = run_delta_checkpointed(
+                prog, shards, cfg, mesh, name
+            )
+        elif cfg.ckpt_every:
             state, iters, edges, ckpt_compute = run_push_checkpointed(
                 prog, shards, cfg, mesh, name
             )

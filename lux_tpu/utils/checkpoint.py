@@ -94,47 +94,43 @@ def load_resume(directory: str, app: str, nv: int):
     return state, it, prev
 
 
-def save_frontier(directory: str, iteration: int, state_global,
-                  changed_global, edges, app: str) -> str:
-    """Frontier-app (push engine) checkpoint: the GLOBAL (nv,) state, the
-    GLOBAL changed-vertex mask (the frontier, layout-free), and the exact
-    traversed-edge accumulator ((2,) uint32 [hi, lo]).  Elastic like
-    save_iteration: any later part count / exchange / mesh rebuilds its
-    queues from the mask (engine.repartition._rebuild_carry machinery)."""
+def _save_global_ckpt(directory: str, iteration: int, state_global,
+                      changed_global, edges, app: str, layout: str,
+                      extra: Dict[str, Any]) -> str:
+    """Shared body of the mask-carrying checkpoint savers (frontier +
+    delta): GLOBAL state + GLOBAL bool mask + exact edge counter +
+    layout-tagged meta, written atomically (tmp + rename).  ONE
+    implementation so the two formats can never drift."""
     os.makedirs(directory, exist_ok=True)
     state_global = np.asarray(state_global)
-    changed_global = np.asarray(changed_global, bool)
     meta = {
         "app": app,
-        "layout": "global-frontier",
+        "layout": layout,
         "nv": int(state_global.shape[0]),
         "dtype": str(state_global.dtype),
     }
     path = os.path.join(directory, f"ckpt_{iteration}.npz")
     tmp = path + ".tmp"
     np.savez(
-        tmp, state=state_global, changed=changed_global,
+        tmp, state=state_global,
+        changed=np.asarray(changed_global, bool),
         edges=np.asarray(edges, np.uint32), iteration=np.int64(iteration),
-        meta=json.dumps(meta),
+        meta=json.dumps(meta), **extra,
     )
     os.replace(tmp + ".npz", path)
     return path
 
 
-def load_resume_frontier(directory: str, app: str, nv: int):
-    """Latest frontier checkpoint as (state_global, changed_global,
-    edges, start_iteration, path); (None, None, None, 0, None) when the
-    directory holds none."""
-    prev = latest(directory)
-    if prev is None:
-        return None, None, None, 0, None
+def _load_global_ckpt(prev: str, app: str, nv: int, layout: str,
+                      wrong_layout_hint: str):
+    """Shared validation + field extraction of _save_global_ckpt files.
+    Returns the open npz dict as plain arrays plus the iteration."""
     with np.load(prev, allow_pickle=False) as z:
         meta = json.loads(str(z["meta"]))
-        if meta.get("layout") != "global-frontier":
+        if meta.get("layout") != layout:
             raise SystemExit(
-                f"{prev}: not a frontier checkpoint (layout "
-                f"{meta.get('layout')!r}); fixed-iteration apps and "
-                "frontier apps use separate directories"
+                f"{prev}: layout {meta.get('layout')!r} is not {layout!r}"
+                f"; {wrong_layout_hint}"
             )
         if meta.get("app") != app:
             raise SystemExit(
@@ -146,9 +142,60 @@ def load_resume_frontier(directory: str, app: str, nv: int):
                 f"{prev}: checkpoint is for nv={meta.get('nv')}, "
                 f"this graph has nv={nv}"
             )
-        return (
-            z["state"], z["changed"], z["edges"], int(z["iteration"]), prev
-        )
+        return {k: z[k] for k in z.files if k != "meta"}
+
+
+def save_frontier(directory: str, iteration: int, state_global,
+                  changed_global, edges, app: str) -> str:
+    """Frontier-app (push engine) checkpoint: the GLOBAL (nv,) state, the
+    GLOBAL changed-vertex mask (the frontier, layout-free), and the exact
+    traversed-edge accumulator ((2,) uint32 [hi, lo]).  Elastic like
+    save_iteration: any later part count / exchange / mesh rebuilds its
+    queues from the mask (engine.repartition._rebuild_carry machinery)."""
+    return _save_global_ckpt(directory, iteration, state_global,
+                             changed_global, edges, app,
+                             "global-frontier", {})
+
+
+def load_resume_frontier(directory: str, app: str, nv: int):
+    """Latest frontier checkpoint as (state_global, changed_global,
+    edges, start_iteration, path); (None, None, None, 0, None) when the
+    directory holds none."""
+    prev = latest(directory)
+    if prev is None:
+        return None, None, None, 0, None
+    z = _load_global_ckpt(
+        prev, app, nv, "global-frontier",
+        "fixed-iteration, frontier, and delta drivers use separate "
+        "directories",
+    )
+    return z["state"], z["changed"], z["edges"], int(z["iteration"]), prev
+
+
+def save_delta(directory: str, iteration: int, state_global,
+               pending_global, edges, thr: int, app: str) -> str:
+    """Delta-stepping checkpoint: the frontier format (GLOBAL state +
+    GLOBAL pending mask + exact edge counter) plus the bucket threshold
+    — everything DeltaCarry needs (engine/delta.py).  Elastic like
+    save_frontier: any later part count restacks the global arrays."""
+    return _save_global_ckpt(directory, iteration, state_global,
+                             pending_global, edges, app, "global-delta",
+                             {"thr": np.int32(thr)})
+
+
+def load_resume_delta(directory: str, app: str, nv: int):
+    """Latest delta checkpoint as (state_global, pending_global, edges,
+    thr, start_iteration, path); (None, None, None, 0, 0, None) when the
+    directory holds none."""
+    prev = latest(directory)
+    if prev is None:
+        return None, None, None, 0, 0, None
+    z = _load_global_ckpt(
+        prev, app, nv, "global-delta",
+        "use a separate --ckpt-dir per driver kind",
+    )
+    return (z["state"], z["changed"], z["edges"], int(z["thr"]),
+            int(z["iteration"]), prev)
 
 
 def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
