@@ -144,8 +144,13 @@ def run_delta_checkpointed(prog, shards, cfg, mesh, name: str):
     from lux_tpu.utils import checkpoint as ckpt
     from lux_tpu.utils.timing import Timer
 
-    if cfg.delta <= 0:  # same guard as run_push_delta (direct callers)
-        raise ValueError(f"delta must be positive, got {cfg.delta}")
+    # same driver-entry contract as run_push_delta: validate AND resolve
+    # the method, so direct callers fail fast instead of deep in the
+    # segment kernel with method='auto'
+    delta_mod._validate(prog, cfg.delta)
+    from lux_tpu.engine import methods
+
+    cfg.method = methods.resolve(cfg.method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     parrays = jax.tree.map(jnp.asarray, shards.parrays)

@@ -125,6 +125,14 @@ def _compile_delta_loop(prog, pspec: PushSpec, spec: ShardSpec,
     return loop
 
 
+def _validate(prog, delta: int) -> None:
+    """Shared driver-entry guards (single-device AND distributed)."""
+    if delta <= 0:
+        raise ValueError(f"delta must be positive, got {delta}")
+    if prog.reduce != "min":
+        raise ValueError("delta-stepping is a min-relaxation driver")
+
+
 def _spmd_delta_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
                           delta: int, arr_blk, parr_blk, c: DeltaCarry
                           ) -> DeltaCarry:
@@ -133,7 +141,7 @@ def _spmd_delta_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
     the push engine's direction switch, is GLOBAL (one psum) so both
     branches are collective-divergence-free; expansion reuses the push
     engine's OWN SPMD prep/relax bodies via a synthesized PushCarry."""
-    import jax.lax as lax
+    lax = jax.lax
 
     in_bucket = c.pending & (c.state < c.thr)
     n_in = lax.psum(jnp.sum(in_bucket.astype(jnp.int32)), push.PARTS_AXIS)
@@ -225,10 +233,7 @@ def run_push_delta_dist(
     both ride ICI, the loop stays on device end to end."""
     from lux_tpu.parallel.mesh import shard_stacked
 
-    if delta <= 0:
-        raise ValueError(f"delta must be positive, got {delta}")
-    if prog.reduce != "min":
-        raise ValueError("delta-stepping is a min-relaxation driver")
+    _validate(prog, delta)
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts % mesh.devices.size == 0
@@ -258,10 +263,7 @@ def run_push_delta(
     is the bucket width in distance units; small Δ approaches Dijkstra
     (fewest edge relaxations, most rounds), large Δ approaches the
     chaotic engine (fewest rounds, most edges)."""
-    if delta <= 0:
-        raise ValueError(f"delta must be positive, got {delta}")
-    if prog.reduce != "min":
-        raise ValueError("delta-stepping is a min-relaxation driver")
+    _validate(prog, delta)
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     arrays = jax.tree.map(jnp.asarray, shards.arrays)

@@ -227,38 +227,87 @@ def run_pull_fixed_streamed(
     for measuring the overlap win).  Returns the final (P, V, ...)
     stacked state (device)."""
     method = methods.resolve(method, prog.reduce)
-    spec = sh.spec
-    P = spec.num_parts
     step = _compiled_chunk_partial(prog, method)
     apply_f = _compiled_apply(prog)
-    varr = jax.tree.map(jnp.asarray, sh.varrays)
+    varr_p = _varr_parts(jax.tree.map(jnp.asarray, sh.varrays),
+                         sh.spec.num_parts)
     state = jnp.asarray(state0)
     for _ in range(num_iters):
-        full = state.reshape((spec.gathered_size,) + state.shape[2:])
-        new_parts = []
-        dev = _put_chunk(sh, 0, 0)
-        for p in range(P):
-            acc = None
-            n_chunks = len(sh.chunks[p])
-            for c in range(n_chunks):
-                cur = dev
-                nxt = (p, c + 1) if c + 1 < n_chunks else (
-                    (p + 1, 0) if p + 1 < P else None
-                )
-                if prefetch and nxt is not None:
-                    # issue the next transfer BEFORE consuming this
-                    # chunk's compute: XLA executes the enqueued step
-                    # while the host link moves the next chunk
-                    dev = _put_chunk(sh, *nxt)
-                part = step(cur, full, state[p])
-                acc = part if acc is None else _COMBINE[prog.reduce](acc, part)
-                if not prefetch:
-                    jax.block_until_ready(acc)  # finish compute ...
-                    if nxt is not None:  # ... before the next transfer
-                        dev = _put_chunk(sh, *nxt)
-                        jax.block_until_ready(dev)
-            new_parts.append(apply_f(
-                state[p], acc, jax.tree.map(lambda a: a[p], varr)
-            ))
-        state = jnp.stack(new_parts)
+        state = _streamed_iteration(
+            prog, sh, step, apply_f, varr_p, state, prefetch
+        )
     return state
+
+
+def _varr_parts(varr, num_parts: int) -> list:
+    """Per-part vertex-array views, sliced ONCE per run (not per chunk
+    per iteration — tree-mapping inside the hot loop re-dispatched P
+    slice ops every pass)."""
+    return [jax.tree.map(lambda a, p=p: a[p], varr)
+            for p in range(num_parts)]
+
+
+def _streamed_iteration(prog, sh: StreamedPullShards, step, apply_f,
+                        varr_p: list, state, prefetch: bool):
+    """One whole-graph pull iteration with host-resident edges: stream
+    every part's chunks (double-buffered when ``prefetch``), combine the
+    per-chunk partial reductions with the reduce's own op, apply."""
+    spec = sh.spec
+    full = state.reshape((spec.gathered_size,) + state.shape[2:])
+    new_parts = []
+    dev = _put_chunk(sh, 0, 0)
+    for p in range(spec.num_parts):
+        acc = None
+        n_chunks = len(sh.chunks[p])
+        for c in range(n_chunks):
+            cur = dev
+            nxt = (p, c + 1) if c + 1 < n_chunks else (
+                (p + 1, 0) if p + 1 < spec.num_parts else None
+            )
+            if prefetch and nxt is not None:
+                # issue the next transfer BEFORE consuming this chunk's
+                # compute: XLA executes the enqueued step while the
+                # host link moves the next chunk
+                dev = _put_chunk(sh, *nxt)
+            part = step(cur, full, state[p])
+            acc = part if acc is None else _COMBINE[prog.reduce](acc, part)
+            if not prefetch:
+                jax.block_until_ready(acc)  # finish compute ...
+                if nxt is not None:  # ... before the next transfer
+                    dev = _put_chunk(sh, *nxt)
+                    jax.block_until_ready(dev)
+        new_parts.append(apply_f(state[p], acc, varr_p[p]))
+    return jnp.stack(new_parts)
+
+
+def run_pull_until_streamed(
+    prog,
+    sh: StreamedPullShards,
+    state0,
+    max_iters: int,
+    active_fn,
+    method: str = "auto",
+    prefetch: bool = True,
+):
+    """Convergence-driven streamed pull (the CC contract: iterate until
+    no vertex is active).  The convergence test costs one scalar fetch
+    per iteration — next to the full edge-array host->device stream the
+    iteration already pays, that is noise.  Returns (final state,
+    iterations run)."""
+    method = methods.resolve(method, prog.reduce)
+    step = _compiled_chunk_partial(prog, method)
+    apply_f = _compiled_apply(prog)
+    varr_p = _varr_parts(jax.tree.map(jnp.asarray, sh.varrays),
+                         sh.spec.num_parts)
+    state = jnp.asarray(state0)
+    it = 0
+    while it < max_iters:
+        new = _streamed_iteration(
+            prog, sh, step, apply_f, varr_p, state, prefetch
+        )
+        active = int(jnp.sum(jax.vmap(active_fn)(state, new)))
+        state = new
+        it += 1
+        if active == 0:
+            break
+    return state, it

@@ -45,6 +45,19 @@ def rng():
     return np.random.default_rng(1234)
 
 
+def forced_cpu_env() -> dict:
+    """Child-process env for CLI subprocess tests: PYTHONPATH pinned to
+    the repo root (NOT the inherited path — the axon sitecustomize would
+    register the TPU plugin at interpreter start and hang every child
+    when the relay is wedged) + JAX_PLATFORMS=cpu.  ONE implementation
+    for every subprocess-spawning test."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def hub_vertex(g) -> int:
     """Max-out-degree start vertex for frontier-app tests: a fixed start
     (e.g. 0) can have zero out-edges on an RMAT draw and converge

@@ -130,15 +130,9 @@ def test_adaptive_recut_keeps_compact():
 def test_cli_compact_gather():
     """--compact-gather on a pull app (end-to-end CLI) and the ring
     rejection."""
-    import os
+    from conftest import forced_cpu_env
 
-    # forced-CPU child env: PYTHONPATH pinned to the repo root (NOT the
-    # inherited path — the axon sitecustomize would register the TPU
-    # plugin at interpreter start and hang when the relay is wedged)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
-    env["PYTHONPATH"] = repo
-    env["JAX_PLATFORMS"] = "cpu"
+    env = forced_cpu_env()
     r = subprocess.run(
         [sys.executable, "-m", "lux_tpu.apps.pagerank", "--rmat-scale", "9",
          "-ni", "5", "--compact-gather", "-check"],

@@ -209,13 +209,9 @@ def test_delta_checkpoint_resume(tmp_path):
 
 
 def test_cli_delta():
-    # forced-CPU child env: PYTHONPATH pinned to the repo root (NOT the
-    # inherited path — the axon sitecustomize would register the TPU
-    # plugin at interpreter start and hang when the relay is wedged)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
-    env["PYTHONPATH"] = repo
-    env["JAX_PLATFORMS"] = "cpu"
+    from conftest import forced_cpu_env
+
+    env = forced_cpu_env()
     r = subprocess.run(
         [sys.executable, "-m", "lux_tpu.apps.sssp", "--rmat-scale", "9",
          "--weighted", "--delta", "4", "-start", "1", "-check"],

@@ -12,12 +12,9 @@ TOOL = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 
 
 def test_micro_race_cpu(tmp_path):
-    # forced-CPU child env: PYTHONPATH pinned to the repo root so the
-    # axon sitecustomize can never hang the workers on a wedged relay
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
-    env["PYTHONPATH"] = repo
-    env["JAX_PLATFORMS"] = "cpu"
+    from conftest import forced_cpu_env
+
+    env = forced_cpu_env()
     env["LUX_METHOD_WINNERS"] = str(tmp_path / "w.json")
     r = subprocess.run(
         [sys.executable, TOOL, "--scale", "10", "--reps", "1", "2", "4",

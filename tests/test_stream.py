@@ -52,6 +52,25 @@ def test_streamed_max_combiner_bitwise():
     assert (out == mono).all()
 
 
+def test_streamed_until_cc_bitwise():
+    """Convergence-driven streaming (CC max-label): same fixpoint, same
+    iteration count, bitwise state vs the monolithic until-engine."""
+    from lux_tpu.models import components
+
+    g = generate.rmat(10, 8, seed=25)
+    sh = build_pull_shards(g, 2)
+    prog = MaxLabelProgram()
+    s0 = pull.init_state(prog, jax.tree.map(jnp.asarray, sh.arrays))
+    mono, iters = pull.run_pull_until(
+        prog, sh.spec, sh.arrays, s0, 64, components.active_count,
+        method="scan")
+    ssh = stream.build_streamed_pull(sh, 512)
+    got, it2 = stream.run_pull_until_streamed(
+        prog, ssh, s0, 64, components.active_count, method="scan")
+    assert int(iters) == it2
+    assert (np.asarray(got) == np.asarray(mono)).all()
+
+
 def test_streamed_weighted_cf_chunks():
     """Weighted + dst-state programs (CF error term) stream too: the
     chunk carries weights and the dst gather."""
@@ -97,14 +116,12 @@ def test_cli_streamed_pagerank():
     """--stream-hbm-gib on the pagerank app: end-to-end under a budget
     forcing multiple chunks, -check verdict, and the combination
     rejections."""
-    import os
     import subprocess
     import sys
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
-    env["PYTHONPATH"] = repo
-    env["JAX_PLATFORMS"] = "cpu"
+    from conftest import forced_cpu_env
+
+    env = forced_cpu_env()
     r = subprocess.run(
         [sys.executable, "-m", "lux_tpu.apps.pagerank", "--rmat-scale",
          "10", "-ni", "4", "--stream-hbm-gib", "0.002", "-check"],
