@@ -24,7 +24,7 @@ __all__ = [
     #   parallel.edge2d.build_edge2d_shards
 ]
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 
 def __getattr__(name):
