@@ -132,17 +132,28 @@ def _run_feat(cfg, g, prog):
 
 
 def main(argv=None):
-    cfg = parse_args(argv, description=__doc__, pull=True)
+    cfg = parse_args(argv, description=__doc__, pull=True, stream=True)
     g = common.load_graph(cfg, weighted=True, bipartite=True)
     prog = cf_model.CFProgram(dtype=cfg.dtype)
     common.validate_exchange(cfg, prog)
+    if cfg.stream_hbm_gib:
+        # host-offload streaming for the WIDE-state app (the (V, K)
+        # latent matrix is the memory case SURVEY.md §7.3 flags)
+        v, elapsed = common.run_streamed(
+            cfg, g, prog, state_width=cf_model.K
+        )
+        report_elapsed(elapsed, g.ne, cfg.num_iters)
+        v = v.astype("float32")
+        print(f"training RMSE = {cf_model.rmse(g, v):.4f}")
+        return _check_tail(cfg, g, v)
     if cfg.method == "pallas":
         return _run_pallas(cfg, g)
     if cfg.feat_shards > 1:
         return _run_feat(cfg, g, prog)
     shards = common.build_exchange_shards(g, cfg)
     est = common.estimate_exchange(shards, cfg, state_width=cf_model.K)
-    common.report_preflight(est, cfg, shards, state_width=cf_model.K)
+    common.report_preflight(est, cfg, shards, state_width=cf_model.K,
+                            stream_hint=True)
 
     mesh = common.make_mesh_if(cfg)
     # single-device paths use device-placed arrays; distributed drivers

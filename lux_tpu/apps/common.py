@@ -295,6 +295,59 @@ def report_preflight(est, cfg: RunConfig, shards, state_width: int = 1,
     )
 
 
+def run_streamed(cfg: RunConfig, g: HostGraph, prog, state_width: int = 1):
+    """Shared --stream-hbm-gib runner for pull apps (the -ll:zsize
+    zero-copy analog, core/lux_mapper.cc:146-165): host-resident edges
+    streamed through a device-byte budget (engine/stream.py).  Validates
+    the combination, builds + prints the streamed geometry, runs, and
+    returns (global_state, elapsed_s).  Each app owns its report tail."""
+    if (cfg.distributed or cfg.exchange != "allgather"
+            or cfg.method == "pallas" or cfg.compact_gather
+            or cfg.edge_shards > 1 or cfg.feat_shards > 1 or cfg.verbose
+            or cfg.ckpt_every or cfg.ckpt_dir):
+        raise SystemExit(
+            "--stream-hbm-gib is the single-process host-offload mode; "
+            "it does not combine with --distributed/--exchange/"
+            "--edge-shards/--feat-shards/--method pallas/"
+            "--compact-gather/-verbose/checkpointing"
+        )
+    import jax
+
+    from lux_tpu.engine import pull, stream as stream_eng
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.utils.timing import Timer
+
+    sbytes = 2 if cfg.dtype == "bfloat16" else 4
+    shards = build_pull_shards(
+        g, cfg.num_parts, sort_segments=cfg.sort_segments
+    )
+    budget = int(cfg.stream_hbm_gib * (1 << 30))
+    chunk_e = stream_eng.chunk_edges_for_budget(
+        shards.spec, budget, sbytes, state_width
+    )
+    resident = stream_eng.streamed_hbm_bytes(
+        shards.spec, chunk_e, sbytes, state_width
+    )
+    total = stream_eng.edge_bytes_total(shards.spec)
+    ssh = stream_eng.build_streamed_pull(shards, chunk_e)
+    print(
+        f"streamed: {len(ssh.chunks[0])} chunk(s) of {chunk_e} edges/part; "
+        f"resident {resident/(1<<30):.3f} GiB <= budget "
+        f"{budget/(1<<30):.3f} GiB (monolithic edge arrays "
+        f"{total/(1<<30):.3f} GiB)"
+    )
+    state0 = pull.init_state(prog, ssh.varrays)
+    from lux_tpu.utils import profiling
+
+    with profiling.trace(cfg.profile_dir):
+        timer = Timer()
+        out = stream_eng.run_pull_fixed_streamed(
+            prog, ssh, state0, cfg.num_iters, method=cfg.method
+        )
+        elapsed = timer.stop(out)
+    return ssh.scatter_to_global(jax.device_get(out)), elapsed
+
+
 def resume_or_init(cfg: RunConfig, app: str, shards, state, nv):
     """Elastic resume: restack the latest global checkpoint (any previous
     -ng/--exchange) onto THIS run's layout; returns (state, start_it)."""

@@ -92,47 +92,10 @@ def _check_tail(cfg, g, ranks) -> int:
 
 def _run_streamed(cfg, g, prog):
     """--stream-hbm-gib: host-offload edge streaming under a device-byte
-    budget (engine/stream.py — the -ll:zsize zero-copy analog,
-    core/lux_mapper.cc:146-165).  Single-process; the O(ne) edge arrays
-    never fully reside on device."""
-    if (cfg.distributed or cfg.exchange != "allgather"
-            or cfg.method == "pallas" or cfg.compact_gather
-            or cfg.edge_shards > 1 or cfg.verbose or cfg.ckpt_every
-            or cfg.ckpt_dir):
-        raise SystemExit(
-            "--stream-hbm-gib is the single-process host-offload mode; "
-            "it does not combine with --distributed/--exchange/"
-            "--edge-shards/--method pallas/--compact-gather/-verbose/"
-            "checkpointing"
-        )
-    from lux_tpu.engine import stream as stream_eng
-    from lux_tpu.graph.shards import build_pull_shards
-
-    shards = build_pull_shards(
-        g, cfg.num_parts, sort_segments=cfg.sort_segments
-    )
-    budget = int(cfg.stream_hbm_gib * (1 << 30))
-    chunk_e = stream_eng.chunk_edges_for_budget(shards.spec, budget)
-    resident = stream_eng.streamed_hbm_bytes(shards.spec, chunk_e)
-    total = stream_eng.edge_bytes_total(shards.spec)
-    ssh = stream_eng.build_streamed_pull(shards, chunk_e)
-    print(
-        f"streamed: {len(ssh.chunks[0])} chunk(s) of {chunk_e} edges/part; "
-        f"resident {resident/(1<<30):.3f} GiB <= budget "
-        f"{budget/(1<<30):.3f} GiB (monolithic edge arrays "
-        f"{total/(1<<30):.3f} GiB)"
-    )
-    state0 = pull.init_state(prog, ssh.varrays)
-    from lux_tpu.utils import profiling
-
-    with profiling.trace(cfg.profile_dir):
-        timer = Timer()
-        out = stream_eng.run_pull_fixed_streamed(
-            prog, ssh, state0, cfg.num_iters, method=cfg.method
-        )
-        elapsed = timer.stop(out)
+    budget (common.run_streamed; engine/stream.py — the -ll:zsize
+    zero-copy analog, core/lux_mapper.cc:146-165)."""
+    ranks, elapsed = common.run_streamed(cfg, g, prog)
     report_elapsed(elapsed, g.ne, cfg.num_iters)
-    ranks = ssh.scatter_to_global(jax.device_get(out))
     common.top_k("rank (pre-divided)", ranks)
     return _check_tail(cfg, g, ranks)
 

@@ -101,13 +101,24 @@ class StreamedPullShards:
         return stacked_to_global(self.cuts, stacked)
 
 
+#: per-edge f32 compute-buffer passes of one ACTIVE chunk: the gathered
+#: (chunk_e, W) src_state, the edge-value array, and ~2 scan
+#: intermediates of the segmented reduce (ops/segment scan path)
+_COMPUTE_PASSES = 4
+
+
 def streamed_hbm_bytes(spec: ShardSpec, chunk_e: int,
-                       state_bytes: int = 4) -> int:
+                       state_bytes: int = 4, state_width: int = 1) -> int:
     """Peak device bytes of the streamed engine: full state + gathered
-    copy + accumulator + TWO resident chunks (double buffer)."""
+    copy + accumulator + TWO resident transfer chunks (double buffer) +
+    the ACTIVE chunk's per-edge compute buffers — the latter scale with
+    ``state_width`` (CF's (V, K) latent matrix makes them the dominant
+    term at K=20; a width-blind budget would overshoot by ~an order of
+    magnitude exactly when the flag matters)."""
     per_chunk = chunk_e * (4 + 4 + 1 + 4) + (spec.nv_pad + 1) * 4
-    state = spec.num_parts * spec.nv_pad * state_bytes
-    return 2 * per_chunk + 3 * state
+    compute = chunk_e * 4 * state_width * _COMPUTE_PASSES
+    state = spec.num_parts * spec.nv_pad * state_bytes * state_width
+    return 2 * per_chunk + compute + 3 * state
 
 
 def edge_bytes_total(spec: ShardSpec) -> int:
@@ -116,11 +127,14 @@ def edge_bytes_total(spec: ShardSpec) -> int:
 
 
 def chunk_edges_for_budget(spec: ShardSpec, budget_bytes: int,
-                           state_bytes: int = 4) -> int:
+                           state_bytes: int = 4,
+                           state_width: int = 1) -> int:
     """Largest LANE-aligned chunk_e whose streamed footprint fits the
     budget (>= one LANE; raises if even that cannot fit)."""
-    fixed = streamed_hbm_bytes(spec, 0, state_bytes)  # state + 2 row_ptrs
-    per_edge = 2 * (4 + 4 + 1 + 4)  # double-buffered src/dst/head/weight
+    # state + 2 row_ptrs
+    fixed = streamed_hbm_bytes(spec, 0, state_bytes, state_width)
+    # double-buffered transfer arrays + the active chunk's compute bufs
+    per_edge = 2 * (4 + 4 + 1 + 4) + 4 * state_width * _COMPUTE_PASSES
     chunk_e = max(0, budget_bytes - fixed) // per_edge // LANE * LANE
     if chunk_e <= 0:
         raise ValueError(

@@ -43,7 +43,7 @@ class RunConfig:
     #: >0 = delta-stepping bucket width for weighted SSSP (engine/delta.py)
     delta: int = 0
     #: >0 = host-offload streaming under this device-byte budget in GiB
-    #: (engine/stream.py; pagerank only — the -ll:zsize analog)
+    #: (engine/stream.py; pagerank + colfilter — the -ll:zsize analog)
     stream_hbm_gib: float = 0.0
     dtype: str = "float32"  # state storage dtype (pagerank/CF)
     #: >1 = 2-D (parts x edge) mesh: each part's edges split over this many

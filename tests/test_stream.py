@@ -93,10 +93,12 @@ def test_capacity_contract():
     g = generate.rmat(11, 8, seed=23)
     sh = build_pull_shards(g, 1)
     total = stream.edge_bytes_total(sh.spec)
-    # a budget sized for ~1/3 of the edges resident (toy graphs carry a
-    # large fixed vertex-side footprint, so size it from the model)
+    # a budget sized for ~1/6 of the edges resident (toy graphs carry a
+    # large fixed vertex-side footprint, so size it from the model; the
+    # streamed per-edge footprint ~3x the monolithic 14 B/edge means the
+    # chunk must stay well under e_pad/3 for budget < total to hold)
     budget = stream.streamed_hbm_bytes(
-        sh.spec, sh.spec.e_pad // 3 // 128 * 128)
+        sh.spec, sh.spec.e_pad // 6 // 128 * 128)
     assert budget < total
     chunk_e = stream.chunk_edges_for_budget(sh.spec, budget)
     assert 0 < chunk_e < sh.spec.e_pad
@@ -137,6 +139,20 @@ def test_cli_streamed_pagerank():
     )
     assert r2.returncode != 0
     assert "--stream-hbm-gib" in r2.stderr
+    # colfilter streams its WIDE (V, K) state too (width-aware budget);
+    # the budget forces MULTIPLE chunks so the cross-chunk combination
+    # of (V, K) partials is actually exercised end-to-end
+    r3 = subprocess.run(
+        [sys.executable, "-m", "lux_tpu.apps.colfilter", "--rmat-scale",
+         "9", "-ni", "3", "--stream-hbm-gib", "0.0005", "-check"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r3.returncode == 0, r3.stderr[-2000:]
+    assert "[PASS]" in r3.stdout
+    import re
+
+    m = re.search(r"streamed: (\d+) chunk", r3.stdout)
+    assert m and int(m.group(1)) >= 2, r3.stdout[:400]
 
 
 def test_chunk_head_flags_rebuilt():
