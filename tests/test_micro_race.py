@@ -33,3 +33,29 @@ def test_micro_race_cpu(tmp_path):
     # off-TPU: the tpu:micro_sum overlay entry must not be recorded
     assert "not on tpu" in r.stdout
     assert not (tmp_path / "w.json").exists()
+
+
+def test_micro_race_gather_modes(tmp_path):
+    """The gather-half workers (direct vs compact mirror) produce rows
+    but never the method winner (they inform layout, not method)."""
+    from conftest import forced_cpu_env
+
+    env = forced_cpu_env()
+    env["LUX_METHOD_WINNERS"] = str(tmp_path / "w.json")
+    r = subprocess.run(
+        [sys.executable, TOOL, "--scale", "10", "--reps", "1", "2", "4",
+         "--methods", "gather", "gatherc", "mxsum",
+         "--outdir", str(tmp_path / "out")],
+        env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = {json.loads(s)["method"]: json.loads(s)
+            for s in r.stdout.splitlines() if s.startswith("{")}
+    assert set(rows) == {"gather", "gatherc", "mxsum"}
+    assert rows["gather"]["micro"] == "gather"
+    assert "# compact mirror: U=" in r.stdout
+    # gather rows are excluded from the method decision (at toy scale
+    # the mxsum slope may be noise-negative -> winner None; either way
+    # a gather mode must never win)
+    assert "winner: gather" not in r.stdout
+    assert "winner: mxsum" in r.stdout or "winner: None" in r.stdout

@@ -35,7 +35,10 @@ echo "relay gate: 8083 accepts"
 #    ("tpu:micro_sum").  Doubles as the tunnel gate: a live tunnel
 #    produces the mxsum row in minutes where the old scale-20 probe
 #    gate could burn 90 min of a 7-min window.
-run micro_race 900 python tools/tpu_micro_race.py --outdir "$LOG/micro"
+#    Also races the gather halves (direct vs compact mirror) — the
+#    roofline's dominant unknown, banked at micro scale.
+run micro_race 1500 python tools/tpu_micro_race.py \
+    --methods mxsum gather gatherc scan --outdir "$LOG/micro"
 grep -q '"ms_per_rep"' "$LOG/micro_race.out" || {
   echo "tunnel dead (no micro rows) — aborting battery"; exit 1; }
 

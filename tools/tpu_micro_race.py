@@ -62,13 +62,45 @@ def worker_main(args) -> int:
     g = generate.rmat(args.scale, args.ef, seed=0)
     rng = np.random.default_rng(0)
     state = jnp.asarray(rng.random(g.nv, np.float32))
-    row_ptr = jnp.asarray(g.row_ptr.astype(np.int32))
-    head = np.zeros(g.ne, np.int32)
-    head[g.row_ptr[:-1][g.row_ptr[:-1] < g.ne]] = 1
-    head_flag = jnp.asarray(head.astype(bool))
-    dst_local = jnp.asarray(g.dst_of_edges().astype(np.int32))
-    vals_fixed = jnp.asarray(rng.random(g.ne, np.float32))
-    jax.block_until_ready((state, row_ptr, head_flag, dst_local, vals_fixed))
+    # each mode transfers ONLY its own operands: the first worker's row
+    # must bank in a window's first minute, so no method pays another
+    # mode's host->device traffic.
+    # "gather"/"gatherc" time the OTHER hot-loop half: the per-edge
+    # state read, direct vs through the compact mirror — the roofline's
+    # dominant unknown, banked at micro scale in the same window.
+    if args.method == "gather":
+        src_pos = jnp.asarray(np.asarray(g.col_idx).astype(np.int32))
+        jax.block_until_ready((state, src_pos))
+
+        def f(x):
+            return x[src_pos].reshape(g.nv, args.ef).sum(axis=1) * 1e-3
+    elif args.method == "gatherc":
+        col = np.asarray(g.col_idx).astype(np.int32)
+        uniq = np.unique(col)
+        mirror_pos = jnp.asarray(uniq.astype(np.int32))
+        mirror_rel = jnp.asarray(np.searchsorted(uniq, col).astype(np.int32))
+        jax.block_until_ready((state, mirror_pos, mirror_rel))
+        print(f"# compact mirror: U={len(uniq)} ({len(uniq)/g.nv:.2f} of nv)",
+              flush=True)
+
+        def f(x):
+            m = x[mirror_pos]
+            return m[mirror_rel].reshape(g.nv, args.ef).sum(axis=1) * 1e-3
+    else:
+        row_ptr = jnp.asarray(g.row_ptr.astype(np.int32))
+        head = np.zeros(g.ne, np.int32)
+        head[g.row_ptr[:-1][g.row_ptr[:-1] < g.ne]] = 1
+        head_flag = jnp.asarray(head.astype(bool))
+        dst_local = jnp.asarray(g.dst_of_edges().astype(np.int32))
+        vals_fixed = jnp.asarray(rng.random(g.ne, np.float32))
+        jax.block_until_ready(
+            (state, row_ptr, head_flag, dst_local, vals_fixed))
+
+        def f(x):
+            vals = vals_fixed * x[0]
+            acc = segment.segment_sum_csc(
+                vals, row_ptr, head_flag, dst_local, method=args.method)
+            return acc * 0.999
     platform = jax.devices()[0].platform
     print(f"# micro worker: platform={platform} method={args.method} "
           f"nv={g.nv} ne={g.ne} setup={time.perf_counter()-t_setup:.1f}s",
@@ -80,10 +112,7 @@ def worker_main(args) -> int:
     @jax.jit
     def run(x0, n):
         def body(_, x):
-            vals = vals_fixed * x[0]
-            acc = segment.segment_sum_csc(
-                vals, row_ptr, head_flag, dst_local, method=args.method)
-            return acc * 0.999
+            return f(x)
         return jax.lax.fori_loop(0, n, body, x0)
 
     t_c = time.perf_counter()
@@ -98,8 +127,10 @@ def worker_main(args) -> int:
         xs.append(n)
     slope, icpt = _fit(xs, ts)
     gteps = g.ne / slope / 1e9 if slope > 0 else float("nan")
+    kind = ("gather" if args.method in ("gather", "gatherc")
+            else "segment_sum")
     print(json.dumps({
-        "micro": "segment_sum", "method": args.method,
+        "micro": kind, "method": args.method,
         "platform": platform, "scale": args.scale, "ne": int(g.ne),
         "ms_per_rep": round(slope * 1e3, 4), "gteps": round(gteps, 4),
         "intercept_ms": round(icpt * 1e3, 2),
@@ -182,8 +213,11 @@ def main(argv=None):
     if not rows:
         print("micro race: no measurements", flush=True)
         return 1
+    # winner = fastest SUM strategy (gather rows time the other
+    # hot-loop half; they inform the layout choice, not the method)
     timed = {m: r["ms_per_rep"] for m, r in rows.items()
-             if r.get("ms_per_rep", 0) > 0}
+             if r.get("ms_per_rep", 0) > 0
+             and m not in ("gather", "gatherc")}
     winner = min(timed, key=timed.get) if timed else None
     platforms = {r.get("platform") for r in rows.values()}
     record = {
