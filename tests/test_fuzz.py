@@ -163,6 +163,40 @@ def test_fuzz_frontier_ckpt_elastic(seed, tmp_path):
 
 
 @pytest.mark.parametrize("seed", SEEDS[:3])
+def test_fuzz_delta_vs_chaotic(seed):
+    """Random weighted graph, random bucket width, random parts/layout
+    (compact on or off), single-device or distributed: delta-stepping
+    must reproduce the chaotic fixpoint bitwise and never traverse MORE
+    edges."""
+    from lux_tpu.engine import delta as delta_mod
+    from lux_tpu.engine import push
+    from lux_tpu.parallel.mesh import make_mesh_for_parts
+
+    rng = np.random.default_rng(seed + 9000)
+    g = generate.rmat(int(rng.integers(8, 11)), int(rng.integers(4, 10)),
+                      seed=seed, weighted=True,
+                      max_weight=int(rng.integers(2, 60)))
+    from conftest import hub_vertex
+
+    start = hub_vertex(g)
+    P = int(rng.choice([2, 4, 8]))
+    sh = build_push_shards(g, P,
+                           compact_gather=bool(rng.integers(2)))
+    prog = sssp.WeightedSSSPProgram(nv=sh.spec.nv, start=start)
+    st_c, _, e_c = push.run_push(prog, sh, 100000, method="scan")
+    width = int(rng.integers(1, 80))
+    if P == 8 and rng.integers(2):
+        mesh = make_mesh_for_parts(P)
+        st_d, _, e_d = delta_mod.run_push_delta_dist(
+            prog, sh, width, mesh, method="scan")
+    else:
+        st_d, _, e_d = delta_mod.run_push_delta(
+            prog, sh, width, method="scan")
+    np.testing.assert_array_equal(np.asarray(st_c), np.asarray(st_d))
+    assert push.edges_total(e_d) <= push.edges_total(e_c)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:3])
 def test_fuzz_all_pull_exchanges_agree(seed):
     """One random graph through EVERY pull exchange layout — allgather
     (random k residency + random sort-segments relayout), ring,
@@ -184,7 +218,8 @@ def test_fuzz_all_pull_exchanges_agree(seed):
     want = pr.pagerank_reference(g, iters)
     mesh = make_mesh(8)
 
-    sh = build_pull_shards(g, P, sort_segments=bool(rng.integers(2)))
+    sh = build_pull_shards(g, P, sort_segments=bool(rng.integers(2)),
+                           compact_gather=bool(rng.integers(2)))
     prog = pr.PageRankProgram(nv=sh.spec.nv)
     s0 = pull.init_state(prog, sh.arrays)
     outs = {
