@@ -162,12 +162,19 @@ def test_fuzz_frontier_ckpt_elastic(seed, tmp_path):
     assert push.edges_total(e) == push.edges_total(want_e)
 
 
-@pytest.mark.parametrize("seed", SEEDS[:3])
-def test_fuzz_delta_vs_chaotic(seed):
-    """Random weighted graph, random bucket width, random parts/layout
-    (compact on or off), single-device or distributed: delta-stepping
-    must reproduce the chaotic fixpoint bitwise and never traverse MORE
-    edges."""
+@pytest.mark.parametrize("seed,compact,dist", [
+    # explicit (compact, distributed) grid — random branch draws with
+    # the fixed seed list left both interesting branches uncovered
+    (SEEDS[0], False, False),
+    (SEEDS[1], True, False),
+    (SEEDS[2], False, True),
+    (SEEDS[3], True, True),
+])
+def test_fuzz_delta_vs_chaotic(seed, compact, dist):
+    """Random weighted graph and bucket width through the delta driver
+    (compact layout on/off x single-device/distributed, per the
+    explicit grid): delta-stepping must reproduce the chaotic fixpoint
+    bitwise and never traverse MORE edges."""
     from lux_tpu.engine import delta as delta_mod
     from lux_tpu.engine import push
     from lux_tpu.parallel.mesh import make_mesh_for_parts
@@ -179,13 +186,12 @@ def test_fuzz_delta_vs_chaotic(seed):
     from conftest import hub_vertex
 
     start = hub_vertex(g)
-    P = int(rng.choice([2, 4, 8]))
-    sh = build_push_shards(g, P,
-                           compact_gather=bool(rng.integers(2)))
+    P = 8 if dist else int(rng.choice([2, 4]))
+    sh = build_push_shards(g, P, compact_gather=compact)
     prog = sssp.WeightedSSSPProgram(nv=sh.spec.nv, start=start)
     st_c, _, e_c = push.run_push(prog, sh, 100000, method="scan")
     width = int(rng.integers(1, 80))
-    if P == 8 and rng.integers(2):
+    if dist:
         mesh = make_mesh_for_parts(P)
         st_d, _, e_d = delta_mod.run_push_delta_dist(
             prog, sh, width, mesh, method="scan")
