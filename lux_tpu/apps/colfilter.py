@@ -139,7 +139,7 @@ def main(argv=None):
     if cfg.stream_hbm_gib:
         # host-offload streaming for the WIDE-state app (the (V, K)
         # latent matrix is the memory case SURVEY.md §7.3 flags)
-        v, elapsed = common.run_streamed(
+        v, elapsed, _ = common.run_streamed(
             cfg, g, prog, state_width=cf_model.K
         )
         report_elapsed(elapsed, g.ne, cfg.num_iters)

@@ -295,21 +295,25 @@ def report_preflight(est, cfg: RunConfig, shards, state_width: int = 1,
     )
 
 
-def run_streamed(cfg: RunConfig, g: HostGraph, prog, state_width: int = 1):
+def run_streamed(cfg: RunConfig, g: HostGraph, prog, state_width: int = 1,
+                 active_fn=None):
     """Shared --stream-hbm-gib runner for pull apps (the -ll:zsize
     zero-copy analog, core/lux_mapper.cc:146-165): host-resident edges
     streamed through a device-byte budget (engine/stream.py).  Validates
     the combination, builds + prints the streamed geometry, runs, and
-    returns (global_state, elapsed_s).  Each app owns its report tail."""
+    returns (global_state, elapsed_s, iters).  ``active_fn`` selects the
+    convergence driver (components) instead of the fixed-iteration one.
+    Each app owns its report tail."""
     if (cfg.distributed or cfg.exchange != "allgather"
             or cfg.method == "pallas" or cfg.compact_gather
             or cfg.edge_shards > 1 or cfg.feat_shards > 1 or cfg.verbose
-            or cfg.ckpt_every or cfg.ckpt_dir):
+            or cfg.ckpt_every or cfg.ckpt_dir or cfg.repartition_every):
         raise SystemExit(
             "--stream-hbm-gib is the single-process host-offload mode; "
             "it does not combine with --distributed/--exchange/"
             "--edge-shards/--feat-shards/--method pallas/"
-            "--compact-gather/-verbose/checkpointing"
+            "--compact-gather/-verbose/checkpointing/"
+            "--repartition-every"
         )
     import jax
 
@@ -341,11 +345,18 @@ def run_streamed(cfg: RunConfig, g: HostGraph, prog, state_width: int = 1):
 
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
-        out = stream_eng.run_pull_fixed_streamed(
-            prog, ssh, state0, cfg.num_iters, method=cfg.method
-        )
+        if active_fn is not None:
+            out, iters = stream_eng.run_pull_until_streamed(
+                prog, ssh, state0, cfg.max_iters, active_fn,
+                method=cfg.method,
+            )
+        else:
+            out = stream_eng.run_pull_fixed_streamed(
+                prog, ssh, state0, cfg.num_iters, method=cfg.method
+            )
+            iters = cfg.num_iters
         elapsed = timer.stop(out)
-    return ssh.scatter_to_global(jax.device_get(out)), elapsed
+    return ssh.scatter_to_global(jax.device_get(out)), elapsed, iters
 
 
 def resume_or_init(cfg: RunConfig, app: str, shards, state, nv):

@@ -17,13 +17,28 @@ from lux_tpu.utils.config import parse_args
 
 
 def main(argv=None):
-    cfg = parse_args(argv, description=__doc__, push=True)
+    cfg = parse_args(argv, description=__doc__, push=True, stream=True)
     g = common.load_graph(cfg)
-    shards = build_push_app_shards(g, cfg)
     prog = cc_model.MaxLabelProgram()
-    labels, state, shards = run_convergence_app(
-        prog, shards, cfg, "components", g=g
-    )
+    if cfg.stream_hbm_gib:
+        # host-offload streaming: CC's pull form to convergence (the
+        # reference's CC starts DENSE anyway, components_gpu.cu:733-737
+        # — the all-in-edges sweep is the natural streamed shape);
+        # falls through to the SHARED report/check tail (run_streamed
+        # already forbids --distributed)
+        from lux_tpu.utils.timing import report_elapsed
+
+        labels, elapsed, iters = common.run_streamed(
+            cfg, g, prog, active_fn=cc_model.active_count
+        )
+        print(f"components converged in {iters} iterations")
+        report_elapsed(elapsed, g.ne, iters)
+        state = shards = None
+    else:
+        shards = build_push_app_shards(g, cfg)
+        labels, state, shards = run_convergence_app(
+            prog, shards, cfg, "components", g=g
+        )
     n_comp = len(np.unique(labels))
     print(f"{n_comp} distinct labels")
     if cfg.check:

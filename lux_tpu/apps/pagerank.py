@@ -94,7 +94,7 @@ def _run_streamed(cfg, g, prog):
     """--stream-hbm-gib: host-offload edge streaming under a device-byte
     budget (common.run_streamed; engine/stream.py — the -ll:zsize
     zero-copy analog, core/lux_mapper.cc:146-165)."""
-    ranks, elapsed = common.run_streamed(cfg, g, prog)
+    ranks, elapsed, _ = common.run_streamed(cfg, g, prog)
     report_elapsed(elapsed, g.ne, cfg.num_iters)
     common.top_k("rank (pre-divided)", ranks)
     return _check_tail(cfg, g, ranks)

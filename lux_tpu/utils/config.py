@@ -43,7 +43,8 @@ class RunConfig:
     #: >0 = delta-stepping bucket width for weighted SSSP (engine/delta.py)
     delta: int = 0
     #: >0 = host-offload streaming under this device-byte budget in GiB
-    #: (engine/stream.py; pagerank + colfilter — the -ll:zsize analog)
+    #: (engine/stream.py; pagerank/colfilter fixed + components until —
+    #: the -ll:zsize analog)
     stream_hbm_gib: float = 0.0
     dtype: str = "float32"  # state storage dtype (pagerank/CF)
     #: >1 = 2-D (parts x edge) mesh: each part's edges split over this many
@@ -127,14 +128,6 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "unique-in-source mirror (working set "
                              "O(unique srcs) instead of O(nv); bitwise-"
                              "identical results)")
-        if stream:
-            ap.add_argument("--stream-hbm-gib", type=float, default=0.0,
-                            help="host-offload streaming: keep the edge "
-                                 "arrays in host RAM and stream double-"
-                                 "buffered chunks through this device-"
-                                 "byte budget per iteration — runs "
-                                 "graphs whose edges exceed one chip's "
-                                 "HBM (the zero-copy-memory analog)")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],
@@ -164,6 +157,16 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "vertices with dist < current bucket — "
                              "near-Dijkstra edge counts (0 = chaotic "
                              "relaxation)")
+    if stream:
+        # apps with a streamed driver (pagerank/colfilter pull-fixed,
+        # components pull-until): host-offload edge streaming
+        ap.add_argument("--stream-hbm-gib", type=float, default=0.0,
+                        help="host-offload streaming: keep the edge "
+                             "arrays in host RAM and stream double-"
+                             "buffered chunks through this device-byte "
+                             "budget per iteration — runs graphs whose "
+                             "edges exceed one chip's HBM (the "
+                             "zero-copy-memory analog)")
     ns = ap.parse_args(argv)
     if ns.ckpt_every and not ns.ckpt_dir:
         ap.error("--ckpt-every requires --ckpt-dir")

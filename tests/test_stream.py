@@ -139,6 +139,14 @@ def test_cli_streamed_pagerank():
     )
     assert r2.returncode != 0
     assert "--stream-hbm-gib" in r2.stderr
+    # components streams its pull form to CONVERGENCE (until driver)
+    r4 = subprocess.run(
+        [sys.executable, "-m", "lux_tpu.apps.components", "--rmat-scale",
+         "10", "--stream-hbm-gib", "0.003", "-check"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r4.returncode == 0, r4.stderr[-2000:]
+    assert "[PASS]" in r4.stdout and "converged in" in r4.stdout
     # colfilter streams its WIDE (V, K) state too (width-aware budget);
     # the budget forces MULTIPLE chunks so the cross-chunk combination
     # of (V, K) partials is actually exercised end-to-end
