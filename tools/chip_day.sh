@@ -80,6 +80,15 @@ LUX_BENCH_WATCHDOG_S=1100 LUX_BENCH_TPU_S=900 \
   LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
   run bench_compact 1200 python bench.py
 
+# 2d) multi-part compact A/B: P=16 vmapped on the one chip — each
+#     part's unique in-neighborhood is far below nv, so this is the
+#     configuration where the mirror SHOULD win most (the bench A/B at
+#     P=1 understates it); compare the two ELAPSED TIME lines
+run app_p16_direct 1500 python -m lux_tpu.apps.pagerank \
+    --rmat-scale 20 -ng 16 -ni 10
+run app_p16_compact 1500 python -m lux_tpu.apps.pagerank \
+    --rmat-scale 20 -ng 16 -ni 10 --compact-gather
+
 # 3) single-chip HBM ceiling vs preflight (VERDICT r1 #7)
 run scale_check 5400 python tools/tpu_scale_check.py --min-scale 18 --max-scale 24
 
