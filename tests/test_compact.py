@@ -159,10 +159,11 @@ def test_cli_compact_gather():
     assert r3.returncode != 0
     assert "--compact-gather" in r3.stderr
     # push apps carry the mirror through their dense rounds: end-to-end
-    # frontier run with on-device validation
+    # distributed frontier run, validated ON DEVICE (the --distributed
+    # -check path runs validate.count_violations over the mesh)
     r4 = subprocess.run(
         [sys.executable, "-m", "lux_tpu.apps.components", "--rmat-scale",
-         "9", "--compact-gather", "-check"],
+         "9", "-ng", "8", "--distributed", "--compact-gather", "-check"],
         capture_output=True, text=True, timeout=300, env=env,
     )
     assert r4.returncode == 0, r4.stderr[-2000:]
