@@ -15,11 +15,13 @@ the SSSP framing; the reference has no weighted SSSP at all (its app is
 BFS, sssp/sssp_gpu.cu:122), so this is target parity, not code parity.
 
 TPU-first shape: ONE extra (P, V) bool mask + ONE int32 threshold on
-top of the push carry; the bucket gate is a `lax.cond` between an
-expansion round (the push engine's OWN prep/relax bodies — queue build,
-two-tier sparse walk, global direction switch — via a synthesized
-PushCarry) and a cheap threshold-advance round (a masked min + round-up,
-no edge work).  The whole loop stays on device in `lax.while_loop`.
+top of the push carry; every round expands via the push engine's OWN
+prep/relax bodies (queue build, two-tier sparse walk, global direction
+switch — through a synthesized PushCarry), with the threshold advance
+FUSED in front (a masked min + round-up — when the current bucket is
+empty the threshold jumps past the smallest pending distance in the
+same round, so there are no advance-only rounds to dispatch).  The
+whole loop stays on device in `lax.while_loop`.
 A dense expansion round relaxes every edge (all sources, not just the
 bucket), which is still exact — min-relaxation is monotone — and clears
 ALL pending work for the round; the accounting (edges walked) uses the
@@ -42,7 +44,7 @@ class DeltaCarry(NamedTuple):
     state: Any    # (P, V) tentative distances
     pending: Any  # (P, V) bool: improved but not yet expanded
     thr: Any      # int32 scalar: current bucket's EXCLUSIVE upper bound
-    it: Any       # int32 rounds run (expansions + advances)
+    it: Any       # int32 expansion rounds run (advances are fused)
     active: Any   # int32 total pending count (0 = converged)
     edges: Any    # exact traversed-edge counter ([hi, lo] uint32 pair)
 
@@ -60,50 +62,57 @@ def _init_carry(prog, pspec: PushSpec, arrays, delta: int) -> DeltaCarry:
     )
 
 
+def _advanced_thr(prog, delta: int, c: DeltaCarry, n_in,
+                  min_pend=None):
+    """The bucket threshold for THIS round: unchanged while the current
+    bucket still has pending work; otherwise jump past the smallest
+    pending distance (skipping empty buckets in one hop).  Fused into
+    the expansion round — a separate advance-only round would pay a
+    whole dispatch to move one scalar, and at small Δ advance rounds
+    are ~half of all rounds.  ``min_pend`` overrides the local masked
+    min (the SPMD path passes its pmin) so the jump arithmetic lives in
+    exactly one place."""
+    if min_pend is None:
+        inf = jnp.int32(prog.inf)
+        min_pend = jnp.min(jnp.where(c.pending, c.state, inf))
+    jumped = (min_pend // jnp.int32(delta) + 1) * jnp.int32(delta)
+    return jnp.where(n_in > 0, c.thr, jumped)
+
+
 def _delta_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
                      delta: int, arrays, parrays, c: DeltaCarry
                      ) -> DeltaCarry:
     in_bucket = c.pending & (c.state < c.thr)
-
-    def expand(c: DeltaCarry) -> DeltaCarry:
-        q_vid, q_val, cnt = jax.vmap(partial(push.build_queue, pspec))(
-            arrays, in_bucket, c.state
-        )
-        num_parts = arrays.global_vid.shape[0]
-        tmp = push.PushCarry(
-            c.state, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1),
-            push._zero_edges(), jnp.zeros((num_parts,), jnp.uint32),
-            jnp.int32(0),
-        )
-        q_vids_all, q_vals_all, preps, use_dense = push._push_prep(
-            pspec, spec, parrays, tmp
-        )
-        new = push._push_relax(
-            prog, pspec, spec, method, arrays, parrays, tmp,
-            q_vids_all, q_vals_all, preps, use_dense,
-        )
-        changed = (new != c.state) & arrays.vtx_mask
-        # sparse rounds expand exactly the bucket; a dense round relaxes
-        # every source, so EVERYTHING pending counts as expanded
-        kept = jnp.where(use_dense, False, c.pending & ~in_bucket)
-        pending = kept | changed
-        edges = push._acc_edges(c.edges, spec.ne, preps[3].sum(), use_dense)
-        return DeltaCarry(
-            new, pending, c.thr, c.it + 1,
-            jnp.sum(pending.astype(jnp.int32)), edges,
-        )
-
-    def advance(c: DeltaCarry) -> DeltaCarry:
-        # bucket empty but work remains: jump thr past the smallest
-        # pending distance (skipping empty buckets in one hop)
-        inf = jnp.int32(prog.inf)
-        min_pend = jnp.min(jnp.where(c.pending, c.state, inf))
-        thr = (min_pend // jnp.int32(delta) + 1) * jnp.int32(delta)
-        return DeltaCarry(c.state, c.pending, thr, c.it + 1,
-                          c.active, c.edges)
-
-    return jax.lax.cond(
-        jnp.sum(in_bucket.astype(jnp.int32)) > 0, expand, advance, c
+    n_in = jnp.sum(in_bucket.astype(jnp.int32))
+    thr = _advanced_thr(prog, delta, c, n_in)
+    # recompute under the (possibly advanced) threshold: non-empty
+    # whenever any work is pending, so every round expands
+    in_bucket = c.pending & (c.state < thr)
+    q_vid, q_val, cnt = jax.vmap(partial(push.build_queue, pspec))(
+        arrays, in_bucket, c.state
+    )
+    num_parts = arrays.global_vid.shape[0]
+    tmp = push.PushCarry(
+        c.state, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1),
+        push._zero_edges(), jnp.zeros((num_parts,), jnp.uint32),
+        jnp.int32(0),
+    )
+    q_vids_all, q_vals_all, preps, use_dense = push._push_prep(
+        pspec, spec, parrays, tmp
+    )
+    new = push._push_relax(
+        prog, pspec, spec, method, arrays, parrays, tmp,
+        q_vids_all, q_vals_all, preps, use_dense,
+    )
+    changed = (new != c.state) & arrays.vtx_mask
+    # sparse rounds expand exactly the bucket; a dense round relaxes
+    # every source, so EVERYTHING pending counts as expanded
+    kept = jnp.where(use_dense, False, c.pending & ~in_bucket)
+    pending = kept | changed
+    edges = push._acc_edges(c.edges, spec.ne, preps[3].sum(), use_dense)
+    return DeltaCarry(
+        new, pending, thr, c.it + 1,
+        jnp.sum(pending.astype(jnp.int32)), edges,
     )
 
 
@@ -137,52 +146,48 @@ def _spmd_delta_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
                           delta: int, arr_blk, parr_blk, c: DeltaCarry
                           ) -> DeltaCarry:
     """One delta round from a device's perspective inside shard_map
-    (k resident parts as the leading axis).  The bucket decision, like
-    the push engine's direction switch, is GLOBAL (one psum) so both
-    branches are collective-divergence-free; expansion reuses the push
-    engine's OWN SPMD prep/relax bodies via a synthesized PushCarry."""
+    (k resident parts as the leading axis).  The bucket-occupancy vote
+    (one psum) and the fused threshold advance (one pmin) are GLOBAL —
+    every device takes the identical single path, so the collectives
+    inside never diverge; expansion reuses the push engine's OWN SPMD
+    prep/relax bodies via a synthesized PushCarry."""
     lax = jax.lax
 
     in_bucket = c.pending & (c.state < c.thr)
     n_in = lax.psum(jnp.sum(in_bucket.astype(jnp.int32)), push.PARTS_AXIS)
-
-    def expand(c: DeltaCarry) -> DeltaCarry:
-        q_vid, q_val, cnt = jax.vmap(partial(push.build_queue, pspec))(
-            arr_blk, in_bucket, c.state
-        )
-        k = arr_blk.global_vid.shape[0]
-        tmp = push.PushCarry(
-            c.state, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1),
-            push._zero_edges(), jnp.zeros((k,), jnp.uint32), jnp.int32(0),
-        )
-        plan = push._spmd_push_prep(pspec, spec, parr_blk, tmp)
-        new = push._spmd_push_relax(
-            prog, pspec, spec, parr_blk, arr_blk,
-            push._allgather_dense_fn(prog, arr_blk, method), tmp, plan,
-        )
-        use_dense = plan[3]
-        changed = (new != c.state) & arr_blk.vtx_mask
-        kept = jnp.where(use_dense, False, c.pending & ~in_bucket)
-        pending = kept | changed
-        active = lax.psum(
-            jnp.sum(pending.astype(jnp.int32)), push.PARTS_AXIS
-        )
-        totals = plan[2][3]
-        g_total = lax.psum(
-            jnp.sum(totals.astype(jnp.uint32)), push.PARTS_AXIS
-        )
-        edges = push._acc_edges(c.edges, spec.ne, g_total, use_dense)
-        return DeltaCarry(new, pending, c.thr, c.it + 1, active, edges)
-
-    def advance(c: DeltaCarry) -> DeltaCarry:
-        inf = jnp.int32(prog.inf)
-        local_min = jnp.min(jnp.where(c.pending, c.state, inf))
-        min_pend = lax.pmin(local_min, push.PARTS_AXIS)
-        thr = (min_pend // jnp.int32(delta) + 1) * jnp.int32(delta)
-        return DeltaCarry(c.state, c.pending, thr, c.it + 1,
-                          c.active, c.edges)
-
-    return jax.lax.cond(n_in > 0, expand, advance, c)
+    # fused threshold advance — same _advanced_thr arithmetic, with the
+    # masked min pmin'd over the parts axis
+    inf = jnp.int32(prog.inf)
+    local_min = jnp.min(jnp.where(c.pending, c.state, inf))
+    thr = _advanced_thr(prog, delta, c, n_in,
+                        min_pend=lax.pmin(local_min, push.PARTS_AXIS))
+    in_bucket = c.pending & (c.state < thr)
+    q_vid, q_val, cnt = jax.vmap(partial(push.build_queue, pspec))(
+        arr_blk, in_bucket, c.state
+    )
+    k = arr_blk.global_vid.shape[0]
+    tmp = push.PushCarry(
+        c.state, q_vid, q_val, cnt, jnp.int32(0), jnp.int32(1),
+        push._zero_edges(), jnp.zeros((k,), jnp.uint32), jnp.int32(0),
+    )
+    plan = push._spmd_push_prep(pspec, spec, parr_blk, tmp)
+    new = push._spmd_push_relax(
+        prog, pspec, spec, parr_blk, arr_blk,
+        push._allgather_dense_fn(prog, arr_blk, method), tmp, plan,
+    )
+    use_dense = plan[3]
+    changed = (new != c.state) & arr_blk.vtx_mask
+    kept = jnp.where(use_dense, False, c.pending & ~in_bucket)
+    pending = kept | changed
+    active = lax.psum(
+        jnp.sum(pending.astype(jnp.int32)), push.PARTS_AXIS
+    )
+    totals = plan[2][3]
+    g_total = lax.psum(
+        jnp.sum(totals.astype(jnp.uint32)), push.PARTS_AXIS
+    )
+    edges = push._acc_edges(c.edges, spec.ne, g_total, use_dense)
+    return DeltaCarry(new, pending, thr, c.it + 1, active, edges)
 
 
 @lru_cache(maxsize=64)
