@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Chip probe: which gather formulation is fast on this TPU?
+
+Round-5 chip data (micro race + bench race) shows the hot loop is
+GATHER-BOUND: XLA's flat 1-D gather runs ~7 cycles/element (0.14 GTEPS
+at rmat17) while the segment reduce does 1.05 GTEPS — the reference's
+coalesced load_kernel (pagerank_gpu.cu:34-47) has no XLA analog.  Mosaic
+exposes the real hardware primitive (``tpu.dynamic_gather``) only for
+2-D ``take_along_axis`` patterns: per-LANE gathers along sublanes
+(axis 0) and per-SUBLANE gathers along lanes (axis 1), idx shape ==
+operand shape (jax pallas mosaic lowering.py _gather_lowering_rule).
+
+This tool times every candidate route to that primitive, each in its own
+abandonable worker (micro-race harness semantics: banked to disk as soon
+as measured, risky variants last, wedged workers never killed):
+
+  flat     y = x[idx]                     XLA 1-D baseline (ties to micro)
+  tala0    take_along_axis(x2d, i, 0)     XLA-level, per-lane rows
+  tala1    take_along_axis(x2d, i, 1)     XLA-level, per-sublane lanes
+  ptala0   same as tala0 inside Pallas    block-local (VMEM) rows
+  ptala1   same as tala1 inside Pallas    128-lane shuffle
+  pstream  arbitrary full-column gather   Pallas: stream in-blocks, mask
+                                          + accumulate (the 3-pass Clos
+                                          permutation's building block)
+
+Every worker numerics-checks its first result against NumPy (exact for
+f32 moves) — on-chip Mosaic validation, not just interpret mode.
+
+Usage: python tools/tpu_gather_probe.py [--scale 17]
+       (worker mode: --worker --variant V, spawned internally)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+VARIANTS = ("flat", "tala0", "tala1", "ptala0", "ptala1", "pstream")
+
+
+def _fit(xs, ys):
+    n = len(xs)
+    mx, my = sum(xs) / n, sum(ys) / n
+    num = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    den = sum((x - mx) ** 2 for x in xs)
+    return num / den, my - (num / den) * mx
+
+
+def _pallas_tala(axis: int, rb: int, interpret: bool = False):
+    """Block-local take_along_axis kernel: grid over row-blocks, idx
+    values local to the block (axis 0: [0, rb); axis 1: [0, 128))."""
+    import functools
+
+    import jax
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.numpy as jnp
+
+    def kernel(x_ref, i_ref, o_ref):
+        o_ref[:] = jnp.take_along_axis(
+            x_ref[:], i_ref[:], axis=axis, mode="promise_in_bounds"
+        )
+
+    @jax.jit
+    def run(x, idx):
+        r, c = x.shape
+        grid = (r // rb,)
+        spec = pl.BlockSpec((rb, c), lambda i: (i, 0))
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[spec, spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)
+            ),
+            interpret=interpret,
+        )(x, idx)
+
+    return run
+
+
+def _pallas_stream(rb_out: int, rb_in: int, interpret: bool = False):
+    """Arbitrary whole-column gather: out[r, c] = x[idx[r, c], c] with
+    idx in [0, R).  Grid (out_blocks, in_blocks); every in-block streams
+    past every out-block (consecutive revisits keep the out block in
+    VMEM); in-range hits are selected in.  One pass of the 3-stage
+    permutation network costs exactly this."""
+    import jax
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.numpy as jnp
+
+    def kernel(x_ref, i_ref, o_ref):
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        base = j * rb_in
+        local = i_ref[:] - base
+        valid = (local >= 0) & (local < rb_in)
+        g = jnp.take_along_axis(
+            x_ref[:],
+            jnp.clip(local, 0, rb_in - 1),
+            axis=0,
+            mode="promise_in_bounds",
+        )
+        o_ref[:] = jnp.where(valid, g, o_ref[:])
+
+    @jax.jit
+    def run(x, idx):
+        r, c = x.shape
+        grid = (r // rb_out, r // rb_in)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((rb_in, c), lambda o, j: (j, 0)),
+                pl.BlockSpec((rb_out, c), lambda o, j: (o, 0)),
+            ],
+            out_specs=pl.BlockSpec((rb_out, c), lambda o, j: (o, 0)),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary")
+            ),
+            interpret=interpret,
+        )(x, idx)
+
+    return run
+
+
+def worker_main(args) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t_setup = time.perf_counter()
+    n = 1 << args.scale  # elements moved per rep (matches rmat edges/8)
+    cols = 128
+    rows = n // cols
+    rb = min(args.rb, rows)
+    interp = bool(int(os.environ.get("LUX_GP_INTERPRET", "0")))
+    rng = np.random.default_rng(0)
+    x_np = rng.random((rows, cols)).astype(np.float32)
+    v = args.variant
+    if v == "flat":
+        idx_np = rng.integers(0, n, n, dtype=np.int32)
+        x = jnp.asarray(x_np.reshape(-1))
+        idx = jnp.asarray(idx_np)
+        want = x_np.reshape(-1)[idx_np]
+
+        def f(x):
+            return x[idx]
+
+        run1 = jax.jit(f)
+    elif v in ("tala0", "ptala0", "pstream"):
+        hi = rb if v == "ptala0" else rows
+        idx_np = rng.integers(0, hi, (rows, cols), dtype=np.int32)
+        if v == "ptala0":  # rows random WITHIN each block (primitive rate)
+            blk = np.arange(rows, dtype=np.int32)[:, None] // rb * rb
+            idx_np = (rng.integers(0, rb, (rows, cols), dtype=np.int32)
+                      + blk).astype(np.int32)
+            want = np.take_along_axis(x_np, idx_np, axis=0)
+            idx_np = idx_np - blk  # kernel sees block-local
+        else:
+            want = np.take_along_axis(x_np, idx_np, axis=0)
+        x = jnp.asarray(x_np)
+        idx = jnp.asarray(idx_np)
+        if v == "tala0":
+            run1 = jax.jit(
+                lambda x: jnp.take_along_axis(
+                    x, idx, axis=0, mode="promise_in_bounds"))
+        elif v == "ptala0":
+            pk = _pallas_tala(0, rb, interp)
+            run1 = lambda x: pk(x, idx)
+        else:
+            pk = _pallas_stream(rb, rb, interp)
+            run1 = lambda x: pk(x, idx)
+    elif v in ("tala1", "ptala1"):
+        idx_np = rng.integers(0, cols, (rows, cols), dtype=np.int32)
+        want = np.take_along_axis(x_np, idx_np, axis=1)
+        x = jnp.asarray(x_np)
+        idx = jnp.asarray(idx_np)
+        if v == "tala1":
+            run1 = jax.jit(
+                lambda x: jnp.take_along_axis(
+                    x, idx, axis=1, mode="promise_in_bounds"))
+        else:
+            pk = _pallas_tala(1, rb, interp)
+            run1 = lambda x: pk(x, idx)
+    else:
+        raise SystemExit(f"unknown variant {v}")
+
+    jax.block_until_ready((x, idx))
+    platform = jax.devices()[0].platform
+    print(f"# gather worker: platform={platform} variant={v} n={n} "
+          f"rows={rows} rb={rb} setup={time.perf_counter()-t_setup:.1f}s",
+          flush=True)
+
+    # numerics first: on-chip result == NumPy oracle, exactly (f32 moves)
+    got = np.asarray(jax.device_get(run1(x)))
+    ok = bool((got.reshape(want.shape) == want).all())
+    print(f"# numerics: {'EXACT' if ok else 'MISMATCH'}", flush=True)
+
+    # x_{k+1} = g(x_k) chaining; scale values so chains stay finite
+    @jax.jit
+    def run(x0, nrep):
+        def body(_, xc):
+            return run1(xc).reshape(xc.shape) * jnp.float32(0.999)
+        return jax.lax.fori_loop(0, nrep, body, x0)
+
+    t_c = time.perf_counter()
+    for r in args.reps:
+        float(jax.device_get(run(x, jnp.int32(r)).ravel()[0]))
+    compile_s = time.perf_counter() - t_c
+    xs, ts = [], []
+    for r in args.reps:
+        t0 = time.perf_counter()
+        float(jax.device_get(run(x, jnp.int32(r)).ravel()[0]))
+        ts.append(time.perf_counter() - t0)
+        xs.append(r)
+    slope, icpt = _fit(xs, ts)
+    ns_per_elem = slope / n * 1e9 if slope > 0 else float("nan")
+    gbps = 2 * 4 * n / slope / 1e9 if slope > 0 else float("nan")
+    print(json.dumps({
+        "gather_probe": v, "platform": platform, "n": n,
+        "numerics_exact": ok,
+        "ms_per_rep": round(slope * 1e3, 4),
+        "ns_per_elem": round(ns_per_elem, 3),
+        "eff_GBps_rw": round(gbps, 2),
+        "intercept_ms": round(icpt * 1e3, 2),
+        "compile_s": round(compile_s, 1),
+        "raw": {str(r): round(t, 4) for r, t in zip(xs, ts)},
+    }), flush=True)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=21,
+                    help="log2(elements) moved per rep")
+    ap.add_argument("--rb", type=int, default=4096,
+                    help="Pallas row-block (VMEM budget: 3*rb*128*4B)")
+    ap.add_argument("--reps", type=int, nargs="+", default=[1, 8, 32])
+    ap.add_argument("--variants", nargs="+", default=list(VARIANTS),
+                    help="probe order; riskiest (pstream) belongs last")
+    ap.add_argument("--variant", help="(worker mode)")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--per-variant-s", type=int,
+                    default=int(os.environ.get("LUX_MICRO_METHOD_S", "300")))
+    ap.add_argument("--outdir", default="/tmp/lux_gather_probe")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return worker_main(args)
+
+    on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if not on_cpu:
+        import socket
+
+        try:
+            socket.create_connection(("127.0.0.1", 8083), timeout=3).close()
+        except OSError:
+            print("relay down (127.0.0.1:8083) — nothing to probe",
+                  flush=True)
+            return 1
+    os.makedirs(args.outdir, exist_ok=True)
+    rows: dict[str, dict] = {}
+    for v in args.variants:
+        out_path = os.path.join(args.outdir, f"gp_{v}.out")
+        out = open(out_path, "wb")
+        err = open(out_path + ".err", "wb")
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               "--variant", v, "--scale", str(args.scale),
+               "--rb", str(args.rb),
+               "--reps", *[str(r) for r in args.reps]]
+        t0 = time.monotonic()
+        proc = subprocess.Popen(cmd, stdout=out, stderr=err,
+                                cwd=os.path.dirname(os.path.abspath(__file__)),
+                                start_new_session=True)
+        while time.monotonic() - t0 < args.per_variant_s:
+            if proc.poll() is not None:
+                break
+            time.sleep(1)
+        abandoned = proc.poll() is None
+        out.close()
+        err.close()
+        for line in (open(out_path, "rb").read()
+                     .decode("utf8", "replace").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows[v] = json.loads(line)
+                except ValueError:
+                    pass
+            elif line:
+                print(line, flush=True)
+        if v in rows:
+            print(json.dumps(rows[v]), flush=True)
+        if abandoned:
+            print(f"# {v} ABANDONED after {args.per_variant_s}s (pid "
+                  f"{proc.pid} left to unwind); stopping probe", flush=True)
+            break
+        if v not in rows:
+            print(f"# {v} produced no measurement (rc={proc.returncode}; "
+                  f"see {out_path}.err)", flush=True)
+    if not rows:
+        print("gather probe: no measurements", flush=True)
+        return 1
+    summary = {v: {"ns_per_elem": r.get("ns_per_elem"),
+                   "exact": r.get("numerics_exact")}
+               for v, r in rows.items()}
+    print(f"# gather probe summary: {json.dumps(summary)}", flush=True)
+    platforms = {r.get("platform") for r in rows.values()}
+    if platforms & {"tpu", "axon"}:
+        from lux_tpu.engine import methods
+
+        methods.record_overlay_entry("tpu:gather_probe", summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
