@@ -1,0 +1,184 @@
+"""Device replay of a routed permutation (ops/route.py) at shuffle speed.
+
+Each Benes pass gathers along one digit.  Mosaic's ``tpu.dynamic_gather``
+covers exactly two shapes (measured on the round-5 v5e window,
+tools/tpu_gather_probe.py: 0.08 ns/element vs 7 ns for XLA's flat
+gather, the pull engine's former per-edge state read — reference role:
+pagerank_gpu.cu:34-47 load_kernel):
+
+  * LANE pass: gather along a 128 digit, batched over rows — operand
+    block (rb, 128), index values in [0, 128);
+  * SUBLANE pass: gather along a digit d <= 8 (one vreg of sublanes),
+    batched over lanes — operand block (d, lb), index values in [0, d).
+
+The digit being gathered must sit in the right position of the physical
+layout, so the host-side planner (``plan_route``) threads ONE transpose
+per pass: it tracks the running digit order, transposes the DATA
+directly from the previous pass's layout into this pass's, and
+pre-arranges every index array into its kernel layout at build time
+(indices are digit-local values — relayouts move their positions, never
+their values).  All transposes are XLA copies at HBM bandwidth; the
+gathers never leave VMEM.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.ops.route import Route
+
+LANE = 128
+
+
+def _lane_kernel(x_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(
+        x_ref[:], i_ref[:], axis=1, mode="promise_in_bounds"
+    )
+
+
+def _sublane_kernel(x_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(
+        x_ref[:], i_ref[:], axis=0, mode="promise_in_bounds"
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("rb", "interpret"))
+def lane_gather(x, idx, rb: int = 1024, interpret: bool = False):
+    """(R, 128) per-row lane shuffle: out[r, c] = x[r, idx[r, c]]."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = x.shape[0]
+    rb = min(rb, r)
+    assert r % rb == 0, (r, rb)
+    spec = pl.BlockSpec((rb, LANE), lambda i: (i, 0))
+    return pl.pallas_call(
+        _lane_kernel,
+        grid=(r // rb,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(x, idx)
+
+
+@functools.partial(jax.jit, static_argnames=("lb", "interpret"))
+def sublane_gather(x, idx, lb: int = 16384, interpret: bool = False):
+    """(d, L) per-lane sublane shuffle (d <= 8, one vreg):
+    out[s, l] = x[idx[s, l], l]."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    d, length = x.shape
+    assert d <= 8, d
+    lb = min(lb, length)
+    assert length % lb == 0, (length, lb)
+    spec = pl.BlockSpec((d, lb), lambda i: (0, i))
+    return pl.pallas_call(
+        _sublane_kernel,
+        grid=(length // lb,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(x, idx)
+
+
+@dataclasses.dataclass
+class DevicePass:
+    """One planned pass: transpose the flat data from the previous
+    layout via ``perm_axes`` (on the mixed-radix ``view`` of the
+    PREVIOUS layout), then run ``kind`` with the pre-arranged ``idx``."""
+
+    kind: str  # "lane" | "sublane"
+    view: tuple[int, ...]  # reshape of the incoming flat array
+    perm_axes: tuple[int, ...]  # np.transpose axes, () if identity
+    kshape: tuple[int, ...]  # 2-D kernel operand shape
+    idx: np.ndarray  # int32, kshape
+
+
+@dataclasses.dataclass
+class RoutePlan:
+    n: int
+    dims: tuple[int, ...]
+    passes: list[DevicePass]
+    final_view: tuple[int, ...]
+    final_perm: tuple[int, ...]  # restore row-major digit order at the end
+
+
+def plan_route(route: Route) -> RoutePlan:
+    """Compile a host Route into transposed-once-per-pass device form."""
+    dims = route.dims
+    k = len(dims)
+    order = list(range(k))  # current digit order, outer->inner
+    passes: list[DevicePass] = []
+    for p in route.passes:
+        g = p.axis
+        d = dims[g]
+        if d == LANE:
+            new_order = [a for a in order if a != g] + [g]
+            kshape = (route.n // LANE, LANE)
+            kind = "lane"
+        else:
+            new_order = [g] + [a for a in order if a != g]
+            kshape = (d, route.n // d)
+            kind = "sublane"
+        view = tuple(dims[a] for a in order)
+        perm_axes = tuple(order.index(a) for a in new_order)
+        if perm_axes == tuple(range(k)):
+            perm_axes = ()
+        # index array: canonical row-major -> this pass's layout
+        idx = np.ascontiguousarray(
+            np.transpose(p.idx, new_order).reshape(kshape), np.int32
+        )
+        passes.append(DevicePass(kind=kind, view=view,
+                                 perm_axes=perm_axes, kshape=kshape,
+                                 idx=idx))
+        order = new_order
+    final_view = tuple(dims[a] for a in order)
+    final_perm = tuple(order.index(a) for a in range(k))
+    if final_perm == tuple(range(k)):
+        final_perm = ()
+    return RoutePlan(n=route.n, dims=dims, passes=passes,
+                     final_view=final_view, final_perm=final_perm)
+
+
+def device_indices(plan: RoutePlan):
+    """The per-pass index arrays as device arrays (put once per graph)."""
+    return tuple(jnp.asarray(p.idx) for p in plan.passes)
+
+
+def apply_route(x, plan: RoutePlan, idx_dev=None, rb: int = 1024,
+                lb: int = 16384, interpret: bool = False):
+    """Replay the permutation on device: x flat (n,) -> x[perm].
+
+    Jit-safe (static plan, traced data); pass ``idx_dev`` from
+    ``device_indices`` to avoid re-uploading indices per call.
+    """
+    if idx_dev is None:
+        idx_dev = device_indices(plan)
+    y = x
+    for p, idx in zip(plan.passes, idx_dev):
+        y = y.reshape(p.view)
+        if p.perm_axes:
+            y = y.transpose(p.perm_axes)
+        y = y.reshape(p.kshape)
+        if p.kind == "lane":
+            y = lane_gather(y, idx, rb=rb, interpret=interpret)
+        else:
+            y = sublane_gather(y, idx, lb=lb, interpret=interpret)
+        y = y.reshape(-1)
+    y = y.reshape(plan.final_view)
+    if plan.final_perm:
+        y = y.transpose(plan.final_perm)
+    return y.reshape(-1)

@@ -1,0 +1,244 @@
+"""Host-side Benes/Clos routing: any fixed permutation as per-digit gathers.
+
+Why this exists (measured on the round-5 v5e window, tools/
+tpu_gather_probe.py): XLA's flat 1-D gather — the pull engine's per-edge
+state read, the role of the reference's coalesced load_kernel
+(pagerank_gpu.cu:34-47) — runs at ~7 ns/element on TPU (scalar-unit
+issue-bound), while Mosaic's ``tpu.dynamic_gather`` moves elements at
+~0.08 ns/element.  But the hardware primitive is narrow: a gather can
+only move data along the LANE axis (width 128) or within ONE vreg of
+sublanes (width <= 8).  An arbitrary N-element permutation therefore has
+to be routed through those widths.
+
+This module does the classic answer: factor N into "digits" from
+{128, 8, 4, 2}, view the flat array as a mixed-radix hypercube, and
+decompose the permutation Clos-style:
+
+    route(pi over (D, M)) = [gather along D] o [per-d route over M] o
+                            [gather along D]
+
+which yields 2k-1 passes for k digits (a Benes network of radix-128/8
+stages).  Each pass gathers along exactly ONE digit, batched over all
+others — exactly the shape ``tpu.dynamic_gather`` supports — and the
+middle recursion is batched, so all leaves of one level share a single
+physical pass.  Pass index arrays are precomputed HERE, once per graph;
+the device replays them every iteration (ops/pallas_shuffle.apply_route).
+
+The stage-1/3 index construction is an edge coloring of the D-regular
+bipartite multigraph between source and destination middle-coordinates:
+repeated Euler splits halve the regularity until single matchings remain
+(possible because digits are powers of two).  Pure NumPy+Python here —
+O(N log D) pointer walking; ``native/lux_route.cc`` accelerates the same
+contract for benchmark-scale graphs (built lazily, identical output).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+#: digits a pass may gather along: 128 rides the lane shuffle, <=8 stays
+#: within one sublane vreg ("multiple source vregs along gather
+#: dimension" is the Mosaic error past 8).
+LANE = 128
+MAX_SUBLANE = 8
+
+
+def factor_digits(n: int) -> list[int]:
+    """Factor ``n`` (a power of two, >= 2) into gatherable digits,
+    most-significant first: as many 128s as possible, then one 8/4/2
+    remainder digit (kept in the MIDDLE recursion where it costs one
+    pass, not two)."""
+    if n & (n - 1) or n < 2:
+        raise ValueError(f"n must be a power of two >= 2, got {n}")
+    digits = []
+    while n >= LANE:
+        digits.append(LANE)
+        n //= LANE
+    while n > 1:
+        d = min(n, MAX_SUBLANE)
+        digits.append(d)
+        n //= d
+    # middle digit is cheapest (appears once in the Benes pass list):
+    # put the small remainder digit innermost
+    return digits
+
+
+@dataclasses.dataclass
+class Pass:
+    """One device pass: gather along ``digit`` (size ``dim``) with the
+    digit at position ``axis`` of the mixed-radix ``shape``; ``idx`` is
+    the full-size int32 gather index array, laid out in ``shape`` order,
+    values in [0, dim)."""
+
+    shape: tuple[int, ...]
+    axis: int
+    idx: np.ndarray  # shape == self.shape, int32
+
+
+@dataclasses.dataclass
+class Route:
+    """A routed permutation: applying ``passes`` in order to ``x``
+    (flattened mixed-radix layout) yields ``x[perm]``."""
+
+    n: int
+    dims: tuple[int, ...]
+    passes: list[Pass]
+
+
+def _split_regular(u: np.ndarray, v: np.ndarray, deg: int, nl: int, nr: int):
+    """Split a deg-regular bipartite multigraph (edges u[i]->v[i], u in
+    [0,nl), v in [0,nr)) into two (deg/2)-regular halves via an Euler
+    partition.  Returns a bool mask (True = first half).  Pure Python
+    pointer walk — the reference implementation and small-N path."""
+    m = len(u)
+    # incidence CSR per side (stable argsort = vectorized bucket fill)
+    l_off = np.zeros(nl + 1, np.int64)
+    np.add.at(l_off[1:], u, 1)
+    np.cumsum(l_off, out=l_off)
+    l_edges = np.argsort(u, kind="stable")
+    r_off = np.zeros(nr + 1, np.int64)
+    np.add.at(r_off[1:], v, 1)
+    np.cumsum(r_off, out=r_off)
+    r_edges = np.argsort(v, kind="stable")
+
+    used = np.zeros(m, bool)
+    half = np.zeros(m, bool)
+    l_ptr = l_off[:-1].copy()
+    r_ptr = r_off[:-1].copy()
+
+    def _next_l(node):
+        p = l_ptr[node]
+        stop = l_off[node + 1]
+        while p < stop and used[l_edges[p]]:
+            p += 1
+        l_ptr[node] = p
+        return l_edges[p] if p < stop else -1
+
+    def _next_r(node):
+        p = r_ptr[node]
+        stop = r_off[node + 1]
+        while p < stop and used[r_edges[p]]:
+            p += 1
+        r_ptr[node] = p
+        return r_edges[p] if p < stop else -1
+
+    for e0 in range(m):
+        if used[e0]:
+            continue
+        # walk the Euler circuit containing e0, alternating halves:
+        # L->R edges get the parity flag, the return R->L edge the other
+        e = e0
+        take = True
+        while True:
+            used[e] = True
+            half[e] = take
+            take = not take
+            # continue from the right endpoint: leave via an unused edge
+            nxt = _next_r(v[e])
+            if nxt < 0:
+                # circuit closed on the right side; all circuits in an
+                # even-regular multigraph close where they started
+                break
+            e = nxt
+            used[e] = True
+            half[e] = take
+            take = not take
+            nxt = _next_l(u[e])
+            if nxt < 0:
+                break
+            e = nxt
+    return half
+
+
+def _color_regular(u: np.ndarray, v: np.ndarray, deg: int, nl: int,
+                   nr: int) -> np.ndarray:
+    """Color a deg-regular bipartite multigraph with ``deg`` colors
+    (deg a power of two) by recursive Euler splits; returns int32
+    colors per edge, each color class a perfect matching."""
+    colors = np.zeros(len(u), np.int32)
+    stack = [(np.arange(len(u), dtype=np.int64), deg, 0)]
+    while stack:
+        sel, d, base = stack.pop()
+        if d == 1:
+            colors[sel] = base
+            continue
+        mask = _split_regular(u[sel], v[sel], d, nl, nr)
+        stack.append((sel[mask], d // 2, base))
+        stack.append((sel[~mask], d // 2, base + d // 2))
+    return colors
+
+
+def _route_rec(perm: np.ndarray, dims: list[int]) -> list[np.ndarray]:
+    """Recursive Clos decomposition.  ``perm`` maps TARGET flat index ->
+    SOURCE flat index over mixed-radix ``dims`` (row-major).  Returns
+    the pass index arrays outermost-first; pass j gathers along digit
+    dims[min(j, 2k-2-j)] (the Benes "V" order), each array flat in the
+    full row-major layout with the gathered digit varying... (see
+    build_route, which reshapes per pass)."""
+    n = len(perm)
+    d = dims[0]
+    if len(dims) == 1:
+        # single digit: the permutation IS a gather along it
+        return [perm.astype(np.int32)]
+    m = n // d  # size of the middle (remaining digits) space
+    tgt = np.arange(n, dtype=np.int64)
+    src = perm.astype(np.int64)
+    # coordinates: flat = digit * m + mid  (digit is OUTERMOST, row-major)
+    d2, m2 = tgt // m, tgt % m
+    d1, m1 = src // m, src % m
+    # color the D-regular multigraph m1 -> m2 with D colors
+    colors = _color_regular(m1, m2, d, m, m)
+    # stage 1: within each middle-coordinate m1 (a "column"), move along
+    # the digit axis: element (d1, m1) -> (c, m1).  idx1[c, m1] = d1.
+    idx1 = np.empty(n, np.int32)
+    idx1[colors.astype(np.int64) * m + m1] = d1.astype(np.int32)
+    # stage 2 (recurse): within each digit value c, an arbitrary
+    # permutation of the middle space: target (c, m2) pulls from (c, m1)
+    mid_perm = np.empty(n, np.int64)
+    mid_perm[colors.astype(np.int64) * m + m2] = m1
+    sub = [
+        _route_rec(mid_perm.reshape(d, m)[c], dims[1:]) for c in range(d)
+    ]
+    # batch the per-c sub-passes into single full-size passes
+    mids = [
+        np.stack([sub[c][j] for c in range(d)]).reshape(-1)
+        for j in range(len(sub[0]))
+    ]
+    # stage 3: within each m2 column, digit c -> d2: idx3[d2, m2] = c
+    idx3 = np.empty(n, np.int32)
+    idx3[d2 * m + m2] = colors
+    return [idx1] + mids + [idx3]
+
+
+def build_route(perm: np.ndarray, dims: list[int] | None = None) -> Route:
+    """Decompose ``perm`` (out[i] = x[perm[i]], a bijection on a
+    power-of-two N) into 2k-1 digit-gather passes.
+
+    Every pass array is returned reshaped to the full mixed-radix
+    ``shape`` with ``axis`` marking the gathered digit, so the device
+    side can transpose that axis into lane/sublane position and feed
+    ``tpu.dynamic_gather`` directly.
+    """
+    n = len(perm)
+    if dims is None:
+        dims = factor_digits(n)
+    assert int(np.prod(dims)) == n, (dims, n)
+    flat_passes = _route_rec(np.asarray(perm, np.int64), list(dims))
+    k = len(dims)
+    assert len(flat_passes) == 2 * k - 1
+    shape = tuple(dims)
+    passes = []
+    for j, idx in enumerate(flat_passes):
+        axis = min(j, 2 * k - 2 - j)
+        passes.append(Pass(shape=shape, axis=axis,
+                           idx=idx.reshape(shape)))
+    return Route(n=n, dims=shape, passes=passes)
+
+
+def apply_route_np(route: Route, x: np.ndarray) -> np.ndarray:
+    """NumPy oracle: replay the passes with take_along_axis."""
+    y = np.asarray(x).reshape(route.dims)
+    for p in route.passes:
+        y = np.take_along_axis(y, p.idx, axis=p.axis)
+    return y.reshape(-1)

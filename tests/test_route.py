@@ -1,0 +1,68 @@
+"""Benes/Clos permutation routing: host construction + oracles.
+
+The route is the host half of the permuted-gather design (the
+measured-fast replacement for XLA's scalar-issue-bound flat gather;
+tools/tpu_gather_probe.py rows in .lux_winners.json).  These tests pin
+the CONSTRUCTION: every pass must be a true per-digit gather (index
+values in range, each batch row a permutation of the digit) and the
+composition must replay the exact permutation.
+"""
+import numpy as np
+import pytest
+
+from lux_tpu.ops import route as R
+
+
+def _check_passes_are_digit_perms(rt):
+    """Each pass, viewed with its axis last, must hold a permutation of
+    [0, dim) in EVERY batch row — gathers that drop or duplicate lanes
+    would still 'apply' but could not be hardware-routed losslessly."""
+    for p in rt.passes:
+        dim = p.shape[p.axis]
+        moved = np.moveaxis(p.idx, p.axis, -1).reshape(-1, dim)
+        assert moved.min() >= 0 and moved.max() < dim
+        sorted_rows = np.sort(moved, axis=1)
+        assert (sorted_rows == np.arange(dim)).all()
+
+
+@pytest.mark.parametrize("n", [128, 1024, 2048, 16384])
+def test_route_random_perm(n, rng):
+    perm = rng.permutation(n)
+    rt = R.build_route(perm)
+    assert len(rt.passes) == 2 * len(rt.dims) - 1
+    _check_passes_are_digit_perms(rt)
+    x = rng.random(n).astype(np.float32)
+    np.testing.assert_array_equal(R.apply_route_np(rt, x), x[perm])
+
+
+def test_route_identity_and_reverse(rng):
+    n = 4096
+    for perm in (np.arange(n), np.arange(n)[::-1].copy()):
+        rt = R.build_route(perm)
+        x = rng.random(n).astype(np.float32)
+        np.testing.assert_array_equal(R.apply_route_np(rt, x), x[perm])
+
+
+def test_route_int_payload(rng):
+    """int32 payloads route bit-exactly (edge ids, labels)."""
+    n = 2048
+    perm = rng.permutation(n)
+    rt = R.build_route(perm)
+    x = rng.integers(-(2**31), 2**31 - 1, n, dtype=np.int32)
+    np.testing.assert_array_equal(R.apply_route_np(rt, x), x[perm])
+
+
+def test_factor_digits():
+    assert R.factor_digits(128) == [128]
+    assert R.factor_digits(1024) == [128, 8]
+    assert R.factor_digits(2048) == [128, 8, 2]
+    assert R.factor_digits(128 * 128) == [128, 128]
+    assert R.factor_digits(1 << 24) == [128, 128, 128, 8]
+    with pytest.raises(ValueError):
+        R.factor_digits(96)
+
+
+def test_route_mixed_small_digit_first_rejected(rng):
+    """dims are caller-overridable; a wrong product must fail loudly."""
+    with pytest.raises(AssertionError):
+        R.build_route(np.arange(256), dims=[128, 4])

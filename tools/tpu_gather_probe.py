@@ -19,9 +19,16 @@ as measured, risky variants last, wedged workers never killed):
   tala1    take_along_axis(x2d, i, 1)     XLA-level, per-sublane lanes
   ptala0   same as tala0 inside Pallas    block-local (VMEM) rows
   ptala1   same as tala1 inside Pallas    128-lane shuffle
+  route    full Benes permutation replay  ops/route + ops/pallas_shuffle:
+                                          2k-1 digit-gather passes + one
+                                          transpose each — the production
+                                          rival of `flat` for the fixed
+                                          per-edge state-read permutation
   pstream  arbitrary full-column gather   Pallas: stream in-blocks, mask
-                                          + accumulate (the 3-pass Clos
-                                          permutation's building block)
+                                          + accumulate (KNOWN-FAILING on
+                                          v5e: sublane dynamic_gather is
+                                          single-vreg only; kept last as
+                                          a canary for that constraint)
 
 Every worker numerics-checks its first result against NumPy (exact for
 f32 moves) — on-chip Mosaic validation, not just interpret mode.
@@ -40,7 +47,8 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-VARIANTS = ("flat", "tala0", "tala1", "ptala0", "ptala1", "pstream")
+VARIANTS = ("flat", "tala0", "tala1", "ptala0", "ptala1", "route",
+            "pstream")
 
 
 def _fit(xs, ys):
@@ -184,6 +192,28 @@ def worker_main(args) -> int:
         else:
             pk = _pallas_stream(rb, rb, interp)
             run1 = lambda x: pk(x, idx)
+    elif v == "route":
+        # full Benes replay of a random PERMUTATION (the production
+        # shape: 2k-1 digit-gather passes + 1 transpose each) — the
+        # apples-to-apples rival of `flat` for a fixed edge permutation
+        from lux_tpu.ops import pallas_shuffle as S
+        from lux_tpu.ops import route as RT
+
+        t_r = time.perf_counter()
+        perm = rng.permutation(n)
+        plan = S.plan_route(RT.build_route(perm))
+        print(f"# route build: {time.perf_counter()-t_r:.1f}s "
+              f"dims={plan.dims} passes={len(plan.passes)}", flush=True)
+        idx_dev = S.device_indices(plan)
+        x = jnp.asarray(x_np.reshape(-1))
+        idx = idx_dev  # block_until_ready target
+        want = x_np.reshape(-1)[perm]
+
+        def f(xc):
+            return S.apply_route(xc, plan, idx_dev=idx_dev, rb=args.rb,
+                                 interpret=interp)
+
+        run1 = jax.jit(f)
     elif v in ("tala1", "ptala1"):
         idx_np = rng.integers(0, cols, (rows, cols), dtype=np.int32)
         want = np.take_along_axis(x_np, idx_np, axis=1)
