@@ -64,6 +64,32 @@ class PullProgram(Protocol):
 _REDUCERS: dict[str, Callable] = segment.reducers()
 
 
+def _route_interpret() -> bool:
+    """Pallas interpret mode off-chip (CPU tests / virtual meshes)."""
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _dst_gather(arrays: ShardArrays, local_state: jnp.ndarray):
+    """Per-edge destination-state read (sentinel-clipped), shared by the
+    direct and routed LOAD paths so the padding convention can't drift."""
+    return local_state[jnp.clip(arrays.dst_local, 0, local_state.shape[0] - 1)]
+
+
+def pull_gather_part_routed(arrays: ShardArrays, full_state: jnp.ndarray,
+                            local_state: jnp.ndarray, route_static,
+                            route_arrays, interpret: bool):
+    """LOAD phase via the routed expand (ops/expand.py): the per-edge
+    state read as Benes lane shuffles instead of a flat XLA gather —
+    bitwise equal on real edge slots (padding junk is only ever read
+    through row_ptr / the dst_local sentinel, same as the direct
+    layout's state[0] reads there)."""
+    from lux_tpu.ops import expand
+
+    src_state = expand.apply_expand(full_state, route_static, route_arrays,
+                                    interpret=interpret)
+    return src_state, _dst_gather(arrays, local_state)
+
+
 def pull_gather_part(arrays: ShardArrays, full_state: jnp.ndarray,
                      local_state: jnp.ndarray):
     """LOAD phase for ONE part: the per-edge (src, dst) state gather —
@@ -84,8 +110,7 @@ def pull_gather_part(arrays: ShardArrays, full_state: jnp.ndarray,
         src_state = mirror[arrays.mirror_rel]   # (E, ...) from U, not P*V
     else:
         src_state = full_state[arrays.src_pos]  # (E, ...) direct gather
-    dst_state = local_state[jnp.clip(arrays.dst_local, 0, local_state.shape[0] - 1)]
-    return src_state, dst_state
+    return src_state, _dst_gather(arrays, local_state)
 
 
 def pull_reduce_part(prog: PullProgram, arrays: ShardArrays, gath,
@@ -105,10 +130,18 @@ def local_pull_step(
     full_state: jnp.ndarray,
     local_state: jnp.ndarray,
     method: str = "scan",
+    route=None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """One pull iteration for ONE part.  ``full_state`` is the (P*V, ...)
-    concatenated padded state of all parts; ``local_state`` is (V, ...)."""
-    gath = pull_gather_part(arrays, full_state, local_state)
+    concatenated padded state of all parts; ``local_state`` is (V, ...).
+    ``route`` = (ExpandStatic, per-part arrays) switches the LOAD phase
+    to the routed-shuffle expand."""
+    if route is not None:
+        gath = pull_gather_part_routed(arrays, full_state, local_state,
+                                       route[0], route[1], interpret)
+    else:
+        gath = pull_gather_part(arrays, full_state, local_state)
     acc = pull_reduce_part(prog, arrays, gath, method)
     return prog.apply(local_state, acc, arrays)
 
@@ -122,12 +155,20 @@ def init_state(prog: PullProgram, arrays: ShardArrays) -> jnp.ndarray:
     )
 
 
-def _pull_iteration(prog, spec: ShardSpec, method, arrays, state):
+def _pull_iteration(prog, spec: ShardSpec, method, arrays, state,
+                    route_static=None, route_arrays=None,
+                    interpret: bool = False):
     """One pull iteration over the whole (P, V, ...) shard stack."""
     full = state.reshape((spec.gathered_size,) + state.shape[2:])
+    if route_static is None:
+        return jax.vmap(
+            lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
+        )(arrays, state)
     return jax.vmap(
-        lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
-    )(arrays, state)
+        lambda arr, loc, ra: local_pull_step(
+            prog, arr, full, loc, method, route=(route_static, ra),
+            interpret=interpret)
+    )(arrays, state, route_arrays)
 
 
 def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "auto"):
@@ -183,10 +224,14 @@ def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "auto"
     return load, comp, update
 
 
-@partial(jax.jit, static_argnames=("prog", "spec", "num_iters", "method"))
-def _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0):
+@partial(jax.jit, static_argnames=("prog", "spec", "num_iters", "method",
+                                   "route_static", "interpret"))
+def _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0,
+                    route_static=None, route_arrays=None,
+                    interpret=False):
     def body(_, state):
-        return _pull_iteration(prog, spec, method, arrays, state)
+        return _pull_iteration(prog, spec, method, arrays, state,
+                               route_static, route_arrays, interpret)
 
     return jax.lax.fori_loop(0, num_iters, body, state0)
 
@@ -198,16 +243,26 @@ def run_pull_fixed(
     state0: jnp.ndarray,
     num_iters: int,
     method: str = "auto",
+    route=None,
 ):
     """Single-device driver: fixed iteration count (PageRank/CF style,
     pagerank/pagerank.cc:109-114).  Whole loop stays on device; the
     compiled program is cached on (prog, spec, num_iters, method).
     ``method="auto"`` resolves to the platform's measured winner
-    (engine.methods).  Returns the final stacked (P, V, ...) state.
+    (engine.methods).  ``route`` (from ops.expand.plan_expand_shards)
+    switches the LOAD phase to the routed-shuffle expand — bitwise-equal
+    results, measured ~15 HBM-bandwidth passes instead of an E-sized
+    scalar-issue-bound flat gather.  Returns the final stacked
+    (P, V, ...) state.
     """
     method = methods.resolve(method, prog.reduce)
     arrays = jax.tree.map(jnp.asarray, arrays)
-    return _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0)
+    rs, ra = route if route is not None else (None, None)
+    if ra is not None:
+        ra = jax.tree.map(jnp.asarray, ra)
+    return _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0,
+                           route_static=rs, route_arrays=ra,
+                           interpret=_route_interpret())
 
 
 def run_pull_until(

@@ -1,0 +1,272 @@
+"""Routed expand: the pull engine's per-edge state read as lane shuffles.
+
+The pull hot loop's LOAD phase is ``state[src_pos]`` — an E-sized random
+gather from the (P*V,) replicated state (the reference's coalesced
+load_kernel, pagerank_gpu.cu:34-47).  On TPU, XLA lowers that to a
+scalar-issue-bound flat gather measured at ~7 ns/element on the round-5
+v5e window, while Mosaic lane shuffles move data at ~0.02 ns/element/pass
+(tools/tpu_gather_probe.py, .lux_winners.json ``tpu:gather_probe``).
+
+This module re-expresses the gather as pure data MOVEMENT so every step
+is a routable shuffle:
+
+    state[src_pos]  =  perm2 ∘ fill_forward ∘ perm1 (state)
+
+1. ``perm1`` — a Benes-routed PERMUTATION (ops/route.py) that places each
+   distinct source's state value at the HEAD slot of its run in CSR edge
+   order (edges sorted by source, so each source's edges are contiguous).
+2. ``fill_forward`` — broadcast each head value across its run.  With
+   STATIC run boundaries this is hierarchical and lane-local: one lane
+   gather fills within each 128-lane row (cells whose head is in an
+   earlier row all share ONE value — the run crossing the row start), and
+   the per-row carry is the same fill-forward problem 128x smaller.
+   Total cost ~1.01 lane passes over N.
+3. ``perm2`` — a second routed permutation from CSR slot order to the
+   engine's CSC slot order, where the existing segmented reducers
+   (ops/segment.py) consume the values unchanged.
+
+Every step moves bits without arithmetic, so the result is BITWISE equal
+to the direct gather — the engine's A/B flag can never change numerics.
+
+Cost model: perm1 and perm2 are 2k-1 passes each (k = len(dims), 4 at
+N=2^24 → 7 passes), fill_forward ~1 — ~15 HBM-bandwidth passes replacing
+E scalar-issued gather slots.  At rmat20/ef16 that is ~5 ms vs ~117 ms.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.ops import route as route_mod
+from lux_tpu.ops import pallas_shuffle as shuf
+
+LANE = 128
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# fill-forward planning
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FFLevelStatic:
+    """Static half of one fill-forward level: the array is viewed
+    (rows, 128); ``base`` levels have no carry recursion."""
+
+    rows: int
+    base: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class FFStatic:
+    levels: tuple[FFLevelStatic, ...]
+    n: int
+
+
+def plan_ff(h: np.ndarray):
+    """Plan fill-forward for static head map ``h`` (h[e] = index of the
+    first slot of e's run; h[e] <= e, h monotone, h[h[e]] == h[e],
+    h[0] == 0).  len(h) must be a power of two >= 128.
+
+    Returns (FFStatic, tuple of per-level index/mask arrays): for each
+    non-base level ``(inrow_idx int32 (R,128), ext_mask bool (R,128))``,
+    for the base level ``(inrow_idx (1,128),)``.
+    """
+    n = len(h)
+    assert n >= LANE and n & (n - 1) == 0, n
+    assert h[0] == 0, "slot 0 must be a head"
+    statics: list[FFLevelStatic] = []
+    arrays: list[np.ndarray] = []
+    h = np.asarray(h, np.int64)
+    while True:
+        rows = len(h) // LANE
+        hr, hc = (h // LANE).reshape(rows, LANE), (h % LANE).reshape(rows, LANE)
+        own = np.arange(rows, dtype=np.int64)[:, None]
+        same = hr == own
+        inrow_idx = np.where(same, hc, 0).astype(np.int32)
+        if rows == 1:
+            statics.append(FFLevelStatic(rows=1, base=True))
+            arrays.append(inrow_idx)
+            return FFStatic(levels=tuple(statics), n=n), tuple(arrays)
+        ext_mask = ~same
+        statics.append(FFLevelStatic(rows=rows, base=False))
+        arrays.append(inrow_idx)
+        arrays.append(ext_mask)
+        # row-level recursion: heads -> head-containing rows; pad the
+        # row array up to a 128-multiple power of two with self-heads
+        heads = np.flatnonzero(h == np.arange(len(h), dtype=np.int64))
+        head_rows = np.unique(heads // LANE)
+        sub_n = max(_next_pow2(rows), LANE)
+        h2 = np.arange(sub_n, dtype=np.int64)
+        pos = np.searchsorted(head_rows, np.arange(rows), side="right") - 1
+        h2[:rows] = head_rows[pos]
+        h = h2
+
+
+def apply_ff(x, static: FFStatic, arrays, interpret: bool = False,
+             rb: int = 1024):
+    """Device fill-forward replay: x (n,) -> x[h] (bitwise)."""
+    return _ff_rec(x, static.levels, list(arrays), interpret, rb)
+
+
+def _ff_rec(x, levels, arrays, interpret, rb):
+    lv = levels[0]
+    y = x.reshape(lv.rows, LANE)
+    inrow_idx = arrays.pop(0)
+    tmp = shuf.lane_gather(y, inrow_idx, rb=min(rb, lv.rows),
+                           interpret=interpret)
+    if lv.base:
+        return tmp.reshape(-1)
+    ext_mask = arrays.pop(0)
+    w = tmp[:, LANE - 1]
+    sub_n = max(_next_pow2(lv.rows), LANE)
+    wp = jnp.pad(w, (0, sub_n - lv.rows))
+    f = _ff_rec(wp, levels[1:], arrays, interpret, rb)[: lv.rows]
+    rc = jnp.roll(f, 1)  # rc[r] = f[r-1]; row 0 is never external
+    out = jnp.where(ext_mask, rc[:, None], tmp)
+    return out.reshape(-1)
+
+
+def apply_ff_np(x, h):
+    """NumPy oracle."""
+    return np.asarray(x)[np.asarray(h, np.int64)]
+
+
+# ---------------------------------------------------------------------------
+# the full expand plan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpandStatic:
+    """Hashable descriptor of a routed expand (safe as a jit static)."""
+
+    n: int
+    e_pad: int
+    state_size: int
+    r1: shuf.StaticRoute
+    ff: FFStatic
+    r2: shuf.StaticRoute
+
+
+def plan_expand(src_pos: np.ndarray, m: int, state_size: int):
+    """Plan the routed expand for ONE part.
+
+    src_pos: (e_pad,) int32 CSC-edge-order gather indices (real edges in
+    slots [0, m), padding after — graph/shards.fill_part layout).
+    state_size: size of the gathered state the engine reads (P*V).
+
+    Returns (ExpandStatic, tuple of np arrays) — the arrays are the
+    pytree half (r1 passes, ff levels, r2 passes, concatenated in that
+    order; ExpandStatic knows the split points implicitly via its
+    sub-plans).
+    """
+    e_pad = len(src_pos)
+    n = max(_next_pow2(e_pad), _next_pow2(state_size), LANE)
+    sp = np.asarray(src_pos[:m], np.int64)
+    csr = np.argsort(sp, kind="stable")  # csr slot j holds CSC edge csr[j]
+    sp_sorted = sp[csr]
+    head = np.empty(m, bool)
+    if m:
+        head[0] = True
+        head[1:] = sp_sorted[1:] != sp_sorted[:-1]
+    head_slots = np.flatnonzero(head)
+    uniq = sp_sorted[head_slots] if m else np.empty(0, np.int64)
+
+    # perm1: out[head_slot j] = x[uniq j]; all other slots filled with
+    # the unused source indices in ascending order (any bijection works)
+    perm1 = np.empty(n, np.int64)
+    perm1[head_slots] = uniq
+    used_src = np.zeros(n, bool)
+    used_src[uniq] = True
+    used_tgt = np.zeros(n, bool)
+    used_tgt[head_slots] = True
+    perm1[~used_tgt] = np.flatnonzero(~used_src)
+    r1 = route_mod.build_route(perm1)
+
+    # fill-forward: h[e] = head slot of e's run (CSR space); padding
+    # slots are their own heads
+    h = np.arange(n, dtype=np.int64)
+    if m:
+        h[:m] = head_slots[np.cumsum(head) - 1]
+    ff_static, ff_arrays = plan_ff(h)
+
+    # perm2: CSR slot j carries CSC edge csr[j] -> out[csr[j]] = y[j]
+    perm2 = np.empty(n, np.int64)
+    perm2[csr] = np.arange(m, dtype=np.int64)
+    perm2[m:] = np.arange(m, n, dtype=np.int64)
+    r2 = route_mod.build_route(perm2)
+
+    r1s, r1a = shuf.freeze_plan(shuf.plan_route(r1))
+    r2s, r2a = shuf.freeze_plan(shuf.plan_route(r2))
+    static = ExpandStatic(n=n, e_pad=e_pad, state_size=state_size,
+                          r1=r1s, ff=ff_static, r2=r2s)
+    return static, tuple(r1a) + tuple(ff_arrays) + tuple(r2a)
+
+
+def split_arrays(static: ExpandStatic, arrays):
+    """Recover the (r1, ff, r2) array groups from the flat tuple."""
+    n1 = len(static.r1.passes)
+    nff = sum(1 if lv.base else 2 for lv in static.ff.levels)
+    r1a = arrays[:n1]
+    ffa = arrays[n1:n1 + nff]
+    r2a = arrays[n1 + nff:]
+    assert len(r2a) == len(static.r2.passes)
+    return r1a, ffa, r2a
+
+
+def apply_expand(full_state, static: ExpandStatic, arrays,
+                 interpret: bool = False):
+    """Device replay: full_state (state_size,) -> full_state[src_pos]
+    (e_pad,), bitwise equal to the direct gather."""
+    if full_state.ndim != 1:
+        raise ValueError(
+            "routed expand supports scalar (1-D) vertex state only; "
+            f"got shape {full_state.shape} — vector-state programs "
+            "(e.g. colfilter's (V, k)) must use the direct gather")
+    r1a, ffa, r2a = split_arrays(static, arrays)
+    x = jnp.pad(full_state, (0, static.n - static.state_size))
+    y = shuf.apply_route_frozen(x, static.r1, r1a, interpret=interpret)
+    y = apply_ff(y, static.ff, ffa, interpret=interpret)
+    z = shuf.apply_route_frozen(y, static.r2, r2a, interpret=interpret)
+    return z[: static.e_pad]
+
+
+def apply_expand_np(src_pos, full_state):
+    """NumPy oracle of the whole expand (the direct gather)."""
+    return np.asarray(full_state)[np.asarray(src_pos, np.int64)]
+
+
+def plan_expand_shards(shards):
+    """Plan the routed expand for every part of a PullShards bundle.
+
+    Returns ``(ExpandStatic, tuple of (P, ...) stacked arrays)`` — the
+    form the engine's vmapped iteration consumes
+    (lux_tpu/engine/pull.py ``route=``).  All parts share one static
+    (same e_pad / gathered size → same dims), asserted here.
+    """
+    arrays = shards.arrays
+    p = arrays.src_pos.shape[0]
+    state_size = shards.spec.gathered_size
+    statics, per_part = [], []
+    for i in range(p):
+        m = int(np.count_nonzero(arrays.edge_mask[i]))
+        s, a = plan_expand(np.asarray(arrays.src_pos[i]), m, state_size)
+        statics.append(s)
+        per_part.append(a)
+    assert all(s == statics[0] for s in statics[1:]), \
+        "parts must share one ExpandStatic"
+    stacked = tuple(
+        np.stack([per_part[i][j] for i in range(p)])
+        for j in range(len(per_part[0]))
+    )
+    return statics[0], stacked

@@ -158,17 +158,53 @@ def device_indices(plan: RoutePlan):
     return tuple(jnp.asarray(p.idx) for p in plan.passes)
 
 
-def apply_route(x, plan: RoutePlan, idx_dev=None, rb: int = 1024,
-                lb: int = 16384, interpret: bool = False):
-    """Replay the permutation on device: x flat (n,) -> x[perm].
+@dataclasses.dataclass(frozen=True)
+class StaticPass:
+    """Hashable half of a DevicePass (everything but the index data)."""
 
-    Jit-safe (static plan, traced data); pass ``idx_dev`` from
-    ``device_indices`` to avoid re-uploading indices per call.
-    """
-    if idx_dev is None:
-        idx_dev = device_indices(plan)
+    kind: str
+    view: tuple[int, ...]
+    perm_axes: tuple[int, ...]
+    kshape: tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class StaticRoute:
+    """Hashable route descriptor — safe as a jit static argument, so the
+    big per-pass index arrays travel as TRACED pytree leaves (engine
+    integration: lux_tpu/ops/expand.py) instead of baked constants."""
+
+    n: int
+    dims: tuple[int, ...]
+    passes: tuple[StaticPass, ...]
+    final_view: tuple[int, ...]
+    final_perm: tuple[int, ...]
+
+
+def freeze_plan(plan: RoutePlan):
+    """Split a RoutePlan into (StaticRoute, tuple-of-index-arrays)."""
+    static = StaticRoute(
+        n=plan.n,
+        dims=tuple(plan.dims),
+        passes=tuple(
+            StaticPass(kind=p.kind, view=tuple(p.view),
+                       perm_axes=tuple(p.perm_axes),
+                       kshape=tuple(p.kshape))
+            for p in plan.passes
+        ),
+        final_view=tuple(plan.final_view),
+        final_perm=tuple(plan.final_perm),
+    )
+    return static, tuple(p.idx for p in plan.passes)
+
+
+def apply_route_frozen(x, static: StaticRoute, idx_dev, rb: int = 1024,
+                       lb: int = 16384, interpret: bool = False):
+    """apply_route on a frozen (StaticRoute, idx arrays) pair.  Traced-
+    data/static-metadata split makes this directly jittable and
+    vmappable (idx arrays stacked with a leading part axis)."""
     y = x
-    for p, idx in zip(plan.passes, idx_dev):
+    for p, idx in zip(static.passes, idx_dev):
         y = y.reshape(p.view)
         if p.perm_axes:
             y = y.transpose(p.perm_axes)
@@ -178,7 +214,21 @@ def apply_route(x, plan: RoutePlan, idx_dev=None, rb: int = 1024,
         else:
             y = sublane_gather(y, idx, lb=lb, interpret=interpret)
         y = y.reshape(-1)
-    y = y.reshape(plan.final_view)
-    if plan.final_perm:
-        y = y.transpose(plan.final_perm)
+    y = y.reshape(static.final_view)
+    if static.final_perm:
+        y = y.transpose(static.final_perm)
     return y.reshape(-1)
+
+
+def apply_route(x, plan: RoutePlan, idx_dev=None, rb: int = 1024,
+                lb: int = 16384, interpret: bool = False):
+    """Replay the permutation on device: x flat (n,) -> x[perm].
+
+    Jit-safe (static plan, traced data); pass ``idx_dev`` from
+    ``device_indices`` to avoid re-uploading indices per call.
+    """
+    if idx_dev is None:
+        idx_dev = device_indices(plan)
+    static, _ = freeze_plan(plan)
+    return apply_route_frozen(x, static, idx_dev, rb=rb, lb=lb,
+                              interpret=interpret)
