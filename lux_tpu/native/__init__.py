@@ -109,6 +109,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i32p, i32p, u8p, f32p,
     ]
     lib.lux_bucket_fill.restype = ctypes.c_int
+    lib.lux_route_color_batched.argtypes = [
+        i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int64, i32p,
+    ]
+    lib.lux_route_color_batched.restype = ctypes.c_int
     return lib
 
 
@@ -350,3 +355,29 @@ def bucket_fill(srcs, row_ptr_slice, weights, cuts, B: int,
         raise ValueError(f"bucket fill failed (rc={rc}): bad cuts/row_ptr "
                          "or bucket overflow")
     return True
+
+
+def route_color(u: np.ndarray, v: np.ndarray, deg: int, nside: int):
+    """Batched Euler-split edge coloring (Benes route construction).
+
+    u, v: (B, n) int64 endpoint arrays of B independent deg-regular
+    bipartite multigraphs (ids in [0, nside)).  Returns (B, n) int32
+    colors — each color class a perfect matching — or None when the
+    native library is unavailable (caller falls back to the Python
+    walk in ops/route.py; colorings may differ, replays agree).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    u = np.ascontiguousarray(u, np.int64)
+    v = np.ascontiguousarray(v, np.int64)
+    assert u.shape == v.shape and u.ndim == 2, (u.shape, v.shape)
+    b, n = u.shape
+    colors = np.empty((b, n), np.int32)
+    rc = lib.lux_route_color_batched(
+        _ptr(u, ctypes.c_int64), _ptr(v, ctypes.c_int64), b, n,
+        deg, nside, _ptr(colors, ctypes.c_int32))
+    if rc != 0:
+        raise ValueError(f"route color failed (rc={rc}): ids out of range "
+                         "or deg not a power of two")
+    return colors

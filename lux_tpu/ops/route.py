@@ -169,45 +169,62 @@ def _color_regular(u: np.ndarray, v: np.ndarray, deg: int, nl: int,
     return colors
 
 
-def _route_rec(perm: np.ndarray, dims: list[int]) -> list[np.ndarray]:
-    """Recursive Clos decomposition.  ``perm`` maps TARGET flat index ->
-    SOURCE flat index over mixed-radix ``dims`` (row-major).  Returns
-    the pass index arrays outermost-first; pass j gathers along digit
-    dims[min(j, 2k-2-j)] (the Benes "V" order), each array flat in the
-    full row-major layout with the gathered digit varying... (see
-    build_route, which reshapes per pass)."""
-    n = len(perm)
+def _color_regular_batched(u: np.ndarray, v: np.ndarray, deg: int,
+                           nside: int) -> np.ndarray:
+    """Color B independent deg-regular bipartite multigraphs
+    (u, v: (B, n)) with deg colors each.  Native single-call path
+    (native/lux_route.cc) when available; Python Euler walk per batch
+    otherwise.  Colorings may differ between the two — both are valid
+    (every color class a perfect matching), and route correctness is
+    pinned on replay equality, not on specific colors."""
+    from lux_tpu import native
+
+    out = native.route_color(u, v, deg, nside)
+    if out is not None:
+        return out
+    return np.stack([
+        _color_regular(u[b], v[b], deg, nside, nside)
+        for b in range(u.shape[0])
+    ])
+
+
+def _route_rec(perms: np.ndarray, dims: list[int]) -> list[np.ndarray]:
+    """Recursive Clos decomposition, batched.  ``perms`` is (B, n): B
+    independent permutations, each mapping TARGET flat index -> SOURCE
+    flat index over mixed-radix ``dims`` (row-major).  Returns the pass
+    index arrays (B, n) outermost-first; pass j gathers along digit
+    dims[min(j, 2k-2-j)] (the Benes "V" order — see build_route, which
+    reshapes per pass).  Batching keeps the coloring at ONE native call
+    per recursion level instead of exploding into per-subproblem Python
+    calls."""
+    b, n = perms.shape
     d = dims[0]
     if len(dims) == 1:
         # single digit: the permutation IS a gather along it
-        return [perm.astype(np.int32)]
+        return [perms.astype(np.int32)]
     m = n // d  # size of the middle (remaining digits) space
     tgt = np.arange(n, dtype=np.int64)
-    src = perm.astype(np.int64)
+    src = perms.astype(np.int64)
     # coordinates: flat = digit * m + mid  (digit is OUTERMOST, row-major)
-    d2, m2 = tgt // m, tgt % m
-    d1, m1 = src // m, src % m
+    m2 = tgt % m  # (n,) shared across batches
+    d1, m1 = src // m, src % m  # (B, n)
     # color the D-regular multigraph m1 -> m2 with D colors
-    colors = _color_regular(m1, m2, d, m, m)
+    colors = _color_regular_batched(
+        m1, np.broadcast_to(m2, (b, n)), d, m).astype(np.int64)
     # stage 1: within each middle-coordinate m1 (a "column"), move along
     # the digit axis: element (d1, m1) -> (c, m1).  idx1[c, m1] = d1.
-    idx1 = np.empty(n, np.int32)
-    idx1[colors.astype(np.int64) * m + m1] = d1.astype(np.int32)
+    idx1 = np.empty((b, n), np.int32)
+    np.put_along_axis(idx1, colors * m + m1, d1.astype(np.int32), axis=1)
     # stage 2 (recurse): within each digit value c, an arbitrary
     # permutation of the middle space: target (c, m2) pulls from (c, m1)
-    mid_perm = np.empty(n, np.int64)
-    mid_perm[colors.astype(np.int64) * m + m2] = m1
-    sub = [
-        _route_rec(mid_perm.reshape(d, m)[c], dims[1:]) for c in range(d)
-    ]
-    # batch the per-c sub-passes into single full-size passes
-    mids = [
-        np.stack([sub[c][j] for c in range(d)]).reshape(-1)
-        for j in range(len(sub[0]))
-    ]
-    # stage 3: within each m2 column, digit c -> d2: idx3[d2, m2] = c
-    idx3 = np.empty(n, np.int32)
-    idx3[d2 * m + m2] = colors
+    mid_perm = np.empty((b, n), np.int64)
+    np.put_along_axis(mid_perm, colors * m + m2, m1, axis=1)
+    sub = _route_rec(mid_perm.reshape(b * d, m), dims[1:])
+    mids = [s.reshape(b, n) for s in sub]
+    # stage 3: within each m2 column, digit c -> d2: idx3[d2, m2] = c,
+    # and since target coordinates enumerate (d2, m2) in flat order this
+    # is the colors array itself
+    idx3 = colors.astype(np.int32)
     return [idx1] + mids + [idx3]
 
 
@@ -224,7 +241,10 @@ def build_route(perm: np.ndarray, dims: list[int] | None = None) -> Route:
     if dims is None:
         dims = factor_digits(n)
     assert int(np.prod(dims)) == n, (dims, n)
-    flat_passes = _route_rec(np.asarray(perm, np.int64), list(dims))
+    flat_passes = [
+        p.reshape(-1)
+        for p in _route_rec(np.asarray(perm, np.int64)[None], list(dims))
+    ]
     k = len(dims)
     assert len(flat_passes) == 2 * k - 1
     shape = tuple(dims)

@@ -66,3 +66,22 @@ def test_route_mixed_small_digit_first_rejected(rng):
     """dims are caller-overridable; a wrong product must fail loudly."""
     with pytest.raises(AssertionError):
         R.build_route(np.arange(256), dims=[128, 4])
+
+
+def test_native_and_python_colorings_both_route(rng, monkeypatch):
+    """The native colorer (native/lux_route.cc) and the Python Euler
+    walk may produce different colorings; both must replay exactly."""
+    from lux_tpu import native
+
+    assert native.get_lib() is not None, \
+        "native lib must be buildable in CI (toolchain baked in)"
+    n = 8192
+    perm = rng.permutation(n)
+    x = rng.random(n).astype(np.float32)
+    rt_native = R.build_route(perm)
+    # force the Python path
+    monkeypatch.setattr(native, "route_color", lambda *a, **k: None)
+    rt_py = R.build_route(perm)
+    for rt in (rt_native, rt_py):
+        _check_passes_are_digit_perms(rt)
+        np.testing.assert_array_equal(R.apply_route_np(rt, x), x[perm])
