@@ -141,9 +141,33 @@ def worker_main():
     # LUX_BENCH_COMPACT_GATHER=1: A/B the unique-in-source mirror layout
     # (reference load_kernel staging); metrics gain a _compact suffix
     compact = os.environ.get("LUX_BENCH_COMPACT_GATHER") == "1"
+    # LUX_BENCH_ROUTE_GATHER=1: A/B the routed-shuffle expand (the LOAD
+    # phase as Benes lane shuffles, ops/expand.py); metrics gain a
+    # _route suffix.  Mutually exclusive with the mirror layout (the
+    # routed path never reads the mirror).
+    route_gather = os.environ.get("LUX_BENCH_ROUTE_GATHER") == "1"
+    if route_gather and compact:
+        raise SystemExit("LUX_BENCH_ROUTE_GATHER and "
+                         "LUX_BENCH_COMPACT_GATHER are mutually exclusive")
     shards = build_pull_shards(g, 1, sort_segments=sort_seg,
                                compact_gather=compact)
     compact_unique = _total_unique(shards) if compact else 0
+    route_plan = None
+    if route_gather:
+        from lux_tpu.ops import expand
+
+        t_plan = time.time()
+        route_plan = expand.plan_expand_shards_cached(shards)
+        # device-resident once, like the graph arrays below — NOT per
+        # run(n) call (the stacked pass arrays are ~1 GB at scale 20;
+        # re-transfer would burn the TPU budget inside the timed loop)
+        route_plan = (route_plan[0],
+                      jax.tree.map(jnp.asarray, route_plan[1]))
+        jax.block_until_ready(route_plan[1])
+        print(f"# worker: routed-expand plan ready in "
+              f"{time.time() - t_plan:.1f}s (n={route_plan[0].n}, "
+              f"{len(route_plan[1])} pass arrays, on device)",
+              file=sys.stderr, flush=True)
     print(f"# worker: graph ready nv={g.nv} ne={g.ne}", file=sys.stderr, flush=True)
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     jax.block_until_ready(arrays)
@@ -191,7 +215,8 @@ def worker_main():
         s0 = pull.init_state(prog, arrays)
 
         def run(n):
-            return pull.run_pull_fixed(prog, shards.spec, arrays, s0, n, method)
+            return pull.run_pull_fixed(prog, shards.spec, arrays, s0, n,
+                                       method, route=route_plan)
 
         return fetch_timed(run)
 
@@ -210,6 +235,10 @@ def worker_main():
             if on_tpu
             else ["scan", "scatter"]
         )
+        if route_gather and "pallas" in methods:
+            # the pallas runner never sees route_plan — timing it here
+            # would bank an unrouted number under the _route suffix
+            methods.remove("pallas")
         risky_tail = ["scan"] if on_tpu else []
     else:
         methods = [method_env]
@@ -229,6 +258,8 @@ def worker_main():
             suffix = "_sortseg" + suffix
         if compact:
             suffix = "_compact" + suffix
+        if route_gather:
+            suffix = "_route" + suffix
         print(
             f"# method {m} ({dt}): {elapsed:.4f}s -> {gteps:.4f} GTEPS",
             file=sys.stderr,
@@ -530,7 +561,10 @@ def worker_main():
         # budget is spent, and BEFORE the risky tail (a scan wedge must
         # not cost it)
         tpu_budget = int(os.environ.get("LUX_BENCH_TPU_S", "600"))
-        if time.monotonic() - t_worker0 < 0.5 * tpu_budget:
+        if route_gather:
+            print("# scale-up skipped: routed-expand A/B plans exist only "
+                  "for the headline graph", file=sys.stderr, flush=True)
+        elif time.monotonic() - t_worker0 < 0.5 * tpu_budget:
             try:
                 from lux_tpu.engine.methods import CONCRETE
 
@@ -567,7 +601,8 @@ def _record_winner(results):
     without a code edit.  Only the sum row: the race is PageRank; min/max
     rows change via the chip battery + PERF.md."""
     if (os.environ.get("LUX_BENCH_SORT_SEGMENTS") == "1"
-            or os.environ.get("LUX_BENCH_COMPACT_GATHER") == "1"):
+            or os.environ.get("LUX_BENCH_COMPACT_GATHER") == "1"
+            or os.environ.get("LUX_BENCH_ROUTE_GATHER") == "1"):
         # an A/B run under a non-default layout must not mutate the
         # default-layout winner (it would silently change every later
         # allgather run); the human folds A/B results in via PERF.md

@@ -74,6 +74,35 @@ def worker_main(args) -> int:
 
         def f(x):
             return x[src_pos].reshape(g.nv, args.ef).sum(axis=1) * 1e-3
+    elif args.method == "route":
+        # the routed-shuffle expand (ops/expand.py) standing in for the
+        # flat gather: directly comparable to the "gather" row (same
+        # reshape-sum tail).  Exactness is checked against the direct
+        # gather before timing.
+        from lux_tpu.ops import expand
+
+        src_pos = np.asarray(g.col_idx).astype(np.int32)
+        t_plan = time.perf_counter()
+        static, arrays_np = expand.plan_expand(src_pos, len(src_pos), g.nv)
+        print(f"# route plan built in {time.perf_counter() - t_plan:.1f}s "
+              f"(n={static.n}, {len(arrays_np)} pass arrays)", flush=True)
+        route_arrays = tuple(jnp.asarray(a) for a in arrays_np)
+        interp = jax.default_backend() not in ("tpu", "axon")
+        jax.block_until_ready((state,) + route_arrays)
+
+        def f(x):
+            vals = expand.apply_expand(x, static, route_arrays,
+                                       interpret=interp)
+            return vals[: g.ne].reshape(g.nv, args.ef).sum(axis=1) * 1e-3
+
+        got = np.asarray(
+            jax.jit(lambda x: expand.apply_expand(
+                x, static, route_arrays, interpret=interp))(state))[: g.ne]
+        want = np.asarray(state)[src_pos]
+        exact = bool((got == want).all())
+        print(f"# route exactness vs direct gather: {exact}", flush=True)
+        if not exact:
+            return 3
     elif args.method == "gatherc":
         col = np.asarray(g.col_idx).astype(np.int32)
         uniq = np.unique(col)
@@ -127,7 +156,7 @@ def worker_main(args) -> int:
         xs.append(n)
     slope, icpt = _fit(xs, ts)
     gteps = g.ne / slope / 1e9 if slope > 0 else float("nan")
-    kind = ("gather" if args.method in ("gather", "gatherc")
+    kind = ("gather" if args.method in ("gather", "gatherc", "route")
             else "segment_sum")
     print(json.dumps({
         "micro": kind, "method": args.method,
@@ -217,7 +246,7 @@ def main(argv=None):
     # hot-loop half; they inform the layout choice, not the method)
     timed = {m: r["ms_per_rep"] for m, r in rows.items()
              if r.get("ms_per_rep", 0) > 0
-             and m not in ("gather", "gatherc")}
+             and m not in ("gather", "gatherc", "route")}
     winner = min(timed, key=timed.get) if timed else None
     platforms = {r.get("platform") for r in rows.values()}
     record = {
