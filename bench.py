@@ -146,18 +146,25 @@ def worker_main():
     # _route suffix.  Mutually exclusive with the mirror layout (the
     # routed path never reads the mirror).
     route_gather = os.environ.get("LUX_BENCH_ROUTE_GATHER") == "1"
-    if route_gather and compact:
-        raise SystemExit("LUX_BENCH_ROUTE_GATHER and "
-                         "LUX_BENCH_COMPACT_GATHER are mutually exclusive")
+    # LUX_BENCH_ROUTE_FUSED=1: the FULL fused routed pipeline (load AND
+    # reduce as routed movement, ops/expand.apply_fused); _routefused
+    # suffix.  The reduce-method race is meaningless here (the fused
+    # path replaces the reducer), so exactly one line is measured.
+    route_fused = os.environ.get("LUX_BENCH_ROUTE_FUSED") == "1"
+    if sum([route_gather, route_fused, compact]) > 1:
+        raise SystemExit("LUX_BENCH_ROUTE_GATHER / LUX_BENCH_ROUTE_FUSED "
+                         "/ LUX_BENCH_COMPACT_GATHER are mutually exclusive")
     shards = build_pull_shards(g, 1, sort_segments=sort_seg,
                                compact_gather=compact)
     compact_unique = _total_unique(shards) if compact else 0
     route_plan = None
-    if route_gather:
+    if route_gather or route_fused:
         from lux_tpu.ops import expand
 
         t_plan = time.time()
-        route_plan = expand.plan_expand_shards_cached(shards)
+        route_plan = (expand.plan_fused_shards_cached(shards, "sum")
+                      if route_fused
+                      else expand.plan_expand_shards_cached(shards))
         # device-resident once, like the graph arrays below — NOT per
         # run(n) call (the stacked pass arrays are ~1 GB at scale 20;
         # re-transfer would burn the TPU budget inside the timed loop)
@@ -214,9 +221,11 @@ def worker_main():
         prog = PageRankProgram(nv=shards.spec.nv, dtype=dt)
         s0 = pull.init_state(prog, arrays)
 
+        run_method = "scan" if method == "fused" else method
+
         def run(n):
             return pull.run_pull_fixed(prog, shards.spec, arrays, s0, n,
-                                       method, route=route_plan)
+                                       run_method, route=route_plan)
 
         return fetch_timed(run)
 
@@ -239,9 +248,12 @@ def worker_main():
             # the pallas runner never sees route_plan — timing it here
             # would bank an unrouted number under the _route suffix
             methods.remove("pallas")
+        if route_fused:
+            # one line: the fused pipeline IS the method
+            methods = ["fused"]
         risky_tail = ["scan"] if on_tpu else []
     else:
-        methods = [method_env]
+        methods = ["fused"] if route_fused else [method_env]
         risky_tail = []
     results = {}
 
@@ -260,15 +272,24 @@ def worker_main():
             suffix = "_compact" + suffix
         if route_gather:
             suffix = "_route" + suffix
+        if route_fused:
+            suffix = "_routefused" + suffix
         print(
             f"# method {m} ({dt}): {elapsed:.4f}s -> {gteps:.4f} GTEPS",
             file=sys.stderr,
             flush=True,
         )
-        model = roofline.pull_iter_model(
-            g.ne, g.nv, m, state_bytes=2 if dt == "bfloat16" else 4,
-            compact_unique=compact_unique,
-        ).scale(iters)
+        if route_plan is not None:
+            model = roofline.routed_pull_iter_model(
+                route_plan[0], g.ne, g.nv,
+                state_bytes=2 if dt == "bfloat16" else 4,
+                method="scan" if m == "fused" else m,
+            ).scale(iters)
+        else:
+            model = roofline.pull_iter_model(
+                g.ne, g.nv, m, state_bytes=2 if dt == "bfloat16" else 4,
+                compact_unique=compact_unique,
+            ).scale(iters)
         _emit(
             {
                 "metric": f"pagerank_gteps_rmat{scale}_1chip{suffix}",
@@ -561,7 +582,7 @@ def worker_main():
         # budget is spent, and BEFORE the risky tail (a scan wedge must
         # not cost it)
         tpu_budget = int(os.environ.get("LUX_BENCH_TPU_S", "600"))
-        if route_gather:
+        if route_gather or route_fused:
             print("# scale-up skipped: routed-expand A/B plans exist only "
                   "for the headline graph", file=sys.stderr, flush=True)
         elif time.monotonic() - t_worker0 < 0.5 * tpu_budget:
@@ -602,7 +623,8 @@ def _record_winner(results):
     rows change via the chip battery + PERF.md."""
     if (os.environ.get("LUX_BENCH_SORT_SEGMENTS") == "1"
             or os.environ.get("LUX_BENCH_COMPACT_GATHER") == "1"
-            or os.environ.get("LUX_BENCH_ROUTE_GATHER") == "1"):
+            or os.environ.get("LUX_BENCH_ROUTE_GATHER") == "1"
+            or os.environ.get("LUX_BENCH_ROUTE_FUSED") == "1"):
         # an A/B run under a non-default layout must not mutate the
         # default-layout winner (it would silently change every later
         # allgather run); the human folds A/B results in via PERF.md

@@ -136,7 +136,20 @@ def local_pull_step(
     """One pull iteration for ONE part.  ``full_state`` is the (P*V, ...)
     concatenated padded state of all parts; ``local_state`` is (V, ...).
     ``route`` = (ExpandStatic, per-part arrays) switches the LOAD phase
-    to the routed-shuffle expand."""
+    to the routed-shuffle expand; (FusedStatic, arrays) replaces BOTH
+    the load and the segmented reduce with the fused routed pipeline
+    (ops/expand.apply_fused — dst-state-independent programs only)."""
+    from lux_tpu.ops import expand
+
+    if route is not None and isinstance(route[0], expand.FusedStatic):
+        assert route[0].reduce == prog.reduce, (
+            f"fused plan was built for reduce={route[0].reduce!r} but the "
+            f"program reduces with {prog.reduce!r}")
+        acc = expand.apply_fused(
+            full_state, route[0], route[1],
+            edge_value=lambda s, w: prog.edge_value(s, w, None),
+            weighted=True, interpret=interpret)
+        return prog.apply(local_state, acc, arrays)
     if route is not None:
         gath = pull_gather_part_routed(arrays, full_state, local_state,
                                        route[0], route[1], interpret)

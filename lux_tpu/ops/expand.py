@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -162,18 +163,11 @@ class ExpandStatic:
     r2: shuf.StaticRoute
 
 
-def plan_expand(src_pos: np.ndarray, m: int, state_size: int):
-    """Plan the routed expand for ONE part.
-
-    src_pos: (e_pad,) int32 CSC-edge-order gather indices (real edges in
-    slots [0, m), padding after — graph/shards.fill_part layout).
-    state_size: size of the gathered state the engine reads (P*V).
-
-    Returns (ExpandStatic, tuple of np arrays) — the arrays are the
-    pytree half (r1 passes, ff levels, r2 passes, concatenated in that
-    order; ExpandStatic knows the split points implicitly via its
-    sub-plans).
-    """
+def _plan_expand_half(src_pos: np.ndarray, m: int, state_size: int):
+    """Shared expand-half construction (state -> filled CSR-run slots):
+    perm1 route + fill-forward plan.  Returns
+    (n, csr, r1_route, ff_static, ff_arrays) — used by both plan_expand
+    and plan_fused so the two can never diverge."""
     e_pad = len(src_pos)
     n = max(_next_pow2(e_pad), _next_pow2(state_size), LANE)
     sp = np.asarray(src_pos[:m], np.int64)
@@ -203,6 +197,24 @@ def plan_expand(src_pos: np.ndarray, m: int, state_size: int):
     if m:
         h[:m] = head_slots[np.cumsum(head) - 1]
     ff_static, ff_arrays = plan_ff(h)
+    return n, csr, r1, ff_static, ff_arrays
+
+
+def plan_expand(src_pos: np.ndarray, m: int, state_size: int):
+    """Plan the routed expand for ONE part.
+
+    src_pos: (e_pad,) int32 CSC-edge-order gather indices (real edges in
+    slots [0, m), padding after — graph/shards.fill_part layout).
+    state_size: size of the gathered state the engine reads (P*V).
+
+    Returns (ExpandStatic, tuple of np arrays) — the arrays are the
+    pytree half (r1 passes, ff levels, r2 passes, concatenated in that
+    order; ExpandStatic knows the split points implicitly via its
+    sub-plans).
+    """
+    e_pad = len(src_pos)
+    n, csr, r1, ff_static, ff_arrays = _plan_expand_half(
+        src_pos, m, state_size)
 
     # perm2: CSR slot j carries CSC edge csr[j] -> out[csr[j]] = y[j]
     perm2 = np.empty(n, np.int64)
@@ -248,6 +260,239 @@ def apply_expand(full_state, static: ExpandStatic, arrays,
 def apply_expand_np(src_pos, full_state):
     """NumPy oracle of the whole expand (the direct gather)."""
     return np.asarray(full_state)[np.asarray(src_pos, np.int64)]
+
+
+# ---------------------------------------------------------------------------
+# fused expand + reduce (v2): the WHOLE hot loop as routed movement
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedStatic:
+    """Hashable descriptor of a fused routed pull iteration: expand
+    (r1 + ff as in ExpandStatic) -> permute into a per-destination
+    pow2-padded GROUP layout (r2) -> masked elementwise edge_value ->
+    per-group reshape-reduce -> small V-space route into accumulator
+    order.  Replaces gather + segmented reduce with ~16 HBM-bandwidth
+    passes; float sums use the group-layout association (a deterministic
+    method-specific order, like mxsum's matmul association)."""
+
+    n: int              # expand space (state/CSR slots)
+    n2: int             # group space (>= padded group layout size)
+    state_size: int
+    v_pad: int          # accumulator slots (local part state size)
+    nv_route: int       # pow2 routing space for the accumulator
+    reduce: str         # "sum" | "min" | "max"
+    groups: tuple[tuple[int, int, int], ...]  # (offset, count, 2**k)
+    r1: shuf.StaticRoute
+    ff: FFStatic
+    r2: shuf.StaticRoute
+    vr: shuf.StaticRoute
+
+
+def _neutral_like(reduce: str, dtype):
+    """Empty-slot identity, matching ops/segment.py's empty-row
+    convention (dtype max/min for integer min/max)."""
+    if reduce == "sum":
+        return jnp.asarray(0, dtype)
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.max if reduce == "min" else info.min, dtype)
+    return jnp.asarray(jnp.inf if reduce == "min" else -jnp.inf, dtype)
+
+
+def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
+               state_size: int, v_pad: int, reduce: str = "sum",
+               weights: np.ndarray | None = None):
+    """Plan the fused routed pull for ONE part.
+
+    src_pos / dst_local: (e_pad,) CSC-order arrays (fill_part layout:
+    real edges in [0, m), dst_local sorted ascending).  v_pad: the
+    part's padded vertex count (accumulator size).  weights: optional
+    per-edge float32 (routed into group layout HERE, at plan time).
+
+    Returns (FusedStatic, arrays): arrays = r1 passes + ff levels + r2
+    passes + (group_mask float/bool, group_weights or (), vr passes).
+    """
+    n, csr, r1, ff_static, ff_arrays = _plan_expand_half(
+        src_pos, m, state_size)
+
+    # --- group layout: per-destination pow2-padded blocks ---
+    dl = np.asarray(dst_local[:m], np.int64)
+    dsts, counts = np.unique(dl, return_counts=True)  # ascending = CSC order
+    p_sizes = np.maximum(1, 2 ** np.ceil(np.log2(np.maximum(counts, 1)))
+                         ).astype(np.int64)
+    ks = np.log2(p_sizes).astype(np.int64)
+    order = np.argsort(ks, kind="stable")  # group by k, stable by dst
+    groups: list[tuple[int, int, int]] = []
+    seg_base = np.empty(len(dsts), np.int64)  # group-layout start per dst
+    off = 0
+    for k in np.unique(ks):
+        sel = order[ks[order] == k]
+        width = 1 << int(k)
+        groups.append((off, len(sel), width))
+        seg_base[sel] = off + np.arange(len(sel), dtype=np.int64) * width
+        off += len(sel) * width
+    n2 = max(_next_pow2(off), n, LANE)
+
+    # perm2: CSR slot j (edge csr[j], dst dl[csr[j]]) -> its slot in the
+    # group layout (seg base + rank within segment)
+    seg_of_edge = np.searchsorted(dsts, dl)         # (m,) CSC order
+    seg_starts = np.zeros(len(dsts) + 1, np.int64)
+    np.cumsum(counts, out=seg_starts[1:])
+    rank_csc = np.arange(m, dtype=np.int64) - seg_starts[seg_of_edge]
+    gslot_csc = seg_base[seg_of_edge] + rank_csc    # (m,) group slot per edge
+    # out[group slot of edge e] = y_csr[csr slot of e]
+    csr_slot_of_edge = np.empty(m, np.int64)
+    csr_slot_of_edge[csr] = np.arange(m, dtype=np.int64)
+    perm2 = np.empty(n2, np.int64)
+    used_tgt2 = np.zeros(n2, bool)
+    used_src2 = np.zeros(n2, bool)
+    perm2[gslot_csc] = csr_slot_of_edge
+    used_tgt2[gslot_csc] = True
+    used_src2[csr_slot_of_edge] = True
+    perm2[~used_tgt2] = np.flatnonzero(~used_src2)
+    r2 = route_mod.build_route(perm2)
+
+    # static group-space mask + pre-routed weights
+    gmask = np.zeros(n2, bool)
+    gmask[gslot_csc] = True
+    if weights is not None:
+        gweights = np.zeros(n2, np.float32)
+        gweights[gslot_csc] = np.asarray(weights[:m], np.float32)
+
+    # accumulator route: totals (group order: one per dst, concat by k)
+    # -> dst_local slots of a (nv_route,) vector; uncovered slots pull
+    # from the zero tail
+    num_seg = len(dsts)
+    nv_route = max(_next_pow2(v_pad), LANE)
+    assert num_seg <= v_pad <= nv_route
+    total_rank = np.empty(num_seg, np.int64)
+    total_rank[order] = np.arange(num_seg, dtype=np.int64)  # dst -> rank
+    permv = np.empty(nv_route, np.int64)
+    used_tgtv = np.zeros(nv_route, bool)
+    used_srcv = np.zeros(nv_route, bool)
+    permv[dsts] = total_rank
+    used_tgtv[dsts] = True
+    used_srcv[total_rank] = True
+    # every other accumulator slot reads an unused source slot; source
+    # slots >= num_seg are filled with the reduce neutral on device
+    permv[~used_tgtv] = np.flatnonzero(~used_srcv)
+    vr = route_mod.build_route(permv)
+
+    r1s, r1a = shuf.freeze_plan(shuf.plan_route(r1))
+    r2s, r2a = shuf.freeze_plan(shuf.plan_route(r2))
+    vrs, vra = shuf.freeze_plan(shuf.plan_route(vr))
+    static = FusedStatic(
+        n=n, n2=n2, state_size=state_size, v_pad=v_pad,
+        nv_route=nv_route, reduce=reduce, groups=tuple(groups),
+        r1=r1s, ff=ff_static, r2=r2s, vr=vrs,
+    )
+    warr = (gweights,) if weights is not None else ()
+    arrays = (tuple(r1a) + tuple(ff_arrays) + tuple(r2a)
+              + (gmask,) + warr + tuple(vra))
+    return static, arrays
+
+
+def split_fused_arrays(static: FusedStatic, arrays, weighted: bool):
+    n1 = len(static.r1.passes)
+    nff = sum(1 if lv.base else 2 for lv in static.ff.levels)
+    n2p = len(static.r2.passes)
+    r1a = arrays[:n1]
+    ffa = arrays[n1:n1 + nff]
+    r2a = arrays[n1 + nff:n1 + nff + n2p]
+    rest = arrays[n1 + nff + n2p:]
+    gmask = rest[0]
+    gweights = rest[1] if weighted else None
+    vra = rest[1 + int(weighted):]
+    assert len(vra) == len(static.vr.passes)
+    return r1a, ffa, r2a, gmask, gweights, vra
+
+
+def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
+                weighted: bool = False, interpret: bool = False):
+    """Device replay of the fused routed pull for one part: full_state
+    (state_size,) -> accumulator (v_pad,).
+
+    edge_value(src_vals, weights) is applied elementwise in GROUP layout
+    (dst-state-dependent programs are unsupported here — use the expand
+    path).  Sum association follows the group layout — a deterministic,
+    method-specific order, like mxsum's."""
+    if full_state.ndim != 1:
+        raise ValueError("fused routed pull supports 1-D state only")
+    r1a, ffa, r2a, gmask, gweights, vra = split_fused_arrays(
+        static, arrays, weighted)
+    x = jnp.pad(full_state, (0, static.n - static.state_size))
+    y = shuf.apply_route_frozen(x, static.r1, r1a, interpret=interpret)
+    y = apply_ff(y, static.ff, ffa, interpret=interpret)
+    y = jnp.pad(y, (0, static.n2 - static.n))
+    y = shuf.apply_route_frozen(y, static.r2, r2a, interpret=interpret)
+    if edge_value is not None:
+        y = edge_value(y, gweights) if weighted else edge_value(y, None)
+    neutral = _neutral_like(static.reduce, y.dtype)
+    y = jnp.where(gmask, y, neutral)
+    red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[static.reduce]
+    totals = []
+    for off, count, width in static.groups:
+        blk = jax.lax.dynamic_slice(y, (off,), (count * width,))
+        totals.append(red(blk.reshape(count, width), axis=1))
+    t = jnp.concatenate(totals) if totals else jnp.zeros(0, y.dtype)
+    t = jnp.concatenate([
+        t, jnp.full((static.nv_route - t.shape[0],), neutral, t.dtype)])
+    acc = shuf.apply_route_frozen(t, static.vr, vra, interpret=interpret)
+    return acc[: static.v_pad]
+
+
+def plan_fused_shards(shards, reduce: str = "sum"):
+    """plan_fused for a PullShards bundle.  Single-part only for now:
+    the fused group layout (offsets/counts/widths) is degree-
+    distribution-dependent, so parts generally do NOT share a static —
+    the vmapped engine cannot batch them.  P=1 covers the single-chip
+    benchmark path; multi-part needs shape-uniform groups (follow-up).
+    """
+    arrays = shards.arrays
+    p = arrays.src_pos.shape[0]
+    if p != 1:
+        raise NotImplementedError(
+            "fused routed pull supports a single part per device for "
+            "now (per-part group layouts differ); use the expand route "
+            "or the direct gather for P > 1")
+    v_pad = arrays.row_ptr.shape[1] - 1
+    m = int(np.count_nonzero(arrays.edge_mask[0]))
+    static, a = plan_fused(
+        np.asarray(arrays.src_pos[0]), np.asarray(arrays.dst_local[0]),
+        m, shards.spec.gathered_size, v_pad, reduce,
+        weights=np.asarray(arrays.weights[0]))
+    stacked = tuple(x[None] for x in a)
+    return static, stacked
+
+
+def plan_fused_shards_cached(shards, reduce: str = "sum",
+                             cache_dir: str = "/tmp/lux_expand_plans"):
+    """plan_fused_shards with the same disk cache as the expand plans
+    (key extended with dst_local/weights bytes and the reduce op)."""
+    import hashlib
+    import os
+    import pickle
+
+    h = hashlib.sha1()
+    h.update(f"fused{PLAN_FORMAT}:{reduce}".encode())
+    h.update(np.ascontiguousarray(shards.arrays.src_pos).tobytes())
+    h.update(np.ascontiguousarray(shards.arrays.dst_local).tobytes())
+    h.update(np.ascontiguousarray(shards.arrays.weights).tobytes())
+    h.update(np.ascontiguousarray(shards.arrays.edge_mask).tobytes())
+    h.update(str(shards.spec.gathered_size).encode())
+    path = os.path.join(cache_dir, f"fused_{h.hexdigest()[:16]}.pkl")
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    plan = plan_fused_shards(shards, reduce)
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(plan, f)
+    os.replace(tmp, path)
+    return plan
 
 
 def plan_expand_shards_cached(shards, cache_dir: str = "/tmp/lux_expand_plans"):

@@ -152,6 +152,42 @@ def pull_iter_model(
     return TrafficModel(bytes_moved, flops, dev)
 
 
+def routed_pull_iter_model(static, ne: int, nv: int,
+                            state_bytes: int = 4,
+                            method: str = "scan") -> TrafficModel:
+    """One ROUTED pull iteration (ops/expand.py) from its plan static.
+
+    Every routed pass streams the value array (read+write) plus its
+    int32 index array over the pass's space; fill-forward is a
+    geometric ~1.01 lane passes; the fused variant adds the group-
+    layout edge_value/mask pass, the reduce pass, and the small
+    accumulator route.  Useful FLOPs are the per-edge combines + apply,
+    as in pull_iter_model — routing moves bits, it does not compute."""
+    v = state_bytes
+
+    def route_bytes(r, space):
+        return len(r.passes) * space * (2 * v + 4)
+
+    b = route_bytes(static.r1, static.n)
+    ff_elems = sum(lv.rows * 128 for lv in static.ff.levels)
+    b += ff_elems * (2 * v + 4 + 1)  # lane gather + idx + ext-mask byte
+    if hasattr(static, "n2"):  # FusedStatic: fused reduce half
+        b += route_bytes(static.r2, static.n2)
+        # edge_value + mask + group reshape-reduce: one streaming pass
+        # over the group space (weights f32 + mask byte reads)
+        b += static.n2 * (2 * v + 4 + 1)
+        b += route_bytes(static.vr, static.nv_route)
+        dev_reduce = ne  # element-wise group adds
+    else:  # ExpandStatic: values land in CSC order, the chosen
+        # segmented reducer still runs — charge its method terms
+        b += route_bytes(static.r2, static.n)
+        b += ne * int(_reduce_bytes_per_edge(method, state_bytes, 1))
+        dev_reduce = ne * _reduce_device_flops_per_edge(method, 1)
+    b += nv * (2 * v + 4)  # apply: old + new state + degree
+    flops = ne + nv * 3
+    return TrafficModel(b, flops, dev_reduce + nv * 3)
+
+
 def edge2d_iter_model(
     ne: int,
     nv: int,

@@ -103,6 +103,39 @@ def worker_main(args) -> int:
         print(f"# route exactness vs direct gather: {exact}", flush=True)
         if not exact:
             return 3
+    elif args.method == "fused":
+        # the COMPLETE fused routed hot loop (expand + reduce as routed
+        # movement) — the number to weigh against gather + a segment-sum
+        # row combined.  Exact for this check's sum only up to group
+        # association; verified against the NumPy oracle with rtol.
+        from lux_tpu.ops import expand
+
+        src_pos = np.asarray(g.col_idx).astype(np.int32)
+        dst_local = g.dst_of_edges().astype(np.int32)
+        t_plan = time.perf_counter()
+        static, arrays_np = expand.plan_fused(
+            src_pos, dst_local, g.ne, g.nv, g.nv, "sum")
+        print(f"# fused plan built in {time.perf_counter() - t_plan:.1f}s "
+              f"(n={static.n}, n2={static.n2}, "
+              f"{len(static.groups)} groups)", flush=True)
+        route_arrays = tuple(jnp.asarray(a) for a in arrays_np)
+        interp = jax.default_backend() not in ("tpu", "axon")
+        jax.block_until_ready((state,) + route_arrays)
+
+        def f(x):
+            acc = expand.apply_fused(x, static, route_arrays,
+                                     interpret=interp)
+            return acc * 1e-3
+
+        got = np.asarray(
+            jax.jit(lambda x: expand.apply_fused(
+                x, static, route_arrays, interpret=interp))(state))
+        want = np.zeros(g.nv, np.float32)
+        np.add.at(want, dst_local, np.asarray(state)[src_pos])
+        ok = bool(np.allclose(got, want, rtol=1e-4, atol=1e-6))
+        print(f"# fused numerics vs oracle: {ok}", flush=True)
+        if not ok:
+            return 3
     elif args.method == "gatherc":
         col = np.asarray(g.col_idx).astype(np.int32)
         uniq = np.unique(col)
@@ -157,7 +190,7 @@ def worker_main(args) -> int:
     slope, icpt = _fit(xs, ts)
     gteps = g.ne / slope / 1e9 if slope > 0 else float("nan")
     kind = ("gather" if args.method in ("gather", "gatherc", "route")
-            else "segment_sum")
+            else "fused" if args.method == "fused" else "segment_sum")
     print(json.dumps({
         "micro": kind, "method": args.method,
         "platform": platform, "scale": args.scale, "ne": int(g.ne),
@@ -246,7 +279,7 @@ def main(argv=None):
     # hot-loop half; they inform the layout choice, not the method)
     timed = {m: r["ms_per_rep"] for m, r in rows.items()
              if r.get("ms_per_rep", 0) > 0
-             and m not in ("gather", "gatherc", "route")}
+             and m not in ("gather", "gatherc", "route", "fused")}
     winner = min(timed, key=timed.get) if timed else None
     platforms = {r.get("platform") for r in rows.values()}
     record = {
