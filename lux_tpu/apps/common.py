@@ -116,6 +116,34 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             "layouts ship their own slices and pallas has its own "
             "block-CSR gather"
         )
+    if getattr(cfg, "route_gather", ""):
+        if getattr(prog, "k", 1) > 1:
+            raise SystemExit(
+                "--route-gather supports scalar vertex state only; "
+                "colfilter's (V, K) latent state (and its dst-state "
+                "error term) uses the direct gather"
+            )
+        if (cfg.distributed or cfg.exchange != "allgather"
+                or cfg.edge_shards > 1 or cfg.feat_shards > 1
+                or cfg.method == "pallas" or cfg.compact_gather
+                or cfg.stream_hbm_gib):
+            raise SystemExit(
+                "--route-gather binds to the single-device allgather "
+                "pull layout (plans are built from its src_pos); it "
+                "cannot combine with --distributed/--edge-shards/"
+                "--feat-shards/--method pallas/--compact-gather/"
+                "--stream-hbm-gib"
+            )
+        if cfg.route_gather == "fused" and cfg.num_parts != 1:
+            raise SystemExit(
+                "--route-gather fused supports -ng 1 (per-part group "
+                "layouts differ); use --route-gather expand for -ng > 1"
+            )
+        if cfg.verbose or cfg.ckpt_every:
+            raise SystemExit(
+                "--route-gather runs the fused on-device loop; "
+                "-verbose / checkpoint stepping are not wired yet"
+            )
     if cfg.feat_shards > 1:
         if getattr(prog, "k", 1) <= 1:
             raise SystemExit(

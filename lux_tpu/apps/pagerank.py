@@ -142,9 +142,18 @@ def main(argv=None):
                 cfg, g.nv, on_iter,
             )
         elif mesh is None:
+            route = None
+            if cfg.route_gather:
+                from lux_tpu.ops import expand
+
+                route = (
+                    expand.plan_fused_shards_cached(shards, prog.reduce)
+                    if cfg.route_gather == "fused"
+                    else expand.plan_expand_shards_cached(shards)
+                )
             state = pull.run_pull_fixed(
                 prog, shards.spec, arrays, state, cfg.num_iters - start_it,
-                cfg.method,
+                cfg.method, route=route,
             )
         elif cfg.verbose and cfg.exchange == "allgather" and cfg.edge_shards == 1:
             # step-wise DISTRIBUTED observability with the 3-phase

@@ -497,8 +497,18 @@ def plan_fused_shards(shards, reduce: str = "sum"):
     return static, stacked
 
 
+def _default_cache_dir() -> str:
+    """Per-user plan cache (a shared world-writable dir would unpickle
+    other users' files and collide on permissions)."""
+    import os
+    import tempfile
+
+    uid = os.getuid() if hasattr(os, "getuid") else "na"
+    return os.path.join(tempfile.gettempdir(), f"lux_expand_plans_{uid}")
+
+
 def plan_fused_shards_cached(shards, reduce: str = "sum",
-                             cache_dir: str = "/tmp/lux_expand_plans"):
+                             cache_dir: str | None = None):
     """plan_fused_shards with the same disk cache as the expand plans
     (key extended with dst_local/weights bytes and the reduce op)."""
     import hashlib
@@ -506,6 +516,7 @@ def plan_fused_shards_cached(shards, reduce: str = "sum",
     import pickle
 
     h = hashlib.sha1()
+    cache_dir = cache_dir or _default_cache_dir()
     h.update(f"fused{PLAN_FORMAT}:{reduce}:idx8={_idx8_enabled()}".encode())
     h.update(np.ascontiguousarray(shards.arrays.src_pos).tobytes())
     h.update(np.ascontiguousarray(shards.arrays.dst_local).tobytes())
@@ -525,7 +536,7 @@ def plan_fused_shards_cached(shards, reduce: str = "sum",
     return plan
 
 
-def plan_expand_shards_cached(shards, cache_dir: str = "/tmp/lux_expand_plans"):
+def plan_expand_shards_cached(shards, cache_dir: str | None = None):
     """plan_expand_shards with a disk cache keyed on the exact gather
     layout (src_pos + edge_mask bytes + gathered size).  Route
     construction is ~90 s per part at 2^24 even with the native colorer
@@ -535,6 +546,7 @@ def plan_expand_shards_cached(shards, cache_dir: str = "/tmp/lux_expand_plans"):
     import os
     import pickle
 
+    cache_dir = cache_dir or _default_cache_dir()
     h = hashlib.sha1()
     h.update(f"fmt{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
     h.update(np.ascontiguousarray(shards.arrays.src_pos).tobytes())

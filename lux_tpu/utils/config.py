@@ -57,6 +57,10 @@ class RunConfig:
     #: compact-gather layout: per-part unique-in-source mirror, the
     #: reference's load_kernel FB staging (graph/shards.build_compact_mirror)
     compact_gather: bool = False
+    #: routed gather: "expand" replaces the pull LOAD phase with Benes
+    #: lane shuffles (bitwise-identical); "fused" also replaces the
+    #: segmented reduce (group-layout sum association).  ops/expand.py.
+    route_gather: str = ""
     #: >0 = adaptive dynamic repartitioning (push apps): every N iterations
     #: rebalance the vertex cuts from the measured per-part load (the Lux
     #: paper's runtime repartitioning, absent from the reference code)
@@ -128,6 +132,14 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "unique-in-source mirror (working set "
                              "O(unique srcs) instead of O(nv); bitwise-"
                              "identical results)")
+        ap.add_argument("--route-gather", nargs="?", const="expand",
+                        default="", choices=["expand", "fused"],
+                        help="Benes-routed pull hot loop (ops/expand.py): "
+                             "'expand' replaces the per-edge state gather "
+                             "with lane shuffles (bitwise-identical); "
+                             "'fused' also replaces the segmented reduce "
+                             "(deterministic group association). "
+                             "Single-device allgather only")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],
@@ -195,6 +207,7 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         feat_shards=getattr(ns, "feat_shards", 1),
         sort_segments=getattr(ns, "sort_segments", False),
         compact_gather=getattr(ns, "compact_gather", False),
+        route_gather=getattr(ns, "route_gather", ""),
         repartition_every=getattr(ns, "repartition_every", 0),
         repartition_threshold=getattr(ns, "repartition_threshold", 1.25),
     )

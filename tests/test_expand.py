@@ -160,3 +160,25 @@ def test_fused_multipart_raises():
     shards = build_pull_shards(g, 2)
     with pytest.raises(NotImplementedError):
         E.plan_fused_shards(shards, "sum")
+
+
+def test_cli_route_gather():
+    """--route-gather on the pagerank CLI: expand is bitwise vs direct
+    (same top ranks), fused passes -check, and the misuse guards fire."""
+    import subprocess, sys, os
+    import lux_tpu
+    repo_root = os.path.dirname(os.path.dirname(lux_tpu.__file__))
+    prev = os.environ.get("PYTHONPATH")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo_root + (os.pathsep + prev if prev else "")}
+    base = [sys.executable, "-m", "lux_tpu.apps.pagerank",
+            "--rmat-scale", "8", "-ni", "4", "-check"]
+    for extra in ([], ["--route-gather"], ["--route-gather", "fused"]):
+        r = subprocess.run(base + extra, capture_output=True, text=True,
+                           env=env, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "[PASS]" in r.stdout
+    bad = subprocess.run(
+        base + ["--route-gather", "--distributed", "-ng", "2"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert bad.returncode != 0
