@@ -37,10 +37,33 @@ echo "relay gate: 8083 accepts"
 #    gate could burn 90 min of a 7-min window.
 #    Also races the gather halves (direct vs compact mirror) — the
 #    roofline's dominant unknown, banked at micro scale.
-run micro_race 1500 python tools/tpu_micro_race.py \
-    --methods mxsum gather gatherc scan --outdir "$LOG/micro"
+#    Round-5 addition: "route" (Benes lane-shuffle expand) and "fused"
+#    (routed expand + group reduce) race the same window — the measured
+#    design bet of the round.  Order: mxsum banks the reduce baseline,
+#    gather banks the flat baseline, then route/fused bank the routed
+#    rows; scan stays last.
+run micro_race 2400 python tools/tpu_micro_race.py \
+    --methods mxsum gather route fused gatherc scan --outdir "$LOG/micro"
 grep -q '"ms_per_rep"' "$LOG/micro_race.out" || {
   echo "tunnel dead (no micro rows) — aborting battery"; exit 1; }
+
+# 0b) uint8 vs int32 pass indices (LUX_ROUTE_IDX8): the 4x index-traffic
+#     lever; a Mosaic rejection of u8 gather operands shows up here, not
+#     mid-battery
+LUX_ROUTE_IDX8=0 run micro_route_i32 900 python tools/tpu_micro_race.py \
+    --methods route --outdir "$LOG/micro_i32"
+
+# 0c) routed end-to-end pagerank at headline scale (plan build ~3 min
+#     first time, then disk-cached): the round's headline bet, banked
+#     before the long component probes
+LUX_BENCH_WATCHDOG_S=1500 LUX_BENCH_TPU_S=1300 \
+  LUX_BENCH_ROUTE_FUSED=1 LUX_BENCH_APPS=pagerank \
+  LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
+  run bench_routefused 1600 python bench.py
+LUX_BENCH_WATCHDOG_S=1500 LUX_BENCH_TPU_S=1300 \
+  LUX_BENCH_ROUTE_GATHER=1 LUX_BENCH_APPS=pagerank \
+  LUX_BENCH_METHOD=mxsum LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
+  run bench_route 1600 python bench.py
 
 # 1) the driver-format bench race FIRST after the gate (VERDICT r3 #1:
 #    the no-suffix TPU datapoint is the top ask — a short window must
