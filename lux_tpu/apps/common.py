@@ -123,21 +123,22 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "colfilter's (V, K) latent state (and its dst-state "
                 "error term) uses the direct gather"
             )
-        if (cfg.distributed or cfg.exchange != "allgather"
+        if (cfg.exchange != "allgather"
                 or cfg.edge_shards > 1 or cfg.feat_shards > 1
                 or cfg.method == "pallas" or cfg.compact_gather
                 or cfg.stream_hbm_gib):
             raise SystemExit(
-                "--route-gather binds to the single-device allgather "
-                "pull layout (plans are built from its src_pos); it "
-                "cannot combine with --distributed/--edge-shards/"
-                "--feat-shards/--method pallas/--compact-gather/"
-                "--stream-hbm-gib"
+                "--route-gather binds to the allgather pull layout "
+                "(plans are built from its src_pos); it cannot combine "
+                "with --edge-shards/--feat-shards/--method pallas/"
+                "--compact-gather/--stream-hbm-gib"
             )
-        if cfg.route_gather == "fused" and cfg.num_parts != 1:
+        if cfg.route_gather == "fused" and (cfg.num_parts != 1
+                                            or cfg.distributed):
             raise SystemExit(
-                "--route-gather fused supports -ng 1 (per-part group "
-                "layouts differ); use --route-gather expand for -ng > 1"
+                "--route-gather fused supports a single resident part "
+                "(-ng 1, single device) for now; --route-gather expand "
+                "runs distributed"
             )
         if cfg.verbose or cfg.ckpt_every:
             raise SystemExit(
@@ -499,8 +500,14 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
         )
     from lux_tpu.parallel import dist
 
+    route = None
+    if getattr(cfg, "route_gather", "") == "expand":
+        from lux_tpu.ops import expand
+
+        route = expand.plan_expand_shards_cached(shards)
     return dist.run_pull_fixed_dist(
-        prog, shards.spec, shards.arrays, state, num_iters, mesh, cfg.method
+        prog, shards.spec, shards.arrays, state, num_iters, mesh, cfg.method,
+        route=route,
     )
 
 

@@ -58,6 +58,11 @@ def lane_gather(x, idx, rb: int = 1024, interpret: bool = False):
     from jax.experimental.pallas import tpu as pltpu
 
     r = x.shape[0]
+    if r == 1:
+        # Mosaic rejects a (1, 128) gather operand ("Shape mismatch in
+        # input, indices and output", measured on v5e); a single row is
+        # 128 elements — plain XLA is exact and negligible
+        return jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)
     rb = min(rb, r)
     assert r % rb == 0, (r, rb)
     spec = pl.BlockSpec((rb, LANE), lambda i: (i, 0))
@@ -130,7 +135,13 @@ def plan_route(route: Route) -> RoutePlan:
     for p in route.passes:
         g = p.axis
         d = dims[g]
-        if d == LANE:
+        if d == LANE or route.n >= LANE:
+            # a small digit (d < 128, d | 128) ALSO rides the lane
+            # kernel: with the digit innermost, each 128-lane row holds
+            # 128/d whole digit-blocks, and the gather stays block-local
+            # via the static fixup lane = (lane//d)*d + idx.  This
+            # avoids the sublane kernel's narrow-minor-dim layouts
+            # ((2, n/2) measured ~10x slower than lane passes on v5e).
             new_order = [a for a in order if a != g] + [g]
             kshape = (route.n // LANE, LANE)
             kind = "lane"
@@ -146,6 +157,9 @@ def plan_route(route: Route) -> RoutePlan:
         idx = np.ascontiguousarray(
             np.transpose(p.idx, new_order).reshape(kshape), np.int32
         )
+        if kind == "lane" and d < LANE:
+            idx = ((np.arange(LANE, dtype=np.int32)[None, :] // d) * d
+                   + idx)
         passes.append(DevicePass(kind=kind, view=view,
                                  perm_axes=perm_axes, kshape=kshape,
                                  idx=idx))

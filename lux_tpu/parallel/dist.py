@@ -37,23 +37,45 @@ def _arrays_specs():
 
 
 @lru_cache(maxsize=64)
-def _compile_fixed(prog, mesh, num_iters: int, method: str):
+def _compile_fixed(prog, mesh, num_iters: int, method: str,
+                   route_static=None, interpret: bool = False):
     """Build (once per config) the jitted shard_map program.  Cached so
-    repeated calls don't retrace; all keys are hashable statics."""
+    repeated calls don't retrace; all keys are hashable statics.
+
+    ``route_static``: ExpandStatic to run each resident part's LOAD
+    phase through the routed-shuffle expand (parts share ONE static by
+    construction, so the vmapped lanes stay uniform; the per-part index
+    arrays ride in as a sharded pytree operand)."""
+    routed = route_static is not None
+    in_specs = (_arrays_specs(), P(PARTS_AXIS))
+    kw = {}
+    if routed:
+        in_specs = in_specs + (P(PARTS_AXIS),)
+        # pallas_call's out_shape carries no varying-mesh-axes
+        # annotation (see parallel/pallas_dist.py): the routed lane
+        # gathers run under this shard_map, so the vma check must be off
+        kw["check_vma"] = False
 
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(_arrays_specs(), P(PARTS_AXIS)),
+        in_specs=in_specs,
         out_specs=P(PARTS_AXIS),
+        **kw,
     )
-    def run(arr_blk, state_blk):
+    def run(arr_blk, state_blk, *route_blk):
         # each device holds k = P/D resident parts (k == 1 when P == D);
         # the per-part step vmaps over the resident lanes — the mapper-
         # slicing analog (core/lux_mapper.cc:102-122)
         def body(_, block):
             full = flatten_gather(block)
+            if routed:
+                return jax.vmap(
+                    lambda arr, loc, ra: local_pull_step(
+                        prog, arr, full, loc, method,
+                        route=(route_static, ra), interpret=interpret)
+                )(arr_blk, block, route_blk[0])
             return jax.vmap(
                 lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
             )(arr_blk, block)
@@ -71,18 +93,35 @@ def run_pull_fixed_dist(
     num_iters: int,
     mesh: Mesh,
     method: str = "auto",
+    route=None,
 ):
     """Fixed-iteration distributed pull (PageRank/CF).  ``arrays`` and
     ``state0`` are stacked (P, ...) with P == mesh size; returns the final
     stacked state (sharded).  P may be any multiple of the mesh size
-    (k parts resident per device)."""
+    (k parts resident per device).  ``route`` (ExpandStatic mode only)
+    runs each part's LOAD phase through the routed-shuffle expand —
+    bitwise-identical to the direct gather, all_gather exchange
+    unchanged."""
     from lux_tpu.engine import methods
+    from lux_tpu.engine.pull import _route_interpret
 
     method = methods.resolve(method, prog.reduce)
     assert spec.num_parts % mesh.devices.size == 0, (spec.num_parts, mesh.shape)
     arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, arrays))
     state0 = shard_stacked(mesh, state0)
-    return _compile_fixed(prog, mesh, num_iters, method)(arrays, state0)
+    if route is None:
+        return _compile_fixed(prog, mesh, num_iters, method)(arrays, state0)
+    rs, ra = route
+    from lux_tpu.ops import expand as _expand
+
+    if isinstance(rs, _expand.FusedStatic):
+        raise NotImplementedError(
+            "fused routed pull is single-device for now (per-part group "
+            "layouts differ); use the expand route distributed")
+    ra = shard_stacked(mesh, jax.tree.map(jnp.asarray, ra))
+    fn = _compile_fixed(prog, mesh, num_iters, method, route_static=rs,
+                        interpret=_route_interpret())
+    return fn(arrays, state0, ra)
 
 
 def compile_pull_phases_dist(prog, mesh, method: str = "auto"):

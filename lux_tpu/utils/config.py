@@ -138,8 +138,9 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "'expand' replaces the per-edge state gather "
                              "with lane shuffles (bitwise-identical); "
                              "'fused' also replaces the segmented reduce "
-                             "(deterministic group association). "
-                             "Single-device allgather only")
+                             "(deterministic group association; single "
+                             "device).  Allgather layout only; 'expand' "
+                             "also runs --distributed")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],

@@ -165,12 +165,10 @@ def test_fused_multipart_raises():
 def test_cli_route_gather():
     """--route-gather on the pagerank CLI: expand is bitwise vs direct
     (same top ranks), fused passes -check, and the misuse guards fire."""
-    import subprocess, sys, os
-    import lux_tpu
-    repo_root = os.path.dirname(os.path.dirname(lux_tpu.__file__))
-    prev = os.environ.get("PYTHONPATH")
-    env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": repo_root + (os.pathsep + prev if prev else "")}
+    import subprocess, sys
+    from tests.conftest import forced_cpu_env
+
+    env = forced_cpu_env()
     base = [sys.executable, "-m", "lux_tpu.apps.pagerank",
             "--rmat-scale", "8", "-ni", "4", "-check"]
     for extra in ([], ["--route-gather"], ["--route-gather", "fused"]):
@@ -178,7 +176,37 @@ def test_cli_route_gather():
                            env=env, timeout=300)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "[PASS]" in r.stdout
-    bad = subprocess.run(
+    # distributed EXPAND is supported; distributed FUSED is not
+    ok_dist = subprocess.run(
         base + ["--route-gather", "--distributed", "-ng", "2"],
         capture_output=True, text=True, env=env, timeout=300)
+    assert ok_dist.returncode == 0, ok_dist.stdout + ok_dist.stderr
+    bad = subprocess.run(
+        base + ["--route-gather", "fused", "--distributed", "-ng", "2"],
+        capture_output=True, text=True, env=env, timeout=300)
     assert bad.returncode != 0
+
+
+@pytest.mark.parametrize("devices", [8, 4])
+def test_distributed_routed_expand_bitwise(devices):
+    """Routed expand under shard_map: bitwise vs the direct distributed
+    gather at P == D (8) and k-resident P > D (8 parts on 4)."""
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.parallel import dist, mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh(devices)
+    g = generate.rmat(10, 8, seed=9)
+    shards = build_pull_shards(g, 8)
+    prog = PageRankProgram(nv=shards.spec.nv)
+    arrays = jax.tree.map(jnp.asarray, shards.arrays)
+    s0 = pull.init_state(prog, arrays)
+    route = E.plan_expand_shards(shards)
+    direct = dist.run_pull_fixed_dist(
+        prog, shards.spec, shards.arrays, s0, 5, mesh, method="scan")
+    routed = dist.run_pull_fixed_dist(
+        prog, shards.spec, shards.arrays, s0, 5, mesh, method="scan",
+        route=route)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
