@@ -157,6 +157,10 @@ def worker_main():
     shards = build_pull_shards(g, 1, sort_segments=sort_seg,
                                compact_gather=compact)
     compact_unique = _total_unique(shards) if compact else 0
+    # _layout["route"] is read by measure()/timed() so the default TPU
+    # race can temporarily switch the routed line on (see below) without
+    # threading a parameter through every closure
+    _layout = {"route": None, "route_tag": ""}
     route_plan = None
     if route_gather or route_fused:
         from lux_tpu.ops import expand
@@ -175,6 +179,8 @@ def worker_main():
               f"{time.time() - t_plan:.1f}s (n={route_plan[0].n}, "
               f"{len(route_plan[1])} pass arrays, on device)",
               file=sys.stderr, flush=True)
+        _layout["route"] = route_plan
+        _layout["route_tag"] = "_routefused" if route_fused else "_route"
     print(f"# worker: graph ready nv={g.nv} ne={g.ne}", file=sys.stderr, flush=True)
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     jax.block_until_ready(arrays)
@@ -222,10 +228,11 @@ def worker_main():
         s0 = pull.init_state(prog, arrays)
 
         run_method = "scan" if method == "fused" else method
+        rp = _layout["route"]
 
         def run(n):
             return pull.run_pull_fixed(prog, shards.spec, arrays, s0, n,
-                                       run_method, route=route_plan)
+                                       run_method, route=rp)
 
         return fetch_timed(run)
 
@@ -270,18 +277,16 @@ def worker_main():
             suffix = "_sortseg" + suffix
         if compact:
             suffix = "_compact" + suffix
-        if route_gather:
-            suffix = "_route" + suffix
-        if route_fused:
-            suffix = "_routefused" + suffix
+        if _layout["route_tag"]:
+            suffix = _layout["route_tag"] + suffix
         print(
             f"# method {m} ({dt}): {elapsed:.4f}s -> {gteps:.4f} GTEPS",
             file=sys.stderr,
             flush=True,
         )
-        if route_plan is not None:
+        if _layout["route"] is not None:
             model = roofline.routed_pull_iter_model(
-                route_plan[0], g.ne, g.nv,
+                _layout["route"][0], g.ne, g.nv,
                 state_bytes=2 if dt == "bfloat16" else 4,
                 method="scan" if m == "fused" else m,
             ).scale(iters)
@@ -544,6 +549,55 @@ def worker_main():
                 measure(best_m, "bfloat16")
             except Exception as e:  # noqa: BLE001
                 print(f"# bf16 variant failed: {e}", file=sys.stderr, flush=True)
+        if (results and on_tpu and not (route_gather or route_fused
+                                        or compact or sort_seg)):
+            # the routed hot loop (ops/expand.py; measured 49x the flat
+            # gather at the load phase) joins the DEFAULT race so the
+            # headline reflects the best shipped config.  Plan
+            # construction is ~3.5 min at scale 20, so build only when
+            # the disk cache already has it (chip_day step 0c warms it)
+            # or most of the TPU budget remains.
+            rp = None
+            saved_results = dict(results)
+            try:
+                from lux_tpu.ops import expand
+                from lux_tpu.engine.methods import CONCRETE
+
+                concrete = {kv: t for kv, t in results.items()
+                            if kv[0] in CONCRETE}
+                tpu_budget = int(os.environ.get("LUX_BENCH_TPU_S", "600"))
+                spent = time.monotonic() - t_worker0
+                cache_path = expand.has_cached_expand_plan(shards)
+                if not concrete:
+                    print("# routed line skipped: no concrete reduce "
+                          "method measured", file=sys.stderr, flush=True)
+                elif cache_path or spent < 0.3 * tpu_budget:
+                    t_plan = time.time()
+                    rp = expand.plan_expand_shards_cached(
+                        shards, cache_path=cache_path)
+                    rp = (rp[0], jax.tree.map(jnp.asarray, rp[1]))
+                    jax.block_until_ready(rp[1])
+                    print(f"# routed plan "
+                          f"({'cache' if cache_path else 'built'}"
+                          f" {time.time() - t_plan:.1f}s) — measuring "
+                          f"routed line", file=sys.stderr, flush=True)
+                    _layout["route"] = rp
+                    _layout["route_tag"] = "_route"
+                    measure(min(concrete, key=concrete.get)[0], dtype)
+                else:
+                    print("# routed line skipped: no cached plan and "
+                          "budget mostly spent", file=sys.stderr, flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(f"# routed line failed: {e}", file=sys.stderr,
+                      flush=True)
+            finally:
+                _layout["route"] = None
+                _layout["route_tag"] = ""
+                del rp  # free the ~1 GB device-resident plan pre-scale-up
+                # the routed elapsed must not pollute the unrouted
+                # results the winner recording and scale-up pick from
+                results.clear()
+                results.update(saved_results)
     # secondary apps run AFTER the headline race banks its lines (each is
     # emitted the moment it exists) and BEFORE the risky tail, so a tail
     # wedge cannot cost the multi-app signal
