@@ -133,13 +133,6 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "with --edge-shards/--feat-shards/--method pallas/"
                 "--compact-gather/--stream-hbm-gib"
             )
-        if cfg.route_gather == "fused" and (cfg.num_parts != 1
-                                            or cfg.distributed):
-            raise SystemExit(
-                "--route-gather fused supports a single resident part "
-                "(-ng 1, single device) for now; --route-gather expand "
-                "runs distributed"
-            )
         if cfg.verbose or cfg.ckpt_every:
             raise SystemExit(
                 "--route-gather runs the fused on-device loop; "
@@ -501,10 +494,13 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
     from lux_tpu.parallel import dist
 
     route = None
-    if getattr(cfg, "route_gather", "") == "expand":
+    rg = getattr(cfg, "route_gather", "")
+    if rg:
         from lux_tpu.ops import expand
 
-        route = expand.plan_expand_shards_cached(shards)
+        route = (expand.plan_fused_shards_cached(shards, prog.reduce)
+                 if rg == "fused"
+                 else expand.plan_expand_shards_cached(shards))
     return dist.run_pull_fixed_dist(
         prog, shards.spec, shards.arrays, state, num_iters, mesh, cfg.method,
         route=route,

@@ -330,7 +330,8 @@ def _neutral_like(reduce: str, dtype):
 
 def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
                state_size: int, v_pad: int, reduce: str = "sum",
-               weights: np.ndarray | None = None):
+               weights: np.ndarray | None = None,
+               template: dict[int, int] | None = None):
     """Plan the fused routed pull for ONE part.
 
     src_pos / dst_local: (e_pad,) CSC-order arrays (fill_part layout:
@@ -347,18 +348,26 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     # --- group layout: per-destination pow2-padded blocks ---
     dl = np.asarray(dst_local[:m], np.int64)
     dsts, counts = np.unique(dl, return_counts=True)  # ascending = CSC order
-    p_sizes = np.maximum(1, 2 ** np.ceil(np.log2(np.maximum(counts, 1)))
-                         ).astype(np.int64)
-    ks = np.log2(p_sizes).astype(np.int64)
+    ks = _width_classes(counts)
     order = np.argsort(ks, kind="stable")  # group by k, stable by dst
+    if template is None:
+        template = {int(k): int((ks == k).sum()) for k in np.unique(ks)}
+    assert set(int(k) for k in np.unique(ks)) <= set(template), (
+        "template is missing width classes present in the data")
     groups: list[tuple[int, int, int]] = []
     seg_base = np.empty(len(dsts), np.int64)  # group-layout start per dst
     seg_stride = np.empty(len(dsts), np.int64)  # per-rank step within seg
+    total_rank = np.empty(len(dsts), np.int64)  # dst -> totals-array slot
     off = 0
-    for k in np.unique(ks):
+    rank_off = 0
+    for k in sorted(template):
         sel = order[ks[order] == k]
         width = 1 << int(k)
-        cnt = len(sel)
+        cnt = template[k]  # >= len(sel); extra rows are dummies that
+        # stay masked to the reduce neutral (multi-part plans share one
+        # template so every part's FusedStatic — and so the vmapped /
+        # sharded engines — stay uniform)
+        assert len(sel) <= cnt, (k, len(sel), cnt)
         groups.append((off, cnt, width))
         if width < LANE:
             # COLUMN-major (width, count) block: narrow-minor-dim row
@@ -366,12 +375,14 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
             # on TPU (measured ~7 ms of the fused loop); transposed, the
             # reduction runs along <= 16 sublane rows with count on the
             # lane axis
-            seg_base[sel] = off + np.arange(cnt, dtype=np.int64)
+            seg_base[sel] = off + np.arange(len(sel), dtype=np.int64)
             seg_stride[sel] = cnt
         else:
-            seg_base[sel] = off + np.arange(cnt, dtype=np.int64) * width
+            seg_base[sel] = off + np.arange(len(sel), dtype=np.int64) * width
             seg_stride[sel] = 1
+        total_rank[sel] = rank_off + np.arange(len(sel), dtype=np.int64)
         off += cnt * width
+        rank_off += cnt
     n2 = max(_next_pow2(off), n, LANE)
 
     # perm2: CSR slot j (edge csr[j], dst dl[csr[j]]) -> its slot in the
@@ -404,11 +415,8 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     # accumulator route: totals (group order: one per dst, concat by k)
     # -> dst_local slots of a (nv_route,) vector; uncovered slots pull
     # from the zero tail
-    num_seg = len(dsts)
-    nv_route = max(_next_pow2(v_pad), LANE)
-    assert num_seg <= v_pad <= nv_route
-    total_rank = np.empty(num_seg, np.int64)
-    total_rank[order] = np.arange(num_seg, dtype=np.int64)  # dst -> rank
+    total_slots = rank_off  # template slots incl. dummies
+    nv_route = max(_next_pow2(max(v_pad, total_slots)), LANE)
     permv = np.empty(nv_route, np.int64)
     used_tgtv = np.zeros(nv_route, bool)
     used_srcv = np.zeros(nv_route, bool)
@@ -489,28 +497,54 @@ def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
     return acc[: static.v_pad]
 
 
+def _width_classes(counts: np.ndarray) -> np.ndarray:
+    """Per-segment width class k (pad width = 2**k) from segment sizes.
+    The ONE derivation shared by template construction and plan_fused —
+    divergence would route through uninitialized layout slots."""
+    return np.ceil(np.log2(np.maximum(counts, 1))).astype(np.int64)
+
+
+def _group_template(arrays) -> dict[int, int]:
+    """Shared per-width-class group counts: the MAX over parts of each
+    class's segment count.  Every part planned against this template
+    yields an identical FusedStatic (dummy rows mask to the reduce
+    neutral), so the vmapped and sharded engines stay uniform."""
+    template: dict[int, int] = {}
+    for i in range(arrays.src_pos.shape[0]):
+        dl = arrays.dst_local[i][arrays.edge_mask[i]]
+        _, counts = np.unique(dl, return_counts=True)
+        ks = _width_classes(counts)
+        for k in np.unique(ks):
+            template[int(k)] = max(template.get(int(k), 0),
+                                   int((ks == k).sum()))
+    return template
+
+
 def plan_fused_shards(shards, reduce: str = "sum"):
-    """plan_fused for a PullShards bundle.  Single-part only for now:
-    the fused group layout (offsets/counts/widths) is degree-
-    distribution-dependent, so parts generally do NOT share a static —
-    the vmapped engine cannot batch them.  P=1 covers the single-chip
-    benchmark path; multi-part needs shape-uniform groups (follow-up).
-    """
+    """plan_fused for a PullShards bundle.  Parts share one group
+    TEMPLATE (max segment count per width class across parts), so all
+    parts produce the same FusedStatic and the vmapped engine batches
+    them; the price is a few dummy group rows per part, masked to the
+    reduce neutral."""
     arrays = shards.arrays
     p = arrays.src_pos.shape[0]
-    if p != 1:
-        raise NotImplementedError(
-            "fused routed pull supports a single part per device for "
-            "now (per-part group layouts differ); use the expand route "
-            "or the direct gather for P > 1")
     v_pad = arrays.row_ptr.shape[1] - 1
-    m = int(np.count_nonzero(arrays.edge_mask[0]))
-    static, a = plan_fused(
-        np.asarray(arrays.src_pos[0]), np.asarray(arrays.dst_local[0]),
-        m, shards.spec.gathered_size, v_pad, reduce,
-        weights=np.asarray(arrays.weights[0]))
-    stacked = tuple(x[None] for x in a)
-    return static, stacked
+    template = _group_template(arrays)
+    statics, per_part = [], []
+    for i in range(p):
+        m = int(np.count_nonzero(arrays.edge_mask[i]))
+        st, a = plan_fused(
+            np.asarray(arrays.src_pos[i]), np.asarray(arrays.dst_local[i]),
+            m, shards.spec.gathered_size, v_pad, reduce,
+            weights=np.asarray(arrays.weights[i]), template=template)
+        statics.append(st)
+        per_part.append(a)
+    assert all(st == statics[0] for st in statics[1:]),         "parts must share one FusedStatic (template bug)"
+    stacked = tuple(
+        np.stack([per_part[i][j] for i in range(p)])
+        for j in range(len(per_part[0]))
+    )
+    return statics[0], stacked
 
 
 def _default_cache_dir() -> str:

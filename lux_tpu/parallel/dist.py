@@ -112,12 +112,6 @@ def run_pull_fixed_dist(
     if route is None:
         return _compile_fixed(prog, mesh, num_iters, method)(arrays, state0)
     rs, ra = route
-    from lux_tpu.ops import expand as _expand
-
-    if isinstance(rs, _expand.FusedStatic):
-        raise NotImplementedError(
-            "fused routed pull is single-device for now (per-part group "
-            "layouts differ); use the expand route distributed")
     ra = shard_stacked(mesh, jax.tree.map(jnp.asarray, ra))
     fn = _compile_fixed(prog, mesh, num_iters, method, route_static=rs,
                         interpret=_route_interpret())

@@ -152,14 +152,51 @@ def test_engine_fused_components_bitwise():
     np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
 
 
-def test_fused_multipart_raises():
+def test_fused_multipart_template():
+    """Parts share one FusedStatic via the group template; the vmapped
+    engine batches them and matches the direct engine."""
     from lux_tpu.graph import generate
+    from lux_tpu.engine import pull
     from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.pagerank import PageRankProgram
 
     g = generate.rmat(8, 8, seed=7)
     shards = build_pull_shards(g, 2)
-    with pytest.raises(NotImplementedError):
-        E.plan_fused_shards(shards, "sum")
+    static, arrays = E.plan_fused_shards(shards, "sum")
+    assert arrays[0].shape[0] == 2
+    prog = PageRankProgram(nv=shards.spec.nv)
+    dev = jax.tree.map(jnp.asarray, shards.arrays)
+    s0 = pull.init_state(prog, dev)
+    direct = pull.run_pull_fixed(prog, shards.spec, dev, s0, 5,
+                                 method="scan")
+    routed = pull.run_pull_fixed(prog, shards.spec, dev, s0, 5,
+                                 method="scan", route=(static, arrays))
+    np.testing.assert_allclose(np.asarray(routed), np.asarray(direct),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_fused_distributed_bitwise_vs_single():
+    """Fused routed pull under shard_map (8 virtual devices) matches the
+    single-device fused engine bitwise (same plans, same association)."""
+    from lux_tpu.graph import generate
+    from lux_tpu.engine import pull
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.parallel import dist, mesh as mesh_lib
+
+    g = generate.rmat(10, 8, seed=11)
+    shards = build_pull_shards(g, 8)
+    prog = PageRankProgram(nv=shards.spec.nv)
+    dev = jax.tree.map(jnp.asarray, shards.arrays)
+    s0 = pull.init_state(prog, dev)
+    fused = E.plan_fused_shards(shards, "sum")
+    single = pull.run_pull_fixed(prog, shards.spec, dev, s0, 5,
+                                 method="scan", route=fused)
+    mesh = mesh_lib.make_mesh(8)
+    dist_out = dist.run_pull_fixed_dist(
+        prog, shards.spec, shards.arrays, s0, 5, mesh, method="scan",
+        route=fused)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(dist_out))
 
 
 def test_cli_route_gather():
@@ -176,13 +213,16 @@ def test_cli_route_gather():
                            env=env, timeout=300)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "[PASS]" in r.stdout
-    # distributed EXPAND is supported; distributed FUSED is not
-    ok_dist = subprocess.run(
-        base + ["--route-gather", "--distributed", "-ng", "2"],
-        capture_output=True, text=True, env=env, timeout=300)
-    assert ok_dist.returncode == 0, ok_dist.stdout + ok_dist.stderr
+    # both modes run --distributed on the allgather exchange
+    for mode in ([], ["fused"]):
+        ok_dist = subprocess.run(
+            base + ["--route-gather", *mode, "--distributed", "-ng", "2"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert ok_dist.returncode == 0, ok_dist.stdout + ok_dist.stderr
+    # the bucket exchanges ship their own slices — routed must reject
     bad = subprocess.run(
-        base + ["--route-gather", "fused", "--distributed", "-ng", "2"],
+        base + ["--route-gather", "--distributed", "-ng", "2",
+                "--exchange", "ring"],
         capture_output=True, text=True, env=env, timeout=300)
     assert bad.returncode != 0
 
