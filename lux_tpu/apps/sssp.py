@@ -206,6 +206,17 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
     from lux_tpu.engine import methods
 
     cfg.method = methods.resolve(cfg.method, prog.reduce)
+    if getattr(cfg, "route_gather", "") and (
+            cfg.distributed or cfg.ckpt_every or cfg.repartition_every
+            or getattr(cfg, "delta", 0) or cfg.verbose
+            or cfg.method == "pallas" or cfg.exchange != "allgather"
+            or cfg.compact_gather):
+        raise SystemExit(
+            "--route-gather on push apps routes the plain single-device "
+            "dense rounds (allgather layout); it cannot combine with "
+            "--distributed/checkpointing/--repartition-every/--delta/"
+            "-verbose/--method pallas/--compact-gather"
+        )
     if cfg.method in ("cumsum", "mxsum"):
         raise SystemExit(
             f"--method {cfg.method} is a prefix-diff strategy: sum-reduce "
@@ -372,8 +383,13 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                     cfg.method
                 )
         elif mesh is None:
+            route = None
+            if getattr(cfg, "route_gather", ""):
+                from lux_tpu.ops import expand
+
+                route = expand.plan_expand_shards_cached(shards)
             state, iters, edges = push.run_push(
-                prog, shards, cfg.max_iters, cfg.method
+                prog, shards, cfg.max_iters, cfg.method, route=route
             )
         elif cfg.exchange == "ring":
             if cfg.verbose:

@@ -68,10 +68,20 @@ def _seg_reduce(prog):
     return segment.segment_min_csc if prog.reduce == "min" else segment.segment_max_csc
 
 
-def dense_part_step(prog, arr: ShardArrays, full_state, local, method="scan"):
+def dense_part_step(prog, arr: ShardArrays, full_state, local, method="scan",
+                    route=None, interpret=False):
     """Pull-mode relaxation over ALL in-edges (sssp_pull_kernel semantics:
-    new[v] = op(old[v], op over in-edges relax(state[src]))."""
-    if arr.mirror_pos.shape[-1] > 0:
+    new[v] = op(old[v], op over in-edges relax(state[src])).
+
+    ``route`` = (ExpandStatic, this part's arrays): the routed-shuffle
+    expand replaces the flat gather (ops/expand.py) — relax is
+    elementwise on (src, weight), so results stay bitwise identical."""
+    if route is not None:
+        from lux_tpu.ops import expand
+
+        src = expand.apply_expand(full_state, route[0], route[1],
+                                  interpret=interpret)
+    elif arr.mirror_pos.shape[-1] > 0:
         # compact-gather mirror (engine/pull.pull_gather_part semantics)
         src = full_state[arr.mirror_pos][arr.mirror_rel]
     else:
@@ -256,7 +266,8 @@ def _push_prep(pspec: PushSpec, spec: ShardSpec, parrays, c: PushCarry):
 
 def _push_relax(prog, pspec: PushSpec, spec: ShardSpec, method, arrays,
                 parrays, c: PushCarry, q_vids_all, q_vals_all, preps,
-                use_dense):
+                use_dense, route_static=None, route_arrays=None,
+                interpret=False):
     """COMP phase: dense (pull over all in-edges) or sparse (scatter the
     frontier's out-edges) relaxation -> new stacked state.
 
@@ -269,6 +280,12 @@ def _push_relax(prog, pspec: PushSpec, spec: ShardSpec, method, arrays,
     rows, counts, incl, _ = preps
 
     def dense_all():
+        if route_static is not None:
+            return jax.vmap(
+                lambda arr, loc, ra: dense_part_step(
+                    prog, arr, full, loc, method,
+                    route=(route_static, ra), interpret=interpret)
+            )(arrays, c.state, route_arrays)
         return jax.vmap(
             lambda arr, loc: dense_part_step(prog, arr, full, loc, method)
         )(arrays, c.state)
@@ -321,12 +338,14 @@ def _push_requeue(prog, pspec: PushSpec, spec: ShardSpec, arrays,
 
 
 def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
-                    arrays, parrays, c: PushCarry) -> PushCarry:
+                    arrays, parrays, c: PushCarry, route_static=None,
+                    route_arrays=None, interpret=False) -> PushCarry:
     """One direction-optimized iteration over all parts (single device)."""
     q_vids_all, q_vals_all, preps, use_dense = _push_prep(pspec, spec, parrays, c)
     new = _push_relax(
         prog, pspec, spec, method, arrays, parrays, c,
         q_vids_all, q_vals_all, preps, use_dense,
+        route_static, route_arrays, interpret,
     )
     return _push_requeue(prog, pspec, spec, arrays, c, new, preps, use_dense)
 
@@ -345,17 +364,32 @@ def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
     )
 
 
+def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
+                              route_static, method: str = "auto"):
+    """compile_push_chunk with the dense rounds' gather routed
+    (interpret mode resolved here, off-chip = CPU tests)."""
+    from lux_tpu.engine.pull import _route_interpret
+
+    return _compile_push_chunk_cached(
+        prog, pspec, spec, methods.resolve(method, prog.reduce),
+        route_static=route_static, interpret=_route_interpret(),
+    )
+
+
 @lru_cache(maxsize=64)
 def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
-                               method: str):
+                               method: str, route_static=None,
+                               interpret=False):
 
     @jax.jit
-    def loop(arrays, parrays, carry: PushCarry, it_stop):
+    def loop(arrays, parrays, carry: PushCarry, it_stop, route_arrays=None):
         def cond(c):
             return (c.active > 0) & (c.it < it_stop)
 
         def body(c):
-            return _push_iteration(prog, pspec, spec, method, arrays, parrays, c)
+            return _push_iteration(prog, pspec, spec, method, arrays,
+                                   parrays, c, route_static, route_arrays,
+                                   interpret)
 
         return jax.lax.while_loop(cond, body, carry)
 
@@ -437,19 +471,30 @@ def run_push(
     shards: PushShards,
     max_iters: int = 10_000,
     method: str = "auto",
+    route=None,
 ):
     """Single-device driver.  The direction switch is one global `lax.cond`
     over vmapped per-part branches — a genuine branch (only the taken mode
     executes; the global predicate makes this legal) with compile size O(1)
-    in the part count.  Returns (final stacked state, iters, edge counter).
+    in the part count.  ``route`` (ops.expand.plan_expand_shards on the
+    PULL layout) runs the dense rounds' gather through the routed
+    expand — bitwise-identical.  Returns (final stacked state, iters,
+    edge counter).
     """
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     parrays = jax.tree.map(jnp.asarray, shards.parrays)
     carry0 = _init_carry(prog, pspec, arrays)
-    loop = compile_push_chunk(prog, pspec, spec, method)
-    out = loop(arrays, parrays, carry0, jnp.int32(max_iters))
+    if route is None:
+        loop = compile_push_chunk(prog, pspec, spec, method)
+        out = loop(arrays, parrays, carry0, jnp.int32(max_iters))
+    else:
+        rs, ra = route
+        ra = jax.tree.map(jnp.asarray, ra)
+        loop = compile_push_chunk_routed(prog, pspec, spec, rs, method)
+        out = loop(arrays, parrays, carry0, jnp.int32(max_iters),
+                   route_arrays=ra)
     return out.state, out.it, out.edges
 
 

@@ -160,6 +160,12 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                         help="dense rounds gather through a per-part "
                              "unique-in-source mirror (working set "
                              "O(unique srcs); bitwise-identical)")
+        ap.add_argument("--route-gather", nargs="?", const="expand",
+                        default="", choices=["expand"],
+                        help="dense rounds' per-edge gather as Benes "
+                             "lane shuffles (ops/expand.py; bitwise-"
+                             "identical).  Single-device allgather only "
+                             "for push apps")
     if sssp:
         ap.add_argument("--weighted", action="store_true",
                         help="relax with edge weights (Dijkstra-style)")

@@ -250,3 +250,24 @@ def test_distributed_routed_expand_bitwise(devices):
         prog, shards.spec, shards.arrays, s0, 5, mesh, method="scan",
         route=route)
     np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
+
+
+def test_push_dense_rounds_routed_bitwise():
+    """Routed expand in the push engine's dense rounds: bitwise state,
+    identical round and exact-edge counters, on SSSP and CC."""
+    from lux_tpu.engine import push
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.models.sssp import SSSPProgram
+    from lux_tpu.models.components import MaxLabelProgram
+
+    g = generate.rmat(9, 8, seed=3)
+    shards = build_push_shards(g, 2)
+    route = E.plan_expand_shards(shards)
+    for prog in (SSSPProgram(nv=g.nv, start=1), MaxLabelProgram()):
+        st, it, ed = push.run_push(prog, shards, method="scan")
+        st2, it2, ed2 = push.run_push(prog, shards, method="scan",
+                                      route=route)
+        np.testing.assert_array_equal(np.asarray(st), np.asarray(st2))
+        assert int(it) == int(it2)
+        assert push.edges_total(ed) == push.edges_total(ed2)
