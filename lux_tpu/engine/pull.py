@@ -141,6 +141,11 @@ def local_pull_step(
     (ops/expand.apply_fused — dst-state-independent programs only)."""
     from lux_tpu.ops import expand
 
+    if route is not None and isinstance(route[0], expand.CFRouteStatic):
+        gath = expand.apply_cf_route(full_state, local_state, route[0],
+                                     route[1], interpret=interpret)
+        acc = pull_reduce_part(prog, arrays, gath, method)
+        return prog.apply(local_state, acc, arrays)
     if route is not None and isinstance(route[0], expand.FusedStatic):
         assert route[0].reduce == prog.reduce, (
             f"fused plan was built for reduce={route[0].reduce!r} but the "
@@ -286,6 +291,7 @@ def run_pull_until(
     max_iters: int,
     active_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
     method: str = "auto",
+    route=None,
 ):
     """Single-device driver: iterate until no vertex is active (the push-app
     convergence contract — total active count == 0, sssp/sssp.cc:115-129 —
@@ -297,21 +303,29 @@ def run_pull_until(
     """
     method = methods.resolve(method, prog.reduce)
     arrays = jax.tree.map(jnp.asarray, arrays)
-    return _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays, state0)
+    rs, ra = route if route is not None else (None, None)
+    if ra is not None:
+        ra = jax.tree.map(jnp.asarray, ra)
+    return _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays,
+                           state0, route_static=rs, route_arrays=ra,
+                           interpret=_route_interpret())
 
 
 @partial(
     jax.jit,
-    static_argnames=("prog", "spec", "max_iters", "active_fn", "method"),
+    static_argnames=("prog", "spec", "max_iters", "active_fn", "method",
+                     "route_static", "interpret"),
 )
-def _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays, state0):
+def _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays, state0,
+                    route_static=None, route_arrays=None, interpret=False):
     def cond(carry):
         _, it, active = carry
         return (active > 0) & (it < max_iters)
 
     def body(carry):
         state, it, _ = carry
-        new = _pull_iteration(prog, spec, method, arrays, state)
+        new = _pull_iteration(prog, spec, method, arrays, state,
+                              route_static, route_arrays, interpret)
         active = jnp.sum(active_fn(state, new))
         return new, it + 1, active
 

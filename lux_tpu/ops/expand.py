@@ -256,10 +256,22 @@ def plan_expand(src_pos: np.ndarray, m: int, state_size: int):
     return static, arrays
 
 
+def _ff_array_count(ff: FFStatic) -> int:
+    return sum(1 if lv.base else 2 for lv in ff.levels)
+
+
+def _num_expand_arrays(static) -> int:
+    """Total plan-array count of an expand-shaped static (r1 + ff + r2)
+    — the ONE place the layout arithmetic lives (split_arrays, the
+    fused splitter, and the CF src/dst split all derive from it)."""
+    return (len(static.r1.passes) + _ff_array_count(static.ff)
+            + len(static.r2.passes))
+
+
 def split_arrays(static: ExpandStatic, arrays):
     """Recover the (r1, ff, r2) array groups from the flat tuple."""
     n1 = len(static.r1.passes)
-    nff = sum(1 if lv.base else 2 for lv in static.ff.levels)
+    nff = _ff_array_count(static.ff)
     r1a = arrays[:n1]
     ffa = arrays[n1:n1 + nff]
     r2a = arrays[n1 + nff:]
@@ -447,7 +459,7 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
 
 def split_fused_arrays(static: FusedStatic, arrays, weighted: bool):
     n1 = len(static.r1.passes)
-    nff = sum(1 if lv.base else 2 for lv in static.ff.levels)
+    nff = _ff_array_count(static.ff)
     n2p = len(static.r2.passes)
     r1a = arrays[:n1]
     ffa = arrays[n1:n1 + nff]
@@ -518,6 +530,88 @@ def _group_template(arrays) -> dict[int, int]:
             template[int(k)] = max(template.get(int(k), 0),
                                    int((ks == k).sum()))
     return template
+
+
+@dataclasses.dataclass(frozen=True)
+class CFRouteStatic:
+    """Routed load for WIDE (V, K) dst-dependent programs (colfilter):
+    the src gather routes per feature column via ``src``, and the
+    dst-state read — ``local_state[dst_local]``, ALSO a sorted-runs
+    gather — routes via ``dst`` (an expand plan over the part's local
+    state).  Hashable jit static."""
+
+    src: ExpandStatic
+    dst: ExpandStatic
+
+
+def plan_cf_route_shards(shards):
+    """(CFRouteStatic, stacked arrays) for the wide dst-dependent pull:
+    arrays = src-plan arrays + dst-plan arrays (split by the statics'
+    pass counts)."""
+    arrays = shards.arrays
+    p = arrays.src_pos.shape[0]
+    v_pad = arrays.row_ptr.shape[1] - 1
+    statics, per_part = [], []
+    for i in range(p):
+        m = int(np.count_nonzero(arrays.edge_mask[i]))
+        s_src, a_src = plan_expand(np.asarray(arrays.src_pos[i]), m,
+                                   shards.spec.gathered_size)
+        s_dst, a_dst = plan_expand(np.asarray(arrays.dst_local[i]), m,
+                                   v_pad)
+        statics.append(CFRouteStatic(src=s_src, dst=s_dst))
+        per_part.append(tuple(a_src) + tuple(a_dst))
+    assert all(st == statics[0] for st in statics[1:])
+    stacked = tuple(
+        np.stack([per_part[i][j] for i in range(p)])
+        for j in range(len(per_part[0]))
+    )
+    return statics[0], stacked
+
+
+def plan_cf_route_shards_cached(shards, cache_dir: str | None = None):
+    """plan_cf_route_shards with the shared disk cache (keyed on
+    src_pos + dst_local + edge_mask bytes and the gathered/local
+    sizes)."""
+    import hashlib
+    import os
+    import pickle
+
+    cache_dir = cache_dir or _default_cache_dir()
+    h = hashlib.sha1()
+    h.update(f"cf{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
+    h.update(np.ascontiguousarray(shards.arrays.src_pos).tobytes())
+    h.update(np.ascontiguousarray(shards.arrays.dst_local).tobytes())
+    h.update(np.ascontiguousarray(shards.arrays.edge_mask).tobytes())
+    h.update(str(shards.spec.gathered_size).encode())
+    path = os.path.join(cache_dir, f"cf_{h.hexdigest()[:16]}.pkl")
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    plan = plan_cf_route_shards(shards)
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(plan, f)
+    os.replace(tmp, path)
+    return plan
+
+
+def apply_cf_route(full_state, local_state, static: CFRouteStatic, arrays,
+                   interpret: bool = False):
+    """(src_state (E, K), dst_state (E, K)) via routed expands per
+    feature column — bitwise equal to the direct gathers."""
+    n_src = _num_expand_arrays(static.src)
+    a_src, a_dst = arrays[:n_src], arrays[n_src:]
+
+    def col_src(col):
+        return apply_expand(col, static.src, a_src, interpret=interpret)
+
+    def col_dst(col):
+        return apply_expand(col, static.dst, a_dst, interpret=interpret)
+
+    src = jax.vmap(col_src, in_axes=1, out_axes=1)(full_state)
+    dst = jax.vmap(col_dst, in_axes=1, out_axes=1)(local_state)
+    return src, dst
 
 
 def plan_fused_shards(shards, reduce: str = "sum"):

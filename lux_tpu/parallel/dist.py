@@ -98,9 +98,10 @@ def run_pull_fixed_dist(
     """Fixed-iteration distributed pull (PageRank/CF).  ``arrays`` and
     ``state0`` are stacked (P, ...) with P == mesh size; returns the final
     stacked state (sharded).  P may be any multiple of the mesh size
-    (k parts resident per device).  ``route`` (ExpandStatic mode only)
-    runs each part's LOAD phase through the routed-shuffle expand —
-    bitwise-identical to the direct gather, all_gather exchange
+    (k parts resident per device).  ``route`` runs each part's hot loop
+    through the routed pipelines (ops/expand.py: ExpandStatic = routed
+    LOAD, bitwise; CFRouteStatic = wide src+dst routed LOAD, bitwise;
+    FusedStatic = routed load AND reduce); the all_gather exchange is
     unchanged."""
     from lux_tpu.engine import methods
     from lux_tpu.engine.pull import _route_interpret
