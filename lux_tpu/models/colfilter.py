@@ -85,22 +85,25 @@ def colfilter(
     gamma: float = GAMMA,
     method: str = "auto",
     dtype: str = "float32",
+    route=None,
 ) -> np.ndarray:
-    """Run CF; returns the (nv, k) latent-vector matrix."""
+    """Run CF; returns the (nv, k) latent-vector matrix.  ``route``: a
+    plan from ops.expand.plan_cf_route_shards (routed src+dst load)."""
     shards = g if isinstance(g, PullShards) else build_pull_shards(g, num_parts)
     assert shards.spec.weighted, "CF requires a weighted (rating) graph"
     prog = CFProgram(k=k, lam=lam, gamma=gamma, dtype=dtype)
     state0 = pull.init_state(prog, shards.arrays)
     if mesh is None:
         final = pull.run_pull_fixed(
-            prog, shards.spec, shards.arrays, state0, num_iters, method=method
+            prog, shards.spec, shards.arrays, state0, num_iters,
+            method=method, route=route,
         )
     else:
         from lux_tpu.parallel import dist
 
         final = dist.run_pull_fixed_dist(
             prog, shards.spec, shards.arrays, state0, num_iters, mesh,
-            method=method,
+            method=method, route=route,
         )
     return shards.scatter_to_global(np.asarray(final))
 

@@ -99,6 +99,7 @@ def connected_components_push(
     exchange: str = "allgather",
     repartition_every: int = 0,
     repartition_threshold: float = 1.25,
+    route=None,
 ) -> np.ndarray:
     """CC on the frontier/push engine (direction-optimizing; what the
     reference app actually runs).  ``g``: HostGraph or pre-built shards;
@@ -115,7 +116,7 @@ def connected_components_push(
     prog = MaxLabelProgram()
     return _push_run(
         prog, g, shards, mesh, max_iters, method, exchange, num_parts,
-        repartition_every, repartition_threshold,
+        repartition_every, repartition_threshold, route=route,
     )
 
 

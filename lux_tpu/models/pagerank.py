@@ -66,14 +66,18 @@ def pagerank(
     num_parts: int = 1,
     method: str = "auto",
     dtype: str = "float32",
+    route=None,
 ) -> np.ndarray:
     """Run PageRank; returns the (nv,) pre-divided rank vector (same
-    semantics as the reference's final vertex state)."""
+    semantics as the reference's final vertex state).  ``route``: a
+    routed-pull plan (ops.expand.plan_expand_shards / plan_fused_shards)
+    for the lane-shuffle hot loop."""
     shards = g if isinstance(g, PullShards) else build_pull_shards(g, num_parts)
     prog = PageRankProgram(nv=shards.spec.nv, dtype=dtype)
     state0 = pull.init_state(prog, shards.arrays)
     final = pull.run_pull_fixed(
-        prog, shards.spec, shards.arrays, state0, num_iters, method=method
+        prog, shards.spec, shards.arrays, state0, num_iters, method=method,
+        route=route,
     )
     return shards.scatter_to_global(np.asarray(final))
 

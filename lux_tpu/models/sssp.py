@@ -75,11 +75,19 @@ class WeightedSSSPProgram(SSSPProgram):
 
 
 def _push_run(prog, g, shards, mesh, max_iters, method, exchange,
-              num_parts, repartition_every=0, repartition_threshold=1.25):
+              num_parts, repartition_every=0, repartition_threshold=1.25,
+              route=None):
     """Shared dispatch for the frontier-model wrappers: single-device,
     all_gather-distributed, or ring-dense distributed; a positive
     ``repartition_every`` selects the adaptive dynamic-repartitioning
-    driver (allgather exchange, needs the HostGraph for rebuilds)."""
+    driver (allgather exchange, needs the HostGraph for rebuilds).
+    ``route`` applies to the single-device non-adaptive path only —
+    silently ignoring it elsewhere would misreport routed numbers."""
+    if route is not None and (mesh is not None or repartition_every > 0):
+        raise ValueError(
+            "route= is a single-device non-adaptive driver option; the "
+            "distributed/ring/repartition push paths run the direct "
+            "gather")
     from lux_tpu.parallel.ring import PushRingShards, build_push_ring_shards
 
     if repartition_every > 0:
@@ -115,7 +123,8 @@ def _push_run(prog, g, shards, mesh, max_iters, method, exchange,
     if mesh is None:
         if isinstance(shards, PushRingShards):
             shards = shards.push  # ring buckets are a distributed layout
-        final, _, _ = push.run_push(prog, shards, max_iters, method=method)
+        final, _, _ = push.run_push(prog, shards, max_iters, method=method,
+                                    route=route)
     elif exchange == "ring":
         if isinstance(shards, PushRingShards):
             rshards = shards
@@ -149,6 +158,7 @@ def sssp(
     repartition_every: int = 0,
     repartition_threshold: float = 1.25,
     delta: int = 0,
+    route=None,
 ) -> np.ndarray:
     """Run SSSP from ``start``; returns (nv,) int32 distances, nv == INF.
     ``exchange="ring"`` (with a mesh) streams dense rounds instead of
@@ -186,6 +196,10 @@ def sssp(
             raise ValueError(
                 "delta-stepping is an allgather-exchange driver"
             )
+        if route is not None:
+            raise ValueError(
+                "delta-stepping does not take route= (its dense rounds "
+                "have their own driver)")
         # check the SHARDS' weights (covers pre-built PushShards too —
         # bucket order silently finalizes too early under negative
         # costs; padding slots are 0.0 so only real negatives trip)
@@ -204,7 +218,7 @@ def sssp(
         return shards.scatter_to_global(np.asarray(final))
     return _push_run(
         prog, g, shards, mesh, max_iters, method, exchange, num_parts,
-        repartition_every, repartition_threshold,
+        repartition_every, repartition_threshold, route=route,
     )
 
 

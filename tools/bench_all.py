@@ -25,6 +25,10 @@ def main(argv=None):
     ap.add_argument("--parts", type=int, default=1)
     ap.add_argument("--delta", type=int, default=8,
                     help="bucket width for the weighted-SSSP delta row")
+    ap.add_argument("--routed", action="store_true",
+                    help="add routed-hot-loop rows (ops/expand.py plans, "
+                         "disk-cached) next to the direct rows for "
+                         "pagerank/sssp/components/colfilter")
     args = ap.parse_args(argv)
 
     import dataclasses
@@ -77,8 +81,24 @@ def main(argv=None):
     print(f"# graph: rmat{args.scale} nv={g.nv} ne={g.ne} "
           f"platform={jax.devices()[0].platform} parts={args.parts}")
 
-    pull_sh = device_pull(build_pull_shards(g, args.parts))
-    push_sh = device_push(build_push_shards(g, args.parts))
+    host_pull = build_pull_shards(g, args.parts)
+    host_push = build_push_shards(g, args.parts)
+    pr_route = push_route = None
+    if args.routed:
+        from lux_tpu.ops import expand
+
+        t0 = time.perf_counter()
+        pr_route = expand.plan_expand_shards_cached(host_pull)
+        # device-resident once, like the shard arrays (H2D must not land
+        # inside the timed region); the push layout embeds the SAME pull
+        # layout, so one plan serves both
+        pr_route = (pr_route[0], jax.tree.map(jnp.asarray, pr_route[1]))
+        jax.block_until_ready(pr_route[1])
+        push_route = pr_route
+        print(f"# routed plan ready in {time.perf_counter()-t0:.0f}s",
+              flush=True)
+    pull_sh = device_pull(host_pull)
+    push_sh = device_push(host_push)
 
     # warm with IDENTICAL args: num_iters is a static compile-cache key
     pr.pagerank(pull_sh, args.iters, args.parts)
@@ -90,13 +110,32 @@ def main(argv=None):
           flush=True)
     timed("pagerank", lambda: pr.pagerank(pull_sh, args.iters, args.parts),
           args.iters * g.ne, base)
+    if pr_route is not None:
+        pr.pagerank(pull_sh, args.iters, args.parts, route=pr_route)  # warm
+        timed("pagerank-routed",
+              lambda: pr.pagerank(pull_sh, args.iters, args.parts,
+                                  route=pr_route),
+              args.iters * g.ne, base)
     sssp.sssp(push_sh, start=0, num_parts=args.parts)  # warm
     timed("sssp", lambda: sssp.sssp(push_sh, start=0, num_parts=args.parts),
           g.ne, base)
+    if push_route is not None:
+        sssp.sssp(push_sh, start=0, num_parts=args.parts, route=push_route)
+        timed("sssp-routed",
+              lambda: sssp.sssp(push_sh, start=0, num_parts=args.parts,
+                                route=push_route),
+              g.ne, base)
     components.connected_components_push(push_sh, num_parts=args.parts)  # warm
     timed("components",
           lambda: components.connected_components_push(push_sh, num_parts=args.parts),
           g.ne, base)
+    if push_route is not None:
+        components.connected_components_push(push_sh, num_parts=args.parts,
+                                             route=push_route)
+        timed("components-routed",
+              lambda: components.connected_components_push(
+                  push_sh, num_parts=args.parts, route=push_route),
+              g.ne, base)
 
     # weighted SSSP: chaotic relaxation vs delta-stepping on the SAME
     # graph/layout — GTEPS over edges ACTUALLY traversed (the engines'
@@ -129,7 +168,15 @@ def main(argv=None):
         (1 << args.scale) // 2, (1 << args.scale) // 2,
         (1 << args.scale) * args.ef // 2, seed=0,
     )
-    cf_sh = device_pull(build_pull_shards(gw, args.parts))
+    host_cf = build_pull_shards(gw, args.parts)
+    cf_route = None
+    if args.routed:
+        from lux_tpu.ops import expand
+
+        cf_route = expand.plan_cf_route_shards_cached(host_cf)
+        cf_route = (cf_route[0], jax.tree.map(jnp.asarray, cf_route[1]))
+        jax.block_until_ready(cf_route[1])
+    cf_sh = device_pull(host_cf)
     cf.colfilter(cf_sh, args.iters, args.parts)  # warm (same static args)
     cf.colfilter(cf_sh, 0, args.parts)
     t0 = time.perf_counter()
@@ -137,6 +184,12 @@ def main(argv=None):
     cf_base = time.perf_counter() - t0  # CF state is (V, K): own baseline
     timed("colfilter", lambda: cf.colfilter(cf_sh, args.iters, args.parts),
           args.iters * gw.ne, cf_base)
+    if cf_route is not None:
+        cf.colfilter(cf_sh, args.iters, args.parts, route=cf_route)  # warm
+        timed("colfilter-routed",
+              lambda: cf.colfilter(cf_sh, args.iters, args.parts,
+                                   route=cf_route),
+              args.iters * gw.ne, cf_base)
 
     print("\n| app | raw s | net s | GTEPS |")
     print("|---|---|---|---|")

@@ -116,7 +116,7 @@ run app_p16_compact 1500 python -m lux_tpu.apps.pagerank \
 run scale_check 5400 python tools/tpu_scale_check.py --min-scale 18 --max-scale 24
 
 # 4) four-app table
-run bench_all 3600 python tools/bench_all.py --scale 18 --iters 10
+run bench_all 4500 python tools/bench_all.py --scale 18 --iters 10 --routed
 
 # 5) host-offload streaming on the real chip (capacity feature: edge
 #    arrays exceed the budget, streamed through HBM in chunks; the
