@@ -80,7 +80,8 @@ def _advanced_thr(prog, delta: int, c: DeltaCarry, n_in,
 
 
 def _delta_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
-                     delta: int, arrays, parrays, c: DeltaCarry
+                     delta: int, arrays, parrays, c: DeltaCarry,
+                     route_static=None, route_arrays=None, interpret=False
                      ) -> DeltaCarry:
     in_bucket = c.pending & (c.state < c.thr)
     n_in = jnp.sum(in_bucket.astype(jnp.int32))
@@ -103,6 +104,7 @@ def _delta_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
     new = push._push_relax(
         prog, pspec, spec, method, arrays, parrays, tmp,
         q_vids_all, q_vals_all, preps, use_dense,
+        route_static, route_arrays, interpret,
     )
     changed = (new != c.state) & arrays.vtx_mask
     # sparse rounds expand exactly the bucket; a dense round relaxes
@@ -118,15 +120,17 @@ def _delta_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
 
 @lru_cache(maxsize=64)
 def _compile_delta_loop(prog, pspec: PushSpec, spec: ShardSpec,
-                        method: str, delta: int):
+                        method: str, delta: int, route_static=None,
+                        interpret=False):
     @jax.jit
-    def loop(arrays, parrays, c0, max_iters):
+    def loop(arrays, parrays, c0, max_iters, route_arrays=None):
         def cond(c):
             return (c.active > 0) & (c.it < max_iters)
 
         def body(c):
             return _delta_iteration(
-                prog, pspec, spec, method, delta, arrays, parrays, c
+                prog, pspec, spec, method, delta, arrays, parrays, c,
+                route_static, route_arrays, interpret
             )
 
         return jax.lax.while_loop(cond, body, c0)
@@ -262,18 +266,32 @@ def run_push_delta(
     delta: int,
     max_iters: int = 100_000,
     method: str = "auto",
+    route=None,
 ):
     """Single-device delta-stepping driver (min-reduce programs).
     Returns (final stacked state, rounds run, edges [hi, lo]).  ``delta``
     is the bucket width in distance units; small Δ approaches Dijkstra
     (fewest edge relaxations, most rounds), large Δ approaches the
-    chaotic engine (fewest rounds, most edges)."""
+    chaotic engine (fewest rounds, most edges).  ``route`` (an expand
+    plan on the pull layout) routes the dense rounds' gather —
+    bitwise-identical."""
     _validate(prog, delta)
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     parrays = jax.tree.map(jnp.asarray, shards.parrays)
     c0 = _init_carry(prog, pspec, arrays, delta)
-    loop = _compile_delta_loop(prog, pspec, spec, method, delta)
-    out = loop(arrays, parrays, c0, jnp.int32(max_iters))
+    if route is None:
+        loop = _compile_delta_loop(prog, pspec, spec, method, delta)
+        out = loop(arrays, parrays, c0, jnp.int32(max_iters))
+    else:
+        from lux_tpu.engine.pull import _route_interpret
+
+        rs, ra = route
+        ra = jax.tree.map(jnp.asarray, ra)
+        loop = _compile_delta_loop(prog, pspec, spec, method, delta,
+                                   route_static=rs,
+                                   interpret=_route_interpret())
+        out = loop(arrays, parrays, c0, jnp.int32(max_iters),
+                   route_arrays=ra)
     return out.state, out.it, out.edges

@@ -196,10 +196,7 @@ def sssp(
             raise ValueError(
                 "delta-stepping is an allgather-exchange driver"
             )
-        if route is not None:
-            raise ValueError(
-                "delta-stepping does not take route= (its dense rounds "
-                "have their own driver)")
+
         # check the SHARDS' weights (covers pre-built PushShards too —
         # bucket order silently finalizes too early under negative
         # costs; padding slots are 0.0 so only real negatives trip)
@@ -207,13 +204,15 @@ def sssp(
             raise ValueError("delta-stepping needs non-negative weights")
         from lux_tpu.engine import delta as delta_mod
 
+        if route is not None and mesh is not None:
+            raise ValueError("route= delta-stepping is single-device")
         if mesh is not None:
             final, _, _ = delta_mod.run_push_delta_dist(
                 prog, shards, delta, mesh, max_iters, method=method
             )
         else:
             final, _, _ = delta_mod.run_push_delta(
-                prog, shards, delta, max_iters, method=method
+                prog, shards, delta, max_iters, method=method, route=route
             )
         return shards.scatter_to_global(np.asarray(final))
     return _push_run(

@@ -349,3 +349,25 @@ def test_cf_routed_distributed():
     out = dist.run_pull_fixed_dist(prog, shards.spec, shards.arrays, s0, 3,
                                    mesh, method="scan", route=route)
     np.testing.assert_array_equal(np.asarray(single), np.asarray(out))
+
+
+def test_delta_routed_bitwise():
+    """Delta-stepping with routed dense rounds: bitwise state, same
+    rounds, same exact edge counter."""
+    from lux_tpu.engine import delta as dmod, push
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.models.sssp import WeightedSSSPProgram
+
+    g = generate.rmat(9, 8, seed=4, weighted=True, max_weight=50)
+    outdeg = np.zeros(g.nv, np.int64)
+    np.add.at(outdeg, np.asarray(g.col_idx), 1)
+    prog = WeightedSSSPProgram(nv=g.nv, start=int(np.argmax(outdeg)))
+    shards = build_push_shards(g, 2)
+    st, it, ed = dmod.run_push_delta(prog, shards, 4, method="scan")
+    route = E.plan_expand_shards(shards)
+    st2, it2, ed2 = dmod.run_push_delta(prog, shards, 4, method="scan",
+                                        route=route)
+    np.testing.assert_array_equal(np.asarray(st), np.asarray(st2))
+    assert int(it) == int(it2)
+    assert push.edges_total(ed) == push.edges_total(ed2)
