@@ -286,7 +286,17 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
         )
     else:
         est = preflight.estimate_pull(shards.spec, state_width, sbytes)
-    return preflight.scale_residency(est, _residency(cfg))
+    est = preflight.scale_residency(est, _residency(cfg))
+    if getattr(cfg, "route_gather", ""):
+        # routed plans are static per-graph device arrays — a real HBM
+        # slice (~270 MB expand / ~630 MB fused at rmat20)
+        est = preflight.add_routed_bytes(
+            est,
+            preflight.routed_plan_bytes_analytic(
+                shards.spec, cfg.route_gather, wide=state_width > 1,
+            ) * _residency(cfg),
+        )
+    return est
 
 
 def report_preflight(est, cfg: RunConfig, shards, state_width: int = 1,

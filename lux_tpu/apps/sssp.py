@@ -277,6 +277,13 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
     else:
         est = preflight.estimate_push(shards.spec, shards.pspec)
     est = preflight.scale_residency(est, common._residency(cfg))
+    if getattr(cfg, "route_gather", ""):
+        # the dense rounds' routed plan is a real per-part HBM slice
+        est = preflight.add_routed_bytes(
+            est,
+            preflight.routed_plan_bytes_analytic(shards.spec, "expand")
+            * common._residency(cfg),
+        )
     print(est)
     preflight.check_fits(est)
     mesh = common.make_mesh_if(cfg)

@@ -153,7 +153,7 @@ def local_pull_step(
         acc = expand.apply_fused(
             full_state, route[0], route[1],
             edge_value=lambda s, w: prog.edge_value(s, w, None),
-            weighted=True, interpret=interpret)
+            interpret=interpret)
         return prog.apply(local_state, acc, arrays)
     if route is not None:
         gath = pull_gather_part_routed(arrays, full_state, local_state,

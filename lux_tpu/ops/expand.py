@@ -47,7 +47,7 @@ LANE = 128
 
 #: bump when plan_expand / freeze_plan output layout changes — salts the
 #: disk-cache key so stale pickles can never replay an incompatible plan
-PLAN_FORMAT = 2
+PLAN_FORMAT = 3
 
 
 def _idx8_enabled() -> bool:
@@ -322,6 +322,7 @@ class FusedStatic:
     v_pad: int          # accumulator slots (local part state size)
     nv_route: int       # pow2 routing space for the accumulator
     reduce: str         # "sum" | "min" | "max"
+    weighted: bool      # plan carries pre-routed f32 weights
     groups: tuple[tuple[int, int, int], ...]  # (offset, count, 2**k)
     r1: shuf.StaticRoute
     ff: FFStatic
@@ -445,8 +446,8 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     vrs, vra = shuf.freeze_plan(shuf.plan_route(vr))
     static = FusedStatic(
         n=n, n2=n2, state_size=state_size, v_pad=v_pad,
-        nv_route=nv_route, reduce=reduce, groups=tuple(groups),
-        r1=r1s, ff=ff_static, r2=r2s, vr=vrs,
+        nv_route=nv_route, reduce=reduce, weighted=weights is not None,
+        groups=tuple(groups), r1=r1s, ff=ff_static, r2=r2s, vr=vrs,
     )
     idx_groups = tuple(r1a) + tuple(ff_arrays) + tuple(r2a)
     if _idx8_enabled():
@@ -473,7 +474,7 @@ def split_fused_arrays(static: FusedStatic, arrays, weighted: bool):
 
 
 def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
-                weighted: bool = False, interpret: bool = False):
+                weighted: bool | None = None, interpret: bool = False):
     """Device replay of the fused routed pull for one part: full_state
     (state_size,) -> accumulator (v_pad,).
 
@@ -483,6 +484,8 @@ def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
     method-specific order, like mxsum's."""
     if full_state.ndim != 1:
         raise ValueError("fused routed pull supports 1-D state only")
+    if weighted is None:
+        weighted = static.weighted
     r1a, ffa, r2a, gmask, gweights, vra = split_fused_arrays(
         static, arrays, weighted)
     x = jnp.pad(full_state, (0, static.n - static.state_size))

@@ -62,6 +62,84 @@ def estimate_pull(spec: ShardSpec, state_width: int = 1,
     return MemoryEstimate(shard, state, gathered, shard + state + gathered)
 
 
+def routed_plan_bytes(static) -> int:
+    """Device-resident bytes of a routed plan's pass arrays
+    (ops/expand.py; uint8 indices by default — LUX_ROUTE_IDX8).  Add to
+    a MemoryEstimate's shard term when `route=` is in play: at rmat20
+    the expand plan is ~270 MB and the fused plan ~630 MB per part, a
+    real slice of one chip's HBM."""
+    from lux_tpu.ops.expand import (CFRouteStatic, FusedStatic,
+                                    _idx8_enabled)
+
+    idx = 1 if _idx8_enabled() else 4
+    if isinstance(static, CFRouteStatic):
+        return routed_plan_bytes(static.src) + routed_plan_bytes(static.dst)
+
+    def route_cost(r, space):
+        return len(r.passes) * space * idx
+
+    def ff_cost(ff):
+        return sum(lv.rows * 128 * (idx + (0 if lv.base else 1))
+                   for lv in ff.levels)
+
+    n = static.n
+    b = route_cost(static.r1, n) + ff_cost(static.ff)
+    if isinstance(static, FusedStatic):
+        b += route_cost(static.r2, static.n2)
+        b += static.n2  # group mask byte
+        if static.weighted:
+            b += static.n2 * 4  # pre-routed f32 weights
+        b += route_cost(static.vr, static.nv_route)
+    else:
+        b += route_cost(static.r2, n)
+    return b
+
+
+def add_routed_bytes(est: MemoryEstimate, extra: int) -> MemoryEstimate:
+    """MemoryEstimate with ``extra`` routed-plan bytes counted as shard
+    (static per-graph) bytes — the ONE place the arithmetic lives."""
+    return MemoryEstimate(
+        est.shard_bytes + extra, est.state_bytes, est.gathered_bytes,
+        est.total_bytes + extra,
+    )
+
+
+def add_routed(est: MemoryEstimate, static) -> MemoryEstimate:
+    """MemoryEstimate with a routed plan's arrays counted in."""
+    return add_routed_bytes(est, routed_plan_bytes(static))
+
+
+def routed_plan_bytes_analytic(spec: ShardSpec, mode: str = "expand",
+                               wide: bool = False) -> int:
+    """Routed-plan bytes from the shard GEOMETRY alone (no plan built):
+    the pass structure depends only on the padded sizes, so preflight
+    can charge the plan before the (minutes-long) construction runs.
+    ``wide`` doubles the expand term (colfilter routes src AND dst)."""
+    from lux_tpu.ops.expand import _idx8_enabled, _next_pow2
+    from lux_tpu.ops.route import factor_digits
+
+    idx = 1 if _idx8_enabled() else 4
+
+    def expand_cost(n):
+        k = len(factor_digits(n))
+        passes = 2 * (2 * k - 1)  # r1 + r2
+        ff = int(1.02 * n) * (idx + 1)  # lane idx + ext-mask byte
+        return passes * n * idx + ff
+
+    n = max(_next_pow2(spec.e_pad), _next_pow2(spec.gathered_size), 128)
+    b = expand_cost(n)
+    if wide:
+        b += expand_cost(max(_next_pow2(spec.e_pad),
+                             _next_pow2(spec.nv_pad), 128))
+    if mode == "fused":
+        # r2 moves to the ~2x group space and gains mask+weights; the
+        # accumulator route is small
+        n2 = 2 * n
+        k2 = len(factor_digits(n2))
+        b += (2 * k2 - 1) * n2 * idx + n2 * 5
+    return b
+
+
 def estimate_push(spec: ShardSpec, pspec: PushSpec,
                   state_dtype_bytes: int = 4) -> MemoryEstimate:
     base = estimate_pull(spec, 1, state_dtype_bytes)

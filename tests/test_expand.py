@@ -371,3 +371,32 @@ def test_delta_routed_bitwise():
     np.testing.assert_array_equal(np.asarray(st), np.asarray(st2))
     assert int(it) == int(it2)
     assert push.edges_total(ed) == push.edges_total(ed2)
+
+
+def test_preflight_routed_terms():
+    """Preflight charges the routed plan's device arrays: the exact and
+    analytic estimates agree with the built plan's actual bytes."""
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.utils import preflight
+
+    sh = build_pull_shards(generate.rmat(10, 8, seed=1), 1)
+    static, arrays = E.plan_expand_shards(sh)
+    actual = sum(a.nbytes for a in arrays)
+    assert preflight.routed_plan_bytes(static) == actual
+    analytic = preflight.routed_plan_bytes_analytic(sh.spec, "expand")
+    assert 0.8 * actual < analytic < 1.5 * actual
+    base = preflight.estimate_pull(sh.spec)
+    routed = preflight.add_routed(base, static)
+    assert routed.total_bytes == base.total_bytes + actual
+    # fused: exact match (weighted and unweighted) + analytic bound
+    fstatic, farrays = E.plan_fused_shards(sh, "sum")
+    factual = sum(a.nbytes for a in farrays)
+    assert preflight.routed_plan_bytes(fstatic) == factual
+    m = int(np.count_nonzero(sh.arrays.edge_mask[0]))
+    fs_unw, fa_unw = E.plan_fused(
+        np.asarray(sh.arrays.src_pos[0]), np.asarray(sh.arrays.dst_local[0]),
+        m, sh.spec.gathered_size, sh.arrays.row_ptr.shape[1] - 1, "sum")
+    assert preflight.routed_plan_bytes(fs_unw) == sum(a.nbytes for a in fa_unw)
+    fanalytic = preflight.routed_plan_bytes_analytic(sh.spec, "fused")
+    assert 0.7 * factual < fanalytic < 2.0 * factual
