@@ -228,9 +228,15 @@ def test_fuzz_all_pull_exchanges_agree(seed):
                            compact_gather=bool(rng.integers(2)))
     prog = pr.PageRankProgram(nv=sh.spec.nv)
     s0 = pull.init_state(prog, sh.arrays)
+    route = None
+    if rng.integers(2):  # randomize the routed-expand load too
+        from lux_tpu.ops import expand
+
+        route = expand.plan_expand_shards(sh)
     outs = {
         "allgather": sh.scatter_to_global(np.asarray(
-            dist.run_pull_fixed_dist(prog, sh.spec, sh.arrays, s0, iters, mesh)
+            dist.run_pull_fixed_dist(prog, sh.spec, sh.arrays, s0, iters,
+                                     mesh, route=route)
         )),
     }
     rs = ring.build_ring_shards(g, P, pull=sh)
