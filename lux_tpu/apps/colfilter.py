@@ -187,7 +187,7 @@ def main(argv=None):
         if (cfg.verbose or cfg.ckpt_every) and mesh is None:
             state, _ = common.run_pull_stepwise(
                 prog, shards.spec, arrays, state, start_it, cfg.num_iters,
-                cfg, g.nv, on_iter,
+                cfg, g.nv, on_iter, route=route,
             )
         elif mesh is None:
             state = pull.run_pull_fixed(

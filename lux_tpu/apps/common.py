@@ -70,6 +70,11 @@ def require_parts_fit_devices(cfg: RunConfig, what: str) -> None:
         )
 
 
+_ROUTE_VERBOSE_ERR = (
+    "-verbose 3-phase fencing is a direct-gather observability mode; "
+    "drop --route-gather or -verbose")
+
+
 def validate_exchange(cfg: RunConfig, prog) -> None:
     """Reject incompatible --exchange combinations BEFORE the O(ne) shard
     build, with a CLI-level message (not a deep driver assert).  Resolves
@@ -133,10 +138,13 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "with --edge-shards/--feat-shards/--method pallas/"
                 "--compact-gather/--stream-hbm-gib"
             )
-        if cfg.verbose or cfg.ckpt_every:
+        if cfg.verbose:
+            raise SystemExit(_ROUTE_VERBOSE_ERR)
+        if cfg.ckpt_every and cfg.distributed:
             raise SystemExit(
-                "--route-gather runs the fused on-device loop; "
-                "-verbose / checkpoint stepping are not wired yet"
+                "--route-gather with checkpointing is a single-device "
+                "stepping mode; the distributed chunked driver runs the "
+                "direct gather — drop one of the flags"
             )
     if cfg.feat_shards > 1:
         if getattr(prog, "k", 1) <= 1:
@@ -521,19 +529,24 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
 
 
 def run_pull_stepwise(prog, spec, arrays, state, start_it, num_iters, cfg,
-                      nv, on_iter=None):
+                      nv, on_iter=None, route=None):
     """Step-wise pull loop for -verbose / -ckpt-every runs.  Verbose mode
     fences each iteration into load/comp/update sub-steps (the reference's
     per-phase kernel timers, sssp_gpu.cu:513-518); otherwise the iteration
-    runs as one jitted step.  Returns (final_state, IterStats)."""
+    runs as one jitted step.  ``route`` applies to the fused-step path
+    only (the 3-phase verbose fence keeps the direct gather — its LOAD
+    boundary is the observability contract).  Returns
+    (final_state, IterStats)."""
     from lux_tpu.engine import pull
     from lux_tpu.utils.timing import IterStats, Timer
 
     stats = IterStats(verbose=cfg.verbose)
     if cfg.verbose:
+        if route is not None:
+            raise SystemExit(_ROUTE_VERBOSE_ERR)
         load, comp, update = pull.compile_pull_phases(prog, spec, cfg.method)
     else:
-        step = pull.compile_pull_step(prog, spec, cfg.method)
+        step = pull.compile_pull_step(prog, spec, cfg.method, route=route)
     for it in range(start_it, num_iters):
         if cfg.verbose:
             t = Timer()

@@ -189,18 +189,33 @@ def _pull_iteration(prog, spec: ShardSpec, method, arrays, state,
     )(arrays, state, route_arrays)
 
 
-def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "auto"):
+def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "auto",
+                      route=None):
     """Jitted SINGLE pull iteration over the whole shard stack (verbose
     mode / step-wise drivers).  The state buffer is donated — the ping-pong
     double buffer of the reference (dist_lr[2], core/graph.h:83) without
-    holding both copies."""
+    holding both copies.  ``route``: a routed-pull plan; its device-
+    placed arrays are bound as ordinary jit arguments (already-on-device
+    operands cost nothing per call — baking them in as closure constants
+    would bloat the lowered module instead)."""
     method = methods.resolve(method, prog.reduce)
+    rs, ra = route if route is not None else (None, None)
+    interpret = _route_interpret()
+    if ra is None:
+
+        @partial(jax.jit, donate_argnums=1)
+        def step(arrays, state):
+            return _pull_iteration(prog, spec, method, arrays, state)
+
+        return step
+    ra = jax.tree.map(jnp.asarray, ra)
 
     @partial(jax.jit, donate_argnums=1)
-    def step(arrays, state):
-        return _pull_iteration(prog, spec, method, arrays, state)
+    def routed_step(arrays, state, route_arrays):
+        return _pull_iteration(prog, spec, method, arrays, state,
+                               rs, route_arrays, interpret)
 
-    return step
+    return lambda arrays, state: routed_step(arrays, state, ra)
 
 
 def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "auto"):
