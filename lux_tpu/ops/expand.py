@@ -35,6 +35,10 @@ E scalar-issued gather slots.  At rmat20/ef16 that is ~5 ms vs ~117 ms.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import os
+import pickle
+import tempfile
 
 import jax
 import jax.numpy as jnp
@@ -55,8 +59,6 @@ def _idx8_enabled() -> bool:
     are digit-local (< 128), so int32 storage wastes 4x HBM read traffic
     per pass.  LUX_ROUTE_IDX8=0 falls back to int32 — the escape hatch
     if a chip's Mosaic lowering rejects the u8 gather operand."""
-    import os
-
     return os.environ.get("LUX_ROUTE_IDX8", "1") != "0"
 
 
@@ -552,51 +554,24 @@ def plan_cf_route_shards(shards):
     arrays = src-plan arrays + dst-plan arrays (split by the statics'
     pass counts)."""
     arrays = shards.arrays
-    p = arrays.src_pos.shape[0]
     v_pad = arrays.row_ptr.shape[1] - 1
-    statics, per_part = [], []
-    for i in range(p):
+
+    def plan_one(i):
         m = int(np.count_nonzero(arrays.edge_mask[i]))
         s_src, a_src = plan_expand(np.asarray(arrays.src_pos[i]), m,
                                    shards.spec.gathered_size)
         s_dst, a_dst = plan_expand(np.asarray(arrays.dst_local[i]), m,
                                    v_pad)
-        statics.append(CFRouteStatic(src=s_src, dst=s_dst))
-        per_part.append(tuple(a_src) + tuple(a_dst))
-    assert all(st == statics[0] for st in statics[1:])
-    stacked = tuple(
-        np.stack([per_part[i][j] for i in range(p)])
-        for j in range(len(per_part[0]))
-    )
-    return statics[0], stacked
+        return CFRouteStatic(src=s_src, dst=s_dst), tuple(a_src) + tuple(a_dst)
+
+    return _stack_parts(arrays.src_pos.shape[0], plan_one)
 
 
 def plan_cf_route_shards_cached(shards, cache_dir: str | None = None):
-    """plan_cf_route_shards with the shared disk cache (keyed on
-    src_pos + dst_local + edge_mask bytes and the gathered/local
-    sizes)."""
-    import hashlib
-    import os
-    import pickle
-
-    cache_dir = cache_dir or _default_cache_dir()
-    h = hashlib.sha1()
-    h.update(f"cf{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
-    h.update(np.ascontiguousarray(shards.arrays.src_pos).tobytes())
-    h.update(np.ascontiguousarray(shards.arrays.dst_local).tobytes())
-    h.update(np.ascontiguousarray(shards.arrays.edge_mask).tobytes())
-    h.update(str(shards.spec.gathered_size).encode())
-    path = os.path.join(cache_dir, f"cf_{h.hexdigest()[:16]}.pkl")
-    if os.path.exists(path):
-        with open(path, "rb") as f:
-            return pickle.load(f)
-    plan = plan_cf_route_shards(shards)
-    os.makedirs(cache_dir, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        pickle.dump(plan, f)
-    os.replace(tmp, path)
-    return plan
+    """plan_cf_route_shards with the shared disk cache."""
+    path = _cache_key_path("cf", shards,
+                           ("src_pos", "dst_local", "edge_mask"), cache_dir)
+    return _load_or_build(path, lambda: plan_cf_route_shards(shards))
 
 
 def apply_cf_route(full_state, local_state, static: CFRouteStatic, arrays,
@@ -624,58 +599,49 @@ def plan_fused_shards(shards, reduce: str = "sum"):
     them; the price is a few dummy group rows per part, masked to the
     reduce neutral."""
     arrays = shards.arrays
-    p = arrays.src_pos.shape[0]
     v_pad = arrays.row_ptr.shape[1] - 1
     template = _group_template(arrays)
-    statics, per_part = [], []
-    for i in range(p):
+
+    def plan_one(i):
         m = int(np.count_nonzero(arrays.edge_mask[i]))
-        st, a = plan_fused(
+        return plan_fused(
             np.asarray(arrays.src_pos[i]), np.asarray(arrays.dst_local[i]),
             m, shards.spec.gathered_size, v_pad, reduce,
             weights=np.asarray(arrays.weights[i]), template=template)
-        statics.append(st)
-        per_part.append(a)
-    assert all(st == statics[0] for st in statics[1:]),         "parts must share one FusedStatic (template bug)"
-    stacked = tuple(
-        np.stack([per_part[i][j] for i in range(p)])
-        for j in range(len(per_part[0]))
-    )
-    return statics[0], stacked
+
+    return _stack_parts(arrays.src_pos.shape[0], plan_one)
 
 
 def _default_cache_dir() -> str:
     """Per-user plan cache (a shared world-writable dir would unpickle
     other users' files and collide on permissions)."""
-    import os
-    import tempfile
-
     uid = os.getuid() if hasattr(os, "getuid") else "na"
     return os.path.join(tempfile.gettempdir(), f"lux_expand_plans_{uid}")
 
 
-def plan_fused_shards_cached(shards, reduce: str = "sum",
-                             cache_dir: str | None = None):
-    """plan_fused_shards with the same disk cache as the expand plans
-    (key extended with dst_local/weights bytes and the reduce op)."""
-    import hashlib
-    import os
-    import pickle
-
-    h = hashlib.sha1()
+def _cache_key_path(tag: str, shards, fields: tuple[str, ...],
+                    cache_dir: str | None) -> str:
+    """Disk-cache path for a plan: sha1 over the format/idx8 salt, the
+    named shard arrays' bytes, and the gathered size.  The (tag,
+    PLAN_FORMAT) pair IS the cache salt — renaming a tag invalidates
+    that plan family exactly like a format bump, so change either only
+    deliberately (and re-warm the benchmark-scale caches after)."""
     cache_dir = cache_dir or _default_cache_dir()
-    h.update(f"fused{PLAN_FORMAT}:{reduce}:idx8={_idx8_enabled()}".encode())
-    h.update(np.ascontiguousarray(shards.arrays.src_pos).tobytes())
-    h.update(np.ascontiguousarray(shards.arrays.dst_local).tobytes())
-    h.update(np.ascontiguousarray(shards.arrays.weights).tobytes())
-    h.update(np.ascontiguousarray(shards.arrays.edge_mask).tobytes())
+    h = hashlib.sha1()
+    h.update(f"{tag}{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
+    for f in fields:
+        h.update(np.ascontiguousarray(getattr(shards.arrays, f)).tobytes())
     h.update(str(shards.spec.gathered_size).encode())
-    path = os.path.join(cache_dir, f"fused_{h.hexdigest()[:16]}.pkl")
+    return os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.pkl")
+
+
+def _load_or_build(path: str, build):
+    """Atomic-rename pickle cache around a plan builder."""
     if os.path.exists(path):
         with open(path, "rb") as f:
             return pickle.load(f)
-    plan = plan_fused_shards(shards, reduce)
-    os.makedirs(cache_dir, exist_ok=True)
+    plan = build()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         pickle.dump(plan, f)
@@ -683,17 +649,32 @@ def plan_fused_shards_cached(shards, reduce: str = "sum",
     return plan
 
 
-def _expand_cache_path(shards, cache_dir: str | None = None) -> str:
-    import hashlib
-    import os
+def _stack_parts(num_parts: int, plan_one):
+    """Per-part plan loop shared by every *_shards planner: plan each
+    part, assert the statics agree (the vmapped/sharded engines rely on
+    one shared static), stack the arrays with a leading part axis."""
+    statics, per_part = [], []
+    for i in range(num_parts):
+        st, a = plan_one(i)
+        statics.append(st)
+        per_part.append(tuple(a))
+    assert all(st == statics[0] for st in statics[1:]), (
+        "parts must share one plan static")
+    stacked = tuple(
+        np.stack([per_part[i][j] for i in range(num_parts)])
+        for j in range(len(per_part[0]))
+    )
+    return statics[0], stacked
 
-    cache_dir = cache_dir or _default_cache_dir()
-    h = hashlib.sha1()
-    h.update(f"fmt{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
-    h.update(np.ascontiguousarray(shards.arrays.src_pos).tobytes())
-    h.update(np.ascontiguousarray(shards.arrays.edge_mask).tobytes())
-    h.update(str(shards.spec.gathered_size).encode())
-    return os.path.join(cache_dir, f"expand_{h.hexdigest()[:16]}.pkl")
+
+def plan_fused_shards_cached(shards, reduce: str = "sum",
+                             cache_dir: str | None = None):
+    """plan_fused_shards with the shared disk cache (the reduce op joins
+    the tag so min/max/sum plans never collide)."""
+    path = _cache_key_path(f"fused-{reduce}", shards,
+                           ("src_pos", "dst_local", "weights", "edge_mask"),
+                           cache_dir)
+    return _load_or_build(path, lambda: plan_fused_shards(shards, reduce))
 
 
 def has_cached_expand_plan(shards, cache_dir: str | None = None):
@@ -701,9 +682,8 @@ def has_cached_expand_plan(shards, cache_dir: str | None = None):
     disk load, else None — lets callers (bench default race) include the
     routed line only when it will not burn plan-construction time inside
     a TPU budget, and reuse the path without re-hashing the arrays."""
-    import os
-
-    path = _expand_cache_path(shards, cache_dir)
+    path = _cache_key_path("expand", shards, ("src_pos", "edge_mask"),
+                           cache_dir)
     return path if os.path.exists(path) else None
 
 
@@ -714,21 +694,9 @@ def plan_expand_shards_cached(shards, cache_dir: str | None = None,
     construction is ~90 s per part at 2^24 even with the native colorer
     (latency-bound Euler walk), so benchmark A/B reruns must not re-pay
     it; the per-iteration device replay never touches this path."""
-    import os
-    import pickle
-
-    cache_dir = cache_dir or _default_cache_dir()
-    path = cache_path or _expand_cache_path(shards, cache_dir)
-    if os.path.exists(path):
-        with open(path, "rb") as f:
-            return pickle.load(f)
-    plan = plan_expand_shards(shards)
-    os.makedirs(cache_dir, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        pickle.dump(plan, f)
-    os.replace(tmp, path)
-    return plan
+    path = cache_path or _cache_key_path("expand", shards,
+                                         ("src_pos", "edge_mask"), cache_dir)
+    return _load_or_build(path, lambda: plan_expand_shards(shards))
 
 
 def plan_expand_shards(shards):
@@ -740,18 +708,10 @@ def plan_expand_shards(shards):
     (same e_pad / gathered size → same dims), asserted here.
     """
     arrays = shards.arrays
-    p = arrays.src_pos.shape[0]
     state_size = shards.spec.gathered_size
-    statics, per_part = [], []
-    for i in range(p):
+
+    def plan_one(i):
         m = int(np.count_nonzero(arrays.edge_mask[i]))
-        s, a = plan_expand(np.asarray(arrays.src_pos[i]), m, state_size)
-        statics.append(s)
-        per_part.append(a)
-    assert all(s == statics[0] for s in statics[1:]), \
-        "parts must share one ExpandStatic"
-    stacked = tuple(
-        np.stack([per_part[i][j] for i in range(p)])
-        for j in range(len(per_part[0]))
-    )
-    return statics[0], stacked
+        return plan_expand(np.asarray(arrays.src_pos[i]), m, state_size)
+
+    return _stack_parts(arrays.src_pos.shape[0], plan_one)
