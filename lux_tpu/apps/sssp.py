@@ -207,15 +207,16 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
 
     cfg.method = methods.resolve(cfg.method, prog.reduce)
     if getattr(cfg, "route_gather", "") and (
-            cfg.distributed or cfg.ckpt_every or cfg.repartition_every
+            cfg.ckpt_every or cfg.repartition_every
             or cfg.verbose or cfg.method == "pallas"
-            or cfg.exchange != "allgather" or cfg.compact_gather):
+            or cfg.exchange != "allgather" or cfg.compact_gather
+            or (cfg.distributed and getattr(cfg, "delta", 0))):
         raise SystemExit(
-            "--route-gather on push apps routes the single-device dense "
-            "rounds (allgather layout; composes with --delta); it cannot "
-            "combine with --distributed/checkpointing/"
-            "--repartition-every/-verbose/--method pallas/"
-            "--compact-gather"
+            "--route-gather on push apps routes the allgather dense "
+            "rounds (single-device or --distributed; composes with "
+            "single-device --delta); it cannot combine with "
+            "checkpointing/--repartition-every/-verbose/"
+            "--method pallas/--compact-gather"
         )
     if cfg.method in ("cumsum", "mxsum"):
         raise SystemExit(
@@ -292,10 +293,10 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
 
     ckpt_compute = None
     with profiling.trace(cfg.profile_dir):
-        # ONE plan computation for every single-device routed branch
-        # (plain push AND delta) — built outside the timed region
+        # ONE plan computation for every routed branch (plain push,
+        # delta, distributed) — built outside the timed region
         route = None
-        if getattr(cfg, "route_gather", "") and mesh is None:
+        if getattr(cfg, "route_gather", ""):
             from lux_tpu.ops import expand
 
             route = expand.plan_expand_shards_cached(shards)
@@ -413,7 +414,7 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
             )
         else:
             state, iters, edges = push.run_push_dist(
-                prog, shards, mesh, cfg.max_iters, cfg.method
+                prog, shards, mesh, cfg.max_iters, cfg.method, route=route
             )
         elapsed = timer.stop(state)
     if ckpt_compute is not None:

@@ -635,12 +635,20 @@ def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
     return _spmd_push_requeue(prog, pspec, spec, qarr_blk, c, new, plan)
 
 
-def _allgather_dense_fn(prog, arr_blk, method):
+def _allgather_dense_fn(prog, arr_blk, method, route_static=None,
+                        route_blk=None, interpret=False):
     """Dense relaxation for the all-gather engines: whole state over ICI,
-    then the segmented reduce over each resident part's in-edges."""
+    then the segmented reduce over each resident part's in-edges
+    (optionally through the routed-shuffle expand — bitwise)."""
 
     def dense_fn(block):
         full = flatten_gather(block)
+        if route_static is not None:
+            return jax.vmap(
+                lambda arr, loc, ra: dense_part_step(
+                    prog, arr, full, loc, method,
+                    route=(route_static, ra), interpret=interpret)
+            )(arr_blk, block, route_blk)
         return jax.vmap(
             lambda arr, loc: dense_part_step(prog, arr, full, loc, method)
         )(arr_blk, block)
@@ -650,19 +658,27 @@ def _allgather_dense_fn(prog, arr_blk, method):
 
 @lru_cache(maxsize=64)
 def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
-                       method: str):
+                       method: str, route_static=None,
+                       interpret: bool = False):
     arr_specs = ShardArrays(*([P(PARTS_AXIS)] * len(ShardArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
     carry_specs = _carry_specs()
+    routed = route_static is not None
+    in_specs = (arr_specs, parr_specs, carry_specs, P())
+    kw = {}
+    if routed:
+        in_specs = in_specs + (P(PARTS_AXIS),)
+        kw["check_vma"] = False  # pallas under shard_map (see dist.py)
 
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(arr_specs, parr_specs, carry_specs, P()),
+        in_specs=in_specs,
         out_specs=carry_specs,
+        **kw,
     )
-    def run(arr_blk, parr_blk, carry_blk, it_stop):
+    def run(arr_blk, parr_blk, carry_blk, it_stop, *route_blk):
 
         def cond(c):
             return (c.active > 0) & (c.it < it_stop)
@@ -670,7 +686,10 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
         def body(c):
             return _spmd_push_iter(
                 prog, pspec, spec, parr_blk, arr_blk,
-                _allgather_dense_fn(prog, arr_blk, method), c,
+                _allgather_dense_fn(
+                    prog, arr_blk, method, route_static,
+                    route_blk[0] if routed else None, interpret),
+                c,
             )
 
         return jax.lax.while_loop(cond, body, carry_blk)
@@ -926,13 +945,27 @@ def run_push_dist(
     mesh: Mesh,
     max_iters: int = 10_000,
     method: str = "auto",
+    route=None,
 ):
     """Distributed driver: queues (sparse rounds) or whole state (dense
-    rounds) exchanged over ICI inside the on-device loop."""
+    rounds) exchanged over ICI inside the on-device loop.  ``route``
+    (an expand plan on the pull layout) replays the dense rounds'
+    gather as routed shuffles — bitwise-identical."""
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts % mesh.devices.size == 0
     arrays, parrays, carry0 = push_init_dist(prog, shards, mesh)
-    run = _compile_push_dist(prog, mesh, pspec, spec, method)
-    out = run(arrays, parrays, carry0, jnp.int32(max_iters))
+    if route is None:
+        run = _compile_push_dist(prog, mesh, pspec, spec, method)
+        out = run(arrays, parrays, carry0, jnp.int32(max_iters))
+    else:
+        from lux_tpu.engine.pull import _route_interpret
+        from lux_tpu.parallel.mesh import shard_stacked
+
+        rs, ra = route
+        ra = shard_stacked(mesh, jax.tree.map(jnp.asarray, ra))
+        run = _compile_push_dist(prog, mesh, pspec, spec, method,
+                                 route_static=rs,
+                                 interpret=_route_interpret())
+        out = run(arrays, parrays, carry0, jnp.int32(max_iters), ra)
     return out.state, out.it, out.edges

@@ -400,3 +400,25 @@ def test_preflight_routed_terms():
     assert preflight.routed_plan_bytes(fs_unw) == sum(a.nbytes for a in fa_unw)
     fanalytic = preflight.routed_plan_bytes_analytic(sh.spec, "fused")
     assert 0.7 * factual < fanalytic < 2.0 * factual
+
+
+def test_push_dist_routed_bitwise():
+    """Routed dense rounds in the DISTRIBUTED push engine (virtual
+    8-mesh): bitwise state, same rounds, same exact edge counters."""
+    from lux_tpu.engine import push
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.models.components import MaxLabelProgram
+    from lux_tpu.parallel.mesh import make_mesh
+
+    g = generate.rmat(9, 8, seed=6)
+    shards = build_push_shards(g, 8)
+    prog = MaxLabelProgram()
+    mesh = make_mesh(8)
+    st, it, ed = push.run_push_dist(prog, shards, mesh, method="scan")
+    route = E.plan_expand_shards(shards)
+    st2, it2, ed2 = push.run_push_dist(prog, shards, mesh, method="scan",
+                                       route=route)
+    np.testing.assert_array_equal(np.asarray(st), np.asarray(st2))
+    assert int(it) == int(it2)
+    assert push.edges_total(ed) == push.edges_total(ed2)
