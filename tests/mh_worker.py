@@ -260,6 +260,30 @@ want = pagerank_reference(g, 5)
 check_local(out, shards.cuts, mine, want, close)
 print(f"process {pid}: multihost pagerank OK over {P} devices / {nproc} procs", flush=True)
 
+# --- routed expand across the REAL process boundary: the Benes
+# lane-shuffle LOAD phase (ops/expand.py) under the same two-process
+# mesh, bitwise-equal to the direct distributed result shard by shard
+from lux_tpu.ops import expand as _expand
+
+r_static, r_arrays = _expand.plan_expand_shards(shards)
+r_local = tuple(a[mine] for a in r_arrays)
+r_dev = jax.tree.map(lambda a: mh.assemble_global(mesh, a, P), r_local)
+r_out = dist.run_pull_fixed_dist(
+    prog, shards.spec, arrays, state0, 5, mesh, route=(r_static, r_dev)
+)
+
+
+def _local_shards(x):
+    return {tuple(map(str, sh.index)): np.asarray(sh.data)
+            for sh in x.addressable_shards}
+
+
+ld, lr = _local_shards(out), _local_shards(r_out)
+assert ld.keys() == lr.keys()
+for key in ld:
+    np.testing.assert_array_equal(ld[key], lr[key])
+print(f"process {pid}: multihost ROUTED pagerank bitwise OK", flush=True)
+
 # --- bucket exchanges (ring, reduce_scatter) with PER-HOST SUBSET
 # builds: each process materializes only its parts' bucket rows (the
 # RMAT27 load plan, SURVEY.md §7.3); assemble_global stitches the
