@@ -128,14 +128,18 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "colfilter's wide dst-dependent load routes with "
                 "--route-gather expand (per-column src + dst plans)"
             )
-        if (cfg.exchange != "allgather"
+        ring_ok = (cfg.exchange == "ring"
+                   and cfg.route_gather == "expand"
+                   and getattr(prog, "k", 1) == 1)
+        if ((cfg.exchange != "allgather" and not ring_ok)
                 or cfg.edge_shards > 1 or cfg.feat_shards > 1
                 or cfg.method == "pallas" or cfg.compact_gather
                 or cfg.stream_hbm_gib):
             raise SystemExit(
                 "--route-gather binds to the allgather pull layout "
-                "(plans are built from its src_pos); it cannot combine "
-                "with --edge-shards/--feat-shards/--method pallas/"
+                "(or, for scalar-state pull apps, the ring buckets via "
+                "per-bucket plans); it cannot combine with --exchange "
+                "scatter/--edge-shards/--feat-shards/--method pallas/"
                 "--compact-gather/--stream-hbm-gib"
             )
         if cfg.verbose:
@@ -500,8 +504,14 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
     if cfg.exchange == "ring":
         from lux_tpu.parallel import ring
 
+        ring_route = None
+        if getattr(cfg, "route_gather", "") == "expand":
+            from lux_tpu.ops import expand
+
+            ring_route = expand.plan_ring_route_shards_cached(shards)
         return ring.run_pull_fixed_ring(
-            prog, shards, state, num_iters, mesh, cfg.method
+            prog, shards, state, num_iters, mesh, cfg.method,
+            route=ring_route,
         )
     if cfg.exchange == "scatter":
         from lux_tpu.parallel import scatter

@@ -592,6 +592,42 @@ def apply_cf_route(full_state, local_state, static: CFRouteStatic, arrays,
     return src, dst
 
 
+def plan_ring_route_shards(rshards):
+    """(ExpandStatic, (P, P_src, ...) stacked arrays) for the RING
+    exchange: one expand plan per (resident part, streamed source part)
+    bucket — src_local gathers a (V,)-sized streamed block with bucket-
+    local indices, real edges prefix-packed (pads hold the V sentinel in
+    dst_local).  Uniform e_bucket_pad/V make every (i, q) static
+    identical, so the ring fold dynamic-indexes the plan slice by the
+    traced round part id."""
+    ra = rshards.rarrays
+    v_pad = rshards.pull.spec.nv_pad
+    num_r, num_src = ra.src_local.shape[:2]
+
+    def plan_one(flat):
+        i, q = divmod(flat, num_src)
+        m = int(np.count_nonzero(ra.dst_local[i, q] < v_pad))
+        return plan_expand(np.asarray(ra.src_local[i, q]), m, v_pad)
+
+    static, flat_stacked = _stack_parts(num_r * num_src, plan_one)
+    stacked = tuple(a.reshape((num_r, num_src) + a.shape[1:])
+                    for a in flat_stacked)
+    return static, stacked
+
+
+def plan_ring_route_shards_cached(rshards, cache_dir: str | None = None):
+    """plan_ring_route_shards with the shared disk cache (keyed on the
+    bucket arrays' bytes + the block size)."""
+    cache_dir = cache_dir or _default_cache_dir()
+    h = hashlib.sha1()
+    h.update(f"ring{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
+    h.update(np.ascontiguousarray(rshards.rarrays.src_local).tobytes())
+    h.update(np.ascontiguousarray(rshards.rarrays.dst_local).tobytes())
+    h.update(str(rshards.pull.spec.nv_pad).encode())
+    path = os.path.join(cache_dir, f"ring_{h.hexdigest()[:16]}.pkl")
+    return _load_or_build(path, lambda: plan_ring_route_shards(rshards))
+
+
 def plan_fused_shards(shards, reduce: str = "sum"):
     """plan_fused for a PullShards bundle.  Parts share one group
     TEMPLATE (max segment count per width class across parts), so all

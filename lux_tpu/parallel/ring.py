@@ -257,23 +257,32 @@ def neutral_like(local, reduce):
 
 
 @lru_cache(maxsize=64)
-def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int, method: str):
+def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int,
+                        method: str, route_static=None,
+                        interpret: bool = False):
     D = mesh.devices.size
     k = num_parts // D
+    routed = route_static is not None
+    in_specs = (
+        RingArrays(*([P(PARTS_AXIS)] * len(RingArrays._fields))),
+        P(PARTS_AXIS),  # vtx_mask
+        P(PARTS_AXIS),  # degree
+        P(PARTS_AXIS),  # state
+    )
+    kw = {}
+    if routed:
+        in_specs = in_specs + (P(PARTS_AXIS),)  # (P, P_src, ...) plans
+        kw["check_vma"] = False  # pallas under shard_map (see dist.py)
 
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            RingArrays(*([P(PARTS_AXIS)] * len(RingArrays._fields))),
-            P(PARTS_AXIS),  # vtx_mask
-            P(PARTS_AXIS),  # degree
-            P(PARTS_AXIS),  # state
-        ),
+        in_specs=in_specs,
         out_specs=P(PARTS_AXIS),
+        **kw,
     )
-    def run(rarr_blk, vtx_mask_blk, degree_blk, state_blk):
+    def run(rarr_blk, vtx_mask_blk, degree_blk, state_blk, *route_blk):
         # k = P/D resident parts per device (k == 1 when parts == devices);
         # the ring circulates (k, V, ...) blocks over the D devices, and
         # each arriving block's k streamed lanes fold into every resident
@@ -288,13 +297,25 @@ def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int, method: str)
                 for j in range(k):
                     q = dev * k + j  # global part id of streamed lane j
 
-                    def one(rarr_i, local_i, acc_i, q=q):
+                    def one(rarr_i, local_i, acc_i, ra_i=None, q=q):
                         dst_state = local_i[
                             jnp.clip(rarr_i.dst_local[q], 0, V - 1)
                         ]
+                        if ra_i is not None:
+                            # bucket-local routed expand of the streamed
+                            # block (ops/expand.py) — bitwise vs the
+                            # flat gather; q is traced, so the (i, q)
+                            # plan slice is a dynamic leading-axis index
+                            from lux_tpu.ops import expand as _expand
+
+                            src_vals = _expand.apply_expand(
+                                stream[j], route_static,
+                                jax.tree.map(lambda a: a[q], ra_i),
+                                interpret=interpret)
+                        else:
+                            src_vals = stream[j][rarr_i.src_local[q]]
                         vals = prog.edge_value(
-                            stream[j][rarr_i.src_local[q]],
-                            rarr_i.weights[q], dst_state,
+                            src_vals, rarr_i.weights[q], dst_state,
                         )
                         part = segment.segment_reduce_by_ends(
                             vals, rarr_i.head_flag[q], rarr_i.dst_local[q],
@@ -302,7 +323,11 @@ def _compile_ring_fixed(prog, mesh, num_parts: int, num_iters: int, method: str)
                         )
                         return _FOLD[prog.reduce](acc_i, part)
 
-                    acc = jax.vmap(one)(rarr_blk, block, acc)
+                    if routed:
+                        acc = jax.vmap(one)(rarr_blk, block, acc,
+                                            route_blk[0])
+                    else:
+                        acc = jax.vmap(one)(rarr_blk, block, acc)
                 return acc
 
             acc = ring_sweep(block, neutral_like(block, prog.reduce), fold, D)
@@ -388,10 +413,13 @@ def run_pull_fixed_ring(
     num_iters: int,
     mesh: Mesh,
     method: str = "auto",
+    route=None,
 ):
     """Distributed fixed-iteration pull with ring-streamed state blocks.
     Signature-compatible with dist.run_pull_fixed_dist: pass the stacked
-    (P, V, ...) initial state (e.g. from engine.pull.init_state)."""
+    (P, V, ...) initial state (e.g. from engine.pull.init_state).
+    ``route`` (plan_ring_route_shards) replays each bucket's streamed-
+    block gather as routed lane shuffles — bitwise-identical."""
     from lux_tpu.engine import methods
 
     method = methods.resolve(method, prog.reduce)
@@ -408,5 +436,15 @@ def run_pull_fixed_ring(
     vtx_mask = shard_stacked(mesh, jnp.asarray(shards.arrays.vtx_mask))
     degree = shard_stacked(mesh, jnp.asarray(shards.arrays.degree))
     state0 = shard_stacked(mesh, state0)
-    run = _compile_ring_fixed(prog, mesh, spec.num_parts, num_iters, method)
-    return run(rarrays, vtx_mask, degree, state0)
+    if route is None:
+        run = _compile_ring_fixed(prog, mesh, spec.num_parts, num_iters,
+                                  method)
+        return run(rarrays, vtx_mask, degree, state0)
+    from lux_tpu.engine.pull import _route_interpret
+
+    rs, ra = route
+    ra = shard_stacked(mesh, jax.tree.map(jnp.asarray, ra))
+    run = _compile_ring_fixed(prog, mesh, spec.num_parts, num_iters,
+                              method, route_static=rs,
+                              interpret=_route_interpret())
+    return run(rarrays, vtx_mask, degree, state0, ra)

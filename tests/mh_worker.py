@@ -265,8 +265,21 @@ print(f"process {pid}: multihost pagerank OK over {P} devices / {nproc} procs", 
 # mesh, bitwise-equal to the direct distributed result shard by shard
 from lux_tpu.ops import expand as _expand
 
-r_static, r_arrays = _expand.plan_expand_shards(shards)
-r_local = tuple(a[mine] for a in r_arrays)
+# plan ONLY this process's parts (per-host O(local parts) work, like
+# the sharded file load above); statics are size-derived so the two
+# processes' statics agree without coordination
+_r_plans = [
+    _expand.plan_expand(np.asarray(shards.arrays.src_pos[i]),
+                        int(np.count_nonzero(shards.arrays.edge_mask[i])),
+                        shards.spec.gathered_size)
+    for i in mine
+]
+r_static = _r_plans[0][0]
+assert all(st == r_static for st, _ in _r_plans[1:])
+r_local = tuple(
+    np.stack([_r_plans[j][1][a] for j in range(len(mine))])
+    for a in range(len(_r_plans[0][1]))
+)
 r_dev = jax.tree.map(lambda a: mh.assemble_global(mesh, a, P), r_local)
 r_out = dist.run_pull_fixed_dist(
     prog, shards.spec, arrays, state0, 5, mesh, route=(r_static, r_dev)

@@ -219,10 +219,15 @@ def test_cli_route_gather():
             base + ["--route-gather", *mode, "--distributed", "-ng", "2"],
             capture_output=True, text=True, env=env, timeout=300)
         assert ok_dist.returncode == 0, ok_dist.stdout + ok_dist.stderr
-    # the bucket exchanges ship their own slices — routed must reject
-    bad = subprocess.run(
+    # ring now routes via per-bucket plans; scatter still rejects
+    ok_ring = subprocess.run(
         base + ["--route-gather", "--distributed", "-ng", "2",
                 "--exchange", "ring"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert ok_ring.returncode == 0, ok_ring.stdout + ok_ring.stderr
+    bad = subprocess.run(
+        base + ["--route-gather", "--distributed", "-ng", "2",
+                "--exchange", "scatter"],
         capture_output=True, text=True, env=env, timeout=300)
     assert bad.returncode != 0
 
@@ -422,3 +427,24 @@ def test_push_dist_routed_bitwise():
     np.testing.assert_array_equal(np.asarray(st), np.asarray(st2))
     assert int(it) == int(it2)
     assert push.edges_total(ed) == push.edges_total(ed2)
+
+
+def test_ring_routed_bitwise():
+    """Routed per-bucket expands in the RING exchange: bitwise vs the
+    direct ring fold on the virtual 8-mesh."""
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.parallel import ring
+    from lux_tpu.parallel.mesh import make_mesh
+    from lux_tpu.models.pagerank import PageRankProgram
+
+    g = generate.rmat(9, 8, seed=15)
+    rs = ring.build_ring_shards(g, 8)
+    prog = PageRankProgram(nv=rs.spec.nv)
+    s0 = pull.init_state(prog, rs.arrays)
+    mesh = make_mesh(8)
+    direct = ring.run_pull_fixed_ring(prog, rs, s0, 4, mesh, method="scan")
+    route = E.plan_ring_route_shards(rs)
+    routed = ring.run_pull_fixed_ring(prog, rs, s0, 4, mesh, method="scan",
+                                      route=route)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
