@@ -128,18 +128,18 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "colfilter's wide dst-dependent load routes with "
                 "--route-gather expand (per-column src + dst plans)"
             )
-        ring_ok = (cfg.exchange == "ring"
-                   and cfg.route_gather == "expand"
-                   and getattr(prog, "k", 1) == 1)
-        if ((cfg.exchange != "allgather" and not ring_ok)
+        bucket_ok = (cfg.exchange in ("ring", "scatter")
+                     and cfg.route_gather == "expand"
+                     and getattr(prog, "k", 1) == 1)
+        if ((cfg.exchange != "allgather" and not bucket_ok)
                 or cfg.edge_shards > 1 or cfg.feat_shards > 1
                 or cfg.method == "pallas" or cfg.compact_gather
                 or cfg.stream_hbm_gib):
             raise SystemExit(
                 "--route-gather binds to the allgather pull layout "
-                "(or, for scalar-state pull apps, the ring buckets via "
-                "per-bucket plans); it cannot combine with --exchange "
-                "scatter/--edge-shards/--feat-shards/--method pallas/"
+                "(or, for scalar-state pull apps, the ring/scatter "
+                "buckets via per-bucket plans); it cannot combine with "
+                "--edge-shards/--feat-shards/--method pallas/"
                 "--compact-gather/--stream-hbm-gib"
             )
         if cfg.verbose:
@@ -301,13 +301,17 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
     est = preflight.scale_residency(est, _residency(cfg))
     if getattr(cfg, "route_gather", ""):
         # routed plans are static per-graph device arrays — a real HBM
-        # slice (~270 MB expand / ~630 MB fused at rmat20)
-        est = preflight.add_routed_bytes(
-            est,
-            preflight.routed_plan_bytes_analytic(
-                shards.spec, cfg.route_gather, wide=state_width > 1,
-            ) * _residency(cfg),
-        )
+        # slice (~270 MB expand / ~630 MB fused at rmat20).  Bucketed
+        # exchanges carry P per-peer plans per resident part, a
+        # different (usually larger) geometry than the allgather plan.
+        if cfg.exchange in ("ring", "scatter"):
+            extra = preflight.routed_bucket_plan_bytes_analytic(
+                shards.spec.num_parts, shards.e_bucket_pad,
+                shards.spec.nv_pad)
+        else:
+            extra = preflight.routed_plan_bytes_analytic(
+                shards.spec, cfg.route_gather, wide=state_width > 1)
+        est = preflight.add_routed_bytes(est, extra * _residency(cfg))
     return est
 
 
@@ -516,8 +520,14 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
     if cfg.exchange == "scatter":
         from lux_tpu.parallel import scatter
 
+        sc_route = None
+        if getattr(cfg, "route_gather", "") == "expand":
+            from lux_tpu.ops import expand
+
+            sc_route = expand.plan_scatter_route_shards_cached(shards)
         return scatter.run_pull_fixed_scatter(
-            prog, shards, state, num_iters, mesh, cfg.method
+            prog, shards, state, num_iters, mesh, cfg.method,
+            route=sc_route,
         )
     from lux_tpu.parallel import dist
 

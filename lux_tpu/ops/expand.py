@@ -600,14 +600,21 @@ def plan_ring_route_shards(rshards):
     dst_local).  Uniform e_bucket_pad/V make every (i, q) static
     identical, so the ring fold dynamic-indexes the plan slice by the
     traced round part id."""
-    ra = rshards.rarrays
-    v_pad = rshards.pull.spec.nv_pad
-    num_r, num_src = ra.src_local.shape[:2]
+    return _plan_bucket_routes(rshards.rarrays.src_local,
+                               rshards.rarrays.dst_local,
+                               rshards.pull.spec.nv_pad)
+
+
+def _plan_bucket_routes(src_local, dst_local, v_pad: int):
+    """Shared (R, P, B) bucket planner for the ring AND reduce_scatter
+    exchanges (identical layout conventions: block-local src indices,
+    real edges prefix-packed, dst pads hold the V sentinel)."""
+    num_r, num_src = src_local.shape[:2]
 
     def plan_one(flat):
         i, q = divmod(flat, num_src)
-        m = int(np.count_nonzero(ra.dst_local[i, q] < v_pad))
-        return plan_expand(np.asarray(ra.src_local[i, q]), m, v_pad)
+        m = int(np.count_nonzero(dst_local[i, q] < v_pad))
+        return plan_expand(np.asarray(src_local[i, q]), m, v_pad)
 
     static, flat_stacked = _stack_parts(num_r * num_src, plan_one)
     stacked = tuple(a.reshape((num_r, num_src) + a.shape[1:])
@@ -615,17 +622,42 @@ def plan_ring_route_shards(rshards):
     return static, stacked
 
 
-def plan_ring_route_shards_cached(rshards, cache_dir: str | None = None):
-    """plan_ring_route_shards with the shared disk cache (keyed on the
-    bucket arrays' bytes + the block size)."""
+def plan_scatter_route_shards(sshards):
+    """Bucket plans for the reduce_scatter exchange: bucket (i, p)
+    gathers MY resident source block i for destination part p — the
+    indexing transpose of the ring's, same machinery (and the same
+    SCALE NOTE as plan_ring_route_shards)."""
+    return _plan_bucket_routes(sshards.sarrays.src_local,
+                               sshards.sarrays.dst_local,
+                               sshards.pull.spec.nv_pad)
+
+
+def _bucket_route_cached(tag: str, src_local, dst_local, v_pad: int,
+                         build, cache_dir: str | None = None):
     cache_dir = cache_dir or _default_cache_dir()
     h = hashlib.sha1()
-    h.update(f"ring{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
-    h.update(np.ascontiguousarray(rshards.rarrays.src_local).tobytes())
-    h.update(np.ascontiguousarray(rshards.rarrays.dst_local).tobytes())
-    h.update(str(rshards.pull.spec.nv_pad).encode())
-    path = os.path.join(cache_dir, f"ring_{h.hexdigest()[:16]}.pkl")
-    return _load_or_build(path, lambda: plan_ring_route_shards(rshards))
+    h.update(f"{tag}{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
+    h.update(np.ascontiguousarray(src_local).tobytes())
+    h.update(np.ascontiguousarray(dst_local).tobytes())
+    h.update(str(v_pad).encode())
+    path = os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.pkl")
+    return _load_or_build(path, build)
+
+
+def plan_ring_route_shards_cached(rshards, cache_dir: str | None = None):
+    """plan_ring_route_shards with the shared disk cache."""
+    return _bucket_route_cached(
+        "ring", rshards.rarrays.src_local, rshards.rarrays.dst_local,
+        rshards.pull.spec.nv_pad,
+        lambda: plan_ring_route_shards(rshards), cache_dir)
+
+
+def plan_scatter_route_shards_cached(sshards, cache_dir: str | None = None):
+    """plan_scatter_route_shards with the shared disk cache."""
+    return _bucket_route_cached(
+        "rscat", sshards.sarrays.src_local, sshards.sarrays.dst_local,
+        sshards.pull.spec.nv_pad,
+        lambda: plan_scatter_route_shards(sshards), cache_dir)
 
 
 def plan_fused_shards(shards, reduce: str = "sum"):

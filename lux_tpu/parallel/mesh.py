@@ -81,3 +81,17 @@ def flatten_gather(block):
     device order, so the flattened axis is in global part order."""
     full = jax.lax.all_gather(block, PARTS_AXIS, tiled=True)
     return full.reshape((-1,) + full.shape[2:])
+
+
+def routed_run_args(mesh, route):
+    """Shared tail for routed exchange drivers: device-shard the plan
+    arrays over the parts axis and resolve interpret mode.  Returns
+    (route_static, sharded_arrays, interpret)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine.pull import _route_interpret
+
+    rs, ra = route
+    ra = shard_stacked(mesh, jax.tree.map(jnp.asarray, ra))
+    return rs, ra, _route_interpret()

@@ -440,11 +440,9 @@ def run_pull_fixed_ring(
         run = _compile_ring_fixed(prog, mesh, spec.num_parts, num_iters,
                                   method)
         return run(rarrays, vtx_mask, degree, state0)
-    from lux_tpu.engine.pull import _route_interpret
+    from lux_tpu.parallel.mesh import routed_run_args
 
-    rs, ra = route
-    ra = shard_stacked(mesh, jax.tree.map(jnp.asarray, ra))
+    rs, ra, interp = routed_run_args(mesh, route)
     run = _compile_ring_fixed(prog, mesh, spec.num_parts, num_iters,
-                              method, route_static=rs,
-                              interpret=_route_interpret())
+                              method, route_static=rs, interpret=interp)
     return run(rarrays, vtx_mask, degree, state0, ra)

@@ -159,7 +159,8 @@ def build_scatter_shards(
 
 @lru_cache(maxsize=64)
 def _compile_scatter_fixed(prog, mesh, num_parts: int, num_iters: int,
-                           method: str):
+                           method: str, route_static=None,
+                           interpret: bool = False):
     assert prog.reduce == "sum", (
         "reduce_scatter exchange requires a sum-reducible program; "
         "use the ring or all_gather drivers for min/max"
@@ -169,19 +170,27 @@ def _compile_scatter_fixed(prog, mesh, num_parts: int, num_iters: int,
         "pre-combined reduce_scatter cannot supply it — use ring/all_gather"
     )
 
+    routed = route_static is not None
+    in_specs = (
+        ScatterArrays(*([P(PARTS_AXIS)] * len(ScatterArrays._fields))),
+        P(PARTS_AXIS),  # vtx_mask
+        P(PARTS_AXIS),  # degree
+        P(PARTS_AXIS),  # state
+    )
+    kw = {}
+    if routed:
+        in_specs = in_specs + (P(PARTS_AXIS),)  # (P, P_dst, ...) plans
+        kw["check_vma"] = False  # pallas under shard_map (see dist.py)
+
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            ScatterArrays(*([P(PARTS_AXIS)] * len(ScatterArrays._fields))),
-            P(PARTS_AXIS),  # vtx_mask
-            P(PARTS_AXIS),  # degree
-            P(PARTS_AXIS),  # state
-        ),
+        in_specs=in_specs,
         out_specs=P(PARTS_AXIS),
+        **kw,
     )
-    def run(sarr_blk, vtx_mask_blk, degree_blk, state_blk):
+    def run(sarr_blk, vtx_mask_blk, degree_blk, state_blk, *route_blk):
         # k = P/D resident source parts per device (k == 1 when parts ==
         # devices) — the leading axis of every block, like the ring/dist
         # engines.  Lane j holds global source part dev*k + j.
@@ -194,14 +203,28 @@ def _compile_scatter_fixed(prog, mesh, num_parts: int, num_iters: int,
                 # partials into destination part p from ALL my resident
                 # source parts, pre-summed before the collective (legal:
                 # sum programs only — the assert above)
-                def lane(loc, src, w, hf, dl):
+                def lane(loc, src, w, hf, dl, ra=None):
                     # dst_state unavailable pre-combination (remote);
                     # sum programs don't use it
-                    vals = prog.edge_value(loc[src], w, None)
+                    if ra is not None:
+                        from lux_tpu.ops import expand as _expand
+
+                        src_vals = _expand.apply_expand(
+                            loc, route_static, ra, interpret=interpret)
+                    else:
+                        src_vals = loc[src]
+                    vals = prog.edge_value(src_vals, w, None)
                     return segment.segment_reduce_by_ends(
                         vals, hf, dl, V, reduce="sum", method=method,
                     )
 
+                if routed:
+                    return jax.vmap(lane)(
+                        local, sarr_blk.src_local[:, p],
+                        sarr_blk.weights[:, p], sarr_blk.head_flag[:, p],
+                        sarr_blk.dst_local[:, p],
+                        jax.tree.map(lambda a: a[:, p], route_blk[0]),
+                    ).sum(axis=0)
                 return jax.vmap(lane)(
                     local, sarr_blk.src_local[:, p], sarr_blk.weights[:, p],
                     sarr_blk.head_flag[:, p], sarr_blk.dst_local[:, p],
@@ -235,10 +258,13 @@ def run_pull_fixed_scatter(
     num_iters: int,
     mesh: Mesh,
     method: str = "auto",
+    route=None,
 ):
     """Distributed fixed-iteration pull with reduce_scatter exchange.
     P may be any multiple of the mesh size (k parts resident per device,
-    like the ring/dist drivers)."""
+    like the ring/dist drivers).  ``route``
+    (plan_scatter_route_shards) replays each bucket's resident-block
+    gather as routed lane shuffles — bitwise-identical."""
     from lux_tpu.engine import methods
 
     method = methods.resolve(method, prog.reduce)
@@ -257,5 +283,13 @@ def run_pull_fixed_scatter(
     vtx_mask = shard_stacked(mesh, jnp.asarray(shards.arrays.vtx_mask))
     degree = shard_stacked(mesh, jnp.asarray(shards.arrays.degree))
     state0 = shard_stacked(mesh, state0)
-    run = _compile_scatter_fixed(prog, mesh, spec.num_parts, num_iters, method)
-    return run(sarrays, vtx_mask, degree, state0)
+    if route is None:
+        run = _compile_scatter_fixed(prog, mesh, spec.num_parts, num_iters,
+                                     method)
+        return run(sarrays, vtx_mask, degree, state0)
+    from lux_tpu.parallel.mesh import routed_run_args
+
+    rs, ra, interp = routed_run_args(mesh, route)
+    run = _compile_scatter_fixed(prog, mesh, spec.num_parts, num_iters,
+                                 method, route_static=rs, interpret=interp)
+    return run(sarrays, vtx_mask, degree, state0, ra)

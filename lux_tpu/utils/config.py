@@ -139,8 +139,9 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "with lane shuffles (bitwise-identical); "
                              "'fused' also replaces the segmented reduce "
                              "(deterministic group association; single "
-                             "device).  Allgather layout only; 'expand' "
-                             "also runs --distributed")
+                             "device).  'expand' runs --distributed on "
+                             "the allgather, ring, and scatter exchanges "
+                             "(per-bucket plans for the bucketed two)")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],

@@ -109,6 +109,23 @@ def add_routed(est: MemoryEstimate, static) -> MemoryEstimate:
     return add_routed_bytes(est, routed_plan_bytes(static))
 
 
+def routed_bucket_plan_bytes_analytic(num_parts: int, e_bucket_pad: int,
+                                      nv_pad: int) -> int:
+    """Per-RESIDENT-PART plan bytes for the bucketed (ring /
+    reduce_scatter) routed exchanges: P plans, one per peer bucket,
+    each over n_b = pow2(max(e_bucket_pad, nv_pad)) — NOT the allgather
+    geometry (a skewed graph's padded bucket can make P * n_b far
+    exceed e_pad)."""
+    from lux_tpu.ops.expand import _idx8_enabled, _next_pow2
+    from lux_tpu.ops.route import factor_digits
+
+    idx = 1 if _idx8_enabled() else 4
+    n_b = max(_next_pow2(e_bucket_pad), _next_pow2(nv_pad), 128)
+    k = len(factor_digits(n_b))
+    per_plan = 2 * (2 * k - 1) * n_b * idx + int(1.02 * n_b) * (idx + 1)
+    return num_parts * per_plan
+
+
 def routed_plan_bytes_analytic(spec: ShardSpec, mode: str = "expand",
                                wide: bool = False) -> int:
     """Routed-plan bytes from the shard GEOMETRY alone (no plan built):

@@ -219,15 +219,17 @@ def test_cli_route_gather():
             base + ["--route-gather", *mode, "--distributed", "-ng", "2"],
             capture_output=True, text=True, env=env, timeout=300)
         assert ok_dist.returncode == 0, ok_dist.stdout + ok_dist.stderr
-    # ring now routes via per-bucket plans; scatter still rejects
-    ok_ring = subprocess.run(
-        base + ["--route-gather", "--distributed", "-ng", "2",
-                "--exchange", "ring"],
-        capture_output=True, text=True, env=env, timeout=300)
-    assert ok_ring.returncode == 0, ok_ring.stdout + ok_ring.stderr
+    # every 1-D exchange routes via per-bucket plans now; the 2-D
+    # edge-sharded mesh still rejects (its chunk layout is its own)
+    for exch in ("ring", "scatter"):
+        ok = subprocess.run(
+            base + ["--route-gather", "--distributed", "-ng", "2",
+                    "--exchange", exch],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
     bad = subprocess.run(
-        base + ["--route-gather", "--distributed", "-ng", "2",
-                "--exchange", "scatter"],
+        base + ["--route-gather", "--distributed", "-ng", "4",
+                "--edge-shards", "2"],
         capture_output=True, text=True, env=env, timeout=300)
     assert bad.returncode != 0
 
@@ -447,4 +449,25 @@ def test_ring_routed_bitwise():
     route = E.plan_ring_route_shards(rs)
     routed = ring.run_pull_fixed_ring(prog, rs, s0, 4, mesh, method="scan",
                                       route=route)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
+
+
+def test_scatter_routed_bitwise():
+    """Routed per-bucket expands in the reduce_scatter exchange: bitwise
+    vs the direct fold on the virtual 8-mesh."""
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.parallel import scatter as sc
+    from lux_tpu.parallel.mesh import make_mesh
+    from lux_tpu.models.pagerank import PageRankProgram
+
+    g = generate.rmat(9, 8, seed=16)
+    ss = sc.build_scatter_shards(g, 8)
+    prog = PageRankProgram(nv=ss.spec.nv)
+    s0 = pull.init_state(prog, ss.arrays)
+    mesh = make_mesh(8)
+    direct = sc.run_pull_fixed_scatter(prog, ss, s0, 4, mesh, method="scan")
+    route = E.plan_scatter_route_shards(ss)
+    routed = sc.run_pull_fixed_scatter(prog, ss, s0, 4, mesh, method="scan",
+                                       route=route)
     np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
