@@ -113,6 +113,12 @@ def _run_feat(cfg, g, prog):
     state = feat.init_state_feat(prog, shards.arrays, mesh)
     from lux_tpu.utils import profiling
 
+    f_route = None
+    if cfg.route_gather and cfg.exchange != "ring":
+        # host-side plan construction stays OUTSIDE the reported time
+        from lux_tpu.ops import expand
+
+        f_route = expand.plan_cf_route_shards_cached(shards)
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
         if cfg.exchange == "ring":
@@ -122,7 +128,7 @@ def _run_feat(cfg, g, prog):
         else:
             state = feat.run_cf_feat_dist(
                 prog, shards.spec, shards.arrays, state, cfg.num_iters,
-                mesh, cfg.method,
+                mesh, cfg.method, route=f_route,
             )
         elapsed = timer.stop(state)
     report_elapsed(elapsed, g.ne, cfg.num_iters)

@@ -131,16 +131,20 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
         bucket_ok = (cfg.exchange in ("ring", "scatter")
                      and cfg.route_gather == "expand"
                      and getattr(prog, "k", 1) == 1)
+        feat_ok = (cfg.feat_shards > 1 and cfg.route_gather == "expand"
+                   and cfg.exchange == "allgather")
         if ((cfg.exchange != "allgather" and not bucket_ok)
-                or cfg.edge_shards > 1 or cfg.feat_shards > 1
+                or cfg.edge_shards > 1
+                or (cfg.feat_shards > 1 and not feat_ok)
                 or cfg.method == "pallas" or cfg.compact_gather
                 or cfg.stream_hbm_gib):
             raise SystemExit(
                 "--route-gather binds to the allgather pull layout "
                 "(or, for scalar-state pull apps, the ring/scatter "
-                "buckets via per-bucket plans); it cannot combine with "
-                "--edge-shards/--feat-shards/--method pallas/"
-                "--compact-gather/--stream-hbm-gib"
+                "buckets via per-bucket plans; --feat-shards routes on "
+                "the allgather exchange); it cannot combine with "
+                "--edge-shards/--method pallas/--compact-gather/"
+                "--stream-hbm-gib"
             )
         if cfg.verbose:
             raise SystemExit(_ROUTE_VERBOSE_ERR)

@@ -110,15 +110,26 @@ def init_state_feat(prog, arrays: ShardArrays, mesh: Mesh):
 
 
 @lru_cache(maxsize=64)
-def _compile_cf_feat(prog, mesh, num_iters: int, method: str):
+def _compile_cf_feat(prog, mesh, num_iters: int, method: str,
+                     route_static=None, interpret: bool = False):
+    routed = route_static is not None
+    in_specs = (_arrays_specs(), P(PARTS_AXIS, None, FEAT_AXIS))
+    kw = {}
+    if routed:
+        # plans shard over parts, replicate over the feat axis (the
+        # same gather serves every feat slice)
+        in_specs = in_specs + (P(PARTS_AXIS),)
+        kw["check_vma"] = False  # pallas under shard_map (see dist.py)
+
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(_arrays_specs(), P(PARTS_AXIS, None, FEAT_AXIS)),
+        in_specs=in_specs,
         out_specs=P(PARTS_AXIS, None, FEAT_AXIS),
+        **kw,
     )
-    def run(arr_blk, state_blk):
+    def run(arr_blk, state_blk, *route_blk):
         # block: (k_parts, V, Kf).  One iteration = parts-axis gather of
         # the LOCAL feat slice, partial dots, one cross-feat psum for the
         # error term, then feat-local accumulate + apply (module docstring;
@@ -126,14 +137,26 @@ def _compile_cf_feat(prog, mesh, num_iters: int, method: str):
         def body(_, block):
             full = flatten_gather(block)  # (P*V, Kf) over parts only
 
-            def gather(arr, loc):
-                src = full[arr.src_pos].astype(jnp.float32)  # (E, Kf)
-                dst = loc[
-                    jnp.clip(arr.dst_local, 0, loc.shape[0] - 1)
-                ].astype(jnp.float32)
+            def gather(arr, loc, ra=None):
+                if ra is not None:
+                    from lux_tpu.ops import expand as _expand
+
+                    src, dst = _expand.apply_cf_route(
+                        full, loc, route_static, ra, interpret=interpret)
+                    src = src.astype(jnp.float32)
+                    dst = dst.astype(jnp.float32)
+                else:
+                    src = full[arr.src_pos].astype(jnp.float32)  # (E, Kf)
+                    dst = loc[
+                        jnp.clip(arr.dst_local, 0, loc.shape[0] - 1)
+                    ].astype(jnp.float32)
                 return src, jnp.sum(src * dst, axis=-1)
 
-            src_vecs, part_dot = jax.vmap(gather)(arr_blk, block)
+            if routed:
+                src_vecs, part_dot = jax.vmap(gather)(
+                    arr_blk, block, route_blk[0])
+            else:
+                src_vecs, part_dot = jax.vmap(gather)(arr_blk, block)
             # the ONLY cross-feat exchange: (k_parts, E) error dots
             err = arr_blk.weights - jax.lax.psum(part_dot, FEAT_AXIS)
             vals = err[..., None] * src_vecs  # (k_parts, E, Kf)
@@ -285,11 +308,15 @@ def run_cf_feat_dist(
     num_iters: int,
     mesh: Mesh,
     method: str = "auto",
+    route=None,
 ):
     """Fixed-iteration CF on the (parts × feat) mesh.  ``state0`` is the
     stacked (P, V, K) latent state; K must divide by the feat extent and
-    P by the parts extent (k resident parts per device).  Returns the
-    final stacked state (sharded)."""
+    P by the parts extent (k resident parts per device).  ``route``
+    (plan_cf_route_shards) replays the src AND dst gathers per feat
+    column — bitwise-identical; the scalar plans serve every feat slice,
+    so they shard over parts and replicate over the feat axis.  Returns
+    the final stacked state (sharded)."""
     from lux_tpu.engine import methods
 
     method = methods.resolve(method, prog.reduce)
@@ -301,4 +328,15 @@ def run_cf_feat_dist(
     assert k % d_feat == 0, (k, d_feat)
     assert prog.reduce == "sum", "feat sharding is CF's sum-reduce path"
     arrays, state0 = shard_feat(mesh, arrays, state0)
-    return _compile_cf_feat(prog, mesh, num_iters, method)(arrays, state0)
+    if route is None:
+        return _compile_cf_feat(prog, mesh, num_iters, method)(
+            arrays, state0)
+    from lux_tpu.engine.pull import _route_interpret
+
+    rs, ra = route
+    ra = jax.tree.map(
+        lambda a: jax.device_put(jnp.asarray(a),
+                                 NamedSharding(mesh, P(PARTS_AXIS))), ra)
+    run = _compile_cf_feat(prog, mesh, num_iters, method,
+                           route_static=rs, interpret=_route_interpret())
+    return run(arrays, state0, ra)

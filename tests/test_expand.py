@@ -471,3 +471,31 @@ def test_scatter_routed_bitwise():
     routed = sc.run_pull_fixed_scatter(prog, ss, s0, 4, mesh, method="scan",
                                        route=route)
     np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
+
+
+def test_feat_sharded_cf_routed_bitwise():
+    """Routed per-column CF load on the 2-D (parts x feat) mesh: plans
+    shard over parts, replicate over feat; bitwise vs the direct feat
+    engine."""
+    from jax.sharding import Mesh
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.colfilter import CFProgram
+    from lux_tpu.parallel import feat
+    from lux_tpu.parallel.mesh import PARTS_AXIS
+    from lux_tpu.parallel.feat import FEAT_AXIS
+
+    gw = generate.bipartite_ratings(256, 256, 4096, seed=0)
+    shards = build_pull_shards(gw, 4)
+    prog = CFProgram(k=8)
+    dev = jax.tree.map(jnp.asarray, shards.arrays)
+    s0 = pull.init_state(prog, dev)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2),
+                (PARTS_AXIS, FEAT_AXIS))
+    direct = feat.run_cf_feat_dist(prog, shards.spec, shards.arrays, s0, 3,
+                                   mesh, method="scan")
+    route = E.plan_cf_route_shards(shards)
+    routed = feat.run_cf_feat_dist(prog, shards.spec, shards.arrays, s0, 3,
+                                   mesh, method="scan", route=route)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
