@@ -133,17 +133,19 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                      and getattr(prog, "k", 1) == 1)
         feat_ok = (cfg.feat_shards > 1 and cfg.route_gather == "expand"
                    and cfg.exchange == "allgather")
+        e2d_ok = (cfg.edge_shards > 1 and cfg.route_gather == "expand"
+                  and cfg.exchange == "allgather"
+                  and getattr(prog, "k", 1) == 1)
         if ((cfg.exchange != "allgather" and not bucket_ok)
-                or cfg.edge_shards > 1
+                or (cfg.edge_shards > 1 and not e2d_ok)
                 or (cfg.feat_shards > 1 and not feat_ok)
                 or cfg.method == "pallas" or cfg.compact_gather
                 or cfg.stream_hbm_gib):
             raise SystemExit(
-                "--route-gather binds to the allgather pull layout "
-                "(or, for scalar-state pull apps, the ring/scatter "
-                "buckets via per-bucket plans; --feat-shards routes on "
-                "the allgather exchange); it cannot combine with "
-                "--edge-shards/--method pallas/--compact-gather/"
+                "--route-gather expand covers every pull layout "
+                "(allgather, ring/scatter buckets, edge-sharded chunks, "
+                "feat-sharded columns); 'fused' is allgather-only, and "
+                "no mode combines with --method pallas/--compact-gather/"
                 "--stream-hbm-gib"
             )
         if cfg.verbose:
@@ -289,9 +291,19 @@ def estimate_exchange(shards, cfg: RunConfig, state_width: int = 1):
 
     sbytes = 2 if cfg.dtype == "bfloat16" else 4
     if cfg.edge_shards > 1:
-        return preflight.estimate_edge2d(
+        est = preflight.estimate_edge2d(
             shards.spec, shards.e2_pad, state_width, sbytes
         )
+        if getattr(cfg, "route_gather", ""):
+            # one chunk plan per device: n from the chunk pad + the
+            # parts-gathered state
+            est = preflight.add_routed_bytes(
+                est,
+                preflight.routed_bucket_plan_bytes_analytic(
+                    1, shards.e2_pad,
+                    shards.spec.num_parts * shards.spec.nv_pad),
+            )
+        return est
     if cfg.exchange == "ring":
         est = preflight.estimate_ring(
             shards.spec, shards.e_bucket_pad, state_width, sbytes
@@ -506,8 +518,14 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
     if cfg.edge_shards > 1:
         from lux_tpu.parallel import edge2d
 
+        e2_route = None
+        if getattr(cfg, "route_gather", "") == "expand":
+            from lux_tpu.ops import expand
+
+            e2_route = expand.plan_edge2d_route_shards_cached(shards)
         return edge2d.run_pull_fixed_2d(
-            prog, shards, state, num_iters, mesh, cfg.method
+            prog, shards, state, num_iters, mesh, cfg.method,
+            route=e2_route,
         )
     if cfg.exchange == "ring":
         from lux_tpu.parallel import ring

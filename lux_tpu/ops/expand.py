@@ -632,6 +632,36 @@ def plan_scatter_route_shards(sshards):
                                sshards.pull.spec.nv_pad)
 
 
+def plan_edge2d_route_shards(eshards):
+    """Per-(part, edge-shard) chunk plans for the 2-D mesh: each chunk's
+    E2-width src_pos gathers the (P*V,) parts-gathered state (pads hold
+    the V sentinel in dst_local).  Uniform chunk pad + gathered size ->
+    one shared static; same SCALE NOTE as the bucket planners."""
+    a2 = eshards.arrays2d
+    num_p, num_e = a2.src_pos.shape[:2]
+    v_pad = a2.vtx_mask.shape[1]
+    gathered = num_p * v_pad
+
+    def plan_one(flat):
+        p, e = divmod(flat, num_e)
+        m = int(np.count_nonzero(a2.dst_local[p, e] < v_pad))
+        return plan_expand(np.asarray(a2.src_pos[p, e]), m, gathered)
+
+    static, flat_stacked = _stack_parts(num_p * num_e, plan_one)
+    stacked = tuple(a.reshape((num_p, num_e) + a.shape[1:])
+                    for a in flat_stacked)
+    return static, stacked
+
+
+def plan_edge2d_route_shards_cached(eshards, cache_dir: str | None = None):
+    """plan_edge2d_route_shards with the shared disk cache."""
+    a2 = eshards.arrays2d
+    return _bucket_route_cached(
+        "e2d", a2.src_pos, a2.dst_local,
+        a2.src_pos.shape[0] * a2.vtx_mask.shape[1],
+        lambda: plan_edge2d_route_shards(eshards), cache_dir)
+
+
 def _bucket_route_cached(tag: str, src_local, dst_local, v_pad: int,
                          build, cache_dir: str | None = None):
     cache_dir = cache_dir or _default_cache_dir()

@@ -171,22 +171,30 @@ _PCOMBINE = {
 
 
 @lru_cache(maxsize=64)
-def _compile_edge2d_fixed(prog, mesh, num_iters: int, method: str):
+def _compile_edge2d_fixed(prog, mesh, num_iters: int, method: str,
+                          route_static=None, interpret: bool = False):
     edge_specs = P(PARTS_AXIS, EDGE_AXIS)
     vtx_specs = P(PARTS_AXIS)  # replicated over the edge axis
     in_specs = Edge2DArrays(
         edge_specs, edge_specs, edge_specs, edge_specs,
         vtx_specs, vtx_specs, vtx_specs,
     )
+    routed = route_static is not None
+    all_specs = (in_specs, P(PARTS_AXIS))
+    kw = {}
+    if routed:
+        all_specs = all_specs + (P(PARTS_AXIS, EDGE_AXIS),)
+        kw["check_vma"] = False  # pallas under shard_map (see dist.py)
 
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(in_specs, P(PARTS_AXIS)),
+        in_specs=all_specs,
         out_specs=P(PARTS_AXIS),
+        **kw,
     )
-    def run(arr_blk, state_blk):
+    def run(arr_blk, state_blk, *route_blk):
         src_pos = arr_blk.src_pos[0, 0]
         dst_loc = arr_blk.dst_local[0, 0]
         head = arr_blk.head_flag[0, 0]
@@ -198,7 +206,16 @@ def _compile_edge2d_fixed(prog, mesh, num_iters: int, method: str):
         def iteration(_, local):
             full = jax.lax.all_gather(local, PARTS_AXIS, tiled=True)
             dst_state = local[jnp.clip(dst_loc, 0, V - 1)]
-            vals = prog.edge_value(full[src_pos], w, dst_state)
+            if routed:
+                from lux_tpu.ops import expand as _expand
+
+                src_vals = _expand.apply_expand(
+                    full, route_static,
+                    jax.tree.map(lambda a: a[0, 0], route_blk[0]),
+                    interpret=interpret)
+            else:
+                src_vals = full[src_pos]
+            vals = prog.edge_value(src_vals, w, dst_state)
             part = segment.segment_reduce_by_ends(
                 vals, head, dst_loc, V, reduce=prog.reduce, method=method
             )
@@ -325,12 +342,26 @@ def run_pull_fixed_2d(
     num_iters: int,
     mesh: Mesh,
     method: str = "auto",
+    route=None,
 ):
     """Fixed-iteration pull over the 2-D (parts, edge) mesh.  ``state0`` is
-    the stacked (P, V, ...) state (engine.pull.init_state)."""
+    the stacked (P, V, ...) state (engine.pull.init_state).  ``route``
+    (plan_edge2d_route_shards) replays each chunk's gathered-state read
+    as routed lane shuffles — bitwise-identical."""
     from lux_tpu.engine import methods
 
     method = methods.resolve(method, prog.reduce)
     arrays, state0 = _place_edge2d(shards, state0, mesh, method)
-    run = _compile_edge2d_fixed(prog, mesh, num_iters, method)
-    return run(arrays, state0)
+    if route is None:
+        run = _compile_edge2d_fixed(prog, mesh, num_iters, method)
+        return run(arrays, state0)
+    from lux_tpu.engine.pull import _route_interpret
+
+    rs, ra = route
+    sh = NamedSharding(mesh, P(PARTS_AXIS, EDGE_AXIS))
+    ra = jax.tree.map(
+        lambda a: jax.device_put(jnp.asarray(a), sh), ra)
+    run = _compile_edge2d_fixed(prog, mesh, num_iters, method,
+                                route_static=rs,
+                                interpret=_route_interpret())
+    return run(arrays, state0, ra)

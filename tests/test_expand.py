@@ -219,17 +219,22 @@ def test_cli_route_gather():
             base + ["--route-gather", *mode, "--distributed", "-ng", "2"],
             capture_output=True, text=True, env=env, timeout=300)
         assert ok_dist.returncode == 0, ok_dist.stdout + ok_dist.stderr
-    # every 1-D exchange routes via per-bucket plans now; the 2-D
-    # edge-sharded mesh still rejects (its chunk layout is its own)
-    for exch in ("ring", "scatter"):
+    # every pull layout routes in expand mode now (allgather, ring,
+    # scatter buckets, edge-sharded chunks); fused stays allgather-only
+    for extra2 in (["--exchange", "ring"], ["--exchange", "scatter"]):
         ok = subprocess.run(
             base + ["--route-gather", "--distributed", "-ng", "2",
-                    "--exchange", exch],
+                    *extra2],
             capture_output=True, text=True, env=env, timeout=300)
         assert ok.returncode == 0, ok.stdout + ok.stderr
-    bad = subprocess.run(
+    ok2 = subprocess.run(
         base + ["--route-gather", "--distributed", "-ng", "4",
                 "--edge-shards", "2"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert ok2.returncode == 0, ok2.stdout + ok2.stderr
+    bad = subprocess.run(
+        base + ["--route-gather", "fused", "--distributed", "-ng", "2",
+                "--exchange", "ring"],
         capture_output=True, text=True, env=env, timeout=300)
     assert bad.returncode != 0
 
@@ -498,4 +503,24 @@ def test_feat_sharded_cf_routed_bitwise():
     route = E.plan_cf_route_shards(shards)
     routed = feat.run_cf_feat_dist(prog, shards.spec, shards.arrays, s0, 3,
                                    mesh, method="scan", route=route)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
+
+
+def test_edge2d_routed_bitwise():
+    """Routed per-chunk expands on the 2-D (parts x edge) mesh: bitwise
+    vs the direct chunked gather."""
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.parallel import edge2d
+    from lux_tpu.models.pagerank import PageRankProgram
+
+    g = generate.rmat(9, 8, seed=17)
+    es = edge2d.build_edge2d_shards(g, 4, 2)
+    prog = PageRankProgram(nv=es.spec.nv)
+    mesh = edge2d.make_mesh2d(4, 2)
+    s0 = pull.init_state(prog, es.arrays)
+    direct = edge2d.run_pull_fixed_2d(prog, es, s0, 4, mesh, method="scan")
+    route = E.plan_edge2d_route_shards(es)
+    routed = edge2d.run_pull_fixed_2d(prog, es, s0, 4, mesh, method="scan",
+                                      route=route)
     np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
