@@ -209,14 +209,17 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
     if getattr(cfg, "route_gather", "") and (
             cfg.ckpt_every or cfg.repartition_every
             or cfg.verbose or cfg.method == "pallas"
-            or cfg.exchange != "allgather" or cfg.compact_gather
+            or (cfg.exchange == "ring" and not cfg.distributed)
+            or cfg.exchange not in ("allgather", "ring")
+            or cfg.compact_gather
             or (cfg.distributed and getattr(cfg, "delta", 0))):
         raise SystemExit(
             "--route-gather on push apps routes the allgather dense "
             "rounds (single-device or --distributed; composes with "
-            "single-device --delta); it cannot combine with "
-            "checkpointing/--repartition-every/-verbose/"
-            "--method pallas/--compact-gather"
+            "single-device --delta) and the distributed ring dense "
+            "rounds; it cannot combine with checkpointing/"
+            "--repartition-every/-verbose/--method pallas/"
+            "--compact-gather"
         )
     if cfg.method in ("cumsum", "mxsum"):
         raise SystemExit(
@@ -293,13 +296,16 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
 
     ckpt_compute = None
     with profiling.trace(cfg.profile_dir):
-        # ONE plan computation for every routed branch (plain push,
-        # delta, distributed) — built outside the timed region
+        # ONE plan computation for every routed branch — built outside
+        # the timed region.  The ring exchange plans per-bucket; every
+        # other branch plans on the pull layout.
         route = None
         if getattr(cfg, "route_gather", ""):
             from lux_tpu.ops import expand
 
-            route = expand.plan_expand_shards_cached(shards)
+            route = (expand.plan_ring_route_shards_cached(shards)
+                     if cfg.exchange == "ring"
+                     else expand.plan_expand_shards_cached(shards))
 
         timer = Timer()
         if cfg.ckpt_every and getattr(cfg, "delta", 0):
@@ -410,7 +416,7 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
                     "allgather-exchange mode; ring runs fused on device"
                 )
             state, iters, edges = push.run_push_ring(
-                prog, shards, mesh, cfg.max_iters, cfg.method
+                prog, shards, mesh, cfg.max_iters, cfg.method, route=route
             )
         else:
             state, iters, edges = push.run_push_dist(

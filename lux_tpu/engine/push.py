@@ -821,7 +821,8 @@ def assemble_carry(c_local: PushCarry, assemble) -> PushCarry:
 
 @lru_cache(maxsize=64)
 def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
-                       e_bucket_pad: int, method: str):
+                       e_bucket_pad: int, method: str,
+                       route_static=None, interpret: bool = False):
     """Direction-optimizing push with the RING dense exchange: sparse
     rounds exchange (vid, value) queues exactly like _compile_push_dist;
     dense rounds fold ppermute-streamed state blocks through the ring
@@ -837,15 +838,22 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
     view_specs = VertexView(*([P(PARTS_AXIS)] * len(VertexView._fields)))
     carry_specs = _carry_specs()
+    routed = route_static is not None
+    in_specs = (rarr_specs, parr_specs, view_specs, carry_specs, P())
+    kw = {}
+    if routed:
+        in_specs = in_specs + (P(PARTS_AXIS),)
+        kw["check_vma"] = False  # pallas under shard_map (see dist.py)
 
     @jax.jit
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(rarr_specs, parr_specs, view_specs, carry_specs, P()),
+        in_specs=in_specs,
         out_specs=carry_specs,
+        **kw,
     )
-    def run(rarr_blk, parr_blk, view_blk, carry_blk, it_stop):
+    def run(rarr_blk, parr_blk, view_blk, carry_blk, it_stop, *route_blk):
         V = spec.nv_pad
         my = jax.lax.axis_index(PARTS_AXIS)
         op = _op(prog)
@@ -863,17 +871,27 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                 for j in range(k):
                     q = dev * k + j  # global part id of streamed lane j
 
-                    def one(rarr_i, acc_i, q=q):
-                        vals = prog.relax(
-                            stream[j][rarr_i.src_local[q]], rarr_i.weights[q]
-                        )
+                    def one(rarr_i, acc_i, ra_i=None, q=q):
+                        if ra_i is not None:
+                            from lux_tpu.ops import expand as _expand
+
+                            src_vals = _expand.apply_expand(
+                                stream[j], route_static,
+                                jax.tree.map(lambda a: a[q], ra_i),
+                                interpret=interpret)
+                        else:
+                            src_vals = stream[j][rarr_i.src_local[q]]
+                        vals = prog.relax(src_vals, rarr_i.weights[q])
                         part = segment.segment_reduce_by_ends(
                             vals, rarr_i.head_flag[q], rarr_i.dst_local[q],
                             V, reduce=prog.reduce, method=method,
                         )
                         return op(acc_i, part)
 
-                    acc = jax.vmap(one)(rarr_blk, acc)
+                    if routed:
+                        acc = jax.vmap(one)(rarr_blk, acc, route_blk[0])
+                    else:
+                        acc = jax.vmap(one)(rarr_blk, acc)
                 return acc
 
             acc = ring_sweep(block, neutral_like(block, prog.reduce), fold, D)
@@ -921,10 +939,15 @@ def run_push_ring(
     mesh: Mesh,
     max_iters: int = 10_000,
     method: str = "auto",
+    route=None,
 ):
     """Distributed push driver with the ring dense exchange.  Only the
     O(part edges) CSR/bucket arrays and O(V) vertex arrays touch the
-    devices — never the pull layout's O(E) stacked arrays."""
+    devices — never the pull layout's O(E) stacked arrays.  ``route``
+    (ops.expand.plan_ring_route_shards on the ring buckets) replays the
+    dense rounds' streamed-block gathers as routed lane shuffles —
+    bitwise-identical (note its plan-footprint SCALE NOTE: the routed
+    mode trades the O(nv/P) memory story for hot-loop speed)."""
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts % mesh.devices.size == 0
@@ -932,10 +955,20 @@ def run_push_ring(
         "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
     )
     rarrays, parrays, view, carry0 = ring_init_dist(prog, shards, mesh)
-    run = _compile_push_ring(
-        prog, mesh, pspec, spec, shards.e_bucket_pad, method
-    )
-    out = run(rarrays, parrays, view, carry0, jnp.int32(max_iters))
+    if route is None:
+        run = _compile_push_ring(
+            prog, mesh, pspec, spec, shards.e_bucket_pad, method
+        )
+        out = run(rarrays, parrays, view, carry0, jnp.int32(max_iters))
+    else:
+        from lux_tpu.parallel.mesh import routed_run_args
+
+        rs, ra, interp = routed_run_args(mesh, route)
+        run = _compile_push_ring(
+            prog, mesh, pspec, spec, shards.e_bucket_pad, method,
+            route_static=rs, interpret=interp,
+        )
+        out = run(rarrays, parrays, view, carry0, jnp.int32(max_iters), ra)
     return out.state, out.it, out.edges
 
 

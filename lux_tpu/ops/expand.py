@@ -599,7 +599,14 @@ def plan_ring_route_shards(rshards):
     local indices, real edges prefix-packed (pads hold the V sentinel in
     dst_local).  Uniform e_bucket_pad/V make every (i, q) static
     identical, so the ring fold dynamic-indexes the plan slice by the
-    traced round part id."""
+    traced round part id.
+
+    SCALE NOTE: the per-device plan footprint is O(P * n_b * passes)
+    with n_b >= nv_pad — the plans do NOT shrink with the streamed block
+    the way ring state does, so at the ring module's RMAT27/P=64 target
+    the routed mode's index arrays dominate; it is a single-pod /
+    moderate-P accelerator, not the capacity mode (preflight charges
+    it via routed_bucket_plan_bytes_analytic)."""
     return _plan_bucket_routes(rshards.rarrays.src_local,
                                rshards.rarrays.dst_local,
                                rshards.pull.spec.nv_pad)

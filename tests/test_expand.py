@@ -524,3 +524,28 @@ def test_edge2d_routed_bitwise():
     routed = edge2d.run_pull_fixed_2d(prog, es, s0, 4, mesh, method="scan",
                                       route=route)
     np.testing.assert_array_equal(np.asarray(direct), np.asarray(routed))
+
+
+@pytest.mark.parametrize("parts", [8, 16])
+def test_push_ring_routed_bitwise(parts):
+    """Routed streamed-block gathers in the push engine's RING dense
+    rounds: bitwise state, rounds, and exact edge counters — at k=1
+    (parts == devices) AND k=2 resident lanes (the plan slice indexing
+    q = dev*k + j is the subtle part)."""
+    from lux_tpu.engine import push
+    from lux_tpu.graph import generate
+    from lux_tpu.parallel.ring import build_push_ring_shards
+    from lux_tpu.parallel.mesh import make_mesh
+    from lux_tpu.models.components import MaxLabelProgram
+
+    g = generate.rmat(9, 8, seed=18)
+    prs = build_push_ring_shards(g, parts)
+    prog = MaxLabelProgram()
+    mesh = make_mesh(8)
+    st, it, ed = push.run_push_ring(prog, prs, mesh, method="scan")
+    route = E.plan_ring_route_shards(prs)
+    st2, it2, ed2 = push.run_push_ring(prog, prs, mesh, method="scan",
+                                       route=route)
+    np.testing.assert_array_equal(np.asarray(st), np.asarray(st2))
+    assert int(it) == int(it2)
+    assert push.edges_total(ed) == push.edges_total(ed2)
