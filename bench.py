@@ -36,9 +36,11 @@ Env knobs:
   LUX_BENCH_TPU_S  (default budget-120) how long to wait for the TPU worker
   LUX_BENCH_CPU_SCALE (default min(scale, 18)) fallback worker's RMAT scale
                    — a 1-core CPU needs a smaller graph to finish in budget
-  LUX_BENCH_APPS   (default pagerank,sssp,components,colfilter) which app
-                   metrics to measure; pagerank is the headline and
-                   always prints last
+  LUX_BENCH_APPS   (default pagerank,sssp,components,colfilter,serve)
+                   which app metrics to measure; pagerank is the headline
+                   and always prints last.  "serve" is the batched
+                   query-serving row (lux_tpu.serve): sssp_qps_* — warm
+                   Q=64 batched QPS vs warm Q=1 sequential.
 """
 from __future__ import annotations
 
@@ -357,7 +359,7 @@ def worker_main():
     apps = [
         a.strip()
         for a in os.environ.get(
-            "LUX_BENCH_APPS", "pagerank,sssp,components,colfilter"
+            "LUX_BENCH_APPS", "pagerank,sssp,components,colfilter,serve"
         ).split(",")
         if a.strip()
     ]
@@ -466,6 +468,29 @@ def worker_main():
                 "dense_rounds": dr,
                 "traversed_edges": traversed,
                 **roofline.summarize(model, elapsed, traversed),
+            }
+        )
+
+    def measure_serve():
+        """Batched query-serving row (lux_tpu.serve): warm Q=64 batched
+        QPS vs warm Q=1 sequential on the headline graph — the serving
+        path's tracked artifact.  Skipped under layout A/B modes (the
+        serving engines bind the default pull layout)."""
+        from lux_tpu.serve.benchmarks import measure_serving
+
+        res = measure_serving(
+            g, shards, app="sssp", q=64, num_seq=4, batched_reps=1,
+            method="auto",
+        )
+        _emit(
+            {
+                "metric": f"sssp_qps_rmat{scale}_1chip{suffix}",
+                "value": res["qps_batched"],
+                "unit": "QPS",
+                # the serving row's baseline IS request-at-a-time
+                # serving: batched/sequential is the subsystem's win
+                "vs_baseline": res["batched_vs_q1"],
+                **res,
             }
         )
 
@@ -627,6 +652,15 @@ def worker_main():
             measure_components(resolve_method("auto", "max", platform))
         except Exception as e:  # noqa: BLE001
             print(f"# components failed: {e}", file=sys.stderr, flush=True)
+    if "serve" in apps:
+        if sort_seg or compact or route_gather or route_fused:
+            print("# serve row skipped: layout A/B run", file=sys.stderr,
+                  flush=True)
+        else:
+            try:
+                measure_serve()
+            except Exception as e:  # noqa: BLE001
+                print(f"# serve failed: {e}", file=sys.stderr, flush=True)
     if "pagerank" in apps and results and (
         on_tpu or os.environ.get("LUX_BENCH_FORCE_SCALEUP") == "1"
     ):
@@ -742,9 +776,11 @@ def _relay(out_path) -> bool:
     found.  The worker emits one line per measured (app, method, dtype)
     as soon as it exists, best-effort: even a worker that later wedged
     inside a risky method has its completed measurements harvested here.
-    One line per family (pagerank/sssp/components/colfilter), each the
-    highest-GTEPS one; the pagerank HEADLINE prints LAST — the driver
-    and the tests read the final stdout line."""
+    One line per family — the metric stem up to ``_rmat``, so the sssp
+    ENGINE row (sssp_gteps) and the sssp SERVING row (sssp_qps) are
+    distinct families whose values (GTEPS vs QPS) never contest each
+    other — each the highest-value one; the pagerank HEADLINE prints
+    LAST — the driver and the tests read the final stdout line."""
     try:
         with open(out_path + ".err", "rb") as f:
             sys.stderr.write(f.read().decode(errors="replace"))
@@ -767,7 +803,7 @@ def _relay(out_path) -> bool:
                     # the best-per-family contest
                     extras.append(obj)
                     continue
-                fam = str(obj.get("metric", "")).split("_")[0]
+                fam = str(obj.get("metric", "")).split("_rmat")[0]
                 if fam not in best or obj.get("value", 0.0) > best[fam].get(
                     "value", 0.0
                 ):
@@ -782,7 +818,8 @@ def _relay(out_path) -> bool:
         return True
     # fixed fallback priority (not max(): that picks the lexicographically
     # largest family — an arbitrary headline when pagerank is excluded)
-    for fam in ("pagerank", "sssp", "components", "colfilter"):
+    for fam in ("pagerank_gteps", "sssp_gteps", "components_gteps",
+                "colfilter_gteps", "sssp_qps"):
         if fam in best:
             headline = fam
             break

@@ -8,6 +8,24 @@ Filtering application suite — built for TPU meshes (SPMD via shard_map +
 XLA collectives over ICI) rather than Legion/GASNet/CUDA.
 """
 
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):
+    # jax < 0.5 ships shard_map under experimental with check_rep instead
+    # of check_vma; every engine writes the modern spelling — adapt once
+    # here (the first lux_tpu import runs before any engine module).
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f, mesh=None, in_specs=None, out_specs=None, **kw):
+        # check_rep is a purely diagnostic static analysis and the old
+        # checker has no rule for while_loop (every engine loop here);
+        # disable it unless the caller explicitly asked for a check
+        kw["check_rep"] = bool(kw.pop("check_vma", False))
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    _jax.shard_map = _compat_shard_map
+
 from lux_tpu.graph.csc import HostGraph, from_edge_list
 from lux_tpu.graph.format import read_lux, read_lux_range, write_lux
 from lux_tpu.graph.push_shards import build_push_shards

@@ -101,8 +101,17 @@ def _run_streamed(cfg, g, prog):
 
 
 def main(argv=None):
-    cfg = parse_args(argv, description=__doc__, pull=True, stream=True)
+    cfg = parse_args(argv, description=__doc__, pull=True, stream=True,
+                     serve=True)
     g = common.load_graph(cfg)
+    if cfg.serve:
+        # personalized-PageRank query service: Q seed vectors per batched
+        # iteration (lux_tpu.serve; -ni is the per-query iteration count)
+        if cfg.dtype != "float32":
+            raise SystemExit("--serve runs the float32 batched engines")
+        from lux_tpu.serve.driver import run_serve_cli
+
+        return run_serve_cli(cfg, g, "ppr")
     prog = PageRankProgram(nv=g.nv, dtype=cfg.dtype)
     common.validate_exchange(cfg, prog)
     if cfg.stream_hbm_gib:

@@ -438,8 +438,16 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
 
 
 def main(argv=None):
-    cfg = parse_args(argv, description=__doc__, sssp=True, push=True)
+    cfg = parse_args(argv, description=__doc__, sssp=True, push=True,
+                     serve=True)
     g = common.load_graph(cfg, weighted=cfg.weighted)
+    if cfg.serve:
+        # batched multi-source query service (lux_tpu.serve): warm
+        # Q-bucket engines + micro-batching scheduler; one JSON metrics
+        # line instead of the one-shot GTEPS report
+        from lux_tpu.serve.driver import run_serve_cli
+
+        return run_serve_cli(cfg, g, "sssp")
     if cfg.weighted and not np.issubdtype(g.weights.dtype, np.integer):
         # same contract the sssp() library entry enforces: int costs
         # (reference WeightType=int); silent truncation would corrupt

@@ -60,6 +60,46 @@ class PageRankProgram:
         return jnp.where(arrays.vtx_mask, pr, 0.0).astype(self.dtype)
 
 
+@dataclasses.dataclass(frozen=True)
+class PPRProgram(PageRankProgram):
+    """Personalized PageRank: the same pre-divided recurrence with the
+    uniform teleport mass (1-ALPHA)/nv replaced by a one-hot mass at
+    ``seed`` — the single-query form of the serving path's batched
+    multi-seed program (lux_tpu.serve.batched.MultiSourcePPR); column q
+    of a batched run equals this program's pull run bitwise."""
+
+    seed: int = 0
+
+    def init_state(self, global_vid, degree, vtx_mask):
+        mass = (global_vid == self.seed).astype(jnp.float32)
+        deg = jnp.maximum(degree.astype(jnp.float32), 1.0)
+        state = jnp.where(degree > 0, mass / deg, mass)
+        return jnp.where(vtx_mask, state, 0.0).astype(self.dtype)
+
+    def apply(self, old_local, acc, arrays: ShardArrays):
+        del old_local
+        mass = (arrays.global_vid == self.seed).astype(jnp.float32)
+        pr = jnp.float32(1.0 - self.alpha) * mass + jnp.float32(self.alpha) * acc
+        deg = arrays.degree.astype(jnp.float32)
+        pr = jnp.where(arrays.degree > 0, pr / jnp.maximum(deg, 1.0), pr)
+        return jnp.where(arrays.vtx_mask, pr, 0.0).astype(self.dtype)
+
+
+def ppr_reference(g: HostGraph, seed: int, num_iters: int) -> np.ndarray:
+    """NumPy float64 oracle of the personalized recurrence (tests)."""
+    deg = g.out_degrees().astype(np.float64)
+    mass = np.zeros(g.nv, np.float64)
+    mass[seed] = 1.0
+    state = np.where(deg > 0, mass / np.maximum(deg, 1.0), mass)
+    dst = g.dst_of_edges()
+    for _ in range(num_iters):
+        acc = np.zeros(g.nv, np.float64)
+        np.add.at(acc, dst, state[g.col_idx])
+        pr = (1.0 - ALPHA) * mass + ALPHA * acc
+        state = np.where(deg > 0, pr / np.maximum(deg, 1.0), pr)
+    return state.astype(np.float32)
+
+
 def pagerank(
     g: HostGraph | PullShards,
     num_iters: int = 10,

@@ -221,6 +221,32 @@ def sssp(
     )
 
 
+def sssp_batched(
+    g: HostGraph | PushShards,
+    sources,
+    num_parts: int = 1,
+    method: str = "auto",
+    max_iters: int = 10_000,
+) -> np.ndarray:
+    """Answer ``len(sources)`` BFS-SSSP queries in ONE batched engine run
+    (lux_tpu.serve.batched — the serving hot path as a library call);
+    returns (Q, nv) int32 distances, nv == INF.  Each row is bitwise
+    equal to ``sssp(g, start=sources[q])``."""
+    from lux_tpu.graph.shards import PullShards, build_pull_shards
+    from lux_tpu.serve.batched import BatchedEngine
+
+    if isinstance(g, PushShards):
+        shards = g.pull
+    elif isinstance(g, PullShards):
+        shards = g
+    else:
+        shards = build_pull_shards(g, num_parts)
+    sources = np.asarray(sources, np.int32)
+    eng = BatchedEngine(shards, "sssp", len(sources), method=method,
+                        max_iters=max_iters)
+    return eng.run(sources).state
+
+
 def inf_value(nv: int, weighted: bool = False) -> int:
     """The unreached-distance sentinel sssp() returns."""
     return (

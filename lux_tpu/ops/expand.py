@@ -36,8 +36,9 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import json
 import os
-import pickle
+import stat
 import tempfile
 
 import jax
@@ -50,8 +51,9 @@ from lux_tpu.ops import pallas_shuffle as shuf
 LANE = 128
 
 #: bump when plan_expand / freeze_plan output layout changes — salts the
-#: disk-cache key so stale pickles can never replay an incompatible plan
-PLAN_FORMAT = 3
+#: disk-cache key so stale cache files can never replay an incompatible
+#: plan (4: pickle -> npz+json storage; keys carry array shape/dtype)
+PLAN_FORMAT = 4
 
 
 def _idx8_enabled() -> bool:
@@ -71,7 +73,11 @@ def _narrow_idx(a: np.ndarray) -> np.ndarray:
     if not np.issubdtype(a.dtype, np.integer):
         return a  # ff levels interleave bool ext masks with index arrays
     if a.size:
-        assert a.min() >= 0 and a.max() < 256, (a.dtype, a.min(), a.max())
+        # strictly < LANE: that is the invariant the u8 lane/sublane
+        # gathers require (lane fixup, ff in-row columns, sublane digits
+        # are all digit-local).  [128, 256) would fit a uint8 but gather
+        # out of bounds under promise_in_bounds — fail here instead.
+        assert a.min() >= 0 and a.max() < LANE, (a.dtype, a.min(), a.max())
     return a.astype(np.uint8)
 
 
@@ -669,15 +675,25 @@ def plan_edge2d_route_shards_cached(eshards, cache_dir: str | None = None):
         lambda: plan_edge2d_route_shards(eshards), cache_dir)
 
 
+def _hash_array(h, a) -> None:
+    """Fold ONE array into a cache key: shape + dtype + bytes.  Byte-
+    identical arrays with different layouts (e.g. a (2, n) int32 vs a
+    (n, 2) int32, or an int32 vs a float32 view) must never collide —
+    replaying a plan built for a different layout would gather garbage."""
+    a = np.ascontiguousarray(a)
+    h.update(f"{a.shape}:{a.dtype.str}:".encode())
+    h.update(a.tobytes())
+
+
 def _bucket_route_cached(tag: str, src_local, dst_local, v_pad: int,
                          build, cache_dir: str | None = None):
     cache_dir = cache_dir or _default_cache_dir()
     h = hashlib.sha1()
     h.update(f"{tag}{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
-    h.update(np.ascontiguousarray(src_local).tobytes())
-    h.update(np.ascontiguousarray(dst_local).tobytes())
+    _hash_array(h, src_local)
+    _hash_array(h, dst_local)
     h.update(str(v_pad).encode())
-    path = os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.pkl")
+    path = os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.npz")
     return _load_or_build(path, build)
 
 
@@ -718,8 +734,8 @@ def plan_fused_shards(shards, reduce: str = "sum"):
 
 
 def _default_cache_dir() -> str:
-    """Per-user plan cache (a shared world-writable dir would unpickle
-    other users' files and collide on permissions)."""
+    """Per-user plan cache dir (vetted by _cache_dir_trusted before any
+    read or write: 0o700, owned by this uid, no symlink)."""
     uid = os.getuid() if hasattr(os, "getuid") else "na"
     return os.path.join(tempfile.gettempdir(), f"lux_expand_plans_{uid}")
 
@@ -735,22 +751,123 @@ def _cache_key_path(tag: str, shards, fields: tuple[str, ...],
     h = hashlib.sha1()
     h.update(f"{tag}{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
     for f in fields:
-        h.update(np.ascontiguousarray(getattr(shards.arrays, f)).tobytes())
+        _hash_array(h, getattr(shards.arrays, f))
     h.update(str(shards.spec.gathered_size).encode())
-    return os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.pkl")
+    return os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.npz")
+
+
+#: the dataclass vocabulary a cached plan static may contain — the JSON
+#: decoder instantiates ONLY these (nothing in the cache file can name
+#: arbitrary code, unlike the pickle format this replaced)
+_STATIC_TYPES = None
+
+
+def _static_types() -> dict:
+    global _STATIC_TYPES
+    if _STATIC_TYPES is None:
+        _STATIC_TYPES = {
+            cls.__name__: cls
+            for cls in (ExpandStatic, FusedStatic, CFRouteStatic, FFStatic,
+                        FFLevelStatic, shuf.StaticRoute, shuf.StaticPass)
+        }
+    return _STATIC_TYPES
+
+
+def _static_to_obj(x):
+    """Plan static -> JSON-able tree (dataclasses tagged by name)."""
+    if dataclasses.is_dataclass(x) and not isinstance(x, type):
+        return {
+            "__type__": type(x).__name__,
+            "fields": {
+                f.name: _static_to_obj(getattr(x, f.name))
+                for f in dataclasses.fields(x)
+            },
+        }
+    if isinstance(x, tuple):
+        return {"__tuple__": [_static_to_obj(v) for v in x]}
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.bool_,)):
+        return bool(x)
+    if isinstance(x, (int, float, str, bool)) or x is None:
+        return x
+    raise TypeError(f"unserializable plan-static field: {type(x)}")
+
+
+def _static_from_obj(o):
+    if isinstance(o, dict) and "__type__" in o:
+        cls = _static_types()[o["__type__"]]
+        return cls(**{k: _static_from_obj(v) for k, v in o["fields"].items()})
+    if isinstance(o, dict) and "__tuple__" in o:
+        return tuple(_static_from_obj(v) for v in o["__tuple__"])
+    return o
+
+
+def _cache_dir_trusted(cache_dir: str) -> bool:
+    """Create (0o700) and vet the plan-cache dir.  The parent is the
+    world-writable temp dir, so another local user can pre-create the
+    path: refuse any dir that is a symlink, not owned by this uid, or
+    group/world-writable — both for loading AND for storing (a plan
+    written into an attacker's dir hands them replace rights)."""
+    try:
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        st = os.lstat(cache_dir)
+    except OSError:
+        return False
+    if stat.S_ISLNK(st.st_mode) or not stat.S_ISDIR(st.st_mode):
+        return False
+    if hasattr(os, "getuid") and st.st_uid != os.getuid():
+        return False
+    if st.st_mode & 0o022:  # group/world-writable
+        return False
+    return True
+
+
+def _save_plan(path: str, plan) -> None:
+    """(static, arrays) -> one npz: arrays under index keys + the static
+    as a JSON byte blob.  No pickle anywhere — loading this file cannot
+    execute code."""
+    static, arrays = plan
+    blob = np.frombuffer(
+        json.dumps(_static_to_obj(static)).encode(), np.uint8
+    )
+    payload = {f"a{i}": np.asarray(a) for i, a in enumerate(arrays)}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, __static__=blob, **payload)
+    os.replace(tmp, path)
+
+
+def _load_plan(path: str):
+    with np.load(path, allow_pickle=False) as z:
+        static = _static_from_obj(
+            json.loads(bytes(z["__static__"]).decode())
+        )
+        arrays = tuple(z[f"a{i}"] for i in range(len(z.files) - 1))
+    return static, arrays
 
 
 def _load_or_build(path: str, build):
-    """Atomic-rename pickle cache around a plan builder."""
-    if os.path.exists(path):
-        with open(path, "rb") as f:
-            return pickle.load(f)
+    """Atomic-rename npz+json plan cache.  An untrusted cache dir (see
+    _cache_dir_trusted) degrades to always-build: correctness never
+    depends on the cache, only plan-construction time does."""
+    trusted = _cache_dir_trusted(os.path.dirname(path))
+    if trusted and os.path.exists(path):
+        try:
+            return _load_plan(path)
+        except (OSError, ValueError, KeyError) as e:
+            # corrupt/foreign file: rebuild (and overwrite) rather than
+            # fail every driver that shares the cache
+            print(f"# plan cache ignored ({path}): {e}", flush=True)
     plan = build()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        pickle.dump(plan, f)
-    os.replace(tmp, path)
+    if trusted:
+        try:
+            _save_plan(path, plan)
+        except (OSError, TypeError, ValueError) as e:
+            # the plan is already in hand; a failed store (disk full,
+            # future static field outside the codec vocabulary) must
+            # cost cache warmth, never the run
+            print(f"# plan cache not written ({path}): {e}", flush=True)
     return plan
 
 

@@ -34,6 +34,13 @@ from lux_tpu.ops.route import Route
 LANE = 128
 
 
+def _compiler_params(pltpu, **kw):
+    """pltpu.CompilerParams across jax versions (TPUCompilerParams before
+    the 0.5-era rename) — one shim shared by every kernel in the repo."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
 def _lane_kernel(x_ref, i_ref, o_ref):
     # idx may arrive uint8 (digit-local values < 128 — 4x less HBM
     # traffic per pass); the widening cast happens in VMEM, free next to
@@ -72,7 +79,8 @@ def lane_gather(x, idx, rb: int = 1024, interpret: bool = False):
         in_specs=[spec, spec],
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,
@@ -97,7 +105,8 @@ def sublane_gather(x, idx, lb: int = 16384, interpret: bool = False):
         in_specs=[spec, spec],
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,
@@ -135,13 +144,19 @@ def plan_route(route: Route) -> RoutePlan:
     for p in route.passes:
         g = p.axis
         d = dims[g]
-        if d == LANE or route.n >= LANE:
+        if d == LANE or (route.n >= LANE and d <= LANE and LANE % d == 0):
             # a small digit (d < 128, d | 128) ALSO rides the lane
             # kernel: with the digit innermost, each 128-lane row holds
             # 128/d whole digit-blocks, and the gather stays block-local
             # via the static fixup lane = (lane//d)*d + idx.  This
             # avoids the sublane kernel's narrow-minor-dim layouts
             # ((2, n/2) measured ~10x slower than lane passes on v5e).
+            # Digits that do NOT divide 128 (caller-supplied dims —
+            # build_route accepts any factorization) would make the
+            # fixup gather across block boundaries under
+            # promise_in_bounds: they fall through to the sublane
+            # kernel, whose own d <= 8 assert fails loudly instead.
+            assert d <= LANE and LANE % d == 0, d
             new_order = [a for a in order if a != g] + [g]
             kshape = (route.n // LANE, LANE)
             kind = "lane"

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lux_tpu.graph.csc import HostGraph
+from lux_tpu.ops.pallas_shuffle import _compiler_params
 
 V_BLK = 512  # output vertex block (lanes: multiple of 128)
 T_CHUNK = 512  # edges per grid step
@@ -273,7 +274,8 @@ def spmv_blockcsr(
         functools.partial(_spmv_kernel, op, v_blk, jnp.dtype(compute_dtype)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_vblocks * v_blk, 1), out_dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -342,7 +344,8 @@ def spmv_blockcsr_2d(
         functools.partial(_spmv2d_kernel, v_blk),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_vblocks, v_blk, k), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

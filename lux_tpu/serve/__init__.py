@@ -1,0 +1,32 @@
+"""lux_tpu.serve — batched multi-source query serving.
+
+Every engine below this package runs ONE analytics job per invocation;
+serving turns the same frontier machinery into a request/response path:
+
+  * ``serve.batched``   — multi-source engines: one compiled step answers
+    Q sssp/bfs sources or Q personalized-PageRank seeds per iteration
+    (trailing query axis over shared graph shards).
+  * ``serve.warm``      — compiled-engine cache keyed on
+    (app, method, layout, Q bucket), pre-traced at service start.
+  * ``serve.scheduler`` — dynamic micro-batching admission queue:
+    coalesce, pad, deadline, backpressure, cold-shape degradation.
+  * ``serve.metrics``   — per-query latency percentiles, batch occupancy,
+    queue depth, warm-vs-cold hit ratio (the structured-stats path of
+    utils/timing + utils/roofline).
+  * ``serve.benchmarks``— the measurement core shared by
+    tools/serve_bench.py and the bench.py ``sssp_qps_*`` row.
+
+The unit of work here is a REQUEST, not a graph.
+"""
+from lux_tpu.serve.batched import (  # noqa: F401
+    BatchedEngine,
+    BatchedResult,
+    MultiSourcePPR,
+    MultiSourceSSSP,
+)
+from lux_tpu.serve.scheduler import (  # noqa: F401
+    MicroBatchScheduler,
+    RejectedError,
+    ServeTimeoutError,
+)
+from lux_tpu.serve.warm import EngineKey, WarmEngineCache  # noqa: F401

@@ -1,0 +1,164 @@
+"""--serve CLI driver shared by the sssp and pagerank apps.
+
+Runs the full serving path on one process: build the pull layout, warm
+the configured Q buckets, push the requested query burst through the
+micro-batching scheduler, and print the structured metrics summary as a
+single JSON line (the same shape tools/serve_bench.py and the bench.py
+``sssp_qps_*`` row emit).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from lux_tpu.serve.benchmarks import pick_sources
+from lux_tpu.serve.metrics import ServeMetrics
+from lux_tpu.serve.scheduler import MicroBatchScheduler, RejectedError
+from lux_tpu.serve.warm import WarmEngineCache
+from lux_tpu.utils.config import RunConfig
+
+
+def _validate(cfg: RunConfig) -> None:
+    bad = []
+    if cfg.distributed:
+        bad.append("--distributed")
+    if cfg.exchange != "allgather":
+        bad.append(f"--exchange {cfg.exchange}")
+    if cfg.method == "pallas":
+        bad.append("--method pallas")
+    if getattr(cfg, "route_gather", ""):
+        bad.append("--route-gather")
+    if cfg.compact_gather or cfg.sort_segments:
+        bad.append("--compact-gather/--sort-segments")
+    if cfg.ckpt_every or cfg.ckpt_dir:
+        bad.append("checkpointing")
+    if getattr(cfg, "repartition_every", 0):
+        bad.append("--repartition-every")
+    if cfg.verbose:
+        bad.append("-verbose")
+    if getattr(cfg, "stream_hbm_gib", 0.0):
+        bad.append("--stream-hbm-gib")
+    if getattr(cfg, "weighted", False) or getattr(cfg, "delta", 0):
+        bad.append("--weighted/--delta")
+    if bad:
+        raise SystemExit(
+            "--serve is the single-process batched query service "
+            "(allgather pull layout, unweighted programs); it does not "
+            "combine with: " + ", ".join(bad))
+
+
+def parse_buckets(spec: str) -> tuple:
+    try:
+        qs = tuple(sorted({int(x) for x in spec.split(",") if x.strip()}))
+    except ValueError:
+        raise SystemExit(f"--serve-buckets: bad bucket list {spec!r}")
+    if not qs or qs[0] < 1:
+        raise SystemExit(f"--serve-buckets: buckets must be >= 1: {spec!r}")
+    return qs
+
+
+def parse_sources(cfg: RunConfig, g) -> np.ndarray:
+    if cfg.serve_sources:
+        try:
+            src = np.asarray(
+                [int(x) for x in cfg.serve_sources.split(",") if x.strip()],
+                np.int32)
+        except ValueError:
+            raise SystemExit(
+                f"--serve-sources: bad vertex list {cfg.serve_sources!r}")
+        if src.size == 0 or src.min() < 0 or src.max() >= g.nv:
+            raise SystemExit(
+                f"--serve-sources: vertices must be in [0, {g.nv})")
+        return src
+    if cfg.serve_queries < 1:
+        raise SystemExit("--serve-queries must be >= 1")
+    return pick_sources(g, cfg.serve_queries, seed=cfg.seed)
+
+
+def _check_answers(app: str, g, cfg: RunConfig, sources, answers) -> int:
+    """-check: validate every answer against the app's host oracle
+    contract (triangle inequality for sssp, one-iteration residual for
+    ppr is covered by tests — here the first few seeds get the exact
+    oracle).  Returns the violation count."""
+    bad = 0
+    if app == "sssp":
+        from lux_tpu.models import sssp as sssp_model
+
+        for i in range(len(sources)):
+            bad += sssp_model.check_distances(g, answers[i])
+            # bind the answer to ITS request: the triangle inequality
+            # holds for any source's distance field (even all-INF), so a
+            # row mismapped across requests would otherwise pass
+            if answers[i][int(sources[i])] != 0:
+                bad += 1
+    else:
+        from lux_tpu.models.pagerank import ppr_reference
+
+        for i in range(min(len(sources), 4)):
+            want = ppr_reference(g, int(sources[i]), cfg.num_iters)
+            scale = max(float(np.abs(want).mean()), 1e-30)
+            tol = 1e-3 * np.maximum(np.abs(want), scale)
+            bad += int(np.sum(np.abs(answers[i] - want) > tol))
+    return bad
+
+
+def run_serve_cli(cfg: RunConfig, g, app: str) -> int:
+    """The --serve entry: serve cfg.serve_queries (or --serve-sources)
+    through warm engines; prints per-run JSON metrics.  Returns the
+    process exit code."""
+    from lux_tpu.apps import common
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.utils.timing import Timer
+
+    _validate(cfg)
+    buckets = parse_buckets(cfg.serve_buckets)
+    sources = parse_sources(cfg, g)
+    shards = build_pull_shards(g, cfg.num_parts)
+    cache = WarmEngineCache(
+        shards, apps=(app,), q_buckets=buckets, method=cfg.method,
+        num_iters=cfg.num_iters, max_iters=cfg.max_iters,
+    )
+    warm_s = cache.prewarm()
+    print(f"warmed {len(buckets)} {app} bucket(s) {buckets} in "
+          f"{warm_s:.1f} s")
+    metrics = ServeMetrics()
+    sched = MicroBatchScheduler(
+        cache, app=app, max_wait_ms=cfg.serve_wait_ms,
+        max_queue=cfg.serve_max_queue,
+        default_timeout_ms=cfg.serve_timeout_ms, metrics=metrics,
+    )
+    timer = Timer()
+    futs = []
+    for s in sources:
+        while True:
+            try:
+                futs.append(sched.submit(int(s)))
+                break
+            except RejectedError:
+                # burst larger than the admission bound: pump the
+                # scheduler until the queue drains a batch, then retry —
+                # the backpressure loop a real client would run
+                if not sched.step():
+                    time.sleep(max(cfg.serve_wait_ms / 4e3, 1e-4))
+    sched.drain()
+    answers = []
+    timeouts = 0
+    for f in futs:
+        try:
+            answers.append(f.result(timeout=0))
+        except Exception:  # noqa: BLE001 — timeout/engine error rows
+            answers.append(None)
+            timeouts += 1
+    elapsed = timer.stop()
+    summary = metrics.summary(elapsed_s=elapsed, cache_stats=cache.stats())
+    print(json.dumps({"metric": f"{app}_serve", **summary}), flush=True)
+    if cfg.check:
+        ok_rows = [(s, a) for s, a in zip(sources, answers) if a is not None]
+        violations = _check_answers(
+            app, g, cfg, [s for s, _ in ok_rows],
+            [a for _, a in ok_rows]) + timeouts
+        ok = common.print_check(f"{app} serve", violations)
+        return 0 if ok else 1
+    return 0

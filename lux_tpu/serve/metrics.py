@@ -1,0 +1,110 @@
+"""Serving metrics: the request-side observability surface.
+
+Collected by the scheduler per request/batch and summarized through the
+same structured-stats helpers the analytics path uses
+(utils/timing.percentiles + utils/roofline.serve_summarize), so a
+serving run emits bench.py-parsable JSON just like an engine run emits
+GTEPS lines.
+
+Memory is bounded for a long-lived service: histograms reservoir-sample
+past their cap (utils/timing.LatencyHistogram), batch records keep a
+recent window plus running aggregates, and queue depth keeps only its
+running max.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+
+from lux_tpu.utils.roofline import serve_summarize
+from lux_tpu.utils.timing import LatencyHistogram
+
+
+@dataclasses.dataclass
+class BatchRecord:
+    q: int  # dispatched bucket size (incl. padding)
+    real: int  # real (non-padding) queries
+    warm: bool  # engine came from the warm cache
+    service_s: float  # engine wall time for the batch
+
+
+class ServeMetrics:
+    """Thread-safe counters for one service lifetime."""
+
+    #: recent BatchRecords kept for inspection; aggregates are unbounded
+    RECENT_BATCHES = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.latency = LatencyHistogram()  # enqueue -> result, per request
+        self.queue_wait = LatencyHistogram()  # enqueue -> dispatch
+        self.batches = collections.deque(maxlen=self.RECENT_BATCHES)
+        self._batch_count = 0
+        self._batch_slots = 0
+        self._batch_real = 0
+        self._batch_warm = 0
+        self.completed = 0
+        self.timeouts = 0
+        self.rejected = 0
+        self.traversed_edges = 0
+        self._depth_max = 0
+        self._depth_n = 0
+
+    def record_batch(self, q: int, real: int, warm: bool, service_s: float):
+        with self._lock:
+            self.batches.append(BatchRecord(q, real, warm, service_s))
+            self._batch_count += 1
+            self._batch_slots += q
+            self._batch_real += real
+            self._batch_warm += int(warm)
+
+    def record_done(self, latency_s: float, wait_s: float, traversed: int):
+        with self._lock:
+            self.completed += 1
+            self.latency.record(latency_s)
+            self.queue_wait.record(wait_s)
+            self.traversed_edges += int(traversed)
+
+    def record_timeout(self):
+        with self._lock:
+            self.timeouts += 1
+
+    def record_rejected(self):
+        with self._lock:
+            self.rejected += 1
+
+    def sample_queue_depth(self, depth: int):
+        with self._lock:
+            self._depth_n += 1
+            self._depth_max = max(self._depth_max, int(depth))
+
+    def summary(self, elapsed_s: float | None = None,
+                cache_stats: dict | None = None) -> dict:
+        """JSON-ready summary; ``elapsed_s`` (service wall time) enables
+        the QPS/aggregate-GTEPS fields."""
+        with self._lock:
+            out = {
+                "completed": self.completed,
+                "timeouts": self.timeouts,
+                "rejected": self.rejected,
+                "latency_ms": self.latency.summary_ms(),
+                "queue_wait_ms": self.queue_wait.summary_ms(),
+                "batches": self._batch_count,
+            }
+            if self._depth_n:
+                out["queue_depth_max"] = self._depth_max
+            if self._batch_count:
+                out["batch_occupancy"] = round(
+                    self._batch_real / max(self._batch_slots, 1), 4)
+                out["warm_batch_ratio"] = round(
+                    self._batch_warm / self._batch_count, 4)
+            completed = self.completed
+            traversed = self.traversed_edges
+            lat = list(self.latency.samples)
+        if elapsed_s is not None:
+            out.update(serve_summarize(completed, elapsed_s, traversed,
+                                       latencies_s=lat))
+        if cache_stats:
+            out["engine_cache"] = cache_stats
+        return out

@@ -1,0 +1,174 @@
+"""Warm engine cache: compiled batched engines keyed on
+(app, method, part layout, Q bucket).
+
+A cold query pays the full trace + XLA compile of the batched loop
+(tens of seconds at bench scale on the CPU fallback) before any graph
+work happens; a service must pay that once per SHAPE, at start.  The
+cache pre-traces the common Q buckets (default 1/8/64) for each served
+app, resolves ``--method auto`` through the measured-winners overlay
+exactly like the one-shot drivers (engine/methods.resolve — a chip
+window's recorded winner redirects the serving path too), and counts
+warm hits vs cold traces so the serving metrics can report the ratio.
+
+The layout half of the key exists because a compiled engine binds the
+shard GEOMETRY (part count, padded sizes): serving a rebuilt/repartitioned
+graph through a stale engine would be a shape error at best.  Engines for
+a superseded layout are dropped when a new shards bundle is installed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Tuple
+
+from lux_tpu.engine import methods
+from lux_tpu.graph.shards import PullShards
+from lux_tpu.serve.batched import BatchedEngine, make_program
+
+#: Q buckets pre-traced at service start.  1 covers the latency floor and
+#: the cold-degradation path, 64 the throughput bucket; 8 the middle.
+DEFAULT_Q_BUCKETS = (1, 8, 64)
+
+
+def layout_key(shards: PullShards) -> tuple:
+    """Hashable shard-geometry key: everything a compiled engine binds."""
+    s = shards.spec
+    return (s.num_parts, s.nv, s.ne, s.nv_pad, s.e_pad, s.weighted)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineKey:
+    app: str
+    method: str
+    layout: tuple
+    q: int
+
+
+class WarmEngineCache:
+    """Engine cache + pre-tracer.  ``get`` returns (engine, was_warm);
+    a miss builds AND executes the engine inline (the cold trace the
+    scheduler's degradation policy tries to keep at Q=1)."""
+
+    def __init__(self, shards: PullShards, apps=("sssp",),
+                 q_buckets=DEFAULT_Q_BUCKETS, method: str = "auto",
+                 num_iters: int = 10, max_iters: int = 10_000):
+        self.shards = shards
+        self.apps = tuple(apps)
+        self.q_buckets = tuple(sorted(set(int(q) for q in q_buckets)))
+        if self.q_buckets and self.q_buckets[0] < 1:
+            raise ValueError(f"q buckets must be >= 1: {self.q_buckets}")
+        self.num_iters = num_iters
+        self.max_iters = max_iters
+        self._layout = layout_key(shards)
+        # one resolution per app (reduce differs), shared by every bucket
+        self._method = {
+            app: methods.resolve(method, make_program(app, shards.spec.nv).reduce)
+            for app in self.apps
+        }
+        self._engines: Dict[EngineKey, BatchedEngine] = {}
+        # ONE device placement of the graph arrays, shared by every
+        # engine of this layout (a per-engine copy would multiply the
+        # O(E) arrays by the bucket count)
+        self._device_arrays = None
+        self._lock = threading.Lock()
+        self.warm_hits = 0
+        self.cold_traces = 0
+        self.warm_seconds = 0.0
+
+    def key(self, app: str, q: int) -> EngineKey:
+        return EngineKey(app=app, method=self._method[app],
+                         layout=self._layout, q=int(q))
+
+    def prewarm(self, apps=None, q_buckets=None) -> float:
+        """Trace + compile + run one dummy batch per (app, bucket);
+        returns the wall seconds spent (service-start cost, reported by
+        the bench drivers so it is never mistaken for request latency)."""
+        t0 = time.perf_counter()
+        for app in apps if apps is not None else self.apps:
+            for q in q_buckets if q_buckets is not None else self.q_buckets:
+                self._build(app, int(q)).warm()
+        spent = time.perf_counter() - t0
+        with self._lock:
+            self.warm_seconds += spent
+        return spent
+
+    def warm_buckets(self, app: str) -> tuple:
+        """Ascending Q buckets with a WARMED engine for ``app``."""
+        with self._lock:
+            return tuple(sorted(
+                k.q for k, e in self._engines.items()
+                if k.app == app and k.layout == self._layout and e._warmed
+            ))
+
+    def is_warm(self, app: str, q: int) -> bool:
+        with self._lock:
+            e = self._engines.get(self.key(app, q))
+        return e is not None and e._warmed
+
+    def _build(self, app: str, q: int) -> BatchedEngine:
+        import jax
+        import jax.numpy as jnp
+
+        k = self.key(app, q)
+        with self._lock:
+            eng = self._engines.get(k)
+            if eng is None:
+                if self._device_arrays is None:
+                    self._device_arrays = jax.tree.map(
+                        jnp.asarray, self.shards.arrays)
+                eng = BatchedEngine(
+                    self.shards, app, q, method=k.method,
+                    num_iters=self.num_iters, max_iters=self.max_iters,
+                    device_arrays=self._device_arrays,
+                )
+                self._engines[k] = eng
+        return eng
+
+    def get(self, app: str, q: int) -> Tuple[BatchedEngine, bool]:
+        """(engine, was_warm).  A cold get warms the engine inline —
+        callers that must not pay a large compile on the request path
+        degrade to q=1 first (scheduler policy).  Counter updates stay
+        under the cache lock (concurrent pumps must not lose hits);
+        the warm itself runs outside it, serialized by the engine's own
+        lock so a racing second pump blocks instead of double-compiling."""
+        eng = self._build(app, q)
+        with self._lock:
+            was_warm = eng._warmed
+            if was_warm:
+                self.warm_hits += 1
+            else:
+                self.cold_traces += 1
+        if was_warm:
+            return eng, True
+        t0 = time.perf_counter()
+        eng.warm()
+        with self._lock:
+            self.warm_seconds += time.perf_counter() - t0
+        return eng, False
+
+    def install_shards(self, shards: PullShards) -> None:
+        """Swap in a rebuilt graph layout; engines for the old geometry
+        are dropped (their compiled shapes no longer match)."""
+        with self._lock:
+            self.shards = shards
+            self._layout = layout_key(shards)
+            self._device_arrays = None  # re-place on next build
+            self._engines = {
+                k: e for k, e in self._engines.items()
+                if k.layout == self._layout
+            }
+
+    def stats(self) -> dict:
+        with self._lock:
+            warmed = sum(1 for e in self._engines.values() if e._warmed)
+            total = len(self._engines)
+            hits, cold = self.warm_hits, self.cold_traces
+        return {
+            "engines": total,
+            "engines_warm": warmed,
+            "warm_hits": hits,
+            "cold_traces": cold,
+            "warm_hit_ratio": round(hits / max(hits + cold, 1), 4),
+            "warm_seconds": round(self.warm_seconds, 3),
+        }

@@ -67,11 +67,21 @@ class RunConfig:
     repartition_every: int = 0
     #: recut when the window's max/mean per-part load exceeds this
     repartition_threshold: float = 1.25
+    #: --serve: run the app as a batched query service (lux_tpu.serve):
+    #: warm Q-bucket engines + micro-batching scheduler instead of one
+    #: whole-graph run
+    serve: bool = False
+    serve_queries: int = 64  # random query count when no explicit list
+    serve_sources: str = ""  # comma-separated query vertices (overrides)
+    serve_buckets: str = "1,8,64"  # warm Q buckets, pre-traced at start
+    serve_wait_ms: float = 5.0  # micro-batch coalescing window
+    serve_timeout_ms: float = 0.0  # per-request deadline (0 = none)
+    serve_max_queue: int = 256  # admission bound (backpressure past it)
 
 
 def parse_args(argv=None, description: str = "", sssp: bool = False,
                pull: bool = False, push: bool = False,
-               stream: bool = False) -> RunConfig:
+               stream: bool = False, serve: bool = False) -> RunConfig:
     """``sssp`` adds -start/--weighted; ``pull`` adds --exchange
     {allgather,ring,scatter}/--dtype; ``push`` adds --exchange
     {allgather,ring} (frontier apps: dense rounds can ring-stream, but
@@ -177,6 +187,26 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "vertices with dist < current bucket — "
                              "near-Dijkstra edge counts (0 = chaotic "
                              "relaxation)")
+    if serve:
+        sg = ap.add_argument_group(
+            "serving (lux_tpu.serve: batched multi-source query service)")
+        sg.add_argument("--serve", action="store_true",
+                        help="serve a burst of queries through warm "
+                             "batched engines + the micro-batching "
+                             "scheduler instead of one whole-graph run")
+        sg.add_argument("--serve-queries", type=int, default=64,
+                        help="number of random query vertices to serve")
+        sg.add_argument("--serve-sources", default="",
+                        help="comma-separated query vertices (overrides "
+                             "--serve-queries)")
+        sg.add_argument("--serve-buckets", default="1,8,64",
+                        help="warm Q buckets pre-traced at service start")
+        sg.add_argument("--serve-wait-ms", type=float, default=5.0,
+                        help="micro-batch coalescing window")
+        sg.add_argument("--serve-timeout-ms", type=float, default=0.0,
+                        help="per-request deadline (0 = none)")
+        sg.add_argument("--serve-max-queue", type=int, default=256,
+                        help="admission-queue bound (rejects past it)")
     if stream:
         # apps with a streamed driver (pagerank/colfilter pull-fixed,
         # components pull-until): host-offload edge streaming
@@ -218,4 +248,11 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         route_gather=getattr(ns, "route_gather", ""),
         repartition_every=getattr(ns, "repartition_every", 0),
         repartition_threshold=getattr(ns, "repartition_threshold", 1.25),
+        serve=getattr(ns, "serve", False),
+        serve_queries=getattr(ns, "serve_queries", 64),
+        serve_sources=getattr(ns, "serve_sources", ""),
+        serve_buckets=getattr(ns, "serve_buckets", "1,8,64"),
+        serve_wait_ms=getattr(ns, "serve_wait_ms", 5.0),
+        serve_timeout_ms=getattr(ns, "serve_timeout_ms", 0.0),
+        serve_max_queue=getattr(ns, "serve_max_queue", 256),
     )

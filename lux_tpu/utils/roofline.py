@@ -280,6 +280,29 @@ def push_run_model(
     return dense + sparse + TrafficModel(rounds * nv * (1 + 4), 0, 0)
 
 
+def serve_summarize(num_queries: int, elapsed_s: float,
+                    traversed_edges: int, latencies_s=None) -> dict:
+    """JSON-ready serving fields (the summarize() analog where the unit
+    of work is a REQUEST): queries/sec, aggregate traversed-edge GTEPS,
+    and latency percentiles (ms).  Batch occupancy lives with the batch
+    records (serve/metrics.ServeMetrics.summary) — one implementation."""
+    from lux_tpu.utils.timing import percentiles
+
+    out = {
+        "qps": round(num_queries / elapsed_s, 3) if elapsed_s > 0 else 0.0,
+        "queries": int(num_queries),
+        "gteps_aggregate": round(traversed_edges / elapsed_s / 1e9, 4)
+        if elapsed_s > 0 else 0.0,
+        "traversed_edges": int(traversed_edges),
+    }
+    if latencies_s:
+        out["latency_ms"] = {
+            k: round(v * 1e3, 3)
+            for k, v in percentiles(latencies_s).items()
+        }
+    return out
+
+
 def summarize(model: TrafficModel, elapsed_s: float, edges_done: int) -> dict:
     """JSON-ready roofline fields for a measured run."""
     out = {

@@ -82,6 +82,57 @@ class IterStats:
         )
 
 
+def percentiles(values, ps=(50, 95, 99)) -> dict:
+    """{"p50": ..., ...} over ``values`` (nearest-rank on the sorted
+    sample — the convention serving dashboards expect: p99 of 100 samples
+    is the 99th largest, never an interpolated value that no request
+    actually experienced).  Empty input yields an empty dict."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return {}
+    out = {}
+    for p in ps:
+        rank = max(int((p / 100.0) * len(vals) + 0.999999) - 1, 0)
+        out[f"p{p}"] = vals[min(rank, len(vals) - 1)]
+    return out
+
+
+class LatencyHistogram:
+    """Per-request latency recorder for the serving path: record seconds,
+    summarize as millisecond percentiles (the structured-stats sibling of
+    IterStats — requests instead of iterations).
+
+    Bounded: past ``max_samples`` the recorder switches to reservoir
+    sampling (uniform over the full stream, deterministic seed), so a
+    long-lived service keeps O(max_samples) memory and statistically
+    valid percentiles instead of one float per request forever."""
+
+    def __init__(self, max_samples: int = 65_536):
+        import random
+
+        self.samples: List[float] = []
+        self.count = 0
+        self.max_samples = max_samples
+        self._rng = random.Random(0x1c3)
+
+    def record(self, seconds: float):
+        self.count += 1
+        if len(self.samples) < self.max_samples:
+            self.samples.append(float(seconds))
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.max_samples:
+                self.samples[j] = float(seconds)
+
+    def __len__(self) -> int:
+        return self.count
+
+    def summary_ms(self, ps=(50, 95, 99)) -> dict:
+        return {
+            k: round(v * 1e3, 3) for k, v in percentiles(self.samples, ps).items()
+        }
+
+
 def report_elapsed(seconds: float, ne: int, iters: int,
                    traversed: Optional[int] = None) -> float:
     """Print the end-of-run summary; returns GTEPS (BASELINE.md metric:

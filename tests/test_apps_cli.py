@@ -417,3 +417,56 @@ def test_pagerank_cli_profile_trace(tmp_path, capsys):
     assert "profiler trace written" in capsys.readouterr().out
     found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
     assert found, "no trace files written"
+
+
+def test_sssp_cli_serve(capsys):
+    """--serve: warm buckets, serve a burst through the scheduler, emit
+    the JSON metrics line, and -check validates every answer."""
+    import json
+
+    args = SMALL + ["--serve", "--serve-queries", "5",
+                    "--serve-buckets", "1,4", "-check"]
+    assert sssp_app.main(args) == 0
+    out = capsys.readouterr().out
+    line = [ln for ln in out.splitlines() if ln.startswith('{"metric"')][0]
+    stats = json.loads(line)
+    assert stats["metric"] == "sssp_serve"
+    assert stats["completed"] == 5 and stats["timeouts"] == 0
+    assert set(stats["latency_ms"]) == {"p50", "p95", "p99"}
+    assert stats["engine_cache"]["engines_warm"] == 2
+    assert "[PASS] sssp serve check" in out
+
+
+def test_pagerank_cli_serve(capsys):
+    import json
+
+    args = SMALL + ["-ni", "4", "--serve", "--serve-queries", "3",
+                    "--serve-buckets", "4", "-check"]
+    assert pr_app.main(args) == 0
+    out = capsys.readouterr().out
+    line = [ln for ln in out.splitlines() if ln.startswith('{"metric"')][0]
+    stats = json.loads(line)
+    assert stats["metric"] == "ppr_serve" and stats["completed"] == 3
+    assert stats["batch_occupancy"] == 0.75  # 3 real queries padded to 4
+    assert "[PASS] ppr serve check" in out
+
+
+def test_serve_cli_rejects_bad_combinations():
+    with pytest.raises(SystemExit, match="does not combine"):
+        sssp_app.main(SMALL + ["--serve", "--distributed"])
+    with pytest.raises(SystemExit, match="does not combine"):
+        sssp_app.main(SMALL + ["--serve", "--weighted"])
+    with pytest.raises(SystemExit, match="bad vertex list"):
+        sssp_app.main(SMALL + ["--serve", "--serve-sources", "1,x"])
+    with pytest.raises(SystemExit, match="must be in"):
+        sssp_app.main(SMALL + ["--serve", "--serve-sources", "999999"])
+    with pytest.raises(SystemExit, match="buckets must be"):
+        sssp_app.main(SMALL + ["--serve", "--serve-buckets", "0,4"])
+
+
+def test_serve_cli_explicit_sources(capsys):
+    assert sssp_app.main(
+        SMALL + ["--serve", "--serve-sources", "3,9", "--serve-buckets", "2"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert '"completed": 2' in out

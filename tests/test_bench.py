@@ -31,14 +31,19 @@ def test_bench_happy_path_multi_app():
         json.loads(s) for s in r.stdout.strip().splitlines()
         if s.startswith("{")
     ]
-    # >=3 metric lines: one per app family, headline (pagerank) LAST
-    fams = [ln["metric"].split("_")[0] for ln in lines]
-    assert set(fams) >= {"pagerank", "sssp", "colfilter"}, fams
-    assert fams[-1] == "pagerank"
+    # >=3 metric lines: one per app family (app + unit stem, so the
+    # sssp_gteps engine row and the sssp_qps serving row are distinct
+    # families), headline (pagerank) LAST
+    fams = [ln["metric"].split("_rmat")[0] for ln in lines]
+    assert set(fams) >= {"pagerank_gteps", "sssp_gteps",
+                         "colfilter_gteps", "sssp_qps"}, fams
+    assert fams[-1] == "pagerank_gteps"
     assert len(fams) == len(set(fams))  # exactly one line per family
     for ln in lines:
-        assert ln["unit"] == "GTEPS"
+        assert ln["unit"] == ("QPS" if "_qps_" in ln["metric"] else "GTEPS")
         assert ln["value"] > 0
+    qps = next(ln for ln in lines if "_qps_" in ln["metric"])
+    assert qps["batched_vs_q1"] > 0 and qps["scheduler"]["completed"] > 0
     cf = next(ln for ln in lines if ln["metric"].startswith("colfilter"))
     assert cf["rmse"] > 0 and cf["iter_ms"] > 0
     sp = next(ln for ln in lines if ln["metric"].startswith("sssp"))

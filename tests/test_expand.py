@@ -6,6 +6,8 @@ engine integration: run_pull_fixed with route= must be bitwise equal to
 the direct-gather engine on every app/reduce combination tried, at P=1
 and vmapped P>1.
 """
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -576,3 +578,119 @@ def test_routed_on_heavy_tail_ba():
         route=E.plan_fused_shards(shards, "sum"))
     np.testing.assert_allclose(np.asarray(fused), np.asarray(direct),
                                rtol=1e-5, atol=1e-7)
+
+
+def test_narrow_idx_rejects_above_lane():
+    """u8 narrowing admits ONLY digit-local values (< 128): [128, 256)
+    fits a uint8 but would gather out of bounds under promise_in_bounds."""
+    ok = E._narrow_idx(np.arange(128, dtype=np.int64).reshape(8, 16))
+    assert ok.dtype == np.uint8
+    with pytest.raises(AssertionError):
+        E._narrow_idx(np.array([128], np.int64))
+    # bool ff masks pass through untouched
+    m = np.array([True, False])
+    assert E._narrow_idx(m) is m
+
+
+def test_cache_key_folds_shape_and_dtype():
+    """Byte-identical arrays with different layouts must key differently
+    (replaying a plan across layouts would gather garbage)."""
+    import hashlib
+
+    a = np.arange(16, dtype=np.int32)
+
+    def key(arr):
+        h = hashlib.sha1()
+        E._hash_array(h, arr)
+        return h.hexdigest()
+
+    assert key(a) != key(a.reshape(4, 4))
+    assert key(a) != key(a.view(np.float32))
+    assert key(a) == key(a.copy())
+
+
+def test_plan_cache_npz_roundtrip(tmp_path, rng):
+    """The disk cache stores npz+json (no pickle): a second build loads
+    the identical plan — equal statics (jit-static equality) and equal
+    arrays — and the file parses with allow_pickle=False."""
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+
+    g = generate.rmat(7, 4, seed=9)
+    shards = build_pull_shards(g, 2)
+    cdir = str(tmp_path / "cache")
+    s1, a1 = E.plan_expand_shards_cached(shards, cache_dir=cdir)
+    files = [f for f in os.listdir(cdir)]
+    assert files and all(f.endswith(".npz") for f in files)
+    with np.load(os.path.join(cdir, files[0]), allow_pickle=False) as z:
+        assert "__static__" in z.files  # loads without pickle at all
+    s2, a2 = E.plan_expand_shards_cached(shards, cache_dir=cdir)
+    assert s1 == s2 and hash(s1) == hash(s2)
+    assert len(a1) == len(a2)
+    for x, y in zip(a1, a2):
+        assert x.dtype == y.dtype and np.array_equal(x, y)
+    # the loaded plan replays bitwise on real edge slots, like the built
+    # one (padding slots are junk by contract)
+    full = rng.standard_normal(shards.spec.gathered_size).astype(np.float32)
+    for p in range(2):
+        got = jax.jit(
+            lambda v: E.apply_expand(
+                v, s2, tuple(jnp.asarray(a[p]) for a in a2), interpret=True
+            )
+        )(jnp.asarray(full))
+        want = E.apply_expand_np(shards.arrays.src_pos[p], full)
+        mask = shards.arrays.edge_mask[p]
+        np.testing.assert_array_equal(np.asarray(got)[mask], want[mask])
+
+
+def test_fused_and_cf_statics_roundtrip_json():
+    """Every static vocabulary member survives the JSON codec with
+    equality (FusedStatic carries nested groups; CFRouteStatic nests two
+    ExpandStatics)."""
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+
+    g = generate.rmat(6, 4, seed=3, weighted=True)
+    shards = build_pull_shards(g, 1)
+    fs, _ = E.plan_fused_shards(shards, "sum")
+    assert E._static_from_obj(E._static_to_obj(fs)) == fs
+    cs, _ = E.plan_cf_route_shards(shards)
+    assert E._static_from_obj(E._static_to_obj(cs)) == cs
+
+
+def test_untrusted_cache_dir_degrades_to_build(tmp_path):
+    """A symlinked or world-writable cache dir is never read OR written —
+    plans still build correctly, just uncached."""
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+
+    g = generate.rmat(6, 4, seed=2)
+    shards = build_pull_shards(g, 1)
+    loose = tmp_path / "loose"
+    loose.mkdir()
+    os.chmod(loose, 0o777)
+    assert not E._cache_dir_trusted(str(loose))
+    s1, _ = E.plan_expand_shards_cached(shards, cache_dir=str(loose))
+    assert list(loose.iterdir()) == []  # nothing written into it
+    link = tmp_path / "link"
+    os.symlink(loose, link)
+    assert not E._cache_dir_trusted(str(link))
+    tight = tmp_path / "tight"
+    s2, _ = E.plan_expand_shards_cached(shards, cache_dir=str(tight))
+    assert E._cache_dir_trusted(str(tight))
+    assert (os.stat(tight).st_mode & 0o777) == 0o700
+    assert s1 == s2
+
+
+def test_corrupt_cache_file_rebuilds(tmp_path):
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+
+    g = generate.rmat(6, 4, seed=2)
+    shards = build_pull_shards(g, 1)
+    cdir = tmp_path / "c"
+    s1, _ = E.plan_expand_shards_cached(shards, cache_dir=str(cdir))
+    (path,) = list(cdir.iterdir())
+    path.write_bytes(b"not an npz")
+    s2, _ = E.plan_expand_shards_cached(shards, cache_dir=str(cdir))
+    assert s1 == s2  # rebuilt (and re-cached) rather than crashed

@@ -211,3 +211,58 @@ def test_record_overlay_entry_invalidates_caches(monkeypatch, tmp_path):
     methods.record_overlay_entry(
         "tpu:pallas_tiles", {"v_blk": 256, "t_chunk": 512})
     assert methods.pallas_tiles() == (256, 512)
+
+
+def test_record_overlay_merges_dict_entries(monkeypatch, tmp_path):
+    """A recorded measurement must survive subsequent records — both of a
+    DIFFERENT method's sub-row under the same key (the round-5 clobber:
+    a later micro-race write dropped the banked mxsum/gather rows) and of
+    a different key entirely (the race winner)."""
+    import json
+
+    path = tmp_path / "winners.json"
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(path))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
+
+    methods.record_overlay_entry("tpu:sum", "scatter")
+    methods.record_overlay_entry(
+        "tpu:micro_sum", {"scale": 17, "ms_per_rep": {"mxsum": 2.0}}
+    )
+    # a later record for a DIFFERENT method merges, never overwrites
+    methods.record_overlay_entry(
+        "tpu:micro_sum", {"ms_per_rep": {"route": 0.3}}
+    )
+    data = json.loads(path.read_text())
+    assert data["tpu:micro_sum"]["ms_per_rep"] == {
+        "mxsum": 2.0, "route": 0.3
+    }
+    assert data["tpu:micro_sum"]["scale"] == 17
+    # the race winner recorded first survived the micro-row records
+    assert data["tpu:sum"] == "scatter"
+    assert methods.resolve("auto", "sum", platform="tpu") == "scatter"
+    # scalar re-records still overwrite (a winner is a decision)
+    methods.record_overlay_entry("tpu:sum", "scan")
+    assert json.loads(path.read_text())["tpu:sum"] == "scan"
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
+
+
+def test_shipped_winners_overlay_has_no_quarantined_sum_row():
+    """Regression for the VERDICT r5 contradiction: the repo's shipped
+    overlay must never record scan — the documented tunnel-wedger,
+    quarantined to last place in docs/PERF.md — as a measured tpu:sum
+    winner, and the round-5 micro rows must stay banked."""
+    import json
+    import os
+
+    repo_overlay = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".lux_winners.json",
+    )
+    data = json.loads(open(repo_overlay).read())
+    assert data.get("tpu:sum") != "scan"
+    micro = data.get("tpu:micro_sum", {}).get("ms_per_rep", {})
+    assert "mxsum" in micro and "route" in micro and "gather" in micro

@@ -1,0 +1,157 @@
+"""serve/batched: bitwise parity with the one-shot engines, per-query
+convergence masking, and the query-axis contract.
+
+The headline acceptance pin: batched multi-source SSSP on rmat16 equals
+Q independent single-source engine/push.py runs BITWISE for
+Q in {1, 8, 64}, including early-converging queries in a mixed batch.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine import push
+from lux_tpu.graph import generate
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models import sssp as sssp_model
+from lux_tpu.serve.batched import (
+    BatchedEngine,
+    MultiSourcePPR,
+    MultiSourceSSSP,
+)
+
+
+@pytest.fixture(scope="module")
+def rmat16():
+    g = generate.rmat(16, 16, seed=7)
+    return g, build_push_shards(g, 1)
+
+
+def _push_reference(pshards, sources):
+    """Independent single-source engine/push.py runs (ONE compiled loop,
+    the engine's own compile cache) -> (len(sources), nv) distances."""
+    import jax
+    import jax.numpy as jnp
+
+    proto = sssp_model.SSSPProgram(nv=pshards.spec.nv, start=0)
+    loop = push.compile_push_chunk(proto, pshards.pspec, pshards.spec)
+    arrays = jax.tree.map(jnp.asarray, pshards.arrays)
+    parrays = jax.tree.map(jnp.asarray, pshards.parrays)
+    out = []
+    for s in sources:
+        prog = dataclasses.replace(proto, start=int(s))
+        carry = push._init_carry(prog, pshards.pspec, arrays)
+        res = loop(arrays, parrays, carry, jnp.int32(10_000))
+        out.append(pshards.scatter_to_global(np.asarray(res.state)))
+    return np.stack(out)
+
+
+def _mixed_sources(g, n):
+    """n distinct sources, a MIXED convergence profile: the hub (deepest
+    run), a zero-out-degree vertex when one exists (converges in one
+    round), plus low- and mid-degree vertices."""
+    deg = np.bincount(g.col_idx, minlength=g.nv)
+    order = np.argsort(deg)
+    picks = [int(np.argmax(deg))]
+    if deg[order[0]] == 0:
+        picks.append(int(order[0]))  # early-converging: no out-edges
+    lo = order[deg[order] > 0]
+    picks.extend(int(v) for v in lo[: n])
+    picks.extend(int(v) for v in order[::-1][1: n])
+    uniq = list(dict.fromkeys(picks))[:n]
+    assert len(uniq) == n
+    return np.asarray(uniq, np.int32)
+
+
+def test_batched_sssp_bitwise_vs_push_rmat16(rmat16):
+    g, pshards = rmat16
+    refs16 = _mixed_sources(g, 16)
+    want = _push_reference(pshards, refs16)
+
+    shards = pshards.pull
+    # Q = 1 and Q = 8: direct slices of the reference set
+    got1 = BatchedEngine(shards, "sssp", 1).run(refs16[:1]).state
+    assert np.array_equal(got1, want[:1])
+    got8 = BatchedEngine(shards, "sssp", 8).run(refs16[:8]).state
+    assert np.array_equal(got8, want[:8])
+    # Q = 64: the 16 reference sources tiled — every one of the 64
+    # queries is checked against its own independent push run, and the
+    # batch mixes early-converging with deep queries
+    q64 = np.tile(refs16, 4)
+    out = BatchedEngine(shards, "sssp", 64).run(q64)
+    assert np.array_equal(out.state, want[np.tile(np.arange(16), 4)])
+    # per-query masking: rounds differ across the mixed batch, and a
+    # finished query stopped contributing traversed edges
+    assert out.rounds.min() < out.rounds.max()
+    assert min(out.traversed) < max(out.traversed)
+    assert out.iters == int(out.rounds.max())
+
+
+def test_batched_sssp_small_vs_bfs_oracle():
+    g = generate.rmat(10, 8, seed=3)
+    shards = build_pull_shards(g, 4)  # multi-part stacking too
+    srcs = _mixed_sources(g, 6)
+    out = BatchedEngine(shards, "sssp", 6).run(srcs)
+    for i, s in enumerate(srcs):
+        assert np.array_equal(out.state[i], sssp_model.bfs_reference(g, int(s)))
+
+
+def test_sssp_batched_library_helper():
+    g = generate.rmat(9, 8, seed=5)
+    srcs = _mixed_sources(g, 3)
+    got = sssp_model.sssp_batched(g, srcs, num_parts=2)
+    for i, s in enumerate(srcs):
+        assert np.array_equal(got[i], sssp_model.sssp(g, start=int(s),
+                                                      num_parts=2))
+
+
+def test_batched_ppr_matches_single_seed_pull():
+    """Each batched PPR column equals the single-seed PPRProgram pull run
+    BITWISE (lane-independent reducers), and approximates the float64
+    host oracle."""
+    from lux_tpu.engine import pull
+    from lux_tpu.models.pagerank import PPRProgram, ppr_reference
+
+    g = generate.rmat(10, 8, seed=11)
+    shards = build_pull_shards(g, 2)
+    seeds = _mixed_sources(g, 4)
+    out = BatchedEngine(shards, "ppr", 4, num_iters=8).run(seeds)
+    for i, s in enumerate(seeds):
+        prog = PPRProgram(nv=g.nv, seed=int(s))
+        s0 = pull.init_state(prog, shards.arrays)
+        single = pull.run_pull_fixed(prog, shards.spec, shards.arrays, s0, 8)
+        assert np.array_equal(out.state[i],
+                              shards.scatter_to_global(np.asarray(single)))
+        want = ppr_reference(g, int(s), 8)
+        np.testing.assert_allclose(out.state[i], want, rtol=2e-4, atol=1e-7)
+
+
+def test_ppr_mass_concentrates_at_seed():
+    g = generate.rmat(9, 8, seed=2)
+    shards = build_pull_shards(g, 1)
+    deg = np.bincount(g.col_idx, minlength=g.nv)
+    seed = int(np.argmax(deg))
+    out = BatchedEngine(shards, "ppr", 1, num_iters=10).run([seed])
+    ranks = out.state[0] * np.maximum(deg, 1)  # undo the pre-division
+    assert int(np.argmax(ranks)) == seed  # teleport mass pins the seed
+
+
+def test_engine_validates_inputs():
+    g = generate.rmat(8, 4, seed=1)
+    shards = build_pull_shards(g, 1)
+    eng = BatchedEngine(shards, "sssp", 2)
+    with pytest.raises(ValueError, match="compiled for Q=2"):
+        eng.run([1, 2, 3])
+    with pytest.raises(ValueError, match="out of range"):
+        eng.run([0, g.nv])
+    with pytest.raises(ValueError, match="unknown served app"):
+        BatchedEngine(shards, "nope", 1)
+    with pytest.raises(ValueError, match="q must be"):
+        BatchedEngine(shards, "sssp", 0)
+
+
+def test_programs_are_hashable_statics():
+    # the compile caches key on the program dataclasses
+    assert hash(MultiSourceSSSP(nv=10)) == hash(MultiSourceSSSP(nv=10))
+    assert MultiSourcePPR(nv=10) == MultiSourcePPR(nv=10)

@@ -1,0 +1,195 @@
+"""serve/scheduler: coalescing, deadlines, backpressure, cold
+degradation — driven deterministically with a fake clock and a fake
+engine cache (no jax in the policy path)."""
+import numpy as np
+import pytest
+
+from lux_tpu.serve.metrics import ServeMetrics
+from lux_tpu.serve.scheduler import (
+    MicroBatchScheduler,
+    RejectedError,
+    ServeTimeoutError,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class FakeResult:
+    def __init__(self, queries):
+        self.queries = list(queries)
+        self.iters = 3
+        self.rounds = np.full(len(queries), 3, np.int32)
+        self.traversed = [100] * len(queries)
+
+    def query_state(self, i):
+        return np.asarray([self.queries[i]])  # echo the query back
+
+
+class FakeEngine:
+    def __init__(self, q, fail=False):
+        self.q = q
+        self.fail = fail
+        self.calls = []
+
+    def run(self, queries):
+        assert len(queries) == self.q
+        if self.fail:
+            raise RuntimeError("engine exploded")
+        self.calls.append(list(queries))
+        return FakeResult(queries)
+
+
+class FakeCache:
+    """warm_buckets/get/is_warm shim around FakeEngines."""
+
+    def __init__(self, warm=(4,), fail=False):
+        self._warm = tuple(sorted(warm))
+        self.engines = {}
+        self.fail = fail
+        self.cold_traces = 0
+        self.warm_hits = 0
+
+    def warm_buckets(self, app):
+        return self._warm
+
+    def get(self, app, q):
+        eng = self.engines.setdefault(q, FakeEngine(q, fail=self.fail))
+        warm = q in self._warm
+        if warm:
+            self.warm_hits += 1
+        else:
+            self.cold_traces += 1
+        return eng, warm
+
+    def stats(self):
+        return {"warm_hits": self.warm_hits,
+                "cold_traces": self.cold_traces}
+
+
+def make(warm=(4,), **kw):
+    clock = FakeClock()
+    cache = FakeCache(warm=warm, fail=kw.pop("fail", False))
+    sched = MicroBatchScheduler(cache, app="sssp", clock=clock,
+                                metrics=ServeMetrics(), **kw)
+    return sched, cache, clock
+
+
+def test_coalesces_within_wait_window():
+    sched, cache, clock = make(warm=(4,), max_wait_ms=10.0)
+    futs = [sched.submit(i) for i in range(3)]
+    # window not elapsed, bucket not full: nothing dispatches
+    assert sched.step() == 0
+    assert not futs[0].done()
+    clock.t = 0.011  # past max_wait_ms
+    assert sched.step() == 3
+    # one padded batch in the smallest covering bucket
+    assert cache.engines[4].calls == [[0, 1, 2, 0]]
+    assert [f.result(timeout=0)[0] for f in futs] == [0, 1, 2]
+    b = sched.metrics.batches[0]
+    assert (b.q, b.real, b.warm) == (4, 3, True)
+
+
+def test_full_bucket_dispatches_without_waiting():
+    sched, cache, clock = make(warm=(2, 4), max_wait_ms=1e6)
+    for i in range(4):
+        sched.submit(i)
+    assert sched.step() == 4  # t == 0: no window elapsed, bucket full
+    assert cache.engines[4].calls == [[0, 1, 2, 3]]
+
+
+def test_overflow_drains_in_bucket_sized_batches():
+    sched, cache, clock = make(warm=(4,), max_wait_ms=0.0)
+    futs = [sched.submit(i) for i in range(6)]
+    assert sched.step() == 4
+    assert sched.pending() == 2
+    assert sched.step() == 2  # remainder padded into the same bucket
+    assert cache.engines[4].calls == [[0, 1, 2, 3], [4, 5, 4, 4]]
+    assert all(f.done() for f in futs)
+
+
+def test_deadline_expiry_returns_timeout_not_hang():
+    sched, cache, clock = make(warm=(4,), max_wait_ms=1e6)
+    fut = sched.submit(7, timeout_ms=5.0)
+    clock.t = 0.006  # past the deadline while still queued
+    assert sched.step() == 1  # resolved AS a timeout
+    with pytest.raises(ServeTimeoutError):
+        fut.result(timeout=0)
+    assert sched.metrics.timeouts == 1
+    assert cache.engines == {}  # nothing ever dispatched
+
+
+def test_result_wall_guard_never_hangs():
+    sched, _, _ = make()
+    fut = sched.submit(1)
+    with pytest.raises(ServeTimeoutError):
+        fut.result(timeout=0.01)  # nobody is pumping: guard fires
+
+
+def test_tight_deadline_forces_early_dispatch():
+    sched, cache, clock = make(warm=(4,), max_wait_ms=1000.0)
+    fut = sched.submit(3, timeout_ms=50.0)
+    # waiting out the 1 s window would blow the 50 ms deadline: dispatch
+    assert sched.step() == 1
+    assert fut.result(timeout=0)[0] == 3
+
+
+def test_bounded_queue_rejects_with_retry_after():
+    sched, _, clock = make(warm=(4,), max_queue=2, max_wait_ms=1e6)
+    sched.submit(0)
+    sched.submit(1)
+    with pytest.raises(RejectedError) as e:
+        sched.submit(2)
+    assert e.value.retry_after_ms > 0
+    assert sched.metrics.rejected == 1
+    assert sched.pending() == 2  # rejected request never queued
+
+
+def test_cold_shape_degrades_to_q1():
+    sched, cache, clock = make(warm=(), max_wait_ms=0.0)
+    futs = [sched.submit(i) for i in range(3)]
+    sched.drain()
+    # nothing warm: served singly through the cold Q=1 engine
+    assert cache.engines[1].calls == [[0], [1], [2]]
+    assert cache.cold_traces >= 1
+    assert [f.result(timeout=0)[0] for f in futs] == [0, 1, 2]
+    assert sched.metrics.summary()["warm_batch_ratio"] == 0.0
+
+
+def test_engine_failure_resolves_requests_with_error():
+    sched, cache, clock = make(warm=(2,), max_wait_ms=0.0, fail=True)
+    fut = sched.submit(5)
+    sched.step()
+    with pytest.raises(RuntimeError, match="engine exploded"):
+        fut.result(timeout=0)
+
+
+def test_metrics_summary_shape():
+    sched, cache, clock = make(warm=(4,), max_wait_ms=0.0)
+    for i in range(4):
+        sched.submit(i)
+    sched.step()
+    s = sched.metrics.summary(elapsed_s=1.0, cache_stats=cache.stats())
+    assert s["completed"] == 4
+    assert s["qps"] == 4.0
+    assert s["batch_occupancy"] == 1.0
+    assert set(s["latency_ms"]) == {"p50", "p95", "p99"}
+    assert s["engine_cache"]["warm_hits"] == 1
+
+
+def test_threaded_loop_end_to_end():
+    """Background-thread mode with the REAL clock (tiny window)."""
+    cache = FakeCache(warm=(4,))
+    sched = MicroBatchScheduler(cache, app="sssp", max_wait_ms=2.0,
+                                metrics=ServeMetrics()).start()
+    try:
+        futs = [sched.submit(i) for i in range(3)]
+        got = [f.result(timeout=5.0)[0] for f in futs]
+        assert got == [0, 1, 2]
+    finally:
+        sched.stop()

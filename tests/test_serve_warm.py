@@ -1,0 +1,73 @@
+"""serve/warm: engine cache keys, pre-tracing, hit accounting, layout
+invalidation, and winners-overlay method resolution reuse."""
+import json
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine import methods
+from lux_tpu.graph import generate
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.serve.warm import EngineKey, WarmEngineCache, layout_key
+
+
+@pytest.fixture(scope="module")
+def small():
+    g = generate.rmat(8, 4, seed=4)
+    return g, build_pull_shards(g, 2)
+
+
+def test_prewarm_and_hit_accounting(small):
+    g, shards = small
+    cache = WarmEngineCache(shards, apps=("sssp",), q_buckets=(1, 4))
+    assert cache.warm_buckets("sssp") == ()
+    spent = cache.prewarm()
+    assert spent > 0 and cache.warm_buckets("sssp") == (1, 4)
+    eng, warm = cache.get("sssp", 4)
+    assert warm and eng.q == 4
+    assert cache.stats()["warm_hits"] == 1
+    # an unwarmed bucket is a cold trace; afterwards it reads warm
+    _, warm = cache.get("sssp", 2)
+    assert not warm
+    _, warm2 = cache.get("sssp", 2)
+    assert warm2
+    st = cache.stats()
+    assert st["cold_traces"] == 1 and st["warm_hits"] == 2
+    assert 0 < st["warm_hit_ratio"] < 1
+    out = eng.run(np.asarray([0, 1, 2, 3], np.int32))
+    assert out.state.shape == (4, g.nv)
+
+
+def test_engine_key_binds_layout(small):
+    g, shards = small
+    cache = WarmEngineCache(shards, apps=("sssp",), q_buckets=(2,))
+    cache.prewarm()
+    assert cache.is_warm("sssp", 2)
+    other = build_pull_shards(g, 4)  # different part geometry
+    assert layout_key(other) != layout_key(shards)
+    cache.install_shards(other)
+    # old-layout engines dropped: the compiled shapes no longer match
+    assert not cache.is_warm("sssp", 2)
+    cache.prewarm()
+    eng, _ = cache.get("sssp", 2)
+    want = [np.argmax(np.bincount(g.col_idx, minlength=g.nv)), 0]
+    out = eng.run(np.asarray(want, np.int32))
+    from lux_tpu.models.sssp import bfs_reference
+
+    assert np.array_equal(out.state[0], bfs_reference(g, int(want[0])))
+
+
+def test_method_resolution_reuses_overlay(small, monkeypatch, tmp_path):
+    _, shards = small
+    path = tmp_path / "winners.json"
+    path.write_text(json.dumps({"cpu:min": "scan"}))
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(path))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
+    cache = WarmEngineCache(shards, apps=("sssp", "ppr"), q_buckets=(1,))
+    # sssp reduces with min -> the overlay row redirects it; ppr (sum)
+    # keeps the static cpu winner
+    assert cache.key("sssp", 1).method == "scan"
+    assert cache.key("ppr", 1).method == methods.WINNERS[("cpu", "sum")]
+    assert isinstance(cache.key("sssp", 1), EngineKey)

@@ -74,3 +74,30 @@ def test_apply_route_composes_under_jit(rng):
 
     np.testing.assert_allclose(
         np.asarray(step(jnp.asarray(x))), x[perm] * 2.0, rtol=1e-6)
+
+
+def test_plan_route_nondividing_digit_takes_sublane():
+    """A digit that does not divide 128 must NOT ride the widened lane
+    path (its (lane//d)*d fixup would cross block boundaries and gather
+    garbage under promise_in_bounds): it falls through to the sublane
+    kernel, whose d <= 8 assert fails loudly for oversized digits."""
+    # d=96: > 8 and 128 % 96 != 0; n = 96*128 >= LANE would have taken
+    # the lane branch before the guard
+    shape = (96, 128)
+    idx = np.zeros(shape, np.int32)
+    r = R.Route(n=96 * 128, dims=shape,
+                passes=[R.Pass(shape=shape, axis=0, idx=idx)])
+    plan = S.plan_route(r)
+    assert plan.passes[0].kind == "sublane"
+    with pytest.raises(AssertionError):  # loud, not garbage
+        S.sublane_gather(jnp.zeros(plan.passes[0].kshape, jnp.float32),
+                            jnp.asarray(plan.passes[0].idx), interpret=True)
+
+
+def test_plan_route_dividing_small_digit_still_rides_lane():
+    shape = (4, 128)
+    idx = np.zeros(shape, np.int32)
+    r = R.Route(n=4 * 128, dims=shape,
+                passes=[R.Pass(shape=shape, axis=0, idx=idx)])
+    plan = S.plan_route(r)
+    assert plan.passes[0].kind == "lane"

@@ -1,0 +1,88 @@
+"""Serving benchmark driver: warm batched throughput vs Q=1 sequential.
+
+Measures the lux_tpu.serve path on one chip (or the CPU fallback) and
+emits bench.py-parsable JSON lines:
+
+  * ``<app>_qps_rmat<scale>_1chip[<suffix>]`` — warm batched QPS at the
+    throughput bucket (value), with the warm Q=1 sequential QPS, the
+    batched-vs-sequential speedup, end-to-end scheduler latency
+    percentiles (p50/p95/p99 ms), batch occupancy, queue stats, and the
+    warm-vs-cold engine hit ratio as extra fields.
+
+The acceptance bar this driver tracks: warm Q=64 batched throughput
+>= 5x warm Q=1 sequential throughput on rmat16 sssp (CPU fallback) —
+the batching win of the trailing-query-axis engines
+(lux_tpu/serve/batched.py) over request-at-a-time serving.
+
+Usage:
+  python tools/serve_bench.py [--rmat-scale 16] [--rmat-ef 16] [--q 64]
+      [--app sssp|ppr] [--num-seq 8] [--reps 2] [--method auto]
+      [--min-speedup 0] [--seed 0]
+
+A nonzero --min-speedup turns the run into a gate: exit 1 when
+batched/sequential falls below it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rmat-scale", type=int, default=16)
+    ap.add_argument("--rmat-ef", type=int, default=16)
+    ap.add_argument("--app", default="sssp", choices=["sssp", "ppr"])
+    ap.add_argument("--q", type=int, default=64,
+                    help="throughput bucket size")
+    ap.add_argument("--num-seq", type=int, default=8,
+                    help="queries in the warm Q=1 sequential baseline")
+    ap.add_argument("--reps", type=int, default=2,
+                    help="full Q-batches in the batched measurement")
+    ap.add_argument("--num-parts", type=int, default=1)
+    ap.add_argument("--method", default="auto")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--min-speedup", type=float, default=0.0,
+                    help="exit 1 if batched/sequential < this (CI gate)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.serve.benchmarks import measure_serving
+
+    g = generate.rmat(args.rmat_scale, args.rmat_ef, seed=0)
+    shards = build_pull_shards(g, args.num_parts)
+    print(f"# serve_bench: nv={g.nv} ne={g.ne} app={args.app} q={args.q} "
+          f"platform={jax.default_backend()}", file=sys.stderr, flush=True)
+    res = measure_serving(
+        g, shards, app=args.app, q=args.q, num_seq=args.num_seq,
+        batched_reps=args.reps, method=args.method, seed=args.seed,
+    )
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    suffix = "" if on_tpu else f"_{jax.default_backend()}_fallback"
+    line = {
+        "metric": f"{args.app}_qps_rmat{args.rmat_scale}_1chip{suffix}",
+        "value": res["qps_batched"],
+        "unit": "QPS",
+        # baseline for the serving row IS request-at-a-time serving:
+        # the batched/sequential ratio is the number that justifies the
+        # subsystem
+        "vs_baseline": res["batched_vs_q1"],
+        **res,
+    }
+    print(json.dumps(line), flush=True)
+    if args.min_speedup and res["batched_vs_q1"] < args.min_speedup:
+        print(f"# FAIL: batched/sequential {res['batched_vs_q1']} < "
+              f"{args.min_speedup}", file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
