@@ -41,6 +41,18 @@ Env knobs:
                    and always prints last.  "serve" is the batched
                    query-serving row (lux_tpu.serve): sssp_qps_* — warm
                    Q=64 batched QPS vs warm Q=1 sequential.
+  LUX_BENCH_RELAY_CAP_S (default 240) grace past last-seen-alive while the
+                   relay endpoint is down.  The TPU-claim wait is ADAPTIVE
+                   (_wait_tpu): liveness is re-probed throughout, so a
+                   relay that dies stops burning budget and one that comes
+                   alive re-extends the wait to the full window.
+  LUX_ROUTE_THREADS / LUX_PLAN_THREADS (default: all cores) native Euler-
+                   colorer / Python planner fan-out for routed-plan
+                   construction (ops/expand).  The routed-race plan builds
+                   on background threads DURING the unrouted race
+                   (expand.plan_async), and every row carries cumulative
+                   cold/warm ``plan_build_seconds`` so amortization claims
+                   are checkable from the driver artifact alone.
 """
 from __future__ import annotations
 
@@ -49,6 +61,7 @@ import os
 import subprocess
 import sys
 import time
+from concurrent.futures import TimeoutError as _FUTURE_TIMEOUT
 
 # Paper-era Lux runs ~1 GTEPS/GPU-class-chip on PageRank per the PVLDB paper
 # family of results; the repo itself publishes nothing (BASELINE.md).
@@ -59,12 +72,36 @@ def _emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+def _plan_build_field():
+    """Cumulative routed-plan construction accounting for this worker
+    (ops.expand cold=built / warm=cache-loaded seconds), attached to
+    EVERY bench row so plan-build amortization is checkable from the
+    driver artifact alone (VERDICT r5 #6; docs/PERF.md plan-build
+    amortization).  Import stays lazy: only the worker (which already
+    imported jax) ever calls this."""
+    try:
+        from lux_tpu.ops import expand
+
+        s = expand.plan_stats_snapshot()
+        return {"cold": round(s["cold_s"], 3), "warm": round(s["warm_s"], 3)}
+    except Exception:  # noqa: BLE001 — accounting must never cost a row
+        return {"cold": 0.0, "warm": 0.0}
+
+
+def _emit_row(obj):
+    """Worker-side emit: every measured row carries plan_build_seconds."""
+    _emit({**obj, "plan_build_seconds": _plan_build_field()})
+
+
 def _zero(metric):
     return {
         "metric": metric,
         "value": 0.0,
         "unit": "GTEPS",
         "vs_baseline": 0.0,
+        # the orchestrator never imports jax; static zeros keep the
+        # every-row-carries-plan_build_seconds contract without it
+        "plan_build_seconds": {"cold": 0.0, "warm": 0.0},
     }
 
 
@@ -94,7 +131,8 @@ def worker_main():
         # orchestrator must harvest the banked line, not fall to insurance
         _emit({"metric": "pagerank_gteps_fake_banked", "value": 123.0,
                "unit": "GTEPS", "vs_baseline": 123.0, "method": "scatter",
-               "dtype": "float32"})
+               "dtype": "float32",
+               "plan_build_seconds": {"cold": 0.0, "warm": 0.0}})
         while True:
             time.sleep(3600)
     # scale-up budget clock: from worker entry — the stagger sleep below
@@ -266,6 +304,35 @@ def worker_main():
         risky_tail = []
     results = {}
 
+    apps = [
+        a.strip()
+        for a in os.environ.get(
+            "LUX_BENCH_APPS", "pagerank,sssp,components,colfilter,serve"
+        ).split(",")
+        if a.strip()
+    ]
+
+    # kick the routed-race plan build NOW, on background host threads
+    # (ops/expand.plan_async — per-part fan-out + per-part disk cache):
+    # it overlaps the whole unrouted race, so by the time the routed
+    # line's turn comes the plan is warm instead of costing ~3 min of
+    # chip window (VERDICT r5 #6).  TPU-only: the routed line itself is.
+    rp_future = None
+    rp_state = {"warm": None}
+    if ("pagerank" in apps and on_tpu
+            and not (route_gather or route_fused or compact or sort_seg)):
+        from lux_tpu.ops import expand
+
+        def _build_rp():
+            # hash/probe INSIDE the background thread (hundreds of MB of
+            # sha1 at scale 20 must not delay the first chip measurement)
+            paths = expand.has_cached_expand_plan(shards)
+            rp_state["warm"] = paths is not None
+            return expand.plan_expand_shards_cached(shards,
+                                                    cache_path=paths)
+
+        rp_future = expand.plan_async(_build_rp)
+
     from lux_tpu.utils import roofline
 
     def measure(m, dt):
@@ -297,7 +364,7 @@ def worker_main():
                 g.ne, g.nv, m, state_bytes=2 if dt == "bfloat16" else 4,
                 compact_unique=compact_unique,
             ).scale(iters)
-        _emit(
+        _emit_row(
             {
                 "metric": f"pagerank_gteps_rmat{scale}_1chip{suffix}",
                 "value": round(gteps, 4),
@@ -341,7 +408,7 @@ def worker_main():
             g2.ne, g2.nv, m, state_bytes=2 if dt == "bfloat16" else 4,
             compact_unique=cu2,
         ).scale(iters)
-        _emit(
+        _emit_row(
             {
                 "metric": f"pagerank_gteps_rmat{s2}_1chip{suffix}",
                 "value": round(gteps, 4),
@@ -356,13 +423,6 @@ def worker_main():
             }
         )
 
-    apps = [
-        a.strip()
-        for a in os.environ.get(
-            "LUX_BENCH_APPS", "pagerank,sssp,components,colfilter,serve"
-        ).split(",")
-        if a.strip()
-    ]
     suffix = "" if on_tpu else f"_{platform}_fallback"
 
     push_shards_cache = []
@@ -431,7 +491,7 @@ def worker_main():
         )
         gteps = traversed / elapsed / 1e9
         model = roofline.push_run_model(g.ne, g.nv, traversed, dr, m)
-        _emit(
+        _emit_row(
             {
                 "metric": f"sssp_gteps_rmat{scale}_1chip{suffix}",
                 "value": round(gteps, 4),
@@ -457,7 +517,7 @@ def worker_main():
         )
         gteps = traversed / elapsed / 1e9
         model = roofline.push_run_model(g.ne, g.nv, traversed, dr, m)
-        _emit(
+        _emit_row(
             {
                 "metric": f"components_gteps_rmat{scale}_1chip{suffix}",
                 "value": round(gteps, 4),
@@ -482,7 +542,7 @@ def worker_main():
             g, shards, app="sssp", q=64, num_seq=4, batched_reps=1,
             method="auto",
         )
-        _emit(
+        _emit_row(
             {
                 "metric": f"sssp_qps_rmat{scale}_1chip{suffix}",
                 "value": res["qps_batched"],
@@ -543,7 +603,7 @@ def worker_main():
         model = roofline.pull_iter_model(
             gw.ne, gw.nv, m, width=prog.k, weighted=True, needs_dst=True
         ).scale(iters)
-        _emit(
+        _emit_row(
             {
                 "metric": f"colfilter_gteps_rmat{scale}_1chip{suffix}",
                 "value": round(gteps, 4),
@@ -574,44 +634,51 @@ def worker_main():
                 measure(best_m, "bfloat16")
             except Exception as e:  # noqa: BLE001
                 print(f"# bf16 variant failed: {e}", file=sys.stderr, flush=True)
-        if (results and on_tpu and not (route_gather or route_fused
-                                        or compact or sort_seg)):
+        if results and on_tpu and rp_future is not None:
             # the routed hot loop (ops/expand.py; measured 49x the flat
             # gather at the load phase) joins the DEFAULT race so the
-            # headline reflects the best shipped config.  Plan
-            # construction is ~3.5 min at scale 20, so build only when
-            # the disk cache already has it (chip_day step 0c warms it)
-            # or most of the TPU budget remains.
+            # headline reflects the best shipped config.  The plan was
+            # building on background host threads for the WHOLE unrouted
+            # race (rp_future, submitted before the first measure) — by
+            # now it is usually done; wait only when enough TPU budget
+            # remains to make the residual build worth it.
             rp = None
             saved_results = dict(results)
             try:
-                from lux_tpu.ops import expand
                 from lux_tpu.engine.methods import CONCRETE
 
                 concrete = {kv: t for kv, t in results.items()
                             if kv[0] in CONCRETE}
                 tpu_budget = int(os.environ.get("LUX_BENCH_TPU_S", "600"))
                 spent = time.monotonic() - t_worker0
-                cache_path = expand.has_cached_expand_plan(shards)
                 if not concrete:
                     print("# routed line skipped: no concrete reduce "
                           "method measured", file=sys.stderr, flush=True)
-                elif cache_path or spent < 0.3 * tpu_budget:
+                elif rp_future.ready() or spent < 0.5 * tpu_budget:
                     t_plan = time.time()
-                    rp = expand.plan_expand_shards_cached(
-                        shards, cache_path=cache_path)
+                    # budget-aware wait: a residual build may not eat
+                    # past ~70% of the TPU window — on timeout the
+                    # banked unrouted rows stand and the routed line is
+                    # skipped, never the whole worker
+                    rp = rp_future.result(
+                        timeout=max(5.0, 0.7 * tpu_budget - spent))
                     rp = (rp[0], jax.tree.map(jnp.asarray, rp[1]))
                     jax.block_until_ready(rp[1])
                     print(f"# routed plan "
-                          f"({'cache' if cache_path else 'built'}"
-                          f" {time.time() - t_plan:.1f}s) — measuring "
-                          f"routed line", file=sys.stderr, flush=True)
+                          f"({'cache' if rp_state['warm'] else 'built, overlapped'}"
+                          f"; waited {time.time() - t_plan:.1f}s) — "
+                          f"measuring routed line", file=sys.stderr,
+                          flush=True)
                     _layout["route"] = rp
                     _layout["route_tag"] = "_route"
                     measure(min(concrete, key=concrete.get)[0], dtype)
                 else:
-                    print("# routed line skipped: no cached plan and "
+                    print("# routed line skipped: plan still building and "
                           "budget mostly spent", file=sys.stderr, flush=True)
+            except (TimeoutError, _FUTURE_TIMEOUT):
+                # 3.10: futures.TimeoutError is NOT the builtin alias yet
+                print("# routed line skipped: plan build exceeded the "
+                      "budget-aware wait", file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001
                 print(f"# routed line failed: {e}", file=sys.stderr,
                       flush=True)
@@ -619,6 +686,12 @@ def worker_main():
                 _layout["route"] = None
                 _layout["route_tag"] = ""
                 del rp  # free the ~1 GB device-resident plan pre-scale-up
+                # drop the Future's pin on the HOST plan copy too (a
+                # build still in flight cannot be cancelled — its daemon
+                # threads run on; later TPU rows are device-bound, so
+                # the contention costs dispatch noise, not timed device
+                # work — but a COMPLETED build's ~1 GB frees here)
+                rp_future = None
                 # the routed elapsed must not pollute the unrouted
                 # results the winner recording and scale-up pick from
                 results.clear()
@@ -770,6 +843,63 @@ def _wait(proc, deadline):
     return proc.poll() is not None
 
 
+def _relay_probe(assume):
+    """One relay-liveness sample, honoring the LUX_BENCH_ASSUME_RELAY
+    test hook ('up'/'down' pin the answer)."""
+    if assume == "down":
+        return False
+    if assume == "up":
+        return True
+    return _relay_listening()
+
+
+def _wait_tpu(proc, t_start, wait_full, down_grace, relay_up0, assume,
+              probe_s=20.0):
+    """Adaptive TPU-claim wait (VERDICT r5 weak #3 / next-round #1: the
+    one-shot spawn-time relay gate sent a live chip day to the CPU
+    insurance path).  While the relay's TCP endpoint accepts, wait out
+    the FULL budgeted window; when it stops accepting, ride out only
+    ``down_grace`` seconds from the last time it was seen alive — the
+    timeout-of-last-resort that hands the run to the insurance worker.
+    The probe re-samples every ``probe_s``, so a relay that comes up
+    mid-wait EXTENDS the wait back to the full window instead of losing
+    the chip day to a stale snapshot.  Returns True iff the worker
+    exited before the adaptive deadline."""
+    up = relay_up0
+    last_up = time.monotonic() if up else t_start
+    next_probe = time.monotonic() + probe_s
+    while True:
+        if proc.poll() is not None:
+            return True
+        now = time.monotonic()
+        # probe BEFORE the deadline check: a relay that came alive since
+        # the last sample must extend the deadline it is about to trip
+        if now >= next_probe:
+            was_up = up
+            up = _relay_probe(assume)
+            next_probe = now + probe_s
+            if up:
+                last_up = now
+                if not was_up:
+                    print(
+                        "# relay came alive — extending TPU wait to the "
+                        "full window",
+                        file=sys.stderr, flush=True,
+                    )
+            elif was_up:
+                print(
+                    f"# relay stopped listening — TPU wait now capped "
+                    f"{down_grace:.0f}s past last-alive",
+                    file=sys.stderr, flush=True,
+                )
+        deadline = t_start + wait_full
+        if not up:
+            deadline = min(deadline, last_up + down_grace)
+        if now >= deadline:
+            return proc.poll() is not None
+        time.sleep(min(2.0, probe_s))
+
+
 def _relay(out_path) -> bool:
     """Forward the BEST of the worker's JSON lines PER APP FAMILY to
     stdout (and its stderr diagnostics to ours); True if any line was
@@ -858,20 +988,30 @@ def main():
     tpu_wait = int(os.environ.get("LUX_BENCH_TPU_S", str(budget - 120)))
     # relay gate: only meaningful when the primary actually targets the
     # tunnel — a pure-CPU run (tests, CI, dev hosts) has no relay and must
-    # not have its wait shortened
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        assume = os.environ.get("LUX_BENCH_ASSUME_RELAY")  # test hook
-        relay_up = assume != "down" and (assume == "up" or _relay_listening())
-        if not relay_up:
+    # not have its wait shortened.  The gate is ADAPTIVE (_wait_tpu): the
+    # spawn-time probe below only decides the initial posture and the
+    # worker's exported budget; liveness is re-sampled throughout the
+    # wait, so a relay that dies mid-claim stops burning budget and one
+    # that comes alive re-extends to the full window (VERDICT r5: the
+    # old one-shot cap sent a live chip day to the insurance path).
+    gate_relay = os.environ.get("JAX_PLATFORMS", "") != "cpu"
+    assume = os.environ.get("LUX_BENCH_ASSUME_RELAY")  # test hook
+    relay_cap = int(os.environ.get("LUX_BENCH_RELAY_CAP_S", "240"))
+    # grace past last-seen-alive while the relay is down: the
+    # timeout-of-last-resort, leaving insurance-wait headroom
+    down_grace = max(0, min(tpu_wait, relay_cap, budget - 180))
+    relay_up0 = True
+    if gate_relay:
+        relay_up0 = _relay_probe(assume)
+        if not relay_up0:
             # still spawn the TPU worker (a warm AOT cache could dodge
-            # remote_compile), but stop waiting on it early — leaving the
-            # budget (less the insurance-wait headroom) to the CPU number
-            cap = int(os.environ.get("LUX_BENCH_RELAY_CAP_S", "240"))
-            tpu_wait = max(0, min(tpu_wait, cap, budget - 180))
+            # remote_compile); the adaptive wait re-extends if the relay
+            # comes up
             why = "assumed down (test hook)" if assume == "down" else "not listening"
             print(
                 f"# relay 127.0.0.1:8083 {why} — TPU wait capped at "
-                f"{tpu_wait}s, insurance favored",
+                f"{down_grace}s, insurance favored (re-probed during the "
+                "wait; a live relay re-extends)",
                 file=sys.stderr,
                 flush=True,
             )
@@ -885,8 +1025,12 @@ def main():
     # (graph gen) is not its timed region (device-bound), while the CPU
     # insurance's timed region IS CPU-bound and must not share the core
     env_primary = dict(os.environ)
-    # export the ACTUAL wait (possibly relay-capped) so the worker's
-    # scale-up budget gate reasons about the real deadline, not a default
+    # export the FULL wait even when the relay looks down at spawn: the
+    # worker's budget gates (routed line, scale-up) only execute once it
+    # actually holds a device — which means the relay recovered and the
+    # adaptive wait extended to the full window.  Exporting the capped
+    # grace here would make a recovered chip day skip the routed
+    # headline against a stale 240s budget (the r5 loss, worker-side).
     env_primary["LUX_BENCH_TPU_S"] = str(tpu_wait)
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
         env_primary.setdefault("LUX_BENCH_PRIMARY_DELAY_S", "180")
@@ -920,7 +1064,12 @@ def main():
         else _spawn_worker(env, cpu_out)
     )
 
-    if _wait(tpu_proc, t_start + tpu_wait) and tpu_proc.returncode == 0 and _relay(tpu_out):
+    tpu_done = (
+        _wait_tpu(tpu_proc, t_start, tpu_wait, down_grace, relay_up0, assume)
+        if gate_relay
+        else _wait(tpu_proc, t_start + tpu_wait)
+    )
+    if tpu_done and tpu_proc.returncode == 0 and _relay(tpu_out):
         if cpu_proc is not None:
             try:
                 cpu_proc.kill()  # insurance unneeded; holds no tunnel claim
@@ -934,7 +1083,7 @@ def main():
         # if the grant ever arrives it finishes and exits on its own.
         print(
             f"# TPU worker (pid {tpu_proc.pid}) still stuck after "
-            f"{tpu_wait}s; using CPU insurance result "
+            f"{time.monotonic() - t_start:.0f}s; using CPU insurance result "
             "(worker left running, not killed)",
             file=sys.stderr,
             flush=True,

@@ -298,6 +298,73 @@ def run_pull_fixed(
                            interpret=_route_interpret())
 
 
+def run_pull_fixed_overlapped(
+    prog: PullProgram,
+    spec: ShardSpec,
+    arrays: ShardArrays,
+    state0: jnp.ndarray,
+    num_iters: int,
+    method: str = "auto",
+    route_future=None,
+    chunk: int = 1,
+):
+    """run_pull_fixed that PIPELINES routed-plan construction with the
+    first iterations: while ``route_future`` (an ops.expand.PlanFuture)
+    is still building on the host, iterations run in ``chunk``-sized
+    direct-gather windows; the moment the plan resolves, the remaining
+    iterations run routed.  The routed expand (and the CF route) is
+    bitwise-equal to the direct gather, so the handover point cannot
+    change the result.  Fused plans change the reduce ASSOCIATION, so a
+    mid-run handover would mix two deterministic orders: a fused future
+    that is already resolved at entry runs fused from iteration 0 (the
+    normal fused semantics); one that resolves mid-run finishes the
+    remaining iterations DIRECT instead — completed device work is
+    never discarded, and the result is exactly the direct engine's.
+
+    This is the time-to-first-iteration fix for cold plan caches
+    (VERDICT r5 #6): an engine no longer stalls ~90 s/part at 2^24
+    before its first dense round.  Returns (final_state, routed_iters)
+    — routed_iters counts how many iterations actually ran routed, so
+    drivers can report the overlap honestly.  Compile note: each
+    distinct handover residual (num_iters - done) is a separate jit
+    static; a driver calls this once per run, and repeat processes hit
+    the persistent XLA compile cache, so the program-cache growth is
+    bounded in practice.
+    """
+    from lux_tpu.ops import expand
+
+    if route_future is None:
+        return run_pull_fixed(prog, spec, arrays, state0, num_iters,
+                              method), 0
+    if route_future.ready():
+        route = route_future.result()
+        return run_pull_fixed(prog, spec, arrays, state0, num_iters,
+                              method, route=route), num_iters
+    state = state0
+    done = 0
+    while done < num_iters and not route_future.ready():
+        k = min(chunk, num_iters - done)
+        state = run_pull_fixed(prog, spec, arrays, state, k, method)
+        # materialize before re-polling: dispatch is async, so without a
+        # sync the loop would queue every chunk before the future could
+        # ever win the race
+        jax.block_until_ready(state)
+        done += k
+    if done >= num_iters:
+        return state, 0
+    route = route_future.result()
+    if isinstance(route[0], expand.FusedStatic):
+        # mixing associations mid-run is invalid; the direct result IS a
+        # valid deterministic answer, so finish direct rather than throw
+        # away the iterations already computed
+        state = run_pull_fixed(prog, spec, arrays, state,
+                               num_iters - done, method)
+        return state, 0
+    state = run_pull_fixed(prog, spec, arrays, state, num_iters - done,
+                           method, route=route)
+    return state, num_iters - done
+
+
 def run_pull_until(
     prog: PullProgram,
     spec: ShardSpec,

@@ -10,6 +10,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional
 
 import numpy as np
@@ -114,6 +115,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64, i32p,
     ]
     lib.lux_route_color_batched.restype = ctypes.c_int
+    try:
+        # threaded colorer (newer .so); a stale prebuilt lib without the
+        # symbol keeps every OTHER entry point alive — route_color then
+        # degrades to the single-thread call instead of failing the bind
+        lib.lux_route_color_batched_mt.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, i32p, ctypes.c_int32,
+        ]
+        lib.lux_route_color_batched_mt.restype = ctypes.c_int
+    except AttributeError:
+        pass
     return lib
 
 
@@ -357,7 +369,43 @@ def bucket_fill(srcs, row_ptr_slice, weights, cuts, B: int,
     return True
 
 
-def route_color(u: np.ndarray, v: np.ndarray, deg: int, nside: int):
+_TLS = threading.local()
+
+
+def set_thread_share(divisor: int) -> None:
+    """Declare that the CURRENT thread is one of ``divisor`` concurrent
+    planning workers (thread-local; ops/expand's fan-out sets it).  The
+    colorer then takes cores/divisor threads instead of all cores, so
+    nested fan-outs (part pool x route overlap x native colorer) divide
+    the machine instead of multiplying to O(cores^2) threads."""
+    _TLS.divisor = max(1, int(divisor))
+
+
+def get_thread_share() -> int:
+    return getattr(_TLS, "divisor", 1)
+
+
+def route_threads() -> int:
+    """Host-thread count for the batched route colorer: LUX_ROUTE_THREADS
+    if set (>=1), else every core — divided by the current thread's
+    declared planning-worker share (set_thread_share).  The per-B Euler
+    walks are independent sub-problems, so thread count never changes
+    output bytes — only wall-clock (docs/PERF.md plan-build
+    amortization)."""
+    env = os.environ.get("LUX_ROUTE_THREADS")
+    base = 0
+    if env:
+        try:
+            base = max(1, int(env))
+        except ValueError:
+            base = 0
+    if not base:
+        base = os.cpu_count() or 1
+    return max(1, base // get_thread_share())
+
+
+def route_color(u: np.ndarray, v: np.ndarray, deg: int, nside: int,
+                n_threads: int | None = None):
     """Batched Euler-split edge coloring (Benes route construction).
 
     u, v: (B, n) int64 endpoint arrays of B independent deg-regular
@@ -365,6 +413,14 @@ def route_color(u: np.ndarray, v: np.ndarray, deg: int, nside: int):
     colors — each color class a perfect matching — or None when the
     native library is unavailable (caller falls back to the Python
     walk in ops/route.py; colorings may differ, replays agree).
+
+    n_threads (default ``route_threads()``) fans the B independent
+    sub-graphs over a native worker pool; the output is bitwise
+    identical for every thread count (disjoint slices, per-thread
+    scratch).  The ctypes call releases the GIL, so the Python planning
+    layer's own executor fan-out (ops/expand._stack_parts) stacks with
+    this without oversubscription drama — the atomic work queue just
+    drains faster.
     """
     lib = get_lib()
     if lib is None:
@@ -374,9 +430,16 @@ def route_color(u: np.ndarray, v: np.ndarray, deg: int, nside: int):
     assert u.shape == v.shape and u.ndim == 2, (u.shape, v.shape)
     b, n = u.shape
     colors = np.empty((b, n), np.int32)
-    rc = lib.lux_route_color_batched(
-        _ptr(u, ctypes.c_int64), _ptr(v, ctypes.c_int64), b, n,
-        deg, nside, _ptr(colors, ctypes.c_int32))
+    if n_threads is None:
+        n_threads = route_threads()
+    if n_threads > 1 and hasattr(lib, "lux_route_color_batched_mt"):
+        rc = lib.lux_route_color_batched_mt(
+            _ptr(u, ctypes.c_int64), _ptr(v, ctypes.c_int64), b, n,
+            deg, nside, _ptr(colors, ctypes.c_int32), n_threads)
+    else:
+        rc = lib.lux_route_color_batched(
+            _ptr(u, ctypes.c_int64), _ptr(v, ctypes.c_int64), b, n,
+            deg, nside, _ptr(colors, ctypes.c_int32))
     if rc != 0:
         raise ValueError(f"route color failed (rc={rc}): ids out of range "
                          "or deg not a power of two")

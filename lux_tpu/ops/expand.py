@@ -34,12 +34,15 @@ E scalar-issued gather slots.  At rmat20/ef16 that is ~5 ms vs ~117 ms.
 """
 from __future__ import annotations
 
+import concurrent.futures as _cf
 import dataclasses
 import hashlib
 import json
 import os
 import stat
 import tempfile
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -52,8 +55,147 @@ LANE = 128
 
 #: bump when plan_expand / freeze_plan output layout changes — salts the
 #: disk-cache key so stale cache files can never replay an incompatible
-#: plan (4: pickle -> npz+json storage; keys carry array shape/dtype)
-PLAN_FORMAT = 4
+#: plan (4: pickle -> npz+json storage; keys carry array shape/dtype;
+#: 5: one cache entry PER PART/BUCKET keyed on that part's own index
+#: arrays — a repartition recut rebuilds only the buckets whose arrays
+#: changed)
+PLAN_FORMAT = 5
+
+
+# ---------------------------------------------------------------------------
+# plan-build accounting + the host-side planning executor
+# ---------------------------------------------------------------------------
+
+_PLAN_STATS_LOCK = threading.Lock()
+_PLAN_STATS = {"cold_s": 0.0, "warm_s": 0.0, "built": 0, "loaded": 0}
+
+
+def _stats_add(kind: str, seconds: float, count: int = 1) -> None:
+    with _PLAN_STATS_LOCK:
+        _PLAN_STATS[f"{kind}_s"] += seconds
+        _PLAN_STATS["built" if kind == "cold" else "loaded"] += count
+
+
+def plan_stats_snapshot() -> dict:
+    """Cumulative plan-construction accounting for this process:
+    ``cold_s`` seconds spent BUILDING plans (cache misses), ``warm_s``
+    seconds spent LOADING them from the disk cache, and the entry
+    counts.  Threaded builds sum per-entry wall time, so cold_s is
+    CPU-ish work, not wall clock — bench.py reports both next to every
+    GTEPS row (``plan_build_seconds``) so amortization claims stay
+    honest (VERDICT r5 #6)."""
+    with _PLAN_STATS_LOCK:
+        return dict(_PLAN_STATS)
+
+
+def reset_plan_stats() -> None:
+    with _PLAN_STATS_LOCK:
+        for k in _PLAN_STATS:
+            _PLAN_STATS[k] = 0.0 if k.endswith("_s") else 0
+
+
+def _plan_threads() -> int:
+    """Python-side plan fan-out width: LUX_PLAN_THREADS if set, else one
+    per core.  The per-part planners are pure NumPy + the native colorer
+    (which releases the GIL), so threads scale until the cores do."""
+    env = os.environ.get("LUX_PLAN_THREADS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return os.cpu_count() or 1
+
+
+def _parallel_map(count: int, fn, workers: int):
+    """Daemon-thread parallel map with an atomic work counter, results
+    in index order.  DAEMON threads on purpose: concurrent.futures
+    executors register an atexit join, so a bench worker that abandons
+    an in-flight plan build (budget spent) would hang at interpreter
+    exit until the build finished — daemon workers just die with the
+    process instead.  Synchronous callers still join normally."""
+    import itertools
+
+    from lux_tpu import native
+
+    results = [None] * count
+    errors = []
+    counter = itertools.count()  # next() is atomic under the GIL
+    # compound the parent's share: a worker of THIS pool spawned from a
+    # worker of an outer pool is one of parent*workers machine-wide, so
+    # the native colorer under it divides cores accordingly instead of
+    # multiplying thread counts (O(cores^2) on many-core hosts)
+    parent_share = native.get_thread_share()
+
+    def work():
+        native.set_thread_share(parent_share * workers)
+        while not errors:
+            i = next(counter)
+            if i >= count:
+                return
+            try:
+                results[i] = fn(i)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=work, daemon=True,
+                                name=f"lux-plan-w{t}")
+               for t in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return results
+
+
+def _map_parts(num_parts: int, fn):
+    """Run fn(i) for i in range(num_parts), fanned over the planning
+    pool, results in index order.  Each plan_one is a pure function of
+    its part's arrays, so the schedule can never change the bytes —
+    only the wall clock.  Ephemeral workers per call keep nested
+    planners (an async parent + per-part children) deadlock-free."""
+    if num_parts <= 1 or _plan_threads() <= 1:
+        return [fn(i) for i in range(num_parts)]
+    return _parallel_map(num_parts, fn, min(_plan_threads(), num_parts))
+
+
+class PlanFuture:
+    """Handle to a routed plan being built off the caller's thread.
+    ``ready()`` polls; ``result()`` blocks and returns the ordinary
+    (static, arrays) pair.  Engines/drivers use this to pipeline plan
+    construction with graph load and the first direct-gather iterations
+    (engine/pull.run_pull_fixed_overlapped)."""
+
+    def __init__(self, fut: _cf.Future):
+        self._fut = fut
+
+    def ready(self) -> bool:
+        return self._fut.done()
+
+    def result(self, timeout: float | None = None):
+        return self._fut.result(timeout)
+
+
+def plan_async(build) -> PlanFuture:
+    """Run any plan builder (e.g. ``lambda:
+    plan_expand_shards_cached(shards)``) on a background DAEMON thread
+    and return a PlanFuture.  Daemon so an abandoned build (e.g. the
+    bench worker skipping the routed line with the budget spent) never
+    blocks process exit; the builder's own per-part fan-out still runs
+    at full width underneath."""
+    fut: _cf.Future = _cf.Future()
+
+    def run():
+        try:
+            fut.set_result(build())
+        except BaseException as e:  # noqa: BLE001 — delivered via result()
+            fut.set_exception(e)
+
+    threading.Thread(target=run, name="lux-plan-async", daemon=True).start()
+    return PlanFuture(fut)
 
 
 def _idx8_enabled() -> bool:
@@ -194,11 +336,26 @@ class ExpandStatic:
     r2: shuf.StaticRoute
 
 
+def _build_routes(*perms):
+    """Build several INDEPENDENT Benes routes, concurrently when the
+    planning pool allows: a plan's r1/r2 (and fused's vr) share no
+    state, and the Euler coloring under build_route releases the GIL in
+    the native layer — so even a single-part (P=1) plan build uses the
+    host's cores.  Pure functions: the schedule can't change bytes."""
+    if _plan_threads() <= 1 or len(perms) <= 1:
+        return tuple(route_mod.build_route(p) for p in perms)
+    return tuple(_parallel_map(
+        len(perms), lambda i: route_mod.build_route(perms[i]),
+        min(len(perms), _plan_threads())))
+
+
 def _plan_expand_half(src_pos: np.ndarray, m: int, state_size: int):
     """Shared expand-half construction (state -> filled CSR-run slots):
-    perm1 route + fill-forward plan.  Returns
-    (n, csr, r1_route, ff_static, ff_arrays) — used by both plan_expand
-    and plan_fused so the two can never diverge."""
+    perm1 + fill-forward plan.  Returns
+    (n, csr, perm1, ff_static, ff_arrays) — used by both plan_expand
+    and plan_fused so the two can never diverge; the callers build the
+    perm1 route TOGETHER with their other route perms (_build_routes)
+    so independent colorings overlap."""
     e_pad = len(src_pos)
     n = max(_next_pow2(e_pad), _next_pow2(state_size), LANE)
     sp = np.asarray(src_pos[:m], np.int64)
@@ -220,7 +377,6 @@ def _plan_expand_half(src_pos: np.ndarray, m: int, state_size: int):
     used_tgt = np.zeros(n, bool)
     used_tgt[head_slots] = True
     perm1[~used_tgt] = np.flatnonzero(~used_src)
-    r1 = route_mod.build_route(perm1)
 
     # fill-forward: h[e] = head slot of e's run (CSR space); padding
     # slots are their own heads
@@ -228,7 +384,7 @@ def _plan_expand_half(src_pos: np.ndarray, m: int, state_size: int):
     if m:
         h[:m] = head_slots[np.cumsum(head) - 1]
     ff_static, ff_arrays = plan_ff(h)
-    return n, csr, r1, ff_static, ff_arrays
+    return n, csr, perm1, ff_static, ff_arrays
 
 
 def plan_expand(src_pos: np.ndarray, m: int, state_size: int):
@@ -244,14 +400,14 @@ def plan_expand(src_pos: np.ndarray, m: int, state_size: int):
     sub-plans).
     """
     e_pad = len(src_pos)
-    n, csr, r1, ff_static, ff_arrays = _plan_expand_half(
+    n, csr, perm1, ff_static, ff_arrays = _plan_expand_half(
         src_pos, m, state_size)
 
     # perm2: CSR slot j carries CSC edge csr[j] -> out[csr[j]] = y[j]
     perm2 = np.empty(n, np.int64)
     perm2[csr] = np.arange(m, dtype=np.int64)
     perm2[m:] = np.arange(m, n, dtype=np.int64)
-    r2 = route_mod.build_route(perm2)
+    r1, r2 = _build_routes(perm1, perm2)
 
     r1s, r1a = shuf.freeze_plan(shuf.plan_route(r1))
     r2s, r2a = shuf.freeze_plan(shuf.plan_route(r2))
@@ -363,7 +519,7 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     Returns (FusedStatic, arrays): arrays = r1 passes + ff levels + r2
     passes + (group_mask float/bool, group_weights or (), vr passes).
     """
-    n, csr, r1, ff_static, ff_arrays = _plan_expand_half(
+    n, csr, perm1, ff_static, ff_arrays = _plan_expand_half(
         src_pos, m, state_size)
 
     # --- group layout: per-destination pow2-padded blocks ---
@@ -424,7 +580,6 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     used_tgt2[gslot_csc] = True
     used_src2[csr_slot_of_edge] = True
     perm2[~used_tgt2] = np.flatnonzero(~used_src2)
-    r2 = route_mod.build_route(perm2)
 
     # static group-space mask + pre-routed weights
     gmask = np.zeros(n2, bool)
@@ -447,7 +602,7 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     # every other accumulator slot reads an unused source slot; source
     # slots >= num_seg are filled with the reduce neutral on device
     permv[~used_tgtv] = np.flatnonzero(~used_srcv)
-    vr = route_mod.build_route(permv)
+    r1, r2, vr = _build_routes(perm1, perm2, permv)
 
     r1s, r1a = shuf.freeze_plan(shuf.plan_route(r1))
     r2s, r2a = shuf.freeze_plan(shuf.plan_route(r2))
@@ -555,29 +710,52 @@ class CFRouteStatic:
     dst: ExpandStatic
 
 
+def _cf_plan_one(shards, i: int):
+    """ONE part's CF route plan — the single derivation shared by the
+    cached and uncached planners."""
+    arrays = shards.arrays
+    v_pad = arrays.row_ptr.shape[1] - 1
+    m = int(np.count_nonzero(arrays.edge_mask[i]))
+    s_src, a_src = plan_expand(np.asarray(arrays.src_pos[i]), m,
+                               shards.spec.gathered_size)
+    s_dst, a_dst = plan_expand(np.asarray(arrays.dst_local[i]), m,
+                               v_pad)
+    return CFRouteStatic(src=s_src, dst=s_dst), tuple(a_src) + tuple(a_dst)
+
+
 def plan_cf_route_shards(shards):
     """(CFRouteStatic, stacked arrays) for the wide dst-dependent pull:
     arrays = src-plan arrays + dst-plan arrays (split by the statics'
     pass counts)."""
+    return _stack_parts(shards.arrays.src_pos.shape[0],
+                        lambda i: _cf_plan_one(shards, i))
+
+
+def _cf_key_one(shards):
     arrays = shards.arrays
     v_pad = arrays.row_ptr.shape[1] - 1
 
-    def plan_one(i):
-        m = int(np.count_nonzero(arrays.edge_mask[i]))
-        s_src, a_src = plan_expand(np.asarray(arrays.src_pos[i]), m,
-                                   shards.spec.gathered_size)
-        s_dst, a_dst = plan_expand(np.asarray(arrays.dst_local[i]), m,
-                                   v_pad)
-        return CFRouteStatic(src=s_src, dst=s_dst), tuple(a_src) + tuple(a_dst)
+    def key_one(h, i):
+        for f in (arrays.src_pos[i], arrays.dst_local[i],
+                  arrays.edge_mask[i]):
+            _hash_array(h, f)
+        h.update(f"{shards.spec.gathered_size}:{v_pad}".encode())
 
-    return _stack_parts(arrays.src_pos.shape[0], plan_one)
+    return key_one
 
 
 def plan_cf_route_shards_cached(shards, cache_dir: str | None = None):
-    """plan_cf_route_shards with the shared disk cache."""
-    path = _cache_key_path("cf", shards,
-                           ("src_pos", "dst_local", "edge_mask"), cache_dir)
-    return _load_or_build(path, lambda: plan_cf_route_shards(shards))
+    """plan_cf_route_shards with the shared per-part disk cache."""
+    return _cached_stack("cf", shards.arrays.src_pos.shape[0],
+                         _cf_key_one(shards),
+                         lambda i: _cf_plan_one(shards, i), cache_dir)
+
+
+def has_cached_cf_plan(shards, cache_dir: str | None = None):
+    """Per-part paths when the CF plan family is fully cached, else
+    None (tools/plan_prewarm.py --check-only)."""
+    return _warm_paths("cf", shards.arrays.src_pos.shape[0],
+                       _cf_key_one(shards), cache_dir)
 
 
 def apply_cf_route(full_state, local_state, static: CFRouteStatic, arrays,
@@ -618,18 +796,30 @@ def plan_ring_route_shards(rshards):
                                rshards.pull.spec.nv_pad)
 
 
-def _plan_bucket_routes(src_local, dst_local, v_pad: int):
-    """Shared (R, P, B) bucket planner for the ring AND reduce_scatter
-    exchanges (identical layout conventions: block-local src indices,
-    real edges prefix-packed, dst pads hold the V sentinel)."""
+def _bucket_plan_one(src_local, dst_local, v_pad: int, state_size: int,
+                     flat: int):
+    """ONE bucket's expand plan over the shared (R, B) bucket layout
+    (block-local src indices, real edges prefix-packed, dst pads hold
+    the V sentinel) — the single derivation for the cached AND uncached
+    ring / reduce_scatter / edge2d planners."""
+    num_src = src_local.shape[1]
+    i, q = divmod(flat, num_src)
+    m = int(np.count_nonzero(dst_local[i, q] < v_pad))
+    return plan_expand(np.asarray(src_local[i, q]), m, state_size)
+
+
+def _plan_bucket_routes(src_local, dst_local, v_pad: int,
+                        state_size: int | None = None):
+    """Shared (R, P, B) bucket planner for the ring / reduce_scatter /
+    edge2d exchanges; ``state_size`` defaults to the per-block v_pad
+    (edge2d gathers the (P*V,) parts-gathered state instead)."""
+    if state_size is None:
+        state_size = v_pad
     num_r, num_src = src_local.shape[:2]
-
-    def plan_one(flat):
-        i, q = divmod(flat, num_src)
-        m = int(np.count_nonzero(dst_local[i, q] < v_pad))
-        return plan_expand(np.asarray(src_local[i, q]), m, v_pad)
-
-    static, flat_stacked = _stack_parts(num_r * num_src, plan_one)
+    static, flat_stacked = _stack_parts(
+        num_r * num_src,
+        lambda flat: _bucket_plan_one(src_local, dst_local, v_pad,
+                                      state_size, flat))
     stacked = tuple(a.reshape((num_r, num_src) + a.shape[1:])
                     for a in flat_stacked)
     return static, stacked
@@ -651,28 +841,18 @@ def plan_edge2d_route_shards(eshards):
     the V sentinel in dst_local).  Uniform chunk pad + gathered size ->
     one shared static; same SCALE NOTE as the bucket planners."""
     a2 = eshards.arrays2d
-    num_p, num_e = a2.src_pos.shape[:2]
     v_pad = a2.vtx_mask.shape[1]
-    gathered = num_p * v_pad
-
-    def plan_one(flat):
-        p, e = divmod(flat, num_e)
-        m = int(np.count_nonzero(a2.dst_local[p, e] < v_pad))
-        return plan_expand(np.asarray(a2.src_pos[p, e]), m, gathered)
-
-    static, flat_stacked = _stack_parts(num_p * num_e, plan_one)
-    stacked = tuple(a.reshape((num_p, num_e) + a.shape[1:])
-                    for a in flat_stacked)
-    return static, stacked
+    return _plan_bucket_routes(a2.src_pos, a2.dst_local, v_pad,
+                               a2.src_pos.shape[0] * v_pad)
 
 
 def plan_edge2d_route_shards_cached(eshards, cache_dir: str | None = None):
-    """plan_edge2d_route_shards with the shared disk cache."""
+    """plan_edge2d_route_shards with the shared per-bucket disk cache."""
     a2 = eshards.arrays2d
+    v_pad = a2.vtx_mask.shape[1]
     return _bucket_route_cached(
-        "e2d", a2.src_pos, a2.dst_local,
-        a2.src_pos.shape[0] * a2.vtx_mask.shape[1],
-        lambda: plan_edge2d_route_shards(eshards), cache_dir)
+        "e2d", a2.src_pos, a2.dst_local, v_pad,
+        a2.src_pos.shape[0] * v_pad, cache_dir)
 
 
 def _hash_array(h, a) -> None:
@@ -685,32 +865,111 @@ def _hash_array(h, a) -> None:
     h.update(a.tobytes())
 
 
-def _bucket_route_cached(tag: str, src_local, dst_local, v_pad: int,
-                         build, cache_dir: str | None = None):
-    cache_dir = cache_dir or _default_cache_dir()
+def _entry_path(cache_dir: str, tag: str, key_one, i: int) -> str:
+    """Disk path of ONE part/bucket's plan entry: sha1 over the
+    (tag, PLAN_FORMAT, idx8) salt plus whatever key_one(h, i) folds in
+    (that part's OWN index arrays + scalar layout salts).  The (tag,
+    PLAN_FORMAT) pair IS the cache salt — renaming a tag invalidates
+    that plan family exactly like a format bump, so change either only
+    deliberately (and re-warm the benchmark-scale caches after)."""
     h = hashlib.sha1()
-    h.update(f"{tag}{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
-    _hash_array(h, src_local)
-    _hash_array(h, dst_local)
-    h.update(str(v_pad).encode())
-    path = os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.npz")
-    return _load_or_build(path, build)
+    h.update(f"{tag}{PLAN_FORMAT}:idx8={_idx8_enabled()}:".encode())
+    key_one(h, i)
+    return os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.npz")
+
+
+def _cached_stack(tag: str, num_parts: int, key_one, build_one,
+                  cache_dir: str | None = None, paths=None):
+    """Incrementally-cached plan family: one npz entry PER PART/BUCKET,
+    keyed on that part's own index arrays, so a repartition/recut
+    (engine/repartition.py) reloads every untouched bucket and rebuilds
+    only the changed ones.  Misses build in parallel on the planning
+    pool; an untrusted cache dir (see _cache_dir_trusted) degrades to
+    always-build — correctness never depends on the cache, only
+    plan-construction time does."""
+    cache_dir = cache_dir or _default_cache_dir()
+    trusted = _cache_dir_trusted(cache_dir)
+    if paths is None and trusted:
+        paths = [_entry_path(cache_dir, tag, key_one, i)
+                 for i in range(num_parts)]
+
+    def one(i):
+        path = paths[i] if trusted else None
+        if path is not None and os.path.exists(path):
+            t0 = time.perf_counter()
+            try:
+                static, arrays = _load_plan(path)
+                _stats_add("warm", time.perf_counter() - t0)
+                return static, arrays
+            except (OSError, ValueError, KeyError) as e:
+                # corrupt/foreign entry: rebuild (and overwrite) rather
+                # than fail every driver that shares the cache
+                print(f"# plan cache ignored ({path}): {e}", flush=True)
+        t0 = time.perf_counter()
+        static, arrays = build_one(i)
+        _stats_add("cold", time.perf_counter() - t0)
+        if path is not None:
+            try:
+                _save_plan(path, (static, arrays))
+            except (OSError, TypeError, ValueError) as e:
+                # the plan is already in hand; a failed store (disk
+                # full, future static field outside the codec
+                # vocabulary) must cost cache warmth, never the run
+                print(f"# plan cache not written ({path}): {e}", flush=True)
+        return static, tuple(arrays)
+
+    return _stack_from(_map_parts(num_parts, one))
+
+
+def _bucket_route_cached(tag: str, src_local, dst_local, v_pad: int,
+                         state_size: int, cache_dir: str | None = None):
+    """Per-bucket incremental cache over the shared (R, B) bucket
+    planner layout (ring / reduce_scatter / edge2d): bucket (i, q) keys
+    on ITS slice of src_local/dst_local only."""
+    num_r, num_src = src_local.shape[:2]
+
+    def key_one(h, flat):
+        i, q = divmod(flat, num_src)
+        _hash_array(h, src_local[i, q])
+        _hash_array(h, dst_local[i, q])
+        h.update(f"{v_pad}:{state_size}".encode())
+
+    static, flat_stacked = _cached_stack(
+        tag, num_r * num_src, key_one,
+        lambda flat: _bucket_plan_one(src_local, dst_local, v_pad,
+                                      state_size, flat),
+        cache_dir)
+    stacked = tuple(a.reshape((num_r, num_src) + a.shape[1:])
+                    for a in flat_stacked)
+    return static, stacked
 
 
 def plan_ring_route_shards_cached(rshards, cache_dir: str | None = None):
-    """plan_ring_route_shards with the shared disk cache."""
+    """plan_ring_route_shards with the shared per-bucket disk cache."""
+    v_pad = rshards.pull.spec.nv_pad
     return _bucket_route_cached(
         "ring", rshards.rarrays.src_local, rshards.rarrays.dst_local,
-        rshards.pull.spec.nv_pad,
-        lambda: plan_ring_route_shards(rshards), cache_dir)
+        v_pad, v_pad, cache_dir)
 
 
 def plan_scatter_route_shards_cached(sshards, cache_dir: str | None = None):
-    """plan_scatter_route_shards with the shared disk cache."""
+    """plan_scatter_route_shards with the shared per-bucket disk cache."""
+    v_pad = sshards.pull.spec.nv_pad
     return _bucket_route_cached(
         "rscat", sshards.sarrays.src_local, sshards.sarrays.dst_local,
-        sshards.pull.spec.nv_pad,
-        lambda: plan_scatter_route_shards(sshards), cache_dir)
+        v_pad, v_pad, cache_dir)
+
+
+def _fused_plan_one(shards, template, reduce: str, i: int):
+    """ONE part's fused plan against a SHARED template — the single
+    derivation for the cached and uncached fused planners."""
+    arrays = shards.arrays
+    v_pad = arrays.row_ptr.shape[1] - 1
+    m = int(np.count_nonzero(arrays.edge_mask[i]))
+    return plan_fused(
+        np.asarray(arrays.src_pos[i]), np.asarray(arrays.dst_local[i]),
+        m, shards.spec.gathered_size, v_pad, reduce,
+        weights=np.asarray(arrays.weights[i]), template=template)
 
 
 def plan_fused_shards(shards, reduce: str = "sum"):
@@ -719,18 +978,9 @@ def plan_fused_shards(shards, reduce: str = "sum"):
     parts produce the same FusedStatic and the vmapped engine batches
     them; the price is a few dummy group rows per part, masked to the
     reduce neutral."""
-    arrays = shards.arrays
-    v_pad = arrays.row_ptr.shape[1] - 1
-    template = _group_template(arrays)
-
-    def plan_one(i):
-        m = int(np.count_nonzero(arrays.edge_mask[i]))
-        return plan_fused(
-            np.asarray(arrays.src_pos[i]), np.asarray(arrays.dst_local[i]),
-            m, shards.spec.gathered_size, v_pad, reduce,
-            weights=np.asarray(arrays.weights[i]), template=template)
-
-    return _stack_parts(arrays.src_pos.shape[0], plan_one)
+    template = _group_template(shards.arrays)
+    return _stack_parts(shards.arrays.src_pos.shape[0],
+                        lambda i: _fused_plan_one(shards, template, reduce, i))
 
 
 def _default_cache_dir() -> str:
@@ -738,22 +988,6 @@ def _default_cache_dir() -> str:
     read or write: 0o700, owned by this uid, no symlink)."""
     uid = os.getuid() if hasattr(os, "getuid") else "na"
     return os.path.join(tempfile.gettempdir(), f"lux_expand_plans_{uid}")
-
-
-def _cache_key_path(tag: str, shards, fields: tuple[str, ...],
-                    cache_dir: str | None) -> str:
-    """Disk-cache path for a plan: sha1 over the format/idx8 salt, the
-    named shard arrays' bytes, and the gathered size.  The (tag,
-    PLAN_FORMAT) pair IS the cache salt — renaming a tag invalidates
-    that plan family exactly like a format bump, so change either only
-    deliberately (and re-warm the benchmark-scale caches after)."""
-    cache_dir = cache_dir or _default_cache_dir()
-    h = hashlib.sha1()
-    h.update(f"{tag}{PLAN_FORMAT}:idx8={_idx8_enabled()}".encode())
-    for f in fields:
-        _hash_array(h, getattr(shards.arrays, f))
-    h.update(str(shards.spec.gathered_size).encode())
-    return os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.npz")
 
 
 #: the dataclass vocabulary a cached plan static may contain — the JSON
@@ -847,78 +1081,123 @@ def _load_plan(path: str):
     return static, arrays
 
 
-def _load_or_build(path: str, build):
-    """Atomic-rename npz+json plan cache.  An untrusted cache dir (see
-    _cache_dir_trusted) degrades to always-build: correctness never
-    depends on the cache, only plan-construction time does."""
-    trusted = _cache_dir_trusted(os.path.dirname(path))
-    if trusted and os.path.exists(path):
-        try:
-            return _load_plan(path)
-        except (OSError, ValueError, KeyError) as e:
-            # corrupt/foreign file: rebuild (and overwrite) rather than
-            # fail every driver that shares the cache
-            print(f"# plan cache ignored ({path}): {e}", flush=True)
-    plan = build()
-    if trusted:
-        try:
-            _save_plan(path, plan)
-        except (OSError, TypeError, ValueError) as e:
-            # the plan is already in hand; a failed store (disk full,
-            # future static field outside the codec vocabulary) must
-            # cost cache warmth, never the run
-            print(f"# plan cache not written ({path}): {e}", flush=True)
-    return plan
-
-
-def _stack_parts(num_parts: int, plan_one):
-    """Per-part plan loop shared by every *_shards planner: plan each
-    part, assert the statics agree (the vmapped/sharded engines rely on
-    one shared static), stack the arrays with a leading part axis."""
-    statics, per_part = [], []
-    for i in range(num_parts):
-        st, a = plan_one(i)
-        statics.append(st)
-        per_part.append(tuple(a))
+def _stack_from(per_part):
+    """Assert the statics agree (the vmapped/sharded engines rely on one
+    shared static) and stack the arrays with a leading part axis."""
+    statics = [st for st, _ in per_part]
     assert all(st == statics[0] for st in statics[1:]), (
         "parts must share one plan static")
+    num_parts = len(per_part)
     stacked = tuple(
-        np.stack([per_part[i][j] for i in range(num_parts)])
-        for j in range(len(per_part[0]))
+        np.stack([per_part[i][1][j] for i in range(num_parts)])
+        for j in range(len(per_part[0][1]))
     )
     return statics[0], stacked
 
 
+def _stack_parts(num_parts: int, plan_one):
+    """Per-part plan fan-out shared by every *_shards planner: plan each
+    part on the planning thread pool (_map_parts — each plan_one is a
+    pure function of its part's arrays, so parallelism is bitwise-free),
+    then assert/stack via _stack_from."""
+    def one(i):
+        st, a = plan_one(i)
+        return st, tuple(a)
+
+    return _stack_from(_map_parts(num_parts, one))
+
+
+def _fused_key_one(shards, template):
+    arrays = shards.arrays
+    tmpl_salt = json.dumps(sorted(template.items())).encode()
+
+    def key_one(h, i):
+        for f in (arrays.src_pos[i], arrays.dst_local[i],
+                  arrays.weights[i], arrays.edge_mask[i]):
+            _hash_array(h, f)
+        v_pad = arrays.row_ptr.shape[1] - 1
+        h.update(f"{shards.spec.gathered_size}:{v_pad}".encode())
+        h.update(tmpl_salt)
+
+    return key_one
+
+
 def plan_fused_shards_cached(shards, reduce: str = "sum",
                              cache_dir: str | None = None):
-    """plan_fused_shards with the shared disk cache (the reduce op joins
-    the tag so min/max/sum plans never collide)."""
-    path = _cache_key_path(f"fused-{reduce}", shards,
-                           ("src_pos", "dst_local", "weights", "edge_mask"),
-                           cache_dir)
-    return _load_or_build(path, lambda: plan_fused_shards(shards, reduce))
+    """plan_fused_shards with the shared per-part disk cache (the reduce
+    op joins the tag so min/max/sum plans never collide).  Each part's
+    key folds the SHARED group template: a recut that changes any
+    part's width-class census invalidates exactly the parts it must
+    (every part's FusedStatic depends on the template)."""
+    template = _group_template(shards.arrays)
+    return _cached_stack(
+        f"fused-{reduce}", shards.arrays.src_pos.shape[0],
+        _fused_key_one(shards, template),
+        lambda i: _fused_plan_one(shards, template, reduce, i), cache_dir)
+
+
+def has_cached_fused_plan(shards, reduce: str = "sum",
+                          cache_dir: str | None = None):
+    """Per-part paths when the fused plan family is fully cached, else
+    None (tools/plan_prewarm.py --check-only)."""
+    template = _group_template(shards.arrays)
+    return _warm_paths(f"fused-{reduce}", shards.arrays.src_pos.shape[0],
+                       _fused_key_one(shards, template), cache_dir)
+
+
+def _expand_key_one(shards):
+    arrays = shards.arrays
+
+    def key_one(h, i):
+        _hash_array(h, arrays.src_pos[i])
+        _hash_array(h, arrays.edge_mask[i])
+        h.update(str(shards.spec.gathered_size).encode())
+
+    return key_one
+
+
+def _expand_plan_one(shards, i: int):
+    arrays = shards.arrays
+    m = int(np.count_nonzero(arrays.edge_mask[i]))
+    return plan_expand(np.asarray(arrays.src_pos[i]), m,
+                       shards.spec.gathered_size)
+
+
+def _warm_paths(tag: str, num_parts: int, key_one,
+                cache_dir: str | None):
+    """Per-part cache paths when the whole family would be a pure disk
+    load (EVERY entry present), else None."""
+    cache_dir = cache_dir or _default_cache_dir()
+    if not _cache_dir_trusted(cache_dir):
+        return None
+    paths = tuple(_entry_path(cache_dir, tag, key_one, i)
+                  for i in range(num_parts))
+    return paths if all(os.path.exists(p) for p in paths) else None
 
 
 def has_cached_expand_plan(shards, cache_dir: str | None = None):
-    """The cache path when plan_expand_shards_cached would be a cheap
-    disk load, else None — lets callers (bench default race) include the
-    routed line only when it will not burn plan-construction time inside
-    a TPU budget, and reuse the path without re-hashing the arrays."""
-    path = _cache_key_path("expand", shards, ("src_pos", "edge_mask"),
-                           cache_dir)
-    return path if os.path.exists(path) else None
+    """The tuple of per-part cache paths when plan_expand_shards_cached
+    would be a pure disk load (EVERY part's entry present), else None —
+    lets callers (bench default race) include the routed line only when
+    it will not burn plan-construction time inside a TPU budget, and
+    reuse the paths without re-hashing the arrays."""
+    return _warm_paths("expand", shards.arrays.src_pos.shape[0],
+                       _expand_key_one(shards), cache_dir)
 
 
 def plan_expand_shards_cached(shards, cache_dir: str | None = None,
-                              cache_path: str | None = None):
-    """plan_expand_shards with a disk cache keyed on the exact gather
-    layout (src_pos + edge_mask bytes + gathered size).  Route
-    construction is ~90 s per part at 2^24 even with the native colorer
-    (latency-bound Euler walk), so benchmark A/B reruns must not re-pay
-    it; the per-iteration device replay never touches this path."""
-    path = cache_path or _cache_key_path("expand", shards,
-                                         ("src_pos", "edge_mask"), cache_dir)
-    return _load_or_build(path, lambda: plan_expand_shards(shards))
+                              cache_path=None):
+    """plan_expand_shards with the per-part disk cache keyed on each
+    part's exact gather layout (src_pos + edge_mask bytes + gathered
+    size).  Route construction is ~90 s per part at 2^24 single-thread
+    even with the native colorer (latency-bound Euler walk) — threaded
+    it scales with cores, but benchmark A/B reruns must still not re-pay
+    it; the per-iteration device replay never touches this path.
+    ``cache_path``: a has_cached_expand_plan result to skip re-hashing."""
+    return _cached_stack(
+        "expand", shards.arrays.src_pos.shape[0], _expand_key_one(shards),
+        lambda i: _expand_plan_one(shards, i), cache_dir,
+        paths=list(cache_path) if cache_path else None)
 
 
 def plan_expand_shards(shards):
@@ -929,11 +1208,5 @@ def plan_expand_shards(shards):
     (lux_tpu/engine/pull.py ``route=``).  All parts share one static
     (same e_pad / gathered size → same dims), asserted here.
     """
-    arrays = shards.arrays
-    state_size = shards.spec.gathered_size
-
-    def plan_one(i):
-        m = int(np.count_nonzero(arrays.edge_mask[i]))
-        return plan_expand(np.asarray(arrays.src_pos[i]), m, state_size)
-
-    return _stack_parts(arrays.src_pos.shape[0], plan_one)
+    return _stack_parts(shards.arrays.src_pos.shape[0],
+                        lambda i: _expand_plan_one(shards, i))

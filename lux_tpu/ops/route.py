@@ -170,16 +170,19 @@ def _color_regular(u: np.ndarray, v: np.ndarray, deg: int, nl: int,
 
 
 def _color_regular_batched(u: np.ndarray, v: np.ndarray, deg: int,
-                           nside: int) -> np.ndarray:
+                           nside: int,
+                           n_threads: int | None = None) -> np.ndarray:
     """Color B independent deg-regular bipartite multigraphs
     (u, v: (B, n)) with deg colors each.  Native single-call path
-    (native/lux_route.cc) when available; Python Euler walk per batch
-    otherwise.  Colorings may differ between the two — both are valid
-    (every color class a perfect matching), and route correctness is
-    pinned on replay equality, not on specific colors."""
+    (native/lux_route.cc) when available — threaded over the
+    independent per-B sub-graphs (bitwise-identical for any thread
+    count); Python Euler walk per batch otherwise.  Colorings may
+    differ between the two — both are valid (every color class a
+    perfect matching), and route correctness is pinned on replay
+    equality, not on specific colors."""
     from lux_tpu import native
 
-    out = native.route_color(u, v, deg, nside)
+    out = native.route_color(u, v, deg, nside, n_threads=n_threads)
     if out is not None:
         return out
     return np.stack([

@@ -203,3 +203,84 @@ def test_record_winner_skips_sortseg_ab(tmp_path, monkeypatch):
     monkeypatch.delenv("LUX_BENCH_SORT_SEGMENTS")
     bench._record_winner(results)
     assert json.loads(f.read_text())["tpu:sum"] == "scan"
+
+
+class _StuckProc:
+    """poll() forever-None stand-in for a claim-stuck TPU worker."""
+    returncode = None
+
+    def poll(self):
+        return None
+
+
+def test_wait_tpu_adaptive_extends_while_relay_alive(monkeypatch, capsys):
+    """The adaptive wait (VERDICT r5: the one-shot 240s cap lost a live
+    chip day): a relay that comes alive mid-wait extends the deadline to
+    the full window; while it stays alive the down_grace cap never
+    fires."""
+    import time
+
+    sys.path.insert(0, os.path.dirname(BENCH))
+    import bench
+
+    probes = iter([True] * 50)  # relay alive on every re-probe
+    monkeypatch.setattr(bench, "_relay_listening", lambda: next(probes))
+    t0 = time.monotonic()
+    # starts DOWN (relay_up0=False) with a tiny grace; probes say alive
+    # -> the wait must run out the FULL window, not the grace
+    done = bench._wait_tpu(_StuckProc(), t0, wait_full=1.2, down_grace=0.2,
+                           relay_up0=False, assume=None, probe_s=0.1)
+    elapsed = time.monotonic() - t0
+    assert not done
+    assert elapsed >= 1.0, elapsed  # not cut at the 0.2s grace
+    assert "came alive" in capsys.readouterr().err
+
+
+def test_wait_tpu_caps_after_relay_dies(monkeypatch, capsys):
+    """A relay that stops listening mid-wait caps the remaining wait at
+    down_grace past last-alive instead of burning the full window."""
+    import time
+
+    sys.path.insert(0, os.path.dirname(BENCH))
+    import bench
+
+    monkeypatch.setattr(bench, "_relay_listening", lambda: False)
+    t0 = time.monotonic()
+    done = bench._wait_tpu(_StuckProc(), t0, wait_full=30.0, down_grace=0.5,
+                           relay_up0=True, assume=None, probe_s=0.1)
+    elapsed = time.monotonic() - t0
+    assert not done
+    assert elapsed < 5.0, elapsed  # nowhere near the 30s full window
+    assert "stopped listening" in capsys.readouterr().err
+
+
+def test_wait_tpu_assume_hook_pins_probes(monkeypatch):
+    """LUX_BENCH_ASSUME_RELAY pins the re-probes too (test hook parity
+    with the spawn-time gate)."""
+    import time
+
+    sys.path.insert(0, os.path.dirname(BENCH))
+    import bench
+
+    def boom():
+        raise AssertionError("probe must not hit the network under assume")
+
+    monkeypatch.setattr(bench, "_relay_listening", boom)
+    t0 = time.monotonic()
+    done = bench._wait_tpu(_StuckProc(), t0, wait_full=30.0, down_grace=0.3,
+                           relay_up0=False, assume="down", probe_s=0.1)
+    assert not done and time.monotonic() - t0 < 5.0
+
+
+def test_every_row_carries_plan_build_seconds():
+    """CI contract for plan-build amortization reporting: every bench
+    row (worker-measured AND the orchestrator's zero row) carries the
+    cold/warm plan_build_seconds field."""
+    sys.path.insert(0, os.path.dirname(BENCH))
+    import bench
+
+    z = bench._zero("pagerank_gteps_rmat20_all_workers_failed")
+    assert z["plan_build_seconds"] == {"cold": 0.0, "warm": 0.0}
+    f = bench._plan_build_field()
+    assert set(f) == {"cold", "warm"}
+    assert f["cold"] >= 0.0 and f["warm"] >= 0.0

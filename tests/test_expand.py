@@ -694,3 +694,210 @@ def test_corrupt_cache_file_rebuilds(tmp_path):
     path.write_bytes(b"not an npz")
     s2, _ = E.plan_expand_shards_cached(shards, cache_dir=str(cdir))
     assert s1 == s2  # rebuilt (and re-cached) rather than crashed
+
+
+def _fake_shards(parts_src, parts_mask, gathered):
+    """Minimal PullShards stand-in for the planner APIs (arrays.src_pos /
+    arrays.edge_mask + spec.gathered_size)."""
+    import types
+
+    return types.SimpleNamespace(
+        arrays=types.SimpleNamespace(
+            src_pos=np.stack(parts_src), edge_mask=np.stack(parts_mask)
+        ),
+        spec=types.SimpleNamespace(gathered_size=gathered),
+    )
+
+
+def test_parallel_plan_build_matches_serial(monkeypatch):
+    """The executor fan-out over parts (and the threaded native colorer
+    underneath) is BITWISE identical to the serial build — the planning
+    layer's half of the tentpole contract."""
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+
+    g = generate.rmat(9, 8, seed=21)
+    shards = build_pull_shards(g, 4)
+    monkeypatch.setenv("LUX_PLAN_THREADS", "1")
+    monkeypatch.setenv("LUX_ROUTE_THREADS", "1")
+    s1, a1 = E.plan_expand_shards(shards)
+    f1, fa1 = E.plan_fused_shards(shards, "sum")
+    monkeypatch.setenv("LUX_PLAN_THREADS", "4")
+    monkeypatch.setenv("LUX_ROUTE_THREADS", "4")
+    s2, a2 = E.plan_expand_shards(shards)
+    f2, fa2 = E.plan_fused_shards(shards, "sum")
+    assert s1 == s2 and f1 == f2
+    for x, y in zip(a1 + fa1, a2 + fa2):
+        assert x.dtype == y.dtype and np.array_equal(x, y)
+
+
+def test_incremental_cache_rebuilds_only_changed_parts(tmp_path, rng):
+    """Per-part cache entries are keyed on each part's OWN arrays: a
+    second layout sharing part 0 reloads its entry and builds only the
+    changed part — the repartition-recut amortization contract."""
+    e_pad, S = 512, 256
+    def mk_part(seed):
+        r = np.random.default_rng(seed)
+        m = 400
+        sp = np.zeros(e_pad, np.int32)
+        sp[:m] = r.integers(0, S, m)
+        mask = np.zeros(e_pad, bool)
+        mask[:m] = True
+        return sp, mask
+
+    p0, p1, p2 = mk_part(1), mk_part(2), mk_part(3)
+    cdir = str(tmp_path / "c")
+    sh_a = _fake_shards([p0[0], p1[0]], [p0[1], p1[1]], S)
+    sh_b = _fake_shards([p0[0], p2[0]], [p0[1], p2[1]], S)
+
+    E.reset_plan_stats()
+    sa, aa = E.plan_expand_shards_cached(sh_a, cache_dir=cdir)
+    st = E.plan_stats_snapshot()
+    assert st["built"] == 2 and st["loaded"] == 0
+    E.reset_plan_stats()
+    sb, ab = E.plan_expand_shards_cached(sh_b, cache_dir=cdir)
+    st = E.plan_stats_snapshot()
+    assert st["loaded"] == 1 and st["built"] == 1, st  # p0 reused, p2 built
+    assert sa == sb
+    # the reused entry replays the identical plan bytes for part 0
+    for x, y in zip(aa, ab):
+        np.testing.assert_array_equal(x[0], y[0])
+    # a full rerun of EITHER layout is pure cache
+    E.reset_plan_stats()
+    E.plan_expand_shards_cached(sh_a, cache_dir=cdir)
+    st = E.plan_stats_snapshot()
+    assert st["built"] == 0 and st["loaded"] == 2
+
+
+def test_bucket_cache_incremental_ring(tmp_path):
+    """Ring per-bucket entries: a warm rerun loads every bucket; the
+    cached plan equals the uncached one bitwise."""
+    from lux_tpu.graph import generate
+    from lux_tpu.parallel import ring
+
+    g = generate.rmat(8, 8, seed=22)
+    rs = ring.build_ring_shards(g, 4)
+    cdir = str(tmp_path / "c")
+    E.reset_plan_stats()
+    s1, a1 = E.plan_ring_route_shards_cached(rs, cache_dir=cdir)
+    st = E.plan_stats_snapshot()
+    assert st["built"] == 16 and st["loaded"] == 0  # (R=4) x (P=4) buckets
+    E.reset_plan_stats()
+    s2, a2 = E.plan_ring_route_shards_cached(rs, cache_dir=cdir)
+    st = E.plan_stats_snapshot()
+    assert st["built"] == 0 and st["loaded"] == 16
+    sd, ad = E.plan_ring_route_shards(rs)
+    assert s1 == s2 == sd
+    for x, y, z in zip(a1, a2, ad):
+        assert np.array_equal(x, y) and np.array_equal(x, z)
+        assert x.shape[:2] == (4, 4)  # (R, P) bucket axes restored
+
+
+def test_fused_cached_matches_uncached(tmp_path):
+    """Per-part fused entries (template-salted keys) replay the exact
+    uncached plan; cf likewise."""
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+
+    g = generate.rmat(8, 8, seed=23, weighted=True)
+    shards = build_pull_shards(g, 2)
+    cdir = str(tmp_path / "c")
+    fs_c, fa_c = E.plan_fused_shards_cached(shards, "sum", cache_dir=cdir)
+    fs_u, fa_u = E.plan_fused_shards(shards, "sum")
+    assert fs_c == fs_u
+    for x, y in zip(fa_c, fa_u):
+        np.testing.assert_array_equal(x, y)
+    cs_c, ca_c = E.plan_cf_route_shards_cached(shards, cache_dir=cdir)
+    cs_u, ca_u = E.plan_cf_route_shards(shards)
+    assert cs_c == cs_u
+    for x, y in zip(ca_c, ca_u):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_plan_async_future_and_overlapped_engine():
+    """plan_async + run_pull_fixed_overlapped: direct-gather chunks run
+    while the plan future builds, the handover is bitwise-invisible, a
+    resolved future routes every iteration, and fused futures are
+    rejected (mid-run association change)."""
+    import time as _time
+
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.pagerank import PageRankProgram
+
+    g = generate.rmat(8, 8, seed=24)
+    shards = build_pull_shards(g, 2)
+    prog = PageRankProgram(nv=shards.spec.nv)
+    dev = jax.tree.map(jnp.asarray, shards.arrays)
+    s0 = pull.init_state(prog, dev)
+    direct = pull.run_pull_fixed(prog, shards.spec, dev, s0, 6,
+                                 method="scan")
+
+    def slow_build():
+        _time.sleep(0.3)
+        return E.plan_expand_shards(shards)
+
+    fut = E.plan_async(slow_build)
+    assert isinstance(fut, E.PlanFuture)
+    out, routed = pull.run_pull_fixed_overlapped(
+        prog, shards.spec, dev, s0, 6, method="scan", route_future=fut)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(out))
+    assert 0 <= routed <= 6
+
+    ready = E.plan_async(lambda: E.plan_expand_shards(shards))
+    ready.result()
+    out2, routed2 = pull.run_pull_fixed_overlapped(
+        prog, shards.spec, dev, s0, 6, method="scan", route_future=ready)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(out2))
+    assert routed2 == 6  # resolved future -> routed from iteration 0
+    # no future at all degrades to the plain driver
+    out3, routed3 = pull.run_pull_fixed_overlapped(
+        prog, shards.spec, dev, s0, 6, method="scan")
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(out3))
+    assert routed3 == 0
+
+    # fused futures: resolved at entry -> run fused from iteration 0
+    # (normal fused semantics, association differs so allclose not
+    # bitwise); resolving mid-run would finish DIRECT (routed == 0)
+    # rather than mix associations or discard completed iterations
+    fused = E.plan_async(lambda: E.plan_fused_shards(shards, "sum"))
+    fused.result()
+    out4, routed4 = pull.run_pull_fixed_overlapped(
+        prog, shards.spec, dev, s0, 6, method="scan", route_future=fused)
+    assert routed4 == 6
+    np.testing.assert_allclose(np.asarray(out4), np.asarray(direct),
+                               rtol=1e-5, atol=1e-7)
+
+    def slow_fused():
+        _time.sleep(0.3)
+        return E.plan_fused_shards(shards, "sum")
+
+    out5, routed5 = pull.run_pull_fixed_overlapped(
+        prog, shards.spec, dev, s0, 6, method="scan",
+        route_future=E.plan_async(slow_fused))
+    assert routed5 in (0, 6)  # mid-run -> finished direct; entry -> fused
+    if routed5 == 0:
+        np.testing.assert_array_equal(np.asarray(out5), np.asarray(direct))
+    else:
+        np.testing.assert_allclose(np.asarray(out5), np.asarray(direct),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_plan_stats_accounting(tmp_path):
+    """cold_s/warm_s + built/loaded counts track cache behavior — the
+    source of bench.py's plan_build_seconds field."""
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+
+    g = generate.rmat(7, 4, seed=25)
+    shards = build_pull_shards(g, 2)
+    cdir = str(tmp_path / "c")
+    E.reset_plan_stats()
+    E.plan_expand_shards_cached(shards, cache_dir=cdir)
+    st = E.plan_stats_snapshot()
+    assert st["built"] == 2 and st["cold_s"] > 0 and st["warm_s"] == 0
+    E.plan_expand_shards_cached(shards, cache_dir=cdir)
+    st2 = E.plan_stats_snapshot()
+    assert st2["loaded"] == 2 and st2["warm_s"] > 0
+    assert st2["cold_s"] == st["cold_s"]  # warm pass added no build time

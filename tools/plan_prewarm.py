@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Routed-plan cache prewarmer — build the Benes expand/fused plans for a
+benchmark configuration BEFORE a chip window opens, so chip-day never
+pays plan construction inside a TPU budget (VERDICT r5 #6; chip_day.sh
+invokes this ahead of the relay gate because it needs only host cores).
+
+The plans are written to the same per-part/per-bucket disk cache
+(ops/expand, /tmp/lux_expand_plans_<uid> by default) that bench.py and
+the apps read, keyed on the exact shard layout bytes — so this MUST use
+the same generator seed/layout as the target run (bench.py: rmat(scale,
+ef, seed=0), build_pull_shards(g, 1), default layout).
+
+Examples:
+    python tools/plan_prewarm.py --scale 20 --ef 16            # expand+fused
+    python tools/plan_prewarm.py --scale 18 --kinds expand     # one family
+    python tools/plan_prewarm.py --scale 20 --check-only       # warm?
+
+Prints one JSON line: per-kind cold/warm build seconds, thread counts,
+and whether each cache was already warm.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# host-only tool: never let the planner's jax import touch the tunnel
+# (the axon sitecustomize registers the TPU plugin at interpreter start,
+# so the env var must be overridden AND the live config forced)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="prewarm routed-plan disk caches for a bench config"
+    )
+    ap.add_argument("--scale", type=int, default=20, help="RMAT scale")
+    ap.add_argument("--ef", type=int, default=16, help="edge factor")
+    ap.add_argument("--parts", type=int, default=1,
+                    help="pull-shard part count (bench.py uses 1)")
+    ap.add_argument("--kinds", default="expand,fused",
+                    help="comma list from {expand,fused,cf}")
+    ap.add_argument("--reduce", default="sum",
+                    help="fused-plan reduce op (joins the cache tag)")
+    ap.add_argument("--threads", type=int, default=0,
+                    help="override LUX_ROUTE_THREADS/LUX_PLAN_THREADS "
+                         "(0 = leave env/cpu_count defaults)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="override the plan cache dir (default per-user tmp)")
+    ap.add_argument("--check-only", action="store_true",
+                    help="report cache warmth without building")
+    args = ap.parse_args(argv)
+
+    if args.threads > 0:
+        os.environ["LUX_ROUTE_THREADS"] = str(args.threads)
+        os.environ["LUX_PLAN_THREADS"] = str(args.threads)
+
+    from lux_tpu import native
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.ops import expand
+
+    kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
+    bad = set(kinds) - {"expand", "fused", "cf"}
+    if bad:
+        ap.error(f"unknown plan kinds: {sorted(bad)}")
+
+    t0 = time.time()
+    g = generate.rmat(args.scale, args.ef, seed=0)
+    shards = build_pull_shards(g, args.parts)
+    gen_s = time.time() - t0
+
+    out = {
+        "scale": args.scale, "ef": args.ef, "parts": args.parts,
+        "graph_build_seconds": round(gen_s, 1),
+        "route_threads": native.route_threads(),
+        "plan_threads": expand._plan_threads(),
+        "kinds": {},
+    }
+    if args.check_only:
+        probes = {
+            "expand": lambda: expand.has_cached_expand_plan(
+                shards, cache_dir=args.cache_dir),
+            "fused": lambda: expand.has_cached_fused_plan(
+                shards, args.reduce, cache_dir=args.cache_dir),
+            "cf": lambda: expand.has_cached_cf_plan(
+                shards, cache_dir=args.cache_dir),
+        }
+        for kind in kinds:
+            out["kinds"][kind] = {"warm": probes[kind]() is not None}
+        print(json.dumps(out), flush=True)
+        return 0
+
+    builders = {
+        "expand": lambda: expand.plan_expand_shards_cached(
+            shards, cache_dir=args.cache_dir),
+        "fused": lambda: expand.plan_fused_shards_cached(
+            shards, args.reduce, cache_dir=args.cache_dir),
+        "cf": lambda: expand.plan_cf_route_shards_cached(
+            shards, cache_dir=args.cache_dir),
+    }
+    for kind in kinds:
+        expand.reset_plan_stats()
+        t0 = time.time()
+        static, arrays = builders[kind]()
+        wall = time.time() - t0
+        st = expand.plan_stats_snapshot()
+        out["kinds"][kind] = {
+            "wall_seconds": round(wall, 1),
+            "cold_seconds": round(st["cold_s"], 1),
+            "warm_seconds": round(st["warm_s"], 1),
+            "entries_built": st["built"],
+            "entries_loaded": st["loaded"],
+            "plan_bytes": int(sum(a.nbytes for a in arrays)),
+        }
+        print(f"# {kind}: {wall:.1f}s wall "
+              f"({st['built']} built / {st['loaded']} loaded)",
+              file=sys.stderr, flush=True)
+        del static, arrays
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
