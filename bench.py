@@ -63,6 +63,10 @@ import sys
 import time
 from concurrent.futures import TimeoutError as _FUTURE_TIMEOUT
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tools"))
+import _env  # noqa: E402 — jax-free env parsing shared with the tpu tools
+
 # Paper-era Lux runs ~1 GTEPS/GPU-class-chip on PageRank per the PVLDB paper
 # family of results; the repo itself publishes nothing (BASELINE.md).
 BASELINE_GTEPS_PER_CHIP = 1.0
@@ -105,6 +109,16 @@ def _zero(metric):
     }
 
 
+def _env_int(name: str, default: int) -> int:
+    """Integer env knob with an error that NAMES the variable (luxcheck
+    LUX-P002).  Delegates to tools/_env.py, the jax-free twin of
+    lux_tpu.utils.config.env_int: the orchestrator half of this file
+    must never import lux_tpu — the package __init__ pulls in jax, and
+    the watchdog has to stay healthy when the device tunnel (or the jax
+    install) is wedged."""
+    return _env.env_int(name, default)
+
+
 def _total_unique(shards) -> int:
     """TOTAL real unique in-sources over all parts (roofline's
     compact_unique contract) — NOT the LANE-padded mirror width."""
@@ -142,7 +156,7 @@ def worker_main():
     # the orchestrator staggers the primary behind the CPU insurance so
     # the insurance's CPU-bound timed region runs on a quiet machine
     # (measured: concurrent graph gen halves the fallback GTEPS)
-    time.sleep(int(os.environ.get("LUX_BENCH_PRIMARY_DELAY_S", "0")))
+    time.sleep(_env_int("LUX_BENCH_PRIMARY_DELAY_S", 0))
     import jax
     import jax.numpy as jnp
 
@@ -166,9 +180,9 @@ def worker_main():
     from lux_tpu.graph.shards import build_pull_shards
     from lux_tpu.models.pagerank import PageRankProgram
 
-    scale = int(os.environ.get("LUX_BENCH_SCALE", "20"))
-    ef = int(os.environ.get("LUX_BENCH_EF", "16"))
-    iters = int(os.environ.get("LUX_BENCH_ITERS", "10"))
+    scale = _env_int("LUX_BENCH_SCALE", 20)
+    ef = _env_int("LUX_BENCH_EF", 16)
+    iters = _env_int("LUX_BENCH_ITERS", 10)
     method_env = os.environ.get("LUX_BENCH_METHOD", "auto")
 
     dtype_env = os.environ.get("LUX_BENCH_DTYPE")
@@ -649,7 +663,7 @@ def worker_main():
 
                 concrete = {kv: t for kv, t in results.items()
                             if kv[0] in CONCRETE}
-                tpu_budget = int(os.environ.get("LUX_BENCH_TPU_S", "600"))
+                tpu_budget = _env_int("LUX_BENCH_TPU_S", 600)
                 spent = time.monotonic() - t_worker0
                 if not concrete:
                     print("# routed line skipped: no concrete reduce "
@@ -742,7 +756,7 @@ def worker_main():
         # scale+2 on the race winner, only while less than half the TPU
         # budget is spent, and BEFORE the risky tail (a scan wedge must
         # not cost it)
-        tpu_budget = int(os.environ.get("LUX_BENCH_TPU_S", "600"))
+        tpu_budget = _env_int("LUX_BENCH_TPU_S", 600)
         if route_gather or route_fused:
             print("# scale-up skipped: routed-expand A/B plans exist only "
                   "for the headline graph", file=sys.stderr, flush=True)
@@ -971,7 +985,7 @@ def _relay_listening(port=None, timeout=3.0) -> bool:
     import socket
 
     if port is None:
-        port = int(os.environ.get("LUX_BENCH_RELAY_PORT", "8083"))
+        port = _env_int("LUX_BENCH_RELAY_PORT", 8083)
     try:
         with socket.create_connection(("127.0.0.1", port), timeout=timeout):
             return True
@@ -980,12 +994,12 @@ def _relay_listening(port=None, timeout=3.0) -> bool:
 
 
 def main():
-    budget = int(os.environ.get("LUX_BENCH_WATCHDOG_S", "900"))
+    budget = _env_int("LUX_BENCH_WATCHDOG_S", 900)
     if budget <= 0:  # 0 = unbounded (documented knob semantics)
         budget = 1 << 30
     t_start = time.monotonic()
-    scale = int(os.environ.get("LUX_BENCH_SCALE", "20"))
-    tpu_wait = int(os.environ.get("LUX_BENCH_TPU_S", str(budget - 120)))
+    scale = _env_int("LUX_BENCH_SCALE", 20)
+    tpu_wait = _env_int("LUX_BENCH_TPU_S", budget - 120)
     # relay gate: only meaningful when the primary actually targets the
     # tunnel — a pure-CPU run (tests, CI, dev hosts) has no relay and must
     # not have its wait shortened.  The gate is ADAPTIVE (_wait_tpu): the
@@ -996,7 +1010,7 @@ def main():
     # old one-shot cap sent a live chip day to the insurance path).
     gate_relay = os.environ.get("JAX_PLATFORMS", "") != "cpu"
     assume = os.environ.get("LUX_BENCH_ASSUME_RELAY")  # test hook
-    relay_cap = int(os.environ.get("LUX_BENCH_RELAY_CAP_S", "240"))
+    relay_cap = _env_int("LUX_BENCH_RELAY_CAP_S", 240)
     # grace past last-seen-alive while the relay is down: the
     # timeout-of-last-resort, leaving insurance-wait headroom
     down_grace = max(0, min(tpu_wait, relay_cap, budget - 180))

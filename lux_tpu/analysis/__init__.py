@@ -1,0 +1,48 @@
+"""lux_tpu.analysis — luxcheck, the repo-native static-analysis suite.
+
+Four checker families encode the invariants that have actually bitten
+this codebase (see each module's docstring for the incident history):
+
+* tracing-safety (LUX-T*) — Python control flow / host concretization on
+  traced values inside jit/shard_map/Pallas bodies (retraces, host
+  syncs in the hot loop);
+* determinism   (LUX-D*) — set-iteration order, wall clock, global RNG
+  feeding result bytes (the bitwise-rerun contract, statically);
+* thread-safety (LUX-C*) — unlocked module state under the PR-2 planner
+  fan-out and the serving scheduler thread;
+* policy        (LUX-P*) — no pickle in cache paths, env knobs through
+  utils.config.env_int, u8 index narrowing through _narrow_idx only.
+
+Meta findings (LUX-X*) keep the suppression machinery itself honest:
+X000 unparsable file, X001 inline suppression without a justification,
+X002 malformed baseline entry, X003 stale baseline entry.
+
+Run it: ``python tools/luxcheck.py --all`` (chip_day step -3, a tier-1
+test, and tools/ci_check.sh all gate on exit 0).  Pure stdlib — never
+imports jax/numpy, so the gate costs milliseconds.
+"""
+from lux_tpu.analysis.core import (  # noqa: F401
+    DEFAULT_TARGETS,
+    Checker,
+    Finding,
+    Module,
+    check_module,
+    check_paths,
+    iter_py_files,
+    load_baseline,
+    repo_root,
+)
+from lux_tpu.analysis.determinism import DeterminismChecker
+from lux_tpu.analysis.policy import PolicyChecker
+from lux_tpu.analysis.threads import ThreadSafetyChecker
+from lux_tpu.analysis.tracing import TracingSafetyChecker
+
+#: the shipped checker set, in report order
+ALL_CHECKERS = (
+    TracingSafetyChecker(),
+    DeterminismChecker(),
+    ThreadSafetyChecker(),
+    PolicyChecker(),
+)
+
+FAMILIES = tuple(c.family for c in ALL_CHECKERS)

@@ -1,0 +1,389 @@
+"""luxcheck core: the repo-native static-analysis engine.
+
+Lux's contract is a deterministic, recompile-free hot loop.  The
+reference gets its race-freedom checked by construction (SURVEY §5); this
+port re-asserts it dynamically via bitwise-rerun tests — which only catch
+a violation AFTER it has cost a run.  This engine encodes the invariants
+that have actually bitten this codebase as AST lints, so a retrace, a
+nondeterministic ordering, or a planner-thread race is rejected before
+any chip budget is spent (tools/chip_day.sh step -3).
+
+Design: pure stdlib ``ast`` — importing this package must never pull in
+jax/numpy (the preflight gate has to run in milliseconds on a cold
+host).  Checkers are small classes registered in
+``lux_tpu.analysis.ALL_CHECKERS``; each yields ``Finding``s against a
+parsed ``Module``.  Two suppression layers, both requiring a written
+justification (an unexplained suppression is itself a finding):
+
+* inline — ``# luxcheck: disable=LUX-T001 -- <why this is safe>`` on the
+  flagged line, or on a comment-only line directly above it;
+* baseline — ``tools/luxcheck_baseline.txt`` entries
+  ``<relpath>:<code>:<fingerprint>  # <why>`` (shipped EMPTY: the
+  baseline exists for emergencies mid-chip-window, not as a dumping
+  ground — stale entries are themselves findings).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import os
+import re
+import tokenize
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+#: scanned by ``--all`` / the repo-clean test, relative to the repo root.
+#: tests/ is deliberately excluded: tests seed violations on purpose
+#: (fixtures) and monkeypatch global state under pytest's isolation.
+DEFAULT_TARGETS = ("lux_tpu", "tools", "bench.py")
+
+#: path parts never scanned (native build artifacts, bytecode)
+EXCLUDE_PARTS = frozenset({"__pycache__", "build", ".git"})
+
+#: a suppression justification must carry at least this many characters —
+#: enough to force a real sentence, short enough not to be ceremony
+MIN_JUSTIFICATION = 8
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*luxcheck:\s*disable=([A-Za-z0-9_,\-]+)\s*(?:--|—|:)?\s*(.*?)\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit.  ``text`` is the stripped source line — it joins the
+    fingerprint so baseline entries survive line-number drift but die
+    when the flagged code itself changes (a stale suppression must not
+    silently cover NEW code)."""
+
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    code: str
+    message: str
+    text: str = ""
+
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            f"{self.path}:{self.code}:{self.text}".encode()
+        )
+        return h.hexdigest()[:12]
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+class Module:
+    """One parsed source file + the per-line suppression table."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Optional[dict] = None
+        # line -> (codes | {"all"}, justification, suppression line no)
+        self.suppressions: dict[int, Tuple[frozenset, str, int]] = {}
+        self._scan_suppressions()
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def _scan_suppressions(self) -> None:
+        # tokenize, don't regex raw lines: the suppression syntax quoted
+        # inside a docstring/string literal (e.g. this engine's own docs)
+        # must neither register a live suppression nor emit a phantom
+        # LUX-X001
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return  # ast.parse succeeded, so this is vanishingly rare
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            codes = frozenset(
+                c.strip() for c in m.group(1).split(",") if c.strip()
+            )
+            just = m.group(2).strip()
+            i = tok.start[0]
+            entry = (codes, just, i)
+            self.suppressions[i] = entry
+            # a comment-only suppression line covers the NEXT line
+            if self.lines[i - 1][: tok.start[1]].strip() == "":
+                self.suppressions.setdefault(i + 1, entry)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for p in ast.walk(self.tree):
+                for c in ast.iter_child_nodes(p):
+                    self._parents[c] = p
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        p = self.parent(node)
+        while p is not None:
+            yield p
+            p = self.parent(p)
+
+    def under_lock(self, node: ast.AST) -> bool:
+        """True when ``node`` sits inside a ``with`` whose context
+        expression names a lock (``with _LOCK:``, ``with self._lock:``,
+        ``with cv:`` via a name containing lock/mutex/cond)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    src = ast.unparse(item.context_expr).lower()
+                    if any(k in src for k in ("lock", "mutex", "cond",
+                                              "flock", "wake")):
+                        return True
+        return False
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+
+class Checker:
+    """Base class: subclasses set ``family``/``name`` and implement
+    ``run(mod) -> Iterable[Finding]``."""
+
+    family = "unset"
+    name = "unset"
+
+    def run(self, mod: Module) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, mod: Module, node: ast.AST, code: str,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(path=mod.relpath, line=line, col=col, code=code,
+                       message=message, text=mod.line_text(line))
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several checker families
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted_name(node.func)
+
+
+def names_in(node: ast.AST) -> frozenset:
+    return frozenset(
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    )
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline application
+# ---------------------------------------------------------------------------
+
+
+def _apply_inline(mod: Module, findings: List[Finding]) -> List[Finding]:
+    """Filter findings through the module's inline suppressions; emit
+    LUX-X001 for suppressions whose justification is missing/too thin.
+    A suppression with a bad justification does NOT suppress."""
+    out: List[Finding] = []
+    bad_lines = set()
+    for line, (codes, just, sline) in sorted(mod.suppressions.items()):
+        if len(just) < MIN_JUSTIFICATION and sline not in bad_lines:
+            bad_lines.add(sline)
+            out.append(Finding(
+                path=mod.relpath, line=sline, col=0, code="LUX-X001",
+                message="suppression without a justification — write why "
+                        "the finding is safe after '--'",
+                text=mod.line_text(sline)))
+    for f in findings:
+        sup = mod.suppressions.get(f.line)
+        if sup is not None:
+            codes, just, sline = sup
+            if (("all" in codes or f.code in codes)
+                    and len(just) >= MIN_JUSTIFICATION):
+                continue
+        out.append(f)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    path: str
+    code: str
+    fingerprint: str
+    justification: str
+    lineno: int
+
+
+def load_baseline(path: str) -> Tuple[List[BaselineEntry], List[Finding]]:
+    """Parse the baseline file.  Malformed or unjustified entries are
+    findings (LUX-X002) — the baseline must never rot silently."""
+    entries: List[BaselineEntry] = []
+    problems: List[Finding] = []
+    if not os.path.exists(path):
+        return entries, problems
+    rel = os.path.basename(path)
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, start=1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, just = line.partition("#")
+            just = just.strip()
+            parts = body.strip().rsplit(":", 2)
+            if len(parts) != 3 or len(just) < MIN_JUSTIFICATION:
+                problems.append(Finding(
+                    path=rel, line=i, col=0, code="LUX-X002",
+                    message="malformed or unjustified baseline entry "
+                            "(want '<path>:<code>:<fingerprint>  # why')",
+                    text=line))
+                continue
+            entries.append(BaselineEntry(
+                path=parts[0], code=parts[1], fingerprint=parts[2],
+                justification=just, lineno=i))
+    return entries, problems
+
+
+def _apply_baseline(findings: List[Finding], baseline_path: Optional[str]
+                    ) -> List[Finding]:
+    if not baseline_path:
+        return findings
+    entries, problems = load_baseline(baseline_path)
+    # ONE-SHOT consumption: each entry suppresses at most one finding.
+    # Fingerprints hash (path, code, line text), so two identical lines
+    # in a file collide — without this, one justified entry would also
+    # cover every FUTURE identical occurrence, unreviewed.
+    keyed: dict[tuple, List[BaselineEntry]] = {}
+    for e in entries:
+        keyed.setdefault((e.path, e.code, e.fingerprint), []).append(e)
+    out: List[Finding] = []
+    for f in findings:
+        k = (f.path, f.code, f.fingerprint())
+        if keyed.get(k):
+            keyed[k].pop()
+            continue
+        out.append(f)
+    rel = os.path.basename(baseline_path)
+    for k, stale in keyed.items():
+        for e in stale:
+            out.append(Finding(
+                path=rel, line=e.lineno, col=0, code="LUX-X003",
+                message=f"stale baseline entry ({e.path}:{e.code}:"
+                        f"{e.fingerprint}) matches no current finding — "
+                        "delete it",
+                text=""))
+    return out + problems
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+def iter_py_files(root: str, targets: Sequence[str] = DEFAULT_TARGETS
+                  ) -> Iterator[str]:
+    for t in targets:
+        full = os.path.join(root, t)
+        if os.path.isfile(full):
+            if full.endswith(".py"):
+                yield full
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in EXCLUDE_PARTS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def check_module(mod: Module, checkers: Sequence[Checker]) -> List[Finding]:
+    """All checkers over one parsed module, inline suppressions applied."""
+    raw: List[Finding] = []
+    for ch in checkers:
+        raw.extend(ch.run(mod))
+    return _apply_inline(mod, raw)
+
+
+def check_file(path: str, root: str, checkers: Sequence[Checker]
+               ) -> List[Finding]:
+    rel = os.path.relpath(path, root)
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        mod = Module(path, rel, source)
+    except (OSError, SyntaxError, ValueError) as e:
+        return [Finding(path=rel.replace(os.sep, "/"), line=1, col=0,
+                        code="LUX-X000",
+                        message=f"file not analyzable: {e}", text="")]
+    return check_module(mod, checkers)
+
+
+def check_paths(paths: Sequence[str], root: str,
+                checkers: Optional[Sequence[Checker]] = None,
+                baseline_path: Optional[str] = None) -> List[Finding]:
+    """The full gate: every .py under ``paths``, inline suppressions and
+    the baseline applied; returns the surviving findings sorted by
+    location.  Exit-0 == empty list."""
+    if checkers is None:
+        from lux_tpu.analysis import ALL_CHECKERS
+
+        checkers = ALL_CHECKERS
+    findings: List[Finding] = []
+    seen: set = set()  # overlapping targets (--all + an explicit subdir)
+    # must scan each FILE once: duplicates double-report and break the
+    # baseline's one-shot consumption
+
+    def one_file(f: str) -> None:
+        key = os.path.realpath(f)
+        if key not in seen:
+            seen.add(key)
+            findings.extend(check_file(f, root, checkers))
+
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isdir(full):
+            for f in iter_py_files(root, [os.path.relpath(full, root)]):
+                one_file(f)
+        elif os.path.isfile(full):
+            one_file(full)
+        else:
+            # a typo'd or renamed target must FAIL the gate, not shrink
+            # it: "clean" after scanning zero files is how a preflight
+            # silently stops preflighting
+            findings.append(Finding(
+                path=p.replace(os.sep, "/"), line=1, col=0,
+                code="LUX-X000",
+                message="target path does not exist — fix the path (a "
+                        "missing target must never pass as clean)",
+                text=""))
+    findings = _apply_baseline(findings, baseline_path)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code))
+
+
+def repo_root() -> str:
+    """The repo root this package is installed in (two levels above)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))

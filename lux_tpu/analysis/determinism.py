@@ -1,0 +1,136 @@
+"""Determinism checkers (LUX-D*): orderings and entropy sources that can
+change result bytes between two runs of the same program.
+
+Lux's verification story is bitwise-rerun equality (tests/test_determinism
+and the parallel==serial plan-build tests) — atomic-free determinism by
+construction, which Tascade (PAPERS.md, arxiv 2311.15810) argues must be
+VERIFIED rather than assumed.  The dynamic tests catch a violation only
+on the inputs they run; these lints reject the generating patterns:
+
+* LUX-D001 — iterating a ``set`` into ordered data.  Python set order is
+  hash-seed-dependent across processes (PYTHONHASHSEED): any array,
+  list, or loop built from raw set iteration can differ between the two
+  halves of a bitwise A/B run.  Wrapping in ``sorted()`` (or an
+  order-insensitive consumer: len/min/max/sum/any/all) is the fix.
+* LUX-D002 — wall-clock reads (``time.time``/``datetime.now``) inside
+  engine/ops/graph/parallel/models code.  Timing belongs in
+  utils/timing + bench/serve metrics; a wall-clock read in engine code
+  either leaks into results or masquerades as one (perf_counter /
+  monotonic are exempt: they cannot produce calendar values that leak
+  into cache keys or filenames).
+* LUX-D003 — process-global RNG (``np.random.*`` legacy API, stdlib
+  ``random.*`` module functions) in package code.  Every draw must go
+  through an explicitly seeded ``np.random.default_rng(seed)`` /
+  ``random.Random(seed)`` so reruns replay (graph/generate.py idiom).
+
+Float accumulation-order hazards (the reduce strategies' sum
+association) are intentionally NOT linted: association is a documented
+per-method contract (docs/PARITY.md) enforced by the bitwise tests —
+a static rule would only restate `jnp.sum` exists.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from lux_tpu.analysis.core import Checker, Finding, Module, call_name
+
+#: consumers for which set iteration order cannot matter
+_ORDER_INSENSITIVE = {"sorted", "len", "min", "max", "sum", "any", "all",
+                      "set", "frozenset"}
+
+#: direct consumers that bake iteration order into data
+_ORDERED_BUILDERS = {"list", "tuple", "np.array", "np.asarray",
+                     "numpy.array", "numpy.asarray", "np.fromiter",
+                     "jnp.array", "jnp.asarray", "np.stack",
+                     "np.concatenate", "enumerate"}
+
+_WALL_CLOCK = {"time.time", "time.time_ns", "datetime.now",
+               "datetime.datetime.now", "datetime.utcnow",
+               "datetime.datetime.utcnow", "datetime.today",
+               "datetime.date.today"}
+
+#: modules where wall-clock reads are a determinism hazard (results /
+#: cache keys); timing+metrics layers are exempt by scope
+_ENGINE_SCOPES = ("lux_tpu/engine/", "lux_tpu/ops/", "lux_tpu/graph/",
+                  "lux_tpu/parallel/", "lux_tpu/models/")
+
+_LEGACY_NP_RANDOM = {"seed", "rand", "randn", "randint", "random",
+                     "choice", "shuffle", "permutation", "uniform",
+                     "normal", "binomial", "poisson", "random_sample"}
+_STDLIB_RANDOM_FNS = {"random", "randint", "randrange", "choice",
+                      "choices", "shuffle", "sample", "uniform",
+                      "gauss", "getrandbits", "seed"}
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return isinstance(node, ast.Call) and call_name(node) in (
+        "set", "frozenset")
+
+
+class DeterminismChecker(Checker):
+    family = "determinism"
+    name = "determinism"
+
+    def run(self, mod: Module) -> Iterable[Finding]:
+        out: List[Finding] = []
+        in_engine = any(mod.relpath.startswith(s) for s in _ENGINE_SCOPES)
+        in_pkg = mod.relpath.startswith("lux_tpu/")
+        for node in ast.walk(mod.tree):
+            # --- D001: set iteration into ordered data ---
+            iters: List[ast.AST] = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                   ast.DictComp)):
+                iters.extend(g.iter for g in node.generators)
+            elif isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn in _ORDERED_BUILDERS:
+                    iters.extend(a for a in node.args)
+            for it in iters:
+                if _is_set_expr(it):
+                    # exempt when the whole construct feeds an
+                    # order-insensitive consumer directly
+                    parent = mod.parent(node)
+                    if (isinstance(parent, ast.Call) and call_name(parent)
+                            in _ORDER_INSENSITIVE):
+                        continue
+                    if (isinstance(node, ast.Call) and call_name(node)
+                            in _ORDER_INSENSITIVE):
+                        continue
+                    out.append(self.finding(
+                        mod, it, "LUX-D001",
+                        "iteration over a set feeds ordered data — set "
+                        "order is hash-seed-dependent across processes; "
+                        "wrap in sorted()"))
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            # --- D002: wall clock in engine scopes ---
+            if in_engine and cn in _WALL_CLOCK:
+                out.append(self.finding(
+                    mod, node, "LUX-D002",
+                    f"wall-clock read `{cn}()` in engine/ops code — "
+                    "timing belongs in utils/timing; results and cache "
+                    "keys must not depend on the calendar"))
+            # --- D003: process-global RNG in package code ---
+            if in_pkg:
+                parts = cn.split(".")
+                if (len(parts) == 3 and parts[0] in ("np", "numpy")
+                        and parts[1] == "random"
+                        and parts[2] in _LEGACY_NP_RANDOM):
+                    out.append(self.finding(
+                        mod, node, "LUX-D003",
+                        f"legacy global RNG `{cn}()` — use an explicitly "
+                        "seeded np.random.default_rng(seed) so reruns "
+                        "replay bitwise"))
+                elif (len(parts) == 2 and parts[0] == "random"
+                        and parts[1] in _STDLIB_RANDOM_FNS):
+                    out.append(self.finding(
+                        mod, node, "LUX-D003",
+                        f"process-global RNG `{cn}()` — use a seeded "
+                        "random.Random(seed) instance"))
+        return out

@@ -1,0 +1,107 @@
+"""Policy checkers (LUX-P*): repo contracts that past PRs established
+after incidents, enforced so they can never quietly regress.
+
+* LUX-P001 — ``pickle`` (import or use) and ``allow_pickle=True``.  The
+  plan disk cache was MOVED OFF pickle in PR 1 (npz + a typed JSON
+  decoder — loading a cache entry cannot execute code; ops/expand.py
+  PLAN_FORMAT history).  Any reintroduction reopens arbitrary-code
+  execution through a world-readable temp dir.
+* LUX-P002 — raw ``int(os.environ...)``/``float(os.environ...)`` casts.
+  ``LUX_PLAN_THREADS=garbage`` used to raise a bare ValueError deep in
+  the planner fan-out; every env knob must parse through
+  ``lux_tpu.utils.config.env_int`` (clear error naming the variable,
+  positivity enforced at the boundary).
+* LUX-P003 — ``.astype(np.uint8)`` index narrowing outside
+  ``ops/expand._narrow_idx``.  The u8 routed-pass indices rely on a
+  strictly-<128 digit-local invariant that ``_narrow_idx`` asserts;
+  an unchecked cast would gather out of bounds under
+  ``promise_in_bounds`` on chip (silent garbage, not an error).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from lux_tpu.analysis.core import Checker, Finding, Module, call_name
+
+_UINT8_NAMES = {"np.uint8", "numpy.uint8", "jnp.uint8"}
+
+
+def _is_environ_expr(node: ast.AST) -> bool:
+    """``os.environ.get(...)`` / ``os.environ[...]`` / ``environ.get``."""
+    if isinstance(node, ast.Call):
+        cn = call_name(node)
+        return cn in ("os.environ.get", "environ.get", "os.getenv",
+                      "getenv")
+    if isinstance(node, ast.Subscript):
+        return ast.unparse(node.value) in ("os.environ", "environ")
+    return False
+
+
+class PolicyChecker(Checker):
+    family = "policy"
+    name = "policy"
+
+    def run(self, mod: Module) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            # --- P001: pickle ---
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] in ("pickle", "cPickle",
+                                                    "dill", "shelve"):
+                        out.append(self.finding(
+                            mod, node, "LUX-P001",
+                            f"`import {alias.name}` — the plan cache is "
+                            "npz+JSON by contract (PLAN_FORMAT 4+); "
+                            "pickle in a cache path is code execution "
+                            "from a temp dir"))
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] in ("pickle",
+                                                         "cPickle", "dill"):
+                    out.append(self.finding(
+                        mod, node, "LUX-P001",
+                        f"`from {node.module} import ...` — pickle is "
+                        "banned in cache/serving paths"))
+            elif isinstance(node, ast.Call):
+                cn = call_name(node)
+                for kw in node.keywords:
+                    if (kw.arg == "allow_pickle"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is True):
+                        out.append(self.finding(
+                            mod, kw.value, "LUX-P001",
+                            "allow_pickle=True — a cache file must never "
+                            "be able to execute code"))
+                # --- P002: raw env int/float cast ---
+                if (cn in ("int", "float") and len(node.args) >= 1
+                        and _is_environ_expr(node.args[0])):
+                    out.append(self.finding(
+                        mod, node, "LUX-P002",
+                        f"raw `{cn}(os.environ...)` — parse env knobs "
+                        "through lux_tpu.utils.config.env_int (clear "
+                        "error naming the variable, bounds enforced at "
+                        "the boundary, not deep in the planner)"))
+                # --- P003: u8 index narrowing outside _narrow_idx ---
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype"
+                        and mod.relpath.startswith("lux_tpu/")
+                        and node.args):
+                    a = node.args[0]
+                    is_u8 = (
+                        (isinstance(a, (ast.Attribute, ast.Name))
+                         and ast.unparse(a) in _UINT8_NAMES)
+                        or (isinstance(a, ast.Constant)
+                            and a.value in ("uint8", "u1"))
+                    )
+                    fn = mod.enclosing_function(node)
+                    if is_u8 and (fn is None
+                                  or fn.name != "_narrow_idx"):
+                        out.append(self.finding(
+                            mod, node, "LUX-P003",
+                            "uint8 index narrowing outside "
+                            "ops/expand._narrow_idx — the <128 "
+                            "digit-local invariant must be asserted, "
+                            "or the u8 gather reads out of bounds "
+                            "on chip"))
+        return out

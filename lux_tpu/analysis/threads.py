@@ -1,0 +1,207 @@
+"""Thread-safety checkers (LUX-C*): shared mutable state touched by the
+planner / scheduler worker threads without a lock.
+
+PR 2 made the host planning layer genuinely concurrent
+(``ops/expand._map_parts`` daemon fan-out, ``plan_async``, the native
+colorer's thread pool) and PR 1 added the serving scheduler thread — so
+module-level mutable state is now shared state.  CPython's GIL makes the
+races "benign" only until a mutation compounds (read-modify-write,
+check-then-act like lazy init running a 120 s ``make`` twice) — and the
+reference's whole pitch is race-freedom checked by construction, so we
+lint the shapes instead of trusting the GIL:
+
+* LUX-C001 — write to a ``global`` inside a function, outside any
+  ``with <...lock...>:`` block (lazy-init caches, counters).
+* LUX-C002 — mutation of a module-level mutable container (dict/list/set
+  assigned at module scope) inside a function, outside a lock.
+* LUX-C003 — ``os.environ`` read inside a function used as a thread
+  target (``threading.Thread(target=f)`` / ``executor.submit(f, ...)``):
+  env mutations from the main thread race it, and per-thread env reads
+  make behavior depend on scheduling.
+* LUX-C004 — ``os.environ`` WRITE in lux_tpu package code: the process
+  environment is global state shared with every reader thread; only
+  tools/ entry points (which set env before spawning work) may write it.
+
+Lock detection is lexical: a ``with`` whose context expression source
+contains lock/mutex/cond/flock/wake.  That matches this repo's idiom
+(``_PLAN_STATS_LOCK``, ``self._wake``); a cleverly-named lock needs an
+inline suppression with a justification, which is the point.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from lux_tpu.analysis.core import Checker, Finding, Module, call_name
+
+_MUTATORS = {"append", "extend", "add", "update", "setdefault", "pop",
+             "popitem", "remove", "discard", "clear", "insert",
+             "__setitem__"}
+
+_ENV_WRITERS = {"setdefault", "update", "pop", "clear"}
+
+
+def _module_mutable_names(mod: Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in mod.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                     ast.DictComp, ast.ListComp,
+                                     ast.SetComp))
+        if isinstance(value, ast.Call) and call_name(value) in (
+                "dict", "list", "set", "defaultdict",
+                "collections.defaultdict", "OrderedDict",
+                "collections.OrderedDict", "deque", "collections.deque"):
+            mutable = True
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    return names
+
+
+def _thread_target_names(mod: Module) -> Set[str]:
+    """Function names handed to Thread(target=...)/executor.submit/
+    thread-pool map helpers in this module.  ONLY the callable position
+    counts — a data argument that happens to share a function's name
+    (``ex.submit(work, parse)``) must not mark that function a thread
+    target, or LUX-C003 false-positives abort the chip_day gate."""
+    targets: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node)
+        last = cn.split(".")[-1]
+        pos = None
+        if last == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                    targets.add(kw.value.id)
+        elif last == "submit":
+            pos = 0
+        elif last == "_parallel_map":  # ops/expand signature: (count, fn, w)
+            pos = 1
+        elif last == "map" and "executor" in cn.lower():
+            pos = 0
+        if pos is not None and pos < len(node.args) and isinstance(
+                node.args[pos], ast.Name):
+            targets.add(node.args[pos].id)
+    return targets
+
+
+def _walk_shallow(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs/lambdas
+    (each nested def is visited as its own function by the caller)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class ThreadSafetyChecker(Checker):
+    family = "thread-safety"
+    name = "threads"
+
+    def run(self, mod: Module) -> Iterable[Finding]:
+        out: List[Finding] = []
+        mutable = _module_mutable_names(mod)
+        thread_targets = _thread_target_names(mod)
+        in_pkg = mod.relpath.startswith("lux_tpu/")
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            globals_declared: Set[str] = set()
+            for node in _walk_shallow(fn):
+                if isinstance(node, ast.Global):
+                    globals_declared.update(node.names)
+            is_thread_target = fn.name in thread_targets
+            for node in _walk_shallow(fn):
+                # --- C001: global write outside a lock ---
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tgts = (node.targets if isinstance(node, ast.Assign)
+                            else [node.target])
+                    for t in tgts:
+                        if (isinstance(t, ast.Name)
+                                and t.id in globals_declared
+                                and not mod.under_lock(node)):
+                            out.append(self.finding(
+                                mod, node, "LUX-C001",
+                                f"write to global `{t.id}` in "
+                                f"`{fn.name}` without a lock — planner/"
+                                "scheduler threads share module state; "
+                                "guard the write or make init eager"))
+                        # --- C002: module container mutated in place ---
+                        elif (isinstance(t, ast.Subscript)
+                              and isinstance(t.value, ast.Name)
+                              and t.value.id in mutable
+                              and not mod.under_lock(node)):
+                            out.append(self.finding(
+                                mod, node, "LUX-C002",
+                                f"unlocked mutation of module-level "
+                                f"container `{t.value.id}` in "
+                                f"`{fn.name}` — guard with a lock"))
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    # --- C002: mutator method on module container ---
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr in _MUTATORS
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id in mutable
+                            and not mod.under_lock(node)):
+                        out.append(self.finding(
+                            mod, node, "LUX-C002",
+                            f"unlocked `{f.value.id}.{f.attr}()` on "
+                            f"module-level container in `{fn.name}` — "
+                            "guard with a lock"))
+                    # --- C004: env write in package code ---
+                    elif (in_pkg and isinstance(f, ast.Attribute)
+                          and f.attr in _ENV_WRITERS
+                          and ast.unparse(f.value) == "os.environ"):
+                        out.append(self.finding(
+                            mod, node, "LUX-C004",
+                            "os.environ mutation in package code — the "
+                            "process env is global state shared with "
+                            "every thread; only tools/ entry points may "
+                            "set it"))
+                    elif in_pkg and call_name(node) in ("os.putenv",
+                                                        "os.unsetenv"):
+                        out.append(self.finding(
+                            mod, node, "LUX-C004",
+                            "os.putenv in package code — env is "
+                            "thread-shared global state"))
+                # --- C004: os.environ[...] = in package code ---
+                if (in_pkg and isinstance(node, ast.Assign)
+                        and any(isinstance(t, ast.Subscript)
+                                and ast.unparse(t.value) == "os.environ"
+                                for t in node.targets)):
+                    out.append(self.finding(
+                        mod, node, "LUX-C004",
+                        "os.environ write in package code — env is "
+                        "thread-shared global state; only tools/ entry "
+                        "points may set it"))
+                # --- C003: env read inside a thread-target function ---
+                if (is_thread_target and isinstance(node, ast.Attribute)
+                        and ast.unparse(node) == "os.environ"):
+                    parent = mod.parent(node)
+                    is_write = (isinstance(parent, ast.Subscript)
+                                and isinstance(mod.parent(parent),
+                                               ast.Assign))
+                    if not is_write:
+                        out.append(self.finding(
+                            mod, node, "LUX-C003",
+                            f"os.environ read inside thread target "
+                            f"`{fn.name}` — resolve env once on the "
+                            "main thread and pass the value in"))
+        return out

@@ -1,0 +1,215 @@
+"""Tracing-safety checkers (LUX-T*): Python control flow and host
+concretization on traced values inside jit / shard_map / Pallas / lax
+control-flow bodies.
+
+The engine's whole performance contract is ONE compiled program per
+(app, layout) replayed for every iteration (docs/PERF.md).  A Python
+``if``/``bool()``/``.item()`` on a traced value either raises a
+ConcretizationTypeError at trace time (best case) or — when the value
+happens to be weakly typed or the branch is shape-dependent — silently
+forces a retrace per distinct value, which on a chip window is the most
+expensive bug class we have.  These lints reject the PATTERN statically
+instead of waiting for the tracer.
+
+Traced contexts recognized (per module, no cross-module dataflow):
+
+* functions decorated ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``
+  / ``@functools.partial(jax.jit, ...)`` (``static_argnames`` /
+  ``static_argnums`` params are exempt — branching on a static is the
+  supported recompile-by-design path);
+* functions decorated with / wrapped in ``shard_map`` the same way;
+* local ``def``s passed to ``jax.jit(f)``, ``shard_map(f, ...)``,
+  ``lax.scan(f, ...)``, ``lax.while_loop(cond, body, ...)``,
+  ``lax.fori_loop(lo, hi, f, ...)``, ``lax.cond(p, t, f, ...)``,
+  ``pl.pallas_call(kernel, ...)``.
+
+Within a traced body, a NON-static parameter is a traced value; we flag
+direct uses only (no aliasing) — precision over recall, because every
+false positive costs a justified suppression.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from lux_tpu.analysis.core import (
+    Checker, Finding, Module, call_name, dotted_name,
+)
+
+_JIT_CALLEES = {"jit", "jax.jit", "shard_map", "jax.experimental."
+                "shard_map.shard_map"}
+_PARTIAL_CALLEES = {"partial", "functools.partial"}
+#: callee -> argument positions whose function operand is traced
+_TRACED_ARG_POS = {
+    "scan": (0,), "lax.scan": (0,), "jax.lax.scan": (0,),
+    "while_loop": (0, 1), "lax.while_loop": (0, 1),
+    "jax.lax.while_loop": (0, 1),
+    "fori_loop": (2,), "lax.fori_loop": (2,), "jax.lax.fori_loop": (2,),
+    "cond": (1, 2), "lax.cond": (1, 2), "jax.lax.cond": (1, 2),
+    "pallas_call": (0,), "pl.pallas_call": (0,),
+    "jit": (0,), "jax.jit": (0,),
+    "shard_map": (0,),
+}
+
+_CAST_BUILTINS = {"bool", "int", "float"}
+_HOST_MATERIALIZERS = {"np.asarray", "np.array", "numpy.asarray",
+                       "numpy.array"}
+
+
+def _is_jit_like(call: str) -> bool:
+    return call in _JIT_CALLEES or call.endswith(".jit")
+
+
+def _static_params(fn: ast.FunctionDef, deco: ast.Call) -> Set[str]:
+    """static_argnames/static_argnums of a ``partial(jax.jit, ...)``
+    decorator resolved to parameter names (best effort on literals)."""
+    statics: Set[str] = set()
+    argnames = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in deco.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str):
+                    statics.add(node.value)
+        elif kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, int) and 0 <= node.value < len(argnames):
+                    statics.add(argnames[node.value])
+    return statics
+
+
+def traced_functions(mod: Module) -> Dict[ast.FunctionDef, Set[str]]:
+    """Map of traced FunctionDef -> set of STATIC parameter names."""
+    by_name: Dict[str, ast.FunctionDef] = {}
+    out: Dict[ast.FunctionDef, Set[str]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name[node.name] = node  # last definition wins, like Python
+            for deco in node.decorator_list:
+                if isinstance(deco, ast.Call):
+                    cn = call_name(deco)
+                    if _is_jit_like(cn):
+                        out[node] = set()
+                    elif cn in _PARTIAL_CALLEES and deco.args:
+                        first = deco.args[0]
+                        fname = (call_name(first)
+                                 if isinstance(first, ast.Call)
+                                 else dotted_name(first))
+                        if _is_jit_like(fname):
+                            out[node] = _static_params(node, deco)
+                elif _is_jit_like(dotted_name(deco)):
+                    out[node] = set()
+    # local defs passed by name into tracing entry points
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node)
+        positions = _TRACED_ARG_POS.get(cn)
+        if positions is None and cn.split(".")[-1] in (
+                "scan", "while_loop", "fori_loop", "cond", "pallas_call"):
+            positions = _TRACED_ARG_POS.get(cn.split(".")[-1])
+        if positions is None:
+            continue
+        for pos in positions:
+            if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                fn = by_name.get(node.args[pos].id)
+                if fn is not None and fn not in out:
+                    out[fn] = set()
+    return out
+
+
+def _traced_params(fn: ast.FunctionDef, statics: Set[str]) -> Set[str]:
+    params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                              + fn.args.kwonlyargs)}
+    if fn.args.vararg:
+        params.add(fn.args.vararg.arg)
+    params -= statics
+    params.discard("self")
+    params.discard("cls")
+    # ``interpret``-style trailing flags are Python bools at trace time
+    # in this codebase's idiom; a traced bool would be flagged at the
+    # call site it is concretized, not at every mention
+    return params
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` are trace-time constants."""
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+
+
+def _is_shape_access(node: ast.AST) -> bool:
+    """References like ``x.shape`` / ``x.ndim`` / ``x.dtype`` are static
+    under trace; a Name that only appears under such an attribute is not
+    a traced-value use."""
+    return isinstance(node, ast.Attribute) and node.attr in (
+        "shape", "ndim", "dtype", "size", "sharding")
+
+
+def _traced_name_used(mod: Module, expr: ast.AST, params: Set[str]) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in params:
+            parent = mod.parent(n)
+            if parent is not None and _is_shape_access(parent):
+                continue
+            if isinstance(parent, ast.Call) and parent.func is n:
+                continue  # calling a param: a callee, not a traced array
+            return True
+    return False
+
+
+class TracingSafetyChecker(Checker):
+    family = "tracing-safety"
+    name = "tracing"
+
+    def run(self, mod: Module) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn, statics in traced_functions(mod).items():
+            params = _traced_params(fn, statics)
+            if not params:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.If) and not _is_none_check(
+                        node.test) and _traced_name_used(
+                            mod, node.test, params):
+                    out.append(self.finding(
+                        mod, node, "LUX-T001",
+                        f"Python `if` on traced value in `{fn.name}` — "
+                        "use jnp.where/lax.cond, or declare the argument "
+                        "static (recompile-by-design)"))
+                elif isinstance(node, ast.While) and _traced_name_used(
+                        mod, node.test, params):
+                    out.append(self.finding(
+                        mod, node, "LUX-T002",
+                        f"Python `while` on traced value in `{fn.name}` — "
+                        "use lax.while_loop (a traced bound retraces "
+                        "per value)"))
+                elif isinstance(node, ast.Call):
+                    cn = call_name(node)
+                    if (cn in _CAST_BUILTINS and len(node.args) == 1
+                            and isinstance(node.args[0], ast.Name)
+                            and node.args[0].id in params):
+                        out.append(self.finding(
+                            mod, node, "LUX-T003",
+                            f"`{cn}()` concretizes traced value "
+                            f"`{node.args[0].id}` in `{fn.name}` — forces "
+                            "a host sync / trace error"))
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "item"
+                          and isinstance(node.func.value, ast.Name)
+                          and node.func.value.id in params):
+                        out.append(self.finding(
+                            mod, node, "LUX-T004",
+                            f"`.item()` on traced value "
+                            f"`{node.func.value.id}` in `{fn.name}` — "
+                            "host sync inside the compiled body"))
+                    elif (cn in _HOST_MATERIALIZERS and node.args
+                          and isinstance(node.args[0], ast.Name)
+                          and node.args[0].id in params):
+                        out.append(self.finding(
+                            mod, node, "LUX-T005",
+                            f"`{cn}()` materializes traced value "
+                            f"`{node.args[0].id}` on host in `{fn.name}` "
+                            "— device->host copy per call"))
+        return out

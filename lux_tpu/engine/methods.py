@@ -23,6 +23,7 @@ string participates in jit static arguments and compile caches as usual.
 from __future__ import annotations
 
 import os
+import threading
 
 #: Concrete strategies a resolution may produce.  ("cumsum"/"mxsum" are
 #: sum-only prefix-diff strategies and "pallas" needs the block-CSR
@@ -57,6 +58,13 @@ WINNERS_FILE = os.path.join(
 _overlay_raw_cache: dict | None = None
 _file_winners_cache: dict | None = None
 _platform_cache: str | None = None
+#: one lock for every lazy-init cache above (+ _tiles_cache): method
+#: resolution runs inside engine setup, which PR 2's planner fan-out
+#: calls from worker threads — an unlocked check-then-act would load the
+#: overlay file N times and, worse, let a reset in record_overlay_entry
+#: interleave with a half-done init (luxcheck LUX-C001).  RLock because
+#: _file_winners/pallas_tiles re-enter _overlay_raw under the same lock.
+_CACHE_LOCK = threading.RLock()
 
 
 def overlay_path() -> str:
@@ -71,19 +79,20 @@ def _overlay_raw() -> dict:
     or missing files read as empty (a half-written file must never break
     every driver)."""
     global _overlay_raw_cache
-    if _overlay_raw_cache is None:
-        raw: dict = {}
-        try:
-            import json
+    with _CACHE_LOCK:
+        if _overlay_raw_cache is None:
+            raw: dict = {}
+            try:
+                import json
 
-            with open(overlay_path()) as f:
-                loaded = json.load(f)
-            if isinstance(loaded, dict):
-                raw = loaded
-        except (OSError, ValueError):
-            pass
-        _overlay_raw_cache = raw
-    return _overlay_raw_cache
+                with open(overlay_path()) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict):
+                    raw = loaded
+            except (OSError, ValueError):
+                pass
+            _overlay_raw_cache = raw
+        return _overlay_raw_cache
 
 
 def _deep_merge(dst: dict, src: dict) -> dict:
@@ -151,9 +160,10 @@ def record_overlay_entry(key: str, value) -> None:
         finally:
             lock.close()  # releases the flock
         global _overlay_raw_cache, _file_winners_cache, _tiles_cache
-        _overlay_raw_cache = None
-        _file_winners_cache = None
-        _tiles_cache = None
+        with _CACHE_LOCK:
+            _overlay_raw_cache = None
+            _file_winners_cache = None
+            _tiles_cache = None
         print(f"# recorded {key} -> {value!r} ({path})", flush=True)
     except OSError as e:
         print(f"# winners file not written: {e}", flush=True)
@@ -166,14 +176,15 @@ def _file_winners() -> dict:
     cumsum/mxsum are sum-only anyway), so the overlay is restricted
     exactly like WINNERS."""
     global _file_winners_cache
-    if _file_winners_cache is None:
-        winners = {}
-        for key, val in _overlay_raw().items():
-            plat, _, red = str(key).partition(":")
-            if plat and red and val in ("scan", "scatter"):
-                winners[(plat, red)] = val
-        _file_winners_cache = winners
-    return _file_winners_cache
+    with _CACHE_LOCK:
+        if _file_winners_cache is None:
+            winners = {}
+            for key, val in _overlay_raw().items():
+                plat, _, red = str(key).partition(":")
+                if plat and red and val in ("scan", "scatter"):
+                    winners[(plat, red)] = val
+            _file_winners_cache = winners
+        return _file_winners_cache
 
 
 _tiles_cache: tuple | None = None
@@ -187,7 +198,9 @@ def pallas_tiles() -> tuple | None:
     V_BLK/T_CHUNK).  Malformed entries are ignored, and v_blk must keep
     the 128-lane alignment the kernel grid assumes."""
     global _tiles_cache
-    if _tiles_cache is None:
+    with _CACHE_LOCK:
+        if _tiles_cache is not None:
+            return _tiles_cache or None
         tiles: tuple = ()
         t = _overlay_raw().get("tpu:pallas_tiles")
         if (
@@ -212,11 +225,12 @@ def default_platform() -> str:
     env = os.environ.get("LUX_METHOD_PLATFORM")
     if env:
         return env
-    if _platform_cache is None:
-        import jax
+    with _CACHE_LOCK:
+        if _platform_cache is None:
+            import jax
 
-        _platform_cache = jax.default_backend()
-    return _platform_cache
+            _platform_cache = jax.default_backend()
+        return _platform_cache
 
 
 def _normalize(platform: str) -> str:

@@ -21,6 +21,11 @@ CONVERTER_PATH = os.path.join(_DIR, "build", "lux-convert")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+#: guards the one-shot build/bind: the planner fan-out (ops/expand
+#: _map_parts) calls get_lib from several worker threads at once, and an
+#: unlocked check-then-act here could run the 120 s `make` twice or bind
+#: a half-written .so (luxcheck LUX-C001)
+_LIB_LOCK = threading.Lock()
 
 
 def _try_build() -> bool:
@@ -38,6 +43,13 @@ def _try_build() -> bool:
 
 def get_lib(build: bool = True) -> Optional[ctypes.CDLL]:
     """The loaded native library, or None if unavailable."""
+    if _lib is not None:  # lock-free fast path: a bound lib never changes
+        return _lib
+    with _LIB_LOCK:
+        return _get_lib_locked(build)
+
+
+def _get_lib_locked(build: bool) -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None:
         return _lib
@@ -45,6 +57,7 @@ def get_lib(build: bool = True) -> Optional[ctypes.CDLL]:
         # one failed attempt (missing toolchain / failed make) is final for
         # the process — don't re-pay the compile timeout per call
         return None
+    # luxcheck: disable=LUX-C001 -- caller get_lib holds _LIB_LOCK
     _tried = True
     if not os.path.exists(_LIB_PATH):
         if not build or not _try_build():
@@ -59,6 +72,7 @@ def get_lib(build: bool = True) -> Optional[ctypes.CDLL]:
         # unloadable or stale .so missing a symbol (and make couldn't
         # refresh it): degrade to the NumPy paths, never crash
         return None
+    # luxcheck: disable=LUX-C001 -- caller get_lib holds _LIB_LOCK
     _lib = lib
     return _lib
 
@@ -387,19 +401,17 @@ def get_thread_share() -> int:
 
 def route_threads() -> int:
     """Host-thread count for the batched route colorer: LUX_ROUTE_THREADS
-    if set (>=1), else every core — divided by the current thread's
-    declared planning-worker share (set_thread_share).  The per-B Euler
-    walks are independent sub-problems, so thread count never changes
-    output bytes — only wall-clock (docs/PERF.md plan-build
-    amortization)."""
-    env = os.environ.get("LUX_ROUTE_THREADS")
-    base = 0
-    if env:
-        try:
-            base = max(1, int(env))
-        except ValueError:
-            base = 0
-    if not base:
+    if set (>=1; garbage or non-positive values raise a clear error at
+    the boundary instead of silently running single-threaded through a
+    chip window — utils.config.env_int), else every core — divided by
+    the current thread's declared planning-worker share
+    (set_thread_share).  The per-B Euler walks are independent
+    sub-problems, so thread count never changes output bytes — only
+    wall-clock (docs/PERF.md plan-build amortization)."""
+    from lux_tpu.utils.config import env_int
+
+    base = env_int("LUX_ROUTE_THREADS", minimum=1)
+    if base is None:
         base = os.cpu_count() or 1
     return max(1, base // get_thread_share())
 

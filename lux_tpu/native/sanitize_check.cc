@@ -1,0 +1,217 @@
+// Sanitizer check driver for the native layer (no Python in the loop).
+//
+// Built three ways by the Makefile — `make tsan` / `make asan` /
+// `make ubsan` — and run by tests/test_native.py (slow tier) and
+// tools/ci_check.sh.  A sanitizer report makes the process exit
+// non-zero (TSan's default exitcode, ASan's abort, UBSan with
+// -fno-sanitize-recover), so "rc == 0" IS "zero reports"; the driver
+// additionally asserts BITWISE equality between the serial and
+// multithreaded colorers, so the run re-proves PR 2's determinism
+// contract while TSan watches every byte of it.
+//
+// Why a standalone binary instead of LD_PRELOADing libtsan under
+// pytest: sanitizer runtimes must be loaded before any instrumented
+// code, which for a ctypes-loaded .so means preloading into the Python
+// interpreter — fragile across libc/sanitizer versions and noisy with
+// CPython's own allocations.  A self-contained driver gives a clean
+// zero-report baseline.
+//
+// Modes: `route` (the multithreaded Euler colorer), `io` (the .lux
+// write/read/bucket paths), `all` (default).
+
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <unistd.h>
+
+extern "C" {
+int lux_read_header(const char* path, uint32_t* nv, uint64_t* ne);
+int lux_read_rows(const char* path, uint64_t row_lo, uint64_t row_hi,
+                  uint64_t* out);
+int lux_read_cols(const char* path, uint32_t nv, uint64_t col_lo,
+                  uint64_t col_hi, uint32_t* out);
+int lux_read_weights(const char* path, uint32_t nv, uint64_t ne,
+                     uint64_t col_lo, uint64_t col_hi, int32_t* out);
+int lux_write_from_edges(const char* path, uint32_t nv, uint64_t ne,
+                         const uint32_t* src, const uint32_t* dst,
+                         const int32_t* weights);
+int lux_count_degrees(const uint32_t* col, uint64_t ne, uint32_t nv,
+                      uint32_t* deg);
+int lux_bucket_split(const uint32_t* srcs, uint64_t m,
+                     const uint32_t* cuts, uint32_t num_parts,
+                     uint64_t* order, uint64_t* counts);
+int lux_route_color_batched(const int64_t* u, const int64_t* v,
+                            int64_t batches, int64_t n, int32_t deg,
+                            int64_t nside, int32_t* colors);
+int lux_route_color_batched_mt(const int64_t* u, const int64_t* v,
+                               int64_t batches, int64_t n, int32_t deg,
+                               int64_t nside, int32_t* colors,
+                               int32_t n_threads);
+}
+
+namespace {
+
+int failures = 0;
+
+#define CHECK(cond, ...)                                          \
+  do {                                                            \
+    if (!(cond)) {                                                \
+      std::fprintf(stderr, "CHECK failed (%s:%d): ", __FILE__,    \
+                   __LINE__);                                     \
+      std::fprintf(stderr, __VA_ARGS__);                          \
+      std::fprintf(stderr, "\n");                                 \
+      ++failures;                                                 \
+    }                                                             \
+  } while (0)
+
+// Deterministic LCG (no libc rand: reproducible across libcs, and the
+// serial-vs-threaded comparison needs identical inputs every build).
+struct Lcg {
+  uint64_t s;
+  explicit Lcg(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    s = s * 6364136223846793005ULL + 1442695040888963407ULL;
+    return s >> 17;
+  }
+};
+
+// One deg-regular bipartite multigraph: u = each left id deg times,
+// v = a Fisher-Yates shuffle of the same multiset.
+void make_regular(int64_t nside, int32_t deg, uint64_t seed,
+                  std::vector<int64_t>& u, std::vector<int64_t>& v) {
+  const int64_t n = nside * deg;
+  u.resize(n);
+  v.resize(n);
+  for (int64_t i = 0; i < nside; ++i)
+    for (int32_t d = 0; d < deg; ++d) u[i * deg + d] = v[i * deg + d] = i;
+  Lcg rng(seed);
+  for (int64_t i = n - 1; i > 0; --i) {
+    const int64_t j = static_cast<int64_t>(rng.next() % (i + 1));
+    std::swap(v[i], v[j]);
+  }
+}
+
+// Every color class of a valid deg-coloring is a perfect matching:
+// each side id appears exactly once per color.
+void check_matching(const int64_t* u, const int64_t* v,
+                    const int32_t* colors, int64_t n, int32_t deg,
+                    int64_t nside) {
+  std::vector<int32_t> seen_u(deg * nside, 0), seen_v(deg * nside, 0);
+  for (int64_t k = 0; k < n; ++k) {
+    const int32_t c = colors[k];
+    CHECK(c >= 0 && c < deg, "color %d out of range", c);
+    if (c < 0 || c >= deg) return;
+    CHECK(++seen_u[c * nside + u[k]] == 1,
+          "left id %" PRId64 " repeated in color %d", u[k], c);
+    CHECK(++seen_v[c * nside + v[k]] == 1,
+          "right id %" PRId64 " repeated in color %d", v[k], c);
+  }
+}
+
+void run_route_case(int64_t batches, int64_t nside, int32_t deg,
+                    uint64_t seed) {
+  const int64_t n = nside * deg;
+  std::vector<int64_t> u(batches * n), v(batches * n);
+  for (int64_t b = 0; b < batches; ++b) {
+    std::vector<int64_t> ub, vb;
+    make_regular(nside, deg, seed + 77 * b, ub, vb);
+    std::memcpy(u.data() + b * n, ub.data(), n * sizeof(int64_t));
+    std::memcpy(v.data() + b * n, vb.data(), n * sizeof(int64_t));
+  }
+  std::vector<int32_t> serial(batches * n), threaded(batches * n);
+  CHECK(lux_route_color_batched(u.data(), v.data(), batches, n, deg,
+                                nside, serial.data()) == 0,
+        "serial colorer failed");
+  for (int32_t nt : {2, 3, 8}) {
+    std::fill(threaded.begin(), threaded.end(), -1);
+    CHECK(lux_route_color_batched_mt(u.data(), v.data(), batches, n, deg,
+                                     nside, threaded.data(), nt) == 0,
+          "threaded colorer failed (nt=%d)", nt);
+    CHECK(std::memcmp(serial.data(), threaded.data(),
+                      serial.size() * sizeof(int32_t)) == 0,
+          "BITWISE MISMATCH serial vs %d threads (B=%" PRId64
+          " nside=%" PRId64 " deg=%d)",
+          nt, batches, nside, deg);
+  }
+  for (int64_t b = 0; b < batches; ++b)
+    check_matching(u.data() + b * n, v.data() + b * n,
+                   serial.data() + b * n, n, deg, nside);
+  std::printf("route ok: B=%" PRId64 " nside=%" PRId64 " deg=%d x{2,3,8} "
+              "threads bitwise == serial\n", batches, nside, deg);
+}
+
+void run_route() {
+  // many small batches: batch-level parallelism + work-queue contention
+  run_route_case(/*batches=*/6, /*nside=*/2048, /*deg=*/8, 1234);
+  // ONE big batch: level-synchronous FRAME parallelism (the planner's
+  // real shape — the top recursion level is a single coloring)
+  run_route_case(/*batches=*/1, /*nside=*/8192, /*deg=*/16, 99);
+}
+
+void run_io() {
+  const uint32_t nv = 300;
+  const uint64_t ne = 4000;
+  std::vector<uint32_t> src(ne), dst(ne);
+  std::vector<int32_t> w(ne);
+  Lcg rng(42);
+  for (uint64_t e = 0; e < ne; ++e) {
+    src[e] = static_cast<uint32_t>(rng.next() % nv);
+    dst[e] = static_cast<uint32_t>(rng.next() % nv);
+    w[e] = static_cast<int32_t>(rng.next() % 100) + 1;
+  }
+  std::string path = "/tmp/lux_sanitize_check_" +
+                     std::to_string(static_cast<long>(getpid())) + ".lux";
+  CHECK(lux_write_from_edges(path.c_str(), nv, ne, src.data(), dst.data(),
+                             w.data()) == 0, "write_from_edges failed");
+  uint32_t nv2 = 0;
+  uint64_t ne2 = 0;
+  CHECK(lux_read_header(path.c_str(), &nv2, &ne2) == 0, "read_header");
+  CHECK(nv2 == nv && ne2 == ne, "header mismatch %u %" PRIu64, nv2, ne2);
+  std::vector<uint64_t> rows(nv);
+  CHECK(lux_read_rows(path.c_str(), 0, nv, rows.data()) == 0, "read_rows");
+  CHECK(rows[nv - 1] == ne, "last row_end %" PRIu64, rows[nv - 1]);
+  // partial reads at awkward offsets (the pread64 paths)
+  std::vector<uint32_t> cols(ne);
+  CHECK(lux_read_cols(path.c_str(), nv, 7, ne - 3, cols.data()) == 0,
+        "read_cols partial");
+  std::vector<int32_t> wback(ne);
+  CHECK(lux_read_weights(path.c_str(), nv, ne, 7, ne - 3,
+                         wback.data()) == 0, "read_weights partial");
+  CHECK(lux_read_cols(path.c_str(), nv, 0, ne, cols.data()) == 0,
+        "read_cols full");
+  std::vector<uint32_t> deg(nv, 0);
+  CHECK(lux_count_degrees(cols.data(), ne, nv, deg.data()) == 0,
+        "count_degrees");
+  uint64_t total = 0;
+  for (uint32_t i = 0; i < nv; ++i) total += deg[i];
+  CHECK(total == ne, "degree sum %" PRIu64, total);
+  const uint32_t cuts[] = {0, 100, 100, 256, nv};
+  std::vector<uint64_t> order(ne), counts(4, 0);
+  CHECK(lux_bucket_split(src.data(), ne, cuts, 4, order.data(),
+                         counts.data()) == 0, "bucket_split");
+  total = 0;
+  for (int q = 0; q < 4; ++q) total += counts[q];
+  CHECK(total == ne, "bucket counts sum %" PRIu64, total);
+  std::remove(path.c_str());
+  std::printf("io ok: nv=%u ne=%" PRIu64 " roundtrip + partial reads + "
+              "buckets\n", nv, ne);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const std::string mode = argc > 1 ? argv[1] : "all";
+  if (mode == "route" || mode == "all") run_route();
+  if (mode == "io" || mode == "all") run_io();
+  if (failures) {
+    std::fprintf(stderr, "sanitize_check: %d failure(s)\n", failures);
+    return 1;
+  }
+  std::printf("sanitize_check: all clean (%s)\n", mode.c_str());
+  return 0;
+}

@@ -95,16 +95,16 @@ def reset_plan_stats() -> None:
 
 
 def _plan_threads() -> int:
-    """Python-side plan fan-out width: LUX_PLAN_THREADS if set, else one
-    per core.  The per-part planners are pure NumPy + the native colorer
-    (which releases the GIL), so threads scale until the cores do."""
-    env = os.environ.get("LUX_PLAN_THREADS")
-    if env:
-        try:
-            return max(1, int(env))
-        except ValueError:
-            pass
-    return os.cpu_count() or 1
+    """Python-side plan fan-out width: LUX_PLAN_THREADS if set (>=1;
+    garbage or non-positive values raise a clear error naming the knob
+    at the boundary — the old silent fallback hid a typo'd value as a
+    mysteriously serial plan build), else one per core.  The per-part
+    planners are pure NumPy + the native colorer (which releases the
+    GIL), so threads scale until the cores do."""
+    from lux_tpu.utils.config import env_int
+
+    n = env_int("LUX_PLAN_THREADS", minimum=1)
+    return n if n is not None else (os.cpu_count() or 1)
 
 
 def _parallel_map(count: int, fn, workers: int):
@@ -992,18 +992,19 @@ def _default_cache_dir() -> str:
 
 #: the dataclass vocabulary a cached plan static may contain — the JSON
 #: decoder instantiates ONLY these (nothing in the cache file can name
-#: arbitrary code, unlike the pickle format this replaced)
-_STATIC_TYPES = None
+#: arbitrary code, unlike the pickle format this replaced).  Built
+#: EAGERLY at import: the cached planners read it from _map_parts worker
+#: threads, and the old unlocked lazy init was a check-then-act race
+#: (luxcheck LUX-C001 — benign under the GIL today, a landmine under
+#: free threading)
+_STATIC_TYPES = {
+    cls.__name__: cls
+    for cls in (ExpandStatic, FusedStatic, CFRouteStatic, FFStatic,
+                FFLevelStatic, shuf.StaticRoute, shuf.StaticPass)
+}
 
 
 def _static_types() -> dict:
-    global _STATIC_TYPES
-    if _STATIC_TYPES is None:
-        _STATIC_TYPES = {
-            cls.__name__: cls
-            for cls in (ExpandStatic, FusedStatic, CFRouteStatic, FFStatic,
-                        FFLevelStatic, shuf.StaticRoute, shuf.StaticPass)
-        }
     return _STATIC_TYPES
 
 

@@ -232,7 +232,11 @@ class MicroBatchScheduler:
                 r.event.set()
             return resolved + len(batch)
         service_s = self._clock() - t0
-        self._last_service_s = service_s
+        with self._lock:
+            # submitters read this (via _retry_after_ms) under the same
+            # lock; an unguarded cross-thread write worked only by the
+            # grace of the GIL (luxcheck triage, thread-safety family)
+            self._last_service_s = service_s
         self.metrics.record_batch(q=q, real=len(batch),
                                   warm=warm_bucket and was_warm,
                                   service_s=service_s)

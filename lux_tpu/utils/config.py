@@ -12,7 +12,37 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 from typing import Optional
+
+
+def env_int(name: str, default: Optional[int] = None, *,
+            minimum: Optional[int] = None,
+            maximum: Optional[int] = None) -> Optional[int]:
+    """Parse an integer env knob at the BOUNDARY, with an error that
+    names the variable.  ``LUX_PLAN_THREADS=garbage`` used to surface as
+    a bare ``ValueError: invalid literal`` deep inside the planner
+    fan-out (or worse, be silently swallowed into a fallback, hiding the
+    typo'd knob); every ``int(os.environ...)`` cast now routes through
+    here (enforced by luxcheck LUX-P002).
+
+    Unset or empty reads as ``default``.  A set-but-garbage or
+    out-of-bounds value raises ValueError immediately — a mistyped
+    thread count must fail the launch, not quietly run single-threaded
+    through a chip window."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        val = int(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"{name} must be an integer, got {raw!r}") from None
+    if minimum is not None and val < minimum:
+        raise ValueError(f"{name} must be >= {minimum}, got {val}")
+    if maximum is not None and val > maximum:
+        raise ValueError(f"{name} must be <= {maximum}, got {val}")
+    return val
 
 
 @dataclasses.dataclass

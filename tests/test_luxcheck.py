@@ -1,0 +1,515 @@
+"""luxcheck (lux_tpu.analysis): each checker family catches its seeded
+violation, suppressions round-trip (inline + baseline, justification
+mandatory), and the shipped package is luxcheck-clean — the tier-1 form
+of the chip-day step -3 gate."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from lux_tpu.analysis import ALL_CHECKERS, check_paths
+from lux_tpu.analysis.core import DEFAULT_TARGETS, Finding, Module
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _check_snippet(tmp_path, relpath, source):
+    """Write ``source`` at ``relpath`` under a scratch repo root and run
+    the full checker set on it (checker scopes key off the relpath)."""
+    full = tmp_path / relpath
+    full.parent.mkdir(parents=True, exist_ok=True)
+    full.write_text(textwrap.dedent(source))
+    return check_paths([relpath], str(tmp_path))
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# tracing-safety fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_tracing_if_on_traced_value(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/engine/bad_jit.py", """\
+        import jax
+
+        @jax.jit
+        def step(state, frontier):
+            if frontier:
+                return state + 1
+            return state
+        """)
+    assert "LUX-T001" in _codes(fs)
+
+
+def test_tracing_while_and_item(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/engine/bad_loop.py", """\
+        import jax
+
+        @jax.jit
+        def run(active, state):
+            while active:
+                state = state * 2
+            return state.sum()
+
+        @jax.jit
+        def pick(dist):
+            return dist.item()
+        """)
+    assert "LUX-T002" in _codes(fs)
+    assert "LUX-T004" in _codes(fs)
+
+
+def test_tracing_cast_in_scan_body(tmp_path):
+    """A local def handed to lax.scan is a traced context even without a
+    jit decorator."""
+    fs = _check_snippet(tmp_path, "lux_tpu/engine/bad_scan.py", """\
+        import jax
+        from jax import lax
+
+        def driver(xs):
+            def body(carry, x):
+                flag = bool(x)
+                return carry + int(flag), x
+            return lax.scan(body, 0, xs)
+        """)
+    assert "LUX-T003" in _codes(fs)
+
+
+def test_tracing_statics_and_none_checks_exempt(tmp_path):
+    """static_argnames branching is the supported recompile-by-design
+    path; `x is None` is a trace-time constant; `.shape` access is
+    static — none may fire."""
+    fs = _check_snippet(tmp_path, "lux_tpu/engine/good_jit.py", """\
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("num_iters",))
+        def run(state, num_iters, mask=None):
+            if num_iters > 3:
+                state = state * 2
+            if mask is None:
+                mask = jnp.ones_like(state)
+            if state.shape[0] > 128:
+                state = state[:128]
+            return jnp.where(mask > 0, state, 0.0)
+        """)
+    assert _codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# determinism fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_determinism_set_iteration(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/graph/bad_set.py", """\
+        import numpy as np
+
+        def owners(edges):
+            uniq = set(int(e) for e in edges)
+            return np.array([x for x in uniq if x > 0])
+
+        def cuts(parts):
+            return list({p.lo for p in parts})
+        """)
+    # the comprehension over `uniq` is an aliased set (untracked —
+    # precision over recall), but the literal/list(set) forms must fire
+    assert "LUX-D001" in _codes(fs)
+
+
+def test_determinism_set_sorted_is_clean(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/graph/good_set.py", """\
+        def owners(edges):
+            return sorted(set(edges))
+
+        def count(edges):
+            return len(set(edges))
+        """)
+    assert _codes(fs) == []
+
+
+def test_determinism_wall_clock_and_rng(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/engine/bad_entropy.py", """\
+        import time
+        import numpy as np
+
+        def stamp_plan(plan):
+            plan["built_at"] = time.time()
+            return plan
+
+        def jitter(n):
+            return np.random.rand(n)
+        """)
+    codes = _codes(fs)
+    assert "LUX-D002" in codes
+    assert "LUX-D003" in codes
+
+
+def test_determinism_perf_counter_clean(tmp_path):
+    """perf_counter/monotonic are timing, not calendar — exempt."""
+    fs = _check_snippet(tmp_path, "lux_tpu/engine/good_timing.py", """\
+        import time
+
+        def timed(fn):
+            t0 = time.perf_counter()
+            out = fn()
+            return out, time.perf_counter() - t0
+        """)
+    assert _codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# thread-safety fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_threads_unlocked_global_and_container(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/ops/bad_state.py", """\
+        _CACHE = None
+        _STATS = {"built": 0}
+
+        def get_cache():
+            global _CACHE
+            if _CACHE is None:
+                _CACHE = {"x": 1}
+            return _CACHE
+
+        def bump():
+            _STATS["built"] += 1
+        """)
+    codes = _codes(fs)
+    assert "LUX-C001" in codes
+    assert "LUX-C002" in codes
+
+
+def test_threads_locked_is_clean(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/ops/good_state.py", """\
+        import threading
+
+        _LOCK = threading.Lock()
+        _CACHE = None
+        _STATS = {"built": 0}
+
+        def get_cache():
+            global _CACHE
+            with _LOCK:
+                if _CACHE is None:
+                    _CACHE = {"x": 1}
+                return _CACHE
+
+        def bump():
+            with _LOCK:
+                _STATS["built"] += 1
+        """)
+    assert _codes(fs) == []
+
+
+def test_threads_env_read_in_thread_target_and_env_write(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/ops/bad_threads.py", """\
+        import os
+        import threading
+
+        def spawn():
+            def work():
+                width = os.environ.get("LUX_WIDTH", "1")
+                return int(width)
+            t = threading.Thread(target=work)
+            t.start()
+            return t
+
+        def force_cpu():
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        """)
+    codes = _codes(fs)
+    assert "LUX-C003" in codes
+    assert "LUX-C004" in codes
+
+
+# ---------------------------------------------------------------------------
+# policy fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_policy_pickle_and_env_cast(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/ops/bad_policy.py", """\
+        import os
+        import pickle
+        import numpy as np
+
+        def load_plan(path):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+
+        def load_npz(path):
+            return np.load(path, allow_pickle=True)
+
+        def threads():
+            return int(os.environ.get("LUX_THREADS", "1"))
+        """)
+    codes = _codes(fs)
+    assert codes.count("LUX-P001") >= 2  # import + allow_pickle=True
+    assert "LUX-P002" in codes
+
+
+def test_policy_uint8_narrowing_outside_narrow_idx(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/ops/bad_narrow.py", """\
+        import numpy as np
+
+        def shrink(idx):
+            return idx.astype(np.uint8)
+
+        def _narrow_idx(a):
+            assert a.max() < 128
+            return a.astype(np.uint8)
+        """)
+    # `shrink` fires; the blessed _narrow_idx home does not
+    assert _codes(fs) == ["LUX-P003"]
+
+
+# ---------------------------------------------------------------------------
+# suppression machinery
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_roundtrip(tmp_path):
+    src = """\
+        import pickle  # luxcheck: disable=LUX-P001 -- fixture: legacy tool kept for migration
+        """
+    assert _codes(_check_snippet(tmp_path, "lux_tpu/a.py", src)) == []
+
+
+def test_inline_suppression_previous_line(tmp_path):
+    src = """\
+        # luxcheck: disable=LUX-P001 -- fixture: legacy tool kept for migration
+        import pickle
+        """
+    assert _codes(_check_snippet(tmp_path, "lux_tpu/b.py", src)) == []
+
+
+def test_inline_suppression_requires_justification(tmp_path):
+    src = """\
+        import pickle  # luxcheck: disable=LUX-P001
+        """
+    codes = _codes(_check_snippet(tmp_path, "lux_tpu/c.py", src))
+    # unjustified: the original finding SURVIVES and the bare
+    # suppression is itself flagged
+    assert "LUX-P001" in codes
+    assert "LUX-X001" in codes
+
+
+def test_inline_suppression_wrong_code_does_not_cover(tmp_path):
+    src = """\
+        import pickle  # luxcheck: disable=LUX-D001 -- wrong code entirely here
+        """
+    codes = _codes(_check_snippet(tmp_path, "lux_tpu/d.py", src))
+    assert "LUX-P001" in codes
+
+
+def test_threads_submit_data_args_not_targets(tmp_path):
+    """Only the CALLABLE position of submit/map marks a thread target —
+    a data argument sharing a function's name must not make that
+    function's env reads LUX-C003 (a lint FP aborts the chip gate)."""
+    fs = _check_snippet(tmp_path, "lux_tpu/ops/submit_args.py", """\
+        import os
+        from concurrent import futures
+
+        def work(x):
+            return x + 1
+
+        def helper():
+            return os.environ.get("LUX_MODE", "a")
+
+        def spawn(executor):
+            return executor.submit(work, helper)
+        """)
+    assert _codes(fs) == []
+
+
+def test_suppression_in_docstring_is_inert(tmp_path):
+    """The suppression syntax QUOTED in a docstring (docs showing the
+    feature) must neither register a live suppression nor emit a
+    phantom LUX-X001 — only real comments count (tokenize-based scan)."""
+    fs = _check_snippet(tmp_path, "lux_tpu/doc_sup.py", '''\
+        """Docs: suppress with  # luxcheck: disable=LUX-P001
+        or with a reason:  # luxcheck: disable=LUX-P001 -- why it is safe
+        """
+        import pickle
+        ''')
+    # the docstring registers nothing: no X001, and the real finding on
+    # line 4 survives (the line-2 example must not cover line 3's next
+    # line either)
+    assert _codes(fs) == ["LUX-P001"]
+
+
+def test_overlapping_targets_scan_once(tmp_path):
+    """--all plus an explicit subpath must not double-report (duplicates
+    would also break one-shot baseline consumption)."""
+    rel = "lux_tpu/dup.py"
+    (tmp_path / "lux_tpu").mkdir(parents=True, exist_ok=True)
+    (tmp_path / rel).write_text("import pickle\n")
+    findings = check_paths(["lux_tpu", rel, "lux_tpu"], str(tmp_path))
+    assert _codes(findings) == ["LUX-P001"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    rel = "lux_tpu/base.py"
+    full = tmp_path / rel
+    full.parent.mkdir(parents=True, exist_ok=True)
+    full.write_text("import pickle\n")
+    findings = check_paths([rel], str(tmp_path))
+    assert _codes(findings) == ["LUX-P001"]
+    fp = findings[0].fingerprint()
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        f"{rel}:LUX-P001:{fp}  # fixture: justified baseline entry\n")
+    assert check_paths([rel], str(tmp_path),
+                       baseline_path=str(baseline)) == []
+    # unjustified entry: does not suppress, and is flagged itself
+    baseline.write_text(f"{rel}:LUX-P001:{fp}\n")
+    codes = _codes(check_paths([rel], str(tmp_path),
+                               baseline_path=str(baseline)))
+    assert "LUX-P001" in codes and "LUX-X002" in codes
+    # stale entry (code fixed, entry left behind) is a finding
+    full.write_text("x = 1\n")
+    baseline.write_text(
+        f"{rel}:LUX-P001:{fp}  # fixture: now-stale baseline entry\n")
+    codes = _codes(check_paths([rel], str(tmp_path),
+                               baseline_path=str(baseline)))
+    assert codes == ["LUX-X003"]
+
+
+def test_fingerprint_tracks_text_not_line(tmp_path):
+    """Adding lines above a finding must not invalidate its baseline
+    entry (fingerprints hash the line TEXT, not the number)."""
+    a = Finding(path="p.py", line=5, code="LUX-P001", col=0,
+                message="m", text="import pickle")
+    b = Finding(path="p.py", line=50, code="LUX-P001", col=0,
+                message="m", text="import pickle")
+    assert a.fingerprint() == b.fingerprint()
+    c = Finding(path="p.py", line=5, code="LUX-P001", col=0,
+                message="m", text="import dill")
+    assert a.fingerprint() != c.fingerprint()
+
+
+def test_unparsable_file_is_a_finding(tmp_path):
+    codes = _codes(_check_snippet(tmp_path, "lux_tpu/broken.py",
+                                  "def broken(:\n"))
+    assert codes == ["LUX-X000"]
+
+
+def test_missing_target_is_a_finding(tmp_path):
+    """A typo'd/renamed target must FAIL the gate — 'clean' after
+    scanning zero files is how a preflight silently stops
+    preflighting."""
+    findings = check_paths(["lux_tpu/nonexistent_dir", "typo.py"],
+                           str(tmp_path))
+    assert _codes(findings) == ["LUX-X000", "LUX-X000"]
+    assert "does not exist" in findings[0].message
+
+
+def test_baseline_entry_is_one_shot(tmp_path):
+    """Fingerprints hash line TEXT, so identical lines collide: one
+    justified entry must suppress exactly ONE occurrence, never a
+    second (possibly future) identical line."""
+    rel = "lux_tpu/twice.py"
+    full = tmp_path / rel
+    full.parent.mkdir(parents=True, exist_ok=True)
+    full.write_text("import pickle\nimport pickle\n")
+    findings = check_paths([rel], str(tmp_path))
+    assert _codes(findings) == ["LUX-P001", "LUX-P001"]
+    fp = findings[0].fingerprint()
+    assert fp == findings[1].fingerprint()  # the collision being guarded
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        f"{rel}:LUX-P001:{fp}  # fixture: covers only one occurrence\n")
+    codes = _codes(check_paths([rel], str(tmp_path),
+                               baseline_path=str(baseline)))
+    assert codes == ["LUX-P001"]
+    # two entries cover both; a third is stale
+    baseline.write_text(
+        f"{rel}:LUX-P001:{fp}  # fixture: first occurrence justified\n"
+        f"{rel}:LUX-P001:{fp}  # fixture: second occurrence justified\n"
+        f"{rel}:LUX-P001:{fp}  # fixture: third entry must go stale\n")
+    codes = _codes(check_paths([rel], str(tmp_path),
+                               baseline_path=str(baseline)))
+    assert codes == ["LUX-X003"]
+
+
+# ---------------------------------------------------------------------------
+# the repo gate
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_luxcheck_clean():
+    """The shipped package/tools/bench are clean under the full checker
+    set + the checked-in baseline — the tier-1 twin of chip_day's
+    step -3 preflight.  A finding here means: fix it, or suppress it
+    WITH a justification (docs/ANALYSIS.md)."""
+    baseline = os.path.join(REPO, "tools", "luxcheck_baseline.txt")
+    findings = check_paths(list(DEFAULT_TARGETS), REPO,
+                           baseline_path=baseline)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_luxcheck_cli_clean_and_jax_free():
+    """`python tools/luxcheck.py --all` exits 0 on the repo, and the
+    preflight never imports jax (it must run on a host whose jax/tunnel
+    is wedged) — asserted via an import tripwire."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    code = (
+        "import builtins, runpy, sys\n"
+        "real = builtins.__import__\n"
+        "def guard(name, *a, **k):\n"
+        "    assert not name.startswith('jax'), 'luxcheck imported jax'\n"
+        "    return real(name, *a, **k)\n"
+        "builtins.__import__ = guard\n"
+        "sys.argv = ['luxcheck.py', '--all']\n"
+        "try:\n"
+        "    runpy.run_path(%r, run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    sys.exit(e.code)\n" % os.path.join(REPO, "tools", "luxcheck.py")
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, cwd=REPO,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_every_family_has_a_checker():
+    fams = {c.family for c in ALL_CHECKERS}
+    assert fams == {"tracing-safety", "determinism", "thread-safety",
+                    "policy"}
+
+
+# ---------------------------------------------------------------------------
+# env_int (the LUX-P002 contract)
+# ---------------------------------------------------------------------------
+
+
+def test_env_int(monkeypatch):
+    from lux_tpu.utils.config import env_int
+
+    monkeypatch.delenv("LUX_TEST_KNOB", raising=False)
+    assert env_int("LUX_TEST_KNOB") is None
+    assert env_int("LUX_TEST_KNOB", 7) == 7
+    monkeypatch.setenv("LUX_TEST_KNOB", " 12 ")
+    assert env_int("LUX_TEST_KNOB", 7) == 12
+    monkeypatch.setenv("LUX_TEST_KNOB", "")
+    assert env_int("LUX_TEST_KNOB", 7) == 7
+    monkeypatch.setenv("LUX_TEST_KNOB", "twelve")
+    with pytest.raises(ValueError, match="LUX_TEST_KNOB"):
+        env_int("LUX_TEST_KNOB")
+    monkeypatch.setenv("LUX_TEST_KNOB", "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        env_int("LUX_TEST_KNOB", minimum=1)
+    monkeypatch.setenv("LUX_TEST_KNOB", "999")
+    with pytest.raises(ValueError, match="<= 256"):
+        env_int("LUX_TEST_KNOB", maximum=256)

