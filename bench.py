@@ -36,11 +36,25 @@ Env knobs:
   LUX_BENCH_TPU_S  (default budget-120) how long to wait for the TPU worker
   LUX_BENCH_CPU_SCALE (default min(scale, 18)) fallback worker's RMAT scale
                    — a 1-core CPU needs a smaller graph to finish in budget
-  LUX_BENCH_APPS   (default pagerank,sssp,components,colfilter,serve)
+  LUX_BENCH_APPS   (default pagerank,sssp,components,colfilter,serve,ba)
                    which app metrics to measure; pagerank is the headline
                    and always prints last.  "serve" is the batched
                    query-serving row (lux_tpu.serve): sssp_qps_* — warm
-                   Q=64 batched QPS vs warm Q=1 sequential.
+                   Q=64 batched QPS vs warm Q=1 sequential.  "ba" is the
+                   standing heavy-tail row: a Barabási-Albert graph
+                   (LUX_BENCH_BA_SCALE, default min(scale, 20) vertices
+                   = 2**bs; LUX_BENCH_BA_M out-edges/vertex, default 4)
+                   through generator -> .lux round trip -> ROUTED-PF
+                   pull, so hub skew is measured where routed-plan
+                   padding bites (VERDICT r5 weak #4).
+  LUX_BENCH_ROUTE_PF=1 / LUX_BENCH_ROUTE_FUSED_PF=1  A/B the PASS-FUSED
+                   routed pipelines (ops/expand.to_pf: 2-3 Benes passes
+                   per Pallas kernel, VMEM-resident intermediates —
+                   ~40% fewer HBM sweeps/iter); _routepf/_routefusedpf
+                   metric suffixes.  The DEFAULT TPU race also measures
+                   a _routepf line right after the _route line (same
+                   plan build + a numpy transform) and records the
+                   winner under "tpu:route_mode" in the overlay.
   LUX_BENCH_RELAY_CAP_S (default 240) grace past last-seen-alive while the
                    relay endpoint is down.  The TPU-claim wait is ADAPTIVE
                    (_wait_tpu): liveness is re-probed throughout, so a
@@ -205,8 +219,15 @@ def worker_main():
     # suffix.  The reduce-method race is meaningless here (the fused
     # path replaces the reducer), so exactly one line is measured.
     route_fused = os.environ.get("LUX_BENCH_ROUTE_FUSED") == "1"
-    if sum([route_gather, route_fused, compact]) > 1:
+    # LUX_BENCH_ROUTE_PF / LUX_BENCH_ROUTE_FUSED_PF: the PASS-FUSED
+    # variants (expand.to_pf — 2-3 Benes passes per kernel, one HBM
+    # read+write per group); _routepf/_routefusedpf suffixes.
+    route_pf = os.environ.get("LUX_BENCH_ROUTE_PF") == "1"
+    route_fused_pf = os.environ.get("LUX_BENCH_ROUTE_FUSED_PF") == "1"
+    if sum([route_gather, route_fused, route_pf, route_fused_pf,
+            compact]) > 1:
         raise SystemExit("LUX_BENCH_ROUTE_GATHER / LUX_BENCH_ROUTE_FUSED "
+                         "/ LUX_BENCH_ROUTE_PF / LUX_BENCH_ROUTE_FUSED_PF "
                          "/ LUX_BENCH_COMPACT_GATHER are mutually exclusive")
     shards = build_pull_shards(g, 1, sort_segments=sort_seg,
                                compact_gather=compact)
@@ -216,13 +237,16 @@ def worker_main():
     # threading a parameter through every closure
     _layout = {"route": None, "route_tag": ""}
     route_plan = None
-    if route_gather or route_fused:
+    if route_gather or route_fused or route_pf or route_fused_pf:
         from lux_tpu.ops import expand
 
         t_plan = time.time()
-        route_plan = (expand.plan_fused_shards_cached(shards, "sum")
-                      if route_fused
-                      else expand.plan_expand_shards_cached(shards))
+        if route_fused or route_fused_pf:
+            route_plan = expand.plan_fused_shards_cached(
+                shards, "sum", pf=route_fused_pf)
+        else:
+            route_plan = expand.plan_expand_shards_cached(
+                shards, pf=route_pf)
         # device-resident once, like the graph arrays below — NOT per
         # run(n) call (the stacked pass arrays are ~1 GB at scale 20;
         # re-transfer would burn the TPU budget inside the timed loop)
@@ -234,7 +258,12 @@ def worker_main():
               f"{len(route_plan[1])} pass arrays, on device)",
               file=sys.stderr, flush=True)
         _layout["route"] = route_plan
-        _layout["route_tag"] = "_routefused" if route_fused else "_route"
+        _layout["route_tag"] = {
+            (True, False, False, False): "_route",
+            (False, True, False, False): "_routefused",
+            (False, False, True, False): "_routepf",
+            (False, False, False, True): "_routefusedpf",
+        }[(route_gather, route_fused, route_pf, route_fused_pf)]
     print(f"# worker: graph ready nv={g.nv} ne={g.ne}", file=sys.stderr, flush=True)
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     jax.block_until_ready(arrays)
@@ -305,23 +334,24 @@ def worker_main():
             if on_tpu
             else ["scan", "scatter"]
         )
-        if route_gather and "pallas" in methods:
+        if (route_gather or route_pf) and "pallas" in methods:
             # the pallas runner never sees route_plan — timing it here
             # would bank an unrouted number under the _route suffix
             methods.remove("pallas")
-        if route_fused:
+        if route_fused or route_fused_pf:
             # one line: the fused pipeline IS the method
             methods = ["fused"]
         risky_tail = ["scan"] if on_tpu else []
     else:
-        methods = ["fused"] if route_fused else [method_env]
+        methods = (["fused"] if route_fused or route_fused_pf
+                   else [method_env])
         risky_tail = []
     results = {}
 
     apps = [
         a.strip()
         for a in os.environ.get(
-            "LUX_BENCH_APPS", "pagerank,sssp,components,colfilter,serve"
+            "LUX_BENCH_APPS", "pagerank,sssp,components,colfilter,serve,ba"
         ).split(",")
         if a.strip()
     ]
@@ -334,7 +364,8 @@ def worker_main():
     rp_future = None
     rp_state = {"warm": None}
     if ("pagerank" in apps and on_tpu
-            and not (route_gather or route_fused or compact or sort_seg)):
+            and not (route_gather or route_fused or route_pf
+                     or route_fused_pf or compact or sort_seg)):
         from lux_tpu.ops import expand
 
         def _build_rp():
@@ -342,8 +373,21 @@ def worker_main():
             # sha1 at scale 20 must not delay the first chip measurement)
             paths = expand.has_cached_expand_plan(shards)
             rp_state["warm"] = paths is not None
-            return expand.plan_expand_shards_cached(shards,
+            base = expand.plan_expand_shards_cached(shards,
                                                     cache_path=paths)
+            # the pass-fused twin: load it when the pf cache is warm
+            # (prewarm writes it), else a pure in-memory numpy transform
+            # of `base` — going through the cached pf planner here would
+            # re-hash and re-read the unfused entries just loaded,
+            # doubling the background wait the race's budget-aware
+            # timeout is spent on
+            pf_paths = expand.has_cached_expand_plan(shards, pf=True)
+            if pf_paths is not None:
+                pf = expand.plan_expand_shards_cached(
+                    shards, pf=True, cache_path=pf_paths)
+            else:
+                pf = expand.to_pf(base)
+            return base, pf
 
         rp_future = expand.plan_async(_build_rp)
 
@@ -373,11 +417,18 @@ def worker_main():
                 state_bytes=2 if dt == "bfloat16" else 4,
                 method="scan" if m == "fused" else m,
             ).scale(iters)
+            # HBM-sweep accounting next to the byte model: the
+            # pass-fusion acceptance metric (r1/ff/r2/reduce sweeps per
+            # iteration; a pf plan's total is ~half the unfused one's)
+            passes = roofline.routed_hbm_passes(
+                _layout["route"][0], "scan" if m == "fused" else m)
         else:
             model = roofline.pull_iter_model(
                 g.ne, g.nv, m, state_bytes=2 if dt == "bfloat16" else 4,
                 compact_unique=compact_unique,
             ).scale(iters)
+            passes = (roofline.pull_hbm_passes(m)
+                      if m in roofline.REDUCE_HBM_PASSES else None)
         _emit_row(
             {
                 "metric": f"pagerank_gteps_rmat{scale}_1chip{suffix}",
@@ -386,6 +437,7 @@ def worker_main():
                 "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
                 "method": m,
                 "dtype": dt,
+                **({"hbm_passes": passes} if passes else {}),
                 **roofline.summarize(model, elapsed, iters * g.ne),
             }
         )
@@ -433,6 +485,8 @@ def worker_main():
                 # pass-through marker: _relay must not let this line
                 # compete with (and hijack) the rmat{scale} headline
                 "scale_up": True,
+                **({"hbm_passes": roofline.pull_hbm_passes(m)}
+                   if m in roofline.REDUCE_HBM_PASSES else {}),
                 **roofline.summarize(model, elapsed, iters * g2.ne),
             }
         )
@@ -568,6 +622,73 @@ def worker_main():
             }
         )
 
+    def measure_ba():
+        """Standing heavy-tail row (VERDICT r5 weak #4: BA existed only
+        as a slow test): a Barabási-Albert graph through the FULL
+        production path — generator -> .lux round trip -> ROUTED-PF
+        pull — so hub skew is measured where routed-plan padding and
+        the pass-fused kernels actually bite, not just unit-tested.
+        Scale defaults to min(headline scale, 20); CPU fallback rows
+        are real (smaller) measurements like every other family.  The
+        metric name carries no ``_rmat``, so _relay treats it as its
+        own family and it can never contest the headline."""
+        from lux_tpu.graph.format import read_lux, write_lux
+        from lux_tpu.ops import expand
+
+        # off-TPU the row is an insurance-path extra: cap its default
+        # scale so the BA generation + cold plan build can never delay
+        # the number the CPU fallback worker exists to bank quickly
+        # (LUX_BENCH_BA_SCALE still overrides for deliberate runs)
+        bs = _env_int("LUX_BENCH_BA_SCALE",
+                      min(scale, 20 if on_tpu else 14))
+        mdeg = _env_int("LUX_BENCH_BA_M", 4)
+        gb0 = generate.barabasi_albert(1 << bs, mdeg, seed=7)
+        path = f"/tmp/lux_bench_ba_{os.getpid()}.lux"
+        write_lux(path, gb0)
+        gb = read_lux(path)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        assert (gb.nv, gb.ne) == (gb0.nv, gb0.ne)
+        shb = build_pull_shards(gb, 1)
+        rp = expand.plan_expand_shards_cached(shb, pf=True)
+        rp = (rp[0], jax.tree.map(jnp.asarray, rp[1]))
+        m = resolve_method("auto", "sum", platform)
+        prog = PageRankProgram(nv=shb.spec.nv, dtype=dtype)
+        arrb = jax.tree.map(jnp.asarray, shb.arrays)
+        s0b = pull.init_state(prog, arrb)
+        jax.block_until_ready((arrb, rp[1]))
+
+        def run(n):
+            return pull.run_pull_fixed(prog, shb.spec, arrb, s0b, n, m,
+                                       route=rp)
+
+        elapsed, _ = fetch_timed(run)
+        gteps = iters * gb.ne / elapsed / 1e9
+        model = roofline.routed_pull_iter_model(
+            rp[0], gb.ne, gb.nv,
+            state_bytes=2 if dtype == "bfloat16" else 4, method=m,
+        ).scale(iters)
+        # same suffix discipline as the headline rows: a bf16 BA run
+        # must never contest the f32 BA family in _relay
+        ba_suffix = ("_bf16" if dtype == "bfloat16" else "") + suffix
+        _emit_row(
+            {
+                "metric":
+                    f"pagerank_gteps_ba{bs}_m{mdeg}_routepf{ba_suffix}",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
+                "dtype": dtype,
+                "nv": int(gb.nv),
+                "ne": int(gb.ne),
+                "hbm_passes": roofline.routed_hbm_passes(rp[0], m),
+                **roofline.summarize(model, elapsed, iters * gb.ne),
+            }
+        )
+
     def measure_cf(m):
         """Fixed-iteration CF (K=20 latent state): edge-update GTEPS +
         per-iteration ms + final RMSE (the reference's CF quality metric,
@@ -651,13 +772,19 @@ def worker_main():
         if results and on_tpu and rp_future is not None:
             # the routed hot loop (ops/expand.py; measured 49x the flat
             # gather at the load phase) joins the DEFAULT race so the
-            # headline reflects the best shipped config.  The plan was
-            # building on background host threads for the WHOLE unrouted
-            # race (rp_future, submitted before the first measure) — by
-            # now it is usually done; wait only when enough TPU budget
-            # remains to make the residual build worth it.
+            # headline reflects the best shipped config — BOTH flavors:
+            # the unfused _route line and the pass-fused _routepf line
+            # (same coloring + a numpy transform; ops/expand.to_pf),
+            # whose winner is recorded under "tpu:route_mode".  The
+            # plans were building on background host threads for the
+            # WHOLE unrouted race (rp_future, submitted before the
+            # first measure) — by now they are usually done; wait only
+            # when enough TPU budget remains to make the residual build
+            # worth it.
             rp = None
+            rp_pair = None
             saved_results = dict(results)
+            routed_elapsed = {}
             try:
                 from lux_tpu.engine.methods import CONCRETE
 
@@ -672,26 +799,42 @@ def worker_main():
                     t_plan = time.time()
                     # budget-aware wait: a residual build may not eat
                     # past ~70% of the TPU window — on timeout the
-                    # banked unrouted rows stand and the routed line is
-                    # skipped, never the whole worker
-                    rp = rp_future.result(
+                    # banked unrouted rows stand and the routed lines
+                    # are skipped, never the whole worker
+                    rp_pair = rp_future.result(
                         timeout=max(5.0, 0.7 * tpu_budget - spent))
-                    rp = (rp[0], jax.tree.map(jnp.asarray, rp[1]))
-                    jax.block_until_ready(rp[1])
-                    print(f"# routed plan "
+                    print(f"# routed plans "
                           f"({'cache' if rp_state['warm'] else 'built, overlapped'}"
                           f"; waited {time.time() - t_plan:.1f}s) — "
-                          f"measuring routed line", file=sys.stderr,
+                          f"measuring routed lines", file=sys.stderr,
                           flush=True)
-                    _layout["route"] = rp
-                    _layout["route_tag"] = "_route"
-                    measure(min(concrete, key=concrete.get)[0], dtype)
+                    best_m = min(concrete, key=concrete.get)[0]
+                    for tag, host_plan in (("_route", rp_pair[0]),
+                                           ("_routepf", rp_pair[1])):
+                        if (tag == "_routepf" and time.monotonic()
+                                - t_worker0 > 0.8 * tpu_budget):
+                            print("# routed-pf line skipped: budget "
+                                  "mostly spent", file=sys.stderr,
+                                  flush=True)
+                            break
+                        rp = (host_plan[0],
+                              jax.tree.map(jnp.asarray, host_plan[1]))
+                        jax.block_until_ready(rp[1])
+                        _layout["route"] = rp
+                        _layout["route_tag"] = tag
+                        measure(best_m, dtype)
+                        routed_elapsed[tag] = results.get((best_m, dtype))
+                        # free this flavor's device copy before the next
+                        _layout["route"] = None
+                        rp = None
+                    host_plan = None  # last flavor's host copy
+                    _record_route_mode(routed_elapsed)
                 else:
-                    print("# routed line skipped: plan still building and "
+                    print("# routed lines skipped: plan still building and "
                           "budget mostly spent", file=sys.stderr, flush=True)
             except (TimeoutError, _FUTURE_TIMEOUT):
                 # 3.10: futures.TimeoutError is NOT the builtin alias yet
-                print("# routed line skipped: plan build exceeded the "
+                print("# routed lines skipped: plan build exceeded the "
                       "budget-aware wait", file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001
                 print(f"# routed line failed: {e}", file=sys.stderr,
@@ -700,11 +843,14 @@ def worker_main():
                 _layout["route"] = None
                 _layout["route_tag"] = ""
                 del rp  # free the ~1 GB device-resident plan pre-scale-up
-                # drop the Future's pin on the HOST plan copy too (a
-                # build still in flight cannot be cancelled — its daemon
+                # drop the HOST plan copies too: rp_pair holds BOTH
+                # flavors' stacked pass arrays (~2 GB at scale 20) and
+                # the scale-up + secondary apps still run after this.
+                # A build still in flight cannot be cancelled (daemon
                 # threads run on; later TPU rows are device-bound, so
                 # the contention costs dispatch noise, not timed device
-                # work — but a COMPLETED build's ~1 GB frees here)
+                # work) — but a COMPLETED build's copies free here.
+                del rp_pair
                 rp_future = None
                 # the routed elapsed must not pollute the unrouted
                 # results the winner recording and scale-up pick from
@@ -739,8 +885,10 @@ def worker_main():
             measure_components(resolve_method("auto", "max", platform))
         except Exception as e:  # noqa: BLE001
             print(f"# components failed: {e}", file=sys.stderr, flush=True)
+    layout_ab = (sort_seg or compact or route_gather or route_fused
+                 or route_pf or route_fused_pf)
     if "serve" in apps:
-        if sort_seg or compact or route_gather or route_fused:
+        if layout_ab:
             print("# serve row skipped: layout A/B run", file=sys.stderr,
                   flush=True)
         else:
@@ -748,6 +896,23 @@ def worker_main():
                 measure_serve()
             except Exception as e:  # noqa: BLE001
                 print(f"# serve failed: {e}", file=sys.stderr, flush=True)
+    if "ba" in apps:
+        # the standing heavy-tail row is itself a routed-pf measurement;
+        # skip it under layout A/B runs (isolation, like serve) and when
+        # the TPU budget is mostly spent (its graph gen + plan build are
+        # host-side but the timed line still needs chip minutes)
+        if layout_ab:
+            print("# ba row skipped: layout A/B run", file=sys.stderr,
+                  flush=True)
+        elif (on_tpu and time.monotonic() - t_worker0
+                > 0.75 * _env_int("LUX_BENCH_TPU_S", 600)):
+            print("# ba row skipped: budget mostly spent", file=sys.stderr,
+                  flush=True)
+        else:
+            try:
+                measure_ba()
+            except Exception as e:  # noqa: BLE001
+                print(f"# ba row failed: {e}", file=sys.stderr, flush=True)
     if "pagerank" in apps and results and (
         on_tpu or os.environ.get("LUX_BENCH_FORCE_SCALEUP") == "1"
     ):
@@ -757,7 +922,7 @@ def worker_main():
         # budget is spent, and BEFORE the risky tail (a scan wedge must
         # not cost it)
         tpu_budget = _env_int("LUX_BENCH_TPU_S", 600)
-        if route_gather or route_fused:
+        if route_gather or route_fused or route_pf or route_fused_pf:
             print("# scale-up skipped: routed-expand A/B plans exist only "
                   "for the headline graph", file=sys.stderr, flush=True)
         elif time.monotonic() - t_worker0 < 0.5 * tpu_budget:
@@ -790,6 +955,22 @@ def worker_main():
             _record_winner(results)
 
 
+def _record_route_mode(routed_elapsed):
+    """Persist the routed-vs-routed-pf winner ("tpu:route_mode" overlay
+    entry) when the default race measured BOTH flavors — both are
+    bitwise-identical to the direct gather, so the recorded mode is a
+    pure perf decision the next process follows via
+    engine.methods.route_mode()."""
+    t_route = routed_elapsed.get("_route")
+    t_pf = routed_elapsed.get("_routepf")
+    if not t_route or not t_pf:
+        return
+    winner = "routed-pf" if t_pf <= t_route else "routed"
+    from lux_tpu.engine.methods import ROUTE_MODE_KEY, record_overlay_entry
+
+    record_overlay_entry(ROUTE_MODE_KEY, winner)
+
+
 def _record_winner(results):
     """Persist the TPU race winner so `--method auto` follows the
     measurement from the NEXT process on (engine/methods reads
@@ -799,7 +980,9 @@ def _record_winner(results):
     if (os.environ.get("LUX_BENCH_SORT_SEGMENTS") == "1"
             or os.environ.get("LUX_BENCH_COMPACT_GATHER") == "1"
             or os.environ.get("LUX_BENCH_ROUTE_GATHER") == "1"
-            or os.environ.get("LUX_BENCH_ROUTE_FUSED") == "1"):
+            or os.environ.get("LUX_BENCH_ROUTE_FUSED") == "1"
+            or os.environ.get("LUX_BENCH_ROUTE_PF") == "1"
+            or os.environ.get("LUX_BENCH_ROUTE_FUSED_PF") == "1"):
         # an A/B run under a non-default layout must not mutate the
         # default-layout winner (it would silently change every later
         # allgather run); the human folds A/B results in via PERF.md

@@ -118,7 +118,8 @@ def _run_feat(cfg, g, prog):
         # host-side plan construction stays OUTSIDE the reported time
         from lux_tpu.ops import expand
 
-        f_route = expand.plan_cf_route_shards_cached(shards)
+        f_route = expand.plan_cf_route_shards_cached(
+            shards, pf=common.route_is_pf(cfg.route_gather))
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
         if cfg.exchange == "ring":
@@ -186,7 +187,8 @@ def main(argv=None):
         # host-side plan construction stays OUTSIDE the reported time
         from lux_tpu.ops import expand
 
-        route = expand.plan_cf_route_shards_cached(shards)
+        route = expand.plan_cf_route_shards_cached(
+            shards, pf=common.route_is_pf(cfg.route_gather))
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
         elapsed = None

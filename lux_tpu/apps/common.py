@@ -75,6 +75,47 @@ _ROUTE_VERBOSE_ERR = (
     "drop --route-gather or -verbose")
 
 
+def route_base(rg: str) -> str:
+    """Layout family of a --route-gather mode: 'expand-pf'/'fused-pf'
+    bind the same shard layouts as their base — pass fusion only changes
+    the device kernel grouping (ops/expand.to_pf), never the plan's
+    layout contract."""
+    return rg[:-3] if rg.endswith("-pf") else rg
+
+
+def route_is_pf(rg: str) -> bool:
+    return rg.endswith("-pf")
+
+
+def resolve_route_auto(cfg) -> None:
+    """Bare ``--route-gather`` (const 'auto') follows the chip-measured
+    routed-vs-routed-pf winner (engine/methods.route_mode: overlay
+    entry ``tpu:route_mode``, recorded by the default TPU bench race;
+    LUX_ROUTE_MODE env override) — an unattended window's measurement
+    changes what the bare flag runs without a code edit.  Both modes
+    are bitwise-identical, so this is a perf decision only."""
+    if getattr(cfg, "route_gather", "") != "auto":
+        return
+    from lux_tpu.engine import methods
+
+    cfg.route_gather = ("expand-pf" if methods.route_mode() == "routed-pf"
+                        else "expand")
+
+
+def downgrade_pf(cfg, layout: str) -> None:
+    """expand-pf -> expand with a stderr note.  Pass-fused plans bind
+    the allgather pull layout; pf is a bitwise-identical kernel-grouping
+    detail, so layouts that plan per-bucket run the unfused family
+    rather than erroring — ONE policy shared by the pull validation and
+    the push apps' ring path."""
+    import sys
+
+    print(f"# --route-gather expand-pf: {layout} plans per-bucket; "
+          "running the unfused 'expand' family (bitwise-identical)",
+          file=sys.stderr)
+    cfg.route_gather = "expand"
+
+
 def validate_exchange(cfg: RunConfig, prog) -> None:
     """Reject incompatible --exchange combinations BEFORE the O(ne) shard
     build, with a CLI-level message (not a deep driver assert).  Resolves
@@ -122,12 +163,20 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             "block-CSR gather"
         )
     if getattr(cfg, "route_gather", ""):
-        if getattr(prog, "k", 1) > 1 and cfg.route_gather == "fused":
+        resolve_route_auto(cfg)
+        if (cfg.route_gather == "expand-pf"
+                and (cfg.exchange != "allgather" or cfg.edge_shards > 1
+                     or cfg.feat_shards > 1)):
+            downgrade_pf(cfg, "this exchange/layout")
+        if getattr(prog, "k", 1) > 1 and route_base(cfg.route_gather) == "fused":
             raise SystemExit(
                 "--route-gather fused supports scalar vertex state; "
                 "colfilter's wide dst-dependent load routes with "
                 "--route-gather expand (per-column src + dst plans)"
             )
+        # the bucketed / sharded exchanges plan per-bucket and are
+        # served by the UNFUSED family only ('expand'); the pass-fused
+        # variants bind the allgather pull layout
         bucket_ok = (cfg.exchange in ("ring", "scatter")
                      and cfg.route_gather == "expand"
                      and getattr(prog, "k", 1) == 1)
@@ -144,8 +193,9 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             raise SystemExit(
                 "--route-gather expand covers every pull layout "
                 "(allgather, ring/scatter buckets, edge-sharded chunks, "
-                "feat-sharded columns); 'fused' is allgather-only, and "
-                "no mode combines with --method pallas/--compact-gather/"
+                "feat-sharded columns); 'fused' and the pass-fused "
+                "'-pf' variants are allgather-only, and no mode "
+                "combines with --method pallas/--compact-gather/"
                 "--stream-hbm-gib"
             )
         if cfg.verbose:
@@ -558,12 +608,14 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
     if rg:
         from lux_tpu.ops import expand
 
-        if rg == "fused":
-            route = expand.plan_fused_shards_cached(shards, prog.reduce)
+        pf = route_is_pf(rg)
+        if route_base(rg) == "fused":
+            route = expand.plan_fused_shards_cached(shards, prog.reduce,
+                                                    pf=pf)
         elif getattr(prog, "k", 1) > 1:
-            route = expand.plan_cf_route_shards_cached(shards)
+            route = expand.plan_cf_route_shards_cached(shards, pf=pf)
         else:
-            route = expand.plan_expand_shards_cached(shards)
+            route = expand.plan_expand_shards_cached(shards, pf=pf)
     return dist.run_pull_fixed_dist(
         prog, shards.spec, shards.arrays, state, num_iters, mesh, cfg.method,
         route=route,

@@ -147,10 +147,11 @@ def main(argv=None):
         # host-side plan construction stays OUTSIDE the reported time
         from lux_tpu.ops import expand
 
+        pf = common.route_is_pf(cfg.route_gather)
         route = (
-            expand.plan_fused_shards_cached(shards, prog.reduce)
-            if cfg.route_gather == "fused"
-            else expand.plan_expand_shards_cached(shards)
+            expand.plan_fused_shards_cached(shards, prog.reduce, pf=pf)
+            if common.route_base(cfg.route_gather) == "fused"
+            else expand.plan_expand_shards_cached(shards, pf=pf)
         )
     with profiling.trace(cfg.profile_dir):
         timer = Timer()

@@ -206,6 +206,10 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
     from lux_tpu.engine import methods
 
     cfg.method = methods.resolve(cfg.method, prog.reduce)
+    common.resolve_route_auto(cfg)
+    if (getattr(cfg, "route_gather", "") == "expand-pf"
+            and cfg.exchange == "ring"):
+        common.downgrade_pf(cfg, "the ring exchange")
     if getattr(cfg, "route_gather", "") and (
             cfg.ckpt_every or cfg.repartition_every
             or cfg.verbose or cfg.method == "pallas"
@@ -305,7 +309,9 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
 
             route = (expand.plan_ring_route_shards_cached(shards)
                      if cfg.exchange == "ring"
-                     else expand.plan_expand_shards_cached(shards))
+                     else expand.plan_expand_shards_cached(
+                         shards,
+                         pf=common.route_is_pf(cfg.route_gather)))
 
         timer = Timer()
         if cfg.ckpt_every and getattr(cfg, "delta", 0):

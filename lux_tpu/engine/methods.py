@@ -187,6 +187,35 @@ def _file_winners() -> dict:
         return _file_winners_cache
 
 
+#: LOAD-phase route modes the bench A/Bs and the overlay may record:
+#: "routed" = the unfused Benes expand (one kernel per pass), "routed-pf"
+#: = the pass-fused replay (2-3 passes per kernel, VMEM-resident
+#: intermediates, ops/expand.to_pf).  Both are bitwise-identical to the
+#: direct gather, so either is always safe to follow.
+ROUTE_MODES = ("routed", "routed-pf")
+
+#: overlay key the TPU bench race records its routed-vs-routed-pf
+#: winner under (bench.py _record_route_mode) — like "tpu:sum", an
+#: unattended chip window updates the default without a code edit.
+ROUTE_MODE_KEY = "tpu:route_mode"
+
+
+def route_mode() -> str:
+    """The preferred routed-plan flavor: LUX_ROUTE_MODE env override,
+    else the chip-measured overlay entry, else "routed-pf" (the
+    analytic winner — ~40% fewer HBM sweeps per iteration — until a
+    window banks the A/B; both modes are bitwise-identical so the
+    default is a perf bet, never a correctness one)."""
+    env = os.environ.get("LUX_ROUTE_MODE")
+    if env:
+        if env not in ROUTE_MODES:
+            raise ValueError(
+                f"LUX_ROUTE_MODE must be one of {ROUTE_MODES}, got {env!r}")
+        return env
+    rec = _overlay_raw().get(ROUTE_MODE_KEY)
+    return rec if rec in ROUTE_MODES else "routed-pf"
+
+
 _tiles_cache: tuple | None = None
 
 

@@ -257,16 +257,28 @@ def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "auto"
     return load, comp, update
 
 
-@partial(jax.jit, static_argnames=("prog", "spec", "num_iters", "method",
-                                   "route_static", "interpret"))
-def _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0,
-                    route_static=None, route_arrays=None,
-                    interpret=False):
+def _pull_fixed_fn(prog, spec, num_iters, method, arrays, state0,
+                   route_static=None, route_arrays=None,
+                   interpret=False):
     def body(_, state):
         return _pull_iteration(prog, spec, method, arrays, state,
                                route_static, route_arrays, interpret)
 
     return jax.lax.fori_loop(0, num_iters, body, state0)
+
+
+_PULL_FIXED_STATICS = ("prog", "spec", "num_iters", "method",
+                       "route_static", "interpret")
+_pull_fixed_jit = jax.jit(_pull_fixed_fn,
+                          static_argnames=_PULL_FIXED_STATICS)
+#: donating twin: state0 (positional 5) is consumed, so the loop's
+#: ping-pong can reuse its HBM buffer instead of holding TWO full state
+#: copies for the whole run (the reference's dist_lr[2] double buffer,
+#: core/graph.h:83, without the second copy).  Opt-in via ``donate=``:
+#: benchmark timing loops re-run from one s0 and must keep it alive.
+_pull_fixed_jit_donate = jax.jit(_pull_fixed_fn,
+                                 static_argnames=_PULL_FIXED_STATICS,
+                                 donate_argnums=(5,))
 
 
 def run_pull_fixed(
@@ -277,6 +289,7 @@ def run_pull_fixed(
     num_iters: int,
     method: str = "auto",
     route=None,
+    donate: bool = False,
 ):
     """Single-device driver: fixed iteration count (PageRank/CF style,
     pagerank/pagerank.cc:109-114).  Whole loop stays on device; the
@@ -285,17 +298,21 @@ def run_pull_fixed(
     (engine.methods).  ``route`` (from ops.expand.plan_expand_shards)
     switches the LOAD phase to the routed-shuffle expand — bitwise-equal
     results, measured ~15 HBM-bandwidth passes instead of an E-sized
-    scalar-issue-bound flat gather.  Returns the final stacked
-    (P, V, ...) state.
+    scalar-issue-bound flat gather (a pass-fused ``pf=True`` plan cuts
+    that to ~7, same bits).  ``donate=True`` donates ``state0`` to the
+    loop (jit donate_argnums) so the hot loop holds ONE full state copy
+    in HBM instead of two — the caller must not reuse ``state0`` after.
+    Returns the final stacked (P, V, ...) state.
     """
     method = methods.resolve(method, prog.reduce)
     arrays = jax.tree.map(jnp.asarray, arrays)
     rs, ra = route if route is not None else (None, None)
     if ra is not None:
         ra = jax.tree.map(jnp.asarray, ra)
-    return _pull_fixed_jit(prog, spec, num_iters, method, arrays, state0,
-                           route_static=rs, route_arrays=ra,
-                           interpret=_route_interpret())
+    fn = _pull_fixed_jit_donate if donate else _pull_fixed_jit
+    return fn(prog, spec, num_iters, method, arrays, state0,
+              route_static=rs, route_arrays=ra,
+              interpret=_route_interpret())
 
 
 def run_pull_fixed_overlapped(
@@ -344,7 +361,11 @@ def run_pull_fixed_overlapped(
     done = 0
     while done < num_iters and not route_future.ready():
         k = min(chunk, num_iters - done)
-        state = run_pull_fixed(prog, spec, arrays, state, k, method)
+        # chunks after the first own their input state (the previous
+        # chunk's output) — donate it so the handover loop never holds
+        # two full state copies; the caller's state0 itself stays alive
+        state = run_pull_fixed(prog, spec, arrays, state, k, method,
+                               donate=done > 0)
         # materialize before re-polling: dispatch is async, so without a
         # sync the loop would queue every chunk before the future could
         # ever win the race
@@ -358,10 +379,10 @@ def run_pull_fixed_overlapped(
         # valid deterministic answer, so finish direct rather than throw
         # away the iterations already computed
         state = run_pull_fixed(prog, spec, arrays, state,
-                               num_iters - done, method)
+                               num_iters - done, method, donate=done > 0)
         return state, 0
     state = run_pull_fixed(prog, spec, arrays, state, num_iters - done,
-                           method, route=route)
+                           method, route=route, donate=done > 0)
     return state, num_iters - done
 
 
@@ -374,6 +395,7 @@ def run_pull_until(
     active_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
     method: str = "auto",
     route=None,
+    donate: bool = False,
 ):
     """Single-device driver: iterate until no vertex is active (the push-app
     convergence contract — total active count == 0, sssp/sssp.cc:115-129 —
@@ -381,6 +403,7 @@ def run_pull_until(
 
     active_fn(old_stacked, new_stacked) -> per-part active counts (P,);
     pass a top-level function (hashable) so the compiled loop caches.
+    ``donate=True`` consumes ``state0`` (see run_pull_fixed).
     Returns (final_state, num_iters_run).
     """
     method = methods.resolve(method, prog.reduce)
@@ -388,18 +411,14 @@ def run_pull_until(
     rs, ra = route if route is not None else (None, None)
     if ra is not None:
         ra = jax.tree.map(jnp.asarray, ra)
-    return _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays,
-                           state0, route_static=rs, route_arrays=ra,
-                           interpret=_route_interpret())
+    fn = _pull_until_jit_donate if donate else _pull_until_jit
+    return fn(prog, spec, max_iters, active_fn, method, arrays,
+              state0, route_static=rs, route_arrays=ra,
+              interpret=_route_interpret())
 
 
-@partial(
-    jax.jit,
-    static_argnames=("prog", "spec", "max_iters", "active_fn", "method",
-                     "route_static", "interpret"),
-)
-def _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays, state0,
-                    route_static=None, route_arrays=None, interpret=False):
+def _pull_until_fn(prog, spec, max_iters, active_fn, method, arrays, state0,
+                   route_static=None, route_arrays=None, interpret=False):
     def cond(carry):
         _, it, active = carry
         return (active > 0) & (it < max_iters)
@@ -415,3 +434,15 @@ def _pull_until_jit(prog, spec, max_iters, active_fn, method, arrays, state0,
         cond, body, (state0, jnp.int32(0), jnp.int32(1))
     )
     return state, iters
+
+
+_PULL_UNTIL_STATICS = ("prog", "spec", "max_iters", "active_fn", "method",
+                       "route_static", "interpret")
+_pull_until_jit = jax.jit(_pull_until_fn,
+                          static_argnames=_PULL_UNTIL_STATICS)
+#: donating twin of the convergence loop (state0 = positional 6); the
+#: old state is folded into the while carry immediately, so donation
+#: frees the input buffer for the loop's ping-pong
+_pull_until_jit_donate = jax.jit(_pull_until_fn,
+                                 static_argnames=_PULL_UNTIL_STATICS,
+                                 donate_argnums=(6,))

@@ -75,7 +75,11 @@ def dense_part_step(prog, arr: ShardArrays, full_state, local, method="scan",
 
     ``route`` = (ExpandStatic, this part's arrays): the routed-shuffle
     expand replaces the flat gather (ops/expand.py) — relax is
-    elementwise on (src, weight), so results stay bitwise identical."""
+    elementwise on (src, weight), so results stay bitwise identical.
+    A pass-fused plan (expand.to_pf / pf=True planners) replays through
+    the fused kernel family transparently — apply_expand dispatches on
+    the static's type, same bits, ~half the HBM sweeps per dense
+    round."""
     if route is not None:
         from lux_tpu.ops import expand
 
@@ -477,9 +481,9 @@ def run_push(
     over vmapped per-part branches — a genuine branch (only the taken mode
     executes; the global predicate makes this legal) with compile size O(1)
     in the part count.  ``route`` (ops.expand.plan_expand_shards on the
-    PULL layout) runs the dense rounds' gather through the routed
-    expand — bitwise-identical.  Returns (final stacked state, iters,
-    edge counter).
+    PULL layout, unfused or pass-fused — both bitwise-identical) runs
+    the dense rounds' gather through the routed expand.  Returns
+    (final stacked state, iters, edge counter).
     """
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec

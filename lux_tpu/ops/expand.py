@@ -31,6 +31,11 @@ to the direct gather — the engine's A/B flag can never change numerics.
 Cost model: perm1 and perm2 are 2k-1 passes each (k = len(dims), 4 at
 N=2^24 → 7 passes), fill_forward ~1 — ~15 HBM-bandwidth passes replacing
 E scalar-issued gather slots.  At rmat20/ef16 that is ~5 ms vs ~117 ms.
+The PASS-FUSED form (``to_pf`` / the ``pf=True`` planners) chains 2-3
+passes per Pallas kernel with VMEM-resident intermediates
+(ops/pallas_shuffle.StaticRoutePF), cutting those ~15 sweeps to ~7 —
+bitwise-identical replay, knobs/accounting in docs/PERF.md
+("Pass-fused routed hot loop").
 """
 from __future__ import annotations
 
@@ -58,8 +63,18 @@ LANE = 128
 #: plan (4: pickle -> npz+json storage; keys carry array shape/dtype;
 #: 5: one cache entry PER PART/BUCKET keyed on that part's own index
 #: arrays — a repartition recut rebuilds only the buckets whose arrays
-#: changed)
+#: changed).  The round-6 PASS-FUSED families did NOT bump this: the
+#: unfused on-disk bytes are unchanged (re-paying the benchmark-scale
+#: Euler colorings would cost ~15 min/part for nothing), and the pf
+#: entries live under their own tags with their own PF_FORMAT + knob
+#: salt (_pf_salt) — the codec merely GAINED static types, which only
+#: pf entries reference.
 PLAN_FORMAT = 5
+
+#: bump when the pass-fused plan layout (StaticRoutePF/StaticGroup/
+#: StaticStep or the pf array arrangement) changes — salts ONLY the
+#: "*-pf" cache families.
+PF_FORMAT = 1
 
 
 # ---------------------------------------------------------------------------
@@ -326,14 +341,17 @@ def apply_ff_np(x, h):
 
 @dataclasses.dataclass(frozen=True)
 class ExpandStatic:
-    """Hashable descriptor of a routed expand (safe as a jit static)."""
+    """Hashable descriptor of a routed expand (safe as a jit static).
+    ``r1``/``r2`` hold either the unfused StaticRoute or, after
+    ``to_pf``, the pass-fused StaticRoutePF — replay dispatches on the
+    type, everything downstream is agnostic."""
 
     n: int
     e_pad: int
     state_size: int
-    r1: shuf.StaticRoute
+    r1: object  # shuf.StaticRoute | shuf.StaticRoutePF
     ff: FFStatic
-    r2: shuf.StaticRoute
+    r2: object
 
 
 def _build_routes(*perms):
@@ -427,19 +445,22 @@ def _ff_array_count(ff: FFStatic) -> int:
 def _num_expand_arrays(static) -> int:
     """Total plan-array count of an expand-shaped static (r1 + ff + r2)
     — the ONE place the layout arithmetic lives (split_arrays, the
-    fused splitter, and the CF src/dst split all derive from it)."""
-    return (len(static.r1.passes) + _ff_array_count(static.ff)
-            + len(static.r2.passes))
+    fused splitter, and the CF src/dst split all derive from it).
+    Routes may be unfused (StaticRoute, one array per pass) or
+    pass-fused (StaticRoutePF, one per in-group gather step) — the
+    count helper in pallas_shuffle covers both."""
+    return (shuf.route_num_arrays(static.r1) + _ff_array_count(static.ff)
+            + shuf.route_num_arrays(static.r2))
 
 
 def split_arrays(static: ExpandStatic, arrays):
     """Recover the (r1, ff, r2) array groups from the flat tuple."""
-    n1 = len(static.r1.passes)
+    n1 = shuf.route_num_arrays(static.r1)
     nff = _ff_array_count(static.ff)
     r1a = arrays[:n1]
     ffa = arrays[n1:n1 + nff]
     r2a = arrays[n1 + nff:]
-    assert len(r2a) == len(static.r2.passes)
+    assert len(r2a) == shuf.route_num_arrays(static.r2)
     return r1a, ffa, r2a
 
 
@@ -466,6 +487,97 @@ def apply_expand_np(src_pos, full_state):
 
 
 # ---------------------------------------------------------------------------
+# pass fusion (routed-pf): upgrade routed plans to the fused-kernel replay
+# ---------------------------------------------------------------------------
+
+
+def _pf_salt() -> str:
+    """Cache-key salt for pass-fused plan entries: the pf layout version
+    plus the fusion knobs — those are baked into the frozen static
+    (grouping + tile geometry), so two processes with different knobs
+    (or across a pf-layout change) must never share an entry."""
+    blk, grp, mb = shuf._pf_defaults()
+    return f":pfv{PF_FORMAT}:{blk}:{grp}:{mb}"
+
+
+def _pf_key_one(base_key_one):
+    """Wrap a per-part cache key with the pass-fusion salt."""
+    salt = _pf_salt().encode()
+
+    def key_one(h, i):
+        base_key_one(h, i)
+        h.update(salt)
+
+    return key_one
+
+
+def _pf_route(static_route, route_arrays, knobs=(None, None, None)):
+    """One frozen route + arrays -> pass-fused form, re-narrowed."""
+    s, a = shuf.pf_from_frozen(static_route, tuple(route_arrays),
+                               max_block=knobs[0], max_group=knobs[1],
+                               vmem_mb=knobs[2])
+    if _idx8_enabled():
+        a = tuple(_narrow_idx(x) for x in a)
+    return s, a
+
+
+def _to_pf_one(static, arrays, knobs=(None, None, None)):
+    """ONE part's plan -> pass-fused (the single derivation shared by
+    to_pf, the cached pf planners, and the CF recursion)."""
+    arrays = tuple(np.asarray(a) for a in arrays)
+    if isinstance(static, ExpandStatic):
+        r1a, ffa, r2a = split_arrays(static, arrays)
+        r1s, r1n = _pf_route(static.r1, r1a, knobs)
+        r2s, r2n = _pf_route(static.r2, r2a, knobs)
+        return (dataclasses.replace(static, r1=r1s, r2=r2s),
+                tuple(r1n) + tuple(ffa) + tuple(r2n))
+    if isinstance(static, FusedStatic):
+        r1a, ffa, r2a, gmask, gweights, vra = split_fused_arrays(
+            static, arrays, static.weighted)
+        r1s, r1n = _pf_route(static.r1, r1a, knobs)
+        r2s, r2n = _pf_route(static.r2, r2a, knobs)
+        vrs, vrn = _pf_route(static.vr, vra, knobs)
+        warr = (gweights,) if static.weighted else ()
+        return (dataclasses.replace(static, r1=r1s, r2=r2s, vr=vrs),
+                tuple(r1n) + tuple(ffa) + tuple(r2n) + (gmask,) + warr
+                + tuple(vrn))
+    if isinstance(static, CFRouteStatic):
+        n_src = _num_expand_arrays(static.src)
+        s_src, a_src = _to_pf_one(static.src, arrays[:n_src], knobs)
+        s_dst, a_dst = _to_pf_one(static.dst, arrays[n_src:], knobs)
+        return CFRouteStatic(src=s_src, dst=s_dst), tuple(a_src) + tuple(a_dst)
+    raise TypeError(f"to_pf: unsupported plan static {type(static)}")
+
+
+def to_pf(plan, max_block=None, max_group=None, vmem_mb=None):
+    """Upgrade a routed plan to the PASS-FUSED replay (``routed-pf``):
+    every Benes route inside the plan (expand r1/r2, fused r1/r2/vr, CF
+    src/dst) is regrouped so 2-3 consecutive permutation passes run in
+    ONE Pallas kernel with VMEM-resident intermediates
+    (ops/pallas_shuffle.pf_from_frozen) — ~40%+ fewer HBM sweeps per
+    iteration, bitwise-identical replay (the same per-pass permutations
+    move the same bits; the fill-forward levels and the fused group
+    reduce are untouched, so even the fused sum association is
+    unchanged).
+
+    Pure NumPy rearrangement of the frozen plan — no Euler recoloring —
+    so a cached unfused plan upgrades in seconds.  Accepts both a
+    single-part plan (2-D arrays) and a stacked shards plan ((P, ...)
+    arrays); parts share one static, asserted like every shards planner.
+    """
+    static, arrays = plan
+    arrays = tuple(np.asarray(a) for a in arrays)
+    knobs = (max_block, max_group, vmem_mb)
+    if arrays and arrays[0].ndim == 3:
+        num_parts = arrays[0].shape[0]
+        return _stack_from(_map_parts(
+            num_parts,
+            lambda i: _to_pf_one(static, tuple(a[i] for a in arrays),
+                                 knobs)))
+    return _to_pf_one(static, arrays, knobs)
+
+
+# ---------------------------------------------------------------------------
 # fused expand + reduce (v2): the WHOLE hot loop as routed movement
 # ---------------------------------------------------------------------------
 
@@ -488,10 +600,10 @@ class FusedStatic:
     reduce: str         # "sum" | "min" | "max"
     weighted: bool      # plan carries pre-routed f32 weights
     groups: tuple[tuple[int, int, int], ...]  # (offset, count, 2**k)
-    r1: shuf.StaticRoute
+    r1: object  # shuf.StaticRoute | shuf.StaticRoutePF (see ExpandStatic)
     ff: FFStatic
-    r2: shuf.StaticRoute
-    vr: shuf.StaticRoute
+    r2: object
+    vr: object
 
 
 def _neutral_like(reduce: str, dtype):
@@ -622,9 +734,9 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
 
 
 def split_fused_arrays(static: FusedStatic, arrays, weighted: bool):
-    n1 = len(static.r1.passes)
+    n1 = shuf.route_num_arrays(static.r1)
     nff = _ff_array_count(static.ff)
-    n2p = len(static.r2.passes)
+    n2p = shuf.route_num_arrays(static.r2)
     r1a = arrays[:n1]
     ffa = arrays[n1:n1 + nff]
     r2a = arrays[n1 + nff:n1 + nff + n2p]
@@ -632,7 +744,7 @@ def split_fused_arrays(static: FusedStatic, arrays, weighted: bool):
     gmask = rest[0]
     gweights = rest[1] if weighted else None
     vra = rest[1 + int(weighted):]
-    assert len(vra) == len(static.vr.passes)
+    assert len(vra) == shuf.route_num_arrays(static.vr)
     return r1a, ffa, r2a, gmask, gweights, vra
 
 
@@ -723,12 +835,13 @@ def _cf_plan_one(shards, i: int):
     return CFRouteStatic(src=s_src, dst=s_dst), tuple(a_src) + tuple(a_dst)
 
 
-def plan_cf_route_shards(shards):
+def plan_cf_route_shards(shards, pf: bool = False):
     """(CFRouteStatic, stacked arrays) for the wide dst-dependent pull:
     arrays = src-plan arrays + dst-plan arrays (split by the statics'
-    pass counts)."""
-    return _stack_parts(shards.arrays.src_pos.shape[0],
+    pass counts).  ``pf=True``: pass-fused (both sub-plans)."""
+    plan = _stack_parts(shards.arrays.src_pos.shape[0],
                         lambda i: _cf_plan_one(shards, i))
+    return to_pf(plan) if pf else plan
 
 
 def _cf_key_one(shards):
@@ -744,18 +857,31 @@ def _cf_key_one(shards):
     return key_one
 
 
-def plan_cf_route_shards_cached(shards, cache_dir: str | None = None):
+def plan_cf_route_shards_cached(shards, cache_dir: str | None = None,
+                                pf: bool = False):
     """plan_cf_route_shards with the shared per-part disk cache."""
-    return _cached_stack("cf", shards.arrays.src_pos.shape[0],
-                         _cf_key_one(shards),
-                         lambda i: _cf_plan_one(shards, i), cache_dir)
+    num = shards.arrays.src_pos.shape[0]
+    key_one = _cf_key_one(shards)
+    if not pf:
+        return _cached_stack("cf", num, key_one,
+                             lambda i: _cf_plan_one(shards, i), cache_dir)
+    base_one = _cached_part_fn("cf", num, key_one,
+                               lambda i: _cf_plan_one(shards, i), cache_dir)
+    return _cached_stack("cf-pf", num, _pf_key_one(key_one),
+                         lambda i: _to_pf_one(*base_one(i)), cache_dir,
+                         validate=_pf_form)
 
 
-def has_cached_cf_plan(shards, cache_dir: str | None = None):
+def has_cached_cf_plan(shards, cache_dir: str | None = None,
+                       pf: bool = False):
     """Per-part paths when the CF plan family is fully cached, else
     None (tools/plan_prewarm.py --check-only)."""
+    key_one = _cf_key_one(shards)
+    if pf:
+        return _warm_paths("cf-pf", shards.arrays.src_pos.shape[0],
+                           _pf_key_one(key_one), cache_dir)
     return _warm_paths("cf", shards.arrays.src_pos.shape[0],
-                       _cf_key_one(shards), cache_dir)
+                       key_one, cache_dir)
 
 
 def apply_cf_route(full_state, local_state, static: CFRouteStatic, arrays,
@@ -878,15 +1004,18 @@ def _entry_path(cache_dir: str, tag: str, key_one, i: int) -> str:
     return os.path.join(cache_dir, f"{tag}_{h.hexdigest()[:16]}.npz")
 
 
-def _cached_stack(tag: str, num_parts: int, key_one, build_one,
-                  cache_dir: str | None = None, paths=None):
-    """Incrementally-cached plan family: one npz entry PER PART/BUCKET,
-    keyed on that part's own index arrays, so a repartition/recut
-    (engine/repartition.py) reloads every untouched bucket and rebuilds
-    only the changed ones.  Misses build in parallel on the planning
-    pool; an untrusted cache dir (see _cache_dir_trusted) degrades to
-    always-build — correctness never depends on the cache, only
-    plan-construction time does."""
+def _cached_part_fn(tag: str, num_parts: int, key_one, build_one,
+                    cache_dir: str | None = None, paths=None,
+                    validate=None):
+    """Per-part disk-cached plan getter: returns ``one(i) -> (static,
+    arrays)``.  Shared by _cached_stack (which fans it out over the
+    planning pool) and the pass-fused planners (whose build path feeds a
+    cached UNFUSED entry through the numpy pf transform).  ``validate``
+    (static -> bool) guards a family against entries of the WRONG PLAN
+    FORM — e.g. a caller handing unfused-family paths to a pf planner
+    would otherwise silently replay unfused kernels under the pf label;
+    a failing entry is treated like corruption: rebuilt and
+    overwritten, so the family self-corrects."""
     cache_dir = cache_dir or _default_cache_dir()
     trusted = _cache_dir_trusted(cache_dir)
     if paths is None and trusted:
@@ -899,6 +1028,9 @@ def _cached_stack(tag: str, num_parts: int, key_one, build_one,
             t0 = time.perf_counter()
             try:
                 static, arrays = _load_plan(path)
+                if validate is not None and not validate(static):
+                    raise ValueError(
+                        "entry is not of this plan family's form")
                 _stats_add("warm", time.perf_counter() - t0)
                 return static, arrays
             except (OSError, ValueError, KeyError) as e:
@@ -918,7 +1050,30 @@ def _cached_stack(tag: str, num_parts: int, key_one, build_one,
                 print(f"# plan cache not written ({path}): {e}", flush=True)
         return static, tuple(arrays)
 
+    return one
+
+
+def _cached_stack(tag: str, num_parts: int, key_one, build_one,
+                  cache_dir: str | None = None, paths=None,
+                  validate=None):
+    """Incrementally-cached plan family: one npz entry PER PART/BUCKET,
+    keyed on that part's own index arrays, so a repartition/recut
+    (engine/repartition.py) reloads every untouched bucket and rebuilds
+    only the changed ones.  Misses build in parallel on the planning
+    pool; an untrusted cache dir (see _cache_dir_trusted) degrades to
+    always-build — correctness never depends on the cache, only
+    plan-construction time does."""
+    one = _cached_part_fn(tag, num_parts, key_one, build_one, cache_dir,
+                          paths, validate=validate)
     return _stack_from(_map_parts(num_parts, one))
+
+
+def _pf_form(static) -> bool:
+    """True iff a plan static is in the PASS-FUSED form (family guard
+    for the "*-pf" cache tags)."""
+    if isinstance(static, CFRouteStatic):
+        return _pf_form(static.src) and _pf_form(static.dst)
+    return isinstance(static.r1, shuf.StaticRoutePF)
 
 
 def _bucket_route_cached(tag: str, src_local, dst_local, v_pad: int,
@@ -972,15 +1127,16 @@ def _fused_plan_one(shards, template, reduce: str, i: int):
         weights=np.asarray(arrays.weights[i]), template=template)
 
 
-def plan_fused_shards(shards, reduce: str = "sum"):
+def plan_fused_shards(shards, reduce: str = "sum", pf: bool = False):
     """plan_fused for a PullShards bundle.  Parts share one group
     TEMPLATE (max segment count per width class across parts), so all
     parts produce the same FusedStatic and the vmapped engine batches
     them; the price is a few dummy group rows per part, masked to the
-    reduce neutral."""
+    reduce neutral.  ``pf=True`` returns the pass-fused form."""
     template = _group_template(shards.arrays)
-    return _stack_parts(shards.arrays.src_pos.shape[0],
+    plan = _stack_parts(shards.arrays.src_pos.shape[0],
                         lambda i: _fused_plan_one(shards, template, reduce, i))
+    return to_pf(plan) if pf else plan
 
 
 def _default_cache_dir() -> str:
@@ -1000,7 +1156,8 @@ def _default_cache_dir() -> str:
 _STATIC_TYPES = {
     cls.__name__: cls
     for cls in (ExpandStatic, FusedStatic, CFRouteStatic, FFStatic,
-                FFLevelStatic, shuf.StaticRoute, shuf.StaticPass)
+                FFLevelStatic, shuf.StaticRoute, shuf.StaticPass,
+                shuf.StaticRoutePF, shuf.StaticGroup, shuf.StaticStep)
 }
 
 
@@ -1124,26 +1281,43 @@ def _fused_key_one(shards, template):
 
 
 def plan_fused_shards_cached(shards, reduce: str = "sum",
-                             cache_dir: str | None = None):
+                             cache_dir: str | None = None,
+                             pf: bool = False):
     """plan_fused_shards with the shared per-part disk cache (the reduce
     op joins the tag so min/max/sum plans never collide).  Each part's
     key folds the SHARED group template: a recut that changes any
     part's width-class census invalidates exactly the parts it must
-    (every part's FusedStatic depends on the template)."""
+    (every part's FusedStatic depends on the template).  ``pf=True``:
+    the pass-fused family (see plan_expand_shards_cached)."""
     template = _group_template(shards.arrays)
-    return _cached_stack(
-        f"fused-{reduce}", shards.arrays.src_pos.shape[0],
-        _fused_key_one(shards, template),
+    num = shards.arrays.src_pos.shape[0]
+    key_one = _fused_key_one(shards, template)
+    if not pf:
+        return _cached_stack(
+            f"fused-{reduce}", num, key_one,
+            lambda i: _fused_plan_one(shards, template, reduce, i),
+            cache_dir)
+    base_one = _cached_part_fn(
+        f"fused-{reduce}", num, key_one,
         lambda i: _fused_plan_one(shards, template, reduce, i), cache_dir)
+    return _cached_stack(
+        f"fused-pf-{reduce}", num, _pf_key_one(key_one),
+        lambda i: _to_pf_one(*base_one(i)), cache_dir,
+        validate=_pf_form)
 
 
 def has_cached_fused_plan(shards, reduce: str = "sum",
-                          cache_dir: str | None = None):
+                          cache_dir: str | None = None, pf: bool = False):
     """Per-part paths when the fused plan family is fully cached, else
     None (tools/plan_prewarm.py --check-only)."""
     template = _group_template(shards.arrays)
+    key_one = _fused_key_one(shards, template)
+    if pf:
+        return _warm_paths(f"fused-pf-{reduce}",
+                           shards.arrays.src_pos.shape[0],
+                           _pf_key_one(key_one), cache_dir)
     return _warm_paths(f"fused-{reduce}", shards.arrays.src_pos.shape[0],
-                       _fused_key_one(shards, template), cache_dir)
+                       key_one, cache_dir)
 
 
 def _expand_key_one(shards):
@@ -1176,38 +1350,65 @@ def _warm_paths(tag: str, num_parts: int, key_one,
     return paths if all(os.path.exists(p) for p in paths) else None
 
 
-def has_cached_expand_plan(shards, cache_dir: str | None = None):
+def has_cached_expand_plan(shards, cache_dir: str | None = None,
+                           pf: bool = False):
     """The tuple of per-part cache paths when plan_expand_shards_cached
     would be a pure disk load (EVERY part's entry present), else None —
     lets callers (bench default race) include the routed line only when
     it will not burn plan-construction time inside a TPU budget, and
     reuse the paths without re-hashing the arrays."""
+    key_one = _expand_key_one(shards)
+    if pf:
+        return _warm_paths("expand-pf", shards.arrays.src_pos.shape[0],
+                           _pf_key_one(key_one), cache_dir)
     return _warm_paths("expand", shards.arrays.src_pos.shape[0],
-                       _expand_key_one(shards), cache_dir)
+                       key_one, cache_dir)
 
 
 def plan_expand_shards_cached(shards, cache_dir: str | None = None,
-                              cache_path=None):
+                              cache_path=None, pf: bool = False):
     """plan_expand_shards with the per-part disk cache keyed on each
     part's exact gather layout (src_pos + edge_mask bytes + gathered
     size).  Route construction is ~90 s per part at 2^24 single-thread
     even with the native colorer (latency-bound Euler walk) — threaded
     it scales with cores, but benchmark A/B reruns must still not re-pay
     it; the per-iteration device replay never touches this path.
-    ``cache_path``: a has_cached_expand_plan result to skip re-hashing."""
+    ``cache_path``: a has_cached_expand_plan result to skip re-hashing.
+
+    ``pf=True``: the pass-fused plan family ("expand-pf" entries, keys
+    fold the fusion knobs).  A pf miss loads (or builds AND caches) the
+    unfused entry and upgrades it with the numpy transform — the Euler
+    coloring is never re-paid for the pf variant.  ``cache_path`` must
+    then come from ``has_cached_expand_plan(..., pf=True)``: entries of
+    the wrong plan form are rejected by a family guard and rebuilt, so
+    a mixed-up path can cost time but never silently replay unfused
+    kernels under the pf label."""
+    num = shards.arrays.src_pos.shape[0]
+    key_one = _expand_key_one(shards)
+    if not pf:
+        return _cached_stack(
+            "expand", num, key_one,
+            lambda i: _expand_plan_one(shards, i), cache_dir,
+            paths=list(cache_path) if cache_path else None)
+    base_one = _cached_part_fn("expand", num, key_one,
+                               lambda i: _expand_plan_one(shards, i),
+                               cache_dir)
     return _cached_stack(
-        "expand", shards.arrays.src_pos.shape[0], _expand_key_one(shards),
-        lambda i: _expand_plan_one(shards, i), cache_dir,
-        paths=list(cache_path) if cache_path else None)
+        "expand-pf", num, _pf_key_one(key_one),
+        lambda i: _to_pf_one(*base_one(i)), cache_dir,
+        paths=list(cache_path) if cache_path else None,
+        validate=_pf_form)
 
 
-def plan_expand_shards(shards):
+def plan_expand_shards(shards, pf: bool = False):
     """Plan the routed expand for every part of a PullShards bundle.
 
     Returns ``(ExpandStatic, tuple of (P, ...) stacked arrays)`` — the
     form the engine's vmapped iteration consumes
     (lux_tpu/engine/pull.py ``route=``).  All parts share one static
     (same e_pad / gathered size → same dims), asserted here.
+    ``pf=True`` returns the pass-fused form (see to_pf).
     """
-    return _stack_parts(shards.arrays.src_pos.shape[0],
+    plan = _stack_parts(shards.arrays.src_pos.shape[0],
                         lambda i: _expand_plan_one(shards, i))
+    return to_pf(plan) if pf else plan

@@ -64,6 +64,57 @@ def factor_digits(n: int) -> list[int]:
     return digits
 
 
+def benes_axes(k: int) -> tuple[int, ...]:
+    """The gathered-axis sequence of the 2k-1 Benes passes (the "V"
+    order build_route emits: dims[min(j, 2k-2-j)] for pass j)."""
+    return tuple(min(j, 2 * k - 2 - j) for j in range(2 * k - 1))
+
+
+def plan_fusion_groups(dims, max_block_elems: int = 1 << 17,
+                       max_group: int = 3) -> tuple[int, ...]:
+    """Pack the Benes pass sequence into consecutive FUSION GROUPS for
+    the pass-fused device replay (ops/pallas_shuffle.plan_route_pf).
+
+    A group of passes can chain inside one Pallas kernel with
+    VMEM-resident intermediates exactly when every inter-pass relayout
+    stays local to the block spanned by the group's gathered digits:
+    the block size is the product of the group's DISTINCT digit dims
+    (axes repeat across the Benes "V" turn — e.g. passes gathering
+    axes (2, 3, 2) span only dims[2]*dims[3]).  ``max_block_elems``
+    is the VMEM budget expressed in elements (the kernel holds the
+    data tile, its per-pass index tiles, and the double-buffered
+    copies of both); ``max_group`` bounds the number of index operands
+    resident per kernel.  Returns the group LENGTHS, summing to 2k-1.
+
+    Greedy left-to-right packing: each pass joins the current group
+    while the distinct-digit block stays within budget.  Purely a
+    function of (dims, knobs) — every part of a multi-part plan gets
+    the identical grouping, which the stacked-plan replay relies on.
+    """
+    if max_block_elems < LANE:
+        raise ValueError(f"max_block_elems must be >= {LANE}, "
+                         f"got {max_block_elems}")
+    if max_group < 1:
+        raise ValueError(f"max_group must be >= 1, got {max_group}")
+    axes = benes_axes(len(dims))
+    groups: list[int] = []
+    cur: list[int] = []  # distinct axes of the current group, in order
+    cur_len = 0
+    for a in axes:
+        nxt = cur if a in cur else cur + [a]
+        blk = 1
+        for x in nxt:
+            blk *= dims[x]
+        if cur and (blk > max_block_elems or cur_len >= max_group):
+            groups.append(cur_len)
+            cur, cur_len = [a], 1
+        else:
+            cur, cur_len = list(nxt), cur_len + 1
+    groups.append(cur_len)
+    assert sum(groups) == len(axes), (groups, axes)
+    return tuple(groups)
+
+
 @dataclasses.dataclass
 class Pass:
     """One device pass: gather along ``digit`` (size ``dim``) with the

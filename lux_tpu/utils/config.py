@@ -172,16 +172,26 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "unique-in-source mirror (working set "
                              "O(unique srcs) instead of O(nv); bitwise-"
                              "identical results)")
-        ap.add_argument("--route-gather", nargs="?", const="expand",
-                        default="", choices=["expand", "fused"],
+        ap.add_argument("--route-gather", nargs="?", const="auto",
+                        default="",
+                        choices=["auto", "expand", "expand-pf", "fused",
+                                 "fused-pf"],
                         help="Benes-routed pull hot loop (ops/expand.py): "
                              "'expand' replaces the per-edge state gather "
                              "with lane shuffles (bitwise-identical); "
                              "'fused' also replaces the segmented reduce "
                              "(deterministic group association; single "
-                             "device).  'expand' runs --distributed on "
-                             "the allgather, ring, and scatter exchanges "
-                             "(per-bucket plans for the bucketed two)")
+                             "device).  The '-pf' variants run the "
+                             "PASS-FUSED kernels (2-3 Benes passes per "
+                             "kernel, VMEM-resident intermediates — same "
+                             "bits, ~40% fewer HBM sweeps).  The bare "
+                             "flag means 'auto': expand-pf or expand per "
+                             "the chip-measured tpu:route_mode overlay "
+                             "(engine/methods.route_mode).  'expand' runs "
+                             "--distributed on the allgather, ring, and "
+                             "scatter exchanges (per-bucket plans for "
+                             "the bucketed two); the -pf variants are "
+                             "allgather-layout modes")
     elif push:
         ap.add_argument("--exchange", default="allgather",
                         choices=["allgather", "ring"],
@@ -201,12 +211,15 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                         help="dense rounds gather through a per-part "
                              "unique-in-source mirror (working set "
                              "O(unique srcs); bitwise-identical)")
-        ap.add_argument("--route-gather", nargs="?", const="expand",
-                        default="", choices=["expand"],
+        ap.add_argument("--route-gather", nargs="?", const="auto",
+                        default="",
+                        choices=["auto", "expand", "expand-pf"],
                         help="dense rounds' per-edge gather as Benes "
                              "lane shuffles (ops/expand.py; bitwise-"
-                             "identical).  Single-device allgather only "
-                             "for push apps")
+                             "identical; 'expand-pf' = pass-fused "
+                             "kernels; bare flag = 'auto', following "
+                             "the tpu:route_mode overlay winner).  "
+                             "Single-device allgather only for push apps")
     if sssp:
         ap.add_argument("--weighted", action="store_true",
                         help="relax with edge weights (Dijkstra-style)")

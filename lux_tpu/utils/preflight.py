@@ -76,7 +76,13 @@ def routed_plan_bytes(static) -> int:
         return routed_plan_bytes(static.src) + routed_plan_bytes(static.dst)
 
     def route_cost(r, space):
-        return len(r.passes) * space * idx
+        # pass-fused routes (StaticRoutePF) carry one index array per
+        # in-group gather STEP — same total as the unfused pass count,
+        # so plan residency is unchanged by fusion (counted by the one
+        # layout-arithmetic home, pallas_shuffle.route_num_arrays)
+        from lux_tpu.ops import pallas_shuffle as shuf
+
+        return shuf.route_num_arrays(r) * space * idx
 
     def ff_cost(ff):
         return sum(lv.rows * 128 * (idx + (0 if lv.base else 1))
@@ -143,6 +149,11 @@ def routed_plan_bytes_analytic(spec: ShardSpec, mode: str = "expand",
         ff = int(1.02 * n) * (idx + 1)  # lane idx + ext-mask byte
         return passes * n * idx + ff
 
+    # pass-fused modes ('expand-pf'/'fused-pf') carry the SAME index
+    # bytes as their base (one index tile per gather step either way —
+    # fusion collapses data sweeps, not plan residency)
+    if mode.endswith("-pf"):
+        mode = mode[:-3]
     n = max(_next_pow2(spec.e_pad), _next_pow2(spec.gathered_size), 128)
     b = expand_cost(n)
     if wide:

@@ -152,6 +152,61 @@ def pull_iter_model(
     return TrafficModel(bytes_moved, flops, dev)
 
 
+def _route_counts(r) -> tuple[int, int]:
+    """(HBM data sweeps, index-array reads) of one frozen route's
+    replay.  Unfused StaticRoute: one kernel — and one full read+write
+    of the data — per pass.  Pass-fused StaticRoutePF: one kernel per
+    GROUP (the 2-3 chained passes keep their intermediate in VMEM), but
+    every in-group gather step still streams its own index tile.
+    Delegates to the ONE layout-arithmetic home (ops/pallas_shuffle);
+    lazy import keeps this module importable without the kernel stack."""
+    from lux_tpu.ops import pallas_shuffle as shuf
+
+    return shuf.route_num_hbm_passes(r), shuf.route_num_arrays(r)
+
+
+#: COMP-phase full-array HBM sweeps by reduce strategy (the v-coefficient
+#: of _reduce_bytes_per_edge: value-array read/write passes)
+REDUCE_HBM_PASSES = {"scan": 2, "cumsum": 2, "mxsum": 2, "scatter": 3,
+                     "pallas": 1}
+
+
+def routed_hbm_passes(static, method: str = "scan") -> dict:
+    """Equivalent FULL-STATE HBM read+write sweeps of one routed pull
+    iteration, per pipeline stage — the accounting behind the
+    pass-fusion bet (ISSUE 4): fusing 2-3 Benes passes per kernel cuts
+    the dominant r1/r2 terms from len(passes) to len(groups).  Stages
+    over spaces other than the expand space n are scaled by their
+    space (vr moves nv_route/n of a sweep per kernel; the fused r2/group
+    reduce run over n2).  ``reduce`` is the chosen segment method's
+    sweep count for expand-shaped plans, or the single masked
+    group-reduce read for fused plans.  Emitted into every routed bench
+    row next to the byte model (bench.py)."""
+    r1, _ = _route_counts(static.r1)
+    r2, _ = _route_counts(static.r2)
+    n = static.n
+    ff = sum(lv.rows * 128 for lv in static.ff.levels) / n
+    out = {"r1": float(r1), "ff": round(ff, 2)}
+    if hasattr(static, "n2"):  # FusedStatic
+        out["r2"] = round(r2 * static.n2 / n, 2)
+        out["reduce"] = round(static.n2 / n, 2)  # masked group-reduce read
+        vr, _ = _route_counts(static.vr)
+        out["vr"] = round(vr * static.nv_route / n, 2)
+    else:
+        out["r2"] = float(r2)
+        out["reduce"] = float(REDUCE_HBM_PASSES[method])
+    out["total"] = round(sum(out.values()), 2)
+    return out
+
+
+def pull_hbm_passes(method: str = "scan") -> dict:
+    """Full-array HBM sweep accounting for the DIRECT (unrouted) pull
+    iteration, so every bench row reports the same field family: one
+    per-edge gather sweep + the reduce method's sweeps."""
+    r = REDUCE_HBM_PASSES[method]
+    return {"gather": 1.0, "reduce": float(r), "total": round(1.0 + r, 2)}
+
+
 def routed_pull_iter_model(static, ne: int, nv: int,
                             state_bytes: int = 4,
                             method: str = "scan") -> TrafficModel:
@@ -161,12 +216,16 @@ def routed_pull_iter_model(static, ne: int, nv: int,
     int32 index array over the pass's space; fill-forward is a
     geometric ~1.01 lane passes; the fused variant adds the group-
     layout edge_value/mask pass, the reduce pass, and the small
-    accumulator route.  Useful FLOPs are the per-edge combines + apply,
-    as in pull_iter_model — routing moves bits, it does not compute."""
+    accumulator route.  A PASS-FUSED route (StaticRoutePF) pays the
+    data read+write once per fusion GROUP — the in-group intermediates
+    live in VMEM — while every gather step still reads its index tile.
+    Useful FLOPs are the per-edge combines + apply, as in
+    pull_iter_model — routing moves bits, it does not compute."""
     v = state_bytes
 
     def route_bytes(r, space):
-        return len(r.passes) * space * (2 * v + 4)
+        data_passes, idx_reads = _route_counts(r)
+        return space * (data_passes * 2 * v + idx_reads * 4)
 
     b = route_bytes(static.r1, static.n)
     ff_elems = sum(lv.rows * 128 for lv in static.ff.levels)

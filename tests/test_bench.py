@@ -272,6 +272,63 @@ def test_wait_tpu_assume_hook_pins_probes(monkeypatch):
     assert not done and time.monotonic() - t0 < 5.0
 
 
+def test_bench_worker_routepf_ab_row():
+    """LUX_BENCH_ROUTE_PF=1 emits the pass-fused A/B row: _routepf
+    metric suffix + the hbm_passes accounting field showing the fused
+    sweep count (r1/r2 collapsed to group counts)."""
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["PYTHONPATH"] = os.path.dirname(BENCH)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "LUX_BENCH_SCALE": "9",
+        "LUX_BENCH_ITERS": "4",
+        "LUX_BENCH_APPS": "pagerank",
+        "LUX_BENCH_ROUTE_PF": "1",
+    })
+    r = subprocess.run(
+        [sys.executable, "-c", "import bench; bench.worker_main()"],
+        env=env, capture_output=True, text=True, timeout=420, cwd="/tmp",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(s) for s in r.stdout.strip().splitlines()
+             if s.startswith("{")]
+    assert lines and all("_routepf" in ln["metric"] for ln in lines)
+    hp = lines[0]["hbm_passes"]
+    # pf plans at this scale: r1/r2 in <= 3 kernels each (vs 5+ passes)
+    assert hp["r1"] <= 3 and hp["r2"] <= 3
+    assert hp["total"] == round(sum(v for k, v in hp.items()
+                                    if k != "total"), 2)
+
+
+def test_bench_worker_ba_row():
+    """The standing heavy-tail row: Barabási-Albert through
+    generator -> .lux -> routed-pf pull, its own metric family (no
+    _rmat in the name), with routed roofline + hbm_passes fields."""
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["PYTHONPATH"] = os.path.dirname(BENCH)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "LUX_BENCH_SCALE": "9",
+        "LUX_BENCH_ITERS": "4",
+        "LUX_BENCH_APPS": "ba",
+        "LUX_BENCH_BA_SCALE": "9",
+    })
+    r = subprocess.run(
+        [sys.executable, "-c", "import bench; bench.worker_main()"],
+        env=env, capture_output=True, text=True, timeout=420, cwd="/tmp",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(s) for s in r.stdout.strip().splitlines()
+             if s.startswith("{")]
+    assert len(lines) == 1, lines
+    ln = lines[0]
+    assert ln["metric"].startswith("pagerank_gteps_ba9_m")
+    assert "_routepf" in ln["metric"] and "_rmat" not in ln["metric"]
+    assert ln["value"] > 0 and ln["ne"] > 0
+    assert ln["hbm_passes"]["total"] > 0
+    assert ln["plan_build_seconds"]["cold"] >= 0.0
+
+
 def test_every_row_carries_plan_build_seconds():
     """CI contract for plan-build amortization reporting: every bench
     row (worker-measured AND the orchestrator's zero row) carries the

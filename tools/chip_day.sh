@@ -48,7 +48,8 @@ echo "luxcheck: clean"
 #     prewarm must not inflate them (bench nices competing workers too)
 echo "=== plan_prewarm (background, $(date +%H:%M:%S))"
 nice -n 19 timeout 7200 python tools/plan_prewarm.py \
-    --scale "${LUX_PREWARM_SCALE:-20}" --ef 16 --kinds expand,fused \
+    --scale "${LUX_PREWARM_SCALE:-20}" --ef 16 \
+    --kinds expand,expand-pf,fused,fused-pf \
     > "$LOG/plan_prewarm.out" 2> "$LOG/plan_prewarm.err" &
 PREWARM_PID=$!
 # abort paths (relay gate, dead-tunnel gate) must not orphan 2h of
@@ -74,11 +75,15 @@ echo "relay gate: 8083 accepts"
 #    roofline's dominant unknown, banked at micro scale.
 #    Round-5 addition: "route" (Benes lane-shuffle expand) and "fused"
 #    (routed expand + group reduce) race the same window — the measured
-#    design bet of the round.  Order: mxsum banks the reduce baseline,
-#    gather banks the flat baseline, then route/fused bank the routed
-#    rows; scan stays last.
-run micro_race 2400 python tools/tpu_micro_race.py \
-    --methods mxsum gather route fused gatherc scan --outdir "$LOG/micro"
+#    design bet of the round.  Round-6 addition: "routepf"/"fusedpf",
+#    the PASS-FUSED variants (2-3 passes per kernel, VMEM-resident
+#    intermediates) — the fused-vs-unfused A/B banked right after each
+#    unfused row so even a short window records the pass-fusion bet.
+#    Order: mxsum banks the reduce baseline, gather the flat baseline,
+#    then route/routepf/fused/fusedpf; scan stays last.
+run micro_race 3000 python tools/tpu_micro_race.py \
+    --methods mxsum gather route routepf fused fusedpf gatherc scan \
+    --outdir "$LOG/micro"
 grep -q '"ms_per_rep"' "$LOG/micro_race.out" || {
   echo "tunnel dead (no micro rows) — aborting battery"; exit 1; }
 
@@ -90,10 +95,23 @@ LUX_ROUTE_IDX8=0 run micro_route_i32 900 python tools/tpu_micro_race.py \
 
 # 0c) routed end-to-end pagerank at headline scale: the round's headline
 #     bet, banked before the long component probes.  First plan-consuming
-#     step — wait for the background prewarm (no-op when already warm)
+#     step — wait for the background prewarm (no-op when already warm).
+#     Round 6: the PASS-FUSED rows run FIRST (the round's bet — pf plans
+#     derive from the same cached coloring, so prewarm covers them), then
+#     the unfused twins for the end-to-end fused-vs-unfused A/B the
+#     winners overlay folds in (_record_route_mode runs in the default
+#     race of step 1; these explicit rows are the per-flavor artifacts).
 echo "waiting for plan_prewarm (pid $PREWARM_PID)"; wait "$PREWARM_PID" || true
 trap - EXIT
 tail -1 "$LOG/plan_prewarm.out" 2>/dev/null | sed 's/^/    prewarm: /'
+LUX_BENCH_WATCHDOG_S=1500 LUX_BENCH_TPU_S=1300 \
+  LUX_BENCH_ROUTE_PF=1 LUX_BENCH_APPS=pagerank \
+  LUX_BENCH_METHOD=mxsum LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
+  run bench_routepf 1600 python bench.py
+LUX_BENCH_WATCHDOG_S=1500 LUX_BENCH_TPU_S=1300 \
+  LUX_BENCH_ROUTE_FUSED_PF=1 LUX_BENCH_APPS=pagerank \
+  LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
+  run bench_routefusedpf 1600 python bench.py
 LUX_BENCH_WATCHDOG_S=1500 LUX_BENCH_TPU_S=1300 \
   LUX_BENCH_ROUTE_FUSED=1 LUX_BENCH_APPS=pagerank \
   LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \

@@ -40,11 +40,34 @@ else
   tail -3 "$LOG/san_build.out" | sed 's/^/    /'
 fi
 
-# 3) fast tier-1 subset: the engine/analysis/native seams this script
+# 3) routed-pf interpret smoke: the pass-fused replay (ops/expand.to_pf)
+#    must stay bitwise-identical to the direct gather on CPU — the
+#    correctness gate that never waits on a chip window
+stage routedpf_smoke 300 env JAX_PLATFORMS=cpu python -c "
+import numpy as np, jax, jax.numpy as jnp
+from lux_tpu.graph import generate
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.engine import pull
+from lux_tpu.models.pagerank import PageRankProgram
+from lux_tpu.ops import expand as E
+g = generate.rmat(8, 8, seed=11)
+sh = build_pull_shards(g, 2)
+prog = PageRankProgram(nv=sh.spec.nv)
+arr = jax.tree.map(jnp.asarray, sh.arrays)
+s0 = pull.init_state(prog, arr)
+d = pull.run_pull_fixed(prog, sh.spec, arr, s0, 3, method='scan')
+r = pull.run_pull_fixed(prog, sh.spec, arr, s0, 3, method='scan',
+                        route=E.plan_expand_shards(sh, pf=True))
+assert (np.asarray(d) == np.asarray(r)).all(), 'routed-pf != direct'
+print('routed-pf bitwise == direct')
+"
+
+# 4) fast tier-1 subset: the engine/analysis/native seams this script
 #    exists to protect (full suite: ROADMAP.md "Tier-1 verify")
-stage tier1_fast 600 env JAX_PLATFORMS=cpu python -m pytest -q \
+stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
     -m 'not slow' -p no:cacheprovider \
     tests/test_luxcheck.py tests/test_native.py tests/test_expand.py \
+    tests/test_passfuse.py \
     tests/test_determinism.py tests/test_serve_scheduler.py
 
 if [ "$FAILED" -ne 0 ]; then

@@ -45,8 +45,12 @@ def main(argv=None):
     ap.add_argument("--ef", type=int, default=16, help="edge factor")
     ap.add_argument("--parts", type=int, default=1,
                     help="pull-shard part count (bench.py uses 1)")
-    ap.add_argument("--kinds", default="expand,fused",
-                    help="comma list from {expand,fused,cf}")
+    ap.add_argument("--kinds", default="expand,expand-pf,fused,fused-pf",
+                    help="comma list from {expand,expand-pf,fused,"
+                         "fused-pf,cf,cf-pf} — the -pf families are the "
+                         "pass-fused twins (derived from the unfused "
+                         "entries by the numpy transform, so warming "
+                         "them after the base family costs seconds)")
     ap.add_argument("--reduce", default="sum",
                     help="fused-plan reduce op (joins the cache tag)")
     ap.add_argument("--threads", type=int, default=0,
@@ -68,7 +72,8 @@ def main(argv=None):
     from lux_tpu.ops import expand
 
     kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
-    bad = set(kinds) - {"expand", "fused", "cf"}
+    bad = set(kinds) - {"expand", "expand-pf", "fused", "fused-pf",
+                        "cf", "cf-pf"}
     if bad:
         ap.error(f"unknown plan kinds: {sorted(bad)}")
 
@@ -88,10 +93,16 @@ def main(argv=None):
         probes = {
             "expand": lambda: expand.has_cached_expand_plan(
                 shards, cache_dir=args.cache_dir),
+            "expand-pf": lambda: expand.has_cached_expand_plan(
+                shards, cache_dir=args.cache_dir, pf=True),
             "fused": lambda: expand.has_cached_fused_plan(
                 shards, args.reduce, cache_dir=args.cache_dir),
+            "fused-pf": lambda: expand.has_cached_fused_plan(
+                shards, args.reduce, cache_dir=args.cache_dir, pf=True),
             "cf": lambda: expand.has_cached_cf_plan(
                 shards, cache_dir=args.cache_dir),
+            "cf-pf": lambda: expand.has_cached_cf_plan(
+                shards, cache_dir=args.cache_dir, pf=True),
         }
         for kind in kinds:
             out["kinds"][kind] = {"warm": probes[kind]() is not None}
@@ -101,10 +112,16 @@ def main(argv=None):
     builders = {
         "expand": lambda: expand.plan_expand_shards_cached(
             shards, cache_dir=args.cache_dir),
+        "expand-pf": lambda: expand.plan_expand_shards_cached(
+            shards, cache_dir=args.cache_dir, pf=True),
         "fused": lambda: expand.plan_fused_shards_cached(
             shards, args.reduce, cache_dir=args.cache_dir),
+        "fused-pf": lambda: expand.plan_fused_shards_cached(
+            shards, args.reduce, cache_dir=args.cache_dir, pf=True),
         "cf": lambda: expand.plan_cf_route_shards_cached(
             shards, cache_dir=args.cache_dir),
+        "cf-pf": lambda: expand.plan_cf_route_shards_cached(
+            shards, cache_dir=args.cache_dir, pf=True),
     }
     for kind in kinds:
         expand.reset_plan_stats()

@@ -77,17 +77,22 @@ def worker_main(args) -> int:
 
         def f(x):
             return x[src_pos].reshape(g.nv, args.ef).sum(axis=1) * 1e-3
-    elif args.method == "route":
+    elif args.method in ("route", "routepf"):
         # the routed-shuffle expand (ops/expand.py) standing in for the
         # flat gather: directly comparable to the "gather" row (same
-        # reshape-sum tail).  Exactness is checked against the direct
-        # gather before timing.
+        # reshape-sum tail).  "routepf" is the PASS-FUSED variant
+        # (expand.to_pf: 2-3 Benes passes per kernel, VMEM-resident
+        # intermediates) — the round-6 A/B this tool banks first.
+        # Exactness is checked against the direct gather before timing.
         from lux_tpu.ops import expand
 
         src_pos = np.asarray(g.col_idx).astype(np.int32)
         t_plan = time.perf_counter()
         static, arrays_np = expand.plan_expand(src_pos, len(src_pos), g.nv)
-        print(f"# route plan built in {time.perf_counter() - t_plan:.1f}s "
+        if args.method == "routepf":
+            static, arrays_np = expand.to_pf((static, arrays_np))
+        print(f"# {args.method} plan built in "
+              f"{time.perf_counter() - t_plan:.1f}s "
               f"(n={static.n}, {len(arrays_np)} pass arrays)", flush=True)
         route_arrays = tuple(jnp.asarray(a) for a in arrays_np)
         interp = jax.default_backend() not in ("tpu", "axon")
@@ -106,11 +111,14 @@ def worker_main(args) -> int:
         print(f"# route exactness vs direct gather: {exact}", flush=True)
         if not exact:
             return 3
-    elif args.method == "fused":
+    elif args.method in ("fused", "fusedpf"):
         # the COMPLETE fused routed hot loop (expand + reduce as routed
         # movement) — the number to weigh against gather + a segment-sum
-        # row combined.  Exact for this check's sum only up to group
-        # association; verified against the NumPy oracle with rtol.
+        # row combined; "fusedpf" pass-fuses its r1/r2/vr routes.  Exact
+        # for this check's sum only up to group association; verified
+        # against the NumPy oracle with rtol (the pf transform keeps the
+        # group layout, so fused and fusedpf are bitwise EQUAL to each
+        # other).
         from lux_tpu.ops import expand
 
         src_pos = np.asarray(g.col_idx).astype(np.int32)
@@ -118,7 +126,10 @@ def worker_main(args) -> int:
         t_plan = time.perf_counter()
         static, arrays_np = expand.plan_fused(
             src_pos, dst_local, g.ne, g.nv, g.nv, "sum")
-        print(f"# fused plan built in {time.perf_counter() - t_plan:.1f}s "
+        if args.method == "fusedpf":
+            static, arrays_np = expand.to_pf((static, arrays_np))
+        print(f"# {args.method} plan built in "
+              f"{time.perf_counter() - t_plan:.1f}s "
               f"(n={static.n}, n2={static.n2}, "
               f"{len(static.groups)} groups)", flush=True)
         route_arrays = tuple(jnp.asarray(a) for a in arrays_np)
@@ -192,8 +203,10 @@ def worker_main(args) -> int:
         xs.append(n)
     slope, icpt = _fit(xs, ts)
     gteps = g.ne / slope / 1e9 if slope > 0 else float("nan")
-    kind = ("gather" if args.method in ("gather", "gatherc", "route")
-            else "fused" if args.method == "fused" else "segment_sum")
+    kind = ("gather"
+            if args.method in ("gather", "gatherc", "route", "routepf")
+            else "fused" if args.method in ("fused", "fusedpf")
+            else "segment_sum")
     print(json.dumps({
         "micro": kind, "method": args.method,
         "platform": platform, "scale": args.scale, "ne": int(g.ne),
@@ -282,7 +295,8 @@ def main(argv=None):
     # hot-loop half; they inform the layout choice, not the method)
     timed = {m: r["ms_per_rep"] for m, r in rows.items()
              if r.get("ms_per_rep", 0) > 0
-             and m not in ("gather", "gatherc", "route", "fused")}
+             and m not in ("gather", "gatherc", "route", "routepf",
+                           "fused", "fusedpf")}
     winner = min(timed, key=timed.get) if timed else None
     platforms = {r.get("platform") for r in rows.values()}
     record = {
