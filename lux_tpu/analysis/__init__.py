@@ -20,6 +20,12 @@ X002 malformed baseline entry, X003 stale baseline entry.
 Run it: ``python tools/luxcheck.py --all`` (chip_day step -3, a tier-1
 test, and tools/ci_check.sh all gate on exit 0).  Pure stdlib — never
 imports jax/numpy, so the gate costs milliseconds.
+
+The jaxpr/HLO-level sibling gate lives in the ``lux_tpu.analysis.ir``
+SUBPACKAGE (luxaudit, chip_day step -3b): it shares this package's
+Finding/fingerprint/baseline machinery but DOES import jax (it traces
+the real engines), so it is deliberately NOT imported here — importing
+``lux_tpu.analysis`` must stay jax-free for the millisecond preflight.
 """
 from lux_tpu.analysis.core import (  # noqa: F401
     DEFAULT_TARGETS,

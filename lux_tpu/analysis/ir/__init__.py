@@ -1,0 +1,117 @@
+"""lux_tpu.analysis.ir — luxaudit, the jaxpr/HLO-level static auditor.
+
+luxcheck (lux_tpu.analysis, PR 3) lints the Python AST; this subpackage
+audits the layer below: the jaxpr and StableHLO the engines actually
+ship.  Five checker families turn five rounds of "single-trace,
+donated, bitwise, under-budget" prose into machine-checked invariants:
+
+* LUX-J1 retrace stability (retrace.py) — J101 structural drift across
+  a family's configs, J102 unhashable jit statics, J103 dynamic-knob
+  recompiles;
+* LUX-J2 donation (donation.py) — J201: every ``donate``d leaf must
+  carry an input_output_alias in the lowered module (XLA drops
+  mismatched donations silently);
+* LUX-J3 collective order (collectives.py) — J301/J302: collectives
+  inside ``lax.cond`` arms / ``lax.while_loop`` bodies require a
+  provably mesh-agreed predicate (the push direction switch can never
+  deadlock a mesh);
+* LUX-J4 VMEM budget (vmem.py) — J401: pass-fused group residency
+  recomputed from the frozen plan's tile geometry + real index dtypes
+  against the budget the knobs promise;
+* LUX-J5 HBM-pass accounting (hbm.py) — J501/J502: the roofline
+  ``routed_hbm_passes`` headline metric cross-checked against the
+  pallas_call kernels actually traced.
+
+Everything runs on CPU (tools/luxaudit.py, chip-day step -3b, a
+ci_check stage) against the REAL engine entry points over a small
+fixture graph (targets.py).  Findings reuse luxcheck's machinery —
+same Finding/fingerprint dataclass, same baseline format
+(tools/luxaudit_baseline.txt, shipped empty, stale entries are
+LUX-X003 findings) — so one suppression policy covers both gates.
+
+Unlike the parent package this subpackage DOES import jax (that is the
+point); ``lux_tpu.analysis`` itself must stay jax-free for the
+millisecond luxcheck preflight, which is why nothing here is imported
+from the parent ``__init__``.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from lux_tpu.analysis.core import Finding, _apply_baseline
+
+#: checker families in report order
+FAMILIES = ("retrace", "donation", "collective", "vmem", "hbm")
+
+
+def run_audit(fast: bool = False,
+              baseline_path: Optional[str] = None,
+              families: Optional[Tuple[str, ...]] = None):
+    """Run the audit units and return ``(findings, report)``.
+
+    ``findings`` is the baseline-filtered, sorted list (empty == exit
+    0); ``report`` is the JSON-ready audit record (per-unit status and
+    timings) the CLI writes as AUDIT_r0X.json.
+    """
+    from lux_tpu.analysis.ir.targets import audit_units
+
+    units = audit_units(fast=fast)
+    findings: List[Finding] = []
+    if families:
+        bad = sorted(set(families) - set(FAMILIES))
+        if bad:
+            findings.append(Finding(
+                path="lux_tpu/analysis/ir", line=1, col=0,
+                code="LUX-J000",
+                message=f"unknown audit family {', '.join(bad)!s} — "
+                        f"valid families: {', '.join(FAMILIES)}",
+                text="families"))
+        units = [u for u in units if u.family in families]
+    if not units:
+        # zero selected units must FAIL, never pass as clean — a typo'd
+        # filter (or a tier with no matching units) silently auditing
+        # nothing is how a preflight stops preflighting (the luxcheck
+        # LUX-X000 missing-target policy, one layer down)
+        findings.append(Finding(
+            path="lux_tpu/analysis/ir", line=1, col=0, code="LUX-J000",
+            message="the family/tier filter selected ZERO audit units — "
+                    "an empty audit must never report clean; fix the "
+                    "--families value or drop --fast",
+            text="no-units"))
+    unit_rows = []
+    for u in units:
+        t0 = time.perf_counter()
+        try:
+            got = list(u.run())
+        except Exception as e:  # an audit crash must FAIL the gate,
+            # never pass as clean — same policy as luxcheck LUX-X000
+            got = [Finding(
+                path=u.path, line=1, col=0, code="LUX-J000",
+                message=f"audit unit crashed: {type(e).__name__}: {e}",
+                text=u.label)]
+        findings.extend(got)
+        unit_rows.append({
+            "family": u.family,
+            "label": u.label,
+            "path": u.path,
+            "findings": len(got),
+            "seconds": round(time.perf_counter() - t0, 3),
+        })
+    findings = _apply_baseline(findings, baseline_path)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    import jax
+
+    report = {
+        "tool": "luxaudit",
+        "jax": jax.__version__,
+        "tier": "fast" if fast else "all",
+        "units": unit_rows,
+        "findings": [
+            {"path": f.path, "code": f.code, "message": f.message,
+             "target": f.text, "fingerprint": f.fingerprint()}
+            for f in findings
+        ],
+        "clean": not findings,
+    }
+    return findings, report

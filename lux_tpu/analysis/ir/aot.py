@@ -1,0 +1,175 @@
+"""AOT tracing/lowering plumbing shared by the luxaudit checker families.
+
+luxcheck (PR 3) guards the Python AST; this layer guards the IR we
+actually ship: every checker here works on the jaxpr produced by
+``jit(...).trace(...)`` and/or the StableHLO produced by ``.lower()``.
+Both are available on CPU with no accelerator attached — the whole audit
+runs in a chip-day preflight before any tunnel is needed.
+
+Version caveats (jax 0.4.37, the pinned toolchain):
+
+* ``Traced.lower(platforms=('tpu',))`` does not exist yet — cross-
+  platform lowering landed in the 0.5 era.  We lower for the DEFAULT
+  (CPU) backend; donation aliasing, jaxpr structure, and pallas_call
+  kernel counts are platform-independent at this level, which is exactly
+  the property the checkers need.  When the pin moves to >= 0.5, switch
+  ``lower_traced`` to ``platforms=('tpu',)`` so the audited module is
+  byte-for-byte the chip one.
+* Donation shows up in the lowered module as per-argument
+  ``tf.aliasing_output`` attributes (the MLIR spelling of XLA's
+  input_output_aliases).  XLA drops a donation SILENTLY (a warning, not
+  an error) when no output matches the donated buffer — the exact
+  failure mode LUX-J2 exists to catch.
+* Pallas kernels survive as ``pallas_call`` jaxpr equations even when
+  traced with ``interpret=True`` (the CPU test mode), so HBM-sweep
+  kernel counting (LUX-J5) does not need a TPU lowering either.
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterator, List, Optional, Tuple
+
+
+def is_literal(v) -> bool:
+    """Literal-vs-Var by shape, not class identity: the Literal class
+    moved between jax.core and jax.extend.core across 0.4/0.5, and the
+    duck test (Literals carry ``val``, Vars carry ``count``) survives
+    both."""
+    return hasattr(v, "val") and not hasattr(v, "count")
+
+
+def sub_jaxprs(eqn) -> Iterator:
+    """Every Jaxpr/ClosedJaxpr reachable from one equation's params —
+    cond branches, while cond/body, scan/pjit/remat/custom_* bodies —
+    yielded as plain ``Jaxpr``s (ClosedJaxprs unwrapped)."""
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        for sub in vals:
+            if hasattr(sub, "jaxpr") and hasattr(sub, "consts"):
+                yield sub.jaxpr  # ClosedJaxpr
+            elif hasattr(sub, "eqns"):
+                yield sub  # bare Jaxpr
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """All equations of ``jaxpr`` and every nested sub-jaxpr, pre-order."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in sub_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def count_primitive(jaxpr, name: str) -> int:
+    return sum(1 for e in iter_eqns(jaxpr) if str(e.primitive) == name)
+
+
+def primitive_sequence(jaxpr) -> Tuple[str, ...]:
+    """The flattened pre-order primitive names — the structural signature
+    LUX-J1 compares across a family's configs.  Avals are deliberately
+    excluded: a Q-bucket family's shapes differ BY DESIGN; what must not
+    differ is the program structure (an iteration-count-dependent unroll
+    or a config-dependent op set is exactly the drift being hunted)."""
+    return tuple(str(e.primitive) for e in iter_eqns(jaxpr))
+
+
+#: primitives that define a program's retrace-relevant STRUCTURE:
+#: control flow, kernels, memory movement, collectives.  Elementwise /
+#: broadcasting idioms are excluded on purpose — jnp legitimately traces
+#: a degenerate Q=1 broadcast differently from Q=4 (slice vs
+#: broadcast_in_dim), and that difference costs nothing; an extra while
+#: loop, gather, or pallas kernel per config value costs a compile and
+#: an HBM sweep.
+STRUCTURAL_PRIMS = frozenset({
+    "while", "cond", "scan", "pallas_call", "custom_call",
+    "gather", "scatter", "scatter-add", "scatter-min", "scatter-max",
+    "dynamic_slice", "dynamic_update_slice", "sort", "dot_general",
+    "psum", "pmin", "pmax", "all_gather", "ppermute", "reduce_scatter",
+    "all_to_all", "shard_map",
+})
+
+
+def structural_signature(jaxpr) -> Tuple[Tuple[str, int], ...]:
+    """Sorted (primitive, count) multiset over STRUCTURAL_PRIMS — the
+    coarse cross-config signature for families whose configs change
+    SHAPES (Q buckets): shapes may differ, structure may not."""
+    counts: dict = {}
+    for e in iter_eqns(jaxpr):
+        name = str(e.primitive)
+        if name in STRUCTURAL_PRIMS:
+            counts[name] = counts.get(name, 0) + 1
+    return tuple(sorted(counts.items()))
+
+
+def traced_jaxpr(traced):
+    """The Jaxpr of a ``jit(...).trace(...)`` result (ClosedJaxpr
+    unwrapped)."""
+    j = traced.jaxpr
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def lower_traced(traced):
+    """Lower a Traced to StableHLO text (see module docstring for the
+    cross-platform caveat)."""
+    return traced.lower().as_text()
+
+
+# ---------------------------------------------------------------------------
+# donation-aliasing extraction from the lowered module
+# ---------------------------------------------------------------------------
+
+_MAIN_RE = re.compile(r"func\.func public @main\((.*?)\)\s*->", re.DOTALL)
+_ARG_RE = re.compile(r"%arg(\d+):")
+
+
+def main_signature(stablehlo_text: str) -> str:
+    m = _MAIN_RE.search(stablehlo_text)
+    return m.group(1) if m else ""
+
+
+def aliased_arg_indices(stablehlo_text: str) -> Tuple[set, int]:
+    """(indices of @main arguments carrying ``tf.aliasing_output``,
+    total argument count).  Argument order is jax's flatten order of the
+    dynamic (non-static) call arguments, so callers can map donated
+    pytree leaves onto these positions with tree_flatten spans."""
+    sig = main_signature(stablehlo_text)
+    aliased: set = set()
+    total = 0
+    # split the signature at each %argN marker; the chunk following a
+    # marker holds that argument's type + attribute dict
+    parts = _ARG_RE.split(sig)
+    # parts = [prefix, idx0, chunk0, idx1, chunk1, ...]
+    for i in range(1, len(parts) - 1, 2):
+        idx = int(parts[i])
+        total = max(total, idx + 1)
+        if "tf.aliasing_output" in parts[i + 1]:
+            aliased.add(idx)
+    return aliased, total
+
+
+def leaf_spans(args) -> List[Tuple[int, int]]:
+    """Flattened-leaf [start, stop) span of each top-level argument, in
+    jax's flatten order (None leaves vanish, matching jax)."""
+    import jax
+
+    spans = []
+    off = 0
+    for a in args:
+        n = len(jax.tree_util.tree_leaves(a))
+        spans.append((off, off + n))
+        off += n
+    return spans
+
+
+def hashable(x) -> Optional[str]:
+    """None when ``hash(x)`` works and is stable; otherwise the error
+    string (the LUX-J102 payload)."""
+    try:
+        h1 = hash(x)
+        h2 = hash(x)
+    except TypeError as e:
+        return str(e)
+    if h1 != h2:
+        return "hash() is not stable across calls"
+    if x != x:
+        return "static compares unequal to itself (breaks cache keying)"
+    return None

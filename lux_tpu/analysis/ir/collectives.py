@@ -1,0 +1,251 @@
+"""LUX-J3: collective-order safety of shard_map bodies, statically.
+
+The push engine's direction switch places collectives (the dense
+branch's all_gather, the ring engine's ppermute sweep) inside
+``lax.cond`` arms and runs the whole thing under ``lax.while_loop``.
+On a mesh that is only safe when every participant takes the SAME
+branch every iteration — i.e. when each branch/loop predicate is a
+mesh-agreed value.  The engines guarantee this by deriving every such
+predicate from a psum; this checker PROVES it from the jaxpr instead of
+trusting the comment (the static-uniformity discipline Tascade argues
+for deterministic reduction trees, arXiv:2311.15810 — reduction and
+collective order must be provably identical on every participant).
+
+Analysis: abstract interpretation over the shard_map body jaxpr with a
+two-point lattice per value — "agreed" (provably identical on every
+mesh participant) or not:
+
+* literals / jaxpr consts: agreed (host constants are broadcast);
+* shard_map inputs: agreed iff their in_names entry is empty
+  (replicated P() operands), per the shard_map equation params;
+* psum / pmin / pmax / all_gather outputs: agreed REGARDLESS of input
+  agreement (an all-reduce of divergent values is still identical
+  everywhere);
+* ppermute / psum_scatter(reduce_scatter) / all_to_all / pgather /
+  axis_index outputs: never agreed;
+* everything else: agreed iff every operand is agreed;
+* while carries: greatest fixpoint (start from the init values'
+  agreement, demote until stable);
+* cond outputs: agreed iff the predicate AND every branch's outputs
+  are agreed.
+
+Findings:
+
+* LUX-J301 — a ``cond`` with collectives in any arm whose predicate is
+  not provably mesh-agreed (participants could take different arms:
+  mismatched collective sequences deadlock the mesh);
+* LUX-J302 — a ``while_loop`` whose body contains collectives and whose
+  stop predicate is not provably mesh-agreed (participants could
+  disagree on the trip count: one device exits, the rest block in the
+  next iteration's collective).
+
+A cond whose arms have DIFFERENT collective sequences is legal exactly
+when the predicate is agreed — the direction switch's design — so
+sequence asymmetry alone is not a finding; the predicate proof is.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from lux_tpu.analysis.core import Finding
+from lux_tpu.analysis.ir import aot
+
+#: collective primitives whose OUTPUT is identical on every participant
+REPLICATING = frozenset({"psum", "pmin", "pmax", "all_gather"})
+#: mesh-synchronizing primitives whose output differs per participant
+DIVERGENT = frozenset(
+    {"ppermute", "reduce_scatter", "all_to_all", "pgather"}
+)
+#: every primitive that synchronizes the mesh (deadlocks when sequences
+#: diverge); axis_index communicates nothing so it is only non-agreed
+COLLECTIVES = REPLICATING | DIVERGENT
+
+
+def _collective_seq(jaxpr) -> Tuple[str, ...]:
+    return tuple(
+        str(e.primitive)
+        for e in aot.iter_eqns(jaxpr)
+        if str(e.primitive) in COLLECTIVES
+    )
+
+
+class _BodyAnalysis:
+    """One shard_map body walk: agreement propagation + findings."""
+
+    def __init__(self, path: str, line: int, label: str):
+        self.path = path
+        self.line = line
+        self.label = label
+        self.findings: List[Finding] = []
+
+    def _finding(self, code: str, message: str) -> None:
+        self.findings.append(Finding(
+            path=self.path, line=self.line, col=0, code=code,
+            message=message, text=self.label))
+
+    def _read(self, env: Dict[int, bool], v) -> bool:
+        if aot.is_literal(v):
+            return True
+        return env.get(id(v), False)
+
+    def eval_jaxpr(self, jaxpr, in_agreed: List[bool],
+                   consts_agreed: bool = True) -> List[bool]:
+        env: Dict[int, bool] = {}
+        for var, ag in zip(jaxpr.invars, in_agreed):
+            env[id(var)] = ag
+        for var in jaxpr.constvars:
+            env[id(var)] = consts_agreed
+        for eqn in jaxpr.eqns:
+            self._eval_eqn(env, eqn)
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    # -- equation dispatch ---------------------------------------------------
+
+    def _eval_eqn(self, env: Dict[int, bool], eqn) -> None:
+        prim = str(eqn.primitive)
+        ins = [self._read(env, v) for v in eqn.invars]
+        if prim in REPLICATING:
+            outs = [True] * len(eqn.outvars)
+        elif prim in DIVERGENT or prim == "axis_index":
+            outs = [False] * len(eqn.outvars)
+        elif prim == "cond":
+            outs = self._eval_cond(eqn, ins)
+        elif prim == "while":
+            outs = self._eval_while(eqn, ins)
+        elif prim == "scan":
+            outs = self._eval_scan(eqn, ins)
+        else:
+            body = list(aot.sub_jaxprs(eqn))
+            if body:
+                # pjit / remat / custom_* / closed_call: evaluate the
+                # (single) body with operand agreement; fall back to
+                # all-operands-agreed when the body shape is unexpected
+                sub = body[0]
+                if len(sub.invars) == len(ins):
+                    outs_sub = self.eval_jaxpr(sub, ins)
+                    outs = (outs_sub if len(outs_sub) == len(eqn.outvars)
+                            else [all(ins)] * len(eqn.outvars))
+                else:
+                    outs = [all(ins)] * len(eqn.outvars)
+            else:
+                outs = [all(ins)] * len(eqn.outvars)
+        for var, ag in zip(eqn.outvars, outs):
+            env[id(var)] = ag
+
+    def _eval_cond(self, eqn, ins: List[bool]) -> List[bool]:
+        branches = eqn.params["branches"]
+        pred_agreed = ins[0]
+        op_agreed = ins[1:]
+        seqs = []
+        branch_outs = []
+        for br in branches:
+            sub = br.jaxpr if hasattr(br, "jaxpr") else br
+            seqs.append(_collective_seq(sub))
+            branch_outs.append(self.eval_jaxpr(sub, list(op_agreed)))
+        if any(seqs) and not pred_agreed:
+            uniq = sorted(set(seqs))
+            self._finding(
+                "LUX-J301",
+                "lax.cond arms contain collectives "
+                f"({' / '.join(','.join(s) or '-' for s in uniq)}) but the "
+                "predicate is not provably mesh-agreed (derive it from a "
+                "psum/pmin/pmax so every participant takes the same arm)")
+        n_out = len(eqn.outvars)
+        outs = []
+        for i in range(n_out):
+            outs.append(pred_agreed and all(
+                bo[i] if i < len(bo) else False for bo in branch_outs))
+        return outs
+
+    def _eval_while(self, eqn, ins: List[bool]) -> List[bool]:
+        cond_j = eqn.params["cond_jaxpr"]
+        body_j = eqn.params["body_jaxpr"]
+        cn = eqn.params.get("cond_nconsts", 0)
+        bn = eqn.params.get("body_nconsts", 0)
+        cond_sub = cond_j.jaxpr if hasattr(cond_j, "jaxpr") else cond_j
+        body_sub = body_j.jaxpr if hasattr(body_j, "jaxpr") else body_j
+        cond_consts = ins[:cn]
+        body_consts = ins[cn:cn + bn]
+        init = ins[cn + bn:]
+        # greatest fixpoint over the carry: a slot is agreed only when
+        # its init AND every body output for it stay agreed
+        carry = list(init)
+        for _ in range(len(carry) + 1):
+            body_out = self.eval_jaxpr(body_sub, body_consts + carry)
+            new = [c and o for c, o in zip(carry, body_out)]
+            if new == carry:
+                break
+            carry = new
+        # collectives in the COND jaxpr count too: a device that exits
+        # while stragglers re-enter the cond's psum deadlocks the same
+        # way a body collective does
+        seq = _collective_seq(body_sub) + _collective_seq(cond_sub)
+        if seq:
+            pred = self.eval_jaxpr(cond_sub, cond_consts + carry)
+            if not all(pred):
+                self._finding(
+                    "LUX-J302",
+                    "lax.while_loop contains collectives "
+                    f"({','.join(seq)}) but the stop predicate is not "
+                    "provably mesh-agreed (psum the active count so "
+                    "every participant agrees on the trip count)")
+        return carry
+
+    def _eval_scan(self, eqn, ins: List[bool]) -> List[bool]:
+        sub_j = eqn.params["jaxpr"]
+        sub = sub_j.jaxpr if hasattr(sub_j, "jaxpr") else sub_j
+        nc = eqn.params.get("num_consts", 0)
+        ncar = eqn.params.get("num_carry", 0)
+        consts = ins[:nc]
+        carry = list(ins[nc:nc + ncar])
+        xs = ins[nc + ncar:]
+        for _ in range(ncar + 1):
+            out = self.eval_jaxpr(sub, consts + carry + xs)
+            new = [c and o for c, o in zip(carry, out[:ncar])]
+            if new == carry:
+                break
+            carry = new
+        out = self.eval_jaxpr(sub, consts + carry + xs)
+        ys = out[ncar:]
+        n_out = len(eqn.outvars)
+        outs = (carry + ys)[:n_out]
+        return outs + [False] * (n_out - len(outs))
+
+
+def check_shard_map_bodies(jaxpr, path: str, label: str,
+                           line: int = 1) -> List[Finding]:
+    """Walk ``jaxpr`` (a traced entry point), analyze every shard_map
+    body found, and return the LUX-J3 findings.  Also usable on jaxprs
+    with no shard_map at all (single-device entry points audit clean by
+    construction — there is no mesh to deadlock)."""
+    findings: List[Finding] = []
+    for eqn in aot.iter_eqns(jaxpr):
+        if str(eqn.primitive) != "shard_map":
+            continue
+        in_names = eqn.params.get("in_names", ())
+        body = eqn.params["jaxpr"]
+        body = body.jaxpr if hasattr(body, "jaxpr") else body
+        agreed = [not names for names in in_names]
+        if len(agreed) != len(body.invars):
+            # unexpected param shape (jax version drift): treat every
+            # input as non-agreed — conservative, never hides a finding
+            agreed = [False] * len(body.invars)
+        ba = _BodyAnalysis(path, line, label)
+        ba.eval_jaxpr(body, agreed)
+        # the while/scan carry fixpoint re-evaluates bodies, so a broken
+        # nested cond is re-found once per fixpoint round — report each
+        # distinct finding once
+        seen = set()
+        for f in ba.findings:
+            key = (f.code, f.message, f.text)
+            if key not in seen:
+                seen.add(key)
+                findings.append(f)
+    return findings
+
+
+def collective_sequence(jaxpr) -> Tuple[str, ...]:
+    """The linearized mesh-collective sequence of a traced entry point
+    (shard_map bodies included) — the audit report records it so a
+    reordering between rounds is visible in the AUDIT json diff."""
+    return _collective_seq(jaxpr)

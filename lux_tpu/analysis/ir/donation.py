@@ -1,0 +1,86 @@
+"""LUX-J2: donated buffers must actually alias in the lowered module.
+
+``donate_argnums`` is a REQUEST: XLA matches each donated input against
+an output of identical shape/dtype/layout and silently drops the ones it
+cannot place (jax raises a warning at execution, not an error — and a
+warning scrolled past in a chip-day log is how "single state copy in the
+hot loop" becomes two copies for a whole window).  PR 4's pull-side
+donation and this PR's push/serve twins are CLAIMS about HBM residency;
+this checker turns them into a lowered-module property: every leaf of a
+``donate``d argument must carry ``tf.aliasing_output`` in the StableHLO
+@main signature (the MLIR spelling of input_output_aliases).
+
+One documented exemption: a donated leaf the lowering PRUNED as unused
+(jax DCE — e.g. the single push step never reads ``carry.active``, the
+while-loop twin's cond does) holds no runtime buffer, so there is
+nothing to alias and nothing resident to free; the kept-vs-pruned split
+is read from the lowering's ``kept_var_idx``.  On a jax that stops
+exposing it, attribution degrades to a total-count comparison (AOT
+caveat in docs/ANALYSIS.md).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from lux_tpu.analysis.core import Finding
+from lux_tpu.analysis.ir import aot
+
+
+def _kept_var_idx(lowered) -> Optional[Sequence[int]]:
+    """Flat input-leaf indices that survived DCE into the lowered main,
+    in argument order — private jax API, guarded (see module docstring)."""
+    try:
+        kept = lowered._lowering.compile_args["kept_var_idx"]
+    except (AttributeError, KeyError, TypeError):  # pragma: no cover
+        return None
+    return sorted(kept)
+
+
+def check_donation(traced, args: Sequence, donate_argnums: Sequence[int],
+                   path: str, label: str, line: int = 1) -> List[Finding]:
+    """Lower ``traced`` and assert every kept leaf of each donated
+    argument is aliased to an output.
+
+    ``args``: the dynamic (non-static) positional arguments, in call
+    order — their tree_flatten spans map donated pytree leaves onto
+    @main argument positions.  ``donate_argnums`` indexes into ``args``.
+    """
+    findings: List[Finding] = []
+    lowered = traced.lower()
+    text = lowered.as_text()
+    aliased, total = aot.aliased_arg_indices(text)
+    spans = aot.leaf_spans(args)
+    n_leaves = spans[-1][1] if spans else 0
+    donated = []
+    for i in donate_argnums:
+        lo, hi = spans[i]
+        donated.extend(range(lo, hi))
+    kept = _kept_var_idx(lowered)
+    if kept is None and total == n_leaves:
+        kept = list(range(n_leaves))  # nothing pruned: identity map
+    if kept is None or len(kept) != total:
+        # attribution unavailable (jax internals drifted): a dropped
+        # donation must still fail, just without naming the leaf
+        if len(aliased) < len(donated):
+            findings.append(Finding(
+                path=path, line=line, col=0, code="LUX-J201",
+                message=f"only {len(aliased)} of {len(donated)} donated "
+                        "leaves carry an input_output_alias in the "
+                        "lowered module (XLA dropped donations; leaf "
+                        "attribution unavailable on this jax — "
+                        f"@main has {total} args vs {n_leaves} leaves)",
+                text=label))
+        return findings
+    pos_of = {leaf: arg_pos for arg_pos, leaf in enumerate(kept)}
+    missing = [leaf for leaf in donated
+               if leaf in pos_of and pos_of[leaf] not in aliased]
+    if missing:
+        findings.append(Finding(
+            path=path, line=line, col=0, code="LUX-J201",
+            message=f"donated flat leaves {missing} carry no "
+                    "tf.aliasing_output in the lowered module — XLA "
+                    "dropped the donation (no matching output "
+                    "shape/dtype), so the hot loop holds an extra full "
+                    "state copy",
+            text=label))
+    return findings

@@ -1,0 +1,101 @@
+"""LUX-J5: HBM-pass accounting must match the kernels actually traced.
+
+``roofline.routed_hbm_passes`` is PR 4's headline metric — every routed
+bench row carries it, and the pass-fusion bet is scored by it (expand
+17.0 -> 9.0 sweeps at rmat20-class k=4).  The number is DERIVED from the
+plan static, not measured; if the replay grows an extra kernel (a pf
+group that silently fails to fuse, a new out-of-band XLA pass, an ff
+level that falls off the Pallas path) the published metric drifts from
+the machine's real traffic with no test noticing.
+
+Two cross-checks pin it:
+
+* LUX-J501 — the ``pallas_call`` equation count of the traced replay
+  must equal the static-derived kernel count
+  (r1 + fill-forward levels + r2 [+ vr] via route_num_hbm_passes);
+* LUX-J502 — the roofline dict's per-stage fields must agree with those
+  same kernel counts after un-scaling the space factors it applies
+  (fused r2 is scaled by n2/n, vr by nv_route/n; ff is a fractional
+  BYTES model, not a kernel count, and is excluded).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from lux_tpu.analysis.core import Finding
+from lux_tpu.analysis.ir import aot
+
+
+def expected_kernels(static) -> int:
+    """Kernel launches of one replay of ``static`` (expand or fused):
+    one per unfused route pass / fused route group, one per
+    fill-forward level."""
+    from lux_tpu.ops import expand as E
+    from lux_tpu.ops import pallas_shuffle as shuf
+
+    if isinstance(static, E.CFRouteStatic):
+        return expected_kernels(static.src) + expected_kernels(static.dst)
+    n = (shuf.route_num_hbm_passes(static.r1) + len(static.ff.levels)
+         + shuf.route_num_hbm_passes(static.r2))
+    if hasattr(static, "vr"):
+        n += shuf.route_num_hbm_passes(static.vr)
+    return n
+
+
+def claimed_kernels(static, claimed: dict) -> Optional[float]:
+    """Reconstruct the kernel count a routed_hbm_passes dict CLAIMS, by
+    un-scaling the space factors the model applies (fused r2 runs over
+    n2, vr over nv_route; unfused fields are already kernel counts).
+    None when the dict is missing stage fields (malformed claim)."""
+    try:
+        r1 = float(claimed["r1"])
+        r2 = float(claimed["r2"])
+    except (KeyError, TypeError):
+        return None
+    if hasattr(static, "n2"):  # FusedStatic: un-scale the space factors
+        r2 = r2 * static.n / static.n2
+        try:
+            vr = float(claimed["vr"]) * static.n / static.nv_route
+        except (KeyError, TypeError):
+            return None
+        return r1 + r2 + vr
+    return r1 + r2
+
+
+def check_hbm(traced, static, path: str, label: str, line: int = 1,
+              claimed: Optional[dict] = None,
+              method: str = "scan") -> List[Finding]:
+    """Audit one routed replay: ``traced`` is the jit-traced replay of
+    ``static`` (apply_expand / apply_fused / a routed engine iteration);
+    ``claimed`` defaults to the live roofline model's output for it."""
+    from lux_tpu.utils import roofline
+
+    findings: List[Finding] = []
+    observed = aot.count_primitive(aot.traced_jaxpr(traced), "pallas_call")
+    expect = expected_kernels(static)
+    if observed != expect:
+        findings.append(Finding(
+            path=path, line=line, col=0, code="LUX-J501",
+            message=f"traced replay launches {observed} pallas_call "
+                    f"kernel(s) but the plan static derives {expect} "
+                    "(route passes/groups + ff levels) — a pass fell off "
+                    "the Pallas path or a group failed to fuse; the "
+                    "hbm_passes metric no longer describes the kernels",
+            text=label))
+    if not hasattr(static, "r1"):
+        # CFRouteStatic: no single roofline claim to cross-check — the
+        # src/dst halves are audited as their own expand replays
+        return findings
+    if claimed is None:
+        claimed = roofline.routed_hbm_passes(static, method=method)
+    want = claimed_kernels(static, claimed)
+    route_expect = expect - len(static.ff.levels)
+    if want is None or abs(want - route_expect) > 0.51:
+        findings.append(Finding(
+            path=path, line=line, col=0, code="LUX-J502",
+            message=f"roofline hbm_passes claims {want} route kernels "
+                    f"(un-scaled r1/r2[/vr]) but the plan static carries "
+                    f"{route_expect} — the published headline metric has "
+                    "drifted from the real kernels",
+            text=label))
+    return findings

@@ -1,0 +1,128 @@
+"""LUX-J1: retrace stability — one trace per engine family config.
+
+"Single trace, no recompiles in the window" is load-bearing perf prose
+in five rounds of PERF.md; what actually enforces it is (a) every jit
+static being hashable with a stable hash, (b) program STRUCTURE not
+depending on the family's config axis (iteration count, Q bucket) —
+a Python-level unroll over the config turns one compile into one per
+value — and (c) genuinely-dynamic knobs (the push engine's ``it_stop``,
+the serve loops' ``max_iters``) actually hitting the compile cache
+instead of re-specializing.  Each sub-check maps to a finding code:
+
+* LUX-J101 — structural drift: two configs of one family trace to
+  different primitive sequences (config-dependent unrolling, an op set
+  that changes with Q, a shape leak into control flow);
+* LUX-J102 — a jit static that is unhashable or hash-unstable (the
+  compile cache can never hit; every call retraces);
+* LUX-J103 — a dynamic-argument re-call grew the jit compile cache
+  (the "one compile serves every run length" contract broken).
+
+The cache-size probe uses the private ``_cache_size`` accessor; on a
+jax without it the J103 check degrades to skipped (documented AOT
+caveat) rather than guessing.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from lux_tpu.analysis.core import Finding
+from lux_tpu.analysis.ir import aot
+
+
+def check_statics(statics: Sequence, path: str, label: str,
+                  line: int = 1) -> List[Finding]:
+    findings: List[Finding] = []
+    for s in statics:
+        err = aot.hashable(s)
+        if err is not None:
+            findings.append(Finding(
+                path=path, line=line, col=0, code="LUX-J102",
+                message=f"jit static {type(s).__name__!r} is not usable as "
+                        f"a compile-cache key: {err}",
+                text=label))
+    return findings
+
+
+def check_variants(traced_variants: Sequence, path: str, label: str,
+                   line: int = 1, strict: bool = True) -> List[Finding]:
+    """All configs of one family must share one program structure.
+
+    ``strict=True`` (configs with IDENTICAL avals, e.g. iteration
+    counts): the full primitive sequence must match.  ``strict=False``
+    (configs that change shapes, e.g. Q buckets): only the structural
+    multiset (aot.STRUCTURAL_PRIMS — control flow, kernels, gathers,
+    collectives) must match; degenerate-broadcast idiom differences at
+    Q=1 are not drift, an extra loop or kernel per config is."""
+    findings: List[Finding] = []
+    sig = (aot.primitive_sequence if strict
+           else aot.structural_signature)
+    seqs = [sig(aot.traced_jaxpr(t)) for t in traced_variants]
+    base = seqs[0]
+    for i, s in enumerate(seqs[1:], start=1):
+        if s != base:
+            if strict:
+                # name the first structural divergence, not 500 prims
+                k = next((j for j in range(min(len(base), len(s)))
+                          if base[j] != s[j]), min(len(base), len(s)))
+                a = base[k] if k < len(base) else "<end>"
+                b = s[k] if k < len(s) else "<end>"
+                detail = (f"{len(base)} vs {len(s)} equations; first "
+                          f"divergence at eqn {k}: {a} vs {b}")
+            else:
+                da = dict(base)
+                db = dict(s)
+                diff = {k for k in set(da) | set(db)
+                        if da.get(k, 0) != db.get(k, 0)}
+                detail = "structural counts differ: " + ", ".join(
+                    f"{k} {da.get(k, 0)}->{db.get(k, 0)}"
+                    for k in sorted(diff))
+            findings.append(Finding(
+                path=path, line=line, col=0, code="LUX-J101",
+                message=f"config variant {i} traces to a different program "
+                        f"structure ({detail}) — the family would retrace "
+                        "per config value in-window",
+                text=label))
+    return findings
+
+
+def check_dynamic_recall(fn, call_a: Callable[[], object],
+                         call_b: Callable[[], object], path: str,
+                         label: str, line: int = 1) -> List[Finding]:
+    """Execute ``call_a`` then ``call_b`` (same shapes, different values
+    of a dynamic knob) and assert the jit cache did not grow on the
+    second call.  ``fn`` is the jitted callable owning the cache."""
+    size = getattr(fn, "_cache_size", None)
+    if size is None:  # pragma: no cover - jax version drift
+        return []
+    call_a()
+    n1 = size()
+    call_b()
+    n2 = size()
+    if n2 > n1:
+        return [Finding(
+            path=path, line=line, col=0, code="LUX-J103",
+            message=f"a dynamic-argument re-call recompiled (jit cache "
+                    f"{n1} -> {n2} entries) — the knob is specializing "
+                    "the trace; one compile must serve every value",
+            text=label)]
+    return []
+
+
+def trace_twice_stable(make_traced: Callable[[], object], path: str,
+                       label: str, line: int = 1,
+                       statics: Optional[Sequence] = None) -> List[Finding]:
+    """Convenience: hash-check statics and assert two traces of the SAME
+    config agree structurally (an unstable trace — e.g. an RNG or a set
+    iteration inside the traced function — shows up here)."""
+    findings = list(check_statics(statics or (), path, label, line))
+    t1, t2 = make_traced(), make_traced()
+    s1 = aot.primitive_sequence(aot.traced_jaxpr(t1))
+    s2 = aot.primitive_sequence(aot.traced_jaxpr(t2))
+    if s1 != s2:
+        findings.append(Finding(
+            path=path, line=line, col=0, code="LUX-J101",
+            message="two traces of the SAME config disagree structurally "
+                    "— the trace is nondeterministic (host RNG / set "
+                    "iteration inside the traced function)",
+            text=label))
+    return findings

@@ -1,0 +1,500 @@
+"""The audited entry points: what ``luxaudit --all`` actually traces.
+
+One small fixture graph (rmat scale 8, 2 parts — the shapes are
+irrelevant to every property audited: donation aliasing, program
+structure, collective agreement, kernel counts, and pf tile geometry are
+all SIZE-INDEPENDENT claims about the engine code) is pushed through the
+REAL engine entry points — the same jit-wrapped functions the drivers
+call, not reimplementations — and each checker family runs on the
+resulting jaxpr / StableHLO.
+
+``--fast`` covers pull + push + one pass-fused config (the ci_check
+tier); ``--all`` adds the serve batched steps, the distributed push
+engines (allgather + ring, on a host-device mesh), the fused-pf plan,
+and the dynamic-knob recompile probes (chip-day step -3b).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Callable, List
+
+from lux_tpu.analysis.core import Finding
+from lux_tpu.analysis.ir import donation, hbm, retrace, vmem
+from lux_tpu.analysis.ir.collectives import check_shard_map_bodies
+
+
+@dataclasses.dataclass
+class AuditUnit:
+    """One audited (entry point, checker family) pair."""
+
+    family: str  # "retrace" | "donation" | "collective" | "vmem" | "hbm"
+    label: str   # stable config descriptor (the finding fingerprint text)
+    path: str    # repo-relative module the finding points at
+    fast: bool   # included in the --fast tier
+    run: Callable[[], List[Finding]]
+
+
+def _active_fn(old, new):
+    """Top-level (hashable) convergence probe for the pull-until audit
+    — the shape run_pull_until's contract requires of callers."""
+    import jax.numpy as jnp
+
+    return jnp.sum(
+        jnp.abs(new - old) > 1e-7,
+        axis=tuple(range(1, old.ndim)),
+    ).astype(jnp.int32)
+
+
+@lru_cache(maxsize=1)
+def fixture():
+    """The shared audit fixture: graph, shard layouts, programs, plans,
+    device-placed trees.  Built once per process (plan construction and
+    device placement dominate the audit's cost)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.models.sssp import SSSPProgram
+    from lux_tpu.ops import expand
+
+    g = generate.rmat(8, 8, seed=7)
+    shards = build_pull_shards(g, 2)
+    pshards = build_push_shards(g, 2)
+    prank = PageRankProgram(nv=shards.spec.nv)
+    psssp = SSSPProgram(nv=g.nv, start=0)
+    arrays = jax.tree.map(jnp.asarray, shards.arrays)
+    state0 = pull.init_state(prank, arrays)
+    plan = expand.plan_expand_shards(shards)
+    plan_pf = expand.to_pf(plan)
+    return {
+        "graph": g,
+        "shards": shards,
+        "pshards": pshards,
+        "prank": prank,
+        "psssp": psssp,
+        "arrays": arrays,
+        "state0": state0,
+        "plan": plan,
+        "plan_pf": plan_pf,
+    }
+
+
+@lru_cache(maxsize=1)
+def _fused_pf_plan():
+    from lux_tpu.ops import expand
+
+    return expand.plan_fused_shards(fixture()["shards"], reduce="sum",
+                                    pf=True)
+
+
+def _dev_route(plan):
+    import jax
+    import jax.numpy as jnp
+
+    rs, ra = plan
+    return rs, jax.tree.map(jnp.asarray, ra)
+
+
+# ---------------------------------------------------------------------------
+# retrace (LUX-J1)
+# ---------------------------------------------------------------------------
+
+
+def _pull_fixed_traced(num_iters: int, route=None):
+    from lux_tpu.engine import pull
+
+    fx = fixture()
+    rs, ra = _dev_route(route) if route is not None else (None, None)
+    return pull._pull_fixed_jit.trace(
+        fx["prank"], fx["shards"].spec, num_iters, "scan", fx["arrays"],
+        fx["state0"], route_static=rs, route_arrays=ra, interpret=True)
+
+
+def _retrace_pull_fixed(routed: bool) -> List[Finding]:
+    fx = fixture()
+    route = fx["plan_pf"] if routed else None
+    label = "pull-fixed/" + ("routed-pf" if routed else "direct")
+    path = "lux_tpu/engine/pull.py"
+    statics = (fx["prank"], fx["shards"].spec, "scan",
+               route[0] if routed else None)
+    out = retrace.trace_twice_stable(
+        lambda: _pull_fixed_traced(2, route), path, label, statics=statics)
+    out += retrace.check_variants(
+        [_pull_fixed_traced(2, route), _pull_fixed_traced(3, route)],
+        path, label)
+    return out
+
+
+def _retrace_pull_until() -> List[Finding]:
+    from lux_tpu.engine import pull
+
+    fx = fixture()
+    path = "lux_tpu/engine/pull.py"
+
+    def tr(max_iters):
+        return pull._pull_until_jit.trace(
+            fx["prank"], fx["shards"].spec, max_iters, _active_fn, "scan",
+            fx["arrays"], fx["state0"], route_static=None,
+            route_arrays=None, interpret=True)
+
+    out = retrace.trace_twice_stable(
+        lambda: tr(2), path, "pull-until/direct",
+        statics=(fx["prank"], fx["shards"].spec, _active_fn, "scan"))
+    out += retrace.check_variants([tr(2), tr(3)], path,
+                                  "pull-until/direct")
+    return out
+
+
+def _retrace_push_chunk() -> List[Finding]:
+    """The push loop's 'one compile serves every run length' contract:
+    it_stop is DYNAMIC — a re-call with a different stop must hit the
+    compile cache, not re-specialize."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import push
+
+    fx = fixture()
+    sh = fx["pshards"]
+    loop = push.compile_push_chunk(fx["psssp"], sh.pspec, sh.spec, "scan")
+    arrays, parrays, carry0 = push.push_init(fx["psssp"], sh)
+
+    def call(stop):
+        def go():
+            out = loop(arrays, parrays, carry0, jnp.int32(stop))
+            jax.block_until_ready(out.state)
+            return out
+
+        return go
+
+    out = retrace.check_statics(
+        (fx["psssp"], sh.pspec, sh.spec, "scan"),
+        "lux_tpu/engine/push.py", "push-chunk")
+    out += retrace.check_dynamic_recall(
+        loop, call(2), call(3), "lux_tpu/engine/push.py",
+        "push-chunk/it_stop")
+    return out
+
+
+def _serve_traced(app: str, q: int):
+    import jax.numpy as jnp
+
+    from lux_tpu.serve import batched
+
+    fx = fixture()
+    spec = fx["shards"].spec
+    prog = batched.make_program(app, spec.nv)
+    if prog.fixpoint:
+        run = batched._compile_batched_fixpoint(prog, spec, "scan")
+    else:
+        run = batched._compile_batched_fixed(prog, spec, "scan")
+    init = batched._compile_batched_init(prog)
+    queries = jnp.zeros((q,), jnp.int32)
+    s0 = init(fx["arrays"], queries)
+    return run, (fx["arrays"], queries, s0, jnp.int32(4))
+
+
+def _retrace_serve(app: str) -> List[Finding]:
+    """Q-bucket structural identity: the batched loop's program may
+    differ across buckets ONLY in the Q axis — a Q-dependent op set or
+    unroll would multiply the warm cache's compile bill."""
+    path = "lux_tpu/serve/batched.py"
+    run1, args1 = _serve_traced(app, 1)
+    run4, args4 = _serve_traced(app, 4)
+    # Q changes the trailing-axis SHAPES, so the comparison is the
+    # coarse structural one: broadcasting idioms may differ at Q=1,
+    # loops/gathers/kernels may not
+    out = retrace.check_variants(
+        [run1.trace(*args1), run4.trace(*args4)], path,
+        f"serve-{app}/Q-buckets", strict=False)
+    return out
+
+
+def _retrace_serve_dynamic() -> List[Finding]:
+    """max_iters is a dynamic operand of the serve loops: re-calls with
+    a different stop must not recompile (the scheduler varies it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.serve import batched
+
+    fx = fixture()
+    run, args = _serve_traced("sssp", 1)
+    arrays, queries, _, _ = args
+    prog = batched.make_program("sssp", fx["shards"].spec.nv)
+    ini = batched._compile_batched_init(prog)
+
+    def call(stop):
+        # the state is donated per call: rebuild it for each probe
+        def go():
+            out = run(arrays, queries, ini(arrays, queries),
+                      jnp.int32(stop))
+            jax.block_until_ready(out[0])
+            return out
+
+        return go
+
+    return retrace.check_dynamic_recall(
+        run, call(2), call(3), "lux_tpu/serve/batched.py",
+        "serve-sssp/max_iters")
+
+
+# ---------------------------------------------------------------------------
+# donation (LUX-J2)
+# ---------------------------------------------------------------------------
+
+
+def _donation_pull_fixed() -> List[Finding]:
+    from lux_tpu.engine import pull
+
+    fx = fixture()
+    args = (fx["arrays"], fx["state0"])
+    traced = pull._pull_fixed_jit_donate.trace(
+        fx["prank"], fx["shards"].spec, 3, "scan", *args,
+        route_static=None, route_arrays=None, interpret=True)
+    return donation.check_donation(
+        traced, args, donate_argnums=(1,), path="lux_tpu/engine/pull.py",
+        label="pull-fixed/donate")
+
+
+def _donation_pull_until() -> List[Finding]:
+    from lux_tpu.engine import pull
+
+    fx = fixture()
+    args = (fx["arrays"], fx["state0"])
+    traced = pull._pull_until_jit_donate.trace(
+        fx["prank"], fx["shards"].spec, 4, _active_fn, "scan", *args,
+        route_static=None, route_arrays=None, interpret=True)
+    return donation.check_donation(
+        traced, args, donate_argnums=(1,), path="lux_tpu/engine/pull.py",
+        label="pull-until/donate")
+
+
+def _donation_push_chunk() -> List[Finding]:
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import push
+
+    fx = fixture()
+    sh = fx["pshards"]
+    loop = push.compile_push_chunk(fx["psssp"], sh.pspec, sh.spec, "scan",
+                                   donate=True)
+    arrays, parrays, carry0 = push.push_init(fx["psssp"], sh)
+    args = (arrays, parrays, carry0, jnp.int32(4))
+    traced = loop.trace(*args)
+    return donation.check_donation(
+        traced, args, donate_argnums=(2,), path="lux_tpu/engine/push.py",
+        label="push-chunk/donate")
+
+
+def _donation_push_step() -> List[Finding]:
+    from lux_tpu.engine import push
+
+    fx = fixture()
+    sh = fx["pshards"]
+    step = push.compile_push_step(fx["psssp"], sh.pspec, sh.spec, "scan")
+    arrays, parrays, carry0 = push.push_init(fx["psssp"], sh)
+    args = (arrays, parrays, carry0)
+    traced = step.trace(*args)
+    return donation.check_donation(
+        traced, args, donate_argnums=(2,), path="lux_tpu/engine/push.py",
+        label="push-step/donate")
+
+
+def _donation_serve(app: str) -> List[Finding]:
+    run, args = _serve_traced(app, 4)
+    traced = run.trace(*args)
+    return donation.check_donation(
+        traced, args, donate_argnums=(2,),
+        path="lux_tpu/serve/batched.py", label=f"serve-{app}/donate")
+
+
+# ---------------------------------------------------------------------------
+# collective order (LUX-J3)
+# ---------------------------------------------------------------------------
+
+
+def _mesh(n: int):
+    from lux_tpu.parallel.mesh import make_mesh_for_parts
+
+    return make_mesh_for_parts(n)
+
+
+def _collective_push_dist() -> List[Finding]:
+    import jax.numpy as jnp
+
+    from lux_tpu.analysis.ir import aot
+    from lux_tpu.engine import push
+
+    fx = fixture()
+    sh = fx["pshards"]
+    mesh = _mesh(2)
+    run = push._compile_push_dist(fx["psssp"], mesh, sh.pspec, sh.spec,
+                                  "scan")
+    arrays, parrays, carry0 = push.push_init(fx["psssp"], sh)
+    traced = run.trace(arrays, parrays, carry0, jnp.int32(4))
+    return check_shard_map_bodies(
+        aot.traced_jaxpr(traced), "lux_tpu/engine/push.py",
+        "push-dist/allgather")
+
+
+def _collective_push_ring() -> List[Finding]:
+    import jax.numpy as jnp
+
+    from lux_tpu.analysis.ir import aot
+    from lux_tpu.engine import push
+    from lux_tpu.parallel.ring import build_push_ring_shards
+
+    fx = fixture()
+    mesh = _mesh(2)
+    rsh = build_push_ring_shards(fx["graph"], 2)
+    run = push._compile_push_ring(fx["psssp"], mesh, rsh.pspec, rsh.spec,
+                                  rsh.e_bucket_pad, "scan")
+    rarrays, parrays, view, carry0 = push.ring_init_dist(
+        fx["psssp"], rsh, mesh)
+    traced = run.trace(rarrays, parrays, view, carry0, jnp.int32(4))
+    return check_shard_map_bodies(
+        aot.traced_jaxpr(traced), "lux_tpu/engine/push.py",
+        "push-ring/ppermute")
+
+
+def _collective_pull_dist() -> List[Finding]:
+    from lux_tpu.analysis.ir import aot
+    from lux_tpu.parallel import dist
+    from lux_tpu.parallel.mesh import shard_stacked
+
+    fx = fixture()
+    mesh = _mesh(2)
+    run = dist._compile_fixed(fx["prank"], mesh, 3, "scan")
+    arrays = shard_stacked(mesh, fx["arrays"])
+    state0 = shard_stacked(mesh, fx["state0"])
+    traced = run.trace(arrays, state0)
+    return check_shard_map_bodies(
+        aot.traced_jaxpr(traced), "lux_tpu/parallel/dist.py",
+        "pull-dist/allgather")
+
+
+# ---------------------------------------------------------------------------
+# VMEM budget (LUX-J4) + HBM passes (LUX-J5)
+# ---------------------------------------------------------------------------
+
+
+def _vmem_expand_pf() -> List[Finding]:
+    fx = fixture()
+    rs, ra = fx["plan_pf"]
+    return vmem.check_vmem(rs, ra, "lux_tpu/ops/pallas_shuffle.py",
+                           "expand-pf")
+
+
+def _vmem_fused_pf() -> List[Finding]:
+    rs, ra = _fused_pf_plan()
+    return vmem.check_vmem(rs, ra, "lux_tpu/ops/pallas_shuffle.py",
+                           "fused-pf")
+
+
+def _expand_traced(plan):
+    import jax
+
+    from lux_tpu.ops import expand
+
+    fx = fixture()
+    rs, ra = _dev_route(plan)
+    part = jax.tree.map(lambda a: a[0], ra)
+    full = fx["state0"].reshape(-1)
+
+    def replay(x, arrs):
+        return expand.apply_expand(x, rs, arrs, interpret=True)
+
+    return jax.jit(replay).trace(full, part), rs
+
+
+def _hbm_expand(routed_pf: bool) -> List[Finding]:
+    fx = fixture()
+    plan = fx["plan_pf"] if routed_pf else fx["plan"]
+    traced, rs = _expand_traced(plan)
+    label = "expand-pf" if routed_pf else "expand"
+    return hbm.check_hbm(traced, rs, "lux_tpu/ops/expand.py", label)
+
+
+def _hbm_fused_pf() -> List[Finding]:
+    import jax
+
+    from lux_tpu.ops import expand
+
+    fx = fixture()
+    rs, ra = _dev_route(_fused_pf_plan())
+    part = jax.tree.map(lambda a: a[0], ra)
+    full = fx["state0"].reshape(-1)
+
+    def replay(x, arrs):
+        return expand.apply_fused(x, rs, arrs, interpret=True)
+
+    traced = jax.jit(replay).trace(full, part)
+    return hbm.check_hbm(traced, rs, "lux_tpu/ops/expand.py", "fused-pf")
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+
+def audit_units(fast: bool = False) -> List[AuditUnit]:
+    units = [
+        AuditUnit("retrace", "pull-fixed/direct",
+                  "lux_tpu/engine/pull.py", True,
+                  lambda: _retrace_pull_fixed(False)),
+        AuditUnit("retrace", "pull-fixed/routed-pf",
+                  "lux_tpu/engine/pull.py", True,
+                  lambda: _retrace_pull_fixed(True)),
+        AuditUnit("retrace", "pull-until/direct",
+                  "lux_tpu/engine/pull.py", False, _retrace_pull_until),
+        AuditUnit("retrace", "push-chunk/it_stop",
+                  "lux_tpu/engine/push.py", True, _retrace_push_chunk),
+        AuditUnit("retrace", "serve-sssp/Q-buckets",
+                  "lux_tpu/serve/batched.py", False,
+                  lambda: _retrace_serve("sssp")),
+        AuditUnit("retrace", "serve-ppr/Q-buckets",
+                  "lux_tpu/serve/batched.py", False,
+                  lambda: _retrace_serve("ppr")),
+        AuditUnit("retrace", "serve-sssp/max_iters",
+                  "lux_tpu/serve/batched.py", False,
+                  _retrace_serve_dynamic),
+        AuditUnit("donation", "pull-fixed/donate",
+                  "lux_tpu/engine/pull.py", True, _donation_pull_fixed),
+        AuditUnit("donation", "pull-until/donate",
+                  "lux_tpu/engine/pull.py", False, _donation_pull_until),
+        AuditUnit("donation", "push-chunk/donate",
+                  "lux_tpu/engine/push.py", True, _donation_push_chunk),
+        AuditUnit("donation", "push-step/donate",
+                  "lux_tpu/engine/push.py", False, _donation_push_step),
+        AuditUnit("donation", "serve-sssp/donate",
+                  "lux_tpu/serve/batched.py", False,
+                  lambda: _donation_serve("sssp")),
+        AuditUnit("donation", "serve-ppr/donate",
+                  "lux_tpu/serve/batched.py", False,
+                  lambda: _donation_serve("ppr")),
+        AuditUnit("collective", "push-dist/allgather",
+                  "lux_tpu/engine/push.py", False, _collective_push_dist),
+        AuditUnit("collective", "push-ring/ppermute",
+                  "lux_tpu/engine/push.py", False, _collective_push_ring),
+        AuditUnit("collective", "pull-dist/allgather",
+                  "lux_tpu/parallel/dist.py", False, _collective_pull_dist),
+        AuditUnit("vmem", "expand-pf", "lux_tpu/ops/pallas_shuffle.py",
+                  True, _vmem_expand_pf),
+        AuditUnit("vmem", "fused-pf", "lux_tpu/ops/pallas_shuffle.py",
+                  False, _vmem_fused_pf),
+        AuditUnit("hbm", "expand", "lux_tpu/ops/expand.py", False,
+                  lambda: _hbm_expand(False)),
+        AuditUnit("hbm", "expand-pf", "lux_tpu/ops/expand.py", True,
+                  lambda: _hbm_expand(True)),
+        AuditUnit("hbm", "fused-pf", "lux_tpu/ops/expand.py", False,
+                  _hbm_fused_pf),
+    ]
+    if fast:
+        units = [u for u in units if u.fast]
+    return units

@@ -1,0 +1,118 @@
+"""LUX-J4: pass-fused group VMEM residency, recomputed at audit time.
+
+``_pf_block_rows`` (ops/pallas_shuffle) sizes each fused pass group's
+tile under LUX_PF_VMEM_MB at PLAN time.  A frozen plan then outlives the
+knobs: it replays from cache in later processes, after planner edits,
+under different env settings.  A planner bug (or a hand-built
+StaticRoutePF) whose tiles exceed the budget fails as a Mosaic VMEM
+blow-up ON CHIP — interpret-mode CPU tests can never catch it, which is
+why this is an audit property, not a unit test.
+
+The recomputation mirrors the Pallas pipeline's actual residency: the
+grid double-buffers every BlockSpec'd operand, so one group holds
+
+    2 * block_rows * 128 * (data_in + data_out + sum(idx itemsize))
+
+bytes of VMEM, with the index itemsize read from the REAL plan arrays
+(u8 after _narrow_idx, i32 otherwise) — tighter than the planner's
+conservative int32 estimate, so a plan the planner accepted always
+passes, and an over-budget group is a genuine LUX-J401 finding.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from lux_tpu.analysis.core import Finding
+
+#: lane width (ops/pallas_shuffle.LANE) — kept as a literal so this
+#: module stays importable without the kernel stack
+LANE = 128
+#: f32 data tile, in + out
+_DATA_BYTES = 8
+
+
+def _budget_bytes() -> int:
+    from lux_tpu.ops.pallas_shuffle import _pf_defaults
+
+    _, _, vmem_mb = _pf_defaults()
+    return vmem_mb << 20
+
+
+def group_residency_bytes(group, idx_arrays) -> int:
+    """Double-buffered VMEM residency of ONE fused pass group given its
+    per-step index arrays (dtype read from the arrays themselves)."""
+    idx_bytes = sum(int(a.dtype.itemsize) for a in idx_arrays)
+    return 2 * group.block_rows * LANE * (_DATA_BYTES + idx_bytes)
+
+
+def _iter_pf_routes(static):
+    """(name, StaticRoutePF) for every pass-fused route inside a plan
+    static (ExpandStatic r1/r2, FusedStatic r1/r2/vr, CFRouteStatic
+    src/dst recursion); unfused routes are skipped — their kernels hold
+    one (rb, 128) block pair, far under any budget."""
+    from lux_tpu.ops import expand as E
+    from lux_tpu.ops.pallas_shuffle import StaticRoutePF
+
+    if isinstance(static, E.CFRouteStatic):
+        for half, sub in (("src", static.src), ("dst", static.dst)):
+            for name, r in _iter_pf_routes(sub):
+                yield f"{half}.{name}", r
+        return
+    names = ("r1", "r2", "vr") if hasattr(static, "vr") else ("r1", "r2")
+    for name in names:
+        r = getattr(static, name)
+        if isinstance(r, StaticRoutePF):
+            yield name, r
+
+
+def _route_arrays_of(static, arrays):
+    """Map each pf route of ``static`` to its slice of the flat plan
+    arrays, using the same split helpers the replay uses."""
+    from lux_tpu.ops import expand as E
+
+    if isinstance(static, E.CFRouteStatic):
+        n_src = E._num_expand_arrays(static.src)
+        out = {}
+        for k, v in _route_arrays_of(static.src, arrays[:n_src]).items():
+            out[f"src.{k}"] = v
+        for k, v in _route_arrays_of(static.dst, arrays[n_src:]).items():
+            out[f"dst.{k}"] = v
+        return out
+    if isinstance(static, E.FusedStatic):
+        r1a, _, r2a, _, _, vra = E.split_fused_arrays(
+            static, arrays, static.weighted)
+        return {"r1": r1a, "r2": r2a, "vr": vra}
+    r1a, _, r2a = E.split_arrays(static, arrays)
+    return {"r1": r1a, "r2": r2a}
+
+
+def check_vmem(static, arrays, path: str, label: str, line: int = 1,
+               budget_bytes: int | None = None) -> List[Finding]:
+    """Audit every pass-fused group of one frozen plan against the VMEM
+    budget the knobs promise (LUX_PF_VMEM_MB at audit time unless
+    ``budget_bytes`` overrides).  ``arrays`` is the plan's flat array
+    tuple (single-part 2-D or stacked (P, ...) — dtypes are identical
+    across parts, which is all the residency model reads)."""
+    findings: List[Finding] = []
+    if budget_bytes is None:
+        budget_bytes = _budget_bytes()
+    by_route = _route_arrays_of(static, tuple(arrays))
+    for name, route in _iter_pf_routes(static):
+        route_arrays = by_route.get(name, ())
+        i = 0
+        for gi, g in enumerate(route.groups):
+            steps = route_arrays[i:i + len(g.steps)]
+            i += len(g.steps)
+            need = group_residency_bytes(g, steps)
+            if need > budget_bytes:
+                findings.append(Finding(
+                    path=path, line=line, col=0, code="LUX-J401",
+                    message=f"pass-fused group {name}[{gi}] "
+                            f"(block_rows={g.block_rows}, "
+                            f"{len(g.steps)} steps) needs {need} B of "
+                            f"VMEM double-buffered, over the "
+                            f"{budget_bytes} B budget the knobs promise "
+                            "(LUX_PF_VMEM_MB) — this blows up in Mosaic "
+                            "on chip, not in interpret-mode tests",
+                    text=f"{label}:{name}[{gi}]"))
+    return findings

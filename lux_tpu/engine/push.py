@@ -355,21 +355,30 @@ def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
 
 
 def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
-                       method: str = "auto"):
+                       method: str = "auto", donate: bool = False):
     """Single-device push loop with a DYNAMIC iteration stop (one compile
     serves every run length and every adaptive-repartition window; the
     driver inspects the carry's load stats between windows).
+
+    ``donate=True`` selects the donating twin (the carry — state + both
+    queue buffers — is consumed, argnum 2), matching the pull engine's
+    run_pull_fixed/run_pull_until ``donate=`` API: the loop's ping-pong
+    reuses the input carry's HBM instead of holding a second full copy.
+    The caller must not reuse the carry it passed in.  luxaudit LUX-J2
+    asserts the aliases actually land in the lowered module.
 
     Resolution happens OUTSIDE the compile cache: caching on "auto" would
     pin the first platform resolution for the process and split the cache
     between "auto" and its concrete equivalent."""
     return _compile_push_chunk_cached(
-        prog, pspec, spec, methods.resolve(method, prog.reduce)
+        prog, pspec, spec, methods.resolve(method, prog.reduce),
+        donate=donate,
     )
 
 
 def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
-                              route_static, method: str = "auto"):
+                              route_static, method: str = "auto",
+                              donate: bool = False):
     """compile_push_chunk with the dense rounds' gather routed
     (interpret mode resolved here, off-chip = CPU tests)."""
     from lux_tpu.engine.pull import _route_interpret
@@ -377,15 +386,16 @@ def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
     return _compile_push_chunk_cached(
         prog, pspec, spec, methods.resolve(method, prog.reduce),
         route_static=route_static, interpret=_route_interpret(),
+        donate=donate,
     )
 
 
 @lru_cache(maxsize=64)
 def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
                                method: str, route_static=None,
-                               interpret=False):
+                               interpret=False, donate=False):
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(2,) if donate else ())
     def loop(arrays, parrays, carry: PushCarry, it_stop, route_arrays=None):
         def cond(c):
             return (c.active > 0) & (c.it < it_stop)
@@ -476,14 +486,19 @@ def run_push(
     max_iters: int = 10_000,
     method: str = "auto",
     route=None,
+    donate: bool = False,
 ):
     """Single-device driver.  The direction switch is one global `lax.cond`
     over vmapped per-part branches — a genuine branch (only the taken mode
     executes; the global predicate makes this legal) with compile size O(1)
     in the part count.  ``route`` (ops.expand.plan_expand_shards on the
     PULL layout, unfused or pass-fused — both bitwise-identical) runs
-    the dense rounds' gather through the routed expand.  Returns
-    (final stacked state, iters, edge counter).
+    the dense rounds' gather through the routed expand.  ``donate=True``
+    runs the donating loop twin: the freshly-built initial carry is
+    consumed, so the hot loop holds ONE state + queue copy in HBM
+    instead of two (the pull engine's ``donate=`` contract on the push
+    side; opt-in because benchmark drivers re-run from one carry).
+    Returns (final stacked state, iters, edge counter).
     """
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
@@ -491,12 +506,13 @@ def run_push(
     parrays = jax.tree.map(jnp.asarray, shards.parrays)
     carry0 = _init_carry(prog, pspec, arrays)
     if route is None:
-        loop = compile_push_chunk(prog, pspec, spec, method)
+        loop = compile_push_chunk(prog, pspec, spec, method, donate=donate)
         out = loop(arrays, parrays, carry0, jnp.int32(max_iters))
     else:
         rs, ra = route
         ra = jax.tree.map(jnp.asarray, ra)
-        loop = compile_push_chunk_routed(prog, pspec, spec, rs, method)
+        loop = compile_push_chunk_routed(prog, pspec, spec, rs, method,
+                                         donate=donate)
         out = loop(arrays, parrays, carry0, jnp.int32(max_iters),
                    route_arrays=ra)
     return out.state, out.it, out.edges

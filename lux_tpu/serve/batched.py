@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from functools import lru_cache
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -155,15 +155,31 @@ def _batched_init(prog, arrays, queries):
 
 
 @lru_cache(maxsize=64)
+def _compile_batched_init(prog):
+    """Jitted per-batch state init, separate from the loop so the loop
+    can DONATE the state buffer it receives (a fused init would leave
+    nothing to donate; split, the loop's ping-pong reuses the init
+    buffer's HBM instead of holding a second (P, V, Q) copy — the
+    serving analog of the pull engine's ``donate=`` contract)."""
+
+    @jax.jit
+    def init(arrays, queries):
+        return _batched_init(prog, arrays, queries)
+
+    return init
+
+
+@lru_cache(maxsize=64)
 def _compile_batched_fixpoint(prog, spec: ShardSpec, method: str):
     """Jitted multi-query fixpoint loop: iterate while ANY query is still
     changing; per-query round counters freeze as queries converge.  The
     compiled program is shape-specialized on Q (the warm cache keys on
-    the Q bucket for exactly this reason)."""
+    the Q bucket for exactly this reason).  ``state0`` (from
+    _compile_batched_init) is DONATED — luxaudit LUX-J2 asserts the
+    alias lands in the lowered module."""
 
-    @jax.jit
-    def run(arrays, queries, max_iters):
-        state0 = _batched_init(prog, arrays, queries)
+    @partial(jax.jit, donate_argnums=2)
+    def run(arrays, queries, state0, max_iters):
         q = queries.shape[0]
 
         def cond(c):
@@ -194,11 +210,11 @@ def _compile_batched_fixpoint(prog, spec: ShardSpec, method: str):
 
 @lru_cache(maxsize=64)
 def _compile_batched_fixed(prog, spec: ShardSpec, method: str):
-    """Jitted fixed-iteration multi-query loop (ppr-style apps)."""
+    """Jitted fixed-iteration multi-query loop (ppr-style apps);
+    ``state0`` donated exactly like the fixpoint twin."""
 
-    @jax.jit
-    def run(arrays, queries, num_iters):
-        state0 = _batched_init(prog, arrays, queries)
+    @partial(jax.jit, donate_argnums=2)
+    def run(arrays, queries, state0, num_iters):
 
         def body(_, state):
             return _batched_iteration(prog, spec, method, arrays, state,
@@ -257,6 +273,7 @@ class BatchedEngine:
         # the O(E) graph arrays on device
         self._arrays = (device_arrays if device_arrays is not None
                         else jax.tree.map(jnp.asarray, shards.arrays))
+        self._init = _compile_batched_init(self.prog)
         if self.prog.fixpoint:
             self._run = _compile_batched_fixpoint(
                 self.prog, shards.spec, self.method)
@@ -274,8 +291,9 @@ class BatchedEngine:
         caller) must not duplicate a multi-second compile."""
         with self._warm_lock:
             if not self._warmed:
-                out = self._run(self._arrays,
-                                jnp.zeros((self.q,), jnp.int32),
+                q0 = jnp.zeros((self.q,), jnp.int32)
+                out = self._run(self._arrays, q0,
+                                self._init(self._arrays, q0),
                                 jnp.int32(1))
                 jax.block_until_ready(out[0])
                 self._warmed = True
@@ -290,8 +308,12 @@ class BatchedEngine:
         nv = self.shards.spec.nv
         if queries.size and (queries.min() < 0 or queries.max() >= nv):
             raise ValueError(f"query vertex out of range [0, {nv})")
+        q_dev = jnp.asarray(queries)
+        # the freshly-initialized state is donated to the loop: one
+        # (P, V, Q) buffer in the hot loop, not two
         state, it, rounds = self._run(
-            self._arrays, jnp.asarray(queries), jnp.int32(self._stop))
+            self._arrays, q_dev, self._init(self._arrays, q_dev),
+            jnp.int32(self._stop))
         self._warmed = True
         rounds = np.asarray(rounds)
         # (P, V, Q) -> (nv, Q) -> (Q, nv); per-query traversed edges are

@@ -1,0 +1,541 @@
+"""luxaudit (lux_tpu.analysis.ir): each LUX-J family catches its seeded
+broken fixture AND passes its clean twin, the audited repo engines are
+clean (the chip-day step -3b gate in tier-1 form), and the baseline
+machinery round-trips — mirroring tests/test_luxcheck.py for the layer
+below the AST."""
+import dataclasses
+import os
+import subprocess
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lux_tpu.analysis.ir import aot, donation, hbm, retrace, run_audit, vmem
+from lux_tpu.analysis.ir.collectives import check_shard_map_bodies
+from tests.conftest import forced_cpu_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# LUX-J1 retrace stability
+# ---------------------------------------------------------------------------
+
+
+def _unrolled(n):
+    """A config-dependent Python unroll — the retrace bug class: every
+    config value is a new program."""
+
+    @jax.jit
+    def f(x, idx):
+        for _ in range(n):
+            x = jnp.take(x, idx) * 2
+        return x
+
+    return f
+
+
+def test_j101_unroll_across_variants_fails():
+    x = jnp.arange(8.0)
+    idx = jnp.arange(8, dtype=jnp.int32)
+    variants = [_unrolled(2).trace(x, idx), _unrolled(3).trace(x, idx)]
+    fs = retrace.check_variants(variants, "lux_tpu/engine/pull.py",
+                                "fixture/unroll")
+    assert "LUX-J101" in _codes(fs)
+    # the coarse (shape-varying-family) signature catches it too: the
+    # unroll duplicates GATHERS, not just elementwise ops
+    fs = retrace.check_variants(variants, "lux_tpu/engine/pull.py",
+                                "fixture/unroll", strict=False)
+    assert "LUX-J101" in _codes(fs)
+
+
+def test_j101_clean_twin_fori_loop():
+    def make(n):
+        @jax.jit
+        def f(x, idx):
+            return jax.lax.fori_loop(
+                0, n, lambda _, s: jnp.take(s, idx) * 2, x)
+
+        return f
+
+    x = jnp.arange(8.0)
+    idx = jnp.arange(8, dtype=jnp.int32)
+    fs = retrace.check_variants(
+        [make(2).trace(x, idx), make(3).trace(x, idx)],
+        "lux_tpu/engine/pull.py", "fixture/fori")
+    assert fs == []
+
+
+def test_j101_coarse_tolerates_broadcast_idioms():
+    """The Q-bucket contract: a degenerate Q=1 broadcast may trace
+    differently (slice vs broadcast_in_dim) without being drift."""
+
+    @jax.jit
+    def f(x, q):
+        return x[:, None] * q[None, :]
+
+    a = f.trace(jnp.arange(8.0), jnp.arange(1.0))
+    b = f.trace(jnp.arange(8.0), jnp.arange(4.0))
+    assert retrace.check_variants([a, b], "p", "fixture/q",
+                                  strict=False) == []
+
+
+def test_j102_unhashable_static():
+    fs = retrace.check_statics([("ok",), [1, 2]], "p", "fixture/statics")
+    assert _codes(fs) == ["LUX-J102"]
+
+
+def test_j103_dynamic_recall():
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    # clean: same shape, different values — one compile
+    fs = retrace.check_dynamic_recall(
+        f, lambda: f(jnp.arange(4.0)), lambda: f(jnp.ones(4)),
+        "p", "fixture/dyn")
+    assert fs == []
+    # broken: the knob leaks into the shape — a recompile per value
+    fs = retrace.check_dynamic_recall(
+        f, lambda: f(jnp.arange(4.0)), lambda: f(jnp.arange(5.0)),
+        "p", "fixture/dyn")
+    assert _codes(fs) == ["LUX-J103"]
+
+
+def test_j101_same_config_double_trace_stable():
+    fs = retrace.trace_twice_stable(
+        lambda: _unrolled(2).trace(jnp.arange(8.0),
+                                   jnp.arange(8, dtype=jnp.int32)),
+        "p", "fixture/stable", statics=((1, 2),))
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# LUX-J2 donation
+# ---------------------------------------------------------------------------
+
+
+def test_j201_dropped_donation_fails():
+    """x is donated AND read, but no output matches its shape: XLA
+    silently drops the donation — the exact bug class."""
+
+    @partial(jax.jit, donate_argnums=0)
+    def f(x, y):
+        return jnp.sum(x) + y
+
+    x, y = jnp.arange(8.0), jnp.arange(4.0)
+    # jax itself only WARNS about the drop (the failure mode: a warning
+    # scrolled past in a log); the checker turns it into a finding
+    with pytest.warns(UserWarning, match="donated buffers were not"):
+        fs = donation.check_donation(f.trace(x, y), (x, y), (0,),
+                                     "p", "fixture/dropped")
+    assert _codes(fs) == ["LUX-J201"]
+
+
+def test_j201_clean_twin_aliases_land():
+    @partial(jax.jit, donate_argnums=0)
+    def f(x, y):
+        return x * 2 + jnp.sum(y)
+
+    x, y = jnp.arange(8.0), jnp.arange(4.0)
+    fs = donation.check_donation(f.trace(x, y), (x, y), (0,),
+                                 "p", "fixture/aliased")
+    assert fs == []
+
+
+def test_j201_pruned_unused_leaf_exempt():
+    """A donated leaf DCE'd out of the lowered module holds no runtime
+    buffer: nothing to alias, nothing resident — must not fire."""
+
+    @partial(jax.jit, donate_argnums=0)
+    def f(c, y):
+        state, unused = c
+        del unused  # never read: DCE'd out of the lowered main
+        return state * 2, y + 1
+
+    c = (jnp.arange(8.0), jnp.arange(3.0))
+    y = jnp.arange(4.0)
+    fs = donation.check_donation(f.trace(c, y), (c, y), (0,),
+                                 "p", "fixture/pruned")
+    assert fs == []
+
+
+def test_j2_pull_and_push_aliases_land_from_lowered_hlo():
+    """The acceptance claim: donation aliases asserted from lowered HLO
+    for BOTH the pull and push engine paths, on CPU."""
+    from lux_tpu.analysis.ir import targets
+
+    assert targets._donation_pull_fixed() == []
+    assert targets._donation_push_chunk() == []
+    assert targets._donation_push_step() == []
+    assert targets._donation_serve("sssp") == []
+
+
+# ---------------------------------------------------------------------------
+# LUX-J3 collective order
+# ---------------------------------------------------------------------------
+
+
+def _mesh2():
+    return jax.sharding.Mesh(np.array(jax.devices()[:2]), ("parts",))
+
+
+def test_j301_mismatched_psum_arm_fails():
+    """A cond whose arms disagree on collectives under a LOCAL (per-
+    device) predicate: participants can take different arms and the
+    psum deadlocks the mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh2()
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("parts"),),
+             out_specs=P("parts"))
+    def f(x):
+        return jax.lax.cond(
+            jnp.sum(x) > 0,  # local value: not mesh-agreed
+            lambda: x + jax.lax.psum(jnp.sum(x), "parts"),
+            lambda: x * 2,
+        )
+
+    fs = check_shard_map_bodies(
+        aot.traced_jaxpr(f.trace(jnp.arange(8.0))), "p", "fixture/cond")
+    assert "LUX-J301" in _codes(fs)
+
+
+def test_j301_clean_twin_psum_predicate():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh2()
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("parts"),),
+             out_specs=P("parts"))
+    def f(x):
+        return jax.lax.cond(
+            jax.lax.psum(jnp.sum(x), "parts") > 0,  # mesh-agreed
+            lambda: x + jax.lax.psum(jnp.sum(x), "parts"),
+            lambda: x * 2,
+        )
+
+    fs = check_shard_map_bodies(
+        aot.traced_jaxpr(f.trace(jnp.arange(8.0))), "p", "fixture/cond")
+    assert fs == []
+
+
+def test_j302_local_while_predicate_fails():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh2()
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("parts"),),
+             out_specs=P("parts"))
+    def f(x):
+        def body(c):
+            s, it = c
+            return s + jax.lax.psum(jnp.sum(s), "parts"), it + 1
+
+        def cond(c):
+            s, it = c
+            # stop depends on the LOCAL shard: devices disagree on the
+            # trip count, one exits while the rest block in the psum
+            return (jnp.sum(s) < 100.0) & (it < 5)
+
+        return jax.lax.while_loop(cond, body, (x, jnp.int32(0)))[0]
+
+    fs = check_shard_map_bodies(
+        aot.traced_jaxpr(f.trace(jnp.arange(4.0))), "p", "fixture/while")
+    assert "LUX-J302" in _codes(fs)
+
+
+def test_j302_clean_twin_psum_carried_predicate():
+    """The push engine's shape: the stop predicate reads a psum'd carry
+    slot — agreed through the while fixpoint."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh2()
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("parts"), P()),
+             out_specs=P("parts"))
+    def f(x, stop):
+        def body(c):
+            s, it, _ = c
+            new = s + jax.lax.all_gather(s, "parts", tiled=True).sum()
+            active = jax.lax.psum(
+                (jnp.sum(new - s) > 0).astype(jnp.int32), "parts")
+            return new, it + 1, active
+
+        def cond(c):
+            _, it, active = c
+            return (active > 0) & (it < stop)
+
+        return jax.lax.while_loop(
+            cond, body, (x, jnp.int32(0), jnp.int32(1)))[0]
+
+    fs = check_shard_map_bodies(
+        aot.traced_jaxpr(f.trace(jnp.arange(4.0), jnp.int32(3))),
+        "p", "fixture/while-clean")
+    assert fs == []
+
+
+def test_j3_real_push_engines_clean():
+    """The direction-optimized engines' cond/while predicates are
+    provably mesh-agreed — the property five rounds of comments assert."""
+    from lux_tpu.analysis.ir import targets
+
+    assert targets._collective_push_dist() == []
+    assert targets._collective_push_ring() == []
+
+
+# ---------------------------------------------------------------------------
+# LUX-J4 VMEM budget
+# ---------------------------------------------------------------------------
+
+
+def _pf_plan():
+    from lux_tpu.analysis.ir.targets import fixture
+
+    return fixture()["plan_pf"]
+
+
+def test_j401_over_budget_group_fails():
+    rs, ra = _pf_plan()
+    from lux_tpu.ops.pallas_shuffle import StaticRoutePF
+
+    assert isinstance(rs.r1, StaticRoutePF)
+    # seed the bug: a group whose tile claims 64x the planned rows —
+    # the shape of a planner regression a cached plan would replay
+    big = dataclasses.replace(
+        rs.r1, groups=tuple(
+            dataclasses.replace(g, block_rows=g.block_rows * 64)
+            for g in rs.r1.groups))
+    broken = dataclasses.replace(rs, r1=big)
+    fs = vmem.check_vmem(broken, ra, "p", "fixture/overbudget",
+                         budget_bytes=1 << 20)
+    assert "LUX-J401" in _codes(fs)
+
+
+def test_j4_real_pf_plans_within_budget():
+    rs, ra = _pf_plan()
+    assert vmem.check_vmem(rs, ra, "p", "expand-pf") == []
+
+
+def test_j4_residency_uses_real_index_dtypes():
+    """The recomputation reads the ACTUAL narrowed dtypes: a u8 plan's
+    residency is below the planner's conservative int32 model."""
+    rs, ra = _pf_plan()
+    from lux_tpu.analysis.ir.vmem import group_residency_bytes
+
+    g = rs.r1.groups[0]
+    idx = [np.zeros((4, 128), np.uint8)] * len(g.steps)
+    narrow = group_residency_bytes(g, idx)
+    wide = group_residency_bytes(
+        g, [a.astype(np.int32) for a in idx])
+    assert narrow < wide
+
+
+# ---------------------------------------------------------------------------
+# LUX-J5 HBM-pass accounting
+# ---------------------------------------------------------------------------
+
+
+def test_j501_direct_gather_vs_routed_claim_fails():
+    """Replay the plan's role with a FLAT gather (zero pallas kernels):
+    the kernel count no longer matches the static — the 'a pass fell
+    off the Pallas path' regression."""
+    rs, _ = _pf_plan()
+
+    @jax.jit
+    def direct(x, idx):
+        return x[idx]
+
+    traced = direct.trace(jnp.arange(256.0),
+                          jnp.arange(256, dtype=jnp.int32))
+    fs = hbm.check_hbm(traced, rs, "p", "fixture/direct")
+    assert "LUX-J501" in _codes(fs)
+
+
+def test_j502_off_by_one_claim_fails():
+    from lux_tpu.analysis.ir.targets import _expand_traced, fixture
+
+    traced, rs = _expand_traced(fixture()["plan_pf"])
+    from lux_tpu.utils import roofline
+
+    claimed = roofline.routed_hbm_passes(rs)
+    claimed["r1"] += 1  # the seeded metric drift
+    fs = hbm.check_hbm(traced, rs, "p", "fixture/offbyone",
+                       claimed=claimed)
+    assert _codes(fs) == ["LUX-J502"]
+
+
+def test_j5_real_replays_match_accounting():
+    from lux_tpu.analysis.ir import targets
+
+    assert targets._hbm_expand(False) == []
+    assert targets._hbm_expand(True) == []
+    assert targets._hbm_fused_pf() == []
+
+
+# ---------------------------------------------------------------------------
+# the gate + baseline machinery
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_luxaudit_clean_fast_tier():
+    """The ci_check tier of the acceptance gate, in-process."""
+    findings, report = run_audit(fast=True)
+    assert findings == [], [f.format() for f in findings]
+    assert report["clean"] and len(report["units"]) >= 5
+
+
+def test_run_audit_crash_is_a_finding(monkeypatch):
+    """An audit unit that CRASHES must fail the gate (LUX-J000), never
+    pass as clean — the luxcheck LUX-X000 policy one layer down."""
+    from lux_tpu.analysis.ir import targets as tmod
+
+    def boom_units(fast=False):
+        return [tmod.AuditUnit("retrace", "boom", "lux_tpu/engine/pull.py",
+                               True, lambda: 1 / 0)]
+
+    monkeypatch.setattr(tmod, "audit_units", boom_units)
+    findings, report = run_audit(fast=True)
+    assert _codes(findings) == ["LUX-J000"]
+    assert not report["clean"]
+
+
+def test_baseline_suppresses_and_stales(monkeypatch, tmp_path):
+    """A justified baseline entry suppresses exactly its finding; a
+    stale entry is itself a finding — luxcheck's machinery, shared."""
+    from lux_tpu.analysis.core import Finding
+    from lux_tpu.analysis.ir import targets as tmod
+
+    seeded = Finding(path="lux_tpu/engine/pull.py", line=1, col=0,
+                     code="LUX-J201", message="seeded", text="unit/x")
+
+    def units(fast=False):
+        return [tmod.AuditUnit("donation", "unit/x",
+                               "lux_tpu/engine/pull.py", True,
+                               lambda: [seeded])]
+
+    monkeypatch.setattr(tmod, "audit_units", units)
+    base = tmp_path / "baseline.txt"
+    base.write_text(f"{seeded.path}:{seeded.code}:{seeded.fingerprint()}"
+                    "  # fixture justification\n")
+    findings, _ = run_audit(fast=True, baseline_path=str(base))
+    assert findings == []
+    # stale entry: nothing matches -> LUX-X003
+    base.write_text("lux_tpu/engine/pull.py:LUX-J201:000000000000"
+                    "  # fixture justification\n")
+    findings, _ = run_audit(fast=True, baseline_path=str(base))
+    codes = _codes(findings)
+    assert "LUX-J201" in codes and "LUX-X003" in codes
+
+
+@pytest.mark.slow
+def test_luxaudit_cli_all_clean():
+    """The full acceptance gate: `tools/luxaudit.py --all` exits 0 on
+    the repo with the shipped (empty) baseline, writing the AUDIT json."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "luxaudit.py"),
+         "--all", "--json", "/tmp/lux_audit_test.json"],
+        capture_output=True, text=True, timeout=560, env=forced_cpu_env(),
+        cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "luxaudit: clean" in out.stdout
+    import json
+
+    with open("/tmp/lux_audit_test.json") as f:
+        rec = json.load(f)
+    assert rec["clean"] and rec["tier"] == "all"
+    fams = {u["family"] for u in rec["units"]}
+    assert fams == {"retrace", "donation", "collective", "vmem", "hbm"}
+
+
+def test_luxaudit_cli_usage_error():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "luxaudit.py")],
+        capture_output=True, text=True, timeout=60, env=forced_cpu_env(),
+        cwd=REPO)
+    assert out.returncode == 2
+
+
+def test_j301_nested_in_while_found_once():
+    """A broken cond NESTED in a while loop: the carry fixpoint
+    re-evaluates the body, but each distinct finding reports once."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh2()
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("parts"),),
+             out_specs=P("parts"))
+    def f(x):
+        def body(c):
+            s, it = c
+            s = jax.lax.cond(
+                jnp.sum(s) > 0,  # local predicate: broken
+                lambda: s + jax.lax.psum(jnp.sum(s), "parts"),
+                lambda: s * 2)
+            return s, it + 1
+
+        def cond(c):
+            return c[1] < 3  # pure index math: agreed, no LUX-J302
+
+        return jax.lax.while_loop(cond, body, (x, jnp.int32(0)))[0]
+
+    fs = check_shard_map_bodies(
+        aot.traced_jaxpr(f.trace(jnp.arange(4.0))), "p", "fixture/nested")
+    assert _codes(fs) == ["LUX-J301"]
+
+
+def test_j302_collective_in_cond_jaxpr_fails():
+    """Code-review regression: a psum that lives only in the while COND
+    jaxpr deadlocks the same way a body collective does (one device
+    exits, stragglers re-enter the cond's psum) — J302 must fire when
+    the predicate has a locally-divergent conjunct."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh2()
+
+    @jax.jit
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("parts"),),
+             out_specs=P("parts"))
+    def f(x):
+        def body(c):
+            s, it = c
+            return s * 2, it + 1  # pure-local body
+
+        def cond(c):
+            s, it = c
+            # local conjunct: devices disagree on the trip count while
+            # the psum synchronizes the mesh every evaluation
+            return ((jnp.sum(s) < 100.0)
+                    & (jax.lax.psum(jnp.sum(s), "parts") < 1e9)
+                    & (it < 5))
+
+        return jax.lax.while_loop(cond, body, (x, jnp.int32(0)))[0]
+
+    fs = check_shard_map_bodies(
+        aot.traced_jaxpr(f.trace(jnp.arange(4.0))), "p", "fixture/condpsum")
+    assert _codes(fs) == ["LUX-J302"]
+
+
+def test_empty_family_filter_is_a_finding():
+    """Code-review regression: a typo'd --families value must FAIL the
+    gate (LUX-J000), never audit zero units and report clean."""
+    findings, report = run_audit(fast=True, families=("donate",))
+    assert not report["clean"]
+    assert "LUX-J000" in _codes(findings)
+    # a valid subset still works
+    findings, report = run_audit(fast=True, families=("donation",))
+    assert findings == [] and report["clean"]

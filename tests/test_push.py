@@ -143,3 +143,35 @@ def test_weighted_sssp_extension():
     np.testing.assert_array_equal(got[finite], want[finite].astype(np.int64))
     assert np.all(got[~finite] == sssp.inf_value(g.nv, weighted=True))
     assert sssp.check_distances(g, got, weighted=True) == 0
+
+def test_run_push_donate_twin():
+    """The push-side ``donate=`` contract (pull parity, luxaudit LUX-J2):
+    the donating loop is bitwise-identical to the default, consumes the
+    carry it is handed, and raises no donation warnings on this backend."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    g = generate.rmat(8, 8, seed=31)
+    sh = build_push_shards(g, 2)
+    prog = sssp.SSSPProgram(nv=g.nv, start=0)
+    ref_state, ref_it, ref_edges = push.run_push(prog, sh)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state, it, edges = push.run_push(prog, sh, donate=True)
+        jax.block_until_ready(state)
+        donation_warnings = [str(i.message) for i in w
+                             if "donat" in str(i.message).lower()]
+    assert donation_warnings == [], donation_warnings
+    np.testing.assert_array_equal(np.asarray(ref_state), np.asarray(state))
+    assert int(it) == int(ref_it)
+    assert push.edges_total(edges) == push.edges_total(ref_edges)
+    # the donating loop really consumes its carry (single copy in HBM)
+    loop = push.compile_push_chunk(prog, sh.pspec, sh.spec, "scan",
+                                   donate=True)
+    arrays, parrays, carry0 = push.push_init(prog, sh)
+    out = loop(arrays, parrays, carry0, jnp.int32(50))
+    jax.block_until_ready(out.state)
+    with pytest.raises((RuntimeError, ValueError)):
+        jnp.sum(carry0.state).block_until_ready()

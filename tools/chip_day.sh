@@ -37,6 +37,29 @@ if ! timeout 120 python tools/luxcheck.py --all \
 fi
 echo "luxcheck: clean"
 
+# -3b) IR preflight: luxaudit traces/lowers the REAL engine entry
+#      points on CPU and audits the jaxpr/StableHLO — retrace stability
+#      (LUX-J1), donation aliases (LUX-J2), collective order under
+#      cond/while predicates (LUX-J3), pass-fused VMEM residency
+#      (LUX-J4), hbm_passes-vs-kernels accounting (LUX-J5).  ABORTS the
+#      window on any finding: a dropped donation or a silently-unfused
+#      pf group costs real HBM/compile budget on every iteration of the
+#      battery; no tunnel needed, so this runs before the relay gate.
+#      The AUDIT json is the round's machine-readable preflight record.
+#      PYTHONPATH pinned to the repo root (tests/conftest.forced_cpu_env
+#      contract): the axon sitecustomize registers the TPU plugin at
+#      interpreter start and would HANG this no-tunnel-needed gate when
+#      the relay is wedged.
+echo "=== luxaudit preflight ($(date +%H:%M:%S))"
+if ! timeout 600 env PYTHONPATH="$PWD" python tools/luxaudit.py --all \
+    --json "$LOG/AUDIT.json" \
+    --progress PROGRESS.jsonl > "$LOG/luxaudit.out" 2>&1; then
+  tail -15 "$LOG/luxaudit.out" | sed 's/^/    /'
+  echo "luxaudit findings (full list: $LOG/luxaudit.out) — aborting battery"
+  exit 1
+fi
+tail -1 "$LOG/luxaudit.out"
+
 # -2) routed-plan prewarm in the BACKGROUND (host cores only, no chip
 #     needed): builds/refreshes the headline-scale expand+fused plan
 #     caches so no battery step pays plan construction inside a TPU

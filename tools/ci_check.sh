@@ -27,6 +27,11 @@ stage() {  # stage <name> <timeout_s> <cmd...>
 # 1) luxcheck: the whole shipped surface, milliseconds, no jax import
 stage luxcheck 120 python tools/luxcheck.py --all
 
+# 1b) luxaudit fast tier: trace/lower the pull + push + routed-pf entry
+#     points and audit the IR (retrace/donation/collective/VMEM/hbm
+#     invariants) — the jaxpr-level half of the static gate
+stage luxaudit 600 python tools/luxaudit.py --fast
+
 # 2) native sanitizer smoke: TSan (the multithreaded colorer, bitwise
 #    vs serial), ASan + UBSan (lux_io's pread64 offset arithmetic).
 #    Skipped quietly when the toolchain can't build them (the pytest

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""luxaudit — the jaxpr/HLO-level static auditor (lux_tpu.analysis.ir).
+
+Usage:
+    python tools/luxaudit.py --all                 # every audited entry point
+    python tools/luxaudit.py --fast                # pull + push + one pf config
+    python tools/luxaudit.py --all --json AUDIT_r06.json
+    python tools/luxaudit.py --all --families donation,collective
+    python tools/luxaudit.py --all --fingerprints  # baseline-entry form
+
+Exit codes: 0 = clean, 1 = findings, 2 = usage.
+
+Where luxcheck (step -3) lints the Python AST in milliseconds, this gate
+traces and lowers the REAL engine entry points — pull fixed/until (direct
+and routed-pf), the push chunk/step loops, the distributed push engines,
+the serve batched steps — over a small fixture graph and audits the IR:
+
+  LUX-J1  retrace stability   (J101 structural drift, J102 unhashable
+                               statics, J103 dynamic-knob recompiles)
+  LUX-J2  donation            (J201 donated leaf without an
+                               input_output_alias in the lowered module)
+  LUX-J3  collective order    (J301/J302 collectives under a predicate
+                               that is not provably mesh-agreed)
+  LUX-J4  VMEM budget         (J401 pass-fused group over the knob budget)
+  LUX-J5  HBM-pass accounting (J501/J502 roofline hbm_passes vs the
+                               kernels actually traced)
+
+Runs entirely on CPU — chip-day step -3b aborts the window on findings
+BEFORE the tunnel is needed; ci_check.sh runs the --fast tier.
+
+Suppression is baseline-only (there is no source line to hang an inline
+comment on): tools/luxaudit_baseline.txt, same format and policy as
+luxcheck's (<path>:<code>:<fingerprint>  # why — ships EMPTY; stale or
+unjustified entries are themselves findings).  Fingerprints hash the
+audited target label, so they survive engine edits but die when the
+target set changes.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# This tool NEEDS jax (it traces the real engines) but must never touch
+# an accelerator: force the CPU backend and the 8-device virtual mesh
+# (tests/conftest.py's harness contract) BEFORE jax initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join("tools", "luxaudit_baseline.txt")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="jaxpr/HLO-level static audit of the engine entry "
+                    "points (retrace, donation, collective-order, VMEM "
+                    "budget, HBM passes)")
+    ap.add_argument("--all", action="store_true",
+                    help="audit every entry point (chip-day step -3b)")
+    ap.add_argument("--fast", action="store_true",
+                    help="pull + push + one pass-fused config (CI tier)")
+    ap.add_argument("--families",
+                    help="comma-separated subset of "
+                         "retrace,donation,collective,vmem,hbm")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppressions file (default "
+                         f"{DEFAULT_BASELINE}; '' disables)")
+    ap.add_argument("--json", dest="json_out",
+                    help="write the full audit record (units, timings, "
+                         "findings) to this path, e.g. AUDIT_r06.json")
+    ap.add_argument("--fingerprints", action="store_true",
+                    help="print findings as ready-to-paste baseline "
+                         "entries instead of human-readable lines")
+    ap.add_argument("--progress",
+                    help="append a one-line audit-status record to this "
+                         "jsonl file (chip_day passes PROGRESS.jsonl so "
+                         "each window's preflight verdict is on the "
+                         "round's permanent record)")
+    args = ap.parse_args(argv)
+
+    if not (args.all or args.fast):
+        ap.print_usage(sys.stderr)
+        print("error: give --all or --fast", file=sys.stderr)
+        return 2
+
+    import jax
+
+    # persistent compile cache: the dynamic-knob probes (LUX-J103)
+    # execute two small compiles; repeat preflights hit the cache
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("LUX_JAX_CACHE", "/tmp/lux_jax_cache"))
+
+    from lux_tpu.analysis.ir import run_audit
+
+    baseline = None
+    if args.baseline:
+        b = (args.baseline if os.path.isabs(args.baseline)
+             else os.path.join(REPO, args.baseline))
+        baseline = b
+    families = (tuple(f.strip() for f in args.families.split(",")
+                      if f.strip())
+                if args.families else None)
+    findings, report = run_audit(fast=not args.all,
+                                 baseline_path=baseline,
+                                 families=families)
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    if args.progress:
+        import time
+
+        with open(args.progress, "a", encoding="utf-8") as f:
+            f.write(json.dumps({
+                "ts": time.time(), "tool": "luxaudit",
+                "tier": report["tier"], "clean": report["clean"],
+                "findings": len(findings),
+                "units": len(report["units"]),
+            }) + "\n")
+    for fi in findings:
+        if args.fingerprints:
+            print(f"{fi.path}:{fi.code}:{fi.fingerprint()}  # JUSTIFY: "
+                  f"{fi.message[:60]}")
+        else:
+            print(f"{fi.format()}  [{fi.text}]")
+    tier = "all" if args.all else "fast"
+    n_units = len(report["units"])
+    if findings:
+        print(f"\nluxaudit: {len(findings)} finding(s) over {n_units} "
+              f"audited entry point(s) ({tier} tier) — fix, or baseline "
+              "WITH a justification (see docs/ANALYSIS.md)",
+              file=sys.stderr)
+        return 1
+    print(f"luxaudit: clean ({n_units} entry point(s), {tier} tier, "
+          f"jax {report['jax']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
