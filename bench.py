@@ -106,9 +106,50 @@ def _plan_build_field():
         return {"cold": 0.0, "warm": 0.0}
 
 
+def _obs_fields():
+    """run_id + the ``phases`` dict for a bench row, read from the
+    flight recorder's span totals (lux_tpu.obs) — ONE clock: the same
+    span durations luxview's waterfall renders, cumulative for this
+    worker like plan_build_seconds.  ``plan`` covers plan.build +
+    plan.load ONLY — plan.color runs nested inside plan.build, so a
+    flat sum over "plan." would count the coloring wall time twice;
+    load/compile/iterate come from the graph.* / compile.* / iterate
+    spans around the measured regions."""
+    try:
+        from lux_tpu import obs
+
+        r = obs.recorder()
+
+        def tot(*prefixes):
+            return round(sum(v[1] for p in prefixes
+                             for v in r.totals(p).values()), 3)
+
+        return {
+            "run_id": r.run_id,
+            "phases": {"load": tot("graph."),
+                       "plan": tot("plan.build", "plan.load"),
+                       "compile": tot("compile."),
+                       "iterate": tot("iterate")},
+        }
+    except Exception:  # noqa: BLE001 — accounting must never cost a row
+        return {}
+
+
 def _emit_row(obj):
-    """Worker-side emit: every measured row carries plan_build_seconds."""
-    _emit({**obj, "plan_build_seconds": _plan_build_field()})
+    """Worker-side emit: every measured row carries plan_build_seconds,
+    its run_id, and the recorder-sourced phases dict; a ``bench.row``
+    point mirrors the row into the event log so luxview links them."""
+    row = {**obj, "plan_build_seconds": _plan_build_field(),
+           **_obs_fields()}
+    try:
+        from lux_tpu import obs
+
+        obs.point("bench.row", metric=row.get("metric"),
+                  value=row.get("value"), unit=row.get("unit"),
+                  method=row.get("method"))
+    except Exception:  # noqa: BLE001 — telemetry must never cost a row
+        pass
+    _emit(row)
 
 
 def _zero(metric):
@@ -120,6 +161,7 @@ def _zero(metric):
         # the orchestrator never imports jax; static zeros keep the
         # every-row-carries-plan_build_seconds contract without it
         "plan_build_seconds": {"cold": 0.0, "warm": 0.0},
+        "run_id": os.environ.get("LUX_OBS_RUN_ID", ""),
     }
 
 
@@ -188,6 +230,7 @@ def worker_main():
     except Exception:
         pass
 
+    from lux_tpu import obs
     from lux_tpu.engine import pull
     from lux_tpu.engine.methods import resolve as resolve_method
     from lux_tpu.graph import generate
@@ -290,10 +333,14 @@ def worker_main():
                 best = min(best, time.perf_counter() - t0)
             return best, out
 
-        for n in (1, iters):  # compile + warm both programs
-            float(jax.device_get(run(n).ravel()[0]))
-        t1, _ = once(1)
-        tn, out = once(iters)
+        # compile/iterate spans: the bench row's ``phases`` dict and
+        # luxview's waterfall are views over these same durations
+        with obs.span("compile.warm", iters=iters):
+            for n in (1, iters):  # compile + warm both programs
+                float(jax.device_get(run(n).ravel()[0]))
+        with obs.span("iterate", iters=iters, reps=reps):
+            t1, _ = once(1)
+            tn, out = once(iters)
         per_iter = max((tn - t1) / (iters - 1), 1e-9) if iters > 1 else tn
         return per_iter * iters, out
 
@@ -495,11 +542,16 @@ def worker_main():
 
     push_shards_cache = []
 
-    def _timed_push_convergence(prog, m):
+    def _timed_push_convergence(prog, m, app=None):
         """Run a frontier app to convergence on the push chunk loop and
         time it with the fetch-differencing discipline: the chunk loop
         takes a DYNAMIC it_stop, so t(full) - t(1) is the honest marginal
         cost of the remaining iterations under one compiled program.
+        ``app`` names the row in the flight recorder and enables one
+        extra NON-timed telemetry run whose per-round frontier/traversed
+        curve lands in the event log (the ring rides the while carry;
+        the timed runs stay ring-free so the differencing numbers are
+        exactly the shipped hot loop's).
         Returns (n_iters, traversed_edges, elapsed_s, dense_rounds)."""
         from lux_tpu.engine import push as push_eng
         from lux_tpu.graph.push_shards import build_push_shards
@@ -518,12 +570,18 @@ def worker_main():
             # safely reusable across timed runs
             return loop(arrays_p, parrays_p, carry0, jnp.int32(n))
 
-        full = run(10_000)  # warm + converge
-        float(jax.device_get(full.state.ravel()[0]))
-        n_iters = int(full.it)
-        traversed = push_eng.edges_total(jax.device_get(full.edges))
-        dense_rounds = int(full.dense_rounds)
-        float(jax.device_get(run(1).state.ravel()[0]))  # warm the 1-stop
+        # compile.warm holds the trace+compile (plus one cheap iteration);
+        # the run-to-convergence is ITERATION work and must land under the
+        # "iterate" prefix, or the row's phases dict would blame a 60s
+        # converge on the compiler
+        with obs.span("compile.warm", app=app or "push"):
+            float(jax.device_get(run(1).state.ravel()[0]))
+        with obs.span("iterate.converge", app=app or "push"):
+            full = run(10_000)  # converge
+            float(jax.device_get(full.state.ravel()[0]))
+            n_iters = int(full.it)
+            traversed = push_eng.edges_total(jax.device_get(full.edges))
+            dense_rounds = int(full.dense_rounds)
 
         def once(n):
             best = float("inf")
@@ -534,11 +592,29 @@ def worker_main():
                 best = min(best, time.perf_counter() - t0)
             return best
 
-        if n_iters > 1:
-            per_iter = max((once(n_iters) - once(1)) / (n_iters - 1), 1e-9)
-            elapsed = per_iter * n_iters
-        else:
-            elapsed = once(n_iters)
+        with obs.span("iterate", app=app or "push", iters=n_iters):
+            if n_iters > 1:
+                per_iter = max((once(n_iters) - once(1)) / (n_iters - 1),
+                               1e-9)
+                elapsed = per_iter * n_iters
+            else:
+                elapsed = once(n_iters)
+        if app is not None:
+            try:
+                from lux_tpu.obs import ring as obs_ring
+
+                with obs.span("telemetry.capture", app=app, method=m):
+                    tloop = push_eng.compile_push_chunk(
+                        prog, pshards.pspec, pshards.spec, m,
+                        telemetry=True)
+                    _, rg = tloop(arrays_p, parrays_p, carry0,
+                                  jnp.int32(10_000),
+                                  obs_ring.new_ring("push"))
+                    obs_ring.emit_ring("push", rg, app=app, method=m)
+            except Exception as e:  # noqa: BLE001 — telemetry is never
+                # load-bearing for a bench row
+                print(f"# push telemetry capture failed: {e}",
+                      file=sys.stderr, flush=True)
         return n_iters, traversed, elapsed, dense_rounds
 
     def measure_sssp():
@@ -555,7 +631,7 @@ def worker_main():
         # metric a meaningless 0.0/traversed=0 line
         start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
         n_iters, traversed, elapsed, dr = _timed_push_convergence(
-            SSSPProgram(nv=g.nv, start=start), m
+            SSSPProgram(nv=g.nv, start=start), m, app="sssp"
         )
         gteps = traversed / elapsed / 1e9
         model = roofline.push_run_model(g.ne, g.nv, traversed, dr, m)
@@ -581,7 +657,7 @@ def worker_main():
         from lux_tpu.models.components import MaxLabelProgram
 
         n_iters, traversed, elapsed, dr = _timed_push_convergence(
-            MaxLabelProgram(), m
+            MaxLabelProgram(), m, app="components"
         )
         gteps = traversed / elapsed / 1e9
         model = roofline.push_run_model(g.ne, g.nv, traversed, dr, m)
@@ -754,12 +830,42 @@ def worker_main():
             }
         )
 
+    def capture_pull_telemetry():
+        """One NON-timed pagerank run on the race winner with the
+        telemetry ring riding the fori carry: the per-iteration residual
+        curve into the event log.  The timed race stays ring-free so the
+        banked GTEPS are exactly the shipped hot loop's; this run costs
+        one extra compile + ``iters`` iterations."""
+        from lux_tpu.engine.methods import CONCRETE
+        from lux_tpu.obs import ring as obs_ring
+
+        concrete = {kv: t for kv, t in results.items() if kv[0] in CONCRETE}
+        if not concrete:
+            return
+        m, dt = min(concrete, key=concrete.get)
+        prog = PageRankProgram(nv=shards.spec.nv, dtype=dt)
+        s0 = pull.init_state(prog, arrays)
+        with obs.span("telemetry.capture", app="pagerank", method=m):
+            out, rg = pull.run_pull_fixed(
+                prog, shards.spec, arrays, s0, iters, m,
+                route=_layout["route"],
+                telemetry=obs_ring.new_ring("pull_fixed"))
+            jax.block_until_ready(out)
+            obs_ring.emit_ring("pull_fixed", rg, app="pagerank",
+                               method=m, iters=iters)
+
     if "pagerank" in apps:
         for m in methods:
             try:
                 measure(m, dtype)
             except Exception as e:  # noqa: BLE001 — a method may be unsupported
                 print(f"# method {m} failed: {e}", file=sys.stderr, flush=True)
+        if results:
+            try:
+                capture_pull_telemetry()
+            except Exception as e:  # noqa: BLE001 — never costs a row
+                print(f"# pull telemetry capture failed: {e}",
+                      file=sys.stderr, flush=True)
         if results and on_tpu and dtype_env is None:
             # bf16 datapoint on the best method BEFORE the risky tail:
             # halved HBM gather + exchange traffic is the interesting
@@ -1176,10 +1282,35 @@ def _relay_listening(port=None, timeout=3.0) -> bool:
         return False
 
 
+def _new_run_id():
+    """Orchestrator-side run id: both workers inherit it via
+    LUX_OBS_RUN_ID, so the TPU primary and the CPU insurance land in ONE
+    flight-recorder timeline and every row they emit links back to it.
+    The id format has exactly one owner — obs/recorder.new_run_id —
+    loaded from its file so the orchestrator stays jax-free WITHOUT
+    registering a package stub (workers forked from this process must
+    still import the real lux_tpu)."""
+    import importlib.util
+
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_lux_obs_recorder",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "lux_tpu", "obs", "recorder.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.new_run_id()
+    except Exception:  # noqa: BLE001 — observability must never fail bench
+        return f"{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}_0"
+
+
 def main():
     budget = _env_int("LUX_BENCH_WATCHDOG_S", 900)
     if budget <= 0:  # 0 = unbounded (documented knob semantics)
         budget = 1 << 30
+    # one run id for the whole bench invocation (chip_day exports its own
+    # battery-wide id; standalone runs mint one here)
+    os.environ.setdefault("LUX_OBS_RUN_ID", _new_run_id())
     t_start = time.monotonic()
     scale = _env_int("LUX_BENCH_SCALE", 20)
     tpu_wait = _env_int("LUX_BENCH_TPU_S", budget - 120)

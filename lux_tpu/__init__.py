@@ -48,7 +48,7 @@ __version__ = "0.5.0"
 def __getattr__(name):
     # lazy subpackage access: lux_tpu.models / apps / parallel / ops / utils
     if name in ("models", "apps", "parallel", "ops", "utils", "graph",
-                "engine", "native"):
+                "engine", "native", "obs", "analysis", "serve"):
         import importlib
 
         return importlib.import_module(f"lux_tpu.{name}")

@@ -1,6 +1,6 @@
 """lux_tpu.analysis — luxcheck, the repo-native static-analysis suite.
 
-Four checker families encode the invariants that have actually bitten
+Five checker families encode the invariants that have actually bitten
 this codebase (see each module's docstring for the incident history):
 
 * tracing-safety (LUX-T*) — Python control flow / host concretization on
@@ -11,7 +11,10 @@ this codebase (see each module's docstring for the incident history):
 * thread-safety (LUX-C*) — unlocked module state under the PR-2 planner
   fan-out and the serving scheduler thread;
 * policy        (LUX-P*) — no pickle in cache paths, env knobs through
-  utils.config.env_int, u8 index narrowing through _narrow_idx only.
+  utils.config.env_int, u8 index narrowing through _narrow_idx only;
+* observability (LUX-O*) — no host syncs / flight-recorder host API in
+  traced bodies, no per-iteration telemetry fetch in driving loops
+  (the luxtrace ring contract, docs/OBSERVABILITY.md).
 
 Meta findings (LUX-X*) keep the suppression machinery itself honest:
 X000 unparsable file, X001 inline suppression without a justification,
@@ -39,6 +42,7 @@ from lux_tpu.analysis.core import (  # noqa: F401
     repo_root,
 )
 from lux_tpu.analysis.determinism import DeterminismChecker
+from lux_tpu.analysis.obs import ObsChecker
 from lux_tpu.analysis.policy import PolicyChecker
 from lux_tpu.analysis.threads import ThreadSafetyChecker
 from lux_tpu.analysis.tracing import TracingSafetyChecker
@@ -49,6 +53,7 @@ ALL_CHECKERS = (
     DeterminismChecker(),
     ThreadSafetyChecker(),
     PolicyChecker(),
+    ObsChecker(),
 )
 
 FAMILIES = tuple(c.family for c in ALL_CHECKERS)

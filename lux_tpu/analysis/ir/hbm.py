@@ -16,7 +16,11 @@ Two cross-checks pin it:
 * LUX-J502 — the roofline dict's per-stage fields must agree with those
   same kernel counts after un-scaling the space factors it applies
   (fused r2 is scaled by n2/n, vr by nv_route/n; ff is a fractional
-  BYTES model, not a kernel count, and is excluded).
+  BYTES model, not a kernel count, and is excluded);
+* LUX-J503 — a pure-telemetry twin (the luxtrace ring in the loop carry,
+  docs/OBSERVABILITY.md) must launch EXACTLY the kernels of its base
+  config: zero added accounted HBM passes is a shipped claim, and a ring
+  that grows a kernel silently skews every hbm_passes bench row.
 """
 from __future__ import annotations
 
@@ -60,6 +64,26 @@ def claimed_kernels(static, claimed: dict) -> Optional[float]:
             return None
         return r1 + r2 + vr
     return r1 + r2
+
+
+def check_kernel_parity(traced_base, traced_twin, path: str, label: str,
+                        line: int = 1) -> List[Finding]:
+    """Audit a telemetry (or other pure-observer) twin against its base
+    config: the twin's ``pallas_call`` count must equal the base's."""
+    n_base = aot.count_primitive(aot.traced_jaxpr(traced_base),
+                                 "pallas_call")
+    n_twin = aot.count_primitive(aot.traced_jaxpr(traced_twin),
+                                 "pallas_call")
+    if n_twin != n_base:
+        return [Finding(
+            path=path, line=line, col=0, code="LUX-J503",
+            message=f"telemetry twin launches {n_twin} pallas_call "
+                    f"kernel(s) vs {n_base} in the base config — the "
+                    "flight-recorder ring is adding HBM passes the "
+                    "roofline accounting (and every bench row's "
+                    "hbm_passes) does not see",
+            text=label)]
+    return []
 
 
 def check_hbm(traced, static, path: str, label: str, line: int = 1,

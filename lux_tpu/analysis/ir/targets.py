@@ -8,10 +8,16 @@ REAL engine entry points — the same jit-wrapped functions the drivers
 call, not reimplementations — and each checker family runs on the
 resulting jaxpr / StableHLO.
 
-``--fast`` covers pull + push + one pass-fused config (the ci_check
-tier); ``--all`` adds the serve batched steps, the distributed push
-engines (allgather + ring, on a host-device mesh), the fused-pf plan,
-and the dynamic-knob recompile probes (chip-day step -3b).
+``--fast`` covers pull + push + one pass-fused config + the luxtrace
+telemetry-ring twins (the ci_check tier); ``--all`` adds the serve
+batched steps, the distributed push engines (allgather + ring, on a
+host-device mesh), the fused-pf plan, and the dynamic-knob recompile
+probes (chip-day step -3b).
+
+The telemetry units ("+ring"/"ring-donate"/"ring-neutral") audit the
+flight-recorder contract (docs/OBSERVABILITY.md): the ring must trace
+like any other config of its family (LUX-J1), donate with the state
+(LUX-J2), and launch zero additional kernels (LUX-J503).
 """
 from __future__ import annotations
 
@@ -105,14 +111,15 @@ def _dev_route(plan):
 # ---------------------------------------------------------------------------
 
 
-def _pull_fixed_traced(num_iters: int, route=None):
+def _pull_fixed_traced(num_iters: int, route=None, ring=None):
     from lux_tpu.engine import pull
 
     fx = fixture()
     rs, ra = _dev_route(route) if route is not None else (None, None)
     return pull._pull_fixed_jit.trace(
         fx["prank"], fx["shards"].spec, num_iters, "scan", fx["arrays"],
-        fx["state0"], route_static=rs, route_arrays=ra, interpret=True)
+        fx["state0"], ring, route_static=rs, route_arrays=ra,
+        interpret=True)
 
 
 def _retrace_pull_fixed(routed: bool) -> List[Finding]:
@@ -147,6 +154,27 @@ def _retrace_pull_until() -> List[Finding]:
         statics=(fx["prank"], fx["shards"].spec, _active_fn, "scan"))
     out += retrace.check_variants([tr(2), tr(3)], path,
                                   "pull-until/direct")
+    return out
+
+
+def _retrace_pull_fixed_ring() -> List[Finding]:
+    """The luxtrace ring's LUX-J1 leg (docs/OBSERVABILITY.md): the
+    telemetry ring is static-shape loop carry, so telemetry-on must
+    trace exactly like any other config of the family — stable across
+    re-traces of one config and structurally identical across iteration
+    counts (one compile still serves every run length)."""
+    from lux_tpu.obs import ring as obs_ring
+
+    fx = fixture()
+    route = fx["plan_pf"]
+    ring = obs_ring.new_ring("pull_fixed")
+    path = "lux_tpu/engine/pull.py"
+    label = "pull-fixed/routed-pf+ring"
+    out = retrace.trace_twice_stable(
+        lambda: _pull_fixed_traced(2, route, ring), path, label)
+    out += retrace.check_variants(
+        [_pull_fixed_traced(2, route, ring),
+         _pull_fixed_traced(3, route, ring)], path, label)
     return out
 
 
@@ -306,6 +334,43 @@ def _donation_push_step() -> List[Finding]:
         label="push-step/donate")
 
 
+def _donation_pull_fixed_ring() -> List[Finding]:
+    """The luxtrace ring's LUX-J2 leg: a donating telemetry run must
+    consume the ring's input buffer WITH the state (the ring is pure
+    loop carry — one ring copy in HBM, not two)."""
+    from lux_tpu.engine import pull
+    from lux_tpu.obs import ring as obs_ring
+
+    fx = fixture()
+    ring = obs_ring.new_ring("pull_fixed", cap=64)
+    args = (fx["arrays"], fx["state0"], ring)
+    traced = pull._pull_fixed_jit_donate.trace(
+        fx["prank"], fx["shards"].spec, 3, "scan", *args,
+        route_static=None, route_arrays=None, interpret=True)
+    return donation.check_donation(
+        traced, args, donate_argnums=(1, 2), path="lux_tpu/engine/pull.py",
+        label="pull-fixed/ring-donate")
+
+
+def _donation_push_chunk_ring() -> List[Finding]:
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import push
+    from lux_tpu.obs import ring as obs_ring
+
+    fx = fixture()
+    sh = fx["pshards"]
+    loop = push.compile_push_chunk(fx["psssp"], sh.pspec, sh.spec, "scan",
+                                   donate=True, telemetry=True)
+    arrays, parrays, carry0 = push.push_init(fx["psssp"], sh)
+    ring = obs_ring.new_ring("push", cap=64)
+    args = (arrays, parrays, carry0, jnp.int32(4), ring)
+    traced = loop.trace(*args)
+    return donation.check_donation(
+        traced, args, donate_argnums=(2, 4), path="lux_tpu/engine/push.py",
+        label="push-chunk/ring-donate")
+
+
 def _donation_serve(app: str) -> List[Finding]:
     run, args = _serve_traced(app, 4)
     traced = run.trace(*args)
@@ -421,6 +486,20 @@ def _hbm_expand(routed_pf: bool) -> List[Finding]:
     return hbm.check_hbm(traced, rs, "lux_tpu/ops/expand.py", label)
 
 
+def _hbm_ring_neutral() -> List[Finding]:
+    """The luxtrace ring's LUX-J5 leg: telemetry-on launches EXACTLY the
+    kernels of telemetry-off on the routed-pf hot loop — zero added
+    accounted HBM passes (the shipped claim in docs/OBSERVABILITY.md)."""
+    from lux_tpu.obs import ring as obs_ring
+
+    fx = fixture()
+    route = fx["plan_pf"]
+    base = _pull_fixed_traced(2, route)
+    twin = _pull_fixed_traced(2, route, obs_ring.new_ring("pull_fixed"))
+    return hbm.check_kernel_parity(base, twin, "lux_tpu/engine/pull.py",
+                                   "pull-fixed/ring-neutral")
+
+
 def _hbm_fused_pf() -> List[Finding]:
     import jax
 
@@ -451,6 +530,9 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
         AuditUnit("retrace", "pull-fixed/routed-pf",
                   "lux_tpu/engine/pull.py", True,
                   lambda: _retrace_pull_fixed(True)),
+        AuditUnit("retrace", "pull-fixed/routed-pf+ring",
+                  "lux_tpu/engine/pull.py", True,
+                  _retrace_pull_fixed_ring),
         AuditUnit("retrace", "pull-until/direct",
                   "lux_tpu/engine/pull.py", False, _retrace_pull_until),
         AuditUnit("retrace", "push-chunk/it_stop",
@@ -472,6 +554,12 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   "lux_tpu/engine/push.py", True, _donation_push_chunk),
         AuditUnit("donation", "push-step/donate",
                   "lux_tpu/engine/push.py", False, _donation_push_step),
+        AuditUnit("donation", "pull-fixed/ring-donate",
+                  "lux_tpu/engine/pull.py", True,
+                  _donation_pull_fixed_ring),
+        AuditUnit("donation", "push-chunk/ring-donate",
+                  "lux_tpu/engine/push.py", False,
+                  _donation_push_chunk_ring),
         AuditUnit("donation", "serve-sssp/donate",
                   "lux_tpu/serve/batched.py", False,
                   lambda: _donation_serve("sssp")),
@@ -492,6 +580,8 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   lambda: _hbm_expand(False)),
         AuditUnit("hbm", "expand-pf", "lux_tpu/ops/expand.py", True,
                   lambda: _hbm_expand(True)),
+        AuditUnit("hbm", "pull-fixed/ring-neutral",
+                  "lux_tpu/engine/pull.py", True, _hbm_ring_neutral),
         AuditUnit("hbm", "fused-pf", "lux_tpu/ops/expand.py", False,
                   _hbm_fused_pf),
     ]

@@ -31,8 +31,10 @@ from typing import Any, Callable, Protocol
 import jax
 import jax.numpy as jnp
 
+from lux_tpu import obs
 from lux_tpu.engine import methods
 from lux_tpu.graph.shards import ShardArrays, ShardSpec
+from lux_tpu.obs import ring as obs_ring
 from lux_tpu.ops import segment
 
 
@@ -258,13 +260,28 @@ def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "auto"
 
 
 def _pull_fixed_fn(prog, spec, num_iters, method, arrays, state0,
-                   route_static=None, route_arrays=None,
+                   ring=None, route_static=None, route_arrays=None,
                    interpret=False):
     def body(_, state):
         return _pull_iteration(prog, spec, method, arrays, state,
                                route_static, route_arrays, interpret)
 
-    return jax.lax.fori_loop(0, num_iters, body, state0)
+    if ring is None:
+        return jax.lax.fori_loop(0, num_iters, body, state0)
+
+    # telemetry twin: the ring rides the SAME fori carry (static shapes,
+    # LUX-J1) and the state math is untouched — bitwise-identical
+    # results, one extra O(P*V) residual reduction per iteration against
+    # the O(E) gather work (obs/ring.py; the l1 residual is the
+    # convergence curve for the fixed-iteration apps)
+    def body_t(i, carry):
+        state, rg = carry
+        new = body(i, state)
+        resid = jnp.sum(jnp.abs(new.astype(jnp.float32)
+                                - state.astype(jnp.float32)))
+        return new, obs_ring.ring_push(rg, i, resid)
+
+    return jax.lax.fori_loop(0, num_iters, body_t, (state0, ring))
 
 
 _PULL_FIXED_STATICS = ("prog", "spec", "num_iters", "method",
@@ -276,9 +293,13 @@ _pull_fixed_jit = jax.jit(_pull_fixed_fn,
 #: copies for the whole run (the reference's dist_lr[2] double buffer,
 #: core/graph.h:83, without the second copy).  Opt-in via ``donate=``:
 #: benchmark timing loops re-run from one s0 and must keep it alive.
+#: The telemetry ring (positional 6) is donated WITH the state: it is
+#: pure loop carry, so its input buffer is dead the moment the loop
+#: starts (None when telemetry is off — an empty pytree donates
+#: nothing; luxaudit LUX-J2 audits both aliases).
 _pull_fixed_jit_donate = jax.jit(_pull_fixed_fn,
                                  static_argnames=_PULL_FIXED_STATICS,
-                                 donate_argnums=(5,))
+                                 donate_argnums=(5, 6))
 
 
 def run_pull_fixed(
@@ -290,6 +311,7 @@ def run_pull_fixed(
     method: str = "auto",
     route=None,
     donate: bool = False,
+    telemetry=None,
 ):
     """Single-device driver: fixed iteration count (PageRank/CF style,
     pagerank/pagerank.cc:109-114).  Whole loop stays on device; the
@@ -302,6 +324,10 @@ def run_pull_fixed(
     that to ~7, same bits).  ``donate=True`` donates ``state0`` to the
     loop (jit donate_argnums) so the hot loop holds ONE full state copy
     in HBM instead of two — the caller must not reuse ``state0`` after.
+    ``telemetry`` (an ``obs.ring.new_ring("pull_fixed")``) carries the
+    per-iteration residual curve in the loop carry — results stay
+    bitwise-identical, the return becomes (state, ring), and a donating
+    run consumes the ring with the state.
     Returns the final stacked (P, V, ...) state.
     """
     method = methods.resolve(method, prog.reduce)
@@ -309,8 +335,11 @@ def run_pull_fixed(
     rs, ra = route if route is not None else (None, None)
     if ra is not None:
         ra = jax.tree.map(jnp.asarray, ra)
+    tel = telemetry
+    if tel is not None:
+        tel = jax.tree.map(jnp.asarray, tel)
     fn = _pull_fixed_jit_donate if donate else _pull_fixed_jit
-    return fn(prog, spec, num_iters, method, arrays, state0,
+    return fn(prog, spec, num_iters, method, arrays, state0, tel,
               route_static=rs, route_arrays=ra,
               interpret=_route_interpret())
 
@@ -361,15 +390,20 @@ def run_pull_fixed_overlapped(
     done = 0
     while done < num_iters and not route_future.ready():
         k = min(chunk, num_iters - done)
-        # chunks after the first own their input state (the previous
-        # chunk's output) — donate it so the handover loop never holds
-        # two full state copies; the caller's state0 itself stays alive
-        state = run_pull_fixed(prog, spec, arrays, state, k, method,
-                               donate=done > 0)
-        # materialize before re-polling: dispatch is async, so without a
-        # sync the loop would queue every chunk before the future could
-        # ever win the race
-        jax.block_until_ready(state)
+        # per-chunk flight-recorder span (host-side, OUTSIDE the
+        # compiled loop — the block_until_ready below is the handover
+        # race's own fence, not a telemetry one)
+        with obs.span("pull.chunk", k=k, done=done, routed=False):
+            # chunks after the first own their input state (the previous
+            # chunk's output) — donate it so the handover loop never
+            # holds two full state copies; the caller's state0 itself
+            # stays alive
+            state = run_pull_fixed(prog, spec, arrays, state, k, method,
+                                   donate=done > 0)
+            # materialize before re-polling: dispatch is async, so
+            # without a sync the loop would queue every chunk before the
+            # future could ever win the race
+            jax.block_until_ready(state)
         done += k
     if done >= num_iters:
         return state, 0
@@ -378,11 +412,16 @@ def run_pull_fixed_overlapped(
         # mixing associations mid-run is invalid; the direct result IS a
         # valid deterministic answer, so finish direct rather than throw
         # away the iterations already computed
-        state = run_pull_fixed(prog, spec, arrays, state,
-                               num_iters - done, method, donate=done > 0)
+        with obs.span("pull.chunk", k=num_iters - done, done=done,
+                      routed=False, fused_skip=True):
+            state = run_pull_fixed(prog, spec, arrays, state,
+                                   num_iters - done, method,
+                                   donate=done > 0)
         return state, 0
-    state = run_pull_fixed(prog, spec, arrays, state, num_iters - done,
-                           method, route=route, donate=done > 0)
+    with obs.span("pull.chunk", k=num_iters - done, done=done,
+                  routed=True):
+        state = run_pull_fixed(prog, spec, arrays, state, num_iters - done,
+                               method, route=route, donate=done > 0)
     return state, num_iters - done
 
 
@@ -396,6 +435,7 @@ def run_pull_until(
     method: str = "auto",
     route=None,
     donate: bool = False,
+    telemetry=None,
 ):
     """Single-device driver: iterate until no vertex is active (the push-app
     convergence contract — total active count == 0, sssp/sssp.cc:115-129 —
@@ -404,6 +444,9 @@ def run_pull_until(
     active_fn(old_stacked, new_stacked) -> per-part active counts (P,);
     pass a top-level function (hashable) so the compiled loop caches.
     ``donate=True`` consumes ``state0`` (see run_pull_fixed).
+    ``telemetry`` (``obs.ring.new_ring("pull_until")``) records the
+    per-iteration active count in the loop carry (bitwise no-op on the
+    state; the return becomes (state, iters, ring)).
     Returns (final_state, num_iters_run).
     """
     method = methods.resolve(method, prog.reduce)
@@ -411,29 +454,39 @@ def run_pull_until(
     rs, ra = route if route is not None else (None, None)
     if ra is not None:
         ra = jax.tree.map(jnp.asarray, ra)
+    tel = telemetry
+    if tel is not None:
+        tel = jax.tree.map(jnp.asarray, tel)
     fn = _pull_until_jit_donate if donate else _pull_until_jit
     return fn(prog, spec, max_iters, active_fn, method, arrays,
-              state0, route_static=rs, route_arrays=ra,
+              state0, tel, route_static=rs, route_arrays=ra,
               interpret=_route_interpret())
 
 
 def _pull_until_fn(prog, spec, max_iters, active_fn, method, arrays, state0,
-                   route_static=None, route_arrays=None, interpret=False):
+                   ring=None, route_static=None, route_arrays=None,
+                   interpret=False):
     def cond(carry):
-        _, it, active = carry
-        return (active > 0) & (it < max_iters)
+        return (carry[2] > 0) & (carry[1] < max_iters)
 
     def body(carry):
-        state, it, _ = carry
+        state, it = carry[0], carry[1]
         new = _pull_iteration(prog, spec, method, arrays, state,
                               route_static, route_arrays, interpret)
         active = jnp.sum(active_fn(state, new))
-        return new, it + 1, active
+        if ring is None:
+            return new, it + 1, active
+        # telemetry rides the while carry (static shapes; the recorded
+        # active count is the one the convergence test already computes)
+        return new, it + 1, active, obs_ring.ring_push(carry[3], it, active)
 
-    state, iters, _ = jax.lax.while_loop(
-        cond, body, (state0, jnp.int32(0), jnp.int32(1))
-    )
-    return state, iters
+    init = (state0, jnp.int32(0), jnp.int32(1))
+    if ring is not None:
+        init = init + (ring,)
+    out = jax.lax.while_loop(cond, body, init)
+    if ring is None:
+        return out[0], out[1]
+    return out[0], out[1], out[3]
 
 
 _PULL_UNTIL_STATICS = ("prog", "spec", "max_iters", "active_fn", "method",
@@ -442,7 +495,8 @@ _pull_until_jit = jax.jit(_pull_until_fn,
                           static_argnames=_PULL_UNTIL_STATICS)
 #: donating twin of the convergence loop (state0 = positional 6); the
 #: old state is folded into the while carry immediately, so donation
-#: frees the input buffer for the loop's ping-pong
+#: frees the input buffer for the loop's ping-pong.  The telemetry ring
+#: (positional 7) is carry too and donates alongside (None = no-op).
 _pull_until_jit_donate = jax.jit(_pull_until_fn,
                                  static_argnames=_PULL_UNTIL_STATICS,
-                                 donate_argnums=(6,))
+                                 donate_argnums=(6, 7))

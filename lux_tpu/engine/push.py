@@ -355,7 +355,8 @@ def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
 
 
 def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
-                       method: str = "auto", donate: bool = False):
+                       method: str = "auto", donate: bool = False,
+                       telemetry: bool = False):
     """Single-device push loop with a DYNAMIC iteration stop (one compile
     serves every run length and every adaptive-repartition window; the
     driver inspects the carry's load stats between windows).
@@ -367,18 +368,27 @@ def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
     The caller must not reuse the carry it passed in.  luxaudit LUX-J2
     asserts the aliases actually land in the lowered module.
 
+    ``telemetry=True`` selects the flight-recorder twin:
+    ``loop(arrays, parrays, carry, it_stop, ring)`` with an
+    ``obs.ring.new_ring("push")`` riding the while carry, recording
+    (iteration, frontier size, traversed edges, direction) per round —
+    every column derived from the carry the engine already maintains, so
+    the state math (and its bytes) is untouched.  Returns (carry, ring);
+    ``donate`` consumes the ring with the carry.
+
     Resolution happens OUTSIDE the compile cache: caching on "auto" would
     pin the first platform resolution for the process and split the cache
     between "auto" and its concrete equivalent."""
     return _compile_push_chunk_cached(
         prog, pspec, spec, methods.resolve(method, prog.reduce),
-        donate=donate,
+        donate=donate, telemetry=telemetry,
     )
 
 
 def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
                               route_static, method: str = "auto",
-                              donate: bool = False):
+                              donate: bool = False,
+                              telemetry: bool = False):
     """compile_push_chunk with the dense rounds' gather routed
     (interpret mode resolved here, off-chip = CPU tests)."""
     from lux_tpu.engine.pull import _route_interpret
@@ -386,14 +396,18 @@ def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
     return _compile_push_chunk_cached(
         prog, pspec, spec, methods.resolve(method, prog.reduce),
         route_static=route_static, interpret=_route_interpret(),
-        donate=donate,
+        donate=donate, telemetry=telemetry,
     )
 
 
 @lru_cache(maxsize=64)
 def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
                                method: str, route_static=None,
-                               interpret=False, donate=False):
+                               interpret=False, donate=False,
+                               telemetry=False):
+    if telemetry:
+        return _compile_push_chunk_telemetry(
+            prog, pspec, spec, method, route_static, interpret, donate)
 
     @partial(jax.jit, donate_argnums=(2,) if donate else ())
     def loop(arrays, parrays, carry: PushCarry, it_stop, route_arrays=None):
@@ -406,6 +420,42 @@ def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
                                    interpret)
 
         return jax.lax.while_loop(cond, body, carry)
+
+    return loop
+
+
+def _compile_push_chunk_telemetry(prog, pspec: PushSpec, spec: ShardSpec,
+                                  method: str, route_static, interpret,
+                                  donate):
+    """The flight-recorder twin of the push chunk loop (see
+    compile_push_chunk).  A separate compile, cached under the same
+    lru key family: the ring rides the while carry, every recorded
+    column is a pure DERIVATION of consecutive carries (frontier =
+    queued count, traversed = edge-counter delta, direction =
+    dense-round delta), so the engine body is byte-for-byte the
+    non-telemetry one."""
+    from lux_tpu.obs import ring as obs_ring
+
+    @partial(jax.jit, donate_argnums=(2, 4) if donate else ())
+    def loop(arrays, parrays, carry: PushCarry, it_stop, ring,
+             route_arrays=None):
+        def cond(cr):
+            c, _ = cr
+            return (c.active > 0) & (c.it < it_stop)
+
+        def body(cr):
+            c, rg = cr
+            c2 = _push_iteration(prog, pspec, spec, method, arrays,
+                                 parrays, c, route_static, route_arrays,
+                                 interpret)
+            # uint32 wrap-around subtraction gives the exact per-round
+            # traversed count (< 2^32 per round by construction)
+            rg = obs_ring.ring_push(
+                rg, c.it, c.active, c2.edges[1] - c.edges[1],
+                c2.dense_rounds - c.dense_rounds)
+            return c2, rg
+
+        return jax.lax.while_loop(cond, body, (carry, ring))
 
     return loop
 
@@ -487,6 +537,7 @@ def run_push(
     method: str = "auto",
     route=None,
     donate: bool = False,
+    telemetry=None,
 ):
     """Single-device driver.  The direction switch is one global `lax.cond`
     over vmapped per-part branches — a genuine branch (only the taken mode
@@ -498,23 +549,35 @@ def run_push(
     consumed, so the hot loop holds ONE state + queue copy in HBM
     instead of two (the pull engine's ``donate=`` contract on the push
     side; opt-in because benchmark drivers re-run from one carry).
-    Returns (final stacked state, iters, edge counter).
+    ``telemetry`` (``obs.ring.new_ring("push")``) records the
+    per-iteration frontier/traversed/direction curve in the loop carry
+    (bitwise no-op on the results; the return gains the fetched ring).
+    Returns (final stacked state, iters, edge counter[, ring]).
     """
     method = methods.resolve(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     parrays = jax.tree.map(jnp.asarray, shards.parrays)
     carry0 = _init_carry(prog, pspec, arrays)
+    tel = telemetry
+    if tel is not None:
+        tel = jax.tree.map(jnp.asarray, tel)
+    extra = () if tel is None else (tel,)
     if route is None:
-        loop = compile_push_chunk(prog, pspec, spec, method, donate=donate)
-        out = loop(arrays, parrays, carry0, jnp.int32(max_iters))
+        loop = compile_push_chunk(prog, pspec, spec, method, donate=donate,
+                                  telemetry=tel is not None)
+        out = loop(arrays, parrays, carry0, jnp.int32(max_iters), *extra)
     else:
         rs, ra = route
         ra = jax.tree.map(jnp.asarray, ra)
         loop = compile_push_chunk_routed(prog, pspec, spec, rs, method,
-                                         donate=donate)
-        out = loop(arrays, parrays, carry0, jnp.int32(max_iters),
+                                         donate=donate,
+                                         telemetry=tel is not None)
+        out = loop(arrays, parrays, carry0, jnp.int32(max_iters), *extra,
                    route_arrays=ra)
+    if tel is not None:
+        out, ring = out
+        return out.state, out.it, out.edges, ring
     return out.state, out.it, out.edges
 
 

@@ -41,6 +41,16 @@ def read_lux(path: str, weighted: Optional[bool] = None, mmap: bool = True) -> H
         explicitly in those cases.
       mmap: memory-map the arrays instead of copying (read-only views).
     """
+    from lux_tpu import obs
+
+    with obs.span("graph.load", file=os.path.basename(path),
+                  mmap=mmap) as sp:
+        g = _read_lux_impl(path, weighted, mmap)
+        sp.set(nv=g.nv, ne=g.ne, weighted=g.weights is not None)
+        return g
+
+
+def _read_lux_impl(path: str, weighted: Optional[bool], mmap: bool) -> HostGraph:
     size = os.path.getsize(path)
     with open(path, "rb") as f:
         header = f.read(LUX_HEADER_BYTES)

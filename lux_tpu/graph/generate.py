@@ -20,27 +20,32 @@ def rmat(
     """Recursive-matrix (Graph500-style) power-law graph: nv = 2**scale,
     ne = nv * edge_factor.  Matches the scale recipe of the reference's RMAT27
     dataset (nv=2^27, ne=2^31 at edge_factor 16, README.md:83)."""
-    rng = np.random.default_rng(seed)
-    nv = 1 << scale
-    ne = nv * edge_factor
-    src = np.zeros(ne, dtype=np.int64)
-    dst = np.zeros(ne, dtype=np.int64)
-    ab = a + b
-    c_norm = c / (1.0 - ab)
-    a_norm = a / ab
-    for bit in range(scale):
-        r1 = rng.random(ne)
-        r2 = rng.random(ne)
-        src_bit = r1 > ab
-        dst_bit = np.where(src_bit, r2 > c_norm, r2 > a_norm)
-        src |= src_bit.astype(np.int64) << bit
-        dst |= dst_bit.astype(np.int64) << bit
-    # Permute vertex labels to avoid degree locality artifacts.
-    perm = rng.permutation(nv)
-    src = perm[src]
-    dst = perm[dst]
-    w = rng.integers(1, max_weight + 1, size=ne).astype(np.int32) if weighted else None
-    return from_edge_list(src, dst, nv, weights=w)
+    from lux_tpu import obs
+
+    with obs.span("graph.generate", kind="rmat", scale=scale,
+                  ef=edge_factor, seed=seed):
+        rng = np.random.default_rng(seed)
+        nv = 1 << scale
+        ne = nv * edge_factor
+        src = np.zeros(ne, dtype=np.int64)
+        dst = np.zeros(ne, dtype=np.int64)
+        ab = a + b
+        c_norm = c / (1.0 - ab)
+        a_norm = a / ab
+        for bit in range(scale):
+            r1 = rng.random(ne)
+            r2 = rng.random(ne)
+            src_bit = r1 > ab
+            dst_bit = np.where(src_bit, r2 > c_norm, r2 > a_norm)
+            src |= src_bit.astype(np.int64) << bit
+            dst |= dst_bit.astype(np.int64) << bit
+        # Permute vertex labels to avoid degree locality artifacts.
+        perm = rng.permutation(nv)
+        src = perm[src]
+        dst = perm[dst]
+        w = (rng.integers(1, max_weight + 1, size=ne).astype(np.int32)
+             if weighted else None)
+        return from_edge_list(src, dst, nv, weights=w)
 
 
 def uniform_random(

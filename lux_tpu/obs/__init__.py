@@ -1,0 +1,36 @@
+"""lux_tpu.obs — luxtrace, the always-on flight recorder.
+
+Three layers, one event log per run:
+
+* **recorder** (pure stdlib) — ``span()``/``point()`` context managers
+  writing an append-only JSONL event log under a uid-checked 0o700 dir;
+  nested span ids, monotonic timestamps, a ``run_id`` that bench rows
+  and AUDIT/PROGRESS entries also carry.  Crash-safe by construction:
+  begin events are on disk before the work they cover runs.
+* **ring** (jax) — fixed-capacity on-device iteration telemetry carried
+  in the hot-loop carry (static shapes, donated with the state, fetched
+  once at run end); bitwise no-op on results, enforced by LUX-J1/J2/J5
+  and the LUX-O checker family.
+* **xprof** (stdlib) — parses the captured XProf/Perfetto trace and
+  attributes device time to the routed-pf kernels vs gather/scatter/
+  collectives.
+
+``tools/luxview.py`` renders any event log into the human report;
+``tools/chip_day.sh`` spans every battery step so an aborted window
+still leaves a complete post-mortem artifact.  Schema + design notes:
+docs/OBSERVABILITY.md.
+
+This ``__init__`` (and recorder) stays jax-free so the tools can import
+it under the same bare-package stub luxcheck uses; ``ring``/``xprof``
+import lazily where needed.
+"""
+from lux_tpu.obs.recorder import (  # noqa: F401
+    Recorder,
+    Span,
+    install,
+    new_run_id,
+    point,
+    recorder,
+    run_id,
+    span,
+)

@@ -1,0 +1,397 @@
+"""luxtrace recorder: the always-on flight recorder's host half.
+
+The reference ships observability it never uses (Legion Prof/Spy behind
+-lg:* flags, SURVEY.md §5); this repo's gap was the same shape — five
+VERDICT rounds of "a window closed and we cannot reconstruct where the
+time went".  The recorder turns every run into an attributable artifact:
+one append-only JSONL event log per run, written THROUGH crashes (begin
+events hit disk before the work they cover runs, so a process killed
+mid-step leaves an unfinished span, not a blank file).
+
+Design constraints, in order:
+
+* pure stdlib — importing this module must never pull in jax/numpy
+  (tools/luxview.py runs it under the same jax-free package stub as
+  luxcheck, on hosts whose tunnel is in ANY state);
+* always-on, never load-bearing — a full disk, an untrusted log dir, or
+  LUX_OBS=0 degrade to in-memory aggregation only; no caller branches on
+  recorder health, and recorder failure can never fail a run;
+* cheap — one span is two dict->JSON lines on a line-buffered fd plus a
+  lock'd counter bump; the hot loops themselves carry their telemetry
+  ON DEVICE (lux_tpu.obs.ring) and the recorder only sees the single
+  end-of-run fetch.
+
+Event vocabulary (one JSON object per line):
+
+  {"e":"m", "run":..,"pid":..,"wall":..,"mono":..,"argv":[..]}   file meta
+  {"e":"b", "n":name,"s":sid,"p":parent_sid|null,"t":mono,"a":{..}}
+  {"e":"e", "s":sid,"t":mono,"ok":bool,"a":{..}}                 span end
+  {"e":"p", "n":name,"t":mono,"a":{..}}                          point
+
+Span ids are "<pid>-<token>-<counter>" (the token is per-process random:
+a long battery recycles pids, and two processes issuing "1234-1" would
+let a later begin overwrite an earlier span in luxview's merge — masking
+exactly the OPEN span a post-mortem exists to show) so events from
+different processes of the same run (bench orchestrator + workers, every
+chip_day step) merge into one timeline: CLOCK_MONOTONIC is system-wide
+on Linux, so cross-process ``t`` values are directly comparable and the
+meta event's (wall, mono) pair anchors them to calendar time.
+
+The run directory is vetted exactly like the plan cache
+(ops/expand._cache_dir_trusted): 0o700, owned by this uid, no symlink —
+and the log is JSON-only by construction (luxcheck LUX-P001 scans this
+package like any other).
+"""
+from __future__ import annotations
+
+import binascii
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional
+
+#: LUX_OBS=0 disables FILE writes (in-memory span totals still
+#: aggregate: plan_build_seconds and the bench phases view depend on
+#: them, and they must never depend on log-dir health)
+ENABLE_ENV = "LUX_OBS"
+DIR_ENV = "LUX_OBS_DIR"
+RUN_ENV = "LUX_OBS_RUN_ID"
+#: retention: the recorder is always-on, so without a bound the root
+#: accumulates one run dir per bench/serve/test/ci invocation until the
+#: disk fills — and a full disk silently disables the post-mortem
+#: logging the feature exists for.  Keep the newest N run dirs (the plan
+#: cache's analogous bounded contract); <= 0 disables the sweep.
+KEEP_ENV = "LUX_OBS_KEEP"
+DEFAULT_KEEP = 64
+#: never sweep a dir whose newest file was written in the last hour —
+#: a live run beyond the keep horizon must not lose its log mid-write
+SWEEP_MIN_AGE_S = 3600.0
+
+
+def default_root() -> str:
+    """Per-user event-log root, the plan cache's sibling."""
+    uid = os.getuid() if hasattr(os, "getuid") else "na"
+    return os.environ.get(DIR_ENV) or os.path.join(
+        tempfile.gettempdir(), f"lux_obs_{uid}")
+
+
+def _dir_trusted(path: str) -> bool:
+    """Create (0o700) and vet an event-log dir: refuse symlinks, foreign
+    owners, and group/other access — same contract as the plan cache."""
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.lstat(path)
+    except OSError:
+        return False
+    if os.path.islink(path) or not os.path.isdir(path):
+        return False
+    if hasattr(os, "getuid") and st.st_uid != os.getuid():
+        return False
+    if st.st_mode & 0o077:
+        try:  # repair a pre-existing loose dir we own
+            os.chmod(path, 0o700)
+        except OSError:
+            return False
+    return True
+
+
+def _sweep_old_runs(root: str, current_dir: str) -> None:
+    """Delete the oldest run dirs beyond the keep horizon (newest file
+    mtime orders them); the current run and anything written within
+    SWEEP_MIN_AGE_S are never touched.  All failures are absorbed —
+    retention, like everything else here, can never fail a run."""
+    raw = os.environ.get(KEEP_ENV, "")
+    try:
+        keep = int(raw) if raw.strip() else DEFAULT_KEEP
+    except ValueError:
+        keep = DEFAULT_KEEP
+    if keep <= 0:
+        return
+    try:
+        cur = os.path.realpath(current_dir)
+        now = time.time()
+        entries = []
+        with os.scandir(root) as it:
+            for de in it:
+                if not de.is_dir(follow_symlinks=False):
+                    continue
+                if os.path.realpath(de.path) == cur:
+                    continue
+                try:
+                    newest = de.stat(follow_symlinks=False).st_mtime
+                    with os.scandir(de.path) as files:
+                        for f in files:
+                            try:
+                                st = f.stat(follow_symlinks=False)
+                            except OSError:
+                                continue
+                            newest = max(newest, st.st_mtime)
+                except OSError:
+                    continue
+                entries.append((newest, de.path))
+        entries.sort(reverse=True)
+        # the current run dir occupies one keep slot
+        for newest, path in entries[max(keep - 1, 0):]:
+            if now - newest < SWEEP_MIN_AGE_S:
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+    except OSError:
+        pass
+
+
+def new_run_id() -> str:
+    """Collision-proof human-sortable run id.  Wall clock + pid + random
+    suffix; never feeds results or cache keys (luxcheck LUX-D002 scopes
+    wall-clock out of engine code — this is the metadata layer)."""
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    rand = binascii.hexlify(os.urandom(3)).decode()
+    return f"{stamp}_{os.getpid()}_{rand}"
+
+
+class Span:
+    """One live span.  Use via ``Recorder.span`` / module-level ``span``:
+
+        with span("plan.build", parts=4) as sp:
+            ...
+            sp.set(bytes=n)        # attrs attached to the END event
+        sp.dur                     # seconds, available after exit
+    """
+
+    __slots__ = ("_rec", "name", "sid", "parent", "attrs", "_end_attrs",
+                 "t0", "dur", "ok")
+
+    def __init__(self, rec: "Recorder", name: str, attrs: dict):
+        self._rec = rec
+        self.name = name
+        self.attrs = attrs
+        self._end_attrs: dict = {}
+        self.sid = ""
+        self.parent: Optional[str] = None
+        self.t0 = 0.0
+        self.dur = 0.0
+        self.ok = True
+
+    def set(self, **attrs) -> "Span":
+        self._end_attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._rec._begin(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.ok = exc_type is None
+        self._rec._end(self)
+        return False
+
+
+class Recorder:
+    """Process-wide flight recorder; one JSONL file per (run, process).
+
+    Thread-safe: the span stack is per-thread (nesting follows each
+    thread's own call structure), the file and the aggregation table are
+    lock-guarded.  All failures are absorbed — a recorder can degrade to
+    memory-only but can never raise into the instrumented code path.
+    """
+
+    def __init__(self, run_id: Optional[str] = None,
+                 root: Optional[str] = None,
+                 enabled: Optional[bool] = None,
+                 clock=time.monotonic):
+        self.run_id = (run_id or os.environ.get(RUN_ENV) or new_run_id())
+        self.root = root or default_root()
+        if enabled is None:
+            enabled = os.environ.get(ENABLE_ENV, "1") != "0"
+        self.enabled = enabled
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._file = None
+        self._file_failed = False
+        self._swept = False
+        # pid reuse over a long battery must not collide sids (luxview
+        # merges all of a run's files into one flat span table)
+        self._sid_prefix = (
+            f"{os.getpid()}-{binascii.hexlify(os.urandom(2)).decode()}")
+        self._next_sid = 0
+        #: span name -> [count, total_seconds]; the single clock behind
+        #: plan_build_seconds AND the bench ``phases`` dict (no drift:
+        #: both are views over the same span durations)
+        self._totals: dict[str, list] = {}
+        self.log_path: Optional[str] = None
+
+    # -- file plumbing --------------------------------------------------
+
+    def run_dir(self) -> str:
+        return os.path.join(self.root, self.run_id)
+
+    def _open(self):
+        """Lazy line-buffered open; one failure disables file output for
+        the process (memory aggregation continues)."""
+        if self._file is not None or self._file_failed or not self.enabled:
+            return self._file
+        d = self.run_dir()
+        if not _dir_trusted(self.root) or not _dir_trusted(d):
+            self._file_failed = True
+            return None
+        if not self._swept:
+            self._swept = True
+            _sweep_old_runs(self.root, d)
+        try:
+            path = os.path.join(d, f"events-{os.getpid()}.jsonl")
+            self._file = open(path, "a", buffering=1, encoding="utf-8")
+            self.log_path = path
+            self._file.write(json.dumps({
+                "e": "m", "run": self.run_id, "pid": os.getpid(),
+                "wall": time.time(), "mono": self.clock(),
+                "argv": sys.argv[:4],
+            }, default=str) + "\n")
+        except OSError:
+            self._file_failed = True
+            self._file = None
+        return self._file
+
+    def _write(self, obj: dict) -> None:
+        with self._lock:
+            f = self._open()
+            if f is None:
+                return
+            try:
+                f.write(json.dumps(obj, default=str) + "\n")
+            except (OSError, ValueError, TypeError):
+                self._file_failed = True
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    # -- spans ----------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def _begin(self, sp: Span) -> None:
+        with self._lock:
+            self._next_sid += 1
+            sp.sid = f"{self._sid_prefix}-{self._next_sid}"
+        st = self._stack()
+        sp.parent = st[-1] if st else None
+        st.append(sp.sid)
+        sp.t0 = self.clock()
+        ev = {"e": "b", "n": sp.name, "s": sp.sid, "p": sp.parent,
+              "t": sp.t0}
+        if sp.attrs:
+            ev["a"] = sp.attrs
+        self._write(ev)
+
+    def _end(self, sp: Span) -> None:
+        t1 = self.clock()
+        sp.dur = t1 - sp.t0
+        st = self._stack()
+        if st and st[-1] == sp.sid:
+            st.pop()
+        elif sp.sid in st:  # mis-nested exit: drop through to it
+            del st[st.index(sp.sid):]
+        if sp.ok:
+            # only completed spans feed the aggregate: the totals are
+            # the ONE clock behind plan_build_seconds and the bench
+            # phases dict, and a failed plan.load (rebuilt under
+            # plan.build) must not drift the two numbers apart.
+            # Failure timings stay in the event log, ok=false.
+            with self._lock:
+                tot = self._totals.setdefault(sp.name, [0, 0.0])
+                tot[0] += 1
+                tot[1] += sp.dur
+        ev = {"e": "e", "s": sp.sid, "t": t1, "ok": sp.ok}
+        if sp._end_attrs:
+            ev["a"] = sp._end_attrs
+        self._write(ev)
+
+    def point(self, name: str, **attrs) -> None:
+        ev = {"e": "p", "n": name, "t": self.clock()}
+        if attrs:
+            ev["a"] = attrs
+        self._write(ev)
+
+    # -- aggregation (the "one clock" view) -----------------------------
+
+    def total_seconds(self, name: str) -> float:
+        with self._lock:
+            tot = self._totals.get(name)
+            return tot[1] if tot else 0.0
+
+    def total_count(self, name: str) -> int:
+        with self._lock:
+            tot = self._totals.get(name)
+            return tot[0] if tot else 0
+
+    def totals(self, prefix: str = "") -> dict:
+        """{name: (count, seconds)} snapshot for names under prefix."""
+        with self._lock:
+            return {k: (v[0], v[1]) for k, v in self._totals.items()
+                    if k.startswith(prefix)}
+
+    def reset_totals(self, prefix: str = "") -> None:
+        with self._lock:
+            for k in list(self._totals):
+                if k.startswith(prefix):
+                    del self._totals[k]
+
+
+# ---------------------------------------------------------------------------
+# process-wide singleton + module-level convenience API
+# ---------------------------------------------------------------------------
+
+_RECORDER: Optional[Recorder] = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def recorder() -> Recorder:
+    """The process recorder (created on first use, honoring
+    LUX_OBS_RUN_ID / LUX_OBS_DIR / LUX_OBS)."""
+    global _RECORDER
+    with _RECORDER_LOCK:
+        if _RECORDER is None:
+            _RECORDER = Recorder()
+        return _RECORDER
+
+
+def install(rec: Optional[Recorder]) -> Optional[Recorder]:
+    """Swap the process recorder (tests; chip-day children inherit the
+    run id via env instead).  Returns the previous one."""
+    global _RECORDER
+    with _RECORDER_LOCK:
+        old, _RECORDER = _RECORDER, rec
+        return old
+
+
+def span(name: str, **attrs) -> Span:
+    return recorder().span(name, **attrs)
+
+
+def point(name: str, **attrs) -> None:
+    recorder().point(name, **attrs)
+
+
+def run_id() -> str:
+    return recorder().run_id

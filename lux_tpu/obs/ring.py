@@ -1,0 +1,94 @@
+"""On-device iteration telemetry: a fixed-capacity ring in the hot-loop
+carry.
+
+The reference prints per-iteration activeNodes/loadTime/... by fencing
+every iteration on the host (-verbose, sssp_gpu.cu:513-518).  Here the
+hot loop lives entirely on device (lax.fori/while), so per-iteration
+host reads would serialize dispatch — the exact failure mode luxcheck's
+LUX-O family rejects.  Instead each engine pushes one small row per
+iteration into a static-shape ring CARRIED IN THE LOOP STATE:
+
+* static shapes (capacity x columns, fixed dtype) — the loop's jaxpr is
+  identical for every run length, so the LUX-J1 retrace audit holds;
+* carried and (optionally) donated with the rest of the state — the
+  LUX-J2 donation audit sees one more aliased leaf, not a second copy;
+* pure additional OUTPUT — the engine's state math never reads the
+  ring, so telemetry-on is bitwise-identical to telemetry-off on every
+  result array, and the plan-derived ``roofline.routed_hbm_passes``
+  accounting is untouched (LUX-J5's claim cross-check still balances);
+* fetched to host ONCE, after the loop completes (``ring_rows``) —
+  never inside it.
+
+Capacity semantics: the ring keeps the LAST ``cap`` rows (wrap-around),
+with ``n`` counting every push, so a 10k-iteration convergence run still
+reports its tail behavior and its exact iteration count.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+#: default ring capacity (rows); per-run override is an ordinary
+#: function argument, not an env knob — rings are built by drivers
+DEFAULT_CAP = 512
+
+#: column schemas, by ring kind (luxview renders these headers)
+SCHEMAS = {
+    "pull_fixed": ("it", "residual_l1"),
+    "pull_until": ("it", "active"),
+    "push": ("it", "frontier", "edges_lo", "dense"),
+}
+
+
+class IterRing(NamedTuple):
+    """The carried telemetry ring: ``buf`` is (cap, cols) of one fixed
+    dtype, ``n`` the int32 count of rows ever pushed (> cap = wrapped)."""
+
+    buf: jnp.ndarray
+    n: jnp.ndarray
+
+
+def new_ring(kind: str, cap: int = DEFAULT_CAP) -> IterRing:
+    """Fresh ring for one of the SCHEMAS kinds.  float32 everywhere:
+    every recorded quantity (iteration index, counts < 2^32 per round)
+    is telemetry, not arithmetic — 24-bit precision on a 268M-edge dense
+    round is a rounding of the CURVE, never of a result."""
+    cols = len(SCHEMAS[kind])
+    return IterRing(jnp.zeros((int(cap), cols), jnp.float32),
+                    jnp.int32(0))
+
+
+def ring_push(ring: IterRing, *vals) -> IterRing:
+    """Append one row (traced; static shapes in, static shapes out)."""
+    cap = ring.buf.shape[0]
+    row = jnp.stack([jnp.asarray(v).astype(jnp.float32) for v in vals])
+    idx = jnp.mod(ring.n, cap)
+    buf = jax.lax.dynamic_update_index_in_dim(ring.buf, row, idx, 0)
+    return IterRing(buf, ring.n + 1)
+
+
+def ring_rows(ring: IterRing):
+    """The ONE host fetch, after the loop: (rows ndarray in push order,
+    total pushes).  Keeps the last ``cap`` rows when wrapped."""
+    import numpy as np
+
+    buf = np.asarray(ring.buf)
+    n = int(ring.n)
+    cap = buf.shape[0]
+    if n <= cap:
+        return buf[:n], n
+    start = n % cap
+    return np.concatenate([buf[start:], buf[:start]]), n
+
+
+def emit_ring(kind: str, ring: IterRing, rec=None, **attrs) -> None:
+    """Fetch the ring and write it into the event log as one point event
+    (the run-end flush; luxview's per-iteration curves read these)."""
+    from lux_tpu import obs
+
+    rows, n = ring_rows(ring)
+    r = rec if rec is not None else obs.recorder()
+    r.point("telemetry.ring", kind=kind, cols=list(SCHEMAS[kind]),
+            n=n, rows=[[float(x) for x in row] for row in rows], **attrs)

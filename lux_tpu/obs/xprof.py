@@ -1,0 +1,198 @@
+"""XProf kernel attribution: who got the device time?
+
+``jax.profiler.start_trace`` writes a TensorBoard profile bundle under
+``<dir>/plugins/profile/<run>/``; the piece this module reads is the
+Chrome/Perfetto ``*.trace.json.gz`` (stdlib gzip+json — no tensorboard
+or profile-proto dependency, per the no-new-deps rule).  Every complete
+event ("ph" == "X") carries (name, dur µs, pid); pid metadata rows name
+the device lanes, so device time separates from host threads.
+
+The attribution question this answers is the routed-pf one: of a
+window's device time, how much ran inside the ``fused_pass_gather``
+Pallas kernels vs ordinary gathers/scatters vs collectives vs everything
+else — the measured counterpart of the static HBM-sweep accounting
+(roofline.routed_hbm_passes, audited by LUX-J5).
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+#: kernel-name classification, first match wins (lowercase substrings).
+#: "routed-pf" names the pass-fused Pallas family (ops/pallas_shuffle
+#: fused_pass_gather + the group-reduce kernels); "route" the unfused
+#: lane shuffles; collectives cover the ICI exchange.
+CLASSES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("routed-pf", ("fused_pass_gather", "pass_gather", "group_reduce")),
+    ("route", ("lane_gather", "lane_shuffle", "shuffle_kernel")),
+    ("collective", ("all-gather", "all-reduce", "all-to-all",
+                    "collective-permute", "reduce-scatter", "psum",
+                    "ppermute", "allgather", "allreduce")),
+    ("gather", ("gather",)),
+    ("scatter", ("scatter",)),
+    ("fusion", ("fusion", "loop_fusion")),
+    ("copy", ("copy", "transpose", "bitcast", "memset")),
+)
+
+
+def classify(name: str) -> str:
+    low = name.lower()
+    for cls, needles in CLASSES:
+        if any(n in low for n in needles):
+            return cls
+    return "other"
+
+
+#: per-file on-disk size cap for synchronous parsing (MB).  trace() runs
+#: attribution in its exit path, INSIDE the chip-day step whose timeout
+#: the battery enforces — a multi-hundred-MB Perfetto bundle (gigabytes
+#: decoded) must not stall or OOM the step that just finished its
+#: measured work.  Oversized files are skipped and reported in the
+#: emitted event; render them offline with a raised LUX_OBS_XPROF_MAX_MB.
+MAX_MB_ENV = "LUX_OBS_XPROF_MAX_MB"
+DEFAULT_MAX_MB = 64
+
+
+def _max_bytes() -> int:
+    from lux_tpu.utils.config import env_int
+
+    try:
+        mb = env_int(MAX_MB_ENV, DEFAULT_MAX_MB, minimum=1)
+    except ValueError:
+        mb = DEFAULT_MAX_MB
+    return int(mb) * (1 << 20)
+
+
+def _trace_files(trace_dir: str) -> List[str]:
+    """Trace files of the NEWEST capture under ``trace_dir``.  jax's
+    profiler writes one ``plugins/profile/<timestamp>/`` bundle per
+    start_trace, and the apps reuse one ``--profile-dir`` across runs —
+    attributing the union of history would inflate every total and mix
+    runs into one frac denominator, so only the latest bundle counts."""
+    runs = [d for d in glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*"))
+        if os.path.isdir(d)]
+    root = max(runs, key=os.path.getmtime) if runs else trace_dir
+    return sorted(
+        glob.glob(os.path.join(root, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(root, "**", "*.trace.json"),
+                    recursive=True))
+
+
+def _load_events(path: str) -> list:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8", errors="replace") as f:
+        doc = json.load(f)
+    return doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+
+
+def _device_pids(events: list) -> set:
+    """pids whose process_name metadata looks like a device lane.  The
+    tunnel-side TPU lanes name themselves '/device:TPU:0'-style; plain
+    CPU traces keep XLA ops under 'TensorFlow Op'/'XLA Ops' threads —
+    when nothing matches, attribution falls back to ALL pids (a host
+    trace is still a real time breakdown, labeled as such by caller)."""
+    pids = set()
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = str(ev.get("args", {}).get("name", "")).lower()
+            if any(k in name for k in ("device", "tpu", "gpu", "xla",
+                                       "accelerator")):
+                pids.add(ev.get("pid"))
+    return pids
+
+
+def kernel_table(trace_dir: str, top: int = 0,
+                 skipped: Optional[List[str]] = None,
+                 meta: Optional[Dict] = None) -> List[Dict]:
+    """Aggregate device time per kernel name over every trace file under
+    ``trace_dir``.  Returns rows sorted by total time desc:
+    {"name", "class", "total_ms", "calls", "frac"} — frac of the summed
+    kernel time.  Empty list when no trace file exists.  Files over the
+    LUX_OBS_XPROF_MAX_MB on-disk cap are not parsed; their paths are
+    appended to ``skipped`` when the caller passes a list.  When a file
+    has no device-lane pids (a host/CPU capture) the fallback sums ALL
+    pids — ``meta["host_only"]`` is set so consumers can label the table
+    as host wall time rather than device time."""
+    totals: Dict[str, List[float]] = {}
+    cap = _max_bytes()
+    loaded = []
+    for path in _trace_files(trace_dir):
+        try:
+            if os.path.getsize(path) > cap:
+                if skipped is not None:
+                    skipped.append(path)
+                continue
+            events = _load_events(path)
+        except (OSError, ValueError):
+            continue
+        loaded.append((events, _device_pids(events)))
+    # the all-pids fallback is BUNDLE-wide, not per-file: when any file
+    # has device lanes, a host-only sibling file contributes nothing
+    # (host wall time must never silently sum into device ms)
+    any_dev = any(dev for _, dev in loaded)
+    if not any_dev and meta is not None and any(
+            ev.get("ph") == "X" for events, _ in loaded for ev in events):
+        meta["host_only"] = True
+    for events, dev in loaded:
+        if any_dev and not dev:
+            continue
+        for ev in events:
+            if ev.get("ph") != "X":
+                continue
+            if dev and ev.get("pid") not in dev:
+                continue
+            name = str(ev.get("name", ""))
+            dur_us = float(ev.get("dur", 0.0))
+            t = totals.setdefault(name, [0.0, 0])
+            t[0] += dur_us
+            t[1] += 1
+    grand = sum(t[0] for t in totals.values()) or 1.0
+    rows = [
+        {"name": name, "class": classify(name),
+         "total_ms": round(t[0] / 1e3, 3), "calls": t[1],
+         "frac": round(t[0] / grand, 4)}
+        for name, t in totals.items()
+    ]
+    rows.sort(key=lambda r: (-r["total_ms"], r["name"]))
+    return rows[:top] if top else rows
+
+
+def class_summary(rows: List[Dict]) -> Dict[str, float]:
+    """{class: total_ms} rollup of a kernel_table."""
+    out: Dict[str, float] = {}
+    for r in rows:
+        out[r["class"]] = round(out.get(r["class"], 0.0)
+                                + r["total_ms"], 3)
+    return out
+
+
+def emit_kernel_table(trace_dir: str, rec=None,
+                      top: int = 40) -> Optional[List[Dict]]:
+    """Parse ``trace_dir`` and write the attribution into the event log
+    as one point event; returns the rows (None when no trace found).
+    Never raises: attribution is bookkeeping, not a run dependency."""
+    skipped: List[str] = []
+    meta: Dict = {}
+    try:
+        rows = kernel_table(trace_dir, top=top, skipped=skipped, meta=meta)
+    except Exception:  # noqa: BLE001 — attribution must never cost a run
+        return None
+    if not rows and not skipped:
+        return None
+    from lux_tpu import obs
+
+    r = rec if rec is not None else obs.recorder()
+    ev = {"trace_dir": trace_dir, "rows": rows,
+          "classes": class_summary(rows)}
+    if meta.get("host_only"):  # no device lanes: host wall, not device ms
+        ev["host_only"] = True
+    if skipped:  # over-cap files: named, not silently absent
+        ev["skipped_over_cap"] = skipped
+        ev["cap_mb"] = _max_bytes() >> 20
+    r.point("xprof.kernels", **ev)
+    return rows

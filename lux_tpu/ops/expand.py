@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lux_tpu import obs
 from lux_tpu.ops import route as route_mod
 from lux_tpu.ops import pallas_shuffle as shuf
 
@@ -1025,21 +1026,27 @@ def _cached_part_fn(tag: str, num_parts: int, key_one, build_one,
     def one(i):
         path = paths[i] if trusted else None
         if path is not None and os.path.exists(path):
-            t0 = time.perf_counter()
+            # span-timed: _stats_add consumes the SPAN's duration, so
+            # plan_build_seconds and the event log's plan.* waterfall
+            # are views over one clock (no drift between the bench row
+            # and the flight recorder)
+            sp = obs.span("plan.load", tag=tag, part=i)
             try:
-                static, arrays = _load_plan(path)
-                if validate is not None and not validate(static):
-                    raise ValueError(
-                        "entry is not of this plan family's form")
-                _stats_add("warm", time.perf_counter() - t0)
+                with sp:
+                    static, arrays = _load_plan(path)
+                    if validate is not None and not validate(static):
+                        raise ValueError(
+                            "entry is not of this plan family's form")
+                _stats_add("warm", sp.dur)
                 return static, arrays
             except (OSError, ValueError, KeyError) as e:
                 # corrupt/foreign entry: rebuild (and overwrite) rather
                 # than fail every driver that shares the cache
                 print(f"# plan cache ignored ({path}): {e}", flush=True)
-        t0 = time.perf_counter()
-        static, arrays = build_one(i)
-        _stats_add("cold", time.perf_counter() - t0)
+        sp = obs.span("plan.build", tag=tag, part=i)
+        with sp:
+            static, arrays = build_one(i)
+        _stats_add("cold", sp.dur)
         if path is not None:
             try:
                 _save_plan(path, (static, arrays))

@@ -231,15 +231,19 @@ def _color_regular_batched(u: np.ndarray, v: np.ndarray, deg: int,
     differ between the two — both are valid (every color class a
     perfect matching), and route correctness is pinned on replay
     equality, not on specific colors."""
-    from lux_tpu import native
+    from lux_tpu import native, obs
 
-    out = native.route_color(u, v, deg, nside, n_threads=n_threads)
-    if out is not None:
-        return out
-    return np.stack([
-        _color_regular(u[b], v[b], deg, nside, nside)
-        for b in range(u.shape[0])
-    ])
+    with obs.span("plan.color", batches=int(u.shape[0]),
+                  n=int(u.shape[1]), deg=int(deg)) as sp:
+        out = native.route_color(u, v, deg, nside, n_threads=n_threads)
+        if out is not None:
+            sp.set(native=True)
+            return out
+        sp.set(native=False)
+        return np.stack([
+            _color_regular(u[b], v[b], deg, nside, nside)
+            for b in range(u.shape[0])
+        ])
 
 
 def _route_rec(perms: np.ndarray, dims: list[int]) -> list[np.ndarray]:

@@ -93,6 +93,8 @@ def measure_serving(g, shards, app: str = "sssp", q: int = 64,
         f.result(timeout=0)  # already resolved; raises on any error
     summary = metrics.summary(elapsed_s=burst_elapsed,
                               cache_stats=cache.stats())
+    # flight-recorder snapshot: luxview's serve section for a bench run
+    metrics.emit_snapshot(summary=summary)
 
     out = {
         "app": app,

@@ -9,6 +9,8 @@ single JSON line (the same shape tools/serve_bench.py and the bench.py
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -108,6 +110,7 @@ def run_serve_cli(cfg: RunConfig, g, app: str) -> int:
     """The --serve entry: serve cfg.serve_queries (or --serve-sources)
     through warm engines; prints per-run JSON metrics.  Returns the
     process exit code."""
+    from lux_tpu import obs
     from lux_tpu.apps import common
     from lux_tpu.graph.shards import build_pull_shards
     from lux_tpu.utils.timing import Timer
@@ -115,7 +118,8 @@ def run_serve_cli(cfg: RunConfig, g, app: str) -> int:
     _validate(cfg)
     buckets = parse_buckets(cfg.serve_buckets)
     sources = parse_sources(cfg, g)
-    shards = build_pull_shards(g, cfg.num_parts)
+    with obs.span("serve.layout", parts=cfg.num_parts):
+        shards = build_pull_shards(g, cfg.num_parts)
     cache = WarmEngineCache(
         shards, apps=(app,), q_buckets=buckets, method=cfg.method,
         num_iters=cfg.num_iters, max_iters=cfg.max_iters,
@@ -131,18 +135,19 @@ def run_serve_cli(cfg: RunConfig, g, app: str) -> int:
     )
     timer = Timer()
     futs = []
-    for s in sources:
-        while True:
-            try:
-                futs.append(sched.submit(int(s)))
-                break
-            except RejectedError:
-                # burst larger than the admission bound: pump the
-                # scheduler until the queue drains a batch, then retry —
-                # the backpressure loop a real client would run
-                if not sched.step():
-                    time.sleep(max(cfg.serve_wait_ms / 4e3, 1e-4))
-    sched.drain()
+    with obs.span("serve.burst", app=app, queries=len(sources)):
+        for s in sources:
+            while True:
+                try:
+                    futs.append(sched.submit(int(s)))
+                    break
+                except RejectedError:
+                    # burst larger than the admission bound: pump the
+                    # scheduler until the queue drains a batch, then retry —
+                    # the backpressure loop a real client would run
+                    if not sched.step():
+                        time.sleep(max(cfg.serve_wait_ms / 4e3, 1e-4))
+        sched.drain()
     answers = []
     timeouts = 0
     for f in futs:
@@ -152,8 +157,27 @@ def run_serve_cli(cfg: RunConfig, g, app: str) -> int:
             answers.append(None)
             timeouts += 1
     elapsed = timer.stop()
-    summary = metrics.summary(elapsed_s=elapsed, cache_stats=cache.stats())
-    print(json.dumps({"metric": f"{app}_serve", **summary}), flush=True)
+    cache_stats = cache.stats()
+    summary = metrics.summary(elapsed_s=elapsed, cache_stats=cache_stats)
+    # end-of-run snapshot: the event log's serve section is complete even
+    # when the periodic cadence never fired (short bursts)
+    metrics.emit_snapshot(summary=summary)
+    print(json.dumps({"metric": f"{app}_serve", "run_id": obs.run_id(),
+                      **summary}), flush=True)
+    prom_path = os.environ.get("LUX_SERVE_PROM")
+    if prom_path:
+        # one-shot scrape artifact: the same Prometheus text a fleet
+        # collector would pull (node_exporter textfile-collector style).
+        # A bad path must not fail a run that already answered its
+        # queries — observability is never load-bearing
+        try:
+            with open(prom_path, "w", encoding="utf-8") as f:
+                f.write(metrics.dump(elapsed_s=elapsed,
+                                     cache_stats=cache_stats))
+            print(f"# prometheus metrics -> {prom_path}", flush=True)
+        except OSError as e:
+            print(f"# prometheus metrics NOT written ({prom_path}): {e}",
+                  file=sys.stderr, flush=True)
     if cfg.check:
         ok_rows = [(s, a) for s, a in zip(sources, answers) if a is not None]
         violations = _check_answers(

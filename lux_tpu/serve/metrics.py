@@ -108,3 +108,117 @@ class ServeMetrics:
         if cache_stats:
             out["engine_cache"] = cache_stats
         return out
+
+    # -- scrape + flight-recorder surfaces --------------------------------
+
+    #: Prometheus histogram boundaries (seconds) for request latency and
+    #: queue wait; chosen to straddle the measured serving band (warm
+    #: Q=64 batch ≈ ms, cold trace ≈ s)
+    BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def _histogram_lines(self, name: str, hist, help_text: str) -> list:
+        """Prometheus text-format histogram from a LatencyHistogram.
+        Past the reservoir cap the recorder holds a uniform SAMPLE of the
+        stream, so bucket counts are scaled to the true request count
+        (the standard reservoir estimator) while ``_count`` stays exact."""
+        samples = list(hist.samples)
+        count = len(hist)
+        lines = [f"# HELP {name} {help_text}",
+                 f"# TYPE {name} histogram"]
+        scale = (count / len(samples)) if samples else 0.0
+        cum = 0
+        for le in self.BUCKETS_S:
+            cum = sum(1 for s in samples if s <= le)
+            lines.append(f'{name}_bucket{{le="{le}"}} '
+                         f"{int(round(cum * scale))}")
+        lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{name}_sum {round(sum(samples) * scale, 6)}")
+        lines.append(f"{name}_count {count}")
+        return lines
+
+    def dump(self, elapsed_s: float | None = None,
+             cache_stats: dict | None = None) -> str:
+        """Prometheus text exposition of the full counter/gauge/histogram
+        set — the scrape surface a serving fleet's collector reads
+        (ROADMAP item 2).  Pure string formatting over the same state
+        ``summary()`` reports; safe to call from any thread."""
+        with self._lock:
+            lines = []
+
+            def counter(name, val, help_text):
+                lines.extend([f"# HELP {name} {help_text}",
+                              f"# TYPE {name} counter", f"{name} {val}"])
+
+            def gauge(name, val, help_text):
+                lines.extend([f"# HELP {name} {help_text}",
+                              f"# TYPE {name} gauge", f"{name} {val}"])
+
+            counter("lux_serve_requests_completed_total", self.completed,
+                    "requests answered")
+            counter("lux_serve_requests_timeout_total", self.timeouts,
+                    "requests whose deadline expired in queue")
+            counter("lux_serve_requests_shed_total", self.rejected,
+                    "requests rejected by bounded-queue backpressure")
+            counter("lux_serve_batches_total", self._batch_count,
+                    "engine batches dispatched")
+            counter("lux_serve_traversed_edges_total", self.traversed_edges,
+                    "edges traversed across all answered queries")
+            if self._depth_n:  # same no-samples guard as summary()
+                gauge("lux_serve_queue_depth_max", self._depth_max,
+                      "maximum observed queue depth")
+            if self._batch_count:
+                gauge("lux_serve_batch_occupancy",
+                      round(self._batch_real / max(self._batch_slots, 1), 4),
+                      "real queries / dispatched slots")
+                gauge("lux_serve_warm_batch_ratio",
+                      round(self._batch_warm / self._batch_count, 4),
+                      "batches served by a warm engine")
+            lines.extend(self._histogram_lines(
+                "lux_serve_request_latency_seconds", self.latency,
+                "enqueue-to-result latency"))
+            lines.extend(self._histogram_lines(
+                "lux_serve_queue_wait_seconds", self.queue_wait,
+                "enqueue-to-dispatch wait"))
+            completed = self.completed
+        if elapsed_s is not None and elapsed_s > 0:
+            lines.extend([
+                "# HELP lux_serve_qps completed requests per second",
+                "# TYPE lux_serve_qps gauge",
+                f"lux_serve_qps {round(completed / elapsed_s, 4)}"])
+        if cache_stats and (cache_stats.get("warm_hits")
+                            or cache_stats.get("cold_traces")):
+            # warm.py's stats() already derives the ratio — expose that
+            # same number rather than a second computation that could
+            # drift (fallback derivation only for a foreign stats dict)
+            ratio = cache_stats.get("warm_hit_ratio")
+            if ratio is None:
+                hits = int(cache_stats.get("warm_hits", 0))
+                cold = int(cache_stats.get("cold_traces", 0))
+                ratio = round(hits / max(hits + cold, 1), 4)
+            lines.extend([
+                "# HELP lux_serve_warm_hit_ratio warm engine-cache "
+                "hits / lookups",
+                "# TYPE lux_serve_warm_hit_ratio gauge",
+                f"lux_serve_warm_hit_ratio {ratio}"])
+        return "\n".join(lines) + "\n"
+
+    def emit_snapshot(self, rec=None, elapsed_s: float | None = None,
+                      cache_stats: dict | None = None,
+                      summary: dict | None = None) -> None:
+        """One ``serve.metrics`` point into the event log — the periodic
+        flight-recorder snapshot (scheduler emits one every
+        ``MicroBatchScheduler.snapshot_every_s``; luxview's serve section
+        reads the LAST one).  Callers that already built ``summary()``
+        pass it via ``summary=`` so the reservoir percentiles are not
+        recomputed for the point event.  Never raises."""
+        try:
+            from lux_tpu import obs
+
+            if summary is None:
+                summary = self.summary(elapsed_s=elapsed_s,
+                                       cache_stats=cache_stats)
+            r = rec if rec is not None else obs.recorder()
+            r.point("serve.metrics", **summary)
+        except Exception:  # noqa: BLE001 — telemetry must never cost a run
+            pass

@@ -91,6 +91,11 @@ class ServeFuture:
 
 
 class MicroBatchScheduler:
+    #: seconds between periodic ``serve.metrics`` flight-recorder
+    #: snapshots (0 disables); measured by the scheduler's own clock so
+    #: fake-clock tests can drive it deterministically
+    snapshot_every_s: float = 30.0
+
     def __init__(self, cache: WarmEngineCache, app: str = "sssp",
                  max_wait_ms: float = 5.0, max_queue: int = 256,
                  default_timeout_ms: float = 0.0, clock=time.monotonic,
@@ -108,6 +113,7 @@ class MicroBatchScheduler:
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._last_service_s = 0.0
+        self._last_snapshot_t: Optional[float] = None
 
     # ------------------------------------------------------------------
     # admission
@@ -210,7 +216,10 @@ class MicroBatchScheduler:
         Returns the number of requests RESOLVED (answers + timeouts).
         Deterministic and reentrant-free — tests drive it with a fake
         clock; the background thread just calls it in a loop."""
+        from lux_tpu import obs
+
         now = self._clock() if now is None else now
+        self._maybe_snapshot(now)
         resolved = self._expire(now)
         if not self._ready(now):
             return resolved
@@ -223,8 +232,13 @@ class MicroBatchScheduler:
         queries = queries + [queries[0]] * pad
         t0 = self._clock()
         try:
-            engine, was_warm = self.cache.get(self.app, q)
-            out = engine.run(queries)
+            # the dispatch span is the serving hot path's flight-recorder
+            # row: one per batch, covering engine lookup + the batched run
+            with obs.span("serve.dispatch", app=self.app, q=q,
+                          real=len(batch)) as sp:
+                engine, was_warm = self.cache.get(self.app, q)
+                out = engine.run(queries)
+                sp.set(warm=was_warm)
         except Exception as e:  # noqa: BLE001 — a failed batch must
             # resolve its requests (a hung future is worse than any error)
             for r in batch:
@@ -252,6 +266,20 @@ class MicroBatchScheduler:
             )
             r.event.set()
         return resolved + len(batch)
+
+    def _maybe_snapshot(self, now: float) -> None:
+        """Periodic ``serve.metrics`` point (snapshot_every_s cadence on
+        the scheduler's own clock) — the long-lived service's heartbeat
+        in the event log."""
+        if not self.snapshot_every_s:
+            return
+        with self._lock:
+            last = self._last_snapshot_t
+            if last is not None and now - last < self.snapshot_every_s:
+                return
+            self._last_snapshot_t = now
+        if last is not None:  # first pump only arms the timer
+            self.metrics.emit_snapshot()
 
     def drain(self, max_steps: int = 10_000) -> int:
         """Pump until the queue is empty; returns requests resolved.

@@ -84,10 +84,15 @@ class WarmEngineCache:
         """Trace + compile + run one dummy batch per (app, bucket);
         returns the wall seconds spent (service-start cost, reported by
         the bench drivers so it is never mistaken for request latency)."""
+        from lux_tpu import obs
+
         t0 = time.perf_counter()
         for app in apps if apps is not None else self.apps:
             for q in q_buckets if q_buckets is not None else self.q_buckets:
-                self._build(app, int(q)).warm()
+                # one span per (app, bucket): the compile waterfall of a
+                # service start is attributable per engine shape
+                with obs.span("serve.pretrace", app=app, q=int(q)):
+                    self._build(app, int(q)).warm()
         spent = time.perf_counter() - t0
         with self._lock:
             self.warm_seconds += spent
@@ -132,6 +137,8 @@ class WarmEngineCache:
         under the cache lock (concurrent pumps must not lose hits);
         the warm itself runs outside it, serialized by the engine's own
         lock so a racing second pump blocks instead of double-compiling."""
+        from lux_tpu import obs
+
         eng = self._build(app, q)
         with self._lock:
             was_warm = eng._warmed
@@ -140,9 +147,14 @@ class WarmEngineCache:
             else:
                 self.cold_traces += 1
         if was_warm:
+            # hit: a point, not a span — nothing is waited on
+            obs.point("serve.cache", app=app, q=int(q), warm=True)
             return eng, True
         t0 = time.perf_counter()
-        eng.warm()
+        # miss: the request path is paying a trace+compile — exactly the
+        # event a post-mortem needs to see on the timeline
+        with obs.span("serve.cold_trace", app=app, q=int(q)):
+            eng.warm()
         with self._lock:
             self.warm_seconds += time.perf_counter() - t0
         return eng, False

@@ -386,6 +386,36 @@ def test_j5_real_replays_match_accounting():
     assert targets._hbm_fused_pf() == []
 
 
+def test_j503_kernel_delta_fails():
+    """Seeded broken twin: a 'telemetry' variant that launches pallas
+    kernels the base config does not — the ring-adds-HBM-passes bug
+    class the parity check exists for."""
+    from lux_tpu.analysis.ir.targets import _expand_traced, fixture
+
+    @jax.jit
+    def base(x, idx):
+        return x[idx]
+
+    traced_base = base.trace(jnp.arange(256.0),
+                             jnp.arange(256, dtype=jnp.int32))
+    traced_twin, _ = _expand_traced(fixture()["plan_pf"])
+    fs = hbm.check_kernel_parity(traced_base, traced_twin, "p",
+                                 "fixture/delta")
+    assert _codes(fs) == ["LUX-J503"]
+
+
+def test_j_ring_units_clean():
+    """The luxtrace telemetry ring's three audited legs (retrace,
+    donation, kernel parity) are clean on the real engines — the
+    static proof behind docs/OBSERVABILITY.md's claims."""
+    from lux_tpu.analysis.ir import targets
+
+    assert targets._retrace_pull_fixed_ring() == []
+    assert targets._donation_pull_fixed_ring() == []
+    assert targets._donation_push_chunk_ring() == []
+    assert targets._hbm_ring_neutral() == []
+
+
 # ---------------------------------------------------------------------------
 # the gate + baseline machinery
 # ---------------------------------------------------------------------------

@@ -1,0 +1,45 @@
+"""Shared bare-package stub for the jax-free tools.
+
+`import lux_tpu` runs the package __init__, which imports jax (the
+shard_map compat shim).  The preflight/post-mortem tools (luxcheck,
+luxview, obs_span) must work in milliseconds on a host whose jax install
+or device tunnel is in ANY state, so instead of executing the real
+__init__ they register a bare package module pointing at the source
+tree; pure-stdlib submodules (lux_tpu.analysis, lux_tpu.obs.recorder)
+then import normally.
+
+One copy of the trick lives here — a change to the stub (or to which
+modules stay stdlib-pure) happens in one place, not per-tool.  Tools add
+their own directory to sys.path before importing this module (they are
+run as scripts / loaded by file location, so no package-relative form).
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+import types
+
+#: repo root (this file lives in tools/)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bare_package() -> str:
+    """Register the bare ``lux_tpu`` stub (idempotent: an already
+    imported real package — or a previous stub — is left alone).
+    Returns the repo root."""
+    if "lux_tpu" not in sys.modules:
+        sys.path.insert(0, REPO)
+        _pkg = types.ModuleType("lux_tpu")
+        _pkg.__path__ = [os.path.join(REPO, "lux_tpu")]
+        sys.modules["lux_tpu"] = _pkg
+    return REPO
+
+
+def load(modname: str):
+    """Import one ``lux_tpu.*`` MODULE under the stub.  The package
+    re-exports e.g. the ``recorder()`` accessor under the same name as
+    its module, so callers resolve the module explicitly through here.
+    """
+    bare_package()
+    return importlib.import_module(modname)

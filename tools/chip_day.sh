@@ -11,11 +11,64 @@ LOG=${1:-/tmp/lux_chip_day_$(date +%H%M)}
 mkdir -p "$LOG"
 echo "logs -> $LOG"
 
+# luxtrace flight recorder: ONE run id for the whole battery — every
+# step (and every python worker, via the recorder's env contract) lands
+# in the same event-log timeline, so even a window that dies at step 0c
+# leaves a complete post-mortem.  window_report.md is written by the
+# EXIT trap below on EVERY exit path, abort and timeout included.
+export LUX_OBS_RUN_ID=${LUX_OBS_RUN_ID:-$(date +%Y%m%d_%H%M%S)_$$_chipday}
+echo "luxtrace run id: $LUX_OBS_RUN_ID"
+PREWARM_PID=""
+STEP_PID=""
+BATTERY_STATUS=aborted
+
+on_exit() {
+  local rc=$?
+  [ -n "$PREWARM_PID" ] && kill "$PREWARM_PID" 2>/dev/null
+  python tools/obs_span.py point battery.exit "rc=$rc" \
+      "status=$BATTERY_STATUS" 2>/dev/null
+  # the post-mortem artifact: rendered from whatever events made it to
+  # disk — an aborted window still gets its waterfall + OPEN spans
+  timeout 120 python tools/luxview.py "$LUX_OBS_RUN_ID" \
+      --out "$LOG/window_report.md" 2>> "$LOG/luxview.err" \
+    && echo "window report -> $LOG/window_report.md"
+  printf '{"ts": %s, "tool": "chip_day", "run_id": "%s", "status": "%s", "rc": %s, "log": "%s"}\n' \
+      "$(date +%s)" "$LUX_OBS_RUN_ID" "$BATTERY_STATUS" "$rc" "$LOG" \
+      >> PROGRESS.jsonl 2>/dev/null
+}
+trap on_exit EXIT
+
+on_signal() {
+  # a mid-step kill (ctrl-C, driver SIGTERM, session timeout) must
+  # still reach on_exit: bash defers traps behind a FOREGROUND child
+  # and does not run EXIT traps at all for an uncaught fatal signal —
+  # so every step runs backgrounded behind an interruptible `wait`
+  # (fg_to), the in-flight child is killed here, and the explicit exit
+  # fires the EXIT trap that renders window_report.md
+  BATTERY_STATUS=killed
+  [ -n "$STEP_PID" ] && kill "$STEP_PID" 2>/dev/null
+  exit 143
+}
+trap on_signal INT TERM HUP
+
+fg_to() {  # interruptible foreground step: fg_to <timeout_s> <cmd...>
+  timeout "$1" "${@:2}" &
+  STEP_PID=$!
+  wait "$STEP_PID"
+  local rc=$?
+  STEP_PID=""
+  return $rc
+}
+
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 to=$2; shift 2
   echo "=== $name ($(date +%H:%M:%S)) timeout ${to}s"
-  timeout "$to" "$@" > "$LOG/$name.out" 2> "$LOG/$name.err"
+  local sid
+  sid=$(python tools/obs_span.py begin "step.$name" "timeout_s=$to" \
+        2>/dev/null)
+  fg_to "$to" "$@" > "$LOG/$name.out" 2> "$LOG/$name.err"
   local rc=$?
+  [ -n "$sid" ] && python tools/obs_span.py end "$sid" --rc $rc 2>/dev/null
   echo "    rc=$rc; tail:"; tail -3 "$LOG/$name.out" | sed 's/^/    /'
   return $rc
 }
@@ -29,12 +82,15 @@ run() {  # run <name> <timeout_s> <cmd...>
 #     milliseconds even when the tunnel is wedged.  Suppress only WITH
 #     a justification (docs/ANALYSIS.md).
 echo "=== luxcheck preflight ($(date +%H:%M:%S))"
-if ! timeout 120 python tools/luxcheck.py --all \
+SID=$(python tools/obs_span.py begin step.luxcheck 2>/dev/null)
+if ! fg_to 120 python tools/luxcheck.py --all \
     > "$LOG/luxcheck.out" 2>&1; then
+  [ -n "$SID" ] && python tools/obs_span.py end "$SID" --rc 1 2>/dev/null
   tail -15 "$LOG/luxcheck.out" | sed 's/^/    /'
   echo "luxcheck findings (full list: $LOG/luxcheck.out) — aborting battery"
   exit 1
 fi
+[ -n "$SID" ] && python tools/obs_span.py end "$SID" 2>/dev/null
 echo "luxcheck: clean"
 
 # -3b) IR preflight: luxaudit traces/lowers the REAL engine entry
@@ -51,13 +107,16 @@ echo "luxcheck: clean"
 #      interpreter start and would HANG this no-tunnel-needed gate when
 #      the relay is wedged.
 echo "=== luxaudit preflight ($(date +%H:%M:%S))"
-if ! timeout 600 env PYTHONPATH="$PWD" python tools/luxaudit.py --all \
+SID=$(python tools/obs_span.py begin step.luxaudit 2>/dev/null)
+if ! fg_to 600 env PYTHONPATH="$PWD" python tools/luxaudit.py --all \
     --json "$LOG/AUDIT.json" \
     --progress PROGRESS.jsonl > "$LOG/luxaudit.out" 2>&1; then
+  [ -n "$SID" ] && python tools/obs_span.py end "$SID" --rc 1 2>/dev/null
   tail -15 "$LOG/luxaudit.out" | sed 's/^/    /'
   echo "luxaudit findings (full list: $LOG/luxaudit.out) — aborting battery"
   exit 1
 fi
+[ -n "$SID" ] && python tools/obs_span.py end "$SID" 2>/dev/null
 tail -1 "$LOG/luxaudit.out"
 
 # -2) routed-plan prewarm in the BACKGROUND (host cores only, no chip
@@ -70,20 +129,22 @@ tail -1 "$LOG/luxaudit.out"
 #     nice -n 19: steps 0/0b bank timed micro rows concurrently — the
 #     prewarm must not inflate them (bench nices competing workers too)
 echo "=== plan_prewarm (background, $(date +%H:%M:%S))"
+PREWARM_SID=$(python tools/obs_span.py begin step.plan_prewarm 2>/dev/null)
 nice -n 19 timeout 7200 python tools/plan_prewarm.py \
     --scale "${LUX_PREWARM_SCALE:-20}" --ef 16 \
     --kinds expand,expand-pf,fused,fused-pf \
     > "$LOG/plan_prewarm.out" 2> "$LOG/plan_prewarm.err" &
-PREWARM_PID=$!
 # abort paths (relay gate, dead-tunnel gate) must not orphan 2h of
-# all-core host work; the success path clears the trap after step 0c's wait
-trap 'kill "$PREWARM_PID" 2>/dev/null' EXIT
+# all-core host work; on_exit kills the pid while it is nonempty — the
+# success path empties it after step 0c's wait
+PREWARM_PID=$!
 
 # -1) fast relay gate: the axon remote_compile endpoint is a local HTTP
 #     server (127.0.0.1:8083).  Connection-refused = relay down — a plain
 #     TCP connect detects that in milliseconds, where a jax probe burns
 #     its whole timeout in C-level claim retries (observed: 59 min).
 if ! timeout 3 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8083' 2>/dev/null; then
+  python tools/obs_span.py point battery.abort reason=relay_down 2>/dev/null
   echo "relay down (127.0.0.1:8083 refused) — aborting battery"; exit 1
 fi
 echo "relay gate: 8083 accepts"
@@ -108,6 +169,7 @@ run micro_race 3000 python tools/tpu_micro_race.py \
     --methods mxsum gather route routepf fused fusedpf gatherc scan \
     --outdir "$LOG/micro"
 grep -q '"ms_per_rep"' "$LOG/micro_race.out" || {
+  python tools/obs_span.py point battery.abort reason=tunnel_dead 2>/dev/null
   echo "tunnel dead (no micro rows) — aborting battery"; exit 1; }
 
 # 0b) uint8 vs int32 pass indices (LUX_ROUTE_IDX8): the 4x index-traffic
@@ -125,7 +187,8 @@ LUX_ROUTE_IDX8=0 run micro_route_i32 900 python tools/tpu_micro_race.py \
 #     winners overlay folds in (_record_route_mode runs in the default
 #     race of step 1; these explicit rows are the per-flavor artifacts).
 echo "waiting for plan_prewarm (pid $PREWARM_PID)"; wait "$PREWARM_PID" || true
-trap - EXIT
+[ -n "$PREWARM_SID" ] && python tools/obs_span.py end "$PREWARM_SID" 2>/dev/null
+PREWARM_PID=""
 tail -1 "$LOG/plan_prewarm.out" 2>/dev/null | sed 's/^/    prewarm: /'
 LUX_BENCH_WATCHDOG_S=1500 LUX_BENCH_TPU_S=1300 \
   LUX_BENCH_ROUTE_PF=1 LUX_BENCH_APPS=pagerank \
@@ -204,4 +267,5 @@ run bench_all 4500 python tools/bench_all.py --scale 18 --iters 10 --routed
 run stream_check 2400 python tools/biggraph_check.py --scale 20 \
     --parts 8 --iters 2 --skip-sssp --stream-hbm-gib 0.15
 
+BATTERY_STATUS=done
 echo "battery done ($(date +%H:%M:%S)); fold results into BASELINE.md"

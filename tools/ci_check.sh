@@ -67,12 +67,26 @@ assert (np.asarray(d) == np.asarray(r)).all(), 'routed-pf != direct'
 print('routed-pf bitwise == direct')
 "
 
+# 3b) obs smoke: a shell-seeded event log must round-trip through
+#     luxview (the post-mortem path chip_day's EXIT trap depends on),
+#     jax-free end to end; LUX-O itself runs inside stage 1's luxcheck
+stage obs_smoke 120 bash -c '
+set -e
+export LUX_OBS_RUN_ID=ci_obs_$$
+sid=$(python tools/obs_span.py begin step.ci timeout_s=9)
+python tools/obs_span.py end "$sid" --rc 0
+python tools/obs_span.py begin step.open_forever > /dev/null
+out=$(python tools/luxview.py "$LUX_OBS_RUN_ID")
+echo "$out" | grep -q "step.ci" || { echo "missing span"; exit 1; }
+echo "$out" | grep -q "OPEN" || { echo "missing post-mortem"; exit 1; }
+'
+
 # 4) fast tier-1 subset: the engine/analysis/native seams this script
 #    exists to protect (full suite: ROADMAP.md "Tier-1 verify")
 stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
     -m 'not slow' -p no:cacheprovider \
     tests/test_luxcheck.py tests/test_native.py tests/test_expand.py \
-    tests/test_passfuse.py \
+    tests/test_passfuse.py tests/test_obs.py \
     tests/test_determinism.py tests/test_serve_scheduler.py
 
 if [ "$FAILED" -ne 0 ]; then

@@ -22,20 +22,16 @@ as a dumping ground — stale entries are themselves findings (LUX-X003).
 import argparse
 import os
 import sys
-import types
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
 
-# The analysis package is pure stdlib, but `import lux_tpu` runs the
-# package __init__, which imports jax (the shard_map compat shim).  The
-# preflight gate must work in milliseconds on a host whose jax install
-# (or device tunnel) is in ANY state, so register a bare package module
-# pointing at the source tree instead of executing the real __init__.
-if "lux_tpu" not in sys.modules:
-    sys.path.insert(0, REPO)
-    _pkg = types.ModuleType("lux_tpu")
-    _pkg.__path__ = [os.path.join(REPO, "lux_tpu")]
-    sys.modules["lux_tpu"] = _pkg
+import _jaxfree  # noqa: E402
+
+# the analysis package is pure stdlib; the stub keeps the preflight gate
+# in milliseconds on a host whose jax install is in ANY state
+REPO = _jaxfree.bare_package()
 
 from lux_tpu.analysis import (  # noqa: E402
     ALL_CHECKERS, DEFAULT_TARGETS, check_paths,

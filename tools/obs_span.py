@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""obs_span — emit luxtrace span events from shell scripts.
+
+tools/chip_day.sh wraps every battery step with this helper, so a window
+that dies mid-step still leaves a complete event log: the begin event is
+on disk before the step runs, and an abort simply leaves the span OPEN —
+exactly what luxview's post-mortem section renders.
+
+Usage (chip_day.sh idiom):
+    sid=$(python tools/obs_span.py begin step.micro_race timeout=3000)
+    ...run the step...
+    python tools/obs_span.py end "$sid" --rc $?
+    python tools/obs_span.py point battery.abort reason=relay_down
+
+All invocations of one run append to ONE shared ``events-shell.jsonl``
+in the run dir (single-line O_APPEND writes are atomic on Linux), keyed
+by $LUX_OBS_RUN_ID / $LUX_OBS_DIR — export the run id once at the top of
+the script and every child process (python workers included, via the
+recorder's env contract) lands in the same timeline.  Monotonic
+timestamps are CLOCK_MONOTONIC, system-wide on Linux, so shell spans and
+worker spans interleave correctly.
+
+Jax-free (luxcheck's bare-package stub): this must work when the tunnel
+or the jax install is wedged — that is precisely when the post-mortem
+matters.  Failures degrade silently (prints an empty sid); observability
+must never fail the battery.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import _jaxfree  # noqa: E402
+
+REPO = _jaxfree.REPO
+_rec = _jaxfree.load("lux_tpu.obs.recorder")
+
+
+def _parse_attrs(pairs):
+    out = {}
+    for p in pairs:
+        k, _, v = p.partition("=")
+        if not _:
+            continue
+        try:
+            out[k] = json.loads(v)
+        except ValueError:
+            out[k] = v
+    return out
+
+
+def _log_path():
+    """The shared shell event file for this run, or None when the dir
+    contract fails (degrade silently — same rule as the recorder)."""
+    run = os.environ.get(_rec.RUN_ENV)
+    if not run or os.environ.get(_rec.ENABLE_ENV, "1") == "0":
+        return None
+    root = _rec.default_root()
+    d = os.path.join(root, run)
+    if not (_rec._dir_trusted(root) and _rec._dir_trusted(d)):
+        return None
+    return os.path.join(d, "events-shell.jsonl")
+
+
+def _write(ev: dict) -> bool:
+    path = _log_path()
+    if path is None:
+        return False
+    try:
+        new = not os.path.exists(path)
+        with open(path, "a", encoding="utf-8") as f:
+            if new:
+                f.write(json.dumps({
+                    "e": "m", "run": os.environ.get(_rec.RUN_ENV),
+                    "pid": os.getpid(), "wall": time.time(),
+                    "mono": time.monotonic(), "argv": ["obs_span(shell)"],
+                }) + "\n")
+            f.write(json.dumps(ev) + "\n")
+        return True
+    except OSError:
+        return False
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="append luxtrace span/point events from shell")
+    ap.add_argument("verb", choices=("begin", "end", "point"))
+    ap.add_argument("name_or_sid",
+                    help="span/point name (begin, point) or the sid "
+                         "printed by begin (end)")
+    ap.add_argument("attrs", nargs="*", help="k=v attributes")
+    ap.add_argument("--rc", type=int, default=0,
+                    help="step exit code (end; nonzero = failed span)")
+    ap.add_argument("--parent", default=None,
+                    help="parent sid (begin; nested shell phases)")
+    args = ap.parse_args(argv)
+
+    t = time.monotonic()
+    if args.verb == "begin":
+        # sid unique across the battery: pid + microsecond monotonic
+        sid = f"sh{os.getpid()}-{int(t * 1e6)}"
+        ev = {"e": "b", "n": args.name_or_sid, "s": sid,
+              "p": args.parent, "t": t}
+        a = _parse_attrs(args.attrs)
+        if a:
+            ev["a"] = a
+        # degrade contract: an empty sid tells the script the log dir is
+        # unusable, so its [ -n "$sid" ] guards skip the end/point spawns
+        print(sid if _write(ev) else "")
+        return 0
+    if args.verb == "end":
+        ev = {"e": "e", "s": args.name_or_sid, "t": t,
+              "ok": args.rc == 0}
+        a = _parse_attrs(args.attrs)
+        if args.rc:
+            a["rc"] = args.rc
+        if a:
+            ev["a"] = a
+        _write(ev)
+        return 0
+    ev = {"e": "p", "n": args.name_or_sid, "t": t}
+    a = _parse_attrs(args.attrs)
+    if a:
+        ev["a"] = a
+    _write(ev)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
