@@ -267,10 +267,16 @@ def worker_main():
     # read+write per group); _routepf/_routefusedpf suffixes.
     route_pf = os.environ.get("LUX_BENCH_ROUTE_PF") == "1"
     route_fused_pf = os.environ.get("LUX_BENCH_ROUTE_FUSED_PF") == "1"
+    # LUX_BENCH_ROUTE_FUSED_MX=1: the MXREDUCE fused pipeline (the
+    # segmented reduction computed INSIDE the final routed Pallas
+    # kernel as an MXU one-hot contraction, ops/expand plan_fused
+    # mx=True); _routefusedmx suffix.
+    route_fused_mx = os.environ.get("LUX_BENCH_ROUTE_FUSED_MX") == "1"
     if sum([route_gather, route_fused, route_pf, route_fused_pf,
-            compact]) > 1:
+            route_fused_mx, compact]) > 1:
         raise SystemExit("LUX_BENCH_ROUTE_GATHER / LUX_BENCH_ROUTE_FUSED "
                          "/ LUX_BENCH_ROUTE_PF / LUX_BENCH_ROUTE_FUSED_PF "
+                         "/ LUX_BENCH_ROUTE_FUSED_MX "
                          "/ LUX_BENCH_COMPACT_GATHER are mutually exclusive")
     shards = build_pull_shards(g, 1, sort_segments=sort_seg,
                                compact_gather=compact)
@@ -280,13 +286,14 @@ def worker_main():
     # threading a parameter through every closure
     _layout = {"route": None, "route_tag": ""}
     route_plan = None
-    if route_gather or route_fused or route_pf or route_fused_pf:
+    if (route_gather or route_fused or route_pf or route_fused_pf
+            or route_fused_mx):
         from lux_tpu.ops import expand
 
         t_plan = time.time()
-        if route_fused or route_fused_pf:
+        if route_fused or route_fused_pf or route_fused_mx:
             route_plan = expand.plan_fused_shards_cached(
-                shards, "sum", pf=route_fused_pf)
+                shards, "sum", pf=route_fused_pf, mx=route_fused_mx)
         else:
             route_plan = expand.plan_expand_shards_cached(
                 shards, pf=route_pf)
@@ -302,11 +309,13 @@ def worker_main():
               file=sys.stderr, flush=True)
         _layout["route"] = route_plan
         _layout["route_tag"] = {
-            (True, False, False, False): "_route",
-            (False, True, False, False): "_routefused",
-            (False, False, True, False): "_routepf",
-            (False, False, False, True): "_routefusedpf",
-        }[(route_gather, route_fused, route_pf, route_fused_pf)]
+            (True, False, False, False, False): "_route",
+            (False, True, False, False, False): "_routefused",
+            (False, False, True, False, False): "_routepf",
+            (False, False, False, True, False): "_routefusedpf",
+            (False, False, False, False, True): "_routefusedmx",
+        }[(route_gather, route_fused, route_pf, route_fused_pf,
+           route_fused_mx)]
     print(f"# worker: graph ready nv={g.nv} ne={g.ne}", file=sys.stderr, flush=True)
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     jax.block_until_ready(arrays)
@@ -385,13 +394,13 @@ def worker_main():
             # the pallas runner never sees route_plan — timing it here
             # would bank an unrouted number under the _route suffix
             methods.remove("pallas")
-        if route_fused or route_fused_pf:
+        if route_fused or route_fused_pf or route_fused_mx:
             # one line: the fused pipeline IS the method
             methods = ["fused"]
         risky_tail = ["scan"] if on_tpu else []
     else:
         methods = (["fused"] if route_fused or route_fused_pf
-                   else [method_env])
+                   or route_fused_mx else [method_env])
         risky_tail = []
     results = {}
 
@@ -412,7 +421,8 @@ def worker_main():
     rp_state = {"warm": None}
     if ("pagerank" in apps and on_tpu
             and not (route_gather or route_fused or route_pf
-                     or route_fused_pf or compact or sort_seg)):
+                     or route_fused_pf or route_fused_mx or compact
+                     or sort_seg)):
         from lux_tpu.ops import expand
 
         def _build_rp():
@@ -765,6 +775,79 @@ def worker_main():
             }
         )
 
+    def measure_mx_micro():
+        """Standing MXU-vs-VPU fused-reduce micro row (ISSUE 7): the
+        SAME tiny fused plan in both flavors — "group" (PR 4's masked
+        group reshape-reduce on the VPU) vs "mxreduce" (the segmented
+        reduction inside the final routed kernel as an MXU one-hot
+        contraction) — so the ``tpu:reduce_mode`` default is measured,
+        not assumed.  Exactness-gated: each flavor must match the
+        NumPy segment-sum oracle (rtol 1e-4 — each has its own
+        deterministic f32 association) before its time counts.  On TPU
+        the winner is banked in the overlay; the row itself is emitted
+        everywhere (CPU rows are real interpret-mode measurements,
+        clearly suffixed like every other fallback family)."""
+        import numpy as np
+
+        from lux_tpu.ops import expand
+
+        ms = _env_int("LUX_BENCH_MX_MICRO_SCALE", 12)
+        gm = generate.rmat(ms, 8, seed=0)
+        src_pos = np.asarray(gm.col_idx).astype(np.int64)
+        dst_local = gm.dst_of_edges().astype(np.int64)
+        rng = np.random.default_rng(0)
+        x0_np = rng.random(gm.nv).astype(np.float32)
+        want = np.zeros(gm.nv, np.float32)
+        np.add.at(want, dst_local, x0_np[src_pos])
+        interp = not on_tpu
+        flavor_ms = {}
+        for name, mx in (("group", False), ("mxreduce", True)):
+            st, arr = expand.plan_fused(
+                src_pos, dst_local, gm.ne, gm.nv, gm.nv, "sum", mx=mx)
+            ra = tuple(jnp.asarray(a) for a in arr)
+            x0 = jnp.asarray(x0_np)
+            jax.block_until_ready((x0,) + ra)
+            got = np.asarray(jax.jit(
+                lambda x, st=st, ra=ra: expand.apply_fused(
+                    x, st, ra, interpret=interp))(x0))[: gm.nv]
+            if not np.allclose(got, want, rtol=1e-4, atol=1e-6):
+                print(f"# mx micro: {name} failed the exactness gate "
+                      f"(maxdiff {np.abs(got - want).max():.3e}); row "
+                      "skipped", file=sys.stderr, flush=True)
+                return
+
+            def run(n, st=st, ra=ra):
+                def body(_, x):
+                    acc = expand.apply_fused(x, st, ra, interpret=interp)
+                    return acc[: gm.nv] * 1e-3
+
+                return jax.lax.fori_loop(0, n, body, x0)
+
+            elapsed, _ = fetch_timed(run)
+            # floor at 0.1 us: the differencing can land at the timer's
+            # resolution on tiny CPU runs, and a 0.0 row would read as
+            # "unmeasured" downstream (every bench value is > 0)
+            flavor_ms[name] = max(round(elapsed / iters * 1e3, 4), 1e-4)
+            print(f"# mx micro {name}: {flavor_ms[name]} ms/iter",
+                  file=sys.stderr, flush=True)
+        winner = min(flavor_ms, key=flavor_ms.get)
+        _emit_row({
+            "metric": f"reduce_micro_mx_vs_group_rmat{ms}{suffix}",
+            "value": flavor_ms[winner],
+            "unit": "ms/iter",
+            "winner": winner,
+            "flavor_ms": flavor_ms,
+            "ne": int(gm.ne),
+        })
+        if on_tpu:
+            from lux_tpu.engine.methods import (REDUCE_MODE_KEY,
+                                                record_overlay_entry)
+
+            record_overlay_entry(REDUCE_MODE_KEY, winner)
+            record_overlay_entry("tpu:micro_reduce",
+                                 {"scale": ms, "ms_per_iter": flavor_ms,
+                                  "winner": winner})
+
     def measure_cf(m):
         """Fixed-iteration CF (K=20 latent state): edge-update GTEPS +
         per-iteration ms + final RMSE (the reference's CF quality metric,
@@ -781,7 +864,11 @@ def worker_main():
         # from a no-op; 1e-3 converges on bipartite_ratings graphs (the
         # same setting every CF oracle test uses) so the tracked RMSE is
         # a real quality signal.  Perf (GTEPS/iter_ms) is gamma-invariant.
-        prog = CFProgram(gamma=1e-3)
+        from lux_tpu.models.colfilter import _resolve_err_dot
+
+        # the banked tpu:cf_err_dot winner is the shipped config — the
+        # bench row measures what the drivers actually run
+        prog = CFProgram(gamma=1e-3, err_dot=_resolve_err_dot(None))
         arrays_w = jax.tree.map(jnp.asarray, wshards.arrays)
         s0 = pull.init_state(prog, arrays_w)
 
@@ -992,7 +1079,7 @@ def worker_main():
         except Exception as e:  # noqa: BLE001
             print(f"# components failed: {e}", file=sys.stderr, flush=True)
     layout_ab = (sort_seg or compact or route_gather or route_fused
-                 or route_pf or route_fused_pf)
+                 or route_pf or route_fused_pf or route_fused_mx)
     if "serve" in apps:
         if layout_ab:
             print("# serve row skipped: layout A/B run", file=sys.stderr,
@@ -1019,6 +1106,19 @@ def worker_main():
                 measure_ba()
             except Exception as e:  # noqa: BLE001
                 print(f"# ba row failed: {e}", file=sys.stderr, flush=True)
+    if "pagerank" in apps:
+        # standing mxu-vs-vpu reduce micro row (tiny graph, both fused
+        # flavors); skipped under layout A/B runs like serve/ba so the
+        # isolation property of those runs holds
+        if layout_ab:
+            print("# mx micro row skipped: layout A/B run",
+                  file=sys.stderr, flush=True)
+        else:
+            try:
+                measure_mx_micro()
+            except Exception as e:  # noqa: BLE001
+                print(f"# mx micro row failed: {e}", file=sys.stderr,
+                      flush=True)
     if "pagerank" in apps and results and (
         on_tpu or os.environ.get("LUX_BENCH_FORCE_SCALEUP") == "1"
     ):
@@ -1028,7 +1128,8 @@ def worker_main():
         # budget is spent, and BEFORE the risky tail (a scan wedge must
         # not cost it)
         tpu_budget = _env_int("LUX_BENCH_TPU_S", 600)
-        if route_gather or route_fused or route_pf or route_fused_pf:
+        if (route_gather or route_fused or route_pf or route_fused_pf
+                or route_fused_mx):
             print("# scale-up skipped: routed-expand A/B plans exist only "
                   "for the headline graph", file=sys.stderr, flush=True)
         elif time.monotonic() - t_worker0 < 0.5 * tpu_budget:
@@ -1088,7 +1189,8 @@ def _record_winner(results):
             or os.environ.get("LUX_BENCH_ROUTE_GATHER") == "1"
             or os.environ.get("LUX_BENCH_ROUTE_FUSED") == "1"
             or os.environ.get("LUX_BENCH_ROUTE_PF") == "1"
-            or os.environ.get("LUX_BENCH_ROUTE_FUSED_PF") == "1"):
+            or os.environ.get("LUX_BENCH_ROUTE_FUSED_PF") == "1"
+            or os.environ.get("LUX_BENCH_ROUTE_FUSED_MX") == "1"):
         # an A/B run under a non-default layout must not mutate the
         # default-layout winner (it would silently change every later
         # allgather run); the human folds A/B results in via PERF.md

@@ -41,6 +41,8 @@ def expected_kernels(static) -> int:
         return expected_kernels(static.src) + expected_kernels(static.dst)
     n = (shuf.route_num_hbm_passes(static.r1) + len(static.ff.levels)
          + shuf.route_num_hbm_passes(static.r2))
+    if getattr(static, "mx", None) is not None:
+        n += 1  # the MXREDUCE final group: suffix gathers + reduction
     if hasattr(static, "vr"):
         n += shuf.route_num_hbm_passes(static.vr)
     return n
@@ -58,11 +60,19 @@ def claimed_kernels(static, claimed: dict) -> Optional[float]:
         return None
     if hasattr(static, "n2"):  # FusedStatic: un-scale the space factors
         r2 = r2 * static.n / static.n2
+        mx = 0.0
+        if getattr(static, "mx", None) is not None:
+            # the mx kernel is claimed at HALF a sweep over n2 (one
+            # read, no full write) — un-scale back to one kernel
+            try:
+                mx = float(claimed["mx"]) * static.n / static.n2 / 0.5
+            except (KeyError, TypeError):
+                return None
         try:
             vr = float(claimed["vr"]) * static.n / static.nv_route
         except (KeyError, TypeError):
             return None
-        return r1 + r2 + vr
+        return r1 + r2 + mx + vr
     return r1 + r2
 
 

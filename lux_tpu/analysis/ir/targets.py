@@ -11,7 +11,10 @@ resulting jaxpr / StableHLO.
 ``--fast`` covers pull + push + one pass-fused config + the luxtrace
 telemetry-ring twins (the ci_check tier); ``--all`` adds the serve
 batched steps, the distributed push engines (allgather + ring, on a
-host-device mesh), the fused-pf plan, and the dynamic-knob recompile
+host-device mesh), the fused-pf and fused-mx plans (the MXREDUCE
+in-kernel reduction: its retrace stability, VMEM ledger incl. the
+one-hot/accumulator tiles, kernel-count parity against the 0.5-sweep
+roofline claim, and ring neutrality), and the dynamic-knob recompile
 probes (chip-day step -3b).
 
 The telemetry units ("+ring"/"ring-donate"/"ring-neutral") audit the
@@ -96,6 +99,14 @@ def _fused_pf_plan():
 
     return expand.plan_fused_shards(fixture()["shards"], reduce="sum",
                                     pf=True)
+
+
+@lru_cache(maxsize=1)
+def _fused_mx_plan():
+    from lux_tpu.ops import expand
+
+    return expand.plan_fused_shards(fixture()["shards"], reduce="sum",
+                                    mx=True)
 
 
 def _dev_route(plan):
@@ -462,6 +473,14 @@ def _vmem_fused_pf() -> List[Finding]:
                            "fused-pf")
 
 
+def _vmem_fused_mx() -> List[Finding]:
+    """LUX-J4's mxreduce leg (ISSUE 7): the MXREDUCE final group's
+    one-hot / accumulator / rank tiles join the residency ledger."""
+    rs, ra = _fused_mx_plan()
+    return vmem.check_vmem(rs, ra, "lux_tpu/ops/pallas_shuffle.py",
+                           "fused-mx")
+
+
 def _expand_traced(plan):
     import jax
 
@@ -517,6 +536,59 @@ def _hbm_fused_pf() -> List[Finding]:
     return hbm.check_hbm(traced, rs, "lux_tpu/ops/expand.py", "fused-pf")
 
 
+def _hbm_fused_mx() -> List[Finding]:
+    """LUX-J5's mxreduce leg: the fused-mx replay's pallas_call count
+    must match the static's derivation (prefix groups + ONE combined
+    gather+reduce kernel), and the roofline claim — which charges that
+    kernel 0.5 sweeps and drops the separate reduce sweep — must
+    un-scale back to the same kernel count."""
+    import jax
+
+    from lux_tpu.ops import expand
+
+    fx = fixture()
+    rs, ra = _dev_route(_fused_mx_plan())
+    part = jax.tree.map(lambda a: a[0], ra)
+    full = fx["state0"].reshape(-1)
+
+    def replay(x, arrs):
+        return expand.apply_fused(x, rs, arrs, interpret=True)
+
+    traced = jax.jit(replay).trace(full, part)
+    return hbm.check_hbm(traced, rs, "lux_tpu/ops/expand.py", "fused-mx")
+
+
+def _retrace_pull_fixed_mx() -> List[Finding]:
+    """LUX-J1 for the mxreduce engine entry point: the fused-mx routed
+    pull must trace stably and keep one compile across run lengths,
+    exactly like every other config of the pull-fixed family."""
+    fx = fixture()
+    route = _fused_mx_plan()
+    path = "lux_tpu/engine/pull.py"
+    label = "pull-fixed/fused-mx"
+    statics = (fx["prank"], fx["shards"].spec, "scan", route[0])
+    out = retrace.trace_twice_stable(
+        lambda: _pull_fixed_traced(2, route), path, label, statics=statics)
+    out += retrace.check_variants(
+        [_pull_fixed_traced(2, route), _pull_fixed_traced(3, route)],
+        path, label)
+    return out
+
+
+def _hbm_mx_ring_neutral() -> List[Finding]:
+    """LUX-J503 for the mxreduce entry point: the telemetry ring on the
+    fused-mx hot loop must launch EXACTLY the base config's kernels —
+    the in-kernel reduction must stay one kernel with the ring riding
+    the carry."""
+    from lux_tpu.obs import ring as obs_ring
+
+    route = _fused_mx_plan()
+    base = _pull_fixed_traced(2, route)
+    twin = _pull_fixed_traced(2, route, obs_ring.new_ring("pull_fixed"))
+    return hbm.check_kernel_parity(base, twin, "lux_tpu/engine/pull.py",
+                                   "pull-fixed/fused-mx/ring-neutral")
+
+
 # ---------------------------------------------------------------------------
 # the registry
 # ---------------------------------------------------------------------------
@@ -533,6 +605,8 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
         AuditUnit("retrace", "pull-fixed/routed-pf+ring",
                   "lux_tpu/engine/pull.py", True,
                   _retrace_pull_fixed_ring),
+        AuditUnit("retrace", "pull-fixed/fused-mx",
+                  "lux_tpu/engine/pull.py", False, _retrace_pull_fixed_mx),
         AuditUnit("retrace", "pull-until/direct",
                   "lux_tpu/engine/pull.py", False, _retrace_pull_until),
         AuditUnit("retrace", "push-chunk/it_stop",
@@ -576,6 +650,8 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   True, _vmem_expand_pf),
         AuditUnit("vmem", "fused-pf", "lux_tpu/ops/pallas_shuffle.py",
                   False, _vmem_fused_pf),
+        AuditUnit("vmem", "fused-mx", "lux_tpu/ops/pallas_shuffle.py",
+                  False, _vmem_fused_mx),
         AuditUnit("hbm", "expand", "lux_tpu/ops/expand.py", False,
                   lambda: _hbm_expand(False)),
         AuditUnit("hbm", "expand-pf", "lux_tpu/ops/expand.py", True,
@@ -584,6 +660,10 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   "lux_tpu/engine/pull.py", True, _hbm_ring_neutral),
         AuditUnit("hbm", "fused-pf", "lux_tpu/ops/expand.py", False,
                   _hbm_fused_pf),
+        AuditUnit("hbm", "fused-mx", "lux_tpu/ops/expand.py", False,
+                  _hbm_fused_mx),
+        AuditUnit("hbm", "pull-fixed/fused-mx/ring-neutral",
+                  "lux_tpu/engine/pull.py", False, _hbm_mx_ring_neutral),
     ]
     if fast:
         units = [u for u in units if u.fast]

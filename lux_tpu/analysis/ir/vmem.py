@@ -45,6 +45,29 @@ def group_residency_bytes(group, idx_arrays) -> int:
     return 2 * group.block_rows * LANE * (_DATA_BYTES + idx_bytes)
 
 
+def mx_residency_bytes(mxg, mx_arrays, weighted: bool) -> int:
+    """VMEM residency of an MXREDUCE final group (the LUX-J4 satellite:
+    the one-hot and accumulator tiles join the ledger).  Streamed
+    operands double-buffer like any BlockSpec'd input: the data tile
+    (f32 in, NO full out tile — the kernel's output is the totals
+    column), the per-step index tiles, the rank tile, and the optional
+    weight tile.  On top: the materialized (v_blk, 128) one-hot operand
+    (f32-width bound — bf16 plans use half), the f32 per-tile
+    accumulator, and the revisited (v_blk, 1) output block (also
+    double-buffered by the pipeline)."""
+    step_arrays = mx_arrays[:len(mxg.steps)]
+    dst_rel = mx_arrays[len(mxg.steps)]
+    idx_bytes = sum(int(a.dtype.itemsize) for a in step_arrays)
+    idx_bytes += int(dst_rel.dtype.itemsize)
+    if weighted:
+        idx_bytes += 4
+    tile = 2 * mxg.block_rows * LANE * (4 + idx_bytes)
+    onehot = mxg.v_blk * LANE * 4
+    acc = mxg.v_blk * 4
+    out_blk = 2 * mxg.v_blk * 4
+    return tile + onehot + acc + out_blk
+
+
 def _iter_pf_routes(static):
     """(name, StaticRoutePF) for every pass-fused route inside a plan
     static (ExpandStatic r1/r2, FusedStatic r1/r2/vr, CFRouteStatic
@@ -79,9 +102,9 @@ def _route_arrays_of(static, arrays):
             out[f"dst.{k}"] = v
         return out
     if isinstance(static, E.FusedStatic):
-        r1a, _, r2a, _, _, vra = E.split_fused_arrays(
+        r1a, _, r2a, _, _, vra, mxa = E.split_fused_arrays(
             static, arrays, static.weighted)
-        return {"r1": r1a, "r2": r2a, "vr": vra}
+        return {"r1": r1a, "r2": r2a, "vr": vra, "mx": mxa}
     r1a, _, r2a = E.split_arrays(static, arrays)
     return {"r1": r1a, "r2": r2a}
 
@@ -115,4 +138,20 @@ def check_vmem(static, arrays, path: str, label: str, line: int = 1,
                             "(LUX_PF_VMEM_MB) — this blows up in Mosaic "
                             "on chip, not in interpret-mode tests",
                     text=f"{label}:{name}[{gi}]"))
+    mxg = getattr(static, "mx", None)
+    if mxg is not None:
+        mxa = by_route.get("mx", ())
+        need = mx_residency_bytes(mxg, mxa, bool(static.weighted))
+        if need > budget_bytes:
+            findings.append(Finding(
+                path=path, line=line, col=0, code="LUX-J401",
+                message=f"MXREDUCE final group (block_rows="
+                        f"{mxg.block_rows}, {len(mxg.steps)} steps, "
+                        f"v_blk={mxg.v_blk}) needs {need} B of VMEM "
+                        f"(streamed tiles double-buffered + the one-hot "
+                        f"and accumulator tiles), over the "
+                        f"{budget_bytes} B budget the knobs promise "
+                        "(LUX_PF_VMEM_MB) — this blows up in Mosaic on "
+                        "chip, not in interpret-mode tests",
+                text=f"{label}:mx"))
     return findings

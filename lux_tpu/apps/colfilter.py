@@ -35,7 +35,9 @@ def _run_pallas(cfg, g):
         if cfg.distributed:
             from lux_tpu.parallel import pallas_dist as pd
 
-            prog = cf_model.CFProgram(dtype=cfg.dtype)
+            prog = cf_model.CFProgram(
+                dtype=cfg.dtype,
+                err_dot=cf_model._resolve_err_dot(None))
             pp = pd.build_pallas_parts(g, cfg.num_parts)
             est = preflight.estimate_pallas_pull(
                 pp.arrays.e_src_pos.shape[1], pp.t_chunk, pp.spec.nv_pad,
@@ -141,7 +143,8 @@ def _run_feat(cfg, g, prog):
 def main(argv=None):
     cfg = parse_args(argv, description=__doc__, pull=True, stream=True)
     g = common.load_graph(cfg, weighted=True, bipartite=True)
-    prog = cf_model.CFProgram(dtype=cfg.dtype)
+    prog = cf_model.CFProgram(
+        dtype=cfg.dtype, err_dot=cf_model._resolve_err_dot(None))
     common.validate_exchange(cfg, prog)
     if cfg.stream_hbm_gib:
         # host-offload streaming for the WIDE-state app (the (V, K)

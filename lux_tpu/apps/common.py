@@ -76,15 +76,30 @@ _ROUTE_VERBOSE_ERR = (
 
 
 def route_base(rg: str) -> str:
-    """Layout family of a --route-gather mode: 'expand-pf'/'fused-pf'
-    bind the same shard layouts as their base — pass fusion only changes
-    the device kernel grouping (ops/expand.to_pf), never the plan's
-    layout contract."""
-    return rg[:-3] if rg.endswith("-pf") else rg
+    """Layout family of a --route-gather mode: 'expand-pf'/'fused-pf'/
+    'fused-mx' bind the same shard layouts as their base — pass fusion
+    (and the mxreduce in-kernel reduction) only changes the device
+    kernel grouping (ops/expand.to_pf / plan_fused mx=True), never the
+    plan's layout contract."""
+    return rg[:-3] if rg.endswith(("-pf", "-mx")) else rg
 
 
 def route_is_pf(rg: str) -> bool:
-    return rg.endswith("-pf")
+    # fused-mx is inherently pass-fused (its prefix groups + the
+    # in-kernel reduce group all run the pf kernels)
+    return rg.endswith(("-pf", "-mx"))
+
+
+def route_mx(rg: str):
+    """The ``mx`` argument of the fused planners for a --route-gather
+    mode: 'fused-mx' plans the MXREDUCE form explicitly; 'fused-pf'
+    follows the chip-measured ``tpu:reduce_mode`` winner (None —
+    ops/expand.resolve_fused_mx), so a banked mxreduce measurement
+    upgrades the pass-fused flag without a code edit; plain 'fused'
+    stays the unfused family (False)."""
+    if rg == "fused-mx":
+        return True
+    return None if rg == "fused-pf" else False
 
 
 def resolve_route_auto(cfg) -> None:
@@ -611,7 +626,8 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
         pf = route_is_pf(rg)
         if route_base(rg) == "fused":
             route = expand.plan_fused_shards_cached(shards, prog.reduce,
-                                                    pf=pf)
+                                                    pf=pf,
+                                                    mx=route_mx(rg))
         elif getattr(prog, "k", 1) > 1:
             route = expand.plan_cf_route_shards_cached(shards, pf=pf)
         else:

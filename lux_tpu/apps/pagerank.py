@@ -149,7 +149,9 @@ def main(argv=None):
 
         pf = common.route_is_pf(cfg.route_gather)
         route = (
-            expand.plan_fused_shards_cached(shards, prog.reduce, pf=pf)
+            expand.plan_fused_shards_cached(
+                shards, prog.reduce, pf=pf,
+                mx=common.route_mx(cfg.route_gather))
             if common.route_base(cfg.route_gather) == "fused"
             else expand.plan_expand_shards_cached(shards, pf=pf)
         )

@@ -216,6 +216,77 @@ def route_mode() -> str:
     return rec if rec in ROUTE_MODES else "routed-pf"
 
 
+#: REDUCE-phase modes of the fused routed hot loop the bench/micro
+#: races may record: "group" = the plain masked group reshape-reduce
+#: (VPU sweep over the group space, PR 4's fused form), "mxreduce" =
+#: the segmented reduction fused INTO the final routed Pallas kernel
+#: as a one-hot x state contraction on the MXU (ops/expand plan_fused
+#: mx=True; arXiv:1811.09736's construction).  Sum rides the MXU (bf16
+#: operands where exact, f32 accumulate — ops/pallas_shuffle
+#: StaticMXGroup documents the precision contract); min/max and
+#: integer sums use the same in-kernel layout with a masked VPU reduce
+#: (min has no matmul identity), dtype-preserving bitwise.  Unlike the
+#: route modes the two flavors are NOT bitwise-identical for float
+#: sums (each has its own deterministic association, like mxsum vs
+#: scan), so the default stays "group" until a chip window BANKS the
+#: measured winner — the three-way tpu:sum story (mxsum vs scan vs
+#: mxreduce) is a race, not an assumption.
+REDUCE_MODES = ("group", "mxreduce")
+
+#: overlay key the mxu-vs-vpu micro race (tools/tpu_micro_race.py,
+#: chip_day step 0) and the bench micro row record their winner under.
+REDUCE_MODE_KEY = "tpu:reduce_mode"
+
+
+def reduce_mode() -> str:
+    """The preferred fused-reduce flavor: LUX_REDUCE_MODE env override,
+    else the chip-measured overlay entry, else "group" (the shipped
+    PR-4 behavior — mxreduce changes float-sum association, so it is
+    followed only once measured).  Consumed by the fused planners'
+    ``mx=None`` default (ops/expand.resolve_fused_mx) and the apps'
+    ``--route-gather fused-pf`` path."""
+    env = os.environ.get("LUX_REDUCE_MODE")
+    if env:
+        if env not in REDUCE_MODES:
+            raise ValueError(
+                f"LUX_REDUCE_MODE must be one of {REDUCE_MODES}, "
+                f"got {env!r}")
+        return env
+    rec = _overlay_raw().get(REDUCE_MODE_KEY)
+    return rec if rec in REDUCE_MODES else "group"
+
+
+#: CF error-dot flavors (models/colfilter): "vpu" = the elementwise
+#: multiply + lane-axis jnp.sum (the shipped form), "mxu" = the K-dim
+#: contraction as a true (rows, K) @ (K, 1) dot_general matmul tile
+#: (f32 operands, f32 accumulate — MXU association, so float results
+#: may differ from "vpu" in the last ulps; the race is exactness-gated
+#: against the NumPy oracle with the documented tolerance).
+CF_DOT_MODES = ("vpu", "mxu")
+
+#: overlay key the CF error-dot micro race (tools/tpu_micro_race.py
+#: ``cfdot`` worker) banks its measured winner under.
+CF_DOT_KEY = "tpu:cf_err_dot"
+
+
+def cf_err_dot_mode() -> str:
+    """The preferred CF error-dot flavor: LUX_CF_ERR_DOT env override,
+    else the chip-measured overlay entry, else "vpu" (the shipped
+    behavior — the MXU tile changes f32 association, so it is followed
+    only once measured).  Resolved at driver entry (models/colfilter
+    ``colfilter``/``make_pallas_runner`` with err_dot=None), never
+    inside a trace."""
+    env = os.environ.get("LUX_CF_ERR_DOT")
+    if env:
+        if env not in CF_DOT_MODES:
+            raise ValueError(
+                f"LUX_CF_ERR_DOT must be one of {CF_DOT_MODES}, "
+                f"got {env!r}")
+        return env
+    rec = _overlay_raw().get(CF_DOT_KEY)
+    return rec if rec in CF_DOT_MODES else "vpu"
+
+
 _tiles_cache: tuple | None = None
 
 

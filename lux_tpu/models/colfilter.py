@@ -32,6 +32,43 @@ LAMBDA = 1e-3
 GAMMA = 3.5e-7
 
 
+def err_dot(src: jnp.ndarray, dst: jnp.ndarray, mode: str = "vpu"):
+    """The per-edge K-dim rating prediction <v_src, v_dst> (the CF
+    error-dot, cf_kernel's dot product loop, colfilter_gpu.cu:85-87).
+
+    "vpu": elementwise multiply + lane-axis ``jnp.sum`` — the shipped
+    form.  "mxu" (ISSUE 7): the K-contraction as a TRUE matmul tile,
+    ``(rows, K) @ (K, 1)`` via dot_general with f32 accumulation — on
+    TPU this rides the MXU while the VPU form serializes K lane adds.
+    Both are exact per-term f32; only the f32 ACCUMULATION order
+    differs (last-ulp association, like mxsum vs scan), so the default
+    stays "vpu" until the micro race (tools/tpu_micro_race.py cfdot)
+    banks a measured winner under ``tpu:cf_err_dot``."""
+    import jax
+
+    prod = src * dst
+    if mode == "mxu":
+        ones = jnp.ones((prod.shape[-1], 1), jnp.float32)
+        out = jax.lax.dot_general(
+            prod, ones, (((prod.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return out[..., 0]
+    if mode != "vpu":
+        raise ValueError(f"err_dot mode must be 'vpu' or 'mxu', got {mode!r}")
+    return jnp.sum(prod, axis=-1)
+
+
+def _resolve_err_dot(mode: str | None) -> str:
+    """None follows the chip-measured ``tpu:cf_err_dot`` overlay winner
+    (engine/methods.cf_err_dot_mode); a concrete mode passes through."""
+    if mode is not None:
+        return mode
+    from lux_tpu.engine import methods
+
+    return methods.cf_err_dot_mode()
+
+
 @dataclasses.dataclass(frozen=True)
 class CFProgram:
     k: int = K
@@ -42,6 +79,11 @@ class CFProgram:
     #: case SURVEY.md §7.3 flags (10.7 GB f32 at RMAT27).  Per-edge error
     #: terms and the segmented accumulation stay float32.
     dtype: str = "float32"
+    #: error-dot lowering ("vpu" | "mxu", see ``err_dot``).  A STATIC
+    #: program attribute: it participates in jit compile caches like
+    #: any other program field, and the default keeps every existing
+    #: caller bitwise-unchanged.
+    err_dot: str = "vpu"
 
     reduce: str = dataclasses.field(default="sum", init=False)
     #: the error term reads the destination's current vector per edge, so
@@ -60,7 +102,7 @@ class CFProgram:
         # gathers arrive in the storage dtype; compute + reduce in f32
         src = src_state.astype(jnp.float32)
         dst = dst_state.astype(jnp.float32)
-        err = weight - jnp.sum(src * dst, axis=-1)
+        err = weight - err_dot(src, dst, self.err_dot)
         # [..., None]: edge values arrive as (E, K) from the CSC engines or
         # (C, T, K) chunk tiles from the distributed Pallas path
         return err[..., None] * src
@@ -86,12 +128,18 @@ def colfilter(
     method: str = "auto",
     dtype: str = "float32",
     route=None,
+    err_dot: str | None = None,
 ) -> np.ndarray:
     """Run CF; returns the (nv, k) latent-vector matrix.  ``route``: a
-    plan from ops.expand.plan_cf_route_shards (routed src+dst load)."""
+    plan from ops.expand.plan_cf_route_shards (routed src+dst load).
+    ``err_dot``: error-dot lowering; the None default follows the
+    measured ``tpu:cf_err_dot`` overlay winner ("vpu" until a window
+    banks one), so an unattended measurement changes the driver with
+    no code edit — same contract as the method winners."""
     shards = g if isinstance(g, PullShards) else build_pull_shards(g, num_parts)
     assert shards.spec.weighted, "CF requires a weighted (rating) graph"
-    prog = CFProgram(k=k, lam=lam, gamma=gamma, dtype=dtype)
+    prog = CFProgram(k=k, lam=lam, gamma=gamma, dtype=dtype,
+                     err_dot=_resolve_err_dot(err_dot))
     state0 = pull.init_state(prog, shards.arrays)
     if mesh is None:
         final = pull.run_pull_fixed(
@@ -111,10 +159,15 @@ def colfilter(
 def make_pallas_runner(g: HostGraph, k: int = K, lam: float = LAMBDA,
                        gamma: float = GAMMA, interpret: bool = False,
                        v_blk: int | None = None, t_chunk: int | None = None,
-                       dtype: str = "float32"):
+                       dtype: str = "float32",
+                       err_dot_mode: str | None = None):
     """Single-chip CF on the fused 2-D Pallas kernel: the err·srcVec
-    accumulation becomes a (V_BLK, T) x (T, K) MXU matmul per chunk.
-    Returns (run(state, num_iters), state0)."""
+    accumulation becomes a (V_BLK, T) x (T, K) MXU matmul per chunk,
+    and with ``err_dot_mode="mxu"`` the error-dot itself lowers as a
+    (C*T, K) @ (K, 1) MXU matmul tile too (None = the measured
+    ``tpu:cf_err_dot`` winner), so BOTH K-contractions of the CF
+    recurrence ride the systolic unit.  Returns
+    (run(state, num_iters), state0)."""
     import functools
 
     import jax
@@ -127,6 +180,7 @@ def make_pallas_runner(g: HostGraph, k: int = K, lam: float = LAMBDA,
         kw["v_blk"] = v_blk
     if t_chunk:
         kw["t_chunk"] = t_chunk
+    ed_mode = _resolve_err_dot(err_dot_mode)
     bc = ps.build_blockcsr(g, **kw)
     nvp = bc.num_vblocks * bc.v_blk
     state0 = np.zeros((nvp, k), np.float32)
@@ -148,7 +202,7 @@ def make_pallas_runner(g: HostGraph, k: int = K, lam: float = LAMBDA,
             # SURVEY.md §7.3's memory case); error math + reduce stay f32
             src_vec = s[e_src].astype(jnp.float32)  # (C, T, K)
             dst_vec = s[dst_global].astype(jnp.float32)
-            err = w - jnp.sum(src_vec * dst_vec, axis=-1)  # (C, T)
+            err = w - err_dot(src_vec, dst_vec, ed_mode)  # (C, T)
             vals = err[..., None] * src_vec
             acc = ps.spmv_blockcsr_2d(
                 vals, e_dst, cb, cf, v_blk=bc.v_blk,

@@ -77,6 +77,11 @@ PLAN_FORMAT = 5
 #: "*-pf" cache families.
 PF_FORMAT = 1
 
+#: bump when the mxreduce plan layout (StaticMXGroup, the rank-major
+#: aligned group space, or the mx array arrangement) changes — salts
+#: ONLY the "fused-mx-*" cache family.
+MX_FORMAT = 1
+
 
 # ---------------------------------------------------------------------------
 # plan-build accounting + the host-side planning executor
@@ -237,6 +242,17 @@ def _narrow_idx(a: np.ndarray) -> np.ndarray:
         # out of bounds under promise_in_bounds — fail here instead.
         assert a.min() >= 0 and a.max() < LANE, (a.dtype, a.min(), a.max())
     return a.astype(np.uint8)
+
+
+def _narrow_mx(a: np.ndarray) -> np.ndarray:
+    """Narrow an mxreduce RANK tile to uint8.  Unlike gather indices
+    these are COMPARISON operands (onehot = iota == rank), so the bound
+    is the u8 range itself: values are in [0, v_blk] with v_blk <= 248
+    (ops/pallas_shuffle._mx_defaults) and v_blk the padding sentinel —
+    never gathered through, safe anywhere <= 255."""
+    if a.size:
+        assert a.min() >= 0 and a.max() <= 255, (a.dtype, a.min(), a.max())
+    return a.astype(np.uint8)  # luxcheck: disable=LUX-P003 -- rank tiles are compared (iota == rank), never gathered through; the full u8 range is the bound and it IS asserted one line up
 
 
 def _next_pow2(n: int) -> int:
@@ -533,7 +549,12 @@ def _to_pf_one(static, arrays, knobs=(None, None, None)):
         return (dataclasses.replace(static, r1=r1s, r2=r2s),
                 tuple(r1n) + tuple(ffa) + tuple(r2n))
     if isinstance(static, FusedStatic):
-        r1a, ffa, r2a, gmask, gweights, vra = split_fused_arrays(
+        if getattr(static, "mx", None) is not None:
+            raise TypeError(
+                "to_pf: mxreduce plans are already pass-fused (and their "
+                "r2 grouping is mx-constrained); build them with "
+                "plan_fused(..., mx=True)")
+        r1a, ffa, r2a, gmask, gweights, vra, _mxa = split_fused_arrays(
             static, arrays, static.weighted)
         r1s, r1n = _pf_route(static.r1, r1a, knobs)
         r2s, r2n = _pf_route(static.r2, r2a, knobs)
@@ -600,11 +621,22 @@ class FusedStatic:
     nv_route: int       # pow2 routing space for the accumulator
     reduce: str         # "sum" | "min" | "max"
     weighted: bool      # plan carries pre-routed f32 weights
-    groups: tuple[tuple[int, int, int], ...]  # (offset, count, 2**k)
+    #: (offset, count, 2**k) per width class.  ``offset`` is a GROUP-
+    #: SPACE element offset for the plain layout, a RANK offset for the
+    #: mxreduce layout (whose element offsets carry per-rank-block
+    #: alignment padding and live in the plan's seg-boundary tiles).
+    groups: tuple[tuple[int, int, int], ...]
     r1: object  # shuf.StaticRoute | shuf.StaticRoutePF (see ExpandStatic)
     ff: FFStatic
     r2: object
     vr: object
+    #: mxreduce: the final r2 group fused WITH the segmented reduction
+    #: (ops/pallas_shuffle.StaticMXGroup).  When set, ``r2`` holds only
+    #: the prefix groups (identity final — the reduction consumes the
+    #: final physical layout via plan-time rank tiles) and the plan's
+    #: arrays carry (mx step idx tiles, dst_rel, tile_block, tile_first)
+    #: in place of the group mask.  None = the plain masked group-reduce.
+    mx: object = None
 
 
 def _neutral_like(reduce: str, dtype):
@@ -621,7 +653,8 @@ def _neutral_like(reduce: str, dtype):
 def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
                state_size: int, v_pad: int, reduce: str = "sum",
                weights: np.ndarray | None = None,
-               template: dict[int, int] | None = None):
+               template: dict[int, int] | None = None,
+               mx: bool = False):
     """Plan the fused routed pull for ONE part.
 
     src_pos / dst_local: (e_pad,) CSC-order arrays (fill_part layout:
@@ -631,7 +664,17 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
 
     Returns (FusedStatic, arrays): arrays = r1 passes + ff levels + r2
     passes + (group_mask float/bool, group_weights or (), vr passes).
-    """
+
+    ``mx=True`` plans the MXREDUCE form instead: the group layout goes
+    rank-major with tile-span-aligned rank blocks, r2's target
+    permutation is pre-composed with the pass-fused final physical
+    layout (shuf.mx_physical_order), and the final pass group carries
+    the segmented reduction in-kernel (shuf.StaticMXGroup) driven by
+    plan-time SEGMENT-BOUNDARY TILES — dst_rel (u8 rank map, sentinel
+    = v_blk), tile_block/tile_first (scalar-prefetch output routing).
+    Arrays become r1 + ff + r2-prefix + mx-steps + (dst_rel,
+    tile_block, tile_first) + (weights?) + vr; no group mask (the
+    sentinel subsumes it).  r1/vr freeze pass-fused directly."""
     n, csr, perm1, ff_static, ff_arrays = _plan_expand_half(
         src_pos, m, state_size)
 
@@ -650,6 +693,7 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     total_rank = np.empty(len(dsts), np.int64)  # dst -> totals-array slot
     off = 0
     rank_off = 0
+    rank_widths: list[np.ndarray] = []  # mx: per-RANK pad width (+dummies)
     for k in sorted(template):
         sel = order[ks[order] == k]
         width = 1 << int(k)
@@ -658,22 +702,61 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
         # template so every part's FusedStatic — and so the vmapped /
         # sharded engines — stay uniform)
         assert len(sel) <= cnt, (k, len(sel), cnt)
-        groups.append((off, cnt, width))
-        if width < LANE:
-            # COLUMN-major (width, count) block: narrow-minor-dim row
-            # layouts like (count, 2) pad every row to a 128-lane vreg
-            # on TPU (measured ~7 ms of the fused loop); transposed, the
-            # reduction runs along <= 16 sublane rows with count on the
-            # lane axis
-            seg_base[sel] = off + np.arange(len(sel), dtype=np.int64)
-            seg_stride[sel] = cnt
-        else:
-            seg_base[sel] = off + np.arange(len(sel), dtype=np.int64) * width
-            seg_stride[sel] = 1
         total_rank[sel] = rank_off + np.arange(len(sel), dtype=np.int64)
-        off += cnt * width
+        if mx:
+            # mx layout is derived from per-rank widths below; ``groups``
+            # records RANK offsets (element offsets carry alignment pads)
+            groups.append((rank_off, cnt, width))
+            rank_widths.append(np.full(cnt, width, np.int64))
+        else:
+            groups.append((off, cnt, width))
+            if width < LANE:
+                # COLUMN-major (width, count) block: narrow-minor-dim row
+                # layouts like (count, 2) pad every row to a 128-lane vreg
+                # on TPU (measured ~7 ms of the fused loop); transposed,
+                # the reduction runs along <= 16 sublane rows with count
+                # on the lane axis
+                seg_base[sel] = off + np.arange(len(sel), dtype=np.int64)
+                seg_stride[sel] = cnt
+            else:
+                seg_base[sel] = (off
+                                 + np.arange(len(sel), dtype=np.int64)
+                                 * width)
+                seg_stride[sel] = 1
+            off += cnt * width
         rank_off += cnt
-    n2 = max(_next_pow2(off), n, LANE)
+    total_slots = rank_off  # template slots incl. dummies
+
+    mx_geom = None
+    if mx:
+        # --- mxreduce layout: rank-major segments, every v_blk-rank
+        # block's span starting on a reduce-tile boundary, so each
+        # kernel tile accumulates into exactly ONE output block ---
+        mx_max_block, tile_rows, v_blk = shuf._mx_defaults()
+        widths = (np.concatenate(rank_widths) if rank_widths
+                  else np.zeros(0, np.int64))
+        num_blocks = max(-(-total_slots // v_blk), 1)
+        ts = tile_rows * LANE
+        cumw = np.zeros(total_slots + 1, np.int64)
+        np.cumsum(widths, out=cumw[1:])
+        bounds = np.minimum(np.arange(num_blocks + 1, dtype=np.int64)
+                            * v_blk, total_slots)
+        block_sizes = cumw[bounds[1:]] - cumw[bounds[:-1]]
+        aligned = -(-block_sizes // ts) * ts
+        aligned_start = np.zeros(num_blocks, np.int64)
+        np.cumsum(aligned[:-1], out=aligned_start[1:])
+        span = int(aligned_start[-1] + block_sizes[-1]) if total_slots else 0
+        n2 = max(_next_pow2(max(span, 1)), n, LANE)
+        if total_slots:
+            blk = np.arange(total_slots, dtype=np.int64) // v_blk
+            seg_base_rank = (aligned_start[blk]
+                             + (cumw[:-1] - cumw[blk * v_blk]))
+        else:
+            seg_base_rank = np.zeros(0, np.int64)
+        mx_geom = (mx_max_block, tile_rows, v_blk, num_blocks,
+                   aligned_start, seg_base_rank)
+    else:
+        n2 = max(_next_pow2(off), n, LANE)
 
     # perm2: CSR slot j (edge csr[j], dst dl[csr[j]]) -> its slot in the
     # group layout (seg base + rank within segment)
@@ -681,8 +764,12 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     seg_starts = np.zeros(len(dsts) + 1, np.int64)
     np.cumsum(counts, out=seg_starts[1:])
     rank_csc = np.arange(m, dtype=np.int64) - seg_starts[seg_of_edge]
-    gslot_csc = (seg_base[seg_of_edge]
-                 + rank_csc * seg_stride[seg_of_edge])  # (m,) group slot
+    if mx:
+        edge_rank = total_rank[seg_of_edge]
+        gslot_csc = mx_geom[5][edge_rank] + rank_csc  # rank-major, stride 1
+    else:
+        gslot_csc = (seg_base[seg_of_edge]
+                     + rank_csc * seg_stride[seg_of_edge])  # (m,) group slot
     # out[group slot of edge e] = y_csr[csr slot of e]
     csr_slot_of_edge = np.empty(m, np.int64)
     csr_slot_of_edge[csr] = np.arange(m, dtype=np.int64)
@@ -694,17 +781,48 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     used_src2[csr_slot_of_edge] = True
     perm2[~used_tgt2] = np.flatnonzero(~used_src2)
 
-    # static group-space mask + pre-routed weights
-    gmask = np.zeros(n2, bool)
-    gmask[gslot_csc] = True
-    if weights is not None:
+    if mx:
+        # pre-compose with the final physical layout: routing perm2r and
+        # SKIPPING the restore transpose lands the desired layout
+        # directly under the in-kernel reduction's rank tiles
+        mx_max_block, tile_rows, v_blk, num_blocks, aligned_start, _ = \
+            mx_geom
+        pf_blk, pf_grp, _ = shuf._pf_defaults()
+        dims2 = route_mod.factor_digits(n2)
+        group_sizes, _sfx = route_mod.plan_mx_fusion_groups(
+            dims2, pf_blk, pf_grp, mx_max_block)
+        sigma = shuf.mx_physical_order(n2, dims2, group_sizes)
+        perm2r = np.empty(n2, np.int64)
+        perm2r[sigma] = perm2
+        # segment-boundary tiles: rank map (sentinel v_blk on padding,
+        # dummy-rank, and junk slots) + per-tile output-block routing
+        rank_rel = np.full(n2, v_blk, np.int64)
+        if m:
+            rank_rel[gslot_csc] = edge_rank % v_blk
+        R = n2 // LANE
+        tb = max(1, min(tile_rows, R))
+        num_tiles = R // tb
+        tstarts = np.arange(num_tiles, dtype=np.int64) * (tb * LANE)
+        tile_block = np.clip(
+            np.searchsorted(aligned_start, tstarts, side="right") - 1,
+            0, num_blocks - 1).astype(np.int32)
+        tile_first = np.zeros(num_tiles, np.int32)
+        tile_first[0] = 1
+        tile_first[1:][tile_block[1:] != tile_block[:-1]] = 1
+        if weights is not None:
+            gweights = np.zeros(n2, np.float32)
+            gweights[gslot_csc] = np.asarray(weights[:m], np.float32)
+    elif weights is not None:
+        # static group-space pre-routed weights (plain layout)
         gweights = np.zeros(n2, np.float32)
         gweights[gslot_csc] = np.asarray(weights[:m], np.float32)
+    if not mx:
+        gmask = np.zeros(n2, bool)
+        gmask[gslot_csc] = True
 
     # accumulator route: totals (group order: one per dst, concat by k)
     # -> dst_local slots of a (nv_route,) vector; uncovered slots pull
     # from the zero tail
-    total_slots = rank_off  # template slots incl. dummies
     nv_route = max(_next_pow2(max(v_pad, total_slots)), LANE)
     permv = np.empty(nv_route, np.int64)
     used_tgtv = np.zeros(nv_route, bool)
@@ -715,8 +833,36 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     # every other accumulator slot reads an unused source slot; source
     # slots >= num_seg are filled with the reduce neutral on device
     permv[~used_tgtv] = np.flatnonzero(~used_srcv)
-    r1, r2, vr = _build_routes(perm1, perm2, permv)
 
+    if mx:
+        r1, r2, vr = _build_routes(perm1, perm2r, permv)
+        r1s, r1a = shuf.plan_route_pf(r1)
+        vrs, vra = shuf.plan_route_pf(vr)
+        r2s, r2a, mxs, mxa = shuf.plan_route_pf_mx(
+            r2, v_blk=v_blk, num_blocks=num_blocks, op=reduce,
+            group_sizes=group_sizes, tile_rows=tb)
+        static = FusedStatic(
+            n=n, n2=n2, state_size=state_size, v_pad=v_pad,
+            nv_route=nv_route, reduce=reduce,
+            weighted=weights is not None, groups=tuple(groups),
+            r1=r1s, ff=ff_static, r2=r2s, vr=vrs, mx=mxs,
+        )
+        idx_groups = (tuple(r1a) + tuple(ff_arrays) + tuple(r2a)
+                      + tuple(mxa))
+        dst_rel = np.ascontiguousarray(rank_rel.reshape(R, LANE))
+        if _idx8_enabled():
+            idx_groups = tuple(_narrow_idx(a) for a in idx_groups)
+            dst_rel = _narrow_mx(dst_rel)
+            vra = tuple(_narrow_idx(a) for a in vra)
+        else:
+            dst_rel = dst_rel.astype(np.int32)
+        warr = ((np.ascontiguousarray(gweights.reshape(R, LANE)),)
+                if weights is not None else ())
+        arrays = (idx_groups + (dst_rel, tile_block, tile_first) + warr
+                  + tuple(vra))
+        return static, arrays
+
+    r1, r2, vr = _build_routes(perm1, perm2, permv)
     r1s, r1a = shuf.freeze_plan(shuf.plan_route(r1))
     r2s, r2a = shuf.freeze_plan(shuf.plan_route(r2))
     vrs, vra = shuf.freeze_plan(shuf.plan_route(vr))
@@ -735,6 +881,11 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
 
 
 def split_fused_arrays(static: FusedStatic, arrays, weighted: bool):
+    """Recover the array groups of a fused plan's flat tuple.  Returns
+    (r1a, ffa, r2a, gmask, gweights, vra, mxa): ``mxa`` is () for plain
+    plans; for mxreduce plans it is (step tiles..., dst_rel, tile_block,
+    tile_first) and ``gmask`` is None (the rank tiles' sentinel subsumes
+    the mask)."""
     n1 = shuf.route_num_arrays(static.r1)
     nff = _ff_array_count(static.ff)
     n2p = shuf.route_num_arrays(static.r2)
@@ -742,11 +893,21 @@ def split_fused_arrays(static: FusedStatic, arrays, weighted: bool):
     ffa = arrays[n1:n1 + nff]
     r2a = arrays[n1 + nff:n1 + nff + n2p]
     rest = arrays[n1 + nff + n2p:]
-    gmask = rest[0]
-    gweights = rest[1] if weighted else None
-    vra = rest[1 + int(weighted):]
+    mxg = getattr(static, "mx", None)
+    if mxg is not None:
+        nmx = len(mxg.steps) + 3  # steps + dst_rel + tile_block/first
+        mxa = rest[:nmx]
+        rest = rest[nmx:]
+        gmask = None
+        gweights = rest[0] if weighted else None
+        vra = rest[int(weighted):]
+    else:
+        mxa = ()
+        gmask = rest[0]
+        gweights = rest[1] if weighted else None
+        vra = rest[1 + int(weighted):]
     assert len(vra) == shuf.route_num_arrays(static.vr)
-    return r1a, ffa, r2a, gmask, gweights, vra
+    return r1a, ffa, r2a, gmask, gweights, vra, mxa
 
 
 def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
@@ -757,31 +918,62 @@ def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
     edge_value(src_vals, weights) is applied elementwise in GROUP layout
     (dst-state-dependent programs are unsupported here — use the expand
     path).  Sum association follows the group layout — a deterministic,
-    method-specific order, like mxsum's."""
+    method-specific order, like mxsum's.  An MXREDUCE plan
+    (``static.mx``) runs the final pass group and the segmented
+    reduction in ONE Pallas kernel (shuf.mxreduce_pass_gather):
+    edge_value applies on the VMEM tile, float sums contract on the MXU
+    (f32 accumulate — its own deterministic association; min/max and
+    integer ops reduce on the VPU, dtype-preserving bitwise), and the
+    group-space array is read once, never written back."""
     if full_state.ndim != 1:
         raise ValueError("fused routed pull supports 1-D state only")
     if weighted is None:
         weighted = static.weighted
-    r1a, ffa, r2a, gmask, gweights, vra = split_fused_arrays(
+    r1a, ffa, r2a, gmask, gweights, vra, mxa = split_fused_arrays(
         static, arrays, weighted)
     x = jnp.pad(full_state, (0, static.n - static.state_size))
     y = shuf.apply_route_frozen(x, static.r1, r1a, interpret=interpret)
     y = apply_ff(y, static.ff, ffa, interpret=interpret)
     y = jnp.pad(y, (0, static.n2 - static.n))
     y = shuf.apply_route_frozen(y, static.r2, r2a, interpret=interpret)
-    if edge_value is not None:
-        y = edge_value(y, gweights) if weighted else edge_value(y, None)
-    neutral = _neutral_like(static.reduce, y.dtype)
-    y = jnp.where(gmask, y, neutral)
-    red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[static.reduce]
-    totals = []
-    for off, count, width in static.groups:
-        blk = jax.lax.dynamic_slice(y, (off,), (count * width,))
-        if width < LANE:  # column-major (width, count) block
-            totals.append(red(blk.reshape(width, count), axis=0))
-        else:
-            totals.append(red(blk.reshape(count, width), axis=1))
-    t = jnp.concatenate(totals) if totals else jnp.zeros(0, y.dtype)
+    total_slots = sum(cnt for _, cnt, _ in static.groups)
+    mxg = getattr(static, "mx", None)
+    if mxg is not None:
+        # r2 above ran only the PREFIX groups (identity final); the mx
+        # kernel chains the suffix gathers with the reduction
+        y = y.reshape(mxg.view)
+        if mxg.perm_axes:
+            y = y.transpose(mxg.perm_axes)
+        y = y.reshape(mxg.kshape)
+        n_steps = len(mxg.steps)
+        step_a = tuple(mxa[:n_steps])
+        dst_rel, tile_block, tile_first = mxa[n_steps:]
+        edge_fn = None
+        if edge_value is not None:
+            edge_fn = (edge_value if weighted
+                       else (lambda v, w: edge_value(v, None)))
+        totals_col = shuf.mxreduce_pass_gather(
+            y, step_a, dst_rel, tile_block, tile_first, group=mxg,
+            edge_fn=edge_fn,
+            weights=gweights if weighted else None,
+            interpret=interpret)
+        t = totals_col[:total_slots]
+        neutral = _neutral_like(static.reduce, t.dtype)
+    else:
+        if edge_value is not None:
+            y = edge_value(y, gweights) if weighted else edge_value(y, None)
+        neutral = _neutral_like(static.reduce, y.dtype)
+        y = jnp.where(gmask, y, neutral)
+        red = {"sum": jnp.sum, "min": jnp.min,
+               "max": jnp.max}[static.reduce]
+        totals = []
+        for off, count, width in static.groups:
+            blk = jax.lax.dynamic_slice(y, (off,), (count * width,))
+            if width < LANE:  # column-major (width, count) block
+                totals.append(red(blk.reshape(width, count), axis=0))
+            else:
+                totals.append(red(blk.reshape(count, width), axis=1))
+        t = jnp.concatenate(totals) if totals else jnp.zeros(0, y.dtype)
     t = jnp.concatenate([
         t, jnp.full((static.nv_route - t.shape[0],), neutral, t.dtype)])
     acc = shuf.apply_route_frozen(t, static.vr, vra, interpret=interpret)
@@ -1076,11 +1268,56 @@ def _cached_stack(tag: str, num_parts: int, key_one, build_one,
 
 
 def _pf_form(static) -> bool:
-    """True iff a plan static is in the PASS-FUSED form (family guard
-    for the "*-pf" cache tags)."""
+    """True iff a plan static is in the plain PASS-FUSED form (family
+    guard for the "*-pf" cache tags; mxreduce entries have their own
+    family and are rejected here)."""
     if isinstance(static, CFRouteStatic):
         return _pf_form(static.src) and _pf_form(static.dst)
+    if getattr(static, "mx", None) is not None:
+        return False
     return isinstance(static.r1, shuf.StaticRoutePF)
+
+
+def _mx_form(static) -> bool:
+    """Family guard for the "fused-mx-*" cache tags: the entry must be
+    an MXREDUCE plan (pass-fused routes + the in-kernel reduce group)."""
+    return (isinstance(static, FusedStatic)
+            and getattr(static, "mx", None) is not None
+            and isinstance(static.r1, shuf.StaticRoutePF))
+
+
+def _mx_salt() -> str:
+    """Cache-key salt for mxreduce entries: the pf salt (grouping knobs
+    are baked into the prefix groups) plus the mx geometry knobs —
+    tile rows, suffix block bound, and v_blk all shape the frozen
+    layout, so processes with different knobs must never share one."""
+    blk, rows, vb = shuf._mx_defaults()
+    return _pf_salt() + f":mx{MX_FORMAT}:{blk}:{rows}:{vb}"
+
+
+def _mx_key_one(base_key_one):
+    """Wrap a per-part cache key with the mxreduce salt."""
+    salt = _mx_salt().encode()
+
+    def key_one(h, i):
+        base_key_one(h, i)
+        h.update(salt)
+
+    return key_one
+
+
+def resolve_fused_mx(mx: bool | None) -> bool:
+    """``mx=None`` on the fused planners follows the chip-measured
+    reduce-mode winner (engine/methods.reduce_mode: overlay entry
+    ``tpu:reduce_mode``, banked by the micro race / bench micro row) —
+    an unattended window's measurement flips the fused families to the
+    MXU reduction without a code edit.  Explicit True/False always
+    wins (the bench A/B lines and the fused-mx app flag are explicit)."""
+    if mx is not None:
+        return mx
+    from lux_tpu.engine import methods
+
+    return methods.reduce_mode() == "mxreduce"
 
 
 def _bucket_route_cached(tag: str, src_local, dst_local, v_pad: int,
@@ -1122,7 +1359,8 @@ def plan_scatter_route_shards_cached(sshards, cache_dir: str | None = None):
         v_pad, v_pad, cache_dir)
 
 
-def _fused_plan_one(shards, template, reduce: str, i: int):
+def _fused_plan_one(shards, template, reduce: str, i: int,
+                    mx: bool = False):
     """ONE part's fused plan against a SHARED template — the single
     derivation for the cached and uncached fused planners."""
     arrays = shards.arrays
@@ -1131,15 +1369,25 @@ def _fused_plan_one(shards, template, reduce: str, i: int):
     return plan_fused(
         np.asarray(arrays.src_pos[i]), np.asarray(arrays.dst_local[i]),
         m, shards.spec.gathered_size, v_pad, reduce,
-        weights=np.asarray(arrays.weights[i]), template=template)
+        weights=np.asarray(arrays.weights[i]), template=template, mx=mx)
 
 
-def plan_fused_shards(shards, reduce: str = "sum", pf: bool = False):
+def plan_fused_shards(shards, reduce: str = "sum", pf: bool = False,
+                      mx: bool | None = False):
     """plan_fused for a PullShards bundle.  Parts share one group
     TEMPLATE (max segment count per width class across parts), so all
     parts produce the same FusedStatic and the vmapped engine batches
     them; the price is a few dummy group rows per part, masked to the
-    reduce neutral.  ``pf=True`` returns the pass-fused form."""
+    reduce neutral.  ``pf=True`` returns the pass-fused form;
+    ``mx=True`` (or mx=None with a banked "mxreduce" tpu:reduce_mode
+    winner — resolve_fused_mx) the MXREDUCE form, which is inherently
+    pass-fused."""
+    if resolve_fused_mx(mx):
+        template = _group_template(shards.arrays)
+        return _stack_parts(
+            shards.arrays.src_pos.shape[0],
+            lambda i: _fused_plan_one(shards, template, reduce, i,
+                                      mx=True))
     template = _group_template(shards.arrays)
     plan = _stack_parts(shards.arrays.src_pos.shape[0],
                         lambda i: _fused_plan_one(shards, template, reduce, i))
@@ -1164,7 +1412,8 @@ _STATIC_TYPES = {
     cls.__name__: cls
     for cls in (ExpandStatic, FusedStatic, CFRouteStatic, FFStatic,
                 FFLevelStatic, shuf.StaticRoute, shuf.StaticPass,
-                shuf.StaticRoutePF, shuf.StaticGroup, shuf.StaticStep)
+                shuf.StaticRoutePF, shuf.StaticGroup, shuf.StaticStep,
+                shuf.StaticMXGroup)
 }
 
 
@@ -1289,16 +1538,28 @@ def _fused_key_one(shards, template):
 
 def plan_fused_shards_cached(shards, reduce: str = "sum",
                              cache_dir: str | None = None,
-                             pf: bool = False):
+                             pf: bool = False,
+                             mx: bool | None = False):
     """plan_fused_shards with the shared per-part disk cache (the reduce
     op joins the tag so min/max/sum plans never collide).  Each part's
     key folds the SHARED group template: a recut that changes any
     part's width-class census invalidates exactly the parts it must
     (every part's FusedStatic depends on the template).  ``pf=True``:
-    the pass-fused family (see plan_expand_shards_cached)."""
+    the pass-fused family (see plan_expand_shards_cached); ``mx``
+    (True, or None following the banked tpu:reduce_mode winner): the
+    mxreduce family — its own "fused-mx-<reduce>" tag, keys folding
+    the mx geometry knobs, entries guarded by the _mx_form validator
+    so a foreign entry rebuilds instead of silently replaying the
+    wrong reduce layout."""
     template = _group_template(shards.arrays)
     num = shards.arrays.src_pos.shape[0]
     key_one = _fused_key_one(shards, template)
+    if resolve_fused_mx(mx):
+        return _cached_stack(
+            f"fused-mx-{reduce}", num, _mx_key_one(key_one),
+            lambda i: _fused_plan_one(shards, template, reduce, i,
+                                      mx=True),
+            cache_dir, validate=_mx_form)
     if not pf:
         return _cached_stack(
             f"fused-{reduce}", num, key_one,
@@ -1314,11 +1575,16 @@ def plan_fused_shards_cached(shards, reduce: str = "sum",
 
 
 def has_cached_fused_plan(shards, reduce: str = "sum",
-                          cache_dir: str | None = None, pf: bool = False):
+                          cache_dir: str | None = None, pf: bool = False,
+                          mx: bool | None = False):
     """Per-part paths when the fused plan family is fully cached, else
     None (tools/plan_prewarm.py --check-only)."""
     template = _group_template(shards.arrays)
     key_one = _fused_key_one(shards, template)
+    if resolve_fused_mx(mx):
+        return _warm_paths(f"fused-mx-{reduce}",
+                           shards.arrays.src_pos.shape[0],
+                           _mx_key_one(key_one), cache_dir)
     if pf:
         return _warm_paths(f"fused-pf-{reduce}",
                            shards.arrays.src_pos.shape[0],

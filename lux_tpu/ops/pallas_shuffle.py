@@ -355,6 +355,37 @@ class StaticRoutePF:
     final_perm: tuple[int, ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class StaticMXGroup:
+    """Static half of an MXREDUCE final group (hashable, jit-safe): the
+    route's last 1-3 Benes passes chained in ONE Pallas kernel with the
+    segmented reduction — the kernel gathers like a fused pass group,
+    then contracts each tile against its plan-time rank map
+    (onehot(v_blk, T) @ vals on the MXU for float sums; masked VPU
+    reduce for min/max and integer sums, the no-matmul-identity layout)
+    and accumulates straight into the (num_blocks * v_blk, 1) totals
+    column.  The full group-space array is READ once and never written
+    back: the separate segment/scatter sweep of the plain fused replay
+    is gone (roofline.routed_hbm_passes charges this kernel 0.5 sweeps).
+
+    Precision contract (docs/PERF.md "MXU reduction"): the one-hot
+    operand is exact in bf16; values enter the contraction in their own
+    dtype (f32 stays f32 — no quantization — and bf16 state is already
+    bf16, so operands are "bf16 where exact"); accumulation is ALWAYS
+    f32 (preferred_element_type), and float-sum totals are returned as
+    f32.  min/max and integer ops never touch the MXU and preserve
+    their dtype bitwise."""
+
+    view: tuple[int, ...]       # reshape of the incoming flat array
+    perm_axes: tuple[int, ...]  # entry transpose (XLA), () if identity
+    kshape: tuple[int, ...]     # 2-D kernel operand shape (R, 128)
+    block_rows: int             # reduce-tile rows (covers whole blocks)
+    steps: tuple[StaticStep, ...]
+    v_blk: int                  # totals ranks per output block
+    num_blocks: int             # output blocks (>= 1)
+    op: str                     # "sum" | "min" | "max"
+
+
 def route_num_arrays(static) -> int:
     """Index-array count of a frozen route (unfused: one per pass;
     pass-fused: one per in-group gather step) — the ONE place array
@@ -448,12 +479,23 @@ def _compose_rowlocal(row_idx: np.ndarray, src: np.ndarray,
     return (t // b) * b + src[t % b]
 
 
-def _pf_plan(n: int, dims, canon, group_sizes, vmem_bytes: int):
+def _pf_plan(n: int, dims, canon, group_sizes, vmem_bytes: int,
+             mx=None):
     """Lower canonical Benes pass indices into the pass-fused frozen
     form.  ``canon``: per-pass full-size index arrays in canonical
     mixed-radix shape (Route.passes[j].idx), values in [0, dims[axis]).
     Returns (StaticRoutePF, tuple of (R, 128) int32 index arrays, one
-    per gather step)."""
+    per gather step).
+
+    ``mx`` (a dict with keys v_blk/num_blocks/op/tile_rows) turns the
+    LAST group into an MXREDUCE group: its passes chain in the same
+    kernel as the segmented one-hot reduction (mxreduce_pass_gather),
+    the final canonical-order restore transpose is SKIPPED (the
+    reduction consumes the final PHYSICAL layout directly — callers
+    pre-compose their target permutation with ``mx_physical_order`` so
+    that layout IS the desired one), and the return grows to
+    (StaticRoutePF[prefix groups, identity final], prefix arrays,
+    StaticMXGroup, mx step arrays)."""
     from lux_tpu.ops import route as route_mod
 
     k = len(dims)
@@ -471,8 +513,11 @@ def _pf_plan(n: int, dims, canon, group_sizes, vmem_bytes: int):
     order = list(range(k))
     groups: list[StaticGroup] = []
     arrays: list[np.ndarray] = []
+    mx_group = None
+    mx_arrays: list[np.ndarray] = []
     j = 0
-    for glen in group_sizes:
+    for gi, glen in enumerate(group_sizes):
+        is_mx = mx is not None and gi == len(group_sizes) - 1
         gaxes = list(axes[j:j + glen])
         gcanon = canon[j:j + glen]
         sset: list[int] = []
@@ -483,7 +528,14 @@ def _pf_plan(n: int, dims, canon, group_sizes, vmem_bytes: int):
         for a in sset:
             B *= dims[a]
         rpb = max(B // LANE, 1)
-        tb = _pf_block_rows(R, rpb, glen, vmem_bytes)
+        if is_mx:
+            # the reduce tile: small (the rank-block alignment padding
+            # of the mx layout is a multiple of its span), covering
+            # whole suffix blocks so the chained gathers stay tile-local
+            tb = max(rpb, min(int(mx["tile_rows"]), R))
+            assert tb % rpb == 0 and R % tb == 0, (tb, rpb, R)
+        else:
+            tb = _pf_block_rows(R, rpb, glen, vmem_bytes)
         rest = [a for a in order if a not in sset]
         # entry layout: rest axes (current relative order) outermost,
         # group axes innermost with the first gathered axis in lane
@@ -496,6 +548,7 @@ def _pf_plan(n: int, dims, canon, group_sizes, vmem_bytes: int):
         if perm_axes == tuple(range(k)):
             perm_axes = ()
         steps: list[StaticStep] = []
+        g_arrays: list[np.ndarray] = []
         for step_i, (g, idx_canon) in enumerate(zip(gaxes, gcanon)):
             d = dims[g]
             relayout = None
@@ -522,12 +575,31 @@ def _pf_plan(n: int, dims, canon, group_sizes, vmem_bytes: int):
             assert row_idx.min() >= 0 and row_idx.max() < LANE, (
                 row_idx.min(), row_idx.max())
             steps.append(StaticStep(relayout=relayout))
-            arrays.append(np.ascontiguousarray(row_idx, np.int32))
-        groups.append(StaticGroup(view=view, perm_axes=perm_axes,
-                                  kshape=(R, LANE), block_rows=tb,
-                                  steps=tuple(steps)))
+            g_arrays.append(np.ascontiguousarray(row_idx, np.int32))
+        if is_mx:
+            mx_group = StaticMXGroup(
+                view=view, perm_axes=perm_axes, kshape=(R, LANE),
+                block_rows=tb, steps=tuple(steps),
+                v_blk=int(mx["v_blk"]), num_blocks=int(mx["num_blocks"]),
+                op=str(mx["op"]))
+            mx_arrays = g_arrays
+        else:
+            groups.append(StaticGroup(view=view, perm_axes=perm_axes,
+                                      kshape=(R, LANE), block_rows=tb,
+                                      steps=tuple(steps)))
+            arrays.extend(g_arrays)
         order = rest + gorder
         j += glen
+    if mx is not None:
+        # the reduction consumes the final physical layout in place —
+        # no restore transpose; the layout the caller's rank map was
+        # built against must be exactly the one the threading produced
+        assert order == _pf_final_order(dims, group_sizes), (
+            order, group_sizes)
+        return (StaticRoutePF(n=n, dims=tuple(dims),
+                              groups=tuple(groups),
+                              final_view=(n,), final_perm=()),
+                tuple(arrays), mx_group, tuple(mx_arrays))
     final_view = tuple(dims[a] for a in order)
     final_perm = tuple(order.index(a) for a in range(k))
     if final_perm == tuple(range(k)):
@@ -603,6 +675,278 @@ def pf_from_frozen(static: StaticRoute, arrays, group_sizes=None,
     canon = _frozen_canonical(static, arrays)
     return _pf_plan(static.n, static.dims, canon, group_sizes,
                     vmem_mb << 20)
+
+
+# ---------------------------------------------------------------------------
+# mxreduce: the segmented reduction fused into the final pass group
+# ---------------------------------------------------------------------------
+#
+# The plain fused replay (apply_fused) ends with: last r2 kernel writes
+# the full group-space array back to HBM, then a separate masked
+# reshape-reduce sweep READS it all again.  mxreduce deletes both: the
+# final group's kernel keeps each tile in VMEM after its chained
+# gathers, applies the program's edge_value, and reduces the tile by
+# destination RANK via the one-hot contraction of arXiv:1811.09736
+# (the pattern already proven on this repo's spmv kernels), streaming
+# only the tiny totals column out.  The host-side planner (ops/expand)
+# lays the group space out so that (a) ranks are monotone along the
+# final PHYSICAL layout (the route's target permutation is pre-composed
+# with mx_physical_order, so no restore transpose is ever needed) and
+# (b) every reduce tile maps into exactly ONE v_blk-rank output block
+# (rank-block starts are tile-span aligned) — which lets the output
+# BlockSpec be scalar-prefetch routed and accumulated in VMEM exactly
+# like ops/pallas_spmv's block-CSR kernel.
+
+
+def _mx_defaults(mx_max_block=None, tile_rows=None, v_blk=None):
+    """mxreduce knobs with env defaults: LUX_MX_MAX_BLOCK (largest
+    suffix-group digit block the reduce kernel may chain — also bounds
+    the rank-block alignment padding), LUX_MX_TILE_ROWS (reduce-tile
+    rows; the kernel unrolls one contraction per row), LUX_MX_VBLK
+    (totals ranks per output block; multiple of 8, <= 248 so the u8
+    rank tiles keep a distinct sentinel).  Like the pf knobs they shape
+    the PLAN (and salt the cache key) and are never read at replay."""
+    from lux_tpu.utils.config import env_int
+
+    if mx_max_block is None:
+        mx_max_block = env_int("LUX_MX_MAX_BLOCK", 1024, minimum=LANE)
+    if tile_rows is None:
+        tile_rows = env_int("LUX_MX_TILE_ROWS", 8, minimum=1)
+    if v_blk is None:
+        v_blk = env_int("LUX_MX_VBLK", 128, minimum=8, maximum=248)
+    if v_blk % 8:
+        raise ValueError(f"LUX_MX_VBLK must be a multiple of 8 (the "
+                         f"output column's sublane alignment), got {v_blk}")
+    for name, v in (("LUX_MX_MAX_BLOCK", mx_max_block),
+                    ("LUX_MX_TILE_ROWS", tile_rows)):
+        if v & (v - 1):
+            raise ValueError(f"{name} must be a power of two (tile and "
+                             f"block geometry divide each other), got {v}")
+    if mx_max_block > tile_rows * LANE:
+        raise ValueError(
+            f"LUX_MX_MAX_BLOCK ({mx_max_block}) exceeds the reduce tile "
+            f"(LUX_MX_TILE_ROWS*128 = {tile_rows * LANE}): the suffix "
+            "group's blocks must fit one tile")
+    return mx_max_block, tile_rows, v_blk
+
+
+def _pf_final_order(dims, group_sizes) -> list[int]:
+    """The digit-axis order of the array's FINAL physical layout after
+    all fused groups, BEFORE the restore transpose — a dry run of
+    _pf_plan's order threading (asserted against the real plan there,
+    so the two can never drift).  Needed ahead of route construction:
+    the mxreduce planner pre-composes its target permutation with this
+    layout (mx_physical_order)."""
+    from lux_tpu.ops import route as route_mod
+
+    k = len(dims)
+    axes = route_mod.benes_axes(k)
+    assert sum(group_sizes) == len(axes), (group_sizes, axes)
+    order = list(range(k))
+    j = 0
+    for glen in group_sizes:
+        gaxes = list(axes[j:j + glen])
+        sset: list[int] = []
+        for a in gaxes:
+            if a not in sset:
+                sset.append(a)
+        rest = [a for a in order if a not in sset]
+        gorder = [a for a in order if a in sset and a != gaxes[0]]
+        gorder.append(gaxes[0])
+        for step_i, g in enumerate(gaxes):
+            if step_i and gorder[-1] != g:
+                gorder = [a for a in gorder if a != g] + [g]
+        order = rest + gorder
+        j += glen
+    return order
+
+
+def mx_physical_order(n: int, dims, group_sizes) -> np.ndarray:
+    """sigma: the canonical flat slot living at each FINAL physical
+    position of a pass-fused replay that skips the restore transpose.
+    A caller that wants physical position p to end up holding
+    ``x[desired[p]]`` routes the permutation ``routed`` where
+    ``routed[sigma] = desired`` — the Benes machinery then lands the
+    desired layout directly and the mxreduce kernel consumes it with
+    plan-time rank tiles, no transpose."""
+    order = _pf_final_order(dims, group_sizes)
+    ids = np.arange(n, dtype=np.int64).reshape(tuple(dims))
+    return np.ascontiguousarray(np.transpose(ids, order)).reshape(-1)
+
+
+def plan_route_pf_mx(route: Route, v_blk: int, num_blocks: int, op: str,
+                     group_sizes, tile_rows: int, max_block=None,
+                     max_group=None, vmem_mb=None):
+    """Compile a host Route into the MXREDUCE pass-fused form: the
+    prefix groups replay as ordinary fused kernels (identity final —
+    no restore), the suffix group becomes the StaticMXGroup consumed by
+    ``mxreduce_pass_gather``.  ``group_sizes`` MUST come from
+    route.plan_mx_fusion_groups for the same dims, and the route's
+    target permutation must have been pre-composed with
+    ``mx_physical_order(n, dims, group_sizes)``.
+
+    Returns (StaticRoutePF, prefix arrays, StaticMXGroup, mx step
+    arrays)."""
+    max_block, max_group, vmem_mb = _pf_defaults(max_block, max_group,
+                                                 vmem_mb)
+    canon = [np.asarray(p.idx) for p in route.passes]
+    return _pf_plan(route.n, route.dims, canon, group_sizes,
+                    vmem_mb << 20,
+                    mx={"v_blk": v_blk, "num_blocks": num_blocks,
+                        "op": op, "tile_rows": tile_rows})
+
+
+def _mx_neutral(op: str, dtype):
+    if op == "sum":
+        return jnp.zeros((), dtype)
+    return reduce_neutral_mx(op, dtype)
+
+
+def reduce_neutral_mx(op: str, dtype):
+    """min/max identity (same convention as ops/pallas_spmv
+    reduce_neutral; duplicated at this layer to keep pallas_shuffle
+    importable without the spmv module's graph deps)."""
+    d = jnp.dtype(dtype)
+    if jnp.issubdtype(d, jnp.integer):
+        info = jnp.iinfo(d)
+        return jnp.asarray(info.max if op == "min" else info.min, d)
+    return jnp.asarray(jnp.inf if op == "min" else -jnp.inf, d)
+
+
+def _mx_kernel(group: StaticMXGroup, edge_fn, weighted: bool,
+               out_dtype, tile_block_ref, tile_first_ref, x_ref, *refs):
+    """MXREDUCE kernel body: chained gathers on the VMEM tile (exactly
+    _pf_kernel's steps), then edge_value + sentinel masking + the
+    per-row one-hot reduction accumulated into the scalar-prefetch
+    routed output block.  One HBM read of the tile, zero full writes."""
+    import jax.experimental.pallas as pl
+
+    tb, v_blk, op = group.block_rows, group.v_blk, group.op
+    n_steps = len(group.steps)
+    o_ref = refs[-1]
+    i = pl.program_id(0)
+    y = x_ref[:]
+    for st, iref in zip(group.steps, refs[:n_steps]):
+        if st.relayout is not None:
+            rview, rperm = st.relayout
+            y = y.reshape(rview).transpose(rperm).reshape(tb, LANE)
+        y = jnp.take_along_axis(
+            y, iref[:].astype(jnp.int32), axis=1, mode="promise_in_bounds"
+        )
+    dst = refs[n_steps][:].astype(jnp.int32)  # (tb, 128) rank-rel map
+    w = refs[n_steps + 1][:] if weighted else None
+    vals = edge_fn(y, w) if edge_fn is not None else y
+    valid = dst < v_blk  # sentinel (v_blk) marks padding/junk slots
+    neutral = _mx_neutral(op, vals.dtype)
+    # mask BEFORE the contraction: routed junk values may be Inf/NaN
+    # sentinels (e.g. int32 maxes cast by an edge_fn) and 0 * NaN = NaN
+    # would poison the matmul accumulator
+    vals = jnp.where(valid, vals, neutral)
+
+    @pl.when(tile_first_ref[i] == 1)
+    def _():
+        o_ref[:] = jnp.full_like(o_ref, _mx_neutral(op, o_ref.dtype))
+
+    float_sum = op == "sum" and jnp.issubdtype(vals.dtype, jnp.floating)
+    if float_sum:
+        # MXU path: bf16 operands where exact (the one-hot is exact in
+        # bf16; bf16 values are already bf16), f32 accumulate always
+        cd = (jnp.bfloat16 if vals.dtype == jnp.bfloat16
+              else jnp.float32)
+        acc = jnp.zeros((v_blk, 1), jnp.float32)
+    else:
+        acc = jnp.full((v_blk, 1), _mx_neutral(op, vals.dtype),
+                       vals.dtype)
+    for r in range(tb):
+        dr = dst[r:r + 1, :]    # (1, 128)
+        vr = vals[r:r + 1, :]   # (1, 128)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (v_blk, LANE), 0)
+        onehot = iota == dr     # (v_blk, 128); sentinel matches no row
+        if float_sum:
+            acc = acc + jax.lax.dot_general(
+                onehot.astype(cd), vr.astype(cd),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            masked = jnp.where(onehot, jnp.broadcast_to(vr, onehot.shape),
+                               _mx_neutral(op, vals.dtype))
+            red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[op]
+            part = red(masked, axis=1, keepdims=True)
+            if op == "sum":
+                acc = acc + part
+            elif op == "min":
+                acc = jnp.minimum(acc, part)
+            else:
+                acc = jnp.maximum(acc, part)
+    if op == "sum":
+        o_ref[:] = o_ref[:] + acc.astype(out_dtype)
+    elif op == "min":
+        o_ref[:] = jnp.minimum(o_ref[:], acc.astype(out_dtype))
+    else:
+        o_ref[:] = jnp.maximum(o_ref[:], acc.astype(out_dtype))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("group", "edge_fn", "interpret"))
+def mxreduce_pass_gather(x, idx, dst_rel, tile_block, tile_first,
+                         group: StaticMXGroup, edge_fn=None,
+                         weights=None, interpret: bool = False):
+    """Run the MXREDUCE final group: x (R, 128) in the group's entry
+    layout -> totals (num_blocks * v_blk, 1).
+
+    ``idx``: tuple of per-step gather index tiles ((R, 128), values
+    < 128, u8 or wider).  ``dst_rel``: (R, 128) plan-time rank map of
+    the FINAL layout (values < v_blk; v_blk = padding sentinel;
+    u8-narrowable).  ``tile_block``/``tile_first``: (R / block_rows,)
+    int32 scalar-prefetch routing of each tile's output block.
+    ``edge_fn(vals, weights)`` is the program's elementwise edge_value,
+    applied on the VMEM tile; ``weights`` an optional (R, 128) f32
+    plan-time array in the same layout."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = x.shape[0]
+    tb = group.block_rows
+    assert r % tb == 0, (r, tb)
+    assert group.kshape == (r, LANE), (group.kshape, x.shape)
+    weighted = weights is not None
+    if edge_fn is None:
+        val_dtype = x.dtype
+    else:
+        val_dtype = jax.eval_shape(
+            edge_fn, jax.ShapeDtypeStruct((tb, LANE), x.dtype),
+            jax.ShapeDtypeStruct((tb, LANE), jnp.float32)
+            if weighted else None).dtype
+    out_dtype = (jnp.float32
+                 if group.op == "sum" and jnp.issubdtype(val_dtype,
+                                                         jnp.floating)
+                 else val_dtype)
+    spec = pl.BlockSpec((tb, LANE), lambda i, cb, cf: (i, 0))
+    n_in = 1 + len(idx) + 1 + int(weighted)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(r // tb,),
+        in_specs=[spec] * n_in,
+        out_specs=pl.BlockSpec((group.v_blk, 1),
+                               lambda i, cb, cf: (cb[i], 0)),
+    )
+    operands = (x,) + tuple(idx) + (dst_rel,)
+    if weighted:
+        operands = operands + (weights,)
+    out = pl.pallas_call(
+        functools.partial(_mx_kernel, group, edge_fn, weighted,
+                          jnp.dtype(out_dtype)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (group.num_blocks * group.v_blk, 1), out_dtype),
+        compiler_params=_compiler_params(
+            pltpu,
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(tile_block, tile_first, *operands)
+    return out.reshape(-1)
 
 
 def _pf_kernel(steps, tb, x_ref, *refs):

@@ -97,6 +97,15 @@ def plan_fusion_groups(dims, max_block_elems: int = 1 << 17,
     if max_group < 1:
         raise ValueError(f"max_group must be >= 1, got {max_group}")
     axes = benes_axes(len(dims))
+    return _pack_axes(dims, axes, max_block_elems, max_group)
+
+
+def _pack_axes(dims, axes, max_block_elems: int,
+               max_group: int) -> tuple[int, ...]:
+    """Greedy left-to-right packing of ``axes`` (a slice of the Benes
+    pass sequence) into fusion groups under the distinct-digit block
+    budget — the shared engine behind plan_fusion_groups and the
+    mxreduce grouping (plan_mx_fusion_groups)."""
     groups: list[int] = []
     cur: list[int] = []  # distinct axes of the current group, in order
     cur_len = 0
@@ -110,9 +119,48 @@ def plan_fusion_groups(dims, max_block_elems: int = 1 << 17,
             cur, cur_len = [a], 1
         else:
             cur, cur_len = list(nxt), cur_len + 1
-    groups.append(cur_len)
+    if cur_len:
+        groups.append(cur_len)
     assert sum(groups) == len(axes), (groups, axes)
     return tuple(groups)
+
+
+def plan_mx_fusion_groups(dims, max_block_elems: int = 1 << 17,
+                          max_group: int = 3,
+                          mx_max_block: int = 1024
+                          ) -> tuple[tuple[int, ...], int]:
+    """Fusion grouping for an MXREDUCE route (ops/pallas_shuffle
+    ``plan_route_pf_mx``): the FINAL group is the longest pass suffix
+    whose distinct-digit block fits ``mx_max_block`` — that group's
+    kernel chains the suffix gathers AND the segmented one-hot
+    reduction on the same VMEM tile, so its block size also bounds the
+    reduce tile (the rank-block alignment padding in ops/expand's mx
+    layout is a multiple of the tile span; a big suffix block would
+    inflate the group space).  The prefix packs greedily exactly like
+    plan_fusion_groups.
+
+    Returns ``(group_sizes, suffix_len)`` with
+    ``group_sizes[-1] == suffix_len``; the final Benes pass gathers
+    digit 0 (dim <= 128), so a valid suffix always exists."""
+    if mx_max_block < LANE:
+        raise ValueError(f"mx_max_block must be >= {LANE}, "
+                         f"got {mx_max_block}")
+    if max_group < 1:
+        raise ValueError(f"max_group must be >= 1, got {max_group}")
+    axes = benes_axes(len(dims))
+    suffix = 0
+    for ln in range(1, min(max_group, len(axes)) + 1):
+        distinct = set(axes[-ln:])
+        blk = 1
+        for a in distinct:
+            blk *= dims[a]
+        if blk > mx_max_block:
+            break
+        suffix = ln
+    assert suffix >= 1, (dims, mx_max_block)
+    prefix = (_pack_axes(dims, axes[:-suffix], max_block_elems,
+                         max_group) if len(axes) > suffix else ())
+    return prefix + (suffix,), suffix
 
 
 @dataclasses.dataclass

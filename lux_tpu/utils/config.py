@@ -175,7 +175,7 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         ap.add_argument("--route-gather", nargs="?", const="auto",
                         default="",
                         choices=["auto", "expand", "expand-pf", "fused",
-                                 "fused-pf"],
+                                 "fused-pf", "fused-mx"],
                         help="Benes-routed pull hot loop (ops/expand.py): "
                              "'expand' replaces the per-edge state gather "
                              "with lane shuffles (bitwise-identical); "
@@ -184,7 +184,14 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                              "device).  The '-pf' variants run the "
                              "PASS-FUSED kernels (2-3 Benes passes per "
                              "kernel, VMEM-resident intermediates — same "
-                             "bits, ~40% fewer HBM sweeps).  The bare "
+                             "bits, ~40% fewer HBM sweeps).  'fused-mx' "
+                             "additionally computes the segmented "
+                             "reduction INSIDE the final routed kernel "
+                             "as an MXU one-hot contraction (own "
+                             "deterministic float-sum association; "
+                             "min/max + integer ops bitwise); 'fused-pf' "
+                             "follows the measured tpu:reduce_mode "
+                             "winner between the two.  The bare "
                              "flag means 'auto': expand-pf or expand per "
                              "the chip-measured tpu:route_mode overlay "
                              "(engine/methods.route_mode).  'expand' runs "

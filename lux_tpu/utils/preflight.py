@@ -92,7 +92,15 @@ def routed_plan_bytes(static) -> int:
     b = route_cost(static.r1, n) + ff_cost(static.ff)
     if isinstance(static, FusedStatic):
         b += route_cost(static.r2, static.n2)
-        b += static.n2  # group mask byte
+        mxg = getattr(static, "mx", None)
+        if mxg is not None:
+            # MXREDUCE final group: its in-group gather step tiles +
+            # the dst_rel rank tile (all idx-width over n2) replace the
+            # group mask; tile_block/tile_first are O(tiles) int32
+            n_tiles = static.n2 // (mxg.block_rows * 128)
+            b += (len(mxg.steps) + 1) * static.n2 * idx + 2 * n_tiles * 4
+        else:
+            b += static.n2  # group mask byte
         if static.weighted:
             b += static.n2 * 4  # pre-routed f32 weights
         b += route_cost(static.vr, static.nv_route)
@@ -149,10 +157,13 @@ def routed_plan_bytes_analytic(spec: ShardSpec, mode: str = "expand",
         ff = int(1.02 * n) * (idx + 1)  # lane idx + ext-mask byte
         return passes * n * idx + ff
 
-    # pass-fused modes ('expand-pf'/'fused-pf') carry the SAME index
-    # bytes as their base (one index tile per gather step either way —
-    # fusion collapses data sweeps, not plan residency)
-    if mode.endswith("-pf"):
+    # pass-fused modes ('expand-pf'/'fused-pf'/'fused-mx') carry the
+    # SAME index bytes as their base (one index tile per gather step
+    # either way — fusion collapses data sweeps, not plan residency);
+    # fused-mx swaps the group mask (1 B/elem) for the rank tile
+    # (idx B/elem) — same order, charged identically here
+    mx = mode == "fused-mx"
+    if mode.endswith(("-pf", "-mx")):
         mode = mode[:-3]
     n = max(_next_pow2(spec.e_pad), _next_pow2(spec.gathered_size), 128)
     b = expand_cost(n)
@@ -160,11 +171,11 @@ def routed_plan_bytes_analytic(spec: ShardSpec, mode: str = "expand",
         b += expand_cost(max(_next_pow2(spec.e_pad),
                              _next_pow2(spec.nv_pad), 128))
     if mode == "fused":
-        # r2 moves to the ~2x group space and gains mask+weights; the
-        # accumulator route is small
+        # r2 moves to the ~2x group space and gains mask+weights (or,
+        # mx: the rank tile + weights); the accumulator route is small
         n2 = 2 * n
         k2 = len(factor_digits(n2))
-        b += (2 * k2 - 1) * n2 * idx + n2 * 5
+        b += (2 * k2 - 1) * n2 * idx + n2 * (idx + 4 if mx else 5)
     return b
 
 

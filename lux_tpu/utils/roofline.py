@@ -189,7 +189,16 @@ def routed_hbm_passes(static, method: str = "scan") -> dict:
     out = {"r1": float(r1), "ff": round(ff, 2)}
     if hasattr(static, "n2"):  # FusedStatic
         out["r2"] = round(r2 * static.n2 / n, 2)
-        out["reduce"] = round(static.n2 / n, 2)  # masked group-reduce read
+        if getattr(static, "mx", None) is not None:
+            # MXREDUCE (ISSUE 7): the final pass group and the segmented
+            # reduction share ONE kernel that reads the group space once
+            # and writes only the tiny totals column — half a read+write
+            # sweep, and the separate masked group-reduce sweep is GONE
+            # (r2 above already counts only the prefix groups)
+            out["mx"] = round(0.5 * static.n2 / n, 2)
+            out["reduce"] = 0.0
+        else:
+            out["reduce"] = round(static.n2 / n, 2)  # masked group-reduce read
         vr, _ = _route_counts(static.vr)
         out["vr"] = round(vr * static.nv_route / n, 2)
     else:
@@ -232,9 +241,18 @@ def routed_pull_iter_model(static, ne: int, nv: int,
     b += ff_elems * (2 * v + 4 + 1)  # lane gather + idx + ext-mask byte
     if hasattr(static, "n2"):  # FusedStatic: fused reduce half
         b += route_bytes(static.r2, static.n2)
-        # edge_value + mask + group reshape-reduce: one streaming pass
-        # over the group space (weights f32 + mask byte reads)
-        b += static.n2 * (2 * v + 4 + 1)
+        mxg = getattr(static, "mx", None)
+        if mxg is not None:
+            # MXREDUCE final group: one read of the group space + its
+            # gather-step index tiles + the rank tile (+ f32 weights),
+            # totals column write is negligible; no separate mask /
+            # reduce sweep.  int32 tile widths, like route_bytes.
+            b += static.n2 * (v + len(mxg.steps) * 4 + 4
+                              + (4 if static.weighted else 0))
+        else:
+            # edge_value + mask + group reshape-reduce: one streaming
+            # pass over the group space (weights f32 + mask byte reads)
+            b += static.n2 * (2 * v + 4 + 1)
         b += route_bytes(static.vr, static.nv_route)
         dev_reduce = ne  # element-wise group adds
     else:  # ExpandStatic: values land in CSC order, the chosen

@@ -40,8 +40,17 @@ def test_bench_happy_path_multi_app():
     assert fams[-1] == "pagerank_gteps"
     assert len(fams) == len(set(fams))  # exactly one line per family
     for ln in lines:
-        assert ln["unit"] == ("QPS" if "_qps_" in ln["metric"] else "GTEPS")
+        assert ln["unit"] == (
+            "QPS" if "_qps_" in ln["metric"]
+            else "ms/iter" if ln["metric"].startswith("reduce_micro")
+            else "GTEPS")
         assert ln["value"] > 0
+    # the standing mxu-vs-vpu reduce micro row (ISSUE 7): both flavors
+    # timed, a winner named, present in the DEFAULT output
+    micro = next(ln for ln in lines
+                 if ln["metric"].startswith("reduce_micro"))
+    assert set(micro["flavor_ms"]) == {"group", "mxreduce"}
+    assert micro["winner"] in micro["flavor_ms"]
     qps = next(ln for ln in lines if "_qps_" in ln["metric"])
     assert qps["batched_vs_q1"] > 0 and qps["scheduler"]["completed"] > 0
     cf = next(ln for ln in lines if ln["metric"].startswith("colfilter"))

@@ -1,0 +1,515 @@
+"""mxreduce: the MXU-resident segmented reduction fused into the
+routed-pf hot loop (ISSUE 7).
+
+Pins, all in interpret mode on CPU (correctness never waits on a chip
+window):
+
+1. the mx fusion grouping (ops/route.plan_mx_fusion_groups) bounds the
+   final group's distinct-digit block and still covers every pass;
+2. the MXREDUCE replay (ops/expand.plan_fused mx=True -> apply_fused ->
+   ops/pallas_shuffle.mxreduce_pass_gather) matches the NumPy segment
+   oracle BITWISE for every f32-exact case — min/max and integer sums
+   across dtypes, and float sums whose terms are exactly representable
+   small integers (any association is exact there) — and to the
+   documented tolerance for general f32 / bf16-operand sums (the MXU
+   contraction owns its deterministic association, like mxsum vs scan;
+   bf16 state accumulates in f32 per the StaticMXGroup precision
+   contract);
+3. the contract holds across reduce ops, group-width censuses (narrow
+   sub-lane segments, lane-wide segments, a hub), weighted plans, and
+   forced mx tile/v_blk/suffix-block knobs;
+4. the engine path (run_pull_fixed route=fused-mx, vmapped parts) agrees
+   with the plain fused path and the direct gather;
+5. the "fused-mx-<reduce>" plan-cache family round-trips, is guarded
+   against foreign entries, and resolves mx=None via the banked
+   ``tpu:reduce_mode`` winner;
+6. roofline accounting: the mx kernel is charged 0.5 sweeps, the
+   separate reduce sweep is gone, the fused-mx total drops below the
+   fused-pf total, and LUX-J4/J5 audit the new form clean;
+7. colfilter's error-dot MXU tile (models/colfilter.err_dot mode="mxu")
+   equals the reference error-dot, through both the pull engine and the
+   single-chip Pallas runner.
+"""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lux_tpu.ops import expand as E
+from lux_tpu.ops import pallas_shuffle as S
+from lux_tpu.ops import route as R
+
+
+def _dev(arrays):
+    return tuple(jnp.asarray(a) for a in arrays)
+
+
+def _make_csc(rng, m, nseg, ss, hub=False):
+    """CSC-order (src_pos, dst_local) with a mixed width census: most
+    segments small (sub-lane widths), optionally one hub destination
+    (lane-wide class) — both group layouts of the template."""
+    p = np.ones(nseg)
+    if hub:
+        p[0] = nseg  # ~half the edges land on dst 0
+    p /= p.sum()
+    dst = np.repeat(np.arange(nseg), rng.multinomial(m, p))
+    src = rng.integers(0, ss, m)
+    order = np.argsort(dst, kind="stable")
+    return src[order].astype(np.int64), dst[order].astype(np.int64)
+
+
+def _oracle(src_pos, dst_local, x, nseg, op, weights=None):
+    vals = np.asarray(x, np.float64)[src_pos]
+    if weights is not None:
+        vals = vals * np.asarray(weights, np.float64)
+    out = np.full(
+        nseg,
+        0.0 if op == "sum" else (np.inf if op == "min" else -np.inf))
+    ufunc = {"sum": np.add, "min": np.minimum, "max": np.maximum}[op]
+    ufunc.at(out, dst_local, vals)
+    return out
+
+
+def _apply(static, arrays, x, **kw):
+    return np.asarray(E.apply_fused(jnp.asarray(x), static, _dev(arrays),
+                                    interpret=True, **kw))
+
+
+# ---------------------------------------------------------------------------
+# mx fusion grouping + physical order
+# ---------------------------------------------------------------------------
+
+
+def test_mx_fusion_groups_bound_suffix_block():
+    # dims (128, 128, 8): passes gather axes 0,1,2,1,0; suffix {1,0}
+    # blocks 128*128 > 1024, so the suffix is the single final 0-pass
+    gs, sfx = R.plan_mx_fusion_groups((128, 128, 8), 1 << 17, 3, 1024)
+    assert gs[-1] == sfx and sum(gs) == 5
+    blk = 1
+    for a in set(R.benes_axes(3)[-sfx:]):
+        blk *= (128, 128, 8)[a]
+    assert blk <= 1024
+    # a wide-open bound lets the whole tail fuse
+    gs2, sfx2 = R.plan_mx_fusion_groups((128, 8), 1 << 17, 3, 1 << 20)
+    assert sum(gs2) == 3 and sfx2 >= 1
+    with pytest.raises(ValueError):
+        R.plan_mx_fusion_groups((128, 8), mx_max_block=64)
+
+
+def test_mx_fusion_groups_cover_every_pass():
+    for dims in [(128,), (128, 8), (128, 128, 2), (128, 128, 128, 8)]:
+        gs, sfx = R.plan_mx_fusion_groups(dims)
+        assert sum(gs) == 2 * len(dims) - 1
+        assert 1 <= sfx == gs[-1]
+
+
+def test_mx_physical_order_is_permutation():
+    for dims in [(128, 8), (128, 128, 8)]:
+        n = int(np.prod(dims))
+        gs, _ = R.plan_mx_fusion_groups(dims)
+        sigma = S.mx_physical_order(n, dims, gs)
+        assert sorted(sigma.tolist()) == list(range(n))
+
+
+# ---------------------------------------------------------------------------
+# the precision contract, across ops / widths / dtypes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("op", ["sum", "min", "max"])
+@pytest.mark.parametrize("hub", [False, True])
+def test_mx_f32_exact_cases_bitwise(op, hub, rng):
+    """f32-exact cases are BITWISE: min/max pick elements (no
+    arithmetic), and integer-valued f32 sums are exact under ANY
+    association — so mx must equal the plain fused path bit for bit."""
+    m, nseg, ss = 900, 41, 600
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss, hub=hub)
+    st, arr = E.plan_fused(src_pos, dst_local, m, ss, 64, op)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 64, op, mx=True)
+    assert stm.mx is not None and st.mx is None
+    x = rng.integers(-1000, 1000, ss).astype(np.float32)
+    ref = _apply(st, arr, x)
+    got = _apply(stm, arrm, x)
+    np.testing.assert_array_equal(ref[:nseg], got[:nseg])
+    oracle = _oracle(src_pos, dst_local, x, nseg, op)
+    np.testing.assert_array_equal(got[:nseg], oracle.astype(np.float32))
+
+
+@pytest.mark.parametrize("op", ["sum", "min", "max"])
+def test_mx_int32_bitwise(op, rng):
+    m, nseg, ss = 700, 37, 500
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss)
+    st, arr = E.plan_fused(src_pos, dst_local, m, ss, 64, op)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 64, op, mx=True)
+    x = rng.integers(-10_000, 10_000, ss).astype(np.int32)
+    ref = _apply(st, arr, x)
+    got = _apply(stm, arrm, x)
+    # integer ops never touch the MXU: dtype-preserving, bitwise
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_mx_general_f32_sum_tolerance(rng):
+    """General f32 sums: the MXU contraction's own deterministic
+    association, equal to the f64 oracle within documented f32
+    tolerance, and run-to-run deterministic."""
+    m, nseg, ss = 1100, 29, 700
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss, hub=True)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 32, "sum", mx=True)
+    x = rng.standard_normal(ss).astype(np.float32)
+    got = _apply(stm, arrm, x)
+    oracle = _oracle(src_pos, dst_local, x, nseg, "sum")
+    np.testing.assert_allclose(got[:nseg], oracle, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(got, _apply(stm, arrm, x))
+
+
+def test_mx_bf16_operand_sum_tolerance(rng):
+    """bf16 state: operands enter the contraction as bf16 (already the
+    storage precision — no further quantization), accumulation is f32
+    (StaticMXGroup contract), totals return f32.  Documented tolerance:
+    bf16's ~8-bit mantissa on the inputs, NOT on the accumulator."""
+    m, nseg, ss = 800, 31, 512
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 64, "sum", mx=True)
+    x = rng.standard_normal(ss).astype(np.float32)
+    xb = jnp.asarray(x).astype(jnp.bfloat16)
+    got = np.asarray(E.apply_fused(xb, stm, _dev(arrm), interpret=True))
+    assert got.dtype == np.float32  # float-sum totals are f32
+    oracle = _oracle(src_pos, dst_local,
+                     np.asarray(xb.astype(jnp.float32)), nseg, "sum")
+    np.testing.assert_allclose(got[:nseg], oracle, rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("op", ["min", "max"])
+def test_mx_bf16_minmax_bitwise(op, rng):
+    """min/max never touch the MXU: bf16 in, bf16 out, bitwise equal to
+    the plain fused path."""
+    m, nseg, ss = 600, 23, 400
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss)
+    st, arr = E.plan_fused(src_pos, dst_local, m, ss, 32, op)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 32, op, mx=True)
+    xb = jnp.asarray(rng.standard_normal(ss).astype(np.float32)).astype(
+        jnp.bfloat16)
+    ref = E.apply_fused(xb, st, _dev(arr), interpret=True)
+    got = E.apply_fused(xb, stm, _dev(arrm), interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(ref.astype(jnp.float32)),
+        np.asarray(got.astype(jnp.float32)))
+
+
+def test_mx_weighted_sum(rng):
+    """Pre-routed f32 weights ride the mx kernel's tile (the plan's
+    gweights array in the final physical layout) and feed edge_value
+    exactly like the plain fused path."""
+    m, nseg, ss = 750, 27, 480
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss)
+    w = rng.random(m).astype(np.float32)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 32, "sum",
+                             weights=w, mx=True)
+    assert stm.weighted
+    x = rng.integers(1, 64, ss).astype(np.float32)
+    wq = np.round(w * 8) / 8  # keep products exactly representable
+    stq, arrq = E.plan_fused(src_pos, dst_local, m, ss, 32, "sum",
+                             weights=wq, mx=True)
+    got = _apply(stq, arrq, x, edge_value=lambda v, ww: v * ww)
+    oracle = _oracle(src_pos, dst_local, x, nseg, "sum", weights=wq)
+    np.testing.assert_allclose(got[:nseg], oracle, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "knobs", [{"LUX_MX_TILE_ROWS": "1", "LUX_MX_MAX_BLOCK": "128"},
+              {"LUX_MX_TILE_ROWS": "16", "LUX_MX_MAX_BLOCK": "2048"},
+              {"LUX_MX_VBLK": "8"},
+              {"LUX_MX_VBLK": "248"},
+              {"LUX_MX_MAX_BLOCK": "128"}]
+)
+def test_mx_knob_geometries_bitwise(knobs, monkeypatch, rng):
+    """Every legal tile/v_blk/suffix-block geometry lands the identical
+    f32-exact bits — the knobs shape the plan, never the math."""
+    for k, v in knobs.items():
+        monkeypatch.setenv(k, v)
+    m, nseg, ss = 640, 19, 400
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss, hub=True)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 32, "sum", mx=True)
+    x = rng.integers(-500, 500, ss).astype(np.float32)
+    got = _apply(stm, arrm, x)
+    oracle = _oracle(src_pos, dst_local, x, nseg, "sum")
+    np.testing.assert_array_equal(got[:nseg], oracle.astype(np.float32))
+
+
+def test_mx_knob_validation():
+    with pytest.raises(ValueError):
+        S._mx_defaults(v_blk=100)  # not a multiple of 8
+    with pytest.raises(ValueError):
+        S._mx_defaults(tile_rows=3)  # not a power of two
+    with pytest.raises(ValueError):
+        S._mx_defaults(mx_max_block=4096, tile_rows=8)  # block > tile
+
+
+def test_mx_rank_tiles_narrow_u8(rng):
+    """The segment-boundary rank tile is u8 under the default
+    LUX_ROUTE_IDX8 layout (the ISSUE's u8-narrowable requirement), with
+    the v_blk sentinel marking every non-edge slot."""
+    m, nseg, ss = 500, 17, 300
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 32, "sum", mx=True)
+    _, _, _, _, _, _, mxa = E.split_fused_arrays(stm, arrm, stm.weighted)
+    dst_rel = mxa[len(stm.mx.steps)]
+    assert dst_rel.dtype == np.uint8
+    assert dst_rel.max() == stm.mx.v_blk  # sentinel present (padding)
+    assert (np.asarray(dst_rel) <= stm.mx.v_blk).all()
+    tile_block, tile_first = mxa[-2], mxa[-1]
+    assert tile_first[0] == 1 and tile_block.dtype == np.int32
+
+
+def test_mx_split_arrays_round_trip(rng):
+    m, nseg, ss = 400, 13, 256
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 16, "sum", mx=True)
+    r1a, ffa, r2a, gmask, gweights, vra, mxa = E.split_fused_arrays(
+        stm, arrm, stm.weighted)
+    assert gmask is None and gweights is None
+    assert len(mxa) == len(stm.mx.steps) + 3
+    total = (len(r1a) + len(ffa) + len(r2a) + len(mxa) + len(vra))
+    assert total == len(arrm)
+    with pytest.raises(TypeError):
+        E.to_pf((stm, arrm))  # mx plans are already pass-fused
+
+
+# ---------------------------------------------------------------------------
+# engine + cache + resolution
+# ---------------------------------------------------------------------------
+
+
+def _engine_fixture(scale=8, parts=2):
+    from lux_tpu.engine import pull
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.pagerank import PageRankProgram
+
+    g = generate.rmat(scale, 8, seed=7)
+    shards = build_pull_shards(g, parts)
+    prog = PageRankProgram(nv=shards.spec.nv)
+    arrays = jax.tree.map(jnp.asarray, shards.arrays)
+    s0 = pull.init_state(prog, arrays)
+    return pull, shards, prog, arrays, s0
+
+
+def test_engine_fused_mx_matches_fused_and_direct(monkeypatch):
+    """The vmapped multi-part engine hot loop on an mx plan: numerically
+    the plain fused path's (and the direct engine's) PageRank."""
+    monkeypatch.setenv("LUX_ROUTE_INTERPRET", "1")
+    pull, shards, prog, arrays, s0 = _engine_fixture()
+    fz = E.plan_fused_shards(shards, "sum")
+    fzmx = E.plan_fused_shards(shards, "sum", mx=True)
+    assert fzmx[0].mx is not None
+    a = pull.run_pull_fixed(prog, shards.spec, arrays, s0, 3,
+                            method="scan", route=_dev_plan(fz))
+    b = pull.run_pull_fixed(prog, shards.spec, arrays, s0, 3,
+                            method="scan", route=_dev_plan(fzmx))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-6)
+    d = pull.run_pull_fixed(prog, shards.spec, arrays, s0, 3,
+                            method="scan")
+    np.testing.assert_allclose(np.asarray(b), np.asarray(d), rtol=3e-6)
+
+
+def _dev_plan(plan):
+    return plan[0], jax.tree.map(jnp.asarray, plan[1])
+
+
+def test_mx_cache_round_trip(tmp_path, rng):
+    """fused-mx-<reduce> family: reload == fresh build, and the family
+    guard rejects foreign (plain-pf) entries instead of replaying the
+    wrong layout."""
+    _, shards, _, _, _ = _engine_fixture(parts=1)
+    cdir = str(tmp_path / "plans")
+    st_c, arr_c = E.plan_fused_shards_cached(shards, "sum", cache_dir=cdir,
+                                             mx=True)
+    st_u, arr_u = E.plan_fused_shards(shards, "sum", mx=True)
+    assert st_c == st_u
+    for a, b in zip(arr_c, arr_u):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    st_r, arr_r = E.plan_fused_shards_cached(shards, "sum", cache_dir=cdir,
+                                             mx=True)
+    assert st_r == st_u
+    for a, b in zip(arr_r, arr_u):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert E.has_cached_fused_plan(shards, "sum", cache_dir=cdir,
+                                   mx=True) is not None
+    # the pf family is a DIFFERENT tag: no cross-contamination
+    assert E.has_cached_fused_plan(shards, "sum", cache_dir=cdir,
+                                   pf=True) is None
+
+
+def test_mx_resolution_follows_reduce_mode(monkeypatch):
+    """mx=None follows the banked tpu:reduce_mode winner (the
+    unattended-window contract); explicit False always wins."""
+    from lux_tpu.engine import methods
+
+    monkeypatch.setenv("LUX_REDUCE_MODE", "mxreduce")
+    assert methods.reduce_mode() == "mxreduce"
+    assert E.resolve_fused_mx(None) is True
+    assert E.resolve_fused_mx(False) is False
+    monkeypatch.setenv("LUX_REDUCE_MODE", "group")
+    assert E.resolve_fused_mx(None) is False
+    monkeypatch.setenv("LUX_REDUCE_MODE", "bogus")
+    with pytest.raises(ValueError):
+        methods.reduce_mode()
+
+
+def test_route_mx_helper():
+    from lux_tpu.apps import common
+
+    assert common.route_mx("fused-mx") is True
+    assert common.route_mx("fused-pf") is None
+    assert common.route_mx("fused") is False
+    assert common.route_base("fused-mx") == "fused"
+    assert common.route_is_pf("fused-mx")
+
+
+# ---------------------------------------------------------------------------
+# accounting + audit
+# ---------------------------------------------------------------------------
+
+
+def test_mx_hbm_passes_drop_below_fused_pf():
+    """The acceptance metric: the accounted sweeps of one fused-mx
+    iteration drop below the fused-pf accounting for the SAME graph —
+    the separate reduce sweep is gone and the final group is charged
+    half a sweep."""
+    from lux_tpu.utils import roofline
+
+    _, shards, _, _, _ = _engine_fixture(parts=1)
+    st_pf, _ = E.plan_fused_shards(shards, "sum", pf=True)
+    st_mx, _ = E.plan_fused_shards(shards, "sum", mx=True)
+    pf = roofline.routed_hbm_passes(st_pf)
+    mx = roofline.routed_hbm_passes(st_mx)
+    assert "mx" in mx and mx["reduce"] == 0.0
+    assert mx["mx"] == pytest.approx(0.5 * st_mx.n2 / st_mx.n, abs=0.01)
+    assert mx["total"] < pf["total"]
+
+
+def test_mx_routed_plan_bytes_exact():
+    """preflight.routed_plan_bytes models an mx plan's device residency
+    EXACTLY (same `== sum(nbytes)` contract the plain families pin in
+    test_expand): step tiles + rank tile replace the group mask, plus
+    the per-tile routing words."""
+    from lux_tpu.utils import preflight
+
+    _, shards, _, _, _ = _engine_fixture(parts=1)
+    for kw in ({"pf": True}, {"mx": True}):
+        st, arr = E.plan_fused_shards(shards, "sum", **kw)
+        assert preflight.routed_plan_bytes(st) == sum(
+            np.asarray(a).nbytes for a in arr), kw
+
+
+def test_mx_byte_model_below_fused_pf():
+    from lux_tpu.utils import roofline
+
+    _, shards, _, _, _ = _engine_fixture(parts=1)
+    st_pf, _ = E.plan_fused_shards(shards, "sum", pf=True)
+    st_mx, _ = E.plan_fused_shards(shards, "sum", mx=True)
+    ne, nv = 2048, 256
+    b_pf = roofline.routed_pull_iter_model(st_pf, ne, nv).bytes_moved
+    b_mx = roofline.routed_pull_iter_model(st_mx, ne, nv).bytes_moved
+    assert b_mx < b_pf
+
+
+def test_mx_kernel_count_and_claim_agree(rng):
+    """LUX-J501/J502 on the mx replay: the traced pallas_call count
+    equals the static derivation (prefix groups + ONE mx kernel), and
+    the 0.5-sweep claim un-scales back to that same count."""
+    from lux_tpu.analysis.ir import hbm
+
+    m, nseg, ss = 500, 17, 300
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 32, "sum", mx=True)
+    ra = _dev(arrm)
+    x = jnp.asarray(rng.random(ss).astype(np.float32))
+
+    def replay(xx, arrs):
+        return E.apply_fused(xx, stm, arrs, interpret=True)
+
+    traced = jax.jit(replay).trace(x, ra)
+    assert hbm.check_hbm(traced, stm, "lux_tpu/ops/expand.py",
+                         "fused-mx-test") == []
+
+
+def test_mx_vmem_audit(rng):
+    """LUX-J4: the mx group's one-hot/accumulator tiles join the
+    residency ledger — clean under the real budget, a finding under an
+    impossible one."""
+    from lux_tpu.analysis.ir import vmem
+
+    m, nseg, ss = 500, 17, 300
+    src_pos, dst_local = _make_csc(rng, m, nseg, ss)
+    stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 32, "sum", mx=True)
+    assert vmem.check_vmem(stm, arrm, "p", "mx-test") == []
+    findings = vmem.check_vmem(stm, arrm, "p", "mx-test", budget_bytes=1)
+    assert any(f.code == "LUX-J401" and f.text.endswith(":mx")
+               for f in findings)
+    need = vmem.mx_residency_bytes(
+        stm.mx, E.split_fused_arrays(stm, arrm, stm.weighted)[6],
+        stm.weighted)
+    assert need > 0
+
+
+# ---------------------------------------------------------------------------
+# colfilter error-dot MXU tile
+# ---------------------------------------------------------------------------
+
+
+def test_cf_err_dot_modes_agree(rng):
+    from lux_tpu.models.colfilter import err_dot
+
+    src = jnp.asarray(rng.standard_normal((64, 20)).astype(np.float32))
+    dst = jnp.asarray(rng.standard_normal((64, 20)).astype(np.float32))
+    a = np.asarray(err_dot(src, dst, "vpu"))
+    b = np.asarray(err_dot(src, dst, "mxu"))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    # 3-D chunk shape (the Pallas runner's (C, T, K) tiles)
+    s3 = src.reshape(4, 16, 20)
+    np.testing.assert_allclose(
+        np.asarray(err_dot(s3, dst.reshape(4, 16, 20), "mxu")),
+        b.reshape(4, 16), rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError):
+        err_dot(src, dst, "tpu")
+
+
+def test_cf_mxu_tile_matches_reference():
+    """The acceptance pin: colfilter with the MXU error-dot tile ==
+    the NumPy reference recurrence, through the pull engine AND the
+    single-chip Pallas runner."""
+    from lux_tpu.graph import generate
+    from lux_tpu.models import colfilter as cf
+
+    g = generate.rmat(8, 8, seed=3, weighted=True)
+    ref = cf.colfilter_reference(g, 3)
+    v = cf.colfilter(g, 3, err_dot="mxu")
+    np.testing.assert_allclose(v, ref, rtol=1e-4, atol=1e-6)
+    p = cf.colfilter_pallas(g, 3, interpret=True, err_dot_mode="mxu")
+    np.testing.assert_allclose(p, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_cf_err_dot_mode_resolution(monkeypatch):
+    from lux_tpu.engine import methods
+    from lux_tpu.models.colfilter import _resolve_err_dot
+
+    monkeypatch.setenv("LUX_CF_ERR_DOT", "mxu")
+    assert methods.cf_err_dot_mode() == "mxu"
+    assert _resolve_err_dot(None) == "mxu"
+    assert _resolve_err_dot("vpu") == "vpu"
+    monkeypatch.setenv("LUX_CF_ERR_DOT", "bogus")
+    with pytest.raises(ValueError):
+        methods.cf_err_dot_mode()
+
+
+def test_cf_program_default_unchanged():
+    """The CFProgram default stays the shipped VPU form — existing
+    callers are bitwise-unchanged until a measurement flips the mode."""
+    from lux_tpu.models.colfilter import CFProgram
+
+    assert CFProgram().err_dot == "vpu"

@@ -165,8 +165,14 @@ echo "relay gate: 8083 accepts"
 #    unfused row so even a short window records the pass-fusion bet.
 #    Order: mxsum banks the reduce baseline, gather the flat baseline,
 #    then route/routepf/fused/fusedpf; scan stays last.
-run micro_race 3000 python tools/tpu_micro_race.py \
-    --methods mxsum gather route routepf fused fusedpf gatherc scan \
+#    Round-7 addition (ISSUE 7): "fusedmx" — the MXREDUCE in-kernel
+#    MXU reduction — races right after fusedpf (their pair banks
+#    tpu:reduce_mode), and the "cfdotvpu"/"cfdotmxu" pair races the CF
+#    error-dot as VPU lane-sum vs a true MXU matmul tile (banks
+#    tpu:cf_err_dot).  All exactness-gated against their oracles.
+run micro_race 3600 python tools/tpu_micro_race.py \
+    --methods mxsum gather route routepf fused fusedpf fusedmx \
+              cfdotvpu cfdotmxu gatherc scan \
     --outdir "$LOG/micro"
 grep -q '"ms_per_rep"' "$LOG/micro_race.out" || {
   python tools/obs_span.py point battery.abort reason=tunnel_dead 2>/dev/null
@@ -198,6 +204,10 @@ LUX_BENCH_WATCHDOG_S=1500 LUX_BENCH_TPU_S=1300 \
   LUX_BENCH_ROUTE_FUSED_PF=1 LUX_BENCH_APPS=pagerank \
   LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
   run bench_routefusedpf 1600 python bench.py
+LUX_BENCH_WATCHDOG_S=1500 LUX_BENCH_TPU_S=1300 \
+  LUX_BENCH_ROUTE_FUSED_MX=1 LUX_BENCH_APPS=pagerank \
+  LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \
+  run bench_routefusedmx 1600 python bench.py
 LUX_BENCH_WATCHDOG_S=1500 LUX_BENCH_TPU_S=1300 \
   LUX_BENCH_ROUTE_FUSED=1 LUX_BENCH_APPS=pagerank \
   LUX_PEAK_GBPS=${LUX_PEAK_GBPS:-819} \

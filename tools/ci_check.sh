@@ -67,6 +67,33 @@ assert (np.asarray(d) == np.asarray(r)).all(), 'routed-pf != direct'
 print('routed-pf bitwise == direct')
 "
 
+# 3a) mxreduce interpret smoke (ISSUE 7): the MXU-resident segmented
+#     reduction fused into the final routed kernel must match the plain
+#     fused path — bitwise for the f32-exact integer-valued case — and
+#     its accounted sweeps must drop below the fused-pf accounting
+stage mxreduce_smoke 300 env JAX_PLATFORMS=cpu python -c "
+import numpy as np, jax.numpy as jnp
+from lux_tpu.ops import expand as E
+from lux_tpu.utils import roofline
+rng = np.random.default_rng(0)
+m, nseg, ss = 700, 37, 500
+dst = np.repeat(np.arange(nseg), rng.multinomial(m, np.ones(nseg)/nseg))
+src = rng.integers(0, ss, m)
+o = np.argsort(dst, kind='stable')
+sp, dl = src[o].astype(np.int64), dst[o].astype(np.int64)
+st, arr = E.plan_fused(sp, dl, m, ss, 64, 'sum')
+sm, am = E.plan_fused(sp, dl, m, ss, 64, 'sum', mx=True)
+x = jnp.asarray(rng.integers(-999, 999, ss).astype(np.float32))
+ref = np.asarray(E.apply_fused(x, st, [jnp.asarray(a) for a in arr], interpret=True))
+got = np.asarray(E.apply_fused(x, sm, [jnp.asarray(a) for a in am], interpret=True))
+assert (ref[:nseg] == got[:nseg]).all(), 'mxreduce != fused (f32-exact)'
+pf = roofline.routed_hbm_passes(E.to_pf((st, arr))[0])
+mx = roofline.routed_hbm_passes(sm)
+assert mx['total'] < pf['total'], (mx, pf)
+print('mxreduce bitwise (f32-exact) == fused;',
+      'sweeps', pf['total'], '->', mx['total'])
+"
+
 # 3b) obs smoke: a shell-seeded event log must round-trip through
 #     luxview (the post-mortem path chip_day's EXIT trap depends on),
 #     jax-free end to end; LUX-O itself runs inside stage 1's luxcheck
@@ -86,7 +113,7 @@ echo "$out" | grep -q "OPEN" || { echo "missing post-mortem"; exit 1; }
 stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
     -m 'not slow' -p no:cacheprovider \
     tests/test_luxcheck.py tests/test_native.py tests/test_expand.py \
-    tests/test_passfuse.py tests/test_obs.py \
+    tests/test_passfuse.py tests/test_mxreduce.py tests/test_obs.py \
     tests/test_determinism.py tests/test_serve_scheduler.py
 
 if [ "$FAILED" -ne 0 ]; then

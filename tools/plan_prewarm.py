@@ -45,9 +45,11 @@ def main(argv=None):
     ap.add_argument("--ef", type=int, default=16, help="edge factor")
     ap.add_argument("--parts", type=int, default=1,
                     help="pull-shard part count (bench.py uses 1)")
-    ap.add_argument("--kinds", default="expand,expand-pf,fused,fused-pf",
+    ap.add_argument("--kinds",
+                    default="expand,expand-pf,fused,fused-pf,fused-mx",
                     help="comma list from {expand,expand-pf,fused,"
-                         "fused-pf,cf,cf-pf} — the -pf families are the "
+                         "fused-pf,fused-mx,cf,cf-pf} — the -pf families "
+                         "are the "
                          "pass-fused twins (derived from the unfused "
                          "entries by the numpy transform, so warming "
                          "them after the base family costs seconds)")
@@ -73,7 +75,7 @@ def main(argv=None):
 
     kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
     bad = set(kinds) - {"expand", "expand-pf", "fused", "fused-pf",
-                        "cf", "cf-pf"}
+                        "fused-mx", "cf", "cf-pf"}
     if bad:
         ap.error(f"unknown plan kinds: {sorted(bad)}")
 
@@ -99,6 +101,8 @@ def main(argv=None):
                 shards, args.reduce, cache_dir=args.cache_dir),
             "fused-pf": lambda: expand.has_cached_fused_plan(
                 shards, args.reduce, cache_dir=args.cache_dir, pf=True),
+            "fused-mx": lambda: expand.has_cached_fused_plan(
+                shards, args.reduce, cache_dir=args.cache_dir, mx=True),
             "cf": lambda: expand.has_cached_cf_plan(
                 shards, cache_dir=args.cache_dir),
             "cf-pf": lambda: expand.has_cached_cf_plan(
@@ -118,6 +122,8 @@ def main(argv=None):
             shards, args.reduce, cache_dir=args.cache_dir),
         "fused-pf": lambda: expand.plan_fused_shards_cached(
             shards, args.reduce, cache_dir=args.cache_dir, pf=True),
+        "fused-mx": lambda: expand.plan_fused_shards_cached(
+            shards, args.reduce, cache_dir=args.cache_dir, mx=True),
         "cf": lambda: expand.plan_cf_route_shards_cached(
             shards, cache_dir=args.cache_dir),
         "cf-pf": lambda: expand.plan_cf_route_shards_cached(

@@ -27,6 +27,13 @@ shipped blanket TPU default that has NEVER been timed on a chip
 ``"tpu:sum"`` blanket-default row; this tool only banks raw numbers plus
 a ``winner`` field for the human / next-round fold-in.
 
+Round-7 exactness-gated pairs (ISSUE 7): "fusedpf" vs "fusedmx" (the
+MXREDUCE in-kernel MXU reduction) banks ``tpu:reduce_mode``, and
+"cfdotvpu" vs "cfdotmxu" (the CF error-dot as VPU lane-sum vs a true
+MXU matmul tile) banks ``tpu:cf_err_dot`` — each worker refuses to emit
+a row that fails its NumPy-oracle gate, so a banked winner is always a
+numerically-verified one.
+
 Usage: python tools/tpu_micro_race.py [--scale 17] [--methods mxsum scan]
        (worker mode: --worker --method M, spawned internally)
 """
@@ -111,21 +118,26 @@ def worker_main(args) -> int:
         print(f"# route exactness vs direct gather: {exact}", flush=True)
         if not exact:
             return 3
-    elif args.method in ("fused", "fusedpf"):
+    elif args.method in ("fused", "fusedpf", "fusedmx"):
         # the COMPLETE fused routed hot loop (expand + reduce as routed
         # movement) — the number to weigh against gather + a segment-sum
-        # row combined; "fusedpf" pass-fuses its r1/r2/vr routes.  Exact
-        # for this check's sum only up to group association; verified
-        # against the NumPy oracle with rtol (the pf transform keeps the
-        # group layout, so fused and fusedpf are bitwise EQUAL to each
-        # other).
+        # row combined; "fusedpf" pass-fuses its r1/r2/vr routes,
+        # "fusedmx" additionally computes the segmented reduction
+        # INSIDE the final routed kernel as an MXU one-hot contraction
+        # (ISSUE 7; its fusedpf-vs-fusedmx delta banks the
+        # tpu:reduce_mode winner).  Exact for this check's sum only up
+        # to group association; verified against the NumPy oracle with
+        # rtol (the pf transform keeps the group layout, so fused and
+        # fusedpf are bitwise EQUAL to each other; fusedmx has its own
+        # deterministic association).
         from lux_tpu.ops import expand
 
         src_pos = np.asarray(g.col_idx).astype(np.int32)
         dst_local = g.dst_of_edges().astype(np.int32)
         t_plan = time.perf_counter()
         static, arrays_np = expand.plan_fused(
-            src_pos, dst_local, g.ne, g.nv, g.nv, "sum")
+            src_pos, dst_local, g.ne, g.nv, g.nv, "sum",
+            mx=args.method == "fusedmx")
         if args.method == "fusedpf":
             static, arrays_np = expand.to_pf((static, arrays_np))
         print(f"# {args.method} plan built in "
@@ -150,6 +162,34 @@ def worker_main(args) -> int:
         print(f"# fused numerics vs oracle: {ok}", flush=True)
         if not ok:
             return 3
+    elif args.method in ("cfdotvpu", "cfdotmxu"):
+        # the CF error-dot (models/colfilter.err_dot): per-edge K=20
+        # <v_src, v_dst> as VPU lane-sum vs a TRUE (rows, K) @ (K, 1)
+        # MXU matmul tile.  Both workers share the identical gather, so
+        # their delta isolates the contraction; exactness is gated
+        # against the NumPy oracle with rtol (f32 association differs).
+        # The pair banks the tpu:cf_err_dot winner.
+        from lux_tpu.models.colfilter import K, err_dot
+
+        mode = "mxu" if args.method == "cfdotmxu" else "vpu"
+        vecs = jnp.asarray(rng.random((g.nv, K), np.float32))
+        src_pos = jnp.asarray(np.asarray(g.col_idx).astype(np.int32))
+        dst_pos = jnp.asarray(g.dst_of_edges().astype(np.int32))
+        jax.block_until_ready((vecs, src_pos, dst_pos))
+        got = np.asarray(jax.jit(
+            lambda v: err_dot(v[src_pos], v[dst_pos], mode))(vecs))
+        want = np.einsum(
+            "ek,ek->e", np.asarray(vecs)[np.asarray(src_pos)],
+            np.asarray(vecs)[np.asarray(dst_pos)]).astype(np.float32)
+        ok = bool(np.allclose(got, want, rtol=1e-4, atol=1e-6))
+        print(f"# cfdot({mode}) numerics vs oracle: {ok}", flush=True)
+        if not ok:
+            return 3
+        state = vecs  # (nv, K) latent state replaces the scalar chain
+
+        def f(v):
+            e = err_dot(v[src_pos], v[dst_pos], mode)
+            return v + e.sum() * jnp.float32(1e-12)
     elif args.method == "gatherc":
         col = np.asarray(g.col_idx).astype(np.int32)
         uniq = np.unique(col)
@@ -205,7 +245,8 @@ def worker_main(args) -> int:
     gteps = g.ne / slope / 1e9 if slope > 0 else float("nan")
     kind = ("gather"
             if args.method in ("gather", "gatherc", "route", "routepf")
-            else "fused" if args.method in ("fused", "fusedpf")
+            else "fused" if args.method in ("fused", "fusedpf", "fusedmx")
+            else "cfdot" if args.method in ("cfdotvpu", "cfdotmxu")
             else "segment_sum")
     print(json.dumps({
         "micro": kind, "method": args.method,
@@ -296,7 +337,8 @@ def main(argv=None):
     timed = {m: r["ms_per_rep"] for m, r in rows.items()
              if r.get("ms_per_rep", 0) > 0
              and m not in ("gather", "gatherc", "route", "routepf",
-                           "fused", "fusedpf")}
+                           "fused", "fusedpf", "fusedmx",
+                           "cfdotvpu", "cfdotmxu")}
     winner = min(timed, key=timed.get) if timed else None
     platforms = {r.get("platform") for r in rows.values()}
     record = {
@@ -310,6 +352,27 @@ def main(argv=None):
         from lux_tpu.engine import methods  # no-jax import (os/json only)
 
         methods.record_overlay_entry("tpu:micro_sum", record)
+        # exactness-gated flavor pairs (ISSUE 7): a pair only banks a
+        # DECISION when both members measured (each worker already
+        # refused to emit a row that failed its oracle gate)
+        t_pf = rows.get("fusedpf", {}).get("ms_per_rep", 0)
+        t_mx = rows.get("fusedmx", {}).get("ms_per_rep", 0)
+        if t_pf > 0 and t_mx > 0:
+            red = "mxreduce" if t_mx <= t_pf else "group"
+            methods.record_overlay_entry(methods.REDUCE_MODE_KEY, red)
+            methods.record_overlay_entry(
+                "tpu:micro_reduce",
+                {"scale": args.scale, "winner": red,
+                 "ms_per_rep": {"group": t_pf, "mxreduce": t_mx}})
+        t_vpu = rows.get("cfdotvpu", {}).get("ms_per_rep", 0)
+        t_mxu = rows.get("cfdotmxu", {}).get("ms_per_rep", 0)
+        if t_vpu > 0 and t_mxu > 0:
+            dot = "mxu" if t_mxu <= t_vpu else "vpu"
+            methods.record_overlay_entry(methods.CF_DOT_KEY, dot)
+            methods.record_overlay_entry(
+                "tpu:micro_cfdot",
+                {"scale": args.scale, "winner": dot,
+                 "ms_per_rep": {"vpu": t_vpu, "mxu": t_mxu}})
     else:
         print(f"# not on tpu ({platforms}); overlay not recorded", flush=True)
     return 0
