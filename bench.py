@@ -46,7 +46,15 @@ Env knobs:
                    = 2**bs; LUX_BENCH_BA_M out-edges/vertex, default 4)
                    through generator -> .lux round trip -> ROUTED-PF
                    pull, so hub skew is measured where routed-plan
-                   padding bites (VERDICT r5 weak #4).
+                   padding bites (VERDICT r5 weak #4).  "fleet" (OPT-IN,
+                   not in the default list: it spawns 1/2/4 worker
+                   processes and ramps each to its knee, minutes of
+                   wall) is the multi-replica serving row
+                   (lux_tpu.serve.fleet): sssp_fleet_qps_w{1,2,4}_* —
+                   offered-QPS ramp to the saturation knee per fleet
+                   width on CPU, QPS + p99 at the knee, plus the paired
+                   interleaved 2w-vs-1w probe (LUX_BENCH_FLEET_SCALE
+                   overrides the rmat scale).
   LUX_BENCH_ROUTE_PF=1 / LUX_BENCH_ROUTE_FUSED_PF=1  A/B the PASS-FUSED
                    routed pipelines (ops/expand.to_pf: 2-3 Benes passes
                    per Pallas kernel, VMEM-resident intermediates —
@@ -708,6 +716,24 @@ def worker_main():
             }
         )
 
+    def measure_fleet():
+        """Multi-replica serving rows (lux_tpu.serve.fleet, OPT-IN via
+        LUX_BENCH_APPS): offered-QPS ramp to the saturation knee at 1/2/4
+        worker processes on CPU, one row per width (distinct metric
+        families — the best-per-family relay contest must never fold
+        widths together), plus the paired interleaved 2w-vs-1w probe on
+        the w2 row.  CPU loopback by design: the fleet layer is host
+        coordination, and the row must be bankable with no chip."""
+        from lux_tpu.serve.fleet.bench import measure_fleet_saturation
+
+        fscale = _env_int("LUX_BENCH_FLEET_SCALE", 12)
+        res = measure_fleet_saturation(scale=fscale, workers=(1, 2, 4))
+        for row in res["rows"]:
+            _emit_row(row)
+        print(f"# fleet knees: {res['knees']} "
+              f"paired_2v1={res.get('scaleup_2v1')}",
+              file=sys.stderr, flush=True)
+
     def measure_ba():
         """Standing heavy-tail row (VERDICT r5 weak #4: BA existed only
         as a slow test): a Barabási-Albert graph through the FULL
@@ -1106,6 +1132,17 @@ def worker_main():
                 measure_ba()
             except Exception as e:  # noqa: BLE001
                 print(f"# ba row failed: {e}", file=sys.stderr, flush=True)
+    if "fleet" in apps:
+        # opt-in multi-replica serving rows; same isolation rule as
+        # serve (the fleet workers bind the default pull layout)
+        if layout_ab:
+            print("# fleet rows skipped: layout A/B run", file=sys.stderr,
+                  flush=True)
+        else:
+            try:
+                measure_fleet()
+            except Exception as e:  # noqa: BLE001
+                print(f"# fleet failed: {e}", file=sys.stderr, flush=True)
     if "pagerank" in apps:
         # standing mxu-vs-vpu reduce micro row (tiny graph, both fused
         # flavors); skipped under layout A/B runs like serve/ba so the

@@ -15,6 +15,9 @@ serving turns the same frontier machinery into a request/response path:
     utils/timing + utils/roofline).
   * ``serve.benchmarks``— the measurement core shared by
     tools/serve_bench.py and the bench.py ``sssp_qps_*`` row.
+  * ``serve.fleet``     — the multi-replica layer: controller/worker
+    split, consistent-hash routing, cross-replica backpressure, live
+    republish, and the ``sssp_fleet_qps_*`` saturation bench.
 
 The unit of work here is a REQUEST, not a graph.
 """

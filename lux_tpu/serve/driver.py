@@ -39,7 +39,7 @@ def _validate(cfg: RunConfig) -> None:
     if getattr(cfg, "repartition_every", 0):
         bad.append("--repartition-every")
     if cfg.verbose:
-        bad.append("-verbose")
+        bad.append("--verbose")
     if getattr(cfg, "stream_hbm_gib", 0.0):
         bad.append("--stream-hbm-gib")
     if getattr(cfg, "weighted", False) or getattr(cfg, "delta", 0):
@@ -120,14 +120,14 @@ def run_serve_cli(cfg: RunConfig, g, app: str) -> int:
     sources = parse_sources(cfg, g)
     with obs.span("serve.layout", parts=cfg.num_parts):
         shards = build_pull_shards(g, cfg.num_parts)
+    metrics = ServeMetrics()
     cache = WarmEngineCache(
         shards, apps=(app,), q_buckets=buckets, method=cfg.method,
-        num_iters=cfg.num_iters, max_iters=cfg.max_iters,
+        num_iters=cfg.num_iters, max_iters=cfg.max_iters, metrics=metrics,
     )
     warm_s = cache.prewarm()
     print(f"warmed {len(buckets)} {app} bucket(s) {buckets} in "
           f"{warm_s:.1f} s")
-    metrics = ServeMetrics()
     sched = MicroBatchScheduler(
         cache, app=app, max_wait_ms=cfg.serve_wait_ms,
         max_queue=cfg.serve_max_queue,

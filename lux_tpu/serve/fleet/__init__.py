@@ -1,0 +1,38 @@
+"""lux_tpu.serve.fleet — the multi-replica serving fleet.
+
+The controller/worker split on top of ``lux_tpu.serve`` (ROADMAP item 2):
+
+* ``fleet.hashring``   — deterministic consistent-hash routing of
+  (app, graph_id, Q-slot) keys with bounded key movement on join/leave.
+* ``fleet.wire``       — length-prefixed JSON + npy frames over loopback
+  TCP (stdlib; no jax.distributed, no pickle) so the whole fleet runs
+  and tests under ``JAX_PLATFORMS=cpu``.
+* ``fleet.worker``     — the replica: a ``WarmEngineCache`` + per-app
+  ``MicroBatchScheduler`` behind a socket, with prepare/commit
+  zero-downtime republish and a ``kill()`` fault drill.
+* ``fleet.controller`` — admission, routing, heartbeat-driven
+  backpressure/shedding, death recovery, and the republish barrier.
+* ``fleet.bench``      — the saturation harness shared by
+  ``tools/fleet_bench.py`` and the bench.py ``fleet`` app: ramp offered
+  QPS to the throughput knee, record QPS + p99 at the knee per fleet
+  width.
+
+This ``__init__`` exports only the controller half; the worker — the
+only half that runs engines — is imported explicitly as
+``lux_tpu.serve.fleet.worker`` or spawned as a process via
+``python -m lux_tpu.serve.fleet.worker``.  ``hashring`` itself is
+stdlib-only and loadable standalone (the cross-process determinism test
+does exactly that).
+"""
+from lux_tpu.serve.fleet.controller import (  # noqa: F401
+    FleetController,
+    FleetError,
+    FleetFuture,
+    FleetRejectedError,
+    FleetTimeoutError,
+    NoWorkersError,
+)
+from lux_tpu.serve.fleet.hashring import (  # noqa: F401
+    HashRing,
+    route_key,
+)

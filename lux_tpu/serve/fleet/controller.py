@@ -1,0 +1,730 @@
+"""Fleet controller: admission, routing, placement, republish.
+
+The controller half of the controller/worker split.  It owns:
+
+* **Membership** — a registry of `ReplicaWorker` endpoints and a
+  consistent-hash ring (``fleet/hashring.py``) over the live ones.
+  Worker death is detected two ways: the connection reset a kill causes,
+  and heartbeat staleness for wedged-but-connected workers.  Either way
+  the worker leaves the ring (moving only its ~1/R of the key space) and
+  its in-flight queries are transparently re-dispatched to ring
+  successors.
+* **Routing** — every query maps to a ``(app, graph_id, Q-slot)`` key
+  (``route_key``); the owner is the first live, unsaturated worker on
+  the ring walk from that key.  Slot affinity keeps repeat queries on
+  the same replica's warm engines so its Q-bucket batches run full.
+* **Backpressure + shedding** — each worker's queue-depth/shed
+  heartbeat (``serve/metrics.py`` counters over the ``stats`` op) marks
+  it saturated past ``sat_frac`` of its admission bound; saturated
+  workers are skipped on the ring walk, and when EVERY live worker is
+  saturated the controller sheds at admission with a ``retry_after_ms``
+  hint — the fleet-level analog of the scheduler's bounded-queue reject.
+  A worker-side shed reply (the race where a queue filled between
+  heartbeats) is retried on the next ring successor before any caller
+  sees an error: degraded, never wrong.
+* **Republish** — ``republish(path)`` is a two-phase barrier: every
+  worker ``prepare``s (loads + prewarms the new snapshot NEXT TO the
+  serving engines), and only when all preparations succeed does the
+  controller send ``commit`` (an atomic cache-pointer swap per worker).
+  Admission never pauses, so zero requests are rejected because of the
+  swap; a failed prepare on any worker aborts the whole republish with
+  the old graph still serving everywhere.
+
+Everything here is stdlib + numpy: the controller process never imports
+jax (graph math lives in the workers), so it stays responsive no matter
+what the engines are doing.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from lux_tpu.serve.fleet.hashring import (
+    DEFAULT_SLOTS,
+    DEFAULT_VNODES,
+    EmptyRingError,
+    HashRing,
+    route_key,
+)
+from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
+
+
+class FleetError(RuntimeError):
+    """Fleet-level request failure (no retry succeeded)."""
+
+
+class FleetRejectedError(FleetError):
+    """Fleet-wide load shed: every live worker is saturated."""
+
+    def __init__(self, retry_after_ms: float):
+        super().__init__(
+            f"fleet saturated; retry after {retry_after_ms:.0f} ms")
+        self.retry_after_ms = retry_after_ms
+
+
+class NoWorkersError(FleetError):
+    """No live workers registered."""
+
+
+class FleetTimeoutError(FleetError, TimeoutError):
+    """The request's deadline expired (in a worker queue or on the wire)."""
+
+
+class FleetFuture:
+    """Handle to one fleet-routed query."""
+
+    def __init__(self, app: str, source: int,
+                 timeout_ms: Optional[float]):
+        self.app = app
+        self.source = int(source)
+        self.timeout_ms = timeout_ms
+        self.worker_id: Optional[str] = None  # who answered
+        self.rounds = 0
+        self.traversed = 0
+        self.attempts = 0
+        self.t_submit = time.monotonic()
+        self.t_done: Optional[float] = None
+        self._cb_lock = threading.Lock()
+        self._event = threading.Event()
+        self._result: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+        self._callbacks: List = []
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def add_done_callback(self, fn) -> None:
+        """Call ``fn(self)`` when the future resolves (immediately if it
+        already did).  Runs on the resolving thread — keep it O(1); it
+        exists so closed-loop clients can track in-flight counts without
+        scanning (a scanning client measures itself, not the fleet)."""
+        run_now = False
+        with self._cb_lock:
+            if self._event.is_set():
+                run_now = True
+            else:
+                self._callbacks.append(fn)
+        if run_now:
+            fn(self)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise FleetTimeoutError("no result within wait timeout")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        """Client-observed submit-to-resolve wall time (the number the
+        saturation bench's percentiles are built from)."""
+        return None if self.t_done is None else self.t_done - self.t_submit
+
+    def _resolve(self, result=None, error=None) -> None:
+        with self._cb_lock:
+            if self._event.is_set():
+                return  # first resolution wins — a racing duplicate
+                # dispatch must never overwrite a result waiters saw
+            self._result = result
+            self._error = error
+            self.t_done = time.monotonic()
+            self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            fn(self)
+
+
+class _HandedOff(Exception):
+    """Internal: a send failed AND _retire had already harvested the
+    pending — the future's ownership moved to the retire path, so the
+    sender must NOT dispatch it again."""
+
+
+class _Pending:
+    """One outstanding frame awaiting a worker reply."""
+
+    def __init__(self, kind: str, fut: Optional[FleetFuture] = None):
+        self.kind = kind  # "query" | "rpc"
+        self.fut = fut
+        self.reply: Optional[dict] = None
+        self.arr: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+
+
+class _WorkerHandle:
+    def __init__(self, wid: str, conn: Conn, info: dict):
+        self.wid = wid
+        self.conn = conn
+        self.info = info
+        self.alive = True
+        self.saturated = False
+        self.last_hb: dict = {}
+        self.last_seen = time.monotonic()
+        self.pending: Dict[str, _Pending] = {}
+        self.reader: Optional[threading.Thread] = None
+
+
+class FleetController:
+    def __init__(self, hb_interval_s: float = 0.25,
+                 hb_timeout_s: float = 3.0, sat_frac: float = 0.8,
+                 retries: int = 3, slots: int = DEFAULT_SLOTS,
+                 vnodes: int = DEFAULT_VNODES):
+        self.hb_interval_s = float(hb_interval_s)
+        self.hb_timeout_s = float(hb_timeout_s)
+        self.sat_frac = float(sat_frac)
+        self.retries = int(retries)
+        self.slots = int(slots)
+        self._lock = threading.Lock()
+        self._ring = HashRing(vnodes)
+        self._workers: Dict[str, _WorkerHandle] = {}
+        self._graph_id: Optional[str] = None
+        self._seq = 0
+        self._closed = False
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        # fleet-level counters (the controller's own observability row)
+        self._counts = {"submitted": 0, "completed": 0, "shed": 0,
+                        "rerouted": 0, "worker_deaths": 0,
+                        "republishes": 0, "errors": 0}
+
+    # ------------------------------------------------------------------
+    # membership
+    # ------------------------------------------------------------------
+
+    @property
+    def graph_id(self) -> Optional[str]:
+        with self._lock:
+            return self._graph_id
+
+    def add_worker(self, host: str, port: int,
+                   timeout_s: float = 60.0) -> str:
+        """Connect + handshake a worker and put it on the ring.  The
+        first worker pins the fleet's graph_id; later joins must serve
+        the same graph (a mismatched replica would answer WRONG, which
+        is worse than answering slow)."""
+        from lux_tpu import obs
+
+        conn = Conn.connect(host, port, timeout_s=timeout_s)
+        handle = _WorkerHandle("?", conn, {})
+        handle.reader = threading.Thread(
+            target=self._read_loop, args=(handle,),
+            name="lux-fleet-ctl-read", daemon=True)
+        handle.reader.start()
+        p = self._send(handle, {"op": "hello"}, _Pending("rpc"))
+        if not p.event.wait(timeout_s) or p.error or not p.reply:
+            conn.close()
+            raise FleetError(f"worker at {host}:{port} failed handshake: "
+                             f"{p.error}")
+        info = p.reply
+        wid = str(info["worker_id"])
+        with self._lock:
+            if self._closed:
+                conn.close()
+                raise FleetError("controller closed")
+            if wid in self._workers and self._workers[wid].alive:
+                conn.close()
+                raise FleetError(f"worker id {wid!r} already registered")
+            if self._graph_id is None:
+                self._graph_id = str(info["graph_id"])
+            elif str(info["graph_id"]) != self._graph_id:
+                conn.close()
+                raise FleetError(
+                    f"worker {wid} serves graph {info['graph_id']!r}, "
+                    f"fleet serves {self._graph_id!r}")
+            handle.wid = wid
+            handle.info = info
+            handle.last_seen = time.monotonic()
+            self._workers[wid] = handle
+            self._ring.add(wid)
+        obs.point("fleet.worker.join", worker=wid,
+                  graph=str(info["graph_id"]), nv=info.get("nv"))
+        self._ensure_heartbeat()
+        return wid
+
+    def remove_worker(self, wid: str, shutdown: bool = True) -> None:
+        """Graceful leave: take the worker off the ring (its keys move to
+        ring successors), optionally ask it to drain and exit."""
+        with self._lock:
+            handle = self._workers.get(wid)
+            if handle is None or not handle.alive:
+                return
+        if shutdown:
+            try:
+                self._rpc(handle, {"op": "shutdown"}, timeout_s=10.0)
+            except FleetError:
+                pass  # it may already be gone; the goal is absence
+        self._retire(handle, cause="leave")
+
+    def workers(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                wid: {"alive": h.alive, "saturated": h.saturated,
+                      "last_hb": dict(h.last_hb)}
+                for wid, h in self._workers.items()
+            }
+
+    def live_workers(self) -> List[str]:
+        with self._lock:
+            return sorted(w for w, h in self._workers.items() if h.alive)
+
+    # ------------------------------------------------------------------
+    # wire plumbing
+    # ------------------------------------------------------------------
+
+    def _next_rid(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return f"r{self._seq}"
+
+    def _send(self, handle: _WorkerHandle, msg: dict,
+              pending: _Pending) -> _Pending:
+        rid = self._next_rid()
+        msg = {**msg, "req_id": rid}
+        with self._lock:
+            handle.pending[rid] = pending
+        try:
+            handle.conn.send(msg)
+        except ConnectionClosed:
+            with self._lock:
+                still_mine = handle.pending.pop(rid, None) is not None
+            if not still_mine:
+                # the reader's _retire beat us to it: it already
+                # harvested this pending as an orphan and re-dispatched
+                # (query) or failed (rpc) it — dispatching again from
+                # here would put the SAME future in flight twice
+                raise _HandedOff() from None
+            self._on_conn_lost(handle)
+            raise
+        return pending
+
+    def _rpc(self, handle: _WorkerHandle, msg: dict,
+             timeout_s: float) -> dict:
+        try:
+            p = self._send(handle, msg, _Pending("rpc"))
+        except (ConnectionClosed, _HandedOff):
+            raise FleetError(f"worker {handle.wid} unreachable") from None
+        if not p.event.wait(timeout_s):
+            raise FleetError(
+                f"worker {handle.wid} did not answer {msg.get('op')!r} "
+                f"within {timeout_s}s")
+        if p.error is not None:
+            raise FleetError(str(p.error))
+        if not p.reply.get("ok"):
+            raise FleetError(
+                f"worker {handle.wid} {msg.get('op')}: "
+                f"{p.reply.get('kind')}: {p.reply.get('err')}")
+        return p.reply
+
+    def _read_loop(self, handle: _WorkerHandle) -> None:
+        while True:
+            try:
+                msg, arr = handle.conn.recv()
+            except (ConnectionClosed, WireError):
+                break
+            rid = msg.get("req_id")
+            with self._lock:
+                p = handle.pending.pop(rid, None)
+                handle.last_seen = time.monotonic()
+            if p is None:
+                continue  # late reply for a retried/abandoned request
+            if p.kind == "query":
+                self._resolve_query(handle, p, msg, arr)
+            else:
+                p.reply = msg
+                p.arr = arr
+                p.event.set()
+        self._on_conn_lost(handle)
+
+    def _on_conn_lost(self, handle: _WorkerHandle) -> None:
+        if handle.wid == "?":  # handshake never completed
+            return
+        self._retire(handle, cause="death")
+
+    def _retire(self, handle: _WorkerHandle, cause: str) -> None:
+        """Take a worker out of service; re-dispatch its in-flight
+        queries on the survivors and fail its in-flight rpcs."""
+        from lux_tpu import obs
+
+        with self._lock:
+            if not handle.alive:
+                return
+            if self._closed:
+                # controller teardown closes every conn; the readers'
+                # resulting ConnectionClosed is shutdown, not death —
+                # a clean close must not mint worker_deaths or spray
+                # fleet.worker.down events into the flight recorder.
+                # In-flight work still RESOLVES (a dropped future hangs
+                # its waiter forever; an error is strictly better)
+                handle.alive = False
+                leftovers = list(handle.pending.values())
+                handle.pending.clear()
+            else:
+                leftovers = None
+        if leftovers is not None:
+            closed_err = FleetError("controller closed")
+            for p in leftovers:
+                if p.kind == "query":
+                    p.fut._resolve(error=closed_err)
+                else:
+                    p.error = closed_err
+                    p.event.set()
+            return
+        with self._lock:
+            if not handle.alive:
+                return
+            handle.alive = False
+            if handle.wid in self._ring.workers():
+                self._ring.remove(handle.wid)
+            orphans = list(handle.pending.values())
+            handle.pending.clear()
+            if cause == "death":
+                self._counts["worker_deaths"] += 1
+        obs.point("fleet.worker.down", worker=handle.wid, cause=cause,
+                  orphans=len(orphans))
+        handle.conn.close()
+        for p in orphans:
+            if p.kind == "query":
+                with self._lock:
+                    self._counts["rerouted"] += 1
+                self._dispatch(p.fut, exclude={handle.wid})
+            else:
+                p.error = FleetError(f"worker {handle.wid} {cause}")
+                p.event.set()
+
+    # ------------------------------------------------------------------
+    # admission + routing
+    # ------------------------------------------------------------------
+
+    def route(self, source: int, app: str = "sssp") -> str:
+        """The ring OWNER of a query's (app, graph, Q-slot) key — where
+        it lands when nothing is saturated (deterministic; tests replay
+        this across processes)."""
+        with self._lock:
+            if self._graph_id is None:
+                raise NoWorkersError("no workers registered")
+            return self._ring.route(
+                route_key(app, self._graph_id, source, self.slots))
+
+    def _candidates(self, app: str, source: int,
+                    exclude: Set[str]) -> List[_WorkerHandle]:
+        with self._lock:
+            if self._graph_id is None:
+                return []
+            try:
+                order = self._ring.successors(
+                    route_key(app, self._graph_id, source, self.slots),
+                    len(self._ring))
+            except EmptyRingError:
+                return []
+            return [self._workers[w] for w in order
+                    if w not in exclude and self._workers[w].alive]
+
+    def _retry_after_ms(self) -> float:
+        hints = []
+        with self._lock:
+            for h in self._workers.values():
+                if h.alive and h.last_hb:
+                    hints.append(float(h.last_hb.get("queue_depth", 0)))
+        # no service-time estimate fleet-wide: one coalescing window per
+        # queued-batch of backlog is the same shape the scheduler uses
+        return 10.0 * (1.0 + max(hints, default=0.0) / 8.0)
+
+    def submit(self, source: int, app: str = "sssp",
+               timeout_ms: Optional[float] = None) -> FleetFuture:
+        """Route + dispatch one query; returns a FleetFuture.  Raises
+        FleetRejectedError synchronously when the whole fleet is
+        saturated (admission backpressure), NoWorkersError when empty."""
+        fut = FleetFuture(app, source, timeout_ms)
+        with self._lock:
+            self._counts["submitted"] += 1
+        self._dispatch(fut, exclude=set(), sync_raise=True)
+        return fut
+
+    def _dispatch(self, fut: FleetFuture, exclude: Set[str],
+                  sync_raise: bool = False) -> None:
+        """Send ``fut`` to the first usable candidate on its ring walk.
+        Resolution failures surface as exceptions only on the synchronous
+        admission path; retries resolve the future instead."""
+        from lux_tpu import obs
+
+        exclude = set(exclude)
+        while True:
+            cands = self._candidates(fut.app, fut.source, exclude)
+            usable = [h for h in cands if not h.saturated]
+            if not usable:
+                if cands:  # alive but all saturated: fleet-level shed
+                    with self._lock:
+                        self._counts["shed"] += 1
+                    err = FleetRejectedError(self._retry_after_ms())
+                    obs.point("fleet.shed", app=fut.app, source=fut.source)
+                else:
+                    err = NoWorkersError(
+                        "no live worker can take this query")
+                if sync_raise:
+                    raise err
+                fut._resolve(error=err)
+                return
+            handle = usable[0]
+            if fut.attempts > self.retries:
+                fut._resolve(error=FleetError(
+                    f"retries exhausted after {fut.attempts} attempts"))
+                return
+            fut.attempts += 1
+            msg = {"op": "query", "app": fut.app, "source": fut.source}
+            if fut.timeout_ms:
+                msg["timeout_ms"] = float(fut.timeout_ms)
+            try:
+                self._send(handle, msg, _Pending("query", fut))
+                return
+            except _HandedOff:
+                return  # _retire owns this future now; it re-dispatched
+            except ConnectionClosed:
+                exclude.add(handle.wid)  # this future never left _send's
+                continue                 # hands; keep walking the ring
+
+    def _resolve_query(self, handle: _WorkerHandle, p: _Pending,
+                       msg: dict, arr) -> None:
+        fut = p.fut
+        if msg.get("ok"):
+            fut.worker_id = handle.wid
+            fut.rounds = int(msg.get("rounds", 0))
+            fut.traversed = int(msg.get("traversed", 0))
+            with self._lock:
+                self._counts["completed"] += 1
+            fut._resolve(result=arr)
+            return
+        kind = msg.get("kind")
+        if kind == "shed":
+            # the between-heartbeats race: this worker's queue filled
+            # before its saturation was visible — believe it immediately
+            # and walk the ring before any caller sees an error
+            with self._lock:
+                handle.saturated = True
+                self._counts["rerouted"] += 1
+            self._dispatch(fut, exclude={handle.wid})
+            return
+        with self._lock:
+            self._counts["errors"] += 1
+        if kind == "timeout":
+            fut._resolve(error=FleetTimeoutError(str(msg.get("err"))))
+        else:
+            fut._resolve(error=FleetError(
+                f"worker {handle.wid}: {msg.get('err')}"))
+
+    # ------------------------------------------------------------------
+    # heartbeats
+    # ------------------------------------------------------------------
+
+    def _ensure_heartbeat(self) -> None:
+        with self._lock:
+            if self._hb_thread is not None or self._closed:
+                return
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name="lux-fleet-ctl-hb", daemon=True)
+            self._hb_thread.start()
+
+    def _hb_loop(self) -> None:
+        from lux_tpu import obs
+
+        while not self._hb_stop.wait(self.hb_interval_s):
+            with self._lock:
+                handles = [h for h in self._workers.values() if h.alive]
+            now = time.monotonic()
+            for h in handles:
+                with self._lock:
+                    stale = now - h.last_seen > self.hb_timeout_s
+                if stale:
+                    self._retire(h, cause="death")
+                    continue
+                try:
+                    p = self._send(h, {"op": "stats"}, _Pending("rpc"))
+                except (ConnectionClosed, _HandedOff):
+                    continue  # worker retired under us; next round
+                if not p.event.wait(self.hb_timeout_s):
+                    continue  # staleness check next round settles it
+                if p.error is not None or not p.reply:
+                    continue
+                hb = p.reply
+                was = h.saturated
+                sat = (hb.get("queue_depth", 0)
+                       >= self.sat_frac * max(hb.get("max_queue", 1), 1))
+                with self._lock:
+                    h.last_hb = hb
+                    h.saturated = sat
+                if was != sat:
+                    obs.point("fleet.saturation", worker=h.wid,
+                              saturated=sat,
+                              depth=hb.get("queue_depth"))
+
+    # ------------------------------------------------------------------
+    # republish
+    # ------------------------------------------------------------------
+
+    def republish(self, path: str, graph_id: Optional[str] = None,
+                  prepare_timeout_s: float = 600.0,
+                  commit_timeout_s: float = 30.0) -> dict:
+        """Zero-downtime graph republish across the whole fleet.
+
+        Two-phase: (1) every live worker prepares (load + prewarm the new
+        snapshot while the old engines keep serving — long, parallel);
+        (2) only if EVERY prepare succeeded, every worker commits (an
+        atomic cache-pointer swap — instant).  A failed prepare anywhere
+        aborts with the old graph still serving everywhere; admission is
+        never paused, so no request is ever rejected because of the swap.
+        """
+        from lux_tpu import obs
+
+        gid = graph_id if graph_id is not None else os.path.basename(
+            str(path))
+        with self._lock:
+            handles = [h for h in self._workers.values() if h.alive]
+        if not handles:
+            raise NoWorkersError("republish with no live workers")
+        # the publish token ties each worker's staged cache to THIS
+        # republish: a stale prepare from an aborted earlier republish
+        # can neither re-stage after our discard nor be committed by us
+        token = f"pub-{self._next_rid()}"
+        with obs.span("fleet.republish", graph=gid, path=str(path),
+                      token=token, workers=[h.wid for h in handles]):
+            pendings = []
+            for h in handles:
+                try:
+                    pendings.append((h, self._send(
+                        h, {"op": "prepare", "path": str(path),
+                            "graph_id": gid, "token": token},
+                        _Pending("rpc"))))
+                except (ConnectionClosed, _HandedOff):
+                    self._discard_staged(handles)
+                    raise FleetError(
+                        f"worker {h.wid} died before prepare") from None
+            deadline = time.monotonic() + prepare_timeout_s
+            for h, p in pendings:
+                err = None
+                if not p.event.wait(max(deadline - time.monotonic(),
+                                        0.001)):
+                    err = "prepare timed out"
+                elif p.error is not None or not p.reply.get("ok"):
+                    err = f"prepare failed: {p.error or p.reply.get('err')}"
+                if err is not None:
+                    # abort BEFORE any commit: old graph still serves
+                    # everywhere; tell the workers whose prepare DID
+                    # succeed to drop the staged cache (a fully-warmed
+                    # second engine set must not sit resident forever)
+                    self._discard_staged(handles)
+                    raise FleetError(
+                        f"worker {h.wid} {err}; republish aborted "
+                        "(old graph still serving)")
+            gens = {}
+            commit_failed = []
+            for h in handles:
+                try:
+                    rep = self._rpc(h, {"op": "commit", "token": token},
+                                    timeout_s=commit_timeout_s)
+                    gens[h.wid] = int(rep["generation"])
+                except FleetError as e:
+                    commit_failed.append((h, e))
+            if not gens:
+                # nothing swapped anywhere: clean abort on the old graph
+                self._discard_staged(handles)
+                raise FleetError(
+                    "every commit failed; republish aborted (old graph "
+                    f"still serving): {[str(e) for _, e in commit_failed]}")
+            # point of no return: at least one replica serves the NEW
+            # graph, so the fleet's graph IS gid now.  A worker whose
+            # commit failed would keep serving the OLD graph under the
+            # new id — mixed generations answer differently for the
+            # same query, which is wrong, not degraded — so retire it
+            # (its keys move to committed successors).
+            for h, e in commit_failed:
+                obs.point("fleet.commit_failed", worker=h.wid,
+                          err=str(e))
+                self._retire(h, cause="commit_failed")
+            with self._lock:
+                self._graph_id = gid
+                self._counts["republishes"] += 1
+        return {"graph_id": gid, "generations": gens,
+                "retired": sorted(h.wid for h, _ in commit_failed)}
+
+    def _discard_staged(self, handles) -> None:
+        """Best-effort ``discard`` to every live worker: an aborted
+        republish must not leave prewarmed second engine caches (and a
+        second copy of the O(E) graph arrays) resident on the workers
+        whose prepare succeeded."""
+        for h in handles:
+            if not h.alive:
+                continue
+            try:
+                self._rpc(h, {"op": "discard"}, timeout_s=10.0)
+            except FleetError:
+                continue  # dying worker: its memory goes with it
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counts)
+            out["graph_id"] = self._graph_id
+            out["workers_alive"] = sum(
+                1 for h in self._workers.values() if h.alive)
+            out["workers_total"] = len(self._workers)
+        return out
+
+    def prom_dump(self) -> str:
+        """One merged Prometheus exposition across the fleet: every
+        series carries its ``replica`` label (serve/metrics.py), so the
+        aggregate stays per-worker attributable.  ``# HELP``/``# TYPE``
+        lines are emitted ONCE per metric name — the text format forbids
+        repeating them, so a naive concatenation of per-worker dumps
+        would not parse for any fleet wider than one worker."""
+        texts = []
+        with self._lock:
+            handles = [h for h in self._workers.values() if h.alive]
+        for h in handles:
+            try:
+                texts.append(self._rpc(h, {"op": "prom"},
+                                       timeout_s=10.0)["text"])
+            except FleetError:
+                continue  # a dying worker's scrape is just absent
+        order: List[str] = []          # families in first-appearance order
+        meta: Dict[str, List[str]] = {}     # family -> [HELP, TYPE]
+        samples: Dict[str, List[str]] = {}  # family -> sample lines
+        for text in texts:
+            fam = None
+            for line in text.splitlines():
+                if line.startswith(("# HELP ", "# TYPE ")):
+                    fam = line.split(" ", 3)[2]
+                    if fam not in meta:
+                        order.append(fam)
+                        meta[fam] = []
+                        samples[fam] = []
+                    if len(meta[fam]) < 2:  # HELP+TYPE once per family
+                        meta[fam].append(line)
+                elif line and fam is not None:
+                    samples[fam].append(line)
+        out: List[str] = []
+        for fam in order:
+            out.extend(meta[fam])
+            out.extend(samples[fam])
+        return "\n".join(out) + ("\n" if out else "")
+
+    def close(self, shutdown_workers: bool = False) -> None:
+        self._hb_stop.set()
+        with self._lock:
+            self._closed = True
+            handles = list(self._workers.values())
+        for h in handles:
+            if shutdown_workers and h.alive:
+                try:
+                    self._rpc(h, {"op": "shutdown"}, timeout_s=10.0)
+                except FleetError:
+                    pass
+            h.conn.close()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)

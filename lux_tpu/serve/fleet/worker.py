@@ -1,0 +1,561 @@
+"""Replica worker: one shared-nothing serving process of the fleet.
+
+This is the worker half of the controller/worker split that
+``serve/driver.py`` (PR 1) fused into one monolith: the worker owns a
+``WarmEngineCache`` + one ``MicroBatchScheduler`` per served app and
+exposes them over the loopback wire protocol (``fleet/wire.py``).  The
+controller owns everything the worker deliberately does NOT: admission,
+routing, placement, and the republish barrier.
+
+Protocol (all frames are JSON dicts, answers carry one npy array)::
+
+    hello                       -> worker identity + layout + warm buckets
+    query    {app, source, ...} -> answer | shed | timeout | error
+    stats                       -> queue depth / shed / completed heartbeat
+    prom                        -> Prometheus text (replica-labelled)
+    prepare  {path, graph_id}   -> stage + prewarm a NEW engine cache
+    commit                      -> atomically swap the staged cache in
+    shutdown                    -> drain and exit
+
+**Zero-downtime republish** is the prepare/commit pair: ``prepare`` loads
+the new ``.lux`` snapshot and prewarms a complete second
+``WarmEngineCache`` on a background thread while the OLD cache keeps
+serving every query; ``commit`` is a pointer swap under the worker lock
+(the schedulers' ``cache`` attribute), so no request ever observes a
+half-warm service.  When the new snapshot has the same shard geometry,
+program and method, the staged cache's prewarm hits the SAME jitted
+loops (``serve/batched.py``'s ``lru_cache`` twins) — the serving analog
+of PR 2's per-bucket incremental plan cache: only what actually changed
+is rebuilt.
+
+A worker never blocks its connection reader: queries are enqueued and
+answered by the responder thread when their ``ServeFuture`` resolves;
+``prepare`` runs on its own thread.  ``kill()`` exists for fault drills —
+it drops the sockets without draining, which is what a SIGKILL'd worker
+process looks like to the controller.
+
+Run standalone (the multi-process fleet)::
+
+    python -m lux_tpu.serve.fleet.worker --port 0 --graph g.lux \
+        --worker-id w0   # prints one READY JSON line with the bound port
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
+from lux_tpu.serve.metrics import ServeMetrics
+from lux_tpu.serve.scheduler import (
+    MicroBatchScheduler,
+    RejectedError,
+    ServeTimeoutError,
+)
+from lux_tpu.serve.warm import WarmEngineCache
+
+
+class ReplicaWorker:
+    """One replica: engines + schedulers behind a loopback socket."""
+
+    #: responder poll cadence while futures are outstanding (seconds);
+    #: bounds added answer latency, not correctness
+    POLL_S = 0.001
+
+    def __init__(self, shards, worker_id: str, graph_id: str = "g",
+                 apps: Tuple[str, ...] = ("sssp",),
+                 q_buckets: Tuple[int, ...] = (1, 8),
+                 host: str = "127.0.0.1", port: int = 0,
+                 method: str = "auto", num_iters: int = 10,
+                 max_iters: int = 10_000, max_wait_ms: float = 2.0,
+                 max_queue: int = 256, max_engines: Optional[int] = None):
+        self.worker_id = str(worker_id)
+        self.host = host
+        self._req_port = int(port)
+        self.apps = tuple(apps)
+        self.q_buckets = tuple(q_buckets)
+        self._method = method
+        self._num_iters = int(num_iters)
+        self._max_iters = int(max_iters)
+        self._max_wait_ms = float(max_wait_ms)
+        self._max_queue = int(max_queue)
+        self._max_engines = max_engines
+        self._num_parts = shards.spec.num_parts
+        self.metrics = ServeMetrics()
+        self._lock = threading.Lock()
+        self._graph_id = str(graph_id)
+        self._generation = 0
+        # (cache, graph_id, token): token ties the staged cache to the
+        # ONE republish that requested it — a slow prepare finishing
+        # after an abort/discard (or after a newer prepare superseded
+        # it) must never stage, or a later commit would swap in the
+        # WRONG graph
+        self._staged: Optional[Tuple[WarmEngineCache, str, str]] = None
+        self._publish_token: Optional[str] = None
+        self._cache = self._make_cache(shards)
+        self._scheds: Dict[str, MicroBatchScheduler] = {
+            app: MicroBatchScheduler(
+                self._cache, app=app, max_wait_ms=self._max_wait_ms,
+                max_queue=self._max_queue, metrics=self.metrics)
+            for app in self.apps
+        }
+        self._listener: Optional[socket.socket] = None
+        self.port: Optional[int] = None
+        self._threads: List[threading.Thread] = []
+        self._conns: List[Conn] = []
+        self._running = False
+        # (conn, req_id, ServeFuture) triples the responder resolves
+        self._resp_wake = threading.Condition(self._lock)
+        self._unanswered: List[tuple] = []
+
+    def _make_cache(self, shards) -> WarmEngineCache:
+        return WarmEngineCache(
+            shards, apps=self.apps, q_buckets=self.q_buckets,
+            method=self._method, num_iters=self._num_iters,
+            max_iters=self._max_iters, metrics=self.metrics,
+            max_engines=self._max_engines)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self, prewarm: bool = True) -> "ReplicaWorker":
+        from lux_tpu import obs
+
+        with obs.span("fleet.worker.start", worker=self.worker_id,
+                      graph=self._graph_id, apps=list(self.apps),
+                      buckets=list(self.q_buckets)):
+            if prewarm:
+                self._cache.prewarm()
+            self._listener = socket.socket(socket.AF_INET,
+                                           socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEADDR, 1)
+            self._listener.bind((self.host, self._req_port))
+            self._listener.listen(32)
+            self.port = self._listener.getsockname()[1]
+            self._running = True
+            for sched in self._scheds.values():
+                sched.start()
+            for fn, name in ((self._accept_loop, "accept"),
+                             (self._respond_loop, "respond")):
+                t = threading.Thread(
+                    target=fn, name=f"lux-fleet-{self.worker_id}-{name}",
+                    daemon=True)
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        """Graceful: drain schedulers, let the responder flush every
+        resolved answer, then close."""
+        import time
+
+        for sched in self._scheds.values():
+            sched.stop(drain=True)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with self._resp_wake:
+                if not self._unanswered:
+                    break
+            time.sleep(0.01)
+        with self._resp_wake:
+            self._running = False
+            self._resp_wake.notify_all()
+        self._close_sockets()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+
+    def kill(self) -> None:
+        """Fault drill: vanish abruptly — close every socket WITHOUT
+        draining, exactly the peer-visible shape of a SIGKILL.  The
+        controller learns about it from the connection reset, not from
+        any goodbye."""
+        from lux_tpu import obs
+
+        obs.point("fleet.worker.kill", worker=self.worker_id)
+        with self._resp_wake:
+            self._running = False
+            self._resp_wake.notify_all()
+        self._close_sockets()
+        for sched in self._scheds.values():
+            sched.stop(drain=False)
+
+    def _close_sockets(self) -> None:
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            c.close()
+
+    # ------------------------------------------------------------------
+    # socket service
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running and self._listener is not None:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                break  # listener closed: stop()/kill()
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = Conn(sock)
+            with self._lock:
+                self._conns.append(conn)
+            # daemon + untracked: a standing replica accepts unboundedly
+            # many connections over its lifetime, so per-conn threads
+            # must not accumulate in a join list; stop()/kill() closes
+            # their sockets, which ends their recv loops promptly
+            threading.Thread(
+                target=self._conn_loop, args=(conn,),
+                name=f"lux-fleet-{self.worker_id}-conn",
+                daemon=True).start()
+
+    def _conn_loop(self, conn: Conn) -> None:
+        while self._running:
+            try:
+                msg, _arr = conn.recv()
+            except (ConnectionClosed, WireError):
+                break
+            try:
+                self._dispatch(conn, msg)
+            except ConnectionClosed:
+                break
+            except Exception as e:  # noqa: BLE001 — a bad op must answer,
+                # not kill the connection serving every other request
+                self._reply_err(conn, msg, "error", err=repr(e))
+        conn.close()
+
+    def _reply_err(self, conn: Conn, msg: dict, kind: str, **extra) -> None:
+        try:
+            conn.send({"req_id": msg.get("req_id"), "ok": False,
+                       "kind": kind, **extra})
+        except ConnectionClosed:
+            pass
+
+    def _dispatch(self, conn: Conn, msg: dict) -> None:
+        op = msg.get("op")
+        rid = msg.get("req_id")
+        if op == "hello":
+            conn.send({"req_id": rid, "ok": True, **self.info()})
+        elif op == "query":
+            self._op_query(conn, msg)
+        elif op == "stats":
+            conn.send({"req_id": rid, "ok": True, **self.heartbeat()})
+        elif op == "prom":
+            conn.send({"req_id": rid, "ok": True,
+                       "text": self.metrics.dump(replica=self.worker_id)})
+        elif op == "prepare":
+            # daemon + untracked, like the conn threads: one per
+            # republish, replies through the conn's send lock
+            threading.Thread(
+                target=self._op_prepare, args=(conn, msg),
+                name=f"lux-fleet-{self.worker_id}-prepare",
+                daemon=True).start()
+        elif op == "commit":
+            self._op_commit(conn, msg)
+        elif op == "discard":
+            # aborted republish: drop the staged cache (and its second
+            # copy of the graph arrays) instead of holding it forever;
+            # clearing the token also strands any still-running prepare
+            # so it cannot re-stage after this
+            with self._lock:
+                had = self._staged is not None
+                self._staged = None
+                self._publish_token = None
+            conn.send({"req_id": rid, "ok": True, "discarded": had})
+        elif op == "shutdown":
+            conn.send({"req_id": rid, "ok": True})
+            threading.Thread(target=self.stop, daemon=True,
+                             name=f"lux-fleet-{self.worker_id}-stop").start()
+        else:
+            self._reply_err(conn, msg, "error", err=f"unknown op {op!r}")
+
+    # ------------------------------------------------------------------
+    # ops
+    # ------------------------------------------------------------------
+
+    def info(self) -> dict:
+        with self._lock:
+            cache, gid, gen = self._cache, self._graph_id, self._generation
+        spec = cache.shards.spec
+        return {
+            "worker_id": self.worker_id,
+            "graph_id": gid,
+            "generation": gen,
+            "nv": int(spec.nv),
+            "ne": int(spec.ne),
+            "num_parts": int(spec.num_parts),
+            "apps": list(self.apps),
+            "buckets": list(self.q_buckets),
+            "max_queue": self._max_queue,
+        }
+
+    def heartbeat(self) -> dict:
+        """The queue-depth/shed heartbeat the controller's backpressure
+        and shedding decisions ride on (plus republish visibility)."""
+        with self._lock:
+            gid, gen = self._graph_id, self._generation
+            staged = self._staged is not None
+            cache = self._cache
+        counts = self.metrics.counters()
+        shed, completed = counts["rejected"], counts["completed"]
+        return {
+            "queue_depth": sum(s.pending() for s in self._scheds.values()),
+            "max_queue": self._max_queue,
+            "shed_total": int(shed),
+            "completed": int(completed),
+            "graph_id": gid,
+            "generation": gen,
+            "staged": staged,
+            "warm_buckets": {app: list(cache.warm_buckets(app))
+                             for app in self.apps},
+        }
+
+    def _op_query(self, conn: Conn, msg: dict) -> None:
+        rid = msg.get("req_id")
+        app = msg.get("app", "sssp")
+        sched = self._scheds.get(app)
+        if sched is None:
+            self._reply_err(conn, msg, "error",
+                            err=f"app {app!r} not served here")
+            return
+        try:
+            fut = sched.submit(int(msg["source"]),
+                               timeout_ms=msg.get("timeout_ms"))
+        except RejectedError as e:
+            self._reply_err(conn, msg, "shed",
+                            retry_after_ms=e.retry_after_ms)
+            return
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply_err(conn, msg, "error", err=repr(e))
+            return
+        with self._resp_wake:
+            self._unanswered.append((conn, rid, fut))
+            self._resp_wake.notify_all()
+
+    def _respond_loop(self) -> None:
+        """Single responder: answers resolve in scheduler batches, so one
+        thread polling ``done()`` at POLL_S keeps up with any rate the
+        engines themselves sustain (no thread-per-request)."""
+        import time
+
+        while True:
+            with self._resp_wake:
+                while self._running and not self._unanswered:
+                    self._resp_wake.wait(timeout=0.1)
+                if not self._running and not self._unanswered:
+                    return
+                pending, self._unanswered = self._unanswered, []
+            still: List[tuple] = []
+            for conn, rid, fut in pending:
+                if not fut.done():
+                    if self._running:
+                        still.append((conn, rid, fut))
+                    else:  # shutting down: never leave a hung future
+                        self._reply_err(conn, {"req_id": rid}, "error",
+                                        err="worker stopping")
+                    continue
+                self._answer(conn, rid, fut)
+            if still:
+                with self._resp_wake:
+                    self._unanswered.extend(still)
+                time.sleep(self.POLL_S)
+
+    def _answer(self, conn: Conn, rid, fut) -> None:
+        try:
+            state = fut.result(timeout=0)
+        except ServeTimeoutError as e:
+            self._reply_err(conn, {"req_id": rid}, "timeout", err=str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — engine errors travel to
+            # the controller as answers, never as a dropped connection
+            self._reply_err(conn, {"req_id": rid}, "error", err=repr(e))
+            return
+        try:
+            conn.send({"req_id": rid, "ok": True,
+                       "rounds": int(fut.rounds),
+                       "traversed": int(fut.traversed_edges)}, arr=state)
+        except ConnectionClosed:
+            pass  # controller went away; nothing to tell it
+
+    # ------------------------------------------------------------------
+    # republish (prepare / commit)
+    # ------------------------------------------------------------------
+
+    def _op_prepare(self, conn: Conn, msg: dict) -> None:
+        from lux_tpu import obs
+
+        rid = msg.get("req_id")
+        path = msg.get("path")
+        gid = msg.get("graph_id") or str(path)
+        token = str(msg.get("token") or rid)
+        with self._lock:
+            # latest prepare wins from the start: an older in-flight
+            # prepare sees its token superseded and will not stage
+            self._publish_token = token
+        try:
+            with obs.span("fleet.publish.prepare", worker=self.worker_id,
+                          graph=gid):
+                from lux_tpu.graph.format import read_lux
+                from lux_tpu.graph.shards import build_pull_shards
+
+                g = read_lux(str(path))
+                shards = build_pull_shards(g, self._num_parts)
+                cache = self._make_cache(shards)
+                cache.prewarm()  # old cache serves throughout this
+            with self._lock:
+                if self._publish_token != token:
+                    # a discard (abort) or a newer prepare happened
+                    # while we built: this cache must NOT stage — a
+                    # later commit would swap in the wrong graph
+                    stale = True
+                else:
+                    stale = False
+                    self._staged = (cache, gid, token)
+                gen_next = self._generation + 1
+            if stale:
+                self._reply_err(conn, msg, "error",
+                                err="prepare superseded/discarded")
+                return
+            conn.send({"req_id": rid, "ok": True, "staged": True,
+                       "graph_id": gid, "generation_next": gen_next,
+                       "token": token})
+        except ConnectionClosed:
+            raise
+        except Exception as e:  # noqa: BLE001 — a failed prepare is an
+            # answer (controller aborts the republish), not a dead worker
+            with self._lock:
+                if self._publish_token == token:
+                    self._publish_token = None
+                    self._staged = None
+            self._reply_err(conn, msg, "error", err=repr(e))
+
+    def _op_commit(self, conn: Conn, msg: dict) -> None:
+        from lux_tpu import obs
+
+        rid = msg.get("req_id")
+        want = msg.get("token")
+        with self._lock:
+            if self._staged is None:
+                err = "nothing staged"
+                staged = None
+            elif want is not None and self._staged[2] != str(want):
+                # the staged cache belongs to a DIFFERENT republish than
+                # the one committing — swapping it in would serve the
+                # wrong graph under the committer's graph_id
+                err = (f"staged token {self._staged[2]!r} does not match "
+                       f"commit token {want!r}")
+                staged = None
+            else:
+                err = None
+                staged, self._staged = self._staged, None
+                cache, gid, _tok = staged
+                self._publish_token = None
+                self._cache = cache
+                self._graph_id = gid
+                self._generation += 1
+                gen = self._generation
+        if staged is None:
+            self._reply_err(conn, msg, "error", err=err)
+            return
+        # the swap the schedulers observe: one attribute store per app.
+        # A pump mid-step keeps the cache object it already grabbed —
+        # both caches are fully warmed, so either answers correctly.
+        for sched in self._scheds.values():
+            sched.cache = cache
+        obs.point("fleet.publish.commit", worker=self.worker_id,
+                  graph=gid, generation=gen)
+        conn.send({"req_id": rid, "ok": True, "generation": gen,
+                   "graph_id": gid})
+
+
+# ----------------------------------------------------------------------
+# standalone process entry
+# ----------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    """Worker process entry: build the graph + shards, start serving,
+    print ONE ready line (JSON: worker_id/port/pid) and block until a
+    ``shutdown`` op or SIGTERM."""
+    import argparse
+    import json
+    import os
+    import signal
+    import sys
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker-id", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--graph", default="",
+                    help=".lux snapshot path (overrides --rmat)")
+    ap.add_argument("--rmat", default="10,8",
+                    help="scale,edge-factor synthetic graph")
+    ap.add_argument("--graph-id", default="")
+    ap.add_argument("--parts", type=int, default=1)
+    ap.add_argument("--apps", default="sssp")
+    ap.add_argument("--buckets", default="1,8")
+    ap.add_argument("--method", default="auto")
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--max-iters", type=int, default=10_000)
+    ap.add_argument("--wait-ms", type=float, default=2.0)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--cpus", default="",
+                    help="pin this replica to these cores (comma list) — "
+                         "the shared-nothing unit sizing the saturation "
+                         "bench measures; affinity is process-wide, so "
+                         "XLA's intra-op pool obeys it too")
+    args = ap.parse_args(argv)
+
+    if args.cpus and hasattr(os, "sched_setaffinity"):
+        os.sched_setaffinity(
+            0, {int(c) for c in args.cpus.split(",") if c.strip()})
+
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.format import read_lux
+    from lux_tpu.graph.shards import build_pull_shards
+
+    if args.graph:
+        g = read_lux(args.graph)
+        gid = args.graph_id or os.path.basename(args.graph)
+    else:
+        scale, ef = (int(x) for x in args.rmat.split(","))
+        g = generate.rmat(scale, ef, seed=0)
+        gid = args.graph_id or f"rmat{scale}"
+    shards = build_pull_shards(g, args.parts)
+    worker = ReplicaWorker(
+        shards, worker_id=args.worker_id, graph_id=gid,
+        apps=tuple(a for a in args.apps.split(",") if a),
+        q_buckets=tuple(int(b) for b in args.buckets.split(",") if b),
+        host=args.host, port=args.port, method=args.method,
+        num_iters=args.num_iters, max_iters=args.max_iters,
+        max_wait_ms=args.wait_ms, max_queue=args.max_queue,
+    )
+    worker.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    print(json.dumps({"ready": True, "worker_id": worker.worker_id,
+                      "port": worker.port, "pid": os.getpid()}),
+          flush=True)
+    try:
+        while not stop.is_set() and worker._running:
+            stop.wait(0.2)
+    except KeyboardInterrupt:
+        pass
+    if worker._running:
+        worker.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

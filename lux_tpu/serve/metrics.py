@@ -47,6 +47,7 @@ class ServeMetrics:
         self.completed = 0
         self.timeouts = 0
         self.rejected = 0
+        self.evictions = 0  # warm-cache engines dropped by the LRU bound
         self.traversed_edges = 0
         self._depth_max = 0
         self._depth_n = 0
@@ -74,6 +75,23 @@ class ServeMetrics:
         with self._lock:
             self.rejected += 1
 
+    def record_eviction(self):
+        with self._lock:
+            self.evictions += 1
+
+    def counters(self) -> dict:
+        """Point-in-time copy of the monotonic counters — the worker
+        heartbeat payload (readers must not reach for the private lock)."""
+        with self._lock:
+            return {
+                "completed": self.completed,
+                "timeouts": self.timeouts,
+                "rejected": self.rejected,
+                "evictions": self.evictions,
+                "batches": self._batch_count,
+                "traversed_edges": self.traversed_edges,
+            }
+
     def sample_queue_depth(self, depth: int):
         with self._lock:
             self._depth_n += 1
@@ -88,6 +106,7 @@ class ServeMetrics:
                 "completed": self.completed,
                 "timeouts": self.timeouts,
                 "rejected": self.rejected,
+                "evictions": self.evictions,
                 "latency_ms": self.latency.summary_ms(),
                 "queue_wait_ms": self.queue_wait.summary_ms(),
                 "batches": self._batch_count,
@@ -117,42 +136,55 @@ class ServeMetrics:
     BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                  0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
-    def _histogram_lines(self, name: str, hist, help_text: str) -> list:
+    def _histogram_lines(self, name: str, hist, help_text: str,
+                         lab: str = "") -> list:
         """Prometheus text-format histogram from a LatencyHistogram.
         Past the reservoir cap the recorder holds a uniform SAMPLE of the
         stream, so bucket counts are scaled to the true request count
-        (the standard reservoir estimator) while ``_count`` stays exact."""
+        (the standard reservoir estimator) while ``_count`` stays exact.
+        ``lab`` is a pre-rendered label pair (``replica="w0",``) merged
+        ahead of ``le`` on every bucket sample."""
         samples = list(hist.samples)
         count = len(hist)
         lines = [f"# HELP {name} {help_text}",
                  f"# TYPE {name} histogram"]
+        bare = f"{{{lab[:-1]}}}" if lab else ""  # label set sans le
         scale = (count / len(samples)) if samples else 0.0
         cum = 0
         for le in self.BUCKETS_S:
             cum = sum(1 for s in samples if s <= le)
-            lines.append(f'{name}_bucket{{le="{le}"}} '
+            lines.append(f'{name}_bucket{{{lab}le="{le}"}} '
                          f"{int(round(cum * scale))}")
-        lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
-        lines.append(f"{name}_sum {round(sum(samples) * scale, 6)}")
-        lines.append(f"{name}_count {count}")
+        lines.append(f'{name}_bucket{{{lab}le="+Inf"}} {count}')
+        lines.append(f"{name}_sum{bare} {round(sum(samples) * scale, 6)}")
+        lines.append(f"{name}_count{bare} {count}")
         return lines
 
     def dump(self, elapsed_s: float | None = None,
-             cache_stats: dict | None = None) -> str:
+             cache_stats: dict | None = None, replica: str = "") -> str:
         """Prometheus text exposition of the full counter/gauge/histogram
         set — the scrape surface a serving fleet's collector reads
         (ROADMAP item 2).  Pure string formatting over the same state
-        ``summary()`` reports; safe to call from any thread."""
+        ``summary()`` reports; safe to call from any thread.
+
+        ``replica`` labels every series with the worker id, so metrics
+        the fleet controller aggregates across workers stay per-worker
+        attributable (one scrape surface, R label values — the
+        Prometheus idiom, not R metric namespaces)."""
+        lab = f'replica="{replica}",' if replica else ""
+        sfx = f"{{{lab[:-1]}}}" if lab else ""
         with self._lock:
             lines = []
 
             def counter(name, val, help_text):
                 lines.extend([f"# HELP {name} {help_text}",
-                              f"# TYPE {name} counter", f"{name} {val}"])
+                              f"# TYPE {name} counter",
+                              f"{name}{sfx} {val}"])
 
             def gauge(name, val, help_text):
                 lines.extend([f"# HELP {name} {help_text}",
-                              f"# TYPE {name} gauge", f"{name} {val}"])
+                              f"# TYPE {name} gauge",
+                              f"{name}{sfx} {val}"])
 
             counter("lux_serve_requests_completed_total", self.completed,
                     "requests answered")
@@ -162,6 +194,8 @@ class ServeMetrics:
                     "requests rejected by bounded-queue backpressure")
             counter("lux_serve_batches_total", self._batch_count,
                     "engine batches dispatched")
+            counter("lux_serve_engine_evictions_total", self.evictions,
+                    "warm-cache engines dropped by the LRU bound")
             counter("lux_serve_traversed_edges_total", self.traversed_edges,
                     "edges traversed across all answered queries")
             if self._depth_n:  # same no-samples guard as summary()
@@ -176,16 +210,16 @@ class ServeMetrics:
                       "batches served by a warm engine")
             lines.extend(self._histogram_lines(
                 "lux_serve_request_latency_seconds", self.latency,
-                "enqueue-to-result latency"))
+                "enqueue-to-result latency", lab=lab))
             lines.extend(self._histogram_lines(
                 "lux_serve_queue_wait_seconds", self.queue_wait,
-                "enqueue-to-dispatch wait"))
+                "enqueue-to-dispatch wait", lab=lab))
             completed = self.completed
         if elapsed_s is not None and elapsed_s > 0:
             lines.extend([
                 "# HELP lux_serve_qps completed requests per second",
                 "# TYPE lux_serve_qps gauge",
-                f"lux_serve_qps {round(completed / elapsed_s, 4)}"])
+                f"lux_serve_qps{sfx} {round(completed / elapsed_s, 4)}"])
         if cache_stats and (cache_stats.get("warm_hits")
                             or cache_stats.get("cold_traces")):
             # warm.py's stats() already derives the ratio — expose that
@@ -200,7 +234,7 @@ class ServeMetrics:
                 "# HELP lux_serve_warm_hit_ratio warm engine-cache "
                 "hits / lookups",
                 "# TYPE lux_serve_warm_hit_ratio gauge",
-                f"lux_serve_warm_hit_ratio {ratio}"])
+                f"lux_serve_warm_hit_ratio{sfx} {ratio}"])
         return "\n".join(lines) + "\n"
 
     def emit_snapshot(self, rec=None, elapsed_s: float | None = None,

@@ -17,18 +17,27 @@ a superseded layout are dropped when a new shards bundle is installed.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 import time
-from typing import Dict, Tuple
+from typing import Optional, Tuple
 
 from lux_tpu.engine import methods
 from lux_tpu.graph.shards import PullShards
 from lux_tpu.serve.batched import BatchedEngine, make_program
+from lux_tpu.utils.config import env_int
 
 #: Q buckets pre-traced at service start.  1 covers the latency floor and
 #: the cold-degradation path, 64 the throughput bucket; 8 the middle.
 DEFAULT_Q_BUCKETS = (1, 8, 64)
+
+#: LRU bound on live engines (env LUX_SERVE_ENGINE_CAP).  Every republish
+#: builds a fresh cache, but within one cache ad-hoc Q shapes and
+#: multi-app serving can still accumulate compiled engines without bound;
+#: 32 covers apps x buckets with headroom while capping resident compiled
+#: programs + their state buffers.
+DEFAULT_MAX_ENGINES = 32
 
 
 def layout_key(shards: PullShards) -> tuple:
@@ -52,7 +61,8 @@ class WarmEngineCache:
 
     def __init__(self, shards: PullShards, apps=("sssp",),
                  q_buckets=DEFAULT_Q_BUCKETS, method: str = "auto",
-                 num_iters: int = 10, max_iters: int = 10_000):
+                 num_iters: int = 10, max_iters: int = 10_000,
+                 metrics=None, max_engines: Optional[int] = None):
         self.shards = shards
         self.apps = tuple(apps)
         self.q_buckets = tuple(sorted(set(int(q) for q in q_buckets)))
@@ -60,13 +70,26 @@ class WarmEngineCache:
             raise ValueError(f"q buckets must be >= 1: {self.q_buckets}")
         self.num_iters = num_iters
         self.max_iters = max_iters
+        #: optional ServeMetrics sink (evictions feed the service's
+        #: counter set so a fleet scrape sees cache churn per replica)
+        self.metrics = metrics
+        if max_engines is None:
+            max_engines = env_int("LUX_SERVE_ENGINE_CAP",
+                                  DEFAULT_MAX_ENGINES, minimum=1)
+        if max_engines < 1:
+            raise ValueError(f"max_engines must be >= 1: {max_engines}")
+        self.max_engines = int(max_engines)
         self._layout = layout_key(shards)
         # one resolution per app (reduce differs), shared by every bucket
         self._method = {
             app: methods.resolve(method, make_program(app, shards.spec.nv).reduce)
             for app in self.apps
         }
-        self._engines: Dict[EngineKey, BatchedEngine] = {}
+        # insertion/recency-ordered: the LRU eviction order (get/_build
+        # refresh recency; the oldest entry past max_engines is dropped)
+        self._engines: "collections.OrderedDict[EngineKey, BatchedEngine]" \
+            = collections.OrderedDict()
+        self.evictions = 0
         # ONE device placement of the graph arrays, shared by every
         # engine of this layout (a per-engine copy would multiply the
         # O(E) arrays by the bucket count)
@@ -128,7 +151,22 @@ class WarmEngineCache:
                     device_arrays=self._device_arrays,
                 )
                 self._engines[k] = eng
+                self._evict_locked()
+            else:
+                self._engines.move_to_end(k)  # refresh LRU recency
         return eng
+
+    def _evict_locked(self) -> None:
+        """Drop least-recently-used engines past ``max_engines`` (caller
+        holds the lock).  A dropped engine's compiled program may still
+        be referenced by an in-flight batch via its local handle — the
+        cache only forgets it, so the next request for that shape pays a
+        fresh cold trace (counted, like every cold trace)."""
+        while len(self._engines) > self.max_engines:
+            self._engines.popitem(last=False)
+            self.evictions += 1
+            if self.metrics is not None:
+                self.metrics.record_eviction()
 
     def get(self, app: str, q: int) -> Tuple[BatchedEngine, bool]:
         """(engine, was_warm).  A cold get warms the engine inline —
@@ -166,19 +204,22 @@ class WarmEngineCache:
             self.shards = shards
             self._layout = layout_key(shards)
             self._device_arrays = None  # re-place on next build
-            self._engines = {
-                k: e for k, e in self._engines.items()
+            self._engines = collections.OrderedDict(
+                (k, e) for k, e in self._engines.items()
                 if k.layout == self._layout
-            }
+            )
 
     def stats(self) -> dict:
         with self._lock:
             warmed = sum(1 for e in self._engines.values() if e._warmed)
             total = len(self._engines)
             hits, cold = self.warm_hits, self.cold_traces
+            evicted = self.evictions
         return {
             "engines": total,
             "engines_warm": warmed,
+            "max_engines": self.max_engines,
+            "evictions": evicted,
             "warm_hits": hits,
             "cold_traces": cold,
             "warm_hit_ratio": round(hits / max(hits + cold, 1), 4),

@@ -108,13 +108,56 @@ echo "$out" | grep -q "step.ci" || { echo "missing span"; exit 1; }
 echo "$out" | grep -q "OPEN" || { echo "missing post-mortem"; exit 1; }
 '
 
+# 3c) fleet smoke (ISSUE 8): a 2-worker loopback fleet must answer,
+#     republish with zero shed, survive a worker kill, and leave a
+#     luxview-renderable event log — the whole controller/worker split
+#     end to end on CPU
+stage fleet_smoke 600 bash -c '
+set -e
+export LUX_OBS_RUN_ID=ci_fleet_$$
+JAX_PLATFORMS=cpu python -c "
+import numpy as np, tempfile, time
+from lux_tpu.graph import generate
+from lux_tpu.graph.format import write_lux
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models.sssp import bfs_reference
+from lux_tpu.serve.fleet.bench import start_fleet
+g = generate.rmat(8, 4, seed=4)
+snap = tempfile.mktemp(suffix=\".lux\"); write_lux(snap, g)
+shards = build_pull_shards(g, 2)
+fleet = start_fleet(2, shards=shards, graph_id=\"snap.lux\",
+                    mode=\"thread\", buckets=(1, 4))
+ctl = fleet.controller
+try:
+    for s in (0, 3, 7):
+        assert np.array_equal(ctl.submit(s).result(timeout=60),
+                              bfs_reference(g, s)), s
+    rep = ctl.republish(snap, graph_id=\"snap.lux\")
+    assert set(rep[\"generations\"].values()) == {1}, rep
+    fleet.thread_workers[0].kill()
+    time.sleep(0.3)
+    for s in (0, 3, 7):
+        assert np.array_equal(ctl.submit(s).result(timeout=60),
+                              bfs_reference(g, s)), s
+    st = ctl.stats()
+    assert st[\"shed\"] == 0 and st[\"worker_deaths\"] == 1, st
+    print(\"fleet smoke:\", st)
+finally:
+    fleet.close()
+"
+out=$(python tools/luxview.py "$LUX_OBS_RUN_ID")
+echo "$out" | grep -q "fleet.start" || { echo "missing fleet.start"; exit 1; }
+echo "$out" | grep -q "fleet.republish" || { echo "missing republish"; exit 1; }
+'
+
 # 4) fast tier-1 subset: the engine/analysis/native seams this script
 #    exists to protect (full suite: ROADMAP.md "Tier-1 verify")
 stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
     -m 'not slow' -p no:cacheprovider \
     tests/test_luxcheck.py tests/test_native.py tests/test_expand.py \
     tests/test_passfuse.py tests/test_mxreduce.py tests/test_obs.py \
-    tests/test_determinism.py tests/test_serve_scheduler.py
+    tests/test_determinism.py tests/test_serve_scheduler.py \
+    tests/test_fleet.py
 
 if [ "$FAILED" -ne 0 ]; then
   echo "ci_check: FAILED (see $LOG)"; exit 1
