@@ -9,7 +9,9 @@ call, not reimplementations — and each checker family runs on the
 resulting jaxpr / StableHLO.
 
 ``--fast`` covers pull + push + one pass-fused config + the luxtrace
-telemetry-ring twins (the ci_check tier); ``--all`` adds the serve
+telemetry-ring twins + the mutation-overlay twins (ISSUE 10: LUX-J1
+occupancy-invariant traces, LUX-J2 delta-carry donation, LUX-J503
+overlay-on/off kernel parity; the ci_check tier); ``--all`` adds the serve
 batched steps, the distributed push engines (allgather + ring, on a
 host-device mesh), the fused-pf and fused-mx plans (the MXREDUCE
 in-kernel reduction: its retrace stability, VMEM ledger incl. the
@@ -117,20 +119,61 @@ def _dev_route(plan):
     return rs, jax.tree.map(jnp.asarray, ra)
 
 
+@lru_cache(maxsize=1)
+def _overlay_fixture():
+    """Mutation overlays at three delta-buffer occupancies — EMPTY,
+    HALF, FULL — against the shared fixture graph (cap pinned small so
+    FULL is cheap).  The LUX-J1 unit's whole point: occupancy is DATA,
+    so all three must produce byte-identical traces (ISSUE 10)."""
+    import numpy as np
+
+    from lux_tpu.mutate import MutableGraph
+
+    fx = fixture()
+    g = fx["graph"]
+    cap = 128
+    rng = np.random.default_rng(0)
+    out = {}
+    for name, n_ins in (("empty", 0), ("half", cap // 2), ("full", cap)):
+        mg = MutableGraph(g, num_parts=2, cap=cap)
+        mg._pull = fx["shards"]  # share the fixture layout
+        if n_ins:
+            # inserts confined to part 0's dst range so ONE part's
+            # buffer actually reaches the occupancy under test, plus a
+            # few tombstones so the deleted-mask path is live
+            hi = int(fx["shards"].cuts[1])
+            mg.apply(rng.integers(0, g.nv, n_ins),
+                     rng.integers(0, hi, n_ins),
+                     np.ones(n_ins, np.int8))
+            dele = rng.choice(g.ne, 8, replace=False)
+            mg.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+                     np.zeros(8, np.int8))
+        out[name] = mg.pull_overlay()
+    return out
+
+
+# a (static, arrays) overlay pair device-places exactly like a route
+# plan pair — one helper, two names for call-site clarity
+_dev_overlay = _dev_route
+
+
 # ---------------------------------------------------------------------------
 # retrace (LUX-J1)
 # ---------------------------------------------------------------------------
 
 
-def _pull_fixed_traced(num_iters: int, route=None, ring=None):
+def _pull_fixed_traced(num_iters: int, route=None, ring=None,
+                       overlay=None):
     from lux_tpu.engine import pull
 
     fx = fixture()
     rs, ra = _dev_route(route) if route is not None else (None, None)
+    os_, oa = _dev_overlay(overlay) if overlay is not None else (None,
+                                                                 None)
     return pull._pull_fixed_jit.trace(
         fx["prank"], fx["shards"].spec, num_iters, "scan", fx["arrays"],
         fx["state0"], ring, route_static=rs, route_arrays=ra,
-        interpret=True)
+        interpret=True, ostatic=os_, oarrays=oa)
 
 
 def _retrace_pull_fixed(routed: bool) -> List[Finding]:
@@ -186,6 +229,66 @@ def _retrace_pull_fixed_ring() -> List[Finding]:
     out += retrace.check_variants(
         [_pull_fixed_traced(2, route, ring),
          _pull_fixed_traced(3, route, ring)], path, label)
+    return out
+
+
+def _retrace_pull_fixed_overlay() -> List[Finding]:
+    """ISSUE 10's LUX-J1 guardrail: the mutation overlay's delta-buffer
+    occupancy (empty / half / full at one capacity) is pure DATA — all
+    three configs must produce the SAME trace (strict: identical avals,
+    identical primitive sequence), and one config must re-trace
+    stably.  A shape- or occupancy-dependent overlay would recompile
+    the serving hot loop on every churn batch."""
+    ovs = _overlay_fixture()
+    path = "lux_tpu/engine/pull.py"
+    label = "pull-fixed/overlay"
+    fx = fixture()
+    out = retrace.check_statics(
+        (fx["prank"], fx["shards"].spec, "scan", ovs["half"][0]),
+        path, label)
+    out += retrace.trace_twice_stable(
+        lambda: _pull_fixed_traced(2, overlay=ovs["half"]), path, label)
+    out += retrace.check_variants(
+        [_pull_fixed_traced(2, overlay=ovs[k])
+         for k in ("empty", "half", "full")], path, label)
+    return out
+
+
+def _retrace_push_chunk_overlay() -> List[Finding]:
+    """The push side of the churn-never-recompiles contract: the
+    overlay chunk loop is ONE compile across delta occupancies — a
+    re-call with different overlay arrays (and a different it_stop)
+    must hit the jit cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import push
+
+    fx = fixture()
+    sh = fx["pshards"]
+    ovs = _overlay_fixture()
+    os_, _ = ovs["half"]
+    loop = push.compile_push_chunk(fx["psssp"], sh.pspec, sh.spec,
+                                   "scan", overlay_static=os_)
+    arrays, parrays, carry0 = push.push_init(fx["psssp"], sh)
+
+    def call(key, stop):
+        oa = _dev_overlay(ovs[key])[1]
+
+        def go():
+            out = loop(arrays, parrays, carry0, jnp.int32(stop),
+                       oarrays=oa)
+            jax.block_until_ready(out.state)
+            return out
+
+        return go
+
+    out = retrace.check_statics(
+        (fx["psssp"], sh.pspec, sh.spec, "scan", os_),
+        "lux_tpu/engine/push.py", "push-chunk/overlay")
+    out += retrace.check_dynamic_recall(
+        loop, call("empty", 2), call("full", 3),
+        "lux_tpu/engine/push.py", "push-chunk/overlay")
     return out
 
 
@@ -382,6 +485,27 @@ def _donation_push_chunk_ring() -> List[Finding]:
         label="push-chunk/ring-donate")
 
 
+def _donation_pull_fixed_overlay() -> List[Finding]:
+    """ISSUE 10's LUX-J2 leg: a donating refresh run must still consume
+    the state carry with the overlay present — the delta buffers ride
+    as read-only arguments (reused across iterations AND refreshes, so
+    they must NOT be donated), while the warm state's input buffer
+    frees for the loop's ping-pong exactly as without the overlay."""
+    from lux_tpu.engine import pull
+
+    fx = fixture()
+    ovs = _overlay_fixture()
+    os_, oa = _dev_overlay(ovs["half"])
+    args = (fx["arrays"], fx["state0"])
+    traced = pull._pull_fixed_jit_donate.trace(
+        fx["prank"], fx["shards"].spec, 3, "scan", *args,
+        route_static=None, route_arrays=None, interpret=True,
+        ostatic=os_, oarrays=oa)
+    return donation.check_donation(
+        traced, args, donate_argnums=(1,), path="lux_tpu/engine/pull.py",
+        label="pull-fixed/overlay-donate")
+
+
 def _donation_serve(app: str) -> List[Finding]:
     run, args = _serve_traced(app, 4)
     traced = run.trace(*args)
@@ -519,6 +643,21 @@ def _hbm_ring_neutral() -> List[Finding]:
                                    "pull-fixed/ring-neutral")
 
 
+def _hbm_overlay_neutral() -> List[Finding]:
+    """ISSUE 10's LUX-J503 leg: overlay-on vs overlay-off kernel parity
+    on the routed-pf hot loop — the tombstone mask is an elementwise
+    select and the delta fold an XLA gather+scatter, so the overlay
+    must launch EXACTLY the base config's custom kernels (zero extra
+    pallas_calls; the O(cap) delta pass rides the fused XLA graph)."""
+    fx = fixture()
+    route = fx["plan_pf"]
+    ovs = _overlay_fixture()
+    base = _pull_fixed_traced(2, route)
+    twin = _pull_fixed_traced(2, route, overlay=ovs["half"])
+    return hbm.check_kernel_parity(base, twin, "lux_tpu/engine/pull.py",
+                                   "pull-fixed/overlay-neutral")
+
+
 def _hbm_fused_pf() -> List[Finding]:
     import jax
 
@@ -609,6 +748,12 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   "lux_tpu/engine/pull.py", False, _retrace_pull_fixed_mx),
         AuditUnit("retrace", "pull-until/direct",
                   "lux_tpu/engine/pull.py", False, _retrace_pull_until),
+        AuditUnit("retrace", "pull-fixed/overlay",
+                  "lux_tpu/engine/pull.py", True,
+                  _retrace_pull_fixed_overlay),
+        AuditUnit("retrace", "push-chunk/overlay",
+                  "lux_tpu/engine/push.py", False,
+                  _retrace_push_chunk_overlay),
         AuditUnit("retrace", "push-chunk/it_stop",
                   "lux_tpu/engine/push.py", True, _retrace_push_chunk),
         AuditUnit("retrace", "serve-sssp/Q-buckets",
@@ -628,6 +773,9 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   "lux_tpu/engine/push.py", True, _donation_push_chunk),
         AuditUnit("donation", "push-step/donate",
                   "lux_tpu/engine/push.py", False, _donation_push_step),
+        AuditUnit("donation", "pull-fixed/overlay-donate",
+                  "lux_tpu/engine/pull.py", True,
+                  _donation_pull_fixed_overlay),
         AuditUnit("donation", "pull-fixed/ring-donate",
                   "lux_tpu/engine/pull.py", True,
                   _donation_pull_fixed_ring),
@@ -658,6 +806,8 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   lambda: _hbm_expand(True)),
         AuditUnit("hbm", "pull-fixed/ring-neutral",
                   "lux_tpu/engine/pull.py", True, _hbm_ring_neutral),
+        AuditUnit("hbm", "pull-fixed/overlay-neutral",
+                  "lux_tpu/engine/pull.py", True, _hbm_overlay_neutral),
         AuditUnit("hbm", "fused-pf", "lux_tpu/ops/expand.py", False,
                   _hbm_fused_pf),
         AuditUnit("hbm", "fused-mx", "lux_tpu/ops/expand.py", False,

@@ -116,11 +116,19 @@ def pull_gather_part(arrays: ShardArrays, full_state: jnp.ndarray,
 
 
 def pull_reduce_part(prog: PullProgram, arrays: ShardArrays, gath,
-                     method: str):
+                     method: str, del_val=None):
     """COMP phase for ONE part: per-edge values + segmented reduce by
-    destination (the pr_kernel hot loop, pagerank_gpu.cu:49-102)."""
+    destination (the pr_kernel hot loop, pagerank_gpu.cu:49-102).
+    ``del_val`` (the mutation overlay's tombstone mask,
+    lux_tpu.mutate.overlay) neutralizes deleted base edges' VALUES —
+    the base arrays and the reduce itself run unchanged, so the overlay
+    never retraces (LUX-J1)."""
     src_state, dst_state = gath
     vals = prog.edge_value(src_state, arrays.weights, dst_state)
+    if del_val is not None:
+        from lux_tpu.mutate import overlay as _ovl
+
+        vals = _ovl.mask_deleted(vals, del_val, prog.reduce)
     return _REDUCERS[prog.reduce](
         vals, arrays.row_ptr, arrays.head_flag, arrays.dst_local, method=method
     )
@@ -134,15 +142,27 @@ def local_pull_step(
     method: str = "scan",
     route=None,
     interpret: bool = False,
+    overlay=None,
 ) -> jnp.ndarray:
     """One pull iteration for ONE part.  ``full_state`` is the (P*V, ...)
     concatenated padded state of all parts; ``local_state`` is (V, ...).
     ``route`` = (ExpandStatic, per-part arrays) switches the LOAD phase
     to the routed-shuffle expand; (FusedStatic, arrays) replaces BOTH
     the load and the segmented reduce with the fused routed pipeline
-    (ops/expand.apply_fused — dst-state-independent programs only)."""
+    (ops/expand.apply_fused — dst-state-independent programs only).
+    ``overlay`` = (OverlayStatic, this part's OverlayArrays): the
+    dynamic-graph mutation overlay (lux_tpu.mutate) — tombstoned base
+    edges neutralize, then the fixed-capacity insert buffer gathers D
+    extra source states and scatter-combines them into the accumulator
+    BEFORE apply.  Static shapes throughout: churn never retraces."""
     from lux_tpu.ops import expand
 
+    if overlay is not None and route is not None and isinstance(
+            route[0], (expand.FusedStatic, expand.CFRouteStatic)):
+        raise ValueError(
+            "mutation overlays compose with the direct gather and the "
+            "routed EXPAND plans only; fused/CF plans bake the reduce "
+            "layout at plan time — compact instead")
     if route is not None and isinstance(route[0], expand.CFRouteStatic):
         gath = expand.apply_cf_route(full_state, local_state, route[0],
                                      route[1], interpret=interpret)
@@ -162,7 +182,15 @@ def local_pull_step(
                                        route[0], route[1], interpret)
     else:
         gath = pull_gather_part(arrays, full_state, local_state)
-    acc = pull_reduce_part(prog, arrays, gath, method)
+    acc = pull_reduce_part(
+        prog, arrays, gath, method,
+        del_val=overlay[1].del_val if overlay is not None else None)
+    if overlay is not None:
+        from lux_tpu.mutate import overlay as _ovl
+
+        acc = _ovl.delta_scatter(
+            acc, full_state, overlay[1],
+            lambda s, w: prog.edge_value(s, w, None), prog.reduce)
     return prog.apply(local_state, acc, arrays)
 
 
@@ -177,18 +205,32 @@ def init_state(prog: PullProgram, arrays: ShardArrays) -> jnp.ndarray:
 
 def _pull_iteration(prog, spec: ShardSpec, method, arrays, state,
                     route_static=None, route_arrays=None,
-                    interpret: bool = False):
-    """One pull iteration over the whole (P, V, ...) shard stack."""
+                    interpret: bool = False, ostatic=None, oarrays=None):
+    """One pull iteration over the whole (P, V, ...) shard stack.
+    ``ostatic``/``oarrays`` carry the mutation overlay (static half as
+    a jit static, arrays vmapped with the shards)."""
     full = state.reshape((spec.gathered_size,) + state.shape[2:])
+
+    def step(arr, loc, ra=None, oa=None):
+        return local_pull_step(
+            prog, arr, full, loc, method,
+            route=(route_static, ra) if route_static is not None else None,
+            interpret=interpret,
+            overlay=(ostatic, oa) if ostatic is not None else None)
+
+    if route_static is None and ostatic is None:
+        return jax.vmap(lambda arr, loc: step(arr, loc))(arrays, state)
     if route_static is None:
         return jax.vmap(
-            lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
-        )(arrays, state)
+            lambda arr, loc, oa: step(arr, loc, oa=oa)
+        )(arrays, state, oarrays)
+    if ostatic is None:
+        return jax.vmap(
+            lambda arr, loc, ra: step(arr, loc, ra=ra)
+        )(arrays, state, route_arrays)
     return jax.vmap(
-        lambda arr, loc, ra: local_pull_step(
-            prog, arr, full, loc, method, route=(route_static, ra),
-            interpret=interpret)
-    )(arrays, state, route_arrays)
+        lambda arr, loc, ra, oa: step(arr, loc, ra=ra, oa=oa)
+    )(arrays, state, route_arrays, oarrays)
 
 
 def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "auto",
@@ -261,10 +303,11 @@ def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "auto"
 
 def _pull_fixed_fn(prog, spec, num_iters, method, arrays, state0,
                    ring=None, route_static=None, route_arrays=None,
-                   interpret=False):
+                   interpret=False, ostatic=None, oarrays=None):
     def body(_, state):
         return _pull_iteration(prog, spec, method, arrays, state,
-                               route_static, route_arrays, interpret)
+                               route_static, route_arrays, interpret,
+                               ostatic, oarrays)
 
     if ring is None:
         return jax.lax.fori_loop(0, num_iters, body, state0)
@@ -285,7 +328,7 @@ def _pull_fixed_fn(prog, spec, num_iters, method, arrays, state0,
 
 
 _PULL_FIXED_STATICS = ("prog", "spec", "num_iters", "method",
-                       "route_static", "interpret")
+                       "route_static", "interpret", "ostatic")
 _pull_fixed_jit = jax.jit(_pull_fixed_fn,
                           static_argnames=_PULL_FIXED_STATICS)
 #: donating twin: state0 (positional 5) is consumed, so the loop's
@@ -312,6 +355,7 @@ def run_pull_fixed(
     route=None,
     donate: bool = False,
     telemetry=None,
+    overlay=None,
 ):
     """Single-device driver: fixed iteration count (PageRank/CF style,
     pagerank/pagerank.cc:109-114).  Whole loop stays on device; the
@@ -328,6 +372,11 @@ def run_pull_fixed(
     per-iteration residual curve in the loop carry — results stay
     bitwise-identical, the return becomes (state, ring), and a donating
     run consumes the ring with the state.
+    ``overlay`` ((OverlayStatic, OverlayArrays) from
+    lux_tpu.mutate.overlay) runs the step against the mutating graph:
+    base gathers unchanged, tombstones neutralized, the fixed-capacity
+    insert buffer folded in per iteration — occupancy is data, so
+    churn never recompiles (luxaudit LUX-J1 pins it).
     Returns the final stacked (P, V, ...) state.
     """
     method = methods.resolve(method, prog.reduce)
@@ -335,13 +384,16 @@ def run_pull_fixed(
     rs, ra = route if route is not None else (None, None)
     if ra is not None:
         ra = jax.tree.map(jnp.asarray, ra)
+    os_, oa = overlay if overlay is not None else (None, None)
+    if oa is not None:
+        oa = jax.tree.map(jnp.asarray, oa)
     tel = telemetry
     if tel is not None:
         tel = jax.tree.map(jnp.asarray, tel)
     fn = _pull_fixed_jit_donate if donate else _pull_fixed_jit
     return fn(prog, spec, num_iters, method, arrays, state0, tel,
               route_static=rs, route_arrays=ra,
-              interpret=_route_interpret())
+              interpret=_route_interpret(), ostatic=os_, oarrays=oa)
 
 
 def run_pull_fixed_overlapped(
@@ -436,6 +488,7 @@ def run_pull_until(
     route=None,
     donate: bool = False,
     telemetry=None,
+    overlay=None,
 ):
     """Single-device driver: iterate until no vertex is active (the push-app
     convergence contract — total active count == 0, sssp/sssp.cc:115-129 —
@@ -446,7 +499,10 @@ def run_pull_until(
     ``donate=True`` consumes ``state0`` (see run_pull_fixed).
     ``telemetry`` (``obs.ring.new_ring("pull_until")``) records the
     per-iteration active count in the loop carry (bitwise no-op on the
-    state; the return becomes (state, iters, ring)).
+    state; the return becomes (state, iters, ring)).  ``overlay`` runs
+    against the mutating graph (see run_pull_fixed) — this is the
+    incremental-refresh entry point (lux_tpu.mutate.refresh): warm
+    state in, iterate the overlay step until quiescent.
     Returns (final_state, num_iters_run).
     """
     method = methods.resolve(method, prog.reduce)
@@ -454,25 +510,29 @@ def run_pull_until(
     rs, ra = route if route is not None else (None, None)
     if ra is not None:
         ra = jax.tree.map(jnp.asarray, ra)
+    os_, oa = overlay if overlay is not None else (None, None)
+    if oa is not None:
+        oa = jax.tree.map(jnp.asarray, oa)
     tel = telemetry
     if tel is not None:
         tel = jax.tree.map(jnp.asarray, tel)
     fn = _pull_until_jit_donate if donate else _pull_until_jit
     return fn(prog, spec, max_iters, active_fn, method, arrays,
               state0, tel, route_static=rs, route_arrays=ra,
-              interpret=_route_interpret())
+              interpret=_route_interpret(), ostatic=os_, oarrays=oa)
 
 
 def _pull_until_fn(prog, spec, max_iters, active_fn, method, arrays, state0,
                    ring=None, route_static=None, route_arrays=None,
-                   interpret=False):
+                   interpret=False, ostatic=None, oarrays=None):
     def cond(carry):
         return (carry[2] > 0) & (carry[1] < max_iters)
 
     def body(carry):
         state, it = carry[0], carry[1]
         new = _pull_iteration(prog, spec, method, arrays, state,
-                              route_static, route_arrays, interpret)
+                              route_static, route_arrays, interpret,
+                              ostatic, oarrays)
         active = jnp.sum(active_fn(state, new))
         if ring is None:
             return new, it + 1, active
@@ -490,7 +550,7 @@ def _pull_until_fn(prog, spec, max_iters, active_fn, method, arrays, state0,
 
 
 _PULL_UNTIL_STATICS = ("prog", "spec", "max_iters", "active_fn", "method",
-                       "route_static", "interpret")
+                       "route_static", "interpret", "ostatic")
 _pull_until_jit = jax.jit(_pull_until_fn,
                           static_argnames=_PULL_UNTIL_STATICS)
 #: donating twin of the convergence loop (state0 = positional 6); the

@@ -69,7 +69,7 @@ def _seg_reduce(prog):
 
 
 def dense_part_step(prog, arr: ShardArrays, full_state, local, method="scan",
-                    route=None, interpret=False):
+                    route=None, interpret=False, del_val=None):
     """Pull-mode relaxation over ALL in-edges (sssp_pull_kernel semantics:
     new[v] = op(old[v], op over in-edges relax(state[src])).
 
@@ -79,7 +79,10 @@ def dense_part_step(prog, arr: ShardArrays, full_state, local, method="scan",
     A pass-fused plan (expand.to_pf / pf=True planners) replays through
     the fused kernel family transparently — apply_expand dispatches on
     the static's type, same bits, ~half the HBM sweeps per dense
-    round."""
+    round.  ``del_val`` (lux_tpu.mutate.overlay tombstone mask)
+    neutralizes deleted base edges' relax values — exactly absorbed by
+    the min/max combiner, so a dense round equals the merged graph's
+    bitwise; insert folding happens once per iteration in _push_relax."""
     if route is not None:
         from lux_tpu.ops import expand
 
@@ -91,6 +94,10 @@ def dense_part_step(prog, arr: ShardArrays, full_state, local, method="scan",
     else:
         src = full_state[arr.src_pos]
     vals = prog.relax(src, arr.weights)
+    if del_val is not None:
+        from lux_tpu.mutate import overlay as _ovl
+
+        vals = _ovl.mask_deleted(vals, del_val, prog.reduce)
     acc = _seg_reduce(prog)(
         vals, arr.row_ptr, arr.head_flag, arr.dst_local, method=method
     )
@@ -271,28 +278,50 @@ def _push_prep(pspec: PushSpec, spec: ShardSpec, parrays, c: PushCarry):
 def _push_relax(prog, pspec: PushSpec, spec: ShardSpec, method, arrays,
                 parrays, c: PushCarry, q_vids_all, q_vals_all, preps,
                 use_dense, route_static=None, route_arrays=None,
-                interpret=False):
+                interpret=False, ostatic=None, oarrays=None):
     """COMP phase: dense (pull over all in-edges) or sparse (scatter the
     frontier's out-edges) relaxation -> new stacked state.
 
     ``use_dense`` is GLOBAL (identical for every part), so the direction
     switch is ONE `lax.cond` whose branches vmap over parts — a genuine
     branch (only the taken mode executes) with compile size O(1) in P,
-    not the P-fold Python unroll of round 1."""
+    not the P-fold Python unroll of round 1.
+
+    ``ostatic``/``oarrays`` (lux_tpu.mutate.overlay): dense rounds
+    neutralize tombstoned base edges in-place; sparse rounds already
+    skip them (the patched CSR pads their destinations to the
+    drop-sentinel, build_push_overlay).  The fixed-capacity INSERT
+    buffer is folded in once per round AFTER the direction branch —
+    always relaxing every delta edge from the round's input state is
+    monotone-safe (min/max relaxation is idempotent) and keeps both
+    branches' traces identical in shape."""
     V = spec.nv_pad
     full = c.state.reshape((spec.gathered_size,) + c.state.shape[2:])
     rows, counts, incl, _ = preps
+    if (ostatic is None) != (oarrays is None):
+        # a loop compiled without overlay_static would otherwise
+        # silently IGNORE a passed oarrays (base-graph results under a
+        # caller who believes churn applied); the reverse dies on None
+        raise ValueError(
+            "overlay_static and oarrays must be passed together: "
+            "compile_push_chunk(..., overlay_static=ostatic) and "
+            "loop(..., oarrays=oarr)")
+    dv = oarrays.del_val if ostatic is not None else None
 
     def dense_all():
         if route_static is not None:
             return jax.vmap(
-                lambda arr, loc, ra: dense_part_step(
+                lambda arr, loc, ra, *o: dense_part_step(
                     prog, arr, full, loc, method,
-                    route=(route_static, ra), interpret=interpret)
-            )(arrays, c.state, route_arrays)
+                    route=(route_static, ra), interpret=interpret,
+                    del_val=o[0] if o else None)
+            )(arrays, c.state, route_arrays,
+              *((dv,) if dv is not None else ()))
         return jax.vmap(
-            lambda arr, loc: dense_part_step(prog, arr, full, loc, method)
-        )(arrays, c.state)
+            lambda arr, loc, *o: dense_part_step(
+                prog, arr, full, loc, method,
+                del_val=o[0] if o else None)
+        )(arrays, c.state, *((dv,) if dv is not None else ()))
 
     def sparse_all():
         def run(cap):
@@ -319,7 +348,19 @@ def _push_relax(prog, pspec: PushSpec, spec: ShardSpec, method, arrays,
             fits, lambda: run(small), lambda: run(pspec.e_sp)
         )
 
-    return jax.lax.cond(use_dense, dense_all, sparse_all)
+    new = jax.lax.cond(use_dense, dense_all, sparse_all)
+    if ostatic is None:
+        return new
+    from lux_tpu.mutate import overlay as _ovl
+
+    # insert fold: O(cap) gather + drop-scatter per round, relaxing
+    # every live delta edge from the round's INPUT state (c.state, the
+    # same state both branches read) — exact for the monotone min/max
+    # programs, and the empty-slot sentinel drops everything else
+    return jax.vmap(
+        lambda oa, loc: _ovl.delta_scatter(loc, full, oa, prog.relax,
+                                           prog.reduce)
+    )(oarrays, new)
 
 
 def _push_requeue(prog, pspec: PushSpec, spec: ShardSpec, arrays,
@@ -343,20 +384,21 @@ def _push_requeue(prog, pspec: PushSpec, spec: ShardSpec, arrays,
 
 def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
                     arrays, parrays, c: PushCarry, route_static=None,
-                    route_arrays=None, interpret=False) -> PushCarry:
+                    route_arrays=None, interpret=False, ostatic=None,
+                    oarrays=None) -> PushCarry:
     """One direction-optimized iteration over all parts (single device)."""
     q_vids_all, q_vals_all, preps, use_dense = _push_prep(pspec, spec, parrays, c)
     new = _push_relax(
         prog, pspec, spec, method, arrays, parrays, c,
         q_vids_all, q_vals_all, preps, use_dense,
-        route_static, route_arrays, interpret,
+        route_static, route_arrays, interpret, ostatic, oarrays,
     )
     return _push_requeue(prog, pspec, spec, arrays, c, new, preps, use_dense)
 
 
 def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
                        method: str = "auto", donate: bool = False,
-                       telemetry: bool = False):
+                       telemetry: bool = False, overlay_static=None):
     """Single-device push loop with a DYNAMIC iteration stop (one compile
     serves every run length and every adaptive-repartition window; the
     driver inspects the carry's load stats between windows).
@@ -376,19 +418,25 @@ def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
     the state math (and its bytes) is untouched.  Returns (carry, ring);
     ``donate`` consumes the ring with the carry.
 
+    ``overlay_static`` (lux_tpu.mutate.overlay.OverlayStatic) compiles
+    the mutation-overlay twin: the loop then takes the stacked
+    OverlayArrays as a trailing ``oarrays`` argument — occupancy is
+    data, so churn re-calls never recompile (LUX-J1).
+
     Resolution happens OUTSIDE the compile cache: caching on "auto" would
     pin the first platform resolution for the process and split the cache
     between "auto" and its concrete equivalent."""
     return _compile_push_chunk_cached(
         prog, pspec, spec, methods.resolve(method, prog.reduce),
-        donate=donate, telemetry=telemetry,
+        donate=donate, telemetry=telemetry, ostatic=overlay_static,
     )
 
 
 def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
                               route_static, method: str = "auto",
                               donate: bool = False,
-                              telemetry: bool = False):
+                              telemetry: bool = False,
+                              overlay_static=None):
     """compile_push_chunk with the dense rounds' gather routed
     (interpret mode resolved here, off-chip = CPU tests)."""
     from lux_tpu.engine.pull import _route_interpret
@@ -396,7 +444,7 @@ def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
     return _compile_push_chunk_cached(
         prog, pspec, spec, methods.resolve(method, prog.reduce),
         route_static=route_static, interpret=_route_interpret(),
-        donate=donate, telemetry=telemetry,
+        donate=donate, telemetry=telemetry, ostatic=overlay_static,
     )
 
 
@@ -404,20 +452,22 @@ def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
 def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
                                method: str, route_static=None,
                                interpret=False, donate=False,
-                               telemetry=False):
+                               telemetry=False, ostatic=None):
     if telemetry:
         return _compile_push_chunk_telemetry(
-            prog, pspec, spec, method, route_static, interpret, donate)
+            prog, pspec, spec, method, route_static, interpret, donate,
+            ostatic)
 
     @partial(jax.jit, donate_argnums=(2,) if donate else ())
-    def loop(arrays, parrays, carry: PushCarry, it_stop, route_arrays=None):
+    def loop(arrays, parrays, carry: PushCarry, it_stop, route_arrays=None,
+             oarrays=None):
         def cond(c):
             return (c.active > 0) & (c.it < it_stop)
 
         def body(c):
             return _push_iteration(prog, pspec, spec, method, arrays,
                                    parrays, c, route_static, route_arrays,
-                                   interpret)
+                                   interpret, ostatic, oarrays)
 
         return jax.lax.while_loop(cond, body, carry)
 
@@ -426,7 +476,7 @@ def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
 
 def _compile_push_chunk_telemetry(prog, pspec: PushSpec, spec: ShardSpec,
                                   method: str, route_static, interpret,
-                                  donate):
+                                  donate, ostatic=None):
     """The flight-recorder twin of the push chunk loop (see
     compile_push_chunk).  A separate compile, cached under the same
     lru key family: the ring rides the while carry, every recorded
@@ -438,7 +488,7 @@ def _compile_push_chunk_telemetry(prog, pspec: PushSpec, spec: ShardSpec,
 
     @partial(jax.jit, donate_argnums=(2, 4) if donate else ())
     def loop(arrays, parrays, carry: PushCarry, it_stop, ring,
-             route_arrays=None):
+             route_arrays=None, oarrays=None):
         def cond(cr):
             c, _ = cr
             return (c.active > 0) & (c.it < it_stop)
@@ -447,7 +497,7 @@ def _compile_push_chunk_telemetry(prog, pspec: PushSpec, spec: ShardSpec,
             c, rg = cr
             c2 = _push_iteration(prog, pspec, spec, method, arrays,
                                  parrays, c, route_static, route_arrays,
-                                 interpret)
+                                 interpret, ostatic, oarrays)
             # uint32 wrap-around subtraction gives the exact per-round
             # traversed count (< 2^32 per round by construction)
             rg = obs_ring.ring_push(

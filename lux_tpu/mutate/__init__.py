@@ -1,0 +1,47 @@
+"""lux_tpu.mutate — dynamic-graph mutation as a first-class workload.
+
+The frozen-`.lux` engines gain live edge churn without retrace:
+
+  deltalog  — batched insert/delete resolved against the base CSC,
+              with a crash-safe npz+json journal (no pickle);
+  overlay   — statically-shaped per-part device buffers (tombstone
+              mask + fixed-capacity insert slots, ``LUX_DELTA_CAP``)
+              the overlay-aware hot loops consume — empty/half/full
+              buffers trace identically (luxaudit LUX-J1);
+  graph     — MutableGraph: base + log + layouts + auto-compaction;
+  refresh   — warm-restart PageRank/CC/SSSP from prior converged
+              state, seeding only delta-touched vertices;
+  compact   — merge the log into a new snapshot, invalidate only the
+              plan-cache buckets whose index arrays changed
+              (PLAN_FORMAT 5), publish to a live fleet.
+
+``refresh``/``compact`` import the engines, and the engines lazily
+import ``overlay`` — so this package eagerly exposes only the
+engine-free half and resolves the rest on first attribute access.
+"""
+from __future__ import annotations
+
+from lux_tpu.mutate.deltalog import (  # noqa: F401
+    DeltaLog,
+    DeltaOverflow,
+    OP_DELETE,
+    OP_INSERT,
+)
+from lux_tpu.mutate.overlay import (  # noqa: F401 — before graph: it
+    OverlayArrays,                    # imports overlay through the
+    OverlayStatic,                    # package, mid-initialization
+    build_pull_overlay,
+    build_push_overlay,
+    delta_cap,
+)
+from lux_tpu.mutate.graph import MutableGraph  # noqa: F401
+
+_LAZY = ("refresh", "compact")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return importlib.import_module(f"lux_tpu.mutate.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,144 @@
+"""Compaction: merge the delta-log into a new `.lux` base snapshot.
+
+Protocol (crash-safe, each step durable before the next):
+
+  1. materialize the merged graph (deltalog.merged_graph — the ONE
+     deterministic definition the property tests pin);
+  2. write it as a `.lux` snapshot via a tmp + fsync + rename (a crash
+     mid-write leaves the old snapshot intact);
+  3. rotate the journal (deltalog.journal_reset — batches now live in
+     the snapshot; a crash between 2 and 3 replays them against the
+     OLD base: stale but consistent, never half-applied);
+  4. rebuild the shard layouts REUSING the old vertex cuts, so the
+     per-bucket plan cache (ops/expand PLAN_FORMAT 5: one npz entry per
+     part keyed on that part's OWN index arrays) invalidates ONLY the
+     buckets whose arrays actually changed — ``invalidation_report``
+     computes exactly which, from the same key derivation the cache
+     uses (never a parallel reimplementation);
+  5. optionally publish the snapshot to a live serving fleet through
+     PR 8's token-guarded prepare/commit republish
+     (``publish_to_fleet``) — zero-downtime, bitwise-equal answers.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from lux_tpu.graph.format import write_lux
+
+
+def snapshot_write(path: str, g) -> None:
+    """Durable `.lux` write: tmp + fsync + atomic rename (write_lux
+    itself streams straight to the target, which a crash would tear)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    write_lux(tmp, g)
+    with open(tmp, "rb+") as f:
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def plan_bucket_paths(shards, cache_dir: Optional[str] = None):
+    """The expand plan family's per-bucket cache PATHS for a shard
+    bundle — derived by the cache's own key functions
+    (ops/expand._expand_key_one/_entry_path), so this report can never
+    drift from what the cache actually keys on.  None when the cache
+    dir is untrusted (the cache itself degrades the same way)."""
+    from lux_tpu.ops import expand
+
+    cache_dir = cache_dir or expand._default_cache_dir()
+    if not expand._cache_dir_trusted(cache_dir):
+        return None
+    key_one = expand._expand_key_one(shards)
+    return [expand._entry_path(cache_dir, "expand", key_one, i)
+            for i in range(shards.arrays.src_pos.shape[0])]
+
+
+def invalidation_report(old_shards, new_shards,
+                        cache_dir: Optional[str] = None) -> dict:
+    """Which plan-cache buckets a compaction invalidates: a bucket
+    survives iff its content-derived cache path is UNCHANGED (same
+    index arrays -> same sha -> same npz entry replays).  Returns
+    {parts, changed, fraction, changed_parts}."""
+    P = old_shards.arrays.src_pos.shape[0]
+    old_p = plan_bucket_paths(old_shards, cache_dir)
+    new_p = plan_bucket_paths(new_shards, cache_dir)
+    if old_p is None or new_p is None or \
+            new_shards.arrays.src_pos.shape[0] != P:
+        # untrusted cache dir or a recut that changed the part count:
+        # everything rebuilds
+        changed = list(range(new_shards.arrays.src_pos.shape[0]))
+    else:
+        changed = [i for i in range(P) if old_p[i] != new_p[i]]
+    total = new_shards.arrays.src_pos.shape[0]
+    return {
+        "parts": total,
+        "changed": len(changed),
+        "fraction": round(len(changed) / total, 4) if total else 0.0,
+        "changed_parts": changed,
+    }
+
+
+def compact_mutable(mg, path: Optional[str] = None,
+                    reuse_cuts: bool = True) -> dict:
+    """Compact a MutableGraph in place (step list in the module
+    docstring).  Returns a report: snapshot path (or None), merged
+    sizes, and the per-layout bucket-invalidation summary."""
+    from lux_tpu import obs
+    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.graph.shards import build_pull_shards
+
+    with obs.span("mutate.compact", inserts=int(mg.log.ins_live.sum()),
+                  deletes=int(mg.log.del_base.sum())) as sp:
+        if mg.log.journal_dir is not None and path is None:
+            raise ValueError(
+                "a journaled MutableGraph needs a snapshot path to "
+                "compact: rotating the journal without persisting the "
+                "merged base would drop durable mutations (set "
+                "MutableGraph(snapshot=...) or pass compact(path=...))")
+        merged = mg.log.merged_graph()
+        if path is not None:
+            snapshot_write(path, merged)
+        mg.log.journal_reset()
+
+        old_pull = mg._pull
+        cuts = (np.asarray(old_pull.cuts) if (reuse_cuts
+                                              and old_pull is not None)
+                else None)
+        report = {"path": path, "nv": int(merged.nv),
+                  "ne": int(merged.ne)}
+        new_pull = new_push = None
+        if mg._push is not None:
+            new_push = build_push_shards(merged, mg.num_parts, cuts=cuts)
+            new_pull = new_push.pull
+        elif old_pull is not None:
+            new_pull = build_pull_shards(merged, mg.num_parts, cuts=cuts)
+        if old_pull is not None and new_pull is not None:
+            report["invalidation"] = invalidation_report(old_pull,
+                                                         new_pull)
+        # swap the base LAST so a build failure leaves mg consistent
+        mg.base = merged
+        mg.log = type(mg.log)(merged, journal_dir=mg.log.journal_dir)
+        mg._pull = new_pull
+        mg._push = new_push
+        mg._csr = None
+        mg._csr_perms = None
+        sp.set(ne=report["ne"],
+               invalidated=report.get("invalidation", {}).get("changed"))
+    return report
+
+
+def publish_to_fleet(controller, path: str,
+                     graph_id: Optional[str] = None) -> dict:
+    """Publish a compacted snapshot to a live fleet through the
+    controller's token-guarded two-phase republish (serve/fleet:
+    prepare a second engine cache while the old graph serves, then an
+    atomic commit — zero shed, bitwise-equal answers; a failed prepare
+    anywhere aborts with the old graph still serving)."""
+    from lux_tpu import obs
+
+    gid = graph_id if graph_id is not None else os.path.basename(path)
+    with obs.span("mutate.publish", graph=gid):
+        return controller.republish(path, graph_id=gid)

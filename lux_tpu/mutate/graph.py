@@ -1,0 +1,181 @@
+"""MutableGraph: one live graph = base snapshot + delta-log + layouts.
+
+The driver-facing bundle tying the mutation subsystem together: it owns
+the base HostGraph, the DeltaLog (optionally journaled), the lazily
+built pull/push shard layouts of the BASE (which the overlay-aware hot
+loops keep consuming unchanged across churn), the cached push CSR
+permutations (so tombstone patching is O(deleted) per refresh, not a
+re-sort), and the compaction trigger: a batch that overflows any
+part's delta capacity compacts FIRST (merging the log into a new base,
+reusing the old cuts so untouched plan-cache buckets survive —
+PLAN_FORMAT 5 keys per bucket), then applies.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.mutate import overlay as ovl
+from lux_tpu.mutate.deltalog import DeltaLog
+
+
+class MutableGraph:
+    """A mutating graph the engines can serve without retrace.
+
+    ``num_parts`` fixes the shard layout; ``cap`` (default
+    ``LUX_DELTA_CAP``) the per-part delta capacity; ``journal_dir``
+    makes mutations durable (crash-replay on reopen).  ``snapshot``
+    names where compaction writes merged ``.lux`` snapshots (falls
+    back to in-memory-only compaction when None)."""
+
+    def __init__(self, g: HostGraph, num_parts: int = 1,
+                 cap: Optional[int] = None,
+                 journal_dir: Optional[str] = None,
+                 snapshot: Optional[str] = None):
+        self.base = g
+        self.num_parts = num_parts
+        self.cap = ovl.delta_cap(cap)
+        self.snapshot = snapshot
+        self.log = DeltaLog(g, journal_dir=journal_dir)
+        self.compactions = 0
+        self._pull = None
+        self._push = None
+        self._csr = None          # base out-edge view (refresh cascades)
+        self._csr_perms = None    # push CSC->CSR slot maps
+        self._version = 0         # bumps on every applied batch/compact
+
+    # ------------------------------------------------------------------
+    # layouts (base graph, default fill order — the overlay contract)
+    # ------------------------------------------------------------------
+
+    @property
+    def pull_shards(self):
+        if self._pull is None:
+            from lux_tpu.graph.shards import build_pull_shards
+
+            self._pull = build_pull_shards(self.base, self.num_parts)
+        return self._pull
+
+    @property
+    def push_shards(self):
+        if self._push is None:
+            from lux_tpu.graph.push_shards import build_push_shards
+
+            self._push = build_push_shards(self.base, self.num_parts)
+            # share the pull layout (one O(E) build, one overlay target)
+            self._pull = self._push.pull
+        return self._push
+
+    def base_csr(self):
+        """(csr_row_ptr, csr_dst, csr_perm) of the BASE graph, cached —
+        the refresh deletion cascades walk out-edges through this."""
+        if self._csr is None:
+            self._csr = self.base.to_csr()
+        return self._csr
+
+    def csr_perms(self):
+        if self._csr_perms is None:
+            self._csr_perms = ovl.push_csr_perms(self.push_shards,
+                                                 self.base)
+        return self._csr_perms
+
+    # ------------------------------------------------------------------
+    # mutation
+    # ------------------------------------------------------------------
+
+    def apply(self, src, dst, op, weight=None) -> dict:
+        """Apply one mutation batch; when it would overflow any part's
+        delta capacity, compact FIRST (fold the standing log into the
+        base — the prior converged app states equal that merged graph,
+        so warm refresh stays sound) and THEN apply, keeping the new
+        batch in the log.  Never reshapes a device buffer — the
+        overlay cap is invariant, the BASE absorbs the log.  A batch
+        that ALONE exceeds the capacity raises DeltaOverflow (folding
+        it too would silently invalidate every caller-held prior
+        state): split it, raise LUX_DELTA_CAP, or compact() and
+        cold-recompute.  Returns the log stats, with ``compacted`` set
+        when a compaction ran."""
+        from lux_tpu import obs
+        from lux_tpu.mutate.deltalog import DeltaOverflow
+
+        with obs.span("mutate.apply", rows=int(np.size(src))) as sp:
+            compacted = False
+            if not self.log.empty and self._would_overflow(dst, op):
+                self.compact()
+                compacted = True
+            self.log.apply(src, dst, op, weight)
+            self._version += 1
+            if self._overflowed():
+                raise DeltaOverflow(
+                    "one batch exceeds the per-part delta capacity "
+                    f"{self.cap} (LUX_DELTA_CAP) on its own — split the "
+                    "batch, raise the capacity, or compact() and "
+                    "cold-recompute the app states")
+            sp.set(compacted=compacted)
+        return {**self.log.stats(), "compacted": compacted}
+
+    def _would_overflow(self, dst, op) -> bool:
+        """Conservative pre-check: standing per-part occupancy plus the
+        batch's inserts (in-batch insert/delete pairs are not netted —
+        compacting a little early is harmless, late is a hard error)."""
+        from lux_tpu.graph.partition import part_of_vertex
+        from lux_tpu.mutate.deltalog import OP_INSERT
+
+        occ = np.asarray(
+            ovl.occupancy(self.pull_shards, self.log,
+                          self.cap)["per_part"], np.int64)
+        dstb = np.atleast_1d(np.asarray(dst, np.int64))
+        opb = np.atleast_1d(np.asarray(op, np.int64))
+        ins = dstb[opb == OP_INSERT]
+        if len(ins):
+            occ = occ + np.bincount(
+                part_of_vertex(np.asarray(self.pull_shards.cuts), ins),
+                minlength=len(occ))
+        return bool(occ.max() > self.cap)
+
+    def _overflowed(self) -> bool:
+        occ = ovl.occupancy(self.pull_shards, self.log, self.cap)
+        return occ["max"] > self.cap
+
+    # ------------------------------------------------------------------
+    # overlays
+    # ------------------------------------------------------------------
+
+    def pull_overlay(self):
+        """(OverlayStatic, OverlayArrays) for the pull engine."""
+        return ovl.build_pull_overlay(self.pull_shards, self.log,
+                                      self.cap)
+
+    def push_overlay(self):
+        """(OverlayStatic, OverlayArrays, patched PushArrays)."""
+        return ovl.build_push_overlay(self.push_shards, self.log,
+                                      self.cap,
+                                      csr_perms=self.csr_perms())
+
+    def occupancy(self) -> dict:
+        return ovl.occupancy(self.pull_shards, self.log, self.cap)
+
+    # ------------------------------------------------------------------
+    # compaction
+    # ------------------------------------------------------------------
+
+    def compact(self, path: Optional[str] = None,
+                reuse_cuts: bool = True) -> dict:
+        """Merge the delta-log into a new base (see mutate.compact for
+        the snapshot/journal/invalidation protocol); rebuilt layouts
+        keep the old cuts by default so only the plan-cache buckets
+        whose index arrays changed are invalidated."""
+        from lux_tpu.mutate import compact as compact_mod
+
+        report = compact_mod.compact_mutable(
+            self, path=path if path is not None else self.snapshot,
+            reuse_cuts=reuse_cuts)
+        self.compactions += 1
+        self._version += 1
+        return report
+
+    @property
+    def version(self) -> int:
+        return self._version

@@ -1,0 +1,285 @@
+"""Statically-shaped mutation overlays for the hot loops.
+
+The engines must consume a mutating graph WITHOUT retracing: every
+overlay structure here is a fixed-shape jit ARGUMENT (never a static),
+so empty / half-full / full delta buffers produce byte-identical traces
+(luxaudit LUX-J1 pins this).  Two pieces:
+
+  * ``del_val`` — a (P, E) bool tombstone mask over the base CSC edge
+    slots.  The engines neutralize tombstoned VALUES (reduce identity:
+    +0.0 for sum — exact no-op in IEEE for the non-negative rank
+    states; dtype max/min for integer min/max — exactly absorbed), so
+    the base segmented reduce runs unchanged over unchanged arrays.
+  * ``d_src_pos / d_dst_local / d_weight`` — (P, D) fixed-capacity
+    insert buffers (D = ``LUX_DELTA_CAP`` rounded to the TPU lane
+    width; overflow raises DeltaOverflow and triggers compaction,
+    never a reshape).  Empty/tombstoned slots carry the ``nv_pad``
+    destination sentinel, so the device-side scatter (mode="drop")
+    subsumes the validity mask — the same sentinel idiom as
+    ``ShardArrays.dst_local`` padding and the push engine's CSR pads.
+
+Exactness contract: for min/max/integer reduces the overlay step is
+BITWISE equal to a cold-rebuilt step on the merged graph (the combiner
+is exactly associative/commutative and the neutral absorbs exactly).
+For float32 sums the delta pass is a separate deterministic
+association (base-segment sum + scatter-add), so per-iteration equality
+is exact-arithmetic only — converged fixpoints are compared instead
+(docs/DYNAMIC.md "shape contract"; tests/test_mutate.py pins both).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from lux_tpu.graph.partition import part_of_vertex
+from lux_tpu.mutate.deltalog import DeltaLog, DeltaOverflow
+from lux_tpu.utils.config import env_int
+
+LANE = 128
+
+#: default per-part insert capacity (slots) when LUX_DELTA_CAP is unset
+DEFAULT_CAP = 1024
+
+
+def delta_cap(cap: Optional[int] = None) -> int:
+    """Resolve the per-part delta-buffer capacity: explicit argument,
+    else ``LUX_DELTA_CAP``, else DEFAULT_CAP — always rounded UP to the
+    lane width so the buffers tile like every other device array.  The
+    capacity is part of the overlay's STATIC shape: changing it (not
+    filling it) is what recompiles."""
+    if cap is None:
+        cap = env_int("LUX_DELTA_CAP", DEFAULT_CAP, minimum=1)
+    return -(-cap // LANE) * LANE
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlayStatic:
+    """Hashable overlay descriptor (safe as a jit static): only the
+    SHAPE-defining facts live here — occupancy is data."""
+
+    cap: int
+    weighted: bool
+
+
+class OverlayArrays(NamedTuple):
+    """Stacked per-part overlay arrays (leading axis = part); a pytree.
+
+    Shapes (P parts, E = e_pad base edge slots, D = cap):
+      del_val:     (P, E) bool  True where the base edge is tombstoned.
+      d_src_pos:   (P, D) int32 insert source position in the (P*V,)
+                   gathered state (same encoding as ShardArrays.src_pos);
+                   empty slots hold 0 (their scatter is dropped anyway).
+      d_dst_local: (P, D) int32 local destination, or the nv_pad
+                   SENTINEL on empty slots (scatter mode="drop").
+      d_weight:    (P, D) float32 insert weights (zeros when unweighted).
+    """
+
+    del_val: np.ndarray
+    d_src_pos: np.ndarray
+    d_dst_local: np.ndarray
+    d_weight: np.ndarray
+
+
+def _neutral(reduce: str, dtype):
+    """Reduce identity matching ops/segment.py's empty-row convention
+    (and ops/expand._neutral_like)."""
+    import jax.numpy as jnp
+
+    if reduce == "sum":
+        return jnp.asarray(0, dtype)
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.max if reduce == "min" else info.min, dtype)
+    return jnp.asarray(jnp.inf if reduce == "min" else -jnp.inf, dtype)
+
+
+# ---------------------------------------------------------------------------
+# device-side replay (called from inside the engines' jitted bodies)
+# ---------------------------------------------------------------------------
+
+
+def mask_deleted(vals, del_val, reduce: str):
+    """Neutralize tombstoned base-edge VALUES before the segmented
+    reduce — for sum the +0.0 is an exact IEEE no-op on the remaining
+    addends, for min/max the dtype extreme is exactly absorbed, so the
+    base reduce's association (and for min/max its bits) is that of the
+    merged graph."""
+    import jax.numpy as jnp
+
+    return jnp.where(del_val, _neutral(reduce, vals.dtype), vals)
+
+
+def delta_scatter(acc, full_state, oarr, value_fn, reduce: str):
+    """Fold the part's insert buffer into a per-destination accumulator
+    ``acc`` (shape (V,) — dst sentinel nv_pad lands out of bounds and
+    drops): gather the D source states from the gathered full state,
+    apply ``value_fn(src_state, weight)`` (the program's edge_value /
+    relax), scatter-combine by local destination.  O(D) on top of the
+    O(E) base pass; D is static, so occupancy never retraces."""
+    import jax.numpy as jnp
+
+    src = full_state[jnp.clip(oarr.d_src_pos, 0, full_state.shape[0] - 1)]
+    vals = value_fn(src, oarr.d_weight)
+    if reduce == "sum":
+        return acc.at[oarr.d_dst_local].add(vals, mode="drop")
+    if reduce == "min":
+        return acc.at[oarr.d_dst_local].min(vals, mode="drop")
+    return acc.at[oarr.d_dst_local].max(vals, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# host-side builders
+# ---------------------------------------------------------------------------
+
+
+def _csc_slot_of_base_edge(shards, edge_idx: np.ndarray, base_row_ptr):
+    """Map base CSC edge indices -> (part, slot) under the shards' cuts.
+    Requires the default fill_part layout (no sort_segments): slot =
+    edge index rebased to the part's edge range."""
+    cuts = np.asarray(shards.cuts, np.int64)
+    dst = (np.searchsorted(base_row_ptr, edge_idx, side="right") - 1)
+    part = part_of_vertex(cuts, dst).astype(np.int64)
+    elo = np.asarray(base_row_ptr, np.int64)[cuts[part]]
+    return part, (edge_idx - elo)
+
+
+def build_pull_overlay(shards, dlog: DeltaLog, cap: Optional[int] = None):
+    """(OverlayStatic, OverlayArrays) for a PullShards bundle built from
+    ``dlog.base`` with the DEFAULT layout (sort_segments / compact
+    mirrors reorder edge slots and are rejected — the tombstone mask
+    addresses slots by base CSC position).
+
+    Raises DeltaOverflow when any part's live inserts exceed the
+    capacity — the caller compacts (MutableGraph does automatically)."""
+    arrays = shards.arrays
+    if arrays.mirror_pos.shape[-1] > 0:
+        raise ValueError("mutation overlays require the default pull "
+                         "layout (compact_gather reorders the gather; "
+                         "rebuild shards without it)")
+    P = arrays.src_pos.shape[0]
+    e_pad = arrays.src_pos.shape[1]
+    nv_pad = arrays.vtx_mask.shape[1]
+    cuts = np.asarray(shards.cuts, np.int64)
+    D = delta_cap(cap)
+    static = OverlayStatic(cap=D, weighted=shards.spec.weighted)
+
+    del_val = np.zeros((P, e_pad), bool)
+    dele = dlog.deleted_edges()
+    if len(dele):
+        part, slot = _csc_slot_of_base_edge(shards, dele,
+                                            dlog.base.row_ptr)
+        # the mask addresses base slots by position — verify the layout
+        # assumption on the (small) deleted set instead of trusting it
+        own = part_of_vertex(cuts, np.asarray(dlog.base.col_idx,
+                                              np.int64)[dele]).astype(np.int64)
+        want = (own * nv_pad
+                + (np.asarray(dlog.base.col_idx, np.int64)[dele]
+                   - cuts[own])).astype(np.int64)
+        got = np.asarray(arrays.src_pos, np.int64)[part, slot]
+        if not np.array_equal(got, want):
+            raise ValueError(
+                "shards edge layout does not match the base CSC order "
+                "(sort_segments layout?) — mutation overlays need the "
+                "default fill order")
+        del_val[part, slot] = True
+
+    d_src_pos = np.zeros((P, D), np.int32)
+    d_dst_local = np.full((P, D), nv_pad, np.int32)
+    d_weight = np.zeros((P, D), np.float32)
+    isrc, idst, iw = dlog.live_inserts()
+    if len(isrc):
+        p_of = part_of_vertex(cuts, idst).astype(np.int64)
+        counts = np.bincount(p_of, minlength=P)
+        if counts.max() > D:
+            raise DeltaOverflow(
+                f"part {int(counts.argmax())} holds {int(counts.max())} "
+                f"live inserts > capacity {D} (LUX_DELTA_CAP) — compact")
+        own = part_of_vertex(cuts, isrc).astype(np.int64)
+        spos = (own * nv_pad + (isrc - cuts[own])).astype(np.int32)
+        # append order within each part: stable sort by part keeps it
+        order = np.argsort(p_of, kind="stable")
+        slot = np.arange(len(isrc), dtype=np.int64)
+        starts = np.zeros(P + 1, np.int64)
+        np.cumsum(counts, out=starts[1:])
+        slot = slot - starts[p_of[order]]
+        rows = p_of[order]
+        d_src_pos[rows, slot] = spos[order]
+        d_dst_local[rows, slot] = (idst[order]
+                                   - cuts[rows]).astype(np.int32)
+        d_weight[rows, slot] = iw[order].astype(np.float32)
+    return static, OverlayArrays(del_val, d_src_pos, d_dst_local,
+                                 d_weight)
+
+
+def occupancy(shards, dlog: DeltaLog, cap: Optional[int] = None) -> dict:
+    """Host-side buffer accounting: per-part live-insert counts against
+    the capacity (the bench rows' ``delta_occupancy``)."""
+    P = shards.arrays.src_pos.shape[0]
+    _, idst, _ = dlog.live_inserts()
+    counts = np.bincount(
+        part_of_vertex(np.asarray(shards.cuts, np.int64), idst),
+        minlength=P)
+    D = delta_cap(cap)
+    return {"cap": D, "max": int(counts.max()) if len(counts) else 0,
+            "per_part": counts.astype(int).tolist(),
+            "frac": round(float(counts.max()) / D, 4) if len(counts)
+            else 0.0, "deletes": int(dlog.del_base.sum())}
+
+
+def push_csr_perms(pshards, base) -> list:
+    """Per-part CSC-slot -> CSR-slot maps of the push layout (the
+    stable source sort build_push_shards performs).  O(E log E) once
+    per snapshot — MutableGraph caches these so per-refresh tombstone
+    patching is O(deleted), not a re-sort."""
+    cuts = np.asarray(pshards.cuts, np.int64)
+    rp = np.asarray(base.row_ptr, np.int64)
+    perms = []
+    for p in range(pshards.spec.num_parts):
+        elo, ehi = int(rp[cuts[p]]), int(rp[cuts[p + 1]])
+        srcs = np.asarray(base.col_idx[elo:ehi], np.int64)
+        order = np.argsort(srcs, kind="stable")
+        inv = np.empty(len(srcs), np.int64)
+        inv[order] = np.arange(len(srcs), dtype=np.int64)
+        perms.append(inv)
+    return perms
+
+
+def build_push_overlay(pshards, dlog: DeltaLog,
+                       cap: Optional[int] = None, csr_perms=None):
+    """(OverlayStatic, OverlayArrays, patched PushArrays) for a
+    PushShards bundle: the overlay arrays drive the DENSE rounds (the
+    embedded pull layout) and the insert scatter; the patched CSR
+    arrays retire deleted edges from the SPARSE walk by pointing their
+    destinations at the nv_pad sentinel — the walk's existing
+    drop-scatter handles the rest, no kernel change."""
+    from lux_tpu.graph.push_shards import PushArrays
+
+    static, oarr = build_pull_overlay(pshards.pull, dlog, cap)
+    parr = pshards.parrays
+    dele = dlog.deleted_edges()
+    if not len(dele):
+        return static, oarr, parr
+    if csr_perms is None:
+        csr_perms = push_csr_perms(pshards, dlog.base)
+    nv_pad = pshards.pull.arrays.vtx_mask.shape[1]
+    part, slot = _csc_slot_of_base_edge(pshards.pull, dele,
+                                        dlog.base.row_ptr)
+    csr_dst = np.array(parr.csr_dst_local, copy=True)
+    for p in np.unique(part):
+        sl = slot[part == p]
+        csr_dst[p, csr_perms[int(p)][sl]] = nv_pad
+    return static, oarr, PushArrays(parr.uniq_src, parr.csr_row_ptr,
+                                    csr_dst, parr.csr_weight)
+
+
+def merged_degree_stacked(shards, dlog: DeltaLog) -> np.ndarray:
+    """The merged graph's out-degrees in the shards' (P, V) stacked
+    layout (padding slots 0) — pagerank's apply divides by these, and
+    they are an ordinary jit argument, so the patch never retraces."""
+    from lux_tpu.graph.shards import global_to_stacked
+
+    deg = dlog.merged_out_degrees()
+    return global_to_stacked(np.asarray(shards.cuts),
+                             shards.arrays.degree.shape[1], deg)

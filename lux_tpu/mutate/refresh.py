@@ -1,0 +1,352 @@
+"""Incremental refresh: warm-restart the apps after edge churn.
+
+Cold recompute pays load + shard build + plan + compile + full
+iteration; refresh pays an O(delta) host analysis plus a warm re-entry
+of the ALREADY-COMPILED overlay hot loop from the prior converged
+state — the ROADMAP's ">=10x over cold recompute at 1% churn" bar
+(measured table in docs/DYNAMIC.md).
+
+Per-app exactness contracts (pinned by tests/test_mutate.py):
+
+  * SSSP (min, int32) / CC (max, int32): the merged graph's fixpoint is
+    UNIQUE, so any sound refresh converges to the cold rebuild's exact
+    bits.  Soundness under deletion needs an invalidation pass — a
+    monotone engine cannot un-relax:
+      - SSSP: dirty = destinations of deleted TIGHT edges
+        (dist[v] == dist[u] + w), closed over tight out-edges (the
+        classic decremental cascade, over-approximation is safe);
+        dirty resets to INF, the frontier seeds with every LIVE
+        in-neighbor of the dirty set (they never change, so they must
+        push first) plus insert endpoints.  Needs strictly positive
+        weights (a zero-weight tight cycle breaks the cascade's
+        induction) — BFS hops are 1, weighted graphs are validated.
+      - CC: dirty = every vertex whose label belongs to a component
+        touched by a deletion (labels of deleted-edge endpoints);
+        dirty resets to own-id and seeds ACTIVE (the cold contract,
+        restricted to the dirty region) plus the region's live
+        in-neighbors.
+  * PageRank (f32 sum): warm state = prior ranks rescaled for changed
+    out-degrees; converge to an EXACT f32 fixpoint (residual == 0) of
+    the overlay map.  Sum associations differ between the overlay
+    decomposition and a cold-rebuilt layout, so per-iteration equality
+    is exact-arithmetic only; the CONVERGED fixpoints are compared
+    (bitwise in practice under the alpha=0.15 contraction — the bench
+    rows and tests check, never assume).
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from lux_tpu.graph.shards import global_to_stacked
+
+
+def _stack(shards, vec, fill=0):
+    """Global (nv,) -> the shards' (P, nv_pad) stacked layout with
+    ``fill`` on padding slots (global_to_stacked zero-fills; the push
+    apps keep INF there, matching init_state)."""
+    out = global_to_stacked(np.asarray(shards.cuts),
+                            shards.arrays.vtx_mask.shape[1], vec)
+    if fill:
+        out = np.where(np.asarray(shards.arrays.vtx_mask), out, fill)
+    return out.astype(vec.dtype)
+
+
+# ---------------------------------------------------------------------------
+# deletion-invalidation analysis (host, O(affected))
+# ---------------------------------------------------------------------------
+
+
+def _dead_edges(mg, weighted: bool):
+    """(src, dst, w) of EVERY edge removed by the log: base tombstones
+    plus dead inserts.  Dead inserts matter when refreshes interleave
+    with batches without compaction — the prior state may have depended
+    on an insert a later batch deleted; over-including inserts that
+    were never live during the prior convergence is safe (the dirty
+    analysis only over-approximates)."""
+    g = mg.base
+    dele = mg.log.deleted_edges()
+    dst_of = np.searchsorted(np.asarray(g.row_ptr, np.int64), dele,
+                             side="right") - 1
+    src_of = np.asarray(g.col_idx, np.int64)[dele]
+    w_of = (np.asarray(g.weights, np.int64)[dele]
+            if weighted else np.ones(len(dele), np.int64))
+    dead = ~mg.log.ins_live
+    dsrc = mg.log.ins_src[dead]
+    ddst = mg.log.ins_dst[dead]
+    dw = (mg.log.ins_w[dead] if weighted
+          else np.ones(int(dead.sum()), np.int64))
+    return (np.concatenate([src_of, dsrc]),
+            np.concatenate([dst_of, ddst]),
+            np.concatenate([w_of, dw]))
+
+
+def sssp_dirty(mg, dist: np.ndarray, start: int,
+               weighted: bool = False) -> np.ndarray:
+    """(nv,) bool: vertices whose distance a deletion may invalidate.
+    Over-approximating closure over TIGHT out-edges (live base edges
+    AND live inserts) of the old distance field — a non-dirty vertex
+    keeps a shortest path avoiding every removed edge, so its old
+    distance stays exact (the boundary the warm relaxation restarts
+    from)."""
+    g = mg.base
+    dirty = np.zeros(g.nv, bool)
+    rs, rd, rw = _dead_edges(mg, weighted)
+    if not len(rs):
+        return dirty
+    if weighted:
+        wall = np.asarray(g.weights, np.int64)
+        _, _, liw = mg.log.live_inserts()
+        if ((len(wall) and wall.min() <= 0)
+                or (len(rw) and rw.min() <= 0)
+                or (len(liw) and liw.min() <= 0)):
+            raise ValueError(
+                "sssp refresh under deletion needs strictly positive "
+                "weights (zero-weight tight cycles break the "
+                "invalidation cascade) — compact instead")
+    dist = np.asarray(dist, np.int64)
+    tight = dist[rd] == dist[rs] + rw
+    seeds = np.unique(rd[tight])
+    seeds = seeds[seeds != start]  # the source's 0 never depends on edges
+    if not len(seeds):
+        return dirty
+    csr_row_ptr, csr_dst, csr_perm = mg.base_csr()
+    w_of = (np.asarray(g.weights, np.int64) if weighted
+            else np.ones(g.ne, np.int64))
+    csr_w = w_of[csr_perm]
+    csr_live = (~mg.log.del_base)[csr_perm]
+    # live-insert out-adjacency for the cascade (src -> [(dst, w)])
+    ins_adj: dict = {}
+    isrc, idst, iw = mg.log.live_inserts()
+    for j in range(len(isrc)):
+        ins_adj.setdefault(int(isrc[j]), []).append(
+            (int(idst[j]), int(iw[j]) if weighted else 1))
+    dirty[seeds] = True
+    dq = deque(int(v) for v in seeds)
+    while dq:
+        v = dq.popleft()
+        lo, hi = int(csr_row_ptr[v]), int(csr_row_ptr[v + 1])
+        nbrs = [(int(csr_dst[k]), int(csr_w[k]))
+                for k in range(lo, hi) if csr_live[k]]
+        nbrs += ins_adj.get(v, [])
+        for t, w in nbrs:
+            # removed edges were handled by the seed rule
+            if (not dirty[t] and t != start
+                    and dist[t] == dist[v] + w):
+                dirty[t] = True
+                dq.append(t)
+    return dirty
+
+
+def cc_dirty(mg, labels: np.ndarray) -> np.ndarray:
+    """(nv,) bool: every vertex whose converged label belongs to a
+    label-component containing a removed edge endpoint (base tombstones
+    AND dead inserts) — a deletion may split the component, so the
+    whole region recomputes from own-ids (max-label cannot decrease
+    incrementally)."""
+    g = mg.base
+    rs, rd, _ = _dead_edges(mg, weighted=False)
+    if not len(rs):
+        return np.zeros(g.nv, bool)
+    labels = np.asarray(labels, np.int64)
+    bad = np.unique(np.concatenate([labels[rs], labels[rd]]))
+    return np.isin(labels, bad)
+
+
+def _live_in_neighbors(mg, region: np.ndarray) -> np.ndarray:
+    """(nv,) bool: sources of LIVE base in-edges into ``region`` plus
+    live insert sources targeting it — the boundary that must seed the
+    warm frontier (its members never change, so only the initial queue
+    can make them push)."""
+    g = mg.base
+    seeds = np.zeros(g.nv, bool)
+    if region.any():
+        dst_of = g.dst_of_edges()
+        m = region[dst_of] & ~mg.log.del_base
+        seeds[np.asarray(g.col_idx, np.int64)[m]] = True
+    isrc, idst, _ = mg.log.live_inserts()
+    if len(isrc):
+        seeds[isrc[region[idst]]] = True
+    return seeds
+
+
+# ---------------------------------------------------------------------------
+# warm-restart drivers
+# ---------------------------------------------------------------------------
+
+
+def _warm_push_carry(prog, pshards, state_stacked, frontier_stacked,
+                     force_active: bool):
+    """A PushCarry seeded from a prior state + frontier mask (the warm
+    twin of push._init_carry).  ``force_active`` keeps the loop alive
+    for at least one round when the frontier is empty but delta edges
+    exist (the insert fold runs inside the round)."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from lux_tpu.engine import push
+
+    arrays = jax.tree.map(jnp.asarray, pshards.arrays)
+    state0 = jnp.asarray(state_stacked)
+    mask0 = jnp.asarray(frontier_stacked) & arrays.vtx_mask
+    q_vid, q_val, cnt = jax.vmap(partial(push.build_queue,
+                                         pshards.pspec))(
+        arrays, mask0, state0)
+    active = jnp.sum(cnt)
+    if force_active:
+        active = jnp.maximum(active, jnp.int32(1))
+    num_parts = arrays.global_vid.shape[0]
+    return arrays, push.PushCarry(
+        state0, q_vid, q_val, cnt, jnp.int32(0), active,
+        push._zero_edges(), jnp.zeros((num_parts,), jnp.uint32),
+        jnp.int32(0))
+
+
+def _run_push_overlay(prog, mg, state_g, frontier_g, method, max_iters,
+                      pad_fill):
+    """Shared warm push loop: overlay + patched CSR through the
+    ALREADY-COMPILED chunk loop (same lru family as cold runs of this
+    (prog, spec, ostatic) — re-entry is compile-free)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu import obs
+    from lux_tpu.engine import push
+
+    pshards = mg.push_shards
+    with obs.span("mutate.overlay", kind="push"):
+        ostatic, oarr, parr = mg.push_overlay()
+    state = _stack(pshards.pull, state_g, fill=pad_fill)
+    frontier = _stack(pshards.pull, frontier_g.astype(np.int32)) > 0
+    arrays, carry0 = _warm_push_carry(
+        prog, pshards, state, frontier, force_active=not mg.log.empty)
+    loop = push.compile_push_chunk(prog, pshards.pspec, pshards.spec,
+                                   method, overlay_static=ostatic)
+    with obs.span("mutate.refresh", app=prog.__class__.__name__,
+                  kind="push") as sp:
+        out = loop(arrays, jax.tree.map(jnp.asarray, parr), carry0,
+                   jnp.int32(max_iters),
+                   oarrays=jax.tree.map(jnp.asarray, oarr))
+        jax.block_until_ready(out.state)
+        sp.set(iters=int(out.it))
+    return out.state, int(out.it)
+
+
+def refresh_sssp(mg, prior_state_g: np.ndarray, start: int,
+                 method: str = "auto", weighted: bool = False,
+                 max_iters: int = 10_000):
+    """Warm SSSP refresh.  ``prior_state_g``: the (nv,) converged
+    distances on the PRE-churn graph.  Returns (dist (nv,), rounds) on
+    the merged graph — bitwise equal to a cold rebuild (unique int
+    fixpoint; pinned by tests)."""
+    from lux_tpu.models.sssp import SSSPProgram, WeightedSSSPProgram
+
+    cls = WeightedSSSPProgram if weighted else SSSPProgram
+    prog = cls(nv=mg.base.nv, start=start)
+    dist = np.asarray(prior_state_g).copy()
+    dirty = sssp_dirty(mg, dist, start, weighted)
+    seeds = _live_in_neighbors(mg, dirty)
+    # boundary members must hold a REACHED value to be worth pushing
+    seeds &= np.asarray(dist) < prog.inf
+    seeds &= ~dirty
+    isrc, _, _ = mg.log.live_inserts()
+    if len(isrc):
+        s = np.unique(isrc)
+        seeds[s[dist[s] < prog.inf]] = True
+    dist[dirty] = prog.inf
+    dist[start] = 0
+    if dirty[start]:
+        seeds[start] = True
+    state, it = _run_push_overlay(prog, mg, dist, seeds, method,
+                                  max_iters, pad_fill=prog.inf)
+    return mg.push_shards.scatter_to_global(np.asarray(state)), it
+
+
+def refresh_components(mg, prior_labels_g: np.ndarray,
+                       method: str = "auto", max_iters: int = 10_000):
+    """Warm CC refresh from prior converged labels; returns
+    (labels (nv,), rounds) — bitwise equal to a cold rebuild."""
+    from lux_tpu.models.components import MaxLabelProgram
+
+    prog = MaxLabelProgram()
+    labels = np.asarray(prior_labels_g).copy()
+    dirty = cc_dirty(mg, labels)
+    seeds = _live_in_neighbors(mg, dirty) | dirty
+    isrc, idst, _ = mg.log.live_inserts()
+    if len(isrc):
+        seeds[np.unique(isrc)] = True
+        seeds[np.unique(idst)] = True
+    labels[dirty] = np.flatnonzero(dirty)  # reset to own id (cold init)
+    state, it = _run_push_overlay(prog, mg, labels, seeds, method,
+                                  max_iters, pad_fill=-1)
+    return mg.push_shards.scatter_to_global(np.asarray(state)), it
+
+
+def _changed_count(old, new):
+    """Top-level (hashable) residual probe: count of entries that moved
+    — residual 0 is the exact-fixpoint convergence the refresh contract
+    uses."""
+    import jax.numpy as jnp
+
+    return jnp.sum(old != new,
+                   axis=tuple(range(1, old.ndim))).astype(jnp.int32)
+
+
+def converge_pagerank(shards, method: str = "auto", route=None,
+                      overlay=None, state0=None, max_iters: int = 512,
+                      dtype: str = "float32",
+                      degree_override=None):
+    """Iterate PageRank to an EXACT f32 fixpoint (residual == 0) —
+    shared by the warm refresh and the cold comparison leg.  Returns
+    (stacked state, iters).  ``degree_override`` substitutes the merged
+    out-degrees ((P, V) int32 array — an ordinary jit argument)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import pull
+    from lux_tpu.models.pagerank import PageRankProgram
+
+    prog = PageRankProgram(nv=shards.spec.nv, dtype=dtype)
+    arrays = jax.tree.map(jnp.asarray, shards.arrays)
+    if degree_override is not None:
+        arrays = arrays._replace(degree=jnp.asarray(degree_override))
+    if state0 is None:
+        state0 = pull.init_state(prog, arrays)
+    else:
+        state0 = jnp.asarray(state0)
+    return pull.run_pull_until(
+        prog, shards.spec, arrays, state0, max_iters, _changed_count,
+        method=method, route=route, overlay=overlay)
+
+
+def refresh_pagerank(mg, prior_state_stacked, method: str = "auto",
+                     route=None, max_iters: int = 512,
+                     dtype: str = "float32"):
+    """Warm PageRank refresh: prior converged ranks rescaled for the
+    merged out-degrees (the state stores rank/deg), then the overlay
+    step iterates to an exact f32 fixpoint.  ``route``: a BASE-graph
+    expand plan (unfused or pass-fused) — the base gather is unchanged
+    by churn, so the cached plan keeps serving.  Returns
+    (stacked state, iters)."""
+    from lux_tpu import obs
+    from lux_tpu.mutate import overlay as ovl
+
+    shards = mg.pull_shards
+    with obs.span("mutate.overlay", kind="pull"):
+        ostatic, oarr = mg.pull_overlay()
+        deg_new = ovl.merged_degree_stacked(shards, mg.log)
+    deg_old = np.asarray(shards.arrays.degree, np.float32)
+    dn = deg_new.astype(np.float32)
+    scale = np.where(deg_old > 0, deg_old, 1.0) / np.where(dn > 0, dn,
+                                                           1.0)
+    warm = (np.asarray(prior_state_stacked, np.float32)
+            * scale).astype(dtype)
+    with obs.span("mutate.refresh", app="pagerank", kind="pull") as sp:
+        state, it = converge_pagerank(
+            shards, method=method, route=route, overlay=(ostatic, oarr),
+            state0=warm, max_iters=max_iters, dtype=dtype,
+            degree_override=deg_new)
+        sp.set(iters=int(it))
+    return state, int(it)

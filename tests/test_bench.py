@@ -43,6 +43,7 @@ def test_bench_happy_path_multi_app():
         assert ln["unit"] == (
             "QPS" if "_qps_" in ln["metric"]
             else "ms/iter" if ln["metric"].startswith("reduce_micro")
+            else "x" if "_refresh_" in ln["metric"]
             else "GTEPS")
         assert ln["value"] > 0
     # the standing mxu-vs-vpu reduce micro row (ISSUE 7): both flavors
@@ -55,8 +56,22 @@ def test_bench_happy_path_multi_app():
     assert qps["batched_vs_q1"] > 0 and qps["scheduler"]["completed"] > 0
     cf = next(ln for ln in lines if ln["metric"].startswith("colfilter"))
     assert cf["rmse"] > 0 and cf["iter_ms"] > 0
-    sp = next(ln for ln in lines if ln["metric"].startswith("sssp"))
+    sp = next(ln for ln in lines if ln["metric"].startswith("sssp_gteps"))
     assert sp["traversed_edges"] > 0 and sp["iters"] > 0
+    # the standing dynamic-graph rows (ISSUE 10): refresh-vs-cold
+    # speedup with the occupancy/invalidation/bitwise accounting
+    for app in ("pagerank", "sssp"):
+        rf = next(ln for ln in lines
+                  if ln["metric"].startswith(f"{app}_refresh_churn1pct"))
+        assert rf["refresh_s"] > 0 and rf["cold_s"] > 0
+        assert set(rf["cold_breakdown"]) == {"load", "build", "plan",
+                                             "compute"}
+        assert 0 < rf["delta_occupancy"]["max"] <= rf["delta_occupancy"]["cap"]
+        assert 0 < rf["invalidated_bucket_fraction"] <= 1.0
+        assert isinstance(rf["bitwise_equal"], bool)
+        assert rf["churn_frac"] > 0
+    assert next(ln for ln in lines
+                if ln["metric"].startswith("sssp_refresh"))["bitwise_equal"]
 
 
 def test_bench_insurance_survives_hung_primary():

@@ -1,0 +1,410 @@
+"""Dynamic-graph mutation subsystem (lux_tpu.mutate, ISSUE 10).
+
+The load-bearing claims, each pinned here:
+  * delta-log then compact == building the merged graph from scratch,
+    BITWISE (graph arrays and converged app results) — property test
+    over random insert/delete batch sequences;
+  * the overlay-aware hot loops are bitwise-equal to a cold rebuild
+    per iteration for the exactly-associative (min/max int) reduces,
+    and converge to the same exact f32 fixpoint for PageRank;
+  * churn across delta occupancy levels causes ZERO retraces (the
+    jit-cache probe twin of luxaudit's LUX-J1 unit);
+  * overflow triggers compaction (never a reshape), the journal
+    replays committed batches only (kill between append and marker
+    loses exactly the uncommitted batch), and compaction invalidates
+    ONLY the plan-cache buckets whose index arrays changed.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine import pull, push
+from lux_tpu.graph import generate
+from lux_tpu.graph.csc import from_edge_list
+from lux_tpu.graph.format import read_lux
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models import components as comp
+from lux_tpu.models.sssp import SSSPProgram, bfs_reference
+from lux_tpu.mutate import (
+    DeltaLog,
+    MutableGraph,
+    OP_DELETE,
+    OP_INSERT,
+)
+from lux_tpu.mutate import refresh as refresh_mod
+from lux_tpu.mutate.deltalog import DeltaOverflow
+
+
+def _churn_batches(g, rng, n_batches, k, oracle):
+    """Yield random mixed batches, mutating the python ``oracle`` edge
+    list (delete-newest-match rule — the documented log semantic)."""
+    for _ in range(n_batches):
+        srcs, dsts, ops, ws = [], [], [], []
+        for _ in range(k):
+            if rng.random() < 0.45 and oracle:
+                u, v, w = oracle[rng.integers(len(oracle))]
+                for i in range(len(oracle) - 1, -1, -1):
+                    if oracle[i][0] == u and oracle[i][1] == v:
+                        del oracle[i]
+                        break
+                srcs.append(u)
+                dsts.append(v)
+                ops.append(OP_DELETE)
+                ws.append(0)
+            else:
+                u = int(rng.integers(g.nv))
+                v = int(rng.integers(g.nv))
+                w = int(rng.integers(1, 9))
+                oracle.append((u, v, w))
+                srcs.append(u)
+                dsts.append(v)
+                ops.append(OP_INSERT)
+                ws.append(w)
+        yield srcs, dsts, ops, ws
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_property_compact_bitwise_vs_scratch(seed, tmp_path):
+    """ANY random insert/delete batch sequence, applied via delta-log
+    then compacted, equals building the merged graph from scratch —
+    bitwise, including the .lux round trip."""
+    g = generate.rmat(9, 8, seed=seed, weighted=True, max_weight=9)
+    rng = np.random.default_rng(seed)
+    oracle = list(zip(g.col_idx.tolist(), g.dst_of_edges().tolist(),
+                      np.asarray(g.weights).tolist()))
+    mg = MutableGraph(g, num_parts=3)
+    for batch in _churn_batches(g, rng, 4, 50, oracle):
+        mg.apply(*batch)
+    snap = str(tmp_path / "merged.lux")
+    mg.compact(path=snap)
+    got = read_lux(snap)
+    es = np.array([e[0] for e in oracle])
+    ed = np.array([e[1] for e in oracle])
+    ew = np.array([e[2] for e in oracle], np.int32)
+    want = from_edge_list(es, ed, g.nv, weights=ew)
+    assert np.array_equal(got.row_ptr, want.row_ptr)
+    assert np.array_equal(got.col_idx, want.col_idx)
+    assert np.array_equal(got.weights, want.weights)
+    # the in-place compacted base IS the snapshot
+    assert np.array_equal(mg.base.col_idx, want.col_idx)
+
+
+@pytest.mark.parametrize("seed", [4, 9])
+def test_property_refresh_converged_bitwise(seed):
+    """Converged app results after churn+refresh equal a cold run on
+    the merged graph: bitwise for the unique-int-fixpoint apps
+    (SSSP/CC), and the exact f32 fixpoint for PageRank."""
+    g = generate.rmat(9, 8, seed=seed)
+    rng = np.random.default_rng(seed)
+    mg = MutableGraph(g, num_parts=3)
+    start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
+    prog = SSSPProgram(nv=g.nv, start=start)
+    st, _, _ = push.run_push(prog, mg.push_shards)
+    dist = mg.push_shards.scatter_to_global(np.asarray(st))
+    labels = comp.connected_components_push(g, num_parts=3)
+    pr, _ = refresh_mod.converge_pagerank(mg.pull_shards)
+
+    oracle = list(zip(g.col_idx.tolist(), g.dst_of_edges().tolist(),
+                      [0] * g.ne))
+    for batch in _churn_batches(g, rng, 3, 40, oracle):
+        # unweighted base: deletes of not-present pairs can happen when
+        # the oracle drew an edge the log already tombstoned — skip
+        # row-by-row like a driver would
+        for u, v, o, w in zip(*batch):
+            try:
+                mg.apply([u], [v], [o], [w])
+            except KeyError:
+                pass
+        dist, _ = refresh_mod.refresh_sssp(mg, dist, start)
+        labels, _ = refresh_mod.refresh_components(mg, labels)
+        pr, _ = refresh_mod.refresh_pagerank(mg, pr)
+        merged = mg.log.merged_graph()
+        assert np.array_equal(dist, bfs_reference(merged, start))
+        assert np.array_equal(
+            labels, comp.connected_components_push(merged, num_parts=3))
+    # pagerank: exact fixpoint, bitwise-equal to a cold fixpoint on the
+    # merged graph at matched cuts
+    merged = mg.log.merged_graph()
+    sh_cold = build_pull_shards(merged, 3,
+                                cuts=np.asarray(mg.pull_shards.cuts))
+    pr_cold, _ = refresh_mod.converge_pagerank(sh_cold)
+    assert np.array_equal(np.asarray(pr), np.asarray(pr_cold))
+
+
+def test_overlay_step_bitwise_minmax():
+    """Per-ITERATION bitwise equality for the exactly-associative
+    combiner: the overlay pull step (max-label CC) equals the step on
+    cold-rebuilt merged shards, iteration by iteration."""
+    g = generate.rmat(9, 8, seed=2)
+    rng = np.random.default_rng(0)
+    mg = MutableGraph(g, num_parts=2)
+    dele = rng.choice(g.ne, 30, replace=False)
+    mg.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+             np.full(30, OP_DELETE, np.int8))
+    mg.apply(rng.integers(0, g.nv, 40), rng.integers(0, g.nv, 40),
+             np.full(40, OP_INSERT, np.int8))
+    prog = comp.MaxLabelProgram()
+    sh = mg.pull_shards
+    merged = mg.log.merged_graph()
+    sh_m = build_pull_shards(merged, 2, cuts=np.asarray(sh.cuts))
+    s0 = pull.init_state(prog, sh.arrays)
+    s0_m = pull.init_state(prog, sh_m.arrays)
+    ov = mg.pull_overlay()
+    for n in (1, 2, 4):
+        a = pull.run_pull_fixed(prog, sh.spec, sh.arrays, s0, n,
+                                method="scan", overlay=ov)
+        b = pull.run_pull_fixed(prog, sh_m.spec, sh_m.arrays, s0_m, n,
+                                method="scan")
+        assert np.array_equal(sh.scatter_to_global(np.asarray(a)),
+                              sh_m.scatter_to_global(np.asarray(b))), n
+
+
+def test_overlay_routed_pf_bitwise():
+    """The overlay composes with a BASE-graph routed(-pf) expand plan
+    bitwise (the routed gather is movement-only), and rejects fused
+    plans (whose reduce layout is baked at plan time)."""
+    from lux_tpu.ops import expand
+
+    g = generate.rmat(9, 8, seed=13)
+    rng = np.random.default_rng(2)
+    mg = MutableGraph(g, num_parts=2)
+    pr0, _ = refresh_mod.converge_pagerank(mg.pull_shards)
+    mg.apply(rng.integers(0, g.nv, 30), rng.integers(0, g.nv, 30),
+             np.full(30, OP_INSERT, np.int8))
+    dele = rng.choice(g.ne, 20, replace=False)
+    mg.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+             np.full(20, OP_DELETE, np.int8))
+    plan = expand.plan_expand_shards(mg.pull_shards, pf=True)
+    a, _ = refresh_mod.refresh_pagerank(mg, pr0)
+    b, _ = refresh_mod.refresh_pagerank(mg, pr0, route=plan)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    fused = expand.plan_fused_shards(mg.pull_shards, reduce="sum")
+    with pytest.raises(ValueError, match="fused"):
+        refresh_mod.refresh_pagerank(mg, pr0, route=fused)
+
+
+def test_zero_retrace_across_occupancy():
+    """Churn at empty/half/full delta occupancy re-enters ONE compiled
+    program — the dynamic twin of luxaudit's LUX-J1 overlay unit."""
+    g = generate.rmat(9, 8, seed=7)
+    rng = np.random.default_rng(0)
+    mg = MutableGraph(g, num_parts=2, cap=256)
+    pr, _ = refresh_mod.converge_pagerank(mg.pull_shards)
+    start = 1
+    prog = SSSPProgram(nv=g.nv, start=start)
+    st, _, _ = push.run_push(prog, mg.push_shards)
+    dist = mg.push_shards.scatter_to_global(np.asarray(st))
+    sizes = []
+    for lvl in (4, 60, 180):
+        mg.apply(rng.integers(0, g.nv, lvl),
+                 rng.integers(0, g.nv, lvl),
+                 np.full(lvl, OP_INSERT, np.int8))
+        pr, _ = refresh_mod.refresh_pagerank(mg, pr)
+        dist, _ = refresh_mod.refresh_sssp(mg, dist, start)
+        sizes.append(pull._pull_until_jit._cache_size())
+    assert sizes[0] == sizes[1] == sizes[2], sizes
+
+
+def test_overflow_triggers_compaction():
+    """A batch that would overflow any part's delta capacity compacts
+    the standing log FIRST and then applies — the new batch stays in
+    the log (warm refresh from a prior converged state remains sound),
+    shapes never change.  A batch that alone exceeds the capacity
+    raises instead of silently invalidating caller-held priors."""
+    g = generate.rmat(9, 8, seed=5)
+    rng = np.random.default_rng(1)
+    mg = MutableGraph(g, num_parts=2, cap=128)
+    _ = mg.pull_shards
+    old_ne = g.ne
+    st = mg.apply(rng.integers(0, g.nv, 100), np.full(100, 3),
+                  np.full(100, OP_INSERT, np.int8))
+    assert not st["compacted"]
+    st = mg.apply(rng.integers(0, g.nv, 100), np.full(100, 3),
+                  np.full(100, OP_INSERT, np.int8))
+    assert st["compacted"] and mg.compactions == 1
+    # the FIRST batch folded into the base; the second is still live
+    assert mg.base.ne == old_ne + 100
+    assert mg.log.stats()["inserts_live"] == 100
+    # one batch alone past the capacity: a hard error, never a silent
+    # fold (and never a reshape)
+    with pytest.raises(DeltaOverflow, match="on its own"):
+        mg.apply(rng.integers(0, g.nv, 200), np.full(200, 3),
+                 np.full(200, OP_INSERT, np.int8))
+    # the raw builder raises rather than reshaping
+    mg2 = MutableGraph(g, num_parts=2, cap=128)
+    log = DeltaLog(g)
+    log.apply(rng.integers(0, g.nv, 200), np.full(200, 3),
+              np.full(200, OP_INSERT, np.int8))
+    from lux_tpu.mutate import build_pull_overlay
+
+    with pytest.raises(DeltaOverflow):
+        build_pull_overlay(mg2.pull_shards, log, cap=128)
+
+
+def test_apply_batch_atomicity():
+    """A batch with an invalid row leaves the in-memory state AND the
+    journal exactly as before — never half a batch in either, and the
+    journal stays replayable (a committed poisoned batch would make
+    every reopen raise)."""
+    g = generate.rmat(8, 4, seed=3)
+    jd = tempfile.mkdtemp()
+    log = DeltaLog(g, journal_dir=jd)
+    log.apply([1], [2], [OP_INSERT], [5])
+    before = log.stats()
+    # row 2 is valid, row 3 deletes a non-existent edge
+    with pytest.raises(KeyError):
+        log.apply([3, 1], [4, 3], [OP_INSERT, OP_DELETE], [6, 0])
+    assert log.stats() == before
+    log.apply([7], [8], [OP_INSERT], [9])
+    # reopen replays BOTH committed batches and nothing else
+    log2 = DeltaLog(g, journal_dir=jd)
+    assert log2.stats()["batches"] == 2
+    assert np.array_equal(log2.live_inserts()[0], log.live_inserts()[0])
+
+
+def test_journal_roundtrip_and_crash_replay():
+    """Committed batches replay on reopen; a batch whose npz landed but
+    whose fsync MARKER did not (kill in the append window) is ignored
+    AND cleaned up — exactly one batch lost, never a torn state."""
+    g = generate.rmat(8, 4, seed=3)
+    jd = tempfile.mkdtemp()
+    log = DeltaLog(g, journal_dir=jd)
+    log.apply([1], [2], [OP_INSERT], [5])
+    log.apply([2, 1], [3, 2], [OP_INSERT, OP_DELETE], [6, 0])
+    # simulate the crash: append the npz, die before the marker
+    seq = log._journal_write_batch(np.array([7]), np.array([8]),
+                                   np.array([OP_INSERT], np.int8),
+                                   np.array([9]))
+    log2 = DeltaLog(g, journal_dir=jd)
+    s = log2.stats()
+    assert s == {"inserts_live": 1, "inserts_total": 2,
+                 "deletes_base": 0, "batches": 2}
+    assert not os.path.exists(log2._batch_path(seq))
+    # the replayed log resolves identically to the in-memory one
+    assert np.array_equal(log2.live_inserts()[0], log.live_inserts()[0])
+    # base mismatch is refused loudly
+    g2 = generate.rmat(8, 5, seed=3)
+    with pytest.raises(ValueError, match="different base"):
+        DeltaLog(g2, journal_dir=jd)
+    # a SAME-nv/ne different-content base (edge-count-conserving churn
+    # epoch) is caught by the content fingerprint, not just the sizes
+    g3 = generate.rmat(8, 4, seed=99)
+    assert (g3.nv, g3.ne) == (g.nv, g.ne)
+    with pytest.raises(ValueError, match="different base"):
+        DeltaLog(g3, journal_dir=jd)
+
+
+def test_journal_rotates_on_compact(tmp_path):
+    g = generate.rmat(8, 4, seed=3)
+    jd = str(tmp_path / "jr")
+    mg = MutableGraph(g, num_parts=2, journal_dir=jd)
+    mg.apply([1, 2], [3, 4], [OP_INSERT, OP_INSERT])
+    mg.compact(path=str(tmp_path / "s.lux"))
+    # no batches survive; a fresh open on the NEW base sees a clean log
+    log = DeltaLog(mg.base, journal_dir=jd)
+    assert log.stats()["batches"] == 0 and log.empty
+
+
+def test_delete_missing_edge_raises():
+    g = generate.rmat(8, 4, seed=3)
+    log = DeltaLog(g)
+    # delete an edge, then delete it again -> second must fail
+    u, v = int(g.col_idx[0]), int(g.dst_of_edges()[0])
+    n_par = int(np.sum((g.col_idx[g.row_ptr[v]:g.row_ptr[v + 1]] == u)))
+    for _ in range(n_par):
+        log.apply([u], [v], [OP_DELETE])
+    with pytest.raises(KeyError):
+        log.apply([u], [v], [OP_DELETE])
+    # insert-then-delete within one batch resolves in order
+    log.apply([u, u], [v, v], [OP_INSERT, OP_DELETE])
+    assert log.stats()["inserts_live"] == 0
+
+
+def test_bucket_invalidation_is_minimal():
+    """Churn confined to one part's destination range (at balanced
+    insert/delete counts, so the shared e_pad stays put) invalidates
+    EXACTLY that part's plan-cache bucket — PLAN_FORMAT 5's per-bucket
+    keys doing their job through the compaction path."""
+    g = generate.rmat(10, 8, seed=2)
+    mg = MutableGraph(g, num_parts=4)
+    cuts = np.asarray(mg.pull_shards.cuts)
+    lo, hi = int(cuts[2]), int(cuts[3])
+    dsts = g.dst_of_edges()
+    in_p2 = np.flatnonzero((dsts >= lo) & (dsts < hi))
+    rng = np.random.default_rng(0)
+    dele = rng.choice(in_p2, 8, replace=False)
+    mg.apply(g.col_idx[dele], dsts[dele], np.full(8, OP_DELETE, np.int8))
+    mg.apply(rng.integers(0, g.nv, 8), rng.integers(lo, hi, 8),
+             np.full(8, OP_INSERT, np.int8))
+    rep = mg.compact()
+    assert rep["invalidation"]["changed_parts"] == [2], rep
+    assert rep["invalidation"]["fraction"] == 0.25
+
+
+def test_weighted_refresh_and_zero_weight_guard():
+    g = generate.rmat(9, 8, seed=5, weighted=True, max_weight=9)
+    rng = np.random.default_rng(3)
+    mg = MutableGraph(g, num_parts=2)
+    from lux_tpu.models.sssp import WeightedSSSPProgram, sssp
+
+    start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
+    prog = WeightedSSSPProgram(nv=g.nv, start=start)
+    st, _, _ = push.run_push(prog, mg.push_shards)
+    dist = mg.push_shards.scatter_to_global(np.asarray(st))
+    dele = rng.choice(g.ne, 20, replace=False)
+    mg.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+             np.full(20, OP_DELETE, np.int8))
+    mg.apply(rng.integers(0, g.nv, 20), rng.integers(0, g.nv, 20),
+             np.full(20, OP_INSERT, np.int8), rng.integers(1, 9, 20))
+    dist2, _ = refresh_mod.refresh_sssp(mg, dist, start, weighted=True)
+    want = sssp(mg.log.merged_graph(), start=start, num_parts=2,
+                weighted=True)
+    assert np.array_equal(dist2, want)
+    # zero weights break the tight-edge cascade's induction: refuse
+    mg0 = MutableGraph(g, num_parts=2)
+    mg0.apply([1], [2], [OP_INSERT], [0])
+    mg0.log.apply(*([g.col_idx[:1], g.dst_of_edges()[:1],
+                     [OP_DELETE], [0]]))
+    with pytest.raises(ValueError, match="positive"):
+        refresh_mod.sssp_dirty(mg0, dist, start, weighted=True)
+
+
+def test_compact_republish_to_fleet(tmp_path):
+    """The full production loop: serve -> churn -> compact -> publish
+    the compacted snapshot to a live 2-worker fleet through the
+    token-guarded prepare/commit republish -> answers match the merged
+    graph, zero shed."""
+    from lux_tpu.graph.format import write_lux
+    from lux_tpu.mutate import compact as compact_mod
+    from lux_tpu.serve.fleet.bench import start_fleet
+
+    g = generate.rmat(8, 4, seed=4)
+    base_snap = str(tmp_path / "base.lux")
+    write_lux(base_snap, g)
+    mg = MutableGraph(g, num_parts=2)
+    fleet = start_fleet(2, shards=mg.pull_shards, graph_id="live",
+                        mode="thread", buckets=(1, 4))
+    try:
+        ctl = fleet.controller
+        for s in (0, 3):
+            assert np.array_equal(ctl.submit(s).result(timeout=60),
+                                  bfs_reference(g, s))
+        rng = np.random.default_rng(0)
+        mg.apply(rng.integers(0, g.nv, 24), rng.integers(0, g.nv, 24),
+                 np.full(24, OP_INSERT, np.int8))
+        dele = rng.choice(g.ne, 12, replace=False)
+        mg.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+                 np.full(12, OP_DELETE, np.int8))
+        snap = str(tmp_path / "compacted.lux")
+        mg.compact(path=snap)
+        rep = compact_mod.publish_to_fleet(ctl, snap, graph_id="live")
+        assert set(rep["generations"].values()) == {1}, rep
+        merged = mg.base
+        for s in (0, 3, 7):
+            assert np.array_equal(ctl.submit(s).result(timeout=60),
+                                  bfs_reference(merged, s)), s
+        assert ctl.stats()["shed"] == 0
+    finally:
+        fleet.close()

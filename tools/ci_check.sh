@@ -94,6 +94,37 @@ print('mxreduce bitwise (f32-exact) == fused;',
       'sweeps', pf['total'], '->', mx['total'])
 "
 
+# 3a2) mutate smoke (ISSUE 10): small graph -> 1% churn via the
+#      delta-log -> warm overlay refresh -> compact -> the refreshed
+#      distances AND the compacted graph arrays must be bitwise equal
+#      to a from-scratch rebuild of the merged graph
+stage mutate_smoke 300 env JAX_PLATFORMS=cpu python -c "
+import numpy as np
+from lux_tpu.graph import generate
+from lux_tpu.mutate import MutableGraph
+from lux_tpu.mutate import refresh as R
+from lux_tpu.models.sssp import SSSPProgram, bfs_reference
+from lux_tpu.engine import push
+g = generate.rmat(9, 8, seed=3)
+rng = np.random.default_rng(0)
+mg = MutableGraph(g, num_parts=2)
+start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
+st, _, _ = push.run_push(SSSPProgram(nv=g.nv, start=start), mg.push_shards)
+d0 = mg.push_shards.scatter_to_global(np.asarray(st))
+k = g.ne // 200
+dele = rng.choice(g.ne, k, replace=False)
+mg.apply(g.col_idx[dele], g.dst_of_edges()[dele], np.zeros(k, np.int8))
+mg.apply(rng.integers(0, g.nv, k), rng.integers(0, g.nv, k), np.ones(k, np.int8))
+d1, rounds = R.refresh_sssp(mg, d0, start)
+merged = mg.log.merged_graph()
+assert np.array_equal(d1, bfs_reference(merged, start)), 'refresh != cold'
+rep = mg.compact()
+assert np.array_equal(mg.base.col_idx, merged.col_idx), 'compact != merged'
+print('mutate smoke: refresh bitwise in', rounds, 'rounds;',
+      'invalidated', rep['invalidation']['changed'], '/',
+      rep['invalidation']['parts'], 'buckets')
+"
+
 # 3b) obs smoke: a shell-seeded event log must round-trip through
 #     luxview (the post-mortem path chip_day's EXIT trap depends on),
 #     jax-free end to end; LUX-O itself runs inside stage 1's luxcheck
@@ -157,7 +188,7 @@ stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_luxcheck.py tests/test_native.py tests/test_expand.py \
     tests/test_passfuse.py tests/test_mxreduce.py tests/test_obs.py \
     tests/test_determinism.py tests/test_serve_scheduler.py \
-    tests/test_fleet.py
+    tests/test_fleet.py tests/test_mutate.py
 
 if [ "$FAILED" -ne 0 ]; then
   echo "ci_check: FAILED (see $LOG)"; exit 1
