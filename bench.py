@@ -29,7 +29,13 @@ Env knobs:
   LUX_BENCH_SCALE  (default 20)  RMAT scale, nv = 2**scale
   LUX_BENCH_EF     (default 16)  edge factor, ne = nv * ef
   LUX_BENCH_ITERS  (default 10)
-  LUX_BENCH_METHOD (default auto: race scan vs scatter [vs pallas on TPU])
+  LUX_BENCH_METHOD (default auto: race scan vs scatter [vs cumsum/mxsum/
+                   mxscan/pallas on TPU].  The default output also carries
+                   a standing `scan_micro_mx_vs_vpu` row — scan vs mxsum
+                   vs mxscan on one tiny csc census
+                   (LUX_BENCH_SCAN_MICRO_SCALE, default 12), each flavor
+                   oracle-gated, winner banked under "tpu:sum" on TPU
+                   only, consumed by engine/methods.sum_mode)
   LUX_BENCH_DTYPE  (default float32; bfloat16 halves state bandwidth)
   LUX_BENCH_WATCHDOG_S (default 900) total wall budget for the orchestrator
                    (0 = unbounded)
@@ -399,8 +405,11 @@ def worker_main():
         # result is emitted the moment it exists, so if a later method
         # wedges this worker the orchestrator still harvests the banked
         # lines from the output file.
+        # "mxscan" (ISSUE 11): the blocked MXU segmented scan joins the
+        # full-scale race ahead of "pallas" (both are Pallas kernels;
+        # the wedge-prone associative_scan stays quarantined last)
         methods = (
-            ["scatter", "cumsum", "mxsum", "pallas"]
+            ["scatter", "cumsum", "mxsum", "mxscan", "pallas"]
             if on_tpu
             else ["scan", "scatter"]
         )
@@ -1084,6 +1093,86 @@ def worker_main():
                                  {"scale": ms, "ms_per_iter": flavor_ms,
                                   "winner": winner})
 
+    def measure_scan_micro():
+        """Standing MXU-vs-VPU segmented-SCAN micro row (ISSUE 11): the
+        SAME tiny csc census through all three scan-family flavors —
+        "scan" (the shipped VPU ``lax.associative_scan`` ladder),
+        "mxsum" (prefix-diff blocked triangular matmul) and "mxscan"
+        (the segmented scan itself as masked triangular MXU
+        contractions, ops/pallas_scan) — so the ``tpu:sum`` scan-family
+        default is measured, not assumed.  Exactness-gated: each flavor
+        must match the NumPy f64 segment-sum oracle (atol scaled by the
+        prefix-diff strategies' documented ne*eps cancellation bound)
+        before its time counts.  On TPU the winner is banked under
+        ``tpu:sum`` (consumed by engine/methods.sum_mode on the csc
+        gather-apply paths); the row itself is emitted everywhere (CPU
+        rows are real interpret-mode measurements, clearly suffixed
+        like every other fallback family)."""
+        import numpy as np
+
+        from lux_tpu.ops import segment
+
+        ms = _env_int("LUX_BENCH_SCAN_MICRO_SCALE", 12)
+        gm = generate.rmat(ms, 8, seed=0)
+        shm = build_pull_shards(gm, 1)
+        rng = np.random.default_rng(0)
+        e_pad = shm.arrays.src_pos.shape[1]
+        vals_np = np.zeros(e_pad, np.float32)
+        vals_np[: gm.ne] = rng.random(gm.ne).astype(np.float32)
+        dst = gm.dst_of_edges()
+        want = np.zeros(gm.nv, np.float64)
+        np.add.at(want, dst, vals_np[: gm.ne].astype(np.float64))
+        vals = jnp.asarray(vals_np)
+        rp = jnp.asarray(shm.arrays.row_ptr[0])
+        hf = jnp.asarray(shm.arrays.head_flag[0])
+        dl = jnp.asarray(shm.arrays.dst_local[0])
+        jax.block_until_ready((vals, rp, hf, dl))
+        atol = max(1e-5, gm.ne * 6e-7)
+        flavor_ms = {}
+        for name in ("scan", "mxsum", "mxscan"):
+            got = np.asarray(jax.jit(
+                lambda v, name=name: segment.segment_sum_csc(
+                    v, rp, hf, dl, method=name))(vals))
+            if not np.allclose(got[: gm.nv], want, rtol=1e-3, atol=atol):
+                print(f"# scan micro: {name} failed the exactness gate "
+                      f"(maxdiff {np.abs(got[: gm.nv] - want).max():.3e})"
+                      "; row skipped", file=sys.stderr, flush=True)
+                return
+
+            def run(n, name=name):
+                def body(_, v):
+                    acc = segment.segment_sum_csc(v, rp, hf, dl,
+                                                  method=name)
+                    return vals * (1.0 + acc[0] * 1e-9)
+
+                return jax.lax.fori_loop(0, n, body, vals)
+
+            elapsed, _ = fetch_timed(run)
+            # same 0.1 us floor as the mx micro row: a 0.0 value would
+            # read as "unmeasured" downstream
+            flavor_ms[name] = max(round(elapsed / iters * 1e3, 4), 1e-4)
+            print(f"# scan micro {name}: {flavor_ms[name]} ms/iter",
+                  file=sys.stderr, flush=True)
+        winner = min(flavor_ms, key=flavor_ms.get)
+        _emit_row({
+            "metric": f"scan_micro_mx_vs_vpu_rmat{ms}{suffix}",
+            "value": flavor_ms[winner],
+            "unit": "ms/iter",
+            "winner": winner,
+            "flavor_ms": flavor_ms,
+            "ne": int(gm.ne),
+        })
+        if on_tpu:
+            from lux_tpu.engine.methods import (record_overlay_entry,
+                                                record_sum_family_winner)
+
+            # never clobbers a measured blanket 'scatter' winner (this
+            # row does not time scatter; the full race may)
+            record_sum_family_winner(winner)
+            record_overlay_entry("tpu:micro_scan",
+                                 {"scale": ms, "ms_per_iter": flavor_ms,
+                                  "winner": winner})
+
     def measure_cf(m):
         """Fixed-iteration CF (K=20 latent state): edge-update GTEPS +
         per-iteration ms + final RMSE (the reference's CF quality metric,
@@ -1385,6 +1474,14 @@ def worker_main():
             except Exception as e:  # noqa: BLE001
                 print(f"# mx micro row failed: {e}", file=sys.stderr,
                       flush=True)
+            # standing scan-family micro row (ISSUE 11): scan vs mxsum
+            # vs mxscan on one tiny csc census, winner banked under
+            # tpu:sum on TPU (engine/methods.sum_mode consumes it)
+            try:
+                measure_scan_micro()
+            except Exception as e:  # noqa: BLE001
+                print(f"# scan micro row failed: {e}", file=sys.stderr,
+                      flush=True)
     if "pagerank" in apps and results and (
         on_tpu or os.environ.get("LUX_BENCH_FORCE_SCALEUP") == "1"
     ):
@@ -1467,11 +1564,30 @@ def _record_winner(results):
     if not f32:
         return
     overall = min(f32, key=f32.get)
-    # a blanket default must hold on every engine path (bucketed ring /
-    # edge2d layouts run scan/scatter only), so only those are ever
-    # recorded; a faster sum-only winner is reported for the human +
-    # PERF.md instead
-    safe = {m: t for m, t in f32.items() if m in ("scan", "scatter")}
+    # a recorded tpu:sum must hold on every engine path AND be
+    # numerically verified.  scan/scatter are blanket-valid; the
+    # scan-family strategies (mxsum/mxscan, ISSUE 11) are safe to
+    # record — engine/methods.sum_mode follows them on the csc
+    # gather-apply paths while the bucketed layouts downgrade to
+    # 'scan' — but ONLY when this same machine's oracle-gated micro
+    # race already verified them (the full-scale race times, it never
+    # checks numerics; a banked winner must always be a verified one).
+    # Anything else (pallas/cumsum/fused) is still reported for the
+    # human + PERF.md instead of banked.
+    from lux_tpu.engine import methods as _methods
+
+    gated: set = set()
+    try:
+        with open(_methods.overlay_path()) as f:
+            raw = json.load(f)
+        micro = raw.get("tpu:micro_scan") or {}
+        gated = (set(micro.get("ms_per_iter") or ())
+                 | set(micro.get("ms_per_rep") or ()))
+    except (OSError, ValueError, AttributeError):
+        pass
+    safe = {m: t for m, t in f32.items()
+            if m in ("scan", "scatter")
+            or (m in ("mxsum", "mxscan") and m in gated)}
     if not safe:
         return
     best = min(safe, key=safe.get)

@@ -96,6 +96,26 @@ def check_kernel_parity(traced_base, traced_twin, path: str, label: str,
     return []
 
 
+def check_kernel_count(traced, expected: int, path: str, label: str,
+                       line: int = 1) -> List[Finding]:
+    """LUX-J501 for standalone kernels (ISSUE 11's mxscan leg): the
+    traced program must launch EXACTLY ``expected`` pallas_call kernels.
+    mxscan's whole accounting claim (REDUCE_HBM_PASSES["mxscan"] == 2 is
+    EXACT, not a ladder floor) rests on the segmented scan being ONE
+    kernel — a fallback to the VPU ladder or a split kernel silently
+    falsifies every hbm_passes row that cites it."""
+    observed = aot.count_primitive(aot.traced_jaxpr(traced), "pallas_call")
+    if observed != expected:
+        return [Finding(
+            path=path, line=line, col=0, code="LUX-J501",
+            message=f"traced program launches {observed} pallas_call "
+                    f"kernel(s) but the accounting derives {expected} — "
+                    "the published hbm_passes no longer describes the "
+                    "kernels actually launched",
+            text=label)]
+    return []
+
+
 def check_hbm(traced, static, path: str, label: str, line: int = 1,
               claimed: Optional[dict] = None,
               method: str = "scan") -> List[Finding]:

@@ -16,8 +16,11 @@ batched steps, the distributed push engines (allgather + ring, on a
 host-device mesh), the fused-pf and fused-mx plans (the MXREDUCE
 in-kernel reduction: its retrace stability, VMEM ledger incl. the
 one-hot/accumulator tiles, kernel-count parity against the 0.5-sweep
-roofline claim, and ring neutrality), and the dynamic-knob recompile
-probes (chip-day step -3b).
+roofline claim, and ring neutrality), the mxscan entry points (ISSUE
+11 — the blocked MXU segmented scan: LUX-J1 trace stability, LUX-J4
+tile residency, LUX-J501 one-kernel accounting, LUX-J503 ring
+neutrality), and the dynamic-knob recompile probes (chip-day step
+-3b).
 
 The telemetry units ("+ring"/"ring-donate"/"ring-neutral") audit the
 flight-recorder contract (docs/OBSERVABILITY.md): the ring must trace
@@ -163,7 +166,7 @@ _dev_overlay = _dev_route
 
 
 def _pull_fixed_traced(num_iters: int, route=None, ring=None,
-                       overlay=None):
+                       overlay=None, method: str = "scan"):
     from lux_tpu.engine import pull
 
     fx = fixture()
@@ -171,7 +174,7 @@ def _pull_fixed_traced(num_iters: int, route=None, ring=None,
     os_, oa = _dev_overlay(overlay) if overlay is not None else (None,
                                                                  None)
     return pull._pull_fixed_jit.trace(
-        fx["prank"], fx["shards"].spec, num_iters, "scan", fx["arrays"],
+        fx["prank"], fx["shards"].spec, num_iters, method, fx["arrays"],
         fx["state0"], ring, route_static=rs, route_arrays=ra,
         interpret=True, ostatic=os_, oarrays=oa)
 
@@ -728,6 +731,69 @@ def _hbm_mx_ring_neutral() -> List[Finding]:
                                    "pull-fixed/fused-mx/ring-neutral")
 
 
+def _retrace_pull_fixed_mxscan() -> List[Finding]:
+    """LUX-J1 for the mxscan engine entry point (ISSUE 11): the
+    mxscan-reduced pull must trace stably and keep one compile across
+    run lengths — segment geometry (row_ptr/head_flag VALUES) is data,
+    so different censuses share the compile; only the tile-rows knob,
+    read at trace time, may change the program."""
+    fx = fixture()
+    path = "lux_tpu/engine/pull.py"
+    label = "pull-fixed/mxscan"
+    statics = (fx["prank"], fx["shards"].spec, "mxscan", None)
+    out = retrace.trace_twice_stable(
+        lambda: _pull_fixed_traced(2, method="mxscan"), path, label,
+        statics=statics)
+    out += retrace.check_variants(
+        [_pull_fixed_traced(2, method="mxscan"),
+         _pull_fixed_traced(3, method="mxscan")], path, label)
+    return out
+
+
+def _vmem_mxscan() -> List[Finding]:
+    """LUX-J4's mxscan leg: the scan tile + head-count tiles + masked
+    triangular operand + carry against LUX_PF_VMEM_MB."""
+    return vmem.check_vmem_mxscan("lux_tpu/ops/pallas_scan.py", "mxscan")
+
+
+def _hbm_mxscan() -> List[Finding]:
+    """LUX-J5's mxscan leg: the traced csc segment sum on
+    method='mxscan' must launch EXACTLY ONE pallas_call — the kernel
+    count behind REDUCE_HBM_PASSES['mxscan'] == 2 being exact."""
+    import jax
+
+    from lux_tpu.ops import segment
+
+    fx = fixture()
+    arr = fx["arrays"]
+    e_pad = fx["shards"].arrays.src_pos.shape[1]
+    import jax.numpy as jnp
+
+    vals = jnp.ones((e_pad,), jnp.float32)
+
+    def reduce_part(v, rp, hf, dl):
+        return segment.segment_sum_csc(v, rp, hf, dl, method="mxscan")
+
+    traced = jax.jit(reduce_part).trace(
+        vals, arr.row_ptr[0], arr.head_flag[0], arr.dst_local[0])
+    return hbm.check_kernel_count(traced, 1, "lux_tpu/ops/pallas_scan.py",
+                                  "segment/mxscan")
+
+
+def _hbm_mxscan_ring_neutral() -> List[Finding]:
+    """LUX-J503 for the mxscan entry point: the telemetry ring on the
+    mxscan-reduced hot loop must launch EXACTLY the base config's
+    kernels — the scan stays one kernel per part-iteration with the
+    ring riding the carry."""
+    from lux_tpu.obs import ring as obs_ring
+
+    base = _pull_fixed_traced(2, method="mxscan")
+    twin = _pull_fixed_traced(2, method="mxscan",
+                              ring=obs_ring.new_ring("pull_fixed"))
+    return hbm.check_kernel_parity(base, twin, "lux_tpu/engine/pull.py",
+                                   "pull-fixed/mxscan/ring-neutral")
+
+
 # ---------------------------------------------------------------------------
 # the registry
 # ---------------------------------------------------------------------------
@@ -746,6 +812,9 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   _retrace_pull_fixed_ring),
         AuditUnit("retrace", "pull-fixed/fused-mx",
                   "lux_tpu/engine/pull.py", False, _retrace_pull_fixed_mx),
+        AuditUnit("retrace", "pull-fixed/mxscan",
+                  "lux_tpu/engine/pull.py", False,
+                  _retrace_pull_fixed_mxscan),
         AuditUnit("retrace", "pull-until/direct",
                   "lux_tpu/engine/pull.py", False, _retrace_pull_until),
         AuditUnit("retrace", "pull-fixed/overlay",
@@ -800,6 +869,8 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   False, _vmem_fused_pf),
         AuditUnit("vmem", "fused-mx", "lux_tpu/ops/pallas_shuffle.py",
                   False, _vmem_fused_mx),
+        AuditUnit("vmem", "mxscan", "lux_tpu/ops/pallas_scan.py",
+                  False, _vmem_mxscan),
         AuditUnit("hbm", "expand", "lux_tpu/ops/expand.py", False,
                   lambda: _hbm_expand(False)),
         AuditUnit("hbm", "expand-pf", "lux_tpu/ops/expand.py", True,
@@ -814,6 +885,11 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   _hbm_fused_mx),
         AuditUnit("hbm", "pull-fixed/fused-mx/ring-neutral",
                   "lux_tpu/engine/pull.py", False, _hbm_mx_ring_neutral),
+        AuditUnit("hbm", "segment/mxscan", "lux_tpu/ops/pallas_scan.py",
+                  False, _hbm_mxscan),
+        AuditUnit("hbm", "pull-fixed/mxscan/ring-neutral",
+                  "lux_tpu/engine/pull.py", False,
+                  _hbm_mxscan_ring_neutral),
     ]
     if fast:
         units = [u for u in units if u.fast]

@@ -68,6 +68,39 @@ def mx_residency_bytes(mxg, mx_arrays, weighted: bool) -> int:
     return tile + onehot + acc + out_blk
 
 
+def check_vmem_mxscan(path: str, label: str, line: int = 1,
+                      budget_bytes: int | None = None,
+                      tile_rows: int | None = None,
+                      val_bytes: int = 4) -> List[Finding]:
+    """LUX-J4 for the mxscan kernel (ISSUE 11): the scan tile's
+    residency — streamed value/byte tiles double-buffered + the head
+    count and its transpose + the per-row (128, 128) masked triangular
+    operand + the carry scratch — against the same LUX_PF_VMEM_MB
+    budget the pf groups answer to.  The tile geometry is env-shaped
+    (LUX_MXSCAN_TILE_ROWS) at TRACE time, so like the pf plans a bad
+    knob combination must fail in this audit, not as a Mosaic VMEM
+    blow-up on chip."""
+    from lux_tpu.ops.pallas_scan import (_mxscan_defaults,
+                                         mxscan_residency_bytes)
+
+    if budget_bytes is None:
+        budget_bytes = _budget_bytes()
+    tb = _mxscan_defaults(tile_rows)
+    need = mxscan_residency_bytes(tb, val_bytes)
+    if need > budget_bytes:
+        return [Finding(
+            path=path, line=line, col=0, code="LUX-J401",
+            message=f"mxscan tile (LUX_MXSCAN_TILE_ROWS={tb}, "
+                    f"{val_bytes}B values) needs {need} B of VMEM "
+                    f"(streamed tiles double-buffered + head-count "
+                    f"tiles + the masked triangular operand + carry), "
+                    f"over the {budget_bytes} B budget the knobs "
+                    "promise (LUX_PF_VMEM_MB) — this blows up in "
+                    "Mosaic on chip, not in interpret-mode tests",
+            text=f"{label}:mxscan")]
+    return []
+
+
 def _iter_pf_routes(static):
     """(name, StaticRoutePF) for every pass-fused route inside a plan
     static (ExpandStatic r1/r2, FusedStatic r1/r2/vr, CFRouteStatic

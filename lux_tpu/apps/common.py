@@ -131,14 +131,37 @@ def downgrade_pf(cfg, layout: str) -> None:
     cfg.route_gather = "expand"
 
 
+def _downgrade_scan_family(cfg: RunConfig, was_auto: bool, prog,
+                           where: str) -> None:
+    """An AUTO-refined scan-family winner (mxsum/mxscan via the banked
+    ``tpu:sum`` entry, engine/methods.sum_mode) downgrades to the
+    blanket winner on the bucketed exchanges — their drivers run
+    scan/scatter only, exactly like segment_reduce_by_ends' own
+    downgrade.  An EXPLICIT --method choice is left for the branch's
+    own SystemExit (loud CLI failure, never a silent swap)."""
+    if was_auto and cfg.method in ("mxsum", "mxscan"):
+        import sys
+
+        from lux_tpu.engine import methods
+
+        blanket = methods.resolve("auto", prog.reduce)
+        print(f"# --method auto: banked {cfg.method} winner downgraded "
+              f"to {blanket} on {where} (bucketed reductions run "
+              "scan/scatter)", file=sys.stderr)
+        cfg.method = blanket
+
+
 def validate_exchange(cfg: RunConfig, prog) -> None:
     """Reject incompatible --exchange combinations BEFORE the O(ne) shard
     build, with a CLI-level message (not a deep driver assert).  Resolves
-    ``--method auto`` to the platform's measured winner first, so every
+    ``--method auto`` to the platform's measured winner first — through
+    ``resolve_sum``, so the banked ``tpu:sum`` scan-family winner
+    (ISSUE 11) actually reaches the engines from the CLI — and every
     later check (and the run itself) sees a concrete strategy."""
     from lux_tpu.engine import methods
 
-    cfg.method = methods.resolve(cfg.method, prog.reduce)
+    was_auto = cfg.method == "auto"
+    cfg.method = methods.resolve_sum(cfg.method, prog.reduce)
     if cfg.method in ("cumsum", "mxsum") and prog.reduce != "sum":
         raise SystemExit(
             f"--method {cfg.method} is a prefix-diff strategy: sum-reduce "
@@ -235,6 +258,9 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "allgather or ring exchange; it cannot combine with "
                 "--exchange scatter or --edge-shards"
             )
+        if cfg.exchange == "ring":
+            _downgrade_scan_family(cfg, was_auto, prog,
+                                   "--feat-shards --exchange ring")
         if cfg.exchange == "ring" and cfg.method not in ("scan", "scatter"):
             raise SystemExit(
                 "--feat-shards --exchange ring supports --method "
@@ -276,20 +302,26 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
                 "--edge-shards (2-D mesh) has its own exchange; it cannot "
                 "combine with --exchange ring/scatter"
             )
-        if cfg.method in ("cumsum", "mxsum"):
+        _downgrade_scan_family(cfg, was_auto, prog, "--edge-shards")
+        if cfg.method in ("cumsum", "mxsum", "mxscan"):
             raise SystemExit(
                 "--edge-shards supports --method scan or scatter "
-                "(edge chunks carry no row_ptr for prefix-diff reduces)"
+                "(edge chunks carry no row_ptr for prefix-diff reduces; "
+                "the mxscan kernel is confined to the csc engines here)"
             )
         return
     if cfg.exchange == "allgather":
         return
     if not cfg.distributed:
         raise SystemExit(f"--exchange {cfg.exchange} requires --distributed")
-    if cfg.method in ("cumsum", "mxsum"):
+    _downgrade_scan_family(cfg, was_auto, prog,
+                           f"--exchange {cfg.exchange}")
+    if cfg.method in ("cumsum", "mxsum", "mxscan"):
         raise SystemExit(
             "--exchange ring/scatter supports --method scan or scatter "
-            "(bucketed reductions carry no row_ptr for prefix-diff reduces)"
+            "(bucketed reductions carry no row_ptr for prefix-diff "
+            "reduces; the mxscan kernel is confined to the csc engines "
+            "here)"
         )
     if cfg.exchange == "scatter":
         if prog.reduce != "sum" or getattr(prog, "needs_dst_state", False):

@@ -150,7 +150,7 @@ def run_delta_checkpointed(prog, shards, cfg, mesh, name: str):
     delta_mod._validate(prog, cfg.delta)
     from lux_tpu.engine import methods
 
-    cfg.method = methods.resolve(cfg.method, prog.reduce)
+    cfg.method = methods.resolve_sum(cfg.method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     parrays = jax.tree.map(jnp.asarray, shards.parrays)
@@ -205,7 +205,7 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
     must use the returned layout, not the one passed in."""
     from lux_tpu.engine import methods
 
-    cfg.method = methods.resolve(cfg.method, prog.reduce)
+    cfg.method = methods.resolve_sum(cfg.method, prog.reduce)
     common.resolve_route_auto(cfg)
     if (getattr(cfg, "route_gather", "") == "expand-pf"
             and cfg.exchange == "ring"):

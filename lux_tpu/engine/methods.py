@@ -28,8 +28,10 @@ import threading
 #: Concrete strategies a resolution may produce.  ("cumsum"/"mxsum" are
 #: sum-only prefix-diff strategies and "pallas" needs the block-CSR
 #: layout — none is safe as a blanket default, so winners stay within
-#: the universally-valid {scan, scatter} set.)
-CONCRETE = ("scan", "cumsum", "mxsum", "scatter")
+#: the universally-valid {scan, scatter} set.  "mxscan" (ISSUE 11) is
+#: the blocked MXU segmented scan: valid on every csc path and on 1-D
+#: bucketed paths, reached through the scan-family refinement below.)
+CONCRETE = ("scan", "cumsum", "mxsum", "mxscan", "scatter")
 
 #: (platform, reduce) -> measured winner.  The chip battery
 #: (tools/chip_day.sh) is the only sanctioned way to change a tpu row.
@@ -285,6 +287,100 @@ def cf_err_dot_mode() -> str:
         return env
     rec = _overlay_raw().get(CF_DOT_KEY)
     return rec if rec in CF_DOT_MODES else "vpu"
+
+
+#: SCAN-FAMILY float-sum strategies the three-way ``tpu:sum`` race
+#: (tools/tpu_micro_race.py mxsum/mxscan/scan workers, bench.py's
+#: standing ``scan_micro_mx_vs_vpu`` row) may bank: "scan" = the VPU
+#: ``lax.associative_scan`` ladder (the shipped default), "mxsum" = the
+#: prefix-diff blocked triangular matmul (arXiv:1811.09736; global-
+#: prefix f32 caveat), "mxscan" = the segmented scan ITSELF as masked
+#: triangular MXU contractions (ops/pallas_scan, arXiv:2505.15112's
+#: blocked systolic scan; accumulation stays within a segment).  The
+#: three differ only in float-sum association (min/max/integer paths
+#: are bitwise), so like ``tpu:reduce_mode`` the VPU default is retired
+#: only through a banked on-chip measurement — never assumed.
+SUM_MODES = ("scan", "mxsum", "mxscan")
+
+#: overlay key the scan-family race banks its winner under.  The SAME
+#: key also carries the app-level bench race's blanket winner (which
+#: may be "scatter"): the two readers consume disjoint value domains —
+#: ``_file_winners`` follows {scan, scatter} as the blanket default,
+#: ``sum_mode`` follows SUM_MODES as the csc-path refinement — so one
+#: key stays coherent whichever race wrote last.
+SUM_MODE_KEY = "tpu:sum"
+
+
+def sum_mode(platform: str | None = None) -> str:
+    """The preferred scan-family float-sum strategy: LUX_SUM_MODE env
+    override (explicit choice, any platform), else the chip-measured
+    ``tpu:sum`` overlay entry ON TPU ONLY, else "scan" — the shipped
+    VPU default stays until a window measures, and CPU runs are
+    bitwise-unchanged by a banked TPU winner (the acceptance contract
+    of ISSUE 11)."""
+    env = os.environ.get("LUX_SUM_MODE")
+    if env:
+        if env not in SUM_MODES:
+            raise ValueError(
+                f"LUX_SUM_MODE must be one of {SUM_MODES}, got {env!r}")
+        return env
+    plat = _normalize(platform if platform is not None
+                      else default_platform())
+    rec = _overlay_raw().get(SUM_MODE_KEY)
+    if plat == "tpu" and rec in SUM_MODES:
+        return rec
+    return "scan"
+
+
+def record_sum_family_winner(winner: str) -> bool:
+    """Bank a scan-family race winner under ``tpu:sum`` — UNLESS the
+    key currently holds a measured "scatter" blanket winner.  The
+    scan-family races (micro race, bench's scan micro row) never time
+    scatter, so overwriting a full-race scatter measurement with a
+    family-internal winner would destroy the better datapoint (the
+    same chip-data-is-scarce rule behind record_overlay_entry's
+    deep-merge).  The full bench race's _record_winner times BOTH
+    domains and may overwrite freely.  Returns True when recorded."""
+    assert winner in SUM_MODES, winner
+    prev = _overlay_raw().get(SUM_MODE_KEY)
+    if prev == "scatter":
+        print(f"# tpu:sum holds a measured blanket 'scatter' winner; "
+              f"scan-family winner {winner!r} NOT banked over it "
+              "(raw times live in the micro rows)", flush=True)
+        return False
+    record_overlay_entry(SUM_MODE_KEY, winner)
+    return True
+
+
+def resolve_sum(method: str, reduce: str = "sum",
+                platform: str | None = None) -> str:
+    """``resolve`` plus the scan-family refinement for the csc
+    gather-apply engines (pull single-device + dist + the app CLIs;
+    push also routes through here, though every shipped push program
+    reduces with min/max, so the sum-only refinement is DORMANT there
+    until a sum-reduce push program exists): when an AUTO resolution
+    lands on the blanket "scan" default for a float SUM, the banked
+    ``tpu:sum`` scan-family winner (mxsum/mxscan) is followed instead.
+    Explicit concrete methods still pass through untouched, min/max
+    rows are untouched, and the bucketed ring/edge2d/feat DRIVERS keep
+    plain ``resolve`` (their scan/scatter asserts therefore never see
+    a refined winner; apps/common downgrades an auto-refined winner
+    before those exchanges, and ops/segment.segment_reduce_by_ends
+    downgrades for library callers who pass one explicitly).
+
+    A set LUX_SUM_MODE is an EXPLICIT user choice: under
+    ``method="auto"`` it wins for float sums on every platform — even
+    where the blanket resolution is "scatter" (the CPU default) — so
+    'LUX_SUM_MODE forces a flavor anywhere' (docs/PERF.md) holds from
+    every driver.  A BANKED winner (no env) still refines only the
+    blanket "scan" default, on TPU."""
+    if (method == "auto" and reduce == "sum"
+            and os.environ.get("LUX_SUM_MODE")):
+        return sum_mode(platform)  # validates + returns the env choice
+    resolved = resolve(method, reduce, platform)
+    if method == "auto" and reduce == "sum" and resolved == "scan":
+        return sum_mode(platform)
+    return resolved
 
 
 _tiles_cache: tuple | None = None

@@ -242,7 +242,7 @@ def compile_pull_step(prog: PullProgram, spec: ShardSpec, method: str = "auto",
     placed arrays are bound as ordinary jit arguments (already-on-device
     operands cost nothing per call — baking them in as closure constants
     would bloat the lowered module instead)."""
-    method = methods.resolve(method, prog.reduce)
+    method = methods.resolve_sum(method, prog.reduce)
     rs, ra = route if route is not None else (None, None)
     interpret = _route_interpret()
     if ra is None:
@@ -277,7 +277,7 @@ def compile_pull_phases(prog: PullProgram, spec: ShardSpec, method: str = "auto"
     fusion — this is the observability path; run_pull_fixed is the perf
     path.  Returns (load, comp, update).
     """
-    method = methods.resolve(method, prog.reduce)
+    method = methods.resolve_sum(method, prog.reduce)
 
     @jax.jit
     def load(arrays, state):
@@ -379,7 +379,7 @@ def run_pull_fixed(
     churn never recompiles (luxaudit LUX-J1 pins it).
     Returns the final stacked (P, V, ...) state.
     """
-    method = methods.resolve(method, prog.reduce)
+    method = methods.resolve_sum(method, prog.reduce)
     arrays = jax.tree.map(jnp.asarray, arrays)
     rs, ra = route if route is not None else (None, None)
     if ra is not None:
@@ -505,7 +505,7 @@ def run_pull_until(
     state in, iterate the overlay step until quiescent.
     Returns (final_state, num_iters_run).
     """
-    method = methods.resolve(method, prog.reduce)
+    method = methods.resolve_sum(method, prog.reduce)
     arrays = jax.tree.map(jnp.asarray, arrays)
     rs, ra = route if route is not None else (None, None)
     if ra is not None:

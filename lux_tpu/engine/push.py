@@ -427,7 +427,7 @@ def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
     pin the first platform resolution for the process and split the cache
     between "auto" and its concrete equivalent."""
     return _compile_push_chunk_cached(
-        prog, pspec, spec, methods.resolve(method, prog.reduce),
+        prog, pspec, spec, methods.resolve_sum(method, prog.reduce),
         donate=donate, telemetry=telemetry, ostatic=overlay_static,
     )
 
@@ -442,7 +442,7 @@ def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
     from lux_tpu.engine.pull import _route_interpret
 
     return _compile_push_chunk_cached(
-        prog, pspec, spec, methods.resolve(method, prog.reduce),
+        prog, pspec, spec, methods.resolve_sum(method, prog.reduce),
         route_static=route_static, interpret=_route_interpret(),
         donate=donate, telemetry=telemetry, ostatic=overlay_static,
     )
@@ -514,7 +514,7 @@ def compile_push_phases(prog, pspec: PushSpec, spec: ShardSpec,
                         method: str = "auto"):
     """Uncached resolution shim — see compile_push_chunk."""
     return _compile_push_phases_cached(
-        prog, pspec, spec, methods.resolve(method, prog.reduce)
+        prog, pspec, spec, methods.resolve_sum(method, prog.reduce)
     )
 
 
@@ -558,7 +558,7 @@ def compile_push_step(prog, pspec: PushSpec, spec: ShardSpec, method: str = "aut
     timers, sssp_gpu.cu:513-518).  The carry is donated (state/queue
     double buffers reuse HBM)."""
     return _compile_push_step_cached(
-        prog, pspec, spec, methods.resolve(method, prog.reduce)
+        prog, pspec, spec, methods.resolve_sum(method, prog.reduce)
     )
 
 
@@ -604,7 +604,7 @@ def run_push(
     (bitwise no-op on the results; the return gains the fetched ring).
     Returns (final stacked state, iters, edge counter[, ring]).
     """
-    method = methods.resolve(method, prog.reduce)
+    method = methods.resolve_sum(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     arrays = jax.tree.map(jnp.asarray, shards.arrays)
     parrays = jax.tree.map(jnp.asarray, shards.parrays)
@@ -849,7 +849,7 @@ def compile_push_phases_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
     The phase bodies are the SAME _spmd_push_* the fused engines use.
     Observability path; _compile_push_dist is the perf path."""
     return _compile_push_phases_dist_cached(
-        prog, mesh, pspec, spec, methods.resolve(method, prog.reduce)
+        prog, mesh, pspec, spec, methods.resolve_sum(method, prog.reduce)
     )
 
 
@@ -1081,11 +1081,11 @@ def run_push_ring(
     dense rounds' streamed-block gathers as routed lane shuffles —
     bitwise-identical (note its plan-footprint SCALE NOTE: the routed
     mode trades the O(nv/P) memory story for hot-loop speed)."""
-    method = methods.resolve(method, prog.reduce)
+    method = methods.resolve_sum(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts % mesh.devices.size == 0
     assert method in ("scan", "scatter"), (
-        "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
+        segment.BUCKETED_METHODS_NOTE
     )
     rarrays, parrays, view, carry0 = ring_init_dist(prog, shards, mesh)
     if route is None:
@@ -1117,7 +1117,7 @@ def run_push_dist(
     rounds) exchanged over ICI inside the on-device loop.  ``route``
     (an expand plan on the pull layout) replays the dense rounds'
     gather as routed shuffles — bitwise-identical."""
-    method = methods.resolve(method, prog.reduce)
+    method = methods.resolve_sum(method, prog.reduce)
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts % mesh.devices.size == 0
     arrays, parrays, carry0 = push_init_dist(prog, shards, mesh)

@@ -1,0 +1,222 @@
+"""mxscan: MXU-resident blocked segmented inclusive scan (ISSUE 11).
+
+The float segmented sums of both engines bottom out in
+``lax.associative_scan`` on the VPU (ops/segment.py "scan") — a
+log-depth elementwise ladder whose every level re-materializes the full
+edge array.  arXiv:2505.15112's blocked systolic scan is the scan-side
+twin of mxreduce's one-hot reduction (arXiv:1811.09736, ISSUE 7): tile
+the array into 128-lane rows, compute each row's inclusive prefix as a
+triangular matmul on the MXU, and propagate one carried offset through
+the sequential grid.  Segment restarts fold into the SAME contraction
+by masking the triangular operand with the head flags, so
+``segment_*_csc`` consume the scanned array through the unchanged
+ends-gather — no separate correction pass.
+
+Mechanics, per (tile_rows, 128) VMEM tile of the flattened edge array
+(one Pallas kernel, one HBM read of the values + one write of the
+scanned array — the floor the VPU ladder's "2 sweeps" accounting only
+aspires to):
+
+  * the packed head/pad byte tile is split into head flags ``h`` and the
+    padding mask, and the tile-wide inclusive head COUNT is one
+    ``(B, 128) x (128, 128)`` triangular matmul (counts <= 128: exact in
+    f32);
+  * per 128-lane row, the segmented-scan operand
+    ``M[i, j] = (j <= i) & (c[j] == c[i])`` is the triangular operand
+    masked by the head flags (equal inclusive head counts == same
+    segment; ``c`` is monotone, so the mask is two broadcast compares);
+  * float sums contract ``row @ M^T`` on the MXU — bf16 operands only
+    where exact (M is 0/1; bf16 values are already bf16; f32 values stay
+    f32), f32 accumulation ALWAYS, one rounding to the value dtype at
+    the tile write;
+  * min/max and INTEGER sums never touch the MXU: the same masked
+    layout reduces on the VPU, dtype-preserving and BITWISE equal to
+    the ladder scan (order-insensitive combiners);
+  * the inter-tile offset (the running value of the segment left open at
+    a tile boundary) lives in a (1, 1) VMEM scratch carried across the
+    sequential grid — reset at ``program_id(0) == 0``, so the kernel
+    vmaps over parts unchanged (the batch grid dim is prepended and the
+    tile axis keeps its program_id).
+
+Precision caveat (shared with mxreduce's contraction): the float-sum
+matmul multiplies EVERY in-row value by its 0/1 mask entry, so a
+non-finite value poisons its whole row (0 * Inf = NaN).  Padding slots
+are neutralized in-kernel via the packed pad bit; real values must be
+finite — true of every shipped sum program (pagerank ranks, CF errors).
+min/max keep Inf semantics exactly (masked select, no multiply).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from lux_tpu.ops.pallas_shuffle import (LANE, _compiler_params,
+                                        _mx_neutral)
+
+#: packed head/pad byte: bit 0 = segment head, bit 1 = padding slot
+HEAD_BIT = 1
+PAD_BIT = 2
+
+
+def _mxscan_defaults(tile_rows=None) -> int:
+    """Scan-tile rows (LUX_MXSCAN_TILE_ROWS, default 8): rows per kernel
+    tile — the kernel unrolls one masked contraction per row, exactly
+    like mxreduce's LUX_MX_TILE_ROWS.  Read at TRACE time and baked into
+    the compiled program, never at replay."""
+    from lux_tpu.utils.config import env_int
+
+    if tile_rows is None:
+        tile_rows = env_int("LUX_MXSCAN_TILE_ROWS", 8, minimum=1,
+                            maximum=256)
+    if tile_rows & (tile_rows - 1):
+        raise ValueError(
+            f"LUX_MXSCAN_TILE_ROWS must be a power of two (tile and pad "
+            f"geometry divide each other), got {tile_rows}")
+    return tile_rows
+
+
+def _scan_kernel(op: str, tb: int, x_ref, hv_ref, o_ref, carry_ref):
+    """One (tb, 128) tile: masked triangular intra-row scan + the carried
+    inter-tile offset.  ``carry_ref`` is (1, 1) VMEM scratch holding the
+    scanned value at the end of the previous row/tile."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    x = x_ref[:]
+    float_sum = op == "sum" and jnp.issubdtype(x.dtype, jnp.floating)
+    neutral = _mx_neutral(op, x.dtype)
+
+    @pl.when(i == 0)
+    def _():
+        carry_ref[:, :] = jnp.full_like(
+            carry_ref, _mx_neutral(op, carry_ref.dtype))
+
+    hv = hv_ref[:].astype(jnp.int32)
+    h = (hv & HEAD_BIT).astype(jnp.float32)
+    pad = (hv & PAD_BIT) != 0
+    # neutralize padding BEFORE any contraction: junk pad values may be
+    # Inf/NaN and 0 * NaN = NaN would poison the row's matmul (the same
+    # rule as mxreduce's sentinel masking)
+    xm = jnp.where(pad, neutral, x)
+    # inclusive head count per row: C[r, i] = sum_{j<=i} h[r, j] — ONE
+    # (tb, 128) x (128, 128) triangular matmul, exact in f32 (<= 128)
+    io0 = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 0)
+    io1 = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 1)
+    tri_incl = (io0 <= io1).astype(jnp.float32)  # tri[j, i] = j <= i
+    c = jax.lax.dot_general(
+        h, tri_incl, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (tb, 128): C[r, i] = sum_j h[r, j] * (j <= i)
+    ct = jnp.transpose(c)          # (128, tb): c[j] addressable per row
+    if not float_sum:
+        xt = jnp.transpose(xm)     # (128, tb): values as columns
+    cd = jnp.bfloat16 if x.dtype == jnp.bfloat16 else jnp.float32
+    carry = carry_ref[:, :]
+    for r in range(tb):
+        c_row = c[r:r + 1, :]          # (1, 128): c[i] along lanes
+        c_col = ct[:, r:r + 1]         # (128, 1): c[j] along sublanes
+        no_head = c_row == 0.0         # carry applies before row's 1st head
+        if float_sum:
+            # M[i, j] = (j <= i) & (c[j] >= c[i]): the triangular operand
+            # masked by the head flags (c monotone: >= on j <= i <=> ==)
+            m = (io1 <= io0) & (c_row >= c_col)
+            y = jax.lax.dot_general(
+                xm[r:r + 1, :].astype(cd), m.astype(cd),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (1, 128): y[0, i] = sum_j x[j] * M[i, j], f32 accumulate
+            y = y + jnp.where(no_head, carry, jnp.float32(0.0))
+        else:
+            # masked VPU layout: Mt[j, i] = (j <= i) & (c[j] >= c[i])
+            mt = (io0 <= io1) & (c_col >= c_row)
+            masked = jnp.where(mt, xt[:, r:r + 1], neutral)
+            red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[op]
+            y = red(masked, axis=0, keepdims=True)  # (1, 128)
+            if op == "sum":
+                y = y + jnp.where(no_head, carry,
+                                  jnp.zeros((), x.dtype))
+            elif op == "min":
+                y = jnp.where(no_head, jnp.minimum(y, carry), y)
+            else:
+                y = jnp.where(no_head, jnp.maximum(y, carry), y)
+        carry = y[:, LANE - 1:LANE]
+        o_ref[r:r + 1, :] = y.astype(o_ref.dtype)
+    carry_ref[:, :] = carry
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("op", "tile_rows", "interpret"))
+def mxscan_segmented(vals, head_flag, invalid, op: str = "sum",
+                     tile_rows: int | None = None,
+                     interpret: bool | None = None):
+    """Segmented inclusive scan of ``vals`` (E,) with restarts at
+    ``head_flag`` slots — the drop-in for ``ops.segment._segmented_scan``
+    on 1-D values.  ``invalid`` (E,) bool marks padding slots whose
+    values must be neutralized in-kernel (csc callers: slot index >=
+    row_ptr[-1]; bucketed callers: dst_local == num_segments); invalid
+    slots' OUTPUTS are unspecified, exactly like the ladder scan's
+    padding outputs, and are never read by the ends gathers.
+
+    Returns the scanned array in ``vals.dtype`` (float sums accumulate
+    in f32 and round once per tile row on the way out).
+    """
+    if op not in ("sum", "min", "max"):
+        raise ValueError(f"mxscan op must be sum|min|max, got {op!r}")
+    if vals.ndim != 1:
+        raise ValueError(
+            "mxscan_segmented is a 1-D kernel; (E, K)-valued reductions "
+            "keep the VPU scan (ops/segment dispatches the fallback)")
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tb = _mxscan_defaults(tile_rows)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    n = vals.shape[0]
+    if n == 0:
+        return vals
+    hv = (head_flag.astype(jnp.uint8) * jnp.uint8(HEAD_BIT)  # luxcheck: disable=LUX-P003 -- flag BYTE (values 0-3), a mask operand never used as a gather index
+          | invalid.astype(jnp.uint8) * jnp.uint8(PAD_BIT))  # luxcheck: disable=LUX-P003 -- same packed flag byte, second bit
+    unit = tb * LANE
+    padn = (-n) % unit
+    if padn:
+        vals = jnp.pad(vals, (0, padn))
+        hv = jnp.pad(hv, (0, padn), constant_values=PAD_BIT)
+    rows = vals.shape[0] // LANE
+    x2 = vals.reshape(rows, LANE)
+    hv2 = hv.reshape(rows, LANE)
+    float_sum = op == "sum" and jnp.issubdtype(vals.dtype, jnp.floating)
+    carry_dtype = jnp.float32 if float_sum else vals.dtype
+    spec = pl.BlockSpec((tb, LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_scan_kernel, op, tb),
+        grid=(rows // tb,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, vals.dtype),
+        scratch_shapes=[pltpu.VMEM((1, 1), carry_dtype)],
+        compiler_params=_compiler_params(
+            pltpu,
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(x2, hv2)
+    out = out.reshape(-1)
+    return out[:n] if padn else out
+
+
+def mxscan_residency_bytes(tile_rows: int, val_bytes: int = 4) -> int:
+    """VMEM residency of one mxscan kernel instance — the LUX-J4 ledger
+    entry (analysis/ir/vmem.check_vmem_mxscan).  Streamed operands
+    double-buffer through the Pallas pipeline: the value tile (in + out)
+    and the packed head/pad byte tile; on top live the tile-wide head
+    count and its transpose (f32), the transposed value tile (VPU
+    path bound), the per-row (128, 128) masked triangular operand plus
+    its compare/select twin, and the (1, 1) carry."""
+    tile = 2 * tile_rows * LANE * (2 * val_bytes + 1)
+    counts = 2 * tile_rows * LANE * 4          # C + C^T, f32
+    xt = tile_rows * LANE * val_bytes          # transposed values
+    masks = 2 * LANE * LANE * 4                # iota/compare + masked op
+    return tile + counts + xt + masks + 8

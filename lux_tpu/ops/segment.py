@@ -20,6 +20,18 @@ atomics):
                   (B,T)x(T,T) contraction + a recursive block-offset scan
                   instead of a log-depth elementwise ladder.  Same global-
                   prefix precision caveat as ``cumsum``.
+  * ``mxscan``  — the SEGMENTED scan itself as blocked triangular MXU
+                  contractions (lux_tpu.ops.pallas_scan, ISSUE 11;
+                  arXiv:2505.15112's blocked systolic scan): one Pallas
+                  kernel, head flags masking the triangular operand so
+                  restarts fold into the contraction, a carried
+                  inter-tile offset through the sequential grid.  Float
+                  sums accumulate in f32 (own deterministic association,
+                  like mxsum vs scan — tolerance-equal to ``scan``);
+                  min/max and integer sums use the same masked layout on
+                  the VPU, BITWISE equal to ``scan``.  1-D values only:
+                  (E, K) shapes fall back to ``scan`` (bitwise-identical
+                  to asking for ``scan``).
   * ``scatter`` — `segment_sum/min/max` with sorted ids (XLA scatter).
 
 All take static-shape padded inputs from lux_tpu.graph.shards.
@@ -53,34 +65,73 @@ def _ends_gather(scanned, row_ptr, neutral):
     return jnp.where(nonempty, scanned[safe], neutral)
 
 
+#: the ONE copy of the bucketed exchange drivers' method-assert text
+#: (push-ring / pull-ring / scatter / feat share the invariant, so
+#: they must share the words — a drifting copy would state false
+#: guidance about where refined winners go)
+BUCKETED_METHODS_NOTE = (
+    "bucketed (row_ptr-free) exchange drivers accept method='scan' "
+    "or 'scatter' only (--method / LUX_BENCH_METHOD); auto-resolved "
+    "scan-family winners (LUX_SUM_MODE: mxsum/mxscan) never reach "
+    "this driver — they refine through resolve_sum on the csc "
+    "engines, and apps/common downgrades them before these "
+    "exchanges — so pass 'scan' or 'scatter' explicitly")
+
+
+def _mxscan_csc(vals, row_ptr, head_flag, op):
+    """The mxscan scanned array for a csc-encoded segment reduction:
+    slots at or past row_ptr[-1] are padding and neutralize in-kernel
+    (lux_tpu.ops.pallas_scan precision caveat)."""
+    from lux_tpu.ops import pallas_scan
+
+    invalid = (jnp.arange(vals.shape[0], dtype=row_ptr.dtype)
+               >= row_ptr[-1])
+    return pallas_scan.mxscan_segmented(vals, head_flag, invalid, op=op)
+
+
 MX_BLOCK = 512  # triangular-matmul tile for the mxsum cumsum
 
 
 def matmul_cumsum(x: jnp.ndarray, block: int = MX_BLOCK) -> jnp.ndarray:
-    """Inclusive 1-D cumsum as blocked triangular matmuls (MXU-friendly;
-    arXiv:1811.09736 construction): per-block prefix = x2 @ L^T with L
-    lower-triangular ones, block offsets by recursing on the block sums.
-    f32 accumulation throughout."""
+    """Inclusive cumsum along axis 0 as blocked triangular matmuls
+    (MXU-friendly; arXiv:1811.09736 construction): per-block prefix =
+    x2 @ L^T with L lower-triangular ones, block offsets by recursing on
+    the block sums.  f32 accumulation throughout.  (E, K) values ride
+    the same contraction with K batched along the free axis — this lifts
+    the former 1-D-only restriction that silently degraded ``mxsum`` to
+    a plain cumsum for CF/feat-shaped values (ISSUE 11)."""
     n = x.shape[0]
     if n == 0:
         return x
     pad = (-n) % block
-    xp = jnp.pad(x, (0, pad))
+    xp = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
     nb = xp.shape[0] // block
-    x2 = xp.reshape(nb, block)
     tri = jnp.tril(jnp.ones((block, block), jnp.float32))
-    intra = jax.lax.dot_general(
-        x2.astype(jnp.float32), tri,
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (nb, block): intra[b, i] = sum_{j<=i} x2[b, j]
-    tots = intra[:, -1]
+    if x.ndim == 1:
+        x2 = xp.reshape(nb, block)
+        intra = jax.lax.dot_general(
+            x2.astype(jnp.float32), tri,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (nb, block): intra[b, i] = sum_{j<=i} x2[b, j]
+        tots = intra[:, -1]
+    else:
+        x2 = xp.reshape((nb, block) + x.shape[1:])
+        x2 = x2.reshape(nb, block, -1)  # (nb, block, K)
+        intra = jax.lax.dot_general(
+            x2.astype(jnp.float32), tri,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (nb, K, block): intra[b, k, i] = sum_{j<=i} x2[b, j, k]
+        intra = jnp.swapaxes(intra, 1, 2)  # (nb, block, K)
+        tots = intra[:, -1, :]
     if nb > block:
         incl = matmul_cumsum(tots, block)
     else:
-        incl = jnp.cumsum(tots)
+        incl = jnp.cumsum(tots, axis=0)
     offs = incl - tots  # exclusive block offsets
-    return (intra + offs[:, None]).reshape(-1)[:n].astype(x.dtype)
+    out = intra + offs[:, None]
+    return out.reshape((-1,) + x.shape[1:])[:n].astype(x.dtype)
 
 
 def segment_sum_csc(
@@ -91,6 +142,11 @@ def segment_sum_csc(
     method: str = "scan",
 ) -> jnp.ndarray:
     """Sum ``vals`` (edge-aligned, (E,) or (E, K)) per destination -> (V, ...)."""
+    if method == "mxscan" and vals.ndim > 1:
+        method = "scan"  # the blocked kernel is 1-D (module docstring)
+    if method == "mxscan":
+        scanned = _mxscan_csc(vals, row_ptr, head_flag, "sum")
+        return _ends_gather(scanned, row_ptr, jnp.zeros((), vals.dtype))
     if method == "scan":
         flag = head_flag
         if vals.ndim > 1:
@@ -98,7 +154,7 @@ def segment_sum_csc(
         scanned = _segmented_scan(vals, jnp.broadcast_to(flag, vals.shape), jnp.add)
         return _ends_gather(scanned, row_ptr, jnp.zeros((), vals.dtype))
     if method in ("cumsum", "mxsum"):
-        if method == "mxsum" and vals.ndim == 1:
+        if method == "mxsum":
             c = matmul_cumsum(vals)
         else:
             c = jnp.cumsum(vals, axis=0)
@@ -111,7 +167,11 @@ def segment_sum_csc(
             _scatter_dtype(vals), dst_local, num_segments=row_ptr.shape[0] - 1,
             indices_are_sorted=True,
         ).astype(vals.dtype)
-    raise ValueError(method)
+    raise ValueError(
+        f"segment_sum_csc: unknown method {method!r}; accepted: 'scan', "
+        "'mxscan', 'cumsum', 'mxsum', 'scatter' (--method / "
+        "LUX_BENCH_METHOD; the scan-family refinement is LUX_SUM_MODE, "
+        "engine/methods.sum_mode)")
 
 
 def _scatter_dtype(vals: jnp.ndarray) -> jnp.ndarray:
@@ -126,6 +186,12 @@ def _scatter_dtype(vals: jnp.ndarray) -> jnp.ndarray:
 
 
 def _segment_minmax(vals, row_ptr, head_flag, dst_local, op, neutral, method):
+    if method == "mxscan" and vals.ndim > 1:
+        method = "scan"  # the blocked kernel is 1-D (module docstring)
+    if method == "mxscan":
+        scanned = _mxscan_csc(vals, row_ptr, head_flag,
+                              "min" if op is jnp.minimum else "max")
+        return _ends_gather(scanned, row_ptr, neutral)
     if method == "scan":
         flag = head_flag
         if vals.ndim > 1:
@@ -139,7 +205,11 @@ def _segment_minmax(vals, row_ptr, head_flag, dst_local, op, neutral, method):
             _scatter_dtype(vals), dst_local, num_segments=row_ptr.shape[0] - 1,
             indices_are_sorted=True,
         ).astype(vals.dtype)
-    raise ValueError(method)
+    raise ValueError(
+        f"segment min/max: unknown method {method!r}; accepted: 'scan', "
+        "'mxscan' (bitwise — min/max stay on the masked VPU path), "
+        "'scatter' (cumsum/mxsum are sum-only prefix-diff strategies); "
+        "set via --method / LUX_BENCH_METHOD or LUX_SUM_MODE")
 
 
 def segment_reduce_by_ends(
@@ -162,6 +232,16 @@ def segment_reduce_by_ends(
     ``dst_local == num_segments`` (dropped by the scatter).  Empty
     destinations get the reduce's neutral element, matching the
     *_csc reducers.
+
+    Accepted methods: ``scan``, ``scatter``, and ``mxscan`` (ISSUE 11 —
+    the blocked MXU scan replaces the VPU ladder for 1-D values, using
+    the dst_local sentinel as its padding mask).  ``cumsum``/``mxsum``
+    DOWNGRADE to ``scan``: the prefix-diff strategies need a row_ptr the
+    bucketed encoding deliberately doesn't have — so a blanket
+    scan-family winner (engine/methods.sum_mode) stays safe on every
+    layout, with the bucketed paths running exactly the shipped VPU
+    scan.  ``mxscan`` on (E, K) values downgrades the same way (1-D
+    kernel).
     """
     if reduce == "sum":
         op, neutral = jnp.add, jnp.zeros((), vals.dtype)
@@ -196,13 +276,25 @@ def segment_reduce_by_ends(
             _scatter_dtype(vals), dst_local, num_segments=num_segments,
             indices_are_sorted=True,
         ).astype(vals.dtype)
-    if method != "scan":
+    if method in ("cumsum", "mxsum") or (method == "mxscan"
+                                         and vals.ndim > 1):
+        method = "scan"  # see docstring: prefix-diff needs a row_ptr
+    if method == "mxscan":
+        from lux_tpu.ops import pallas_scan
+
+        scanned = pallas_scan.mxscan_segmented(
+            vals, head_flag, dst_local >= num_segments, op=reduce)
+    elif method == "scan":
+        flag = head_flag.reshape(head_flag.shape + (1,) * (vals.ndim - 1))
+        scanned = _segmented_scan(vals, jnp.broadcast_to(flag, vals.shape),
+                                  op)
+    else:
         raise ValueError(
-            f"method {method!r}: bucketed (row_ptr-free) reductions support "
-            "'scan' and 'scatter' only"
-        )
-    flag = head_flag.reshape(head_flag.shape + (1,) * (vals.ndim - 1))
-    scanned = _segmented_scan(vals, jnp.broadcast_to(flag, vals.shape), op)
+            f"segment_reduce_by_ends: unknown method {method!r}; "
+            "bucketed (row_ptr-free) reductions accept 'scan', 'scatter' "
+            "and 'mxscan' ('cumsum'/'mxsum' downgrade to 'scan' — "
+            "prefix-diff needs a row_ptr); set via --method / "
+            "LUX_BENCH_METHOD or LUX_SUM_MODE (engine/methods.sum_mode)")
     # an edge is its segment's end iff the next slot starts a new segment
     # (head_flag is True at position 0 of every segment, including the
     # first padding slot after the real edges)

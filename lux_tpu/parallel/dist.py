@@ -106,7 +106,7 @@ def run_pull_fixed_dist(
     from lux_tpu.engine import methods
     from lux_tpu.engine.pull import _route_interpret
 
-    method = methods.resolve(method, prog.reduce)
+    method = methods.resolve_sum(method, prog.reduce)
     assert spec.num_parts % mesh.devices.size == 0, (spec.num_parts, mesh.shape)
     arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, arrays))
     state0 = shard_stacked(mesh, state0)
@@ -139,7 +139,7 @@ def compile_pull_phases_dist(prog, mesh, method: str = "auto"):
     from lux_tpu.engine import methods
 
     return _compile_phases_dist_cached(
-        prog, mesh, methods.resolve(method, prog.reduce)
+        prog, mesh, methods.resolve_sum(method, prog.reduce)
     )
 
 
@@ -243,7 +243,7 @@ def run_pull_until_dist(
     """
     from lux_tpu.engine import methods
 
-    method = methods.resolve(method, prog.reduce)
+    method = methods.resolve_sum(method, prog.reduce)
     assert spec.num_parts % mesh.devices.size == 0, (spec.num_parts, mesh.shape)
     arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, arrays))
     state0 = shard_stacked(mesh, state0)

@@ -318,7 +318,9 @@ def _place_edge2d(shards: Edge2DShards, state0, mesh: Mesh, method: str):
     assert mesh.shape[PARTS_AXIS] == spec.num_parts
     assert mesh.shape[EDGE_AXIS] == shards.num_edge_shards
     assert method in ("scan", "scatter"), (
-        "edge-sharded chunks carry no row_ptr; use 'scan' or 'scatter'"
+        "edge-sharded chunks carry no row_ptr: method='scan' or "
+        "'scatter' only (--method / LUX_BENCH_METHOD; LUX_SUM_MODE "
+        "winners downgrade to 'scan' on this layout)"
     )
     edge_sh = NamedSharding(mesh, P(PARTS_AXIS, EDGE_AXIS))
     vtx_sh = NamedSharding(mesh, P(PARTS_AXIS))

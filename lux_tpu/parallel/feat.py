@@ -285,7 +285,7 @@ def run_cf_feat_ring(
     assert prog.reduce == "sum"
     assert len(shards.parts_subset) == spec.num_parts
     assert method in ("scan", "scatter"), (
-        "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
+        segment.BUCKETED_METHODS_NOTE
     )
     arr_sh = NamedSharding(mesh, P(PARTS_AXIS))
     st_sh = NamedSharding(mesh, P(PARTS_AXIS, None, FEAT_AXIS))

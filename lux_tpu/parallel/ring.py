@@ -430,7 +430,7 @@ def run_pull_fixed_ring(
         "hosts (multihost.assemble_global) before driving"
     )
     assert method in ("scan", "scatter"), (
-        "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
+        segment.BUCKETED_METHODS_NOTE
     )
     rarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.rarrays))
     vtx_mask = shard_stacked(mesh, jnp.asarray(shards.arrays.vtx_mask))

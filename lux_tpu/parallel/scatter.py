@@ -277,7 +277,7 @@ def run_pull_fixed_scatter(
         "across hosts (multihost.assemble_global) before driving"
     )
     assert method in ("scan", "scatter"), (
-        "bucketed (row_ptr-free) reductions support 'scan' and 'scatter'"
+        segment.BUCKETED_METHODS_NOTE
     )
     sarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, shards.sarrays))
     vtx_mask = shard_stacked(mesh, jnp.asarray(shards.arrays.vtx_mask))

@@ -15,9 +15,11 @@ Q.  A leading-axis vmap replays the index work per query — measured ~2x
 SLOWER per query than sequential runs on the CPU fallback, while the
 trailing layout measures >10x FASTER at Q=64 (tools/serve_bench.py).
 
-Numerics: every reducer strategy (scan/scatter/cumsum/mxsum) combines
-along the edge axis with query lanes independent, so column q of a
-batched run is BITWISE equal to a single-query run.  For SSSP the
+Numerics: every reducer strategy (scan/scatter/cumsum/mxsum; "mxscan"
+falls back to the VPU scan bitwise — the batched (E, Q) value shape is
+outside its 1-D kernel) combines along the edge axis with query lanes
+independent, so column q of a batched run is BITWISE equal to a
+single-query run.  For SSSP the
 converged distances are additionally a unique fixpoint of min-relaxation,
 so the dense-iteration loop below lands on exactly the distances the
 direction-optimized push engine (engine/push.py) produces —

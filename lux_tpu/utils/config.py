@@ -129,10 +129,13 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
     ap.add_argument("-check", "-c", action="store_true")
     ap.add_argument("--max-iters", type=int, default=10_000)
     ap.add_argument("--method", default="auto",
-                    choices=["auto", "scan", "cumsum", "mxsum", "scatter",
-                             "pallas"],
+                    choices=["auto", "scan", "cumsum", "mxsum", "mxscan",
+                             "scatter", "pallas"],
                     help="segment-reduction strategy; auto = the measured "
-                         "per-platform winner (engine.methods)")
+                         "per-platform winner (engine.methods; float sums "
+                         "additionally refine through the banked tpu:sum "
+                         "scan-family winner, engine/methods.sum_mode / "
+                         "LUX_SUM_MODE)")
     ap.add_argument("--distributed", action="store_true",
                     help="shard parts over the device mesh")
     ap.add_argument("--rmat-scale", type=int, default=16)

@@ -63,6 +63,10 @@ class TrafficModel:
 PALLAS_V_BLK = 512
 #: default mxsum block size (ops/segment.py MX_BLOCK) — MACs per value
 MXSUM_T = 512
+#: mxscan triangular tile (ops/pallas_scan: the 128-lane row) — MACs
+#: per scanned value in EACH of its two per-row contractions (the
+#: head-count matmul + the masked value contraction)
+MXSCAN_T = 128
 
 
 def _reduce_bytes_per_edge(method: str, sb: int, w: int) -> float:
@@ -75,6 +79,12 @@ def _reduce_bytes_per_edge(method: str, sb: int, w: int) -> float:
         # the value array (log-depth ladder touches tiles repeatedly;
         # 2 passes is the optimistic floor) + the flag byte
         return 2 * v + 1
+    if method == "mxscan":
+        # blocked MXU segmented scan (ops/pallas_scan): ONE kernel —
+        # value read + scanned write (the floor "scan" only aspires to:
+        # the ladder's 2 is unattainable, the kernel's 2 is exact) +
+        # the flag byte read + the packed head/pad byte (write + read)
+        return 2 * v + 3
     if method == "scatter":
         # sorted segment_* scatter: value read + accumulator read/write
         # per edge (random by dst) + dst ids
@@ -99,6 +109,10 @@ def _reduce_device_flops_per_edge(method: str, w: int) -> int:
         return 2 * PALLAS_V_BLK * w  # V_BLK MACs to sum one value
     if method == "mxsum":
         return 2 * MXSUM_T * w  # T MACs per prefix value
+    if method == "mxscan":
+        # two per-row contractions (head count + masked values), T MACs
+        # per scanned value each
+        return 2 * 2 * MXSCAN_T * w
     return w  # element-wise reduce: 1 op per value lane
 
 
@@ -166,9 +180,13 @@ def _route_counts(r) -> tuple[int, int]:
 
 
 #: COMP-phase full-array HBM sweeps by reduce strategy (the v-coefficient
-#: of _reduce_bytes_per_edge: value-array read/write passes)
-REDUCE_HBM_PASSES = {"scan": 2, "cumsum": 2, "mxsum": 2, "scatter": 3,
-                     "pallas": 1}
+#: of _reduce_bytes_per_edge: value-array read/write passes).  mxscan's
+#: 2 is EXACT — one Pallas kernel, one value read + one scanned write,
+#: enforced by luxaudit LUX-J501 kernel counting — where scan's 2 is the
+#: optimistic floor of a log-depth ladder (measured materializations:
+#: docs/PERF.md "MXU scan" accounting table).
+REDUCE_HBM_PASSES = {"scan": 2, "cumsum": 2, "mxsum": 2, "mxscan": 2,
+                     "scatter": 3, "pallas": 1}
 
 
 def routed_hbm_passes(static, method: str = "scan") -> dict:

@@ -42,7 +42,8 @@ def test_bench_happy_path_multi_app():
     for ln in lines:
         assert ln["unit"] == (
             "QPS" if "_qps_" in ln["metric"]
-            else "ms/iter" if ln["metric"].startswith("reduce_micro")
+            else "ms/iter" if ln["metric"].startswith(("reduce_micro",
+                                                       "scan_micro"))
             else "x" if "_refresh_" in ln["metric"]
             else "GTEPS")
         assert ln["value"] > 0
@@ -52,6 +53,12 @@ def test_bench_happy_path_multi_app():
                  if ln["metric"].startswith("reduce_micro"))
     assert set(micro["flavor_ms"]) == {"group", "mxreduce"}
     assert micro["winner"] in micro["flavor_ms"]
+    # the standing scan-family micro row (ISSUE 11): all three flavors
+    # timed (each oracle-gated), a winner named, in the DEFAULT output
+    smicro = next(ln for ln in lines
+                  if ln["metric"].startswith("scan_micro"))
+    assert set(smicro["flavor_ms"]) == {"scan", "mxsum", "mxscan"}
+    assert smicro["winner"] in smicro["flavor_ms"]
     qps = next(ln for ln in lines if "_qps_" in ln["metric"])
     assert qps["batched_vs_q1"] > 0 and qps["scheduler"]["completed"] > 0
     cf = next(ln for ln in lines if ln["metric"].startswith("colfilter"))
@@ -227,6 +234,39 @@ def test_record_winner_skips_sortseg_ab(tmp_path, monkeypatch):
     monkeypatch.delenv("LUX_BENCH_SORT_SEGMENTS")
     bench._record_winner(results)
     assert json.loads(f.read_text())["tpu:sum"] == "scan"
+
+
+def test_record_winner_family_requires_micro_gate(tmp_path, monkeypatch):
+    """The full-scale race times, it never checks numerics — so a
+    scan-family winner (mxsum/mxscan) may be banked as tpu:sum ONLY
+    when this machine's oracle-gated micro row already verified it
+    (ISSUE 11 review fix: a banked winner is always a verified one)."""
+    import json as _json
+
+    sys.path.insert(0, os.path.dirname(BENCH))
+    import bench
+
+    from lux_tpu.engine import methods
+
+    f = tmp_path / "w.json"
+    monkeypatch.setenv("LUX_METHOD_WINNERS", str(f))
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
+    results = {("mxscan", "float32"): 0.5, ("scan", "float32"): 1.0,
+               ("scatter", "float32"): 2.0}
+    bench._record_winner(results)
+    # no oracle-gated micro row on this machine: the unverified family
+    # winner is NOT trusted; the fastest blanket-safe method is banked
+    assert _json.loads(f.read_text())["tpu:sum"] == "scan"
+    methods.record_overlay_entry(
+        "tpu:micro_scan",
+        {"ms_per_iter": {"scan": 1.0, "mxsum": 1.0, "mxscan": 0.5}})
+    bench._record_winner(results)
+    assert _json.loads(f.read_text())["tpu:sum"] == "mxscan"
+    monkeypatch.setattr(methods, "_overlay_raw_cache", None)
+    monkeypatch.setattr(methods, "_file_winners_cache", None)
+    monkeypatch.setattr(methods, "_tiles_cache", None)
 
 
 class _StuckProc:

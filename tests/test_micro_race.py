@@ -24,7 +24,9 @@ def test_micro_race_cpu(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     rows = [json.loads(s) for s in r.stdout.splitlines()
             if s.startswith("{")]
-    assert {row["method"] for row in rows} == {"mxsum", "scan"}
+    # the default race is the three-way scan family (ISSUE 11): the
+    # banked tpu:sum winner requires ALL of them to measure
+    assert {row["method"] for row in rows} == {"mxsum", "mxscan", "scan"}
     for row in rows:
         assert row["micro"] == "segment_sum"
         # toy scale: slope noise may go negative; the field must exist

@@ -170,9 +170,14 @@ echo "relay gate: 8083 accepts"
 #    tpu:reduce_mode), and the "cfdotvpu"/"cfdotmxu" pair races the CF
 #    error-dot as VPU lane-sum vs a true MXU matmul tile (banks
 #    tpu:cf_err_dot).  All exactness-gated against their oracles.
+#    Round-8 addition (ISSUE 11): "mxscan" — the blocked MXU segmented
+#    scan (ops/pallas_scan) — completes the three-way scan-family race;
+#    scan+mxsum+mxscan together bank tpu:sum (the sum_mode winner the
+#    csc engines follow).  mxscan runs second-to-last (new Pallas
+#    kernel); scan stays last (the observed tunnel-wedger).
 run micro_race 3600 python tools/tpu_micro_race.py \
     --methods mxsum gather route routepf fused fusedpf fusedmx \
-              cfdotvpu cfdotmxu gatherc scan \
+              cfdotvpu cfdotmxu gatherc mxscan scan \
     --outdir "$LOG/micro"
 grep -q '"ms_per_rep"' "$LOG/micro_race.out" || {
   python tools/obs_span.py point battery.abort reason=tunnel_dead 2>/dev/null

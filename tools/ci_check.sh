@@ -94,6 +94,48 @@ print('mxreduce bitwise (f32-exact) == fused;',
       'sweeps', pf['total'], '->', mx['total'])
 "
 
+# 3a1) mxscan interpret smoke (ISSUE 11): the blocked MXU segmented
+#      scan must be BITWISE equal to the VPU ladder for int32 and
+#      min/max, within the documented tolerance for f32 sums, and the
+#      row_ptr-free bucketed path must agree too
+stage mxscan_smoke 300 env JAX_PLATFORMS=cpu python -c "
+import numpy as np, jax.numpy as jnp
+from lux_tpu.graph import generate
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.ops import segment
+g = generate.rmat(8, 8, seed=11)
+sh = build_pull_shards(g, 1)
+a = sh.arrays
+rng = np.random.default_rng(0)
+rp, hf = jnp.asarray(a.row_ptr[0]), jnp.asarray(a.head_flag[0])
+dl = jnp.asarray(a.dst_local[0])
+e_pad = a.src_pos.shape[1]
+iv = jnp.asarray(rng.integers(-999, 999, e_pad).astype(np.int32))
+for fn in (segment.segment_sum_csc, segment.segment_min_csc,
+           segment.segment_max_csc):
+    ref = np.asarray(fn(iv, rp, hf, dl, method='scan'))
+    got = np.asarray(fn(iv, rp, hf, dl, method='mxscan'))
+    assert (ref == got).all(), fn
+fv = jnp.asarray(rng.random(e_pad).astype(np.float32))
+ref = np.asarray(segment.segment_sum_csc(fv, rp, hf, dl, method='scan'))
+got = np.asarray(segment.segment_sum_csc(fv, rp, hf, dl, method='mxscan'))
+assert np.allclose(ref, got, rtol=1e-4, atol=1e-5)
+from lux_tpu.parallel.ring import mark_bucket_heads
+V, m, B = 37, 60, 128
+dlb = np.sort(rng.integers(0, V, m)).astype(np.int32)
+dst = np.full(B, V, np.int32); dst[:m] = dlb
+head = np.zeros(B, bool); mark_bucket_heads(head, dlb)
+vals = np.zeros(B, np.float32); vals[:m] = rng.random(m) + 0.5
+r2 = np.asarray(segment.segment_reduce_by_ends(
+    jnp.asarray(vals), jnp.asarray(head), jnp.asarray(dst), V,
+    reduce='sum', method='scan'))
+g2 = np.asarray(segment.segment_reduce_by_ends(
+    jnp.asarray(vals), jnp.asarray(head), jnp.asarray(dst), V,
+    reduce='sum', method='mxscan'))
+assert np.allclose(r2, g2, rtol=1e-5, atol=1e-6)
+print('mxscan bitwise (int/min/max) == scan; f32 within tolerance')
+"
+
 # 3a2) mutate smoke (ISSUE 10): small graph -> 1% churn via the
 #      delta-log -> warm overlay refresh -> compact -> the refreshed
 #      distances AND the compacted graph arrays must be bitwise equal
@@ -186,7 +228,8 @@ echo "$out" | grep -q "fleet.republish" || { echo "missing republish"; exit 1; }
 stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
     -m 'not slow' -p no:cacheprovider \
     tests/test_luxcheck.py tests/test_native.py tests/test_expand.py \
-    tests/test_passfuse.py tests/test_mxreduce.py tests/test_obs.py \
+    tests/test_passfuse.py tests/test_mxreduce.py tests/test_mxscan.py \
+    tests/test_obs.py \
     tests/test_determinism.py tests/test_serve_scheduler.py \
     tests/test_fleet.py tests/test_mutate.py
 

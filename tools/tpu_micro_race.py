@@ -34,6 +34,14 @@ MXU matmul tile) banks ``tpu:cf_err_dot`` — each worker refuses to emit
 a row that fails its NumPy-oracle gate, so a banked winner is always a
 numerically-verified one.
 
+Round-8 (ISSUE 11): "mxscan" — the segmented scan itself as blocked
+masked-triangular MXU contractions (ops/pallas_scan) — joins the
+segment-sum workers, and every segment-sum worker is now oracle-gated.
+The THREE-WAY scan-family race (scan vs mxsum vs mxscan on one census)
+banks ``tpu:sum`` only when all three flavors measure; the banked
+winner retires the VPU default through engine/methods.sum_mode on the
+csc gather-apply paths (CPU runs stay bitwise-unchanged).
+
 Usage: python tools/tpu_micro_race.py [--scale 17] [--methods mxsum scan]
        (worker mode: --worker --method M, spawned internally)
 """
@@ -217,6 +225,28 @@ def worker_main(args) -> int:
             acc = segment.segment_sum_csc(
                 vals, row_ptr, head_flag, dst_local, method=args.method)
             return acc * 0.999
+
+        # exactness gate (ISSUE 11): every segment-sum worker must match
+        # the NumPy f64 oracle before its time counts — the three-way
+        # tpu:sum race (scan/mxsum/mxscan) only banks numerically
+        # verified rows.  rtol covers each strategy's own deterministic
+        # f32 association (mxsum's global prefix is the loosest).
+        got = np.asarray(jax.jit(
+            lambda x: segment.segment_sum_csc(
+                vals_fixed * x[0], row_ptr, head_flag, dst_local,
+                method=args.method))(state))
+        s0 = float(np.asarray(state)[0])
+        want = np.zeros(g.nv, np.float64)
+        np.add.at(want, np.asarray(dst_local),
+                  np.asarray(vals_fixed, np.float64) * s0)
+        # atol scales with ne * f32-eps: the prefix-diff strategies'
+        # documented global-prefix cancellation bound (measured at its
+        # edge for mxsum/cumsum; scan/mxscan sit ~100x under it)
+        atol = max(1e-5, g.ne * 6e-7)
+        ok = bool(np.allclose(got[: g.nv], want, rtol=1e-3, atol=atol))
+        print(f"# {args.method} numerics vs oracle: {ok}", flush=True)
+        if not ok:
+            return 3
     platform = jax.devices()[0].platform
     print(f"# micro worker: platform={platform} method={args.method} "
           f"nv={g.nv} ne={g.ne} setup={time.perf_counter()-t_setup:.1f}s",
@@ -264,8 +294,12 @@ def main(argv=None):
     ap.add_argument("--scale", type=int, default=17)
     ap.add_argument("--ef", type=int, default=16)
     ap.add_argument("--reps", type=int, nargs="+", default=[1, 8, 32])
-    ap.add_argument("--methods", nargs="+", default=["mxsum", "scan"],
-                    help="race order; the risky method belongs LAST")
+    ap.add_argument("--methods", nargs="+",
+                    default=["mxsum", "mxscan", "scan"],
+                    help="race order; the risky method belongs LAST "
+                         "(scan — the one observed tunnel-wedger; "
+                         "mxscan is the new Pallas kernel, second to "
+                         "last)")
     ap.add_argument("--method", help="(worker mode) single method to time")
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--per-method-s", type=int,
@@ -373,6 +407,25 @@ def main(argv=None):
                 "tpu:micro_cfdot",
                 {"scale": args.scale, "winner": dot,
                  "ms_per_rep": {"vpu": t_vpu, "mxu": t_mxu}})
+        # the three-way tpu:sum scan-family race (ISSUE 11): the banked
+        # winner is followed by engine/methods.sum_mode on the csc
+        # gather-apply paths.  Banked ONLY when ALL THREE flavors
+        # measured (each already oracle-gated in its worker): a partial
+        # race must not retire the shipped VPU default on a guess.
+        fam = {m: rows.get(m, {}).get("ms_per_rep", 0)
+               for m in methods.SUM_MODES}
+        if all(t > 0 for t in fam.values()):
+            win = min(fam, key=fam.get)
+            # never clobbers a measured blanket 'scatter' winner (this
+            # race does not time scatter)
+            methods.record_sum_family_winner(win)
+            methods.record_overlay_entry(
+                "tpu:micro_scan",
+                {"scale": args.scale, "winner": win, "ms_per_rep": fam})
+        else:
+            missing = [m for m, t in fam.items() if t <= 0]
+            print(f"# tpu:sum NOT banked (unmeasured flavors: {missing})",
+                  flush=True)
     else:
         print(f"# not on tpu ({platforms}); overlay not recorded", flush=True)
     return 0
