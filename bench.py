@@ -43,8 +43,14 @@ Env knobs:
   LUX_BENCH_CPU_SCALE (default min(scale, 18)) fallback worker's RMAT scale
                    — a 1-core CPU needs a smaller graph to finish in budget
   LUX_BENCH_APPS   (default pagerank,sssp,components,colfilter,serve,ba,
-                   refresh) which app metrics to measure; pagerank is the
-                   headline and always prints last.  "refresh" is the
+                   refresh,live) which app metrics to measure; pagerank
+                   is the headline and always prints last.  "live" is
+                   the mutation-aware serving row (lux_tpu.serve.live,
+                   ISSUE 12): sssp_live_w2_* — a 2-worker thread-mode
+                   live fleet under a concurrent writer + closed-loop
+                   readers (write batches/s, read QPS, read-staleness
+                   generations p50/p99, fleet warm-refresh latency;
+                   LUX_BENCH_LIVE_SCALE, default 12).  "refresh" is the
                    dynamic-graph row family (lux_tpu.mutate, ISSUE 10):
                    pagerank_refresh_churn1pct_* / sssp_refresh_churn1pct_*
                    — warm overlay refresh after 1% edge churn vs a cold
@@ -431,7 +437,7 @@ def worker_main():
         a.strip()
         for a in os.environ.get(
             "LUX_BENCH_APPS",
-            "pagerank,sssp,components,colfilter,serve,ba,refresh",
+            "pagerank,sssp,components,colfilter,serve,ba,refresh,live",
         ).split(",")
         if a.strip()
     ]
@@ -816,6 +822,27 @@ def worker_main():
                 **roofline.summarize(model, elapsed, iters * gb.ne),
             }
         )
+
+    def measure_live():
+        """Standing mixed read/write serving row (ISSUE 12,
+        lux_tpu.serve.live): a 2-worker thread-mode LIVE fleet under
+        concurrent writer + closed-loop readers — sustained write
+        batches/s through admit->journal->replicate, read QPS, read
+        staleness in generations (p50/p99 of journal-gen-at-submit
+        minus the answer's generation tag), and the fleet-wide warm
+        refresh latency.  CPU loopback by design, like the fleet rows:
+        the write path is host coordination + O(delta) overlay
+        rebuilds, bankable with no chip window."""
+        from lux_tpu.serve.live.bench import measure_live_mixed
+
+        lscale = _env_int("LUX_BENCH_LIVE_SCALE", 12)
+        row = measure_live_mixed(scale=lscale, workers=2)
+        _emit_row(row)
+        print(f"# live: {row['value']} read QPS, "
+              f"{row['write_batches_per_s']} write batches/s, "
+              f"staleness p99 {row['staleness_gen_p99']} gen, "
+              f"fleet refresh {row['fleet_refresh_s']}s",
+              file=sys.stderr, flush=True)
 
     def measure_refresh():
         """Standing dynamic-graph rows (ISSUE 10, lux_tpu.mutate):
@@ -1442,6 +1469,24 @@ def worker_main():
                 measure_fleet()
             except Exception as e:  # noqa: BLE001
                 print(f"# fleet failed: {e}", file=sys.stderr, flush=True)
+    if "live" in apps:
+        # the mutation-aware serving row (ISSUE 12): its own thread-mode
+        # fleet on its own graph; same isolation rule as serve/fleet
+        # (live workers bind the default pull layout) and the same
+        # budget gate as ba/refresh (host-side, but minutes are minutes)
+        if layout_ab:
+            print("# live row skipped: layout A/B run", file=sys.stderr,
+                  flush=True)
+        elif (on_tpu and time.monotonic() - t_worker0
+                > 0.75 * _env_int("LUX_BENCH_TPU_S", 600)):
+            print("# live row skipped: budget mostly spent",
+                  file=sys.stderr, flush=True)
+        else:
+            try:
+                measure_live()
+            except Exception as e:  # noqa: BLE001
+                print(f"# live row failed: {e}", file=sys.stderr,
+                      flush=True)
     if "refresh" in apps:
         # dynamic-graph refresh rows (ISSUE 10): own graph + 8-part
         # layout; jax.clear_caches() inside the cold legs recompiles

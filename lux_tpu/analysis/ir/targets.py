@@ -360,6 +360,37 @@ def _retrace_serve(app: str) -> List[Finding]:
     return out
 
 
+def _retrace_serve_overlay() -> List[Finding]:
+    """ISSUE 12's LUX-J1 guardrail on the SERVING loop: the overlay-twin
+    batched fixpoint (the live fleet's query path) across delta-buffer
+    occupancies — empty / half / full are pure data, so all three must
+    trace byte-identically; a churn batch must never recompile a warm
+    Q-bucket engine."""
+    import jax.numpy as jnp
+
+    from lux_tpu.serve import batched
+
+    ovs = _overlay_fixture()
+    fx = fixture()
+    spec = fx["shards"].spec
+    prog = batched.make_program("sssp", spec.nv)
+    path = "lux_tpu/serve/batched.py"
+    label = "serve-sssp/overlay"
+
+    def traced(key):
+        os_, oa = _dev_overlay(ovs[key])
+        run = batched._compile_batched_fixpoint(prog, spec, "scan", os_)
+        queries = jnp.zeros((4,), jnp.int32)
+        s0 = batched._compile_batched_init(prog)(fx["arrays"], queries)
+        return run.trace(fx["arrays"], queries, s0, jnp.int32(4), oa)
+
+    out = retrace.trace_twice_stable(lambda: traced("half"), path,
+                                     label)
+    out += retrace.check_variants(
+        [traced(k) for k in ("empty", "half", "full")], path, label)
+    return out
+
+
 def _retrace_serve_dynamic() -> List[Finding]:
     """max_iters is a dynamic operand of the serve loops: re-calls with
     a different stop must not recompile (the scheduler varies it)."""
@@ -834,6 +865,9 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
         AuditUnit("retrace", "serve-sssp/max_iters",
                   "lux_tpu/serve/batched.py", False,
                   _retrace_serve_dynamic),
+        AuditUnit("retrace", "serve-sssp/overlay",
+                  "lux_tpu/serve/batched.py", False,
+                  _retrace_serve_overlay),
         AuditUnit("donation", "pull-fixed/donate",
                   "lux_tpu/engine/pull.py", True, _donation_pull_fixed),
         AuditUnit("donation", "pull-until/donate",

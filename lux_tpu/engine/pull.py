@@ -159,10 +159,9 @@ def local_pull_step(
 
     if overlay is not None and route is not None and isinstance(
             route[0], (expand.FusedStatic, expand.CFRouteStatic)):
-        raise ValueError(
-            "mutation overlays compose with the direct gather and the "
-            "routed EXPAND plans only; fused/CF plans bake the reduce "
-            "layout at plan time — compact instead")
+        from lux_tpu.mutate.overlay import FUSED_OVERLAY_NOTE
+
+        raise ValueError(FUSED_OVERLAY_NOTE)
     if route is not None and isinstance(route[0], expand.CFRouteStatic):
         gath = expand.apply_cf_route(full_state, local_state, route[0],
                                      route[1], interpret=interpret)

@@ -42,6 +42,21 @@ LANE = 128
 #: default per-part insert capacity (slots) when LUX_DELTA_CAP is unset
 DEFAULT_CAP = 1024
 
+#: the ONE overlay-vs-plan-family rejection message (engine/pull.py and
+#: any future fused consumer raise it): it must name the escape hatches,
+#: not just the incompatibility — a serving operator hitting this mid-
+#: incident needs the next command, not a design note.
+FUSED_OVERLAY_NOTE = (
+    "mutation overlays compose with the direct gather and the routed "
+    "EXPAND plan family only (plan_expand_shards / --route-gather "
+    "expand|expand-pf, i.e. route_base=\"expand\"); fused/CF plans bake "
+    "the reduce layout at plan time, so tombstones cannot neutralize "
+    "per-edge values there.  Escape hatches: (1) re-plan the route with "
+    "route_base=\"expand\" (LUX_ROUTE_MODE=routed or routed-pf keeps "
+    "the overlay-compatible family; pass-fusion is preserved), or "
+    "(2) compact() the MutableGraph — the merged base serves any plan "
+    "family again (capacity knob: LUX_DELTA_CAP)")
+
 
 def delta_cap(cap: Optional[int] = None) -> int:
     """Resolve the per-part delta-buffer capacity: explicit argument,
@@ -211,6 +226,26 @@ def build_pull_overlay(shards, dlog: DeltaLog, cap: Optional[int] = None):
         d_weight[rows, slot] = iw[order].astype(np.float32)
     return static, OverlayArrays(del_val, d_src_pos, d_dst_local,
                                  d_weight)
+
+
+def empty_overlay_arrays(shards, cap: Optional[int] = None) -> OverlayArrays:
+    """The zero-churn OverlayArrays for a shard bundle: no tombstones,
+    every insert slot empty (nv_pad dst sentinel).  An engine compiled
+    with an OverlayStatic but handed these arrays is BITWISE equal to
+    the no-overlay engine — the warm path live serving starts from
+    before any delta arrives (and what a freshly republished replica
+    resets to)."""
+    arrays = shards.arrays
+    P = arrays.src_pos.shape[0]
+    e_pad = arrays.src_pos.shape[1]
+    nv_pad = arrays.vtx_mask.shape[1]
+    D = delta_cap(cap)
+    return OverlayArrays(
+        del_val=np.zeros((P, e_pad), bool),
+        d_src_pos=np.zeros((P, D), np.int32),
+        d_dst_local=np.full((P, D), nv_pad, np.int32),
+        d_weight=np.zeros((P, D), np.float32),
+    )
 
 
 def occupancy(shards, dlog: DeltaLog, cap: Optional[int] = None) -> dict:

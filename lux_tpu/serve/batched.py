@@ -135,19 +135,44 @@ class MultiSourcePPR(QueryProgram):
 
 
 def _batched_iteration(prog, spec: ShardSpec, method, arrays, state,
-                       queries):
-    """One batched pull iteration over the whole (P, V, Q) shard stack."""
+                       queries, oarrays=None):
+    """One batched pull iteration over the whole (P, V, Q) shard stack.
+
+    ``oarrays`` (lux_tpu.mutate.overlay.OverlayArrays, vmapped with the
+    shards) runs the step against the MUTATING graph: tombstoned base
+    edges neutralize their (E, Q) values (reduce identity — exact for
+    min/max, IEEE no-op addend for sums), then the fixed-capacity insert
+    buffer gathers D extra source rows ((D, Q) — every query lane sees
+    every insert) and scatter-combines them into the accumulator before
+    apply.  Shapes are static, so delta occupancy never retraces the
+    serving loop (the same LUX-J1 contract the one-shot engines pin)."""
     full = state.reshape((spec.gathered_size,) + state.shape[2:])
     reducer = segment.reducers()[prog.reduce]
 
-    def part(arr, loc):
+    def part(arr, loc, oa=None):
         src = full[arr.src_pos]  # (E, Q)
         vals = prog.edge_value(src, arr.weights)
+        if oa is not None:
+            from lux_tpu.mutate import overlay as _ovl
+
+            # (E,) mask against (E, Q) values: one broadcast lane axis
+            vals = _ovl.mask_deleted(vals, oa.del_val[:, None],
+                                     prog.reduce)
         acc = reducer(vals, arr.row_ptr, arr.head_flag, arr.dst_local,
                       method=method)
+        if oa is not None:
+            from lux_tpu.mutate import overlay as _ovl
+
+            acc = _ovl.delta_scatter(
+                acc, full, oa,
+                lambda s, w: prog.edge_value(s, w), prog.reduce)
         return prog.apply(loc, acc, arr, queries)
 
-    return jax.vmap(part)(arrays, state)
+    if oarrays is None:
+        return jax.vmap(lambda arr, loc: part(arr, loc))(arrays, state)
+    return jax.vmap(
+        lambda arr, loc, oa: part(arr, loc, oa=oa)
+    )(arrays, state, oarrays)
 
 
 def _batched_init(prog, arrays, queries):
@@ -172,59 +197,84 @@ def _compile_batched_init(prog):
 
 
 @lru_cache(maxsize=64)
-def _compile_batched_fixpoint(prog, spec: ShardSpec, method: str):
+def _compile_batched_fixpoint(prog, spec: ShardSpec, method: str,
+                              overlay_static=None):
     """Jitted multi-query fixpoint loop: iterate while ANY query is still
     changing; per-query round counters freeze as queries converge.  The
     compiled program is shape-specialized on Q (the warm cache keys on
     the Q bucket for exactly this reason).  ``state0`` (from
     _compile_batched_init) is DONATED — luxaudit LUX-J2 asserts the
-    alias lands in the lowered module."""
+    alias lands in the lowered module.  ``overlay_static``
+    (mutate.overlay.OverlayStatic) compiles the mutation-overlay twin:
+    the loop takes a trailing ``oarrays`` pytree and serves the merged
+    graph — one trace per capacity, occupancy is data."""
 
-    @partial(jax.jit, donate_argnums=2)
-    def run(arrays, queries, state0, max_iters):
-        q = queries.shape[0]
-
-        def cond(c):
-            _, it, active, _ = c
-            return (it < max_iters) & jnp.any(active > 0)
-
-        def body(c):
-            state, it, active, rounds = c
-            new = _batched_iteration(prog, spec, method, arrays, state,
-                                     queries)
-            changed = jnp.sum(
-                (new != state).astype(jnp.int32), axis=(0, 1)
-            )  # (Q,)
-            # a query active at iteration entry walked every edge this
-            # round; converged queries' counters stay frozen
-            rounds = rounds + (active > 0).astype(jnp.int32)
-            return new, it + 1, changed, rounds
-
-        state, it, _, rounds = jax.lax.while_loop(
-            cond, body,
-            (state0, jnp.int32(0), jnp.ones((q,), jnp.int32),
-             jnp.zeros((q,), jnp.int32)),
-        )
-        return state, it, rounds
+    if overlay_static is None:
+        @partial(jax.jit, donate_argnums=2)
+        def run(arrays, queries, state0, max_iters):
+            return _fixpoint_body(prog, spec, method, arrays, queries,
+                                  state0, max_iters)
+    else:
+        @partial(jax.jit, donate_argnums=2)
+        def run(arrays, queries, state0, max_iters, oarrays):
+            return _fixpoint_body(prog, spec, method, arrays, queries,
+                                  state0, max_iters, oarrays)
 
     return run
 
 
+def _fixpoint_body(prog, spec, method, arrays, queries, state0, max_iters,
+                   oarrays=None):
+    q = queries.shape[0]
+
+    def cond(c):
+        _, it, active, _ = c
+        return (it < max_iters) & jnp.any(active > 0)
+
+    def body(c):
+        state, it, active, rounds = c
+        new = _batched_iteration(prog, spec, method, arrays, state,
+                                 queries, oarrays=oarrays)
+        changed = jnp.sum(
+            (new != state).astype(jnp.int32), axis=(0, 1)
+        )  # (Q,)
+        # a query active at iteration entry walked every edge this
+        # round; converged queries' counters stay frozen
+        rounds = rounds + (active > 0).astype(jnp.int32)
+        return new, it + 1, changed, rounds
+
+    state, it, _, rounds = jax.lax.while_loop(
+        cond, body,
+        (state0, jnp.int32(0), jnp.ones((q,), jnp.int32),
+         jnp.zeros((q,), jnp.int32)),
+    )
+    return state, it, rounds
+
+
 @lru_cache(maxsize=64)
-def _compile_batched_fixed(prog, spec: ShardSpec, method: str):
+def _compile_batched_fixed(prog, spec: ShardSpec, method: str,
+                           overlay_static=None):
     """Jitted fixed-iteration multi-query loop (ppr-style apps);
-    ``state0`` donated exactly like the fixpoint twin."""
+    ``state0`` donated and ``overlay_static`` compiling the overlay twin
+    exactly like the fixpoint factory."""
 
-    @partial(jax.jit, donate_argnums=2)
-    def run(arrays, queries, state0, num_iters):
-
+    def _body(arrays, queries, state0, num_iters, oarrays=None):
         def body(_, state):
             return _batched_iteration(prog, spec, method, arrays, state,
-                                      queries)
+                                      queries, oarrays=oarrays)
 
         state = jax.lax.fori_loop(0, num_iters, body, state0)
         q = queries.shape[0]
         return state, num_iters, jnp.full((q,), num_iters, jnp.int32)
+
+    if overlay_static is None:
+        @partial(jax.jit, donate_argnums=2)
+        def run(arrays, queries, state0, num_iters):
+            return _body(arrays, queries, state0, num_iters)
+    else:
+        @partial(jax.jit, donate_argnums=2)
+        def run(arrays, queries, state0, num_iters, oarrays):
+            return _body(arrays, queries, state0, num_iters, oarrays)
 
     return run
 
@@ -255,11 +305,19 @@ class BatchedEngine:
     """One compiled batched engine bound to a (shards, app, Q, method)
     tuple.  ``run`` answers exactly ``q`` queries per call (the scheduler
     pads short batches); ``warm()`` executes one dummy batch so the XLA
-    compile happens at service start, not on the first request."""
+    compile happens at service start, not on the first request.
+
+    ``overlay_static`` (mutate.overlay.OverlayStatic) builds the LIVE
+    twin: every ``run`` then REQUIRES the current OverlayArrays (and,
+    for degree-consuming programs like ppr, the merged degree stack) —
+    an engine compiled for a mutating graph must never silently answer
+    from the base graph.  Occupancy is data: empty through full buffers
+    hit one compiled program."""
 
     def __init__(self, shards: PullShards, app: str, q: int,
                  method: str = "auto", num_iters: int = 10,
-                 max_iters: int = 10_000, device_arrays=None):
+                 max_iters: int = 10_000, device_arrays=None,
+                 overlay_static=None):
         if q < 1:
             raise ValueError(f"q must be >= 1, got {q}")
         self.shards = shards
@@ -269,6 +327,7 @@ class BatchedEngine:
         self.method = methods.resolve(method, self.prog.reduce)
         self.num_iters = num_iters
         self.max_iters = max_iters
+        self.overlay_static = overlay_static
         # ``device_arrays``: a pre-placed shard tree SHARED across
         # engines (the warm cache passes one per layout) — without it
         # every (app, Q-bucket) engine would hold its own full copy of
@@ -278,31 +337,47 @@ class BatchedEngine:
         self._init = _compile_batched_init(self.prog)
         if self.prog.fixpoint:
             self._run = _compile_batched_fixpoint(
-                self.prog, shards.spec, self.method)
+                self.prog, shards.spec, self.method, overlay_static)
             self._stop = max_iters
         else:
             self._run = _compile_batched_fixed(
-                self.prog, shards.spec, self.method)
+                self.prog, shards.spec, self.method, overlay_static)
             self._stop = num_iters
         self._warmed = False
         self._warm_lock = threading.Lock()
 
-    def warm(self) -> "BatchedEngine":
+    def _empty_oarrays(self):
+        from lux_tpu.mutate import overlay as _ovl
+
+        return jax.tree.map(jnp.asarray, _ovl.empty_overlay_arrays(
+            self.shards, self.overlay_static.cap))
+
+    def warm(self, oarrays=None) -> "BatchedEngine":
         """Trace + compile + execute one dummy batch (queries = vertex 0).
         Serialized: concurrent pumps (scheduler thread + a draining
-        caller) must not duplicate a multi-second compile."""
+        caller) must not duplicate a multi-second compile.  An overlay
+        engine warms against the given (or the empty) OverlayArrays —
+        same trace as any occupancy."""
         with self._warm_lock:
             if not self._warmed:
                 q0 = jnp.zeros((self.q,), jnp.int32)
+                extra = ()
+                if self.overlay_static is not None:
+                    extra = (oarrays if oarrays is not None
+                             else self._empty_oarrays(),)
                 out = self._run(self._arrays, q0,
                                 self._init(self._arrays, q0),
-                                jnp.int32(1))
+                                jnp.int32(1), *extra)
                 jax.block_until_ready(out[0])
                 self._warmed = True
         return self
 
-    def run(self, queries) -> BatchedResult:
-        """Answer ``queries`` ((q,) int vertex ids) -> BatchedResult."""
+    def run(self, queries, oarrays=None, degree=None) -> BatchedResult:
+        """Answer ``queries`` ((q,) int vertex ids) -> BatchedResult.
+        ``oarrays``: the current mutation OverlayArrays (required iff the
+        engine was built with ``overlay_static``).  ``degree``: merged
+        (P, V) out-degree stack substituting the base degrees for
+        degree-consuming programs (an ordinary array arg — no retrace)."""
         queries = np.asarray(queries, np.int32)
         if queries.shape != (self.q,):
             raise ValueError(
@@ -310,12 +385,23 @@ class BatchedEngine:
         nv = self.shards.spec.nv
         if queries.size and (queries.min() < 0 or queries.max() >= nv):
             raise ValueError(f"query vertex out of range [0, {nv})")
+        if (self.overlay_static is None) != (oarrays is None):
+            # mirror engine/push.py's pairing guard: a silently-ignored
+            # overlay would serve base-graph answers under a live graph
+            raise ValueError(
+                "overlay_static and oarrays must be passed together: "
+                "BatchedEngine(..., overlay_static=ostatic) and "
+                "run(..., oarrays=oarr)")
         q_dev = jnp.asarray(queries)
+        arrays = self._arrays
+        if degree is not None:
+            arrays = arrays._replace(degree=jnp.asarray(degree))
+        extra = () if oarrays is None else (oarrays,)
         # the freshly-initialized state is donated to the loop: one
         # (P, V, Q) buffer in the hot loop, not two
         state, it, rounds = self._run(
-            self._arrays, q_dev, self._init(self._arrays, q_dev),
-            jnp.int32(self._stop))
+            arrays, q_dev, self._init(arrays, q_dev),
+            jnp.int32(self._stop), *extra)
         self._warmed = True
         rounds = np.asarray(rounds)
         # (P, V, Q) -> (nv, Q) -> (Q, nv); per-query traversed edges are

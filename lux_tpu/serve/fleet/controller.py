@@ -74,14 +74,36 @@ class FleetTimeoutError(FleetError, TimeoutError):
     """The request's deadline expired (in a worker queue or on the wire)."""
 
 
+class StaleReadError(FleetError):
+    """A ``min_generation`` read bound no live worker currently
+    satisfies (replication still in flight, or a recovering worker
+    mid-catch-up).  Retry, or read without the bound and accept the
+    generation tag the answer carries."""
+
+    def __init__(self, min_generation: int, best: int):
+        super().__init__(
+            f"no live worker has replicated generation {min_generation} "
+            f"yet (freshest replica serves {best}); retry or drop the "
+            "min_generation bound")
+        self.min_generation = int(min_generation)
+        self.best = int(best)
+
+
 class FleetFuture:
     """Handle to one fleet-routed query."""
 
     def __init__(self, app: str, source: int,
-                 timeout_ms: Optional[float]):
+                 timeout_ms: Optional[float],
+                 min_generation: Optional[int] = None):
         self.app = app
         self.source = int(source)
         self.timeout_ms = timeout_ms
+        #: read-your-writes bound: only workers whose applied mutation
+        #: generation is >= this may answer (None = any replica)
+        self.min_generation = min_generation
+        #: mutation generation the ANSWER reflects (None on a
+        #: static-snapshot fleet) — always >= min_generation when set
+        self.generation: Optional[int] = None
         self.worker_id: Optional[str] = None  # who answered
         self.rounds = 0
         self.traversed = 0
@@ -167,6 +189,11 @@ class _WorkerHandle:
         self.last_seen = time.monotonic()
         self.pending: Dict[str, _Pending] = {}
         self.reader: Optional[threading.Thread] = None
+        #: highest mutation generation this worker acknowledged as
+        #: SERVABLE (delta acks + heartbeats keep it fresh); the
+        #: min_generation routing bound filters on it.  0 on a
+        #: static-snapshot fleet — min_generation=None ignores it.
+        self.delta_gen = 0
 
 
 class FleetController:
@@ -435,11 +462,16 @@ class FleetController:
         return 10.0 * (1.0 + max(hints, default=0.0) / 8.0)
 
     def submit(self, source: int, app: str = "sssp",
-               timeout_ms: Optional[float] = None) -> FleetFuture:
+               timeout_ms: Optional[float] = None,
+               min_generation: Optional[int] = None) -> FleetFuture:
         """Route + dispatch one query; returns a FleetFuture.  Raises
         FleetRejectedError synchronously when the whole fleet is
-        saturated (admission backpressure), NoWorkersError when empty."""
-        fut = FleetFuture(app, source, timeout_ms)
+        saturated (admission backpressure), NoWorkersError when empty,
+        StaleReadError when ``min_generation`` (the read-your-writes
+        bound: only replicas that have applied that mutation generation
+        may answer) is ahead of every live replica."""
+        fut = FleetFuture(app, source, timeout_ms,
+                          min_generation=min_generation)
         with self._lock:
             self._counts["submitted"] += 1
         self._dispatch(fut, exclude=set(), sync_raise=True)
@@ -455,9 +487,17 @@ class FleetController:
         exclude = set(exclude)
         while True:
             cands = self._candidates(fut.app, fut.source, exclude)
-            usable = [h for h in cands if not h.saturated]
+            fresh = cands if fut.min_generation is None else [
+                h for h in cands if h.delta_gen >= fut.min_generation]
+            usable = [h for h in fresh if not h.saturated]
             if not usable:
-                if cands:  # alive but all saturated: fleet-level shed
+                if cands and not fresh:
+                    # replicas exist but none has caught up to the read
+                    # bound: a staleness miss, not load or absence
+                    err = StaleReadError(
+                        fut.min_generation,
+                        max(h.delta_gen for h in cands))
+                elif fresh:  # alive + fresh but saturated: fleet shed
                     with self._lock:
                         self._counts["shed"] += 1
                     err = FleetRejectedError(self._retry_after_ms())
@@ -494,6 +534,8 @@ class FleetController:
             fut.worker_id = handle.wid
             fut.rounds = int(msg.get("rounds", 0))
             fut.traversed = int(msg.get("traversed", 0))
+            gen = msg.get("generation")
+            fut.generation = None if gen is None else int(gen)
             with self._lock:
                 self._counts["completed"] += 1
             fut._resolve(result=arr)
@@ -556,6 +598,11 @@ class FleetController:
                 with self._lock:
                     h.last_hb = hb
                     h.saturated = sat
+                    if "delta_generation" in hb:
+                        # monotonic max: a heartbeat raced by a delta
+                        # ack must never move the routing bound BACK
+                        h.delta_gen = max(h.delta_gen,
+                                          int(hb["delta_generation"]))
                 if was != sat:
                     obs.point("fleet.saturation", worker=h.wid,
                               saturated=sat,
@@ -567,7 +614,8 @@ class FleetController:
 
     def republish(self, path: str, graph_id: Optional[str] = None,
                   prepare_timeout_s: float = 600.0,
-                  commit_timeout_s: float = 30.0) -> dict:
+                  commit_timeout_s: float = 30.0,
+                  base_generation: Optional[int] = None) -> dict:
         """Zero-downtime graph republish across the whole fleet.
 
         Two-phase: (1) every live worker prepares (load + prewarm the new
@@ -576,6 +624,11 @@ class FleetController:
         atomic cache-pointer swap — instant).  A failed prepare anywhere
         aborts with the old graph still serving everywhere; admission is
         never paused, so no request is ever rejected because of the swap.
+
+        ``base_generation``: for LIVE (mutation-aware) fleets, the
+        mutation generation the new snapshot embeds — workers stage a
+        fresh LiveReplica on that epoch base alongside the staged cache
+        (serve/live); a plain snapshot republish leaves it None.
         """
         from lux_tpu import obs
 
@@ -591,13 +644,15 @@ class FleetController:
         token = f"pub-{self._next_rid()}"
         with obs.span("fleet.republish", graph=gid, path=str(path),
                       token=token, workers=[h.wid for h in handles]):
+            prep_msg = {"op": "prepare", "path": str(path),
+                        "graph_id": gid, "token": token}
+            if base_generation is not None:
+                prep_msg["base_generation"] = int(base_generation)
             pendings = []
             for h in handles:
                 try:
                     pendings.append((h, self._send(
-                        h, {"op": "prepare", "path": str(path),
-                            "graph_id": gid, "token": token},
-                        _Pending("rpc"))))
+                        h, {**prep_msg}, _Pending("rpc"))))
                 except (ConnectionClosed, _HandedOff):
                     self._discard_staged(handles)
                     raise FleetError(

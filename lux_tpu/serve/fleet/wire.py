@@ -40,6 +40,20 @@ MAX_HEADER = 16 * 1024 * 1024
 MAX_PAYLOAD = 64 * 1024 * 1024
 
 
+def max_frame_bytes() -> int:
+    """The payload bound, env-tunable: ``LUX_FLEET_MAX_FRAME_MB``
+    (default 64 MiB).  Resolved per call so a worker process launched
+    with the knob and a controller sharing its environment agree — the
+    first hardening step toward non-loopback workers shipping bigger
+    snapshots/answers (ROADMAP item 2); both peers must raise it, since
+    a frame one side can send and the other refuses to receive is a
+    dropped connection, not an error reply."""
+    from lux_tpu.utils.config import env_int
+
+    return env_int("LUX_FLEET_MAX_FRAME_MB", MAX_PAYLOAD // (1024 * 1024),
+                   minimum=1) * 1024 * 1024
+
+
 class WireError(RuntimeError):
     """Malformed frame (bad length prefix, oversized, bad JSON)."""
 
@@ -89,10 +103,11 @@ class Conn:
     def send(self, msg: dict, arr: Optional[np.ndarray] = None) -> None:
         header = json.dumps(msg, separators=(",", ":")).encode("utf-8")
         payload = pack_array(arr) if arr is not None else b""
-        if len(header) > MAX_HEADER or len(payload) > MAX_PAYLOAD:
+        if len(header) > MAX_HEADER or len(payload) > max_frame_bytes():
             raise WireError(
                 f"frame too large: header={len(header)} "
-                f"payload={len(payload)}")
+                f"payload={len(payload)} (payload bound is "
+                "LUX_FLEET_MAX_FRAME_MB)")
         frame = _HDR.pack(len(header), len(payload)) + header + payload
         with self._send_lock:
             try:
@@ -103,8 +118,9 @@ class Conn:
     def recv(self) -> Tuple[dict, Optional[np.ndarray]]:
         """Next (message, array-or-None).  Single-reader only."""
         hl, pl = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
-        if hl > MAX_HEADER or pl > MAX_PAYLOAD:
-            raise WireError(f"frame length out of bounds: {hl}/{pl}")
+        if hl > MAX_HEADER or pl > max_frame_bytes():
+            raise WireError(f"frame length out of bounds: {hl}/{pl} "
+                            "(payload bound is LUX_FLEET_MAX_FRAME_MB)")
         try:
             msg = json.loads(_recv_exact(self._sock, hl).decode("utf-8"))
         except ValueError as e:

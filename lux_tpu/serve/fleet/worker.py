@@ -68,7 +68,8 @@ class ReplicaWorker:
                  host: str = "127.0.0.1", port: int = 0,
                  method: str = "auto", num_iters: int = 10,
                  max_iters: int = 10_000, max_wait_ms: float = 2.0,
-                 max_queue: int = 256, max_engines: Optional[int] = None):
+                 max_queue: int = 256, max_engines: Optional[int] = None,
+                 live=None):
         self.worker_id = str(worker_id)
         self.host = host
         self._req_port = int(port)
@@ -85,12 +86,22 @@ class ReplicaWorker:
         self._lock = threading.Lock()
         self._graph_id = str(graph_id)
         self._generation = 0
-        # (cache, graph_id, token): token ties the staged cache to the
-        # ONE republish that requested it — a slow prepare finishing
-        # after an abort/discard (or after a newer prepare superseded
-        # it) must never stage, or a later commit would swap in the
-        # WRONG graph
-        self._staged: Optional[Tuple[WarmEngineCache, str, str]] = None
+        #: serve/live.LiveReplica -> this worker serves a MUTATING
+        #: graph: the cache compiles overlay twins, ``delta`` batches
+        #: install new overlays (never a retrace or swap), ``refresh``
+        #: warms the standing states, and answers carry generation tags.
+        #: _live_lock serializes the write path (delta apply, refresh,
+        #: live commit) — queries never take it, they read the cache's
+        #: atomic overlay tuple
+        self._live = live
+        self._live_lock = threading.Lock()
+        # (cache, graph_id, token, staged LiveReplica | None): token
+        # ties the staged cache to the ONE republish that requested it
+        # — a slow prepare finishing after an abort/discard (or after a
+        # newer prepare superseded it) must never stage, or a later
+        # commit would swap in the WRONG graph
+        self._staged: Optional[
+            Tuple[WarmEngineCache, str, str, object]] = None
         self._publish_token: Optional[str] = None
         self._cache = self._make_cache(shards)
         self._scheds: Dict[str, MicroBatchScheduler] = {
@@ -108,12 +119,18 @@ class ReplicaWorker:
         self._resp_wake = threading.Condition(self._lock)
         self._unanswered: List[tuple] = []
 
-    def _make_cache(self, shards) -> WarmEngineCache:
-        return WarmEngineCache(
+    def _make_cache(self, shards, live=None) -> WarmEngineCache:
+        live = live if live is not None else self._live
+        cache = WarmEngineCache(
             shards, apps=self.apps, q_buckets=self.q_buckets,
             method=self._method, num_iters=self._num_iters,
             max_iters=self._max_iters, metrics=self.metrics,
-            max_engines=self._max_engines)
+            max_engines=self._max_engines,
+            overlay_static=None if live is None else live.overlay_static)
+        if live is not None:
+            oarr, deg = live.serving_overlay()
+            cache.set_overlay(live.servable_generation(), oarr, deg)
+        return cache
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -220,11 +237,11 @@ class ReplicaWorker:
     def _conn_loop(self, conn: Conn) -> None:
         while self._running:
             try:
-                msg, _arr = conn.recv()
+                msg, arr = conn.recv()
             except (ConnectionClosed, WireError):
                 break
             try:
-                self._dispatch(conn, msg)
+                self._dispatch(conn, msg, arr)
             except ConnectionClosed:
                 break
             except Exception as e:  # noqa: BLE001 — a bad op must answer,
@@ -239,13 +256,28 @@ class ReplicaWorker:
         except ConnectionClosed:
             pass
 
-    def _dispatch(self, conn: Conn, msg: dict) -> None:
+    def _dispatch(self, conn: Conn, msg: dict, arr=None) -> None:
         op = msg.get("op")
         rid = msg.get("req_id")
         if op == "hello":
             conn.send({"req_id": rid, "ok": True, **self.info()})
         elif op == "query":
             self._op_query(conn, msg)
+        elif op in ("delta", "refresh", "read"):
+            # every live op serializes on _live_lock, which a running
+            # refresh holds for engine-loop seconds — so ALL of them run
+            # off the connection reader (daemon threads, like prepare):
+            # the reader keeps draining query frames and the schedulers
+            # keep answering while the write path waits its turn.
+            # Ordering stays intact: the controller's single-writer
+            # admission never has two deltas in flight per worker.
+            fn = {"delta": self._op_delta, "refresh": self._op_refresh,
+                  "read": self._op_read}[op]
+            args = (conn, msg, arr) if op == "delta" else (conn, msg)
+            threading.Thread(
+                target=fn, args=args,
+                name=f"lux-fleet-{self.worker_id}-{op}",
+                daemon=True).start()
         elif op == "stats":
             conn.send({"req_id": rid, "ok": True, **self.heartbeat()})
         elif op == "prom":
@@ -284,8 +316,9 @@ class ReplicaWorker:
     def info(self) -> dict:
         with self._lock:
             cache, gid, gen = self._cache, self._graph_id, self._generation
+            live = self._live
         spec = cache.shards.spec
-        return {
+        out = {
             "worker_id": self.worker_id,
             "graph_id": gid,
             "generation": gen,
@@ -296,6 +329,12 @@ class ReplicaWorker:
             "buckets": list(self.q_buckets),
             "max_queue": self._max_queue,
         }
+        if live is not None:
+            out["live"] = True
+            out["delta_generation"] = live.servable_generation()
+            out["journal_generation"] = live.generation()
+            out["standing"] = [[a, s] for a, s in live.standing_spec]
+        return out
 
     def heartbeat(self) -> dict:
         """The queue-depth/shed heartbeat the controller's backpressure
@@ -306,7 +345,7 @@ class ReplicaWorker:
             cache = self._cache
         counts = self.metrics.counters()
         shed, completed = counts["rejected"], counts["completed"]
-        return {
+        out = {
             "queue_depth": sum(s.pending() for s in self._scheds.values()),
             "max_queue": self._max_queue,
             "shed_total": int(shed),
@@ -317,6 +356,9 @@ class ReplicaWorker:
             "warm_buckets": {app: list(cache.warm_buckets(app))
                              for app in self.apps},
         }
+        if self._live is not None:
+            out["delta_generation"] = self._live.servable_generation()
+        return out
 
     def _op_query(self, conn: Conn, msg: dict) -> None:
         rid = msg.get("req_id")
@@ -378,12 +420,142 @@ class ReplicaWorker:
             # the controller as answers, never as a dropped connection
             self._reply_err(conn, {"req_id": rid}, "error", err=repr(e))
             return
+        reply = {"req_id": rid, "ok": True,
+                 "rounds": int(fut.rounds),
+                 "traversed": int(fut.traversed_edges)}
+        if fut.generation is not None:
+            # the mutation generation the answering batch served — the
+            # read-your-writes tag (a lower bound on what it saw)
+            reply["generation"] = int(fut.generation)
         try:
-            conn.send({"req_id": rid, "ok": True,
-                       "rounds": int(fut.rounds),
-                       "traversed": int(fut.traversed_edges)}, arr=state)
+            conn.send(reply, arr=state)
         except ConnectionClosed:
             pass  # controller went away; nothing to tell it
+
+    # ------------------------------------------------------------------
+    # live ops (mutation-aware serving, serve/live)
+    # ------------------------------------------------------------------
+
+    def _live_or_refuse(self, conn: Conn, msg: dict):
+        """The CURRENT replica, read by the CALLER inside _live_lock —
+        a capture taken before the lock could be a replica a concurrent
+        commit already retired, and applying to it while installing
+        into the new cache is the exact cross-epoch race the lock
+        exists to prevent."""
+        live = self._live
+        if live is None:
+            self._reply_err(conn, msg, "error",
+                            err="worker is not live (start it with a "
+                                "LiveReplica / --live)")
+        return live
+
+    def _op_delta(self, conn: Conn, msg: dict, arr) -> None:
+        """Apply ONE replicated mutation batch: journal it durably,
+        rebuild + install the serving overlay, ack the generation.
+        O(delta) host work on its own daemon thread (see _dispatch —
+        the conn reader must keep draining query frames while this
+        waits out a running refresh's _live_lock); ordering comes from
+        the controller's single-writer admission, not the reader."""
+        from lux_tpu import obs
+        from lux_tpu.mutate.deltalog import DeltaOverflow
+        from lux_tpu.serve.live.replica import GenerationGap
+
+        if arr is None:
+            self._reply_err(conn, msg, "error",
+                            err="delta op needs the (rows, 4) batch "
+                                "payload")
+            return
+        gen = msg.get("generation")
+        with self._live_lock:
+            live = self._live_or_refuse(conn, msg)
+            if live is None:
+                return
+            try:
+                oarr, deg = live.apply_batch(arr, int(gen))
+            except GenerationGap as e:
+                self._reply_err(conn, msg, "gen_gap", have=e.have,
+                                want=e.want)
+                return
+            except DeltaOverflow as e:
+                # the batch IS journaled (durable) but exceeds the
+                # overlay capacity: escalate — the controller answers
+                # with a fleet-wide compaction + republish
+                obs.point("live.overflow", worker=self.worker_id,
+                          generation=int(gen))
+                self._reply_err(
+                    conn, msg, "overflow", err=str(e),
+                    generation=live.servable_generation(),
+                    journal_generation=live.generation())
+                return
+            except ConnectionClosed:
+                return
+            except Exception as e:  # noqa: BLE001 — off the conn
+                # reader now: an unanswered delta would stall the
+                # controller's write path for its full timeout
+                self._reply_err(conn, msg, "error", err=repr(e))
+                return
+            with self._lock:
+                cache = self._cache
+            cache.set_overlay(int(gen), oarr, deg)
+        obs.point("live.delta", worker=self.worker_id,
+                  generation=int(gen), rows=int(arr.shape[0]))
+        try:
+            conn.send({"req_id": msg.get("req_id"), "ok": True,
+                       "generation": int(gen)})
+        except ConnectionClosed:
+            pass  # controller went away; the apply itself is durable
+
+    def _op_refresh(self, conn: Conn, msg: dict) -> None:
+        """Warm-refresh the standing states to the current servable
+        generation (PR 10's refresh machinery) — BETWEEN queries: the
+        schedulers keep answering through the installed overlay while
+        this runs."""
+        try:
+            with self._live_lock:
+                live = self._live_or_refuse(conn, msg)
+                if live is None:
+                    return
+                res = live.refresh()
+        except ConnectionClosed:
+            return
+        except Exception as e:  # noqa: BLE001 — a failed refresh is an
+            # answer; the overlay path still serves every query
+            self._reply_err(conn, msg, "error", err=repr(e))
+            return
+        try:
+            conn.send({"req_id": msg.get("req_id"), "ok": True, **res})
+        except ConnectionClosed:
+            pass
+        except Exception as e:  # noqa: BLE001 — e.g. an over-bound
+            # frame: answer with the error, never hang the controller
+            self._reply_err(conn, msg, "error", err=repr(e))
+
+    def _op_read(self, conn: Conn, msg: dict) -> None:
+        """Serve a STANDING state (O(1): the refreshed array + its
+        generation tag)."""
+        app = msg.get("app", "sssp")
+        with self._live_lock:
+            live = self._live_or_refuse(conn, msg)
+            if live is None:
+                return
+            try:
+                ent = live.standing(app)
+            except KeyError:
+                self._reply_err(
+                    conn, msg, "error",
+                    err=f"no refreshed standing state for {app!r} "
+                        f"(configured: {[a for a, _ in live.standing_spec]};"
+                        " send a refresh first)")
+                return
+            state = ent["state"]
+            reply = {"req_id": msg.get("req_id"), "ok": True,
+                     "generation": int(ent["generation"]),
+                     "iters": int(ent["iters"]), "app": app,
+                     "arg": ent.get("arg")}
+        try:
+            conn.send(reply, arr=state)
+        except ConnectionClosed:
+            pass
 
     # ------------------------------------------------------------------
     # republish (prepare / commit)
@@ -396,6 +568,18 @@ class ReplicaWorker:
         path = msg.get("path")
         gid = msg.get("graph_id") or str(path)
         token = str(msg.get("token") or rid)
+        base_gen = msg.get("base_generation")
+        if self._live is not None and base_gen is None:
+            # a live worker republished WITHOUT an epoch base would keep
+            # an old-epoch delta log under a new base — wrong answers
+            # forever after; refuse loudly (the live controller always
+            # sends the base generation)
+            self._reply_err(
+                conn, msg, "error",
+                err="live worker needs base_generation in prepare "
+                    "(republish through LiveFleetController.compact_fleet"
+                    " / republish(base_generation=...))")
+            return
         with self._lock:
             # latest prepare wins from the start: an older in-flight
             # prepare sees its token superseded and will not stage
@@ -408,7 +592,19 @@ class ReplicaWorker:
 
                 g = read_lux(str(path))
                 shards = build_pull_shards(g, self._num_parts)
-                cache = self._make_cache(shards)
+                live2 = None
+                if self._live is not None:
+                    from lux_tpu.serve.live.replica import LiveReplica
+
+                    # journal-less while staged: the dir still holds the
+                    # OLD epoch; rebind_journal rotates it at commit
+                    live2 = LiveReplica(
+                        g, shards, cap=self._live.cap,
+                        base_generation=int(base_gen),
+                        standing=self._live.standing_spec,
+                        method=self._live.method,
+                        max_iters=self._live.max_iters)
+                cache = self._make_cache(shards, live=live2)
                 cache.prewarm()  # old cache serves throughout this
             with self._lock:
                 if self._publish_token != token:
@@ -418,7 +614,7 @@ class ReplicaWorker:
                     stale = True
                 else:
                     stale = False
-                    self._staged = (cache, gid, token)
+                    self._staged = (cache, gid, token, live2)
                 gen_next = self._generation + 1
             if stale:
                 self._reply_err(conn, msg, "error",
@@ -442,6 +638,17 @@ class ReplicaWorker:
 
         rid = msg.get("req_id")
         want = msg.get("token")
+        # the WHOLE swap (cache + schedulers + live replica) happens
+        # under _live_lock so a racing delta can never apply to the old
+        # replica and then install its overlay into the new cache (old
+        # epoch's edge slots under new engines = silent wrong answers).
+        # Lock order _live_lock -> _lock matches _op_delta.
+        with self._live_lock:
+            self._op_commit_locked(conn, msg, rid, want)
+
+    def _op_commit_locked(self, conn: Conn, msg: dict, rid, want) -> None:
+        from lux_tpu import obs
+
         with self._lock:
             if self._staged is None:
                 err = "nothing staged"
@@ -456,7 +663,7 @@ class ReplicaWorker:
             else:
                 err = None
                 staged, self._staged = self._staged, None
-                cache, gid, _tok = staged
+                cache, gid, _tok, live2 = staged
                 self._publish_token = None
                 self._cache = cache
                 self._graph_id = gid
@@ -470,10 +677,23 @@ class ReplicaWorker:
         # both caches are fully warmed, so either answers correctly.
         for sched in self._scheds.values():
             sched.cache = cache
+        if live2 is not None:
+            # live epoch handover (caller holds _live_lock): the new
+            # base embeds every batch up to its base_generation, so
+            # epoch-boundary standing states carry over warm and the
+            # local journal rotates (crash order matches
+            # mutate/compact.py: the snapshot was durable first)
+            old = self._live
+            live2.inherit_standing(old)
+            live2.rebind_journal(old.journal_dir, prior=old)
+            self._live = live2
         obs.point("fleet.publish.commit", worker=self.worker_id,
                   graph=gid, generation=gen)
-        conn.send({"req_id": rid, "ok": True, "generation": gen,
-                   "graph_id": gid})
+        reply = {"req_id": rid, "ok": True, "generation": gen,
+                 "graph_id": gid}
+        if live2 is not None:
+            reply["delta_generation"] = live2.servable_generation()
+        conn.send(reply)
 
 
 # ----------------------------------------------------------------------
@@ -508,6 +728,24 @@ def main(argv=None) -> int:
     ap.add_argument("--max-iters", type=int, default=10_000)
     ap.add_argument("--wait-ms", type=float, default=2.0)
     ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--live", action="store_true",
+                    help="serve a MUTATING graph: compile overlay-twin "
+                         "engines, accept delta/refresh/read ops, tag "
+                         "answers with mutation generations (serve/live)")
+    ap.add_argument("--journal-dir", default="",
+                    help="live mode: durable local delta journal "
+                         "(npz+.ok; a killed worker recovers its exact "
+                         "committed prefix and catches up on rejoin)")
+    ap.add_argument("--delta-cap", type=int, default=0,
+                    help="live mode: per-part insert capacity "
+                         "(0 = LUX_DELTA_CAP/default)")
+    ap.add_argument("--base-generation", type=int, default=0,
+                    help="live mode: the mutation generation the loaded "
+                         "snapshot embeds (the controller's epoch base)")
+    ap.add_argument("--standing", default="sssp:0",
+                    help="live mode: comma list of standing apps kept "
+                         "warm by refresh ops — sssp:<start>, pagerank, "
+                         "components")
     ap.add_argument("--cpus", default="",
                     help="pin this replica to these cores (comma list) — "
                          "the shared-nothing unit sizing the saturation "
@@ -531,6 +769,16 @@ def main(argv=None) -> int:
         g = generate.rmat(scale, ef, seed=0)
         gid = args.graph_id or f"rmat{scale}"
     shards = build_pull_shards(g, args.parts)
+    live = None
+    if args.live:
+        from lux_tpu.serve.live.replica import LiveReplica, parse_standing
+
+        live = LiveReplica(
+            g, shards, cap=args.delta_cap or None,
+            journal_dir=args.journal_dir or None,
+            base_generation=args.base_generation,
+            standing=parse_standing(args.standing),
+            method=args.method, max_iters=args.max_iters)
     worker = ReplicaWorker(
         shards, worker_id=args.worker_id, graph_id=gid,
         apps=tuple(a for a in args.apps.split(",") if a),
@@ -538,13 +786,16 @@ def main(argv=None) -> int:
         host=args.host, port=args.port, method=args.method,
         num_iters=args.num_iters, max_iters=args.max_iters,
         max_wait_ms=args.wait_ms, max_queue=args.max_queue,
+        live=live,
     )
     worker.start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    print(json.dumps({"ready": True, "worker_id": worker.worker_id,
-                      "port": worker.port, "pid": os.getpid()}),
-          flush=True)
+    ready = {"ready": True, "worker_id": worker.worker_id,
+             "port": worker.port, "pid": os.getpid()}
+    if live is not None:
+        ready["delta_generation"] = live.servable_generation()
+    print(json.dumps(ready), flush=True)
     try:
         while not stop.is_set() and worker._running:
             stop.wait(0.2)

@@ -1,0 +1,30 @@
+"""lux_tpu.serve.live — mutation-aware serving: the write path through
+the fleet (ISSUE 12, ROADMAP item 2).
+
+PR 8's fleet swaps compacted snapshots; PR 10's delta-log lives on one
+host.  This package closes the seam: the controller ADMITS edge
+insert/delete batches, sequences them into an authoritative crash-safe
+journal (mutate/deltalog.py's npz+``.ok`` format) with monotonic
+GENERATION numbers, and replicates each committed batch to every
+replica, where workers install statically-shaped overlays into the
+serving engines (no retrace, no snapshot swap) and run PR 10's warm
+refresh between queries.  Query answers carry generation tags;
+admission takes a ``min_generation`` bound — read-your-writes.
+``DeltaOverflow`` anywhere escalates to a fleet-wide compaction through
+the token-guarded two-phase republish; a joining/recovering worker
+catches up by snapshot + journal replay.
+
+Pieces:
+
+* ``journal.py``   — LiveJournal: the controller's sequencer (the ONE
+  write order) + batch wire packing + the compaction epoch.
+* ``replica.py``   — LiveReplica: worker-side delta log, serving
+  overlays, standing-state warm refresh.
+* ``controller.py``— LiveFleetController: admit/replicate/refresh/
+  compact + generation-aware routing and worker catch-up.
+* ``bench.py``     — thread-mode live fleet helper + the mixed
+  read/write measurement behind bench.py's ``sssp_live_*`` row.
+"""
+from lux_tpu.serve.live.controller import LiveFleetController  # noqa: F401
+from lux_tpu.serve.live.journal import LiveJournal  # noqa: F401
+from lux_tpu.serve.live.replica import GenerationGap, LiveReplica  # noqa: F401,E501

@@ -1,0 +1,447 @@
+"""LiveFleetController: the write path through the fleet.
+
+Extends PR 8's FleetController with the mutation-aware serving
+protocol (ISSUE 12 / ROADMAP item 2):
+
+* **admit_writes** — the ONE write order: each batch is sequenced into
+  the authoritative LiveJournal (durably, when journaled) and only THEN
+  replicated to every live worker as a ``delta`` op; the commit
+  generation returns to the caller once every reachable replica
+  acknowledged, so a subsequent ``submit(min_generation=gen)`` is
+  read-your-writes end to end.  The write path is single-writer
+  (``_write_lock``) — generations are total-ordered by construction.
+* **replication faults** — a worker that dies mid-replication is
+  retired by the base controller (its reads move to ring successors);
+  a sequence gap (a recovered worker that lost its uncommitted tail)
+  is answered with the catch-up stream from the journal; a rejoining
+  worker is synced in ``add_worker`` (snapshot + journal replay on its
+  side, ``batches_since`` from ours).
+* **refresh_fleet** — fans the ``refresh`` op to every replica so
+  PR 10's warm refresh (SSSP/CC bitwise, PageRank exact-fixpoint) runs
+  fleet-wide between queries; standing reads (``read_standing``) serve
+  the refreshed states O(1) with generation tags.
+* **compaction escalation** — a ``DeltaOverflow`` on any replica
+  escalates here: the journal compacts into a durable snapshot
+  (``snapshot_path``), ``base_generation`` advances, and the fleet
+  moves onto the new epoch through the token-guarded two-phase
+  republish (old overlays serve until the atomic commit; zero shed).
+
+The controller still never imports jax — the journal is numpy, the
+graph math lives in the workers.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from lux_tpu.graph.csc import HostGraph
+from lux_tpu.serve.fleet.controller import (
+    FleetController,
+    FleetError,
+    NoWorkersError,
+    _Pending,
+)
+from lux_tpu.serve.fleet.wire import ConnectionClosed
+from lux_tpu.serve.live.journal import LiveJournal
+
+
+class LiveFleetController(FleetController):
+    """``base``: the controller's HostGraph copy of the CURRENT epoch
+    snapshot (what every worker loaded).  ``journal_dir`` makes the
+    write order durable; ``snapshot_path`` names where compactions
+    write merged snapshots — REQUIRED before any overflow can be
+    escalated (and for journaled compaction at all)."""
+
+    def __init__(self, base: HostGraph,
+                 journal_dir: Optional[str] = None,
+                 snapshot_path: Optional[str] = None,
+                 delta_timeout_s: float = 60.0,
+                 refresh_timeout_s: float = 600.0, **kw):
+        super().__init__(**kw)
+        self.journal = LiveJournal(base, journal_dir=journal_dir)
+        self.snapshot_path = snapshot_path
+        self.delta_timeout_s = float(delta_timeout_s)
+        self.refresh_timeout_s = float(refresh_timeout_s)
+        #: single-writer sequencing: admits, republishes, and the
+        #: compactions they escalate to are totally ordered; reads
+        #: never take this.  Reentrant because compact_fleet (holding
+        #: it) republishes through the serialized override below.
+        self._write_lock = threading.RLock()
+        self._live_counts = {"writes": 0, "write_rows": 0,
+                             "compactions": 0, "resyncs": 0}
+
+    # ------------------------------------------------------------------
+    # membership: live handshake + catch-up
+    # ------------------------------------------------------------------
+
+    def add_worker(self, host: str, port: int,
+                   timeout_s: float = 60.0) -> str:
+        """Base handshake + the live catch-up: the worker must be live
+        and at-or-behind the journal; behind means it recovered/joined
+        from the epoch snapshot + its local committed prefix, and the
+        missing batches stream to it before it serves a stale-bounded
+        read."""
+        wid = super().add_worker(host, port, timeout_s=timeout_s)
+        with self._lock:
+            handle = self._workers[wid]
+        info = handle.info
+        if not info.get("live"):
+            self.remove_worker(wid, shutdown=False)
+            raise FleetError(
+                f"worker {wid} is not live (start it with --live / a "
+                "LiveReplica); a static replica would serve writes-blind "
+                "answers with no generation tag")
+        have = int(info.get("delta_generation", 0))
+        gen = self.journal.generation()
+        if have > gen:
+            self.remove_worker(wid, shutdown=False)
+            raise FleetError(
+                f"worker {wid} is at generation {have}, ahead of the "
+                f"journal ({gen}) — it belongs to a different write "
+                "history (wrong journal dir or wiped controller state)")
+        if have < self.journal.base_generation:
+            self.remove_worker(wid, shutdown=False)
+            raise FleetError(
+                f"worker {wid} is at generation {have}, before the "
+                f"current epoch base {self.journal.base_generation}: its "
+                "missing batches were compacted into the snapshot — "
+                "restart it from the current snapshot")
+        self._raise_delta_gen(handle, have)
+        if have < gen:
+            with self._lock:
+                self._live_counts["resyncs"] += 1
+            self._sync_worker(handle)
+        return wid
+
+    def _raise_delta_gen(self, handle, gen: int) -> None:
+        """Monotonic, LOCKED delta_gen update: the heartbeat thread
+        does its max() under self._lock, so an unlocked store here
+        could be overwritten by a stale heartbeat read-modify-write —
+        exactly the backslide that would make a just-acked
+        min_generation read spuriously StaleReadError."""
+        with self._lock:
+            handle.delta_gen = max(handle.delta_gen, int(gen))
+
+    def _sync_worker(self, handle, start: Optional[int] = None) -> None:
+        """Stream the batches a behind worker is missing, in order.
+        ``start`` overrides the tracked delta_gen — the gen_gap path
+        passes the worker's OWN reported position instead of lowering
+        the shared (heartbeat-raced) field."""
+        from lux_tpu import obs
+
+        if start is None:
+            start = handle.delta_gen
+        with obs.span("live.sync", worker=handle.wid, have=start,
+                      want=self.journal.generation()):
+            for gen, arr in self.journal.batches_since(start):
+                rep = self._delta_rpc(handle, gen, arr,
+                                      self.delta_timeout_s)
+                if rep.get("kind") == "overflow":
+                    raise FleetError(
+                        f"worker {handle.wid} overflowed at generation "
+                        f"{gen} during catch-up — compact the fleet "
+                        "first (compact_fleet), then rejoin it")
+                if not rep.get("ok"):
+                    raise FleetError(
+                        f"worker {handle.wid} failed catch-up at "
+                        f"generation {gen}: {rep.get('err')}")
+                self._raise_delta_gen(handle, gen)
+
+    # ------------------------------------------------------------------
+    # the write path
+    # ------------------------------------------------------------------
+
+    def admit_writes(self, src, dst, op, weight=None,
+                     timeout_s: Optional[float] = None) -> dict:
+        """Admit ONE edge-mutation batch: sequence it into the journal
+        (durable before anything else sees it), replicate to every live
+        worker, return the commit generation once all reachable
+        replicas acknowledged.  An overflow anywhere escalates to a
+        fleet-wide compaction (``snapshot_path`` required) before
+        returning.  Raises like DeltaLog.apply on an invalid batch —
+        nothing journaled, nothing replicated, no generation burned."""
+        from lux_tpu import obs
+
+        timeout_s = self.delta_timeout_s if timeout_s is None else timeout_s
+        with self._write_lock:
+            rows = int(np.size(np.atleast_1d(np.asarray(src))))
+            with obs.span("live.admit", rows=rows) as sp:
+                gen = self.journal.admit(src, dst, op, weight)
+                acked, overflow = self._replicate(gen, timeout_s)
+                compacted = False
+                if overflow:
+                    self._compact_fleet_locked()
+                    compacted = True
+                    acked = self.live_workers()
+                with self._lock:
+                    self._live_counts["writes"] += 1
+                    self._live_counts["write_rows"] += rows
+                sp.set(generation=gen, acked=len(acked),
+                       compacted=compacted)
+        return {"generation": gen, "acked": acked,
+                "compacted": compacted}
+
+    def _delta_rpc(self, handle, gen: int, arr: np.ndarray,
+                   timeout_s: float) -> dict:
+        """One delta frame to one worker; returns the reply dict (ok or
+        kind=gen_gap/overflow/error) — NEVER raises for a worker-side
+        refusal, only for transport loss (as FleetError).  Hand-rolled
+        next to FleetController._send because a delta carries an array
+        payload (the base _send is header-only)."""
+        p = _Pending("rpc")
+        rid = self._next_rid()
+        with self._lock:
+            handle.pending[rid] = p
+        try:
+            handle.conn.send({"op": "delta", "req_id": rid,
+                              "generation": int(gen)}, arr=arr)
+        except ConnectionClosed:
+            with self._lock:
+                still_mine = handle.pending.pop(rid, None) is not None
+            if still_mine:
+                # the reader's _retire did not harvest this pending:
+                # book the death ourselves (same shape as base _send);
+                # a harvested rpc already carries p.error — fall through
+                self._on_conn_lost(handle)
+                raise FleetError(
+                    f"worker {handle.wid} died mid-replication"
+                ) from None
+        if not p.event.wait(timeout_s):
+            raise FleetError(
+                f"worker {handle.wid} did not ack generation {gen} "
+                f"within {timeout_s}s")
+        if p.error is not None:
+            raise FleetError(str(p.error))
+        return p.reply
+
+    def _replicate(self, gen: int, timeout_s: float
+                   ) -> Tuple[List[str], bool]:
+        """Fan one committed batch to every live worker.  Returns
+        (acked worker ids, overflow anywhere).  A worker lost mid-
+        replication is simply absent from the ack list (the base
+        controller retired it — its reads moved); a gen_gap worker gets
+        the catch-up stream inline."""
+        arr = self.journal.payload(gen)
+        with self._lock:
+            handles = [h for h in self._workers.values() if h.alive]
+        acked: List[str] = []
+        overflow = False
+        for h in handles:
+            try:
+                rep = self._delta_rpc(h, gen, arr, timeout_s)
+            except FleetError:
+                continue  # retired mid-replication; rejoin re-syncs it
+            if rep.get("ok"):
+                self._raise_delta_gen(h, gen)
+                acked.append(h.wid)
+            elif rep.get("kind") == "overflow":
+                # durable on that worker, not servable: escalate
+                overflow = True
+            elif rep.get("kind") == "gen_gap":
+                try:
+                    with self._lock:
+                        self._live_counts["resyncs"] += 1
+                    self._sync_worker(h, start=int(rep.get("have", 0)))
+                    acked.append(h.wid)
+                except FleetError:
+                    continue
+        return acked, overflow
+
+    def republish(self, path, graph_id=None,
+                  prepare_timeout_s: float = 600.0,
+                  commit_timeout_s: float = 30.0,
+                  base_generation=None) -> dict:
+        """The base two-phase republish, SERIALIZED against the write
+        path: the live worker's prepare-refusal message points
+        operators here, and a delta racing a worker's cache/replica
+        commit swap would install an old-epoch overlay into new-base
+        engines."""
+        with self._write_lock:
+            return super().republish(
+                path, graph_id=graph_id,
+                prepare_timeout_s=prepare_timeout_s,
+                commit_timeout_s=commit_timeout_s,
+                base_generation=base_generation)
+
+    # ------------------------------------------------------------------
+    # fleet-wide refresh + standing reads
+    # ------------------------------------------------------------------
+
+    def refresh_fleet(self, timeout_s: Optional[float] = None) -> dict:
+        """Run the warm refresh on EVERY replica (parallel — each
+        worker refreshes between its own queries).  Returns per-worker
+        {generation, apps{...}} plus the fleet wall seconds (the bench
+        row's ``fleet_refresh_s``)."""
+        from lux_tpu import obs
+
+        timeout_s = (self.refresh_timeout_s if timeout_s is None
+                     else timeout_s)
+        with self._lock:
+            handles = [h for h in self._workers.values() if h.alive]
+        if not handles:
+            raise NoWorkersError("refresh with no live workers")
+        t0 = time.perf_counter()
+        with obs.span("live.refresh_fleet",
+                      workers=[h.wid for h in handles]):
+            from lux_tpu.serve.fleet.controller import _HandedOff
+
+            pendings = []
+            for h in handles:
+                try:
+                    pendings.append((h, self._send(
+                        h, {"op": "refresh"}, _Pending("rpc"))))
+                except (ConnectionClosed, _HandedOff):
+                    continue  # a dying worker's refresh is just absent
+            out: Dict[str, dict] = {}
+            deadline = time.monotonic() + timeout_s
+            for h, p in pendings:
+                if not p.event.wait(max(deadline - time.monotonic(),
+                                        0.001)):
+                    raise FleetError(
+                        f"worker {h.wid} did not finish refresh within "
+                        f"{timeout_s}s")
+                if p.error is not None or not p.reply.get("ok"):
+                    raise FleetError(
+                        f"worker {h.wid} refresh failed: "
+                        f"{p.error or p.reply.get('err')}")
+                out[h.wid] = {k: v for k, v in p.reply.items()
+                              if k not in ("req_id", "ok")}
+        return {"workers": out,
+                "seconds": round(time.perf_counter() - t0, 4)}
+
+    def read_standing(self, app: str = "sssp",
+                      worker: Optional[str] = None,
+                      timeout_s: float = 30.0) -> dict:
+        """One replica's refreshed standing state for ``app``:
+        {state, generation, iters, worker}.  ``worker=None`` picks the
+        freshest live replica."""
+        with self._lock:
+            handles = [h for h in self._workers.values() if h.alive
+                       and (worker is None or h.wid == worker)]
+        if not handles:
+            raise NoWorkersError(f"no live worker matches {worker!r}")
+        h = max(handles, key=lambda x: x.delta_gen)
+        p = self._send(h, {"op": "read", "app": app}, _Pending("rpc"))
+        if not p.event.wait(timeout_s):
+            raise FleetError(f"worker {h.wid} read timed out")
+        if p.error is not None or not p.reply.get("ok"):
+            raise FleetError(f"worker {h.wid} read: "
+                             f"{p.error or p.reply.get('err')}")
+        return {"state": p.arr, "generation": int(p.reply["generation"]),
+                "iters": int(p.reply["iters"]), "worker": h.wid,
+                "arg": p.reply.get("arg")}
+
+    def read_standing_all(self, app: str = "sssp",
+                          timeout_s: float = 30.0) -> Dict[str, dict]:
+        """The standing state from EVERY live replica — the acceptance
+        surface: after a refresh, all entries must agree bitwise
+        (SSSP/CC) / to <= 1 ulp (PageRank) and carry tags >= the last
+        admitted generation."""
+        out = {}
+        for wid in self.live_workers():
+            out[wid] = self.read_standing(app, worker=wid,
+                                          timeout_s=timeout_s)
+        return out
+
+    # ------------------------------------------------------------------
+    # compaction escalation
+    # ------------------------------------------------------------------
+
+    def compact_fleet(self) -> dict:
+        """Public entry: fold the journal epoch into a new snapshot and
+        republish it fleet-wide (token-guarded two-phase; old overlays
+        serve until the atomic commit)."""
+        with self._write_lock:
+            return self._compact_fleet_locked()
+
+    def _compact_fleet_locked(self) -> dict:
+        from lux_tpu import obs
+
+        if self.snapshot_path is None:
+            raise FleetError(
+                "fleet compaction needs LiveFleetController("
+                "snapshot_path=...) — an overflowed delta log cannot "
+                "fold into a base nobody persists")
+        gen = self.journal.generation()
+        with obs.span("live.compact_fleet", generation=gen):
+            self.journal.compact(self.snapshot_path)
+            rep = self.republish(self.snapshot_path,
+                                 graph_id=self.graph_id,
+                                 base_generation=gen)
+            with self._lock:
+                self._live_counts["compactions"] += 1
+                for h in self._workers.values():
+                    if h.alive:
+                        h.delta_gen = gen
+        return {"generation": gen, "republish": rep}
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def generation(self) -> int:
+        return self.journal.generation()
+
+    def worker_generations(self) -> Dict[str, int]:
+        with self._lock:
+            return {wid: h.delta_gen
+                    for wid, h in self._workers.items() if h.alive}
+
+    def stats(self) -> dict:
+        out = super().stats()
+        with self._lock:
+            out.update(self._live_counts)
+        out["journal"] = self.journal.stats()
+        out["worker_generations"] = self.worker_generations()
+        return out
+
+
+def start_live_fleet(n_workers: int, g: HostGraph, parts: int = 2,
+                     cap: Optional[int] = None,
+                     buckets=(1, 4), graph_id: str = "live",
+                     standing=(("sssp", 0),),
+                     journal_root: Optional[str] = None,
+                     snapshot_path: Optional[str] = None,
+                     max_queue: int = 256, wait_ms: float = 2.0,
+                     hb_interval_s: float = 0.25, method: str = "auto"):
+    """A thread-mode live fleet over one in-memory graph: ``n_workers``
+    LiveReplica-backed ReplicaWorkers sharing the pull layout, behind a
+    LiveFleetController.  ``journal_root`` gives the controller
+    (``<root>/controller``) and each worker (``<root>/<wid>``) durable
+    journals — the replication-fault tests and any real deployment want
+    this; None keeps everything in-memory.  Returns a fleet/bench.Fleet
+    (``close()`` tears it all down)."""
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.serve.fleet.bench import Fleet
+    from lux_tpu.serve.fleet.worker import ReplicaWorker
+    from lux_tpu.serve.live.replica import LiveReplica
+
+    shards = build_pull_shards(g, parts)
+    ctl = LiveFleetController(
+        g, journal_dir=(None if journal_root is None
+                        else os.path.join(journal_root, "controller")),
+        snapshot_path=snapshot_path, hb_interval_s=hb_interval_s)
+    workers: list = []
+    fleet = Fleet(ctl, workers, [])
+    try:
+        for i in range(n_workers):
+            wid = f"w{i}"
+            live = LiveReplica(
+                g, shards, cap=cap,
+                journal_dir=(None if journal_root is None
+                             else os.path.join(journal_root, wid)),
+                standing=standing, method=method)
+            w = ReplicaWorker(
+                shards, worker_id=wid, graph_id=graph_id,
+                q_buckets=tuple(buckets), max_queue=max_queue,
+                max_wait_ms=wait_ms, method=method, live=live).start()
+            workers.append(w)
+            ctl.add_worker("127.0.0.1", w.port)
+    except BaseException:
+        fleet.close()
+        raise
+    return fleet

@@ -59,6 +59,9 @@ class _Request:
     error: Optional[BaseException] = None
     traversed: int = 0
     rounds: int = 0
+    #: mutation generation the answering batch served (None = the cache
+    #: is not live; static-snapshot serving carries no tag)
+    generation: Optional[int] = None
 
 
 class ServeFuture:
@@ -88,6 +91,13 @@ class ServeFuture:
     @property
     def rounds(self) -> int:
         return self._req.rounds
+
+    @property
+    def generation(self) -> Optional[int]:
+        """Mutation generation the answer reflects (a LOWER bound: the
+        overlay installed at dispatch, never newer than the state the
+        batch actually saw); None when serving a static snapshot."""
+        return self._req.generation
 
 
 class MicroBatchScheduler:
@@ -236,9 +246,23 @@ class MicroBatchScheduler:
             # row: one per batch, covering engine lookup + the batched run
             with obs.span("serve.dispatch", app=self.app, q=q,
                           real=len(batch)) as sp:
-                engine, was_warm = self.cache.get(self.app, q)
-                out = engine.run(queries)
-                sp.set(warm=was_warm)
+                # ONE read of self.cache for the whole dispatch: a
+                # republish commit reassigns it concurrently, and an
+                # old-cache engine run with the NEW cache's overlay
+                # (different e_pad/nv_pad) would shape-error the batch
+                cache = self.cache
+                engine, was_warm = cache.get(self.app, q)
+                # one atomic tuple read: the generation tag below is the
+                # overlay this batch dispatches with (a racing newer
+                # install makes the tag a lower bound — safe direction)
+                overlay = cache.current_overlay()
+                if overlay is None:
+                    out = engine.run(queries)
+                    gen = None
+                else:
+                    gen, oarr, deg = overlay
+                    out = engine.run(queries, oarrays=oarr, degree=deg)
+                sp.set(warm=was_warm, generation=gen)
         except Exception as e:  # noqa: BLE001 — a failed batch must
             # resolve its requests (a hung future is worse than any error)
             for r in batch:
@@ -259,6 +283,7 @@ class MicroBatchScheduler:
             r.result = out.query_state(i)
             r.traversed = out.traversed[i]
             r.rounds = int(out.rounds[i])
+            r.generation = gen
             self.metrics.record_done(
                 latency_s=done_t - r.enqueue_t,
                 wait_s=t0 - r.enqueue_t,

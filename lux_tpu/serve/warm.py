@@ -62,8 +62,15 @@ class WarmEngineCache:
     def __init__(self, shards: PullShards, apps=("sssp",),
                  q_buckets=DEFAULT_Q_BUCKETS, method: str = "auto",
                  num_iters: int = 10, max_iters: int = 10_000,
-                 metrics=None, max_engines: Optional[int] = None):
+                 metrics=None, max_engines: Optional[int] = None,
+                 overlay_static=None):
         self.shards = shards
+        #: mutate.overlay.OverlayStatic -> every engine of this cache is
+        #: the LIVE twin (takes OverlayArrays per batch); the current
+        #: arrays live in ``_overlay`` as one immutable (generation,
+        #: oarrays, degree) tuple so dispatchers read them atomically
+        self.overlay_static = overlay_static
+        self._overlay = None
         self.apps = tuple(apps)
         self.q_buckets = tuple(sorted(set(int(q) for q in q_buckets)))
         if self.q_buckets and self.q_buckets[0] < 1:
@@ -103,6 +110,48 @@ class WarmEngineCache:
         return EngineKey(app=app, method=self._method[app],
                          layout=self._layout, q=int(q))
 
+    # ------------------------------------------------------------------
+    # live overlay (mutation-aware serving)
+    # ------------------------------------------------------------------
+
+    def set_overlay(self, generation: int, oarrays, degree=None) -> None:
+        """Install the CURRENT mutation overlay: one atomic store of an
+        immutable (generation, device OverlayArrays, device degree)
+        tuple.  Dispatchers that read the tuple before a newer install
+        tag their answers with the OLDER generation — a lower bound on
+        what the batch actually served, which is exactly the direction
+        read-your-writes needs."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.overlay_static is None:
+            raise ValueError(
+                "cache was built without overlay_static; a live worker "
+                "must construct its WarmEngineCache with the overlay "
+                "descriptor so every engine compiles the overlay twin")
+        dev_o = jax.tree.map(jnp.asarray, oarrays)
+        dev_d = None if degree is None else jnp.asarray(degree)
+        self._overlay = (int(generation), dev_o, dev_d)
+
+    def current_overlay(self):
+        """(generation, OverlayArrays, degree) or None (non-live cache).
+        A live cache that has not had set_overlay called yet serves the
+        zero-churn empty overlay at generation 0."""
+        if self.overlay_static is None:
+            return None
+        ov = self._overlay
+        if ov is None:
+            from lux_tpu.mutate import overlay as _ovl
+
+            self.set_overlay(0, _ovl.empty_overlay_arrays(
+                self.shards, self.overlay_static.cap))
+            ov = self._overlay
+        return ov
+
+    def _warm_oarrays(self):
+        ov = self.current_overlay()
+        return None if ov is None else ov[1]
+
     def prewarm(self, apps=None, q_buckets=None) -> float:
         """Trace + compile + run one dummy batch per (app, bucket);
         returns the wall seconds spent (service-start cost, reported by
@@ -115,7 +164,7 @@ class WarmEngineCache:
                 # one span per (app, bucket): the compile waterfall of a
                 # service start is attributable per engine shape
                 with obs.span("serve.pretrace", app=app, q=int(q)):
-                    self._build(app, int(q)).warm()
+                    self._build(app, int(q)).warm(self._warm_oarrays())
         spent = time.perf_counter() - t0
         with self._lock:
             self.warm_seconds += spent
@@ -149,6 +198,7 @@ class WarmEngineCache:
                     self.shards, app, q, method=k.method,
                     num_iters=self.num_iters, max_iters=self.max_iters,
                     device_arrays=self._device_arrays,
+                    overlay_static=self.overlay_static,
                 )
                 self._engines[k] = eng
                 self._evict_locked()
@@ -192,7 +242,7 @@ class WarmEngineCache:
         # miss: the request path is paying a trace+compile — exactly the
         # event a post-mortem needs to see on the timeline
         with obs.span("serve.cold_trace", app=app, q=int(q)):
-            eng.warm()
+            eng.warm(self._warm_oarrays())
         with self._lock:
             self.warm_seconds += time.perf_counter() - t0
         return eng, False
@@ -204,6 +254,7 @@ class WarmEngineCache:
             self.shards = shards
             self._layout = layout_key(shards)
             self._device_arrays = None  # re-place on next build
+            self._overlay = None        # stale occupancy, stale shapes
             self._engines = collections.OrderedDict(
                 (k, e) for k, e in self._engines.items()
                 if k.layout == self._layout

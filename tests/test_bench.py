@@ -41,7 +41,8 @@ def test_bench_happy_path_multi_app():
     assert len(fams) == len(set(fams))  # exactly one line per family
     for ln in lines:
         assert ln["unit"] == (
-            "QPS" if "_qps_" in ln["metric"]
+            "QPS" if ("_qps_" in ln["metric"]
+                      or "_live_" in ln["metric"])
             else "ms/iter" if ln["metric"].startswith(("reduce_micro",
                                                        "scan_micro"))
             else "x" if "_refresh_" in ln["metric"]
@@ -61,6 +62,15 @@ def test_bench_happy_path_multi_app():
     assert smicro["winner"] in smicro["flavor_ms"]
     qps = next(ln for ln in lines if "_qps_" in ln["metric"])
     assert qps["batched_vs_q1"] > 0 and qps["scheduler"]["completed"] > 0
+    # the standing mutation-aware serving row (ISSUE 12): mixed
+    # read/write window with staleness + fleet-refresh accounting
+    lv = next(ln for ln in lines
+              if ln["metric"].startswith("sssp_live_w2"))
+    assert lv["write_batches_per_s"] > 0 and lv["fleet_refresh_s"] > 0
+    assert lv["staleness_gen_p99"] >= lv["staleness_gen_p50"] >= 0
+    assert lv["final_generation"] > 0 and lv["read_errors"] == 0
+    assert set(lv["worker_generations"].values()) == {
+        lv["final_generation"]}
     cf = next(ln for ln in lines if ln["metric"].startswith("colfilter"))
     assert cf["rmse"] > 0 and cf["iter_ms"] > 0
     sp = next(ln for ln in lines if ln["metric"].startswith("sssp_gteps"))

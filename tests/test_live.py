@@ -1,0 +1,651 @@
+"""serve/live: mutation-aware serving — the write path through the
+fleet (ISSUE 12).
+
+Pins the acceptance surface: a write admitted at the controller is
+readable from EVERY replica with a generation tag >= its commit
+generation; fleet-wide warm-refresh answers are bitwise-equal (SSSP/CC;
+PageRank <= 1 ulp) to a single-host apply+refresh of the same batch
+sequence — including under a mid-replication worker kill, where the
+killed worker recovers its exact committed journal prefix and catches
+up.  Plus the satellites: the overlay-twin batched engines (bitwise vs
+the merged reference, zero retrace across occupancies), the
+LUX_FLEET_MAX_FRAME_MB wire knob, and the fused/CF overlay rejection
+naming its escape hatches.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from lux_tpu.graph import generate
+from lux_tpu.graph.format import read_lux
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models.sssp import bfs_reference
+from lux_tpu.mutate import overlay as ovl
+from lux_tpu.mutate.deltalog import DeltaLog, DeltaOverflow
+from lux_tpu.serve.fleet.controller import FleetError, StaleReadError
+from lux_tpu.serve.fleet.worker import ReplicaWorker
+from lux_tpu.serve.live.controller import (
+    LiveFleetController,
+    start_live_fleet,
+)
+from lux_tpu.serve.live.journal import (
+    LiveJournal,
+    pack_batch,
+    unpack_batch,
+)
+from lux_tpu.serve.live.replica import (
+    GenerationGap,
+    LiveReplica,
+    parse_standing,
+)
+
+
+@pytest.fixture(scope="module")
+def small():
+    g = generate.rmat(8, 8, seed=4)
+    return g, build_pull_shards(g, 2)
+
+
+def _churned_log(g, k=15, seed=0):
+    rng = np.random.default_rng(seed)
+    dlog = DeltaLog(g)
+    dele = rng.choice(g.ne, k, replace=False)
+    dlog.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+               np.zeros(k, np.int8))
+    dlog.apply(rng.integers(0, g.nv, k), rng.integers(0, g.nv, k),
+               np.ones(k, np.int8))
+    return dlog
+
+
+def _batches(g, n, rows=12, seed=1):
+    """n random insert/delete batches against ``g`` (deletes target
+    distinct base edges so every batch resolves)."""
+    rng = np.random.default_rng(seed)
+    dele_pool = rng.permutation(g.ne)
+    out = []
+    lo = 0
+    for i in range(n):
+        ndel = rows // 2
+        dele = dele_pool[lo:lo + ndel]
+        lo += ndel
+        src = np.concatenate([np.asarray(g.col_idx, np.int64)[dele],
+                              rng.integers(0, g.nv, rows - ndel)])
+        dst = np.concatenate([np.asarray(g.dst_of_edges(),
+                                         np.int64)[dele],
+                              rng.integers(0, g.nv, rows - ndel)])
+        op = np.concatenate([np.zeros(ndel, np.int8),
+                             np.ones(rows - ndel, np.int8)])
+        out.append((src, dst, op))
+    return out
+
+
+# ----------------------------------------------------------------------
+# overlay-twin batched engines
+# ----------------------------------------------------------------------
+
+
+def test_batched_overlay_matches_merged_reference(small):
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.serve.batched import BatchedEngine
+
+    g, sh = small
+    dlog = _churned_log(g)
+    ostatic = ovl.OverlayStatic(cap=ovl.delta_cap(256),
+                                weighted=sh.spec.weighted)
+    _, oarr = ovl.build_pull_overlay(sh, dlog, cap=256)
+    eng = BatchedEngine(sh, "sssp", 4, overlay_static=ostatic).warm()
+    merged = dlog.merged_graph()
+    srcs = [0, 3, 7, 11]
+    out = eng.run(srcs, oarrays=jax.tree.map(jnp.asarray, oarr))
+    for i, s in enumerate(srcs):
+        assert np.array_equal(out.query_state(i),
+                              bfs_reference(merged, s)), s
+    # the zero-churn overlay is BITWISE the plain engine
+    plain = BatchedEngine(sh, "sssp", 4).warm().run(srcs)
+    empty = eng.run(srcs, oarrays=jax.tree.map(
+        jnp.asarray, ovl.empty_overlay_arrays(sh, 256)))
+    assert np.array_equal(empty.state, plain.state)
+
+
+def test_batched_overlay_ppr_lane_independence(small):
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.serve.batched import BatchedEngine
+
+    g, sh = small
+    dlog = _churned_log(g)
+    ostatic = ovl.OverlayStatic(cap=ovl.delta_cap(256),
+                                weighted=sh.spec.weighted)
+    _, oarr = ovl.build_pull_overlay(sh, dlog, cap=256)
+    deg = ovl.merged_degree_stacked(sh, dlog)
+    oarr_d = jax.tree.map(jnp.asarray, oarr)
+    e4 = BatchedEngine(sh, "ppr", 4, overlay_static=ostatic)
+    e1 = BatchedEngine(sh, "ppr", 1, overlay_static=ostatic)
+    srcs = [0, 3, 7, 11]
+    o4 = e4.run(srcs, oarrays=oarr_d, degree=deg)
+    for i, s in enumerate(srcs):
+        o1 = e1.run([s], oarrays=oarr_d, degree=deg)
+        assert np.array_equal(o4.query_state(i), o1.query_state(0)), s
+
+
+def test_batched_overlay_zero_retrace(small):
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.serve import batched as B
+
+    g, sh = small
+    dlog = _churned_log(g)
+    ostatic = ovl.OverlayStatic(cap=ovl.delta_cap(256),
+                                weighted=sh.spec.weighted)
+    prog = B.make_program("sssp", sh.spec.nv)
+    run = B._compile_batched_fixpoint(prog, sh.spec, "scan", ostatic)
+    arrs = jax.tree.map(jnp.asarray, sh.arrays)
+    sizes = []
+    for occ in (ovl.build_pull_overlay(sh, dlog, cap=256)[1],
+                ovl.empty_overlay_arrays(sh, 256)):
+        q = jnp.zeros((2,), jnp.int32)
+        st = B._compile_batched_init(prog)(arrs, q)
+        run(arrs, q, st, jnp.int32(2), jax.tree.map(jnp.asarray, occ))
+        sizes.append(run._cache_size())
+    assert sizes == [1, 1]  # occupancy is data, never a trace
+
+
+def test_batched_overlay_pairing_guard(small):
+    from lux_tpu.serve.batched import BatchedEngine
+
+    g, sh = small
+    ostatic = ovl.OverlayStatic(cap=ovl.delta_cap(128),
+                                weighted=sh.spec.weighted)
+    live_eng = BatchedEngine(sh, "sssp", 1, overlay_static=ostatic)
+    with pytest.raises(ValueError, match="passed together"):
+        live_eng.run([0])
+    plain = BatchedEngine(sh, "sssp", 1)
+    with pytest.raises(ValueError, match="passed together"):
+        plain.run([0], oarrays=ovl.empty_overlay_arrays(sh, 128))
+
+
+def test_fused_overlay_rejection_names_escape_hatch(small):
+    """Satellite: the fused/CF rejection must name the escape hatches
+    (compact, or route_base=\"expand\") and the knobs — not just say
+    'not supported'."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import pull
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.ops import expand
+
+    g, sh = small
+    dlog = _churned_log(g)
+    ostatic, oarr = ovl.build_pull_overlay(sh, dlog, cap=256)
+    prog = PageRankProgram(nv=sh.spec.nv)
+    arrs = jax.tree.map(jnp.asarray, sh.arrays)
+    st, fa = expand.plan_fused_shards(sh)
+    with pytest.raises(ValueError) as ei:
+        pull.run_pull_fixed(
+            prog, sh.spec, arrs, pull.init_state(prog, arrs), 2,
+            method="scan", route=(st, fa), overlay=(ostatic, oarr))
+    msg = str(ei.value)
+    assert "route_base=\"expand\"" in msg
+    assert "compact()" in msg
+    assert "LUX_ROUTE_MODE" in msg and "LUX_DELTA_CAP" in msg
+
+
+def test_wire_max_frame_env_knob(monkeypatch):
+    """Satellite: LUX_FLEET_MAX_FRAME_MB bounds the payload both ways
+    (send refuses before the bytes move; recv refuses a hostile length
+    prefix)."""
+    from lux_tpu.serve.fleet import wire
+
+    assert wire.max_frame_bytes() == wire.MAX_PAYLOAD
+    monkeypatch.setenv("LUX_FLEET_MAX_FRAME_MB", "1")
+    assert wire.max_frame_bytes() == 1024 * 1024
+
+    class _Sock:
+        def sendall(self, b):
+            raise AssertionError("oversized frame must not hit the wire")
+
+    conn = wire.Conn.__new__(wire.Conn)
+    conn._sock = _Sock()
+    import threading
+
+    conn._send_lock = threading.Lock()
+    conn._closed = False
+    with pytest.raises(wire.WireError, match="LUX_FLEET_MAX_FRAME_MB"):
+        conn.send({"op": "x"}, arr=np.zeros(1024 * 1024, np.int64))
+    monkeypatch.setenv("LUX_FLEET_MAX_FRAME_MB", "64")
+    conn2 = wire.Conn.__new__(wire.Conn)
+    sent = []
+
+    class _Sock2:
+        def sendall(self, b):
+            sent.append(len(b))
+
+    conn2._sock = _Sock2()
+    conn2._send_lock = threading.Lock()
+    conn2._closed = False
+    conn2.send({"op": "x"}, arr=np.zeros(1024 * 1024, np.int64))
+    assert sent
+    monkeypatch.setenv("LUX_FLEET_MAX_FRAME_MB", "junk")
+    with pytest.raises(ValueError, match="LUX_FLEET_MAX_FRAME_MB"):
+        wire.max_frame_bytes()
+
+
+# ----------------------------------------------------------------------
+# journal + replica
+# ----------------------------------------------------------------------
+
+
+def test_live_journal_sequencing_reload_and_epoch(small, tmp_path):
+    g, _sh = small
+    jd = str(tmp_path / "ctl")
+    J = LiveJournal(g, journal_dir=jd)
+    gens = [J.admit(s, d, o) for s, d, o in _batches(g, 3)]
+    assert gens == [1, 2, 3]
+    assert [gen for gen, _ in J.batches_since(1)] == [2, 3]
+    with pytest.raises(KeyError):
+        J.payload(4)
+    # wire pack round-trip
+    s, d, o = _batches(g, 1)[0]
+    arr = pack_batch(s, d, o)
+    s2, d2, o2, w2 = unpack_batch(arr)
+    assert np.array_equal(s, s2) and np.array_equal(d, d2)
+    assert np.array_equal(o.astype(np.int8), o2)
+    # reload: same generation line, same catch-up stream
+    J2 = LiveJournal(g, journal_dir=jd)
+    assert J2.generation() == 3
+    assert np.array_equal(J2.payload(2), J.payload(2))
+    # compaction epoch: base advances, old batches gone, line continues
+    snap = str(tmp_path / "snap.lux")
+    merged = J.compact(snap)
+    assert J.base_generation == 3 and J.generation() == 3
+    assert not J.batches_since(3)
+    with pytest.raises(KeyError, match="compacted"):
+        J.batches_since(0)
+    assert J.admit([1], [2], [1]) == 4
+    J3 = LiveJournal(read_lux(snap), journal_dir=jd)
+    assert J3.base_generation == 3 and J3.generation() == 4
+    assert merged.ne == read_lux(snap).ne
+    # a journaled sequencer refuses to compact without a snapshot
+    with pytest.raises(ValueError, match="snapshot path"):
+        J3.compact()
+
+
+def test_replica_kill_between_receipt_and_marker_recovers_prefix(
+        small, tmp_path, monkeypatch):
+    """Satellite: a worker killed between delta receipt and the .ok
+    marker must recover to the EXACT committed prefix and, after
+    catch-up, answer bitwise-equal to a never-killed replica."""
+    g, sh = small
+    J = LiveJournal(g)
+    batches = _batches(g, 3)
+    for s, d, o in batches:
+        J.admit(s, d, o)
+    wd = str(tmp_path / "w")
+    rep = LiveReplica(g, sh, cap=256, journal_dir=wd,
+                      standing=(("sssp", 0),))
+    rep.apply_batch(J.payload(1), 1)
+    # the crash window: batch npz lands, the marker never does
+    monkeypatch.setattr(
+        DeltaLog, "_journal_mark",
+        lambda self, seq: (_ for _ in ()).throw(
+            KeyboardInterrupt("killed before marker")))
+    with pytest.raises(KeyboardInterrupt):
+        rep.apply_batch(J.payload(2), 2)
+    monkeypatch.undo()
+    # recover: replay stops at the first missing marker — generation 1,
+    # not 2 (the torn batch is gone), never a half-applied state
+    rec = LiveReplica(g, sh, cap=256, journal_dir=wd,
+                      standing=(("sssp", 0),))
+    assert rec.generation() == 1 == rec.servable_generation()
+    # catch up to the committed prefix of the AUTHORITATIVE journal
+    for gen, arr in J.batches_since(rec.generation()):
+        rec.apply_batch(arr, gen)
+    assert rec.generation() == 3
+    # answers bitwise-equal to a never-killed replica
+    clean = LiveReplica(g, sh, cap=256, standing=(("sssp", 0),))
+    for gen, arr in J.batches_since(0):
+        clean.apply_batch(arr, gen)
+    rec.refresh()
+    clean.refresh()
+    assert np.array_equal(rec.standing("sssp")["state"],
+                          clean.standing("sssp")["state"])
+    assert np.array_equal(rec.standing("sssp")["state"],
+                          bfs_reference(J.log.merged_graph(), 0))
+
+
+def test_replica_generation_gap_and_overflow(small, tmp_path):
+    g, sh = small
+    J = LiveJournal(g)
+    for s, d, o in _batches(g, 2):
+        J.admit(s, d, o)
+    rep = LiveReplica(g, sh, cap=128, standing=())
+    with pytest.raises(GenerationGap) as ei:
+        rep.apply_batch(J.payload(2), 2)  # skipped generation 1
+    assert ei.value.have == 0 and ei.value.want == 2
+    rep.apply_batch(J.payload(1), 1)
+    # one batch past the per-part capacity: journaled but not servable
+    rng = np.random.default_rng(7)
+    big = pack_batch(rng.integers(0, g.nv, 400),
+                     rng.integers(0, g.nv, 400), np.ones(400, np.int8))
+    with pytest.raises(DeltaOverflow):
+        rep.apply_batch(big, 2)
+    assert rep.generation() == 2  # durable...
+    assert rep.servable_generation() == 1  # ...but the overlay lags
+
+
+def test_parse_standing():
+    assert parse_standing("sssp:7,pagerank") == (("sssp", 7),
+                                                ("pagerank", None))
+    with pytest.raises(ValueError, match="unknown standing app"):
+        parse_standing("bfsish")
+
+
+# ----------------------------------------------------------------------
+# the fleet write path (acceptance pins)
+# ----------------------------------------------------------------------
+
+
+def _close(fleet):
+    fleet.close()
+
+
+def test_live_fleet_read_your_writes(small, tmp_path):
+    """Acceptance: a write admitted at the controller is readable from
+    EVERY replica with a generation tag >= its commit generation."""
+    g, _sh = small
+    fleet = start_live_fleet(2, g, parts=2, cap=256,
+                             standing=(("sssp", 0),))
+    ctl = fleet.controller
+    try:
+        f = ctl.submit(3)
+        assert np.array_equal(f.result(timeout=60), bfs_reference(g, 3))
+        assert f.generation == 0
+        for s, d, o in _batches(g, 2):
+            rep = ctl.admit_writes(s, d, o)
+        assert rep["generation"] == 2
+        assert set(rep["acked"]) == {"w0", "w1"}
+        merged = ctl.journal.log.merged_graph()
+        # route around the ring: every source key lands somewhere —
+        # check BOTH replicas answer with the write visible, by asking
+        # each one directly through the standing read AND via routed
+        # queries with the read-your-writes bound
+        seen = set()
+        for s in (0, 3, 7, 11, 20, 33, 40, 41):
+            f = ctl.submit(s, min_generation=2)
+            assert np.array_equal(f.result(timeout=60),
+                                  bfs_reference(merged, s)), s
+            assert f.generation >= 2
+            seen.add(f.worker_id)
+        assert seen == {"w0", "w1"}  # both replicas served tagged reads
+        assert ctl.worker_generations() == {"w0": 2, "w1": 2}
+        # stale bound: nobody has generation 99
+        with pytest.raises(StaleReadError):
+            ctl.submit(0, min_generation=99)
+    finally:
+        _close(fleet)
+
+
+def test_live_fleet_refresh_bitwise_vs_single_host(small, tmp_path):
+    """Acceptance: fleet-wide warm-refresh answers are bitwise-equal
+    (SSSP/CC; PageRank <= 1 ulp) to a single-host apply+refresh of the
+    same batch sequence."""
+    g, sh = small
+    standing = (("sssp", 0), ("components", None), ("pagerank", None))
+    fleet = start_live_fleet(2, g, parts=2, cap=256, standing=standing)
+    ctl = fleet.controller
+    try:
+        batches = _batches(g, 2)
+        for s, d, o in batches:
+            ctl.admit_writes(s, d, o)
+        ctl.refresh_fleet()
+        # more churn, refresh again: the WARM path (prior states), not
+        # just the cold first convergence
+        for s, d, o in _batches(g, 2, seed=9):
+            gen = ctl.admit_writes(s, d, o)["generation"]
+        res = ctl.refresh_fleet()
+        assert all(w["generation"] == gen
+                   for w in res["workers"].values())
+        # single host: same batch sequence through apply + refresh
+        solo = LiveReplica(g, build_pull_shards(g, 2), cap=256,
+                           standing=standing)
+        for gg, arr in ctl.journal.batches_since(0):
+            solo.apply_batch(arr, gg)
+        solo.refresh()
+        merged = ctl.journal.log.merged_graph()
+        for app in ("sssp", "components", "pagerank"):
+            allr = ctl.read_standing_all(app)
+            assert set(allr) == {"w0", "w1"}
+            ref = solo.standing(app)["state"]
+            for wid, ent in allr.items():
+                assert ent["generation"] >= gen, (app, wid)
+                if app == "pagerank":
+                    a = ent["state"].view(np.int32).astype(np.int64)
+                    b = ref.view(np.int32).astype(np.int64)
+                    assert np.abs(a - b).max() <= 1, (app, wid)
+                else:
+                    assert np.array_equal(ent["state"], ref), (app, wid)
+        # and sssp is the merged graph's true answer, not just agreement
+        assert np.array_equal(ctl.read_standing("sssp")["state"],
+                              bfs_reference(merged, 0))
+    finally:
+        _close(fleet)
+
+
+def test_live_fleet_mid_replication_kill_and_rejoin(
+        small, tmp_path, monkeypatch):
+    """Acceptance under faults: a worker killed mid-replication (after
+    the delta npz, before the .ok marker) recovers its exact committed
+    prefix from its journal, rejoins, catches up through the
+    controller, and its reads/refresh answers are bitwise-equal to the
+    survivor's."""
+    g, sh = small
+    jroot = str(tmp_path / "j")
+    fleet = start_live_fleet(2, g, parts=2, cap=256,
+                             journal_root=jroot,
+                             standing=(("sssp", 0),))
+    ctl = fleet.controller
+    try:
+        batches = _batches(g, 4)
+        s, d, o = batches[0]
+        ctl.admit_writes(s, d, o)
+        # arm the crash on w1's NEXT journal mark, then vanish —
+        # the delta npz is on disk, the marker never lands
+        w1 = fleet.thread_workers[1]
+        orig_mark = DeltaLog._journal_mark
+
+        def boom(self_log, seq):
+            if self_log is w1._live.mg.log:
+                w1.kill()
+                raise OSError("killed between receipt and marker")
+            return orig_mark(self_log, seq)
+
+        monkeypatch.setattr(DeltaLog, "_journal_mark", boom)
+        s, d, o = batches[1]
+        rep = ctl.admit_writes(s, d, o)
+        monkeypatch.undo()
+        assert rep["acked"] == ["w0"]
+        deadline = time.monotonic() + 10
+        while ctl.live_workers() != ["w0"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        # fleet keeps admitting + serving while w1 is down
+        s, d, o = batches[2]
+        rep = ctl.admit_writes(s, d, o)
+        assert rep["generation"] == 3 and rep["acked"] == ["w0"]
+        f = ctl.submit(3, min_generation=3)
+        merged = ctl.journal.log.merged_graph()
+        assert np.array_equal(f.result(timeout=60),
+                              bfs_reference(merged, 3))
+        # recover w1 from its journal: the committed prefix is EXACTLY
+        # generation 1 (batch 2's marker never landed)
+        live2 = LiveReplica(g, sh, cap=256,
+                            journal_dir=os.path.join(jroot, "w1"),
+                            standing=(("sssp", 0),))
+        assert live2.generation() == 1
+        w1b = ReplicaWorker(sh, "w1", graph_id="live",
+                            q_buckets=(1, 4), live=live2).start()
+        fleet.thread_workers.append(w1b)
+        ctl.add_worker("127.0.0.1", w1b.port)
+        # catch-up ran inside add_worker: w1 is current again
+        assert ctl.worker_generations() == {"w0": 3, "w1": 3}
+        ctl.refresh_fleet()
+        allr = ctl.read_standing_all("sssp")
+        assert set(allr) == {"w0", "w1"}
+        assert np.array_equal(allr["w0"]["state"], allr["w1"]["state"])
+        assert np.array_equal(allr["w1"]["state"],
+                              bfs_reference(merged, 0))
+        assert allr["w1"]["generation"] == 3
+        # and routed reads hit the recovered replica too
+        seen = set()
+        for srcv in (0, 3, 7, 11, 20, 33):
+            fq = ctl.submit(srcv, min_generation=3)
+            assert np.array_equal(fq.result(timeout=60),
+                                  bfs_reference(merged, srcv))
+            seen.add(fq.worker_id)
+        assert "w1" in seen
+    finally:
+        _close(fleet)
+
+
+def test_overflow_escalates_to_fleet_compaction(small, tmp_path):
+    g, _sh = small
+    snap = str(tmp_path / "snap.lux")
+    fleet = start_live_fleet(2, g, parts=2, cap=128,
+                             snapshot_path=snap,
+                             journal_root=str(tmp_path / "j"),
+                             standing=(("sssp", 0),))
+    ctl = fleet.controller
+    rng = np.random.default_rng(1)
+    try:
+        rep = None
+        for i in range(3):
+            rep = ctl.admit_writes(rng.integers(0, g.nv, 120),
+                                   rng.integers(0, g.nv, 120),
+                                   np.ones(120, np.int8))
+            if rep["compacted"]:
+                break
+        assert rep["compacted"], "cap=128 never overflowed"
+        gen = rep["generation"]
+        assert ctl.journal.base_generation == gen
+        assert os.path.exists(snap)
+        # post-compaction: the whole fleet serves the new epoch, the
+        # write that triggered the escalation included
+        merged = ctl.journal.log.merged_graph()
+        f = ctl.submit(3, min_generation=gen)
+        assert np.array_equal(f.result(timeout=60),
+                              bfs_reference(merged, 3))
+        assert f.generation >= gen
+        assert ctl.worker_generations() == {"w0": gen, "w1": gen}
+        # the generation line continues across the epoch
+        s, d, o = _batches(g, 1)[0]
+        del_live = ctl.journal.log  # deletes must target the NEW base
+        live_edges = np.flatnonzero(~del_live.del_base)[:4]
+        base = del_live.base
+        rep2 = ctl.admit_writes(
+            np.asarray(base.col_idx, np.int64)[live_edges],
+            np.asarray(base.dst_of_edges(), np.int64)[live_edges],
+            np.zeros(4, np.int8))
+        assert rep2["generation"] == gen + 1
+    finally:
+        _close(fleet)
+
+
+def test_standing_state_not_stale_across_compaction(small, tmp_path):
+    """A standing state refreshed BEFORE later batches must not carry
+    across the compaction epoch (the new base embeds those batches; a
+    carried-over prior would be re-tagged current by the fresh-epoch
+    refresh without recomputing).  Only epoch-boundary states inherit
+    warm."""
+    g, _sh = small
+    snap = str(tmp_path / "snap.lux")
+    fleet = start_live_fleet(2, g, parts=2, cap=256,
+                             snapshot_path=snap,
+                             standing=(("sssp", 0),))
+    ctl = fleet.controller
+    try:
+        batches = _batches(g, 2)
+        s, d, o = batches[0]
+        ctl.admit_writes(s, d, o)
+        ctl.refresh_fleet()  # standing converges at generation 1
+        s, d, o = batches[1]
+        ctl.admit_writes(s, d, o)  # generation 2, NOT refreshed
+        ctl.compact_fleet()  # epoch base := 2
+        ctl.refresh_fleet()
+        merged = ctl.journal.log.merged_graph()
+        for wid, ent in ctl.read_standing_all("sssp").items():
+            assert ent["generation"] == 2, wid
+            assert np.array_equal(ent["state"],
+                                  bfs_reference(merged, 0)), wid
+        # piggyback (same fleet, snapshot on disk): a live worker must
+        # refuse a prepare with no base_generation — a snapshot swap
+        # that abandons the epoch would serve wrong answers under the
+        # same generation line — and the abort leaves it serving
+        with pytest.raises(FleetError, match="base_generation"):
+            ctl.republish(snap, graph_id="live")
+        f = ctl.submit(3)
+        assert np.array_equal(f.result(timeout=60),
+                              bfs_reference(merged, 3))
+    finally:
+        _close(fleet)
+
+
+def test_live_controller_refuses_static_worker(small):
+    g, sh = small
+    # prewarm=False: the refusal happens at the hello handshake — no
+    # engine is ever exercised, so don't pay the compile
+    w = ReplicaWorker(sh, "ws", graph_id="live",
+                      q_buckets=(1,)).start(prewarm=False)
+    ctl = LiveFleetController(g)
+    try:
+        with pytest.raises(FleetError, match="not live"):
+            ctl.add_worker("127.0.0.1", w.port)
+        assert ctl.live_workers() == []
+    finally:
+        ctl.close()
+        if w._running:
+            w.stop()
+
+
+@pytest.mark.slow
+def test_live_bench_row_shape():
+    """Slow tier: tier-1 already exercises the live row end-to-end
+    through test_bench's happy path (the real bench.py run asserts its
+    fields); this is the direct harness-shape check."""
+    from lux_tpu.serve.live.bench import measure_live_mixed
+
+    row = measure_live_mixed(scale=8, ef=8, workers=2, batch_rows=16,
+                             write_batches=3, reader_threads=1,
+                             min_window_s=0.5)
+    assert row["metric"] == "sssp_live_w2_rmat8_cpu"
+    assert row["unit"] == "QPS" and row["value"] > 0
+    assert row["write_batches_per_s"] > 0
+    assert row["final_generation"] == 3
+    assert set(row["worker_generations"].values()) == {3}
+    assert row["fleet_refresh_s"] > 0
+    assert row["read_errors"] == 0
+    for k in ("staleness_gen_p50", "staleness_gen_p99", "read_p50_ms",
+              "read_p99_ms", "write_rows_per_s", "compactions"):
+        assert k in row
+
+
+def test_shared_pull_layout_determinism(small):
+    """The overlay contract LiveReplica leans on: the push-embedded
+    pull layout is BITWISE the standalone pull layout, so overlays
+    built from the serving shards address the refresh engines' slots
+    too."""
+    import jax
+
+    g, sh = small
+    from lux_tpu.graph.push_shards import build_push_shards
+
+    other = build_push_shards(g, 2).pull
+    for a, b in zip(jax.tree_util.tree_leaves(sh.arrays),
+                    jax.tree_util.tree_leaves(other.arrays)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

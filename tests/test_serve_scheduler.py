@@ -58,6 +58,9 @@ class FakeCache:
     def warm_buckets(self, app):
         return self._warm
 
+    def current_overlay(self):
+        return None  # static snapshot: no live overlay, no tags
+
     def get(self, app, q):
         eng = self.engines.setdefault(q, FakeEngine(q, fail=self.fail))
         warm = q in self._warm

@@ -223,6 +223,42 @@ echo "$out" | grep -q "fleet.start" || { echo "missing fleet.start"; exit 1; }
 echo "$out" | grep -q "fleet.republish" || { echo "missing republish"; exit 1; }
 '
 
+# 3d) live smoke (ISSUE 12): a 2-worker thread-mode LIVE fleet — one
+#     write batch admitted at the controller, replicated to every
+#     replica, read back with a min_generation bound (read-your-writes)
+#     and through the fleet-wide warm refresh, tagged >= the commit
+#     generation and bitwise-equal to the merged-graph reference
+stage live_smoke 600 env JAX_PLATFORMS=cpu python -c "
+import numpy as np
+from lux_tpu.graph import generate
+from lux_tpu.models.sssp import bfs_reference
+from lux_tpu.serve.live.controller import start_live_fleet
+from lux_tpu.serve.live.bench import churn_batch
+g = generate.rmat(8, 4, seed=4)
+fleet = start_live_fleet(2, g, parts=2, cap=256, buckets=(1, 4),
+                         standing=(('sssp', 0),))
+ctl = fleet.controller
+try:
+    rng = np.random.default_rng(0)
+    src, dst, op = churn_batch(ctl.journal.log, rng, 32)
+    rep = ctl.admit_writes(src, dst, op)
+    assert rep['generation'] == 1 and len(rep['acked']) == 2, rep
+    merged = ctl.journal.log.merged_graph()
+    for s in (0, 3, 7):
+        f = ctl.submit(s, min_generation=1)
+        assert np.array_equal(f.result(timeout=60),
+                              bfs_reference(merged, s)), s
+        assert f.generation >= 1
+    ctl.refresh_fleet()
+    allr = ctl.read_standing_all('sssp')
+    for wid, ent in allr.items():
+        assert ent['generation'] >= 1, wid
+        assert np.array_equal(ent['state'], bfs_reference(merged, 0)), wid
+    print('live smoke:', ctl.worker_generations())
+finally:
+    fleet.close()
+"
+
 # 4) fast tier-1 subset: the engine/analysis/native seams this script
 #    exists to protect (full suite: ROADMAP.md "Tier-1 verify")
 stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
@@ -231,7 +267,7 @@ stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_passfuse.py tests/test_mxreduce.py tests/test_mxscan.py \
     tests/test_obs.py \
     tests/test_determinism.py tests/test_serve_scheduler.py \
-    tests/test_fleet.py tests/test_mutate.py
+    tests/test_fleet.py tests/test_mutate.py tests/test_live.py
 
 if [ "$FAILED" -ne 0 ]; then
   echo "ci_check: FAILED (see $LOG)"; exit 1
