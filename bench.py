@@ -43,8 +43,18 @@ Env knobs:
   LUX_BENCH_CPU_SCALE (default min(scale, 18)) fallback worker's RMAT scale
                    — a 1-core CPU needs a smaller graph to finish in budget
   LUX_BENCH_APPS   (default pagerank,sssp,components,colfilter,serve,ba,
-                   refresh,live) which app metrics to measure; pagerank
-                   is the headline and always prints last.  "live" is
+                   refresh,live,bfs,labelprop) which app metrics to
+                   measure; pagerank is the headline and always prints
+                   last.  "bfs"/"labelprop" are the spec-compiled
+                   luxprog workload rows (ISSUE 13): bfs = multi-source
+                   BFS on the headline graph's push layout, labelprop =
+                   the wide-state dense-pull row on its own small graph
+                   (LUX_BENCH_LABELPROP_SCALE, default min(scale, 12)).
+                   "kcore" and "triangles" are OPT-IN luxprog rows
+                   (LUX_BENCH_KCORE_SCALE / LUX_BENCH_TRIANGLES_SCALE):
+                   the iterative peel compiles one program per level,
+                   and the triangle bitsets are quadratic in nv — both
+                   bounded-small by design.  "live" is
                    the mutation-aware serving row (lux_tpu.serve.live,
                    ISSUE 12): sssp_live_w2_* — a 2-worker thread-mode
                    live fleet under a concurrent writer + closed-loop
@@ -437,7 +447,8 @@ def worker_main():
         a.strip()
         for a in os.environ.get(
             "LUX_BENCH_APPS",
-            "pagerank,sssp,components,colfilter,serve,ba,refresh,live",
+            "pagerank,sssp,components,colfilter,serve,ba,refresh,live,"
+            "bfs,labelprop",
         ).split(",")
         if a.strip()
     ]
@@ -712,6 +723,183 @@ def worker_main():
                 "dense_rounds": dr,
                 "traversed_edges": traversed,
                 **roofline.summarize(model, elapsed, traversed),
+            }
+        )
+
+    def measure_bfs():
+        """Spec-compiled multi-source BFS (ISSUE 13, lux_tpu.program):
+        the luxprog payoff workload on the push engine, riding the SAME
+        timed convergence harness as sssp — the program object is the
+        only difference, which is the point (the compiler, not the
+        engines, absorbed the scenario)."""
+        import numpy as np
+
+        from lux_tpu.program import workloads as prog_workloads
+
+        m = resolve_method("auto", "min", platform)
+        deg = np.bincount(g.col_idx, minlength=g.nv)
+        srcs = tuple(int(v) for v in np.argsort(deg)[::-1][:4])
+        prog = prog_workloads.bfs_program(g.nv, srcs)
+        n_iters, traversed, elapsed, dr = _timed_push_convergence(
+            prog, m, app="bfs")
+        gteps = traversed / elapsed / 1e9
+        model = roofline.push_run_model(g.ne, g.nv, traversed, dr, m)
+        _emit_row(
+            {
+                "metric": f"bfs_gteps_rmat{scale}_1chip{suffix}",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
+                "sources": list(srcs),
+                "iters": n_iters,
+                "dense_rounds": dr,
+                "traversed_edges": traversed,
+                # dense rounds are pull-style in-edge sweeps: the same
+                # accounted-sweep family every pull row carries
+                "hbm_passes": roofline.pull_hbm_passes(m),
+                **roofline.summarize(model, elapsed, traversed),
+            }
+        )
+
+    def _fetch_timed_iters(run, n_iters, reps=2):
+        """fetch_timed's differencing discipline for a secondary app
+        with its OWN iteration count (the closure above is bound to the
+        headline race's).  Returns honest per-run seconds."""
+
+        def once(n):
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                out = run(n)
+                float(jax.device_get(out.ravel()[0]))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        with obs.span("compile.warm", iters=n_iters):
+            for n in (1, n_iters):
+                float(jax.device_get(run(n).ravel()[0]))
+        with obs.span("iterate", iters=n_iters, reps=reps):
+            t1 = once(1)
+            tn = once(n_iters)
+        per_iter = (max((tn - t1) / (n_iters - 1), 1e-9)
+                    if n_iters > 1 else tn)
+        return per_iter * n_iters
+
+    def measure_labelprop():
+        """Spec-compiled seeded label propagation (ISSUE 13): the WIDE
+        (V, L) dense-pull workload on its own small graph
+        (LUX_BENCH_LABELPROP_SCALE, default min(scale, 12)) — GTEPS
+        counts edge traversals (each moves L lanes; the row carries
+        ``labels`` so the byte volume is reconstructible)."""
+        from lux_tpu.graph.shards import build_pull_shards as _bps
+        from lux_tpu.program import workloads as prog_workloads
+
+        lscale = _env_int("LUX_BENCH_LABELPROP_SCALE", min(scale, 12))
+        labels, stride, n_it = 8, 16, 10
+        m = resolve_method("auto", "sum", platform)
+        gl = generate.rmat(lscale, ef, seed=0)
+        shl = _bps(gl, 1)
+        prog = prog_workloads.labelprop_program(labels, stride)
+        arr_l = jax.tree.map(jnp.asarray, shl.arrays)
+        s0 = pull.init_state(prog, arr_l)
+
+        def run(n):
+            return pull.run_pull_fixed(prog, shl.spec, arr_l, s0, n, m)
+
+        elapsed = _fetch_timed_iters(run, n_it)
+        gteps = n_it * gl.ne / elapsed / 1e9
+        _emit_row(
+            {
+                "metric": f"labelprop_gteps_rmat{lscale}_1chip{suffix}",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
+                "labels": labels,
+                "seed_stride": stride,
+                "iters": n_it,
+                "hbm_passes": roofline.pull_hbm_passes(m),
+            }
+        )
+
+    def measure_kcore():
+        """Spec-compiled k-core decomposition (ISSUE 13, OPT-IN via
+        LUX_BENCH_APPS): the iterative peel on its own small graph —
+        one compiled program per level, warm-started survivors.  GTEPS
+        over ne * total peel rounds (each round is one dense in-edge
+        sweep)."""
+        from lux_tpu.graph.shards import build_pull_shards as _bps
+        from lux_tpu.program import workloads as prog_workloads
+
+        kscale = _env_int("LUX_BENCH_KCORE_SCALE", min(scale, 12))
+        m = resolve_method("auto", "sum", platform)
+        gk = generate.rmat(kscale, ef, seed=0)
+        gks = prog_workloads.symmetrize(gk)
+        shk = _bps(gks, 1)
+        with obs.span("compile.warm", app="kcore"):
+            # the peel compiles ONE program per level (kk is a static),
+            # so the warm pass must run the FULL decomposition — a
+            # partial warm would leave levels >= 2 compiling inside the
+            # timed region and the row would report compile time
+            prog_workloads.kcore(shk, method=m)
+        with obs.span("iterate", app="kcore"):
+            t0 = time.perf_counter()
+            coreness, kmax, rounds = prog_workloads.kcore(shk, method=m)
+            elapsed = time.perf_counter() - t0
+        gteps = rounds * gks.ne / elapsed / 1e9
+        _emit_row(
+            {
+                "metric": f"kcore_gteps_rmat{kscale}_1chip{suffix}",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
+                "k_max": int(kmax),
+                "peel_rounds": int(rounds),
+                "core_vertices": int((coreness > 0).sum()),
+                "hbm_passes": roofline.pull_hbm_passes(m),
+            }
+        )
+
+    def measure_triangles():
+        """Spec-compiled weighted triangle counting (ISSUE 13, OPT-IN):
+        the two-phase intersection-heavy program on its own small
+        symmetrized graph (bitset state is quadratic in nv by design).
+        GTEPS over 2 edge sweeps (one per phase); the row carries the
+        exactness cross-check against the NumPy oracle."""
+        import numpy as np
+
+        from lux_tpu.program import workloads as prog_workloads
+
+        tscale = _env_int("LUX_BENCH_TRIANGLES_SCALE", 10)
+        m = resolve_method("auto", "sum", platform)
+        gt = prog_workloads.symmetrize(
+            generate.rmat(tscale, ef, seed=0, weighted=True))
+        with obs.span("compile.warm", app="triangles"):
+            prog_workloads.triangles(gt, method=m)
+        with obs.span("iterate", app="triangles"):
+            t0 = time.perf_counter()
+            incidence, stats = prog_workloads.triangles(gt, method=m)
+            elapsed = time.perf_counter() - t0
+        sweeps = 2
+        gteps = sweeps * gt.ne / elapsed / 1e9
+        oracle_ok = bool(
+            np.allclose(incidence, prog_workloads.triangles_reference(gt),
+                        rtol=1e-5))
+        _emit_row(
+            {
+                "metric": f"triangles_gteps_rmat{tscale}_1chip{suffix}",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / BASELINE_GTEPS_PER_CHIP, 4),
+                "method": m,
+                "total_weighted_incidence":
+                    stats["total_weighted_incidence"],
+                "bitset_words": stats["bitset_words"],
+                "oracle_ok": oracle_ok,
+                "hbm_passes": {"per_phase": roofline.pull_hbm_passes(m),
+                               "phases": sweeps},
             }
         )
 
@@ -1430,6 +1618,14 @@ def worker_main():
             measure_components(resolve_method("auto", "max", platform))
         except Exception as e:  # noqa: BLE001
             print(f"# components failed: {e}", file=sys.stderr, flush=True)
+    if "bfs" in apps:
+        # spec-compiled workload rows (ISSUE 13).  bfs rides the
+        # headline graph's push layout, so it runs under layout A/B too
+        # (the dense rounds honor sort_seg/compact exactly like sssp).
+        try:
+            measure_bfs()
+        except Exception as e:  # noqa: BLE001
+            print(f"# bfs failed: {e}", file=sys.stderr, flush=True)
     layout_ab = (sort_seg or compact or route_gather or route_fused
                  or route_pf or route_fused_pf or route_fused_mx)
     if "serve" in apps:
@@ -1441,6 +1637,41 @@ def worker_main():
                 measure_serve()
             except Exception as e:  # noqa: BLE001
                 print(f"# serve failed: {e}", file=sys.stderr, flush=True)
+    if "labelprop" in apps:
+        # spec-compiled wide-state dense-pull row (ISSUE 13); own small
+        # graph on the default layout — skipped under layout A/B for
+        # isolation, like serve
+        if layout_ab:
+            print("# labelprop row skipped: layout A/B run",
+                  file=sys.stderr, flush=True)
+        else:
+            try:
+                measure_labelprop()
+            except Exception as e:  # noqa: BLE001
+                print(f"# labelprop failed: {e}", file=sys.stderr,
+                      flush=True)
+    if "kcore" in apps:
+        # OPT-IN (LUX_BENCH_APPS=...,kcore): the iterative peel compiles
+        # one program per level — minutes of compile on purpose
+        if layout_ab:
+            print("# kcore row skipped: layout A/B run", file=sys.stderr,
+                  flush=True)
+        else:
+            try:
+                measure_kcore()
+            except Exception as e:  # noqa: BLE001
+                print(f"# kcore failed: {e}", file=sys.stderr, flush=True)
+    if "triangles" in apps:
+        # OPT-IN: quadratic bitset state, small graph by design
+        if layout_ab:
+            print("# triangles row skipped: layout A/B run",
+                  file=sys.stderr, flush=True)
+        else:
+            try:
+                measure_triangles()
+            except Exception as e:  # noqa: BLE001
+                print(f"# triangles failed: {e}", file=sys.stderr,
+                      flush=True)
     if "ba" in apps:
         # the standing heavy-tail row is itself a routed-pf measurement;
         # skip it under layout A/B runs (isolation, like serve) and when

@@ -830,6 +830,123 @@ def _hbm_mxscan_ring_neutral() -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 
+def _spec_labelprop_prog():
+    """A spec-compiled WIDE pull program (ISSUE 13) — the audit gates
+    must cover compiled programs exactly like hand-wired dataclasses."""
+    from lux_tpu.program import workloads
+
+    return workloads.labelprop_program(labels=4, stride=8)
+
+
+def _spec_labelprop_traced(num_iters: int):
+    from lux_tpu.engine import pull
+
+    fx = fixture()
+    prog = _spec_labelprop_prog()
+    state0 = pull.init_state(prog, fx["arrays"])
+    return pull._pull_fixed_jit.trace(
+        prog, fx["shards"].spec, num_iters, "scan", fx["arrays"], state0,
+        None, route_static=None, route_arrays=None, interpret=True,
+        ostatic=None, oarrays=None), state0
+
+
+def _retrace_spec_labelprop() -> List[Finding]:
+    """LUX-J1 for a spec-compiled program (labelprop, dense pull, wide
+    state): the compiled program must be a stable jit static — stable
+    across re-traces AND across reconstruction of an equal program
+    (two equal specs ARE one program), structurally identical across
+    iteration counts."""
+    fx = fixture()
+    path = "lux_tpu/program/spec.py"
+    label = "pull-fixed/spec-labelprop"
+    out = retrace.check_statics(
+        (_spec_labelprop_prog(), fx["shards"].spec, "scan"), path, label)
+    out += retrace.trace_twice_stable(
+        lambda: _spec_labelprop_traced(2)[0], path, label)
+    out += retrace.check_variants(
+        [_spec_labelprop_traced(2)[0], _spec_labelprop_traced(3)[0]],
+        path, label)
+    return out
+
+
+def _donation_spec_labelprop() -> List[Finding]:
+    """LUX-J2 for the spec-compiled pull program: the donating twin
+    must consume the wide state buffer exactly like a hand-wired
+    program's."""
+    from lux_tpu.engine import pull
+
+    fx = fixture()
+    prog = _spec_labelprop_prog()
+    state0 = pull.init_state(prog, fx["arrays"])
+    args = (fx["arrays"], state0)
+    traced = pull._pull_fixed_jit_donate.trace(
+        prog, fx["shards"].spec, 3, "scan", *args,
+        route_static=None, route_arrays=None, interpret=True)
+    return donation.check_donation(
+        traced, args, donate_argnums=(1,),
+        path="lux_tpu/program/spec.py",
+        label="pull-fixed/spec-labelprop-donate")
+
+
+def _spec_bfs_prog():
+    from lux_tpu.program import workloads
+
+    return workloads.bfs_program(fixture()["graph"].nv, (0, 5))
+
+
+def _retrace_spec_bfs_push() -> List[Finding]:
+    """LUX-J1 for a spec-compiled frontier program (bfs) on the push
+    chunk loop: statics hashable, it_stop re-calls hit the compile
+    cache (the same one-compile-serves-every-run-length contract the
+    hand-wired sssp unit pins)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import push
+
+    fx = fixture()
+    sh = fx["pshards"]
+    prog = _spec_bfs_prog()
+    loop = push.compile_push_chunk(prog, sh.pspec, sh.spec, "scan")
+    arrays, parrays, carry0 = push.push_init(prog, sh)
+
+    def call(stop):
+        def go():
+            out = loop(arrays, parrays, carry0, jnp.int32(stop))
+            jax.block_until_ready(out.state)
+            return out
+
+        return go
+
+    path = "lux_tpu/program/spec.py"
+    out = retrace.check_statics((prog, sh.pspec, sh.spec, "scan"),
+                                path, "push-chunk/spec-bfs")
+    out += retrace.check_dynamic_recall(
+        loop, call(2), call(3), path, "push-chunk/spec-bfs/it_stop")
+    return out
+
+
+def _donation_spec_bfs_push() -> List[Finding]:
+    """LUX-J2 for the spec-compiled push program: the donating chunk
+    loop consumes the carry."""
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import push
+
+    fx = fixture()
+    sh = fx["pshards"]
+    prog = _spec_bfs_prog()
+    loop = push.compile_push_chunk(prog, sh.pspec, sh.spec, "scan",
+                                   donate=True)
+    arrays, parrays, carry0 = push.push_init(prog, sh)
+    args = (arrays, parrays, carry0, jnp.int32(4))
+    traced = loop.trace(*args)
+    return donation.check_donation(
+        traced, args, donate_argnums=(2,),
+        path="lux_tpu/program/spec.py",
+        label="push-chunk/spec-bfs-donate")
+
+
 def audit_units(fast: bool = False) -> List[AuditUnit]:
     units = [
         AuditUnit("retrace", "pull-fixed/direct",
@@ -868,6 +985,12 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
         AuditUnit("retrace", "serve-sssp/overlay",
                   "lux_tpu/serve/batched.py", False,
                   _retrace_serve_overlay),
+        AuditUnit("retrace", "pull-fixed/spec-labelprop",
+                  "lux_tpu/program/spec.py", False,
+                  _retrace_spec_labelprop),
+        AuditUnit("retrace", "push-chunk/spec-bfs",
+                  "lux_tpu/program/spec.py", False,
+                  _retrace_spec_bfs_push),
         AuditUnit("donation", "pull-fixed/donate",
                   "lux_tpu/engine/pull.py", True, _donation_pull_fixed),
         AuditUnit("donation", "pull-until/donate",
@@ -891,6 +1014,12 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
         AuditUnit("donation", "serve-ppr/donate",
                   "lux_tpu/serve/batched.py", False,
                   lambda: _donation_serve("ppr")),
+        AuditUnit("donation", "pull-fixed/spec-labelprop-donate",
+                  "lux_tpu/program/spec.py", False,
+                  _donation_spec_labelprop),
+        AuditUnit("donation", "push-chunk/spec-bfs-donate",
+                  "lux_tpu/program/spec.py", False,
+                  _donation_spec_bfs_push),
         AuditUnit("collective", "push-dist/allgather",
                   "lux_tpu/engine/push.py", False, _collective_push_dist),
         AuditUnit("collective", "push-ring/ppermute",

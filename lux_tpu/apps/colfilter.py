@@ -185,13 +185,9 @@ def main(argv=None):
         if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
             common.save_global(cfg, "colfilter", shards, it + 1, st)
 
-    route = None
-    if cfg.route_gather and mesh is None:
-        # host-side plan construction stays OUTSIDE the reported time
-        from lux_tpu.ops import expand
-
-        route = expand.plan_cf_route_shards_cached(
-            shards, pf=common.route_is_pf(cfg.route_gather))
+    # host-side plan construction stays OUTSIDE the reported time
+    route = (common.build_pull_route(cfg, shards, prog)
+             if mesh is None else None)
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
         elapsed = None

@@ -331,6 +331,54 @@ def validate_exchange(cfg: RunConfig, prog) -> None:
             )
 
 
+def build_pull_route(cfg: RunConfig, shards, prog):
+    """ONE --route-gather plan construction for a pull-layout run
+    (host-side — call it OUTSIDE the timed region): fused plans for the
+    'fused*' modes, the CF per-column src+dst plan for wide
+    dst-dependent programs, the expand plan otherwise; '' = None.
+    Shared by the pagerank/colfilter mains, the generic program driver
+    (apps/run.py), and run_fixed_dist, so the mode->planner dispatch
+    cannot drift per driver."""
+    rg = getattr(cfg, "route_gather", "")
+    if not rg:
+        return None
+    from lux_tpu.ops import expand
+
+    pf = route_is_pf(rg)
+    if route_base(rg) == "fused":
+        if getattr(prog, "k", 1) > 1:
+            # defense-in-depth twin of validate_exchange's CLI guard:
+            # a library caller skipping validation must get the clear
+            # error here, not a mid-iteration fused-shape crash
+            raise SystemExit(
+                "--route-gather fused supports scalar vertex state; "
+                "wide dst-dependent programs route with "
+                "--route-gather expand (per-column src + dst plans)")
+        return expand.plan_fused_shards_cached(shards, prog.reduce, pf=pf,
+                                               mx=route_mx(rg))
+    if getattr(prog, "k", 1) > 1:
+        # wide states route through the CF per-column src+dst plans (a
+        # program that ignores dst still reads it exactly; XLA DCEs it)
+        return expand.plan_cf_route_shards_cached(shards, pf=pf)
+    return expand.plan_expand_shards_cached(shards, pf=pf)
+
+
+def build_push_route(cfg: RunConfig, shards):
+    """The push apps' --route-gather twin of build_pull_route: ring
+    exchanges plan per-bucket, every other push branch routes the dense
+    rounds on the pull layout.  Shared by the sssp/components
+    convergence driver and the generic program driver's frontier
+    workloads."""
+    if not getattr(cfg, "route_gather", ""):
+        return None
+    from lux_tpu.ops import expand
+
+    if cfg.exchange == "ring":
+        return expand.plan_ring_route_shards_cached(shards)
+    return expand.plan_expand_shards_cached(
+        shards, pf=route_is_pf(cfg.route_gather))
+
+
 def build_exchange_shards(g: HostGraph, cfg: RunConfig):
     """Shard builder for the selected --exchange strategy (SURVEY.md §2.5).
     ring/scatter bucket the graph for their collectives; allgather uses the
@@ -650,20 +698,7 @@ def run_fixed_dist(prog, shards, state, num_iters, mesh, cfg: RunConfig):
         )
     from lux_tpu.parallel import dist
 
-    route = None
-    rg = getattr(cfg, "route_gather", "")
-    if rg:
-        from lux_tpu.ops import expand
-
-        pf = route_is_pf(rg)
-        if route_base(rg) == "fused":
-            route = expand.plan_fused_shards_cached(shards, prog.reduce,
-                                                    pf=pf,
-                                                    mx=route_mx(rg))
-        elif getattr(prog, "k", 1) > 1:
-            route = expand.plan_cf_route_shards_cached(shards, pf=pf)
-        else:
-            route = expand.plan_expand_shards_cached(shards, pf=pf)
+    route = build_pull_route(cfg, shards, prog)
     return dist.run_pull_fixed_dist(
         prog, shards.spec, shards.arrays, state, num_iters, mesh, cfg.method,
         route=route,

@@ -142,19 +142,9 @@ def main(argv=None):
         if cfg.ckpt_every and cfg.ckpt_dir and (it + 1) % cfg.ckpt_every == 0:
             common.save_global(cfg, "pagerank", shards, it + 1, st)
 
-    route = None
-    if cfg.route_gather and mesh is None:
-        # host-side plan construction stays OUTSIDE the reported time
-        from lux_tpu.ops import expand
-
-        pf = common.route_is_pf(cfg.route_gather)
-        route = (
-            expand.plan_fused_shards_cached(
-                shards, prog.reduce, pf=pf,
-                mx=common.route_mx(cfg.route_gather))
-            if common.route_base(cfg.route_gather) == "fused"
-            else expand.plan_expand_shards_cached(shards, pf=pf)
-        )
+    # host-side plan construction stays OUTSIDE the reported time
+    route = (common.build_pull_route(cfg, shards, prog)
+             if mesh is None else None)
     with profiling.trace(cfg.profile_dir):
         timer = Timer()
         elapsed = None  # chunked path reports compute-only time

@@ -1,0 +1,248 @@
+"""Generic spec-workload driver: ``python -m lux_tpu.apps.run <program>``.
+
+ONE driver for every declarative workload (ISSUE 13): it owns the CLI
+boilerplate the four original apps used to each re-wire — graph load,
+flag validation, shard build, ``--route-gather``/``--method`` resolution
+through :mod:`lux_tpu.apps.common`, preflight, timing, the reference
+[PASS]/[FAIL] ``-check`` verdict — so a new workload is a spec in
+:mod:`lux_tpu.program.library` plus a ~40-line runner entry here.
+
+Shipped programs (the ISSUE 13 payoff set):
+
+  bfs        multi-source BFS on the frontier/push engine (``--sources``;
+             ``--engine pull`` runs the pull-until surface — bitwise-
+             identical distances); the full push flag surface applies
+             (--distributed, --exchange ring, --route-gather, ...)
+  kcore      k-core decomposition by iterative peel (``--kmax``); runs on
+             the symmetrized simple view unless ``--directed``
+  labelprop  seeded multi-class label propagation (dense pull, wide
+             (V, --labels) state; seeds every ``--seed-stride``)
+  triangles  weighted triangle counting — the two-phase
+             intersection-heavy program (symmetrized view; unit weights
+             when the input graph is unweighted)
+
+The four reference apps keep their dedicated CLIs
+(``lux_tpu.apps.{pagerank,sssp,components,colfilter}``) for their deep
+flag surfaces; they evaluate the same spec registry.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from lux_tpu.apps import common
+from lux_tpu.program import workloads
+from lux_tpu.utils.config import parse_args
+from lux_tpu.utils.timing import Timer, report_elapsed
+
+
+def _parse_sources(cfg, nv: int):
+    try:
+        srcs = [int(s) for s in cfg.sources.split(",") if s.strip()]
+    except ValueError:
+        raise SystemExit(f"--sources must be comma-separated vertex ids, "
+                         f"got {cfg.sources!r}")
+    if not srcs:
+        raise SystemExit("--sources needs at least one vertex")
+    for s in srcs:
+        if not 0 <= s < nv:
+            raise SystemExit(f"--sources vertex {s} out of range [0, {nv})")
+    return srcs
+
+
+def _require_allgather(cfg, what: str) -> None:
+    if cfg.exchange != "allgather" or cfg.edge_shards > 1 \
+            or cfg.feat_shards > 1:
+        raise SystemExit(
+            f"{what} runs on the allgather pull layout; --exchange "
+            "ring/scatter, --edge-shards and --feat-shards are not "
+            "wired to this workload")
+
+
+def _check_verdict(cfg, name: str, violations: int) -> int:
+    if not cfg.check:
+        return 0
+    return 0 if common.print_check(name, violations) else 1
+
+
+def _run_bfs(cfg) -> int:
+    g = common.load_graph(cfg)
+    sources = _parse_sources(cfg, g.nv)
+    if cfg.prog_engine == "pull":
+        # the pull-until surface: bitwise the same min fixpoint
+        _require_allgather(cfg, "bfs --engine pull")
+        from lux_tpu.engine import methods
+
+        cfg.method = methods.resolve_sum(cfg.method, "min")
+        common.resolve_route_auto(cfg)
+        if cfg.route_gather and (cfg.distributed or cfg.method == "pallas"):
+            raise SystemExit("bfs --engine pull routes single-device "
+                             "allgather runs only")
+        from lux_tpu.graph.shards import build_pull_shards
+
+        shards = build_pull_shards(g, cfg.num_parts)
+        prog = workloads.bfs_program(g.nv, sources)
+        route = common.build_pull_route(cfg, shards, prog)
+        mesh = common.make_mesh_if(cfg)
+        timer = Timer()
+        dist, iters = workloads.bfs(
+            shards, sources,
+            num_parts=cfg.num_parts, max_iters=cfg.max_iters,
+            method=cfg.method, engine="pull", mesh=mesh, route=route)
+        elapsed = timer.stop(dist)
+    else:
+        # home surface: the direction-optimizing push engine, through
+        # the SAME convergence driver the sssp/components CLIs use —
+        # preflight, routing, ring exchange, repartition, GTEPS
+        from lux_tpu.apps.sssp import build_push_app_shards, \
+            run_convergence_app
+
+        if cfg.method == "pallas":
+            raise SystemExit("--method pallas is a sum-reduce kernel; "
+                             "bfs reduces with min")
+        shards = build_push_app_shards(g, cfg)
+        prog = workloads.bfs_program(shards.spec.nv, sources)
+        dist, _state, shards = run_convergence_app(
+            prog, shards, cfg, "bfs", g=g)
+        elapsed = None  # run_convergence_app already reported
+        iters = None
+    reached = int(np.sum(dist < g.nv))
+    depth = int(dist[dist < g.nv].max(initial=0))
+    if elapsed is not None:
+        print(f"bfs converged in {iters} iterations")
+        report_elapsed(elapsed, g.ne, max(iters, 1))
+    print(f"reached {reached}/{g.nv} vertices from {len(sources)} "
+          f"source(s); max level {depth}")
+    return _check_verdict(cfg, "bfs",
+                          workloads.check_bfs(g, dist, sources))
+
+
+def _run_kcore(cfg) -> int:
+    g0 = common.load_graph(cfg)
+    g = g0 if cfg.directed else workloads.symmetrize(g0)
+    view = "directed in-neighborhoods" if cfg.directed else \
+        "symmetrized simple view"
+    from lux_tpu.program import library
+    from lux_tpu.program.spec import bind
+
+    prog = bind(library.KCORE, kk=1)
+    common.validate_exchange(cfg, prog)
+    _require_allgather(cfg, "kcore")
+    from lux_tpu.graph.shards import build_pull_shards
+
+    shards = build_pull_shards(g, cfg.num_parts)
+    est = common.estimate_exchange(shards, cfg)
+    common.report_preflight(est, cfg, shards)
+    mesh = common.make_mesh_if(cfg)
+    route = common.build_pull_route(cfg, shards, prog) \
+        if mesh is None else None
+    timer = Timer()
+    coreness, kmax, rounds = workloads.kcore(
+        shards, kmax=cfg.kmax, num_parts=cfg.num_parts,
+        max_iters=cfg.max_iters, method=cfg.method, mesh=mesh,
+        route=route)
+    elapsed = timer.stop(coreness)
+    print(f"kcore ({view}): k_max={kmax} in {rounds} peel rounds")
+    report_elapsed(elapsed, g.ne, max(rounds, 1))
+    top = np.bincount(coreness, minlength=kmax + 1)
+    print("core sizes (|coreness >= k|): "
+          + ", ".join(f"k{k}={int(top[k:].sum())}"
+                      for k in range(1, min(kmax, 8) + 1)))
+    return _check_verdict(cfg, "kcore", workloads.check_kcore(g, coreness))
+
+
+def _run_labelprop(cfg) -> int:
+    g = common.load_graph(cfg)
+    prog = workloads.labelprop_program(cfg.labels, cfg.seed_stride)
+    common.validate_exchange(cfg, prog)
+    _require_allgather(cfg, "labelprop")
+    if cfg.route_gather:
+        raise SystemExit(
+            "labelprop's wide probability state is not wired to "
+            "--route-gather (see docs/PROGRAMS.md lowering matrix)")
+    from lux_tpu.graph.shards import build_pull_shards
+
+    shards = build_pull_shards(g, cfg.num_parts)
+    est = common.estimate_exchange(shards, cfg, state_width=cfg.labels)
+    common.report_preflight(est, cfg, shards, state_width=cfg.labels)
+    mesh = common.make_mesh_if(cfg)
+    timer = Timer()
+    probs = workloads.labelprop(
+        shards, labels=cfg.labels,
+        stride=cfg.seed_stride, num_iters=cfg.num_iters,
+        num_parts=cfg.num_parts, method=cfg.method, mesh=mesh)
+    elapsed = timer.stop(probs)
+    report_elapsed(elapsed, g.ne, cfg.num_iters)
+    hist = np.bincount(probs.argmax(-1), minlength=cfg.labels)
+    print("argmax label histogram: "
+          + ", ".join(f"c{i}={int(n)}" for i, n in enumerate(hist)))
+    return _check_verdict(
+        cfg, "labelprop",
+        workloads.check_labelprop(probs, cfg.labels, cfg.seed_stride))
+
+
+def _run_triangles(cfg) -> int:
+    g0 = common.load_graph(cfg)
+    if cfg.directed:
+        if g0.weights is None:
+            raise SystemExit("triangles --directed needs a weighted graph "
+                             "(the closing-edge weight)")
+        g = g0
+    else:
+        g = workloads.symmetrize(g0)
+    if cfg.distributed or cfg.route_gather:
+        raise SystemExit(
+            "triangles is a single-device two-phase program; "
+            "--distributed/--route-gather are not wired (see "
+            "docs/PROGRAMS.md)")
+    _require_allgather(cfg, "triangles")
+    timer = Timer()
+    incidence, stats = workloads.triangles(
+        g, num_parts=cfg.num_parts, method=cfg.method)
+    elapsed = timer.stop(incidence)
+    report_elapsed(elapsed, g.ne, 2)  # two phases, one edge sweep each
+    print(f"weighted triangle incidence total = "
+          f"{stats['total_weighted_incidence']:.1f} "
+          f"(bitset words/vertex: {stats['bitset_words']})")
+    if g0.weights is None and not cfg.directed:
+        print(f"triangles (unit weights, exact) = "
+              f"{stats['triangles_if_unit']:.0f}")
+    return _check_verdict(cfg, "triangles",
+                          workloads.check_triangles(g, incidence))
+
+
+#: name -> (parse_args surface, runner)
+PROGRAMS = {
+    "bfs": ("push", _run_bfs),
+    "kcore": ("pull", _run_kcore),
+    "labelprop": ("pull", _run_labelprop),
+    "triangles": ("pull", _run_triangles),
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("usage: python -m lux_tpu.apps.run "
+              f"{{{','.join(sorted(PROGRAMS))}}} [flags]   "
+              "(-h after a program name for its flags)")
+        return 0 if argv else 2
+    name = argv[0]
+    if name not in PROGRAMS:
+        print(f"unknown program {name!r}; available: "
+              + ", ".join(sorted(PROGRAMS))
+              + " (the reference apps keep their own CLIs: "
+                "python -m lux_tpu.apps.<pagerank|sssp|components|"
+                "colfilter>)", file=sys.stderr)
+        return 2
+    kind, runner = PROGRAMS[name]
+    cfg = parse_args(argv[1:], description=__doc__,
+                     pull=kind == "pull", push=kind == "push",
+                     program=True, prog=name)
+    return runner(cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -302,16 +302,8 @@ def run_convergence_app(prog, shards, cfg, name: str, g=None):
     with profiling.trace(cfg.profile_dir):
         # ONE plan computation for every routed branch — built outside
         # the timed region.  The ring exchange plans per-bucket; every
-        # other branch plans on the pull layout.
-        route = None
-        if getattr(cfg, "route_gather", ""):
-            from lux_tpu.ops import expand
-
-            route = (expand.plan_ring_route_shards_cached(shards)
-                     if cfg.exchange == "ring"
-                     else expand.plan_expand_shards_cached(
-                         shards,
-                         pf=common.route_is_pf(cfg.route_gather)))
+        # other branch plans on the pull layout (common.build_push_route).
+        route = common.build_push_route(cfg, shards)
 
         timer = Timer()
         if cfg.ckpt_every and getattr(cfg, "delta", 0):

@@ -24,8 +24,9 @@ import numpy as np
 
 from lux_tpu.engine import pull
 from lux_tpu.graph.csc import HostGraph
-from lux_tpu.graph.shards import PullShards, ShardArrays, build_pull_shards
+from lux_tpu.graph.shards import PullShards, build_pull_shards
 from lux_tpu.parallel.mesh import Mesh
+from lux_tpu.program import SpecBacked, library
 
 K = 20
 LAMBDA = 1e-3
@@ -70,7 +71,18 @@ def _resolve_err_dot(mode: str | None) -> str:
 
 
 @dataclasses.dataclass(frozen=True)
-class CFProgram:
+class CFProgram(SpecBacked):
+    """CF as a named parameter bundle over the declarative spec
+    (lux_tpu.program.library.COLFILTER — ISSUE 13): per edge
+    err = rating - <v_src, v_dst> (the spec's ``dot_lanes`` is the
+    ``err_dot`` helper above, so the banked ``tpu:cf_err_dot`` winner
+    flows through unchanged), value pushed to dst = err * v_src, update
+    v += GAMMA * (accErr - LAMBDA * v).  Gathers arrive in the storage
+    dtype; compute + reduce stay float32.  The error term reads the
+    destination's current vector per edge (``needs_dst_state`` via the
+    spec), so exchanges that pre-combine remotely (reduce_scatter)
+    can't run CF."""
+
     k: int = K
     lam: float = LAMBDA
     gamma: float = GAMMA
@@ -85,36 +97,13 @@ class CFProgram:
     #: caller bitwise-unchanged.
     err_dot: str = "vpu"
 
-    reduce: str = dataclasses.field(default="sum", init=False)
-    #: the error term reads the destination's current vector per edge, so
-    #: exchanges that pre-combine remotely (reduce_scatter) can't run CF
-    needs_dst_state: bool = dataclasses.field(default=True, init=False)
+    @property
+    def spec(self):
+        return library.COLFILTER
 
-    def init_state(self, global_vid, degree, vtx_mask):
-        del degree
-        v0 = jnp.full(
-            (global_vid.shape[0], self.k), np.sqrt(1.0 / self.k), jnp.float32
-        )
-        return jnp.where(vtx_mask[:, None], v0, 0.0).astype(self.dtype)
-
-    def edge_value(self, src_state, weight, dst_state=None):
-        # err = rating - <v_src, v_dst>; value pushed to dst = err * v_src.
-        # gathers arrive in the storage dtype; compute + reduce in f32
-        src = src_state.astype(jnp.float32)
-        dst = dst_state.astype(jnp.float32)
-        err = weight - err_dot(src, dst, self.err_dot)
-        # [..., None]: edge values arrive as (E, K) from the CSC engines or
-        # (C, T, K) chunk tiles from the distributed Pallas path
-        return err[..., None] * src
-
-    def apply(self, old_local, acc, arrays: ShardArrays):
-        old = old_local.astype(jnp.float32)
-        new = old + jnp.float32(self.gamma) * (
-            acc - jnp.float32(self.lam) * old
-        )
-        return jnp.where(
-            jnp.asarray(arrays.vtx_mask)[:, None], new, old
-        ).astype(self.dtype)
+    def _env(self):
+        return {"k": self.k, "lam": self.lam, "gamma": self.gamma,
+                "dtype": self.dtype, "err_dot": self.err_dot}
 
 
 def colfilter(

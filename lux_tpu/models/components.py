@@ -22,43 +22,23 @@ import numpy as np
 
 from lux_tpu.engine import pull
 from lux_tpu.graph.csc import HostGraph
-from lux_tpu.graph.shards import PullShards, ShardArrays, build_pull_shards
+from lux_tpu.graph.shards import PullShards, build_pull_shards
+from lux_tpu.program import SpecBacked, library
 
 
 @dataclasses.dataclass(frozen=True)
-class MaxLabelProgram:
-    """Max-label propagation vertex program (the CC kernel).
+class MaxLabelProgram(SpecBacked):
+    """Max-label propagation vertex program (the CC kernel), evaluated
+    from the declarative spec (lux_tpu.program.library.COMPONENTS —
+    ISSUE 13): labels init to the vertex id (-1 on padding so it never
+    wins a max), everyone starts active (the reference's dense all-ones
+    bitmap, components_gpu.cu:733-737).  The spec's edge/apply serve the
+    pull engine and its edge/frontier the push engine — one declaration,
+    both contracts."""
 
-    Implements BOTH engine contracts: the pull engine's edge_value/apply
-    (dense path) and the push engine's init_frontier/relax (frontier path).
-    """
-
-    reduce: str = dataclasses.field(default="max", init=False)
-
-    def init_state(self, global_vid, degree, vtx_mask):
-        del degree
-        # padding slots get -1 so they never win a max
-        return jnp.where(vtx_mask, global_vid, -1)
-
-    # --- pull engine contract ---
-    def edge_value(self, src_state, weight, dst_state=None):
-        del weight, dst_state
-        return src_state
-
-    def apply(self, old_local, acc, arrays: ShardArrays):
-        new = jnp.maximum(old_local, acc)
-        return jnp.where(jnp.asarray(arrays.vtx_mask), new, old_local)
-
-    # --- push engine contract ---
-    def init_frontier(self, global_vid, state, vtx_mask):
-        # everyone starts active: the reference seeds a DENSE all-ones
-        # bitmap (components_gpu.cu:733-737)
-        del global_vid, state
-        return vtx_mask
-
-    def relax(self, src_val, weight):
-        del weight
-        return src_val
+    @property
+    def spec(self):
+        return library.COMPONENTS
 
 
 def active_count(old_local, new_local):

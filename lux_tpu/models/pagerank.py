@@ -20,14 +20,22 @@ import numpy as np
 
 from lux_tpu.engine import pull
 from lux_tpu.graph.csc import HostGraph
-from lux_tpu.graph.shards import PullShards, ShardArrays, build_pull_shards
+from lux_tpu.graph.shards import PullShards, build_pull_shards
+from lux_tpu.program import SpecBacked, library
 
-ALPHA = 0.15
+#: reference ALPHA (pagerank/app.h:24) — defined with the spec it
+#: parameterizes (lux_tpu.program.library), re-exported here
+ALPHA = library.ALPHA
 
 
 def apply_rank_update(acc, degree, nv, alpha=ALPHA):
-    """The shared PageRank recurrence tail: (initRank + alpha*acc),
-    pre-divided by out-degree when nonzero (pr_kernel, pagerank_gpu.cu:97-100)."""
+    """The PageRank recurrence tail for the BLOCK-CSR Pallas runner
+    below: (initRank + alpha*acc), pre-divided by out-degree when
+    nonzero (pr_kernel, pagerank_gpu.cu:97-100).  The gather-apply
+    engines evaluate the same math from the declarative spec
+    (program.library.PAGERANK — ISSUE 13); the Pallas path keeps this
+    explicit form because its padded block layout is not the spec's
+    per-part environment."""
     init_rank = jnp.float32((1.0 - alpha) / nv)
     pr = init_rank + jnp.float32(alpha) * acc
     deg = degree.astype(jnp.float32)
@@ -35,29 +43,25 @@ def apply_rank_update(acc, degree, nv, alpha=ALPHA):
 
 
 @dataclasses.dataclass(frozen=True)
-class PageRankProgram:
+class PageRankProgram(SpecBacked):
+    """PageRank as a named parameter bundle over the declarative spec
+    (lux_tpu.program.library.PAGERANK): init/edge/apply are EVALUATED
+    from the spec — there is no hand-wired body left (ISSUE 13), and
+    the personalized variant below is the same template with a one-hot
+    teleport mass substituted."""
+
     nv: int
     alpha: float = ALPHA
     #: state storage dtype.  "bfloat16" halves HBM gather traffic and the
     #: per-iteration all_gather over ICI; accumulation stays float32.
     dtype: str = "float32"
 
-    reduce: str = dataclasses.field(default="sum", init=False)
+    @property
+    def spec(self):
+        return library.PAGERANK
 
-    def init_state(self, global_vid, degree, vtx_mask):
-        rank = jnp.float32(1.0 / self.nv)
-        deg = degree.astype(jnp.float32)
-        state = jnp.where(degree > 0, rank / jnp.maximum(deg, 1.0), rank)
-        return jnp.where(vtx_mask, state, 0.0).astype(self.dtype)
-
-    def edge_value(self, src_state, weight, dst_state=None):
-        del weight, dst_state
-        return src_state.astype(jnp.float32)  # reduce in f32 regardless
-
-    def apply(self, old_local, acc, arrays: ShardArrays):
-        del old_local
-        pr = apply_rank_update(acc, arrays.degree, self.nv, self.alpha)
-        return jnp.where(arrays.vtx_mask, pr, 0.0).astype(self.dtype)
+    def _env(self):
+        return {"nv": self.nv, "alpha": self.alpha, "dtype": self.dtype}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,24 +69,18 @@ class PPRProgram(PageRankProgram):
     """Personalized PageRank: the same pre-divided recurrence with the
     uniform teleport mass (1-ALPHA)/nv replaced by a one-hot mass at
     ``seed`` — the single-query form of the serving path's batched
-    multi-seed program (lux_tpu.serve.batched.MultiSourcePPR); column q
-    of a batched run equals this program's pull run bitwise."""
+    multi-seed program (lux_tpu.serve.batched.MultiSourcePPR, the SAME
+    spec Q-lifted); column q of a batched run equals this program's
+    pull run bitwise."""
 
     seed: int = 0
 
-    def init_state(self, global_vid, degree, vtx_mask):
-        mass = (global_vid == self.seed).astype(jnp.float32)
-        deg = jnp.maximum(degree.astype(jnp.float32), 1.0)
-        state = jnp.where(degree > 0, mass / deg, mass)
-        return jnp.where(vtx_mask, state, 0.0).astype(self.dtype)
+    @property
+    def spec(self):
+        return library.PPR
 
-    def apply(self, old_local, acc, arrays: ShardArrays):
-        del old_local
-        mass = (arrays.global_vid == self.seed).astype(jnp.float32)
-        pr = jnp.float32(1.0 - self.alpha) * mass + jnp.float32(self.alpha) * acc
-        deg = arrays.degree.astype(jnp.float32)
-        pr = jnp.where(arrays.degree > 0, pr / jnp.maximum(deg, 1.0), pr)
-        return jnp.where(arrays.vtx_mask, pr, 0.0).astype(self.dtype)
+    def _env(self):
+        return {**super()._env(), "seed": self.seed}
 
 
 def ppr_reference(g: HostGraph, seed: int, num_iters: int) -> np.ndarray:

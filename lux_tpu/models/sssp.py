@@ -19,59 +19,54 @@ from __future__ import annotations
 
 import dataclasses
 
-import jax.numpy as jnp
 import numpy as np
 
 from lux_tpu.engine import push
 from lux_tpu.graph.csc import HostGraph
 from lux_tpu.graph.push_shards import PushShards, build_push_shards
 from lux_tpu.parallel.mesh import Mesh
+from lux_tpu.program import SpecBacked, library
 
 
 @dataclasses.dataclass(frozen=True)
-class SSSPProgram:
-    """BFS-SSSP vertex program: hop-count relaxation."""
+class SSSPProgram(SpecBacked):
+    """BFS-SSSP vertex program: hop-count relaxation, evaluated from the
+    declarative spec (lux_tpu.program.library.SSSP — ISSUE 13).  The
+    weighted variant below is the same template with the relax
+    expression substituted; the former copy-pasted bodies are gone."""
 
     nv: int
     start: int = 0
 
-    reduce: str = dataclasses.field(default="min", init=False)
+    @property
+    def spec(self):
+        return library.SSSP
 
     @property
     def inf(self) -> int:
         """Unreached sentinel: nv, reference parity (hop counts < nv)."""
         return self.nv
 
-    def init_state(self, global_vid, degree, vtx_mask):
-        del degree
-        inf = jnp.int32(self.inf)
-        d = jnp.where(global_vid == self.start, jnp.int32(0), inf)
-        return jnp.where(vtx_mask, d, inf)
-
-    def init_frontier(self, global_vid, state, vtx_mask):
-        del state
-        return (global_vid == self.start) & vtx_mask
-
-    def relax(self, src_val, weight):
-        del weight
-        return src_val + jnp.int32(1)
+    def _env(self):
+        return {"start": self.start, "inf": self.inf}
 
 
 @dataclasses.dataclass(frozen=True)
 class WeightedSSSPProgram(SSSPProgram):
     """True weighted SSSP (chaotic relaxation; extension, not in the
-    reference code)."""
+    reference code).  Weights are integer ratings/costs (WeightType =
+    int in the reference, col_filter/app.h:24); sssp() validates
+    integrality."""
+
+    @property
+    def spec(self):
+        return library.SSSP_WEIGHTED
 
     @property
     def inf(self) -> int:
         # weighted distances can exceed nv; use a large sentinel that still
         # survives `inf + max_weight` in int32
         return 1 << 30
-
-    def relax(self, src_val, weight):
-        # weights are integer ratings/costs (WeightType = int in the
-        # reference, col_filter/app.h:24); sssp() validates integrality
-        return src_val + weight.astype(jnp.int32)
 
 
 def _push_run(prog, g, shards, mesh, max_iters, method, exchange,

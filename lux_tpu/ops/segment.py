@@ -142,6 +142,14 @@ def segment_sum_csc(
     method: str = "scan",
 ) -> jnp.ndarray:
     """Sum ``vals`` (edge-aligned, (E,) or (E, K)) per destination -> (V, ...)."""
+    if method == "mxsum" and jnp.issubdtype(vals.dtype, jnp.integer):
+        # matmul_cumsum accumulates in float32 UNCONDITIONALLY — exact
+        # for the float sums the strategy was built for, but integer
+        # sums (ISSUE 13's uint32 bitset unions / int32 alive counts)
+        # must stay exact past 2^24: downgrade to the bitwise scan,
+        # same family-downgrade contract as segment_reduce_by_ends's
+        # (a banked tpu:sum=mxsum winner stays safe on every program)
+        method = "scan"
     if method == "mxscan" and vals.ndim > 1:
         method = "scan"  # the blocked kernel is 1-D (module docstring)
     if method == "mxscan":

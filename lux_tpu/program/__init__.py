@@ -1,0 +1,44 @@
+"""luxprog — the declarative vertex-program compiler (ISSUE 13).
+
+Lux's whole design is one fixed vertex-program contract — the paper's
+pull/push task bodies are exactly ``init / compute(gather) /
+update(apply)`` — yet the apps used to hand-wire gather/apply/scatter
+into the engines, so every new scenario cost a PR.  This package turns
+that contract into DATA:
+
+  * :mod:`lux_tpu.program.expr` — the restricted expression language
+    spec fields are written in (Python syntax, closed vocabulary, no
+    ``eval``; compiled once per distinct source through ``ast``).
+  * :mod:`lux_tpu.program.spec` — :class:`VertexProgramSpec` (the
+    declarative program: state init, edge message, a reduce from the
+    ``ops/segment.py`` monoid set, apply/update, convergence rule,
+    frontier rule) plus the compiled-program bases that implement BOTH
+    engine protocols (pull's ``init_state/edge_value/apply`` and push's
+    ``init_state/init_frontier/relax``) and the serve Q-axis lift.
+  * :mod:`lux_tpu.program.library` — the named spec registry: the four
+    reference apps re-expressed as specs (``models/*`` classes now
+    evaluate these — the hand-wired bodies are DELETED, not shadowed)
+    and the four payoff workloads (bfs, kcore, labelprop, triangles).
+  * :mod:`lux_tpu.program.workloads` — runners + NumPy oracles for the
+    new workloads, lowering through the EXISTING engine entry points
+    (zero edits inside the engine hot-loop bodies).
+
+Because a compiled program is a frozen dataclass over the spec and its
+parameter bindings, two equal specs ARE the same program to every jit
+static and lru compile cache: spec-compiled programs hit the exact
+plan/trace caches the hand-wired dataclasses did (LUX-J1; pinned by
+tests/test_program.py's ``_cache_size`` probes).  This is the
+fine-grained-task-to-portable-kernel aggregation argument of
+arXiv:2210.06438 applied to the repo: express the per-vertex task once,
+declaratively, and lower it onto every execution surface.
+
+See docs/PROGRAMS.md for the spec schema and the lowering matrix.
+"""
+from lux_tpu.program.spec import (  # noqa: F401
+    BatchedSpecBacked,
+    BatchedSpecProgram,
+    SpecBacked,
+    SpecProgram,
+    VertexProgramSpec,
+    active_changed,
+)

@@ -1,0 +1,287 @@
+"""The restricted expression language vertex-program specs are written in.
+
+A spec field (``init`` / ``edge`` / ``apply`` / ``frontier``) is a short
+straight-line program in Python SYNTAX but not Python semantics: a
+sequence of ``name = expression`` bindings followed by one final
+expression, compiled through :mod:`ast` against a CLOSED vocabulary —
+names resolve to the lowering environment (engine-supplied arrays plus
+program parameters), calls resolve to the builtin table below, and every
+other construct (attribute access, subscripts, comprehensions, lambdas,
+imports, statements beyond assignment) is rejected at definition time.
+There is no ``eval``/``exec`` of user text (luxcheck policy family): the
+AST is walked into nested closures once per distinct source string
+(cached), so evaluating a spec during tracing costs dict lookups.
+
+Why a DSL instead of Python callables: specs must be DATA — hashable,
+comparable, printable — so compiled programs participate in the engines'
+jit-static and lru compile caches exactly like the hand-wired program
+dataclasses they replaced (two equal specs ARE one program: zero
+retrace, LUX-J1), and so a new scenario is a config edit reviewable as
+config (arXiv:2210.06438's fine-grained-task aggregation argument).
+
+Vocabulary (beyond ``+ - * / // % ** << >> & | ^ ~ -x`` and single
+comparisons):
+
+  where(c, a, b)        jnp.where
+  maximum / minimum     elementwise (the monoid ops)
+  abs(x), sqrt(x)       sqrt keeps Python/NumPy scalars scalar (trace-
+                        time constants fold in float64, like hand code)
+  f32/i32/u32(x)        dtype cast: scalars via the NumPy scalar type
+                        (== jnp.float32(v) in the hand-wired bodies),
+                        arrays via .astype
+  cast(x, dt)           astype to a dtype NAME (a param or a literal)
+  lane(x)               x[..., None] — broadcast a per-vertex/edge
+                        column against a trailing feature/query axis
+  row(x)                x[None, :]
+  arange(n)             int32 iota (n is a trace-time int param)
+  onehot(x, n)          (len(x), n) float32 one-hot of an int vector
+  fullk(ref, n, v)      (len(ref), n) float32 filled with v
+  rowsum(x)             jnp.sum(x, axis=-1, keepdims=True)
+  sum_lanes(x)          jnp.sum(x, axis=-1) — collapse a feature axis
+  popcount(x)           jax.lax.population_count
+  isin(x, vals)         membership of x in a (small) tuple param
+  dot_lanes(a, b, mode) the CF error-dot K-contraction
+                        (models.colfilter.err_dot: "vpu" | "mxu")
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import operator
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+
+class SpecSyntaxError(ValueError):
+    """A spec expression used a construct outside the language."""
+
+
+def _is_scalar(x) -> bool:
+    return isinstance(x, (bool, int, float, np.bool_, np.number))
+
+
+def _cast(x, dt):
+    """Dtype cast matching the hand-wired idioms bitwise: Python/NumPy
+    scalars through the NumPy scalar type (``jnp.float32(v)``), arrays
+    through ``.astype``.  Same-dtype astype is a no-op."""
+    if _is_scalar(x):
+        return np.dtype(dt).type(x)
+    return x.astype(dt)
+
+
+def _sqrt(x):
+    # trace-time constants stay float64 Python-side (np.sqrt(1.0/k) in
+    # the hand-wired CF init); arrays go through jnp
+    if _is_scalar(x):
+        return float(np.sqrt(x))
+    import jax.numpy as jnp
+
+    return jnp.sqrt(x)
+
+
+def _isin(x, vals):
+    if not isinstance(vals, (tuple, list)):
+        raise SpecSyntaxError(
+            f"isin() needs a tuple parameter, got {type(vals).__name__}")
+    import jax.numpy as jnp
+
+    out = x == vals[0]
+    for v in vals[1:]:
+        out = jnp.logical_or(out, x == v)
+    return out
+
+
+def _builtins() -> Dict[str, Callable]:
+    """The call vocabulary.  Built lazily (jax import) and returned as a
+    fresh dict so a caller can never mutate the shared table."""
+    import jax
+    import jax.numpy as jnp
+
+    def dot_lanes(a, b, mode):
+        from lux_tpu.models.colfilter import err_dot
+
+        return err_dot(a, b, mode)
+
+    return {
+        "where": jnp.where,
+        "maximum": jnp.maximum,
+        "minimum": jnp.minimum,
+        "abs": jnp.abs,
+        "sqrt": _sqrt,
+        "f32": functools.partial(_cast, dt="float32"),
+        "i32": functools.partial(_cast, dt="int32"),
+        "u32": functools.partial(_cast, dt="uint32"),
+        "cast": _cast,
+        "lane": lambda x: x[..., None],
+        "row": lambda x: x[None, :],
+        "arange": lambda n: jnp.arange(n, dtype=jnp.int32),
+        "onehot": lambda x, n: (
+            jnp.arange(n, dtype=jnp.int32)[None, :] == x[..., None]
+        ).astype(jnp.float32),
+        "fullk": lambda ref, n, v: jnp.full(
+            (ref.shape[0], int(n)), v, jnp.float32),
+        "rowsum": lambda x: jnp.sum(x, axis=-1, keepdims=True),
+        "sum_lanes": lambda x: jnp.sum(x, axis=-1),
+        "popcount": jax.lax.population_count,
+        "isin": _isin,
+        "dot_lanes": dot_lanes,
+    }
+
+
+def _lnot(x):
+    import jax.numpy as jnp
+
+    return ~x if not _is_scalar(x) else jnp.logical_not(x)
+
+
+_BINOPS = {
+    ast.Add: operator.add,
+    ast.Sub: operator.sub,
+    ast.Mult: operator.mul,
+    ast.Div: operator.truediv,
+    ast.FloorDiv: operator.floordiv,
+    ast.Mod: operator.mod,
+    ast.Pow: operator.pow,
+    ast.LShift: operator.lshift,
+    ast.RShift: operator.rshift,
+    ast.BitAnd: operator.and_,
+    ast.BitOr: operator.or_,
+    ast.BitXor: operator.xor,
+}
+
+_CMPOPS = {
+    ast.Eq: operator.eq,
+    ast.NotEq: operator.ne,
+    ast.Lt: operator.lt,
+    ast.LtE: operator.le,
+    ast.Gt: operator.gt,
+    ast.GtE: operator.ge,
+}
+
+_UNOPS = {
+    ast.USub: operator.neg,
+    ast.Invert: _lnot,
+}
+
+
+def _err(src: str, node: ast.AST, msg: str) -> SpecSyntaxError:
+    line = src.splitlines()[node.lineno - 1] if hasattr(node, "lineno") else src
+    return SpecSyntaxError(f"{msg} (in spec expression: {line.strip()!r})")
+
+
+def _compile_expr(node: ast.expr, src: str) -> Callable[[dict], Any]:
+    """Recursively lower one expression node to an env -> value closure."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, (bool, int, float, str)):
+            v = node.value
+            return lambda env: v
+        raise _err(src, node, f"constant {node.value!r} is not allowed")
+    if isinstance(node, ast.Name):
+        name = node.id
+        marker = object()
+
+        def load(env, name=name, marker=marker):
+            v = env.get(name, marker)
+            if v is marker:
+                raise SpecSyntaxError(
+                    f"unknown name {name!r}; available here: "
+                    + ", ".join(sorted(k for k in env if not k.startswith("_"))))
+            return v
+
+        return load
+    if isinstance(node, ast.BinOp):
+        op = _BINOPS.get(type(node.op))
+        if op is None:
+            raise _err(src, node, f"operator {type(node.op).__name__} "
+                                  "is not in the language")
+        lf = _compile_expr(node.left, src)
+        rf = _compile_expr(node.right, src)
+        return lambda env: op(lf(env), rf(env))
+    if isinstance(node, ast.UnaryOp):
+        op = _UNOPS.get(type(node.op))
+        if op is None:
+            raise _err(src, node, f"unary {type(node.op).__name__} "
+                                  "is not in the language")
+        vf = _compile_expr(node.operand, src)
+        return lambda env: op(vf(env))
+    if isinstance(node, ast.Compare):
+        if len(node.ops) != 1:
+            raise _err(src, node, "chained comparisons are not allowed")
+        op = _CMPOPS.get(type(node.ops[0]))
+        if op is None:
+            raise _err(src, node, f"comparison {type(node.ops[0]).__name__} "
+                                  "is not in the language")
+        lf = _compile_expr(node.left, src)
+        rf = _compile_expr(node.comparators[0], src)
+        return lambda env: op(lf(env), rf(env))
+    if isinstance(node, ast.Call):
+        if node.keywords:
+            raise _err(src, node, "keyword arguments are not allowed")
+        if not isinstance(node.func, ast.Name):
+            raise _err(src, node, "only builtin-name calls are allowed")
+        fname = node.func.id
+        argfs = [_compile_expr(a, src) for a in node.args]
+
+        def call(env, fname=fname, argfs=argfs):
+            fn = env["_builtins"].get(fname)
+            if fn is None:
+                raise SpecSyntaxError(
+                    f"unknown function {fname!r}; builtins: "
+                    + ", ".join(sorted(env["_builtins"])))
+            return fn(*[f(env) for f in argfs])
+
+        return call
+    if isinstance(node, ast.Tuple):
+        elfs = [_compile_expr(e, src) for e in node.elts]
+        return lambda env: tuple(f(env) for f in elfs)
+    raise _err(src, node, f"{type(node).__name__} is not in the language")
+
+
+@functools.lru_cache(maxsize=1024)
+def compile_source(src: str):
+    """Compile a spec field to ``run(env) -> value``.  ``src`` is a
+    sequence of single-name assignments ending in one expression;
+    rebinding a name is allowed (straight-line SSA-ish style).  Raises
+    :class:`SpecSyntaxError` for anything outside the language — at
+    spec-definition time, not at trace time."""
+    try:
+        tree = ast.parse(src, mode="exec")
+    except SyntaxError as e:
+        raise SpecSyntaxError(f"spec expression does not parse: {e}") from None
+    if not tree.body:
+        raise SpecSyntaxError("empty spec expression")
+    steps = []
+    for stmt in tree.body[:-1]:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1 \
+                or not isinstance(stmt.targets[0], ast.Name):
+            raise _err(src, stmt,
+                       "only 'name = expression' bindings may precede the "
+                       "final expression")
+        steps.append((stmt.targets[0].id,
+                      _compile_expr(stmt.value, src)))
+    last = tree.body[-1]
+    if not isinstance(last, ast.Expr):
+        raise _err(src, last, "a spec must END in a bare expression "
+                              "(its value is the result)")
+    final = _compile_expr(last.value, src)
+
+    def run(env: dict):
+        scope = dict(env)
+        scope["_builtins"] = _builtins()
+        for name, fn in steps:
+            scope[name] = fn(scope)
+        return final(scope)
+
+    return run
+
+
+def run(src: str, env: dict):
+    """Evaluate a spec field against ``env`` (parameters + lowering
+    arrays).  Parsing is cached per distinct source string."""
+    return compile_source(src)(env)
+
+
+def check(src: str) -> None:
+    """Parse-validate a spec field (definition-time gate); no-op result."""
+    compile_source(src)

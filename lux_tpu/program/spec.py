@@ -1,0 +1,261 @@
+"""VertexProgramSpec — the declarative vertex program — and its compiled
+forms.
+
+A spec is the whole app contract as data (the paper's ``init / compute /
+update`` task bodies, SURVEY.md §2): per-vertex state initialization,
+the per-edge message, a combiner from the :mod:`lux_tpu.ops.segment`
+monoid set, the apply/update rule, the convergence rule, and (for
+frontier programs) the initial-frontier rule.  Every field is a string
+in the :mod:`lux_tpu.program.expr` language, so a spec is hashable,
+comparable, and printable — which is exactly what the engines need from
+a program: their jit statics and lru compile caches key on the program
+object, and two equal specs ARE one program (zero retrace across
+reconstruction; tests/test_program.py pins the ``_cache_size`` probes).
+
+The compiled forms implement the EXISTING engine protocols verbatim —
+no engine edit was needed to consume them:
+
+  * :class:`SpecBacked` / :class:`SpecProgram` — pull's
+    ``init_state/edge_value/apply`` (engine/pull.PullProgram) AND push's
+    ``init_state/init_frontier/relax`` (engine/push.PushProgram) from
+    one spec, so a program runs on pull fixed/until (direct, routed,
+    routed-pf), push (sparse/dense direction switch), the dist engines,
+    and the mutation overlays of both engines unchanged.
+  * :class:`BatchedSpecBacked` / :class:`BatchedSpecProgram` — the
+    serve Q-axis lift (serve/batched.QueryProgram): the spec's declared
+    ``query_param`` binds to the traced (Q,) query vector on a TRAILING
+    axis and every per-vertex name broadcasts with ``[:, None]``, so
+    column q of a batched run is bitwise the single-query program.
+
+Environment names a spec may use (beyond its own parameters):
+
+  init:      vid, degree, vtx_mask          -> per-vertex state
+  edge:      src, weight, dst               -> per-edge message
+             (``dst`` — the destination's CURRENT state — exists on the
+             pull surfaces only; push relax sees src/weight)
+  apply:     old, acc, vid, degree, vtx_mask -> new per-vertex state
+  frontier:  vid, state, vtx_mask           -> initial active mask
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+from lux_tpu.program import expr
+
+REDUCES = ("sum", "min", "max")
+CONVERGENCES = ("fixed", "quiescent")
+
+
+@dataclasses.dataclass(frozen=True)
+class VertexProgramSpec:
+    """One declarative vertex program.  ``edge`` doubles as pull's
+    edge_value and push's relax (they are the same message along the
+    edge); ``apply`` may be empty for reduce-only phases (triangle
+    counting's phase 2) and ``frontier`` empty for pull-only programs.
+    ``query_param`` names the parameter that becomes the serve Q axis
+    ("" = not Q-liftable).  ``state_width`` documents the trailing
+    feature width (1 = scalar state); width-parameterized specs (e.g.
+    labelprop's ``labels``) carry the width on the compiled program
+    instead."""
+
+    name: str
+    reduce: str
+    init: str
+    edge: str
+    apply: str = ""
+    frontier: str = ""
+    convergence: str = "fixed"
+    state_width: int = 1
+    needs_dst_state: bool = False
+    query_param: str = ""
+
+    def __post_init__(self):
+        if self.reduce not in REDUCES:
+            raise ValueError(
+                f"spec {self.name!r}: reduce must be one of {REDUCES} "
+                f"(the ops/segment.py monoid set), got {self.reduce!r}")
+        if self.convergence not in CONVERGENCES:
+            raise ValueError(
+                f"spec {self.name!r}: convergence must be one of "
+                f"{CONVERGENCES}, got {self.convergence!r}")
+        for field in ("init", "edge", "apply", "frontier"):
+            src = getattr(self, field)
+            if src:
+                try:
+                    expr.check(src)
+                except expr.SpecSyntaxError as e:
+                    raise expr.SpecSyntaxError(
+                        f"spec {self.name!r}.{field}: {e}") from None
+
+
+def active_changed(old, new):
+    """Top-level (hashable) convergence probe shared by every quiescent
+    spec program: per-part count of state entries that moved — the
+    run_pull_until ``active_fn`` contract (models/components
+    active_count_stacked generalized over trailing state axes)."""
+    import jax.numpy as jnp
+
+    return jnp.sum(old != new,
+                   axis=tuple(range(1, old.ndim))).astype(jnp.int32)
+
+
+class SpecBacked:
+    """Engine-protocol methods evaluated from a declarative spec.
+
+    Subclasses provide ``spec`` (a :class:`VertexProgramSpec`, as a
+    property or dataclass field) and ``_env()`` (the parameter
+    bindings).  The five protocol methods below ARE the former
+    hand-wired gather/apply bodies of the model classes — there is no
+    shadow implementation left."""
+
+    def _env(self) -> dict:
+        return {}
+
+    def _eval(self, source: str, **env):
+        return expr.run(source, {**self._env(), **env})
+
+    # --- shared contract -------------------------------------------------
+    @property
+    def reduce(self) -> str:
+        return self.spec.reduce
+
+    @property
+    def needs_dst_state(self) -> bool:
+        return self.spec.needs_dst_state
+
+    def init_state(self, global_vid, degree, vtx_mask):
+        return self._eval(self.spec.init, vid=global_vid, degree=degree,
+                          vtx_mask=vtx_mask)
+
+    # --- pull engine contract -------------------------------------------
+    def edge_value(self, src_state, weight, dst_state=None):
+        return self._eval(self.spec.edge, src=src_state, weight=weight,
+                          dst=dst_state)
+
+    def apply(self, old_local, acc, arrays):
+        if not self.spec.apply:
+            raise ValueError(
+                f"spec {self.spec.name!r} is a reduce-only phase (no "
+                "apply rule); run it through the load/comp phase split "
+                "(program.workloads.reduce_phase), not an update loop")
+        env = {"old": old_local, "acc": acc}
+        # the bucketed exchange drivers (ring/scatter/edge2d/feat) pass
+        # duck-typed views carrying only the fields their applies need
+        # (vtx_mask/degree); bind what exists — a spec referencing a
+        # missing name fails with the evaluator's unknown-name error
+        for name, attr in (("vid", "global_vid"), ("degree", "degree"),
+                           ("vtx_mask", "vtx_mask")):
+            if hasattr(arrays, attr):
+                env[name] = getattr(arrays, attr)
+        return self._eval(self.spec.apply, **env)
+
+    # --- push engine contract -------------------------------------------
+    def init_frontier(self, global_vid, state, vtx_mask):
+        if not self.spec.frontier:
+            raise ValueError(
+                f"spec {self.spec.name!r} declares no frontier rule; "
+                "it lowers onto the pull engines only")
+        return self._eval(self.spec.frontier, vid=global_vid, state=state,
+                          vtx_mask=vtx_mask)
+
+    def relax(self, src_val, weight):
+        if self.spec.needs_dst_state:
+            raise ValueError(
+                f"spec {self.spec.name!r} reads the destination state "
+                "per edge; the push (scatter) lowering has no dst read "
+                "— run it on a pull surface")
+        return self._eval(self.spec.edge, src=src_val, weight=weight,
+                          dst=None)
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecProgram(SpecBacked):
+    """A spec compiled against concrete parameter bindings — the generic
+    form the registry workloads and the ``apps.run`` driver use (the
+    model classes in ``models/*`` are named spec-backed dataclasses with
+    the same machinery).  ``args`` is a sorted tuple of (name, value)
+    pairs; values must be hashable (ints, floats, strings, tuples).
+    ``width`` is the trailing state width this instance runs at (for
+    width-parameterized specs)."""
+
+    spec: VertexProgramSpec
+    args: Tuple[Tuple[str, Any], ...] = ()
+    width: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "args", tuple(sorted(self.args)))
+        hash(self.args)  # fail at construction, not inside a jit cache
+
+    def _env(self) -> dict:
+        return dict(self.args)
+
+    @property
+    def k(self) -> int:
+        return self.width or self.spec.state_width
+
+
+def bind(spec: VertexProgramSpec, width: int = 0, **params) -> SpecProgram:
+    """Sugar: ``bind(library.BFS, nv=..., sources=(0, 5))``."""
+    return SpecProgram(spec, tuple(sorted(params.items())), width)
+
+
+class BatchedSpecBacked:
+    """The serve Q-axis lift of a spec (serve/batched.QueryProgram
+    contract): state carries a TRAILING query axis, the spec's declared
+    ``query_param`` binds to the traced (Q,) query vector as a leading
+    broadcast row, and every per-vertex name binds with a trailing
+    broadcast lane — so the SAME init/edge/apply text lowers to the
+    (V, Q) batched step, bitwise equal per column to the single-query
+    program (the hand-wired MultiSource* bodies this replaces)."""
+
+    def _env(self) -> dict:
+        return {}
+
+    @property
+    def reduce(self) -> str:
+        return self.spec.reduce
+
+    @property
+    def fixpoint(self) -> bool:
+        return self.spec.convergence == "quiescent"
+
+    def _qenv(self, global_vid, degree, vtx_mask, queries) -> dict:
+        qp = self.spec.query_param
+        if not qp:
+            raise ValueError(
+                f"spec {self.spec.name!r} declares no query_param; it "
+                "has no Q-axis serve lowering")
+        return {**self._env(), "vid": global_vid[:, None],
+                "degree": degree[:, None], "vtx_mask": vtx_mask[:, None],
+                qp: queries[None, :]}
+
+    def init_part(self, global_vid, degree, vtx_mask, queries):
+        return expr.run(self.spec.init,
+                        self._qenv(global_vid, degree, vtx_mask, queries))
+
+    def edge_value(self, src_state, weights):
+        return expr.run(self.spec.edge,
+                        {**self._env(), "src": src_state,
+                         "weight": weights[:, None], "dst": None})
+
+    def apply(self, old_local, acc, arr, queries):
+        env = self._qenv(arr.global_vid, arr.degree, arr.vtx_mask, queries)
+        env.update(old=old_local, acc=acc)
+        return expr.run(self.spec.apply, env)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedSpecProgram(BatchedSpecBacked):
+    """Generic Q-lifted program (the serve registry's named classes are
+    spec-backed dataclasses over the same machinery)."""
+
+    spec: VertexProgramSpec
+    args: Tuple[Tuple[str, Any], ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "args", tuple(sorted(self.args)))
+        hash(self.args)
+
+    def _env(self) -> dict:
+        return dict(self.args)

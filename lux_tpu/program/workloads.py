@@ -1,0 +1,472 @@
+"""Runners + NumPy oracles for the spec-only workloads (ISSUE 13).
+
+The four payoff workloads — multi-source BFS, k-core decomposition,
+seeded label propagation, and weighted triangle counting — exist ONLY as
+declarative specs (:mod:`lux_tpu.program.library`) plus the thin host
+drivers below, which lower through the EXISTING public engine entry
+points (``run_push`` / ``run_pull_until`` / ``run_pull_fixed`` /
+``compile_pull_phases`` and their dist twins).  Zero lines changed
+inside the engine hot-loop bodies: the compiler, not the engines,
+absorbs the new scenarios (the ISSUE 13 acceptance criterion).
+
+Each workload ships a NetworkX-free NumPy oracle (the ``*_reference``
+functions) and a ``check_*`` invariant for the CLI's ``-check`` verdict
+(the reference apps' CHECK_TASK_ID discipline).
+
+Stress corners, by design:
+  * bfs        — frontier/push, sparse->dense direction switch, routed
+                 dense rounds; a SEED-SET rule (distance to the nearest
+                 of several sources) instead of sssp's single start.
+  * kcore      — ITERATIVE PEEL: a host loop over k, each level one
+                 spec program run to quiescence, warm-started from the
+                 previous level's survivors (k-cores nest).
+  * labelprop  — dense pull with a WIDE (V, L) probability state.
+  * triangles  — a genuinely new INTERSECTION-HEAVY access pattern the
+                 compiler expresses as a TWO-PHASE program: phase 1
+                 builds per-vertex neighborhood bitsets (a sum-reduce
+                 whose integer sum IS the set union), phase 2 is a
+                 reduce-only pass intersecting the src/dst bitsets per
+                 edge (the dst-dependent load only pull provides).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from lux_tpu.graph.csc import HostGraph, from_edge_list
+from lux_tpu.program import library
+from lux_tpu.program.spec import SpecProgram, active_changed, bind
+
+#: triangle counting builds (V, ceil(nv/32)) uint32 bitsets — quadratic
+#: memory in nv.  Bound it loudly instead of OOMing quietly; the
+#: workload is a small-scale bench row by design (LUX_BENCH_APPS opt-in).
+TRIANGLES_MAX_NV = 1 << 15
+
+
+def active_changed_scalar(old, new):
+    """Per-part SCALAR active count (the run_pull_until_dist contract;
+    top-level so compiled loops cache)."""
+    import jax.numpy as jnp
+
+    return jnp.sum(old != new)
+
+
+def symmetrize(g: HostGraph, unit_weights: bool = False) -> HostGraph:
+    """Undirected simple view of ``g``: dedupe unordered pairs, drop
+    self-loops, emit BOTH orientations.  Weights: max over the parallel
+    directed duplicates of a pair (1 everywhere when the input is
+    unweighted or ``unit_weights``) — k-core and triangle counting are
+    classically undirected, so their apps run on this view by default."""
+    src = np.asarray(g.col_idx, np.int64)
+    dst = np.asarray(g.dst_of_edges(), np.int64)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    lo = np.minimum(src, dst)
+    hi = np.maximum(src, dst)
+    key = lo * g.nv + hi
+    if g.weights is None or unit_weights:
+        pairs = np.unique(key)
+        w_und = np.ones(pairs.shape[0], np.int32)
+    else:
+        order = np.argsort(key, kind="stable")
+        key_s = key[order]
+        w_s = np.asarray(g.weights)[keep][order]
+        pairs, first = np.unique(key_s, return_index=True)
+        w_und = np.maximum.reduceat(w_s, first).astype(np.int32)
+    lo = (pairs // g.nv).astype(np.int64)
+    hi = (pairs % g.nv).astype(np.int64)
+    es = np.concatenate([lo, hi])
+    ed = np.concatenate([hi, lo])
+    return from_edge_list(es, ed, g.nv,
+                          weights=np.concatenate([w_und, w_und]))
+
+
+def _pull_setup(g, num_parts: int):
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.graph.shards import PullShards, build_pull_shards
+
+    shards = g if isinstance(g, PullShards) else build_pull_shards(
+        g, num_parts)
+    return shards, jax.tree.map(jnp.asarray, shards.arrays)
+
+
+# ---------------------------------------------------------------------------
+# BFS
+# ---------------------------------------------------------------------------
+
+
+def bfs_program(nv: int, sources: Sequence[int]) -> SpecProgram:
+    srcs = tuple(sorted(set(int(s) for s in sources)))
+    if not srcs:
+        raise ValueError("bfs needs at least one source vertex")
+    for s in srcs:
+        if not 0 <= s < nv:
+            raise ValueError(f"bfs source {s} out of range [0, {nv})")
+    return bind(library.BFS, nv=nv, sources=srcs)
+
+
+def bfs(g, sources: Sequence[int], num_parts: int = 1,
+        max_iters: int = 10_000, method: str = "auto",
+        engine: str = "push", mesh=None, route=None,
+        exchange: str = "allgather") -> Tuple[np.ndarray, int]:
+    """Multi-source BFS: hop distance to the NEAREST source, INF == nv.
+    ``engine="push"`` runs the direction-optimizing frontier engine
+    (the workload's home surface; ``route`` routes the dense rounds);
+    ``engine="pull"`` runs the pull-until surface — bitwise-identical
+    distances (unique min fixpoint).  Returns (dist (nv,), iters)."""
+    from lux_tpu.graph.push_shards import PushShards, build_push_shards
+
+    if engine == "push":
+        from lux_tpu.engine import push
+
+        if exchange == "ring":
+            from lux_tpu.parallel.ring import (PushRingShards,
+                                               build_push_ring_shards)
+
+            if mesh is None:
+                raise ValueError("bfs exchange='ring' needs a mesh")
+            rsh = (g if isinstance(g, PushRingShards)
+                   else build_push_ring_shards(g, num_parts))
+            prog = bfs_program(rsh.spec.nv, sources)
+            final, it, _ = push.run_push_ring(prog, rsh, mesh, max_iters,
+                                              method)
+            return rsh.scatter_to_global(np.asarray(final)), int(it)
+        shards = g if isinstance(g, PushShards) else build_push_shards(
+            g, num_parts)
+        prog = bfs_program(shards.spec.nv, sources)
+        if mesh is None:
+            final, it, _ = push.run_push(prog, shards, max_iters, method,
+                                         route=route)
+        else:
+            final, it, _ = push.run_push_dist(prog, shards, mesh,
+                                              max_iters, method,
+                                              route=route)
+        return shards.scatter_to_global(np.asarray(final)), int(it)
+    if engine != "pull":
+        raise ValueError(f"bfs engine must be 'push' or 'pull', got {engine!r}")
+    from lux_tpu.engine import pull
+
+    shards, arrays = _pull_setup(g, num_parts)
+    prog = bfs_program(shards.spec.nv, sources)
+    state0 = pull.init_state(prog, arrays)
+    if mesh is not None:
+        if route is not None:
+            # run_pull_until_dist has no routed form — dropping the
+            # plan silently would misreport what was benchmarked
+            raise ValueError(
+                "bfs engine='pull' routes single-device runs only; "
+                "the dist pull-until driver has no route= path (use "
+                "engine='push' for routed distributed dense rounds)")
+        from lux_tpu.parallel import dist
+
+        final, it = dist.run_pull_until_dist(
+            prog, shards.spec, shards.arrays, state0, max_iters,
+            active_changed_scalar, mesh, method)
+    else:
+        final, it = pull.run_pull_until(
+            prog, shards.spec, arrays, state0, max_iters, active_changed,
+            method, route=route)
+    return shards.scatter_to_global(np.asarray(final)), int(it)
+
+
+def bfs_reference(g: HostGraph, sources: Sequence[int]) -> np.ndarray:
+    """Host multi-source BFS oracle over the out-adjacency (CSR) view."""
+    csr_row_ptr, csr_dst, _ = g.to_csr()
+    dist = np.full(g.nv, g.nv, np.int32)
+    dq = deque()
+    for s in sorted(set(int(s) for s in sources)):
+        dist[s] = 0
+        dq.append(s)
+    while dq:
+        u = dq.popleft()
+        for v in csr_dst[csr_row_ptr[u]: csr_row_ptr[u + 1]]:
+            if dist[v] == g.nv:
+                dist[v] = dist[u] + 1
+                dq.append(v)
+    return dist
+
+
+def check_bfs(g: HostGraph, dist: np.ndarray,
+              sources: Sequence[int]) -> int:
+    """-check invariant — the full min fixpoint, so the gate bounds the
+    distances from BOTH sides: every source at 0; every edge satisfies
+    dist[dst] <= dist[src] + 1 (reached sources only — the upper
+    bound); and every non-source vertex's distance EQUALS
+    min over in-edges of dist[src] + 1, INF included (the lower bound:
+    an all-zeros answer fails here, not just an over-estimate)."""
+    dist = np.asarray(dist, np.int64)
+    srcs = set(int(s) for s in sources)
+    bad = sum(int(dist[s] != 0) for s in srcs)
+    dst = g.dst_of_edges()
+    reached = dist[g.col_idx] < g.nv
+    bad += int(np.sum((dist[dst] > dist[g.col_idx] + 1) & reached))
+    # lower bound via the fixpoint: relax every edge once into a fresh
+    # accumulator; a non-source vertex must sit exactly at its best
+    # in-edge relaxation (clipped at the INF sentinel nv)
+    best = np.full(g.nv, g.nv, np.int64)
+    np.minimum.at(best, dst, np.minimum(dist[g.col_idx] + 1, g.nv))
+    non_src = np.ones(g.nv, bool)
+    non_src[list(srcs)] = False
+    bad += int(np.sum(non_src & (dist != best)))
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# k-core decomposition
+# ---------------------------------------------------------------------------
+
+
+def kcore(g, kmax: int = 0, num_parts: int = 1, max_iters: int = 10_000,
+          method: str = "auto", mesh=None, route=None,
+          ) -> Tuple[np.ndarray, int, int]:
+    """Coreness per vertex by ITERATIVE PEEL over the in-neighborhood:
+    for k = 1, 2, ... run the one-level spec (library.KCORE) to
+    quiescence — a vertex survives level k iff it keeps >= k alive
+    in-neighbors — warm-starting each level from the previous level's
+    survivors (k-cores nest, so the monotone fixpoint carries over).
+    Classic undirected coreness: pass a ``symmetrize(g)`` view (the
+    app's default).  ``kmax=0`` peels until the core empties.  Each
+    level is its own compiled program (k is a static parameter — the
+    honest shape of a peel); levels reuse one layout and one ``route``
+    plan.  Returns (coreness (nv,) int32, k_max, total_rounds)."""
+    from lux_tpu.engine import pull
+
+    shards, arrays = _pull_setup(g, num_parts)
+    nv = shards.spec.nv
+    coreness = np.zeros(nv, np.int32)
+    state = None
+    rounds = 0
+    k = 1
+    while kmax == 0 or k <= kmax:
+        prog = bind(library.KCORE, kk=k)
+        if state is None:
+            state = pull.init_state(prog, arrays)
+        if mesh is not None:
+            from lux_tpu.parallel import dist
+
+            state, it = dist.run_pull_until_dist(
+                prog, shards.spec, shards.arrays, state, max_iters,
+                active_changed_scalar, mesh, method)
+        else:
+            state, it = pull.run_pull_until(
+                prog, shards.spec, arrays, state, max_iters,
+                active_changed, method, route=route)
+        rounds += int(it)
+        alive = shards.scatter_to_global(np.asarray(state)) > 0
+        if not alive.any():
+            break
+        coreness[alive] = k
+        k += 1
+    return coreness, int(coreness.max(initial=0)), rounds
+
+
+def kcore_reference(g: HostGraph, kmax: int = 0) -> np.ndarray:
+    """NumPy peel oracle (same in-neighborhood semantics)."""
+    nv = g.nv
+    dst = g.dst_of_edges()
+    coreness = np.zeros(nv, np.int32)
+    alive = np.ones(nv, bool)
+    k = 1
+    while kmax == 0 or k <= kmax:
+        while True:
+            cnt = np.zeros(nv, np.int64)
+            live = alive[g.col_idx] & alive[dst]
+            np.add.at(cnt, dst[live], 1)
+            new = alive & (cnt >= k)
+            if (new == alive).all():
+                break
+            alive = new
+        if not alive.any():
+            break
+        coreness[alive] = k
+        k += 1
+    return coreness
+
+
+def check_kcore(g: HostGraph, coreness: np.ndarray) -> int:
+    """-check invariant: inside the level-c subgraph induced by
+    {v: coreness[v] >= c}, every member keeps >= c in-neighbors — for
+    c = each vertex's own coreness.  One vectorized pass: count
+    in-neighbors u with coreness[u] >= coreness[v]."""
+    coreness = np.asarray(coreness, np.int64)
+    dst = g.dst_of_edges()
+    cnt = np.zeros(g.nv, np.int64)
+    np.add.at(cnt, dst, (coreness[g.col_idx] >= coreness[dst]).astype(
+        np.int64))
+    return int(np.sum((coreness > 0) & (cnt < coreness)))
+
+
+# ---------------------------------------------------------------------------
+# label propagation
+# ---------------------------------------------------------------------------
+
+
+def labelprop_program(labels: int, stride: int) -> SpecProgram:
+    if labels < 2:
+        raise ValueError(f"labelprop needs >= 2 labels, got {labels}")
+    if stride < 1:
+        raise ValueError(f"labelprop seed stride must be >= 1, got {stride}")
+    return bind(library.LABELPROP, labels=int(labels), stride=int(stride),
+                width=int(labels))
+
+
+def labelprop(g, labels: int = 8, stride: int = 16, num_iters: int = 10,
+              num_parts: int = 1, method: str = "auto", mesh=None,
+              ) -> np.ndarray:
+    """Seeded multi-class label propagation (dense pull, WIDE state):
+    every ``stride``-th vertex is pinned to one-hot class
+    ``vid % labels``; everyone else averages incoming class rows for
+    ``num_iters`` fixed iterations.  Returns (nv, labels) float32
+    class probabilities."""
+    from lux_tpu.engine import pull
+
+    shards, arrays = _pull_setup(g, num_parts)
+    prog = labelprop_program(labels, stride)
+    state0 = pull.init_state(prog, arrays)
+    if mesh is not None:
+        from lux_tpu.parallel import dist
+
+        final = dist.run_pull_fixed_dist(
+            prog, shards.spec, shards.arrays, state0, num_iters, mesh,
+            method)
+    else:
+        final = pull.run_pull_fixed(prog, shards.spec, arrays, state0,
+                                    num_iters, method)
+    return shards.scatter_to_global(np.asarray(final))
+
+
+def labelprop_reference(g: HostGraph, labels: int = 8, stride: int = 16,
+                        num_iters: int = 10) -> np.ndarray:
+    """Float64 oracle of the identical recurrence."""
+    nv = g.nv
+    vid = np.arange(nv)
+    seeded = (vid % stride) == 0
+    eye = np.eye(labels)
+    p = np.full((nv, labels), 1.0 / labels)
+    p[seeded] = eye[vid[seeded] % labels]
+    dst = g.dst_of_edges()
+    for _ in range(num_iters):
+        acc = np.zeros_like(p)
+        np.add.at(acc, dst, p[g.col_idx])
+        tot = acc.sum(-1, keepdims=True)
+        norm = np.where(tot > 0, acc / np.maximum(tot, 1e-30), p)
+        p = np.where(seeded[:, None], eye[vid % labels], norm)
+    return p
+
+
+def check_labelprop(probs: np.ndarray, labels: int, stride: int) -> int:
+    """-check invariant: finite rows; seed rows exactly one-hot; every
+    row with in-edges sums to ~1 (rows that kept the uniform prior do
+    too, so the check is unconditional)."""
+    probs = np.asarray(probs, np.float64)
+    nv = probs.shape[0]
+    vid = np.arange(nv)
+    seeded = (vid % stride) == 0
+    bad = int((~np.isfinite(probs)).any(axis=-1).sum())
+    eye = np.eye(labels)
+    bad += int((probs[seeded] != eye[vid[seeded] % labels]).any(-1).sum())
+    bad += int(np.sum(np.abs(probs.sum(-1) - 1.0) > 1e-3))
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# weighted triangle counting (two-phase)
+# ---------------------------------------------------------------------------
+
+
+def triangles(g, num_parts: int = 1, method: str = "auto",
+              ) -> Tuple[np.ndarray, dict]:
+    """Weighted triangle counting as the TWO-PHASE spec program:
+
+      phase 1 (library.TRI_NEIGHBORS, one pull iteration): each vertex
+        accumulates the uint32 bitset union of its in-neighbors' ids;
+      phase 2 (library.TRI_COUNT, reduce-only through the pull engine's
+        load/comp phase split): per edge (u, v), weight(u, v) *
+        |bits(u) & bits(v)|, sum-reduced per destination.
+
+    Returns (incidence (nv,) float32, stats).  ``incidence[v]`` is the
+    weighted triangle incidence Σ_{u→v} w(u,v)·|N(u) ∩ N(v)|.  On a
+    ``symmetrize(..., unit_weights=True)`` view the totals are exact
+    counts: stats["triangles"] = Σ incidence / 6 (each triangle is seen
+    once per directed edge).  Requires an edge-weighted graph (the
+    symmetrize helper provides unit weights)."""
+    shards, arrays = _pull_setup(g, num_parts)
+    nv = shards.spec.nv
+    if nv > TRIANGLES_MAX_NV:
+        raise ValueError(
+            f"triangles builds (V, ceil(nv/32)) uint32 bitsets — "
+            f"quadratic memory; nv={nv} exceeds the supported "
+            f"{TRIANGLES_MAX_NV} (run a smaller graph)")
+    if not shards.spec.weighted:
+        raise ValueError(
+            "triangles weights each closing edge; pass a weighted graph "
+            "(program.workloads.symmetrize assigns unit weights)")
+    if isinstance(g, HostGraph):
+        # phase 1's sum-as-union is exact only on a SIMPLE graph: a
+        # duplicate (src, dst) edge adds the source's bit twice and the
+        # binary carry corrupts the neighboring bitset lane.  symmetrize
+        # dedupes; a raw --directed input must be checked here.
+        key = g.col_idx.astype(np.int64) * g.nv + g.dst_of_edges()
+        if np.unique(key).size != g.ne:
+            raise ValueError(
+                "triangles needs a SIMPLE graph (no parallel duplicate "
+                "edges — a duplicate source bit would carry into the "
+                "next bitset lane); dedupe first, e.g. via "
+                "program.workloads.symmetrize")
+    from lux_tpu.engine import pull
+
+    words = (nv + 31) // 32
+    phase1 = bind(library.TRI_NEIGHBORS, w=words, width=words)
+    bits = pull.run_pull_fixed(phase1, shards.spec, arrays,
+                               pull.init_state(phase1, arrays), 1, method)
+    incidence = reduce_phase(bind(library.TRI_COUNT), shards, arrays,
+                             bits, method)
+    total = float(incidence.sum())
+    return incidence, {
+        "total_weighted_incidence": total,
+        # exact only under unit weights (documented above)
+        "triangles_if_unit": total / 6.0,
+        "bitset_words": words,
+    }
+
+
+def reduce_phase(prog, shards, arrays, state, method: str = "auto",
+                 ) -> np.ndarray:
+    """Run a reduce-only spec phase: ONE gather + edge_value + segmented
+    reduce over the supplied state, through the pull engine's public
+    load/comp phase split (compile_pull_phases) — no update loop, so the
+    phase needs no apply rule.  Returns the reduced (nv,) accumulator."""
+    from lux_tpu.engine import pull
+
+    load, comp, _ = pull.compile_pull_phases(prog, shards.spec, method)
+    acc = comp(arrays, load(arrays, state))
+    return shards.scatter_to_global(np.asarray(acc))
+
+
+def triangles_reference(g: HostGraph) -> np.ndarray:
+    """NumPy oracle: per-vertex weighted triangle incidence via
+    adjacency sets (O(E·deg) — CLI/test scale)."""
+    nv = g.nv
+    dst = g.dst_of_edges()
+    nbrs = [set() for _ in range(nv)]
+    for u, v in zip(g.col_idx, dst):
+        nbrs[int(v)].add(int(u))
+    out = np.zeros(nv, np.float64)
+    w = g.weights if g.weights is not None else np.ones(g.ne, np.int64)
+    for u, v, ww in zip(g.col_idx, dst, w):
+        out[int(v)] += float(ww) * len(nbrs[int(u)] & nbrs[int(v)])
+    return out.astype(np.float32)
+
+
+def check_triangles(g: HostGraph, incidence: np.ndarray) -> int:
+    """-check: recompute the oracle and count mismatches (the workload
+    is small-scale by construction, so the O(E·deg) oracle is the
+    honest validator)."""
+    ref = triangles_reference(g)
+    got = np.asarray(incidence, np.float64)
+    tol = 1e-5 * np.maximum(np.abs(ref), 1.0)
+    return int(np.sum(~np.isfinite(got) | (np.abs(got - ref) > tol)))

@@ -44,6 +44,7 @@ import numpy as np
 from lux_tpu.engine import methods
 from lux_tpu.graph.shards import PullShards, ShardSpec
 from lux_tpu.ops import segment
+from lux_tpu.program import BatchedSpecBacked, library
 
 
 class QueryProgram:
@@ -71,67 +72,46 @@ class QueryProgram:
 
 
 @dataclasses.dataclass(frozen=True)
-class MultiSourceSSSP(QueryProgram):
+class MultiSourceSSSP(BatchedSpecBacked, QueryProgram):
     """Q-source BFS-SSSP (reference parity: unweighted hop counts,
-    INF == nv — models/sssp.SSSPProgram semantics per query lane)."""
+    INF == nv): the Q-axis lift of the SAME declarative spec the
+    one-shot program evaluates (program.library.SSSP with the
+    ``start`` query parameter bound to the traced query vector —
+    ISSUE 13), so each lane is models/sssp.SSSPProgram bitwise by
+    construction, not by parallel implementation."""
 
     nv: int
 
-    reduce: str = dataclasses.field(default="min", init=False)
-    fixpoint: bool = dataclasses.field(default=True, init=False)
+    @property
+    def spec(self):
+        return library.SSSP
 
     @property
     def inf(self) -> int:
         return self.nv
 
-    def init_part(self, global_vid, degree, vtx_mask, queries):
-        del degree
-        inf = jnp.int32(self.inf)
-        d = jnp.where(global_vid[:, None] == queries[None, :], jnp.int32(0),
-                      inf)
-        return jnp.where(vtx_mask[:, None], d, inf)
-
-    def edge_value(self, src_state, weights):
-        del weights
-        return src_state + jnp.int32(1)
-
-    def apply(self, old_local, acc, arr, queries):
-        del queries
-        new = jnp.minimum(old_local, acc)
-        return jnp.where(arr.vtx_mask[:, None], new, old_local)
+    def _env(self):
+        return {"inf": self.inf}
 
 
 @dataclasses.dataclass(frozen=True)
-class MultiSourcePPR(QueryProgram):
-    """Q-seed personalized PageRank: the repo's pre-divided recurrence
-    (models/pagerank.apply_rank_update) with the uniform teleport mass
-    replaced by a one-hot mass at each query's seed — column q equals a
-    single-seed models/pagerank.PPRProgram pull run bitwise."""
+class MultiSourcePPR(BatchedSpecBacked, QueryProgram):
+    """Q-seed personalized PageRank: the Q-axis lift of
+    program.library.PPR (``seed`` bound to the query vector) — column q
+    equals a single-seed models/pagerank.PPRProgram pull run bitwise,
+    because both EVALUATE the one spec."""
 
     nv: int
-    alpha: float = 0.15  # reference ALPHA (multiplies the neighbor sum)
+    alpha: float = library.ALPHA  # reference ALPHA
 
-    reduce: str = dataclasses.field(default="sum", init=False)
-    fixpoint: bool = dataclasses.field(default=False, init=False)
+    @property
+    def spec(self):
+        return library.PPR
 
-    def init_part(self, global_vid, degree, vtx_mask, queries):
-        seed = (global_vid[:, None] == queries[None, :]).astype(jnp.float32)
-        deg = jnp.maximum(degree.astype(jnp.float32), 1.0)[:, None]
-        state = jnp.where(degree[:, None] > 0, seed / deg, seed)
-        return jnp.where(vtx_mask[:, None], state, 0.0)
-
-    def edge_value(self, src_state, weights):
-        del weights
-        return src_state.astype(jnp.float32)
-
-    def apply(self, old_local, acc, arr, queries):
-        del old_local
-        seed = (arr.global_vid[:, None] == queries[None, :]).astype(
-            jnp.float32)
-        pr = jnp.float32(1.0 - self.alpha) * seed + jnp.float32(self.alpha) * acc
-        deg = arr.degree.astype(jnp.float32)[:, None]
-        pr = jnp.where(arr.degree[:, None] > 0, pr / jnp.maximum(deg, 1.0), pr)
-        return jnp.where(arr.vtx_mask[:, None], pr, 0.0)
+    def _env(self):
+        # the serve engines are float32 (driver-enforced); the spec's
+        # trailing cast is a no-op at that dtype
+        return {"nv": self.nv, "alpha": self.alpha, "dtype": "float32"}
 
 
 def _batched_iteration(prog, spec: ShardSpec, method, arrays, state,

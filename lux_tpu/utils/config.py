@@ -107,18 +107,30 @@ class RunConfig:
     serve_wait_ms: float = 5.0  # micro-batch coalescing window
     serve_timeout_ms: float = 0.0  # per-request deadline (0 = none)
     serve_max_queue: int = 256  # admission bound (backpressure past it)
+    # --- generic program driver (python -m lux_tpu.apps.run) ---------------
+    sources: str = "0"  # bfs: comma-separated seed vertices
+    labels: int = 8  # labelprop: number of classes
+    seed_stride: int = 16  # labelprop: every Nth vertex is a seed
+    kmax: int = 0  # kcore: peel ceiling (0 = until the core empties)
+    prog_engine: str = "auto"  # workload surface override (push/pull)
+    directed: bool = False  # kcore/triangles: skip the symmetrized view
 
 
 def parse_args(argv=None, description: str = "", sssp: bool = False,
                pull: bool = False, push: bool = False,
-               stream: bool = False, serve: bool = False) -> RunConfig:
+               stream: bool = False, serve: bool = False,
+               program: bool = False, prog: str = "") -> RunConfig:
     """``sssp`` adds -start/--weighted; ``pull`` adds --exchange
     {allgather,ring,scatter}/--dtype; ``push`` adds --exchange
     {allgather,ring} (frontier apps: dense rounds can ring-stream, but
-    reduce_scatter can't pre-combine min/max).  Flags appear only on apps
+    reduce_scatter can't pre-combine min/max); ``program`` adds the
+    generic program driver's workload knobs (apps/run.py — ``prog``
+    names the workload in the usage line).  Flags appear only on apps
     that consume them — a silently-ignored flag would misreport what was
     benchmarked."""
-    ap = argparse.ArgumentParser(description=description)
+    ap = argparse.ArgumentParser(
+        description=description,
+        prog=f"python -m lux_tpu.apps.run {prog}" if prog else None)
     ap.add_argument("-file", help=".lux graph file (default: synthetic RMAT)")
     ap.add_argument("-ng", "--num-parts", type=int, default=1,
                     help="number of graph parts (one per chip)")
@@ -260,6 +272,29 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
                         help="per-request deadline (0 = none)")
         sg.add_argument("--serve-max-queue", type=int, default=256,
                         help="admission-queue bound (rejects past it)")
+    if program:
+        pg = ap.add_argument_group(
+            "program (generic spec-workload driver, lux_tpu.apps.run)")
+        pg.add_argument("--sources", default="0",
+                        help="bfs: comma-separated seed vertices "
+                             "(distance = hops to the nearest)")
+        pg.add_argument("--labels", type=int, default=8,
+                        help="labelprop: number of label classes (the "
+                             "wide-state trailing dim)")
+        pg.add_argument("--seed-stride", type=int, default=16,
+                        help="labelprop: every Nth vertex is a pinned "
+                             "seed of class vid %% labels")
+        pg.add_argument("--kmax", type=int, default=0,
+                        help="kcore: peel ceiling (0 = peel until the "
+                             "core empties)")
+        pg.add_argument("--engine", dest="prog_engine", default="auto",
+                        choices=["auto", "push", "pull"],
+                        help="execution surface override for workloads "
+                             "that lower onto both (bfs)")
+        pg.add_argument("--directed", action="store_true",
+                        help="kcore/triangles: run on the directed "
+                             "in-neighborhoods as-is instead of the "
+                             "symmetrized simple view")
     if stream:
         # apps with a streamed driver (pagerank/colfilter pull-fixed,
         # components pull-until): host-offload edge streaming
@@ -308,4 +343,10 @@ def parse_args(argv=None, description: str = "", sssp: bool = False,
         serve_wait_ms=getattr(ns, "serve_wait_ms", 5.0),
         serve_timeout_ms=getattr(ns, "serve_timeout_ms", 0.0),
         serve_max_queue=getattr(ns, "serve_max_queue", 256),
+        sources=getattr(ns, "sources", "0"),
+        labels=getattr(ns, "labels", 8),
+        seed_stride=getattr(ns, "seed_stride", 16),
+        kmax=getattr(ns, "kmax", 0),
+        prog_engine=getattr(ns, "prog_engine", "auto"),
+        directed=getattr(ns, "directed", False),
     )

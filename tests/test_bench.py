@@ -8,6 +8,8 @@ import signal
 import subprocess
 import sys
 
+import pytest
+
 BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
 
 
@@ -99,7 +101,10 @@ def test_bench_insurance_survives_hung_primary():
             # primary targets a non-cpu platform so the insurance spawns
             "JAX_PLATFORMS": "bogus_tpu",
             "LUX_BENCH_WATCHDOG_S": "240",
-            "LUX_BENCH_TPU_S": "15",
+            # the window only has to outlive worker spawn + the first
+            # liveness probes — the fake hang never produces; a short
+            # window keeps this wall-clock test inside the tier-1 budget
+            "LUX_BENCH_TPU_S": "7",
         },
         timeout=300,
     )
@@ -126,7 +131,9 @@ def test_bench_harvests_banked_lines_from_wedged_primary():
             "LUX_BENCH_APPS": "pagerank",
             "JAX_PLATFORMS": "bogus_tpu",
             "LUX_BENCH_WATCHDOG_S": "240",
-            "LUX_BENCH_TPU_S": "15",
+            # long enough for the primary to import jax and EMIT its
+            # banked line before wedging; short enough for tier-1
+            "LUX_BENCH_TPU_S": "8",
         },
         timeout=300,
     )
@@ -151,12 +158,12 @@ def test_bench_relay_gate_caps_tpu_wait():
             "LUX_BENCH_WATCHDOG_S": "240",
             "LUX_BENCH_TPU_S": "9999",  # would exceed budget un-capped...
             "LUX_BENCH_ASSUME_RELAY": "down",  # ...but the gate caps it
-            "LUX_BENCH_RELAY_CAP_S": "10",
+            "LUX_BENCH_RELAY_CAP_S": "5",
         },
         timeout=300,
     )
     assert "assumed down (test hook)" in r.stderr
-    assert "TPU wait capped at 10s" in r.stderr
+    assert "TPU wait capped at 5s" in r.stderr
     # and the insurance number actually lands
     assert r.returncode == 0, r.stderr[-2000:]
     line = json.loads(r.stdout.strip().splitlines()[-1])
@@ -193,8 +200,24 @@ def test_bench_worker_scaleup_line():
           if ln["metric"] == "pagerank_gteps_rmat11_1chip_cpu_fallback"]
     assert up, [ln["metric"] for ln in lines]
     assert up[0]["achieved_GBps"] > 0 and up[0]["bytes_per_edge"] > 0
-    # budget-half-spent gate: no scale-up line
-    env["LUX_BENCH_TPU_S"] = "0"
+
+
+@pytest.mark.slow
+def test_bench_worker_scaleup_budget_gate():
+    """The budget-half-spent gate: no scale-up line when the TPU window
+    is exhausted.  Slow tier — a full second worker run whose only
+    assertion is the gate message (the positive scale-up line above
+    stays tier-1)."""
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["PYTHONPATH"] = os.path.dirname(BENCH)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "LUX_BENCH_SCALE": "9",
+        "LUX_BENCH_APPS": "pagerank",
+        "LUX_BENCH_FORCE_SCALEUP": "1",
+        "LUX_BENCH_TPU_S": "0",
+    })
     r2 = subprocess.run(
         [sys.executable, "-c", "import bench; bench.worker_main()"],
         env=env, capture_output=True, text=True, timeout=420, cwd="/tmp",

@@ -1,0 +1,738 @@
+"""luxprog (ISSUE 13): the declarative vertex-program compiler.
+
+Three claim families:
+
+  1. SPEC-VS-HANDWIRED BITWISE PINS — the four reference apps'
+     spec-backed programs against in-test copies of the DELETED
+     hand-wired bodies, across the execution surfaces: pull
+     fixed/until (direct + routed-pf), push (sparse/dense), mutation
+     overlays on both engines, and the serve Q-axis batched step.
+  2. ORACLE CHECKS for the four payoff workloads (bfs, kcore,
+     labelprop, triangles) — NetworkX-free NumPy oracles — plus the
+     generic CLI driver end-to-end.
+  3. ZERO-RETRACE: spec-compiled programs hit the SAME jit/lru compile
+     caches as any other program dataclass (equal specs ARE one
+     program), probed with ``_cache_size`` across fresh instances.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lux_tpu.engine import pull, push
+from lux_tpu.graph import generate
+from lux_tpu.graph.csc import from_edge_list
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.program import (BatchedSpecProgram, SpecProgram,
+                             VertexProgramSpec, active_changed, library)
+from lux_tpu.program import expr as expr_mod
+from lux_tpu.program import workloads
+from lux_tpu.program.spec import bind
+
+
+# ---------------------------------------------------------------------------
+# the deleted hand-wired bodies, preserved here as the bitwise reference
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _HandPageRank:
+    nv: int
+    alpha: float = 0.15
+    dtype: str = "float32"
+    reduce: str = dataclasses.field(default="sum", init=False)
+
+    def init_state(self, global_vid, degree, vtx_mask):
+        rank = jnp.float32(1.0 / self.nv)
+        deg = degree.astype(jnp.float32)
+        state = jnp.where(degree > 0, rank / jnp.maximum(deg, 1.0), rank)
+        return jnp.where(vtx_mask, state, 0.0).astype(self.dtype)
+
+    def edge_value(self, src_state, weight, dst_state=None):
+        del weight, dst_state
+        return src_state.astype(jnp.float32)
+
+    def apply(self, old_local, acc, arrays):
+        del old_local
+        init_rank = jnp.float32((1.0 - self.alpha) / self.nv)
+        pr = init_rank + jnp.float32(self.alpha) * acc
+        deg = arrays.degree.astype(jnp.float32)
+        pr = jnp.where(arrays.degree > 0, pr / jnp.maximum(deg, 1.0), pr)
+        return jnp.where(arrays.vtx_mask, pr, 0.0).astype(self.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class _HandPPR(_HandPageRank):
+    seed: int = 0
+
+    def init_state(self, global_vid, degree, vtx_mask):
+        mass = (global_vid == self.seed).astype(jnp.float32)
+        deg = jnp.maximum(degree.astype(jnp.float32), 1.0)
+        state = jnp.where(degree > 0, mass / deg, mass)
+        return jnp.where(vtx_mask, state, 0.0).astype(self.dtype)
+
+    def apply(self, old_local, acc, arrays):
+        del old_local
+        mass = (arrays.global_vid == self.seed).astype(jnp.float32)
+        pr = jnp.float32(1.0 - self.alpha) * mass \
+            + jnp.float32(self.alpha) * acc
+        deg = arrays.degree.astype(jnp.float32)
+        pr = jnp.where(arrays.degree > 0, pr / jnp.maximum(deg, 1.0), pr)
+        return jnp.where(arrays.vtx_mask, pr, 0.0).astype(self.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class _HandSSSP:
+    nv: int
+    start: int = 0
+    reduce: str = dataclasses.field(default="min", init=False)
+
+    @property
+    def inf(self):
+        return self.nv
+
+    def init_state(self, global_vid, degree, vtx_mask):
+        del degree
+        inf = jnp.int32(self.inf)
+        d = jnp.where(global_vid == self.start, jnp.int32(0), inf)
+        return jnp.where(vtx_mask, d, inf)
+
+    def init_frontier(self, global_vid, state, vtx_mask):
+        del state
+        return (global_vid == self.start) & vtx_mask
+
+    def relax(self, src_val, weight):
+        del weight
+        return src_val + jnp.int32(1)
+
+
+@dataclasses.dataclass(frozen=True)
+class _HandWeightedSSSP(_HandSSSP):
+    @property
+    def inf(self):
+        return 1 << 30
+
+    def relax(self, src_val, weight):
+        return src_val + weight.astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class _HandMaxLabel:
+    reduce: str = dataclasses.field(default="max", init=False)
+
+    def init_state(self, global_vid, degree, vtx_mask):
+        del degree
+        return jnp.where(vtx_mask, global_vid, -1)
+
+    def edge_value(self, src_state, weight, dst_state=None):
+        del weight, dst_state
+        return src_state
+
+    def apply(self, old_local, acc, arrays):
+        new = jnp.maximum(old_local, acc)
+        return jnp.where(jnp.asarray(arrays.vtx_mask), new, old_local)
+
+    def init_frontier(self, global_vid, state, vtx_mask):
+        del global_vid, state
+        return vtx_mask
+
+    def relax(self, src_val, weight):
+        del weight
+        return src_val
+
+
+@dataclasses.dataclass(frozen=True)
+class _HandCF:
+    k: int = 20
+    lam: float = 1e-3
+    gamma: float = 3.5e-7
+    dtype: str = "float32"
+    err_dot: str = "vpu"
+    reduce: str = dataclasses.field(default="sum", init=False)
+    needs_dst_state: bool = dataclasses.field(default=True, init=False)
+
+    def init_state(self, global_vid, degree, vtx_mask):
+        del degree
+        v0 = jnp.full((global_vid.shape[0], self.k),
+                      np.sqrt(1.0 / self.k), jnp.float32)
+        return jnp.where(vtx_mask[:, None], v0, 0.0).astype(self.dtype)
+
+    def edge_value(self, src_state, weight, dst_state=None):
+        from lux_tpu.models.colfilter import err_dot
+
+        src = src_state.astype(jnp.float32)
+        dst = dst_state.astype(jnp.float32)
+        err = weight - err_dot(src, dst, self.err_dot)
+        return err[..., None] * src
+
+    def apply(self, old_local, acc, arrays):
+        old = old_local.astype(jnp.float32)
+        new = old + jnp.float32(self.gamma) * (
+            acc - jnp.float32(self.lam) * old)
+        return jnp.where(
+            jnp.asarray(arrays.vtx_mask)[:, None], new, old
+        ).astype(self.dtype)
+
+
+@lru_cache(maxsize=1)
+def _fx():
+    g = generate.rmat(8, 6, seed=3)
+    sh = build_pull_shards(g, 2)
+    psh = build_push_shards(g, 2)
+    arrays = jax.tree.map(jnp.asarray, sh.arrays)
+    return g, sh, psh, arrays
+
+
+@lru_cache(maxsize=1)
+def _fx_w():
+    gw = generate.rmat(7, 5, seed=5, weighted=True, max_weight=9)
+    return gw, build_push_shards(gw, 2)
+
+
+def _run_fixed(prog, sh, arrays, n=4, route=None, overlay=None):
+    s0 = pull.init_state(prog, arrays)
+    return np.asarray(pull.run_pull_fixed(
+        prog, sh.spec, arrays, s0, n, "scan", route=route,
+        overlay=overlay))
+
+
+# ---------------------------------------------------------------------------
+# 1. spec-vs-handwired bitwise pins
+# ---------------------------------------------------------------------------
+
+
+def test_pagerank_spec_bitwise_pull_direct_and_routed_pf():
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.ops import expand
+
+    g, sh, _, arrays = _fx()
+    spec_p = PageRankProgram(nv=sh.spec.nv)
+    hand_p = _HandPageRank(nv=sh.spec.nv)
+    assert np.array_equal(_run_fixed(spec_p, sh, arrays),
+                          _run_fixed(hand_p, sh, arrays))
+    plan = expand.to_pf(expand.plan_expand_shards(sh))
+    rt = (plan[0], jax.tree.map(jnp.asarray, plan[1]))
+    assert np.array_equal(_run_fixed(spec_p, sh, arrays, route=rt),
+                          _run_fixed(hand_p, sh, arrays, route=rt))
+    # bf16 storage twin
+    assert np.array_equal(
+        _run_fixed(PageRankProgram(nv=sh.spec.nv, dtype="bfloat16"), sh,
+                   arrays),
+        _run_fixed(_HandPageRank(nv=sh.spec.nv, dtype="bfloat16"), sh,
+                   arrays))
+
+
+def test_ppr_spec_bitwise():
+    from lux_tpu.models.pagerank import PPRProgram
+
+    g, sh, _, arrays = _fx()
+    assert np.array_equal(
+        _run_fixed(PPRProgram(nv=sh.spec.nv, seed=17), sh, arrays),
+        _run_fixed(_HandPPR(nv=sh.spec.nv, seed=17), sh, arrays))
+
+
+def test_pagerank_spec_bitwise_overlay():
+    """Mutation-overlay surface (PR 10): base tombstones + inserts
+    through the spec-compiled program == the hand-wired one, bitwise."""
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.mutate import OP_DELETE, OP_INSERT, MutableGraph
+
+    g, _, _, _ = _fx()
+    rng = np.random.default_rng(0)
+    mg = MutableGraph(g, num_parts=2, cap=128)
+    dele = rng.choice(g.ne, 16, replace=False)
+    mg.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+             np.full(16, OP_DELETE, np.int8))
+    mg.apply(rng.integers(0, g.nv, 24), rng.integers(0, g.nv, 24),
+             np.full(24, OP_INSERT, np.int8))
+    sh = mg.pull_shards
+    arrays = jax.tree.map(jnp.asarray, sh.arrays)
+    ov = mg.pull_overlay()
+    assert np.array_equal(
+        _run_fixed(PageRankProgram(nv=sh.spec.nv), sh, arrays, overlay=ov),
+        _run_fixed(_HandPageRank(nv=sh.spec.nv), sh, arrays, overlay=ov))
+
+
+def test_sssp_spec_bitwise_push_direct_routed_weighted():
+    from lux_tpu.models.sssp import SSSPProgram, WeightedSSSPProgram
+    from lux_tpu.ops import expand
+
+    g, sh, psh, _ = _fx()
+    start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
+    for spec_p, hand_p, shards in (
+        (SSSPProgram(nv=g.nv, start=start),
+         _HandSSSP(nv=g.nv, start=start), psh),
+        (WeightedSSSPProgram(nv=_fx_w()[0].nv, start=start),
+         _HandWeightedSSSP(nv=_fx_w()[0].nv, start=start), _fx_w()[1]),
+    ):
+        s_s, it_s, e_s = push.run_push(spec_p, shards, 1000, "scan")
+        s_h, it_h, e_h = push.run_push(hand_p, shards, 1000, "scan")
+        assert np.array_equal(np.asarray(s_s), np.asarray(s_h))
+        assert int(it_s) == int(it_h)
+        assert np.array_equal(np.asarray(e_s), np.asarray(e_h))
+    # routed-pf dense rounds (the push --route-gather expand-pf surface)
+    plan = expand.to_pf(expand.plan_expand_shards(psh))
+    rt = (plan[0], jax.tree.map(jnp.asarray, plan[1]))
+    s_s, _, _ = push.run_push(SSSPProgram(nv=g.nv, start=start), psh,
+                              1000, "scan", route=rt)
+    s_h, _, _ = push.run_push(_HandSSSP(nv=g.nv, start=start), psh,
+                              1000, "scan", route=rt)
+    assert np.array_equal(np.asarray(s_s), np.asarray(s_h))
+
+
+def test_sssp_spec_bitwise_push_overlay():
+    """Push-engine overlay surface: churn through the spec program ==
+    the hand-wired one (compile_push_chunk overlay twins)."""
+    from lux_tpu.models.sssp import SSSPProgram
+    from lux_tpu.mutate import OP_INSERT, MutableGraph
+
+    g, _, _, _ = _fx()
+    rng = np.random.default_rng(1)
+    mg = MutableGraph(g, num_parts=2, cap=128)
+    mg.apply(rng.integers(0, g.nv, 24), rng.integers(0, g.nv, 24),
+             np.full(24, OP_INSERT, np.int8))
+    pshards = mg.push_shards
+    ostatic, oarr, parr = mg.push_overlay()
+    start = int(np.argmax(np.bincount(g.col_idx, minlength=g.nv)))
+    outs = []
+    for prog in (SSSPProgram(nv=g.nv, start=start),
+                 _HandSSSP(nv=g.nv, start=start)):
+        arrays, _, carry0 = push.push_init(prog, pshards)
+        loop = push.compile_push_chunk(prog, pshards.pspec, pshards.spec,
+                                       "scan", overlay_static=ostatic)
+        out = loop(arrays, jax.tree.map(jnp.asarray, parr), carry0,
+                   jnp.int32(1000),
+                   oarrays=jax.tree.map(jnp.asarray, oarr))
+        outs.append(np.asarray(out.state))
+    assert np.array_equal(outs[0], outs[1])
+
+
+def test_components_spec_bitwise_pull_until_and_push():
+    from lux_tpu.models.components import MaxLabelProgram
+
+    g, sh, psh, arrays = _fx()
+    spec_p, hand_p = MaxLabelProgram(), _HandMaxLabel()
+    s_s, it_s = pull.run_pull_until(
+        spec_p, sh.spec, arrays, pull.init_state(spec_p, arrays), 100,
+        active_changed, "scan")
+    s_h, it_h = pull.run_pull_until(
+        hand_p, sh.spec, arrays, pull.init_state(hand_p, arrays), 100,
+        active_changed, "scan")
+    assert np.array_equal(np.asarray(s_s), np.asarray(s_h))
+    assert int(it_s) == int(it_h)
+    p_s, _, _ = push.run_push(spec_p, psh, 1000, "scan")
+    p_h, _, _ = push.run_push(hand_p, psh, 1000, "scan")
+    assert np.array_equal(np.asarray(p_s), np.asarray(p_h))
+
+
+def test_colfilter_spec_bitwise_direct_and_cf_route():
+    from lux_tpu.models.colfilter import CFProgram
+    from lux_tpu.ops import expand
+
+    gw = generate.bipartite_ratings(128, 128, 1500, seed=1)
+    sh = build_pull_shards(gw, 2)
+    arrays = jax.tree.map(jnp.asarray, sh.arrays)
+    spec_p, hand_p = CFProgram(), _HandCF()
+    assert np.array_equal(_run_fixed(spec_p, sh, arrays, n=3),
+                          _run_fixed(hand_p, sh, arrays, n=3))
+    plan = expand.plan_cf_route_shards(sh)
+    rt = (plan[0], jax.tree.map(jnp.asarray, plan[1]))
+    assert np.array_equal(_run_fixed(spec_p, sh, arrays, n=3, route=rt),
+                          _run_fixed(hand_p, sh, arrays, n=3, route=rt))
+    # the mxu error-dot lowering stays a program parameter
+    assert np.array_equal(
+        _run_fixed(CFProgram(err_dot="mxu"), sh, arrays, n=2),
+        _run_fixed(_HandCF(err_dot="mxu"), sh, arrays, n=2))
+
+
+def test_serve_batched_spec_bitwise():
+    """The Q-axis lift: serve's spec-backed MultiSource programs ==
+    hand-wired batched bodies, and each column == the single-query
+    spec program (one spec, three lowerings)."""
+    from lux_tpu.models.pagerank import PPRProgram
+    from lux_tpu.serve import batched as sb
+
+    g, sh, _, arrays = _fx()
+    queries = jnp.asarray(np.array([0, 9, 40, 177], np.int32))
+
+    @dataclasses.dataclass(frozen=True)
+    class _HandMSPPR(sb.QueryProgram):
+        nv: int
+        alpha: float = 0.15
+        reduce: str = dataclasses.field(default="sum", init=False)
+        fixpoint: bool = dataclasses.field(default=False, init=False)
+
+        def init_part(self, global_vid, degree, vtx_mask, queries):
+            seed = (global_vid[:, None] == queries[None, :]).astype(
+                jnp.float32)
+            deg = jnp.maximum(degree.astype(jnp.float32), 1.0)[:, None]
+            state = jnp.where(degree[:, None] > 0, seed / deg, seed)
+            return jnp.where(vtx_mask[:, None], state, 0.0)
+
+        def edge_value(self, src_state, weights):
+            del weights
+            return src_state.astype(jnp.float32)
+
+        def apply(self, old_local, acc, arr, queries):
+            del old_local
+            seed = (arr.global_vid[:, None] == queries[None, :]).astype(
+                jnp.float32)
+            pr = jnp.float32(1.0 - self.alpha) * seed \
+                + jnp.float32(self.alpha) * acc
+            deg = arr.degree.astype(jnp.float32)[:, None]
+            pr = jnp.where(arr.degree[:, None] > 0,
+                           pr / jnp.maximum(deg, 1.0), pr)
+            return jnp.where(arr.vtx_mask[:, None], pr, 0.0)
+
+    spec_p = sb.MultiSourcePPR(nv=sh.spec.nv)
+    hand_p = _HandMSPPR(nv=sh.spec.nv)
+    outs = {}
+    for name, prog in (("spec", spec_p), ("hand", hand_p)):
+        run = sb._compile_batched_fixed(prog, sh.spec, "scan")
+        state0 = sb._batched_iteration  # noqa: F841 (doc anchor)
+        init = sb._compile_batched_init(prog)
+        state, _, _ = run(arrays, queries, init(arrays, queries),
+                          jnp.int32(4))
+        outs[name] = np.asarray(state)
+    assert np.array_equal(outs["spec"], outs["hand"])
+    # column q == the single-seed spec program's pull run (two columns:
+    # each seed is its own compiled single-query program — lanes are
+    # independent, so two pins buy what four would)
+    glob = sh.scatter_to_global(outs["spec"])  # (nv, Q)
+    for qi in (0, 3):
+        seed = int(np.asarray(queries)[qi])
+        single = _run_fixed(PPRProgram(nv=sh.spec.nv, seed=seed),
+                            sh, arrays, n=4)
+        assert np.array_equal(glob[:, qi],
+                              sh.scatter_to_global(single)), qi
+
+
+@pytest.mark.slow
+def test_serve_sssp_engine_matches_push():
+    """BatchedEngine (spec path end-to-end) vs the one-shot push run.
+    Slow tier: tier-1's test_serve_batched already pins the batched
+    engines against push/pull bitwise — this is the e2e double-check."""
+    from lux_tpu.models.sssp import sssp
+    from lux_tpu.serve.batched import BatchedEngine
+
+    g, sh, _, _ = _fx()
+    srcs = np.array([3, 50, 120], np.int32)
+    eng = BatchedEngine(sh, "sssp", len(srcs), method="scan")
+    res = eng.run(srcs)
+    for qi, s in enumerate(srcs):
+        assert np.array_equal(res.state[qi], sssp(g, start=int(s))), qi
+
+
+# ---------------------------------------------------------------------------
+# 2. the four payoff workloads: oracles + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_bfs_push_pull_routed_match_oracle():
+    from lux_tpu.ops import expand
+
+    g, sh, psh, _ = _fx()
+    sources = (3, 77, 200)
+    ref = workloads.bfs_reference(g, sources)
+    d_push, _ = workloads.bfs(psh, sources)
+    assert np.array_equal(d_push, ref)
+    d_pull, _ = workloads.bfs(sh, sources, engine="pull")
+    assert np.array_equal(d_pull, ref)
+    plan = expand.plan_expand_shards(psh)
+    d_rt, _ = workloads.bfs(psh, sources,
+                            route=(plan[0],
+                                   jax.tree.map(jnp.asarray, plan[1])))
+    assert np.array_equal(d_rt, ref)
+    assert workloads.check_bfs(g, ref, sources) == 0
+    # the -check gate bounds distances from BOTH sides: an all-zeros
+    # answer (sources fine, every edge satisfied) must FAIL the
+    # lower-bound/fixpoint leg, and an over-estimate the upper bound
+    assert workloads.check_bfs(g, np.zeros(g.nv, np.int32), sources) > 0
+    over = ref.copy()
+    over[ref == 1] = 3
+    assert workloads.check_bfs(g, over, sources) > 0
+
+
+@pytest.mark.slow
+def test_bfs_single_source_matches_sssp():
+    """BFS at one source is sssp's unweighted relaxation — the spec
+    family's internal consistency check (slow tier: the oracle test
+    above already pins bfs on every surface)."""
+    from lux_tpu.models.sssp import sssp
+
+    g, _, psh, _ = _fx()
+    d, _ = workloads.bfs(psh, (11,))
+    assert np.array_equal(d, sssp(g, start=11))
+
+
+def test_kcore_matches_peel_oracle():
+    # a capped peel keeps the tier-1 cost at 5 level compiles; coreness
+    # below the cap must still match the (capped) oracle exactly
+    g, sh, _, _ = _fx()
+    core, kmax, rounds = workloads.kcore(sh, kmax=5)
+    ref = workloads.kcore_reference(g, kmax=5)
+    assert np.array_equal(core, ref)
+    assert kmax == int(ref.max()) == 5 and rounds > kmax
+    # the invariant check passes on any capped prefix too: every vertex
+    # at level c keeps >= c in-neighbors at its own level
+    assert workloads.check_kcore(g, core) == 0
+
+
+@pytest.mark.slow
+def test_kcore_full_peel_and_symmetrized():
+    g, sh, _, _ = _fx()
+    core, kmax, _ = workloads.kcore(sh)
+    ref = workloads.kcore_reference(g)
+    assert np.array_equal(core, ref) and kmax == int(ref.max()) >= 2
+    gs = workloads.symmetrize(g)
+    core_s, _, _ = workloads.kcore(gs, kmax=3)
+    assert np.array_equal(core_s, workloads.kcore_reference(gs, kmax=3))
+
+
+def test_labelprop_matches_float64_oracle():
+    g, sh, _, _ = _fx()
+    probs = workloads.labelprop(sh, labels=6, stride=8, num_iters=5)
+    ref = workloads.labelprop_reference(g, labels=6, stride=8, num_iters=5)
+    assert probs.shape == (g.nv, 6)
+    np.testing.assert_allclose(probs, ref, rtol=2e-4, atol=1e-6)
+    assert workloads.check_labelprop(probs, 6, 8) == 0
+
+
+def test_triangles_matches_oracle_and_exact_count():
+    # K6 complete graph: C(6,3) = 20 triangles, exactly counted
+    n = 6
+    pairs = [(a, b) for a in range(n) for b in range(n) if a != b]
+    es = np.array([p[0] for p in pairs])
+    ed = np.array([p[1] for p in pairs])
+    g6 = from_edge_list(es, ed, n, weights=np.ones(len(es), np.int32))
+    inc, stats = workloads.triangles(g6)
+    assert stats["triangles_if_unit"] == 20.0
+    assert np.array_equal(inc, workloads.triangles_reference(g6))
+    # weighted, on a symmetrized rmat draw
+    g = generate.rmat(7, 4, seed=9, weighted=True, max_weight=7)
+    gs = workloads.symmetrize(g)
+    inc, _ = workloads.triangles(gs, num_parts=2)
+    ref = workloads.triangles_reference(gs)
+    np.testing.assert_allclose(inc, ref, rtol=1e-5)
+    assert workloads.check_triangles(gs, inc) == 0
+
+
+def test_triangles_guards():
+    g, _, _, _ = _fx()
+    with pytest.raises(ValueError, match="weighted"):
+        workloads.triangles(g)  # unweighted
+    big = generate.path_graph(workloads.TRIANGLES_MAX_NV + 1)
+    big.weights = np.ones(big.ne, np.int32)
+    with pytest.raises(ValueError, match="quadratic"):
+        workloads.triangles(big)
+    # a MULTIgraph corrupts the sum-as-union bitsets via binary carry:
+    # refused loudly, never a silently-wrong count
+    dup = from_edge_list(np.array([1, 1, 2]), np.array([0, 0, 0]), 3,
+                         weights=np.ones(3, np.int32))
+    with pytest.raises(ValueError, match="SIMPLE"):
+        workloads.triangles(dup)
+
+
+def test_integer_sum_strategies_stay_exact():
+    """The scan-family refinement must never corrupt INTEGER sum
+    programs: matmul_cumsum accumulates f32, so a banked (or forced)
+    mxsum downgrades to the bitwise scan for integer values — pinned
+    end-to-end on the uint32 bitset workload (a 2^31 bit is not f32-
+    representable; the pre-fix run lost high bits and failed -check)."""
+    from lux_tpu.ops import segment
+
+    rng = np.random.default_rng(0)
+    vals = (np.uint32(1) << rng.integers(0, 32, 64).astype(np.uint32))
+    row_ptr = jnp.asarray(np.array([0, 20, 20, 45, 64], np.int32))
+    head = np.zeros(64, bool)
+    head[[0, 20, 45]] = True
+    dst = np.repeat(np.arange(4), np.diff([0, 20, 20, 45, 64]))
+    args = (jnp.asarray(vals), row_ptr, jnp.asarray(head),
+            jnp.asarray(dst.astype(np.int32)))
+    ref = np.asarray(segment.segment_sum_csc(*args, method="scan"))
+    for m in ("mxsum", "cumsum", "scatter", "mxscan"):
+        got = np.asarray(segment.segment_sum_csc(*args, method=m))
+        assert got.dtype == ref.dtype and np.array_equal(got, ref), m
+    # end-to-end: the triangles workload under a forced mxsum winner
+    gt = workloads.symmetrize(generate.rmat(7, 4, seed=9, weighted=True))
+    inc, _ = workloads.triangles(gt, method="mxsum")
+    np.testing.assert_allclose(inc, workloads.triangles_reference(gt),
+                               rtol=1e-5)
+
+
+def test_bfs_pull_mesh_refuses_route():
+    g, sh, _, _ = _fx()
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU harness")
+    from lux_tpu.parallel.mesh import make_mesh_for_parts
+
+    with pytest.raises(ValueError, match="route"):
+        workloads.bfs(sh, (3,), num_parts=2, engine="pull",
+                      mesh=make_mesh_for_parts(2), route=("fake", None))
+
+
+def test_run_cli_labelprop(capsys):
+    """One generic-driver e2e stays in tier-1 (the factored CLI path);
+    the full four-program sweep rides the slow tier + the ci_check
+    program_smoke stage (bfs + triangles, [PASS]-gated)."""
+    from lux_tpu.apps import run as run_app
+
+    small = ["--rmat-scale", "7", "--rmat-ef", "5"]
+    assert run_app.main(["labelprop"] + small
+                        + ["--labels", "4", "-ni", "2", "-check"]) == 0
+    assert "[PASS] labelprop" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_run_cli_all_programs(capsys):
+    from lux_tpu.apps import run as run_app
+
+    small = ["--rmat-scale", "7", "--rmat-ef", "5"]
+    assert run_app.main(["bfs"] + small + ["--sources", "0,3", "-check"]) == 0
+    out = capsys.readouterr().out
+    assert "[PASS] bfs" in out and "reached" in out
+    assert run_app.main(["kcore"] + small + ["--kmax", "3", "-check"]) == 0
+    assert "[PASS] kcore" in capsys.readouterr().out
+    assert run_app.main(["triangles"] + small + ["-check"]) == 0
+    out = capsys.readouterr().out
+    assert "[PASS] triangles" in out and "unit weights, exact" in out
+
+
+def test_run_cli_rejections(capsys):
+    from lux_tpu.apps import run as run_app
+
+    assert run_app.main(["nope"]) == 2
+    assert "unknown program" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        run_app.main(["bfs", "--rmat-scale", "7", "--sources", "frog"])
+    with pytest.raises(SystemExit):
+        run_app.main(["labelprop", "--rmat-scale", "7",
+                      "--route-gather", "expand"])
+
+
+# ---------------------------------------------------------------------------
+# 3. cache identity / zero-retrace, language guards
+# ---------------------------------------------------------------------------
+
+
+def test_spec_program_equality_and_zero_retrace():
+    """Two freshly-constructed equal programs ARE one program to the
+    compile caches: the pull jit cache does not grow on the second
+    run, and the push chunk lru returns the identical compiled loop."""
+    g, sh, psh, arrays = _fx()
+
+    def fresh():
+        return bind(library.KCORE, kk=2)
+
+    assert fresh() == fresh() and hash(fresh()) == hash(fresh())
+    s0 = pull.init_state(fresh(), arrays)
+    pull.run_pull_fixed(fresh(), sh.spec, arrays, s0, 2, "scan")
+    size0 = pull._pull_fixed_jit._cache_size()
+    pull.run_pull_fixed(fresh(), sh.spec, arrays, s0, 2, "scan")
+    assert pull._pull_fixed_jit._cache_size() == size0
+    # model classes are spec-backed dataclasses with the same property
+    from lux_tpu.models.sssp import SSSPProgram
+
+    l1 = push.compile_push_chunk(SSSPProgram(nv=g.nv, start=1),
+                                 psh.pspec, psh.spec, "scan")
+    l2 = push.compile_push_chunk(SSSPProgram(nv=g.nv, start=1),
+                                 psh.pspec, psh.spec, "scan")
+    assert l1 is l2
+
+
+def test_spec_program_param_identity_is_static():
+    """Different bindings are different programs (kcore's per-level
+    compile is honest), equal bindings are not."""
+    a, b = bind(library.KCORE, kk=2), bind(library.KCORE, kk=3)
+    assert a != b and a == bind(library.KCORE, kk=2)
+
+
+def test_expr_language_rejects_out_of_vocabulary():
+    for bad in (
+        "__import__('os').system('x')",
+        "src.dtype",
+        "src[0]",
+        "[x for x in src]",
+        "lambda x: x",
+        "src if weight else dst",
+        "a = 1",  # no final expression
+        "f = exec",
+    ):
+        with pytest.raises(expr_mod.SpecSyntaxError):
+            expr_mod.check(bad)
+    with pytest.raises(expr_mod.SpecSyntaxError, match="unknown name"):
+        expr_mod.run("nope + 1", {"x": 1})
+    with pytest.raises(expr_mod.SpecSyntaxError, match="unknown function"):
+        expr_mod.run("frobnicate(x)", {"x": 1})
+
+
+def test_spec_validation_at_definition():
+    with pytest.raises(ValueError, match="monoid"):
+        VertexProgramSpec(name="bad", reduce="mean", init="vid", edge="src")
+    with pytest.raises(ValueError, match="convergence"):
+        VertexProgramSpec(name="bad", reduce="sum", init="vid",
+                          edge="src", convergence="whenever")
+    with pytest.raises(expr_mod.SpecSyntaxError, match="bad.*init"):
+        VertexProgramSpec(name="bad", reduce="sum", init="vid ++", edge="s")
+
+
+def test_lowering_guards():
+    """Reduce-only phases refuse update loops; pull-only specs refuse
+    the push contract; dst-reading specs refuse the push relax; specs
+    without a query param refuse the serve lift."""
+    g, sh, _, arrays = _fx()
+    tri = bind(library.TRI_COUNT)
+    with pytest.raises(ValueError, match="reduce-only"):
+        tri.apply(None, None, sh.arrays)
+    with pytest.raises(ValueError, match="no frontier"):
+        bind(library.KCORE, kk=1).init_frontier(None, None, None)
+    with pytest.raises(ValueError, match="destination state"):
+        bind(library.COLFILTER, k=20, lam=0.0, gamma=0.0,
+             dtype="float32", err_dot="vpu").relax(None, None)
+    with pytest.raises(ValueError, match="query_param"):
+        BatchedSpecProgram(library.COMPONENTS).init_part(
+            None, None, None, None)
+
+
+def test_registry_covers_all_shipped_programs():
+    assert set(library.REGISTRY) == {
+        "pagerank", "ppr", "sssp", "sssp_weighted", "components",
+        "colfilter", "bfs", "kcore", "labelprop", "tri_neighbors",
+        "tri_count"}
+    for s in library.REGISTRY.values():
+        assert isinstance(s, VertexProgramSpec)
+
+
+@pytest.mark.slow
+def test_spec_programs_on_virtual_mesh():
+    """Dist-engine surface: spec programs run the shard_map engines on
+    the virtual mesh unchanged (pull fixed + push dist).  Slow tier:
+    tier-1's test_dist/test_ring/test_scatter already drive the dist
+    engines through the (now spec-backed) model programs every run."""
+    from lux_tpu.models.pagerank import PageRankProgram
+    from lux_tpu.parallel import dist
+    from lux_tpu.parallel.mesh import make_mesh_for_parts
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU harness")
+    g, sh4, psh4, _ = None, None, None, None
+    g = generate.rmat(8, 6, seed=3)
+    sh4 = build_pull_shards(g, 4)
+    psh4 = build_push_shards(g, 4)
+    mesh = make_mesh_for_parts(4)
+    prog = PageRankProgram(nv=sh4.spec.nv)
+    s0 = pull.init_state(prog, jax.tree.map(jnp.asarray, sh4.arrays))
+    out = dist.run_pull_fixed_dist(prog, sh4.spec, sh4.arrays, s0, 3,
+                                   mesh, "scan")
+    ref = _run_fixed(_HandPageRank(nv=sh4.spec.nv), sh4,
+                     jax.tree.map(jnp.asarray, sh4.arrays), n=3)
+    assert np.array_equal(np.asarray(out), ref)
+    # push-dist with a spec-only workload (bfs)
+    d_dist, _ = workloads.bfs(psh4, (3, 77), mesh=mesh)
+    assert np.array_equal(d_dist, workloads.bfs_reference(g, (3, 77)))

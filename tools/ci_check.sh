@@ -259,13 +259,28 @@ finally:
     fleet.close()
 "
 
+# 3e) program smoke (ISSUE 13): one spec-only workload end-to-end
+#     through the GENERIC driver on a tiny graph — the declarative
+#     compiler's whole path (spec -> program -> engine -> [PASS] check)
+#     plus the exact two-phase triangle count against its oracle
+stage program_smoke 300 bash -c '
+set -e
+out=$(JAX_PLATFORMS=cpu python -m lux_tpu.apps.run bfs \
+      --rmat-scale 7 --rmat-ef 5 --sources 0,3 -check)
+echo "$out" | grep -q "\[PASS\] bfs" || { echo "bfs check failed"; exit 1; }
+out=$(JAX_PLATFORMS=cpu python -m lux_tpu.apps.run triangles \
+      --rmat-scale 7 --rmat-ef 5 -check)
+echo "$out" | grep -q "\[PASS\] triangles" || { echo "triangles check failed"; exit 1; }
+echo "$out" | grep "unit weights, exact"
+'
+
 # 4) fast tier-1 subset: the engine/analysis/native seams this script
 #    exists to protect (full suite: ROADMAP.md "Tier-1 verify")
 stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
     -m 'not slow' -p no:cacheprovider \
     tests/test_luxcheck.py tests/test_native.py tests/test_expand.py \
     tests/test_passfuse.py tests/test_mxreduce.py tests/test_mxscan.py \
-    tests/test_obs.py \
+    tests/test_obs.py tests/test_program.py \
     tests/test_determinism.py tests/test_serve_scheduler.py \
     tests/test_fleet.py tests/test_mutate.py tests/test_live.py
 
