@@ -1,0 +1,275 @@
+"""Chaos soak: a seeded randomized fault schedule over a live fleet.
+
+One soak builds a 2-worker (default) mutation-aware fleet
+(``serve/live``), installs a seeded wire-fault plan, and drives a
+seeded event stream — edge-churn writes, bounded reads, stale-degrade
+reads, fleet refreshes, worker kills + rejoins, optionally a
+controller kill + promotion — asserting the STANDING INVARIANTS at
+every step and again after recovery:
+
+1. **No acked write lost** — every admit that returned is applied to
+   an independent mirror DeltaLog; at the end the controller journal's
+   merged graph must equal the mirror's bitwise (and after a failover,
+   the promoted controller's generation line must cover every ack).
+2. **Read-your-writes** — a read bounded by ``min_generation=g``
+   either carries a tag >= g or raised StaleReadError; with
+   ``stale_ok`` it carries the explicit ``stale`` tag instead.  Every
+   answer is compared BITWISE against ``bfs_reference`` of the merged
+   graph at exactly the generation its tag names — a stale answer must
+   be a CORRECT old answer, never a wrong one.
+3. **Post-recovery convergence** — after the soak (kills, faults,
+   failover and all), a fleet refresh + standing reads from EVERY
+   replica are bitwise-equal to the merged reference.
+
+Determinism: the event stream and the fault plan both derive from the
+ONE ``seed``; a failure raises :class:`ChaosFailure` whose message
+prints the seed, the plan (with live fire counts) and the event tail —
+the reproduction recipe, per the acceptance criterion.
+
+Scope note: the default insert capacity is sized so the soak never
+crosses a compaction epoch — overflow escalation has its own dedicated
+drills (tests/test_live.py) and folding epochs into the soak would
+mostly re-test them slowly.  Worker rejoin therefore replays the local
+journal prefix and catches up from the controller, the same path a
+production same-epoch crash takes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from lux_tpu import fault
+from lux_tpu.fault.drills import wire_chaos
+from lux_tpu.mutate.deltalog import DeltaLog
+
+
+class ChaosFailure(AssertionError):
+    """An invariant broke; the message carries seed + plan + events."""
+
+
+def _fail(seed: int, plan, events: List[dict], why: str,
+          cause: Optional[BaseException] = None) -> "ChaosFailure":
+    tail = events[-12:]
+    msg = (f"chaos soak FAILED (seed={seed}): {why}\n"
+           f"reproduce: chaos_soak(seed={seed})\n"
+           f"{plan.describe() if plan is not None else 'no wire plan'}\n"
+           "event tail:\n" +
+           "\n".join(f"  {json.dumps(e, default=str)}" for e in tail))
+    err = ChaosFailure(msg)
+    if cause is not None:
+        err.__cause__ = cause
+    return err
+
+
+def chaos_soak(seed: int, steps: int = 16, workers: int = 2,
+               scale: int = 8, ef: int = 4, rows: int = 10,
+               cap: int = 4096, controller_kill: bool = False,
+               wire_faults: bool = True,
+               journal_root: Optional[str] = None,
+               read_deadline_s: float = 60.0) -> dict:
+    """Run one seeded soak; returns the report dict or raises
+    :class:`ChaosFailure`."""
+    from lux_tpu import obs
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.models.sssp import bfs_reference
+    from lux_tpu.serve.live.bench import churn_batch
+    from lux_tpu.serve.live.controller import (
+        promote_live_controller,
+        start_live_fleet,
+    )
+    from lux_tpu.serve.live.replica import LiveReplica
+
+    rng = np.random.default_rng(seed)
+    g = generate.rmat(scale, ef, seed=int(rng.integers(1 << 30)))
+    own_tmp = None
+    if journal_root is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="lux_chaos_")
+        journal_root = own_tmp.name
+    snapshot_path = os.path.join(journal_root, "snap.lux")
+    standing = (("sssp", 0),)
+    parts = 2
+    plan = wire_chaos(seed=seed + 1) if wire_faults else None
+    events: List[dict] = []
+    graphs = {0: g}  # generation -> merged HostGraph (tiny at scale 8)
+    mirror = DeltaLog(g)  # the independent acked-writes mirror
+    acked_gen = 0
+    kills = rejoins = failovers = 0
+    dead: Dict[str, object] = {}  # wid -> killed worker (to rejoin)
+
+    fleet = start_live_fleet(
+        workers, g, parts=parts, cap=cap, buckets=(1, 4),
+        standing=standing, journal_root=journal_root,
+        snapshot_path=snapshot_path)
+    ctl = fleet.controller
+    shards = build_pull_shards(g, parts)
+
+    def bounded_read(src: int, bound: int, stale_ok: bool):
+        fut = ctl.submit_retrying(
+            int(src), deadline_s=read_deadline_s, min_generation=bound,
+            stale_ok=stale_ok,
+            request_id=f"chaos-{seed}-r{len(events)}")
+        ans = fut.result(timeout=0)
+        tag = fut.generation if fut.generation is not None else 0
+        if not stale_ok and tag < bound:
+            raise AssertionError(
+                f"read-your-writes broke: bound {bound}, tag {tag}")
+        if stale_ok and tag < bound and not fut.stale:
+            raise AssertionError(
+                f"stale answer (tag {tag} < bound {bound}) missing the "
+                "explicit stale tag")
+        ref = bfs_reference(graphs[tag], int(src))
+        if not np.array_equal(ans, ref):
+            raise AssertionError(
+                f"answer at generation {tag} (src {src}) is not the "
+                "merged reference — wrong, not just stale")
+        return tag, bool(fut.stale)
+
+    def rejoin(wid: str):
+        from lux_tpu.serve.fleet.worker import ReplicaWorker
+
+        live = LiveReplica(
+            g, shards, cap=cap,
+            journal_dir=os.path.join(journal_root, wid),
+            standing=standing)
+        w = ReplicaWorker(shards, worker_id=wid, graph_id="live",
+                          q_buckets=(1, 4), live=live).start()
+        fleet.thread_workers.append(w)
+        ctl.add_worker("127.0.0.1", w.port)
+        return w
+
+    try:
+        with obs.span("fault.chaos", seed=seed, steps=steps,
+                      workers=workers):
+            if plan is not None:
+                fault.install(plan)
+            kill_step = (int(rng.integers(steps // 3, 2 * steps // 3))
+                         if controller_kill else -1)
+            for i in range(steps):
+                if i == kill_step:
+                    ctl.kill()
+                    failovers += 1
+                    endpoints = [("127.0.0.1", w.port)
+                                 for w in fleet.thread_workers
+                                 if w._running]
+                    ctl, rep = promote_live_controller(
+                        g, os.path.join(journal_root, "controller"),
+                        snapshot_path, endpoints, seed=seed + 2)
+                    fleet.controller = ctl
+                    events.append({"i": i, "ev": "failover",
+                                   "joined": rep["joined"],
+                                   "refused": rep["refused"],
+                                   "gen": ctl.generation()})
+                    if ctl.generation() < acked_gen:
+                        raise AssertionError(
+                            f"promotion lost acked writes: journal at "
+                            f"{ctl.generation()}, acked {acked_gen}")
+                    continue
+                ev = rng.choice(
+                    ["write", "read", "read_stale", "refresh", "kill"],
+                    p=[0.45, 0.25, 0.10, 0.10, 0.10])
+                if ev == "kill" and len(ctl.live_workers()) <= 1:
+                    ev = "write"  # never kill the last live replica
+                if ev == "write":
+                    src, dst, op = churn_batch(mirror, rng, rows)
+                    rep = ctl.admit_writes(
+                        src, dst, op,
+                        write_id=f"chaos-{seed}-w{i}")
+                    if not rep.get("deduped"):
+                        mirror.apply(src, dst, op)
+                        graphs[rep["generation"]] = mirror.merged_graph()
+                    acked_gen = max(acked_gen, rep["generation"])
+                    events.append({"i": i, "ev": "write",
+                                   "gen": rep["generation"],
+                                   "acked": rep["acked"]})
+                elif ev in ("read", "read_stale"):
+                    src = int(rng.integers(0, g.nv))
+                    stale_ok = ev == "read_stale"
+                    bound = acked_gen + (1 if stale_ok else 0)
+                    tag, stale = bounded_read(src, bound, stale_ok)
+                    events.append({"i": i, "ev": ev, "src": src,
+                                   "bound": bound, "tag": tag,
+                                   "stale": stale})
+                elif ev == "refresh":
+                    if dead:  # rejoin before refreshing (refresh_fleet
+                        # needs every live replica to answer)
+                        for wid in sorted(dead):
+                            rejoin(wid)
+                            rejoins += 1
+                        dead.clear()
+                    ctl.refresh_fleet()
+                    for wid, ent in ctl.read_standing_all("sssp").items():
+                        tag = int(ent["generation"])
+                        if not np.array_equal(
+                                ent["state"],
+                                bfs_reference(graphs[tag], 0)):
+                            raise AssertionError(
+                                f"standing state on {wid} at generation "
+                                f"{tag} != merged reference")
+                    events.append({"i": i, "ev": "refresh",
+                                   "gen": acked_gen})
+                else:  # kill one worker (rejoined on a later refresh
+                    # or at the end)
+                    victim = sorted(ctl.live_workers())[
+                        int(rng.integers(0, len(ctl.live_workers())))]
+                    w = next(x for x in fleet.thread_workers
+                             if x.worker_id == victim and x._running)
+                    w.kill()
+                    dead[victim] = w
+                    kills += 1
+                    events.append({"i": i, "ev": "kill", "wid": victim})
+            # ---- post-recovery acceptance --------------------------------
+            for wid in sorted(dead):
+                rejoin(wid)
+                rejoins += 1
+            dead.clear()
+            merged = ctl.journal.log.merged_graph()
+            mref = mirror.merged_graph()
+            if not (np.array_equal(merged.row_ptr, mref.row_ptr)
+                    and np.array_equal(merged.col_idx, mref.col_idx)):
+                raise AssertionError(
+                    "controller journal merged graph != acked-writes "
+                    "mirror (acked write lost or corrupted)")
+            for src in rng.integers(0, g.nv, 3):
+                bounded_read(int(src), acked_gen, stale_ok=False)
+            ctl.refresh_fleet()
+            allr = ctl.read_standing_all("sssp")
+            final_ref = bfs_reference(graphs[acked_gen], 0)
+            for wid, ent in allr.items():
+                if int(ent["generation"]) < acked_gen:
+                    raise AssertionError(
+                        f"{wid} standing tag {ent['generation']} < "
+                        f"acked {acked_gen} after final refresh")
+                if not np.array_equal(ent["state"], final_ref):
+                    raise AssertionError(
+                        f"{wid} post-recovery standing state != merged "
+                        "reference")
+    except ChaosFailure:
+        raise
+    except BaseException as e:  # noqa: BLE001 — every failure must
+        # carry its reproduction recipe (seed + plan + events)
+        raise _fail(seed, plan, events, f"{type(e).__name__}: {e}",
+                    cause=e) from e
+    finally:
+        if plan is not None:
+            fault.uninstall()
+        try:
+            fleet.close()
+        except Exception:  # noqa: BLE001 — teardown must finish
+            pass
+        if own_tmp is not None:
+            own_tmp.cleanup()
+    return {
+        "seed": seed, "steps": steps, "generation": acked_gen,
+        "writes": sum(1 for e in events if e["ev"] == "write"),
+        "reads": sum(1 for e in events if e["ev"].startswith("read")),
+        "worker_kills": kills, "rejoins": rejoins,
+        "failovers": failovers,
+        "faults_injected": plan.total_fired() if plan else 0,
+        "fault_counters": plan.counters() if plan else [],
+        "events": events,
+    }

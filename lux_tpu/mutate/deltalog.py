@@ -50,7 +50,20 @@ def _fsync_write(path: str, data: bytes) -> None:
     DIRECTORY fsync — without the last, the rename's directory entry
     can flush after a later file's, and the batch-before-marker
     ordering the crash-replay protocol depends on would not be
-    durable."""
+    durable.
+
+    Fault site ``journal.write`` (lux_tpu.fault): a ``torn`` rule lands
+    HALF the bytes at the final path with no rename and no fsync — the
+    on-disk shape of a non-atomic writer caught by a crash — then
+    raises InjectedKill; the replay protocol must discard exactly that
+    file (no marker ever follows it)."""
+    from lux_tpu import fault
+
+    rule = fault.ppoint("journal.write", file=os.path.basename(path))
+    if rule is not None and rule.action == "torn":
+        with open(path, "wb") as f:
+            f.write(data[:max(len(data) // 2, 1)])
+        raise fault.InjectedKill(f"injected torn write at {path}")
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(data)
@@ -115,13 +128,19 @@ class DeltaLog:
     # mutation API
     # ------------------------------------------------------------------
 
-    def apply(self, src, dst, op, weight=None) -> None:
+    def apply(self, src, dst, op, weight=None,
+              journal_extra: Optional[dict] = None) -> None:
         """Apply ONE batch of edge mutations (arrays of equal length;
         ``op`` rows are OP_INSERT/OP_DELETE).  Rows resolve in order —
         a batch may insert an edge and delete it again.  Deleting an
         edge that does not exist (in base or live inserts) raises
         KeyError: silent no-op deletes would let the log and the true
         graph drift apart.
+
+        ``journal_extra``: extra named uint8/int arrays journaled WITH
+        the batch npz and ignored by replay (the live sequencer rides
+        its idempotent write-ids here) — same format version, older
+        readers skip unknown keys.
 
         Atomicity: the WHOLE batch resolves against the in-memory
         state first (an invalid row restores the pre-batch state and
@@ -141,6 +160,15 @@ class DeltaLog:
                          or dst.min() < 0 or dst.max() >= self.base.nv):
             raise ValueError("edge endpoints out of [0, nv) — the delta"
                              " log mutates edges, never the vertex set")
+        bad = set(journal_extra or ()) & {"src", "dst", "op", "w"}
+        if bad:
+            # validated BEFORE the batch touches memory: a savez kwarg
+            # collision would raise after _apply_resolved committed —
+            # memory one batch ahead of the journal, the drift the
+            # atomicity contract forbids
+            raise ValueError(
+                f"journal_extra keys {sorted(bad)} collide with the "
+                "reserved batch fields ('src', 'dst', 'op', 'w')")
         # snapshot the resolution state: growth rebinds the ins_*
         # arrays (never mutates them), so references suffice there;
         # del_base / ins_live ARE mutated in place and copy
@@ -153,8 +181,16 @@ class DeltaLog:
              self.ins_live, self.batches_applied) = snap
             raise
         if self.journal_dir is not None:
+            from lux_tpu import fault
+
             seq = self._journal_write_batch(src, dst, op, w,
-                                            self.batches_applied - 1)
+                                            self.batches_applied - 1,
+                                            extra=journal_extra)
+            # THE crash window the replay protocol is built around:
+            # batch npz durable, marker not yet — fault drills
+            # (kill_before_marker / kill_at("after_delta_before_marker"))
+            # inject the kill exactly here
+            fault.ppoint("journal.before_marker", seq=seq)
             self._journal_mark(seq)
 
     def _apply_resolved(self, src, dst, op, w) -> None:
@@ -339,7 +375,8 @@ class DeltaLog:
                 self._apply_resolved(z["src"], z["dst"], z["op"], z["w"])
             seq += 1
 
-    def _journal_write_batch(self, src, dst, op, w, seq=None) -> int:
+    def _journal_write_batch(self, src, dst, op, w, seq=None,
+                             extra: Optional[dict] = None) -> int:
         """Durably append ONE batch npz; the batch is NOT committed
         until _journal_mark writes its marker (the crash-window the
         replay protocol is built around)."""
@@ -348,7 +385,7 @@ class DeltaLog:
         import io
 
         buf = io.BytesIO()
-        np.savez(buf, src=src, dst=dst, op=op, w=w)
+        np.savez(buf, src=src, dst=dst, op=op, w=w, **(extra or {}))
         _fsync_write(self._batch_path(seq), buf.getvalue())
         return seq
 

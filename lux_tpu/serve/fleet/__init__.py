@@ -31,6 +31,8 @@ from lux_tpu.serve.fleet.controller import (  # noqa: F401
     FleetRejectedError,
     FleetTimeoutError,
     NoWorkersError,
+    StaleReadError,
+    WorkerRefusedError,
 )
 from lux_tpu.serve.fleet.hashring import (  # noqa: F401
     HashRing,

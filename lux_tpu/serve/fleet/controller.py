@@ -39,22 +39,36 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from lux_tpu import fault
 from lux_tpu.serve.fleet.hashring import (
     DEFAULT_SLOTS,
     DEFAULT_VNODES,
     EmptyRingError,
     HashRing,
+    h64,
     route_key,
 )
 from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
+from lux_tpu.utils.backoff import Backoff, retry_call
 
 
 class FleetError(RuntimeError):
     """Fleet-level request failure (no retry succeeded)."""
+
+
+class WorkerRefusedError(FleetError):
+    """A worker refused the handshake for a PERMANENT reason (e.g. the
+    split-brain guard: its journal is ahead of this controller's) —
+    retrying the same hello cannot succeed, so reconnect loops must
+    surface this instead of backing off forever."""
+
+    def __init__(self, kind: str, err: str):
+        super().__init__(f"worker refused handshake ({kind}): {err}")
+        self.kind = kind
 
 
 class FleetRejectedError(FleetError):
@@ -94,20 +108,43 @@ class FleetFuture:
 
     def __init__(self, app: str, source: int,
                  timeout_ms: Optional[float],
-                 min_generation: Optional[int] = None):
+                 min_generation: Optional[int] = None,
+                 stale_ok: bool = False,
+                 request_id: Optional[str] = None):
         self.app = app
         self.source = int(source)
         self.timeout_ms = timeout_ms
         #: read-your-writes bound: only workers whose applied mutation
         #: generation is >= this may answer (None = any replica)
         self.min_generation = min_generation
+        #: opt-in bounded-staleness degrade: when NO replica satisfies
+        #: min_generation, serve from the freshest one anyway and tag
+        #: the answer ``stale`` instead of raising StaleReadError — the
+        #: caller inspects ``generation`` (always carried) to see HOW
+        #: stale, which is the bound
+        self.stale_ok = bool(stale_ok)
+        #: True iff the answer's generation is below min_generation —
+        #: the explicit degrade tag the stale_ok contract promises
+        self.stale = False
+        self._degrade_counted = False  # one counter bump per query
+        #: idempotent client request id: ONE id across every retry /
+        #: re-dispatch of this logical query (reads are idempotent, so
+        #: replay is safe; the id keeps flight-recorder timelines and
+        #: retry counters attributable to one logical request)
+        self.request_id = request_id
         #: mutation generation the ANSWER reflects (None on a
         #: static-snapshot fleet) — always >= min_generation when set
+        #: unless ``stale`` is True
         self.generation: Optional[int] = None
         self.worker_id: Optional[str] = None  # who answered
         self.rounds = 0
         self.traversed = 0
         self.attempts = 0
+        #: attempts already spent by the retry ENVELOPE on earlier
+        #: futures of the same logical request — added to the wire
+        #: ``attempt`` number (so replicas can count envelope retries)
+        #: without consuming this future's own ring-walk retry budget
+        self.attempt_base = 0
         self.t_submit = time.monotonic()
         self.t_done: Optional[float] = None
         self._cb_lock = threading.Lock()
@@ -176,6 +213,27 @@ class _Pending:
         self.arr: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.event = threading.Event()
+        self.t0 = time.monotonic()  # the abandoned-pending sweep key
+
+
+_INCARNATION_LOCK = threading.Lock()
+_INCARNATION_SEQ = 0
+
+
+def _next_incarnation() -> str:
+    """Globally-unique controller incarnation tag (pid + a locked
+    counter + an os.urandom token): two controllers — same process
+    (tests), a restart (failover), a standby on ANOTHER host, or a
+    successor that landed on a reused pid — can never mint colliding
+    publish tokens.  pid+counter alone only holds within one process
+    lifetime; the random token carries the guarantee across hosts and
+    pid wraparound (a dead predecessor's staged cache must never
+    exact-match a successor's commit)."""
+    global _INCARNATION_SEQ
+    with _INCARNATION_LOCK:
+        _INCARNATION_SEQ += 1
+        return (f"c{os.getpid()}x{_INCARNATION_SEQ}"
+                f"-{os.urandom(6).hex()}")
 
 
 class _WorkerHandle:
@@ -217,7 +275,16 @@ class FleetController:
         # fleet-level counters (the controller's own observability row)
         self._counts = {"submitted": 0, "completed": 0, "shed": 0,
                         "rerouted": 0, "worker_deaths": 0,
-                        "republishes": 0, "errors": 0}
+                        "republishes": 0, "errors": 0, "retries": 0,
+                        "timeouts": 0, "stale_degraded": 0,
+                        "failovers": 0}
+        #: per-worker retry/timeout/stale attribution (prom labels)
+        self._per_worker: Dict[str, Dict[str, int]] = {}
+        #: this controller incarnation's publish-token prefix: a
+        #: PROMOTED controller restarts _seq at 0, and its tokens must
+        #: never collide with a dead predecessor's still staged on a
+        #: worker (the commit token check is exact-match)
+        self._incarnation = _next_incarnation()
 
     # ------------------------------------------------------------------
     # membership
@@ -236,19 +303,29 @@ class FleetController:
         is worse than answering slow)."""
         from lux_tpu import obs
 
-        conn = Conn.connect(host, port, timeout_s=timeout_s)
+        conn = Conn.connect(host, port, timeout_s=timeout_s,
+                            owner="controller")
         handle = _WorkerHandle("?", conn, {})
         handle.reader = threading.Thread(
             target=self._read_loop, args=(handle,),
             name="lux-fleet-ctl-read", daemon=True)
         handle.reader.start()
-        p = self._send(handle, {"op": "hello"}, _Pending("rpc"))
+        p = self._send(handle, {"op": "hello", **self._hello_info()},
+                       _Pending("rpc"))
         if not p.event.wait(timeout_s) or p.error or not p.reply:
             conn.close()
             raise FleetError(f"worker at {host}:{port} failed handshake: "
                              f"{p.error}")
+        if not p.reply.get("ok", True):
+            # the worker REFUSED (split-brain guard and friends):
+            # permanent — surfaced as its own type so reconnect/
+            # failover loops stop instead of backing off forever
+            conn.close()
+            raise WorkerRefusedError(str(p.reply.get("kind")),
+                                     str(p.reply.get("err")))
         info = p.reply
         wid = str(info["worker_id"])
+        conn.label(peer=wid)
         with self._lock:
             if self._closed:
                 conn.close()
@@ -273,6 +350,12 @@ class FleetController:
         self._ensure_heartbeat()
         return wid
 
+    def _hello_info(self) -> dict:
+        """Extra hello fields the worker validates us against (the live
+        controller sends its journal generation so the worker-side
+        split-brain guard can compare)."""
+        return {}
+
     def remove_worker(self, wid: str, shutdown: bool = True) -> None:
         """Graceful leave: take the worker off the ring (its keys move to
         ring successors), optionally ask it to drain and exit."""
@@ -286,6 +369,73 @@ class FleetController:
             except FleetError:
                 pass  # it may already be gone; the goal is absence
         self._retire(handle, cause="leave")
+
+    def takeover(self, endpoints: Sequence[Tuple[str, int]],
+                 deadline_s: float = 30.0, seed: int = 0) -> dict:
+        """Failover promotion (ISSUE 14): rebuild the ring on THIS
+        (fresh/standby) controller from worker re-hellos.  Per
+        endpoint, ``add_worker`` is retried with jittered exponential
+        backoff until ``deadline_s`` — a worker mid-GC or mid-batch
+        answers late, not never — EXCEPT a WorkerRefusedError
+        (split-brain guard), which is permanent and recorded, not
+        retried.  After the joins, every worker gets a ``discard``: a
+        dead predecessor's aborted republish must not leave staged
+        caches armed under tokens only it knew (this re-arms the
+        publish-token state from zero).
+
+        Returns {joined: [wid...], failed: {host:port: err}, refused:
+        {host:port: err}}.  Subclasses recover their own state BEFORE
+        calling this (the live controller replays its journal in
+        __init__ and re-syncs behind workers inside add_worker)."""
+        from lux_tpu import obs
+
+        joined: List[str] = []
+        failed: Dict[str, str] = {}
+        refused: Dict[str, str] = {}
+        with obs.span("fleet.takeover",
+                      endpoints=[f"{h}:{p}" for h, p in endpoints]):
+            for i, (host, port) in enumerate(endpoints):
+                bo = Backoff(seed=seed + i)
+                deadline = time.monotonic() + float(deadline_s)
+                while True:
+                    try:
+                        joined.append(self.add_worker(host, port,
+                                                      timeout_s=10.0))
+                        break
+                    except WorkerRefusedError as e:
+                        refused[f"{host}:{port}"] = str(e)
+                        break
+                    except (FleetError, OSError) as e:
+                        if time.monotonic() >= deadline:
+                            failed[f"{host}:{port}"] = str(e)
+                            break
+                        bo.sleep()
+            with self._lock:
+                handles = [h for h in self._workers.values() if h.alive]
+                self._counts["failovers"] += 1
+            self._discard_staged(handles)
+        obs.point("fleet.takeover.done", joined=joined,
+                  failed=sorted(failed), refused=sorted(refused))
+        return {"joined": joined, "failed": failed, "refused": refused}
+
+    def kill(self) -> None:
+        """Fault drill: the controller VANISHES — every worker
+        connection drops with no shutdown, no drain, no goodbye (the
+        peer-visible shape of a controller SIGKILL; workers keep
+        serving and wait to be re-helloed by a successor).  In-process
+        waiters differ from a real crash in one deliberate way: their
+        futures resolve with a 'controller closed' error instead of
+        dying with the process, so drill clients unblock and exercise
+        their retry envelopes."""
+        from lux_tpu import obs
+
+        obs.point("fleet.controller.kill")
+        self._hb_stop.set()
+        with self._lock:
+            self._closed = True
+            handles = list(self._workers.values())
+        for h in handles:
+            h.conn.close()
 
     def workers(self) -> Dict[str, dict]:
         with self._lock:
@@ -347,11 +497,19 @@ class FleetController:
                 f"{p.reply.get('kind')}: {p.reply.get('err')}")
         return p.reply
 
+    def _count_worker(self, wid: str, key: str, n: int = 1) -> None:
+        """Per-worker counter bump (prom label attribution); caller
+        must NOT hold self._lock."""
+        with self._lock:
+            d = self._per_worker.setdefault(
+                wid, {"retries": 0, "timeouts": 0, "stale_served": 0})
+            d[key] = d.get(key, 0) + n
+
     def _read_loop(self, handle: _WorkerHandle) -> None:
         while True:
             try:
                 msg, arr = handle.conn.recv()
-            except (ConnectionClosed, WireError):
+            except (ConnectionClosed, WireError, fault.InjectedKill):
                 break
             rid = msg.get("req_id")
             with self._lock:
@@ -418,6 +576,8 @@ class FleetController:
             if p.kind == "query":
                 with self._lock:
                     self._counts["rerouted"] += 1
+                    self._counts["retries"] += 1
+                self._count_worker(handle.wid, "retries")
                 self._dispatch(p.fut, exclude={handle.wid})
             else:
                 p.error = FleetError(f"worker {handle.wid} {cause}")
@@ -463,19 +623,109 @@ class FleetController:
 
     def submit(self, source: int, app: str = "sssp",
                timeout_ms: Optional[float] = None,
-               min_generation: Optional[int] = None) -> FleetFuture:
+               min_generation: Optional[int] = None,
+               stale_ok: bool = False,
+               request_id: Optional[str] = None,
+               attempt_offset: int = 0) -> FleetFuture:
         """Route + dispatch one query; returns a FleetFuture.  Raises
         FleetRejectedError synchronously when the whole fleet is
         saturated (admission backpressure), NoWorkersError when empty,
         StaleReadError when ``min_generation`` (the read-your-writes
         bound: only replicas that have applied that mutation generation
-        may answer) is ahead of every live replica."""
+        may answer) is ahead of every live replica — unless
+        ``stale_ok``, which DEGRADES that case instead: the freshest
+        live replica answers, and the future comes back with
+        ``stale=True`` plus the generation it actually served (the
+        explicit bounded-staleness tag)."""
         fut = FleetFuture(app, source, timeout_ms,
-                          min_generation=min_generation)
+                          min_generation=min_generation,
+                          stale_ok=stale_ok, request_id=request_id)
+        fut.attempt_base = int(attempt_offset)
         with self._lock:
             self._counts["submitted"] += 1
         self._dispatch(fut, exclude=set(), sync_raise=True)
         return fut
+
+    def submit_retrying(self, source: int, app: str = "sssp",
+                        deadline_s: float = 30.0,
+                        attempt_timeout_s: float = 5.0,
+                        timeout_ms: Optional[float] = None,
+                        min_generation: Optional[int] = None,
+                        stale_ok: bool = False,
+                        request_id: Optional[str] = None,
+                        backoff: Optional[Backoff] = None) -> FleetFuture:
+        """The hardened client envelope (ISSUE 14): submit + wait with
+        a CLIENT deadline, retrying fleet sheds (honoring their
+        ``retry_after_ms`` hint, jitter on top), staleness misses,
+        worker timeouts and transient fleet errors until ``deadline_s``
+        of wall time is spent — then the LAST error raises.
+
+        Retried: sheds, staleness misses, timeouts, and empty-fleet
+        windows (a failover in progress).  NOT retried: plain
+        FleetError — a worker-reported op error ("app not served",
+        an engine exception), retries-exhausted, or a closed
+        controller is the same answer every time, and burning the
+        whole client deadline re-asking would just delay it.
+
+        ``attempt_timeout_s`` bounds each TRY separately from the
+        overall deadline: a request frame lost on the wire (or a
+        worker that died holding it) resolves nothing, and waiting the
+        whole client deadline on one dead attempt would turn every
+        lost frame into a full-deadline stall — the classic
+        per-request-timeout vs end-to-end-deadline split.  One
+        ``request_id`` spans every attempt (minted from the submit
+        counter when not given), so retries stay one logical request
+        in the flight recorder and the retry counters; queries are
+        idempotent reads, so replay is safe.  Returns the RESOLVED
+        future (``result()`` cannot block or raise)."""
+        if request_id is None:
+            request_id = f"q{self._next_rid()[1:]}"
+        # jitter seeded per LOGICAL REQUEST (the unique request id), not
+        # per source: N clients retrying the same source must draw
+        # DIFFERENT delay sequences, or a fleet-wide shed wakes them in
+        # lockstep every round — the herd full jitter exists to prevent
+        bo = backoff if backoff is not None else Backoff(
+            seed=h64(f"{request_id}/{source}"))
+        deadline = time.monotonic() + float(deadline_s)
+        state = {"attempts": 0, "last": None}
+
+        def on_retry(exc, n):
+            state["attempts"] = n
+            state["last"] = exc
+            with self._lock:
+                self._counts["retries"] += 1
+
+        def attempt() -> FleetFuture:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # our deadline and retry_call's are computed from two
+                # monotonic() reads microseconds apart — at expiry,
+                # re-raise the LAST REAL error (the documented
+                # contract) rather than minting a synthetic timeout
+                # that would mask it; retry_call's own expired
+                # deadline then re-raises it unchanged
+                last = state["last"]
+                if last is not None:
+                    raise last
+                raise FleetTimeoutError(
+                    f"client deadline of {deadline_s}s spent "
+                    f"(request {request_id})")
+            fut = self.submit(source, app=app, timeout_ms=timeout_ms,
+                              min_generation=min_generation,
+                              stale_ok=stale_ok, request_id=request_id,
+                              attempt_offset=state["attempts"])
+            # raises the worker/fleet error; an unresolved future past
+            # the attempt timeout raises FleetTimeoutError -> retried
+            fut.result(timeout=min(remaining, float(attempt_timeout_s)))
+            return fut
+
+        out = retry_call(
+            attempt,
+            retry_on=(FleetRejectedError, StaleReadError,
+                      FleetTimeoutError, NoWorkersError),
+            deadline_s=deadline_s, backoff=bo, on_retry=on_retry)
+        out.attempts += state["attempts"]  # envelope attempts included
+        return out
 
     def _dispatch(self, fut: FleetFuture, exclude: Set[str],
                   sync_raise: bool = False) -> None:
@@ -486,9 +736,30 @@ class FleetController:
 
         exclude = set(exclude)
         while True:
+            degraded = False
             cands = self._candidates(fut.app, fut.source, exclude)
             fresh = cands if fut.min_generation is None else [
                 h for h in cands if h.delta_gen >= fut.min_generation]
+            if cands and not fresh and fut.stale_ok:
+                degraded = True
+                # bounded-staleness degrade (opt-in): no replica meets
+                # the bound, so the FRESHEST one answers and the future
+                # carries stale=True + the served generation — an
+                # explicitly tagged stale read instead of an error
+                fresh = sorted(cands, key=lambda h: -h.delta_gen)
+                if not fut._degrade_counted:
+                    # once per LOGICAL query: the ring walk can loop
+                    # (dead candidate) and re-dispatch can re-enter —
+                    # neither is a second degrade decision.  This event
+                    # records the DECISION; the stale_degraded COUNTER
+                    # bumps at resolve time from the answer's actual
+                    # tag (a replica that catches up mid-flight serves
+                    # fresh — the counter must not claim otherwise)
+                    fut._degrade_counted = True
+                    obs.point("fleet.stale_degrade", app=fut.app,
+                              source=fut.source,
+                              want=fut.min_generation,
+                              best=fresh[0].delta_gen)
             usable = [h for h in fresh if not h.saturated]
             if not usable:
                 if cands and not fresh:
@@ -515,9 +786,19 @@ class FleetController:
                     f"retries exhausted after {fut.attempts} attempts"))
                 return
             fut.attempts += 1
-            msg = {"op": "query", "app": fut.app, "source": fut.source}
+            msg = {"op": "query", "app": fut.app, "source": fut.source,
+                   "attempt": fut.attempt_base + fut.attempts}
             if fut.timeout_ms:
                 msg["timeout_ms"] = float(fut.timeout_ms)
+            if fut.request_id is not None:
+                msg["client_rid"] = str(fut.request_id)
+            if degraded:
+                # carry the read bound itself, not a pre-judged hint:
+                # the replica counts a stale SERVE from its answer's
+                # ACTUAL generation vs this bound, so a replica that
+                # catches up mid-flight serves fresh and counts nothing
+                # — per-worker and fleet-level stale counters agree
+                msg["stale_bound"] = int(fut.min_generation)
             try:
                 self._send(handle, msg, _Pending("query", fut))
                 return
@@ -536,6 +817,16 @@ class FleetController:
             fut.traversed = int(msg.get("traversed", 0))
             gen = msg.get("generation")
             fut.generation = None if gen is None else int(gen)
+            if (fut.min_generation is not None
+                    and fut.generation is not None
+                    and fut.generation < fut.min_generation):
+                # the stale_ok degrade actually happened: tag it and
+                # count it HERE, from the answer's real generation —
+                # the authoritative "stale reads served" number
+                fut.stale = True
+                with self._lock:
+                    self._counts["stale_degraded"] += 1
+                self._count_worker(handle.wid, "stale_served")
             with self._lock:
                 self._counts["completed"] += 1
             fut._resolve(result=arr)
@@ -548,11 +839,16 @@ class FleetController:
             with self._lock:
                 handle.saturated = True
                 self._counts["rerouted"] += 1
+                self._counts["retries"] += 1
+            self._count_worker(handle.wid, "retries")
             self._dispatch(fut, exclude={handle.wid})
             return
         with self._lock:
             self._counts["errors"] += 1
         if kind == "timeout":
+            with self._lock:
+                self._counts["timeouts"] += 1
+            self._count_worker(handle.wid, "timeouts")
             fut._resolve(error=FleetTimeoutError(str(msg.get("err"))))
         else:
             fut._resolve(error=FleetError(
@@ -570,6 +866,33 @@ class FleetController:
                 target=self._hb_loop, name="lux-fleet-ctl-hb", daemon=True)
             self._hb_thread.start()
 
+    #: a pending older than this is presumed unanswerable (a frame lost
+    #: on the wire never gets a reply; the envelope abandoned its future
+    #: long ago) — swept by the heartbeat loop so handle.pending cannot
+    #: grow for the lifetime of a connection under a lossy-wire fault
+    #: plan.  This is also a HARD CAP on unbounded queries: a swept
+    #: future resolves with FleetTimeoutError (first resolution wins),
+    #: so a genuine answer arriving later is dropped as a late reply.
+    #: Generous on purpose — an engine run that legitimately needs
+    #: longer than this should carry its own timeout_ms budget.
+    PENDING_SWEEP_S = 600.0
+
+    def _sweep_stale_pending(self, handle: _WorkerHandle,
+                             now: float) -> None:
+        with self._lock:
+            stale = [rid for rid, p in handle.pending.items()
+                     if now - p.t0 > self.PENDING_SWEEP_S]
+            dead = [handle.pending.pop(rid) for rid in stale]
+        for p in dead:
+            err = FleetTimeoutError(
+                f"request to worker {handle.wid} unanswered for "
+                f"{self.PENDING_SWEEP_S:g}s (frame lost?)")
+            if p.kind == "query":
+                p.fut._resolve(error=err)
+            else:
+                p.error = err
+                p.event.set()
+
     def _hb_loop(self) -> None:
         from lux_tpu import obs
 
@@ -577,6 +900,8 @@ class FleetController:
             with self._lock:
                 handles = [h for h in self._workers.values() if h.alive]
             now = time.monotonic()
+            for h in handles:
+                self._sweep_stale_pending(h, now)
             for h in handles:
                 with self._lock:
                     stale = now - h.last_seen > self.hb_timeout_s
@@ -640,8 +965,11 @@ class FleetController:
             raise NoWorkersError("republish with no live workers")
         # the publish token ties each worker's staged cache to THIS
         # republish: a stale prepare from an aborted earlier republish
-        # can neither re-stage after our discard nor be committed by us
-        token = f"pub-{self._next_rid()}"
+        # can neither re-stage after our discard nor be committed by us.
+        # The incarnation prefix keeps tokens unique across controller
+        # RESTARTS — a promoted controller's _seq starts over, and its
+        # commit must never match a dead predecessor's staged cache
+        token = f"pub-{self._incarnation}-{self._next_rid()}"
         with obs.span("fleet.republish", graph=gid, path=str(path),
                       token=token, workers=[h.wid for h in handles]):
             prep_msg = {"op": "prepare", "path": str(path),
@@ -738,7 +1066,7 @@ class FleetController:
         lines are emitted ONCE per metric name — the text format forbids
         repeating them, so a naive concatenation of per-worker dumps
         would not parse for any fleet wider than one worker."""
-        texts = []
+        texts = [self._own_prom_text()]
         with self._lock:
             handles = [h for h in self._workers.values() if h.alive]
         for h in handles:
@@ -768,6 +1096,56 @@ class FleetController:
             out.extend(meta[fam])
             out.extend(samples[fam])
         return "\n".join(out) + ("\n" if out else "")
+
+    def _own_prom_text(self) -> str:
+        """The controller's OWN exposition families (ISSUE 14):
+        fleet-level counters, per-worker retry/timeout/stale
+        attribution, and the installed fault plan's injection counts —
+        merged ahead of the worker scrapes by prom_dump."""
+        with self._lock:
+            counts = dict(self._counts)
+            per_worker = {w: dict(d) for w, d in self._per_worker.items()}
+        lines: List[str] = []
+        help_txt = {
+            "retries": "queries re-dispatched or envelope-retried",
+            "timeouts": "queries whose deadline expired fleet-wide",
+            "failovers": "controller takeover promotions",
+            "stale_degraded": "reads served under the bounded-staleness"
+                              " degrade",
+            "shed": "fleet-wide admission sheds",
+            "worker_deaths": "workers retired by death detection",
+        }
+        for key, help_text in help_txt.items():
+            name = f"lux_fleet_{key}_total"
+            lines.extend([f"# HELP {name} {help_text}",
+                          f"# TYPE {name} counter",
+                          f"{name} {counts.get(key, 0)}"])
+        wk_keys = (("retries", "lux_fleet_worker_retries_total",
+                    "retries attributed to this worker"),
+                   ("timeouts", "lux_fleet_worker_timeouts_total",
+                    "timeouts attributed to this worker"),
+                   ("stale_served", "lux_fleet_worker_stale_reads_total",
+                    "stale-degraded reads this worker served"))
+        for key, name, help_text in wk_keys:
+            rows = [(w, d.get(key, 0)) for w, d in
+                    sorted(per_worker.items()) if d.get(key, 0)]
+            if not rows:
+                continue
+            lines.extend([f"# HELP {name} {help_text}",
+                          f"# TYPE {name} counter"])
+            lines.extend(f'{name}{{worker="{w}"}} {n}' for w, n in rows)
+        plan = fault.active_plan()
+        if plan is not None and plan.total_fired():
+            name = "lux_fault_injected_total"
+            lines.extend([
+                f"# HELP {name} faults injected by the installed "
+                f"FaultPlan ({plan.name})",
+                f"# TYPE {name} counter"])
+            lines.extend(
+                f'{name}{{site="{r["site"]}",target="{r["target"]}",'
+                f'action="{r["action"]}"}} {r["count"]}'
+                for r in plan.counters())
+        return "\n".join(lines) + ("\n" if lines else "")
 
     def close(self, shutdown_workers: bool = False) -> None:
         self._hb_stop.set()

@@ -7,32 +7,60 @@ gRPC is not in the container, and pickle over a socket is an arbitrary-
 code-execution surface (luxcheck LUX-P001 bans it repo-wide).  A frame
 here is::
 
-    !II  header_len payload_len
+    !III  header_len payload_len payload_crc32
     header_len bytes   UTF-8 JSON object (the message)
     payload_len bytes  optional np.save() bytes (one ndarray)
 
 The npy container carries dtype/shape itself, so answers round-trip
 bitwise with no schema drift; ``allow_pickle=False`` on the way back in
-keeps the no-pickle policy airtight.  Every message is a JSON dict; the
+keeps the no-pickle policy airtight.  The crc32 (ISSUE 14) makes
+payload corruption DETECTABLE: a length prefix already fails loudly,
+but flipped bits inside an npy's data region used to parse as a valid
+— wrong — answer; now they are a WireError (both peers always run the
+same code, so the frame layout can evolve atomically).  Every message is a JSON dict; the
 conventional keys are ``op`` (requests), ``req_id`` (multiplexing), and
 ``ok``/``err`` (replies) — the framing layer does not interpret them.
 
 ``Conn`` wraps a connected socket with a send lock (many threads reply
 on one connection: the worker's responder + op handlers) and a recv that
 is only ever called from that connection's single reader thread.
+
+**Deadlines** (ISSUE 14): once a frame is IN FLIGHT — the first header
+byte arrived, or a send began — the rest must complete within
+``LUX_FLEET_TIMEOUT_S`` (default 60 s; 0 disables).  Idle waits between
+frames stay unbounded (a quiet peer is normal; a half-frame peer is
+hung).  Timeouts are selectors-based, never ``settimeout`` — the reader
+and the senders share one socket object, and a socket-level timeout set
+by one would race the other.  A deadline expiring raises
+:class:`WireTimeout` (a ``ConnectionClosed``: a peer that hangs
+mid-frame has desynchronized the stream, so the connection is done)
+naming the peer and the knob.
+
+**Fault injection** (ISSUE 14): every send and every received frame
+consults the process's installed :class:`lux_tpu.fault.FaultPlan` at
+sites ``wire.send`` / ``wire.recv`` with (owner, peer, op) context —
+drops, delays, truncated/partial writes, corrupt payloads, resets and
+kills are injected HERE, at the layer where real networks fail, so
+drills exercise the exact production frames.  No plan installed = one
+``None`` check per frame.
 """
 from __future__ import annotations
 
 import io
 import json
+import selectors
 import socket
 import struct
 import threading
+import time
+import zlib
 from typing import Optional, Tuple
 
 import numpy as np
 
-_HDR = struct.Struct("!II")
+from lux_tpu import fault as _fault
+
+_HDR = struct.Struct("!III")
 
 #: sanity bounds — a corrupt length prefix must fail loudly, not OOM the
 #: controller (64 MiB covers a (nv,) answer for any graph serve handles)
@@ -54,6 +82,17 @@ def max_frame_bytes() -> int:
                    minimum=1) * 1024 * 1024
 
 
+def frame_timeout_s() -> Optional[float]:
+    """Per-frame in-flight deadline: ``LUX_FLEET_TIMEOUT_S`` seconds
+    (default 60; 0 disables).  Resolved per call like max_frame_bytes
+    so both peers of a spawned-process fleet agree from one
+    environment."""
+    from lux_tpu.utils.config import env_float
+
+    t = env_float("LUX_FLEET_TIMEOUT_S", 60.0, minimum=0.0)
+    return None if not t else float(t)
+
+
 class WireError(RuntimeError):
     """Malformed frame (bad length prefix, oversized, bad JSON)."""
 
@@ -62,17 +101,91 @@ class ConnectionClosed(ConnectionError):
     """Peer closed the connection (EOF mid-frame or between frames)."""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+class WireTimeout(ConnectionClosed):
+    """A frame in flight did not complete within LUX_FLEET_TIMEOUT_S.
+    Subclasses ConnectionClosed: a peer hung mid-frame has
+    desynchronized the byte stream, so every handler that survives a
+    dropped peer (retire + re-dispatch) is the right handler here too
+    — previously this peer would have blocked its reader thread
+    forever."""
+
+    def __init__(self, direction: str, peer: str, waiting_bytes: int,
+                 timeout_s: float):
+        super().__init__(
+            f"{direction} to/from peer {peer!r} stalled mid-frame "
+            f"({waiting_bytes} bytes outstanding after {timeout_s:g}s) "
+            "— raise LUX_FLEET_TIMEOUT_S if this link is genuinely "
+            "that slow")
+        self.peer = peer
+        self.timeout_s = timeout_s
+
+
+def _wait_io(sock: socket.socket, direction: str, deadline: float,
+             peer: str, nbytes: int, timeout_s: float) -> None:
+    """Block until the socket is ready for ``direction`` or the
+    deadline passes (WireTimeout).  selectors (epoll/poll under the
+    hood), NOT select.select: fd-set select breaks on fds >= 1024
+    (FD_SETSIZE), and a big fleet's controller — many workers, engine
+    caches, journal files — crosses that line with perfectly healthy
+    sockets."""
+    rem = deadline - time.monotonic()
+    if rem <= 0:
+        raise WireTimeout(direction, peer, nbytes, timeout_s)
+    ev = (selectors.EVENT_READ if direction == "recv"
+          else selectors.EVENT_WRITE)
+    try:
+        with selectors.DefaultSelector() as sel:
+            sel.register(sock, ev)
+            ready = sel.select(rem)
+    except (OSError, ValueError) as e:  # fd closed under us
+        raise ConnectionClosed(f"{direction} to/from {peer}: {e}") \
+            from None
+    if not ready:
+        raise WireTimeout(direction, peer, nbytes, timeout_s)
+
+
+def _recv_exact(sock: socket.socket, n: int, peer: str = "peer",
+                timeout_s: Optional[float] = None,
+                idle_first: bool = False) -> bytes:
+    """Read exactly ``n`` bytes.  ``idle_first`` lets the FIRST byte
+    wait forever (the normal idle gap between frames); once any byte
+    of a frame arrived, the rest must land within ``timeout_s``."""
     buf = bytearray()
+    deadline: Optional[float] = (
+        None if timeout_s is None or idle_first
+        else time.monotonic() + timeout_s)
     while len(buf) < n:
+        if deadline is not None:
+            _wait_io(sock, "recv", deadline, peer, n - len(buf),
+                     timeout_s)
         try:
             chunk = sock.recv(n - len(buf))
         except OSError as e:
-            raise ConnectionClosed(f"recv failed: {e}") from None
+            raise ConnectionClosed(f"recv from {peer} failed: {e}") \
+                from None
         if not chunk:
-            raise ConnectionClosed("peer closed")
+            raise ConnectionClosed(f"peer {peer} closed")
         buf.extend(chunk)
+        if deadline is None and timeout_s is not None:
+            deadline = time.monotonic() + timeout_s  # frame in flight
     return bytes(buf)
+
+
+def _send_all(sock: socket.socket, data: bytes, peer: str,
+              timeout_s: Optional[float]) -> None:
+    view = memoryview(data)
+    deadline = (None if timeout_s is None
+                else time.monotonic() + timeout_s)
+    while view.nbytes:
+        if deadline is not None:
+            _wait_io(sock, "send", deadline, peer, view.nbytes,
+                     timeout_s)
+        try:
+            sent = sock.send(view)
+        except OSError as e:
+            raise ConnectionClosed(f"send to {peer} failed: {e}") \
+                from None
+        view = view[sent:]
 
 
 def pack_array(arr: np.ndarray) -> bytes:
@@ -86,19 +199,45 @@ def unpack_array(payload: bytes) -> np.ndarray:
 
 
 class Conn:
-    """One framed, thread-safe-for-send connection."""
+    """One framed, thread-safe-for-send connection.  ``peer`` names the
+    REMOTE end and ``owner`` the local one — purely observability +
+    fault-rule matching labels (errors name the peer; FaultRules match
+    both)."""
 
-    def __init__(self, sock: socket.socket):
+    #: class-level label defaults: a Conn built without __init__ (test
+    #: doubles) still labels errors and matches fault rules sanely
+    peer = "peer"
+    owner: Optional[str] = None
+
+    def __init__(self, sock: socket.socket, peer: str = "peer",
+                 owner: Optional[str] = None):
         self._sock = sock
         self._send_lock = threading.Lock()
         self._closed = False
+        self.peer = str(peer)
+        self.owner = owner
 
     @classmethod
-    def connect(cls, host: str, port: int, timeout_s: float = 10.0) -> "Conn":
+    def connect(cls, host: str, port: int, timeout_s: float = 10.0,
+                peer: Optional[str] = None,
+                owner: Optional[str] = None) -> "Conn":
         sock = socket.create_connection((host, port), timeout=timeout_s)
         sock.settimeout(None)  # blocking from here on; reader owns recv
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        return cls(sock)
+        return cls(sock, peer=peer if peer else f"{host}:{port}",
+                   owner=owner)
+
+    def label(self, peer: Optional[str] = None,
+              owner: Optional[str] = None) -> "Conn":
+        """Re-label after identity is learned (the controller knows a
+        worker's id only after its hello)."""
+        if peer is not None:
+            self.peer = str(peer)
+        if owner is not None:
+            self.owner = str(owner)
+        return self
+
+    # ------------------------------------------------------------------
 
     def send(self, msg: dict, arr: Optional[np.ndarray] = None) -> None:
         header = json.dumps(msg, separators=(",", ":")).encode("utf-8")
@@ -108,27 +247,108 @@ class Conn:
                 f"frame too large: header={len(header)} "
                 f"payload={len(payload)} (payload bound is "
                 "LUX_FLEET_MAX_FRAME_MB)")
-        frame = _HDR.pack(len(header), len(payload)) + header + payload
+        frame = (_HDR.pack(len(header), len(payload),
+                           zlib.crc32(payload)) + header + payload)
+        rule = _fault.fire("wire.send", owner=self.owner, peer=self.peer,
+                           op=msg.get("op"))
+        if rule is not None:
+            frame = self._faulted_send(rule, frame)
+            if frame is None:
+                return
         with self._send_lock:
-            try:
-                self._sock.sendall(frame)
-            except OSError as e:
-                raise ConnectionClosed(f"send failed: {e}") from None
+            _send_all(self._sock, frame, self.peer, frame_timeout_s())
+
+    def _faulted_send(self, rule, frame: bytes) -> Optional[bytes]:
+        """Apply a fired send-site rule; returns the (possibly altered)
+        frame to transmit, or None when nothing should be sent."""
+        act = rule.action
+        if act == "drop":
+            return None
+        if act == "kill":
+            raise _fault.InjectedKill("injected kill at wire.send")
+        if act == "delay":
+            if rule.delay_ms > 0:
+                time.sleep(rule.delay_ms / 1e3)
+            return frame
+        if act == "corrupt":
+            # flip bits near the end of the frame (payload when present,
+            # header otherwise) — the peer must detect, not crash
+            buf = bytearray(frame)
+            buf[-1] ^= 0xFF
+            buf[len(buf) // 2] ^= 0xFF
+            return bytes(buf)
+        if act in ("truncate", "partial"):
+            cut = min(_HDR.size + max(rule.trunc_bytes, 0),
+                      max(len(frame) - 1, 1))
+            with self._send_lock:
+                _send_all(self._sock, frame[:cut], self.peer,
+                          frame_timeout_s())
+            if act == "truncate":
+                self.close()  # peer sees EOF mid-frame
+            # "partial": stop mid-frame WITHOUT closing — the peer's
+            # LUX_FLEET_TIMEOUT_S deadline is what unsticks it
+            return None
+        if act == "reset":
+            self.close()
+            raise ConnectionClosed(
+                f"injected reset to peer {self.peer!r}")
+        return frame  # noop
 
     def recv(self) -> Tuple[dict, Optional[np.ndarray]]:
         """Next (message, array-or-None).  Single-reader only."""
-        hl, pl = _HDR.unpack(_recv_exact(self._sock, _HDR.size))
-        if hl > MAX_HEADER or pl > max_frame_bytes():
-            raise WireError(f"frame length out of bounds: {hl}/{pl} "
-                            "(payload bound is LUX_FLEET_MAX_FRAME_MB)")
-        try:
-            msg = json.loads(_recv_exact(self._sock, hl).decode("utf-8"))
-        except ValueError as e:
-            raise WireError(f"bad frame header JSON: {e}") from None
-        if not isinstance(msg, dict):
-            raise WireError(f"frame header is not an object: {type(msg)}")
-        arr = unpack_array(_recv_exact(self._sock, pl)) if pl else None
-        return msg, arr
+        timeout_s = frame_timeout_s()
+        while True:
+            hl, pl, crc = _HDR.unpack(_recv_exact(
+                self._sock, _HDR.size, peer=self.peer,
+                timeout_s=timeout_s, idle_first=True))
+            if hl > MAX_HEADER or pl > max_frame_bytes():
+                raise WireError(f"frame length out of bounds: {hl}/{pl} "
+                                "(payload bound is LUX_FLEET_MAX_FRAME_MB)")
+            try:
+                msg = json.loads(_recv_exact(
+                    self._sock, hl, peer=self.peer,
+                    timeout_s=timeout_s).decode("utf-8"))
+            except ValueError as e:
+                raise WireError(f"bad frame header JSON: {e}") from None
+            if not isinstance(msg, dict):
+                raise WireError(
+                    f"frame header is not an object: {type(msg)}")
+            payload = _recv_exact(self._sock, pl, peer=self.peer,
+                                  timeout_s=timeout_s) if pl else b""
+            rule = _fault.fire("wire.recv", owner=self.owner,
+                               peer=self.peer, op=msg.get("op"))
+            if rule is not None:
+                if rule.action == "drop":
+                    continue  # the frame never happened
+                if rule.action == "kill":
+                    raise _fault.InjectedKill(
+                        "injected kill at wire.recv")
+                if rule.action == "delay" and rule.delay_ms > 0:
+                    time.sleep(rule.delay_ms / 1e3)
+                if rule.action == "reset":
+                    self.close()
+                    raise ConnectionClosed(
+                        f"injected reset from peer {self.peer!r}")
+                if rule.action == "corrupt" and payload:
+                    buf = bytearray(payload)
+                    buf[-1] ^= 0xFF
+                    buf[len(buf) // 2] ^= 0xFF
+                    payload = bytes(buf)
+            if not payload:
+                return msg, None
+            if zlib.crc32(payload) != crc:
+                # flipped bits inside the npy DATA region would parse
+                # as a valid (wrong!) array — the crc is the only
+                # detector for silent payload corruption
+                raise WireError(
+                    f"corrupt payload from peer {self.peer!r}: crc "
+                    "mismatch")
+            try:
+                return msg, unpack_array(payload)
+            except ValueError as e:
+                raise WireError(
+                    f"corrupt npy payload from peer {self.peer!r}: {e}"
+                ) from None
 
     def close(self) -> None:
         self._closed = True

@@ -45,6 +45,7 @@ import socket
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from lux_tpu import fault
 from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
 from lux_tpu.serve.metrics import ServeMetrics
 from lux_tpu.serve.scheduler import (
@@ -166,16 +167,16 @@ class ReplicaWorker:
     def stop(self) -> None:
         """Graceful: drain schedulers, let the responder flush every
         resolved answer, then close."""
-        import time
+        from lux_tpu.utils.backoff import poll_until
 
         for sched in self._scheds.values():
             sched.stop(drain=True)
-        deadline = time.monotonic() + 2.0
-        while time.monotonic() < deadline:
+
+        def _flushed() -> bool:
             with self._resp_wake:
-                if not self._unanswered:
-                    break
-            time.sleep(0.01)
+                return not self._unanswered
+
+        poll_until(_flushed, timeout_s=2.0)
         with self._resp_wake:
             self._running = False
             self._resp_wake.notify_all()
@@ -198,6 +199,19 @@ class ReplicaWorker:
         self._close_sockets()
         for sched in self._scheds.values():
             sched.stop(drain=False)
+
+    def kill_at(self, point: str, count: int = 1,
+                after: int = 0) -> None:
+        """Arm a fault-plan kill of THIS worker at a named process
+        point (``lux_tpu.fault.ppoint`` sites, e.g.
+        ``"after_delta_before_marker"`` — the PR 12 drill's window,
+        aliased to ``journal.before_marker``).  Generalizes the
+        hand-placed monkeypatch drills: when the point fires inside one
+        of this worker's op threads, ``kill()`` drops the sockets first
+        (the peer-visible SIGKILL shape) and the op aborts with
+        InjectedKill — no ack, no reply, exactly a crash."""
+        fault.arm_kill(point, self.kill, owner_id=self.worker_id,
+                       count=count, after=after)
 
     def _close_sockets(self) -> None:
         if self._listener is not None:
@@ -222,7 +236,7 @@ class ReplicaWorker:
             except OSError:
                 break  # listener closed: stop()/kill()
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn = Conn(sock)
+            conn = Conn(sock, peer="controller", owner=self.worker_id)
             with self._lock:
                 self._conns.append(conn)
             # daemon + untracked: a standing replica accepts unboundedly
@@ -235,18 +249,25 @@ class ReplicaWorker:
                 daemon=True).start()
 
     def _conn_loop(self, conn: Conn) -> None:
-        while self._running:
-            try:
-                msg, arr = conn.recv()
-            except (ConnectionClosed, WireError):
-                break
-            try:
-                self._dispatch(conn, msg, arr)
-            except ConnectionClosed:
-                break
-            except Exception as e:  # noqa: BLE001 — a bad op must answer,
-                # not kill the connection serving every other request
-                self._reply_err(conn, msg, "error", err=repr(e))
+        with fault.owner(self.worker_id):
+            while self._running:
+                try:
+                    msg, arr = conn.recv()
+                except (ConnectionClosed, WireError):
+                    break
+                except fault.InjectedKill:
+                    break  # drill: the rule's callback (kill()) already
+                    # dropped every socket; this thread just ends
+                try:
+                    self._dispatch(conn, msg, arr)
+                except ConnectionClosed:
+                    break
+                except fault.InjectedKill:
+                    break  # as above — a killed worker answers nothing
+                except Exception as e:  # noqa: BLE001 — a bad op must
+                    # answer, not kill the connection serving every
+                    # other request
+                    self._reply_err(conn, msg, "error", err=repr(e))
         conn.close()
 
     def _reply_err(self, conn: Conn, msg: dict, kind: str, **extra) -> None:
@@ -256,10 +277,45 @@ class ReplicaWorker:
         except ConnectionClosed:
             pass
 
+    def _spawn_op(self, fn, args, name: str) -> None:
+        """One op on its own daemon thread, carrying this worker's
+        fault-owner identity (thread-locals do not cross threads — a
+        drill targeting w1's journal points must fire in w1's op
+        threads, not whoever shares the process)."""
+        def run():
+            with fault.owner(self.worker_id):
+                try:
+                    fn(*args)
+                except fault.InjectedKill:
+                    pass  # killed mid-op: sockets already dropped by
+                    # the rule's callback; a crashed worker says nothing
+        threading.Thread(target=run, name=name, daemon=True).start()
+
     def _dispatch(self, conn: Conn, msg: dict, arr=None) -> None:
         op = msg.get("op")
         rid = msg.get("req_id")
         if op == "hello":
+            ctl_gen = msg.get("journal_generation")
+            if (self._live is not None and ctl_gen is not None
+                    and self._live.generation() > int(ctl_gen)):
+                # SPLIT-BRAIN GUARD (ISSUE 14): this worker's local
+                # journal holds writes the hello'ing controller's
+                # journal does not.  Enrolling would let a stale /
+                # wiped controller re-sequence generations the fleet
+                # already acked — refuse from OUR side too (the
+                # controller-side add_worker check protects a good
+                # controller from a bad worker; this protects a good
+                # worker from a bad controller).
+                self._reply_err(
+                    conn, msg, "stale_controller",
+                    err=(f"worker {self.worker_id} is at journaled "
+                         f"generation {self._live.generation()}, ahead "
+                         f"of this controller's journal ({int(ctl_gen)})"
+                         " — refusing a controller behind my own "
+                         "journal; recover the controller from the "
+                         "authoritative journal dir"),
+                    journal_generation=self._live.generation())
+                return
             conn.send({"req_id": rid, "ok": True, **self.info()})
         elif op == "query":
             self._op_query(conn, msg)
@@ -274,10 +330,8 @@ class ReplicaWorker:
             fn = {"delta": self._op_delta, "refresh": self._op_refresh,
                   "read": self._op_read}[op]
             args = (conn, msg, arr) if op == "delta" else (conn, msg)
-            threading.Thread(
-                target=fn, args=args,
-                name=f"lux-fleet-{self.worker_id}-{op}",
-                daemon=True).start()
+            self._spawn_op(fn, args,
+                           name=f"lux-fleet-{self.worker_id}-{op}")
         elif op == "stats":
             conn.send({"req_id": rid, "ok": True, **self.heartbeat()})
         elif op == "prom":
@@ -286,10 +340,8 @@ class ReplicaWorker:
         elif op == "prepare":
             # daemon + untracked, like the conn threads: one per
             # republish, replies through the conn's send lock
-            threading.Thread(
-                target=self._op_prepare, args=(conn, msg),
-                name=f"lux-fleet-{self.worker_id}-prepare",
-                daemon=True).start()
+            self._spawn_op(self._op_prepare, (conn, msg),
+                           name=f"lux-fleet-{self.worker_id}-prepare")
         elif op == "commit":
             self._op_commit(conn, msg)
         elif op == "discard":
@@ -363,6 +415,13 @@ class ReplicaWorker:
     def _op_query(self, conn: Conn, msg: dict) -> None:
         rid = msg.get("req_id")
         app = msg.get("app", "sssp")
+        if int(msg.get("attempt", 1) or 1) > 1:
+            # a re-dispatched / envelope-retried query landing here —
+            # the per-replica retry counter the prom surface labels
+            self.metrics.record_retry()
+        # stale_bound rides to _answer: whether this degraded dispatch
+        # actually SERVED stale is decided by the answer's generation
+        stale_bound = msg.get("stale_bound")
         sched = self._scheds.get(app)
         if sched is None:
             self._reply_err(conn, msg, "error",
@@ -379,7 +438,7 @@ class ReplicaWorker:
             self._reply_err(conn, msg, "error", err=repr(e))
             return
         with self._resp_wake:
-            self._unanswered.append((conn, rid, fut))
+            self._unanswered.append((conn, rid, fut, stale_bound))
             self._resp_wake.notify_all()
 
     def _respond_loop(self) -> None:
@@ -396,21 +455,22 @@ class ReplicaWorker:
                     return
                 pending, self._unanswered = self._unanswered, []
             still: List[tuple] = []
-            for conn, rid, fut in pending:
+            for conn, rid, fut, bound in pending:
                 if not fut.done():
                     if self._running:
-                        still.append((conn, rid, fut))
+                        still.append((conn, rid, fut, bound))
                     else:  # shutting down: never leave a hung future
                         self._reply_err(conn, {"req_id": rid}, "error",
                                         err="worker stopping")
                     continue
-                self._answer(conn, rid, fut)
+                self._answer(conn, rid, fut, stale_bound=bound)
             if still:
                 with self._resp_wake:
                     self._unanswered.extend(still)
                 time.sleep(self.POLL_S)
 
-    def _answer(self, conn: Conn, rid, fut) -> None:
+    def _answer(self, conn: Conn, rid, fut,
+                stale_bound: Optional[int] = None) -> None:
         try:
             state = fut.result(timeout=0)
         except ServeTimeoutError as e:
@@ -427,6 +487,10 @@ class ReplicaWorker:
             # the mutation generation the answering batch served — the
             # read-your-writes tag (a lower bound on what it saw)
             reply["generation"] = int(fut.generation)
+            if stale_bound is not None and fut.generation < int(stale_bound):
+                # a stale_ok degrade that actually SERVED below its
+                # bound — counted from the answer, where it lands
+                self.metrics.record_stale_read()
         try:
             conn.send(reply, arr=state)
         except ConnectionClosed:
@@ -499,6 +563,10 @@ class ReplicaWorker:
             cache.set_overlay(int(gen), oarr, deg)
         obs.point("live.delta", worker=self.worker_id,
                   generation=int(gen), rows=int(arr.shape[0]))
+        # applied + journaled + overlay installed, ack not yet sent:
+        # a kill here is the "durable but silent" window the
+        # controller's gen_gap/rejoin machinery must absorb
+        fault.ppoint("worker.before_delta_ack", generation=int(gen))
         try:
             conn.send({"req_id": msg.get("req_id"), "ok": True,
                        "generation": int(gen)})

@@ -25,6 +25,9 @@ Pieces:
 * ``bench.py``     — thread-mode live fleet helper + the mixed
   read/write measurement behind bench.py's ``sssp_live_*`` row.
 """
-from lux_tpu.serve.live.controller import LiveFleetController  # noqa: F401
+from lux_tpu.serve.live.controller import (  # noqa: F401
+    LiveFleetController,
+    promote_live_controller,
+)
 from lux_tpu.serve.live.journal import LiveJournal  # noqa: F401
 from lux_tpu.serve.live.replica import GenerationGap, LiveReplica  # noqa: F401,E501

@@ -43,10 +43,11 @@ from lux_tpu.serve.fleet.controller import (
     FleetController,
     FleetError,
     NoWorkersError,
+    WorkerRefusedError,
     _Pending,
 )
 from lux_tpu.serve.fleet.wire import ConnectionClosed
-from lux_tpu.serve.live.journal import LiveJournal
+from lux_tpu.serve.live.journal import LiveJournal, read_live_meta
 
 
 class LiveFleetController(FleetController):
@@ -72,7 +73,18 @@ class LiveFleetController(FleetController):
         #: it) republishes through the serialized override below.
         self._write_lock = threading.RLock()
         self._live_counts = {"writes": 0, "write_rows": 0,
-                             "compactions": 0, "resyncs": 0}
+                             "compactions": 0, "resyncs": 0,
+                             "overflow_compactions": 0,
+                             "write_dedups": 0}
+
+    def _hello_info(self) -> dict:
+        """The live handshake extras: our journal generation, so the
+        worker-side split-brain guard can refuse a controller whose
+        journal is BEHIND the worker's own (a wiped/wrong-dir
+        controller must not re-sequence generations the fleet already
+        acked)."""
+        return {"live": True,
+                "journal_generation": self.journal.generation()}
 
     # ------------------------------------------------------------------
     # membership: live handshake + catch-up
@@ -89,9 +101,15 @@ class LiveFleetController(FleetController):
         with self._lock:
             handle = self._workers[wid]
         info = handle.info
+        # the three PERMANENT rejections raise WorkerRefusedError, not
+        # plain FleetError: takeover()'s per-endpoint retry loop treats
+        # FleetError as transient and would re-hello a worker that can
+        # never qualify for the full deadline — these cannot heal by
+        # retrying the same handshake
         if not info.get("live"):
             self.remove_worker(wid, shutdown=False)
-            raise FleetError(
+            raise WorkerRefusedError(
+                "static",
                 f"worker {wid} is not live (start it with --live / a "
                 "LiveReplica); a static replica would serve writes-blind "
                 "answers with no generation tag")
@@ -99,13 +117,15 @@ class LiveFleetController(FleetController):
         gen = self.journal.generation()
         if have > gen:
             self.remove_worker(wid, shutdown=False)
-            raise FleetError(
+            raise WorkerRefusedError(
+                "ahead_of_journal",
                 f"worker {wid} is at generation {have}, ahead of the "
                 f"journal ({gen}) — it belongs to a different write "
                 "history (wrong journal dir or wiped controller state)")
         if have < self.journal.base_generation:
             self.remove_worker(wid, shutdown=False)
-            raise FleetError(
+            raise WorkerRefusedError(
+                "pre_epoch",
                 f"worker {wid} is at generation {have}, before the "
                 f"current epoch base {self.journal.base_generation}: its "
                 "missing batches were compacted into the snapshot — "
@@ -156,34 +176,65 @@ class LiveFleetController(FleetController):
     # ------------------------------------------------------------------
 
     def admit_writes(self, src, dst, op, weight=None,
-                     timeout_s: Optional[float] = None) -> dict:
+                     timeout_s: Optional[float] = None,
+                     write_id: Optional[str] = None) -> dict:
         """Admit ONE edge-mutation batch: sequence it into the journal
         (durable before anything else sees it), replicate to every live
         worker, return the commit generation once all reachable
         replicas acknowledged.  An overflow anywhere escalates to a
         fleet-wide compaction (``snapshot_path`` required) before
         returning.  Raises like DeltaLog.apply on an invalid batch —
-        nothing journaled, nothing replicated, no generation burned."""
+        nothing journaled, nothing replicated, no generation burned.
+
+        ``write_id`` (ISSUE 14): idempotence key for the retry
+        envelope.  A client whose ack was lost (controller crash after
+        journaling) retries the SAME id against the promoted
+        controller and gets the already-committed generation back —
+        ``deduped: True``, nothing re-applied, nothing re-replicated
+        (the replicas were synced past it at re-hello)."""
         from lux_tpu import obs
 
         timeout_s = self.delta_timeout_s if timeout_s is None else timeout_s
         with self._write_lock:
+            if write_id is not None:
+                got = self.journal.lookup_write(write_id)
+                if got is not None:
+                    with self._lock:
+                        self._live_counts["write_dedups"] += 1
+                    obs.point("live.admit.dedup", write_id=str(write_id),
+                              generation=got)
+                    return {"generation": got,
+                            "acked": self.live_workers(),
+                            "compacted": False, "deduped": True}
             rows = int(np.size(np.atleast_1d(np.asarray(src))))
             with obs.span("live.admit", rows=rows) as sp:
-                gen = self.journal.admit(src, dst, op, weight)
+                gen = self.journal.admit(src, dst, op, weight,
+                                         write_id=write_id)
                 acked, overflow = self._replicate(gen, timeout_s)
                 compacted = False
                 if overflow:
-                    self._compact_fleet_locked()
+                    # SATELLITE (ISSUE 14): the overflow-escalated
+                    # compaction used to run inside the generic
+                    # republish spans only — invisible as an ESCALATION
+                    # in the flight recorder, so a chaos soak's latency
+                    # spike had nothing to attribute itself to.  Now:
+                    # its own span + counter, nested around the fold.
+                    with self._lock:
+                        self._live_counts["overflow_compactions"] += 1
+                    obs.point("live.overflow.escalated", generation=gen,
+                              rows=rows)
+                    with obs.span("live.overflow.compact",
+                                  generation=gen, rows=rows):
+                        self._compact_fleet_locked()
                     compacted = True
                     acked = self.live_workers()
                 with self._lock:
                     self._live_counts["writes"] += 1
                     self._live_counts["write_rows"] += rows
                 sp.set(generation=gen, acked=len(acked),
-                       compacted=compacted)
+                       compacted=compacted, deduped=False)
         return {"generation": gen, "acked": acked,
-                "compacted": compacted}
+                "compacted": compacted, "deduped": False}
 
     def _delta_rpc(self, handle, gen: int, arr: np.ndarray,
                    timeout_s: float) -> dict:
@@ -398,6 +449,49 @@ class LiveFleetController(FleetController):
         out["journal"] = self.journal.stats()
         out["worker_generations"] = self.worker_generations()
         return out
+
+
+def promote_live_controller(base: HostGraph, journal_dir: str,
+                            snapshot_path: Optional[str],
+                            endpoints, deadline_s: float = 30.0,
+                            seed: int = 0, **kw):
+    """Controller FAILOVER (ISSUE 14): build a fresh (restarted or
+    standby-promoted) LiveFleetController on the authoritative journal
+    dir and re-enroll the surviving workers.
+
+    Recovery is exactly the durable state: ``live_meta.json`` carries
+    the epoch base generation; when an epoch boundary passed (a
+    compaction), the CURRENT base is the snapshot at ``snapshot_path``,
+    not the original graph, so it is (re)loaded from there; the
+    DeltaLog replay then restores this epoch's committed batches — the
+    whole generation line, with the base-sha check refusing a journal
+    against the wrong snapshot.  ``takeover`` rebuilds the ring from
+    worker re-hellos with jittered backoff, re-arms the publish-token
+    state (discard), and — through the live ``add_worker`` — streams
+    catch-up batches to any replica the dead controller had not
+    finished replicating to.  Workers whose journals are AHEAD of ours
+    refuse us (split-brain guard) and are reported, not enrolled.
+
+    Returns ``(controller, takeover_report)``."""
+    from lux_tpu import obs
+    from lux_tpu.graph.format import read_lux
+
+    meta = read_live_meta(journal_dir)
+    if meta is not None and int(meta["base_generation"]) > 0:
+        if snapshot_path is None or not os.path.exists(snapshot_path):
+            raise FleetError(
+                f"journal {journal_dir} is on epoch base "
+                f"{meta['base_generation']} but no snapshot exists at "
+                f"{snapshot_path!r} — the epoch base graph is the "
+                "compacted snapshot, not the original")
+        base = read_lux(snapshot_path)
+    with obs.span("live.promote", journal=journal_dir,
+                  endpoints=[f"{h}:{p}" for h, p in endpoints]):
+        ctl = LiveFleetController(
+            base, journal_dir=journal_dir, snapshot_path=snapshot_path,
+            **kw)
+        rep = ctl.takeover(endpoints, deadline_s=deadline_s, seed=seed)
+    return ctl, rep
 
 
 def start_live_fleet(n_workers: int, g: HostGraph, parts: int = 2,

@@ -103,6 +103,14 @@ class LiveJournal:
         #: committed batches of THIS epoch, wire-packed, replication +
         #: catch-up order; index i commits generation base_generation+i+1
         self._batches: List[np.ndarray] = []
+        #: idempotent write-id -> commit generation (ISSUE 14): a client
+        #: retrying an admit whose ack was lost to a controller crash
+        #: gets the ALREADY-COMMITTED generation back instead of a
+        #: double apply.  Journaled write-ids ride inside the batch npz
+        #: (``wid`` key, ignored by replay), so a RESTARTED controller
+        #: rebuilds the current epoch's map; ids of compacted epochs
+        #: survive only in this process's memory — documented window.
+        self._write_ids: Dict[str, int] = {}
         if journal_dir is not None and self.log.batches_applied:
             self._reload_epoch_batches()
         if journal_dir is not None and meta is None:
@@ -115,16 +123,38 @@ class LiveJournal:
     def generation(self) -> int:
         return self.base_generation + self.log.batches_applied
 
-    def admit(self, src, dst, op, weight=None) -> int:
+    def admit(self, src, dst, op, weight=None,
+              write_id: Optional[str] = None) -> int:
         """Sequence ONE batch: resolve against the merged state, journal
         it durably (marker last), and return its COMMIT generation.
         Raises like DeltaLog.apply on an invalid batch — nothing is
-        journaled, no generation is burned."""
+        journaled, no generation is burned.
+
+        ``write_id``: idempotence key — a replayed admit with an
+        already-committed id returns that commit's generation WITHOUT
+        applying anything (the retry-after-lost-ack path; callers that
+        replicate must check ``generation()`` did not advance)."""
+        if write_id is not None:
+            got = self._write_ids.get(str(write_id))
+            if got is not None:
+                return got
         arr = pack_batch(src, dst, op, weight)
         s, d, o, w = unpack_batch(arr)
-        self.log.apply(s, d, o, w)
+        extra = None
+        if write_id is not None:
+            extra = {"wid": np.frombuffer(
+                str(write_id).encode("utf-8"), np.uint8)}
+        self.log.apply(s, d, o, w, journal_extra=extra)
         self._batches.append(arr)
-        return self.generation()
+        gen = self.generation()
+        if write_id is not None:
+            self._write_ids[str(write_id)] = gen
+        return gen
+
+    def lookup_write(self, write_id: str) -> Optional[int]:
+        """The commit generation of an already-admitted ``write_id``,
+        or None."""
+        return self._write_ids.get(str(write_id))
 
     # ------------------------------------------------------------------
     # replication / catch-up views
@@ -202,11 +232,17 @@ class LiveJournal:
                          allow_pickle=False) as z:
                 self._batches.append(
                     pack_batch(z["src"], z["dst"], z["op"], z["w"]))
+                if "wid" in z.files:  # idempotent write-id rides along
+                    wid = bytes(np.asarray(z["wid"],
+                                           np.uint8)).decode("utf-8")
+                    self._write_ids[wid] = (self.base_generation
+                                            + seq + 1)
 
     def stats(self) -> dict:
         return {
             "generation": self.generation(),
             "base_generation": self.base_generation,
             "epoch_batches": len(self._batches),
+            "write_ids": len(self._write_ids),
             **self.log.stats(),
         }

@@ -48,6 +48,8 @@ class ServeMetrics:
         self.timeouts = 0
         self.rejected = 0
         self.evictions = 0  # warm-cache engines dropped by the LRU bound
+        self.retries = 0  # re-dispatched / envelope-retried requests served
+        self.stale_reads = 0  # bounded-staleness degraded reads served
         self.traversed_edges = 0
         self._depth_max = 0
         self._depth_n = 0
@@ -79,6 +81,14 @@ class ServeMetrics:
         with self._lock:
             self.evictions += 1
 
+    def record_retry(self):
+        with self._lock:
+            self.retries += 1
+
+    def record_stale_read(self):
+        with self._lock:
+            self.stale_reads += 1
+
     def counters(self) -> dict:
         """Point-in-time copy of the monotonic counters — the worker
         heartbeat payload (readers must not reach for the private lock)."""
@@ -88,6 +98,8 @@ class ServeMetrics:
                 "timeouts": self.timeouts,
                 "rejected": self.rejected,
                 "evictions": self.evictions,
+                "retries": self.retries,
+                "stale_reads": self.stale_reads,
                 "batches": self._batch_count,
                 "traversed_edges": self.traversed_edges,
             }
@@ -107,6 +119,8 @@ class ServeMetrics:
                 "timeouts": self.timeouts,
                 "rejected": self.rejected,
                 "evictions": self.evictions,
+                "retries": self.retries,
+                "stale_reads": self.stale_reads,
                 "latency_ms": self.latency.summary_ms(),
                 "queue_wait_ms": self.queue_wait.summary_ms(),
                 "batches": self._batch_count,
@@ -196,6 +210,10 @@ class ServeMetrics:
                     "engine batches dispatched")
             counter("lux_serve_engine_evictions_total", self.evictions,
                     "warm-cache engines dropped by the LRU bound")
+            counter("lux_serve_retries_total", self.retries,
+                    "re-dispatched or envelope-retried requests served")
+            counter("lux_serve_stale_reads_total", self.stale_reads,
+                    "bounded-staleness degraded reads served")
             counter("lux_serve_traversed_edges_total", self.traversed_edges,
                     "edges traversed across all answered queries")
             if self._depth_n:  # same no-samples guard as summary()

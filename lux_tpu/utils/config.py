@@ -45,6 +45,30 @@ def env_int(name: str, default: Optional[int] = None, *,
     return val
 
 
+def env_float(name: str, default: Optional[float] = None, *,
+              minimum: Optional[float] = None,
+              maximum: Optional[float] = None) -> Optional[float]:
+    """``env_int``'s float twin — same boundary contract: unset/empty
+    reads as ``default``, garbage or out-of-bounds raises a ValueError
+    that NAMES the knob (LUX-P002 routes every ``float(os.environ...)``
+    cast through here, like the int casts through env_int)."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        val = float(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a number, got {raw!r}") from None
+    if val != val:  # NaN would defeat every min/max comparison below
+        raise ValueError(f"{name} must be a number, got NaN")
+    if minimum is not None and val < minimum:
+        raise ValueError(f"{name} must be >= {minimum}, got {val}")
+    if maximum is not None and val > maximum:
+        raise ValueError(f"{name} must be <= {maximum}, got {val}")
+    return val
+
+
 @dataclasses.dataclass
 class RunConfig:
     file: Optional[str] = None  # .lux path; None => synthetic RMAT

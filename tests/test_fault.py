@@ -1,0 +1,761 @@
+"""luxfault (ISSUE 14): deterministic fault injection, controller
+failover, and the retry/backoff-hardened serving envelope.
+
+Pins the acceptance surface: (a) faults are DATA — seeded FaultPlans
+fired at the wire layer and named process points, every historical
+ad-hoc drill (PR 8 worker kill mid-burst, PR 10 torn journal write,
+PR 12 kill between delta receipt and marker) re-expressed as a named
+plan and still passing; (b) a controller killed mid-write-load is
+replaced by a promoted controller that recovers the ring from
+re-hellos and the generation line from journal + live_meta with ZERO
+acked-write loss and bitwise-equal answers; (c) the client envelope:
+per-call wire deadlines naming peer + knob, jittered-backoff retries
+honoring retry_after_ms, idempotent write ids, and the opt-in
+bounded-staleness degrade with its explicit tag; (d) the chaos soak's
+fixed-seed tier-1 instance (20 seeds ride the slow tier).
+"""
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lux_tpu import fault
+from lux_tpu.fault import drills
+from lux_tpu.fault.chaos import chaos_soak
+from lux_tpu.fault.plan import FaultPlan, FaultPlanError, FaultRule
+from lux_tpu.graph import generate
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models.sssp import bfs_reference
+from lux_tpu.mutate.deltalog import DeltaLog
+from lux_tpu.serve.fleet import wire
+from lux_tpu.serve.fleet.controller import (
+    FleetController,
+    FleetError,
+    FleetRejectedError,
+    StaleReadError,
+    WorkerRefusedError,
+)
+from lux_tpu.serve.fleet.worker import ReplicaWorker
+from lux_tpu.serve.live.controller import (
+    LiveFleetController,
+    promote_live_controller,
+    start_live_fleet,
+)
+from lux_tpu.serve.live.journal import LiveJournal
+from lux_tpu.serve.live.replica import LiveReplica
+from lux_tpu.utils.backoff import Backoff, poll_until, retry_call
+from lux_tpu.utils.config import env_float
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    fault.uninstall()
+
+
+@pytest.fixture(scope="module")
+def small():
+    g = generate.rmat(8, 6, seed=9)
+    return g, build_pull_shards(g, 2)
+
+
+def _batches(g, n, rows=12, seed=1):
+    rng = np.random.default_rng(seed)
+    dele_pool = rng.permutation(g.ne)
+    out, lo = [], 0
+    for _ in range(n):
+        ndel = rows // 2
+        dele = dele_pool[lo:lo + ndel]
+        lo += ndel
+        src = np.concatenate([np.asarray(g.col_idx, np.int64)[dele],
+                              rng.integers(0, g.nv, rows - ndel)])
+        dst = np.concatenate([np.asarray(g.dst_of_edges(),
+                                         np.int64)[dele],
+                              rng.integers(0, g.nv, rows - ndel)])
+        op = np.concatenate([np.zeros(ndel, np.int8),
+                             np.ones(rows - ndel, np.int8)])
+        out.append((src, dst, op))
+    return out
+
+
+# ----------------------------------------------------------------------
+# FaultPlan mechanics
+# ----------------------------------------------------------------------
+
+
+def test_plan_json_roundtrip_and_validation():
+    p = drills.wire_chaos(seed=11)
+    p2 = FaultPlan.from_json(p.to_json())
+    assert [r.to_dict() for r in p2.rules] == [
+        r.to_dict() for r in p.rules]
+    assert p2.seed == 11
+    with pytest.raises(FaultPlanError, match="unknown site"):
+        FaultRule("nowhere", "drop")
+    with pytest.raises(FaultPlanError, match="not expressible"):
+        FaultRule("wire.send", "torn")  # torn is a journal action
+    with pytest.raises(FaultPlanError, match="unknown rule fields"):
+        FaultRule.from_dict({"site": "proc", "action": "kill",
+                             "typo_field": 1})
+    with pytest.raises(FaultPlanError, match="bad plan JSON"):
+        FaultPlan.from_json("not json")
+
+
+def test_plan_seeded_prob_is_deterministic():
+    def fires(seed):
+        p = FaultPlan([FaultRule("wire.send", "drop", prob=0.5)],
+                      seed=seed)
+        return [p.fire("wire.send", peer="x") is not None
+                for _ in range(32)]
+
+    assert fires(3) == fires(3)
+    assert fires(3) != fires(4)  # 1/2^32 false-failure odds
+
+
+def test_plan_after_count_and_alias_gating():
+    p = FaultPlan([FaultRule("proc", "kill",
+                             point="after_delta_before_marker",
+                             after=1, count=1)])
+    # the alias resolves to the placed point name
+    assert p.rules[0].point == "journal.before_marker"
+    fault.install(p)
+    assert fault.ppoint("journal.before_marker") is None  # after=1
+    with pytest.raises(fault.InjectedKill):
+        fault.ppoint("after_delta_before_marker")  # alias at call site
+    assert fault.ppoint("journal.before_marker") is None  # count spent
+    assert p.total_fired() == 1
+
+
+def test_plan_env_install(monkeypatch, tmp_path):
+    plan_json = FaultPlan([FaultRule("wire.recv", "delay",
+                                     delay_ms=1.0)], seed=5,
+                          name="envplan").to_json()
+    path = tmp_path / "plan.json"
+    path.write_text(plan_json)
+    monkeypatch.setenv("LUX_FAULT_PLAN", str(path))
+    monkeypatch.setattr(fault, "_ENV_CHECKED", False)
+    monkeypatch.setattr(fault, "_PLAN", None)
+    p = fault.active_plan()
+    assert p is not None and p.name == "envplan" and p.seed == 5
+    fault.uninstall()
+    # inline JSON form
+    monkeypatch.setenv("LUX_FAULT_PLAN", plan_json)
+    monkeypatch.setattr(fault, "_ENV_CHECKED", False)
+    assert fault.active_plan().name == "envplan"
+
+
+# ----------------------------------------------------------------------
+# backoff + env_float satellites
+# ----------------------------------------------------------------------
+
+
+def test_backoff_jitter_seeded_and_capped():
+    a = Backoff(base_ms=10, cap_ms=50, seed=7)
+    b = Backoff(base_ms=10, cap_ms=50, seed=7)
+    da = [a.next_s() for _ in range(8)]
+    assert da == [b.next_s() for _ in range(8)]  # seeded replay
+    assert all(0.0 <= d <= 0.05 for d in da)  # cap respected
+    assert Backoff(base_ms=10, cap_ms=50, seed=8).next_s() != da[0]
+    a.reset()
+    assert a.attempt == 0
+
+
+def test_backoff_env_knobs(monkeypatch):
+    monkeypatch.setenv("LUX_BACKOFF_BASE_MS", "100")
+    monkeypatch.setenv("LUX_BACKOFF_CAP_MS", "200")
+    bo = Backoff(seed=0)
+    assert bo.base_ms == 100.0 and bo.cap_ms == 200.0
+    monkeypatch.setenv("LUX_BACKOFF_BASE_MS", "garbage")
+    with pytest.raises(ValueError, match="LUX_BACKOFF_BASE_MS"):
+        Backoff(seed=0)
+
+
+def test_retry_call_honors_retry_after_and_deadline():
+    calls = []
+
+    def flaky():
+        calls.append(time.monotonic())
+        if len(calls) < 3:
+            raise FleetRejectedError(retry_after_ms=30.0)
+        return "ok"
+
+    t0 = time.monotonic()
+    assert retry_call(flaky, retry_on=(FleetRejectedError,),
+                      deadline_s=10.0,
+                      backoff=Backoff(base_ms=1, cap_ms=2, seed=0)) == "ok"
+    assert len(calls) == 3
+    # the two retries each slept >= the 30 ms hint (jitter only adds)
+    assert time.monotonic() - t0 >= 0.055
+
+    def always():
+        raise FleetRejectedError(retry_after_ms=5.0)
+
+    with pytest.raises(FleetRejectedError):  # LAST error re-raises
+        retry_call(always, retry_on=(FleetRejectedError,),
+                   deadline_s=0.15,
+                   backoff=Backoff(base_ms=1, cap_ms=5, seed=0))
+
+
+def test_poll_until_and_env_float(monkeypatch):
+    state = {"n": 0}
+
+    def pred():
+        state["n"] += 1
+        return state["n"] >= 3
+
+    assert poll_until(pred, timeout_s=5.0)
+    assert not poll_until(lambda: False, timeout_s=0.05)
+    monkeypatch.setenv("LUX_TEST_FLOAT", "2.5")
+    assert env_float("LUX_TEST_FLOAT") == 2.5
+    monkeypatch.setenv("LUX_TEST_FLOAT", "nope")
+    with pytest.raises(ValueError, match="LUX_TEST_FLOAT"):
+        env_float("LUX_TEST_FLOAT")
+    monkeypatch.setenv("LUX_TEST_FLOAT", "nan")
+    with pytest.raises(ValueError, match="LUX_TEST_FLOAT"):
+        env_float("LUX_TEST_FLOAT")
+    monkeypatch.setenv("LUX_TEST_FLOAT", "")
+    assert env_float("LUX_TEST_FLOAT", 1.5) == 1.5
+
+
+# ----------------------------------------------------------------------
+# wire faults + per-call deadlines
+# ----------------------------------------------------------------------
+
+
+def _sock_pair(owner_a="a", owner_b="b"):
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    out = {}
+
+    def acc():
+        s, _ = srv.accept()
+        out["conn"] = wire.Conn(s, peer=owner_a, owner=owner_b)
+
+    t = threading.Thread(target=acc)
+    t.start()
+    ca = wire.Conn.connect("127.0.0.1", srv.getsockname()[1],
+                           peer=owner_b, owner=owner_a)
+    t.join()
+    srv.close()
+    return ca, out["conn"]
+
+
+def test_wire_partial_write_hits_deadline_naming_peer_and_knob(
+        monkeypatch):
+    monkeypatch.setenv("LUX_FLEET_TIMEOUT_S", "0.25")
+    ca, cb = _sock_pair("client", "w0")
+    try:
+        fault.install(FaultPlan([FaultRule(
+            "wire.send", "partial", op="query", count=1,
+            trunc_bytes=4)]))
+        ca.send({"op": "query", "n": 1})  # only a prefix hits the wire
+        t0 = time.monotonic()
+        with pytest.raises(wire.WireTimeout) as ei:
+            cb.recv()
+        assert time.monotonic() - t0 < 5.0
+        assert "client" in str(ei.value)  # names the hung peer...
+        assert "LUX_FLEET_TIMEOUT_S" in str(ei.value)  # ...and the knob
+        assert isinstance(ei.value, wire.ConnectionClosed)
+    finally:
+        ca.close()
+        cb.close()
+
+
+def test_wire_drop_delay_corrupt_truncate_reset(monkeypatch):
+    monkeypatch.setenv("LUX_FLEET_TIMEOUT_S", "5")
+    ca, cb = _sock_pair("ctl", "w0")
+    try:
+        plan = fault.install(FaultPlan([
+            FaultRule("wire.send", "drop", op="dropme", count=1),
+            FaultRule("wire.recv", "delay", op="slow", delay_ms=30,
+                      count=1),
+            FaultRule("wire.send", "corrupt", op="garble", count=1),
+        ]))
+        ca.send({"op": "dropme"})
+        ca.send({"op": "slow", "n": 2})
+        t0 = time.monotonic()
+        msg, _ = cb.recv()  # the dropped frame never arrives
+        assert msg["n"] == 2 and time.monotonic() - t0 >= 0.025
+        ca.send({"op": "garble"}, arr=np.arange(64))
+        # flipped payload bits are caught by the frame crc — without
+        # it they parse as a valid, WRONG array
+        with pytest.raises(wire.WireError, match="crc"):
+            cb.recv()
+        assert plan.total_fired() == 3
+        # truncate: prefix + EOF mid-frame on a fresh pair
+        fault.install(FaultPlan([FaultRule(
+            "wire.send", "truncate", count=1, trunc_bytes=2)]))
+        cc, cd = _sock_pair("ctl", "w1")
+        cc.send({"op": "x"})
+        with pytest.raises(wire.ConnectionClosed):
+            cd.recv()
+        cc.close()
+        cd.close()
+        # reset: the sender's own socket drops before anything is sent
+        fault.install(FaultPlan([FaultRule(
+            "wire.send", "reset", count=1)]))
+        ce, cf = _sock_pair("ctl", "w2")
+        with pytest.raises(wire.ConnectionClosed, match="injected reset"):
+            ce.send({"op": "x"})
+        with pytest.raises(wire.ConnectionClosed):
+            cf.recv()
+        cf.close()
+    finally:
+        ca.close()
+        cb.close()
+
+
+# ----------------------------------------------------------------------
+# the re-expressed historical drills (named, seeded plans)
+# ----------------------------------------------------------------------
+
+
+def _mk_fleet(shards, n):
+    ctl = FleetController(hb_interval_s=0.1)
+    workers = [ReplicaWorker(shards, worker_id=f"w{i}", q_buckets=(1, 4),
+                             max_wait_ms=1.0).start() for i in range(n)]
+    for w in workers:
+        ctl.add_worker("127.0.0.1", w.port)
+    return ctl, workers
+
+
+def _teardown(ctl, workers):
+    ctl.close()
+    for w in workers:
+        if w._running:
+            w.stop()
+
+
+def test_drill_worker_kill_mid_burst_as_plan(small):
+    """PR 8's kill-mid-burst drill as a named, seeded FaultPlan: the
+    victim dies when its Nth query FRAME arrives (wire.recv site), the
+    controller re-dispatches to ring successors — every answer that
+    arrives is correct, and the injection shows in the prom surface."""
+    g, shards = small
+    ctl, workers = _mk_fleet(shards, 2)
+    try:
+        srcs = list(range(24))
+        import collections
+
+        victim = collections.Counter(
+            ctl.route(s) for s in srcs).most_common(1)[0][0]
+        w = next(x for x in workers if x.worker_id == victim)
+        plan = drills.worker_kill_mid_burst(victim, nth_query=3, seed=2)
+        plan.bind(f"kill:{victim}", w.kill)
+        fault.install(plan)
+        futs = [ctl.submit(s) for s in srcs]
+        got = 0
+        for s, f in zip(srcs, futs):
+            try:
+                a = f.result(timeout=60)
+            except FleetError:
+                continue  # degraded is allowed; wrong is not
+            got += 1
+            assert np.array_equal(a, bfs_reference(g, s)), s
+        assert got > 0
+        assert plan.total_fired() == 1
+        assert ctl.stats()["worker_deaths"] == 1
+        assert ctl.live_workers() == sorted(
+            x.worker_id for x in workers if x.worker_id != victim)
+        dump = ctl.prom_dump()
+        assert 'lux_fault_injected_total{site="wire.recv",' in dump
+        assert "lux_fleet_retries_total" in dump
+    finally:
+        _teardown(ctl, workers)
+
+
+def test_drill_kill_before_marker_as_plan(small, tmp_path):
+    """PR 12's kill-between-receipt-and-marker drill as a plan: the
+    batch npz lands, the injected crash fires before the marker, and
+    recovery replays the EXACT committed prefix then catches up to
+    bitwise-equal answers."""
+    g, sh = small
+    J = LiveJournal(g)
+    for s, d, o in _batches(g, 3):
+        J.admit(s, d, o)
+    wd = str(tmp_path / "w")
+    rep = LiveReplica(g, sh, cap=256, journal_dir=wd,
+                      standing=(("sssp", 0),))
+    rep.apply_batch(J.payload(1), 1)
+    fault.install(drills.kill_before_marker(seed=4))
+    with pytest.raises(fault.InjectedKill):
+        rep.apply_batch(J.payload(2), 2)
+    fault.uninstall()
+    rec = LiveReplica(g, sh, cap=256, journal_dir=wd,
+                      standing=(("sssp", 0),))
+    assert rec.generation() == 1 == rec.servable_generation()
+    for gen, arr in J.batches_since(rec.generation()):
+        rec.apply_batch(arr, gen)
+    assert rec.generation() == 3
+    rec.refresh()
+    assert np.array_equal(rec.standing("sssp")["state"],
+                          bfs_reference(J.log.merged_graph(), 0))
+
+
+def test_drill_torn_journal_write_as_plan(small, tmp_path):
+    """PR 10's torn-journal drill as a plan: the batch npz is HALF
+    written straight to its final name (no rename, no marker), then
+    the injected crash — replay must discard exactly that batch and
+    keep the committed prefix."""
+    g, _sh = small
+    jd = str(tmp_path / "j")
+    log = DeltaLog(g, journal_dir=jd)
+    b = _batches(g, 2)
+    log.apply(*b[0])
+    fault.install(drills.torn_journal_write(seed=3))
+    with pytest.raises(fault.InjectedKill):
+        log.apply(*b[1])
+    fault.uninstall()
+    # on disk: batch 1's npz exists but is torn and unmarked
+    assert os.path.exists(os.path.join(jd, "batch_00000001.npz"))
+    assert not os.path.exists(os.path.join(jd, "batch_00000001.ok"))
+    rec = DeltaLog(g, journal_dir=jd)  # replay
+    assert rec.batches_applied == 1
+    # the torn npz was removed so the sequence number is reusable
+    assert not os.path.exists(os.path.join(jd, "batch_00000001.npz"))
+    rec.apply(*b[1])  # the lost batch re-applies cleanly
+    assert rec.batches_applied == 2
+
+
+def test_worker_kill_at_named_point_live_fleet(small, tmp_path):
+    """The issue's API: ``worker.kill_at("after_delta_before_marker")``
+    — the worker dies inside the delta window (batch npz journaled, no
+    marker, no ack; journaled workers only — the window IS the journal
+    protocol's), the write path survives on the other replica, and the
+    victim recovers to its exact committed prefix on rejoin."""
+    g, sh = small
+    root = str(tmp_path / "fleet")
+    fleet = start_live_fleet(2, g, parts=2, cap=512,
+                             standing=(("sssp", 0),),
+                             journal_root=root)
+    ctl = fleet.controller
+    try:
+        b = _batches(g, 2)
+        ctl.admit_writes(*b[0])
+        victim = fleet.thread_workers[1]
+        victim.kill_at("after_delta_before_marker")
+        rep = ctl.admit_writes(*b[1])
+        # the killed replica cannot have acked; the survivor did
+        assert victim.worker_id not in rep["acked"]
+        assert rep["acked"], rep
+        assert ctl.generation() == 2
+        merged = ctl.journal.log.merged_graph()
+        f = ctl.submit(3, min_generation=2)
+        assert np.array_equal(f.result(timeout=60),
+                              bfs_reference(merged, 3))
+        plan = fault.active_plan()
+        assert plan is not None and plan.total_fired() == 1
+        fault.uninstall()
+        # the victim's journal holds EXACTLY the committed prefix
+        # (generation 1; the killed batch's marker never landed), and
+        # the rejoin catch-up brings it to parity
+        live2 = LiveReplica(g, sh, cap=512,
+                            journal_dir=os.path.join(
+                                root, victim.worker_id),
+                            standing=(("sssp", 0),))
+        assert live2.generation() == 1
+        w2 = ReplicaWorker(sh, worker_id=victim.worker_id,
+                           graph_id="live", q_buckets=(1, 4),
+                           live=live2).start()
+        fleet.thread_workers.append(w2)
+        ctl.add_worker("127.0.0.1", w2.port)
+        assert ctl.worker_generations()[victim.worker_id] == 2
+        f = ctl.submit(5, min_generation=2)
+        assert np.array_equal(f.result(timeout=60),
+                              bfs_reference(merged, 5))
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# the client envelope
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live_fleet(small):
+    """ONE shared in-memory live fleet for the read-side envelope
+    tests (they only advance generations monotonically)."""
+    g, _sh = small
+    fleet = start_live_fleet(2, g, parts=2, cap=1024,
+                             standing=(("sssp", 0),))
+    yield g, fleet
+    fleet.close()
+
+
+def test_submit_retrying_retries_sheds_with_hint(live_fleet):
+    g, fleet = live_fleet
+    ctl = fleet.controller
+    real, calls = ctl.submit, []
+
+    def flaky(*a, **kw):
+        calls.append(1)
+        if len(calls) < 3:
+            raise FleetRejectedError(retry_after_ms=5.0)
+        return real(*a, **kw)
+
+    before = ctl.stats()["retries"]
+    try:
+        ctl.submit = flaky
+        fut = ctl.submit_retrying(1, deadline_s=30.0,
+                                  backoff=Backoff(base_ms=1, cap_ms=4,
+                                                  seed=0))
+    finally:
+        ctl.submit = real
+    assert len(calls) == 3
+    assert np.array_equal(fut.result(timeout=0), bfs_reference(
+        fleet.controller.journal.log.merged_graph(), 1))
+    assert fut.request_id is not None
+    assert ctl.stats()["retries"] - before == 2
+
+    def hopeless(*a, **kw):
+        raise FleetRejectedError(retry_after_ms=2.0)
+
+    try:
+        ctl.submit = hopeless
+        with pytest.raises(FleetRejectedError):
+            ctl.submit_retrying(1, deadline_s=0.2,
+                                backoff=Backoff(base_ms=1, cap_ms=4,
+                                                seed=0))
+    finally:
+        ctl.submit = real
+
+
+def test_stale_pending_sweep_resolves_abandoned_futures():
+    """A frame lost on the wire (injected drop) leaves a _Pending no
+    reply will ever pop; the heartbeat sweep must bound that leak by
+    resolving + dropping pendings past the horizon."""
+    from lux_tpu.serve.fleet.controller import (
+        FleetFuture,
+        FleetTimeoutError,
+        _Pending,
+        _WorkerHandle,
+    )
+
+    class _C:
+        def close(self):
+            pass
+
+    ctl = FleetController()
+    try:
+        h = _WorkerHandle("wx", _C(), {})
+        fut = FleetFuture("sssp", 0, None)
+        p = _Pending("query", fut)
+        rpc = _Pending("rpc")
+        h.pending["r1"], h.pending["r2"] = p, rpc
+        ctl._sweep_stale_pending(h, p.t0 + 1.0)  # too young: kept
+        assert len(h.pending) == 2
+        ctl._sweep_stale_pending(h, p.t0 + ctl.PENDING_SWEEP_S + 1.0)
+        assert not h.pending
+        with pytest.raises(FleetTimeoutError, match="unanswered"):
+            fut.result(timeout=0)
+        assert rpc.event.is_set() and rpc.error is not None
+    finally:
+        ctl.close()
+
+
+def test_stale_degrade_tags_instead_of_error(live_fleet):
+    g, fleet = live_fleet
+    ctl = fleet.controller
+    b = _batches(g, 1, seed=21)[0]
+    gen = ctl.admit_writes(*b)["generation"]
+    ahead = ctl.generation() + 5  # a bound no replica can meet
+    with pytest.raises(StaleReadError):
+        ctl.submit(2, min_generation=ahead)
+    fut = ctl.submit_retrying(2, deadline_s=60.0, min_generation=ahead,
+                              stale_ok=True)
+    ans = fut.result(timeout=0)
+    assert fut.stale is True  # the explicit degrade tag
+    assert fut.generation is not None and fut.generation < ahead
+    assert fut.generation >= gen
+    # a stale answer is a CORRECT answer for the generation it names
+    assert np.array_equal(
+        ans, bfs_reference(ctl.journal.log.merged_graph(), 2))
+    st = ctl.stats()
+    assert st["stale_degraded"] >= 1
+    dump = ctl.prom_dump()
+    assert "lux_fleet_stale_degraded_total" in dump
+    assert "lux_fleet_worker_stale_reads_total" in dump
+    # the serving replica counted it too (per-replica label)
+    assert 'lux_serve_stale_reads_total{replica="' in dump
+    # a bounded read that CAN be satisfied is not tagged stale
+    f2 = ctl.submit_retrying(2, deadline_s=60.0, min_generation=gen)
+    f2.result(timeout=0)
+    assert f2.stale is False and f2.generation >= gen
+
+
+def test_write_id_idempotence(live_fleet, tmp_path):
+    g, fleet = live_fleet
+    ctl = fleet.controller
+    b = _batches(g, 1, seed=33)[0]
+    r1 = ctl.admit_writes(*b, write_id="wid-1")
+    r2 = ctl.admit_writes(*b, write_id="wid-1")  # the lost-ack replay
+    assert r1["generation"] == r2["generation"]
+    assert not r1["deduped"] and r2["deduped"]
+    assert ctl.generation() == r1["generation"]  # nothing re-applied
+    assert ctl.stats()["write_dedups"] == 1
+    # journaled write-ids survive a controller restart (same dir)
+    jd = str(tmp_path / "j")
+    J = LiveJournal(g, journal_dir=jd)
+    s, d, o = _batches(g, 1, seed=34)[0]
+    gen = J.admit(s, d, o, write_id="w-persist")
+    J2 = LiveJournal(g, journal_dir=jd)
+    assert J2.lookup_write("w-persist") == gen
+    assert J2.admit(s, d, o, write_id="w-persist") == gen  # no re-apply
+    assert J2.generation() == gen
+
+
+# ----------------------------------------------------------------------
+# controller failover (the tentpole acceptance drill)
+# ----------------------------------------------------------------------
+
+
+def test_controller_kill_mid_write_load_failover(small, tmp_path):
+    """Kill the controller mid-write-load; the promoted controller
+    recovers the ring from worker re-hellos and the generation line
+    from journal + live_meta, loses ZERO acked writes, and answers
+    bitwise-equal to the merged reference after promotion."""
+    g, _sh = small
+    root = str(tmp_path / "fleet")
+    snap = os.path.join(root, "snap.lux")
+    fleet = start_live_fleet(2, g, parts=2, cap=1024,
+                             standing=(("sssp", 0),),
+                             journal_root=root, snapshot_path=snap)
+    ctl = fleet.controller
+    sent = []  # (write_id, batch) in admit order
+    acked = {}  # write_id -> generation
+    stop = threading.Event()
+    kill_after = 3
+
+    def writer():
+        rng = np.random.default_rng(5)
+        mirror = DeltaLog(g)
+        i = 0
+        while not stop.is_set() and i < 64:
+            from lux_tpu.serve.live.bench import churn_batch
+
+            s, d, o = churn_batch(mirror, rng, 8)
+            wid = f"fo-{i}"
+            sent.append((wid, (s, d, o)))
+            try:
+                rep = fleet.controller.admit_writes(
+                    s, d, o, write_id=wid)
+            except Exception:  # noqa: BLE001 — the kill window
+                sent.pop()
+                break
+            mirror.apply(s, d, o)
+            acked[wid] = rep["generation"]
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    while len(acked) < kill_after:  # let real write load build up
+        time.sleep(0.01)
+    ctl.kill()  # the controller process "dies": no drain, no goodbye
+    t.join(timeout=60)
+    stop.set()
+    assert len(acked) >= kill_after
+    last_acked = max(acked.values())
+    # ---- promote a successor on the authoritative journal dir -------
+    endpoints = [("127.0.0.1", w.port) for w in fleet.thread_workers]
+    ctl2, rep = promote_live_controller(
+        g, os.path.join(root, "controller"), snap, endpoints, seed=1)
+    fleet.controller = ctl2  # so close() tears the right one down
+    try:
+        assert sorted(rep["joined"]) == ["w0", "w1"]
+        assert not rep["refused"] and not rep["failed"]
+        # zero acked-write loss: the generation line covers every ack,
+        # and each acked write's journaled payload matches what was sent
+        assert ctl2.generation() >= last_acked
+        by_wid = dict(sent)
+        for wid, gen in acked.items():
+            s, d, o = by_wid[wid]
+            arr = ctl2.journal.payload(gen)
+            assert np.array_equal(arr[:, 0], np.asarray(s, np.int64)), wid
+            assert np.array_equal(arr[:, 1], np.asarray(d, np.int64)), wid
+            assert np.array_equal(arr[:, 2], np.asarray(o, np.int64)), wid
+            # the retry envelope's idempotent replay finds them too
+            assert ctl2.journal.lookup_write(wid) == gen
+        assert ctl2.stats()["failovers"] == 1
+        # workers were re-synced to the full journal at re-hello
+        assert set(ctl2.worker_generations().values()) == {
+            ctl2.generation()}
+        # bitwise-equal answers after promotion
+        merged = ctl2.journal.log.merged_graph()
+        for src in (0, 3, 11):
+            f = ctl2.submit_retrying(src, deadline_s=60.0,
+                                     min_generation=last_acked)
+            assert np.array_equal(f.result(timeout=0),
+                                  bfs_reference(merged, src)), src
+        ctl2.refresh_fleet()
+        for wid, ent in ctl2.read_standing_all("sssp").items():
+            assert ent["generation"] >= last_acked, wid
+            assert np.array_equal(ent["state"],
+                                  bfs_reference(merged, 0)), wid
+    finally:
+        fleet.close()
+
+
+def test_worker_refuses_controller_behind_its_journal(small, tmp_path):
+    """Split-brain guard: a worker whose journal holds generations a
+    hello'ing controller's journal does not must refuse the hello —
+    a wiped/wrong-dir controller cannot re-sequence acked history."""
+    g, _sh = small
+    root = str(tmp_path / "fleet")
+    fleet = start_live_fleet(1, g, parts=2, cap=512,
+                             standing=(("sssp", 0),),
+                             journal_root=root)
+    try:
+        ctl = fleet.controller
+        for b in _batches(g, 2):
+            ctl.admit_writes(*b)
+        ctl.kill()
+        # the promoted impostor lost the journal: a FRESH dir at gen 0
+        ctl2 = LiveFleetController(
+            g, journal_dir=str(tmp_path / "wiped"))
+        w = fleet.thread_workers[0]
+        with pytest.raises(WorkerRefusedError,
+                           match="behind my own journal"):
+            ctl2.add_worker("127.0.0.1", w.port)
+        # takeover records the refusal instead of retrying forever
+        rep = ctl2.takeover([("127.0.0.1", w.port)], deadline_s=5.0)
+        assert rep["joined"] == [] and len(rep["refused"]) == 1
+        assert "behind my own journal" in next(iter(
+            rep["refused"].values()))
+        ctl2.close()
+        # the REAL successor (authoritative journal dir) is accepted
+        ctl3, rep3 = promote_live_controller(
+            g, os.path.join(root, "controller"), None,
+            [("127.0.0.1", w.port)])
+        fleet.controller = ctl3
+        assert rep3["joined"] == [w.worker_id]
+        assert ctl3.generation() == 2
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# chaos soak
+# ----------------------------------------------------------------------
+
+
+def test_chaos_soak_fixed_seed():
+    """The tier-1 chaos instance: one fixed seed, wire faults + worker
+    kill/rejoin + bounded and stale reads, every standing invariant
+    asserted (failures print seed + plan — the reproduction)."""
+    rep = chaos_soak(seed=0, steps=10)
+    assert rep["generation"] >= 1 and rep["writes"] >= 1
+    assert rep["reads"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(1, 21))
+def test_chaos_soak_many_seeds(seed):
+    """The acceptance sweep: >= 20 distinct seeds in the slow tier
+    (every third seed also kills + promotes the controller)."""
+    rep = chaos_soak(seed=seed, steps=14,
+                     controller_kill=(seed % 3 == 0))
+    assert rep["generation"] >= 1

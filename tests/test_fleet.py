@@ -8,6 +8,7 @@ import collections
 import json
 import os
 import socket
+import struct
 import subprocess
 import sys
 import threading
@@ -160,11 +161,35 @@ def test_wire_rejects_oversized_and_bad_frames():
     ca, cb = Conn(a), Conn(b)
     with pytest.raises(WireError):
         ca.send({"x": "y" * (20 * 1024 * 1024)})
-    # a corrupt length prefix fails loudly on the reader
-    a.sendall(b"\xff\xff\xff\xff\xff\xff\xff\xff")
+    # a corrupt length prefix fails loudly on the reader (12 bytes:
+    # header_len / payload_len / payload_crc32)
+    a.sendall(b"\xff" * 12)
     with pytest.raises(WireError):
         cb.recv()
     ca.close(), cb.close()
+    # flipped bits inside the npy DATA region are caught by the crc —
+    # they would otherwise parse as a valid, WRONG array
+    a, b = socket.socketpair()
+    ca, cb = Conn(a), Conn(b)
+    ca.send({"op": "ans"}, arr=np.arange(64))
+    hdr = _recv12(b)
+    body = bytearray()
+    while len(body) < hdr[0] + hdr[1]:
+        body.extend(b.recv(65536))
+    body[hdr[0] + hdr[1] // 2] ^= 0xFF  # corrupt mid-payload
+    b2a, b2b = socket.socketpair()
+    c2 = Conn(b2b)
+    b2a.sendall(struct.pack("!III", *hdr) + bytes(body))
+    with pytest.raises(WireError, match="crc"):
+        c2.recv()
+    ca.close(), cb.close(), c2.close(), b2a.close()
+
+
+def _recv12(sock):
+    buf = b""
+    while len(buf) < 12:
+        buf += sock.recv(12 - len(buf))
+    return struct.unpack("!III", buf)
 
 
 # ----------------------------------------------------------------------

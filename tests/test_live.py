@@ -206,9 +206,12 @@ def test_wire_max_frame_env_knob(monkeypatch):
     assert wire.max_frame_bytes() == wire.MAX_PAYLOAD
     monkeypatch.setenv("LUX_FLEET_MAX_FRAME_MB", "1")
     assert wire.max_frame_bytes() == 1024 * 1024
+    # 0 disables the in-flight deadline (no select on the fake socket)
+    monkeypatch.setenv("LUX_FLEET_TIMEOUT_S", "0")
+    assert wire.frame_timeout_s() is None
 
     class _Sock:
-        def sendall(self, b):
+        def send(self, b):
             raise AssertionError("oversized frame must not hit the wire")
 
     conn = wire.Conn.__new__(wire.Conn)
@@ -224,8 +227,10 @@ def test_wire_max_frame_env_knob(monkeypatch):
     sent = []
 
     class _Sock2:
-        def sendall(self, b):
+        def send(self, b):
+            # the chunked sender consumes the memoryview via send()
             sent.append(len(b))
+            return len(b)
 
     conn2._sock = _Sock2()
     conn2._send_lock = threading.Lock()
@@ -515,8 +520,14 @@ def test_live_fleet_mid_replication_kill_and_rejoin(
 
 
 def test_overflow_escalates_to_fleet_compaction(small, tmp_path):
+    from lux_tpu import obs
+    from lux_tpu.obs.recorder import Recorder
+
     g, _sh = small
     snap = str(tmp_path / "snap.lux")
+    rec = Recorder(run_id="tovf", root=str(tmp_path / "obs"),
+                   enabled=True)
+    old_rec = obs.install(rec)
     fleet = start_live_fleet(2, g, parts=2, cap=128,
                              snapshot_path=snap,
                              journal_root=str(tmp_path / "j"),
@@ -535,6 +546,21 @@ def test_overflow_escalates_to_fleet_compaction(small, tmp_path):
         gen = rep["generation"]
         assert ctl.journal.base_generation == gen
         assert os.path.exists(snap)
+        # ISSUE 14 satellite: the overflow-triggered compaction is no
+        # longer silent in the flight recorder — its own counter, an
+        # escalation point event, and a span a chaos post-mortem can
+        # attribute the latency spike to
+        assert ctl.stats()["overflow_compactions"] == 1
+        import json as _json
+
+        evs = []
+        for fn in sorted(os.listdir(rec.run_dir())):
+            if fn.startswith("events-") and fn.endswith(".jsonl"):
+                with open(os.path.join(rec.run_dir(), fn)) as fh:
+                    evs += [_json.loads(ln) for ln in fh if ln.strip()]
+        names = [e.get("n") for e in evs]
+        assert "live.overflow.escalated" in names  # the point event
+        assert "live.overflow.compact" in names  # the span
         # post-compaction: the whole fleet serves the new epoch, the
         # write that triggered the escalation included
         merged = ctl.journal.log.merged_graph()
@@ -554,6 +580,7 @@ def test_overflow_escalates_to_fleet_compaction(small, tmp_path):
             np.zeros(4, np.int8))
         assert rep2["generation"] == gen + 1
     finally:
+        obs.install(old_rec)
         _close(fleet)
 
 

@@ -259,6 +259,72 @@ finally:
     fleet.close()
 "
 
+# 3d1) fault smoke (ISSUE 14): a thread-mode live fleet under ONE
+#      wire-fault plan (delayed + dropped query frames absorbed by the
+#      retry envelope) and ONE controller kill + promotion — zero
+#      acked-write loss, bitwise answers after failover, [PASS]-gated
+stage fault_smoke 600 bash -c '
+set -e
+out=$(JAX_PLATFORMS=cpu python -c "
+import numpy as np, os, tempfile
+from lux_tpu import fault
+from lux_tpu.fault.drills import wire_chaos
+from lux_tpu.fault.plan import FaultPlan, FaultRule
+from lux_tpu.graph import generate
+from lux_tpu.models.sssp import bfs_reference
+from lux_tpu.serve.live.bench import churn_batch
+from lux_tpu.serve.live.controller import (
+    promote_live_controller, start_live_fleet)
+root = tempfile.mkdtemp(prefix=\"lux_fault_smoke_\")
+g = generate.rmat(8, 4, seed=4)
+fleet = start_live_fleet(2, g, parts=2, cap=512, buckets=(1, 4),
+                         standing=((\"sssp\", 0),), journal_root=root)
+ctl = fleet.controller
+try:
+    # wire-fault plan: every query frame delayed, first one dropped
+    fault.install(FaultPlan([
+        FaultRule(\"wire.send\", \"drop\", op=\"query\", count=1,
+                  owner=\"controller\"),
+        FaultRule(\"wire.recv\", \"delay\", op=\"query\", delay_ms=2.0),
+    ], name=\"smoke\"))
+    rng = np.random.default_rng(0)
+    acked = 0
+    for i in range(3):
+        s, d, o = churn_batch(ctl.journal.log, rng, 16)
+        acked = ctl.admit_writes(s, d, o,
+                                 write_id=f\"smoke-{i}\")[\"generation\"]
+    for s in (0, 3, 7):
+        f = ctl.submit_retrying(s, deadline_s=60, attempt_timeout_s=5,
+                                min_generation=acked)
+        assert np.array_equal(f.result(timeout=0), bfs_reference(
+            ctl.journal.log.merged_graph(), s)), s
+    plan = fault.active_plan()
+    assert plan.total_fired() > 0, \"no fault actually injected\"
+    fault.uninstall()
+    # controller-restart plan: kill + promote on the journal dir
+    ctl.kill()
+    eps = [(\"127.0.0.1\", w.port) for w in fleet.thread_workers]
+    ctl2, rep = promote_live_controller(
+        g, os.path.join(root, \"controller\"), None, eps)
+    fleet.controller = ctl2
+    assert sorted(rep[\"joined\"]) == [\"w0\", \"w1\"], rep
+    assert ctl2.generation() == acked
+    assert ctl2.journal.lookup_write(\"smoke-0\") == 1
+    merged = ctl2.journal.log.merged_graph()
+    for s in (0, 3, 7):
+        f = ctl2.submit_retrying(s, deadline_s=60,
+                                 min_generation=acked)
+        assert np.array_equal(f.result(timeout=0),
+                              bfs_reference(merged, s)), s
+    print(\"[PASS] fault smoke: gen\", acked, \"failovers\",
+          ctl2.stats()[\"failovers\"])
+finally:
+    fleet.close()
+")
+echo "$out" | grep -q "\[PASS\] fault smoke" || { echo "fault smoke failed"; exit 1; }
+echo "$out"
+'
+
 # 3e) program smoke (ISSUE 13): one spec-only workload end-to-end
 #     through the GENERIC driver on a tiny graph — the declarative
 #     compiler's whole path (spec -> program -> engine -> [PASS] check)
@@ -275,14 +341,18 @@ echo "$out" | grep "unit weights, exact"
 '
 
 # 4) fast tier-1 subset: the engine/analysis/native seams this script
-#    exists to protect (full suite: ROADMAP.md "Tier-1 verify")
-stage tier1_fast 700 env JAX_PLATFORMS=cpu python -m pytest -q \
+#    exists to protect (full suite: ROADMAP.md "Tier-1 verify").
+#    Budget sized to measured cost: test_fault.py alone runs ~300 s on
+#    this quota-swinging host (live fleets + chaos seeds), on top of
+#    the ~600 s the pre-ISSUE-14 subset already used.
+stage tier1_fast 1200 env JAX_PLATFORMS=cpu python -m pytest -q \
     -m 'not slow' -p no:cacheprovider \
     tests/test_luxcheck.py tests/test_native.py tests/test_expand.py \
     tests/test_passfuse.py tests/test_mxreduce.py tests/test_mxscan.py \
     tests/test_obs.py tests/test_program.py \
     tests/test_determinism.py tests/test_serve_scheduler.py \
-    tests/test_fleet.py tests/test_mutate.py tests/test_live.py
+    tests/test_fleet.py tests/test_mutate.py tests/test_live.py \
+    tests/test_fault.py
 
 if [ "$FAILED" -ne 0 ]; then
   echo "ci_check: FAILED (see $LOG)"; exit 1
