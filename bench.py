@@ -940,8 +940,10 @@ def worker_main():
         res = measure_fleet_saturation(scale=fscale, workers=(1, 2, 4))
         for row in res["rows"]:
             _emit_row(row)
+        oh = res.get("trace_overhead") or {}
         print(f"# fleet knees: {res['knees']} "
-              f"paired_2v1={res.get('scaleup_2v1')}",
+              f"paired_2v1={res.get('scaleup_2v1')} "
+              f"trace_overhead={oh.get('overhead_frac')}",
               file=sys.stderr, flush=True)
 
     def measure_ba():
@@ -1026,10 +1028,12 @@ def worker_main():
         lscale = _env_int("LUX_BENCH_LIVE_SCALE", 12)
         row = measure_live_mixed(scale=lscale, workers=2)
         _emit_row(row)
+        slo = {s["name"]: s["verdict"] for s in row.get("slo", [])}
         print(f"# live: {row['value']} read QPS, "
               f"{row['write_batches_per_s']} write batches/s, "
               f"staleness p99 {row['staleness_gen_p99']} gen, "
-              f"fleet refresh {row['fleet_refresh_s']}s",
+              f"fleet refresh {row['fleet_refresh_s']}s, "
+              f"slo {slo}",
               file=sys.stderr, flush=True)
 
     def measure_refresh():

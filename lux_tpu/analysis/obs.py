@@ -29,6 +29,14 @@ a chip window.  These lints reject it statically:
   ``jax.debug.callback`` / ``io_callback``) inside a traced body in the
   shipped tree.  Debug-only affordances; each one is a device->host
   round trip per execution.
+* LUX-O005 — distributed trace-context API (``obs/dtrace.py``: mint /
+  child / child_of / wire_ctx / tspan / emit_span / to_wire /
+  from_wire) inside a traced body.  A context is host metadata: minted
+  inside a jit body it runs at TRACE time, baking one span id into the
+  compiled program — every execution would then "belong" to the trace
+  that happened to be live at compile time, which is precisely the
+  lie a tracing system must never tell.  Contexts are minted and
+  propagated strictly outside compiled code.
 
 Pure stdlib AST like the rest of the suite — the traced-context
 detection is shared with the tracing-safety family (tracing.py).
@@ -58,22 +66,32 @@ _RECORDER_MEMBERS = {"span", "point", "recorder"}
 #: ring HOST-fetch members (ring_push is the traced-side API and legal)
 _RING_FETCH_MEMBERS = {"ring_rows", "emit_ring"}
 
+#: distributed trace-context API (LUX-O005): mutating/minting a trace
+#: context inside a traced body runs at trace time and bakes one id
+#: into the compiled program
+_DTRACE_MEMBERS = {"mint", "child", "child_of", "wire_ctx", "tspan",
+                   "emit_span", "to_wire", "from_wire", "wire_point"}
+
 #: compiled-runner call names for LUX-O003 (suffix match: methods and
 #: module-qualified forms both count)
 _RUNNER_SUFFIXES = ("run_pull_fixed", "run_pull_until", "run_push",
                     "run_pull_fixed_overlapped")
 
 
-def _obs_aliases(mod: Module) -> Tuple[Set[str], Set[str], Set[str], Set[str]]:
+def _obs_aliases(mod: Module) -> Tuple[Set[str], Set[str], Set[str],
+                                       Set[str], Set[str], Set[str]]:
     """(obs_module_aliases, ring_module_aliases, direct_recorder_names,
-    direct_ringfetch_names): names this module binds to lux_tpu.obs /
-    lux_tpu.obs.ring / individual recorder+ring functions.
+    direct_ringfetch_names, dtrace_module_aliases, direct_dtrace_names):
+    names this module binds to lux_tpu.obs / lux_tpu.obs.ring /
+    lux_tpu.obs.dtrace / individual recorder+ring+dtrace functions.
     Import-resolution keeps the checker precise: a stray local
     ``span()`` helper is not a finding."""
     obs_mods: Set[str] = set()
     ring_mods: Set[str] = set()
     rec_names: Set[str] = set()
     fetch_names: Set[str] = set()
+    dtrace_mods: Set[str] = set()
+    dtrace_names: Set[str] = set()
     for node in ast.walk(mod.tree):
         if isinstance(node, ast.Import):
             for a in node.names:
@@ -81,6 +99,8 @@ def _obs_aliases(mod: Module) -> Tuple[Set[str], Set[str], Set[str], Set[str]]:
                     obs_mods.add(a.asname or a.name)
                 elif a.name == "lux_tpu.obs.ring":
                     ring_mods.add(a.asname or a.name)
+                elif a.name == "lux_tpu.obs.dtrace":
+                    dtrace_mods.add(a.asname or a.name)
         elif isinstance(node, ast.ImportFrom):
             m = node.module or ""
             for a in node.names:
@@ -89,6 +109,8 @@ def _obs_aliases(mod: Module) -> Tuple[Set[str], Set[str], Set[str], Set[str]]:
                     obs_mods.add(bound)
                 elif m == "lux_tpu.obs" and a.name == "ring":
                     ring_mods.add(bound)
+                elif m == "lux_tpu.obs" and a.name == "dtrace":
+                    dtrace_mods.add(bound)
                 elif m == "lux_tpu.obs" and a.name == "recorder":
                     obs_mods.add(bound)
                 elif m in ("lux_tpu.obs", "lux_tpu.obs.recorder") and (
@@ -97,7 +119,20 @@ def _obs_aliases(mod: Module) -> Tuple[Set[str], Set[str], Set[str], Set[str]]:
                 elif m in ("lux_tpu.obs", "lux_tpu.obs.ring") and (
                         a.name in _RING_FETCH_MEMBERS):
                     fetch_names.add(bound)
-    return obs_mods, ring_mods, rec_names, fetch_names
+                elif m == "lux_tpu.obs.dtrace" and (
+                        a.name in _DTRACE_MEMBERS):
+                    dtrace_names.add(bound)
+    return (obs_mods, ring_mods, rec_names, fetch_names, dtrace_mods,
+            dtrace_names)
+
+
+def _is_dtrace_call(cn: str, dtrace_mods: Set[str],
+                    dtrace_names: Set[str]) -> bool:
+    if cn in dtrace_names:
+        return True
+    head, _, member = cn.rpartition(".")
+    return member in _DTRACE_MEMBERS and (
+        head in dtrace_mods or head == "lux_tpu.obs.dtrace")
 
 
 def _is_recorder_call(cn: str, obs_mods: Set[str], ring_mods: Set[str],
@@ -152,7 +187,8 @@ class ObsChecker(Checker):
 
     def run(self, mod: Module) -> Iterable[Finding]:
         out: List[Finding] = []
-        obs_mods, ring_mods, rec_names, fetch_names = _obs_aliases(mod)
+        (obs_mods, ring_mods, rec_names, fetch_names, dtrace_mods,
+         dtrace_names) = _obs_aliases(mod)
         traced = set(traced_functions(mod))
 
         for fn in traced:
@@ -182,6 +218,14 @@ class ObsChecker(Checker):
                         f"host callback `{cn}` inside traced body "
                         f"`{fn.name}` — a device->host round trip per "
                         "execution; remove before shipping"))
+                elif _is_dtrace_call(cn, dtrace_mods, dtrace_names):
+                    out.append(self.finding(
+                        mod, node, "LUX-O005",
+                        f"trace-context API `{cn}` inside traced body "
+                        f"`{fn.name}` — contexts are host metadata; "
+                        "minted here it runs at TRACE time and bakes "
+                        "one span id into the compiled program (mint/"
+                        "propagate outside jit, docs/OBSERVABILITY.md)"))
 
         # LUX-O003: ring fetch in a Python loop that drives a compiled
         # runner — the per-iteration-fence anti-pattern, host side

@@ -261,8 +261,11 @@ class FaultPlan:
             return None
         from lux_tpu import obs
 
-        obs.point("fault.inject", plan=self.name, site=site,
-                  action=rule.action, note=rule.note,
+        # plan name + SEED ride the event (ISSUE 15 satellite): a
+        # stitched timeline showing an injected fault next to the spans
+        # it perturbed must also name the exact reproduction recipe
+        obs.point("fault.inject", plan=self.name, seed=self.seed,
+                  site=site, action=rule.action, note=rule.note,
                   **{k: v for k, v in ctx.items() if v is not None})
         if cb is not None:
             cb()

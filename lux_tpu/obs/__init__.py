@@ -23,6 +23,13 @@ docs/OBSERVABILITY.md.
 This ``__init__`` (and recorder) stays jax-free so the tools can import
 it under the same bare-package stub luxcheck uses; ``ring``/``xprof``
 import lazily where needed.
+
+``dtrace`` (also stdlib-only) is the distributed-tracing layer on top:
+trace contexts minted at the fleet entry points, carried on every fleet
+frame, recorded as span attrs each hop — ``tools/luxstitch.py`` merges
+the per-process logs into one causally-ordered fleet timeline, and
+``obs/slo.py`` evaluates declarative SLOs as multi-window burn rates
+over the serving metrics with trace-id exemplars.
 """
 from lux_tpu.obs.recorder import (  # noqa: F401
     Recorder,
@@ -34,3 +41,4 @@ from lux_tpu.obs.recorder import (  # noqa: F401
     run_id,
     span,
 )
+from lux_tpu.obs import dtrace  # noqa: F401  (stdlib-only, like recorder)

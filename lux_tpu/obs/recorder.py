@@ -243,7 +243,19 @@ class Recorder:
             _sweep_old_runs(self.root, d)
         try:
             path = os.path.join(d, f"events-{os.getpid()}.jsonl")
-            self._file = open(path, "a", buffering=1, encoding="utf-8")
+            # block-buffered, flushed explicitly: span begins/points
+            # flush (the crash-safety contract — a killed process must
+            # leave its OPEN span on disk), while retroactive spans
+            # (emit_span — the work already finished) ride the buffer
+            # so high-rate distributed tracing is not one syscall per
+            # event
+            self._file = open(path, "a", buffering=8192,
+                              encoding="utf-8")
+            # buffered tail events must survive a normal exit even when
+            # nobody closes the recorder (CLI tools, bench workers)
+            import atexit
+
+            atexit.register(self.close)
             self.log_path = path
             self._file.write(json.dumps({
                 "e": "m", "run": self.run_id, "pid": os.getpid(),
@@ -256,19 +268,46 @@ class Recorder:
         return self._file
 
     def _write(self, obj: dict) -> None:
+        self._write_lines((obj,), flush=True)
+
+    def _write_lines(self, objs, flush: bool = False) -> None:
+        try:
+            # serialize OUTSIDE the lock: the recorder is process-wide
+            # and high-rate tracing writes from every serving thread —
+            # holding the lock across json.dumps serializes them all
+            text = "".join(json.dumps(o, separators=(",", ":"),
+                                      default=str) + "\n"
+                           for o in objs)
+        except (ValueError, TypeError):
+            text = None
         with self._lock:
             f = self._open()
             if f is None:
                 return
             try:
-                f.write(json.dumps(obj, default=str) + "\n")
-            except (OSError, ValueError, TypeError):
+                if text is None:
+                    raise ValueError("unserializable event")
+                f.write(text)
+                if flush:
+                    f.flush()
+            except (OSError, ValueError):
                 self._file_failed = True
                 try:
                     self._file.close()
                 except OSError:
                     pass
                 self._file = None
+
+    def flush(self) -> None:
+        """Push buffered (retroactive-span) events to disk — readers
+        of a LIVE log (tests, a mid-run luxstitch) call this; close()
+        flushes implicitly."""
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                except OSError:
+                    pass
 
     def close(self) -> None:
         with self._lock:
@@ -332,6 +371,39 @@ class Recorder:
         if attrs:
             ev["a"] = attrs
         self._write(ev)
+
+    def emit_span(self, name: str, t0: float, t1: float, ok: bool = True,
+                  attrs: Optional[dict] = None,
+                  end_attrs: Optional[dict] = None) -> str:
+        """Record a span RETROACTIVELY — begin+end in one call, never
+        touching the per-thread nesting stack.  This exists for work
+        whose begin and end happen on different threads (a fleet query
+        submitted on the caller's thread resolves on the connection
+        reader): a stack-based ``span()`` begun there would become the
+        phantom parent of every later span on the submitting thread.
+        The two events carry the timestamps the caller measured; the
+        aggregate totals count it like any completed span.  Returns the
+        minted sid (the distributed-tracing layer links across
+        processes via its own span attrs, not this id)."""
+        with self._lock:
+            self._next_sid += 1
+            sid = f"{self._sid_prefix}-{self._next_sid}"
+            if ok:
+                tot = self._totals.setdefault(name, [0, 0.0])
+                tot[0] += 1
+                tot[1] += float(t1) - float(t0)
+        b = {"e": "b", "n": name, "s": sid, "p": None, "t": float(t0)}
+        if attrs:
+            b["a"] = dict(attrs)
+        e = {"e": "e", "s": sid, "t": float(t1), "ok": bool(ok)}
+        if end_attrs:
+            e["a"] = dict(end_attrs)
+        # both halves are already known and the work already ENDED, so
+        # one UNFLUSHED buffered write — per-event write syscalls are
+        # the dominant cost of high-rate tracing, and a crash loses
+        # nothing a post-mortem needs (open spans always flush)
+        self._write_lines((b, e))
+        return sid
 
     # -- aggregation (the "one clock" view) -----------------------------
 

@@ -172,8 +172,11 @@ def run_serve_cli(cfg: RunConfig, g, app: str) -> int:
         # queries — observability is never load-bearing
         try:
             with open(prom_path, "w", encoding="utf-8") as f:
+                # exemplars off: the textfile collector parses classic
+                # 0.0.4 text format, where exemplar syntax is illegal
                 f.write(metrics.dump(elapsed_s=elapsed,
-                                     cache_stats=cache_stats))
+                                     cache_stats=cache_stats,
+                                     exemplars=False))
             print(f"# prometheus metrics -> {prom_path}", flush=True)
         except OSError as e:
             print(f"# prometheus metrics NOT written ({prom_path}): {e}",

@@ -44,6 +44,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from lux_tpu import fault
+from lux_tpu.obs import dtrace
+from lux_tpu.obs.slo import SLOEngine
 from lux_tpu.serve.fleet.hashring import (
     DEFAULT_SLOTS,
     DEFAULT_VNODES,
@@ -132,6 +134,12 @@ class FleetFuture:
         #: replay is safe; the id keeps flight-recorder timelines and
         #: retry counters attributable to one logical request)
         self.request_id = request_id
+        #: distributed trace context (obs/dtrace.py): the ROOT of this
+        #: logical request's trace, minted by submit.  Derived from
+        #: request_id when one exists, so envelope retries and replays
+        #: against a promoted controller stay ONE trace.  None when
+        #: tracing is disabled.
+        self.tc: Optional[dtrace.TraceContext] = None
         #: mutation generation the ANSWER reflects (None on a
         #: static-snapshot fleet) — always >= min_generation when set
         #: unless ``stale`` is True
@@ -147,6 +155,11 @@ class FleetFuture:
         self.attempt_base = 0
         self.t_submit = time.monotonic()
         self.t_done: Optional[float] = None
+        #: controller-installed SLO observer, called INSIDE _resolve
+        #: before waiters wake — a slo_status() right after result()
+        #: must already include this request (done callbacks run after
+        #: the event, which would race that read)
+        self._slo_hook = None
         self._cb_lock = threading.Lock()
         self._event = threading.Event()
         self._result: Optional[np.ndarray] = None
@@ -155,6 +168,20 @@ class FleetFuture:
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The resolution error (None while pending or on success) —
+        the SLO engine's good/bad split reads this without racing
+        ``result()``'s raise."""
+        with self._cb_lock:
+            return self._error if self._event.is_set() else None
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        """The distributed trace id this request records under (link
+        into a luxstitch timeline); None when tracing is off."""
+        return None if self.tc is None else self.tc.trace_id
 
     def add_done_callback(self, fn) -> None:
         """Call ``fn(self)`` when the future resolves (immediately if it
@@ -191,8 +218,24 @@ class FleetFuture:
             self._result = result
             self._error = error
             self.t_done = time.monotonic()
+            if self._slo_hook is not None:
+                try:
+                    # error passed explicitly: the hook runs under
+                    # _cb_lock, and the .error property re-takes it
+                    self._slo_hook(self, error)
+                except Exception:  # noqa: BLE001 — scoring can never
+                    pass           # fail a request
             self._event.set()
             cbs, self._callbacks = self._callbacks, []
+        # the request's ROOT span, emitted retroactively (begin on the
+        # submitting thread, end here on whichever thread resolved it
+        # — emit_span bypasses the recorder's nesting stack on purpose)
+        dtrace.emit_span(
+            "fleet.request", self.tc, self.t_submit, self.t_done,
+            ok=error is None, app=self.app, source=self.source,
+            worker=self.worker_id, attempts=self.attempts,
+            stale=self.stale or None,
+            kind=None if error is None else type(error).__name__)
         for fn in cbs:
             fn(self)
 
@@ -206,14 +249,24 @@ class _HandedOff(Exception):
 class _Pending:
     """One outstanding frame awaiting a worker reply."""
 
-    def __init__(self, kind: str, fut: Optional[FleetFuture] = None):
+    def __init__(self, kind: str, fut: Optional[FleetFuture] = None,
+                 tc: Optional[dtrace.TraceContext] = None):
         self.kind = kind  # "query" | "rpc"
         self.fut = fut
+        #: this ATTEMPT's trace context (a child of the future's root;
+        #: the wire frame carried the same ids) — its span is emitted
+        #: when the attempt concludes, on whichever path that happens
+        self.tc = tc
         self.reply: Optional[dict] = None
         self.arr: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.event = threading.Event()
         self.t0 = time.monotonic()  # the abandoned-pending sweep key
+
+    def end_span(self, name: str, ok: bool, **attrs) -> None:
+        if self.tc is not None:
+            dtrace.emit_span(name, self.tc, self.t0, time.monotonic(),
+                             ok=ok, **attrs)
 
 
 _INCARNATION_LOCK = threading.Lock()
@@ -280,6 +333,9 @@ class FleetController:
                         "failovers": 0}
         #: per-worker retry/timeout/stale attribution (prom labels)
         self._per_worker: Dict[str, Dict[str, int]] = {}
+        #: SLO burn-rate engine (obs/slo.py), installed via set_slos();
+        #: fed from the resolve paths, read via slo_status()
+        self._slo: Optional[SLOEngine] = None
         #: this controller incarnation's publish-token prefix: a
         #: PROMOTED controller restarts _seq at 0, and its tokens must
         #: never collide with a dead predecessor's still staged on a
@@ -296,11 +352,15 @@ class FleetController:
             return self._graph_id
 
     def add_worker(self, host: str, port: int,
-                   timeout_s: float = 60.0) -> str:
+                   timeout_s: float = 60.0,
+                   tc: Optional[dtrace.TraceContext] = None) -> str:
         """Connect + handshake a worker and put it on the ring.  The
         first worker pins the fleet's graph_id; later joins must serve
         the same graph (a mismatched replica would answer WRONG, which
-        is worse than answering slow)."""
+        is worse than answering slow).  ``tc``: the trace context of
+        the operation driving this join (a takeover's re-hello sweep),
+        carried on the hello frame so the worker's hello span links
+        causally."""
         from lux_tpu import obs
 
         conn = Conn.connect(host, port, timeout_s=timeout_s,
@@ -310,10 +370,17 @@ class FleetController:
             target=self._read_loop, args=(handle,),
             name="lux-fleet-ctl-read", daemon=True)
         handle.reader.start()
-        p = self._send(handle, {"op": "hello", **self._hello_info()},
-                       _Pending("rpc"))
+        hello = {"op": "hello", **self._hello_info()}
+        htc = tc.child() if tc is not None else None
+        t_hello = time.monotonic()
+        if htc is not None:
+            hello["tc"] = htc.to_wire()
+        p = self._send(handle, hello, _Pending("rpc"))
         if not p.event.wait(timeout_s) or p.error or not p.reply:
             conn.close()
+            dtrace.emit_span("fleet.hello", htc, t_hello,
+                             time.monotonic(), ok=False,
+                             peer=f"{host}:{port}")
             raise FleetError(f"worker at {host}:{port} failed handshake: "
                              f"{p.error}")
         if not p.reply.get("ok", True):
@@ -321,6 +388,10 @@ class FleetController:
             # permanent — surfaced as its own type so reconnect/
             # failover loops stop instead of backing off forever
             conn.close()
+            dtrace.emit_span("fleet.hello", htc, t_hello,
+                             time.monotonic(), ok=False,
+                             peer=f"{host}:{port}",
+                             kind=str(p.reply.get("kind")))
             raise WorkerRefusedError(str(p.reply.get("kind")),
                                      str(p.reply.get("err")))
         info = p.reply
@@ -345,6 +416,8 @@ class FleetController:
             handle.last_seen = time.monotonic()
             self._workers[wid] = handle
             self._ring.add(wid)
+        dtrace.emit_span("fleet.hello", htc, t_hello, time.monotonic(),
+                         ok=True, worker=wid)
         obs.point("fleet.worker.join", worker=wid,
                   graph=str(info["graph_id"]), nv=info.get("nv"))
         self._ensure_heartbeat()
@@ -392,15 +465,21 @@ class FleetController:
         joined: List[str] = []
         failed: Dict[str, str] = {}
         refused: Dict[str, str] = {}
-        with obs.span("fleet.takeover",
-                      endpoints=[f"{h}:{p}" for h, p in endpoints]):
+        # the takeover trace: keyed on THIS incarnation, so the
+        # re-hello spans every worker records parent into one
+        # timeline entry next to the write trace the failover
+        # interrupted (the kill-mid-write drill's stitched view)
+        ttc = dtrace.mint(key=f"takeover:{self._incarnation}")
+        with dtrace.tspan("fleet.takeover", ttc, always=True,
+                          endpoints=[f"{h}:{p}" for h, p in endpoints]):
             for i, (host, port) in enumerate(endpoints):
                 bo = Backoff(seed=seed + i)
                 deadline = time.monotonic() + float(deadline_s)
                 while True:
                     try:
                         joined.append(self.add_worker(host, port,
-                                                      timeout_s=10.0))
+                                                      timeout_s=10.0,
+                                                      tc=ttc))
                         break
                     except WorkerRefusedError as e:
                         refused[f"{host}:{port}"] = str(e)
@@ -573,6 +652,8 @@ class FleetController:
                   orphans=len(orphans))
         handle.conn.close()
         for p in orphans:
+            p.end_span("fleet.attempt", ok=False, worker=handle.wid,
+                       kind=f"worker_{cause}")
             if p.kind == "query":
                 with self._lock:
                     self._counts["rerouted"] += 1
@@ -641,9 +722,26 @@ class FleetController:
                           min_generation=min_generation,
                           stale_ok=stale_ok, request_id=request_id)
         fut.attempt_base = int(attempt_offset)
+        # the trace root: keyed on the request id when one exists, so
+        # every envelope retry (and a replay against a PROMOTED
+        # controller) lands in the same trace (obs/dtrace.py)
+        fut.tc = dtrace.mint(
+            key=None if request_id is None else f"q:{request_id}")
+        with self._lock:
+            if self._slo is not None:
+                fut._slo_hook = self._slo_observe
         with self._lock:
             self._counts["submitted"] += 1
-        self._dispatch(fut, exclude=set(), sync_raise=True)
+        try:
+            self._dispatch(fut, exclude=set(), sync_raise=True)
+        except FleetError as e:
+            # synchronous admission failures (shed / staleness miss /
+            # empty fleet) still close the trace root and score the
+            # SLO — resolving the future is harmless (the caller gets
+            # the raise and drops it) and keeps the availability
+            # numbers honest about sheds
+            fut._resolve(error=e)
+            raise
         return fut
 
     def submit_retrying(self, source: int, app: str = "sssp",
@@ -788,6 +886,15 @@ class FleetController:
             fut.attempts += 1
             msg = {"op": "query", "app": fut.app, "source": fut.source,
                    "attempt": fut.attempt_base + fut.attempts}
+            atc = None
+            if fut.tc is not None:
+                # one child context per ATTEMPT: the frame carries it,
+                # the worker's span parents on it, and its span is
+                # emitted when the attempt concludes — so a retried
+                # request shows every attempt as a sibling under the
+                # one fleet.request root
+                atc = fut.tc.child()
+                msg["tc"] = atc.to_wire()
             if fut.timeout_ms:
                 msg["timeout_ms"] = float(fut.timeout_ms)
             if fut.request_id is not None:
@@ -800,7 +907,8 @@ class FleetController:
                 # — per-worker and fleet-level stale counters agree
                 msg["stale_bound"] = int(fut.min_generation)
             try:
-                self._send(handle, msg, _Pending("query", fut))
+                self._send(handle, msg,
+                           _Pending("query", fut, tc=atc))
                 return
             except _HandedOff:
                 return  # _retire owns this future now; it re-dispatched
@@ -811,6 +919,9 @@ class FleetController:
     def _resolve_query(self, handle: _WorkerHandle, p: _Pending,
                        msg: dict, arr) -> None:
         fut = p.fut
+        p.end_span("fleet.attempt", ok=bool(msg.get("ok")),
+                   worker=handle.wid,
+                   kind=None if msg.get("ok") else msg.get("kind"))
         if msg.get("ok"):
             fut.worker_id = handle.wid
             fut.rounds = int(msg.get("rounds", 0))
@@ -887,6 +998,8 @@ class FleetController:
             err = FleetTimeoutError(
                 f"request to worker {handle.wid} unanswered for "
                 f"{self.PENDING_SWEEP_S:g}s (frame lost?)")
+            p.end_span("fleet.attempt", ok=False, worker=handle.wid,
+                       kind="swept")
             if p.kind == "query":
                 p.fut._resolve(error=err)
             else:
@@ -970,8 +1083,12 @@ class FleetController:
         # RESTARTS — a promoted controller's _seq starts over, and its
         # commit must never match a dead predecessor's staged cache
         token = f"pub-{self._incarnation}-{self._next_rid()}"
-        with obs.span("fleet.republish", graph=gid, path=str(path),
-                      token=token, workers=[h.wid for h in handles]):
+        # the republish trace: two-phase barrier as one timeline —
+        # every worker's prepare/commit spans parent into it
+        rtc = dtrace.mint(key=f"republish:{token}")
+        with dtrace.tspan("fleet.republish", rtc, always=True, graph=gid,
+                          path=str(path), token=token,
+                          workers=[h.wid for h in handles]):
             prep_msg = {"op": "prepare", "path": str(path),
                         "graph_id": gid, "token": token}
             if base_generation is not None:
@@ -979,8 +1096,14 @@ class FleetController:
             pendings = []
             for h in handles:
                 try:
+                    msg = {**prep_msg}
+                    if rtc is not None:
+                        # the republish ROOT rides every frame: worker
+                        # prepare/commit spans parent directly under
+                        # fleet.republish (one barrier, one timeline)
+                        msg["tc"] = rtc.to_wire()
                     pendings.append((h, self._send(
-                        h, {**prep_msg}, _Pending("rpc"))))
+                        h, msg, _Pending("rpc"))))
                 except (ConnectionClosed, _HandedOff):
                     self._discard_staged(handles)
                     raise FleetError(
@@ -1006,7 +1129,10 @@ class FleetController:
             commit_failed = []
             for h in handles:
                 try:
-                    rep = self._rpc(h, {"op": "commit", "token": token},
+                    cmsg = {"op": "commit", "token": token}
+                    if rtc is not None:
+                        cmsg["tc"] = rtc.to_wire()
+                    rep = self._rpc(h, cmsg,
                                     timeout_s=commit_timeout_s)
                     gens[h.wid] = int(rep["generation"])
                 except FleetError as e:
@@ -1058,6 +1184,39 @@ class FleetController:
                 1 for h in self._workers.values() if h.alive)
             out["workers_total"] = len(self._workers)
         return out
+
+    # -- SLOs (obs/slo.py, ISSUE 15) -----------------------------------
+
+    def set_slos(self, specs) -> SLOEngine:
+        """Install declarative SLO specs; every resolved query (and,
+        on the live controller, every admitted write) feeds the
+        burn-rate engine from here on.  Returns the engine."""
+        engine = SLOEngine(specs)
+        with self._lock:
+            self._slo = engine
+        return engine
+
+    def slo_status(self) -> List[dict]:
+        """One verdict row per installed spec (empty when none):
+        multi-window burn rates, ok/warn/burning verdict, and the
+        exemplar trace ids linking a burning SLO to stitched
+        timelines."""
+        with self._lock:
+            engine = self._slo
+        return [] if engine is None else engine.status()
+
+    def _slo_observe(self, fut: FleetFuture, error) -> None:
+        """Resolve-time hook scoring one query: availability from the
+        error class, latency from the future's own stamps, staleness
+        from the explicit degrade tag — exemplar'd with the request's
+        trace id.  Runs inside the future's resolve (before waiters
+        wake), so a scrape right after ``result()`` already counts it."""
+        with self._lock:
+            engine = self._slo
+        if engine is None:
+            return
+        engine.observe_query(fut.latency_s, ok=error is None,
+                             stale=fut.stale, trace_id=fut.trace_id)
 
     def prom_dump(self) -> str:
         """One merged Prometheus exposition across the fleet: every
@@ -1134,6 +1293,10 @@ class FleetController:
             lines.extend([f"# HELP {name} {help_text}",
                           f"# TYPE {name} counter"])
             lines.extend(f'{name}{{worker="{w}"}} {n}' for w, n in rows)
+        with self._lock:
+            engine = self._slo
+        if engine is not None:
+            lines.extend(engine.prom_lines())
         plan = fault.active_plan()
         if plan is not None and plan.total_fired():
             name = "lux_fault_injected_total"

@@ -59,6 +59,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from lux_tpu import fault as _fault
+from lux_tpu.obs import dtrace as _dtrace
 
 _HDR = struct.Struct("!III")
 
@@ -208,14 +209,30 @@ class Conn:
     #: doubles) still labels errors and matches fault rules sanely
     peer = "peer"
     owner: Optional[str] = None
+    _tc_sent = 0
+    _tc_rcvd = 0
+
+    #: skew-stamp throttle: the first N traced frames per connection
+    #: always stamp dtrace.send/recv points, then every Mth — the skew
+    #: solver needs a SAMPLE of (send, recv) pairs per process pair
+    #: (it takes minima), and stamping every frame of a saturated
+    #: fleet would make the stamps themselves the overhead
+    TC_STAMP_FIRST = 32
+    TC_STAMP_EVERY = 16
 
     def __init__(self, sock: socket.socket, peer: str = "peer",
                  owner: Optional[str] = None):
         self._sock = sock
         self._send_lock = threading.Lock()
         self._closed = False
+        self._tc_sent = 0
+        self._tc_rcvd = 0
         self.peer = str(peer)
         self.owner = owner
+
+    def _stamp(self, count: int) -> bool:
+        return (count <= self.TC_STAMP_FIRST
+                or count % self.TC_STAMP_EVERY == 0)
 
     @classmethod
     def connect(cls, host: str, port: int, timeout_s: float = 10.0,
@@ -256,6 +273,23 @@ class Conn:
             if frame is None:
                 return
         with self._send_lock:
+            tc = msg.get("tc")
+            if tc is not None:
+                # the skew-correction stamp (ISSUE 15): paired with the
+                # receiver's dtrace.recv by the header's span id, these
+                # are the (send, recv) pairs luxstitch bounds
+                # per-process clock offsets from.  Untraced frames
+                # (heartbeats, no header) cost exactly this None check;
+                # traced ones are throttled past TC_STAMP_FIRST (see
+                # _stamp).  Counter + stamp live INSIDE the send lock:
+                # the receiver counts frames in arrival (= send) order,
+                # and both sides must pick the SAME frames to stamp or
+                # the (send, recv) pairs never match up under
+                # concurrent senders.
+                self._tc_sent += 1
+                if self._stamp(self._tc_sent):
+                    _dtrace.wire_point("send", tc, msg.get("op"),
+                                       self.peer, self.owner)
             _send_all(self._sock, frame, self.peer, frame_timeout_s())
 
     def _faulted_send(self, rule, frame: bytes) -> Optional[bytes]:
@@ -334,6 +368,12 @@ class Conn:
                     buf[-1] ^= 0xFF
                     buf[len(buf) // 2] ^= 0xFF
                     payload = bytes(buf)
+            tc = msg.get("tc")
+            if tc is not None:
+                self._tc_rcvd += 1
+                if self._stamp(self._tc_rcvd):
+                    _dtrace.wire_point("recv", tc, msg.get("op"),
+                                       self.peer, self.owner)
             if not payload:
                 return msg, None
             if zlib.crc32(payload) != crc:

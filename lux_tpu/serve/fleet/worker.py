@@ -43,9 +43,11 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from lux_tpu import fault
+from lux_tpu.obs import dtrace
 from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
 from lux_tpu.serve.metrics import ServeMetrics
 from lux_tpu.serve.scheduler import (
@@ -271,9 +273,15 @@ class ReplicaWorker:
         conn.close()
 
     def _reply_err(self, conn: Conn, msg: dict, kind: str, **extra) -> None:
+        reply = {"req_id": msg.get("req_id"), "ok": False,
+                 "kind": kind, **extra}
+        ctx = dtrace.child_of(msg)
+        if ctx is not None:
+            # error replies ride the trace too: the reply frame pairs
+            # its own send/recv skew points under a fresh span id
+            reply["tc"] = ctx.to_wire()
         try:
-            conn.send({"req_id": msg.get("req_id"), "ok": False,
-                       "kind": kind, **extra})
+            conn.send(reply)
         except ConnectionClosed:
             pass
 
@@ -295,28 +303,35 @@ class ReplicaWorker:
         op = msg.get("op")
         rid = msg.get("req_id")
         if op == "hello":
-            ctl_gen = msg.get("journal_generation")
-            if (self._live is not None and ctl_gen is not None
-                    and self._live.generation() > int(ctl_gen)):
-                # SPLIT-BRAIN GUARD (ISSUE 14): this worker's local
-                # journal holds writes the hello'ing controller's
-                # journal does not.  Enrolling would let a stale /
-                # wiped controller re-sequence generations the fleet
-                # already acked — refuse from OUR side too (the
-                # controller-side add_worker check protects a good
-                # controller from a bad worker; this protects a good
-                # worker from a bad controller).
-                self._reply_err(
-                    conn, msg, "stale_controller",
-                    err=(f"worker {self.worker_id} is at journaled "
-                         f"generation {self._live.generation()}, ahead "
-                         f"of this controller's journal ({int(ctl_gen)})"
-                         " — refusing a controller behind my own "
-                         "journal; recover the controller from the "
-                         "authoritative journal dir"),
-                    journal_generation=self._live.generation())
-                return
-            conn.send({"req_id": rid, "ok": True, **self.info()})
+            # re-hellos are traced (ISSUE 15): a failover takeover's
+            # hello carries its context, so the stitched timeline links
+            # takeover -> this worker's re-enrollment causally
+            with dtrace.tspan("worker.hello", dtrace.child_of(msg),
+                              always=True,
+                              worker=self.worker_id) as hsp:
+                ctl_gen = msg.get("journal_generation")
+                if (self._live is not None and ctl_gen is not None
+                        and self._live.generation() > int(ctl_gen)):
+                    # SPLIT-BRAIN GUARD (ISSUE 14): this worker's local
+                    # journal holds writes the hello'ing controller's
+                    # journal does not.  Enrolling would let a stale /
+                    # wiped controller re-sequence generations the fleet
+                    # already acked — refuse from OUR side too (the
+                    # controller-side add_worker check protects a good
+                    # controller from a bad worker; this protects a good
+                    # worker from a bad controller).
+                    hsp.set(refused="stale_controller")
+                    self._reply_err(
+                        conn, msg, "stale_controller",
+                        err=(f"worker {self.worker_id} is at journaled "
+                             f"generation {self._live.generation()}, ahead "
+                             f"of this controller's journal ({int(ctl_gen)})"
+                             " — refusing a controller behind my own "
+                             "journal; recover the controller from the "
+                             "authoritative journal dir"),
+                        journal_generation=self._live.generation())
+                    return
+                conn.send({"req_id": rid, "ok": True, **self.info()})
         elif op == "query":
             self._op_query(conn, msg)
         elif op in ("delta", "refresh", "read"):
@@ -336,7 +351,7 @@ class ReplicaWorker:
             conn.send({"req_id": rid, "ok": True, **self.heartbeat()})
         elif op == "prom":
             conn.send({"req_id": rid, "ok": True,
-                       "text": self.metrics.dump(replica=self.worker_id)})
+                       "text": self.prom_text()})
         elif op == "prepare":
             # daemon + untracked, like the conn threads: one per
             # republish, replies through the conn's send lock
@@ -412,9 +427,52 @@ class ReplicaWorker:
             out["delta_generation"] = self._live.servable_generation()
         return out
 
+    def prom_text(self) -> str:
+        """This replica's scrape (the ``prom`` op): the full
+        counter/histogram set via ``ServeMetrics.scrape`` — never
+        stale-empty between snapshots — plus the live-path gauges the
+        Prometheus surface was missing (ISSUE 15 satellite), all
+        replica-labelled: servable-vs-journaled generation lag (the
+        overflow window made visible), delta-overlay occupancy (how
+        close this replica is to the compaction escalation), and
+        warm-engine-cache occupancy (LRU pressure)."""
+        depth = sum(s.pending() for s in self._scheds.values())
+        with self._lock:
+            cache = self._cache
+            live = self._live
+        stats = cache.stats()
+        extra = [("lux_serve_engine_cache_occupancy",
+                  stats.get("occupancy", 0.0),
+                  "resident warm engines / LRU cap")]
+        if live is not None:
+            lag = max(live.generation() - live.servable_generation(), 0)
+            extra.extend([
+                ("lux_live_generation_lag", lag,
+                 "journaled minus servable generations (nonzero only "
+                 "in the overflow window)"),
+                ("lux_live_servable_generation",
+                 live.servable_generation(),
+                 "mutation generation the installed overlay serves"),
+                ("lux_live_delta_occupancy",
+                 round(float(
+                     live.stats()["delta_occupancy"]["frac"]), 4),
+                 "fraction of the per-part insert capacity in use "
+                 "(max part)"),
+            ])
+        return self.metrics.scrape(queue_depth=depth, cache_stats=stats,
+                                   replica=self.worker_id,
+                                   extra_gauges=extra)
+
     def _op_query(self, conn: Conn, msg: dict) -> None:
         rid = msg.get("req_id")
         app = msg.get("app", "sssp")
+        # THIS hop's trace context: a child of the frame's header
+        # (the controller's attempt span is the causal parent); the
+        # worker.query span it names covers receipt -> answer sent,
+        # i.e. queue wait + batch + responder — the worker's share of
+        # the request's latency in the stitched timeline
+        wtc = dtrace.child_of(msg)
+        t_recv = time.monotonic()
         if int(msg.get("attempt", 1) or 1) > 1:
             # a re-dispatched / envelope-retried query landing here —
             # the per-replica retry counter the prom surface labels
@@ -424,21 +482,33 @@ class ReplicaWorker:
         stale_bound = msg.get("stale_bound")
         sched = self._scheds.get(app)
         if sched is None:
+            dtrace.emit_span("worker.query", wtc, t_recv,
+                             time.monotonic(), ok=False,
+                             worker=self.worker_id, kind="error")
             self._reply_err(conn, msg, "error",
                             err=f"app {app!r} not served here")
             return
         try:
-            fut = sched.submit(int(msg["source"]),
-                               timeout_ms=msg.get("timeout_ms"))
+            fut = sched.submit(
+                int(msg["source"]), timeout_ms=msg.get("timeout_ms"),
+                trace=(wtc.trace_id if wtc is not None and wtc.sampled
+                       else None))
         except RejectedError as e:
+            dtrace.emit_span("worker.query", wtc, t_recv,
+                             time.monotonic(), ok=False,
+                             worker=self.worker_id, kind="shed")
             self._reply_err(conn, msg, "shed",
                             retry_after_ms=e.retry_after_ms)
             return
         except (KeyError, TypeError, ValueError) as e:
+            dtrace.emit_span("worker.query", wtc, t_recv,
+                             time.monotonic(), ok=False,
+                             worker=self.worker_id, kind="error")
             self._reply_err(conn, msg, "error", err=repr(e))
             return
         with self._resp_wake:
-            self._unanswered.append((conn, rid, fut, stale_bound))
+            self._unanswered.append((conn, rid, fut, stale_bound,
+                                     wtc, t_recv))
             self._resp_wake.notify_all()
 
     def _respond_loop(self) -> None:
@@ -455,34 +525,49 @@ class ReplicaWorker:
                     return
                 pending, self._unanswered = self._unanswered, []
             still: List[tuple] = []
-            for conn, rid, fut, bound in pending:
+            for conn, rid, fut, bound, wtc, t_recv in pending:
                 if not fut.done():
                     if self._running:
-                        still.append((conn, rid, fut, bound))
+                        still.append((conn, rid, fut, bound, wtc,
+                                      t_recv))
                     else:  # shutting down: never leave a hung future
                         self._reply_err(conn, {"req_id": rid}, "error",
                                         err="worker stopping")
                     continue
-                self._answer(conn, rid, fut, stale_bound=bound)
+                self._answer(conn, rid, fut, stale_bound=bound,
+                             tc=wtc, t_recv=t_recv)
             if still:
                 with self._resp_wake:
                     self._unanswered.extend(still)
                 time.sleep(self.POLL_S)
 
     def _answer(self, conn: Conn, rid, fut,
-                stale_bound: Optional[int] = None) -> None:
+                stale_bound: Optional[int] = None, tc=None,
+                t_recv: Optional[float] = None) -> None:
+        def span(ok: bool, **extra) -> None:
+            if tc is not None and t_recv is not None:
+                dtrace.emit_span("worker.query", tc, t_recv,
+                                 time.monotonic(), ok=ok,
+                                 worker=self.worker_id, **extra)
+
         try:
             state = fut.result(timeout=0)
         except ServeTimeoutError as e:
+            span(False, kind="timeout")
             self._reply_err(conn, {"req_id": rid}, "timeout", err=str(e))
             return
         except Exception as e:  # noqa: BLE001 — engine errors travel to
             # the controller as answers, never as a dropped connection
+            span(False, kind="error")
             self._reply_err(conn, {"req_id": rid}, "error", err=repr(e))
             return
         reply = {"req_id": rid, "ok": True,
                  "rounds": int(fut.rounds),
                  "traversed": int(fut.traversed_edges)}
+        if tc is not None:
+            # the reply frame carries the WORKER's context so its
+            # send/recv skew points pair under a unique span id
+            reply["tc"] = tc.to_wire()
         if fut.generation is not None:
             # the mutation generation the answering batch served — the
             # read-your-writes tag (a lower bound on what it saw)
@@ -491,6 +576,7 @@ class ReplicaWorker:
                 # a stale_ok degrade that actually SERVED below its
                 # bound — counted from the answer, where it lands
                 self.metrics.record_stale_read()
+        span(True, generation=fut.generation)
         try:
             conn.send(reply, arr=state)
         except ConnectionClosed:
@@ -530,48 +616,66 @@ class ReplicaWorker:
                                 "payload")
             return
         gen = msg.get("generation")
-        with self._live_lock:
-            live = self._live_or_refuse(conn, msg)
-            if live is None:
-                return
+        # the replication hop's span (ISSUE 15): child of the
+        # controller's replicate context, covering journal append +
+        # overlay rebuild + install — where a write's latency actually
+        # goes on the worker side.  The fault points inside (torn
+        # writes, before-marker / before-ack kills) land within its
+        # time range, so a stitched timeline shows the injected fault
+        # next to the hop it perturbed.
+        ctx = dtrace.child_of(msg)
+        with dtrace.tspan("worker.delta", ctx, always=True,
+                          worker=self.worker_id,
+                          generation=int(gen) if gen is not None else None,
+                          rows=int(arr.shape[0])) as dsp:
+            with self._live_lock:
+                live = self._live_or_refuse(conn, msg)
+                if live is None:
+                    return
+                try:
+                    oarr, deg = live.apply_batch(arr, int(gen))
+                except GenerationGap as e:
+                    dsp.set(kind="gen_gap")
+                    self._reply_err(conn, msg, "gen_gap", have=e.have,
+                                    want=e.want)
+                    return
+                except DeltaOverflow as e:
+                    # the batch IS journaled (durable) but exceeds the
+                    # overlay capacity: escalate — the controller answers
+                    # with a fleet-wide compaction + republish
+                    obs.point("live.overflow", worker=self.worker_id,
+                              generation=int(gen))
+                    dsp.set(kind="overflow")
+                    self._reply_err(
+                        conn, msg, "overflow", err=str(e),
+                        generation=live.servable_generation(),
+                        journal_generation=live.generation())
+                    return
+                except ConnectionClosed:
+                    return
+                except Exception as e:  # noqa: BLE001 — off the conn
+                    # reader now: an unanswered delta would stall the
+                    # controller's write path for its full timeout
+                    dsp.set(kind="error")
+                    self._reply_err(conn, msg, "error", err=repr(e))
+                    return
+                with self._lock:
+                    cache = self._cache
+                cache.set_overlay(int(gen), oarr, deg)
+            obs.point("live.delta", worker=self.worker_id,
+                      generation=int(gen), rows=int(arr.shape[0]))
+            # applied + journaled + overlay installed, ack not yet sent:
+            # a kill here is the "durable but silent" window the
+            # controller's gen_gap/rejoin machinery must absorb
+            fault.ppoint("worker.before_delta_ack", generation=int(gen))
+            ack = {"req_id": msg.get("req_id"), "ok": True,
+                   "generation": int(gen)}
+            if ctx is not None:
+                ack["tc"] = ctx.to_wire()
             try:
-                oarr, deg = live.apply_batch(arr, int(gen))
-            except GenerationGap as e:
-                self._reply_err(conn, msg, "gen_gap", have=e.have,
-                                want=e.want)
-                return
-            except DeltaOverflow as e:
-                # the batch IS journaled (durable) but exceeds the
-                # overlay capacity: escalate — the controller answers
-                # with a fleet-wide compaction + republish
-                obs.point("live.overflow", worker=self.worker_id,
-                          generation=int(gen))
-                self._reply_err(
-                    conn, msg, "overflow", err=str(e),
-                    generation=live.servable_generation(),
-                    journal_generation=live.generation())
-                return
+                conn.send(ack)
             except ConnectionClosed:
-                return
-            except Exception as e:  # noqa: BLE001 — off the conn
-                # reader now: an unanswered delta would stall the
-                # controller's write path for its full timeout
-                self._reply_err(conn, msg, "error", err=repr(e))
-                return
-            with self._lock:
-                cache = self._cache
-            cache.set_overlay(int(gen), oarr, deg)
-        obs.point("live.delta", worker=self.worker_id,
-                  generation=int(gen), rows=int(arr.shape[0]))
-        # applied + journaled + overlay installed, ack not yet sent:
-        # a kill here is the "durable but silent" window the
-        # controller's gen_gap/rejoin machinery must absorb
-        fault.ppoint("worker.before_delta_ack", generation=int(gen))
-        try:
-            conn.send({"req_id": msg.get("req_id"), "ok": True,
-                       "generation": int(gen)})
-        except ConnectionClosed:
-            pass  # controller went away; the apply itself is durable
+                pass  # controller went away; the apply itself is durable
 
     def _op_refresh(self, conn: Conn, msg: dict) -> None:
         """Warm-refresh the standing states to the current servable
@@ -579,11 +683,14 @@ class ReplicaWorker:
         schedulers keep answering through the installed overlay while
         this runs."""
         try:
-            with self._live_lock:
-                live = self._live_or_refuse(conn, msg)
-                if live is None:
-                    return
-                res = live.refresh()
+            with dtrace.tspan("worker.refresh", dtrace.child_of(msg),
+                              always=True,
+                              worker=self.worker_id):
+                with self._live_lock:
+                    live = self._live_or_refuse(conn, msg)
+                    if live is None:
+                        return
+                    res = live.refresh()
         except ConnectionClosed:
             return
         except Exception as e:  # noqa: BLE001 — a failed refresh is an
@@ -602,7 +709,9 @@ class ReplicaWorker:
         """Serve a STANDING state (O(1): the refreshed array + its
         generation tag)."""
         app = msg.get("app", "sssp")
-        with self._live_lock:
+        with dtrace.tspan("worker.read", dtrace.child_of(msg),
+                          worker=self.worker_id, app=app), \
+                self._live_lock:
             live = self._live_or_refuse(conn, msg)
             if live is None:
                 return
@@ -630,8 +739,6 @@ class ReplicaWorker:
     # ------------------------------------------------------------------
 
     def _op_prepare(self, conn: Conn, msg: dict) -> None:
-        from lux_tpu import obs
-
         rid = msg.get("req_id")
         path = msg.get("path")
         gid = msg.get("graph_id") or str(path)
@@ -652,9 +759,10 @@ class ReplicaWorker:
             # latest prepare wins from the start: an older in-flight
             # prepare sees its token superseded and will not stage
             self._publish_token = token
+        ctx = dtrace.child_of(msg)
         try:
-            with obs.span("fleet.publish.prepare", worker=self.worker_id,
-                          graph=gid):
+            with dtrace.tspan("fleet.publish.prepare", ctx, always=True,
+                              worker=self.worker_id, graph=gid):
                 from lux_tpu.graph.format import read_lux
                 from lux_tpu.graph.shards import build_pull_shards
 
@@ -702,8 +810,6 @@ class ReplicaWorker:
             self._reply_err(conn, msg, "error", err=repr(e))
 
     def _op_commit(self, conn: Conn, msg: dict) -> None:
-        from lux_tpu import obs
-
         rid = msg.get("req_id")
         want = msg.get("token")
         # the WHOLE swap (cache + schedulers + live replica) happens
@@ -755,8 +861,11 @@ class ReplicaWorker:
             live2.inherit_standing(old)
             live2.rebind_journal(old.journal_dir, prior=old)
             self._live = live2
+        ctx = dtrace.child_of(msg)
         obs.point("fleet.publish.commit", worker=self.worker_id,
-                  graph=gid, generation=gen)
+                  graph=gid, generation=gen,
+                  **(ctx.attrs() if ctx is not None and ctx.sampled
+                     else {}))
         reply = {"req_id": rid, "ok": True, "generation": gen,
                  "graph_id": gid}
         if live2 is not None:

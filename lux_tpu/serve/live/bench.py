@@ -91,6 +91,12 @@ def measure_live_mixed(scale: int = 12, ef: int = 8, workers: int = 2,
         workers, g, parts=parts, cap=cap, buckets=buckets,
         snapshot_path=snap, graph_id=f"rmat{scale}")
     ctl = fleet.controller
+    # the standing serving SLOs (obs/slo.py), scored over this window's
+    # own reads + writes: the row records a verdict per objective with
+    # exemplar trace ids linking into the run's stitched timelines
+    from lux_tpu.obs.slo import default_fleet_slos
+
+    ctl.set_slos(default_fleet_slos())
     stop = threading.Event()
     reads_ok = [0] * reader_threads
     read_errors = [0] * reader_threads
@@ -156,6 +162,7 @@ def measure_live_mixed(scale: int = 12, ef: int = 8, workers: int = 2,
                 refresh = ctl.refresh_fleet()
             gens = ctl.worker_generations()
             ctl_stats = ctl.stats()
+            slo_rows = ctl.slo_status()
     finally:
         fleet.close()
         try:
@@ -190,5 +197,6 @@ def measure_live_mixed(scale: int = 12, ef: int = 8, workers: int = 2,
         "nv": int(g.nv),
         "ne": int(g.ne),
         "controller": ctl_stats,
+        "slo": slo_rows,
     }
     return row

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from lux_tpu.graph.csc import HostGraph
+from lux_tpu.obs import dtrace
 from lux_tpu.serve.fleet.controller import (
     FleetController,
     FleetError,
@@ -91,13 +92,13 @@ class LiveFleetController(FleetController):
     # ------------------------------------------------------------------
 
     def add_worker(self, host: str, port: int,
-                   timeout_s: float = 60.0) -> str:
+                   timeout_s: float = 60.0, tc=None) -> str:
         """Base handshake + the live catch-up: the worker must be live
         and at-or-behind the journal; behind means it recovered/joined
         from the epoch snapshot + its local committed prefix, and the
         missing batches stream to it before it serves a stale-bounded
         read."""
-        wid = super().add_worker(host, port, timeout_s=timeout_s)
+        wid = super().add_worker(host, port, timeout_s=timeout_s, tc=tc)
         with self._lock:
             handle = self._workers[wid]
         info = handle.info
@@ -134,7 +135,7 @@ class LiveFleetController(FleetController):
         if have < gen:
             with self._lock:
                 self._live_counts["resyncs"] += 1
-            self._sync_worker(handle)
+            self._sync_worker(handle, tc=tc)
         return wid
 
     def _raise_delta_gen(self, handle, gen: int) -> None:
@@ -146,20 +147,24 @@ class LiveFleetController(FleetController):
         with self._lock:
             handle.delta_gen = max(handle.delta_gen, int(gen))
 
-    def _sync_worker(self, handle, start: Optional[int] = None) -> None:
+    def _sync_worker(self, handle, start: Optional[int] = None,
+                     tc=None) -> None:
         """Stream the batches a behind worker is missing, in order.
         ``start`` overrides the tracked delta_gen — the gen_gap path
         passes the worker's OWN reported position instead of lowering
-        the shared (heartbeat-raced) field."""
-        from lux_tpu import obs
-
+        the shared (heartbeat-raced) field.  ``tc``: the trace driving
+        this catch-up (a takeover's re-hello, an admit's gen_gap
+        answer) — each streamed batch rides as a traced delta frame,
+        so recovery work is attributable in the stitched timeline."""
         if start is None:
             start = handle.delta_gen
-        with obs.span("live.sync", worker=handle.wid, have=start,
-                      want=self.journal.generation()):
+        sctx = tc.child() if tc is not None else dtrace.mint()
+        with dtrace.tspan("live.sync", sctx, always=True,
+                          worker=handle.wid,
+                          have=start, want=self.journal.generation()):
             for gen, arr in self.journal.batches_since(start):
                 rep = self._delta_rpc(handle, gen, arr,
-                                      self.delta_timeout_s)
+                                      self.delta_timeout_s, tc=sctx)
                 if rep.get("kind") == "overflow":
                     raise FleetError(
                         f"worker {handle.wid} overflowed at generation "
@@ -192,9 +197,31 @@ class LiveFleetController(FleetController):
         controller and gets the already-committed generation back —
         ``deduped: True``, nothing re-applied, nothing re-replicated
         (the replicas were synced past it at re-hello)."""
+        timeout_s = self.delta_timeout_s if timeout_s is None else timeout_s
+        # the WRITE trace root (ISSUE 15): keyed on the idempotent
+        # write_id, so a client retrying a lost ack against a PROMOTED
+        # controller lands its replay — and its dedup answer — in the
+        # SAME trace as the original attempt.  That identity is what
+        # makes the kill-mid-write drill stitch into one timeline.
+        wtc = dtrace.mint(
+            key=None if write_id is None else f"w:{write_id}")
+        t_admit = time.monotonic()
+        try:
+            return self._admit_writes_scored(src, dst, op, weight,
+                                             write_id, timeout_s,
+                                             wtc, t_admit)
+        except Exception:
+            # a failed admit (journal refusal, overflow-escalation
+            # failure, replication FleetError) is write_ack-BAD — the
+            # SLO must see it, like submit keeps availability honest
+            # about sheds
+            self._observe_write(t_admit, ok=False, tc=wtc)
+            raise
+
+    def _admit_writes_scored(self, src, dst, op, weight, write_id,
+                             timeout_s, wtc, t_admit):
         from lux_tpu import obs
 
-        timeout_s = self.delta_timeout_s if timeout_s is None else timeout_s
         with self._write_lock:
             if write_id is not None:
                 got = self.journal.lookup_write(write_id)
@@ -202,15 +229,23 @@ class LiveFleetController(FleetController):
                     with self._lock:
                         self._live_counts["write_dedups"] += 1
                     obs.point("live.admit.dedup", write_id=str(write_id),
-                              generation=got)
+                              generation=got,
+                              **(wtc.attrs() if wtc is not None
+                                 and wtc.sampled else {}))
+                    dtrace.emit_span("live.admit", wtc, t_admit,
+                                     time.monotonic(), ok=True,
+                                     deduped=True, generation=got)
+                    self._observe_write(t_admit, ok=True, tc=wtc)
                     return {"generation": got,
                             "acked": self.live_workers(),
                             "compacted": False, "deduped": True}
             rows = int(np.size(np.atleast_1d(np.asarray(src))))
-            with obs.span("live.admit", rows=rows) as sp:
+            with dtrace.tspan("live.admit", wtc, always=True,
+                              rows=rows) as sp:
                 gen = self.journal.admit(src, dst, op, weight,
                                          write_id=write_id)
-                acked, overflow = self._replicate(gen, timeout_s)
+                acked, overflow = self._replicate(gen, timeout_s,
+                                                  tc=wtc)
                 compacted = False
                 if overflow:
                     # SATELLITE (ISSUE 14): the overflow-escalated
@@ -233,23 +268,49 @@ class LiveFleetController(FleetController):
                     self._live_counts["write_rows"] += rows
                 sp.set(generation=gen, acked=len(acked),
                        compacted=compacted, deduped=False)
+        self._observe_write(t_admit, ok=True, tc=wtc)
         return {"generation": gen, "acked": acked,
                 "compacted": compacted, "deduped": False}
 
+    def _observe_write(self, t0: float, ok: bool, tc=None) -> None:
+        """Score one admit against the write_latency SLO (admit ->
+        every reachable replica acked)."""
+        with self._lock:
+            engine = self._slo
+        if engine is None:
+            return
+        engine.observe_write(
+            time.monotonic() - t0, ok=ok,
+            trace_id=None if tc is None else tc.trace_id)
+
     def _delta_rpc(self, handle, gen: int, arr: np.ndarray,
-                   timeout_s: float) -> dict:
+                   timeout_s: float, tc=None) -> dict:
         """One delta frame to one worker; returns the reply dict (ok or
         kind=gen_gap/overflow/error) — NEVER raises for a worker-side
         refusal, only for transport loss (as FleetError).  Hand-rolled
         next to FleetController._send because a delta carries an array
-        payload (the base _send is header-only)."""
+        payload (the base _send is header-only).  ``tc``: the write/
+        sync trace — each frame carries its own child, and the
+        replication hop is emitted as a ``live.replicate`` span so the
+        worker's ``worker.delta`` span has its controller-side
+        parent."""
+        ctx = tc.child() if tc is not None else None
+        t0 = time.monotonic()
+
+        def span(ok: bool, **extra) -> None:
+            dtrace.emit_span("live.replicate", ctx, t0, time.monotonic(),
+                             ok=ok, worker=handle.wid,
+                             generation=int(gen), **extra)
+
         p = _Pending("rpc")
         rid = self._next_rid()
         with self._lock:
             handle.pending[rid] = p
+        msg = {"op": "delta", "req_id": rid, "generation": int(gen)}
+        if ctx is not None:
+            msg["tc"] = ctx.to_wire()
         try:
-            handle.conn.send({"op": "delta", "req_id": rid,
-                              "generation": int(gen)}, arr=arr)
+            handle.conn.send(msg, arr=arr)
         except ConnectionClosed:
             with self._lock:
                 still_mine = handle.pending.pop(rid, None) is not None
@@ -258,24 +319,30 @@ class LiveFleetController(FleetController):
                 # book the death ourselves (same shape as base _send);
                 # a harvested rpc already carries p.error — fall through
                 self._on_conn_lost(handle)
+                span(False, kind="died_mid_replication")
                 raise FleetError(
                     f"worker {handle.wid} died mid-replication"
                 ) from None
         if not p.event.wait(timeout_s):
+            span(False, kind="ack_timeout")
             raise FleetError(
                 f"worker {handle.wid} did not ack generation {gen} "
                 f"within {timeout_s}s")
         if p.error is not None:
+            span(False, kind="error")
             raise FleetError(str(p.error))
+        span(bool(p.reply.get("ok")),
+             kind=None if p.reply.get("ok") else p.reply.get("kind"))
         return p.reply
 
-    def _replicate(self, gen: int, timeout_s: float
+    def _replicate(self, gen: int, timeout_s: float, tc=None
                    ) -> Tuple[List[str], bool]:
         """Fan one committed batch to every live worker.  Returns
         (acked worker ids, overflow anywhere).  A worker lost mid-
         replication is simply absent from the ack list (the base
         controller retired it — its reads moved); a gen_gap worker gets
-        the catch-up stream inline."""
+        the catch-up stream inline.  ``tc``: the admitting write's
+        trace, carried on every replication frame."""
         arr = self.journal.payload(gen)
         with self._lock:
             handles = [h for h in self._workers.values() if h.alive]
@@ -283,7 +350,7 @@ class LiveFleetController(FleetController):
         overflow = False
         for h in handles:
             try:
-                rep = self._delta_rpc(h, gen, arr, timeout_s)
+                rep = self._delta_rpc(h, gen, arr, timeout_s, tc=tc)
             except FleetError:
                 continue  # retired mid-replication; rejoin re-syncs it
             if rep.get("ok"):
@@ -296,7 +363,8 @@ class LiveFleetController(FleetController):
                 try:
                     with self._lock:
                         self._live_counts["resyncs"] += 1
-                    self._sync_worker(h, start=int(rep.get("have", 0)))
+                    self._sync_worker(h, start=int(rep.get("have", 0)),
+                                      tc=tc)
                     acked.append(h.wid)
                 except FleetError:
                     continue
@@ -327,8 +395,6 @@ class LiveFleetController(FleetController):
         worker refreshes between its own queries).  Returns per-worker
         {generation, apps{...}} plus the fleet wall seconds (the bench
         row's ``fleet_refresh_s``)."""
-        from lux_tpu import obs
-
         timeout_s = (self.refresh_timeout_s if timeout_s is None
                      else timeout_s)
         with self._lock:
@@ -336,15 +402,19 @@ class LiveFleetController(FleetController):
         if not handles:
             raise NoWorkersError("refresh with no live workers")
         t0 = time.perf_counter()
-        with obs.span("live.refresh_fleet",
-                      workers=[h.wid for h in handles]):
+        rtc = dtrace.mint()
+        with dtrace.tspan("live.refresh_fleet", rtc,
+                          workers=[h.wid for h in handles]):
             from lux_tpu.serve.fleet.controller import _HandedOff
 
             pendings = []
             for h in handles:
                 try:
+                    msg = {"op": "refresh"}
+                    if rtc is not None:
+                        msg["tc"] = rtc.to_wire()
                     pendings.append((h, self._send(
-                        h, {"op": "refresh"}, _Pending("rpc"))))
+                        h, msg, _Pending("rpc"))))
                 except (ConnectionClosed, _HandedOff):
                     continue  # a dying worker's refresh is just absent
             out: Dict[str, dict] = {}
@@ -449,6 +519,38 @@ class LiveFleetController(FleetController):
         out["journal"] = self.journal.stats()
         out["worker_generations"] = self.worker_generations()
         return out
+
+    def _own_prom_text(self) -> str:
+        """Base families + the journal/live-path gauges the Prometheus
+        surface was missing (ISSUE 15 satellite): controller journal
+        depth (epoch batches held for catch-up), and per-worker
+        journal-vs-replicated generation lag — labelled per worker
+        like every fleet series."""
+        text = super()._own_prom_text()
+        js = self.journal.stats()
+        gen = self.journal.generation()
+        lines = []
+        for name, val, help_text in (
+                ("lux_live_journal_depth", js["epoch_batches"],
+                 "batches journaled since the epoch base (catch-up "
+                 "stream length)"),
+                ("lux_live_journal_generation", gen,
+                 "the controller journal's commit generation"),
+                ("lux_live_base_generation", js["base_generation"],
+                 "the current epoch base (advances at compaction)")):
+            lines.extend([f"# HELP {name} {help_text}",
+                          f"# TYPE {name} gauge", f"{name} {val}"])
+        gens = self.worker_generations()
+        if gens:
+            name = "lux_live_worker_generation_lag"
+            lines.extend([
+                f"# HELP {name} journal generation minus this worker's "
+                "replicated generation",
+                f"# TYPE {name} gauge"])
+            lines.extend(
+                f'{name}{{worker="{w}"}} {max(gen - g, 0)}'
+                for w, g in sorted(gens.items()))
+        return text + "\n".join(lines) + "\n"
 
 
 def promote_live_controller(base: HostGraph, journal_dir: str,

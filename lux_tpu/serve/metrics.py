@@ -16,6 +16,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
 
 from lux_tpu.utils.roofline import serve_summarize
 from lux_tpu.utils.timing import LatencyHistogram
@@ -53,6 +54,14 @@ class ServeMetrics:
         self.traversed_edges = 0
         self._depth_max = 0
         self._depth_n = 0
+        #: service birth on the monotonic clock — scrape()'s qps
+        #: denominator, so a scrape is meaningful from the first
+        #: request, not only after a caller hands dump() an elapsed_s
+        self._t_start = time.monotonic()
+        #: latency-bucket upper bound -> (trace_id, seconds): the most
+        #: recent distributed-trace exemplar per histogram bucket
+        #: (ISSUE 15) — a burning latency SLO links to timelines
+        self._exemplars: dict = {}
 
     def record_batch(self, q: int, real: int, warm: bool, service_s: float):
         with self._lock:
@@ -62,12 +71,20 @@ class ServeMetrics:
             self._batch_real += real
             self._batch_warm += int(warm)
 
-    def record_done(self, latency_s: float, wait_s: float, traversed: int):
+    def record_done(self, latency_s: float, wait_s: float, traversed: int,
+                    trace: str | None = None):
         with self._lock:
             self.completed += 1
             self.latency.record(latency_s)
             self.queue_wait.record(wait_s)
             self.traversed_edges += int(traversed)
+            if trace is not None:
+                for le in self.BUCKETS_S:
+                    if latency_s <= le:
+                        self._exemplars[le] = (str(trace), latency_s)
+                        break
+                else:
+                    self._exemplars["+Inf"] = (str(trace), latency_s)
 
     def record_timeout(self):
         with self._lock:
@@ -151,31 +168,44 @@ class ServeMetrics:
                  0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
     def _histogram_lines(self, name: str, hist, help_text: str,
-                         lab: str = "") -> list:
+                         lab: str = "", exemplars: dict | None = None
+                         ) -> list:
         """Prometheus text-format histogram from a LatencyHistogram.
         Past the reservoir cap the recorder holds a uniform SAMPLE of the
         stream, so bucket counts are scaled to the true request count
         (the standard reservoir estimator) while ``_count`` stays exact.
         ``lab`` is a pre-rendered label pair (``replica="w0",``) merged
-        ahead of ``le`` on every bucket sample."""
+        ahead of ``le`` on every bucket sample.  ``exemplars`` maps a
+        bucket bound to its latest (trace_id, seconds) — appended in the
+        OpenMetrics exemplar form (``# {trace_id="..."} <value>``), the
+        link from a histogram bucket to a stitched fleet timeline."""
         samples = list(hist.samples)
         count = len(hist)
         lines = [f"# HELP {name} {help_text}",
                  f"# TYPE {name} histogram"]
         bare = f"{{{lab[:-1]}}}" if lab else ""  # label set sans le
+
+        def exm(le) -> str:
+            ex = (exemplars or {}).get(le)
+            if ex is None:
+                return ""
+            return f' # {{trace_id="{ex[0]}"}} {round(ex[1], 6)}'
+
         scale = (count / len(samples)) if samples else 0.0
         cum = 0
         for le in self.BUCKETS_S:
             cum = sum(1 for s in samples if s <= le)
             lines.append(f'{name}_bucket{{{lab}le="{le}"}} '
-                         f"{int(round(cum * scale))}")
-        lines.append(f'{name}_bucket{{{lab}le="+Inf"}} {count}')
+                         f"{int(round(cum * scale))}{exm(le)}")
+        lines.append(f'{name}_bucket{{{lab}le="+Inf"}} {count}'
+                     f'{exm("+Inf")}')
         lines.append(f"{name}_sum{bare} {round(sum(samples) * scale, 6)}")
         lines.append(f"{name}_count{bare} {count}")
         return lines
 
     def dump(self, elapsed_s: float | None = None,
-             cache_stats: dict | None = None, replica: str = "") -> str:
+             cache_stats: dict | None = None, replica: str = "",
+             exemplars: bool = True) -> str:
         """Prometheus text exposition of the full counter/gauge/histogram
         set — the scrape surface a serving fleet's collector reads
         (ROADMAP item 2).  Pure string formatting over the same state
@@ -184,7 +214,14 @@ class ServeMetrics:
         ``replica`` labels every series with the worker id, so metrics
         the fleet controller aggregates across workers stay per-worker
         attributable (one scrape surface, R label values — the
-        Prometheus idiom, not R metric namespaces)."""
+        Prometheus idiom, not R metric namespaces).
+
+        ``exemplars`` appends the per-bucket trace-id exemplars to the
+        latency histogram in OpenMetrics form.  Exemplar syntax is an
+        OPENMETRICS feature — a strict classic text-format (0.0.4)
+        parser rejects the trailing ``# {...}``, so pass
+        ``exemplars=False`` when the output feeds one (the
+        textfile-collector artifact does)."""
         lab = f'replica="{replica}",' if replica else ""
         sfx = f"{{{lab[:-1]}}}" if lab else ""
         with self._lock:
@@ -228,7 +265,8 @@ class ServeMetrics:
                       "batches served by a warm engine")
             lines.extend(self._histogram_lines(
                 "lux_serve_request_latency_seconds", self.latency,
-                "enqueue-to-result latency", lab=lab))
+                "enqueue-to-result latency", lab=lab,
+                exemplars=dict(self._exemplars) if exemplars else None))
             lines.extend(self._histogram_lines(
                 "lux_serve_queue_wait_seconds", self.queue_wait,
                 "enqueue-to-dispatch wait", lab=lab))
@@ -254,6 +292,46 @@ class ServeMetrics:
                 "# TYPE lux_serve_warm_hit_ratio gauge",
                 f"lux_serve_warm_hit_ratio{sfx} {ratio}"])
         return "\n".join(lines) + "\n"
+
+    def exemplars(self) -> dict:
+        """Point-in-time copy of the per-bucket latency exemplars
+        ({bucket_le: (trace_id, seconds)})."""
+        with self._lock:
+            return dict(self._exemplars)
+
+    def scrape(self, queue_depth: int | None = None,
+               cache_stats: dict | None = None, replica: str = "",
+               extra_gauges=()) -> str:
+        """The on-demand scrape surface (ISSUE 15 satellite): ``dump``
+        plus the IN-FLIGHT window state a collector needs between the
+        scheduler's periodic snapshots.  ``dump()`` alone answers a
+        mid-burst scrape with no rate and no live depth — the QPS/depth
+        picture existed only in ``serve.metrics`` snapshot events, so a
+        scrape landing between snapshots looked stale-empty.  Here:
+
+        * ``lux_serve_qps`` is always present, over the service's OWN
+          lifetime clock (fresh start -> 0, never absent);
+        * ``queue_depth`` (the caller's live scheduler depth) becomes a
+          ``lux_serve_queue_depth`` gauge — the current value, next to
+          ``dump``'s running max;
+        * ``extra_gauges`` — (name, value, help) rows — lets the worker
+          fold its live-path gauges (journal/overlay/cache occupancy)
+          into the same replica-labelled exposition."""
+        elapsed = max(time.monotonic() - self._t_start, 1e-9)
+        text = self.dump(elapsed_s=elapsed, cache_stats=cache_stats,
+                         replica=replica)
+        lab = f'{{replica="{replica}"}}' if replica else ""
+        lines = []
+        if queue_depth is not None:
+            lines.extend([
+                "# HELP lux_serve_queue_depth current queued requests",
+                "# TYPE lux_serve_queue_depth gauge",
+                f"lux_serve_queue_depth{lab} {int(queue_depth)}"])
+        for name, val, help_text in extra_gauges:
+            lines.extend([f"# HELP {name} {help_text}",
+                          f"# TYPE {name} gauge",
+                          f"{name}{lab} {val}"])
+        return text + ("\n".join(lines) + "\n" if lines else "")
 
     def emit_snapshot(self, rec=None, elapsed_s: float | None = None,
                       cache_stats: dict | None = None,

@@ -62,6 +62,10 @@ class _Request:
     #: mutation generation the answering batch served (None = the cache
     #: is not live; static-snapshot serving carries no tag)
     generation: Optional[int] = None
+    #: distributed trace id of the request (obs/dtrace.py), carried so
+    #: the dispatch span and the latency histogram's exemplars can link
+    #: batches back to fleet timelines; None = untraced
+    trace: Optional[str] = None
 
 
 class ServeFuture:
@@ -141,13 +145,13 @@ class MicroBatchScheduler:
         buckets = max(depth // max(self._max_bucket(), 1), 1)
         return per_batch * buckets
 
-    def submit(self, query: int, timeout_ms: Optional[float] = None
-               ) -> ServeFuture:
+    def submit(self, query: int, timeout_ms: Optional[float] = None,
+               trace: Optional[str] = None) -> ServeFuture:
         now = self._clock()
         t = self.default_timeout_ms if timeout_ms is None else float(timeout_ms)
         deadline = now + t / 1e3 if t > 0 else None
         req = _Request(query=int(query), enqueue_t=now, deadline_t=deadline,
-                       event=threading.Event())
+                       event=threading.Event(), trace=trace)
         with self._wake:
             if len(self._queue) >= self.max_queue:
                 self.metrics.record_rejected()
@@ -240,12 +244,19 @@ class MicroBatchScheduler:
         queries = [r.query for r in batch]
         pad = q - len(queries)
         queries = queries + [queries[0]] * pad
+        # distributed-trace linkage: the batch's dispatch span names the
+        # traces it serves (bounded — a Q=64 batch lists a sample), so a
+        # stitched timeline can find which batch answered a request
+        traces = [r.trace for r in batch if r.trace is not None]
         t0 = self._clock()
         try:
             # the dispatch span is the serving hot path's flight-recorder
             # row: one per batch, covering engine lookup + the batched run
             with obs.span("serve.dispatch", app=self.app, q=q,
-                          real=len(batch)) as sp:
+                          real=len(batch),
+                          **({"traces": traces[:4],
+                              "n_traced": len(traces)}
+                             if traces else {})) as sp:
                 # ONE read of self.cache for the whole dispatch: a
                 # republish commit reassigns it concurrently, and an
                 # old-cache engine run with the NEW cache's overlay
@@ -288,6 +299,7 @@ class MicroBatchScheduler:
                 latency_s=done_t - r.enqueue_t,
                 wait_s=t0 - r.enqueue_t,
                 traversed=out.traversed[i],
+                trace=r.trace,
             )
             r.event.set()
         return resolved + len(batch)

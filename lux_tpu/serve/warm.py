@@ -270,6 +270,10 @@ class WarmEngineCache:
             "engines": total,
             "engines_warm": warmed,
             "max_engines": self.max_engines,
+            # resident engines / LRU cap — the Prometheus gauge feeding
+            # capacity planning (an always-1.0 cache is thrashing its
+            # LRU; see evictions)
+            "occupancy": round(total / max(self.max_engines, 1), 4),
             "evictions": evicted,
             "warm_hits": hits,
             "cold_traces": cold,

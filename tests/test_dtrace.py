@@ -1,0 +1,786 @@
+"""luxwire-trace (ISSUE 15): distributed request tracing, the
+luxstitch causal timeline, and the SLO burn-rate engine.
+
+Pins the acceptance surface: (a) trace contexts are minted at the
+fleet entry points, carried on every frame, and recorded as span attrs
+whose parent links survive the wire — a query's stitched chain is
+``fleet.request -> fleet.attempt -> worker.query``; (b) identity is
+deterministic under retries — the kill-mid-write drill's original
+admit, the failover takeover's re-hellos, and the dedup-acked replay
+stitch into ONE timeline with causal parent links asserted; (c)
+luxstitch's clock-skew correction recovers a synthetic cross-machine
+offset from the wire's send/recv pairs; (d) SLOs evaluate as
+multi-window burn rates with trace-id exemplars, and the Prometheus
+surface (scrape() freshness, exemplar suffixes, journal/lag gauges,
+merged exposition across a failover) parses with an in-test minimal
+Prometheus text parser.
+"""
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lux_tpu import fault, obs
+from lux_tpu.fault.plan import FaultPlan, FaultRule
+from lux_tpu.graph import generate
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models.sssp import bfs_reference
+from lux_tpu.obs import dtrace
+from lux_tpu.obs.recorder import Recorder
+from lux_tpu.obs.slo import (
+    SLOEngine,
+    SLOSpec,
+    SLOSpecError,
+    default_fleet_slos,
+    specs_from_json,
+)
+from lux_tpu.serve.fleet.bench import start_fleet
+from lux_tpu.serve.live.controller import (
+    promote_live_controller,
+    start_live_fleet,
+)
+from lux_tpu.serve.metrics import ServeMetrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_dtrace_state():
+    yield
+    dtrace.set_enabled(None)
+    fault.uninstall()
+
+
+@pytest.fixture(scope="module")
+def small():
+    g = generate.rmat(8, 6, seed=9)
+    return g, build_pull_shards(g, 2)
+
+
+@pytest.fixture()
+def rec(tmp_path):
+    r = Recorder(run_id="dtr", root=str(tmp_path), enabled=True)
+    old = obs.install(r)
+    yield r
+    r.close()
+    obs.install(old)
+
+
+def read_events(run_dir):
+    evs = []
+    if not os.path.isdir(run_dir):  # lazy open: nothing written yet
+        return evs
+    for fn in sorted(os.listdir(run_dir)):
+        if fn.startswith("events-") and fn.endswith(".jsonl"):
+            with open(os.path.join(run_dir, fn), encoding="utf-8") as f:
+                evs.extend(json.loads(ln) for ln in f if ln.strip())
+    return evs
+
+
+def spans_by_name(evs):
+    out = {}
+    for ev in evs:
+        if ev.get("e") == "b":
+            out.setdefault(ev["n"], []).append(ev)
+    return out
+
+
+# ----------------------------------------------------------------------
+# a minimal Prometheus text parser (the satellite's round-trip oracle)
+# ----------------------------------------------------------------------
+
+
+def prom_parse(text):
+    """Strict-enough parser: returns {family: {"help":…, "type":…,
+    "samples": [(name, labels_dict, value)]}}.  Enforces the rules the
+    exposition format actually has — HELP/TYPE at most once per family,
+    samples grouped under their family, every sample line parseable —
+    and strips OpenMetrics exemplar suffixes (`# {...} v`)."""
+    fams = {}
+    cur = None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            kind = line[2:6].strip().lower()
+            rest = line.split(" ", 3)
+            fam, payload = rest[2], rest[3] if len(rest) > 3 else ""
+            ent = fams.setdefault(fam, {"help": None, "type": None,
+                                        "samples": []})
+            assert ent[kind] is None, \
+                f"{kind.upper()} repeated for family {fam}"
+            ent[kind] = payload
+            cur = fam
+            continue
+        assert not line.startswith("#"), f"stray comment: {line!r}"
+        sample = line
+        if " # {" in sample:  # exemplar suffix
+            sample = sample.split(" # {", 1)[0]
+        if "{" in sample:
+            name = sample.split("{", 1)[0]
+            labels_raw = sample.split("{", 1)[1].rsplit("}", 1)[0]
+            value = sample.rsplit("}", 1)[1].strip()
+            labels = {}
+            for pair in filter(None, labels_raw.split(",")):
+                k, v = pair.split("=", 1)
+                labels[k] = v.strip('"')
+        else:
+            parts = sample.split()
+            assert len(parts) == 2, f"bad sample line: {line!r}"
+            name, value = parts
+            labels = {}
+        float(value)  # must parse
+        base = name
+        for sfx in ("_bucket", "_sum", "_count"):
+            if base.endswith(sfx):
+                base = base[: -len(sfx)]
+        fam = base if base in fams else name
+        assert cur is not None and fam in fams, \
+            f"sample {name} before any HELP/TYPE"
+        fams[fam]["samples"].append((name, labels, float(value)))
+    return fams
+
+
+# ----------------------------------------------------------------------
+# context mechanics
+# ----------------------------------------------------------------------
+
+
+def test_mint_deterministic_from_key():
+    a = dtrace.mint(key="w:write-7")
+    b = dtrace.mint(key="w:write-7")
+    c = dtrace.mint(key="w:write-8")
+    assert a.trace_id == b.trace_id and a.span_id == b.span_id
+    assert c.trace_id != a.trace_id
+    assert a.parent_span_id is None and a.sampled
+    # random mints differ
+    assert dtrace.mint().trace_id != dtrace.mint().trace_id
+
+
+def test_child_links_and_wire_round_trip():
+    root = dtrace.mint(key="q:r1")
+    ch = root.child()
+    assert ch.trace_id == root.trace_id
+    assert ch.parent_span_id == root.span_id
+    assert ch.span_id != root.span_id
+    back = dtrace.TraceContext.from_wire(ch.to_wire())
+    assert (back.trace_id, back.span_id, back.parent_span_id,
+            back.flags) == (ch.trace_id, ch.span_id,
+                            ch.parent_span_id, ch.flags)
+    assert dtrace.TraceContext.from_wire({"nope": 1}) is None
+    assert dtrace.wire_ctx({"op": "query"}) is None
+    got = dtrace.child_of({"tc": root.to_wire()})
+    assert got.parent_span_id == root.span_id
+
+
+def test_disable_and_sampling(monkeypatch):
+    dtrace.set_enabled(False)
+    assert dtrace.mint(key="x") is None
+    dtrace.set_enabled(True)
+    assert dtrace.mint(key="x") is not None
+    dtrace.set_enabled(None)
+    monkeypatch.setenv("LUX_DTRACE", "0")
+    assert dtrace.mint() is None
+    monkeypatch.setenv("LUX_DTRACE", "1")
+    # rate 0: context still propagates, but unsampled (no recording)
+    monkeypatch.setenv("LUX_DTRACE_SAMPLE", "0.0")
+    ctx = dtrace.mint(key="y")
+    assert ctx is not None and not ctx.sampled
+    assert not ctx.child().sampled  # flags propagate
+    monkeypatch.setenv("LUX_DTRACE_SAMPLE", "1.0")
+    assert dtrace.mint(key="y").sampled
+    # the decision is derived from the trace id: every process (and
+    # every retry of a keyed trace) agrees without coordination
+    monkeypatch.setenv("LUX_DTRACE_SAMPLE", "0.5")
+    draws = {dtrace.mint(key="z").sampled for _ in range(4)}
+    assert len(draws) == 1
+    monkeypatch.setenv("LUX_DTRACE_SAMPLE", "2.0")
+    with pytest.raises(ValueError):
+        dtrace.mint()
+
+
+def test_emit_span_is_stack_neutral(rec):
+    rec.emit_span("retro", 1.0, 2.0, ok=True, attrs={"k": 1})
+    with rec.span("normal"):
+        pass
+    rec.flush()
+    evs = read_events(rec.run_dir())
+    by = spans_by_name(evs)
+    assert by["retro"][0]["p"] is None
+    # the retroactive span must NOT become the next span's parent
+    assert by["normal"][0]["p"] is None
+    assert rec.total_count("retro") == 1
+    ends = {e["s"]: e for e in evs if e.get("e") == "e"}
+    assert ends[by["retro"][0]["s"]]["t"] == 2.0
+
+
+def test_tspan_unsampled_records_nothing(rec):
+    ctx = dtrace.TraceContext("t0", "s0", flags=0)
+    with dtrace.tspan("quiet", ctx, a=1) as sp:
+        sp.set(b=2)
+    dtrace.emit_span("quiet2", ctx, 0.0, 1.0)
+    rec.flush()
+    assert not [e for e in read_events(rec.run_dir())
+                if e.get("e") == "b"]
+    # ctx=None degrades to a PLAIN span (single-process behavior),
+    # and None-valued attrs are dropped from the log
+    with dtrace.tspan("plain", None, a=1, b=None):
+        pass
+    rec.flush()
+    by = spans_by_name(read_events(rec.run_dir()))
+    assert by["plain"][0]["a"] == {"a": 1}
+    assert "trace" not in by["plain"][0].get("a", {})
+
+
+def test_tspan_always_keeps_operational_spans_when_unsampled(rec):
+    """Operational spans (takeover, republish, delta install, hello)
+    predate tracing as UNCONDITIONAL recorder spans; head-sampling
+    thins the trace store, not the local flight recorder.  always=True
+    records the unsampled span PLAIN — present in the post-mortem, no
+    trace attrs (never half-trace)."""
+    ctx = dtrace.TraceContext("t0", "s0", flags=0)
+    with dtrace.tspan("ops.takeover", ctx, always=True, worker="w0"):
+        pass
+    rec.flush()
+    by = spans_by_name(read_events(rec.run_dir()))
+    a = by["ops.takeover"][0].get("a", {})
+    assert a == {"worker": "w0"}  # recorded, and trace-attr-free
+    # a SAMPLED context is unaffected by the flag: full trace attrs
+    with dtrace.tspan("ops.traced", dtrace.TraceContext("t1", "s1"),
+                      always=True):
+        pass
+    rec.flush()
+    by = spans_by_name(read_events(rec.run_dir()))
+    assert by["ops.traced"][0]["a"]["trace"] == "t1"
+
+
+# ----------------------------------------------------------------------
+# luxstitch: skew correction + causal ordering
+# ----------------------------------------------------------------------
+
+
+def _write_log(run_dir, pid, events):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, f"events-{pid}.jsonl"), "w") as f:
+        f.write(json.dumps({"e": "m", "run": "syn", "pid": pid,
+                            "wall": 0.0, "mono": 0.0}) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def test_stitch_skew_correction_synthetic(tmp_path):
+    """Process 2's clock runs 5 s ahead of process 1; traced frames in
+    both directions (1 ms transit) must recover the offset and restore
+    send-before-recv ordering."""
+    run = str(tmp_path / "syn")
+    off = 5.0
+    # pid 1 at true time t stamps t; pid 2 stamps t + off
+    _write_log(run, 1, [
+        {"e": "b", "n": "fleet.request", "s": "1-a-1", "p": None,
+         "t": 10.0, "a": {"trace": "T", "span": "r0"}},
+        {"e": "e", "s": "1-a-1", "t": 10.5, "ok": True},
+        {"e": "p", "n": "dtrace.send", "t": 10.010,
+         "a": {"trace": "T", "span": "w1", "op": "query"}},
+        {"e": "p", "n": "dtrace.recv", "t": 10.111 + off - off,
+         "a": {"trace": "T", "span": "w2", "op": "reply"}},
+    ])
+    _write_log(run, 2, [
+        {"e": "p", "n": "dtrace.recv", "t": 10.011 + off,
+         "a": {"trace": "T", "span": "w1", "op": "query"}},
+        {"e": "b", "n": "worker.query", "s": "2-b-1", "p": None,
+         "t": 10.012 + off,
+         "a": {"trace": "T", "span": "s1", "parent_span": "r0"}},
+        {"e": "e", "s": "2-b-1", "t": 10.100 + off, "ok": True},
+        {"e": "p", "n": "dtrace.send", "t": 10.110 + off,
+         "a": {"trace": "T", "span": "w2", "op": "reply"}},
+    ])
+    luxstitch = _load_tool("luxstitch")
+    files = luxstitch.load_files(sorted(
+        os.path.join(run, f) for f in os.listdir(run)))
+    st = luxstitch.stitch(files)
+    offs = st["offsets"]
+    base, other = offs[1], offs[2]
+    # pid 2's correction must be ~-5 s relative to pid 1 (recovered to
+    # within the 1 ms transit asymmetry)
+    assert abs((other - base) + off) < 0.005, offs
+    tr = st["traces"]["T"]
+    names = [sp["name"] for sp in tr["spans"]]
+    assert names == ["fleet.request", "worker.query"]
+    req, wq = tr["spans"]
+    assert wq["depth"] == 1 and wq["parent_span"] == "r0"
+    # corrected: the worker span starts AFTER the request began and
+    # inside its window — on raw clocks it started 5 s "later"
+    assert req["g0"] < wq["g0"] < req["g1"]
+    out = []
+    luxstitch.render_trace("T", tr, out)
+    text = "\n".join(out)
+    assert "worker.query" in text and "[2]" in text
+
+
+def test_stitch_cli_and_faults(tmp_path, capsys):
+    run = str(tmp_path / "cli")
+    _write_log(run, 7, [
+        {"e": "b", "n": "live.admit", "s": "7-a-1", "p": None,
+         "t": 1.0, "a": {"trace": "W", "span": "a0"}},
+        {"e": "e", "s": "7-a-1", "t": 1.2, "ok": True},
+        {"e": "p", "n": "fault.inject", "t": 1.1,
+         "a": {"plan": "drill", "seed": 3, "site": "proc",
+               "action": "kill", "point": "journal.before_marker"}},
+    ])
+    luxstitch = _load_tool("luxstitch")
+    assert luxstitch.main([run]) == 0
+    out = capsys.readouterr().out
+    assert "live.admit" in out
+    # the injected fault is interleaved with plan + seed (satellite)
+    assert "FAULT proc/kill" in out and "seed=3" in out
+    assert "plan=drill" in out
+    js = str(tmp_path / "st.json")
+    assert luxstitch.main([run, "--json", js, "--trace", "W"]) == 0
+    data = json.load(open(js))
+    assert "W" in data["traces"]
+    assert luxstitch.main([run, "--trace", "nope"]) == 2
+    assert luxstitch.main(["--root", str(tmp_path), "missing_run"]) == 2
+
+
+# ----------------------------------------------------------------------
+# fleet end-to-end: one traced query's causal chain
+# ----------------------------------------------------------------------
+
+
+def test_traced_query_causal_chain(small, rec):
+    g, shards = small
+    fleet = start_fleet(2, shards=shards, graph_id="g", mode="thread",
+                        buckets=(1, 4))
+    ctl = fleet.controller
+    ctl.set_slos(default_fleet_slos())
+    try:
+        with fault.installed(FaultPlan([FaultRule(
+                "wire.recv", "delay", op="query", delay_ms=2.0)],
+                name="delayed", seed=11)):
+            fut = ctl.submit(3, request_id="req-1")
+            assert np.array_equal(fut.result(timeout=60),
+                                  bfs_reference(g, 3))
+        assert fut.trace_id == dtrace.mint(key="q:req-1").trace_id
+        # worker-side prom carries a latency exemplar naming the trace
+        w = (fleet.thread_workers[0]
+             if fleet.thread_workers[0].worker_id == fut.worker_id
+             else fleet.thread_workers[1])
+        assert f'trace_id="{fut.trace_id}"' in w.prom_text()
+        slo = ctl.slo_status()
+        assert {r["name"] for r in slo} == {
+            "read_availability", "read_latency", "read_freshness",
+            "write_ack"}
+        av = next(r for r in slo if r["name"] == "read_availability")
+        assert av["verdict"] == "ok" and av["total"] == 1
+        assert av["exemplar_traces"] == [fut.trace_id]
+    finally:
+        fleet.close()
+    rec.flush()
+    evs = read_events(rec.run_dir())
+    by = spans_by_name(evs)
+    req = [e for e in by["fleet.request"]
+           if e["a"]["trace"] == fut.trace_id]
+    att = [e for e in by["fleet.attempt"]
+           if e["a"]["trace"] == fut.trace_id]
+    wq = [e for e in by["worker.query"]
+          if e["a"]["trace"] == fut.trace_id]
+    assert len(req) == 1 and len(att) == 1 and len(wq) == 1
+    # THE causal chain: request -> attempt -> worker hop
+    assert att[0]["a"]["parent_span"] == req[0]["a"]["span"]
+    assert wq[0]["a"]["parent_span"] == att[0]["a"]["span"]
+    # wire skew stamps pair per traced frame (request out, reply back)
+    pts = [e for e in evs if e.get("e") == "p"
+           and e["n"] in ("dtrace.send", "dtrace.recv")
+           and e["a"].get("trace") == fut.trace_id]
+    sends = {e["a"]["span"] for e in pts if e["n"] == "dtrace.send"}
+    recvs = {e["a"]["span"] for e in pts if e["n"] == "dtrace.recv"}
+    assert sends and sends == recvs
+    # the dispatch batch names the trace it served
+    disp = [e for e in by["serve.dispatch"]
+            if fut.trace_id in (e["a"].get("traces") or [])]
+    assert disp
+    # the injected delay is a point in the same log, with its seed
+    inj = [e for e in evs if e.get("e") == "p"
+           and e["n"] == "fault.inject"]
+    assert inj and inj[0]["a"]["seed"] == 11
+    # luxstitch groups the whole thing into one causally-ordered trace
+    luxstitch = _load_tool("luxstitch")
+    st = luxstitch.stitch(luxstitch.load_files(sorted(
+        os.path.join(rec.run_dir(), f)
+        for f in os.listdir(rec.run_dir()))))
+    tr = st["traces"][fut.trace_id]
+    chain = [sp["name"] for sp in tr["spans"]]
+    assert chain[:3] == ["fleet.request", "fleet.attempt",
+                         "worker.query"]
+    assert [sp["depth"] for sp in tr["spans"][:3]] == [0, 1, 2]
+    assert tr["faults"], "injected fault not interleaved in the trace"
+
+
+def test_untraced_when_disabled(small, rec):
+    g, shards = small
+    dtrace.set_enabled(False)
+    fleet = start_fleet(1, shards=shards, graph_id="g", mode="thread",
+                        buckets=(1, 4))
+    try:
+        fut = fleet.controller.submit(3)
+        assert np.array_equal(fut.result(timeout=60),
+                              bfs_reference(g, 3))
+        assert fut.trace_id is None
+    finally:
+        fleet.close()
+    rec.flush()
+    evs = read_events(rec.run_dir())
+    assert not [e for e in evs
+                if e.get("e") == "p" and e["n"].startswith("dtrace.")]
+    assert "fleet.request" not in spans_by_name(evs)
+
+
+# ----------------------------------------------------------------------
+# ACCEPTANCE: the kill-mid-write drill stitches into one trace
+# ----------------------------------------------------------------------
+
+
+def test_traced_kill_mid_write_failover_one_trace(small, rec, tmp_path):
+    """Admit a write under a write_id; kill the controller; promote a
+    successor (takeover + re-hellos, all traced); replay the SAME
+    write_id and get the dedup ack.  The stitched timeline must show
+    ONE write trace — original live.admit, its live.replicate /
+    worker.delta hops with causal parent links, and the dedup-acked
+    replay — next to the takeover trace whose worker.hello spans link
+    under the promoted controller's fleet.takeover span.  Also pins
+    the satellite: the successor's FIRST prom_dump is one valid
+    exposition (minimal-parser round trip) carrying the re-helloed
+    workers' series, the failover counter, and the live gauges."""
+    g, _sh = small
+    root = str(tmp_path / "fleet")
+    snap = os.path.join(root, "snap.lux")
+    fleet = start_live_fleet(2, g, parts=2, cap=1024,
+                             standing=(("sssp", 0),),
+                             journal_root=root, snapshot_path=snap)
+    ctl = fleet.controller
+    wid = "acc-w0"
+    wtrace = dtrace.mint(key=f"w:{wid}").trace_id
+    try:
+        src = np.array([0, 1]); dst = np.array([3, 4])
+        op = np.ones(2, np.int8)
+        rep = ctl.admit_writes(src, dst, op, write_id=wid)
+        gen = rep["generation"]
+        assert rep["deduped"] is False and len(rep["acked"]) == 2
+        ctl.kill()  # the controller vanishes mid-service
+        eps = [("127.0.0.1", w.port) for w in fleet.thread_workers]
+        ctl2, trep = promote_live_controller(
+            g, os.path.join(root, "controller"), snap, eps, seed=1)
+        fleet.controller = ctl2
+        assert sorted(trep["joined"]) == ["w0", "w1"]
+        # the client's retry of the SAME logical write: dedup-acked,
+        # and — because trace ids are keyed — in the SAME trace
+        rep2 = ctl2.admit_writes(src, dst, op, write_id=wid)
+        assert rep2["deduped"] is True and rep2["generation"] == gen
+        # ---- satellite: the successor's first merged scrape --------
+        text = ctl2.prom_dump()
+        fams = prom_parse(text)
+        assert fams["lux_fleet_failovers_total"]["samples"][0][2] == 1
+        lat = fams["lux_serve_request_latency_seconds"]["samples"]
+        assert {s[1].get("replica") for s in lat
+                if s[1].get("replica")} == {"w0", "w1"}
+        depth = fams["lux_live_journal_depth"]["samples"][0][2]
+        assert depth == gen  # epoch batches == committed generation
+        lag = fams["lux_live_worker_generation_lag"]["samples"]
+        assert {s[1]["worker"] for s in lag} == {"w0", "w1"}
+        assert all(s[2] == 0 for s in lag)  # fully re-synced
+        occ = fams["lux_serve_engine_cache_occupancy"]["samples"]
+        assert {s[1]["replica"] for s in occ} == {"w0", "w1"}
+        ctl2.close()
+    finally:
+        fleet.close()
+    # ---- the stitched timeline ------------------------------------
+    rec.flush()
+    evs = read_events(rec.run_dir())
+    by = spans_by_name(evs)
+    admits = [e for e in by["live.admit"]
+              if e["a"].get("trace") == wtrace]
+    # original + dedup replay, SAME trace, both under the keyed root
+    assert len(admits) == 2
+    assert [bool(e["a"].get("deduped")) for e in admits].count(True) == 1
+    reps = [e for e in by["live.replicate"]
+            if e["a"].get("trace") == wtrace]
+    assert len(reps) == 2  # one per worker
+    admit_span = admits[0]["a"]["span"]
+    assert all(r["a"]["parent_span"] == admit_span for r in reps)
+    deltas = [e for e in by["worker.delta"]
+              if e["a"].get("trace") == wtrace]
+    assert {d["a"]["parent_span"] for d in deltas} <= {
+        r["a"]["span"] for r in reps}
+    assert {d["a"].get("generation") for d in deltas} == {gen}
+    # the dedup point carries the same trace
+    dpts = [e for e in evs if e.get("e") == "p"
+            and e["n"] == "live.admit.dedup"]
+    assert dpts and dpts[0]["a"]["trace"] == wtrace
+    # the takeover trace: fleet.takeover -> fleet.hello -> worker.hello
+    tko = by["fleet.takeover"][0]
+    ttrace = tko["a"]["trace"]
+    hellos = [e for e in by["fleet.hello"]
+              if e["a"].get("trace") == ttrace]
+    assert len(hellos) == 2
+    assert all(h["a"]["parent_span"] == tko["a"]["span"]
+               for h in hellos)
+    whellos = [e for e in by["worker.hello"]
+               if e["a"].get("trace") == ttrace]
+    assert {w["a"]["parent_span"] for w in whellos} == {
+        h["a"]["span"] for h in hellos}
+    # luxstitch: ONE write trace containing both admits + the hops
+    luxstitch = _load_tool("luxstitch")
+    st = luxstitch.stitch(luxstitch.load_files(sorted(
+        os.path.join(rec.run_dir(), f)
+        for f in os.listdir(rec.run_dir()))))
+    tr = st["traces"][wtrace]
+    names = [sp["name"] for sp in tr["spans"]]
+    assert names.count("live.admit") == 2
+    assert "live.replicate" in names and "worker.delta" in names
+    assert ttrace in st["traces"]
+    tnames = [sp["name"] for sp in st["traces"][ttrace]["spans"]]
+    assert tnames[0] == "fleet.takeover"
+    assert "worker.hello" in tnames
+
+
+# ----------------------------------------------------------------------
+# SLO engine
+# ----------------------------------------------------------------------
+
+
+def test_slo_spec_validation_and_round_trip():
+    s = SLOSpec("lat", "latency", objective=0.95, threshold_ms=100.0)
+    assert SLOSpec.from_dict(s.to_dict()).to_dict() == s.to_dict()
+    specs = specs_from_json(json.dumps([s.to_dict()]))
+    assert specs[0].name == "lat"
+    with pytest.raises(SLOSpecError):
+        SLOSpec("x", "nope")
+    with pytest.raises(SLOSpecError):
+        SLOSpec("x", "availability", objective=1.0)
+    with pytest.raises(SLOSpecError):
+        SLOSpec("x", "latency")  # threshold required
+    with pytest.raises(SLOSpecError):
+        SLOSpec("x", "availability", windows=())
+    with pytest.raises(SLOSpecError):
+        SLOSpec.from_dict({"name": "x", "kind": "availability",
+                           "bogus": 1})
+    with pytest.raises(SLOSpecError):
+        specs_from_json("{}")
+    with pytest.raises(SLOSpecError):
+        SLOEngine([SLOSpec("a", "availability"),
+                   SLOSpec("a", "availability")])
+
+
+def test_slo_burn_rates_multiwindow():
+    clock = [0.0]
+    eng = SLOEngine([
+        SLOSpec("avail", "availability", objective=0.9,
+                windows=((10.0, 2.0), (40.0, 1.5))),
+    ], clock=lambda: clock[0])
+    # 20 s of clean traffic
+    for i in range(20):
+        clock[0] += 1.0
+        eng.observe_query(0.01, ok=True, trace_id=f"g{i}")
+    st = eng.status()[0]
+    assert st["verdict"] == "ok" and st["total"] == 20
+    # exemplar of last resort: the worst traced observation
+    assert len(st["exemplar_traces"]) == 1
+    # now a hot burst: 50% failures for 10 s -> burn 5.0 in the short
+    # window (> 2.0) but the long window still dilutes (warn, not page)
+    for i in range(10):
+        clock[0] += 1.0
+        eng.observe_query(0.01, ok=bool(i % 2), trace_id=f"b{i}")
+    st = eng.status()[0]
+    short = st["windows"]["10s"]
+    assert short["burning"] and short["burn"] > 2.0
+    assert st["verdict"] in ("warn", "burning")
+    assert st["exemplar_traces"]  # the offending traces
+    assert all(t.startswith("b") for t in st["exemplar_traces"])
+    # keep failing long enough and BOTH windows burn -> page
+    for i in range(30):
+        clock[0] += 1.0
+        eng.observe_query(0.01, ok=False, trace_id=f"c{i}")
+    st = eng.status()[0]
+    assert st["verdict"] == "burning"
+    assert all(w["burning"] for w in st["windows"].values())
+
+
+def test_slo_kinds_latency_staleness_write():
+    clock = [0.0]
+    eng = SLOEngine([
+        SLOSpec("lat", "latency", objective=0.5, threshold_ms=50.0,
+                windows=((10.0, 0.9),)),
+        SLOSpec("fresh", "staleness", objective=0.5,
+                windows=((10.0, 1.5),)),
+        SLOSpec("wr", "write_latency", objective=0.5,
+                threshold_ms=100.0, windows=((10.0, 1.5),)),
+    ], clock=lambda: clock[0])
+    for i in range(8):
+        clock[0] += 0.5
+        eng.observe_query(0.2 if i % 2 else 0.001, ok=True,
+                          stale=bool(i % 2), trace_id=f"t{i}")
+        eng.observe_write(0.001, ok=True, trace_id=f"w{i}")
+    rows = {r["name"]: r for r in eng.status()}
+    assert rows["lat"]["bad"] == 4 and rows["lat"]["total"] == 8
+    # 50% slow / 50% budget = burn 1.0, over the 0.9 threshold
+    assert rows["lat"]["verdict"] == "burning"
+    assert rows["fresh"]["bad"] == 4
+    assert rows["wr"]["bad"] == 0 and rows["wr"]["verdict"] == "ok"
+    # writes never feed query specs and vice versa
+    assert rows["wr"]["total"] == 8
+    # errored queries don't pollute latency/staleness, only availability
+    eng.observe_query(None, ok=False, trace_id="e")
+    rows = {r["name"]: r for r in eng.status()}
+    assert rows["lat"]["total"] == 8 and rows["fresh"]["total"] == 8
+    text = "\n".join(eng.prom_lines())
+    assert 'lux_slo_burn_rate{slo="lat",window="10s"}' in text
+    assert 'lux_slo_verdict{slo="lat"} 2' in text
+
+
+def test_slo_no_data_verdict():
+    eng = SLOEngine(default_fleet_slos())
+    assert {r["verdict"] for r in eng.status()} == {"no_data"}
+
+
+def test_failed_admit_scores_write_slo(small, tmp_path):
+    """An admit that RAISES (invalid batch, replication failure) is
+    write_ack-BAD: a fleet where every write fails must not report
+    'ok'/'no_data' from slo_status() — the same honesty submit keeps
+    for availability by resolving sheds into the future."""
+    g, _sh = small
+    root = str(tmp_path / "f")
+    fleet = start_live_fleet(1, g, parts=2, cap=64, journal_root=root,
+                             snapshot_path=os.path.join(root, "s.lux"))
+    ctl = fleet.controller
+    ctl.set_slos(default_fleet_slos())
+    try:
+        # an edge absent in BOTH orientations: deleting it raises from
+        # the journal apply, nothing journaled, no generation burned
+        have = set()
+        for d in range(g.nv):
+            for s in g.col_idx[g.row_ptr[d]:g.row_ptr[d + 1]]:
+                have.add((int(s), int(d)))
+        s, d = next((a, b) for a in range(g.nv) for b in range(g.nv)
+                    if a != b and (a, b) not in have
+                    and (b, a) not in have)
+        with pytest.raises(KeyError):
+            ctl.admit_writes(np.array([s]), np.array([d]),
+                             np.zeros(1, np.int8))
+        row = {r["name"]: r for r in ctl.slo_status()}["write_ack"]
+        assert (row["bad"], row["total"]) == (1, 1)
+        # and a later good write scores good against the same spec
+        ctl.admit_writes(np.array([s]), np.array([d]),
+                         np.ones(1, np.int8))
+        row = {r["name"]: r for r in ctl.slo_status()}["write_ack"]
+        assert (row["bad"], row["total"]) == (1, 2)
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# scrape() + exemplars (the metrics satellites)
+# ----------------------------------------------------------------------
+
+
+def test_scrape_fresh_start_never_empty():
+    m = ServeMetrics()
+    text = m.scrape(queue_depth=0, replica="w9")
+    fams = prom_parse(text)
+    # mid-burst/fresh scrape carries the live state dump() omits
+    assert fams["lux_serve_qps"]["samples"][0][2] == 0.0
+    assert fams["lux_serve_queue_depth"]["samples"][0][1] == {
+        "replica": "w9"}
+    assert fams["lux_serve_requests_completed_total"][
+        "samples"][0][2] == 0
+    # and qps becomes real once traffic lands, with no snapshot needed
+    m.record_done(0.01, 0.001, traversed=5)
+    fams = prom_parse(m.scrape(queue_depth=2))
+    assert fams["lux_serve_qps"]["samples"][0][2] > 0
+    assert fams["lux_serve_queue_depth"]["samples"][0][2] == 2
+
+
+def test_latency_exemplars_in_dump():
+    m = ServeMetrics()
+    m.record_done(0.004, 0.001, traversed=1, trace="abc123")
+    m.record_done(0.3, 0.001, traversed=1)  # untraced: no exemplar
+    text = m.dump()
+    line = next(l for l in text.splitlines()
+                if 'trace_id="abc123"' in l)
+    assert "lux_serve_request_latency_seconds_bucket" in line
+    assert line.split(" # ")[0].endswith(" 1")
+    assert m.exemplars()[0.005][0] == "abc123"
+    prom_parse(text)  # exemplar suffix must not break parsing
+    gauges = [("lux_live_generation_lag", 3, "lag")]
+    fams = prom_parse(m.scrape(extra_gauges=gauges, replica="w0"))
+    assert fams["lux_live_generation_lag"]["samples"][0] == (
+        "lux_live_generation_lag", {"replica": "w0"}, 3.0)
+
+
+def test_fault_inject_point_carries_seed(rec):
+    plan = FaultPlan([FaultRule("proc", "delay", point="p.x",
+                                delay_ms=0.0)], seed=42, name="s")
+    with fault.installed(plan):
+        fault.ppoint("p.x")
+    rec.flush()
+    evs = [e for e in read_events(rec.run_dir())
+           if e.get("e") == "p" and e["n"] == "fault.inject"]
+    assert evs and evs[0]["a"]["seed"] == 42
+    assert evs[0]["a"]["plan"] == "s"
+
+
+# ----------------------------------------------------------------------
+# LUX-O005: trace contexts must stay out of traced bodies
+# ----------------------------------------------------------------------
+
+
+_O005_BAD = """
+import jax
+from lux_tpu.obs import dtrace
+
+@jax.jit
+def step(x):
+    ctx = dtrace.mint(key="inside")
+    return x + 1
+"""
+
+_O005_CLEAN = """
+import jax
+from lux_tpu.obs import dtrace
+
+def serve(x):
+    ctx = dtrace.mint(key="outside")
+    with dtrace.tspan("serve", ctx):
+        return _step(x)
+
+@jax.jit
+def _step(x):
+    return x + 1
+"""
+
+
+def test_luxo005_seeded_and_clean(tmp_path):
+    from lux_tpu.analysis import check_paths
+    from lux_tpu.analysis.obs import ObsChecker
+
+    def run(source, name):
+        p = tmp_path / name
+        p.write_text(source)
+        return check_paths([str(p)], str(tmp_path),
+                           checkers=[ObsChecker()])
+
+    finds = run(_O005_BAD, "bad.py")
+    assert [f.code for f in finds] == ["LUX-O005"]
+    assert "trace-context" in finds[0].message
+    assert not run(_O005_CLEAN, "clean.py")

@@ -325,6 +325,56 @@ echo "$out" | grep -q "\[PASS\] fault smoke" || { echo "fault smoke failed"; exi
 echo "$out"
 '
 
+# 3d2) dtrace smoke (ISSUE 15): a 2-worker thread fleet serves a
+#      TRACED burst through an injected wire delay; luxstitch must
+#      merge the per-process logs into causally-linked timelines
+#      (request -> attempt -> worker spans, the injected fault visible
+#      with its plan + seed) and luxview must render the cross-process
+#      waterfall — the tool half runs JAX-FREE
+stage dtrace_smoke 600 bash -c '
+set -e
+export LUX_OBS_RUN_ID=ci_dtrace_$$
+JAX_PLATFORMS=cpu python -c "
+import numpy as np
+from lux_tpu import fault
+from lux_tpu.fault.plan import FaultPlan, FaultRule
+from lux_tpu.graph import generate
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models.sssp import bfs_reference
+from lux_tpu.obs.slo import default_fleet_slos
+from lux_tpu.serve.fleet.bench import start_fleet
+g = generate.rmat(8, 4, seed=4)
+shards = build_pull_shards(g, 2)
+fleet = start_fleet(2, shards=shards, graph_id=\"g\", mode=\"thread\",
+                    buckets=(1, 4))
+ctl = fleet.controller
+ctl.set_slos(default_fleet_slos())
+try:
+    with fault.installed(FaultPlan([FaultRule(
+            \"wire.recv\", \"delay\", op=\"query\", delay_ms=3.0)],
+            name=\"ci_dtrace\", seed=7)):
+        for s in (0, 3, 7, 9):
+            f = ctl.submit(s, request_id=f\"ci-{s}\")
+            assert np.array_equal(f.result(timeout=60),
+                                  bfs_reference(g, s)), s
+            assert f.trace_id, \"query was not traced\"
+    st = ctl.slo_status()
+    assert any(r[\"exemplar_traces\"] for r in st), st
+    print(\"[PASS] dtrace burst:\",
+          {r[\"name\"]: r[\"verdict\"] for r in st})
+finally:
+    fleet.close()
+"
+out=$(python tools/luxstitch.py "$LUX_OBS_RUN_ID")
+echo "$out" | grep -q "fleet.request" || { echo "missing request span"; exit 1; }
+echo "$out" | grep -q "worker.query" || { echo "missing worker span"; exit 1; }
+echo "$out" | grep -q "FAULT wire.recv/delay" || { echo "missing fault point"; exit 1; }
+echo "$out" | grep -q "seed=7" || { echo "missing fault seed"; exit 1; }
+view=$(python tools/luxview.py "$LUX_OBS_RUN_ID")
+echo "$view" | grep -q "## Distributed traces" || { echo "missing luxview section"; exit 1; }
+echo "$view" | grep -q "fleet.request" || { echo "luxview missing trace"; exit 1; }
+'
+
 # 3e) program smoke (ISSUE 13): one spec-only workload end-to-end
 #     through the GENERIC driver on a tiny graph — the declarative
 #     compiler's whole path (spec -> program -> engine -> [PASS] check)
@@ -352,7 +402,7 @@ stage tier1_fast 1200 env JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_obs.py tests/test_program.py \
     tests/test_determinism.py tests/test_serve_scheduler.py \
     tests/test_fleet.py tests/test_mutate.py tests/test_live.py \
-    tests/test_fault.py
+    tests/test_fault.py tests/test_dtrace.py
 
 if [ "$FAILED" -ne 0 ]; then
   echo "ci_check: FAILED (see $LOG)"; exit 1

@@ -1,0 +1,435 @@
+#!/usr/bin/env python
+"""luxstitch — merge N per-process luxtrace event logs into one
+causally-ordered fleet timeline.
+
+Usage:
+    python tools/luxstitch.py <run_id | run dir> [--json FILE]
+    python tools/luxstitch.py <run dir> --trace <trace_id>
+    python tools/luxstitch.py --latest
+
+Every process of a fleet run (controller, each worker, the bench
+orchestrator) writes its own ``events-<pid>.jsonl`` under one run dir;
+traced hops (``lux_tpu/obs/dtrace.py``) record spans carrying
+``trace``/``span``/``parent_span`` attrs, and the wire layer stamps a
+``dtrace.send``/``dtrace.recv`` point pair per traced frame.  This tool:
+
+1. loads every event file, attributing each event to its process (the
+   ``m`` meta line's pid);
+2. **corrects clock skew**: for each process pair exchanging traced
+   frames, a send at (corrected) time g1 must precede its recv at g2 —
+   min over A->B frames of (recv - send) bounds offset(B) - offset(A)
+   from above by transit, and the reverse direction bounds it from
+   below; the midpoint of the two one-way minima is the classic
+   NTP-style estimate, propagated BFS from a reference process (on one
+   Linux host CLOCK_MONOTONIC is system-wide and the offsets come out
+   ~0; across machines this is what makes the merged ordering honest);
+3. groups spans by ``trace`` id and orders each trace causally —
+   parents before children, siblings by corrected start time — and
+   interleaves the ``fault.inject`` points whose firing falls inside
+   the trace's time range, so an injected fault is visible NEXT TO the
+   spans it perturbed, with its plan name + seed (the reproduction);
+4. renders the cross-process waterfall (or emits the whole stitched
+   structure as JSON for tooling).
+
+Pure stdlib and jax-free like luxview (same bare-package stub): a
+post-mortem stitch must run on any host.  luxview imports this module
+for its "Distributed traces" section.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import _jaxfree  # noqa: E402
+
+_rec = _jaxfree.load("lux_tpu.obs.recorder")
+
+
+def load_files(paths):
+    """Per-process event load: [{pid, meta, spans{sid->span},
+    points[...]}] — like luxview.load_events but KEEPING the process
+    attribution the skew solver needs (luxview's flat merge drops it)."""
+    out = []
+    for path in paths:
+        pid = None
+        spans = {}
+        points = []
+        meta = None
+        order = 0
+        try:
+            f = open(path, encoding="utf-8")
+        except OSError:
+            continue
+        with f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    ev = json.loads(raw)
+                except ValueError:
+                    continue  # torn final line of a killed process
+                kind = ev.get("e")
+                if kind == "m":
+                    if meta is None:
+                        meta = ev
+                        pid = ev.get("pid")
+                elif kind == "b":
+                    spans[ev.get("s")] = {
+                        "sid": ev.get("s"), "name": ev.get("n", "?"),
+                        "t0": float(ev.get("t", 0.0)), "t1": None,
+                        "ok": None, "attrs": ev.get("a", {}) or {},
+                        "end_attrs": {}, "pid": pid, "order": order}
+                    order += 1
+                elif kind == "e":
+                    sp = spans.get(ev.get("s"))
+                    if sp is not None:
+                        sp["t1"] = float(ev.get("t", 0.0))
+                        sp["ok"] = bool(ev.get("ok", True))
+                        sp["end_attrs"] = ev.get("a", {}) or {}
+                elif kind == "p":
+                    points.append({"name": ev.get("n", "?"),
+                                   "t": float(ev.get("t", 0.0)),
+                                   "attrs": ev.get("a", {}) or {},
+                                   "pid": pid})
+        if meta is not None or spans or points:
+            out.append({"pid": pid, "meta": meta, "spans": spans,
+                        "points": points, "path": path})
+    return out
+
+
+# ----------------------------------------------------------------------
+# clock-skew correction
+# ----------------------------------------------------------------------
+
+
+def solve_offsets(files):
+    """{pid: correction seconds} such that ``t + correction`` is on the
+    shared timeline (reference = the first pid, correction 0).
+
+    Bounds come from the dtrace.send/recv pairs: a frame's span id is
+    stamped once on each side, so for processes A != B,
+
+        (t_recv + c_B) - (t_send + c_A) = transit >= 0
+        =>  c_B - c_A >= t_send - t_recv      (for every A->B frame)
+
+    and the reverse direction gives the upper bound; the estimate is
+    the midpoint of the tightest pair (standard one-way-delay
+    symmetrization).  Pairs whose span id appears more than once per
+    direction (barrier frames fanning one context to N workers) are
+    skipped as ambiguous.  Processes with no traced exchange keep
+    correction 0 (same-host monotonic is already shared)."""
+    sends = collections.defaultdict(list)  # span -> [(pid, t)]
+    recvs = collections.defaultdict(list)
+    for f in files:
+        for p in f["points"]:
+            if p["name"] == "dtrace.send":
+                sends[p["attrs"].get("span")].append((p["pid"], p["t"]))
+            elif p["name"] == "dtrace.recv":
+                recvs[p["attrs"].get("span")].append((p["pid"], p["t"]))
+    #: (A, B) -> min over frames of (t_recv_B - t_send_A)
+    lo = {}
+    for span, snd in sends.items():
+        rcv = recvs.get(span)
+        if rcv is None or len(snd) != 1 or len(rcv) != 1:
+            continue  # unmatched or ambiguous (fan-out frame)
+        (pa, ts), (pb, tr) = snd[0], rcv[0]
+        if pa == pb or pa is None or pb is None:
+            continue
+        d = tr - ts
+        key = (pa, pb)
+        if key not in lo or d < lo[key]:
+            lo[key] = d
+    pids = sorted({p for f in files if f["pid"] is not None
+                   for p in [f["pid"]]})
+    offsets = {p: 0.0 for p in pids}
+    if not lo or not pids:
+        return offsets
+    # adjacency over measured pairs; BFS from the reference pid
+    adj = collections.defaultdict(set)
+    for a, b in lo:
+        adj[a].add(b)
+        adj[b].add(a)
+    seen = set()
+    for root in pids:
+        if root in seen:
+            continue
+        seen.add(root)
+        queue = [root]
+        while queue:
+            a = queue.pop(0)
+            for b in adj[a]:
+                if b in seen:
+                    continue
+                d_ab = lo.get((a, b))  # bound: c_b - c_a >= -d_ab
+                d_ba = lo.get((b, a))  # bound: c_b - c_a <= +d_ba
+                if d_ab is not None and d_ba is not None:
+                    delta = (d_ba - d_ab) / 2.0
+                elif d_ab is not None:
+                    delta = -d_ab  # one-sided: assume zero transit
+                else:
+                    delta = d_ba
+                offsets[b] = offsets[a] + delta
+                seen.add(b)
+                queue.append(b)
+    return offsets
+
+
+# ----------------------------------------------------------------------
+# the stitch
+# ----------------------------------------------------------------------
+
+
+def stitch(files):
+    """The merged structure::
+
+        {offsets: {pid: seconds},
+         traces: {trace_id: {spans: [span...causal order...],
+                             t0, t1, faults: [point...]}},
+         spans: {sid: span},    # every span, corrected times
+         points: [point...]}    # every point, corrected times
+
+    Span dicts gain ``g0``/``g1`` (corrected times) and ``trace``/
+    ``span``/``parent_span`` lifted out of attrs."""
+    offsets = solve_offsets(files)
+    all_spans = {}
+    all_points = []
+    for f in files:
+        c = offsets.get(f["pid"], 0.0)
+        for sid, sp in f["spans"].items():
+            sp = dict(sp)
+            sp["g0"] = sp["t0"] + c
+            sp["g1"] = None if sp["t1"] is None else sp["t1"] + c
+            a = sp["attrs"]
+            sp["trace"] = a.get("trace")
+            sp["span"] = a.get("span")
+            sp["parent_span"] = a.get("parent_span")
+            all_spans[sid] = sp
+        for p in f["points"]:
+            p = dict(p)
+            p["g"] = p["t"] + c
+            all_points.append(p)
+    all_points.sort(key=lambda p: p["g"])
+
+    traces = {}
+    by_trace = collections.defaultdict(list)
+    for sp in all_spans.values():
+        if sp["trace"] is not None:
+            by_trace[sp["trace"]].append(sp)
+    for tid, spans in by_trace.items():
+        ordered = _causal_order(spans)
+        t0 = min(sp["g0"] for sp in spans)
+        t1 = max([sp["g1"] for sp in spans if sp["g1"] is not None]
+                 or [t0])
+        faults = [p for p in all_points
+                  if p["name"] == "fault.inject"
+                  and t0 - 0.05 <= p["g"] <= t1 + 0.05]
+        traces[tid] = {"spans": ordered, "t0": t0, "t1": t1,
+                       "faults": faults,
+                       "pids": sorted({sp["pid"] for sp in spans
+                                       if sp["pid"] is not None})}
+    return {"offsets": offsets, "traces": traces, "spans": all_spans,
+            "points": all_points}
+
+
+def _causal_order(spans):
+    """Parents before children; siblings (and spans whose parent is in
+    another — unrecorded — hop) by corrected start time.  Duplicated
+    dtrace span ids (a replayed keyed root) stay distinct luxtrace
+    spans and sort by time."""
+    by_id = collections.defaultdict(list)
+    for sp in spans:
+        if sp["span"] is not None:
+            by_id[sp["span"]].append(sp)
+    roots = []
+    children = collections.defaultdict(list)
+    for sp in spans:
+        parent = sp["parent_span"]
+        if parent is not None and parent in by_id:
+            children[parent].append(sp)
+        else:
+            roots.append(sp)
+    roots.sort(key=lambda s: s["g0"])
+    out = []
+    seen = set()
+
+    def emit(sp, depth):
+        key = id(sp)
+        if key in seen:
+            return
+        seen.add(key)
+        sp = dict(sp)
+        sp["depth"] = depth
+        out.append(sp)
+        kids = sorted(children.get(sp["span"], []),
+                      key=lambda s: s["g0"])
+        for k in kids:
+            emit(k, depth + 1)
+
+    for r in roots:
+        emit(r, 0)
+    return out
+
+
+# ----------------------------------------------------------------------
+# rendering
+# ----------------------------------------------------------------------
+
+
+def _fmt_attrs(attrs, limit=5):
+    drop = ("trace", "span", "parent_span")
+    items = [(k, v) for k, v in attrs.items()
+             if k not in drop and not isinstance(v, (list, dict))]
+    if not items:
+        return ""
+    return "  [" + ", ".join(f"{k}={v}" for k, v in items[:limit]) + "]"
+
+
+def render_trace(tid, tr, out, t_base=None):
+    """One trace's cross-process waterfall: corrected offsets from the
+    trace start, pid column, causal indentation, fault injections
+    interleaved at their corrected times."""
+    t0 = tr["t0"] if t_base is None else t_base
+    out.append(f"### trace {tid}  — {len(tr['spans'])} span(s) across "
+               f"{len(tr['pids'])} process(es) "
+               f"{tr['pids']}, {tr['t1'] - tr['t0']:.3f}s")
+    rows = []
+    for sp in tr["spans"]:
+        state = ""
+        if sp["g1"] is None:
+            state = "  ** OPEN **"
+        elif sp["ok"] is False:
+            state = "  !! failed"
+        dur = (sp["g1"] - sp["g0"]) if sp["g1"] is not None else 0.0
+        rows.append((sp["g0"], 0,
+                     f"  {sp['g0'] - t0:+9.4f}s  [{sp['pid']}] "
+                     f"{'  ' * sp['depth']}{sp['name']:<28} "
+                     f"{dur * 1e3:9.2f}ms"
+                     f"{_fmt_attrs({**sp['attrs'], **sp['end_attrs']})}"
+                     f"{state}"))
+    for p in tr["faults"]:
+        a = p["attrs"]
+        rows.append((p["g"], 1,
+                     f"  {p['g'] - t0:+9.4f}s  [{p['pid']}] "
+                     f"~~ FAULT {a.get('site')}/{a.get('action')} "
+                     f"plan={a.get('plan')} seed={a.get('seed')}"
+                     f"{_fmt_attrs({k: v for k, v in a.items() if k not in ('site', 'action', 'plan', 'seed', 'note')})}"))
+    # interleave by corrected time, but keep the causal span order when
+    # clocks tie (faults sort after the span that was running)
+    for _, _, line in sorted(rows, key=lambda r: (r[0], r[1])):
+        out.append(line)
+    out.append("")
+
+
+def render(stitched, max_traces=20):
+    out = []
+    offs = stitched["offsets"]
+    traces = stitched["traces"]
+    out.append(f"# luxstitch — {len(traces)} trace(s), "
+               f"{len(stitched['spans'])} span(s), "
+               f"{len(offs)} process(es)")
+    nonzero = {p: round(c, 6) for p, c in offs.items() if c}
+    out.append(f"- clock corrections (s): "
+               f"{nonzero if nonzero else 'none needed (shared clock)'}")
+    out.append("")
+    ordered = sorted(traces.items(),
+                     key=lambda kv: (-len(kv[1]["spans"]), kv[1]["t0"]))
+    for tid, tr in ordered[:max_traces]:
+        render_trace(tid, tr, out)
+    if len(ordered) > max_traces:
+        out.append(f"... ({len(ordered) - max_traces} more trace(s); "
+                   "--trace <id> for one)")
+    return "\n".join(out) + "\n"
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+
+def resolve_target(target, root, latest):
+    if latest:
+        runs = [r for r in glob.glob(os.path.join(root, "*"))
+                if os.path.isdir(r)]
+        runs.sort(key=os.path.getmtime)
+        if not runs:
+            return [], root
+        target = runs[-1]
+    if target is None:
+        return [], root
+    if os.path.isfile(target):
+        return [target], target
+    d = target if os.path.isdir(target) else os.path.join(root, target)
+    if os.path.isdir(d):
+        return sorted(glob.glob(os.path.join(d, "events-*.jsonl"))), d
+    return [], target
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-process luxtrace logs into one "
+                    "causally-ordered, skew-corrected fleet timeline")
+    ap.add_argument("target", nargs="?",
+                    help="run id, run dir, or events-*.jsonl file")
+    ap.add_argument("--latest", action="store_true",
+                    help="newest run under the event-log root")
+    ap.add_argument("--root", default=None,
+                    help="event-log root (default: LUX_OBS_DIR or the "
+                         "uid-scoped tmp dir)")
+    ap.add_argument("--trace", default=None,
+                    help="render only this trace id")
+    ap.add_argument("--json", default=None,
+                    help="write the stitched structure as JSON here")
+    ap.add_argument("--out", default=None,
+                    help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+
+    root = args.root or _rec.default_root()
+    if not args.target and not args.latest:
+        ap.print_usage(sys.stderr)
+        print("error: give a run id/dir/file or --latest",
+              file=sys.stderr)
+        return 2
+    paths, label = resolve_target(args.target, root, args.latest)
+    if not paths:
+        print(f"luxstitch: no event files for "
+              f"{args.target or '--latest'} (root {root})",
+              file=sys.stderr)
+        return 2
+    stitched = stitch(load_files(paths))
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(stitched, f, default=str)
+        print(f"luxstitch: stitched JSON -> {args.json} "
+              f"({len(stitched['traces'])} traces)")
+    if args.trace:
+        tr = stitched["traces"].get(args.trace)
+        if tr is None:
+            print(f"luxstitch: no trace {args.trace!r} in {label} "
+                  f"(have: {sorted(stitched['traces'])[:10]}...)",
+                  file=sys.stderr)
+            return 2
+        out = []
+        render_trace(args.trace, tr, out)
+        report = "\n".join(out) + "\n"
+    else:
+        report = render(stitched)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(report)
+        print(f"luxstitch: report -> {args.out}")
+    else:
+        sys.stdout.write(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,9 +9,12 @@ Usage:
 The report sections, in order: post-mortem (spans left OPEN by a dead
 process — an aborted chip window's first question), the phase waterfall
 (every span, nested, with offsets/durations on the shared monotonic
-clock), per-iteration telemetry curves (the on-device rings flushed at
-run end), the XProf kernel-attribution table, the last serving-metrics
-snapshot, and the bench rows that carried this run_id.
+clock), distributed traces (the cross-process waterfalls luxstitch
+builds from the fleet's trace-context span attrs, skew-corrected, with
+fault injections interleaved), per-iteration telemetry curves (the
+on-device rings flushed at run end), the XProf kernel-attribution
+table, the last serving-metrics snapshot, and the bench rows that
+carried this run_id.
 
 Pure stdlib and jax-free (the same bare-package stub as luxcheck): a
 post-mortem must render on a host whose jax install or device tunnel is
@@ -32,6 +35,7 @@ if _TOOLS not in sys.path:
     sys.path.insert(0, _TOOLS)
 
 import _jaxfree  # noqa: E402
+import luxstitch  # noqa: E402  — the stitcher library (jax-free too)
 
 REPO = _jaxfree.REPO
 _rec = _jaxfree.load("lux_tpu.obs.recorder")
@@ -294,7 +298,30 @@ def render_bench(points, out: list) -> None:
                    f"{a.get('method', '')}")
 
 
-def render(metas, spans, points, bad, label: str) -> str:
+def render_dtraces(stitched, out: list, max_traces: int = 8) -> None:
+    """The cross-process waterfalls (luxstitch): one block per
+    distributed trace, largest first."""
+    traces = (stitched or {}).get("traces") or {}
+    if not traces:
+        out.append("(no distributed traces in this log — fleet frames "
+                   "record them when LUX_DTRACE is on)")
+        return
+    offs = {p: round(c, 6)
+            for p, c in stitched["offsets"].items() if c}
+    out.append(f"{len(traces)} trace(s); clock corrections: "
+               f"{offs if offs else 'none (shared clock)'}")
+    out.append("")
+    ordered = sorted(traces.items(),
+                     key=lambda kv: (-len(kv[1]["spans"]),
+                                     kv[1]["t0"]))
+    for tid, tr in ordered[:max_traces]:
+        luxstitch.render_trace(tid, tr, out)
+    if len(ordered) > max_traces:
+        out.append(f"... ({len(ordered) - max_traces} more; "
+                   "tools/luxstitch.py renders them all)")
+
+
+def render(metas, spans, points, bad, label: str, stitched=None) -> str:
     out = []
     run = metas[0].get("run") if metas else "?"
     out.append(f"# luxtrace report — run {run}")
@@ -331,6 +358,10 @@ def render(metas, spans, points, bad, label: str) -> str:
     out.append("## Phase waterfall")
     out.append("")
     render_waterfall(spans, out)
+    out.append("")
+    out.append("## Distributed traces")
+    out.append("")
+    render_dtraces(stitched, out)
     out.append("")
     out.append("## On-device iteration telemetry")
     out.append("")
@@ -411,7 +442,8 @@ def main(argv=None) -> int:
               f"{args.target or '--latest'} (root {root})", file=sys.stderr)
         return 2
     metas, spans, points, bad = load_events(files)
-    report = render(metas, spans, points, bad, label)
+    stitched = luxstitch.stitch(luxstitch.load_files(files))
+    report = render(metas, spans, points, bad, label, stitched=stitched)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(report)
