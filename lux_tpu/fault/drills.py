@@ -16,6 +16,9 @@ test prints instead of logic buried in a monkeypatch:
   npz half-written straight to its final name (a non-atomic writer /
   reordered flush), then the crash — replay must drop exactly that
   batch and keep the prefix.
+* :func:`controller_kill_at_heartbeat` — ISSUE 16's election drill:
+  kill the controller at its Nth heartbeat sweep; a standby (not the
+  harness) must detect the silence and promote a successor.
 * :func:`wire_chaos`              — the chaos soak's background noise:
   seeded probabilistic frame delays/drops on query traffic.
 
@@ -65,6 +68,22 @@ def torn_journal_write(owner: Optional[str] = None,
         after=max(int(nth) - 1, 0), count=1,
         note="PR10 drill: torn journal write (partial npz, no marker)")],
         seed=seed, name="torn_journal_write")
+
+
+def controller_kill_at_heartbeat(nth: int = 3, seed: int = 0
+                                 ) -> FaultPlan:
+    """ISSUE 16's election drill: kill the CONTROLLER at its ``nth``
+    heartbeat sweep (the ``controller.heartbeat`` proc point at the top
+    of ``_hb_loop``) — mid-flight, not at a quiet boundary.  Bind the
+    trigger: ``plan.bind("kill:controller", ctl.kill)``; a Standby
+    (serve/autopilot/election.py) must then detect the silence and
+    promote, with the harness doing NOTHING."""
+    return FaultPlan([FaultRule(
+        "proc", "kill", owner="controller",
+        point="controller.heartbeat", after=max(int(nth) - 1, 0),
+        count=1, callback="kill:controller",
+        note=f"ISSUE16 drill: kill controller at heartbeat #{nth}")],
+        seed=seed, name="controller_kill_at_heartbeat")
 
 
 def wire_chaos(seed: int, delay_ms: float = 3.0, delay_prob: float = 0.10,

@@ -207,6 +207,23 @@ def mint(key: Optional[str] = None) -> Optional[TraceContext]:
     return TraceContext(trace_id, span_id, flags=flags)
 
 
+def incident(key: str) -> Optional[TraceContext]:
+    """A keyed ROOT context for an operational INCIDENT (ISSUE 16): an
+    autopilot scale action, a controller election, a policy-mode
+    switch.  Identical to ``mint(key)`` except the head-sampling
+    decision is forced ON: ``LUX_DTRACE_SAMPLE`` exists to thin the
+    per-REQUEST trace store, and autonomous control actions are orders
+    of magnitude rarer than requests — a fleet that scaled itself or
+    elected a controller must ALWAYS be able to render that incident
+    as one stitched timeline, whatever the request sampling dial says.
+    Still None when tracing is disabled outright (``LUX_DTRACE=0``)."""
+    if not enabled():
+        return None
+    return TraceContext(_hex_hash(f"lux:{key}", 8),
+                        _hex_hash(f"lux:{key}/root", 6),
+                        flags=FLAG_SAMPLED)
+
+
 def wire_ctx(msg: dict) -> Optional[TraceContext]:
     """The context a received frame carries (``msg['tc']``), or None."""
     tc = msg.get("tc")

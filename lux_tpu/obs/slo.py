@@ -322,6 +322,11 @@ class SLOEngine:
                 out.append(row)
         return out
 
+    def verdicts(self, now: Optional[float] = None) -> Dict[str, str]:
+        """``{spec name: verdict}`` — the compact form the autopilot
+        policy/autoscaler layers (ISSUE 16) match rules against."""
+        return {r["name"]: r["verdict"] for r in self.status(now=now)}
+
     def prom_lines(self) -> List[str]:
         """The verdicts as Prometheus gauges (merged into the
         controller's own exposition): burn per (slo, window), and a
@@ -347,6 +352,23 @@ class SLOEngine:
                           f"# TYPE {name} gauge"])
             lines.extend(f'{name}{{slo="{s}"}} {v}' for s, v in verd)
         return lines
+
+
+#: verdict severity order, mildest first — ``worst_verdict`` and the
+#: autoscaler's hot/idle decision rank against this
+VERDICT_ORDER = ("no_data", "ok", "warn", "burning")
+
+
+def worst_verdict(rows) -> str:
+    """The most severe verdict across status rows (``"no_data"`` for an
+    empty set) — the one-word fleet health the autopilot layers key
+    their decisions on."""
+    worst = 0
+    for r in rows:
+        v = r.get("verdict") if isinstance(r, dict) else str(r)
+        if v in VERDICT_ORDER:
+            worst = max(worst, VERDICT_ORDER.index(v))
+    return VERDICT_ORDER[worst]
 
 
 def default_fleet_slos(read_p99_ms: float = 500.0,

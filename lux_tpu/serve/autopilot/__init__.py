@@ -1,0 +1,37 @@
+"""luxpilot — the self-driving fleet (ISSUE 16).
+
+The closed control loop over the serving fleet's own telemetry:
+
+* :mod:`.policy` — admission policy as JSON-round-trip data: which
+  degraded mode (serve / queue / stale_degrade / shed) each SLO
+  verdict buys, evaluated on the controller's heartbeat cadence;
+* :mod:`.autoscaler` — SLO- and occupancy-driven scale decisions with
+  hysteresis, cooldown and a rebalance-preview move budget;
+* :mod:`.election` — standby controllers that detect incumbent death
+  and run a deterministic, incarnation-fenced election through
+  ``promote_live_controller``;
+* :mod:`.subscribe` — standing-query subscriptions: register once,
+  get pushed every refreshed answer with the generation tag as
+  cursor, surviving controller elections via hub rebind.
+
+Every autonomous action (scale, elect, promote, policy switch,
+subscription push) emits a causally-linked dtrace span on a keyed
+incident trace, so ``luxstitch`` renders one timeline per incident.
+"""
+from lux_tpu.serve.autopilot.autoscaler import (Autoscaler,
+                                                AutoscalerConfig)
+from lux_tpu.serve.autopilot.election import (Standby, StandbyGroup,
+                                              live_promoter)
+from lux_tpu.serve.autopilot.policy import (MODES, AdmissionPolicy,
+                                            PolicyError, PolicyRule,
+                                            default_fleet_policy)
+from lux_tpu.serve.autopilot.subscribe import (Subscription,
+                                               SubscriptionClosed,
+                                               SubscriptionHub)
+
+__all__ = [
+    "AdmissionPolicy", "Autoscaler", "AutoscalerConfig", "MODES",
+    "PolicyError", "PolicyRule", "Standby", "StandbyGroup",
+    "Subscription", "SubscriptionClosed", "SubscriptionHub",
+    "default_fleet_policy", "live_promoter",
+]

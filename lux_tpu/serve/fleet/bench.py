@@ -598,3 +598,137 @@ def measure_fleet_saturation(scale: int = 12, ef: int = 8,
             # sequential-knee ratio the host noise owns
             out["scaleup_2v1"] = row["paired_vs_w1"]["median_ratio"]
     return out
+
+
+# ----------------------------------------------------------------------
+# the autoscale ramp (ISSUE 16)
+# ----------------------------------------------------------------------
+
+
+def measure_autoscale(scale: int = 10, ef: int = 8, parts: int = 2,
+                      start_workers: int = 1, max_workers: int = 2,
+                      buckets: Sequence[int] = (1, 8),
+                      start_qps: float = 8.0, growth: float = 1.6,
+                      max_levels: int = 10, window_s: float = 1.0,
+                      overload_factor: float = 1.5,
+                      overload_levels: int = 2,
+                      max_shed_frac: float = 0.5,
+                      seed: int = 0) -> dict:
+    """The closed-loop bench (ISSUE 16 acceptance): measure the knee at
+    ``start_workers``, install the default AdmissionPolicy plus an
+    Autoscaler fed that knee, offer load ABOVE it, and let the pilot
+    act — the scaler must spawn+join replicas (previewed, cooldown-
+    gated), then a second ramp measures the recovered knee.  The row
+    records knee-before, knee-after, every scale action, and the shed
+    fraction of the overload window against the policy's
+    ``max_shed_frac`` budget (``shed_bounded`` is the acceptance bit).
+
+    Thread-mode workers by design: the pilot's spawn callable must
+    build replicas in-process, and the knee COMPARISON (not its
+    absolute value) is the datapoint."""
+    from lux_tpu import obs
+    from lux_tpu.graph import generate
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.serve.autopilot import (
+        Autoscaler,
+        AutoscalerConfig,
+        default_fleet_policy,
+    )
+    from lux_tpu.serve.benchmarks import pick_sources
+    from lux_tpu.serve.fleet.worker import ReplicaWorker
+
+    g = generate.rmat(scale, ef, seed=seed)
+    sources = pick_sources(g, 256, seed=seed)
+    shards = build_pull_shards(g, parts)
+    gid = f"rmat{scale}"
+    fleet = start_fleet(start_workers, shards=shards, graph_id=gid,
+                        mode="thread", parts=parts, buckets=buckets)
+    ctl = fleet.controller
+    policy = default_fleet_policy(max_shed_frac=max_shed_frac)
+    ctl.set_slos(default_fleet_slos())
+    ctl.set_policy(policy)
+
+    def spawn(i: int):
+        w = ReplicaWorker(
+            shards, worker_id=f"w{start_workers + i}", graph_id=gid,
+            q_buckets=tuple(buckets)).start()
+        fleet.thread_workers.append(w)
+        return w
+
+    scaler = Autoscaler(
+        ctl, spawn,
+        config=AutoscalerConfig(
+            min_workers=start_workers, max_workers=max_workers,
+            up_consecutive=2, down_consecutive=1000, cooldown_s=0.0,
+            max_move_frac=0.95))
+    try:
+        with obs.span("fleet.bench.autoscale", scale=scale,
+                      start_workers=start_workers,
+                      max_workers=max_workers):
+            before = ramp_to_knee(
+                ctl, sources, start_qps=start_qps, growth=growth,
+                max_levels=max_levels, window_s=window_s)
+            scaler.set_capacity(before["knee_qps"])
+            overload_qps = before["knee_qps"] * float(overload_factor)
+            scaler.note_offered_qps(overload_qps)
+            shed = submitted = 0
+            overload = []
+            for i in range(int(overload_levels)):
+                with obs.span("fleet.bench.overload", level=i,
+                              offered=round(overload_qps, 1)):
+                    lv = offered_level(ctl, sources, overload_qps,
+                                       window_s)
+                overload.append(lv)
+                shed += lv["shed"]
+                submitted += lv["submitted"]
+                act = scaler.tick()
+                if act is not None:
+                    overload[-1]["scale_action"] = act
+                if len(ctl.live_workers()) >= max_workers:
+                    break
+            scaler.note_offered_qps(None)  # the recovery ramp sets its
+            # own rate per level; a stale overload note would pin "hot"
+            after = ramp_to_knee(
+                ctl, sources, start_qps=start_qps, growth=growth,
+                max_levels=max_levels, window_s=window_s)
+            ctl_stats = ctl.stats()
+            slo_rows = ctl.slo_status()
+    finally:
+        scaler.stop()
+        fleet.close()
+    actions = scaler.actions()
+    shed_frac = round(shed / max(submitted, 1), 4)
+    workers_after = start_workers + sum(
+        1 for a in actions if a["action"] == "scale_up") - sum(
+        1 for a in actions if a["action"] == "scale_down")
+    row = {
+        "metric": (f"sssp_autoscale_w{start_workers}to{workers_after}"
+                   f"_rmat{scale}_cpu"),
+        "value": after["knee_qps"],
+        "unit": "QPS",
+        "knee_before_qps": before["knee_qps"],
+        "knee_after_qps": after["knee_qps"],
+        "knee_before_p99_ms": before["knee_p99_ms"],
+        "knee_after_p99_ms": after["knee_p99_ms"],
+        "workers_before": start_workers,
+        "workers_after": workers_after,
+        "scale_actions": actions,
+        "overload_qps": round(overload_qps, 1),
+        "shed": shed,
+        "submitted": submitted,
+        "shed_frac": shed_frac,
+        "max_shed_frac": policy.max_shed_frac,
+        "shed_bounded": bool(shed_frac <= policy.max_shed_frac),
+        "policy": policy.to_dict(),
+        "pilot": ctl_stats.get("pilot"),
+        "app": "sssp",
+        "platform": "cpu",
+        "mode": "thread",
+        "nv": int(g.nv),
+        "ne": int(g.ne),
+        "controller": ctl_stats,
+        "slo": slo_rows,
+        "run_id": obs.run_id(),
+    }
+    return {"rows": [row], "before": before, "after": after,
+            "overload": overload, "graph": gid}

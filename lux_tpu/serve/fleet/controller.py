@@ -56,6 +56,12 @@ from lux_tpu.serve.fleet.hashring import (
 )
 from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
 from lux_tpu.utils.backoff import Backoff, retry_call
+from lux_tpu.utils.config import env_float
+
+#: admission-policy modes (ISSUE 16) and their prom gauge codes; must
+#: match serve/autopilot/policy.MODES (pinned by tests/test_autopilot)
+_POLICY_MODE_CODE = {"serve": 0, "queue": 1, "stale_degrade": 2,
+                     "shed": 3}
 
 
 class FleetError(RuntimeError):
@@ -308,10 +314,24 @@ class _WorkerHandle:
 
 
 class FleetController:
-    def __init__(self, hb_interval_s: float = 0.25,
-                 hb_timeout_s: float = 3.0, sat_frac: float = 0.8,
+    def __init__(self, hb_interval_s: Optional[float] = None,
+                 hb_timeout_s: Optional[float] = None,
+                 sat_frac: float = 0.8,
                  retries: int = 3, slots: int = DEFAULT_SLOTS,
                  vnodes: int = DEFAULT_VNODES):
+        # ISSUE 16 satellite: the heartbeat cadence and death threshold
+        # were hard-coded ctor defaults, so standby election timeouts
+        # (which must be a multiple of the death threshold to avoid
+        # false promotions) had to GUESS them.  Both are env knobs now,
+        # resolved HERE in the constructing thread (LUX-C003: never
+        # inside the heartbeat loop), with bounds and errors that name
+        # the knob (LUX-P002 contract).
+        if hb_interval_s is None:
+            hb_interval_s = env_float("LUX_FLEET_HEARTBEAT_S", 0.25,
+                                      minimum=0.01, maximum=60.0)
+        if hb_timeout_s is None:
+            hb_timeout_s = env_float("LUX_FLEET_DEATH_S", 3.0,
+                                     minimum=0.05, maximum=600.0)
         self.hb_interval_s = float(hb_interval_s)
         self.hb_timeout_s = float(hb_timeout_s)
         self.sat_frac = float(sat_frac)
@@ -336,6 +356,17 @@ class FleetController:
         #: SLO burn-rate engine (obs/slo.py), installed via set_slos();
         #: fed from the resolve paths, read via slo_status()
         self._slo: Optional[SLOEngine] = None
+        #: autopilot state (ISSUE 16): the installed AdmissionPolicy
+        #: (serve/autopilot/policy.py — duck-typed: anything with
+        #: .decide(status_rows) -> (mode, reason)), the mode it most
+        #: recently chose, the pilot action counters the Prometheus
+        #: surface exposes, and the SubscriptionHub attach point
+        self._policy = None
+        self._policy_mode = "serve"
+        self._pilot_counts = {"scale_up": 0, "scale_down": 0,
+                              "elections": 0, "policy_switches": 0,
+                              "sub_pushes": 0, "sub_coalesced": 0}
+        self._sub_hub = None
         #: this controller incarnation's publish-token prefix: a
         #: PROMOTED controller restarts _seq at 0, and its tokens must
         #: never collide with a dead predecessor's still staged on a
@@ -350,6 +381,29 @@ class FleetController:
     def graph_id(self) -> Optional[str]:
         with self._lock:
             return self._graph_id
+
+    @property
+    def incarnation(self) -> str:
+        """This controller incarnation's fencing token: publish tokens
+        carry it, takeover traces key on it, and a standby election is
+        claimed AGAINST it (one election per dead incarnation)."""
+        return self._incarnation
+
+    def ping(self) -> dict:
+        """Liveness probe for standby controllers (ISSUE 16): cheap,
+        lock-only, and raising once the controller closed or was
+        kill()ed — the in-process analog of a missed network heartbeat.
+        Standbys probe on a jittered cadence and declare death only
+        after the probe has failed for longer than the fleet's own
+        worker death threshold (the knobs compose; see
+        serve/autopilot/election.py)."""
+        with self._lock:
+            if self._closed:
+                raise FleetError("controller closed")
+            return {"incarnation": self._incarnation,
+                    "workers_alive": sum(
+                        1 for h in self._workers.values() if h.alive),
+                    "policy_mode": self._policy_mode}
 
     def add_worker(self, host: str, port: int,
                    timeout_s: float = 60.0,
@@ -832,13 +886,34 @@ class FleetController:
         admission path; retries resolve the future instead."""
         from lux_tpu import obs
 
+        with self._lock:
+            mode = self._policy_mode
+        if mode == "shed":
+            # the AdmissionPolicy chose shed (ISSUE 16): reject at
+            # admission before any routing work, exactly like the
+            # all-saturated shed — degraded by POLICY, never wrong
+            with self._lock:
+                self._counts["shed"] += 1
+            obs.point("fleet.shed", app=fut.app, source=fut.source,
+                      policy="shed")
+            err = FleetRejectedError(self._retry_after_ms())
+            if sync_raise:
+                raise err
+            fut._resolve(error=err)
+            return
+        # stale_degrade mode widens EVERY bounded read to the stale_ok
+        # contract (freshest replica + explicit stale tag) without the
+        # caller opting in — the policy's answer to a burning
+        # freshness/latency SLO is "serve stale rather than error"
+        stale_ok = fut.stale_ok or (mode == "stale_degrade"
+                                    and fut.min_generation is not None)
         exclude = set(exclude)
         while True:
             degraded = False
             cands = self._candidates(fut.app, fut.source, exclude)
             fresh = cands if fut.min_generation is None else [
                 h for h in cands if h.delta_gen >= fut.min_generation]
-            if cands and not fresh and fut.stale_ok:
+            if cands and not fresh and stale_ok:
                 degraded = True
                 # bounded-staleness degrade (opt-in): no replica meets
                 # the bound, so the FRESHEST one answers and the future
@@ -859,6 +934,13 @@ class FleetController:
                               want=fut.min_generation,
                               best=fresh[0].delta_gen)
             usable = [h for h in fresh if not h.saturated]
+            if not usable and fresh and mode == "queue":
+                # queue mode (ISSUE 16): admit past the saturation skip
+                # and let the workers' own bounded queues absorb the
+                # burst — the policy prefers queueing delay over sheds
+                # while the SLO is only warning, and the worker-side
+                # admission bound still backstops it
+                usable = fresh
             if not usable:
                 if cands and not fresh:
                     # replicas exist but none has caught up to the read
@@ -1010,6 +1092,15 @@ class FleetController:
         from lux_tpu import obs
 
         while not self._hb_stop.wait(self.hb_interval_s):
+            try:
+                # the seeded controller-death drill fires here
+                # (fault/drills.controller_kill_at_heartbeat): the
+                # rule's callback ran kill() — every worker conn is
+                # already down with no goodbye — so this sweep thread
+                # just stops; standby detection takes it from there
+                fault.ppoint("controller.heartbeat", owner="controller")
+            except fault.InjectedKill:
+                return
             with self._lock:
                 handles = [h for h in self._workers.values() if h.alive]
             now = time.monotonic()
@@ -1045,6 +1136,9 @@ class FleetController:
                     obs.point("fleet.saturation", worker=h.wid,
                               saturated=sat,
                               depth=hb.get("queue_depth"))
+            # the admission policy rides the heartbeat cadence: one
+            # burn-rate evaluation per sweep, mode switches spanned
+            self.policy_tick()
 
     # ------------------------------------------------------------------
     # republish
@@ -1183,6 +1277,8 @@ class FleetController:
             out["workers_alive"] = sum(
                 1 for h in self._workers.values() if h.alive)
             out["workers_total"] = len(self._workers)
+            out["pilot"] = dict(self._pilot_counts)
+            out["policy_mode"] = self._policy_mode
         return out
 
     # -- SLOs (obs/slo.py, ISSUE 15) -----------------------------------
@@ -1204,6 +1300,68 @@ class FleetController:
         with self._lock:
             engine = self._slo
         return [] if engine is None else engine.status()
+
+    # -- autopilot surface (serve/autopilot, ISSUE 16) -----------------
+
+    def set_policy(self, policy) -> None:
+        """Install an AdmissionPolicy (``None`` clears it back to plain
+        serving).  The policy is re-evaluated against ``slo_status()``
+        every heartbeat sweep (and once right here): its chosen mode
+        gates ``_dispatch`` — ``shed`` rejects at admission, ``queue``
+        admits past the saturation skip, ``stale_degrade`` serves
+        bounded reads from the freshest replica with the explicit stale
+        tag.  Mode switches emit a ``pilot.policy.switch`` incident
+        span and bump the switch counter."""
+        with self._lock:
+            self._policy = policy
+            if policy is None:
+                self._policy_mode = "serve"
+        if policy is not None:
+            self.policy_tick()
+
+    def policy_mode(self) -> str:
+        with self._lock:
+            return self._policy_mode
+
+    def policy_tick(self) -> str:
+        """One policy evaluation (the heartbeat loop's cadence; tests
+        and the demo call it directly).  Returns the current mode."""
+        with self._lock:
+            policy = self._policy
+        if policy is None:
+            return "serve"
+        mode, reason = policy.decide(self.slo_status())
+        with self._lock:
+            prev = self._policy_mode
+            if mode == prev:
+                return mode
+            self._policy_mode = mode
+            self._pilot_counts["policy_switches"] += 1
+            seq = self._pilot_counts["policy_switches"]
+        # a mode switch is an autonomous action: keyed incident trace,
+        # always-recorded span — luxstitch renders the switch next to
+        # the burning SLO windows that caused it
+        ptc = dtrace.incident(f"policy:{self._incarnation}:{seq}")
+        with dtrace.tspan("pilot.policy.switch", ptc, always=True,
+                          prev=prev, mode=mode, reason=reason):
+            pass
+        return mode
+
+    def _pilot_count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._pilot_counts[key] = self._pilot_counts.get(key, 0) + n
+
+    def rebalance_preview(self, add: Sequence[str] = (),
+                          remove: Sequence[str] = (),
+                          app: str = "sssp") -> dict:
+        """Dry-run a membership change over THIS fleet's routable key
+        space (every Q-slot of ``app`` on the pinned graph) — the
+        autoscaler's cost gate.  See ``HashRing.rebalance_preview``."""
+        with self._lock:
+            gid = self._graph_id if self._graph_id is not None else "g"
+            keys = [f"{app}|{gid}|q{s}" for s in range(self.slots)]
+            return self._ring.rebalance_preview(keys, add=add,
+                                                remove=remove)
 
     def _slo_observe(self, fut: FleetFuture, error) -> None:
         """Resolve-time hook scoring one query: availability from the
@@ -1293,6 +1451,61 @@ class FleetController:
             lines.extend([f"# HELP {name} {help_text}",
                           f"# TYPE {name} counter"])
             lines.extend(f'{name}{{worker="{w}"}} {n}' for w, n in rows)
+        # -- autopilot families (ISSUE 16) -----------------------------
+        with self._lock:
+            pilot = dict(self._pilot_counts)
+            mode = self._policy_mode
+            has_policy = self._policy is not None
+            hub = self._sub_hub
+        if pilot["scale_up"] or pilot["scale_down"]:
+            name = "lux_pilot_scale_actions_total"
+            lines.extend([
+                f"# HELP {name} autoscaler spawn/retire actions",
+                f"# TYPE {name} counter"])
+            lines.extend(
+                f'{name}{{direction="{d}"}} {pilot[k]}'
+                for d, k in (("up", "scale_up"), ("down", "scale_down"))
+                if pilot[k])
+        if pilot["elections"]:
+            name = "lux_pilot_elections_total"
+            lines.extend([
+                f"# HELP {name} standby elections won by this "
+                "controller", f"# TYPE {name} counter",
+                f"{name} {pilot['elections']}"])
+        if has_policy:
+            name = "lux_pilot_policy_mode"
+            lines.extend([
+                f"# HELP {name} admission-policy mode (0 serve, 1 "
+                "queue, 2 stale_degrade, 3 shed)",
+                f"# TYPE {name} gauge",
+                f"{name} {_POLICY_MODE_CODE.get(mode, 0)}"])
+            name = "lux_pilot_policy_switches_total"
+            lines.extend([
+                f"# HELP {name} admission-policy mode switches",
+                f"# TYPE {name} counter",
+                f"{name} {pilot['policy_switches']}"])
+        if pilot["sub_pushes"] or pilot["sub_coalesced"]:
+            for key, name, help_text in (
+                    ("sub_pushes", "lux_pilot_subscription_pushes_total",
+                     "standing-query answers pushed to subscribers"),
+                    ("sub_coalesced",
+                     "lux_pilot_subscription_coalesced_total",
+                     "subscription updates superseded before delivery")):
+                lines.extend([f"# HELP {name} {help_text}",
+                              f"# TYPE {name} counter",
+                              f"{name} {pilot[key]}"])
+        if hub is not None:
+            name = "lux_pilot_subscriptions"
+            lines.extend([
+                f"# HELP {name} active standing-query subscriptions",
+                f"# TYPE {name} gauge", f"{name} {hub.active()}"])
+            lag = hub.max_lag()
+            if lag is not None:
+                name = "lux_pilot_subscription_lag"
+                lines.extend([
+                    f"# HELP {name} max generations between the journal "
+                    "and a subscriber's delivered cursor",
+                    f"# TYPE {name} gauge", f"{name} {lag}"])
         with self._lock:
             engine = self._slo
         if engine is not None:
@@ -1315,6 +1528,10 @@ class FleetController:
         with self._lock:
             self._closed = True
             handles = list(self._workers.values())
+            hub = self._sub_hub
+            self._sub_hub = None
+        if hub is not None:
+            hub.close()
         for h in handles:
             if shutdown_workers and h.alive:
                 try:

@@ -141,3 +141,62 @@ class HashRing:
         """key -> owner for a whole key set (the controller's per-slot
         routing table, rebuilt on membership change)."""
         return {k: self.route(k) for k in keys}
+
+    def rebalance_preview(self, keys: Sequence[str],
+                          add: Sequence[str] = (),
+                          remove: Sequence[str] = ()) -> dict:
+        """DRY-RUN a membership change (ISSUE 16): the exact key
+        movement ``add``/``remove`` would cause over ``keys``, with
+        nothing mutated.  This is the autoscaler's cost gate — a scale
+        decision can see, before acting, that a join moves ~1/(R+1) of
+        the keys (all TO the joiner) while a retire moves exactly the
+        leaver's share, and refuse actions whose warm-cache flush would
+        cost more than the load problem they solve.
+
+        Returns ``{total, moved, moved_frac, gained, lost, add,
+        remove}`` where ``gained``/``lost`` count moved keys per
+        receiving/yielding worker.  The preview is computed on throwaway
+        ring clones built from the same deterministic vnode hashes, so
+        it matches a real ``add()``/``remove()`` table diff EXACTLY
+        (property-pinned against the join/leave movement tests)."""
+        add = [str(w) for w in add]
+        remove = [str(w) for w in remove]
+        overlap = sorted(set(add) & set(remove))
+        if overlap:
+            raise ValueError(f"workers both added and removed: {overlap}")
+        for w in add:
+            if w in self._members:
+                raise ValueError(f"worker {w!r} already on the ring")
+        for w in remove:
+            if w not in self._members:
+                raise ValueError(f"worker {w!r} not on the ring")
+
+        def _owners(members) -> Dict[str, str]:
+            if not members:
+                return {k: "" for k in keys}
+            ring = HashRing(self.vnodes)
+            for w in sorted(members):
+                ring.add(w)
+            return ring.table(keys)
+
+        before = _owners(list(self._members))
+        after = _owners([w for w in self._members if w not in remove]
+                        + add)
+        gained: Dict[str, int] = {}
+        lost: Dict[str, int] = {}
+        moved = 0
+        for k in keys:
+            b, a = before[k], after[k]
+            if b == a:
+                continue
+            moved += 1
+            if a:
+                gained[a] = gained.get(a, 0) + 1
+            if b:
+                lost[b] = lost.get(b, 0) + 1
+        total = len(keys)
+        return {"total": total, "moved": moved,
+                "moved_frac": (moved / total) if total else 0.0,
+                "gained": dict(sorted(gained.items())),
+                "lost": dict(sorted(lost.items())),
+                "add": sorted(add), "remove": sorted(remove)}

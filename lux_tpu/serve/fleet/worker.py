@@ -218,6 +218,14 @@ class ReplicaWorker:
     def _close_sockets(self) -> None:
         if self._listener is not None:
             try:
+                # shutdown() first: close() alone does not wake a
+                # thread blocked in accept() (the fd stays parked in
+                # the syscall), which turns every stop() into a full
+                # join timeout on the accept thread
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._listener.close()
             except OSError:
                 pass
@@ -412,9 +420,14 @@ class ReplicaWorker:
             cache = self._cache
         counts = self.metrics.counters()
         shed, completed = counts["rejected"], counts["completed"]
+        depth = sum(s.pending() for s in self._scheds.values())
         out = {
-            "queue_depth": sum(s.pending() for s in self._scheds.values()),
+            "queue_depth": depth,
             "max_queue": self._max_queue,
+            # queue occupancy as a ready-made fraction (ISSUE 16): the
+            # autoscaler's hot/idle signal, precomputed here so every
+            # consumer divides by the same admission bound
+            "occupancy": round(depth / max(self._max_queue, 1), 4),
             "shed_total": int(shed),
             "completed": int(completed),
             "graph_id": gid,

@@ -269,6 +269,10 @@ class LiveFleetController(FleetController):
                 sp.set(generation=gen, acked=len(acked),
                        compacted=compacted, deduped=False)
         self._observe_write(t_admit, ok=True, tc=wtc)
+        # standing-query subscribers (ISSUE 16): push, don't poll —
+        # outside the write lock (the hub only coalesces a pending
+        # generation here; its dispatcher thread does the reads)
+        self._notify_subs(gen, tc=wtc)
         return {"generation": gen, "acked": acked,
                 "compacted": compacted, "deduped": False}
 
@@ -431,8 +435,44 @@ class LiveFleetController(FleetController):
                         f"{p.error or p.reply.get('err')}")
                 out[h.wid] = {k: v for k, v in p.reply.items()
                               if k not in ("req_id", "ok")}
+        # a refresh recomputes every standing answer: subscribers get
+        # the refreshed states pushed under the refresh's own trace
+        self._notify_subs(self.journal.generation(), tc=rtc,
+                          refreshed=True)
         return {"workers": out,
                 "seconds": round(time.perf_counter() - t0, 4)}
+
+    # -- standing-query subscriptions (serve/autopilot, ISSUE 16) ------
+
+    def subscribe(self, app: str = "sssp", min_generation: int = 0):
+        """Register a standing-query subscription: the returned
+        :class:`~lux_tpu.serve.autopilot.subscribe.Subscription`
+        receives every refreshed answer for ``app`` pushed on
+        write-commit and fleet refresh, with the generation tag as the
+        cursor — the push replacement for ``read_standing`` polling.
+        The hub (and its subscribers) SURVIVES a controller death: an
+        elected successor adopts it via ``SubscriptionHub.rebind``, so
+        a client registers once per fleet, not once per incarnation."""
+        from lux_tpu.serve.autopilot.subscribe import SubscriptionHub
+
+        with self._lock:
+            if self._sub_hub is None:
+                self._sub_hub = SubscriptionHub(self)
+            hub = self._sub_hub
+        return hub.subscribe(app, cursor=min_generation)
+
+    def unsubscribe(self, sub) -> None:
+        with self._lock:
+            hub = self._sub_hub
+        if hub is not None:
+            hub.unsubscribe(sub)
+
+    def _notify_subs(self, generation: int, tc=None,
+                     refreshed: bool = False) -> None:
+        with self._lock:
+            hub = self._sub_hub
+        if hub is not None:
+            hub.notify(int(generation), tc=tc, refreshed=refreshed)
 
     def read_standing(self, app: str = "sssp",
                       worker: Optional[str] = None,

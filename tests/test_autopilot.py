@@ -1,0 +1,758 @@
+"""luxpilot (ISSUE 16): the self-driving fleet.
+
+Pins the acceptance surface: (a) AdmissionPolicy is JSON-round-trip
+DATA — ordered first-match rules over SLO verdicts, unknown fields
+refused — and the installed policy's mode actually gates ``_dispatch``
+(shed rejects at admission, stale_degrade serves bounded reads with
+the explicit stale tag); (b) ``rebalance_preview`` is a bitwise
+dry-run: its movement report matches a real join/leave table diff
+exactly; (c) the Autoscaler's hysteresis/cooldown/move-budget gates
+fire deterministically under a fake clock, and scale actions emit
+keyed ``pilot.scale`` incident spans; (d) the ELECTION DRILL — a
+seeded FaultPlan kills the controller at a heartbeat sweep and a
+STANDBY (not the harness) detects the silence, wins the
+incarnation-fenced election, and promotes with zero acked-write loss,
+one stitched incident trace, and split-brain refused in both
+directions; (e) subscriptions push generation-tagged standing answers,
+coalesce bursts, and survive the election via hub rebind; (f) the
+full autonomous loop (``autopilot_soak``) holds under a fixed seed.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lux_tpu import fault, obs
+from lux_tpu.fault import drills
+from lux_tpu.fault.chaos import autopilot_soak
+from lux_tpu.graph import generate
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models.sssp import bfs_reference
+from lux_tpu.obs import dtrace
+from lux_tpu.obs.dtrace import _hex_hash
+from lux_tpu.obs.recorder import Recorder
+from lux_tpu.obs.slo import worst_verdict
+from lux_tpu.serve.autopilot import (
+    MODES,
+    AdmissionPolicy,
+    Autoscaler,
+    AutoscalerConfig,
+    PolicyError,
+    PolicyRule,
+    Standby,
+    StandbyGroup,
+    SubscriptionClosed,
+    default_fleet_policy,
+)
+from lux_tpu.serve.fleet.controller import (
+    _POLICY_MODE_CODE,
+    FleetController,
+    FleetRejectedError,
+    WorkerRefusedError,
+)
+from lux_tpu.serve.fleet.hashring import HashRing
+from lux_tpu.serve.fleet.worker import ReplicaWorker
+from lux_tpu.serve.live.controller import (
+    LiveFleetController,
+    promote_live_controller,
+    start_live_fleet,
+)
+from tests.test_dtrace import prom_parse, read_events, spans_by_name
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    yield
+    dtrace.set_enabled(None)
+    fault.uninstall()
+
+
+@pytest.fixture(scope="module")
+def small():
+    g = generate.rmat(8, 6, seed=9)
+    return g, build_pull_shards(g, 2)
+
+
+@pytest.fixture()
+def rec(tmp_path):
+    r = Recorder(run_id="pilot", root=str(tmp_path), enabled=True)
+    old = obs.install(r)
+    yield r
+    r.close()
+    obs.install(old)
+
+
+def _batches(g, n, rows=12, seed=1):
+    rng = np.random.default_rng(seed)
+    dele_pool = rng.permutation(g.ne)
+    out, lo = [], 0
+    for _ in range(n):
+        ndel = rows // 2
+        dele = dele_pool[lo:lo + ndel]
+        lo += ndel
+        src = np.concatenate([np.asarray(g.col_idx, np.int64)[dele],
+                              rng.integers(0, g.nv, rows - ndel)])
+        dst = np.concatenate([np.asarray(g.dst_of_edges(),
+                                         np.int64)[dele],
+                              rng.integers(0, g.nv, rows - ndel)])
+        op = np.concatenate([np.zeros(ndel, np.int8),
+                             np.ones(rows - ndel, np.int8)])
+        out.append((src, dst, op))
+    return out
+
+
+# ----------------------------------------------------------------------
+# admission policy as data
+# ----------------------------------------------------------------------
+
+
+def test_policy_rule_and_bounds_validation():
+    with pytest.raises(PolicyError, match="unknown mode"):
+        PolicyRule(mode="panic")
+    with pytest.raises(PolicyError, match="unknown verdict"):
+        PolicyRule(verdict="meltdown")
+    with pytest.raises(PolicyError, match="default_mode"):
+        AdmissionPolicy(default_mode="panic")
+    with pytest.raises(PolicyError, match="max_shed_frac"):
+        AdmissionPolicy(max_shed_frac=1.5)
+
+
+def test_policy_json_round_trip_and_unknown_fields():
+    pol = default_fleet_policy(max_shed_frac=0.25)
+    back = AdmissionPolicy.from_json(pol.to_json())
+    assert back.to_dict() == pol.to_dict()
+    assert back.max_shed_frac == 0.25
+    assert back.name == "default_fleet_policy"
+    # unknown fields are refused at BOTH levels, like FaultPlan/SLOSpec
+    with pytest.raises(PolicyError, match="unknown policy fields"):
+        AdmissionPolicy.from_dict({"rules": [], "surprise": 1})
+    with pytest.raises(PolicyError, match="unknown rule fields"):
+        AdmissionPolicy.from_dict(
+            {"rules": [{"slo": "*", "verdict": "warn", "mode": "queue",
+                        "extra": True}]})
+    with pytest.raises(PolicyError, match="bad policy JSON"):
+        AdmissionPolicy.from_json("{nope")
+    with pytest.raises(PolicyError, match="'rules'"):
+        AdmissionPolicy.from_dict({"default_mode": "serve"})
+
+
+def test_policy_decide_first_match_glob_and_default():
+    pol = AdmissionPolicy([
+        PolicyRule(slo="read_freshness", verdict="burning",
+                   mode="stale_degrade", note="stale beats absent"),
+        PolicyRule(slo="read_*", verdict="burning", mode="shed"),
+        PolicyRule(slo="*", verdict="warn", mode="queue"),
+    ])
+    rows = [{"name": "read_latency", "verdict": "ok"},
+            {"name": "read_freshness", "verdict": "ok"}]
+    assert pol.decide(rows) == ("serve", "default")
+    rows[0]["verdict"] = "warn"
+    assert pol.decide(rows) == ("queue", "read_latency=warn")
+    # order wins over row position: freshness burning beats the
+    # earlier-listed latency row matching the broader glob rule
+    rows[0]["verdict"] = "burning"
+    rows[1]["verdict"] = "burning"
+    mode, reason = pol.decide(rows)
+    assert mode == "stale_degrade"
+    assert reason.startswith("read_freshness=burning")
+    assert "stale beats absent" in reason
+    rows[1]["verdict"] = "ok"
+    assert pol.decide(rows)[0] == "shed"
+
+
+def test_default_fleet_policy_ladder_and_mode_code_pin():
+    pol = default_fleet_policy()
+    assert pol.decide([{"name": "read_availability",
+                        "verdict": "burning"}])[0] == "shed"
+    assert pol.decide([{"name": "read_freshness",
+                        "verdict": "burning"}])[0] == "stale_degrade"
+    assert pol.decide([{"name": "journal_lag",
+                        "verdict": "warn"}])[0] == "queue"
+    # the prom gauge coding must track MODES ordinally (dashboards
+    # key on the numbers)
+    assert _POLICY_MODE_CODE == {m: i for i, m in enumerate(MODES)}
+    assert worst_verdict([]) == "no_data"
+
+
+# ----------------------------------------------------------------------
+# rebalance preview (satellite 1)
+# ----------------------------------------------------------------------
+
+
+def test_rebalance_preview_matches_actual_membership_change():
+    keys = [f"sssp|g|q{i}" for i in range(256)]
+    ring = HashRing()
+    for w in ("w0", "w1", "w2"):
+        ring.add(w)
+    before = ring.table(keys)
+
+    prev = ring.rebalance_preview(keys, add=["w3"])
+    ring.add("w3")
+    after = ring.table(keys)
+    moved = [k for k in keys if before[k] != after[k]]
+    assert prev["moved"] == len(moved)
+    assert prev["moved_frac"] == pytest.approx(len(moved) / len(keys))
+    # a join moves keys ONLY to the joiner, ~1/(R+1) of the space
+    assert set(prev["gained"]) == {"w3"}
+    assert prev["gained"]["w3"] == len(moved)
+    assert sum(prev["lost"].values()) == len(moved)
+    assert 0.05 < prev["moved_frac"] < 0.5
+
+    prev2 = ring.rebalance_preview(keys, remove=["w1"])
+    ring.remove("w1")
+    after2 = ring.table(keys)
+    moved2 = [k for k in keys if after[k] != after2[k]]
+    assert prev2["moved"] == len(moved2)
+    assert set(prev2["lost"]) == {"w1"}
+    # the leaver yields exactly its share; nobody else's keys move
+    assert prev2["lost"]["w1"] == len(
+        [k for k in keys if after[k] == "w1"])
+
+
+def test_rebalance_preview_validation_and_empty_ring():
+    keys = ["a", "b", "c"]
+    ring = HashRing()
+    ring.add("w0")
+    with pytest.raises(ValueError, match="already on the ring"):
+        ring.rebalance_preview(keys, add=["w0"])
+    with pytest.raises(ValueError, match="not on the ring"):
+        ring.rebalance_preview(keys, remove=["ghost"])
+    with pytest.raises(ValueError, match="both added and removed"):
+        ring.rebalance_preview(keys, add=["w0x"], remove=["w0x"])
+    # retiring the last worker routes everything to nowhere — the
+    # preview reports total movement instead of crashing
+    prev = ring.rebalance_preview(keys, remove=["w0"])
+    assert prev["moved"] == 3 and prev["gained"] == {}
+    assert prev["lost"] == {"w0": 3}
+
+
+# ----------------------------------------------------------------------
+# fleet timing knobs (satellite 6)
+# ----------------------------------------------------------------------
+
+
+def test_fleet_timing_env_knobs(monkeypatch):
+    monkeypatch.setenv("LUX_FLEET_HEARTBEAT_S", "0.07")
+    monkeypatch.setenv("LUX_FLEET_DEATH_S", "0.9")
+    ctl = FleetController()
+    try:
+        assert ctl.hb_interval_s == pytest.approx(0.07)
+        assert ctl.hb_timeout_s == pytest.approx(0.9)
+    finally:
+        ctl.close()
+    # explicit ctor args beat the environment
+    ctl = FleetController(hb_interval_s=0.5, hb_timeout_s=2.0)
+    try:
+        assert ctl.hb_interval_s == 0.5 and ctl.hb_timeout_s == 2.0
+    finally:
+        ctl.close()
+    # garbage env fails loudly, NAMING the knob
+    monkeypatch.setenv("LUX_FLEET_DEATH_S", "soon")
+    with pytest.raises(ValueError, match="LUX_FLEET_DEATH_S"):
+        FleetController()
+    monkeypatch.setenv("LUX_FLEET_DEATH_S", "9999")
+    with pytest.raises(ValueError, match="LUX_FLEET_DEATH_S"):
+        FleetController()
+
+
+def test_autoscaler_config_env_knobs(monkeypatch):
+    monkeypatch.setenv("LUX_PILOT_UP_OCC", "0.8")
+    monkeypatch.setenv("LUX_PILOT_COOLDOWN_S", "7")
+    cfg = AutoscalerConfig()
+    assert cfg.up_occupancy == pytest.approx(0.8)
+    assert cfg.cooldown_s == pytest.approx(7.0)
+    assert AutoscalerConfig(up_occupancy=0.9).up_occupancy == 0.9
+    monkeypatch.setenv("LUX_PILOT_UP_OCC", "hot")
+    with pytest.raises(ValueError, match="LUX_PILOT_UP_OCC"):
+        AutoscalerConfig()
+    monkeypatch.delenv("LUX_PILOT_UP_OCC")
+    with pytest.raises(ValueError, match="min_workers"):
+        AutoscalerConfig(min_workers=3, max_workers=1)
+    with pytest.raises(ValueError, match="flap"):
+        AutoscalerConfig(up_occupancy=0.3, down_occupancy=0.3)
+
+
+# ----------------------------------------------------------------------
+# autoscaler control loop (fakes + fake clock: fully deterministic)
+# ----------------------------------------------------------------------
+
+
+class _FakeWorker:
+    def __init__(self, wid):
+        self.worker_id = wid
+        self.port = 0
+
+
+class _FakeCtl:
+    incarnation = "fake-inc"
+
+    def __init__(self, occ=0.0, alive=1, moved_frac=0.1):
+        self.occ = occ
+        self.n_alive = alive
+        self.moved_frac = moved_frac
+        self.slo_rows = []
+        self.added, self.removed = [], []
+        self.counts = {}
+
+    def workers(self):
+        return {f"w{i}": {"alive": True, "saturated": False,
+                          "last_hb": {"occupancy": self.occ}}
+                for i in range(self.n_alive)}
+
+    def slo_status(self):
+        return list(self.slo_rows)
+
+    def rebalance_preview(self, add=(), remove=(), app="sssp"):
+        return {"total": 256, "moved": int(256 * self.moved_frac),
+                "moved_frac": self.moved_frac, "gained": {},
+                "lost": {}, "add": list(add), "remove": list(remove)}
+
+    def add_worker(self, host, port, tc=None):
+        self.added.append(port)
+        self.n_alive += 1
+
+    def remove_worker(self, wid, shutdown=True):
+        self.removed.append(wid)
+        self.n_alive -= 1
+
+    def _pilot_count(self, key, n=1):
+        self.counts[key] = self.counts.get(key, 0) + n
+
+
+def _scaler(ctl, **cfg_kw):
+    cfg_kw.setdefault("min_workers", 1)
+    cfg_kw.setdefault("max_workers", 3)
+    cfg_kw.setdefault("up_occupancy", 0.6)
+    cfg_kw.setdefault("down_occupancy", 0.15)
+    cfg_kw.setdefault("up_consecutive", 2)
+    cfg_kw.setdefault("down_consecutive", 2)
+    cfg_kw.setdefault("cooldown_s", 10.0)
+    reaped = []
+    spawned = []
+
+    def spawn(i):
+        w = _FakeWorker(f"s{i}")
+        spawned.append(w)
+        return w
+
+    sc = Autoscaler(ctl, spawn, reap=reaped.append,
+                    config=AutoscalerConfig(**cfg_kw))
+    return sc, spawned, reaped
+
+
+def test_autoscaler_hysteresis_cooldown_and_bounds():
+    ctl = _FakeCtl(occ=0.9, alive=1)
+    sc, spawned, _ = _scaler(ctl)
+    # hot, but one tick is not a trend (up_consecutive=2)
+    assert sc.tick(now=0.0) is None
+    act = sc.tick(now=1.0)
+    assert act["action"] == "scale_up" and act["worker"] == "s0"
+    assert ctl.n_alive == 2 and ctl.counts["scale_up"] == 1
+    # cooldown gates actions; a signal held hot THROUGH the window
+    # keeps its streak, so the first post-cooldown tick may fire
+    assert sc.tick(now=2.0) is None          # cooling (10s window)
+    assert sc.tick(now=5.0) is None          # still cooling
+    act2 = sc.tick(now=12.0)
+    assert act2["action"] == "scale_up" and ctl.n_alive == 3
+    # max_workers bound: hot forever, but the fleet stays at 3
+    assert sc.tick(now=23.0) is None and sc.tick(now=24.0) is None
+    assert ctl.n_alive == 3
+    assert [a["seq"] for a in sc.actions()] == [1, 2]
+
+
+def test_autoscaler_scale_down_lifo_and_floor():
+    ctl = _FakeCtl(occ=0.9, alive=1)
+    sc, spawned, reaped = _scaler(ctl, cooldown_s=0.0)
+    sc.tick(now=0.0)
+    sc.tick(now=1.0)   # spawn s0
+    sc.tick(now=2.0)
+    sc.tick(now=3.0)   # spawn s1
+    assert ctl.n_alive == 3
+    ctl.occ = 0.0      # now idle
+    assert sc.tick(now=4.0) is None
+    act = sc.tick(now=5.0)
+    # LIFO: the NEWEST spawned worker retires first
+    assert act["action"] == "scale_down" and act["worker"] == "s1"
+    assert ctl.removed == ["s1"] and reaped == [spawned[1]]
+    sc.tick(now=6.0)
+    assert sc.tick(now=7.0)["worker"] == "s0"
+    # floor: nothing spawned remains -> the operator's baseline
+    # worker is never reaped, no matter how idle
+    assert sc.tick(now=8.0) is None and sc.tick(now=9.0) is None
+    assert ctl.n_alive == 1
+
+
+def test_autoscaler_burning_verdict_and_knee_trigger():
+    # occupancy calm, but a burning SLO verdict is hot on its own
+    ctl = _FakeCtl(occ=0.1, alive=1)
+    ctl.slo_rows = [{"name": "read_latency", "verdict": "burning"}]
+    sc, _, _ = _scaler(ctl)
+    sc.tick(now=0.0)
+    assert sc.tick(now=1.0)["action"] == "scale_up"
+    # knee-derived desired count: 130 qps / 50 qps-per-worker -> 3
+    ctl2 = _FakeCtl(occ=0.1, alive=1)
+    sc2, _, _ = _scaler(ctl2, cooldown_s=0.0)
+    sc2.set_capacity(50.0)
+    sc2.note_offered_qps(130.0)
+    assert sc2.signals()["desired"] == 3
+    sc2.tick(now=0.0)
+    assert sc2.tick(now=1.0)["action"] == "scale_up"
+    sc2.tick(now=2.0)
+    assert sc2.tick(now=3.0)["action"] == "scale_up"
+    assert sc2.tick(now=4.0) is None  # desired met at 3
+    sc2.note_offered_qps(None)        # load note withdrawn: no signal
+    assert sc2.signals()["desired"] is None
+
+
+def test_autoscaler_move_budget_refuses_and_reaps():
+    ctl = _FakeCtl(occ=0.9, alive=1, moved_frac=0.8)
+    sc, spawned, reaped = _scaler(ctl, max_move_frac=0.5)
+    sc.tick(now=0.0)
+    # hot and ready — but the previewed rebalance would move 80% of
+    # the keyspace: the action is refused and the orphan reaped
+    assert sc.tick(now=1.0) is None
+    assert ctl.added == [] and reaped == spawned
+    assert sc.stats()["refused_moves"] == 1
+    assert sc.stats()["actions"] == 0
+
+
+def test_autoscaler_scale_span_is_keyed_incident(rec):
+    dtrace.set_enabled(True)
+    ctl = _FakeCtl(occ=0.9, alive=1)
+    sc, _, _ = _scaler(ctl)
+    sc.tick(now=0.0)
+    sc.tick(now=1.0)
+    by = spans_by_name(read_events(rec.run_dir()))
+    (span,) = by["pilot.scale"]
+    a = span["a"]
+    assert a["trace"] == _hex_hash("lux:scale:fake-inc:1", 8)
+    assert a["direction"] == "up" and a["worker"] == "s0"
+    assert a["moved_frac"] == pytest.approx(0.1)
+    assert a["verdict"] == "no_data" and a["seq"] == 1
+
+
+# ----------------------------------------------------------------------
+# policy gates dispatch on a live fleet
+# ----------------------------------------------------------------------
+
+
+def test_policy_modes_gate_live_dispatch(small, tmp_path, rec):
+    g, _sh = small
+    dtrace.set_enabled(True)
+    fleet = start_live_fleet(1, g, parts=2, cap=1024,
+                             standing=(("sssp", 0),),
+                             hb_interval_s=0.1)
+    ctl = fleet.controller
+    try:
+        gen = ctl.admit_writes(*_batches(g, 1)[0])["generation"]
+        merged = ctl.journal.log.merged_graph()
+        # serve (no policy): a normal query answers bitwise
+        f = ctl.submit_retrying(0, deadline_s=60.0)
+        assert np.array_equal(f.result(timeout=0),
+                              bfs_reference(merged, 0))
+        # shed: the installed policy rejects at admission
+        ctl.set_policy(AdmissionPolicy(default_mode="shed"))
+        assert ctl.policy_mode() == "shed"
+        with pytest.raises(FleetRejectedError):
+            ctl.submit(0)
+        fams = prom_parse(ctl.prom_dump())
+        assert float(fams["lux_pilot_policy_mode"]
+                     ["samples"][0][2]) == 3
+        assert float(fams["lux_fleet_shed_total"]
+                     ["samples"][0][2]) == 1
+        # stale_degrade: a bounded read ahead of every replica is
+        # SERVED with the explicit stale tag instead of erroring
+        ctl.set_policy(AdmissionPolicy(default_mode="stale_degrade"))
+        f = ctl.submit(0, min_generation=gen + 50)
+        assert np.array_equal(f.result(timeout=60.0),
+                              bfs_reference(merged, 0))
+        assert f.stale is True
+        # queue mode admits normally when nothing is saturated
+        ctl.set_policy(AdmissionPolicy(default_mode="queue"))
+        f = ctl.submit(0)
+        assert np.array_equal(f.result(timeout=60.0),
+                              bfs_reference(merged, 0))
+        # clearing the policy restores plain serving
+        ctl.set_policy(None)
+        assert ctl.policy_mode() == "serve"
+        assert "lux_pilot_policy_mode" not in prom_parse(
+            ctl.prom_dump())
+        # each mode CHANGE emitted a pilot.policy.switch span on its
+        # own keyed incident (serve->shed->stale_degrade->queue)
+        by = spans_by_name(read_events(rec.run_dir()))
+        switches = by["pilot.policy.switch"]
+        assert [s["a"]["mode"] for s in switches] == [
+            "shed", "stale_degrade", "queue"]
+        assert switches[0]["a"]["prev"] == "serve"
+        assert switches[0]["a"]["trace"] == _hex_hash(
+            f"lux:policy:{ctl.incarnation}:1", 8)
+        assert len({s["a"]["trace"] for s in switches}) == 3
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# standing-query subscriptions
+# ----------------------------------------------------------------------
+
+
+def test_subscription_push_cursor_and_coalescing(small, tmp_path, rec):
+    g, _sh = small
+    dtrace.set_enabled(True)
+    fleet = start_live_fleet(1, g, parts=2, cap=1024,
+                             standing=(("sssp", 0),),
+                             hb_interval_s=0.1)
+    ctl = fleet.controller
+    mirror = None
+    try:
+        sub = ctl.subscribe("sssp")
+        b = _batches(g, 3, seed=5)
+        ctl.admit_writes(*b[0])
+        ctl.refresh_fleet()
+        up = sub.get(timeout_s=30.0)
+        assert up["app"] == "sssp" and up["generation"] >= 1
+        assert sub.cursor == up["generation"]
+        merged = ctl.journal.log.merged_graph()
+        assert np.array_equal(up["state"], bfs_reference(merged, 0))
+        # a burst coalesces: two commits, at most the LATEST answer
+        # is delivered (the superseded one is counted, not replayed)
+        ctl.admit_writes(*b[1])
+        gen3 = ctl.admit_writes(*b[2])["generation"]
+        ctl.refresh_fleet()
+        while sub.get(timeout_s=30.0)["generation"] < gen3:
+            pass
+        assert sub.cursor == gen3
+        merged = ctl.journal.log.merged_graph()
+        fams = prom_parse(ctl.prom_dump())
+        assert int(fams["lux_pilot_subscriptions"]
+                   ["samples"][0][2]) == 1
+        assert int(fams["lux_pilot_subscription_pushes_total"]
+                   ["samples"][0][2]) >= 2
+        assert int(fams["lux_pilot_subscription_lag"]
+                   ["samples"][0][2]) == 0
+        assert ctl._sub_hub.max_lag() == 0
+        # pushes are traced
+        by = spans_by_name(read_events(rec.run_dir()))
+        pushes = [s for s in by.get("pilot.subscribe.push", ())
+                  if "err" not in s["a"]]
+        assert pushes and pushes[-1]["a"]["app"] == "sssp"
+        # unsubscribe closes the stream
+        ctl.unsubscribe(sub)
+        with pytest.raises(SubscriptionClosed):
+            sub.get(timeout_s=1.0)
+        assert ctl._sub_hub.active() == 0
+        # late registration is seeded from the CURRENT generation —
+        # register once never means wait-for-the-next-write
+        sub2 = ctl.subscribe("sssp")
+        up2 = sub2.get(timeout_s=30.0)
+        assert up2["generation"] == gen3
+        assert np.array_equal(up2["state"], bfs_reference(merged, 0))
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# the election drill (satellite 3)
+# ----------------------------------------------------------------------
+
+
+def test_standby_election_drill(small, tmp_path, rec):
+    """Seeded controller-kill chaos plan; a STANDBY — not the test —
+    detects the death, wins the fenced election, and promotes: zero
+    acked-write loss, one stitched incident trace, split-brain refused
+    in both directions."""
+    g, _sh = small
+    dtrace.set_enabled(True)
+    root = str(tmp_path / "fleet")
+    snap = os.path.join(root, "snap.lux")
+    fleet = start_live_fleet(2, g, parts=2, cap=1024,
+                             standing=(("sssp", 0),),
+                             journal_root=root, snapshot_path=snap,
+                             hb_interval_s=0.05)
+    ctl = fleet.controller
+    inc0 = ctl.incarnation
+    standbys = []
+    try:
+        acked = {}
+        for i, b in enumerate(_batches(g, 3)):
+            acked[f"el-{i}"] = ctl.admit_writes(
+                *b, write_id=f"el-{i}")["generation"]
+        last = max(acked.values())
+        # the drill: a SEEDED plan kills the controller at its 2nd
+        # heartbeat sweep — the standbys must do all the noticing
+        plan = drills.controller_kill_at_heartbeat(nth=2, seed=0)
+        plan.bind("kill:controller", ctl.kill)
+        group = StandbyGroup()
+
+        def _promote(tc=None):
+            endpoints = [("127.0.0.1", w.port)
+                         for w in fleet.thread_workers]
+            return promote_live_controller(
+                g, os.path.join(root, "controller"), snap, endpoints,
+                seed=1)
+
+        standbys = [Standby(group, sid, ctl, _promote,
+                            hb_interval_s=0.02, death_after_s=0.15,
+                            seed=0).start()
+                    for sid in (0, 1)]
+        with fault.installed(plan):
+            got = group.wait_promoted(timeout_s=60.0)
+        assert plan.total_fired() == 1
+        assert got is not None, "no standby promoted"
+        ctl2, rep = got
+        fleet.controller = ctl2  # close() tears the successor down
+        for s in standbys:
+            s.stop()
+        # deterministic election: lowest standby id won, fenced on
+        # the dead incarnation; the loser adopted
+        assert group.claimed_by(inc0) == 0
+        assert standbys[0].outcome == "won"
+        assert standbys[1].outcome in ("adopted", "won")
+        assert group.elections == 1
+        assert ctl2.incarnation != inc0
+        # a straggler declaring the SAME death later is fenced out
+        assert group.claim(1, inc0) is False
+        # zero acked-write loss across the unattended promotion
+        assert sorted(rep["joined"]) == ["w0", "w1"]
+        assert not rep["refused"] and not rep["failed"]
+        assert ctl2.generation() >= last
+        for wid, gen in acked.items():
+            assert ctl2.journal.lookup_write(wid) == gen
+        merged = ctl2.journal.log.merged_graph()
+        f = ctl2.submit_retrying(0, deadline_s=60.0,
+                                 min_generation=last)
+        assert np.array_equal(f.result(timeout=0),
+                              bfs_reference(merged, 0))
+        assert "lux_pilot_elections_total 1" in ctl2.prom_dump()
+        # ONE stitched trace: both detects + the winner's elect and
+        # promote all mint the trace id from the election key
+        tid = _hex_hash(f"lux:election:{inc0}", 8)
+        by = spans_by_name(read_events(rec.run_dir()))
+        detects = by["pilot.detect"]
+        assert len(detects) == 2
+        assert {s["a"]["standby"] for s in detects} == {0, 1}
+        (elect,) = by["pilot.elect"]
+        (promote,) = by["pilot.promote"]
+        assert elect["a"]["winner"] == 0
+        assert "err" not in promote["a"]
+        assert promote["a"]["incarnation"] == ctl2.incarnation
+        assert promote["a"]["joined"] == 2
+        for s in detects + [elect, promote]:
+            assert s["a"]["trace"] == tid
+        # split-brain, direction 1: an impostor controller on a WIPED
+        # journal is refused by workers holding acked history
+        wiped = LiveFleetController(g, journal_dir=str(
+            tmp_path / "wiped"))
+        with pytest.raises(WorkerRefusedError,
+                           match="behind my own journal"):
+            wiped.add_worker("127.0.0.1", fleet.thread_workers[0].port)
+        wiped.close()
+        # split-brain, direction 2: the promoted LIVE controller
+        # refuses a static-snapshot worker (no journal lineage)
+        ws = ReplicaWorker(_sh, worker_id="ws", graph_id="live",
+                           q_buckets=(1, 4)).start()
+        try:
+            with pytest.raises(WorkerRefusedError) as ei:
+                ctl2.add_worker("127.0.0.1", ws.port)
+            assert ei.value.kind == "static"
+        finally:
+            ws.kill()
+    finally:
+        for s in standbys:
+            s.stop()
+        fleet.close()
+
+
+def test_election_fence_and_retry_after_failed_promotion():
+    """Unit-level election properties: only the lowest live id may
+    claim; a released claim lets the next standby retry; a failed
+    promote releases the fence and the SAME standby retries."""
+    group = StandbyGroup()
+    group.register(2)
+    group.register(5)
+    assert group.claim(5, "inc-a") is False   # not the lowest
+    assert group.claim(2, "inc-a") is True
+    assert group.claim(2, "inc-a") is False   # fenced: already claimed
+    group.release(2, "inc-a")
+    group.deregister(2)
+    assert group.claim(5, "inc-a") is True    # next-lowest retries
+
+    class _DeadCtl:
+        incarnation = "dead-1"
+        hb_interval_s = 0.01
+        hb_timeout_s = 0.05
+
+        def ping(self):
+            raise RuntimeError("gone")
+
+    calls = []
+
+    def flaky_promote(tc=None):
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("promotion interrupted")
+        return _FakeCtl(), {"joined": ["w0"]}
+
+    g2 = StandbyGroup()
+    sb = Standby(g2, 0, _DeadCtl(), flaky_promote, seed=3).start()
+    got = g2.wait_promoted(timeout_s=30.0)
+    sb.stop()
+    assert got is not None and len(calls) == 2
+    assert sb.outcome == "won"
+    assert got[1] == {"joined": ["w0"]}
+
+
+# ----------------------------------------------------------------------
+# the full autonomous loop
+# ----------------------------------------------------------------------
+
+
+def test_autopilot_soak_fixed_seed(rec):
+    """The acceptance soak: ramp -> previewed scale-up, kill ->
+    standby election with the subscription surviving via rebind,
+    overflow -> escalated compaction; zero acked loss and bitwise
+    reads asserted inside the soak, incident spans asserted here."""
+    dtrace.set_enabled(True)
+    report = autopilot_soak(0, steps=3, scale=6, cap=32, rows=8)
+    assert report["scale_ups"] >= 1
+    assert report["elections"] == 1 and report["winner"] == 0
+    assert report["compactions"] >= 1
+    assert report["writes"] >= 4 and report["reads"] >= 3
+    assert report["sub_delivered"], "subscription never delivered"
+    by = spans_by_name(read_events(rec.run_dir()))
+    # every autonomous action spanned on its keyed incident trace
+    keys = report["incident_keys"]
+    etid = _hex_hash(f"lux:{keys['election']}", 8)
+    assert {s["a"]["trace"] for s in by["pilot.elect"]} == {etid}
+    assert {s["a"]["trace"] for s in by["pilot.promote"]} == {etid}
+    scale_tids = {s["a"]["trace"] for s in by["pilot.scale"]}
+    assert scale_tids == {
+        _hex_hash(f"lux:{k}", 8) for k in keys["scale"]}
+    assert by.get("pilot.subscribe.push")
+
+
+@pytest.mark.slow
+def test_autopilot_soak_seed_sweep():
+    for seed in range(10):
+        report = autopilot_soak(seed, steps=3, scale=6, cap=32,
+                                rows=8)
+        assert report["elections"] == 1, seed
+        assert report["scale_ups"] >= 1, seed
+        assert report["compactions"] >= 1, seed
+
+
+@pytest.mark.slow
+def test_autoscale_bench_row():
+    from lux_tpu.serve.fleet.bench import measure_autoscale
+    out = measure_autoscale(scale=8, ef=4, start_qps=16.0,
+                            max_levels=6, window_s=0.6)
+    (row,) = out["rows"]
+    assert row["metric"].startswith("sssp_autoscale_w1to")
+    assert row["workers_after"] > row["workers_before"]
+    assert len(row["scale_actions"]) >= 1
+    assert row["shed_bounded"] is True
+    assert row["shed_frac"] <= row["max_shed_frac"]
+    assert row["knee_after_qps"] > 0

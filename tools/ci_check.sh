@@ -375,6 +375,33 @@ echo "$view" | grep -q "## Distributed traces" || { echo "missing luxview sectio
 echo "$view" | grep -q "fleet.request" || { echo "luxview missing trace"; exit 1; }
 '
 
+# 3d3) autopilot smoke (ISSUE 16): the FULL autonomous loop on a tiny
+#      live fleet — a load ramp trips the autoscaler into a previewed
+#      scale-up, a controller kill is detected by a STANDBY that wins
+#      the fenced election and promotes unattended (the standing-query
+#      subscription keeps delivering across the failover via hub
+#      rebind), and fat churn batches overflow the delta capacity into
+#      an escalated compaction — zero acked-write loss and bitwise
+#      reads asserted inside the soak, [PASS]-gated here
+stage autopilot_smoke 600 bash -c '
+set -e
+out=$(JAX_PLATFORMS=cpu python -c "
+from lux_tpu.fault.chaos import autopilot_soak
+report = autopilot_soak(0, steps=3, scale=6, cap=32, rows=8)
+assert report[\"scale_ups\"] >= 1, report
+assert report[\"elections\"] == 1 and report[\"winner\"] == 0, report
+assert report[\"compactions\"] >= 1, report
+assert report[\"sub_delivered\"], report
+print(\"[PASS] autopilot smoke: gen\", report[\"generation\"],
+      \"scale_ups\", report[\"scale_ups\"],
+      \"elections\", report[\"elections\"],
+      \"compactions\", report[\"compactions\"],
+      \"sub\", report[\"sub_delivered\"])
+")
+echo "$out" | grep -q "\[PASS\] autopilot smoke" || { echo "autopilot smoke failed"; exit 1; }
+echo "$out"
+'
+
 # 3e) program smoke (ISSUE 13): one spec-only workload end-to-end
 #     through the GENERIC driver on a tiny graph — the declarative
 #     compiler's whole path (spec -> program -> engine -> [PASS] check)
@@ -402,7 +429,7 @@ stage tier1_fast 1200 env JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_obs.py tests/test_program.py \
     tests/test_determinism.py tests/test_serve_scheduler.py \
     tests/test_fleet.py tests/test_mutate.py tests/test_live.py \
-    tests/test_fault.py tests/test_dtrace.py
+    tests/test_fault.py tests/test_dtrace.py tests/test_autopilot.py
 
 if [ "$FAILED" -ne 0 ]; then
   echo "ci_check: FAILED (see $LOG)"; exit 1
