@@ -1234,6 +1234,22 @@ def worker_main():
                 # (docs/DYNAMIC.md); sssp/cc are bitwise by
                 # construction (unique integer fixpoints)
                 row["max_ulp_diff"] = ulp_dist(mine, cold)
+                # accounted HBM sweeps per warm-refresh iteration by
+                # route family (ISSUE 17): the timed leg above ran the
+                # platform default; a serving deployment rides fused-pf
+                # (overlays tombstone in group space), whose routed
+                # total is the banked win
+                fst_acc, _ = expand_mod.plan_fused_shards_cached(
+                    mg.pull_shards, "sum", pf=True, mx=False)
+                est_acc, _ = expand_mod.plan_expand_shards_cached(
+                    mg.pull_shards, pf=True)
+                row["hbm_passes"] = {
+                    "direct": roofline.pull_hbm_passes("scan"),
+                    "expand_pf": roofline.routed_hbm_passes(est_acc,
+                                                            "scan"),
+                    "fused_pf": roofline.routed_hbm_passes(fst_acc,
+                                                           "scan"),
+                }
             _emit_row(row)
             print(f"# refresh {app}: {r_s:.3f}s vs cold {c_s:.3f}s "
                   f"= {speedup:.1f}x (bitwise={bitwise})",
@@ -1390,6 +1406,78 @@ def worker_main():
             record_sum_family_winner(winner)
             record_overlay_entry("tpu:micro_scan",
                                  {"scale": ms, "ms_per_iter": flavor_ms,
+                                  "winner": winner})
+
+    def measure_merge_micro():
+        """Standing TREE-vs-BULK cross-part merge micro row (ISSUE 17):
+        the SAME small multi-part SSSP push run through both cross-part
+        merge modes — "bulk" (concatenate-and-scatter, the serialized
+        all-to-one dependence) and "tree" (the static asynchronous
+        reduction tree of ops/merge_tree.py) — so the ``tpu:merge_mode``
+        default is measured, not assumed.  Oracle-gated twice: each
+        mode must land bitwise on the NumPy BFS hop oracle (the int-min
+        monoid is associative+commutative+idempotent, so ANY merge
+        order is exact — the luxmerge precision contract), and tree
+        must equal bulk bitwise before either time counts.  On TPU the
+        winner is banked under ``tpu:merge_mode`` (consumed by
+        engine/push._resolve_merge); the row is emitted everywhere."""
+        import numpy as np
+
+        from lux_tpu.engine import push as push_eng
+        from lux_tpu.graph.push_shards import build_push_shards
+        from lux_tpu.models.sssp import SSSPProgram, bfs_reference
+
+        ms = _env_int("LUX_BENCH_MERGE_MICRO_SCALE", 12)
+        mparts = _env_int("LUX_BENCH_MERGE_MICRO_PARTS", 4)
+        gm = generate.rmat(ms, 8, seed=0)
+        shm = build_push_shards(gm, mparts)
+        start = int(np.argmax(np.bincount(gm.col_idx, minlength=gm.nv)))
+        progm = SSSPProgram(nv=gm.nv, start=start)
+        want = bfs_reference(gm, start)
+        mode_ms, dists = {}, {}
+        for mode in ("bulk", "tree"):
+            st, _, _ = push_eng.run_push(progm, shm, merge=mode)
+            got = shm.scatter_to_global(np.asarray(st))
+            dists[mode] = got
+            # bfs_reference marks unreachable with nv; push with inf
+            if not np.array_equal(
+                    np.where(got >= progm.inf, gm.nv, got), want):
+                print(f"# merge micro: {mode} failed the BFS oracle "
+                      "gate; row skipped", file=sys.stderr, flush=True)
+                return
+            t_best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                st, _, _ = push_eng.run_push(progm, shm, merge=mode)
+                jax.block_until_ready(st)
+                t_best = min(t_best, time.perf_counter() - t0)
+            mode_ms[mode] = max(round(t_best * 1e3, 4), 1e-4)
+            print(f"# merge micro {mode}: {mode_ms[mode]} ms/run",
+                  file=sys.stderr, flush=True)
+        if not np.array_equal(dists["bulk"], dists["tree"]):
+            print("# merge micro: tree != bulk bitwise (int monoid "
+                  "contract violated); row skipped", file=sys.stderr,
+                  flush=True)
+            return
+        winner = min(mode_ms, key=mode_ms.get)
+        _emit_row({
+            "metric": f"merge_micro_tree_vs_bulk_rmat{ms}{suffix}",
+            "value": mode_ms[winner],
+            "unit": "ms/run",
+            "winner": winner,
+            "mode_ms": mode_ms,
+            "bitwise_equal": True,
+            "parts": mparts,
+            "ne": int(gm.ne),
+        })
+        if on_tpu:
+            from lux_tpu.engine.methods import (MERGE_MODE_KEY,
+                                                record_overlay_entry)
+
+            record_overlay_entry(MERGE_MODE_KEY, winner)
+            record_overlay_entry("tpu:micro_merge",
+                                 {"scale": ms, "parts": mparts,
+                                  "ms_per_run": mode_ms,
                                   "winner": winner})
 
     def measure_cf(m):
@@ -1761,6 +1849,14 @@ def worker_main():
                 measure_scan_micro()
             except Exception as e:  # noqa: BLE001
                 print(f"# scan micro row failed: {e}", file=sys.stderr,
+                      flush=True)
+            # standing tree-vs-bulk cross-part merge micro row (ISSUE
+            # 17): oracle-gated SSSP race, winner banked under
+            # tpu:merge_mode on TPU (engine/push._resolve_merge)
+            try:
+                measure_merge_micro()
+            except Exception as e:  # noqa: BLE001
+                print(f"# merge micro row failed: {e}", file=sys.stderr,
                       flush=True)
     if "pagerank" in apps and results and (
         on_tpu or os.environ.get("LUX_BENCH_FORCE_SCALEUP") == "1"

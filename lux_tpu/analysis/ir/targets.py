@@ -19,8 +19,11 @@ one-hot/accumulator tiles, kernel-count parity against the 0.5-sweep
 roofline claim, and ring neutrality), the mxscan entry points (ISSUE
 11 — the blocked MXU segmented scan: LUX-J1 trace stability, LUX-J4
 tile residency, LUX-J501 one-kernel accounting, LUX-J503 ring
-neutrality), and the dynamic-knob recompile probes (chip-day step
--3b).
+neutrality), the dynamic-knob recompile probes (chip-day step
+-3b), and the luxmerge units (ISSUE 17): the fused-family overlay's
+LUX-J1 occupancy invariance, its LUX-J503 overlay-on/off kernel parity
+on fused-pf, and the tree merge's LUX-J3 static collective schedule
+(the tree's LUX-J1 compile-cache contract rides the fast tier).
 
 The telemetry units ("+ring"/"ring-donate"/"ring-neutral") audit the
 flight-recorder contract (docs/OBSERVABILITY.md): the ring must trace
@@ -326,6 +329,65 @@ def _retrace_push_chunk() -> List[Finding]:
     return out
 
 
+def _retrace_push_chunk_tree() -> List[Finding]:
+    """ISSUE 17's LUX-J1 leg for the TREE cross-part merge: the
+    asynchronous reduction tree is a STATIC schedule (ops/merge_tree.py
+    — plan_tree is a pure function of the part count), so the tree-merge
+    chunk loop must hold the same contracts as the bulk one: hashable
+    statics and one compile across run lengths (it_stop re-calls hit
+    the cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.engine import push
+
+    fx = fixture()
+    sh = fx["pshards"]
+    loop = push.compile_push_chunk(fx["psssp"], sh.pspec, sh.spec, "scan",
+                                   merge="tree")
+    arrays, parrays, carry0 = push.push_init(fx["psssp"], sh)
+
+    def call(stop):
+        def go():
+            out = loop(arrays, parrays, carry0, jnp.int32(stop))
+            jax.block_until_ready(out.state)
+            return out
+
+        return go
+
+    out = retrace.check_statics(
+        (fx["psssp"], sh.pspec, sh.spec, "scan", "tree"),
+        "lux_tpu/engine/push.py", "push-chunk/tree-merge")
+    out += retrace.check_dynamic_recall(
+        loop, call(2), call(3), "lux_tpu/engine/push.py",
+        "push-chunk/tree-merge/it_stop")
+    return out
+
+
+def _retrace_pull_fixed_fused_overlay() -> List[Finding]:
+    """ISSUE 17's LUX-J1 leg for overlays on the FUSED families: the
+    group-space tombstone (the plan's gslot route) is scattered from
+    overlay DATA, so delta occupancy must stay trace-invariant on the
+    fused-pf hot loop exactly as it is on expand — empty / half / full
+    produce one trace, and the config re-traces stably (a churn batch
+    never recompiles the fastest serving kernels)."""
+    ovs = _overlay_fixture()
+    fx = fixture()
+    route = _fused_pf_plan()
+    path = "lux_tpu/engine/pull.py"
+    label = "pull-fixed/fused-pf+overlay"
+    out = retrace.check_statics(
+        (fx["prank"], fx["shards"].spec, "scan", route[0],
+         ovs["half"][0]), path, label)
+    out += retrace.trace_twice_stable(
+        lambda: _pull_fixed_traced(2, route, overlay=ovs["half"]),
+        path, label)
+    out += retrace.check_variants(
+        [_pull_fixed_traced(2, route, overlay=ovs[k])
+         for k in ("empty", "half", "full")], path, label)
+    return out
+
+
 def _serve_traced(app: str, q: int):
     import jax.numpy as jnp
 
@@ -597,6 +659,30 @@ def _collective_push_ring() -> List[Finding]:
         "push-ring/ppermute")
 
 
+def _collective_push_dist_tree() -> List[Finding]:
+    """ISSUE 17's LUX-J3 leg: the tree merge's staged ppermute
+    concatenation (merge_tree.staged_concat_gather) replaces the bulk
+    all_gather barrier — every stage's permutation is derived from the
+    mesh-agreed device count alone (bruck_schedule), never from data,
+    so the checker must find an identical collective sequence in every
+    shard_map body (the deadlock-freedom proof obligation)."""
+    import jax.numpy as jnp
+
+    from lux_tpu.analysis.ir import aot
+    from lux_tpu.engine import push
+
+    fx = fixture()
+    sh = fx["pshards"]
+    mesh = _mesh(2)
+    run = push._compile_push_dist(fx["psssp"], mesh, sh.pspec, sh.spec,
+                                  "scan", merge="tree")
+    arrays, parrays, carry0 = push.push_init(fx["psssp"], sh)
+    traced = run.trace(arrays, parrays, carry0, jnp.int32(4))
+    return check_shard_map_bodies(
+        aot.traced_jaxpr(traced), "lux_tpu/engine/push.py",
+        "push-dist/tree-merge")
+
+
 def _collective_pull_dist() -> List[Finding]:
     from lux_tpu.analysis.ir import aot
     from lux_tpu.parallel import dist
@@ -690,6 +776,21 @@ def _hbm_overlay_neutral() -> List[Finding]:
     twin = _pull_fixed_traced(2, route, overlay=ovs["half"])
     return hbm.check_kernel_parity(base, twin, "lux_tpu/engine/pull.py",
                                    "pull-fixed/overlay-neutral")
+
+
+def _hbm_fused_overlay_neutral() -> List[Finding]:
+    """ISSUE 17's LUX-J503 leg: overlay-on vs overlay-off kernel parity
+    on the FUSED-PF hot loop — the group-space tombstone is a scatter +
+    select in plain XLA and the insert fold rides the existing
+    delta_scatter graph, so mutation on the fastest plan family must
+    launch EXACTLY the base config's pallas kernels (the accounted
+    hbm_passes win is real, not paid back in hidden launches)."""
+    route = _fused_pf_plan()
+    ovs = _overlay_fixture()
+    base = _pull_fixed_traced(2, route)
+    twin = _pull_fixed_traced(2, route, overlay=ovs["half"])
+    return hbm.check_kernel_parity(base, twin, "lux_tpu/engine/pull.py",
+                                   "pull-fixed/fused-pf/overlay-neutral")
 
 
 def _hbm_fused_pf() -> List[Finding]:
@@ -973,6 +1074,12 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   _retrace_push_chunk_overlay),
         AuditUnit("retrace", "push-chunk/it_stop",
                   "lux_tpu/engine/push.py", True, _retrace_push_chunk),
+        AuditUnit("retrace", "push-chunk/tree-merge",
+                  "lux_tpu/engine/push.py", True,
+                  _retrace_push_chunk_tree),
+        AuditUnit("retrace", "pull-fixed/fused-pf+overlay",
+                  "lux_tpu/engine/pull.py", False,
+                  _retrace_pull_fixed_fused_overlay),
         AuditUnit("retrace", "serve-sssp/Q-buckets",
                   "lux_tpu/serve/batched.py", False,
                   lambda: _retrace_serve("sssp")),
@@ -1024,6 +1131,9 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   "lux_tpu/engine/push.py", False, _collective_push_dist),
         AuditUnit("collective", "push-ring/ppermute",
                   "lux_tpu/engine/push.py", False, _collective_push_ring),
+        AuditUnit("collective", "push-dist/tree-merge",
+                  "lux_tpu/engine/push.py", False,
+                  _collective_push_dist_tree),
         AuditUnit("collective", "pull-dist/allgather",
                   "lux_tpu/parallel/dist.py", False, _collective_pull_dist),
         AuditUnit("vmem", "expand-pf", "lux_tpu/ops/pallas_shuffle.py",
@@ -1042,6 +1152,9 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   "lux_tpu/engine/pull.py", True, _hbm_ring_neutral),
         AuditUnit("hbm", "pull-fixed/overlay-neutral",
                   "lux_tpu/engine/pull.py", True, _hbm_overlay_neutral),
+        AuditUnit("hbm", "pull-fixed/fused-pf/overlay-neutral",
+                  "lux_tpu/engine/pull.py", False,
+                  _hbm_fused_overlay_neutral),
         AuditUnit("hbm", "fused-pf", "lux_tpu/ops/expand.py", False,
                   _hbm_fused_pf),
         AuditUnit("hbm", "fused-mx", "lux_tpu/ops/expand.py", False,

@@ -135,7 +135,7 @@ def _route_arrays_of(static, arrays):
             out[f"dst.{k}"] = v
         return out
     if isinstance(static, E.FusedStatic):
-        r1a, _, r2a, _, _, vra, mxa = E.split_fused_arrays(
+        r1a, _, r2a, _, _, _, vra, mxa = E.split_fused_arrays(
             static, arrays, static.weighted)
         return {"r1": r1a, "r2": r2a, "vr": vra, "mx": mxa}
     r1a, _, r2a = E.split_arrays(static, arrays)

@@ -383,6 +383,49 @@ def resolve_sum(method: str, reduce: str = "sum",
     return resolved
 
 
+#: CROSS-PART MERGE modes of the push engine's frontier aggregation
+#: (ISSUE 17, luxmerge): "bulk" = the bulk-synchronous flatten — every
+#: part's queue concatenated (single all_gather on the dist engines)
+#: and scattered into one destination pass per superstep (the shipped
+#: PR-3 behavior); "tree" = the asynchronous reduction tree
+#: (ops/merge_tree, Tascade arXiv:2311.15810's atomic-free construction)
+#: — per-source-block partial frontiers combine pairwise up a STATIC
+#: schedule, and the dist queue exchange runs as staged
+#: recursive-doubling ppermute rounds instead of one barrier
+#: all_gather.  Both modes are bitwise-identical for min/max/integer
+#: monoids (scatter-reduce into disjoint destination slots is
+#: order-independent there — every shipped push program reduces with
+#: min/max), but a float-SUM push program would see the tree's
+#: association, so like ``tpu:reduce_mode`` the bulk default is retired
+#: only through a banked on-chip measurement, never assumed.
+MERGE_MODES = ("bulk", "tree")
+
+#: overlay key the merge micro race (bench.py's standing
+#: ``merge_micro_tree_vs_bulk`` row) banks its measured winner under.
+MERGE_MODE_KEY = "tpu:merge_mode"
+
+
+def merge_mode(platform: str | None = None) -> str:
+    """The preferred cross-part merge flavor: LUX_MERGE_MODE env
+    override (explicit choice, any platform), else the chip-measured
+    ``tpu:merge_mode`` overlay entry ON TPU ONLY, else "bulk" — the
+    shipped bulk-synchronous merge stays until a window measures, and
+    CPU runs are bitwise-unchanged by a banked TPU winner (the same
+    acceptance contract as ``tpu:sum``)."""
+    env = os.environ.get("LUX_MERGE_MODE")
+    if env:
+        if env not in MERGE_MODES:
+            raise ValueError(
+                f"LUX_MERGE_MODE must be one of {MERGE_MODES}, got {env!r}")
+        return env
+    plat = _normalize(platform if platform is not None
+                      else default_platform())
+    rec = _overlay_raw().get(MERGE_MODE_KEY)
+    if plat == "tpu" and rec in MERGE_MODES:
+        return rec
+    return "bulk"
+
+
 _tiles_cache: tuple | None = None
 
 

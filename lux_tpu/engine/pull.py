@@ -154,11 +154,15 @@ def local_pull_step(
     dynamic-graph mutation overlay (lux_tpu.mutate) — tombstoned base
     edges neutralize, then the fixed-capacity insert buffer gathers D
     extra source states and scatter-combines them into the accumulator
-    BEFORE apply.  Static shapes throughout: churn never retraces."""
+    BEFORE apply.  Static shapes throughout: churn never retraces.
+    Overlays compose with the expand AND fused-pf/fused-mx routes (the
+    fused families tombstone in group space through the plan's gslot
+    route, apply_fused ``del_val=``); only the CF route remains
+    overlay-free (mutate.overlay.FUSED_OVERLAY_NOTE)."""
     from lux_tpu.ops import expand
 
     if overlay is not None and route is not None and isinstance(
-            route[0], (expand.FusedStatic, expand.CFRouteStatic)):
+            route[0], expand.CFRouteStatic):
         from lux_tpu.mutate.overlay import FUSED_OVERLAY_NOTE
 
         raise ValueError(FUSED_OVERLAY_NOTE)
@@ -174,7 +178,14 @@ def local_pull_step(
         acc = expand.apply_fused(
             full_state, route[0], route[1],
             edge_value=lambda s, w: prog.edge_value(s, w, None),
-            interpret=interpret)
+            interpret=interpret,
+            del_val=overlay[1].del_val if overlay is not None else None)
+        if overlay is not None:
+            from lux_tpu.mutate import overlay as _ovl
+
+            acc = _ovl.delta_scatter(
+                acc, full_state, overlay[1],
+                lambda s, w: prog.edge_value(s, w, None), prog.reduce)
         return prog.apply(local_state, acc, arrays)
     if route is not None:
         gath = pull_gather_part_routed(arrays, full_state, local_state,

@@ -17,6 +17,11 @@ sssp_gpu.cu/components_gpu.cu):
     the graceful sparse->dense degradation of sssp_gpu.cu:485-490.
   * The mode predicate is made GLOBAL (psum'd) so collectives (the dense
     branch's all_gather) sit inside `lax.cond` without divergence.
+  * Cross-part merge (sparse rounds): bulk concatenate-and-scatter, or
+    the static asynchronous reduction TREE of ops/merge_tree.py
+    (``merge="tree"`` / the banked ``tpu:merge_mode`` winner) — per-part
+    partial frontiers combine pairwise, bitwise-identical for the
+    min/max programs at any arity.
   * Convergence: psum'd changed-vertex count reaches zero — on-device,
     zero-lag (vs the 4-iteration SLIDING_WINDOW host pipeline,
     sssp/sssp.cc:115-129).
@@ -38,7 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from lux_tpu.engine import methods
 from lux_tpu.graph.push_shards import PushArrays, PushShards, PushSpec, SRC_SENTINEL
 from lux_tpu.graph.shards import ShardArrays, ShardSpec
-from lux_tpu.ops import segment
+from lux_tpu.ops import merge_tree, segment
 from lux_tpu.parallel.mesh import PARTS_AXIS, flatten_gather, shard_stacked
 
 
@@ -120,13 +125,12 @@ def sparse_prep(parr: PushArrays, q_vids):
     return idx_c, counts, incl, total
 
 
-def sparse_part_step(prog, pspec: PushSpec, parr: PushArrays, nv_pad,
-                     q_vids, q_vals, rows, counts, incl, local,
-                     cap: int | None = None):
-    """Push-mode: compact the frontier's out-edges (restricted to this
-    part's dsts) into a ``cap``-sized buffer (default the full e_sp
-    tier), then scatter-combine."""
-    del counts
+def _sparse_walk(prog, pspec: PushSpec, parr: PushArrays, nv_pad,
+                 q_vids, q_vals, rows, incl, cap: int | None):
+    """The compacted out-edge walk both merge modes share: map each slot
+    of a ``cap``-sized buffer to a (queue entry, within-entry edge) pair
+    and gather (dst, candidate).  Returns (dst, cand, entry_c); invalid
+    slots carry ``dst == nv_pad`` (the drop sentinel)."""
     j = jnp.arange(cap or pspec.e_sp, dtype=jnp.int32)
     entry = jnp.searchsorted(incl, j, side="right")
     entry_c = jnp.clip(entry, 0, q_vids.shape[0] - 1)
@@ -138,9 +142,65 @@ def sparse_part_step(prog, pspec: PushSpec, parr: PushArrays, nv_pad,
     valid = j < total
     dst = jnp.where(valid, parr.csr_dst_local[edge], nv_pad)
     cand = prog.relax(q_vals[entry_c], parr.csr_weight[edge])
+    return dst, cand, entry_c
+
+
+def sparse_part_step(prog, pspec: PushSpec, parr: PushArrays, nv_pad,
+                     q_vids, q_vals, rows, counts, incl, local,
+                     cap: int | None = None):
+    """Push-mode BULK merge: compact the frontier's out-edges (restricted
+    to this part's dsts) into a ``cap``-sized buffer (default the full
+    e_sp tier), then scatter-combine the whole concatenated frontier
+    into the local slice in one pass."""
+    del counts
+    dst, cand, _ = _sparse_walk(
+        prog, pspec, parr, nv_pad, q_vids, q_vals, rows, incl, cap
+    )
     if prog.reduce == "min":
         return local.at[dst].min(cand, mode="drop")
     return local.at[dst].max(cand, mode="drop")
+
+
+def sparse_part_step_tree(prog, pspec: PushSpec, parr: PushArrays, nv_pad,
+                          q_vids, q_vals, rows, counts, incl, local,
+                          cap: int | None = None):
+    """Push-mode TREE merge (Tascade-style, ops/merge_tree.py): the same
+    compacted walk, but each SOURCE part's candidates scatter into their
+    own neutral-initialized partial accumulator; the per-part partials
+    then combine pairwise up the static reduction tree and the root
+    combines with the local slice.  min/max scatters are
+    order-independent and the tree reassociates only min/max, so the
+    result is bitwise-identical to the bulk scatter at any arity —
+    while giving the compiler P independent partial frontiers with no
+    serializing all-to-one scatter dependence (the asynchronous-merge
+    shape; ISSUE 17 / PERF.md "Asynchronous merge")."""
+    del counts
+    dst, cand, entry_c = _sparse_walk(
+        prog, pspec, parr, nv_pad, q_vids, q_vals, rows, incl, cap
+    )
+    # queue layout is P consecutive f_cap runs (one per source part; the
+    # dist exchange may rotate WHICH part owns a run, never run layout)
+    blk = entry_c // pspec.f_cap
+    num_blocks = q_vids.shape[0] // pspec.f_cap
+    neu = merge_tree.neutral(prog.reduce, local.dtype)
+    partials = jnp.full((num_blocks,) + local.shape, neu, local.dtype)
+    if prog.reduce == "min":
+        partials = partials.at[blk, dst].min(cand, mode="drop")
+    else:
+        partials = partials.at[blk, dst].max(cand, mode="drop")
+    op = _op(prog)
+    return op(local, merge_tree.tree_combine(partials, op))
+
+
+def _resolve_merge(merge: str | None) -> str:
+    """auto-resolution shim for the cross-part merge mode (OUTSIDE the
+    compile caches, like methods.resolve_sum): None reads the banked
+    ``tpu:merge_mode`` winner / LUX_MERGE_MODE override."""
+    m = methods.merge_mode() if merge is None else merge
+    if m not in methods.MERGE_MODES:
+        raise ValueError(
+            f"merge must be one of {methods.MERGE_MODES}, got {m!r}")
+    return m
 
 
 def build_queue(pspec: PushSpec, arr: ShardArrays, changed, values):
@@ -278,7 +338,8 @@ def _push_prep(pspec: PushSpec, spec: ShardSpec, parrays, c: PushCarry):
 def _push_relax(prog, pspec: PushSpec, spec: ShardSpec, method, arrays,
                 parrays, c: PushCarry, q_vids_all, q_vals_all, preps,
                 use_dense, route_static=None, route_arrays=None,
-                interpret=False, ostatic=None, oarrays=None):
+                interpret=False, ostatic=None, oarrays=None,
+                merge: str = "bulk"):
     """COMP phase: dense (pull over all in-edges) or sparse (scatter the
     frontier's out-edges) relaxation -> new stacked state.
 
@@ -324,11 +385,13 @@ def _push_relax(prog, pspec: PushSpec, spec: ShardSpec, method, arrays,
         )(arrays, c.state, *((dv,) if dv is not None else ()))
 
     def sparse_all():
+        step = sparse_part_step if merge == "bulk" else sparse_part_step_tree
+
         def run(cap):
             def f(arr, parr, r, cn, inc, loc):
                 return jnp.where(
                     arr.vtx_mask,
-                    sparse_part_step(
+                    step(
                         prog, pspec, parr, V, q_vids_all, q_vals_all,
                         r, cn, inc, loc, cap,
                     ),
@@ -385,20 +448,21 @@ def _push_requeue(prog, pspec: PushSpec, spec: ShardSpec, arrays,
 def _push_iteration(prog, pspec: PushSpec, spec: ShardSpec, method,
                     arrays, parrays, c: PushCarry, route_static=None,
                     route_arrays=None, interpret=False, ostatic=None,
-                    oarrays=None) -> PushCarry:
+                    oarrays=None, merge: str = "bulk") -> PushCarry:
     """One direction-optimized iteration over all parts (single device)."""
     q_vids_all, q_vals_all, preps, use_dense = _push_prep(pspec, spec, parrays, c)
     new = _push_relax(
         prog, pspec, spec, method, arrays, parrays, c,
         q_vids_all, q_vals_all, preps, use_dense,
-        route_static, route_arrays, interpret, ostatic, oarrays,
+        route_static, route_arrays, interpret, ostatic, oarrays, merge,
     )
     return _push_requeue(prog, pspec, spec, arrays, c, new, preps, use_dense)
 
 
 def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
                        method: str = "auto", donate: bool = False,
-                       telemetry: bool = False, overlay_static=None):
+                       telemetry: bool = False, overlay_static=None,
+                       merge: str | None = None):
     """Single-device push loop with a DYNAMIC iteration stop (one compile
     serves every run length and every adaptive-repartition window; the
     driver inspects the carry's load stats between windows).
@@ -423,12 +487,18 @@ def compile_push_chunk(prog, pspec: PushSpec, spec: ShardSpec,
     OverlayArrays as a trailing ``oarrays`` argument — occupancy is
     data, so churn re-calls never recompile (LUX-J1).
 
+    ``merge`` ("bulk" | "tree" | None) selects the cross-part merge of
+    the sparse rounds (ops/merge_tree.py); None resolves the banked
+    ``tpu:merge_mode`` winner.  Bitwise-identical either way for the
+    min/max push programs.
+
     Resolution happens OUTSIDE the compile cache: caching on "auto" would
     pin the first platform resolution for the process and split the cache
     between "auto" and its concrete equivalent."""
     return _compile_push_chunk_cached(
         prog, pspec, spec, methods.resolve_sum(method, prog.reduce),
         donate=donate, telemetry=telemetry, ostatic=overlay_static,
+        merge=_resolve_merge(merge),
     )
 
 
@@ -436,7 +506,8 @@ def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
                               route_static, method: str = "auto",
                               donate: bool = False,
                               telemetry: bool = False,
-                              overlay_static=None):
+                              overlay_static=None,
+                              merge: str | None = None):
     """compile_push_chunk with the dense rounds' gather routed
     (interpret mode resolved here, off-chip = CPU tests)."""
     from lux_tpu.engine.pull import _route_interpret
@@ -445,6 +516,7 @@ def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
         prog, pspec, spec, methods.resolve_sum(method, prog.reduce),
         route_static=route_static, interpret=_route_interpret(),
         donate=donate, telemetry=telemetry, ostatic=overlay_static,
+        merge=_resolve_merge(merge),
     )
 
 
@@ -452,11 +524,12 @@ def compile_push_chunk_routed(prog, pspec: PushSpec, spec: ShardSpec,
 def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
                                method: str, route_static=None,
                                interpret=False, donate=False,
-                               telemetry=False, ostatic=None):
+                               telemetry=False, ostatic=None,
+                               merge: str = "bulk"):
     if telemetry:
         return _compile_push_chunk_telemetry(
             prog, pspec, spec, method, route_static, interpret, donate,
-            ostatic)
+            ostatic, merge)
 
     @partial(jax.jit, donate_argnums=(2,) if donate else ())
     def loop(arrays, parrays, carry: PushCarry, it_stop, route_arrays=None,
@@ -467,7 +540,7 @@ def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
         def body(c):
             return _push_iteration(prog, pspec, spec, method, arrays,
                                    parrays, c, route_static, route_arrays,
-                                   interpret, ostatic, oarrays)
+                                   interpret, ostatic, oarrays, merge)
 
         return jax.lax.while_loop(cond, body, carry)
 
@@ -476,7 +549,7 @@ def _compile_push_chunk_cached(prog, pspec: PushSpec, spec: ShardSpec,
 
 def _compile_push_chunk_telemetry(prog, pspec: PushSpec, spec: ShardSpec,
                                   method: str, route_static, interpret,
-                                  donate, ostatic=None):
+                                  donate, ostatic=None, merge: str = "bulk"):
     """The flight-recorder twin of the push chunk loop (see
     compile_push_chunk).  A separate compile, cached under the same
     lru key family: the ring rides the while carry, every recorded
@@ -497,7 +570,7 @@ def _compile_push_chunk_telemetry(prog, pspec: PushSpec, spec: ShardSpec,
             c, rg = cr
             c2 = _push_iteration(prog, pspec, spec, method, arrays,
                                  parrays, c, route_static, route_arrays,
-                                 interpret, ostatic, oarrays)
+                                 interpret, ostatic, oarrays, merge)
             # uint32 wrap-around subtraction gives the exact per-round
             # traversed count (< 2^32 per round by construction)
             rg = obs_ring.ring_push(
@@ -511,16 +584,17 @@ def _compile_push_chunk_telemetry(prog, pspec: PushSpec, spec: ShardSpec,
 
 
 def compile_push_phases(prog, pspec: PushSpec, spec: ShardSpec,
-                        method: str = "auto"):
+                        method: str = "auto", merge: str | None = None):
     """Uncached resolution shim — see compile_push_chunk."""
     return _compile_push_phases_cached(
-        prog, pspec, spec, methods.resolve_sum(method, prog.reduce)
+        prog, pspec, spec, methods.resolve_sum(method, prog.reduce),
+        _resolve_merge(merge),
     )
 
 
 @lru_cache(maxsize=64)
 def _compile_push_phases_cached(prog, pspec: PushSpec, spec: ShardSpec,
-                                method: str):
+                                method: str, merge: str = "bulk"):
     """One push iteration as THREE separately-jitted sub-steps for the
     -verbose phase breakdown (the reference's per-iteration
     loadTime/compTime/updateTime, sssp_gpu.cu:513-518):
@@ -541,7 +615,7 @@ def _compile_push_phases_cached(prog, pspec: PushSpec, spec: ShardSpec,
         q_vids_all, q_vals_all, preps, use_dense = plan
         return _push_relax(
             prog, pspec, spec, method, arrays, parrays, carry,
-            q_vids_all, q_vals_all, preps, use_dense,
+            q_vids_all, q_vals_all, preps, use_dense, merge=merge,
         )
 
     @jax.jit
@@ -552,23 +626,26 @@ def _compile_push_phases_cached(prog, pspec: PushSpec, spec: ShardSpec,
     return load, comp, update
 
 
-def compile_push_step(prog, pspec: PushSpec, spec: ShardSpec, method: str = "auto"):
+def compile_push_step(prog, pspec: PushSpec, spec: ShardSpec,
+                      method: str = "auto", merge: str | None = None):
     """Jitted SINGLE iteration (verbose mode / step-wise drivers — the
     per-iteration observability the reference gets from -verbose kernel
     timers, sssp_gpu.cu:513-518).  The carry is donated (state/queue
     double buffers reuse HBM)."""
     return _compile_push_step_cached(
-        prog, pspec, spec, methods.resolve_sum(method, prog.reduce)
+        prog, pspec, spec, methods.resolve_sum(method, prog.reduce),
+        _resolve_merge(merge),
     )
 
 
 @lru_cache(maxsize=64)
 def _compile_push_step_cached(prog, pspec: PushSpec, spec: ShardSpec,
-                              method: str):
+                              method: str, merge: str = "bulk"):
 
     @partial(jax.jit, donate_argnums=2)
     def step(arrays, parrays, carry: PushCarry):
-        return _push_iteration(prog, pspec, spec, method, arrays, parrays, carry)
+        return _push_iteration(prog, pspec, spec, method, arrays, parrays,
+                               carry, merge=merge)
 
     return step
 
@@ -588,6 +665,7 @@ def run_push(
     route=None,
     donate: bool = False,
     telemetry=None,
+    merge: str | None = None,
 ):
     """Single-device driver.  The direction switch is one global `lax.cond`
     over vmapped per-part branches — a genuine branch (only the taken mode
@@ -602,6 +680,9 @@ def run_push(
     ``telemetry`` (``obs.ring.new_ring("push")``) records the
     per-iteration frontier/traversed/direction curve in the loop carry
     (bitwise no-op on the results; the return gains the fetched ring).
+    ``merge`` ("bulk" | "tree", None = the banked ``tpu:merge_mode``)
+    selects the sparse rounds' cross-part merge — bitwise-identical for
+    the min/max push programs (ops/merge_tree.py).
     Returns (final stacked state, iters, edge counter[, ring]).
     """
     method = methods.resolve_sum(method, prog.reduce)
@@ -615,14 +696,15 @@ def run_push(
     extra = () if tel is None else (tel,)
     if route is None:
         loop = compile_push_chunk(prog, pspec, spec, method, donate=donate,
-                                  telemetry=tel is not None)
+                                  telemetry=tel is not None, merge=merge)
         out = loop(arrays, parrays, carry0, jnp.int32(max_iters), *extra)
     else:
         rs, ra = route
         ra = jax.tree.map(jnp.asarray, ra)
         loop = compile_push_chunk_routed(prog, pspec, spec, rs, method,
                                          donate=donate,
-                                         telemetry=tel is not None)
+                                         telemetry=tel is not None,
+                                         merge=merge)
         out = loop(arrays, parrays, carry0, jnp.int32(max_iters), *extra,
                    route_arrays=ra)
     if tel is not None:
@@ -641,23 +723,40 @@ def _carry_specs():
 
 
 def _spmd_push_prep(pspec: PushSpec, spec: ShardSpec, parr_blk,
-                    c: PushCarry):
-    """LOAD phase from a device's perspective inside shard_map: all_gather
+                    c: PushCarry, merge: str = "bulk", num_dev: int = 1):
+    """LOAD phase from a device's perspective inside shard_map: exchange
     the frontier (vid, value) queues (they are small: O(P * f_cap)), plan
     each resident part's sparse out-edge walk, and psum the GLOBAL
     direction/tier votes.  Returns the plan
     (q_vids_all, q_vals_all, (rows, counts, incl, totals), use_dense,
-    flags) — q/use_dense/flags are replicated across devices (gather/psum
-    results), the preps are per-resident-lane."""
-    # device order x resident order == global part order (shard_stacked
-    # gives device d parts [d*k, (d+1)*k)), so the tiled gather flattens
-    # straight into the (P * f_cap,) global queue view
-    q_vids_all = jax.lax.all_gather(
-        c.q_vid, PARTS_AXIS, tiled=True
-    ).reshape(-1)
-    q_vals_all = jax.lax.all_gather(
-        c.q_val, PARTS_AXIS, tiled=True
-    ).reshape(-1)
+    flags) — use_dense/flags are psum results (replicated); the preps are
+    per-resident-lane.
+
+    ``merge == "tree"`` swaps the bulk all_gather barrier for the staged
+    ppermute concatenation (merge_tree.staged_concat_gather) — each
+    device then holds the full queue in a per-device ROTATED part order,
+    which every downstream consumer absorbs (walk totals are sums, the
+    destination scatter is min/max: order-independent, bitwise)."""
+    if merge == "tree" and num_dev > 1:
+        # unconditional straight-line collectives with static offsets —
+        # the LUX-J3 deadlock-freedom argument (ops/merge_tree.py)
+        q_vids_all = merge_tree.staged_concat_gather(
+            c.q_vid, PARTS_AXIS, num_dev
+        ).reshape(-1)
+        q_vals_all = merge_tree.staged_concat_gather(
+            c.q_val, PARTS_AXIS, num_dev
+        ).reshape(-1)
+    else:
+        # device order x resident order == global part order
+        # (shard_stacked gives device d parts [d*k, (d+1)*k)), so the
+        # tiled gather flattens straight into the (P * f_cap,) global
+        # queue view
+        q_vids_all = jax.lax.all_gather(
+            c.q_vid, PARTS_AXIS, tiled=True
+        ).reshape(-1)
+        q_vals_all = jax.lax.all_gather(
+            c.q_val, PARTS_AXIS, tiled=True
+        ).reshape(-1)
     rows, counts, incl, totals = jax.vmap(
         lambda parr: sparse_prep(parr, q_vids_all)
     )(parr_blk)
@@ -681,7 +780,8 @@ def _spmd_push_prep(pspec: PushSpec, spec: ShardSpec, parr_blk,
 
 
 def _spmd_push_relax(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
-                     qarr_blk, dense_fn, c: PushCarry, plan):
+                     qarr_blk, dense_fn, c: PushCarry, plan,
+                     merge: str = "bulk"):
     """COMP phase from a device's perspective: one GLOBAL `lax.cond`
     between the engine-specific dense relaxation and the sparse frontier
     scatter over the resident lanes."""
@@ -690,11 +790,13 @@ def _spmd_push_relax(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
     V = spec.nv_pad
 
     def sparse_branch():
+        step = sparse_part_step if merge == "bulk" else sparse_part_step_tree
+
         def run(cap):
             def f(qarr, parr, r, cn, inc, loc):
                 return jnp.where(
                     qarr.vtx_mask,
-                    sparse_part_step(
+                    step(
                         prog, pspec, parr, V, q_vids_all, q_vals_all,
                         r, cn, inc, loc, cap,
                     ),
@@ -738,7 +840,8 @@ def _spmd_push_requeue(prog, pspec: PushSpec, spec: ShardSpec, qarr_blk,
 
 
 def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
-                    qarr_blk, dense_fn, c: PushCarry) -> PushCarry:
+                    qarr_blk, dense_fn, c: PushCarry,
+                    merge: str = "bulk", num_dev: int = 1) -> PushCarry:
     """ONE direction-optimized iteration from a device's perspective
     inside shard_map — the single source of truth for the dist, step-dist,
     ring, and pallas engines (their only difference is ``dense_fn``), and
@@ -750,7 +853,9 @@ def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
     vmaps over the resident lanes — the mapper-slicing analog
     (core/lux_mapper.cc:102-122).
 
-    * frontier (vid, value) queues are all_gathered unconditionally;
+    * frontier (vid, value) queues are exchanged unconditionally (bulk
+      all_gather, or ``merge == "tree"``'s staged ppermute concatenation
+      — same straight-line legality, see _spmd_push_prep);
     * the mode decision is GLOBAL (psum'd count + overflow/tier flags) so
       the dense branch's collectives sit inside `lax.cond` without
       divergence;
@@ -761,9 +866,9 @@ def _spmd_push_iter(prog, pspec: PushSpec, spec: ShardSpec, parr_blk,
       (k, V, ...) resident block: the all-gathered segmented reduce, or
       the ppermute ring fold.
     """
-    plan = _spmd_push_prep(pspec, spec, parr_blk, c)
+    plan = _spmd_push_prep(pspec, spec, parr_blk, c, merge, num_dev)
     new = _spmd_push_relax(
-        prog, pspec, spec, parr_blk, qarr_blk, dense_fn, c, plan
+        prog, pspec, spec, parr_blk, qarr_blk, dense_fn, c, plan, merge
     )
     return _spmd_push_requeue(prog, pspec, spec, qarr_blk, c, new, plan)
 
@@ -792,7 +897,7 @@ def _allgather_dense_fn(prog, arr_blk, method, route_static=None,
 @lru_cache(maxsize=64)
 def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                        method: str, route_static=None,
-                       interpret: bool = False):
+                       interpret: bool = False, merge: str = "bulk"):
     arr_specs = ShardArrays(*([P(PARTS_AXIS)] * len(ShardArrays._fields)))
     parr_specs = PushArrays(*([P(PARTS_AXIS)] * len(PushArrays._fields)))
     carry_specs = _carry_specs()
@@ -822,7 +927,7 @@ def _compile_push_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                 _allgather_dense_fn(
                     prog, arr_blk, method, route_static,
                     route_blk[0] if routed else None, interpret),
-                c,
+                c, merge, mesh.devices.size,
             )
 
         return jax.lax.while_loop(cond, body, carry_blk)
@@ -847,7 +952,10 @@ def compile_push_phases_dist(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                                              + active/edges psums)
 
     The phase bodies are the SAME _spmd_push_* the fused engines use.
-    Observability path; _compile_push_dist is the perf path."""
+    Observability path; _compile_push_dist is the perf path.  Always
+    bulk-merge: the phase split's plan_specs model the gathered queue
+    views as value-replicated lanes, which the tree exchange's rotated
+    per-device order would not be (the perf loops take merge=)."""
     return _compile_push_phases_dist_cached(
         prog, mesh, pspec, spec, methods.resolve_sum(method, prog.reduce)
     )
@@ -955,7 +1063,8 @@ def assemble_carry(c_local: PushCarry, assemble) -> PushCarry:
 @lru_cache(maxsize=64)
 def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
                        e_bucket_pad: int, method: str,
-                       route_static=None, interpret: bool = False):
+                       route_static=None, interpret: bool = False,
+                       merge: str = "bulk"):
     """Direction-optimizing push with the RING dense exchange: sparse
     rounds exchange (vid, value) queues exactly like _compile_push_dist;
     dense rounds fold ppermute-streamed state blocks through the ring
@@ -1032,7 +1141,8 @@ def _compile_push_ring(prog, mesh, pspec: PushSpec, spec: ShardSpec,
 
         def body(c):
             return _spmd_push_iter(
-                prog, pspec, spec, parr_blk, view_blk, ring_dense_fn, c
+                prog, pspec, spec, parr_blk, view_blk, ring_dense_fn, c,
+                merge, D,
             )
 
         return jax.lax.while_loop(cond, body, carry_blk)
@@ -1073,6 +1183,7 @@ def run_push_ring(
     max_iters: int = 10_000,
     method: str = "auto",
     route=None,
+    merge: str | None = None,
 ):
     """Distributed push driver with the ring dense exchange.  Only the
     O(part edges) CSR/bucket arrays and O(V) vertex arrays touch the
@@ -1082,6 +1193,7 @@ def run_push_ring(
     bitwise-identical (note its plan-footprint SCALE NOTE: the routed
     mode trades the O(nv/P) memory story for hot-loop speed)."""
     method = methods.resolve_sum(method, prog.reduce)
+    merge = _resolve_merge(merge)
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts % mesh.devices.size == 0
     assert method in ("scan", "scatter"), (
@@ -1090,7 +1202,8 @@ def run_push_ring(
     rarrays, parrays, view, carry0 = ring_init_dist(prog, shards, mesh)
     if route is None:
         run = _compile_push_ring(
-            prog, mesh, pspec, spec, shards.e_bucket_pad, method
+            prog, mesh, pspec, spec, shards.e_bucket_pad, method,
+            merge=merge,
         )
         out = run(rarrays, parrays, view, carry0, jnp.int32(max_iters))
     else:
@@ -1099,7 +1212,7 @@ def run_push_ring(
         rs, ra, interp = routed_run_args(mesh, route)
         run = _compile_push_ring(
             prog, mesh, pspec, spec, shards.e_bucket_pad, method,
-            route_static=rs, interpret=interp,
+            route_static=rs, interpret=interp, merge=merge,
         )
         out = run(rarrays, parrays, view, carry0, jnp.int32(max_iters), ra)
     return out.state, out.it, out.edges
@@ -1112,17 +1225,23 @@ def run_push_dist(
     max_iters: int = 10_000,
     method: str = "auto",
     route=None,
+    merge: str | None = None,
 ):
     """Distributed driver: queues (sparse rounds) or whole state (dense
     rounds) exchanged over ICI inside the on-device loop.  ``route``
     (an expand plan on the pull layout) replays the dense rounds'
-    gather as routed shuffles — bitwise-identical."""
+    gather as routed shuffles — bitwise-identical.  ``merge`` ("bulk" |
+    "tree", None = banked winner): tree mode exchanges the queues via
+    staged ppermutes and merges through the static reduction tree —
+    also bitwise (ops/merge_tree.py)."""
     method = methods.resolve_sum(method, prog.reduce)
+    merge = _resolve_merge(merge)
     spec, pspec = shards.spec, shards.pspec
     assert spec.num_parts % mesh.devices.size == 0
     arrays, parrays, carry0 = push_init_dist(prog, shards, mesh)
     if route is None:
-        run = _compile_push_dist(prog, mesh, pspec, spec, method)
+        run = _compile_push_dist(prog, mesh, pspec, spec, method,
+                                 merge=merge)
         out = run(arrays, parrays, carry0, jnp.int32(max_iters))
     else:
         from lux_tpu.engine.pull import _route_interpret
@@ -1132,6 +1251,7 @@ def run_push_dist(
         ra = shard_stacked(mesh, jax.tree.map(jnp.asarray, ra))
         run = _compile_push_dist(prog, mesh, pspec, spec, method,
                                  route_static=rs,
-                                 interpret=_route_interpret())
+                                 interpret=_route_interpret(),
+                                 merge=merge)
         out = run(arrays, parrays, carry0, jnp.int32(max_iters), ra)
     return out.state, out.it, out.edges

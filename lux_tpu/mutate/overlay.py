@@ -43,17 +43,22 @@ LANE = 128
 DEFAULT_CAP = 1024
 
 #: the ONE overlay-vs-plan-family rejection message (engine/pull.py and
-#: any future fused consumer raise it): it must name the escape hatches,
+#: any future CF consumer raise it): it must name the escape hatches,
 #: not just the incompatibility — a serving operator hitting this mid-
-#: incident needs the next command, not a design note.
+#: incident needs the next command, not a design note.  Since luxmerge
+#: the note covers ONLY the CF (colfilter) route: the fused-pf and
+#: fused-mx families tombstone in GROUP SPACE through the plan's gslot
+#: route (ops/expand.apply_fused ``del_val=``), so overlays ride the
+#: fastest kernels directly.
 FUSED_OVERLAY_NOTE = (
-    "mutation overlays compose with the direct gather and the routed "
-    "EXPAND plan family only (plan_expand_shards / --route-gather "
-    "expand|expand-pf, i.e. route_base=\"expand\"); fused/CF plans bake "
-    "the reduce layout at plan time, so tombstones cannot neutralize "
-    "per-edge values there.  Escape hatches: (1) re-plan the route with "
-    "route_base=\"expand\" (LUX_ROUTE_MODE=routed or routed-pf keeps "
-    "the overlay-compatible family; pass-fusion is preserved), or "
+    "mutation overlays compose with the direct gather, the routed "
+    "EXPAND family, and the FUSED families (fused/fused-pf/fused-mx "
+    "tombstone deleted edges in group space via the plan's gslot "
+    "route) — but NOT the CF route: its dst-state-dependent error term "
+    "re-reads the destination per edge, and the overlay's insert "
+    "buffer carries no dst-state replay for it.  Escape hatches: "
+    "(1) re-plan the route with route_base=\"expand\" "
+    "(LUX_ROUTE_MODE=routed or routed-pf keeps pass-fusion), or "
     "(2) compact() the MutableGraph — the merged base serves any plan "
     "family again (capacity knob: LUX_DELTA_CAP)")
 

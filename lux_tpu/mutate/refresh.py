@@ -36,6 +36,7 @@ Per-app exactness contracts (pinned by tests/test_mutate.py):
 from __future__ import annotations
 
 from collections import deque
+from functools import lru_cache
 
 import numpy as np
 
@@ -294,14 +295,76 @@ def _changed_count(old, new):
                    axis=tuple(range(1, old.ndim))).astype(jnp.int32)
 
 
+def pagerank_tolerance_threshold(tolerance: float,
+                                 alpha: float | None = None) -> float:
+    """The per-entry residual threshold a declared served-error bound
+    ``tolerance`` buys the frontier-tolerance refresh.
+
+    The PageRank update contracts the rank error by ``alpha`` per step
+    (models/pagerank.py: new = (1-alpha)/nv + alpha*acc — the classic
+    Banach bound puts a state whose step residual is r within
+    r*alpha/(1-alpha) of the fixpoint in the contraction norm).  The
+    probe measures the PER-ENTRY movement of the stored (pre-divided)
+    state while the contraction argument lives in the undivided ranks'
+    L1 norm, so the threshold is declared CONSERVATIVELY at
+    tolerance*(1-alpha) — an extra alpha/(1-alpha) (~0.18 at the
+    reference alpha=0.15) of slack against the norm gap.  The CONTRACT
+    is the tested one: max observed served error vs an f64 oracle stays
+    <= the declared tolerance across churn sequences
+    (tests/test_merge_tree.py) — the formula is the sizing argument,
+    the test is the promise."""
+    if alpha is None:
+        from lux_tpu.models.pagerank import ALPHA
+
+        alpha = ALPHA
+    if tolerance < 0:
+        raise ValueError(f"tolerance must be >= 0, got {tolerance}")
+    return float(tolerance) * (1.0 - float(alpha))
+
+
+@lru_cache(maxsize=None)
+def _tolerance_probe(threshold: float):
+    """Hashable residual probe for the frontier-tolerance refresh:
+    counts entries that moved by MORE than ``threshold`` — the loop
+    quiesces when every entry's step movement is inside the band.
+    lru_cache returns the SAME function object per threshold, so the
+    compiled loop caches exactly like the exact probe (one compile per
+    declared tolerance, zero retrace across refreshes)."""
+
+    def probe(old, new):
+        import jax.numpy as jnp
+
+        d = jnp.abs(new.astype(jnp.float32) - old.astype(jnp.float32))
+        return jnp.sum(d > jnp.float32(threshold),
+                       axis=tuple(range(1, old.ndim))).astype(jnp.int32)
+
+    return probe
+
+
+def pagerank_probe(tolerance: float = 0.0):
+    """The convergence probe for a declared served-error bound:
+    ``tolerance=0`` returns ``_changed_count`` ITSELF — the exact
+    residual==0 path, same function object, same compiled program,
+    bitwise the exact refresh (the degrade-to-exact leg of the
+    tolerance contract)."""
+    if tolerance <= 0:
+        return _changed_count
+    return _tolerance_probe(pagerank_tolerance_threshold(tolerance))
+
+
 def converge_pagerank(shards, method: str = "auto", route=None,
                       overlay=None, state0=None, max_iters: int = 512,
                       dtype: str = "float32",
-                      degree_override=None):
+                      degree_override=None, tolerance: float = 0.0):
     """Iterate PageRank to an EXACT f32 fixpoint (residual == 0) —
     shared by the warm refresh and the cold comparison leg.  Returns
     (stacked state, iters).  ``degree_override`` substitutes the merged
-    out-degrees ((P, V) int32 array — an ordinary jit argument)."""
+    out-degrees ((P, V) int32 array — an ordinary jit argument).
+    ``tolerance`` > 0 switches to the frontier-tolerance band: the loop
+    quiesces once every entry's step movement is inside
+    pagerank_tolerance_threshold(tolerance) — served error vs the true
+    fixpoint stays <= tolerance (the tested contract); 0 is bitwise the
+    exact path (pagerank_probe returns _changed_count itself)."""
     import jax
     import jax.numpy as jnp
 
@@ -317,19 +380,25 @@ def converge_pagerank(shards, method: str = "auto", route=None,
     else:
         state0 = jnp.asarray(state0)
     return pull.run_pull_until(
-        prog, shards.spec, arrays, state0, max_iters, _changed_count,
+        prog, shards.spec, arrays, state0, max_iters,
+        pagerank_probe(tolerance),
         method=method, route=route, overlay=overlay)
 
 
 def refresh_pagerank(mg, prior_state_stacked, method: str = "auto",
                      route=None, max_iters: int = 512,
-                     dtype: str = "float32"):
+                     dtype: str = "float32", tolerance: float = 0.0):
     """Warm PageRank refresh: prior converged ranks rescaled for the
     merged out-degrees (the state stores rank/deg), then the overlay
     step iterates to an exact f32 fixpoint.  ``route``: a BASE-graph
-    expand plan (unfused or pass-fused) — the base gather is unchanged
-    by churn, so the cached plan keeps serving.  Returns
-    (stacked state, iters)."""
+    plan — expand (unfused or pass-fused) OR a fused family
+    (fused/fused-pf/fused-mx tombstone in group space since luxmerge);
+    the base gather is unchanged by churn, so the cached plan keeps
+    serving.  ``tolerance``: the frontier-tolerance band (see
+    converge_pagerank) — 0 is bitwise the exact refresh, > 0 trades a
+    declared served-error bound for fewer warm iterations; a serving
+    layer MUST surface the bound on every read of the refreshed state
+    (the tolerance tag, serve/fleet).  Returns (stacked state, iters)."""
     from lux_tpu import obs
     from lux_tpu.mutate import overlay as ovl
 
@@ -347,6 +416,6 @@ def refresh_pagerank(mg, prior_state_stacked, method: str = "auto",
         state, it = converge_pagerank(
             shards, method=method, route=route, overlay=(ostatic, oarr),
             state0=warm, max_iters=max_iters, dtype=dtype,
-            degree_override=deg_new)
-        sp.set(iters=int(it))
+            degree_override=deg_new, tolerance=tolerance)
+        sp.set(iters=int(it), tolerance=float(tolerance))
     return state, int(it)

@@ -82,6 +82,15 @@ PF_FORMAT = 1
 #: ONLY the "fused-mx-*" cache family.
 MX_FORMAT = 1
 
+#: bump when the FUSED plan array arrangement changes — salts the
+#: "fused-*" cache families only (expand/ring/cf entries are untouched,
+#: the same surgical-salt precedent as PF_FORMAT/MX_FORMAT).
+#: 1: plans gained the runtime ``gslot`` array (CSC edge -> group slot,
+#: sentinel n2 on padding) that lets mutation overlays tombstone edges
+#: in GROUP SPACE at apply time (apply_fused ``del_val=``) — the fused
+#: families now serve live mutation without the expand downgrade.
+FUSED_FORMAT = 1
+
 
 # ---------------------------------------------------------------------------
 # plan-build accounting + the host-side planning executor
@@ -554,15 +563,15 @@ def _to_pf_one(static, arrays, knobs=(None, None, None)):
                 "to_pf: mxreduce plans are already pass-fused (and their "
                 "r2 grouping is mx-constrained); build them with "
                 "plan_fused(..., mx=True)")
-        r1a, ffa, r2a, gmask, gweights, vra, _mxa = split_fused_arrays(
-            static, arrays, static.weighted)
+        r1a, ffa, r2a, gmask, gweights, gslot, vra, _mxa = \
+            split_fused_arrays(static, arrays, static.weighted)
         r1s, r1n = _pf_route(static.r1, r1a, knobs)
         r2s, r2n = _pf_route(static.r2, r2a, knobs)
         vrs, vrn = _pf_route(static.vr, vra, knobs)
         warr = (gweights,) if static.weighted else ()
         return (dataclasses.replace(static, r1=r1s, r2=r2s, vr=vrs),
                 tuple(r1n) + tuple(ffa) + tuple(r2n) + (gmask,) + warr
-                + tuple(vrn))
+                + (gslot,) + tuple(vrn))
     if isinstance(static, CFRouteStatic):
         n_src = _num_expand_arrays(static.src)
         s_src, a_src = _to_pf_one(static.src, arrays[:n_src], knobs)
@@ -637,6 +646,9 @@ class FusedStatic:
     #: arrays carry (mx step idx tiles, dst_rel, tile_block, tile_first)
     #: in place of the group mask.  None = the plain masked group-reduce.
     mx: object = None
+    #: base CSC edge slots (length of the runtime ``gslot`` tombstone
+    #: route, FUSED_FORMAT 1) — the overlay's del_val addresses these.
+    e_pad: int = 0
 
 
 def _neutral_like(reduce: str, dtype):
@@ -819,6 +831,14 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
     if not mx:
         gmask = np.zeros(n2, bool)
         gmask[gslot_csc] = True
+    # runtime tombstone route: CSC edge rank -> group slot, sentinel n2
+    # on the padding rows (scatter mode="drop" ignores it).  Lets a
+    # mutation overlay mask deleted edges in GROUP SPACE at apply time
+    # (apply_fused ``del_val=``) without touching the frozen routes —
+    # the fused families serve live mutation without the expand
+    # downgrade.  CSC order matches OverlayArrays.del_val.
+    gslot_full = np.full(len(src_pos), n2, np.int32)
+    gslot_full[:m] = gslot_csc
 
     # accumulator route: totals (group order: one per dst, concat by k)
     # -> dst_local slots of a (nv_route,) vector; uncovered slots pull
@@ -846,6 +866,7 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
             nv_route=nv_route, reduce=reduce,
             weighted=weights is not None, groups=tuple(groups),
             r1=r1s, ff=ff_static, r2=r2s, vr=vrs, mx=mxs,
+            e_pad=len(src_pos),
         )
         idx_groups = (tuple(r1a) + tuple(ff_arrays) + tuple(r2a)
                       + tuple(mxa))
@@ -859,7 +880,7 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
         warr = ((np.ascontiguousarray(gweights.reshape(R, LANE)),)
                 if weights is not None else ())
         arrays = (idx_groups + (dst_rel, tile_block, tile_first) + warr
-                  + tuple(vra))
+                  + (gslot_full,) + tuple(vra))
         return static, arrays
 
     r1, r2, vr = _build_routes(perm1, perm2, permv)
@@ -870,22 +891,24 @@ def plan_fused(src_pos: np.ndarray, dst_local: np.ndarray, m: int,
         n=n, n2=n2, state_size=state_size, v_pad=v_pad,
         nv_route=nv_route, reduce=reduce, weighted=weights is not None,
         groups=tuple(groups), r1=r1s, ff=ff_static, r2=r2s, vr=vrs,
+        e_pad=len(src_pos),
     )
     idx_groups = tuple(r1a) + tuple(ff_arrays) + tuple(r2a)
     if _idx8_enabled():
         idx_groups = tuple(_narrow_idx(a) for a in idx_groups)
         vra = tuple(_narrow_idx(a) for a in vra)
     warr = (gweights,) if weights is not None else ()
-    arrays = idx_groups + (gmask,) + warr + tuple(vra)
+    arrays = idx_groups + (gmask,) + warr + (gslot_full,) + tuple(vra)
     return static, arrays
 
 
 def split_fused_arrays(static: FusedStatic, arrays, weighted: bool):
     """Recover the array groups of a fused plan's flat tuple.  Returns
-    (r1a, ffa, r2a, gmask, gweights, vra, mxa): ``mxa`` is () for plain
-    plans; for mxreduce plans it is (step tiles..., dst_rel, tile_block,
-    tile_first) and ``gmask`` is None (the rank tiles' sentinel subsumes
-    the mask)."""
+    (r1a, ffa, r2a, gmask, gweights, gslot, vra, mxa): ``mxa`` is () for
+    plain plans; for mxreduce plans it is (step tiles..., dst_rel,
+    tile_block, tile_first) and ``gmask`` is None (the rank tiles'
+    sentinel subsumes the mask).  ``gslot`` is the (e_pad,) CSC-edge ->
+    group-slot tombstone route (FUSED_FORMAT 1)."""
     n1 = shuf.route_num_arrays(static.r1)
     nff = _ff_array_count(static.ff)
     n2p = shuf.route_num_arrays(static.r2)
@@ -900,18 +923,21 @@ def split_fused_arrays(static: FusedStatic, arrays, weighted: bool):
         rest = rest[nmx:]
         gmask = None
         gweights = rest[0] if weighted else None
-        vra = rest[int(weighted):]
+        gslot = rest[int(weighted)]
+        vra = rest[1 + int(weighted):]
     else:
         mxa = ()
         gmask = rest[0]
         gweights = rest[1] if weighted else None
-        vra = rest[1 + int(weighted):]
+        gslot = rest[1 + int(weighted)]
+        vra = rest[2 + int(weighted):]
     assert len(vra) == shuf.route_num_arrays(static.vr)
-    return r1a, ffa, r2a, gmask, gweights, vra, mxa
+    return r1a, ffa, r2a, gmask, gweights, gslot, vra, mxa
 
 
 def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
-                weighted: bool | None = None, interpret: bool = False):
+                weighted: bool | None = None, interpret: bool = False,
+                del_val=None):
     """Device replay of the fused routed pull for one part: full_state
     (state_size,) -> accumulator (v_pad,).
 
@@ -924,13 +950,25 @@ def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
     edge_value applies on the VMEM tile, float sums contract on the MXU
     (f32 accumulate — its own deterministic association; min/max and
     integer ops reduce on the VPU, dtype-preserving bitwise), and the
-    group-space array is read once, never written back."""
+    group-space array is read once, never written back.
+
+    ``del_val``: optional (e_pad,) bool CSC-order tombstones (overlay
+    deletions).  Scattered through the plan's ``gslot`` route into a
+    GROUP-SPACE mask: the plain layout folds it into the group mask, the
+    mx layout redirects the tombstoned ranks to the kernel's sentinel
+    (v_blk) — deleted edges reduce as the neutral, bitwise equal to the
+    expand path's pre-reduce masking, with zero plan rebuild and zero
+    retrace across delta occupancies (same shapes, same static)."""
     if full_state.ndim != 1:
         raise ValueError("fused routed pull supports 1-D state only")
     if weighted is None:
         weighted = static.weighted
-    r1a, ffa, r2a, gmask, gweights, vra, mxa = split_fused_arrays(
+    r1a, ffa, r2a, gmask, gweights, gslot, vra, mxa = split_fused_arrays(
         static, arrays, weighted)
+    g_del = None
+    if del_val is not None:
+        g_del = (jnp.zeros((static.n2,), jnp.bool_)
+                 .at[gslot].set(del_val, mode="drop"))
     x = jnp.pad(full_state, (0, static.n - static.state_size))
     y = shuf.apply_route_frozen(x, static.r1, r1a, interpret=interpret)
     y = apply_ff(y, static.ff, ffa, interpret=interpret)
@@ -948,6 +986,10 @@ def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
         n_steps = len(mxg.steps)
         step_a = tuple(mxa[:n_steps])
         dst_rel, tile_block, tile_first = mxa[n_steps:]
+        if g_del is not None:
+            dst_rel = jnp.where(g_del.reshape(dst_rel.shape),
+                                jnp.asarray(mxg.v_blk, dst_rel.dtype),
+                                dst_rel)
         edge_fn = None
         if edge_value is not None:
             edge_fn = (edge_value if weighted
@@ -963,7 +1005,8 @@ def apply_fused(full_state, static: FusedStatic, arrays, edge_value=None,
         if edge_value is not None:
             y = edge_value(y, gweights) if weighted else edge_value(y, None)
         neutral = _neutral_like(static.reduce, y.dtype)
-        y = jnp.where(gmask, y, neutral)
+        keep = gmask if g_del is None else gmask & ~g_del
+        y = jnp.where(keep, y, neutral)
         red = {"sum": jnp.sum, "min": jnp.min,
                "max": jnp.max}[static.reduce]
         totals = []
@@ -1531,6 +1574,7 @@ def _fused_key_one(shards, template):
             _hash_array(h, f)
         v_pad = arrays.row_ptr.shape[1] - 1
         h.update(f"{shards.spec.gathered_size}:{v_pad}".encode())
+        h.update(f":fusedv{FUSED_FORMAT}".encode())
         h.update(tmpl_salt)
 
     return key_one

@@ -1,0 +1,169 @@
+"""Static asynchronous reduction trees for the cross-part frontier merge.
+
+The push engine's bulk-synchronous merge concatenates every part's
+frontier queue (a reshape on one device, an `all_gather` over ICI on the
+dist engines) and scatters the whole concatenation into each part's
+state slice — one barrier per superstep.  Tascade (arXiv:2311.15810)
+argues the aggregation should instead climb a STATIC reduction tree:
+per-part partial frontiers combine pairwise, atomic-free, with the
+combine order fixed at compile time so every participant provably
+executes the identical schedule.  This module is that schedule:
+
+* :func:`plan_tree` — the pairwise combine levels for any arity
+  (non-powers of two get byes), a pure host-side plan;
+* :func:`tree_combine` — the device-side evaluation of that plan over a
+  stacked ``(B, ...)`` block of partial accumulators;
+* :func:`neutral` — the combiner identity each partial starts from;
+* :func:`staged_concat_gather` — the dist engines' queue exchange as
+  ceil(log2 D) staged `ppermute` rounds (a Bruck concatenation) instead
+  of one bulk `all_gather`.
+
+Exactness contract (pinned by tests/test_merge_tree.py):
+
+* min / max / integer sum are associative AND commutative in machine
+  arithmetic, so ``tree_combine`` is bitwise-identical to any other
+  combine order — including the bulk left-fold — at every arity;
+* float sum reassociates, so a float-sum tree is NOT bitwise the bulk
+  fold.  The push engine therefore ships tree mode only for its min/max
+  programs; float-sum trees stay behind the oracle-gated
+  ``tpu:merge_mode`` A/B race (bench.py `merge_micro_tree_vs_bulk`)
+  with the VPU bulk fold the default until measured on chip.
+
+Deadlock-freedom (LUX-J3): :func:`staged_concat_gather`'s ppermute
+rounds are straight-line code with static Python-int rotation offsets —
+no branch, no data-dependent trip count — so every mesh participant
+executes the same collective sequence unconditionally.  The collective
+checker (analysis/ir/collectives.py) proves the enclosing loop/branch
+predicates are psum-agreed exactly as it does for the bulk all_gather.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@lru_cache(maxsize=None)
+def plan_tree(arity: int) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
+    """The static pairwise combine schedule for ``arity`` partials.
+
+    Returns a tuple of levels; each level is a tuple of ``(dst, src)``
+    index pairs meaning "combine partial ``src`` into partial ``dst``".
+    Indices not named at a level carry through unchanged (byes — how a
+    non-power-of-two arity stays balanced).  Level count is
+    ceil(log2(arity)); an arity of 0 or 1 has no levels.
+    """
+    if arity < 0:
+        raise ValueError(f"arity must be >= 0, got {arity}")
+    levels = []
+    live = list(range(arity))
+    while len(live) > 1:
+        pairs = []
+        nxt = []
+        i = 0
+        while i + 1 < len(live):
+            pairs.append((live[i], live[i + 1]))
+            nxt.append(live[i])
+            i += 2
+        if i < len(live):
+            nxt.append(live[i])  # bye: odd survivor rides up untouched
+        levels.append(tuple(pairs))
+        live = nxt
+    return tuple(levels)
+
+
+def tree_depth(arity: int) -> int:
+    return len(plan_tree(arity))
+
+
+def tree_combine(partials, op):
+    """Combine a stacked ``(B, ...)`` block of partial accumulators up
+    the :func:`plan_tree` schedule; returns the ``(...)`` root.
+
+    ``op`` is the elementwise combiner (``jnp.minimum`` / ``jnp.maximum``
+    / ``jnp.add``).  The adjacent-pair levels are evaluated as two
+    strided slices per level — the whole level combines in ONE
+    vectorized ``op`` call, so the device cost is ceil(log2 B) passes
+    over the accumulator, not B.
+    """
+    b = partials.shape[0]
+    if b == 0:
+        raise ValueError("tree_combine needs at least one partial")
+    while b > 1:
+        even = (b // 2) * 2
+        lo = partials[0:even:2]
+        hi = partials[1:even:2]
+        nxt = op(lo, hi)
+        if b % 2:
+            nxt = jnp.concatenate([nxt, partials[even:]], axis=0)
+        partials = nxt
+        b = partials.shape[0]
+    return partials[0]
+
+
+def neutral(reduce: str, dtype):
+    """The combiner identity a partial accumulator starts from —
+    combining it with any value returns that value bitwise (min/max on
+    IEEE floats: ``min(x, +inf) == x`` for every non-NaN x, including
+    signed zeros; integers: the dtype extremes)."""
+    dt = jnp.dtype(dtype)
+    if reduce == "sum":
+        return jnp.zeros((), dt)
+    if reduce not in ("min", "max"):
+        raise ValueError(f"unknown reduce {reduce!r}")
+    if jnp.issubdtype(dt, jnp.integer):
+        info = jnp.iinfo(dt)
+        return jnp.asarray(info.max if reduce == "min" else info.min, dt)
+    return jnp.asarray(np.inf if reduce == "min" else -np.inf, dt)
+
+
+def bruck_schedule(num_dev: int) -> Tuple[int, ...]:
+    """The static rotation offsets of :func:`staged_concat_gather`:
+    doubling strides ``(1, 2, 4, ...)`` below ``num_dev`` — the
+    mesh-collective schedule LUX-J3 audits, ceil(log2 D) rounds."""
+    if num_dev < 1:
+        raise ValueError(f"num_dev must be >= 1, got {num_dev}")
+    offs = []
+    s = 1
+    while s < num_dev:
+        offs.append(s)
+        s *= 2
+    return tuple(offs)
+
+
+def staged_concat_gather(block, axis_name: str, num_dev: int):
+    """Concatenate every device's ``(k, ...)`` block along the mesh axis
+    via staged ppermute rounds — the reduction-tree replacement for
+    ``all_gather(..., tiled=True)`` in the push engine's queue exchange.
+
+    Bruck construction: at round ``s`` (static doubling offsets from
+    :func:`bruck_schedule`) each device appends the buffer received from
+    device ``(d + s) % D`` to its own, then truncates to ``D`` device
+    blocks.  After ceil(log2 D) rounds device ``d`` holds the blocks of
+    devices ``d, d+1, ..., d+D-1`` (mod D) — the full concatenation in a
+    per-device ROTATED order.  The push engine's downstream consumers
+    are all order-independent (the walk totals are sums; the destination
+    scatter is a min/max), so the rotation never reaches the carry and
+    results stay bitwise identical to the bulk gather.
+
+    The rounds are unconditional straight-line collectives with static
+    integer offsets: every participant runs the identical sequence, the
+    LUX-J3 deadlock-freedom argument (module docstring).
+    """
+    k = block.shape[0]
+    buf = block
+    blocks = 1
+    for s in bruck_schedule(num_dev):
+        recv = jax.lax.ppermute(
+            buf, axis_name,
+            [(j, (j - s) % num_dev) for j in range(num_dev)],
+        )
+        buf = jnp.concatenate([buf, recv], axis=0)
+        blocks = min(2 * blocks, num_dev)
+        # consecutive-mod-D truncation: the first D device blocks of
+        # [d..d+2s) are exactly [d..d+D) — duplicates fall off the end
+        buf = buf[: blocks * k]
+    return buf

@@ -53,8 +53,8 @@ class SubscriptionClosed(RuntimeError):
 class Subscription:
     """One registered standing query.  ``get(timeout_s)`` blocks for
     the next pushed update — ``{app, generation, state, iters, worker,
-    refreshed}`` — strictly newer than ``cursor``; iteration yields
-    updates until the subscription closes."""
+    tolerance, refreshed}`` — strictly newer than ``cursor``; iteration
+    yields updates until the subscription closes."""
 
     def __init__(self, sub_id: int, app: str, cursor: int = 0):
         self.sub_id = int(sub_id)
@@ -274,6 +274,9 @@ class SubscriptionHub:
                           "state": ans["state"],
                           "iters": ans.get("iters"),
                           "worker": ans.get("worker"),
+                          # the served-error contract of the pushed
+                          # answer (luxmerge tolerance tag; 0.0 = exact)
+                          "tolerance": float(ans.get("tolerance") or 0.0),
                           "refreshed": bool(refreshed)}
                 pushed = 0
                 for s in by_app[app]:

@@ -741,7 +741,14 @@ class ReplicaWorker:
             reply = {"req_id": msg.get("req_id"), "ok": True,
                      "generation": int(ent["generation"]),
                      "iters": int(ent["iters"]), "app": app,
-                     "arg": ent.get("arg")}
+                     "arg": ent.get("arg"),
+                     # the tolerance tag (luxmerge): the declared
+                     # served-error bound the refresh quiesced under —
+                     # 0.0 means the exact fixpoint.  Rides every
+                     # standing read exactly like the stale tag rides
+                     # degraded queries: the caller always sees the
+                     # error contract of what it was served.
+                     "tolerance": float(ent.get("tolerance") or 0.0)}
         try:
             conn.send(reply, arr=state)
         except ConnectionClosed:
@@ -792,7 +799,9 @@ class ReplicaWorker:
                         base_generation=int(base_gen),
                         standing=self._live.standing_spec,
                         method=self._live.method,
-                        max_iters=self._live.max_iters)
+                        max_iters=self._live.max_iters,
+                        route_family=self._live.route_family,
+                        tolerance=self._live.tolerance)
                 cache = self._make_cache(shards, live=live2)
                 cache.prewarm()  # old cache serves throughout this
             with self._lock:
@@ -936,6 +945,17 @@ def main(argv=None) -> int:
                     help="live mode: comma list of standing apps kept "
                          "warm by refresh ops — sssp:<start>, pagerank, "
                          "components")
+    ap.add_argument("--route-gather", default="",
+                    help="live mode: gather-plan family the standing "
+                         "PageRank refresh rides (fused-pf/fused-mx/"
+                         "fused/expand/expand-pf; 'none' = direct; "
+                         "'' = LUX_LIVE_ROUTE env, default fused-pf). "
+                         "All families are bitwise-equal — perf only")
+    ap.add_argument("--tolerance", type=float, default=0.0,
+                    help="live mode: frontier-tolerance band for the "
+                         "standing PageRank refresh — declared per-entry "
+                         "served-error bound, surfaced on every read as "
+                         "the tolerance tag (0 = exact fixpoint)")
     ap.add_argument("--cpus", default="",
                     help="pin this replica to these cores (comma list) — "
                          "the shared-nothing unit sizing the saturation "
@@ -968,7 +988,9 @@ def main(argv=None) -> int:
             journal_dir=args.journal_dir or None,
             base_generation=args.base_generation,
             standing=parse_standing(args.standing),
-            method=args.method, max_iters=args.max_iters)
+            method=args.method, max_iters=args.max_iters,
+            route_family=args.route_gather or None,
+            tolerance=args.tolerance)
     worker = ReplicaWorker(
         shards, worker_id=args.worker_id, graph_id=gid,
         apps=tuple(a for a in args.apps.split(",") if a),

@@ -87,9 +87,13 @@ def measure_live_mixed(scale: int = 12, ef: int = 8, workers: int = 2,
                         f"lux_live_bench_{os.getpid()}.lux")
     sources = pick_sources(g, 64, seed=seed)
     rng = np.random.default_rng(seed)
+    # standing pagerank rides the replicas' gather route (luxmerge:
+    # fused-pf by default — mutation overlays on the fastest plan
+    # family), so the refresh leg measures the shipped serving config
     fleet = start_live_fleet(
         workers, g, parts=parts, cap=cap, buckets=buckets,
-        snapshot_path=snap, graph_id=f"rmat{scale}")
+        snapshot_path=snap, graph_id=f"rmat{scale}",
+        standing=(("sssp", 0), ("pagerank", None)))
     ctl = fleet.controller
     # the standing serving SLOs (obs/slo.py), scored over this window's
     # own reads + writes: the row records a verdict per objective with
@@ -172,6 +176,27 @@ def measure_live_mixed(scale: int = 12, ef: int = 8, workers: int = 2,
     stale = sorted(x for s in staleness for x in s)
     lats = sorted(x for s in lat_ms for x in s)
     ok = sum(reads_ok)
+    # accounted HBM sweeps of ONE standing-pagerank refresh iteration,
+    # per route family (utils/roofline.py) — the luxmerge win the row
+    # banks: the pre-luxmerge refresh paid the DIRECT gather's sweeps,
+    # the fused-pf route the replicas now ride pays the routed total.
+    # Plan construction is host-side accounting on the same layout the
+    # fleet served (build_pull_shards is deterministic), outside every
+    # timed region.
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.ops import expand
+    from lux_tpu.utils import roofline
+
+    sh_acc = build_pull_shards(g, parts)
+    fst, _ = expand.plan_fused_shards_cached(sh_acc, "sum", pf=True,
+                                             mx=False)
+    est, _ = expand.plan_expand_shards_cached(sh_acc, pf=True)
+    refresh_passes = {
+        "direct": roofline.pull_hbm_passes("scan"),
+        "expand_pf": roofline.routed_hbm_passes(est, "scan"),
+        "fused_pf": roofline.routed_hbm_passes(fst, "scan"),
+        "route_family": os.environ.get("LUX_LIVE_ROUTE", "fused-pf"),
+    }
     row = {
         "metric": f"sssp_live_w{workers}_rmat{scale}_cpu",
         "value": round(ok / max(read_s, 1e-9), 2),
@@ -187,6 +212,7 @@ def measure_live_mixed(scale: int = 12, ef: int = 8, workers: int = 2,
         "staleness_gen_p50": _pct(stale, 50),
         "staleness_gen_p99": _pct(stale, 99),
         "fleet_refresh_s": refresh["seconds"],
+        "hbm_passes": refresh_passes,
         "final_generation": max(gens.values()) if gens else 0,
         "worker_generations": gens,
         "compactions": compactions,

@@ -478,8 +478,10 @@ class LiveFleetController(FleetController):
                       worker: Optional[str] = None,
                       timeout_s: float = 30.0) -> dict:
         """One replica's refreshed standing state for ``app``:
-        {state, generation, iters, worker}.  ``worker=None`` picks the
-        freshest live replica."""
+        {state, generation, iters, worker, tolerance}.  ``worker=None``
+        picks the freshest live replica.  ``tolerance`` is the declared
+        served-error bound the answering refresh quiesced under
+        (0.0 = exact fixpoint) — the luxmerge twin of the stale tag."""
         with self._lock:
             handles = [h for h in self._workers.values() if h.alive
                        and (worker is None or h.wid == worker)]
@@ -494,7 +496,8 @@ class LiveFleetController(FleetController):
                              f"{p.error or p.reply.get('err')}")
         return {"state": p.arr, "generation": int(p.reply["generation"]),
                 "iters": int(p.reply["iters"]), "worker": h.wid,
-                "arg": p.reply.get("arg")}
+                "arg": p.reply.get("arg"),
+                "tolerance": float(p.reply.get("tolerance") or 0.0)}
 
     def read_standing_all(self, app: str = "sssp",
                           timeout_s: float = 30.0) -> Dict[str, dict]:
@@ -643,7 +646,9 @@ def start_live_fleet(n_workers: int, g: HostGraph, parts: int = 2,
                      journal_root: Optional[str] = None,
                      snapshot_path: Optional[str] = None,
                      max_queue: int = 256, wait_ms: float = 2.0,
-                     hb_interval_s: float = 0.25, method: str = "auto"):
+                     hb_interval_s: float = 0.25, method: str = "auto",
+                     route_family: Optional[str] = None,
+                     tolerance: float = 0.0):
     """A thread-mode live fleet over one in-memory graph: ``n_workers``
     LiveReplica-backed ReplicaWorkers sharing the pull layout, behind a
     LiveFleetController.  ``journal_root`` gives the controller
@@ -670,7 +675,8 @@ def start_live_fleet(n_workers: int, g: HostGraph, parts: int = 2,
                 g, shards, cap=cap,
                 journal_dir=(None if journal_root is None
                              else os.path.join(journal_root, wid)),
-                standing=standing, method=method)
+                standing=standing, method=method,
+                route_family=route_family, tolerance=tolerance)
             w = ReplicaWorker(
                 shards, worker_id=wid, graph_id=graph_id,
                 q_buckets=tuple(buckets), max_queue=max_queue,

@@ -92,11 +92,28 @@ class LiveReplica:
                  journal_dir: Optional[str] = None,
                  base_generation: int = 0,
                  standing: Tuple[Tuple[str, Optional[int]], ...] = (),
-                 method: str = "auto", max_iters: int = 10_000):
+                 method: str = "auto", max_iters: int = 10_000,
+                 route_family: Optional[str] = None,
+                 tolerance: float = 0.0):
         self.shards = shards
         self.cap = ovl.delta_cap(cap)
         self.method = method
         self.max_iters = int(max_iters)
+        # Standing-refresh gather route: since luxmerge the fused
+        # families tombstone deleted edges in group space, so the
+        # PageRank refresh rides the FASTEST plan family instead of the
+        # forced-expand downgrade.  None -> LUX_LIVE_ROUTE env, default
+        # 'fused-pf' (banked tpu:reduce_mode winner); '' / 'none'
+        # disables routing (the pre-luxmerge direct gather).
+        if route_family is None:
+            route_family = os.environ.get("LUX_LIVE_ROUTE", "fused-pf")
+        self.route_family = str(route_family)
+        #: frontier-tolerance band for the standing PageRank refresh —
+        #: 0.0 is bitwise the exact fixpoint loop; > 0 trades a declared
+        #: per-entry served-error bound (surfaced on every fleet read as
+        #: the tolerance tag) for fewer warm iterations.
+        self.tolerance = float(tolerance)
+        self._pr_route = None  # lazily-planned pagerank gather route
         self.journal_dir = journal_dir
         self.standing_spec = tuple(
             (app, None if arg is None else int(arg))
@@ -277,22 +294,51 @@ class LiveReplica:
                                           max_iters=self.max_iters)
         return {"state": labels, "iters": int(it)}
 
+    def _pagerank_route(self):
+        """The (cached) base-graph gather plan the standing PageRank
+        refresh rides — the base gather is unchanged by churn, so one
+        plan serves every refresh of the epoch.  Family comes from the
+        ``route_family`` knob (env LUX_LIVE_ROUTE, default 'fused-pf');
+        every family is bitwise-equal through the overlay, so this is a
+        perf decision only."""
+        rg = self.route_family
+        if rg in ("", "none"):
+            return None
+        if self._pr_route is None:
+            from lux_tpu.apps.common import route_base, route_is_pf, \
+                route_mx
+            from lux_tpu.ops import expand
+
+            shards = self.mg.pull_shards
+            pf = route_is_pf(rg)
+            if route_base(rg) == "fused":
+                self._pr_route = expand.plan_fused_shards_cached(
+                    shards, "sum", pf=pf, mx=route_mx(rg))
+            else:
+                self._pr_route = expand.plan_expand_shards_cached(
+                    shards, pf=pf)
+        return self._pr_route
+
     def _refresh_pagerank(self, ent):
         from lux_tpu.mutate import refresh as R
 
         shards = self.mg.pull_shards
+        route = self._pagerank_route()
         if ent is None:
             oarr, deg = self.serving_overlay()
             stacked, it = R.converge_pagerank(
-                shards, method=self.method,
+                shards, method=self.method, route=route,
                 overlay=(self.overlay_static, oarr),
-                degree_override=deg)
+                degree_override=deg, tolerance=self.tolerance)
         else:
             stacked, it = R.refresh_pagerank(self.mg, ent["stacked"],
-                                             method=self.method)
+                                             method=self.method,
+                                             route=route,
+                                             tolerance=self.tolerance)
         stacked = np.asarray(stacked)
         return {"state": shards.scatter_to_global(stacked),
-                "stacked": stacked, "iters": int(it)}
+                "stacked": stacked, "iters": int(it),
+                "tolerance": self.tolerance}
 
     # ------------------------------------------------------------------
     # republish plumbing
@@ -325,6 +371,7 @@ class LiveReplica:
             "base_generation": self.base_generation,
             "delta_occupancy": occ,
             "standing": {app: {"generation": e.get("generation"),
-                               "iters": e.get("iters")}
+                               "iters": e.get("iters"),
+                               "tolerance": e.get("tolerance", 0.0)}
                          for app, e in self._standing.items()},
         }

@@ -103,6 +103,9 @@ def routed_plan_bytes(static) -> int:
             b += static.n2  # group mask byte
         if static.weighted:
             b += static.n2 * 4  # pre-routed f32 weights
+        # runtime gslot tombstone route (int32 over the base edge slots,
+        # FUSED_FORMAT 1 — what lets overlays ride the fused families)
+        b += static.e_pad * 4
         b += route_cost(static.vr, static.nv_route)
     else:
         b += route_cost(static.r2, n)
@@ -172,10 +175,12 @@ def routed_plan_bytes_analytic(spec: ShardSpec, mode: str = "expand",
                              _next_pow2(spec.nv_pad), 128))
     if mode == "fused":
         # r2 moves to the ~2x group space and gains mask+weights (or,
-        # mx: the rank tile + weights); the accumulator route is small
+        # mx: the rank tile + weights); the accumulator route is small;
+        # the gslot tombstone route adds 4 B per base edge slot
         n2 = 2 * n
         k2 = len(factor_digits(n2))
         b += (2 * k2 - 1) * n2 * idx + n2 * (idx + 4 if mx else 5)
+        b += 4 * spec.e_pad
     return b
 
 

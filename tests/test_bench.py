@@ -47,6 +47,7 @@ def test_bench_happy_path_multi_app():
                       or "_live_" in ln["metric"])
             else "ms/iter" if ln["metric"].startswith(("reduce_micro",
                                                        "scan_micro"))
+            else "ms/run" if ln["metric"].startswith("merge_micro")
             else "x" if "_refresh_" in ln["metric"]
             else "GTEPS")
         assert ln["value"] > 0
@@ -62,6 +63,13 @@ def test_bench_happy_path_multi_app():
                   if ln["metric"].startswith("scan_micro"))
     assert set(smicro["flavor_ms"]) == {"scan", "mxsum", "mxscan"}
     assert smicro["winner"] in smicro["flavor_ms"]
+    # the standing tree-vs-bulk merge micro row (ISSUE 17): both merge
+    # modes timed behind the double bitwise oracle gate, a winner named
+    mmicro = next(ln for ln in lines
+                  if ln["metric"].startswith("merge_micro"))
+    assert set(mmicro["mode_ms"]) == {"bulk", "tree"}
+    assert mmicro["winner"] in mmicro["mode_ms"]
+    assert mmicro["bitwise_equal"] is True and mmicro["parts"] > 1
     qps = next(ln for ln in lines if "_qps_" in ln["metric"])
     assert qps["batched_vs_q1"] > 0 and qps["scheduler"]["completed"] > 0
     # the standing mutation-aware serving row (ISSUE 12): mixed

@@ -170,10 +170,12 @@ def test_batched_overlay_pairing_guard(small):
         plain.run([0], oarrays=ovl.empty_overlay_arrays(sh, 128))
 
 
-def test_fused_overlay_rejection_names_escape_hatch(small):
-    """Satellite: the fused/CF rejection must name the escape hatches
-    (compact, or route_base=\"expand\") and the knobs — not just say
-    'not supported'."""
+def test_cf_overlay_rejection_names_escape_hatch(small):
+    """Satellite (rescoped by luxmerge): the overlay rejection now
+    covers ONLY the CF route — the fused families tombstone in group
+    space and must RUN under an overlay.  The CF raise must still name
+    the escape hatches (compact, or route_base=\"expand\") and the
+    knobs — not just say 'not supported'."""
     import jax
     import jax.numpy as jnp
 
@@ -186,7 +188,7 @@ def test_fused_overlay_rejection_names_escape_hatch(small):
     ostatic, oarr = ovl.build_pull_overlay(sh, dlog, cap=256)
     prog = PageRankProgram(nv=sh.spec.nv)
     arrs = jax.tree.map(jnp.asarray, sh.arrays)
-    st, fa = expand.plan_fused_shards(sh)
+    st, fa = expand.plan_cf_route_shards(sh)
     with pytest.raises(ValueError) as ei:
         pull.run_pull_fixed(
             prog, sh.spec, arrs, pull.init_state(prog, arrs), 2,
@@ -195,6 +197,13 @@ def test_fused_overlay_rejection_names_escape_hatch(small):
     assert "route_base=\"expand\"" in msg
     assert "compact()" in msg
     assert "LUX_ROUTE_MODE" in msg and "LUX_DELTA_CAP" in msg
+    # the fused family is no longer rejected: the same overlay runs on
+    # the fused route (group-space tombstones via the plan's gslot)
+    fst, ffa = expand.plan_fused_shards(sh)
+    out = pull.run_pull_fixed(
+        prog, sh.spec, arrs, pull.init_state(prog, arrs), 2,
+        method="scan", route=(fst, ffa), overlay=(ostatic, oarr))
+    assert np.isfinite(np.asarray(out)).all()
 
 
 def test_wire_max_frame_env_knob(monkeypatch):

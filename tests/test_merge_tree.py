@@ -1,0 +1,317 @@
+"""luxmerge: the asynchronous cross-part merge and the
+frontier-tolerance refresh (ISSUE 17).
+
+Pins the exactness contracts of ops/merge_tree.py — the static
+reduction-tree schedule is bitwise-identical to the bulk left-fold for
+the min/max/integer monoids at EVERY arity (byes included), the push
+engine's tree mode lands on the bulk answer bitwise at every part
+count, and the LUX_MERGE_MODE knob resolves exactly like the other
+banked method knobs.  Plus the tolerance-refresh contract: a declared
+served-error bound is HONORED against a float64 oracle of the merged
+graph's fixpoint across churn sequences, tolerance=0 degrades to the
+bitwise exact path (same probe function object, same compiled
+program), the bound rides every standing read through the fleet as a
+served-read tag (the luxmerge twin of PR 14's stale tag), and the
+fused-overlay refresh route re-enters ONE compiled program across
+delta occupancies.
+"""
+import numpy as np
+import pytest
+
+from lux_tpu.engine import methods, pull, push
+from lux_tpu.graph import generate
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.models.pagerank import ALPHA, _host_iteration
+from lux_tpu.models.sssp import SSSPProgram, bfs_reference
+from lux_tpu.mutate import MutableGraph, OP_DELETE, OP_INSERT
+from lux_tpu.mutate import refresh as refresh_mod
+from lux_tpu.ops import merge_tree
+
+
+# ----------------------------------------------------------------------
+# the static schedule (host-side plan)
+# ----------------------------------------------------------------------
+
+
+def test_plan_tree_schedule_shape():
+    """Every arity's plan is a legal tournament: ceil(log2) levels,
+    exactly arity-1 combines total, no index touched twice per level,
+    and the byes keep non-powers-of-two balanced."""
+    import math
+
+    for arity in range(0, 18):
+        levels = merge_tree.plan_tree(arity)
+        want_depth = 0 if arity <= 1 else math.ceil(math.log2(arity))
+        assert len(levels) == want_depth, arity
+        assert merge_tree.tree_depth(arity) == want_depth
+        total = 0
+        for lvl in levels:
+            touched = [i for pair in lvl for i in pair]
+            assert len(touched) == len(set(touched)), (arity, lvl)
+            total += len(lvl)
+        assert total == max(arity - 1, 0), arity
+    with pytest.raises(ValueError, match="arity"):
+        merge_tree.plan_tree(-1)
+    with pytest.raises(ValueError, match="num_dev"):
+        merge_tree.bruck_schedule(0)
+    # doubling offsets, ceil(log2 D) rounds
+    assert merge_tree.bruck_schedule(1) == ()
+    assert merge_tree.bruck_schedule(5) == (1, 2, 4)
+    assert merge_tree.bruck_schedule(8) == (1, 2, 4)
+
+
+def test_tree_combine_bitwise_monoids():
+    """tree_combine == the bulk left-fold BITWISE for min/max (int and
+    float) and integer sum at every arity 1..9 — the reassociation-free
+    monoids the push engine ships tree mode for.  Float sum is checked
+    only to float tolerance: it genuinely reassociates, which is why it
+    stays behind the oracle-gated A/B race."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    ops = {"min": jnp.minimum, "max": jnp.maximum, "sum": jnp.add}
+    for arity in range(1, 10):
+        for dtype in (np.int32, np.float32):
+            vals = rng.integers(-1000, 1000,
+                                size=(arity, 33)).astype(dtype)
+            for name, op in ops.items():
+                got = np.asarray(
+                    merge_tree.tree_combine(jnp.asarray(vals), op))
+                bulk = vals[0]
+                for i in range(1, arity):
+                    bulk = np.asarray(op(bulk, vals[i]))
+                if name == "sum" and dtype is np.float32:
+                    np.testing.assert_allclose(got, bulk, rtol=1e-6)
+                else:
+                    assert np.array_equal(got, bulk), (arity, name,
+                                                       dtype)
+                # the neutral really is a combiner identity (bitwise)
+                n = merge_tree.neutral(name, dtype)
+                assert np.array_equal(
+                    np.asarray(op(jnp.asarray(vals[0]), n)), vals[0])
+
+
+# ----------------------------------------------------------------------
+# the engine contract: tree merge == bulk merge at every part count
+# ----------------------------------------------------------------------
+
+
+def test_push_tree_merge_bitwise_vs_bulk():
+    """run_push with merge="tree" lands on the bulk answer BITWISE at
+    parts 1/2/4 (arity 1, even, and power-of-two paths through the
+    schedule) and both match the BFS oracle."""
+    g = generate.rmat(9, 8, seed=11)
+    want = bfs_reference(g, 0)
+    for parts in (1, 2, 4):
+        shm = build_push_shards(g, parts)
+        prog = SSSPProgram(nv=g.nv, start=0)
+        outs = {}
+        for mode in ("bulk", "tree"):
+            st, _, _ = push.run_push(prog, shm, merge=mode)
+            d = shm.scatter_to_global(np.asarray(st))
+            assert np.array_equal(
+                np.where(d >= prog.inf, g.nv, d), want), (parts, mode)
+            outs[mode] = d
+        assert np.array_equal(outs["bulk"], outs["tree"]), parts
+
+
+def test_push_dist_tree_merge_bitwise_vs_bulk():
+    """The virtual-mesh dist engine: the staged-ppermute Bruck queue
+    exchange + tree combine lands on the bulk all_gather's answer
+    BITWISE — the per-device rotation never reaches the carry (every
+    downstream consumer is order-independent)."""
+    from lux_tpu.parallel import mesh as mesh_lib
+
+    g = generate.rmat(9, 8, seed=17)
+    shm = build_push_shards(g, 4)
+    prog = SSSPProgram(nv=g.nv, start=0)
+    mesh = mesh_lib.make_mesh_for_parts(4)
+    outs = {}
+    for mode in ("bulk", "tree"):
+        st, _, _ = push.run_push_dist(prog, shm, mesh, merge=mode)
+        outs[mode] = np.asarray(st)
+    assert outs["bulk"].tobytes() == outs["tree"].tobytes()
+    d = shm.scatter_to_global(outs["tree"])
+    assert np.array_equal(np.where(d >= prog.inf, g.nv, d),
+                          bfs_reference(g, 0))
+
+
+def test_merge_mode_knob(monkeypatch):
+    """LUX_MERGE_MODE resolves like the other banked knobs: explicit
+    env wins on any platform, invalid values raise naming the choices,
+    and the CPU default stays the shipped bulk merge."""
+    monkeypatch.delenv("LUX_MERGE_MODE", raising=False)
+    assert methods.merge_mode("cpu") == "bulk"
+    monkeypatch.setenv("LUX_MERGE_MODE", "tree")
+    assert methods.merge_mode("cpu") == "tree"
+    assert push._resolve_merge(None) == "tree"
+    monkeypatch.setenv("LUX_MERGE_MODE", "chaotic")
+    with pytest.raises(ValueError, match="LUX_MERGE_MODE"):
+        methods.merge_mode("cpu")
+    monkeypatch.delenv("LUX_MERGE_MODE", raising=False)
+    with pytest.raises(ValueError, match="merge"):
+        push._resolve_merge("bogus")
+
+
+# ----------------------------------------------------------------------
+# frontier-tolerance refresh: the declared-error contract
+# ----------------------------------------------------------------------
+
+
+def _oracle_fixpoint(merged):
+    """float64 fixpoint of the merged graph's recurrence — 200 exact
+    host iterations (contraction ~ALPHA per step; 0.15^200 is far
+    below f64 resolution)."""
+    deg = merged.out_degrees().astype(np.float64)
+    st = np.where(deg > 0, (1.0 / merged.nv) / np.maximum(deg, 1.0),
+                  1.0 / merged.nv)
+    for _ in range(200):
+        st = _host_iteration(merged, st, deg)
+    return st
+
+
+def _churn(mg, g, rng, ndel=20, nins=30):
+    if ndel:
+        dele = rng.choice(g.ne, ndel, replace=False)
+        mg.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+                 np.full(ndel, OP_DELETE, np.int8))
+    mg.apply(rng.integers(0, g.nv, nins), rng.integers(0, g.nv, nins),
+             np.full(nins, OP_INSERT, np.int8))
+
+
+def test_tolerance_threshold_and_probe_identity():
+    """The sizing formula and the compile-cache identity: the probe for
+    a tolerance is ONE function object (one compiled loop per declared
+    bound, zero retrace across refreshes), and tolerance<=0 returns the
+    exact residual probe ITSELF."""
+    t = refresh_mod.pagerank_tolerance_threshold(1e-4)
+    assert t == pytest.approx(1e-4 * (1.0 - ALPHA))
+    with pytest.raises(ValueError, match="tolerance"):
+        refresh_mod.pagerank_tolerance_threshold(-1e-6)
+    assert refresh_mod.pagerank_probe(0.0) is refresh_mod._changed_count
+    assert refresh_mod.pagerank_probe(1e-4) is \
+        refresh_mod.pagerank_probe(1e-4)
+    assert refresh_mod.pagerank_probe(1e-4) is not \
+        refresh_mod.pagerank_probe(1e-5)
+
+
+def test_tolerance_contract_vs_f64_oracle():
+    """The promise itself: across a churn sequence of warm refreshes,
+    the max observed served error vs the float64 fixpoint of the merged
+    graph stays <= the DECLARED tolerance — while the band buys fewer
+    warm iterations than the exact path."""
+    g = generate.rmat(9, 8, seed=21)
+    exact_iters = {}
+    for tol in (0.0, 1e-4, 1e-6):
+        rng = np.random.default_rng(3)
+        mg = MutableGraph(g, num_parts=2, cap=2048)
+        pr, _ = refresh_mod.converge_pagerank(mg.pull_shards,
+                                              tolerance=tol)
+        iters = []
+        for b in range(3):
+            _churn(mg, g, rng)
+            pr, it = refresh_mod.refresh_pagerank(mg, pr, tolerance=tol)
+            iters.append(it)
+            want = _oracle_fixpoint(mg.log.merged_graph())
+            got = mg.pull_shards.scatter_to_global(np.asarray(pr))
+            err = float(np.max(np.abs(got.astype(np.float64) - want)))
+            if tol > 0:
+                assert err <= tol, (tol, b, err)
+            else:
+                # exact path: f32 fixpoint noise only, orders below
+                # any tolerance a caller would declare
+                assert err <= 1e-8, (b, err)
+        if tol == 0.0:
+            exact_iters = dict(enumerate(iters))
+        else:
+            assert all(iters[b] <= exact_iters[b] for b in range(3)), (
+                tol, iters, exact_iters)
+
+
+def test_tolerance_zero_bitwise_exact_path():
+    """tolerance=0 IS the exact refresh: same converged bits as the
+    default call on the same churn — the degrade-to-exact leg of the
+    contract."""
+    g = generate.rmat(9, 8, seed=23)
+    rng = np.random.default_rng(5)
+    mg = MutableGraph(g, num_parts=2, cap=1024)
+    pr0, _ = refresh_mod.converge_pagerank(mg.pull_shards)
+    _churn(mg, g, rng)
+    a, ita = refresh_mod.refresh_pagerank(mg, pr0)
+    b, itb = refresh_mod.refresh_pagerank(mg, pr0, tolerance=0.0)
+    assert ita == itb
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tolerance_refresh_zero_retrace_fused_route():
+    """Warm tolerance refreshes on the fused-pf route across delta
+    occupancies re-enter ONE compiled program — the serving-config
+    composition (fastest plan family + tolerance band) of
+    test_mutate.py's zero-retrace pin."""
+    from lux_tpu.ops import expand
+
+    g = generate.rmat(9, 8, seed=7)
+    rng = np.random.default_rng(0)
+    mg = MutableGraph(g, num_parts=2, cap=512)
+    route = expand.plan_fused_shards_cached(mg.pull_shards, "sum",
+                                            pf=True, mx=False)
+    pr, _ = refresh_mod.converge_pagerank(mg.pull_shards, route=route,
+                                          tolerance=1e-5)
+    sizes = []
+    for lvl in (4, 60, 180):
+        _churn(mg, g, rng, ndel=0, nins=lvl)
+        pr, _ = refresh_mod.refresh_pagerank(mg, pr, route=route,
+                                             tolerance=1e-5)
+        sizes.append(pull._pull_until_jit._cache_size())
+    assert sizes[0] == sizes[1] == sizes[2], sizes
+
+
+# ----------------------------------------------------------------------
+# the served-read tag: tolerance rides every standing read
+# ----------------------------------------------------------------------
+
+
+def test_tolerance_tag_through_fleet():
+    """A fleet started with a declared tolerance serves the bound on
+    EVERY standing pagerank read — the tag a client needs to interpret
+    an approximate answer, exactly like the stale tag; apps refreshed
+    exactly tag 0.0."""
+    from lux_tpu.serve.live.controller import start_live_fleet
+
+    g = generate.rmat(8, 8, seed=4)
+    tol = 2e-4
+    fleet = start_live_fleet(
+        2, g, parts=2, cap=512,
+        standing=(("sssp", 0), ("pagerank", None)), tolerance=tol)
+    ctl = fleet.controller
+    try:
+        rng = np.random.default_rng(1)
+        src = rng.integers(0, g.nv, 16)
+        dst = rng.integers(0, g.nv, 16)
+        ctl.admit_writes(src, dst, np.ones(16, np.int8))
+        ctl.refresh_fleet()
+        ent = ctl.read_standing("pagerank")
+        assert ent["tolerance"] == pytest.approx(tol)
+        assert ent["generation"] >= 1
+        # every replica tags, not just the routed one
+        for wid, e in ctl.read_standing_all("pagerank").items():
+            assert e["tolerance"] == pytest.approx(tol), wid
+        # the exact app's tag is 0.0 — absence of a band is declared too
+        assert ctl.read_standing("sssp")["tolerance"] == 0.0
+    finally:
+        fleet.close()
+
+
+def test_tolerance_tag_default_zero_single_host():
+    """The default serving config declares tolerance 0.0 on its
+    standing entries (the LiveReplica knob surface, no fleet)."""
+    from lux_tpu.graph.shards import build_pull_shards
+    from lux_tpu.serve.live.replica import LiveReplica
+
+    g = generate.rmat(8, 8, seed=4)
+    solo = LiveReplica(g, build_pull_shards(g, 2), cap=256,
+                       standing=(("pagerank", None),))
+    solo.refresh()
+    ent = solo.standing("pagerank")
+    assert ent.get("tolerance", 0.0) == 0.0
+    assert solo.tolerance == 0.0

@@ -163,8 +163,9 @@ def test_overlay_step_bitwise_minmax():
 
 def test_overlay_routed_pf_bitwise():
     """The overlay composes with a BASE-graph routed(-pf) expand plan
-    bitwise (the routed gather is movement-only), and rejects fused
-    plans (whose reduce layout is baked at plan time)."""
+    bitwise (the routed gather is movement-only), and since luxmerge
+    also RUNS on fused plans (group-space tombstones) instead of
+    rejecting them."""
     from lux_tpu.ops import expand
 
     g = generate.rmat(9, 8, seed=13)
@@ -180,9 +181,54 @@ def test_overlay_routed_pf_bitwise():
     a, _ = refresh_mod.refresh_pagerank(mg, pr0)
     b, _ = refresh_mod.refresh_pagerank(mg, pr0, route=plan)
     assert np.array_equal(np.asarray(a), np.asarray(b))
+    # fused sum: a different (group-layout) association, same contract
+    # as the fused engines — it serves the refresh without raising and
+    # lands on the same fixpoint to float tolerance
     fused = expand.plan_fused_shards(mg.pull_shards, reduce="sum")
-    with pytest.raises(ValueError, match="fused"):
-        refresh_mod.refresh_pagerank(mg, pr0, route=fused)
+    c, _ = refresh_mod.refresh_pagerank(mg, pr0, route=fused)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                               rtol=0, atol=1e-6)
+
+
+def test_overlay_fused_families_bitwise():
+    """overlay∘fused, overlay∘fused-pf and overlay∘fused-mx are BITWISE
+    equal to overlay∘expand (and to the cold merged-graph step) for the
+    exactly-associative max reduce — the luxmerge acceptance claim that
+    live mutation runs on the fastest plan families undowngraded."""
+    from lux_tpu.ops import expand
+
+    g = generate.rmat(9, 8, seed=13)
+    rng = np.random.default_rng(2)
+    mg = MutableGraph(g, num_parts=2)
+    dele = rng.choice(g.ne, 25, replace=False)
+    mg.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+             np.full(25, OP_DELETE, np.int8))
+    mg.apply(rng.integers(0, g.nv, 40), rng.integers(0, g.nv, 40),
+             np.full(40, OP_INSERT, np.int8))
+    prog = comp.MaxLabelProgram()
+    sh = mg.pull_shards
+    merged = mg.log.merged_graph()
+    sh_m = build_pull_shards(merged, 2, cuts=np.asarray(sh.cuts))
+    s0 = pull.init_state(prog, sh.arrays)
+    s0_m = pull.init_state(prog, sh_m.arrays)
+    ov = mg.pull_overlay()
+    plan_exp = expand.plan_expand_shards(sh, pf=True)
+    plan_f = expand.plan_fused_shards(sh, reduce="max")
+    plans = (("fused", plan_f), ("fused-pf", expand.to_pf(plan_f)),
+             ("fused-mx", expand.plan_fused_shards(sh, reduce="max",
+                                                   mx=True)))
+    for n in (1, 3):
+        ref = pull.run_pull_fixed(prog, sh_m.spec, sh_m.arrays, s0_m, n,
+                                  method="scan")
+        a = pull.run_pull_fixed(prog, sh.spec, sh.arrays, s0, n,
+                                method="scan", overlay=ov, route=plan_exp)
+        for name, pl in plans:
+            b = pull.run_pull_fixed(prog, sh.spec, sh.arrays, s0, n,
+                                    method="scan", overlay=ov, route=pl)
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (name, n)
+            assert np.array_equal(
+                sh.scatter_to_global(np.asarray(b)),
+                sh_m.scatter_to_global(np.asarray(ref))), (name, n)
 
 
 def test_zero_retrace_across_occupancy():

@@ -256,7 +256,7 @@ def test_mx_rank_tiles_narrow_u8(rng):
     m, nseg, ss = 500, 17, 300
     src_pos, dst_local = _make_csc(rng, m, nseg, ss)
     stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 32, "sum", mx=True)
-    _, _, _, _, _, _, mxa = E.split_fused_arrays(stm, arrm, stm.weighted)
+    _, _, _, _, _, _, _, mxa = E.split_fused_arrays(stm, arrm, stm.weighted)
     dst_rel = mxa[len(stm.mx.steps)]
     assert dst_rel.dtype == np.uint8
     assert dst_rel.max() == stm.mx.v_blk  # sentinel present (padding)
@@ -269,11 +269,12 @@ def test_mx_split_arrays_round_trip(rng):
     m, nseg, ss = 400, 13, 256
     src_pos, dst_local = _make_csc(rng, m, nseg, ss)
     stm, arrm = E.plan_fused(src_pos, dst_local, m, ss, 16, "sum", mx=True)
-    r1a, ffa, r2a, gmask, gweights, vra, mxa = E.split_fused_arrays(
+    r1a, ffa, r2a, gmask, gweights, gslot, vra, mxa = E.split_fused_arrays(
         stm, arrm, stm.weighted)
     assert gmask is None and gweights is None
+    assert gslot.shape == (len(src_pos),) and gslot.dtype == np.int32
     assert len(mxa) == len(stm.mx.steps) + 3
-    total = (len(r1a) + len(ffa) + len(r2a) + len(mxa) + len(vra))
+    total = (len(r1a) + len(ffa) + len(r2a) + len(mxa) + 1 + len(vra))
     assert total == len(arrm)
     with pytest.raises(TypeError):
         E.to_pf((stm, arrm))  # mx plans are already pass-fused
@@ -452,7 +453,7 @@ def test_mx_vmem_audit(rng):
     assert any(f.code == "LUX-J401" and f.text.endswith(":mx")
                for f in findings)
     need = vmem.mx_residency_bytes(
-        stm.mx, E.split_fused_arrays(stm, arrm, stm.weighted)[6],
+        stm.mx, E.split_fused_arrays(stm, arrm, stm.weighted)[7],
         stm.weighted)
     assert need > 0
 

@@ -167,6 +167,60 @@ print('mutate smoke: refresh bitwise in', rounds, 'rounds;',
       rep['invalidation']['parts'], 'buckets')
 "
 
+# 3a3) merge smoke (ISSUE 17): the asynchronous reduction tree must be
+#      BITWISE the bulk merge for the integer min monoid (SSSP end to
+#      end at an odd part count — the bye path), and a churn overlay on
+#      the fused plan families must land on the routed-expand overlay's
+#      exact bits — the luxmerge correctness gate, [PASS]-gated
+stage merge_smoke 300 bash -c '
+set -e
+out=$(JAX_PLATFORMS=cpu python -c "
+import numpy as np
+from lux_tpu.engine import pull, push
+from lux_tpu.graph import generate
+from lux_tpu.graph.push_shards import build_push_shards
+from lux_tpu.models.components import MaxLabelProgram
+from lux_tpu.models.sssp import SSSPProgram, bfs_reference
+from lux_tpu.mutate import MutableGraph
+from lux_tpu.ops import expand
+g = generate.rmat(8, 8, seed=11)
+shm = build_push_shards(g, 3)
+prog = SSSPProgram(nv=g.nv, start=0)
+outs = {}
+for mode in (\"bulk\", \"tree\"):
+    st, _, _ = push.run_push(prog, shm, merge=mode)
+    outs[mode] = shm.scatter_to_global(np.asarray(st))
+assert np.array_equal(outs[\"bulk\"], outs[\"tree\"]), \"tree != bulk\"
+got = np.where(outs[\"tree\"] >= prog.inf, g.nv, outs[\"tree\"])
+assert np.array_equal(got, bfs_reference(g, 0)), \"tree != oracle\"
+mg = MutableGraph(g, num_parts=2)
+rng = np.random.default_rng(0)
+k = 20
+dele = rng.choice(g.ne, k, replace=False)
+mg.apply(g.col_idx[dele], g.dst_of_edges()[dele], np.zeros(k, np.int8))
+mg.apply(rng.integers(0, g.nv, k), rng.integers(0, g.nv, k),
+         np.ones(k, np.int8))
+sh = mg.pull_shards
+ov = mg.pull_overlay()
+cprog = MaxLabelProgram()
+s0 = pull.init_state(cprog, sh.arrays)
+a = pull.run_pull_fixed(cprog, sh.spec, sh.arrays, s0, 3,
+                        method=\"scan\", overlay=ov,
+                        route=expand.plan_expand_shards(sh, pf=True))
+for name, pl in ((\"fused-pf\", expand.to_pf(
+        expand.plan_fused_shards(sh, reduce=\"max\"))),
+                 (\"fused-mx\", expand.plan_fused_shards(
+        sh, reduce=\"max\", mx=True))):
+    b = pull.run_pull_fixed(cprog, sh.spec, sh.arrays, s0, 3,
+                            method=\"scan\", overlay=ov, route=pl)
+    assert np.array_equal(np.asarray(a), np.asarray(b)), name
+print(\"[PASS] merge smoke: tree==bulk bitwise (3 parts, bye path);\",
+      \"overlay on fused-pf/fused-mx == expand overlay bitwise\")
+")
+echo "$out" | grep -q "\[PASS\] merge smoke" || { echo "merge smoke failed"; exit 1; }
+echo "$out"
+'
+
 # 3b) obs smoke: a shell-seeded event log must round-trip through
 #     luxview (the post-mortem path chip_day's EXIT trap depends on),
 #     jax-free end to end; LUX-O itself runs inside stage 1's luxcheck
@@ -429,7 +483,8 @@ stage tier1_fast 1200 env JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_obs.py tests/test_program.py \
     tests/test_determinism.py tests/test_serve_scheduler.py \
     tests/test_fleet.py tests/test_mutate.py tests/test_live.py \
-    tests/test_fault.py tests/test_dtrace.py tests/test_autopilot.py
+    tests/test_fault.py tests/test_dtrace.py tests/test_autopilot.py \
+    tests/test_merge_tree.py
 
 if [ "$FAILED" -ne 0 ]; then
   echo "ci_check: FAILED (see $LOG)"; exit 1
