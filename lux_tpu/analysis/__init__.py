@@ -1,6 +1,6 @@
 """lux_tpu.analysis — luxcheck, the repo-native static-analysis suite.
 
-Five checker families encode the invariants that have actually bitten
+Six checker families encode the invariants that have actually bitten
 this codebase (see each module's docstring for the incident history):
 
 * tracing-safety (LUX-T*) — Python control flow / host concretization on
@@ -14,7 +14,11 @@ this codebase (see each module's docstring for the incident history):
   utils.config.env_int, u8 index narrowing through _narrow_idx only;
 * observability (LUX-O*) — no host syncs / flight-recorder host API in
   traced bodies, no per-iteration telemetry fetch in driving loops
-  (the luxtrace ring contract, docs/OBSERVABILITY.md).
+  (the luxtrace ring contract, docs/OBSERVABILITY.md);
+* lock-order    (LUX-L*) — the fleet's lock discipline: acquisition-
+  graph cycles, AB/BA order inversions, blocking calls under a held
+  lock, acquire/release split across helpers (docs/ANALYSIS.md's
+  protocol tier; the dynamic side is ``lux_tpu.analysis.proto``).
 
 Meta findings (LUX-X*) keep the suppression machinery itself honest:
 X000 unparsable file, X001 inline suppression without a justification,
@@ -42,6 +46,7 @@ from lux_tpu.analysis.core import (  # noqa: F401
     repo_root,
 )
 from lux_tpu.analysis.determinism import DeterminismChecker
+from lux_tpu.analysis.locks import LockOrderChecker
 from lux_tpu.analysis.obs import ObsChecker
 from lux_tpu.analysis.policy import PolicyChecker
 from lux_tpu.analysis.threads import ThreadSafetyChecker
@@ -54,6 +59,7 @@ ALL_CHECKERS = (
     ThreadSafetyChecker(),
     PolicyChecker(),
     ObsChecker(),
+    LockOrderChecker(),
 )
 
 FAMILIES = tuple(c.family for c in ALL_CHECKERS)

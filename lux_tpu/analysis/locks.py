@@ -1,0 +1,402 @@
+"""Lock-order checkers (LUX-L*): the serving/mutation fleet's lock
+discipline as AST lints.
+
+PR 12 split the replica worker's locking into ``_live_lock`` (admission
+order) and ``_lock`` (engine/staged state) and wrote the ordering down
+as COMMENTS ("Lock order _live_lock -> _lock matches _op_delta").  A
+comment can't fail CI; these checkers can.  They build a per-module
+lock-acquisition graph from the AST — lexically nested ``with`` blocks
+plus one level of same-class/same-module call propagation — and flag
+the four shapes that turn a two-lock design into a deadlock or a
+stall:
+
+* LUX-L001 — a CYCLE in the acquisition graph (including a self-cycle
+  on a known non-reentrant ``threading.Lock`` reached through a helper
+  call: the classic re-entrant deadlock).
+* LUX-L002 — the same two locks acquired in BOTH orders by direct
+  lexical nesting (the textbook AB/BA deadlock pair).
+* LUX-L003 — a blocking call (thread ``join``, future ``result``,
+  socket send/recv/accept/connect, ``time.sleep``, engine
+  compile/prewarm) made while LEXICALLY holding a lock: the fleet's
+  hot locks bound every RPC's tail latency, so blocking under one
+  stalls the whole replica.  ``Condition.wait`` is deliberately NOT in
+  the set — ``Condition(self._lock).wait()`` RELEASES the lock while
+  waiting and is this repo's standard wake idiom.
+* LUX-L004 — a raw ``.acquire()``/``.release()`` UNBALANCED within one
+  function (acquired in one helper, released in another): invisible to
+  both this graph and human readers; use ``with`` or pair them in one
+  frame.  ``__enter__``/``__exit__`` pairs are exempt — a lock-shaped
+  context manager is the FIX for this finding, not an instance of it.
+
+Scope and honesty: the graph is PER MODULE and identities are lexical
+(``ClassName._attr`` for ``self`` attributes, the bare name for
+module-level locks, the unparsed expression otherwise).  Cross-module
+cycles and aliased locks (``Condition(self._lock)`` shares its
+underlying lock) are out of reach — the protocol tier
+(``lux_tpu.analysis.proto``) covers the cross-component orderings;
+docs/ANALYSIS.md states the boundary.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from lux_tpu.analysis.core import (
+    Checker,
+    Finding,
+    Module,
+    call_name,
+    dotted_name,
+)
+from lux_tpu.analysis.threads import _walk_shallow
+
+#: threading constructors that create a lock-like object; the bool says
+#: whether re-acquisition on the same thread self-deadlocks
+_LOCK_CTORS = {
+    "Lock": True,          # non-reentrant
+    "Semaphore": True,
+    "BoundedSemaphore": True,
+    "RLock": False,
+    "Condition": False,    # re-entrant w.r.t. its (R)Lock by idiom here
+}
+
+#: keywords marking a with-expression as a lock (same list as
+#: Module.under_lock, so LUX-L and LUX-C agree on what a lock is)
+_LOCKISH = ("lock", "mutex", "cond", "flock", "wake")
+
+#: method/attribute names whose call blocks the calling thread
+_BLOCKING_ATTRS = {
+    "join", "result", "sendall", "recv", "recv_exact", "recv_into",
+    "accept", "connect", "wait_promoted", "prewarm", "compile",
+}
+
+#: dotted call names that block regardless of receiver
+_BLOCKING_CALLS = {"time.sleep"}
+
+
+def _is_lockish(src: str) -> bool:
+    low = src.lower()
+    return any(k in low for k in _LOCKISH)
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    """'Lock'/'RLock'/... when ``value`` is a threading-style lock
+    constructor call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    last = call_name(value).split(".")[-1]
+    return last if last in _LOCK_CTORS else None
+
+
+class _ModuleLocks:
+    """The module's lock identities + per-function acquisition sets."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        # identity -> ctor kind (None = lexically lock-ish, ctor unseen)
+        self.kinds: Dict[str, Optional[str]] = {}
+        self._collect_identities()
+        # "C.m" / "f" -> locks acquired lexically anywhere in the body
+        self.fn_locks: Dict[str, Set[str]] = {}
+        self._collect_fn_locks()
+
+    # -- identities -----------------------------------------------------
+
+    def _collect_identities(self) -> None:
+        for node in self.mod.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _ctor_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.kinds[t.id] = kind
+            elif isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    kind = _ctor_kind(sub.value)
+                    if not kind:
+                        continue
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            self.kinds[f"{node.name}.{t.attr}"] = kind
+
+    def enclosing_class(self, node: ast.AST) -> Optional[str]:
+        for anc in self.mod.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc.name
+        return None
+
+    def lock_id(self, expr: ast.AST, cls: Optional[str]) -> Optional[str]:
+        """Resolve a with-context expression to a lock identity, or
+        None when it isn't a lock."""
+        src = ast.unparse(expr)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.kinds:
+                return expr.id
+            return expr.id if _is_lockish(src) else None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and cls is not None):
+            ident = f"{cls}.{expr.attr}"
+            if ident in self.kinds or _is_lockish(src):
+                return ident
+            return None
+        return src if _is_lockish(src) else None
+
+    def kind_of(self, ident: str) -> Optional[str]:
+        return self.kinds.get(ident)
+
+    # -- per-function lock sets ----------------------------------------
+
+    def _fn_key(self, fn: ast.AST) -> str:
+        cls = self.enclosing_class(fn)
+        return f"{cls}.{fn.name}" if cls else fn.name
+
+    def _collect_fn_locks(self) -> None:
+        for fn in ast.walk(self.mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            cls = self.enclosing_class(fn)
+            acquired: Set[str] = set()
+            for node in _walk_shallow(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        ident = self.lock_id(item.context_expr, cls)
+                        if ident:
+                            acquired.add(ident)
+            self.fn_locks[self._fn_key(fn)] = acquired
+
+    def callee_locks(self, call: ast.Call,
+                     cls: Optional[str]) -> Tuple[str, Set[str]]:
+        """(callee display name, locks that callee acquires) for
+        same-class ``self.m(...)`` and same-module ``f(...)`` calls;
+        empty set for anything unresolvable."""
+        f = call.func
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and cls is not None):
+            key = f"{cls}.{f.attr}"
+            return key, self.fn_locks.get(key, set())
+        if isinstance(f, ast.Name):
+            return f.id, self.fn_locks.get(f.id, set())
+        return dotted_name(f), set()
+
+
+#: one acquisition-order edge: (held, then, site node, how, via)
+_Edge = Tuple[str, str, ast.AST, str, str]
+
+
+def _with_body_edges(locks: _ModuleLocks, fn: ast.AST,
+                     cls: Optional[str]) -> List[_Edge]:
+    """Edges contributed by one function: for every lock-holding
+    ``with``, the locks acquired inside its body — directly (nested
+    with) or one call level down (same class / same module)."""
+    edges: List[_Edge] = []
+    for node in _walk_shallow(fn):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        held = [locks.lock_id(item.context_expr, cls)
+                for item in node.items]
+        held = [h for h in held if h]
+        if not held:
+            continue
+        for inner in ast.walk(node):
+            if inner is node:
+                continue
+            if isinstance(inner, (ast.With, ast.AsyncWith)):
+                for item in inner.items:
+                    ident = locks.lock_id(item.context_expr, cls)
+                    if not ident:
+                        continue
+                    for h in held:
+                        if ident != h:
+                            edges.append((h, ident, inner, "direct",
+                                          fn.name))
+            elif isinstance(inner, ast.Call):
+                callee, acq = locks.callee_locks(inner, cls)
+                for ident in sorted(acq):
+                    for h in held:
+                        # self-edges via a call are kept: they are the
+                        # re-entrant deadlock candidates for plain Lock
+                        edges.append((h, ident, inner, "call",
+                                      f"{fn.name} -> {callee}"))
+    return edges
+
+
+def _find_cycle(adj: Dict[str, Set[str]]) -> Optional[List[str]]:
+    """Shortest-ish cycle via DFS; returns the node sequence (first ==
+    last) or None."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(adj.get(n, ())):
+            if m not in color:
+                continue
+            if color[m] == GRAY:
+                i = stack.index(m)
+                return stack[i:] + [m]
+            if color[m] == WHITE:
+                got = dfs(m)
+                if got:
+                    return got
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(adj):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got:
+                return got
+    return None
+
+
+class LockOrderChecker(Checker):
+    family = "lock-order"
+    name = "locks"
+
+    def run(self, mod: Module) -> Iterable[Finding]:
+        out: List[Finding] = []
+        locks = _ModuleLocks(mod)
+        edges: List[_Edge] = []
+        in_pkg = mod.relpath.startswith("lux_tpu/")
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            cls = locks.enclosing_class(fn)
+            edges.extend(_with_body_edges(locks, fn, cls))
+            if in_pkg:
+                out.extend(self._blocking(mod, locks, fn, cls))
+            out.extend(self._unbalanced(mod, locks, fn, cls))
+        out.extend(self._order_findings(mod, locks, edges))
+        return out
+
+    # -- L001 / L002: the acquisition graph -----------------------------
+
+    def _order_findings(self, mod: Module, locks: _ModuleLocks,
+                        edges: List[_Edge]) -> List[Finding]:
+        out: List[Finding] = []
+        direct: Dict[Tuple[str, str], _Edge] = {}
+        adj: Dict[str, Set[str]] = {}
+        first: Dict[Tuple[str, str], _Edge] = {}
+        for e in edges:
+            a, b, node, how, via = e
+            if a == b:
+                # self-cycle: only a deadlock for a known non-reentrant
+                # ctor reached through a call (with A: helper() where
+                # helper re-acquires A)
+                kind = locks.kind_of(a)
+                if how == "call" and kind and _LOCK_CTORS[kind]:
+                    out.append(self.finding(
+                        mod, node, "LUX-L001",
+                        f"re-entrant self-deadlock: `{a}` is a "
+                        f"non-reentrant threading.{kind} already held "
+                        f"here and re-acquired via `{via}`"))
+                continue
+            key = (a, b)
+            first.setdefault(key, e)
+            if how == "direct":
+                direct.setdefault(key, e)
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        reported_pairs: Set[Tuple[str, str]] = set()
+        for (a, b), e in sorted(direct.items()):
+            if (b, a) in direct and (b, a) not in reported_pairs:
+                reported_pairs.add((a, b))
+                ea, eb = direct[(a, b)], direct[(b, a)]
+                out.append(self.finding(
+                    mod, eb[2], "LUX-L002",
+                    f"inconsistent lock order: `{a}` -> `{b}` in "
+                    f"`{ea[4]}` (line {ea[2].lineno}) but `{b}` -> "
+                    f"`{a}` here in `{eb[4]}` — two threads taking "
+                    "opposite orders deadlock"))
+                # drop the pair from the graph so L001 doesn't re-report
+                adj[a].discard(b)
+                adj[b].discard(a)
+        cycle = _find_cycle(adj)
+        if cycle:
+            steps = []
+            for x, y in zip(cycle, cycle[1:]):
+                e = first[(x, y)]
+                steps.append(f"`{x}` -> `{y}` ({e[3]} in {e[4]}, line "
+                             f"{e[2].lineno})")
+            anchor = first[(cycle[0], cycle[1])][2]
+            out.append(self.finding(
+                mod, anchor, "LUX-L001",
+                "lock-order cycle: " + "; ".join(steps) +
+                " — some interleaving of these paths deadlocks"))
+        return out
+
+    # -- L003: blocking call while holding a lock ------------------------
+
+    def _blocking(self, mod: Module, locks: _ModuleLocks, fn: ast.AST,
+                  cls: Optional[str]) -> List[Finding]:
+        out: List[Finding] = []
+        for node in _walk_shallow(fn):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = [locks.lock_id(item.context_expr, cls)
+                    for item in node.items]
+            held = [h for h in held if h]
+            if not held:
+                continue
+            for inner in ast.walk(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                cn = call_name(inner)
+                attr = (inner.func.attr
+                        if isinstance(inner.func, ast.Attribute)
+                        else "")
+                if cn in _BLOCKING_CALLS or attr in _BLOCKING_ATTRS:
+                    what = cn or attr
+                    out.append(self.finding(
+                        mod, inner, "LUX-L003",
+                        f"blocking call `{what}` while holding "
+                        f"`{', '.join(held)}` in `{fn.name}` — every "
+                        "path contending this lock stalls behind it; "
+                        "move the blocking call outside the critical "
+                        "section"))
+        return out
+
+    # -- L004: acquire/release split across helpers ----------------------
+
+    def _unbalanced(self, mod: Module, locks: _ModuleLocks,
+                    fn: ast.AST, cls: Optional[str]) -> List[Finding]:
+        if fn.name in ("__enter__", "__exit__"):
+            return []  # a lock-shaped context manager is the fix
+        acq: Dict[str, List[ast.AST]] = {}
+        rel: Dict[str, List[ast.AST]] = {}
+        for node in _walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in ("acquire", "release")):
+                continue
+            ident = locks.lock_id(f.value, cls)
+            if not ident:
+                continue
+            (acq if f.attr == "acquire" else rel).setdefault(
+                ident, []).append(node)
+        out: List[Finding] = []
+        for ident in sorted(set(acq) | set(rel)):
+            na, nr = len(acq.get(ident, ())), len(rel.get(ident, ()))
+            if na == nr:
+                continue
+            node = (acq.get(ident) or rel.get(ident))[0]
+            out.append(self.finding(
+                mod, node, "LUX-L004",
+                f"`{ident}` {na} acquire / {nr} release in "
+                f"`{fn.name}` — the other half lives in a different "
+                "helper, invisible to readers and to the order graph; "
+                "use `with` or pair them in one frame"))
+        return out

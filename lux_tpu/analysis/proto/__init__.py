@@ -1,0 +1,127 @@
+"""luxproto — exhaustive protocol model checking for the distributed
+fleet.
+
+Four executable models of the fleet's coordination protocols, each
+checked EXHAUSTIVELY (full reachable state space, BFS, shortest
+counterexamples) at small-but-covering configurations, plus the
+conformance bridge that keeps the models honest against the real code:
+
+========  ==================================================  =========
+protocol  real code                                           model
+========  ==================================================  =========
+election  serve/autopilot/election.py incarnation fencing     election_model
+publish   serve/fleet controller↔worker two-phase tokens      publish_model
+genline   serve/live generation line / read-your-writes       genline_model
+journal   mutate/deltalog.py batch-then-marker atomicity      journal_model
+========  ==================================================  =========
+
+Every protocol registers a *clean* model (must check clean — CI fails
+otherwise) and one or more *broken twins*: the same model with one
+guard removed, which must PRODUCE a counterexample (a clean broken
+twin means the model lost the guard's coverage — also a CI failure).
+Twins double as the counterexample→FaultPlan source
+(``proto/export.py``).
+
+Pure stdlib + the jax-free protocol-surface modules
+(``pubproto``/``live.errors``/``deltalog`` constants): everything here
+imports under ``tools/_jaxfree.bare_package()``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from lux_tpu.analysis.proto.election_model import ElectionModel
+from lux_tpu.analysis.proto.genline_model import GenLineModel
+from lux_tpu.analysis.proto.journal_model import JournalModel
+from lux_tpu.analysis.proto.mc import (
+    CheckResult,
+    Model,
+    Violation,
+    check,
+)
+from lux_tpu.analysis.proto.publish_model import PublishModel
+
+
+class Protocol:
+    """One registered protocol: the clean model factory plus its
+    broken twins (guard-name → factory)."""
+
+    def __init__(self, name: str, clean: Callable[[], Model],
+                 broken: Dict[str, Callable[[], Model]],
+                 summary: str):
+        self.name = name
+        self.clean = clean
+        self.broken = dict(broken)
+        self.summary = summary
+
+
+#: the shipped registry, in report order — built once at import as a
+#: literal (read-only thereafter: luxproto readers never mutate it)
+PROTOCOLS: Dict[str, Protocol] = {p.name: p for p in (
+    Protocol(
+        "election",
+        clean=lambda: ElectionModel(n_standbys=3, fenced=True,
+                                    max_restarts=1),
+        broken={"unfenced": lambda: ElectionModel(
+            n_standbys=2, fenced=False, max_restarts=1)},
+        summary="controller election incarnation fencing (split-brain "
+                "guard) over the real StandbyGroup",
+    ),
+    Protocol(
+        "publish",
+        clean=lambda: PublishModel(n_workers=2, checked=True),
+        broken={"unchecked_tokens": lambda: PublishModel(
+            n_workers=2, checked=False)},
+        summary="two-phase publish tokens across controller failover "
+                "(exact-match commit, latest-prepare-wins)",
+    ),
+    Protocol(
+        "genline",
+        clean=lambda: GenLineModel(max_writes=3, mode="monotonic_max"),
+        broken={
+            "stale_heartbeat": lambda: GenLineModel(
+                mode="stale_heartbeat"),
+            "optimistic_send": lambda: GenLineModel(
+                mode="optimistic_send"),
+        },
+        summary="generation line: read-your-writes bounds, stale "
+                "tags, monotonic view folding",
+    ),
+    Protocol(
+        "journal",
+        clean=lambda: JournalModel(n_batches=3, marker_first=False),
+        broken={"marker_first": lambda: JournalModel(
+            marker_first=True)},
+        summary="journal crash-atomicity: durable batch npz before "
+                "the .ok marker, replay keeps the committed prefix",
+    ),
+)}
+
+
+def check_protocol(name: str, max_states: int = 1_000_000) -> CheckResult:
+    """Exhaustively check one protocol's CLEAN model."""
+    return check(PROTOCOLS[name].clean(), max_states=max_states)
+
+
+def check_broken(name: str, twin: str,
+                 max_states: int = 1_000_000) -> CheckResult:
+    """Check a broken twin — callers EXPECT a violation here."""
+    return check(PROTOCOLS[name].broken[twin](), max_states=max_states)
+
+
+def check_all(max_states: int = 1_000_000) -> List[CheckResult]:
+    """Clean models for every registered protocol, in registry order."""
+    return [check_protocol(n, max_states=max_states) for n in PROTOCOLS]
+
+
+__all__ = [
+    "CheckResult",
+    "Model",
+    "PROTOCOLS",
+    "Protocol",
+    "Violation",
+    "check",
+    "check_all",
+    "check_broken",
+    "check_protocol",
+]

@@ -1,0 +1,257 @@
+"""Trace-replay conformance: recorded soak event logs checked against
+the protocol models.
+
+The models (``election_model``/``publish_model``/``genline_model``/
+``journal_model``) prove the protocols safe in the abstract; this
+module closes the loop in the other direction — it feeds the event
+logs the REAL fleet emits (``fault.chaos.chaos_soak`` and
+``fault.chaos.autopilot_soak`` return them under ``"events"``) through
+the models' legality rules and flags every observed transition the
+models would not allow.  A soak that passes its own assertions but
+emits a model-illegal transition is a conformance bug: either the
+fleet drifted from the protocol or the model drifted from the fleet,
+and both are findings.
+
+The rules are the models' invariants projected onto the event
+vocabulary:
+
+* **genline** — write generations grow without gaps
+  (``gen <= max_gen + 1``); a non-stale-tagged read satisfies its
+  read-your-writes bound (``tag >= bound``); no tag ever leads the
+  journal generation (``tag <= max_gen``) — the view-never-leads-
+  reality invariant;
+* **election** — every failover names a winner; at most ONE failover
+  per incumbent incarnation (the elections<=1 split-brain guard);
+* **journal/failover durability** — a promoted controller's journal
+  generation covers every acked write (``gen >= max_gen``);
+* **liveness bookkeeping** — a worker is not killed twice without an
+  intervening rejoin (refresh rejoins the dead in ``chaos_soak``).
+
+An EMPTY trace is itself a nonconformance: a soak that recorded
+nothing proves nothing, and a conformance pass over it must not read
+as coverage.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Nonconformance:
+    """One model-illegal observed transition."""
+
+    index: int          # position in the event log (-1: whole-log)
+    rule: str           # short rule id, e.g. "genline.ryw"
+    message: str
+    event: Optional[dict] = None
+
+    def format(self) -> str:
+        at = "log" if self.index < 0 else f"event[{self.index}]"
+        return f"{at} {self.rule}: {self.message}"
+
+
+def _bad(out: List[Nonconformance], i: int, rule: str, msg: str,
+         ev: Optional[dict] = None) -> None:
+    out.append(Nonconformance(index=i, rule=rule, message=msg,
+                              event=ev))
+
+
+#: event vocabularies, used both for validation and for kind detection
+CHAOS_EVS = ("write", "read", "read_stale", "refresh", "kill",
+             "failover")
+PILOT_EVS = ("write", "read", "sub", "scale", "failover")
+
+
+def detect_kind(events: List[dict]) -> str:
+    """``chaos_soak`` events carry the step index ``"i"``;
+    ``autopilot_soak`` events do not."""
+    for ev in events:
+        return "chaos_soak" if "i" in ev else "autopilot_soak"
+    return "empty"
+
+
+def replay_chaos_soak(events: List[dict]) -> List[Nonconformance]:
+    out: List[Nonconformance] = []
+    if not events:
+        _bad(out, -1, "trace.empty",
+             "empty event log — a conformance pass over nothing is "
+             "not coverage")
+        return out
+    max_gen = 0        # journal generation = max acked write gen
+    dead: set = set()  # killed workers awaiting a rejoining refresh
+    failovers = 0
+    last_i = -1
+    for idx, ev in enumerate(events):
+        kind = ev.get("ev")
+        if kind not in CHAOS_EVS:
+            _bad(out, idx, "trace.unknown_event",
+                 f"unknown event kind {kind!r}", ev)
+            continue
+        i = ev.get("i", -1)
+        if not isinstance(i, int) or i < last_i:
+            _bad(out, idx, "trace.step_order",
+                 f"step index {i!r} regressed (last {last_i})", ev)
+        else:
+            last_i = i
+        if kind == "write":
+            gen = int(ev.get("gen", -1))
+            if gen < 1:
+                _bad(out, idx, "genline.gen_positive",
+                     f"write at generation {gen} (< 1)", ev)
+            elif gen > max_gen + 1:
+                _bad(out, idx, "genline.gen_gap",
+                     f"write jumped the generation line: {gen} > "
+                     f"{max_gen} + 1", ev)
+            max_gen = max(max_gen, gen)
+        elif kind in ("read", "read_stale"):
+            bound = int(ev.get("bound", 0))
+            tag = int(ev.get("tag", -1))
+            stale = bool(ev.get("stale", False))
+            if tag > max_gen:
+                _bad(out, idx, "genline.view_leads",
+                     f"read tag {tag} leads the journal generation "
+                     f"{max_gen} — view-never-leads-reality violated",
+                     ev)
+            if not stale and tag < bound:
+                _bad(out, idx, "genline.ryw",
+                     f"non-stale read at bound {bound} answered with "
+                     f"tag {tag} — read-your-writes violated without "
+                     "the stale tag", ev)
+            if kind == "read" and stale:
+                _bad(out, idx, "genline.fresh_required",
+                     "stale answer on a stale_ok=False read", ev)
+        elif kind == "refresh":
+            gen = int(ev.get("gen", -1))
+            if gen != max_gen:
+                _bad(out, idx, "genline.refresh_gen",
+                     f"refresh at generation {gen}, acked line is "
+                     f"{max_gen}", ev)
+            dead.clear()  # refresh rejoins every dead worker first
+        elif kind == "kill":
+            wid = ev.get("wid")
+            if not wid:
+                _bad(out, idx, "fleet.kill_unnamed",
+                     "kill event without a worker id", ev)
+            elif wid in dead:
+                _bad(out, idx, "fleet.double_kill",
+                     f"worker {wid} killed twice with no rejoin "
+                     "between", ev)
+            else:
+                dead.add(wid)
+        elif kind == "failover":
+            failovers += 1
+            if ev.get("winner") is None:
+                _bad(out, idx, "election.no_winner",
+                     "failover completed without a recorded winner",
+                     ev)
+            if failovers > 1:
+                _bad(out, idx, "election.refenced",
+                     f"failover #{failovers} for one incumbent "
+                     "incarnation — elections<=1 violated", ev)
+            gen = int(ev.get("gen", -1))
+            if gen < max_gen:
+                _bad(out, idx, "journal.promotion_lost_writes",
+                     f"promoted controller journal at generation "
+                     f"{gen} < acked line {max_gen}", ev)
+            max_gen = max(max_gen, gen)
+    return out
+
+
+def replay_autopilot_soak(events: List[dict]) -> List[Nonconformance]:
+    out: List[Nonconformance] = []
+    if not events:
+        _bad(out, -1, "trace.empty",
+             "empty event log — a conformance pass over nothing is "
+             "not coverage")
+        return out
+    max_gen = 0      # journal generation (write/failover line)
+    last_seq = -1    # autoscaler action sequence
+    failovers = 0
+    for idx, ev in enumerate(events):
+        kind = ev.get("ev")
+        if kind not in PILOT_EVS:
+            _bad(out, idx, "trace.unknown_event",
+                 f"unknown event kind {kind!r}", ev)
+            continue
+        if kind == "write":
+            gen = int(ev.get("gen", -1))
+            if gen < 1:
+                _bad(out, idx, "genline.gen_positive",
+                     f"write at generation {gen} (< 1)", ev)
+            elif gen > max_gen + 1:
+                _bad(out, idx, "genline.gen_gap",
+                     f"write jumped the generation line: {gen} > "
+                     f"{max_gen} + 1", ev)
+            max_gen = max(max_gen, gen)
+        elif kind == "read":
+            # autopilot reads always carry the full read-your-writes
+            # bound (min_generation = acked_gen at submit time)
+            tag = int(ev.get("tag", -1))
+            if tag > max_gen:
+                _bad(out, idx, "genline.view_leads",
+                     f"read tag {tag} leads the journal generation "
+                     f"{max_gen}", ev)
+            if tag < max_gen:
+                _bad(out, idx, "genline.ryw",
+                     f"read tag {tag} below the acked line {max_gen} "
+                     "— autopilot reads are bound to the full acked "
+                     "generation", ev)
+        elif kind == "sub":
+            gen = int(ev.get("gen", -1))
+            if gen > max_gen:
+                _bad(out, idx, "genline.sub_leads",
+                     f"subscription update at generation {gen} leads "
+                     f"the journal generation {max_gen}", ev)
+        elif kind == "scale":
+            action = ev.get("action")
+            if action not in ("scale_up", "scale_down"):
+                _bad(out, idx, "pilot.scale_action",
+                     f"unknown autoscaler action {action!r}", ev)
+            seq = int(ev.get("seq", -1))
+            if seq <= last_seq:
+                _bad(out, idx, "pilot.scale_seq",
+                     f"autoscaler seq {seq} did not advance (last "
+                     f"{last_seq})", ev)
+            last_seq = max(last_seq, seq)
+            frac = float(ev.get("moved_frac", 0.0))
+            if not (0.0 <= frac <= 1.0):
+                _bad(out, idx, "pilot.moved_frac",
+                     f"moved_frac {frac} outside [0, 1]", ev)
+        elif kind == "failover":
+            failovers += 1
+            if ev.get("winner") is None:
+                _bad(out, idx, "election.no_winner",
+                     "failover completed without a recorded winner",
+                     ev)
+            if failovers > 1:
+                _bad(out, idx, "election.refenced",
+                     f"failover #{failovers} for one incumbent "
+                     "incarnation — elections<=1 violated", ev)
+            gen = int(ev.get("gen", -1))
+            if gen < max_gen:
+                _bad(out, idx, "journal.promotion_lost_writes",
+                     f"promoted controller journal at generation "
+                     f"{gen} < acked line {max_gen}", ev)
+            max_gen = max(max_gen, gen)
+    return out
+
+
+def replay(events: Iterable[dict],
+           kind: str = "auto") -> List[Nonconformance]:
+    """Conformance-check one recorded soak log.  ``kind`` is
+    ``"chaos_soak"``, ``"autopilot_soak"`` or ``"auto"`` (detect by
+    event shape)."""
+    evs = list(events)
+    if kind == "auto":
+        kind = detect_kind(evs)
+    if kind == "chaos_soak":
+        return replay_chaos_soak(evs)
+    if kind == "autopilot_soak":
+        return replay_autopilot_soak(evs)
+    if kind == "empty":
+        return replay_chaos_soak(evs)  # emits the trace.empty finding
+    return [Nonconformance(
+        index=-1, rule="trace.unknown_kind",
+        message=f"unknown trace kind {kind!r} (expected chaos_soak / "
+                "autopilot_soak / auto)")]

@@ -1,0 +1,135 @@
+"""Counterexample → FaultPlan export: a model checker trace becomes a
+seeded, replayable PR-14 fault schedule.
+
+A broken-twin counterexample is a SCHEDULE — an ordering of detect /
+claim / promote / crash steps that breaks an invariant.  The fleet's
+fault engine (``lux_tpu.fault.plan``) already knows how to impose
+schedules on the real code: ``delay`` rules stretch a window open,
+``kill`` rules crash a thread at a named process point.  This module
+translates a :class:`~lux_tpu.analysis.proto.mc.Violation` trace into
+exactly those rules, so the abstract counterexample replays against
+the real implementation (``fault.chaos.election_drill`` for the
+election protocol — the round-trip the tests pin: unfenced group +
+exported plan ⇒ a REAL second election; real fenced group + the same
+plan ⇒ one election).
+
+The plan's seed is derived deterministically from the trace, so the
+exported JSON is bit-stable for a given counterexample — a failing
+check prints a plan that IS its reproduction recipe.
+
+Per-protocol mappings:
+
+* **election** — the first ``claim_win(sA)`` becomes a ``delay`` at
+  ``election.promote`` for standby A (hold the promotion window open);
+  a later ``claim_win(sB)``/``detect(sB)`` becomes a ``delay`` at
+  ``election.detect`` for standby B (make it the late TOCTOU
+  detector).  Replayed by ``election_drill``.
+* **journal** — each ``crash(#N)`` becomes a ``kill`` at
+  ``journal.before_marker`` (the canonical batch-durable/marker-absent
+  window; ``after`` staggers successive crashes).
+* **genline** — the regressing ``deliver_report``/``heartbeat`` step
+  becomes a ``delay`` at ``controller.heartbeat`` (stale heartbeats
+  are delayed heartbeats).
+* **publish** — a mid-barrier ``crash(c0)`` becomes a ``kill`` at
+  ``controller.heartbeat`` for the incumbent (crash between prepare
+  and commit fan-outs).
+"""
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import List, Optional
+
+from lux_tpu.analysis.proto.mc import CheckResult, Violation
+from lux_tpu.fault.plan import FaultPlan, FaultRule
+
+#: how long the exported schedule holds the winner's promotion open /
+#: stalls the late detector — generous multiples of the drill's probe
+#: cadence (hb 10ms, death 30ms) so the replay is schedule-stable
+PROMOTE_HOLD_MS = 1500.0
+DETECT_STALL_MS = 500.0
+
+
+def trace_seed(violation: Violation) -> int:
+    """Deterministic plan seed from the counterexample trace."""
+    digest = hashlib.sha256(
+        "\n".join(violation.trace).encode()).hexdigest()
+    return int(digest[:8], 16)
+
+
+def _election_rules(trace: tuple) -> List[FaultRule]:
+    wins = [m.group(1) for a in trace
+            for m in [re.match(r"claim_win\(s(\d+)\)", a)] if m]
+    first = wins[0] if wins else "0"
+    late = next((w for w in wins[1:] if w != first), None)
+    if late is None:
+        # no second claimant in the trace: stall every OTHER detector
+        late = "1" if first != "1" else "0"
+    return [
+        FaultRule("proc", "delay", point="election.promote",
+                  owner=f"standby-{first}", count=1,
+                  delay_ms=PROMOTE_HOLD_MS,
+                  note=f"hold s{first}'s promotion window open "
+                       f"(trace: claim_win(s{first}) first)"),
+        FaultRule("proc", "delay", point="election.detect",
+                  owner=f"standby-{late}", count=1,
+                  delay_ms=DETECT_STALL_MS,
+                  note=f"make s{late} the late detector (trace: its "
+                       "claim lands after the first winner)"),
+    ]
+
+
+def _journal_rules(trace: tuple) -> List[FaultRule]:
+    crashes = [a for a in trace if a.startswith("crash(")]
+    return [
+        FaultRule("proc", "kill", point="journal.before_marker",
+                  count=1, after=n,
+                  note=f"trace {crash}: crash in the batch-durable/"
+                       "marker-absent window")
+        for n, crash in enumerate(crashes or ("crash(#1)",))
+    ]
+
+
+def _genline_rules(trace: tuple) -> List[FaultRule]:
+    stale = next((a for a in trace
+                  if a.startswith(("deliver_report(", "heartbeat("))),
+                 "deliver_report(w0,gen=0)")
+    return [FaultRule("proc", "delay", point="controller.heartbeat",
+                      count=1, delay_ms=DETECT_STALL_MS,
+                      note=f"trace {stale}: a stale heartbeat is a "
+                           "delayed heartbeat")]
+
+
+def _publish_rules(trace: tuple) -> List[FaultRule]:
+    return [FaultRule("proc", "kill", point="controller.heartbeat",
+                      count=1,
+                      note="trace crash(c0): incumbent dies "
+                           "mid-republish, stale prepare/commit RPCs "
+                           "left in flight")]
+
+
+def export_faultplan(result: CheckResult) -> FaultPlan:
+    """The FaultPlan whose schedule replays ``result``'s
+    counterexample against the real implementation.  Raises
+    ``ValueError`` for a clean result — there is nothing to export."""
+    v = result.violation
+    if v is None:
+        raise ValueError(
+            f"{result.protocol}: clean check has no counterexample "
+            "to export")
+    rules = {
+        "election": _election_rules,
+        "journal": _journal_rules,
+        "genline": _genline_rules,
+        "publish": _publish_rules,
+    }.get(result.protocol)
+    if rules is None:
+        raise ValueError(
+            f"no FaultPlan mapping for protocol {result.protocol!r}")
+    return FaultPlan(
+        rules(v.trace), seed=trace_seed(v),
+        name=f"luxproto-{result.protocol}-counterexample")
+
+
+def export_json(result: CheckResult) -> str:
+    return export_faultplan(result).to_json()

@@ -1,0 +1,211 @@
+"""Protocol model 3: the generation line with read-your-writes and
+stale tags (``serve/live/journal.py`` generations ↔
+``serve/live/controller.py`` delta-gen tracking and stale-bound
+routing).
+
+Conformance bridge: delta delivery raises the REAL
+:class:`~lux_tpu.serve.live.errors.GenerationGap` (its ``have``/
+``want`` fields drive the model's resync transition and appear in the
+trace labels), so the catch-up contract being explored is the class
+production code raises and handlers catch.
+
+What the model explores (2 workers, 2 writes, 1 worker kill+rejoin):
+
+* the journal generation ``G`` advances per acked write;
+* each live worker applies deltas IN ORDER; an out-of-order delivery
+  raises ``GenerationGap(have, want)`` and the worker resyncs from the
+  journal (the real catch-up path);
+* workers report their applied generation via acks and heartbeats —
+  messages that can be DELAYED and arrive after newer reports: the
+  controller's per-worker ``view`` must fold them in with a locked
+  monotonic ``max`` (``LiveFleetController._raise_delta_gen``);
+* reads carry a read-your-writes bound (the client's last acked gen):
+  the controller serves FRESH from a worker whose view ≥ bound, else
+  serves with a ``stale`` tag.
+
+Safety invariants:
+
+1. **view never leads reality** — ``view[w] <= applied[w]`` for every
+   live worker, so a FRESH read is actually fresh;
+2. **fresh means applied** — a read served fresh at bound ``b`` hits a
+   worker with ``applied >= b``;
+3. **the line never regresses** — a worker's view is nondecreasing
+   while it is alive (the monotonic-max contract; regression breaks
+   the read-your-writes session guarantee).
+
+Broken twins:
+
+* ``mode="stale_heartbeat"`` — ``view = report`` raw assignment: a
+  delayed heartbeat drags the view backwards (invariant 3);
+* ``mode="optimistic_send"`` — the view is bumped at delta SEND time
+  instead of at the ack: a fresh read lands on a worker that has not
+  applied the write yet (invariants 1/2).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from lux_tpu.analysis.proto.mc import Action, Model, State
+from lux_tpu.serve.live.errors import GenerationGap
+
+#: controller view folding modes; "monotonic_max" is the real
+#: _raise_delta_gen contract, the others are the broken twins
+MODES = ("monotonic_max", "stale_heartbeat", "optimistic_send")
+
+
+class GenLineModel(Model):
+    """State: ``(G, acked, workers, bad)`` with per-worker
+    ``(alive, applied, view, deltas, reports)``:
+
+    * ``G`` — journal generation (writes so far);
+    * ``acked`` — highest write gen acked to the client (its
+      read-your-writes bound);
+    * ``deltas`` — in-flight delta gens (deliverable in any order);
+    * ``reports`` — in-flight ack/heartbeat payloads (applied gen at
+      send time — the delayed-message hazard);
+    * ``bad`` — first observed safety violation, if any (reads are
+      side-effect-free, so their violations are recorded in-state).
+    """
+
+    name = "genline"
+
+    def __init__(self, n_workers: int = 2, max_writes: int = 2,
+                 max_kills: int = 1, mode: str = "monotonic_max"):
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}: {mode!r}")
+        self.n = int(n_workers)
+        self.max_writes = int(max_writes)
+        self.max_kills = int(max_kills)
+        self.mode = mode
+
+    def config(self) -> Dict[str, object]:
+        return {"workers": self.n, "max_writes": self.max_writes,
+                "max_kills": self.max_kills, "mode": self.mode}
+
+    def initial(self) -> Iterable[State]:
+        w0 = (True, 0, 0, frozenset(), frozenset())
+        yield (0, 0, (w0,) * self.n, 0, None)
+
+    @staticmethod
+    def _w(workers: tuple, i: int, **kw) -> tuple:
+        alive, applied, view, deltas, reports = workers[i]
+        cur = {"alive": alive, "applied": applied, "view": view,
+               "deltas": deltas, "reports": reports}
+        cur.update(kw)
+        nw = (cur["alive"], cur["applied"], cur["view"], cur["deltas"],
+              cur["reports"])
+        return workers[:i] + (nw,) + workers[i + 1:]
+
+    def _fold(self, view: int, report: int) -> int:
+        if self.mode == "stale_heartbeat":
+            return report  # the broken raw assignment
+        return max(view, report)  # the real locked monotonic max
+
+    def actions(self, state: State) -> Iterable[Action]:
+        G, acked, workers, kills, bad = state
+        out: List[Action] = []
+        if bad is not None:
+            return out  # freeze on first violation: shortest trace
+        if G < self.max_writes:
+            # journal commit: gen G+1; delta fans out to live workers,
+            # the write is acked to the client at commit
+            g = G + 1
+            ws = workers
+            for i, w in enumerate(workers):
+                if not w[0]:
+                    continue
+                view = g if self.mode == "optimistic_send" else w[2]
+                ws = self._w(ws, i, deltas=w[3] | {g}, view=view)
+            out.append((f"write(gen={g})", (g, g, ws, kills, bad)))
+        for i, (alive, applied, view, deltas, reports) in \
+                enumerate(workers):
+            if alive:
+                for g in sorted(deltas):
+                    if g == applied + 1:
+                        ws = self._w(workers, i, applied=g,
+                                     deltas=deltas - {g},
+                                     reports=reports | {g})
+                        out.append((f"apply(w{i},gen={g})",
+                                    (G, acked, ws, kills, bad)))
+                    else:
+                        # out-of-order: the worker raises the real
+                        # GenerationGap and resyncs from the journal
+                        gap = GenerationGap(applied, g)
+                        ws = self._w(workers, i, applied=G,
+                                     deltas=frozenset(),
+                                     reports=reports | {G})
+                        out.append((
+                            f"gap_resync(w{i},have={gap.have},"
+                            f"want={gap.want})",
+                            (G, acked, ws, kills, bad)))
+                # heartbeat: report the CURRENT applied gen (acks above
+                # already queued per-delta reports)
+                if applied not in reports:
+                    ws = self._w(workers, i, reports=reports | {applied})
+                    out.append((f"heartbeat(w{i},gen={applied})",
+                                (G, acked, ws, kills, bad)))
+                if kills < self.max_kills:
+                    ws = self._w(workers, i, alive=False,
+                                 deltas=frozenset())
+                    out.append((f"kill(w{i})",
+                                (G, acked, ws, kills + 1, bad)))
+                # reads: serve at the client's read-your-writes bound
+                if view >= acked:
+                    nbad = bad
+                    if applied < acked:
+                        nbad = (f"fresh read at bound {acked} served "
+                                f"by w{i} with applied={applied} — an "
+                                "unapplied write was read as fresh")
+                    out.append((f"read_fresh(w{i},bound={acked})",
+                                (G, acked, workers, kills, nbad)))
+                elif acked > 0:
+                    out.append((
+                        f"read_stale(w{i},bound={acked},view={view})",
+                        (G, acked, workers, kills, bad)))
+            else:
+                # rejoin: replica resyncs from the journal (applied=G);
+                # the controller seeds the view from the resync gen
+                ws = self._w(workers, i, alive=True, applied=G, view=G)
+                out.append((f"rejoin(w{i},gen={G})",
+                            (G, acked, ws, kills, bad)))
+            # delayed report delivery (possible even after a kill: the
+            # message was already in flight)
+            for r in sorted(reports):
+                nview = self._fold(view, r)
+                nbad = bad
+                if alive and nview < view:
+                    nbad = (f"generation line regressed on w{i}: view "
+                            f"{view} -> {nview} after a stale "
+                            "heartbeat — read-your-writes session "
+                            "guarantee broken")
+                ws = self._w(workers, i, view=nview,
+                             reports=reports - {r})
+                out.append((f"deliver_report(w{i},gen={r})",
+                            (G, acked, ws, kills, nbad)))
+        return out
+
+    def invariant(self, state: State) -> Optional[str]:
+        G, acked, workers, _kills, bad = state
+        if bad is not None:
+            return bad
+        for i, (alive, applied, view, _d, _r) in enumerate(workers):
+            if alive and view > applied:
+                return (f"controller view of w{i} ({view}) leads its "
+                        f"applied gen ({applied}) — a fresh read "
+                        "routed there would serve an unapplied write")
+            if applied > G:
+                return (f"w{i} applied gen {applied} beyond the "
+                        f"journal generation {G}")
+        if acked > G:
+            return f"acked gen {acked} beyond journal generation {G}"
+        return None
+
+    def accepting(self, state: State) -> bool:
+        # reads/heartbeats keep at least one action enabled while any
+        # worker lives, so action-less means every worker is dead with
+        # kills exhausted and reports drained: an acceptable terminal
+        # (no liveness promise with zero live replicas)
+        _G, _acked, workers, kills, _bad = state
+        return (all(not w[0] for w in workers)
+                and kills >= self.max_kills
+                and all(not w[4] for w in workers))

@@ -1,0 +1,186 @@
+"""Protocol model 4: journal crash-atomicity
+(``mutate/deltalog.py`` — durable batch npz, then a separate fsync'd
+``.ok`` marker; replay keeps the longest marker-committed prefix).
+
+Conformance bridge: the model imports the real
+``JOURNAL_FORMAT``/``OP_INSERT``/``OP_DELETE`` constants (reported in
+``config()`` so a format bump is visible to the checker) and its
+replay transition mirrors ``DeltaLog._journal_replay`` case for case:
+stop at the first missing marker (removing the orphan batch so the seq
+is reusable), tolerate a marker-without-batch torn directory, and —
+the property under test — NEVER be asked to load a torn batch that
+hides behind a marker, because the batch is fsync'd before the marker
+(``fault.ppoint("journal.before_marker")`` sits exactly in that
+window).
+
+The writer appends batches seq 0..N-1; a crash can land anywhere —
+including mid-append (a torn npz: bytes on disk, fsync never
+finished).  After each crash the model replays and the writer resumes.
+
+Safety invariants:
+
+1. **acked durability** — every batch acked to the client survives
+   every subsequent crash+replay;
+2. **no torn batch behind a marker** — replay never admits a marker
+   whose batch npz is torn (the real replay would ``np.load`` corrupt
+   bytes); batch-before-marker ordering is exactly what forbids it.
+
+The broken twin (``marker_first=True``) writes the marker BEFORE the
+batch npz — the checker finds the crash point where replay admits a
+torn (or absent-then-torn) batch.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from lux_tpu.analysis.proto.mc import Action, Model, State
+from lux_tpu.mutate.deltalog import JOURNAL_FORMAT, OP_DELETE, OP_INSERT
+
+# per-seq batch npz durability
+B_NONE = 0   # nothing on disk
+B_TORN = 2   # bytes on disk, fsync never completed (crash mid-append)
+B_DURABLE = 1
+
+RUNNING = "running"
+CRASHED = "crashed"
+
+
+class JournalModel(Model):
+    """State: ``(phase, cells, acked, crashes, bad)`` where ``cells``
+    holds one ``(batch, marker)`` per seq and ``acked`` is the set of
+    seqs acked to the client.  The writer is deterministic (the real
+    DeltaLog appends sequentially); the nondeterminism is WHERE the
+    crash lands."""
+
+    name = "journal"
+
+    def __init__(self, n_batches: int = 2, max_crashes: int = 2,
+                 marker_first: bool = False):
+        self.n = int(n_batches)
+        self.max_crashes = int(max_crashes)
+        self.marker_first = bool(marker_first)
+
+    def config(self) -> Dict[str, object]:
+        return {"batches": self.n, "max_crashes": self.max_crashes,
+                "marker_first": self.marker_first,
+                "journal_format": JOURNAL_FORMAT,
+                "ops": {"insert": OP_INSERT, "delete": OP_DELETE}}
+
+    def initial(self) -> Iterable[State]:
+        yield (RUNNING, ((B_NONE, False),) * self.n, frozenset(), 0,
+               None)
+
+    @staticmethod
+    def _cell(cells: tuple, i: int, batch=None, marker=None) -> tuple:
+        b, m = cells[i]
+        nb = b if batch is None else batch
+        nm = m if marker is None else marker
+        return cells[:i] + ((nb, nm),) + cells[i + 1:]
+
+    def _writer_step(self, cells: tuple, acked: frozenset,
+                     seq: int) -> Optional[Tuple[str, tuple, frozenset]]:
+        """The single enabled writer action for ``seq`` (the real
+        append path is sequential): returns (label, cells', acked')."""
+        batch, marker = cells[seq]
+        if self.marker_first:
+            # the broken twin commits before the bytes are durable
+            if not marker:
+                return (f"mark(seq={seq})",
+                        self._cell(cells, seq, marker=True), acked)
+            if batch == B_NONE:
+                return (f"append_start(seq={seq})",
+                        self._cell(cells, seq, batch=B_TORN), acked)
+            if batch == B_TORN:
+                return (f"append_fsync(seq={seq})",
+                        self._cell(cells, seq, batch=B_DURABLE), acked)
+        else:
+            if batch == B_NONE:
+                return (f"append_start(seq={seq})",
+                        self._cell(cells, seq, batch=B_TORN), acked)
+            if batch == B_TORN:
+                return (f"append_fsync(seq={seq})",
+                        self._cell(cells, seq, batch=B_DURABLE), acked)
+            if not marker:
+                # fault.ppoint("journal.before_marker") fires here in
+                # the real writer — the canonical crash window
+                return (f"mark(seq={seq})",
+                        self._cell(cells, seq, marker=True), acked)
+        if seq not in acked:
+            return (f"ack(seq={seq})", cells, acked | {seq})
+        return None
+
+    def _replay(self, cells: tuple) -> Tuple[tuple, int, Optional[str]]:
+        """Mirror of ``DeltaLog._journal_replay``: returns the
+        post-replay cells, the recovered-prefix length, and a
+        violation message if replay would load a torn batch."""
+        out = list(cells)
+        recovered = 0
+        for seq in range(self.n):
+            batch, marker = out[seq]
+            if not marker:
+                if batch != B_NONE:
+                    out[seq] = (B_NONE, False)  # orphan npz removed
+                break
+            if batch == B_NONE:
+                # marker without batch: torn directory state —
+                # treated as uncommitted, marker removed
+                out[seq] = (B_NONE, False)
+                break
+            if batch == B_TORN:
+                return (tuple(out), recovered,
+                        f"replay admitted seq {seq}: marker present "
+                        "but the batch npz is torn — np.load reads "
+                        "corrupt bytes (batch-before-marker ordering "
+                        "violated)")
+            recovered += 1
+        return (tuple(out), recovered, None)
+
+    def actions(self, state: State) -> Iterable[Action]:
+        phase, cells, acked, crashes, bad = state
+        out: List[Action] = []
+        if bad is not None:
+            return out  # freeze on first violation: shortest trace
+        if phase == CRASHED:
+            ncells, recovered, nbad = self._replay(cells)
+            if nbad is None:
+                lost = sorted(s for s in acked if s >= recovered
+                              or ncells[s] != (B_DURABLE, True))
+                if lost:
+                    nbad = (f"acked batch(es) {lost} lost in replay "
+                            f"(recovered prefix: {recovered})")
+            out.append((f"replay(recovered={recovered})",
+                        (RUNNING, ncells, acked, crashes, nbad)))
+            return out
+        step = None
+        for seq in range(self.n):
+            step = self._writer_step(cells, acked, seq)
+            if step is not None:
+                break
+        if step is not None:
+            label, ncells, nacked = step
+            out.append((label, (RUNNING, ncells, nacked, crashes, bad)))
+        if crashes < self.max_crashes:
+            out.append((f"crash(#{crashes + 1})",
+                        (CRASHED, cells, acked, crashes + 1, bad)))
+        return out
+
+    def invariant(self, state: State) -> Optional[str]:
+        _phase, cells, acked, _crashes, bad = state
+        if bad is not None:
+            return bad
+        if not self.marker_first:
+            # writer-order sanity on the clean model: a marker must
+            # never exist over a non-durable batch at ANY instant —
+            # this is the window a crash exploits
+            for seq, (batch, marker) in enumerate(cells):
+                if marker and batch != B_DURABLE:
+                    return (f"seq {seq} has a marker over a "
+                            f"non-durable batch (batch={batch})")
+        return None
+
+    def accepting(self, state: State) -> bool:
+        # action-less ⇔ all batches acked + crash budget exhausted
+        phase, cells, acked, crashes, _bad = state
+        return (phase == RUNNING and len(acked) == self.n
+                and crashes >= self.max_crashes
+                and all(c == (B_DURABLE, True) for c in cells))

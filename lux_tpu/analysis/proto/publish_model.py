@@ -1,0 +1,232 @@
+"""Protocol model 2: two-phase publish tokens across controller
+failover (``serve/fleet/controller.py`` republish ↔
+``serve/fleet/worker.py`` prepare/commit/discard).
+
+Conformance bridge: tokens are minted by the REAL
+:func:`~lux_tpu.serve.fleet.pubproto.publish_token` (so the
+incarnation-fencing property being checked is the property of the real
+token format), and the refusal labels on refused transitions are the
+real ``pubproto`` strings the worker sends on the wire.
+
+The model covers the full failure surface of one republish + one
+failover:
+
+* controller c0 (incarnation A) runs prepare → commit over 2 workers
+  and may CRASH at any step, leaving prepare/commit RPCs in flight;
+* successor c1 (incarnation B) takes over: discard fan-out re-arms
+  worker token state, then its own republish — while c0's stale
+  messages are still being delivered;
+* workers follow the real rules: latest prepare wins (the in-flight
+  build re-checks the token before staging), commit installs only on
+  an EXACT token match, discard/commit clears staged.
+
+Safety invariants:
+
+1. **no mismatched install** — a worker never serves a cache under a
+   commit token different from the token it was staged with;
+2. **barrier means uniform** — when the active controller has observed
+   its barrier complete, every worker serves that controller's token.
+
+The broken twin (``checked=False``) disables the worker-side token
+checks (stale-prepare re-check and commit exact-match) — the checker
+then finds the shortest failover schedule in which a dead controller's
+delayed commit installs a cache staged for the successor's republish.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+from lux_tpu.analysis.proto.mc import Action, Model, State
+from lux_tpu.serve.fleet.pubproto import (
+    ERR_NOTHING_STAGED,
+    ERR_PREPARE_SUPERSEDED,
+    publish_token,
+    token_mismatch,
+)
+
+# controller incarnations (c1 is the post-failover successor)
+INCARNATIONS = ("A", "B")
+
+# controller phases
+C_START = "start"       # elected, republish not yet fanned out
+C_PREP = "preparing"    # prepares sent, awaiting staged acks
+C_COMMIT = "committing"  # commits sent, awaiting install acks
+C_DONE = "done"         # barrier observed complete
+C_ABORTED = "aborted"   # a refusal/timeout aborted the republish
+C_DEAD = "dead"         # crashed (c0 only)
+C_OFF = "off"           # not yet elected (c1 before takeover)
+
+#: serving marker for the pre-republish cache
+OLD = "old"
+
+
+class PublishModel(Model):
+    """State:
+    ``(c0_phase, c1_phase, workers)`` with per-worker
+    ``(wtok, builds, staged, serving, pend_prep, pend_commit)``:
+
+    * ``wtok`` — the worker's ``_publish_token`` (latest prepare wins);
+    * ``builds`` — tokens with an in-flight staged-cache build
+      (frozenset: each prepare RPC builds on its own handler thread);
+    * ``staged`` — token of the staged cache, if any;
+    * ``serving`` — ``OLD`` or ``(staged_token, commit_token)`` for the
+      install that produced the serving cache (the pair is what
+      invariant 1 inspects);
+    * ``pend_prep`` / ``pend_commit`` — in-flight RPC tokens, delivered
+      in any order, surviving their sender's crash.
+    """
+
+    name = "publish"
+
+    def __init__(self, n_workers: int = 2, checked: bool = True):
+        self.n = int(n_workers)
+        self.checked = bool(checked)
+        # rid=1: one republish per incarnation in the small scope
+        self.tokens = tuple(
+            publish_token(inc, 1) for inc in INCARNATIONS)
+
+    def config(self) -> Dict[str, object]:
+        return {"workers": self.n, "checked": self.checked,
+                "incarnations": list(INCARNATIONS),
+                "tokens": list(self.tokens)}
+
+    def initial(self) -> Iterable[State]:
+        w0 = (None, frozenset(), None, OLD, frozenset(), frozenset())
+        yield (C_START, C_OFF, (w0,) * self.n)
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _w(workers: tuple, i: int, **kw) -> tuple:
+        wtok, builds, staged, serving, pp, pc = workers[i]
+        cur = {"wtok": wtok, "builds": builds, "staged": staged,
+               "serving": serving, "pp": pp, "pc": pc}
+        cur.update(kw)
+        nw = (cur["wtok"], cur["builds"], cur["staged"], cur["serving"],
+              cur["pp"], cur["pc"])
+        return workers[:i] + (nw,) + workers[i + 1:]
+
+    def _controller_actions(self, cidx: int, state: State) -> List[Action]:
+        c0, c1, workers = state
+        phase = (c0, c1)[cidx]
+        tok = self.tokens[cidx]
+        out: List[Action] = []
+
+        def with_phase(p: str, ws: tuple = None) -> State:
+            nc0, nc1 = (p, c1) if cidx == 0 else (c0, p)
+            return (nc0, nc1, workers if ws is None else ws)
+
+        if phase == C_START:
+            ws = workers
+            for i in range(self.n):
+                ws = self._w(ws, i, pp=ws[i][4] | {tok})
+            out.append((f"send_prepares(c{cidx})", with_phase(C_PREP, ws)))
+        if phase == C_PREP and all(w[2] == tok for w in workers):
+            ws = workers
+            for i in range(self.n):
+                ws = self._w(ws, i, pc=ws[i][5] | {tok})
+            out.append((f"send_commits(c{cidx})",
+                        with_phase(C_COMMIT, ws)))
+        if phase == C_COMMIT and all(
+                w[3] != OLD and w[3][1] == tok for w in workers):
+            out.append((f"barrier_done(c{cidx})", with_phase(C_DONE)))
+        if phase in (C_PREP, C_COMMIT):
+            # refusal ack or timeout → abort + synchronous discard
+            # fan-out (stale RPCs already in flight stay in flight)
+            ws = workers
+            for i in range(self.n):
+                ws = self._w(ws, i, wtok=None, staged=None)
+            out.append((f"abort_discard(c{cidx})",
+                        with_phase(C_ABORTED, ws)))
+        return out
+
+    # -- transition system ----------------------------------------------
+
+    def actions(self, state: State) -> Iterable[Action]:
+        c0, c1, workers = state
+        out: List[Action] = []
+        out += self._controller_actions(0, state)
+        if c1 != C_OFF:
+            out += self._controller_actions(1, state)
+        # crash/failover interleave with everything above
+        if c0 != C_DEAD:
+            out.append(("crash(c0)", (C_DEAD, c1, workers)))
+        if c0 == C_DEAD and c1 == C_OFF:
+            # takeover re-arms every worker via the real discard
+            # semantics (clear staged + token; in-flight builds strand)
+            ws = workers
+            for i in range(self.n):
+                ws = self._w(ws, i, wtok=None, staged=None)
+            out.append(("takeover_discard(c1)", (c0, C_START, ws)))
+        # worker-side message deliveries and build completions
+        for i, (wtok, builds, staged, serving, pp, pc) in enumerate(workers):
+            for t in sorted(pp):
+                # prepare arrives: record token FIRST (latest prepare
+                # wins), then start the staged-cache build
+                ws = self._w(workers, i, wtok=t, builds=builds | {t},
+                             pp=pp - {t})
+                out.append((f"prepare_arrive(w{i},{t})", (c0, c1, ws)))
+            for t in sorted(builds):
+                if wtok == t or not self.checked:
+                    # build done; the pre-stage token re-check passes
+                    # (or is DISABLED in the broken twin)
+                    ws = self._w(workers, i, builds=builds - {t},
+                                 staged=t)
+                    out.append((f"stage(w{i},{t})", (c0, c1, ws)))
+                else:
+                    # real refusal: ERR_PREPARE_SUPERSEDED
+                    ws = self._w(workers, i, builds=builds - {t})
+                    out.append((
+                        f"stage_refused(w{i},{t}) "
+                        f"[{ERR_PREPARE_SUPERSEDED}]", (c0, c1, ws)))
+            for t in sorted(pc):
+                if staged is None:
+                    # real refusal: ERR_NOTHING_STAGED (post-discard /
+                    # duplicate commit) — never installs
+                    ws = self._w(workers, i, pc=pc - {t})
+                    out.append((
+                        f"commit_refused(w{i},{t}) "
+                        f"[{ERR_NOTHING_STAGED}]", (c0, c1, ws)))
+                elif staged == t or not self.checked:
+                    # exact-match install (broken twin installs ANY
+                    # staged cache — the mismatch the checker hunts)
+                    ws = self._w(workers, i, staged=None,
+                                 serving=(staged, t), pc=pc - {t})
+                    out.append((f"commit(w{i},{t})", (c0, c1, ws)))
+                else:
+                    ws = self._w(workers, i, pc=pc - {t})
+                    out.append((
+                        f"commit_refused(w{i},{t}) "
+                        f"[{token_mismatch(staged, t)}]", (c0, c1, ws)))
+        return out
+
+    def invariant(self, state: State) -> Optional[str]:
+        c0, c1, workers = state
+        for i, (_wtok, _builds, _staged, serving, _pp, _pc) in \
+                enumerate(workers):
+            if serving != OLD and serving[0] != serving[1]:
+                return (f"worker w{i} serves a cache staged under "
+                        f"{serving[0]!r} installed by commit "
+                        f"{serving[1]!r} — " +
+                        token_mismatch(serving[0], serving[1]))
+        # active controller: the successor once takeover happened
+        active = 1 if c1 != C_OFF else 0
+        phase = (c0, c1)[active]
+        if phase == C_DONE:
+            tok = self.tokens[active]
+            for i, w in enumerate(workers):
+                if w[3] == OLD or w[3][1] != tok:
+                    return (f"controller c{active} observed its publish "
+                            f"barrier complete but worker w{i} serves "
+                            f"{w[3]!r}, not token {tok!r}")
+        return None
+
+    def accepting(self, state: State) -> bool:
+        # action-less ⇒ c0 dead (crash is enabled otherwise), c1
+        # terminal, all messages/builds drained: a finished incident —
+        # acceptable whether c1's republish committed or aborted
+        # (safety, not liveness, is the model's contract)
+        c0, c1, workers = state
+        drained = all(not w[1] and not w[4] and not w[5]
+                      for w in workers)
+        return (c0 == C_DEAD and c1 in (C_DONE, C_ABORTED) and drained)

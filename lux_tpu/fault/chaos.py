@@ -570,3 +570,107 @@ def autopilot_soak(seed: int, steps: int = 8, scale: int = 7,
         },
         "events": events,
     }
+
+
+def election_drill(plan: "fault.FaultPlan", fenced: bool = True,
+                   timeout_s: float = 30.0) -> dict:
+    """Replay a luxproto election counterexample against the REAL
+    election code (ISSUE 18's model→implementation round-trip).
+
+    ``plan`` is an exported counterexample schedule
+    (``lux_tpu.analysis.proto.export.export_faultplan``): delay rules
+    at the ``election.detect`` / ``election.promote`` process points
+    that hold the first winner's promotion window open while a second
+    standby detects late — the detached-promotion + TOCTOU schedule.
+    The drill runs real :class:`Standby` threads over a dead incumbent
+    and imposes the schedule:
+
+    * ``fenced=True`` — the real :class:`StandbyGroup`: the late
+      claimant is fenced out and must adopt; ``elections == 1``;
+    * ``fenced=False`` — the model's broken twin
+      (``UnfencedStandbyGroup``): the SAME schedule completes a second
+      promotion; ``elections == 2`` — the model's abstract split-brain
+      reproduced as a real one.
+
+    Returns ``{"elections", "outcomes", "winner", "fired"}``; the
+    caller asserts on ``elections``.  The incumbent and the promoted
+    controllers are inert stand-ins — the protocol under drill is the
+    election, not the promotion payload (chaos_soak covers that
+    integration end-to-end).
+    """
+    from lux_tpu.serve.autopilot.election import Standby, StandbyGroup
+
+    class _DeadIncumbent:
+        incarnation = "inc-0"
+        hb_interval_s = 0.01
+        hb_timeout_s = 0.03
+
+        def ping(self):
+            raise ConnectionError("incumbent is dead")
+
+    class _PromotedController:
+        def __init__(self, sid: int):
+            self.incarnation = f"inc-1-s{sid}"
+
+    if fenced:
+        group = StandbyGroup()
+    else:
+        from lux_tpu.analysis.proto.election_model import (
+            UnfencedStandbyGroup,
+        )
+
+        group = UnfencedStandbyGroup()
+    incumbent = _DeadIncumbent()
+    standbys: List[Standby] = []
+    with fault.installed(plan):
+        for sid in range(2):
+            def _promote(tc=None, sid=sid):
+                return (_PromotedController(sid),
+                        {"joined": [], "refused": []})
+
+            standbys.append(Standby(
+                group, sid, incumbent, _promote,
+                hb_interval_s=incumbent.hb_interval_s,
+                death_after_s=incumbent.hb_timeout_s,
+                seed=sid).start())
+        try:
+            # wait for the first claim, then stop the claimant MID-
+            # promotion (its promote is held open by the plan's delay
+            # rule): stop() deregisters it, shifting min(live ids) to
+            # the late detector while the promotion is still running —
+            # the fence is now the ONLY thing standing between the
+            # late claim and a second election
+            deadline = time.monotonic() + timeout_s
+            first = None
+            while time.monotonic() < deadline:
+                first = group.claimed_by(incumbent.incarnation)
+                if first is not None:
+                    break
+                time.sleep(0.002)
+            if first is None:
+                raise AssertionError(
+                    "election drill: no standby claimed within "
+                    f"{timeout_s}s (plan: {plan.describe()})")
+            group.deregister(first)  # stop() would join the held
+            # promotion; the drill needs the deregistration NOW
+            standbys[first]._stop.set()
+            # let both the detached promotion and the late detector
+            # run to completion
+            settle = time.monotonic() + timeout_s
+            while time.monotonic() < settle:
+                done = all(s.outcome is not None or not
+                           (s._thread is not None
+                            and s._thread.is_alive())
+                           for s in standbys)
+                if done and group.promoted is not None:
+                    break
+                time.sleep(0.01)
+        finally:
+            for s in standbys:
+                s.stop()
+    return {
+        "elections": group.elections,
+        "outcomes": {s.standby_id: s.outcome for s in standbys},
+        "winner": group.claimed_by(incumbent.incarnation),
+        "fired": plan.total_fired(),
+    }

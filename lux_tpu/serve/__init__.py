@@ -20,16 +20,41 @@ serving turns the same frontier machinery into a request/response path:
     republish, and the ``sssp_fleet_qps_*`` saturation bench.
 
 The unit of work here is a REQUEST, not a graph.
+
+Exports resolve LAZILY (PEP 562): ``serve.batched``/``serve.warm``
+import jax at module scope, but the fleet's jax-free leaves (``fleet.
+wire``, ``fleet.pubproto``, ``live.journal``, ``autopilot.election``)
+must stay importable under the bare-package stub (tools/_jaxfree.py) so
+the protocol tier (``lux_tpu.analysis.proto``, tools/luxproto.py) can
+model-check the REAL constants/classes on a cold host in milliseconds.
+``import lux_tpu.serve.fleet.wire`` therefore never touches jax;
+``from lux_tpu.serve import BatchedEngine`` still works and pays the
+jax import only when asked.
 """
-from lux_tpu.serve.batched import (  # noqa: F401
-    BatchedEngine,
-    BatchedResult,
-    MultiSourcePPR,
-    MultiSourceSSSP,
-)
-from lux_tpu.serve.scheduler import (  # noqa: F401
-    MicroBatchScheduler,
-    RejectedError,
-    ServeTimeoutError,
-)
-from lux_tpu.serve.warm import EngineKey, WarmEngineCache  # noqa: F401
+_EXPORTS = {
+    "BatchedEngine": "lux_tpu.serve.batched",
+    "BatchedResult": "lux_tpu.serve.batched",
+    "MultiSourcePPR": "lux_tpu.serve.batched",
+    "MultiSourceSSSP": "lux_tpu.serve.batched",
+    "MicroBatchScheduler": "lux_tpu.serve.scheduler",
+    "RejectedError": "lux_tpu.serve.scheduler",
+    "ServeTimeoutError": "lux_tpu.serve.scheduler",
+    "EngineKey": "lux_tpu.serve.warm",
+    "WarmEngineCache": "lux_tpu.serve.warm",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

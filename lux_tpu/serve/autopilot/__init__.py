@@ -17,21 +17,40 @@ The closed control loop over the serving fleet's own telemetry:
 Every autonomous action (scale, elect, promote, policy switch,
 subscription push) emits a causally-linked dtrace span on a keyed
 incident trace, so ``luxstitch`` renders one timeline per incident.
-"""
-from lux_tpu.serve.autopilot.autoscaler import (Autoscaler,
-                                                AutoscalerConfig)
-from lux_tpu.serve.autopilot.election import (Standby, StandbyGroup,
-                                              live_promoter)
-from lux_tpu.serve.autopilot.policy import (MODES, AdmissionPolicy,
-                                            PolicyError, PolicyRule,
-                                            default_fleet_policy)
-from lux_tpu.serve.autopilot.subscribe import (Subscription,
-                                               SubscriptionClosed,
-                                               SubscriptionHub)
 
-__all__ = [
-    "AdmissionPolicy", "Autoscaler", "AutoscalerConfig", "MODES",
-    "PolicyError", "PolicyRule", "Standby", "StandbyGroup",
-    "Subscription", "SubscriptionClosed", "SubscriptionHub",
-    "default_fleet_policy", "live_promoter",
-]
+Exports resolve LAZILY (PEP 562, same contract as ``lux_tpu.serve``):
+``election``/``policy`` are jax-free and the protocol tier
+(``lux_tpu.analysis.proto.election_model``) model-checks the REAL
+``StandbyGroup`` under tools/_jaxfree.py's bare-package stub.
+"""
+_EXPORTS = {
+    "Autoscaler": "lux_tpu.serve.autopilot.autoscaler",
+    "AutoscalerConfig": "lux_tpu.serve.autopilot.autoscaler",
+    "Standby": "lux_tpu.serve.autopilot.election",
+    "StandbyGroup": "lux_tpu.serve.autopilot.election",
+    "live_promoter": "lux_tpu.serve.autopilot.election",
+    "MODES": "lux_tpu.serve.autopilot.policy",
+    "AdmissionPolicy": "lux_tpu.serve.autopilot.policy",
+    "PolicyError": "lux_tpu.serve.autopilot.policy",
+    "PolicyRule": "lux_tpu.serve.autopilot.policy",
+    "default_fleet_policy": "lux_tpu.serve.autopilot.policy",
+    "Subscription": "lux_tpu.serve.autopilot.subscribe",
+    "SubscriptionClosed": "lux_tpu.serve.autopilot.subscribe",
+    "SubscriptionHub": "lux_tpu.serve.autopilot.subscribe",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

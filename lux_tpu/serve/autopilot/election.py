@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from lux_tpu import fault
 from lux_tpu.obs import dtrace
 
 
@@ -202,6 +203,12 @@ class Standby:
             if now - last_ok < self.death_after_s:
                 continue
             # -- death declared ------------------------------------------
+            # named process point: a delay rule here makes THIS standby
+            # a late detector (the TOCTOU schedule luxproto's election
+            # model explores); kill dies silently pre-claim
+            fault.ppoint("election.detect",
+                         owner=f"standby-{self.standby_id}",
+                         incumbent=self.incumbent_incarnation)
             self.detected_at = now
             etc = dtrace.incident(
                 f"election:{self.incumbent_incarnation}")
@@ -225,6 +232,13 @@ class Standby:
                 # re-check — if the winner released, claim again
                 self.group.wait_promoted(self.death_after_s)
                 continue
+            # claim won, promotion not yet run: a delay rule holds the
+            # promotion window open (the detached-promotion schedule);
+            # kill dies HOLDING the claim — the fence then wedges the
+            # election shut rather than admit a rival (by design)
+            fault.ppoint("election.promote",
+                         owner=f"standby-{self.standby_id}",
+                         incumbent=self.incumbent_incarnation)
             t0 = self.clock()
             try:
                 with dtrace.tspan(

@@ -23,18 +23,37 @@ only half that runs engines — is imported explicitly as
 ``python -m lux_tpu.serve.fleet.worker``.  ``hashring`` itself is
 stdlib-only and loadable standalone (the cross-process determinism test
 does exactly that).
+
+Exports resolve LAZILY (PEP 562, same contract as ``lux_tpu.serve``):
+the jax-free leaves (``wire``, ``pubproto``, ``hashring``) stay
+importable under tools/_jaxfree.py's bare-package stub so the protocol
+tier can import the real wire/publish constants without jax.
 """
-from lux_tpu.serve.fleet.controller import (  # noqa: F401
-    FleetController,
-    FleetError,
-    FleetFuture,
-    FleetRejectedError,
-    FleetTimeoutError,
-    NoWorkersError,
-    StaleReadError,
-    WorkerRefusedError,
-)
-from lux_tpu.serve.fleet.hashring import (  # noqa: F401
-    HashRing,
-    route_key,
-)
+_EXPORTS = {
+    "FleetController": "lux_tpu.serve.fleet.controller",
+    "FleetError": "lux_tpu.serve.fleet.controller",
+    "FleetFuture": "lux_tpu.serve.fleet.controller",
+    "FleetRejectedError": "lux_tpu.serve.fleet.controller",
+    "FleetTimeoutError": "lux_tpu.serve.fleet.controller",
+    "NoWorkersError": "lux_tpu.serve.fleet.controller",
+    "StaleReadError": "lux_tpu.serve.fleet.controller",
+    "WorkerRefusedError": "lux_tpu.serve.fleet.controller",
+    "HashRing": "lux_tpu.serve.fleet.hashring",
+    "route_key": "lux_tpu.serve.fleet.hashring",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -54,6 +54,7 @@ from lux_tpu.serve.fleet.hashring import (
     h64,
     route_key,
 )
+from lux_tpu.serve.fleet.pubproto import publish_token
 from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
 from lux_tpu.utils.backoff import Backoff, retry_call
 from lux_tpu.utils.config import env_float
@@ -1176,7 +1177,7 @@ class FleetController:
         # The incarnation prefix keeps tokens unique across controller
         # RESTARTS — a promoted controller's _seq starts over, and its
         # commit must never match a dead predecessor's staged cache
-        token = f"pub-{self._incarnation}-{self._next_rid()}"
+        token = publish_token(self._incarnation, self._next_rid())
         # the republish trace: two-phase barrier as one timeline —
         # every worker's prepare/commit spans parent into it
         rtc = dtrace.mint(key=f"republish:{token}")

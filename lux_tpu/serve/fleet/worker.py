@@ -48,6 +48,11 @@ from typing import Dict, List, Optional, Tuple
 
 from lux_tpu import fault
 from lux_tpu.obs import dtrace
+from lux_tpu.serve.fleet.pubproto import (
+    ERR_NOTHING_STAGED,
+    ERR_PREPARE_SUPERSEDED,
+    token_mismatch,
+)
 from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
 from lux_tpu.serve.metrics import ServeMetrics
 from lux_tpu.serve.scheduler import (
@@ -95,7 +100,8 @@ class ReplicaWorker:
         #: warms the standing states, and answers carry generation tags.
         #: _live_lock serializes the write path (delta apply, refresh,
         #: live commit) — queries never take it, they read the cache's
-        #: atomic overlay tuple
+        #: atomic overlay tuple.  Acquisition order is _live_lock
+        #: BEFORE _lock on every path (checker-enforced: LUX-L002)
         self._live = live
         self._live_lock = threading.Lock()
         # (cache, graph_id, token, staged LiveReplica | None): token
@@ -816,7 +822,7 @@ class ReplicaWorker:
                 gen_next = self._generation + 1
             if stale:
                 self._reply_err(conn, msg, "error",
-                                err="prepare superseded/discarded")
+                                err=ERR_PREPARE_SUPERSEDED)
                 return
             conn.send({"req_id": rid, "ok": True, "staged": True,
                        "graph_id": gid, "generation_next": gen_next,
@@ -838,7 +844,8 @@ class ReplicaWorker:
         # under _live_lock so a racing delta can never apply to the old
         # replica and then install its overlay into the new cache (old
         # epoch's edge slots under new engines = silent wrong answers).
-        # Lock order _live_lock -> _lock matches _op_delta.
+        # Lock order _live_lock -> _lock matches _op_delta; LUX-L002
+        # fails the build on any path that inverts it.
         with self._live_lock:
             self._op_commit_locked(conn, msg, rid, want)
 
@@ -847,14 +854,13 @@ class ReplicaWorker:
 
         with self._lock:
             if self._staged is None:
-                err = "nothing staged"
+                err = ERR_NOTHING_STAGED
                 staged = None
             elif want is not None and self._staged[2] != str(want):
                 # the staged cache belongs to a DIFFERENT republish than
                 # the one committing — swapping it in would serve the
                 # wrong graph under the committer's graph_id
-                err = (f"staged token {self._staged[2]!r} does not match "
-                       f"commit token {want!r}")
+                err = token_mismatch(self._staged[2], str(want))
                 staged = None
             else:
                 err = None

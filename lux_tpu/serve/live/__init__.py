@@ -24,10 +24,34 @@ Pieces:
   compact + generation-aware routing and worker catch-up.
 * ``bench.py``     — thread-mode live fleet helper + the mixed
   read/write measurement behind bench.py's ``sssp_live_*`` row.
+* ``errors.py``    — the protocol-surface exceptions/reason constants
+  (``GenerationGap``, the ``add_worker`` refusal reasons): stdlib-only
+  so the protocol model tier imports the REAL types without jax.
+
+Exports resolve LAZILY (PEP 562, same contract as ``lux_tpu.serve``):
+``journal``/``errors`` are jax-free and must stay importable under
+tools/_jaxfree.py's bare-package stub.
 """
-from lux_tpu.serve.live.controller import (  # noqa: F401
-    LiveFleetController,
-    promote_live_controller,
-)
-from lux_tpu.serve.live.journal import LiveJournal  # noqa: F401
-from lux_tpu.serve.live.replica import GenerationGap, LiveReplica  # noqa: F401,E501
+_EXPORTS = {
+    "LiveFleetController": "lux_tpu.serve.live.controller",
+    "promote_live_controller": "lux_tpu.serve.live.controller",
+    "LiveJournal": "lux_tpu.serve.live.journal",
+    "GenerationGap": "lux_tpu.serve.live.errors",
+    "LiveReplica": "lux_tpu.serve.live.replica",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -48,6 +48,11 @@ from lux_tpu.serve.fleet.controller import (
     _Pending,
 )
 from lux_tpu.serve.fleet.wire import ConnectionClosed
+from lux_tpu.serve.live.errors import (
+    REFUSE_AHEAD,
+    REFUSE_PRE_EPOCH,
+    REFUSE_STATIC,
+)
 from lux_tpu.serve.live.journal import LiveJournal, read_live_meta
 
 
@@ -72,6 +77,10 @@ class LiveFleetController(FleetController):
         #: compactions they escalate to are totally ordered; reads
         #: never take this.  Reentrant because compact_fleet (holding
         #: it) republishes through the serialized override below.
+        #: Acquisition order is _write_lock BEFORE the base _lock on
+        #: every path (checker-enforced: LUX-L002); the fine-grained
+        #: _lock is never held across a send/wait — replication blocks
+        #: under _write_lock ONLY, which is the point of the lock.
         self._write_lock = threading.RLock()
         self._live_counts = {"writes": 0, "write_rows": 0,
                              "compactions": 0, "resyncs": 0,
@@ -110,7 +119,7 @@ class LiveFleetController(FleetController):
         if not info.get("live"):
             self.remove_worker(wid, shutdown=False)
             raise WorkerRefusedError(
-                "static",
+                REFUSE_STATIC,
                 f"worker {wid} is not live (start it with --live / a "
                 "LiveReplica); a static replica would serve writes-blind "
                 "answers with no generation tag")
@@ -119,14 +128,14 @@ class LiveFleetController(FleetController):
         if have > gen:
             self.remove_worker(wid, shutdown=False)
             raise WorkerRefusedError(
-                "ahead_of_journal",
+                REFUSE_AHEAD,
                 f"worker {wid} is at generation {have}, ahead of the "
                 f"journal ({gen}) — it belongs to a different write "
                 "history (wrong journal dir or wiped controller state)")
         if have < self.journal.base_generation:
             self.remove_worker(wid, shutdown=False)
             raise WorkerRefusedError(
-                "pre_epoch",
+                REFUSE_PRE_EPOCH,
                 f"worker {wid} is at generation {have}, before the "
                 f"current epoch base {self.journal.base_generation}: its "
                 "missing batches were compacted into the snapshot — "

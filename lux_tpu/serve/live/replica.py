@@ -44,22 +44,13 @@ from lux_tpu.serve.live.journal import (
     write_live_meta,
 )
 
+from lux_tpu.serve.live.errors import GenerationGap  # noqa: F401 — the
+# protocol exception moved to the stdlib-only errors module so the model
+# tier imports the real type jax-free; re-exported here for callers
+
 #: standing apps the refresh dispatcher knows (arg = sssp start vertex;
 #: pagerank / components take none)
 STANDING_APPS = ("sssp", "pagerank", "components")
-
-
-class GenerationGap(RuntimeError):
-    """A delta arrived out of sequence: the replica holds ``have``, the
-    batch claims ``want``.  The controller answers with the catch-up
-    stream (batches have+1..)."""
-
-    def __init__(self, have: int, want: int):
-        super().__init__(
-            f"replica is at generation {have}, delta claims {want} — "
-            "re-sync from the controller journal")
-        self.have = int(have)
-        self.want = int(want)
 
 
 def parse_standing(spec: str) -> Tuple[Tuple[str, Optional[int]], ...]:

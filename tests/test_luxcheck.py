@@ -273,6 +273,170 @@ def test_policy_uint8_narrowing_outside_narrow_idx(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# lock-order fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_lockorder_ab_ba_inversion(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/serve/bad_order.py", """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._live_lock = threading.Lock()
+
+            def op_delta(self):
+                with self._live_lock:
+                    with self._lock:
+                        pass
+
+            def op_commit(self):
+                with self._lock:
+                    with self._live_lock:
+                        pass
+        """)
+    assert "LUX-L002" in _codes(fs)
+
+
+def test_lockorder_consistent_order_clean(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/serve/good_order.py", """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._live_lock = threading.Lock()
+
+            def op_delta(self):
+                with self._live_lock:
+                    with self._lock:
+                        pass
+
+            def op_commit(self):
+                with self._live_lock:
+                    self._commit_locked()
+
+            def _commit_locked(self):
+                with self._lock:
+                    pass
+        """)
+    assert not [c for c in _codes(fs) if c.startswith("LUX-L")]
+
+
+def test_lockorder_reentrant_self_deadlock(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/serve/bad_reentry.py", """\
+        import threading
+
+        class Group:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """)
+    assert "LUX-L001" in _codes(fs)
+
+
+def test_lockorder_rlock_reentry_clean(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/serve/good_reentry.py", """\
+        import threading
+
+        class Group:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """)
+    assert "LUX-L001" not in _codes(fs)
+
+
+def test_lockorder_blocking_under_lock(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/serve/bad_block.py", """\
+        import threading
+        import time
+
+        class Ctl:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self, thread, fut):
+                with self._lock:
+                    time.sleep(1.0)
+                    thread.join()
+                    fut.result(timeout=5)
+        """)
+    assert _codes(fs).count("LUX-L003") == 3
+
+
+def test_lockorder_condition_wait_and_unheld_clean(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/serve/good_block.py", """\
+        import threading
+        import time
+
+        class Ctl:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._wake_cond = threading.Condition(self._lock)
+
+            def wait_for_work(self):
+                with self._wake_cond:
+                    self._wake_cond.wait(1.0)
+
+            def slow_outside(self, thread):
+                with self._lock:
+                    n = 1
+                time.sleep(0.1)
+                thread.join()
+        """)
+    assert "LUX-L003" not in _codes(fs)
+
+
+def test_lockorder_unbalanced_acquire_release(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/serve/bad_split.py", """\
+        import threading
+
+        class Ctl:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def grab(self):
+                self._lock.acquire()
+
+            def drop(self):
+                self._lock.release()
+        """)
+    assert _codes(fs).count("LUX-L004") == 2
+
+
+def test_lockorder_ctx_manager_pair_exempt(tmp_path):
+    fs = _check_snippet(tmp_path, "lux_tpu/serve/good_split.py", """\
+        class Guard:
+            def __init__(self, lock):
+                self._inner_lock = lock
+
+            def __enter__(self):
+                self._inner_lock.acquire()
+                return self
+
+            def __exit__(self, *exc):
+                self._inner_lock.release()
+        """)
+    assert "LUX-L004" not in _codes(fs)
+
+
+# ---------------------------------------------------------------------------
 # suppression machinery
 # ---------------------------------------------------------------------------
 
@@ -486,7 +650,7 @@ def test_luxcheck_cli_clean_and_jax_free():
 def test_every_family_has_a_checker():
     fams = {c.family for c in ALL_CHECKERS}
     assert fams == {"tracing-safety", "determinism", "thread-safety",
-                    "policy", "observability"}
+                    "policy", "observability", "lock-order"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,312 @@
+"""luxproto (lux_tpu.analysis.proto): every clean protocol model checks
+EXHAUSTIVELY clean, every broken twin produces its designed shortest
+counterexample, recorded soak logs replay conformant through the
+models' legality rules, and an election counterexample round-trips to a
+REAL split brain through the exported FaultPlan — the tier-1 form of
+chip-day step -3c / ci_check's proto_smoke.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from lux_tpu.analysis.proto import (
+    PROTOCOLS,
+    check_all,
+    check_broken,
+    check_protocol,
+)
+from lux_tpu.analysis.proto import conform
+from lux_tpu.analysis.proto.export import (
+    export_faultplan,
+    export_json,
+    trace_seed,
+)
+from lux_tpu.fault.plan import FaultPlan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "tests", "data")
+
+
+def _fixture(name):
+    with open(os.path.join(DATA, name)) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# the clean models: exhaustively clean, real state spaces
+# ---------------------------------------------------------------------------
+
+
+def test_all_protocols_check_clean():
+    results = check_all()
+    assert [r.protocol for r in results] == list(PROTOCOLS)
+    for r in results:
+        assert r.ok, r.violation.format()
+        # exhaustive means a real state space was walked, not a stub
+        assert r.states > 10 and r.transitions > r.states / 2, r
+        assert r.depth > 3, r
+
+
+def test_state_spaces_are_not_degenerate():
+    """Floors (not exact pins — models may legitimately grow): the
+    docs/ANALYSIS.md state-space table stays honest if these move."""
+    floors = {"election": 100, "publish": 1000, "genline": 10000,
+              "journal": 50}
+    for r in check_all():
+        assert r.states >= floors[r.protocol], r.summary()
+
+
+# ---------------------------------------------------------------------------
+# broken twins: each must fail, with its DESIGNED counterexample
+# ---------------------------------------------------------------------------
+
+
+def test_every_broken_twin_fails():
+    for name, proto in PROTOCOLS.items():
+        for twin in proto.broken:
+            r = check_broken(name, twin)
+            assert not r.ok, f"{name}/{twin} unexpectedly clean"
+            assert r.violation.kind == "invariant", (name, twin)
+            assert r.violation.trace, (name, twin)
+
+
+def test_election_unfenced_is_split_brain():
+    v = check_broken("election", "unfenced").violation
+    assert "split brain" in v.message
+    assert "incarnation fence" in v.message
+    # the shortest schedule: winner promotes, stops, late detector
+    # claims the SAME incarnation and promotes again
+    assert v.trace[:2] == ("detect(s0)", "claim_win(s0)")
+    assert sum(a.startswith("claim_win") for a in v.trace) == 2
+
+
+def test_publish_unchecked_tokens_installs_wrong_cache():
+    v = check_broken("publish", "unchecked_tokens").violation
+    # the refusal string is the REAL pubproto.token_mismatch spelling
+    from lux_tpu.serve.fleet.pubproto import token_mismatch
+    assert token_mismatch("pub-A-1", "pub-B-1") in v.message
+    assert any(a.startswith("crash(c0)") for a in v.trace)
+
+
+def test_genline_twins():
+    v = check_broken("genline", "stale_heartbeat").violation
+    assert "read-your-writes" in v.message
+    assert "view 1 -> 0" in v.message
+    v = check_broken("genline", "optimistic_send").violation
+    assert "leads its applied gen" in v.message
+    assert v.trace == ("write(gen=1)",)  # a 1-step counterexample
+
+
+def test_journal_marker_first_loses_atomicity():
+    v = check_broken("journal", "marker_first").violation
+    assert "batch-before-marker" in v.message
+    assert any(a.startswith("crash(") for a in v.trace)
+    assert v.trace[0] == "mark(seq=0)"
+
+
+# ---------------------------------------------------------------------------
+# counterexample -> FaultPlan export
+# ---------------------------------------------------------------------------
+
+
+def test_export_clean_result_raises():
+    with pytest.raises(ValueError, match="no counterexample"):
+        export_faultplan(check_protocol("journal"))
+
+
+def test_election_export_is_deterministic_and_round_trips():
+    r = check_broken("election", "unfenced")
+    plan = export_faultplan(r)
+    assert plan.seed == trace_seed(r.violation)
+    points = {rule.point for rule in plan.rules}
+    assert points == {"election.promote", "election.detect"}
+    # the schedule holds the FIRST winner's promotion open and stalls
+    # the OTHER standby's detection (owners from the trace)
+    owners = {rule.point: rule.owner for rule in plan.rules}
+    assert owners["election.promote"] == "standby-0"
+    assert owners["election.detect"] == "standby-1"
+    # bit-stable: the JSON is the reproduction recipe
+    assert export_json(r) == export_json(
+        check_broken("election", "unfenced"))
+    back = FaultPlan.from_json(export_json(r))
+    assert back.seed == plan.seed
+    assert [ru.point for ru in back.rules] == [
+        ru.point for ru in plan.rules]
+
+
+def test_journal_export_kills_the_marker_window():
+    plan = export_faultplan(check_broken("journal", "marker_first"))
+    assert all(ru.point == "journal.before_marker" for ru in plan.rules)
+    assert all(ru.action == "kill" for ru in plan.rules)
+
+
+# ---------------------------------------------------------------------------
+# the model -> implementation round-trip (the ISSUE-18 acceptance pin):
+# the exported schedule reproduces a REAL split brain on the unfenced
+# group, and the REAL fence absorbs the exact same schedule
+# ---------------------------------------------------------------------------
+
+
+def test_exported_plan_reproduces_split_brain_unfenced():
+    from lux_tpu.fault.chaos import election_drill
+    plan = export_faultplan(check_broken("election", "unfenced"))
+    rep = election_drill(plan, fenced=False)
+    assert rep["elections"] == 2, rep  # the model's violation, live
+    assert sorted(rep["outcomes"].values()) == ["won", "won"], rep
+    assert rep["fired"] > 0, "the exported schedule never injected"
+
+
+def test_fence_absorbs_the_same_schedule():
+    from lux_tpu.fault.chaos import election_drill
+    plan = export_faultplan(check_broken("election", "unfenced"))
+    rep = election_drill(plan, fenced=True)
+    assert rep["elections"] == 1, rep
+    assert sorted(rep["outcomes"].values()) == ["adopted", "won"], rep
+
+
+# ---------------------------------------------------------------------------
+# trace-replay conformance
+# ---------------------------------------------------------------------------
+
+
+def test_recorded_chaos_logs_conform():
+    for name in ("chaos_soak_seed0.json",
+                 "chaos_soak_failover_seed3.json"):
+        events = _fixture(name)
+        assert conform.detect_kind(events) == "chaos_soak"
+        assert conform.replay(events) == [], name
+
+
+def test_recorded_autopilot_log_conforms():
+    events = _fixture("autopilot_soak_seed0.json")
+    assert conform.detect_kind(events) == "autopilot_soak"
+    assert conform.replay(events) == []
+
+
+def test_live_chaos_soak_conforms():
+    """The fixture logs must not drift from live behavior: a fresh
+    soak's events replay conformant too."""
+    from lux_tpu.fault.chaos import chaos_soak
+    rep = chaos_soak(seed=0, steps=10)
+    bad = conform.replay(rep["events"])
+    assert bad == [], [nc.format() for nc in bad]
+
+
+def test_live_autopilot_soak_conforms():
+    from lux_tpu.fault.chaos import autopilot_soak
+    rep = autopilot_soak(0, steps=3, scale=6, cap=32, rows=8)
+    bad = conform.replay(rep["events"], kind="autopilot_soak")
+    assert bad == [], [nc.format() for nc in bad]
+
+
+def test_conformance_catches_doctored_transitions():
+    events = _fixture("chaos_soak_failover_seed3.json")
+
+    def rules_for(mutate):
+        evs = [dict(e) for e in events]
+        mutate(evs)
+        return {nc.rule for nc in conform.replay(evs)}
+
+    def gen_jump(evs):
+        w = next(e for e in evs if e["ev"] == "write")
+        w["gen"] = 99
+
+    def stale_on_fresh(evs):
+        r = next(e for e in evs if e["ev"] == "read")
+        r["stale"] = True
+
+    def second_failover(evs):
+        f = next(e for e in evs if e["ev"] == "failover")
+        evs.append(dict(f))
+
+    def double_kill(evs):
+        k = next(e for e in evs if e["ev"] == "kill")
+        evs.insert(evs.index(k) + 1, dict(k))
+
+    def lost_writes_promotion(evs):
+        f = next(e for e in evs if e["ev"] == "failover")
+        f["gen"] = 0
+
+    assert "genline.gen_gap" in rules_for(gen_jump)
+    assert "genline.fresh_required" in rules_for(stale_on_fresh)
+    assert "election.refenced" in rules_for(second_failover)
+    assert "fleet.double_kill" in rules_for(double_kill)
+    assert "journal.promotion_lost_writes" in rules_for(
+        lost_writes_promotion)
+
+
+def test_conformance_empty_log_is_a_finding():
+    bad = conform.replay([])
+    assert [nc.rule for nc in bad] == ["trace.empty"]
+    assert bad[0].index == -1
+
+
+def test_conformance_unknown_kind_and_event():
+    assert [nc.rule for nc in conform.replay([{"ev": "write"}],
+                                             kind="nope")] \
+        == ["trace.unknown_kind"]
+    rules = {nc.rule for nc in conform.replay(
+        [{"i": 0, "ev": "teleport"}])}
+    assert "trace.unknown_event" in rules
+
+
+# ---------------------------------------------------------------------------
+# the CLI: jax-free gate semantics (exit codes, filter-as-finding)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def luxproto_main():
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    spec = importlib.util.spec_from_file_location(
+        "luxproto", os.path.join(tools, "luxproto.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def test_cli_all_twins_is_clean(luxproto_main, capsys):
+    assert luxproto_main(["--all", "--twins"]) == 0
+    out = capsys.readouterr().out
+    assert "[PASS] luxproto" in out
+    assert "fails as designed" in out
+
+
+def test_cli_empty_filter_is_a_finding(luxproto_main, capsys):
+    assert luxproto_main(["--protocols", ","]) == 1
+    assert "selected NOTHING" in capsys.readouterr().err
+
+
+def test_cli_unknown_protocol_is_a_finding(luxproto_main, capsys):
+    assert luxproto_main(["--protocols", "election,bogus"]) == 1
+    err = capsys.readouterr().err
+    assert "unknown protocol 'bogus'" in err
+
+
+def test_cli_replay_fixtures(luxproto_main, capsys):
+    logs = [os.path.join(DATA, n) for n in (
+        "chaos_soak_seed0.json", "autopilot_soak_seed0.json")]
+    assert luxproto_main(["--replay"] + logs) == 0
+    assert "2 log(s) conform" in capsys.readouterr().out
+
+
+def test_cli_replay_flags_doctored_log(luxproto_main, tmp_path,
+                                       capsys):
+    events = _fixture("chaos_soak_seed0.json")
+    events[0]["gen"] = 50
+    bad = tmp_path / "doctored.json"
+    bad.write_text(json.dumps(events))
+    assert luxproto_main(["--replay", str(bad)]) == 1
+    assert "genline.gen_gap" in capsys.readouterr().out
+
+
+def test_cli_export_twin_prints_plan_json(luxproto_main, capsys):
+    assert luxproto_main(["--export", "election:unfenced"]) == 0
+    plan = FaultPlan.from_json(capsys.readouterr().out)
+    assert {r.point for r in plan.rules} == {
+        "election.promote", "election.detect"}

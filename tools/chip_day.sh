@@ -119,6 +119,27 @@ fi
 [ -n "$SID" ] && python tools/obs_span.py end "$SID" 2>/dev/null
 tail -1 "$LOG/luxaudit.out"
 
+# -3c) protocol preflight: luxproto checks the distributed protocols
+#      (election fencing, two-phase publish, generation line, journal
+#      crash-atomicity) to exhaustion and requires the broken twins to
+#      still fail.  ABORTS on any finding: a protocol counterexample
+#      means the fleet half of the battery (failover/soak steps) would
+#      burn its budget reproducing a bug the model already has the
+#      shortest trace for — and that trace EXPORTS as the FaultPlan
+#      reproduction (tools/luxproto.py --export <protocol>).  Jax-free
+#      like -3, so this costs under a second even tunnel-wedged.
+echo "=== luxproto preflight ($(date +%H:%M:%S))"
+SID=$(python tools/obs_span.py begin step.luxproto 2>/dev/null)
+if ! fg_to 120 python tools/luxproto.py --all --twins \
+    > "$LOG/luxproto.out" 2>&1; then
+  [ -n "$SID" ] && python tools/obs_span.py end "$SID" --rc 1 2>/dev/null
+  tail -15 "$LOG/luxproto.out" | sed 's/^/    /'
+  echo "luxproto findings (full list: $LOG/luxproto.out) — aborting battery"
+  exit 1
+fi
+[ -n "$SID" ] && python tools/obs_span.py end "$SID" 2>/dev/null
+tail -1 "$LOG/luxproto.out"
+
 # -2) routed-plan prewarm in the BACKGROUND (host cores only, no chip
 #     needed): builds/refreshes the headline-scale expand+fused plan
 #     caches so no battery step pays plan construction inside a TPU

@@ -32,6 +32,22 @@ stage luxcheck 120 python tools/luxcheck.py --all
 #     invariants) — the jaxpr-level half of the static gate
 stage luxaudit 600 python tools/luxaudit.py --fast
 
+# 1c) luxproto: exhaustive protocol model checking — election fencing,
+#     two-phase publish tokens, the generation line, journal crash-
+#     atomicity — each model checked to exhaustion, the broken twins
+#     REQUIRED to fail (silent-pass tripwire), and the recorded soak
+#     fixtures replayed through the models' legality rules.  Jax-free
+#     like stage 1, sub-second, [PASS]-gated.
+stage proto_smoke 120 bash -c '
+set -e
+out=$(python tools/luxproto.py --all --twins \
+      --replay tests/data/chaos_soak_seed0.json \
+               tests/data/chaos_soak_failover_seed3.json \
+               tests/data/autopilot_soak_seed0.json)
+echo "$out" | grep -q "\[PASS\] luxproto" || { echo "luxproto failed"; exit 1; }
+echo "$out"
+'
+
 # 2) native sanitizer smoke: TSan (the multithreaded colorer, bitwise
 #    vs serial), ASan + UBSan (lux_io's pread64 offset arithmetic).
 #    Skipped quietly when the toolchain can't build them (the pytest

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""luxproto — exhaustive protocol model checking for the distributed
+fleet (lux_tpu.analysis.proto).
+
+Usage:
+    python tools/luxproto.py --all              # every protocol model
+    python tools/luxproto.py --protocols election,journal
+    python tools/luxproto.py --all --twins      # + broken twins must FAIL
+    python tools/luxproto.py --replay SOAK.json # conformance over a log
+    python tools/luxproto.py --export election:unfenced  # FaultPlan JSON
+    python tools/luxproto.py --list
+
+Exit codes: 0 = clean, 1 = findings, 2 = usage.
+
+What counts as a FINDING (abort-on-findings, like luxcheck):
+
+* a counterexample in a CLEAN protocol model — the protocol (or the
+  model of it) is broken; the shortest trace is printed and the
+  counterexample exports as a seeded PR-14 FaultPlan
+  (``--export <protocol>``) that replays against the real fleet;
+* under ``--twins``: a BROKEN twin that checks clean — the deliberately
+  de-fenced model no longer fails, so either the model drifted from the
+  code or the checker lost the hazard (a silent-pass tripwire);
+* an EMPTY or unknown ``--protocols`` filter — a gate that matched
+  nothing must not read as coverage;
+* under ``--replay``: any recorded soak transition the models would not
+  allow (lux_tpu.analysis.proto.conform), or an empty event log.
+
+Runs as step -3c of tools/chip_day.sh (next to luxcheck/-3 and
+luxaudit/-3b) and as ci_check's ``proto_smoke`` stage.  Pure stdlib —
+the models import the REAL protocol code (StandbyGroup, pubproto,
+GenerationGap, deltalog's journal constants) but none of it touches
+jax, so the gate costs well under a second.
+"""
+import argparse
+import json
+import os
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import _jaxfree  # noqa: E402
+
+REPO = _jaxfree.bare_package()
+
+from lux_tpu.analysis.proto import (  # noqa: E402
+    PROTOCOLS, check_broken, check_protocol,
+)
+from lux_tpu.analysis.proto import conform  # noqa: E402
+from lux_tpu.analysis.proto.export import export_json  # noqa: E402
+
+
+def _parse_protocols(spec):
+    """Comma-separated filter -> (names, findings).  Unknown names and
+    an empty selection are findings, not silent no-ops."""
+    findings = []
+    if spec is None:
+        return list(PROTOCOLS), findings
+    names = [s.strip() for s in spec.split(",") if s.strip()]
+    unknown = [n for n in names if n not in PROTOCOLS]
+    for n in unknown:
+        findings.append(
+            f"luxproto: unknown protocol {n!r} in --protocols "
+            f"(known: {', '.join(PROTOCOLS)})")
+    names = [n for n in names if n in PROTOCOLS]
+    if not names:
+        findings.append(
+            "luxproto: --protocols selected NOTHING — an empty gate "
+            "must not read as coverage")
+    return names, findings
+
+
+def _check_models(names, twins, max_states):
+    findings = []
+    for name in names:
+        res = check_protocol(name, max_states=max_states)
+        print(res.summary())
+        if not res.ok:
+            findings.append(f"{name}: counterexample found")
+            print(res.violation.format())
+            print(f"  replay: python tools/luxproto.py --export {name}")
+        if not twins:
+            continue
+        for twin in PROTOCOLS[name].broken:
+            bres = check_broken(name, twin, max_states=max_states)
+            if bres.ok:
+                findings.append(
+                    f"{name}/{twin}: broken twin checks CLEAN — the "
+                    "model lost the hazard (or the guard it disables "
+                    "is no longer what prevents it)")
+                print(f"{name}/{twin}: unexpectedly clean "
+                      f"({bres.states} states)")
+            else:
+                print(f"{name}/{twin}: fails as designed "
+                      f"({bres.violation.kind}, "
+                      f"{len(bres.violation.trace)}-step trace)")
+    return findings
+
+
+def _load_events(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        doc = doc.get("events", doc)
+    if not isinstance(doc, list):
+        raise ValueError(
+            f"{path}: expected a JSON list of events (or a soak "
+            "report with an 'events' key)")
+    return doc
+
+
+def _replay(paths, kind):
+    findings = []
+    for path in paths:
+        try:
+            events = _load_events(path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            findings.append(f"{path}: unreadable event log: {e}")
+            continue
+        bad = conform.replay(events, kind=kind)
+        label = conform.detect_kind(events) if kind == "auto" else kind
+        if bad:
+            for nc in bad:
+                print(f"{path}: {nc.format()}")
+            findings.append(
+                f"{path}: {len(bad)} model-illegal transition(s)")
+        else:
+            print(f"{path}: {len(events)} events conform ({label})")
+    return findings
+
+
+def _export(spec):
+    """``protocol`` (clean model's counterexample — only exists when
+    the gate is failing) or ``protocol:twin`` (the designed
+    counterexample)."""
+    name, _, twin = spec.partition(":")
+    if name not in PROTOCOLS:
+        print(f"luxproto: unknown protocol {name!r}", file=sys.stderr)
+        return 2
+    if twin:
+        if twin not in PROTOCOLS[name].broken:
+            print(f"luxproto: unknown twin {twin!r} for {name} "
+                  f"(known: {', '.join(PROTOCOLS[name].broken)})",
+                  file=sys.stderr)
+            return 2
+        res = check_broken(name, twin)
+    else:
+        res = check_protocol(name)
+    if res.ok:
+        print(f"luxproto: {spec} checks clean — no counterexample to "
+              "export", file=sys.stderr)
+        return 1
+    print(export_json(res))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="exhaustive protocol model checking (election "
+                    "fencing, two-phase publish, generation line, "
+                    "journal crash-atomicity) + trace-replay "
+                    "conformance")
+    ap.add_argument("--all", action="store_true",
+                    help="check every registered protocol model")
+    ap.add_argument("--protocols", default=None, metavar="A,B",
+                    help="comma-separated subset (empty/unknown "
+                         "selection is itself a finding)")
+    ap.add_argument("--twins", action="store_true",
+                    help="also run the broken twins and REQUIRE them "
+                         "to fail (silent-pass tripwire)")
+    ap.add_argument("--replay", nargs="+", default=None, metavar="LOG",
+                    help="conformance-check recorded soak event logs "
+                         "(JSON list, or a soak report with 'events')")
+    ap.add_argument("--kind", default="auto",
+                    choices=("auto", "chaos_soak", "autopilot_soak"),
+                    help="event-log kind for --replay")
+    ap.add_argument("--export", default=None, metavar="PROTO[:TWIN]",
+                    help="print the counterexample's FaultPlan JSON")
+    ap.add_argument("--max-states", type=int, default=1_000_000,
+                    help="state-space tripwire (exceeding it is a "
+                         "finding, not a silent truncation)")
+    ap.add_argument("--list", action="store_true",
+                    help="list protocols, their broken twins and "
+                         "invariant summaries")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, p in PROTOCOLS.items():
+            twins = ", ".join(p.broken) or "-"
+            print(f"{name:10s} twins=[{twins}]  {p.summary}")
+        return 0
+    if args.export is not None:
+        return _export(args.export)
+
+    run_models = args.all or args.protocols is not None
+    if not run_models and args.replay is None:
+        ap.print_usage(sys.stderr)
+        print("error: give --all, --protocols or --replay",
+              file=sys.stderr)
+        return 2
+    findings = []
+    names = []
+    if run_models:
+        names, findings = _parse_protocols(
+            None if args.all and args.protocols is None
+            else args.protocols)
+        findings += _check_models(names, args.twins, args.max_states)
+    if args.replay is not None:
+        findings += _replay(args.replay, args.kind)
+
+    if findings:
+        print(f"\nluxproto: {len(findings)} finding(s):",
+              file=sys.stderr)
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    done = []
+    if names:
+        done.append(f"{len(names)} protocol(s) exhaustively clean"
+                    + (" (+twins fail as designed)" if args.twins
+                       else ""))
+    if args.replay is not None:
+        done.append(f"{len(args.replay)} log(s) conform")
+    print(f"[PASS] luxproto: {'; '.join(done)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
