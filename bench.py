@@ -82,7 +82,14 @@ Env knobs:
                    offered-QPS ramp to the saturation knee per fleet
                    width on CPU, QPS + p99 at the knee, plus the paired
                    interleaved 2w-vs-1w probe (LUX_BENCH_FLEET_SCALE
-                   overrides the rmat scale).
+                   overrides the rmat scale).  "pod" (OPT-IN, ISSUE 19)
+                   is the placement-tree weak-scaling family:
+                   sssp_pod_w{1,2,4}_rmat{16,18,20} — 1/2/4 REAL worker
+                   processes over loopback, snapshot streamed over the
+                   wire, answer bitwise vs single-host, with per-host
+                   plan/exchange/converge phases and the weak_scaling
+                   ratio on every row (LUX_BENCH_POD_SCALE base, default
+                   16; LUX_BENCH_POD_PARTS, default 8).
   LUX_BENCH_ROUTE_PF=1 / LUX_BENCH_ROUTE_FUSED_PF=1  A/B the PASS-FUSED
                    routed pipelines (ops/expand.to_pf: 2-3 Benes passes
                    per Pallas kernel, VMEM-resident intermediates —
@@ -946,6 +953,89 @@ def worker_main():
               f"trace_overhead={oh.get('overhead_frac')}",
               file=sys.stderr, flush=True)
 
+    def measure_pod():
+        """Placement-tree weak-scaling rows (ISSUE 19, OPT-IN via
+        LUX_BENCH_APPS): 1/2/4 REAL worker processes over loopback TCP
+        with private launcher tmpdirs, one row per width, problem size
+        grown with the width (rmat16 -> 18 -> 20 by default — the curve
+        the chip window re-runs verbatim on process-mode TPU hosts).
+        Each width's sharded sssp answer is asserted BITWISE against
+        the single-host pull engine before its row can emit; the phases
+        dict attributes wall to plan (stream + partial load + warmup) /
+        exchange (frames + assembly) / converge (worker compute);
+        ``weak_scaling`` is the per-host converge throughput vs the w1
+        row.  Emitted via _emit, not _emit_row: the pod phases ARE the
+        row's phase attribution — the driver-process span totals would
+        overwrite them with the oracle run's load/compile/iterate."""
+        import numpy as np
+
+        from lux_tpu.engine.methods import resolve_sum
+        from lux_tpu.graph.format import write_lux
+        from lux_tpu.models.sssp import SSSPProgram
+        from lux_tpu.program.spec import active_changed
+        from lux_tpu.serve.fleet.launcher import launch_pod_worker
+        from lux_tpu.serve.fleet.pod import run_pull_pod
+        from lux_tpu.utils import roofline
+
+        base = _env_int("LUX_BENCH_POD_SCALE", 16)
+        pparts = _env_int("LUX_BENCH_POD_PARTS", 8)
+        per_host0 = None
+        for w in (1, 2, 4):
+            sc = base + {1: 0, 2: 2, 4: 4}[w]
+            gp = generate.rmat(sc, 8, seed=3)
+            snap = f"/tmp/lux_bench_pod_{os.getpid()}_w{w}.lux"
+            write_lux(snap, gp)
+            shp = build_pull_shards(gp, pparts)
+            start = int(np.argmax(gp.out_degrees()))
+            prog = SSSPProgram(nv=shp.spec.nv, start=start)
+            s0 = pull.init_state(prog, shp.arrays)
+            want, _ = pull.run_pull_until(
+                prog, shp.spec, shp.arrays, s0, 10_000, active_changed,
+                method="auto")
+            hs = [launch_pod_worker(f"bench_w{w}_{i}") for i in range(w)]
+            try:
+                res = run_pull_pod(
+                    [("127.0.0.1", h.port) for h in hs], snap, pparts,
+                    app="sssp", start=start)
+            finally:
+                for h in hs:
+                    h.terminate()
+            os.remove(snap)
+            assert np.array_equal(res["state"], np.asarray(want)), (
+                f"pod w{w} != single-host")
+            tconv = max(res["phases"]["converge"], 1e-9)
+            value = gp.ne * res["iters"] / tconv / 1e9
+            per_host = value / w
+            per_host0 = per_host if per_host0 is None else per_host0
+            m = resolve_sum("auto", prog.reduce)
+            row = {
+                "metric": f"sssp_pod_w{w}_rmat{sc}",
+                "value": round(value, 4),
+                "unit": "GTEPS",
+                "method": m,
+                "dtype": "int32",
+                "hosts": w,
+                "parts": pparts,
+                "iters": res["iters"],
+                "edges": int(gp.ne),
+                "weak_scaling": round(per_host / per_host0, 3),
+                "phases": {k: round(v, 3)
+                           for k, v in res["phases"].items()},
+                "workers": {wid: {"lo": i["lo"], "hi": i["hi"],
+                                  "compute_s": round(i["compute_s"], 3)}
+                            for wid, i in res["workers"].items()},
+                "hbm_passes": roofline.pull_hbm_passes(m),
+                "plan_build_seconds": _plan_build_field(),
+                "run_id": obs.recorder().run_id,
+            }
+            obs.point("bench.row", metric=row["metric"],
+                      value=row["value"], unit=row["unit"], method=m)
+            _emit(row)
+            print(f"# pod w{w} rmat{sc}: iters={res['iters']} "
+                  f"phases={row['phases']} "
+                  f"weak_scaling={row['weak_scaling']}",
+                  file=sys.stderr, flush=True)
+
     def measure_ba():
         """Standing heavy-tail row (VERDICT r5 weak #4: BA existed only
         as a slow test): a Barabási-Albert graph through the FULL
@@ -1792,6 +1882,19 @@ def worker_main():
                 measure_fleet()
             except Exception as e:  # noqa: BLE001
                 print(f"# fleet failed: {e}", file=sys.stderr, flush=True)
+    if "pod" in apps:
+        # opt-in placement-tree weak-scaling rows (ISSUE 19): 1/2/4
+        # REAL worker processes, snapshot over the wire; CPU loopback
+        # by design like fleet (the pod layer is host coordination)
+        if layout_ab:
+            print("# pod rows skipped: layout A/B run", file=sys.stderr,
+                  flush=True)
+        else:
+            try:
+                measure_pod()
+            except Exception as e:  # noqa: BLE001
+                print(f"# pod rows failed: {e}", file=sys.stderr,
+                      flush=True)
     if "live" in apps:
         # the mutation-aware serving row (ISSUE 12): its own thread-mode
         # fleet on its own graph; same isolation rule as serve/fleet

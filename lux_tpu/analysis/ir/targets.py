@@ -699,6 +699,66 @@ def _collective_pull_dist() -> List[Finding]:
         "pull-dist/allgather")
 
 
+def _collective_halo_roundtrip() -> List[Finding]:
+    """ISSUE 19's LUX-J3 leg, minimal form: the placement tree's two
+    halo primitives back to back — halo_all_gather's tiled all_gather
+    and halo_reduce_scatter's tiled psum_scatter.  Both permutation-free
+    by construction (the schedule is the mesh axis itself), so the
+    checker must see the identical two-collective sequence in the one
+    shard_map body."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from lux_tpu.analysis.ir import aot
+    from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
+    from lux_tpu.parallel.placement import (
+        halo_all_gather,
+        halo_reduce_scatter,
+    )
+
+    mesh = _mesh(2)
+
+    def body(blk):  # blk: (k=1, V, F) per device
+        full = halo_all_gather(blk)          # (P*V, F)
+        partials = full.reshape((2,) + blk.shape[1:])
+        return halo_reduce_scatter(partials, 1)
+
+    roundtrip = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(PARTS_AXIS),),
+        out_specs=P(PARTS_AXIS)))
+    x = shard_stacked(mesh, jnp.zeros((2, 8, 4), jnp.float32))
+    traced = roundtrip.trace(x)
+    return check_shard_map_bodies(
+        aot.traced_jaxpr(traced), "lux_tpu/parallel/placement.py",
+        "placement/halo-roundtrip")
+
+
+def _collective_pull_scatter() -> List[Finding]:
+    """The scatter engine's exchange (ISSUE 19): per-destination
+    partials pre-summed on the source chip, then ONE halo_reduce_scatter
+    hands each chip its own destination block."""
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.analysis.ir import aot
+    from lux_tpu.parallel import scatter
+    from lux_tpu.parallel.mesh import shard_stacked
+
+    fx = fixture()
+    mesh = _mesh(2)
+    ssh = scatter.build_scatter_shards(fx["graph"], 2, pull=fx["shards"])
+    run = scatter._compile_scatter_fixed(fx["prank"], mesh, 2, 3, "scan")
+    sarrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, ssh.sarrays))
+    vtx_mask = shard_stacked(mesh, jnp.asarray(ssh.arrays.vtx_mask))
+    degree = shard_stacked(mesh, jnp.asarray(ssh.arrays.degree))
+    state0 = shard_stacked(mesh, fx["state0"])
+    traced = run.trace(sarrays, vtx_mask, degree, state0)
+    return check_shard_map_bodies(
+        aot.traced_jaxpr(traced), "lux_tpu/parallel/scatter.py",
+        "pull-scatter/psum-scatter")
+
+
 # ---------------------------------------------------------------------------
 # VMEM budget (LUX-J4) + HBM passes (LUX-J5)
 # ---------------------------------------------------------------------------
@@ -1136,6 +1196,12 @@ def audit_units(fast: bool = False) -> List[AuditUnit]:
                   _collective_push_dist_tree),
         AuditUnit("collective", "pull-dist/allgather",
                   "lux_tpu/parallel/dist.py", False, _collective_pull_dist),
+        AuditUnit("collective", "placement/halo-roundtrip",
+                  "lux_tpu/parallel/placement.py", False,
+                  _collective_halo_roundtrip),
+        AuditUnit("collective", "pull-scatter/psum-scatter",
+                  "lux_tpu/parallel/scatter.py", False,
+                  _collective_pull_scatter),
         AuditUnit("vmem", "expand-pf", "lux_tpu/ops/pallas_shuffle.py",
                   True, _vmem_expand_pf),
         AuditUnit("vmem", "fused-pf", "lux_tpu/ops/pallas_shuffle.py",

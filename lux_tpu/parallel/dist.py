@@ -29,7 +29,8 @@ from lux_tpu.engine.pull import (
     PullProgram, local_pull_step, pull_gather_part, pull_reduce_part,
 )
 from lux_tpu.graph.shards import ShardArrays, ShardSpec
-from lux_tpu.parallel.mesh import PARTS_AXIS, flatten_gather, shard_stacked
+from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
+from lux_tpu.parallel.placement import halo_all_gather
 
 
 def _arrays_specs():
@@ -69,7 +70,7 @@ def _compile_fixed(prog, mesh, num_iters: int, method: str,
         # the per-part step vmaps over the resident lanes — the mapper-
         # slicing analog (core/lux_mapper.cc:102-122)
         def body(_, block):
-            full = flatten_gather(block)
+            full = halo_all_gather(block)
             if routed:
                 return jax.vmap(
                     lambda arr, loc, ra: local_pull_step(
@@ -155,7 +156,7 @@ def _compile_phases_dist_cached(prog, mesh, method: str):
         out_specs=(Pp, Pp),
     )
     def load(arr_blk, state_blk):
-        full = flatten_gather(state_blk)  # the ICI exchange
+        full = halo_all_gather(state_blk)  # the ICI exchange
         return jax.vmap(
             lambda arr, loc: pull_gather_part(arr, full, loc)
         )(arr_blk, state_blk)
@@ -204,7 +205,7 @@ def _compile_until(prog, mesh, max_iters: int, active_fn, method: str):
 
         def body(carry):
             block, it, _ = carry
-            full = flatten_gather(block)
+            full = halo_all_gather(block)
             new = jax.vmap(
                 lambda arr, loc: local_pull_step(prog, arr, full, loc, method)
             )(arr_blk, block)

@@ -75,12 +75,13 @@ def shard_stacked(mesh: Mesh, tree):
 
 def flatten_gather(block):
     """all_gather a (k, V, ...) resident block over the parts axis and
-    flatten to the (P*V, ...) gathered-coordinate state.  Lives next to
-    shard_stacked because that placement IS the ordering invariant:
-    device d holds parts [d*k, (d+1)*k), and tiled=True concatenates in
-    device order, so the flattened axis is in global part order."""
-    full = jax.lax.all_gather(block, PARTS_AXIS, tiled=True)
-    return full.reshape((-1,) + full.shape[2:])
+    flatten to the (P*V, ...) gathered-coordinate state.  Thin alias of
+    ``placement.halo_all_gather`` — the canonical halo-exchange leg
+    (parallel/placement.py owns the ordering invariant and the LUX-J3
+    audit); kept here for the historical import path."""
+    from lux_tpu.parallel.placement import halo_all_gather
+
+    return halo_all_gather(block)
 
 
 def routed_run_args(mesh, route):

@@ -69,14 +69,13 @@ def global_parts_mesh():
 def local_part_range(num_parts: int) -> Sequence[int]:
     """The part indices this host owns under a one-part-per-device layout
     (the analog of the mapper's node-major slice placement,
-    lux_mapper.cc:112-121).  Balanced split: the first ``num_parts %
-    process_count`` hosts take one extra part, so every part has exactly
-    one owner regardless of divisibility."""
-    n_hosts, me = jax.process_count(), jax.process_index()
-    base, extra = divmod(num_parts, n_hosts)
-    lo = me * base + min(me, extra)
-    hi = lo + base + (1 if me < extra else 0)
-    return range(lo, hi)
+    lux_mapper.cc:112-121).  The split arithmetic lives in ONE place —
+    ``placement.PlacementTree.build`` (balanced: the first ``num_parts %
+    process_count`` hosts take one extra part) — so the dist engines and
+    the fleet agree on ownership by construction."""
+    from lux_tpu.parallel.placement import local_tree
+
+    return local_tree(num_parts).parts_of(jax.process_index())
 
 
 def assemble_global(mesh, stacked_local: np.ndarray, num_parts: int):

@@ -1,0 +1,258 @@
+"""PlacementTree — the one partition→(host, device, slice) map.
+
+The reference's machine model lives in core/lux_mapper.cc: LuxMapper
+discovers nodes and GPUs, then slices index launches node-major so part
+p lands on GPU ``p % gpus`` of node ``p / gpus_per_node``
+(lux_mapper.cc:102-140).  lux_tpu previously encoded that same layout
+three times — ``multihost.local_part_range`` (host split),
+``mesh.make_mesh_for_parts`` (device split), and the fleet's implicit
+"one worker = one whole graph" replica assumption.  This module is the
+single source of truth for all of them:
+
+* **dist engines** (``parallel/dist.py``, ``ring.py``, ``scatter.py``,
+  ``multihost.py``) take their parts_subset / mesh / halo-exchange legs
+  from the tree;
+* **fleet** (``serve/fleet/worker.py``, ``controller.py``, ``pod.py``)
+  exchanges the SAME tree over the wire in the hello handshake, so a
+  "replica" and a "mesh slice" are one object: a worker that owns
+  parts [lo, hi) of an N-part graph is routed exactly like a loopback
+  worker that owns all of it.
+
+The tree is deliberately small and wire-friendly: a contiguous
+part-range per host (the balanced split every layer already used),
+serialized as plain JSON lists.  jax is only imported inside the mesh /
+halo functions, so the fleet side (controller, wire tools) can hold and
+ship trees without pulling in an accelerator runtime — the same
+jax-free-leaf contract as ``fleet/wire.py`` (tools/_jaxfree.py).
+
+Halo exchange
+-------------
+The two collective legs every dist engine uses live here, named for
+what they move rather than which engine calls them:
+
+* ``halo_all_gather``     — resident (k, V, ...) block → full (P*V, ...)
+  gathered state (pull/push all_gather engines).  Donation-safe: the
+  gathered buffer is a fresh XLA temporary; the resident block can be
+  donated across iterations.
+* ``halo_reduce_scatter`` — per-destination (P, V, ...) partials →
+  this chip's summed (k, V, ...) block (scatter engine).  The reduction
+  happens inside the collective where XLA fuses it with the transfer.
+
+Both rely on the ``shard_stacked`` ordering invariant (device d holds
+parts [d*k, (d+1)*k)); ``tiled=True`` concatenates/splits in device
+order, so flattened axes are in global part order.  LUX-J3 audits both
+legs (analysis/ir/targets.py "placement/halo-*").
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: wire-schema version for PlacementTree.to_wire (bump on layout change)
+WIRE_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class HostSlice:
+    """One host's contiguous part range [lo, hi) of an N-part graph.
+
+    ``devices`` is the host's local device count (0 = unknown/any): the
+    fleet uses it for capacity accounting only; the dist engines size
+    their local mesh from the actual jax.local_devices() at run time.
+    """
+
+    host: int
+    lo: int
+    hi: int
+    devices: int = 0
+
+    def __post_init__(self):
+        if self.lo > self.hi or self.lo < 0:
+            raise ValueError(f"bad part range [{self.lo}, {self.hi})")
+
+    @property
+    def num_parts(self) -> int:
+        return self.hi - self.lo
+
+    @property
+    def parts(self) -> range:
+        return range(self.lo, self.hi)
+
+    def to_wire(self) -> Dict:
+        return {"host": self.host, "lo": self.lo, "hi": self.hi,
+                "devices": self.devices}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "HostSlice":
+        return cls(host=int(d["host"]), lo=int(d["lo"]), hi=int(d["hi"]),
+                   devices=int(d.get("devices", 0)))
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementTree:
+    """How ``num_parts`` graph partitions map onto hosts (and, within a
+    host, onto devices via ``local_mesh``).  Slices are contiguous,
+    ordered, and tile [0, num_parts) exactly — checked at construction
+    so a tree received over the wire cannot describe overlapping or
+    gapped ownership."""
+
+    num_parts: int
+    slices: Tuple[HostSlice, ...]
+
+    def __post_init__(self):
+        if self.num_parts < 1:
+            raise ValueError(f"num_parts must be >= 1, got {self.num_parts}")
+        if not self.slices:
+            raise ValueError("placement tree needs at least one host slice")
+        cursor = 0
+        for i, s in enumerate(self.slices):
+            if s.host != i:
+                raise ValueError(
+                    f"slice {i} carries host id {s.host}; hosts must be "
+                    "dense 0..H-1 in slice order")
+            if s.lo != cursor:
+                raise ValueError(
+                    f"host {i} starts at part {s.lo}, expected {cursor}: "
+                    "slices must tile [0, num_parts) contiguously")
+            cursor = s.hi
+        if cursor != self.num_parts:
+            raise ValueError(
+                f"slices cover [0, {cursor}) but num_parts={self.num_parts}")
+
+    # ---------------------------------------------------------- build
+    @classmethod
+    def build(cls, num_parts: int, num_hosts: int = 1,
+              devices_per_host: int = 0) -> "PlacementTree":
+        """Balanced node-major split: the first ``num_parts % num_hosts``
+        hosts take one extra part (the historical
+        ``multihost.local_part_range`` arithmetic, now defined once).
+        Hosts beyond ``num_parts`` get empty slices rather than erroring
+        so a fixed fleet can serve a small graph."""
+        if num_hosts < 1:
+            raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+        base, extra = divmod(num_parts, num_hosts)
+        slices = []
+        for h in range(num_hosts):
+            lo = h * base + min(h, extra)
+            hi = lo + base + (1 if h < extra else 0)
+            slices.append(HostSlice(host=h, lo=lo, hi=hi,
+                                    devices=devices_per_host))
+        return cls(num_parts=num_parts, slices=tuple(slices))
+
+    @classmethod
+    def single_host(cls, num_parts: int,
+                    devices: int = 0) -> "PlacementTree":
+        """The degenerate tree every existing single-host path implies."""
+        return cls.build(num_parts, 1, devices)
+
+    # ---------------------------------------------------------- lookup
+    @property
+    def num_hosts(self) -> int:
+        return len(self.slices)
+
+    def parts_of(self, host: int) -> range:
+        """Part indices host ``host`` owns."""
+        return self.slices[host].parts
+
+    def host_of(self, part: int) -> int:
+        """Which host owns ``part`` (binary search over slice bounds)."""
+        if not 0 <= part < self.num_parts:
+            raise IndexError(f"part {part} outside [0, {self.num_parts})")
+        lo, hi = 0, len(self.slices) - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if part >= self.slices[mid].hi:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def slice_of(self, host: int) -> HostSlice:
+        return self.slices[host]
+
+    # ------------------------------------------------------------ wire
+    def to_wire(self) -> Dict:
+        """JSON-safe dict for the fleet hello handshake / pod ops."""
+        return {
+            "version": WIRE_VERSION,
+            "num_parts": self.num_parts,
+            "slices": [s.to_wire() for s in self.slices],
+        }
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "PlacementTree":
+        v = int(d.get("version", 1))
+        if v > WIRE_VERSION:
+            raise ValueError(
+                f"placement tree wire version {v} > supported "
+                f"{WIRE_VERSION}")
+        return cls(
+            num_parts=int(d["num_parts"]),
+            slices=tuple(HostSlice.from_wire(s) for s in d["slices"]),
+        )
+
+    # ------------------------------------------------------------ mesh
+    def mesh(self, devices: Optional[Sequence] = None):
+        """Global 1-D parts mesh for this tree (all hosts' devices when
+        jax.distributed is live, or the local devices on a virtual
+        mesh).  Delegates to ``make_mesh_for_parts`` so k = P/D parts
+        stay resident per device when parts exceed devices."""
+        from lux_tpu.parallel.mesh import make_mesh_for_parts
+
+        return make_mesh_for_parts(self.num_parts, devices)
+
+    def local_mesh(self, host: int, devices: Optional[Sequence] = None):
+        """Mesh over ONE host's slice — what a pod worker runs its local
+        lanes on (parts [lo, hi) resident, k = slice/D per device)."""
+        n = self.slices[host].num_parts
+        if n == 0:
+            raise ValueError(f"host {host} owns no parts")
+        from lux_tpu.parallel.mesh import make_mesh_for_parts
+
+        return make_mesh_for_parts(n, devices)
+
+
+def local_tree(num_parts: int) -> PlacementTree:
+    """The tree for the CURRENT jax multi-process runtime (process-count
+    hosts; single-host tree when jax.distributed was never initialized).
+    """
+    import jax
+
+    return PlacementTree.build(
+        num_parts, jax.process_count(),
+        devices_per_host=jax.local_device_count())
+
+
+# ---------------------------------------------------------------- halo
+def halo_all_gather(block):
+    """all_gather a (k, V, ...) resident block over the parts axis and
+    flatten to the (P*V, ...) gathered-coordinate state.  Must run
+    inside a shard_map body on a parts mesh whose inputs were placed by
+    ``shard_stacked`` — that placement IS the ordering invariant:
+    device d holds parts [d*k, (d+1)*k), and tiled=True concatenates in
+    device order, so the flattened axis is in global part order."""
+    import jax
+
+    from lux_tpu.parallel.mesh import PARTS_AXIS
+
+    full = jax.lax.all_gather(block, PARTS_AXIS, tiled=True)
+    return full.reshape((-1,) + full.shape[2:])
+
+
+def halo_reduce_scatter(partials, k: int):
+    """Sum (P, V, ...) per-destination partials across chips and hand
+    this chip its own (k, V, ...) destination block.  Only SUM-reducible
+    programs qualify (XLA's fused reduce-scatter is addition) — callers
+    assert prog.reduce == "sum".  Same shard_stacked ordering contract
+    as ``halo_all_gather``: tiled psum_scatter over D devices hands
+    device d the contiguous [d*k*V, (d+1)*k*V) slice = its k resident
+    parts' summed destinations."""
+    import jax
+
+    from lux_tpu.parallel.mesh import PARTS_AXIS
+
+    P, V = partials.shape[0], partials.shape[1]
+    flat = partials.reshape((P * V,) + partials.shape[2:])
+    return jax.lax.psum_scatter(
+        flat, PARTS_AXIS, scatter_dimension=0, tiled=True
+    ).reshape((k, V) + partials.shape[2:])

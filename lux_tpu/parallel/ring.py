@@ -156,13 +156,20 @@ def mark_bucket_heads(hf_row: np.ndarray, dl: np.ndarray) -> None:
 
 def build_ring_shards(
     g: HostGraph, num_parts: int, parts_subset=None, pull=None,
-    counts=None,
+    counts=None, placement=None, host: int = 0,
 ) -> RingShards:
     """Bucket the graph for ring streaming.  ``parts_subset`` builds only
     those parts' (P, B) bucket rows (the sharded_load pattern: each host
     materializes O(its edges), not O(ne)).  Pass an existing ``pull``
     build to avoid repartitioning, and/or precomputed ``bucket_counts``
-    to avoid an extra O(ne) pass (tools/biggraph_check.py does both)."""
+    to avoid an extra O(ne) pass (tools/biggraph_check.py does both).
+    ``placement``/``host`` derive the subset from a PlacementTree slice
+    instead — the one ownership map shared with the fleet."""
+    if placement is not None:
+        assert parts_subset is None, "pass placement OR parts_subset"
+        assert placement.num_parts == num_parts, (
+            placement.num_parts, num_parts)
+        parts_subset = placement.parts_of(host)
     pull = pull if pull is not None else build_pull_shards(g, num_parts)
     spec, cuts = pull.spec, pull.cuts
     Pn, V = num_parts, spec.nv_pad

@@ -36,6 +36,7 @@ from lux_tpu.graph.csc import HostGraph
 from lux_tpu.graph.shards import LANE, PullShards, _round_up, build_pull_shards
 from lux_tpu.ops import segment
 from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
+from lux_tpu.parallel.placement import halo_reduce_scatter
 from lux_tpu.parallel.ring import _RingArrView
 
 
@@ -87,14 +88,20 @@ class ScatterShards:
 
 def build_scatter_shards(
     g: HostGraph, num_parts: int, parts_subset=None, pull=None,
-    counts=None,
+    counts=None, placement=None, host: int = 0,
 ) -> ScatterShards:
     """Transposed bucket build: axis 0 = SOURCE owner q (the chip that
     stores and computes the bucket), axis 1 = destination part p.
     ``parts_subset`` selects which chips' rows to materialize (per-host
     builds hold O(their edges), not O(ne)).  Pass an existing ``pull``
     build (e.g. sharded_load.load_pull_shards) to avoid repartitioning,
-    and/or precomputed ``bucket_counts`` to skip an extra O(ne) pass."""
+    and/or precomputed ``bucket_counts`` to skip an extra O(ne) pass.
+    ``placement``/``host`` derive the subset from a PlacementTree slice."""
+    if placement is not None:
+        assert parts_subset is None, "pass placement OR parts_subset"
+        assert placement.num_parts == num_parts, (
+            placement.num_parts, num_parts)
+        parts_subset = placement.parts_of(host)
     from lux_tpu.parallel.ring import (
         _owner_split,
         _slice_dst_local,
@@ -233,13 +240,9 @@ def _compile_scatter_fixed(prog, mesh, num_parts: int, num_iters: int,
             partials = jnp.stack(
                 [partial_for(p) for p in range(num_parts)]
             )  # (P, V, ...)
-            flat = partials.reshape((num_parts * V,) + partials.shape[2:])
-            # tiled psum_scatter over D devices hands device d the
-            # contiguous [d*k*V, (d+1)*k*V) slice = its k resident parts'
-            # summed destinations (shard_stacked ordering)
-            acc = jax.lax.psum_scatter(
-                flat, PARTS_AXIS, scatter_dimension=0, tiled=True
-            ).reshape((k, V) + partials.shape[2:])
+            # the placement tree's reduce-scatter halo leg: device d gets
+            # its k resident parts' summed destination blocks
+            acc = halo_reduce_scatter(partials, k)
             return jax.vmap(
                 lambda loc, a, vm, dg: prog.apply(
                     loc, a, _RingArrView(vtx_mask=vm, degree=dg)

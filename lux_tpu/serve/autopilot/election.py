@@ -280,6 +280,99 @@ class Standby:
         self.outcome = "adopted"
 
 
+class WireIncumbent:
+    """A process-mode incumbent as a Standby sees it (ISSUE 19): dials
+    the controller's ``serve_lease`` port and renews the lease with one
+    RPC per probe.  Satisfies the Standby's incumbent duck type
+    (``.ping()`` raising on death, ``.hb_interval_s``,
+    ``.hb_timeout_s``, ``.incarnation``) — so the SAME fenced election
+    that watches an in-process controller watches one across a process
+    boundary, and the only out-of-band fact a standby needs is the
+    lease address: the cadence and death threshold arrive IN the first
+    grant.
+
+    Death is SILENCE, in either of its wire shapes: a refused dial, a
+    dropped connection, or a reply that doesn't start within the death
+    threshold (``Conn.recv_wait``) all raise — which is exactly what
+    ``Standby._probe_once`` counts as a failed probe.  A renewal that
+    answers with a DIFFERENT incarnation also raises: that's a new
+    controller at the old address, and the election against the one we
+    were watching must still run (adoption handles the successor).
+    """
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout_s: float = 10.0):
+        self.host = str(host)
+        self.port = int(port)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._lock = threading.Lock()
+        self._conn = None
+        self._seq = 0
+        self.incarnation: Optional[str] = None
+        self.hb_interval_s = 0.25
+        self.hb_timeout_s = 3.0
+        grant = self.ping()  # first renewal: learn the lease terms
+        self.incarnation = str(grant["incarnation"])
+        self.hb_interval_s = float(grant.get("hb_interval_s",
+                                             self.hb_interval_s))
+        self.hb_timeout_s = float(grant.get("lease_s",
+                                            self.hb_timeout_s))
+
+    def _drop(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()
+
+    def ping(self) -> dict:
+        from lux_tpu.serve.fleet.wire import Conn
+
+        with self._lock:
+            conn = self._conn
+        if conn is None:
+            # dial OUTSIDE the lock (LUX-L003): a hung connect to a
+            # dead address must not wedge close() behind the probe
+            conn = Conn.connect(
+                self.host, self.port,
+                timeout_s=self.connect_timeout_s,
+                peer=f"incumbent@{self.host}:{self.port}",
+                owner="standby")
+        with self._lock:
+            if self._conn is None:
+                self._conn = conn
+            elif self._conn is not conn:
+                # lost a dial race to another probe; keep the installed
+                # connection (ONE renewal stream per incumbent)
+                conn.close()
+            try:
+                conn = self._conn
+                self._seq += 1
+                conn.send({"op": "lease",
+                           "req_id": f"l{self._seq}"})
+                # the probe's own deadline: a grant that doesn't START
+                # within the death threshold IS a missed renewal
+                msg, _ = conn.recv_wait(
+                    max(self.hb_timeout_s, self.connect_timeout_s))
+            except Exception:
+                self._drop()
+                raise
+            if not msg.get("ok"):
+                self._drop()
+                raise ConnectionError(
+                    f"lease refused: {msg.get('err', msg)}")
+            inc = str(msg.get("incarnation"))
+            if self.incarnation is not None and inc != self.incarnation:
+                self._drop()
+                raise ConnectionError(
+                    f"incumbent incarnation changed "
+                    f"({self.incarnation} -> {inc}): the controller we "
+                    "were watching is gone")
+            return msg
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
 def live_promoter(base, journal_dir: str, snapshot_path: Optional[str],
                   endpoints_fn: Callable[[], list], deadline_s: float = 30.0,
                   seed: int = 0, **kw) -> Callable:

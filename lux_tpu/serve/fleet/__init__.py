@@ -40,6 +40,22 @@ _EXPORTS = {
     "WorkerRefusedError": "lux_tpu.serve.fleet.controller",
     "HashRing": "lux_tpu.serve.fleet.hashring",
     "route_key": "lux_tpu.serve.fleet.hashring",
+    # ISSUE 19: wire-distributed snapshots, pods, process launching
+    "StreamSink": "lux_tpu.serve.fleet.stream",
+    "StreamTable": "lux_tpu.serve.fleet.stream",
+    "negotiate_chunk_bytes": "lux_tpu.serve.fleet.stream",
+    "stream_file": "lux_tpu.serve.fleet.stream",
+    "PodError": "lux_tpu.serve.fleet.pod",
+    "PodWorker": "lux_tpu.serve.fleet.pod",
+    "pod_connect": "lux_tpu.serve.fleet.pod",
+    "run_pull_pod": "lux_tpu.serve.fleet.pod",
+    "LaunchError": "lux_tpu.serve.fleet.launcher",
+    "ProcHandle": "lux_tpu.serve.fleet.launcher",
+    "launch": "lux_tpu.serve.fleet.launcher",
+    "launch_fleet_worker": "lux_tpu.serve.fleet.launcher",
+    "launch_pod_worker": "lux_tpu.serve.fleet.launcher",
+    "launch_script": "lux_tpu.serve.fleet.launcher",
+    "process_spawner": "lux_tpu.serve.fleet.launcher",
 }
 
 __all__ = sorted(_EXPORTS)

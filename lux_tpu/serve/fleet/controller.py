@@ -55,7 +55,13 @@ from lux_tpu.serve.fleet.hashring import (
     route_key,
 )
 from lux_tpu.serve.fleet.pubproto import publish_token
-from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
+from lux_tpu.serve.fleet.stream import negotiate_chunk_bytes, stream_file
+from lux_tpu.serve.fleet.wire import (
+    Conn,
+    ConnectionClosed,
+    WireError,
+    max_frame_bytes,
+)
 from lux_tpu.utils.backoff import Backoff, retry_call
 from lux_tpu.utils.config import env_float
 
@@ -368,6 +374,10 @@ class FleetController:
                               "elections": 0, "policy_switches": 0,
                               "sub_pushes": 0, "sub_coalesced": 0}
         self._sub_hub = None
+        #: lease listener (ISSUE 19): serve_lease() exposes ping() as a
+        #: wire RPC so process-mode standbys can watch this incumbent
+        self._lease_listener = None
+        self._lease_conns: List[Conn] = []
         #: this controller incarnation's publish-token prefix: a
         #: PROMOTED controller restarts _seq at 0, and its tokens must
         #: never collide with a dead predecessor's still staged on a
@@ -397,14 +407,103 @@ class FleetController:
         Standbys probe on a jittered cadence and declare death only
         after the probe has failed for longer than the fleet's own
         worker death threshold (the knobs compose; see
-        serve/autopilot/election.py)."""
+        serve/autopilot/election.py).
+
+        The reply IS a lease grant (ISSUE 19): it names this
+        incarnation and the heartbeat/death intervals the prober should
+        run at, so a WIRE standby (election.WireIncumbent over
+        ``serve_lease``) needs no out-of-band agreement on cadence —
+        the lease terms travel with every renewal."""
         with self._lock:
             if self._closed:
                 raise FleetError("controller closed")
             return {"incarnation": self._incarnation,
                     "workers_alive": sum(
                         1 for h in self._workers.values() if h.alive),
-                    "policy_mode": self._policy_mode}
+                    "policy_mode": self._policy_mode,
+                    "hb_interval_s": self.hb_interval_s,
+                    "lease_s": self.hb_timeout_s}
+
+    def serve_lease(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Expose ``ping()`` as a wire RPC so a standby in ANOTHER
+        process can run the fenced election (ISSUE 19): a tiny listener
+        answering ``{"op": "ping"|"lease"}`` frames with the lease
+        grant.  Dies with the controller — ``kill()``/``close()`` drop
+        the listener and every probe connection, which is exactly the
+        death signal a wire standby watches for.  Returns the bound
+        port (pass ``port=0`` for an ephemeral one)."""
+        import socket as _socket
+
+        with self._lock:
+            if self._closed:
+                raise FleetError("controller closed")
+            if self._lease_listener is not None:
+                return self._lease_listener.getsockname()[1]
+            srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            srv.bind((host, int(port)))
+            srv.listen(8)
+            self._lease_listener = srv
+        t = threading.Thread(target=self._lease_accept_loop, args=(srv,),
+                             name="lux-fleet-lease", daemon=True)
+        t.start()
+        return srv.getsockname()[1]
+
+    def _lease_accept_loop(self, srv) -> None:
+        while True:
+            try:
+                sock, _addr = srv.accept()
+            except OSError:
+                return  # listener closed: controller death
+            conn = Conn(sock, peer="standby", owner="controller-lease")
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    return
+                self._lease_conns.append(conn)
+            threading.Thread(
+                target=self._lease_conn_loop, args=(conn,),
+                name="lux-fleet-lease-conn", daemon=True).start()
+
+    def _lease_conn_loop(self, conn: Conn) -> None:
+        while True:
+            try:
+                msg, _arr = conn.recv()
+            except (ConnectionClosed, WireError):
+                break
+            op = msg.get("op")
+            if op not in ("ping", "lease"):
+                try:
+                    conn.send({"req_id": msg.get("req_id"), "ok": False,
+                               "err": f"lease port speaks ping/lease "
+                                      f"only, not {op!r}"})
+                except ConnectionClosed:
+                    break
+                continue
+            try:
+                pong = self.ping()
+            except FleetError:
+                # closed: drop the connection — silence IS the death
+                # signal; an "I'm dead" reply would be a live answer
+                break
+            try:
+                conn.send({"req_id": msg.get("req_id"), "ok": True,
+                           **pong})
+            except ConnectionClosed:
+                break
+        conn.close()
+
+    def _close_lease(self) -> None:
+        with self._lock:
+            srv, self._lease_listener = self._lease_listener, None
+            conns, self._lease_conns = self._lease_conns, []
+        if srv is not None:
+            try:
+                srv.close()
+            except OSError:
+                pass
+        for c in conns:
+            c.close()
 
     def add_worker(self, host: str, port: int,
                    timeout_s: float = 60.0,
@@ -451,6 +550,18 @@ class FleetController:
                                      str(p.reply.get("err")))
         info = p.reply
         wid = str(info["worker_id"])
+        w_bound = info.get("max_frame_bytes")
+        if w_bound is not None and int(w_bound) != max_frame_bytes():
+            # the other direction of the handshake guard: a worker
+            # framing to a different bound would drop OUR oversized
+            # frames mid-protocol instead of erroring
+            conn.close()
+            raise FleetError(
+                f"worker {wid} at {host}:{port} frames at most "
+                f"{int(w_bound)} payload bytes but this controller "
+                f"frames {max_frame_bytes()} — set "
+                "LUX_FLEET_MAX_FRAME_MB identically in both "
+                "environments")
         conn.label(peer=wid)
         with self._lock:
             if self._closed:
@@ -479,10 +590,12 @@ class FleetController:
         return wid
 
     def _hello_info(self) -> dict:
-        """Extra hello fields the worker validates us against (the live
-        controller sends its journal generation so the worker-side
-        split-brain guard can compare)."""
-        return {}
+        """Extra hello fields the worker validates us against: our
+        payload frame bound (the worker refuses a mismatch — a frame
+        one peer sends and the other refuses to receive is a dropped
+        connection, not an error reply) and, for the live subclass, the
+        journal generation the split-brain guard compares."""
+        return {"max_frame_bytes": max_frame_bytes()}
 
     def remove_worker(self, wid: str, shutdown: bool = True) -> None:
         """Graceful leave: take the worker off the ring (its keys move to
@@ -570,6 +683,7 @@ class FleetController:
             handles = list(self._workers.values())
         for h in handles:
             h.conn.close()
+        self._close_lease()
 
     def workers(self) -> Dict[str, dict]:
         with self._lock:
@@ -1184,8 +1298,42 @@ class FleetController:
         with dtrace.tspan("fleet.republish", rtc, always=True, graph=gid,
                           path=str(path), token=token,
                           workers=[h.wid for h in handles]):
-            prep_msg = {"op": "prepare", "path": str(path),
-                        "graph_id": gid, "token": token}
+            # wire distribution (ISSUE 19): the controller reads the
+            # snapshot bytes LOCALLY and streams them to each worker
+            # over the framed connection, so ``path`` only has to exist
+            # HERE — workers reassemble into their own private tmpdirs
+            # and no shared filesystem is assumed anywhere.  Chunk size
+            # is negotiated down to the smallest frame bound any worker
+            # advertised at hello (older workers advertise nothing and
+            # are assumed to match — the hello guard enforced it).
+            bounds = [h.info.get("max_frame_bytes") for h in handles]
+            bounds = [b for b in bounds if b is not None]
+            chunk = negotiate_chunk_bytes(
+                max_frame_bytes(), min(bounds) if bounds else None)
+            meta = None
+            for h in handles:
+                def _begin(m, _h=h):
+                    if rtc is not None:
+                        m = {**m, "tc": rtc.to_wire()}
+                    return self._rpc(_h, m, timeout_s=60.0)
+
+                try:
+                    meta = stream_file(h.conn, str(path), token, chunk,
+                                       rpc=_begin)
+                except (ConnectionClosed, _HandedOff):
+                    self._discard_staged(handles)
+                    raise FleetError(
+                        f"worker {h.wid} died mid snapshot stream; "
+                        "republish aborted (old graph still serving)"
+                    ) from None
+                except (FleetError, OSError) as e:
+                    self._discard_staged(handles)
+                    raise FleetError(
+                        f"snapshot stream to worker {h.wid} failed: {e};"
+                        " republish aborted (old graph still serving)"
+                    ) from None
+            prep_msg = {"op": "prepare", "graph_id": gid, "token": token,
+                        "stream": True, "sha256": meta["sha256"]}
             if base_generation is not None:
                 prep_msg["base_generation"] = int(base_generation)
             pendings = []
@@ -1540,5 +1688,6 @@ class FleetController:
                 except FleetError:
                     pass
             h.conn.close()
+        self._close_lease()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)

@@ -1,0 +1,210 @@
+"""Process launcher for fleet and pod workers (ISSUE 19).
+
+The autoscaler's ``spawn`` hook has always taken "start me a worker"
+as a callable, and every harness so far passed a closure that built a
+ReplicaWorker IN-PROCESS — threads as processes.  This module is the
+real thing: ``python -m lux_tpu.serve.fleet.worker`` (or ``.pod``)
+subprocesses with PRIVATE tmpdirs, found by parsing the one READY JSON
+line each entrypoint prints.  Nothing is shared between processes but
+the loopback sockets — which is exactly the claim the pod_smoke ci
+stage pins.
+
+``process_spawner`` adapts this to the Autoscaler contract
+(``spawn(index) -> object with .worker_id/.port``, optional
+``reap(worker)``), so a scale-up decision can fork real OS processes.
+
+Jax-free: stdlib only (the subprocesses import jax, the launcher does
+not), so controllers and tests can import it under the bare-package
+stub (tools/_jaxfree.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+class LaunchError(RuntimeError):
+    pass
+
+
+class ProcHandle:
+    """One launched worker process: identity, address, and teardown."""
+
+    def __init__(self, proc: subprocess.Popen, worker_id: str,
+                 port: int, pid: int, tmpdir: Optional[str],
+                 ready: dict):
+        self.proc = proc
+        self.worker_id = str(worker_id)
+        self.port = int(port)
+        self.pid = int(pid)
+        self.tmpdir = tmpdir
+        self.ready = ready  # the full READY line (delta_generation etc)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL: the fault-drill shape — no flush, no goodbye."""
+        if self.alive():
+            self.proc.kill()
+        self.proc.wait(timeout=30.0)
+        self._cleanup()
+
+    def terminate(self, timeout_s: float = 30.0) -> int:
+        """SIGTERM + wait (the entrypoints translate it to a clean
+        stop); escalates to SIGKILL past the deadline."""
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=30.0)
+        rc = self.proc.returncode
+        self._cleanup()
+        return rc
+
+    def _cleanup(self) -> None:
+        tmp, self.tmpdir = self.tmpdir, None
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _read_ready_line(proc: subprocess.Popen,
+                     timeout_s: float) -> dict:
+    """Block for the entrypoint's single READY JSON line (a thread owns
+    the blocking readline so a hung child can't hang the launcher past
+    its deadline)."""
+    out: Dict[str, object] = {}
+    lines: List[str] = []
+
+    def reader():
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                return
+            line = line.strip()
+            if not line:
+                continue
+            lines.append(line)
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue  # jax/XLA chatter on stdout — skip it
+            if msg.get("ready"):
+                out["ready"] = msg
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if "ready" not in out:
+        fate = ("still running" if proc.poll() is None
+                else f"exited {proc.returncode}")
+        proc.kill()
+        raise LaunchError(
+            f"worker process never printed a READY line within "
+            f"{timeout_s:g}s ({fate}); last output: {lines[-3:]}")
+    return out["ready"]  # type: ignore[return-value]
+
+
+def launch(module: str, args: Sequence[str],
+           private_tmp: bool = True,
+           env: Optional[dict] = None,
+           ready_timeout_s: float = 180.0) -> ProcHandle:
+    """Start ``python -m <module> <args>`` and wait for its READY line.
+
+    ``private_tmp``: give the child its OWN TMPDIR (deleted at
+    teardown) — the no-shared-filesystem guarantee is enforced by
+    construction, not convention.  The child inherits the parent env
+    (plus JAX_PLATFORMS=cpu unless already set: pods are CPU
+    process-mode by default) with ``env`` overrides applied last.
+    """
+    return _launch_argv(["-m", module, *args], private_tmp, env,
+                        ready_timeout_s)
+
+
+def launch_script(path: str, args: Sequence[str] = (),
+                  private_tmp: bool = True,
+                  env: Optional[dict] = None,
+                  ready_timeout_s: float = 180.0) -> ProcHandle:
+    """Like :func:`launch` for a standalone script file that speaks the
+    READY-line protocol (tests write small incumbent/worker harness
+    scripts and run them as real processes)."""
+    return _launch_argv([str(path), *args], private_tmp, env,
+                        ready_timeout_s)
+
+
+def _launch_argv(argv: Sequence[str], private_tmp: bool,
+                 env: Optional[dict],
+                 ready_timeout_s: float) -> ProcHandle:
+    child_env = dict(os.environ)
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    tmpdir = None
+    if private_tmp:
+        tmpdir = tempfile.mkdtemp(prefix="lux-launch-")
+        child_env["TMPDIR"] = tmpdir
+        child_env["TMP"] = tmpdir
+    if env:
+        child_env.update({k: str(v) for k, v in env.items()})
+    proc = subprocess.Popen(
+        [sys.executable, *argv],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=child_env,
+        start_new_session=True)  # SIGINT to the parent never strays
+    try:
+        ready = _read_ready_line(proc, ready_timeout_s)
+    except LaunchError:
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+        raise
+    return ProcHandle(proc, str(ready["worker_id"]),
+                      int(ready["port"]), int(ready["pid"]),
+                      tmpdir, ready)
+
+
+def launch_pod_worker(worker_id: str, host: str = "127.0.0.1",
+                      port: int = 0, **kw) -> ProcHandle:
+    """One pod member process (serve/fleet/pod.py main)."""
+    return launch("lux_tpu.serve.fleet.pod",
+                  ["--worker-id", str(worker_id), "--host", host,
+                   "--port", str(int(port))], **kw)
+
+
+def launch_fleet_worker(worker_id: str, extra_args: Sequence[str] = (),
+                        host: str = "127.0.0.1", port: int = 0,
+                        **kw) -> ProcHandle:
+    """One ReplicaWorker process (serve/fleet/worker.py main) — the
+    full serving stack, for process-mode fleets and failover drills."""
+    return launch("lux_tpu.serve.fleet.worker",
+                  ["--worker-id", str(worker_id), "--host", host,
+                   "--port", str(int(port)), *extra_args], **kw)
+
+
+def process_spawner(prefix: str = "pw",
+                    extra_args: Sequence[str] = (),
+                    pod: bool = False,
+                    ready_timeout_s: float = 180.0):
+    """(spawn, reap) pair matching the Autoscaler contract: ``spawn(i)``
+    forks a real worker process and returns its handle (exposing
+    ``.worker_id`` and ``.port`` — the scaler then add_worker()s it);
+    ``reap(handle)`` SIGTERMs and reclaims the private tmpdir."""
+
+    def spawn(index: int) -> ProcHandle:
+        wid = f"{prefix}{int(index)}"
+        if pod:
+            return launch_pod_worker(wid,
+                                     ready_timeout_s=ready_timeout_s)
+        return launch_fleet_worker(wid, extra_args=extra_args,
+                                   ready_timeout_s=ready_timeout_s)
+
+    def reap(handle: ProcHandle) -> None:
+        handle.terminate()
+
+    return spawn, reap

@@ -390,6 +390,20 @@ class Conn:
                     f"corrupt npy payload from peer {self.peer!r}: {e}"
                 ) from None
 
+    def recv_wait(self, timeout_s: float) -> Tuple[dict, Optional[np.ndarray]]:
+        """``recv()`` with a BOUNDED wait for the frame to START.
+
+        Plain ``recv`` idles forever between frames (a quiet peer is
+        normal for the data plane), but a lease probe (election.py's
+        WireIncumbent) must treat silence itself as the signal: an
+        incumbent that stops answering within the lease interval is
+        dead.  Raises :class:`WireTimeout` when no frame begins within
+        ``timeout_s``; once bytes flow, the normal in-flight deadline
+        applies."""
+        _wait_io(self._sock, "recv", time.monotonic() + timeout_s,
+                 self.peer, _HDR.size, timeout_s)
+        return self.recv()
+
     def close(self) -> None:
         self._closed = True
         try:

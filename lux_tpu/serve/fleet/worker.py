@@ -53,7 +53,14 @@ from lux_tpu.serve.fleet.pubproto import (
     ERR_PREPARE_SUPERSEDED,
     token_mismatch,
 )
-from lux_tpu.serve.fleet.wire import Conn, ConnectionClosed, WireError
+from lux_tpu.serve.fleet.stream import StreamTable
+from lux_tpu.serve.fleet.wire import (
+    Conn,
+    ConnectionClosed,
+    WireError,
+    max_frame_bytes,
+)
+from lux_tpu.parallel.placement import PlacementTree
 from lux_tpu.serve.metrics import ServeMetrics
 from lux_tpu.serve.scheduler import (
     MicroBatchScheduler,
@@ -77,7 +84,8 @@ class ReplicaWorker:
                  method: str = "auto", num_iters: int = 10,
                  max_iters: int = 10_000, max_wait_ms: float = 2.0,
                  max_queue: int = 256, max_engines: Optional[int] = None,
-                 live=None):
+                 live=None, placement: Optional[PlacementTree] = None,
+                 placement_host: int = 0):
         self.worker_id = str(worker_id)
         self.host = host
         self._req_port = int(port)
@@ -90,6 +98,19 @@ class ReplicaWorker:
         self._max_queue = int(max_queue)
         self._max_engines = max_engines
         self._num_parts = shards.spec.num_parts
+        #: replica == mesh slice (ISSUE 19): every worker carries its
+        #: coordinates in the ONE placement tree the dist engines use.
+        #: A loopback replica owning the whole graph is just the
+        #: single-host tree — the controller routes both identically.
+        self.placement = (placement if placement is not None
+                          else PlacementTree.single_host(self._num_parts))
+        self.placement_host = int(placement_host)
+        #: wire-streamed snapshot reassembly (fleet/stream.py): token ->
+        #: sink, spooled into this worker's PRIVATE tmpdir (no shared
+        #: filesystem with the controller); _stream_lock serializes the
+        #: conn-reader begin/chunk feed against the prepare thread's pop
+        self._streams = StreamTable(prefix=f"lux-w-{worker_id}-")
+        self._stream_lock = threading.Lock()
         self.metrics = ServeMetrics()
         self._lock = threading.Lock()
         self._graph_id = str(graph_id)
@@ -192,6 +213,8 @@ class ReplicaWorker:
         for t in self._threads:
             t.join(timeout=5.0)
         self._threads = []
+        with self._stream_lock:
+            self._streams.clear()
 
     def kill(self) -> None:
         """Fault drill: vanish abruptly — close every socket WITHOUT
@@ -207,6 +230,8 @@ class ReplicaWorker:
         self._close_sockets()
         for sched in self._scheds.values():
             sched.stop(drain=False)
+        with self._stream_lock:
+            self._streams.clear()
 
     def kill_at(self, point: str, count: int = 1,
                 after: int = 0) -> None:
@@ -323,6 +348,24 @@ class ReplicaWorker:
             with dtrace.tspan("worker.hello", dtrace.child_of(msg),
                               always=True,
                               worker=self.worker_id) as hsp:
+                ctl_bound = msg.get("max_frame_bytes")
+                mine = max_frame_bytes()
+                if ctl_bound is not None and int(ctl_bound) != mine:
+                    # frame-bound mismatch (ISSUE 19): a frame one peer
+                    # can send and the other refuses to receive is a
+                    # DROPPED CONNECTION mid-protocol, not an error
+                    # reply — so mismatched bounds must fail here, at
+                    # the handshake, naming the knob on both sides
+                    hsp.set(refused="frame_bound_mismatch")
+                    self._reply_err(
+                        conn, msg, "frame_bound_mismatch",
+                        err=(f"worker {self.worker_id} frames at most "
+                             f"{mine} payload bytes but the controller "
+                             f"advertises {int(ctl_bound)} — set "
+                             "LUX_FLEET_MAX_FRAME_MB identically in "
+                             "both environments"),
+                        max_frame_bytes=mine)
+                    return
                 ctl_gen = msg.get("journal_generation")
                 if (self._live is not None and ctl_gen is not None
                         and self._live.generation() > int(ctl_gen)):
@@ -366,6 +409,20 @@ class ReplicaWorker:
         elif op == "prom":
             conn.send({"req_id": rid, "ok": True,
                        "text": self.prom_text()})
+        elif op == "stream_begin":
+            # wire-streamed snapshot (fleet/stream.py): open a sink in
+            # the private spool dir; cheap enough for the reader thread
+            with self._stream_lock:
+                self._streams.begin(str(msg.get("token")),
+                                    int(msg.get("nbytes", 0)),
+                                    int(msg.get("chunks", 0)))
+            conn.send({"req_id": rid, "ok": True})
+        elif op == "stream_chunk":
+            # casts: no reply, errors latch in the sink and surface at
+            # the final consumer op (prepare {stream: true})
+            with self._stream_lock:
+                self._streams.chunk(str(msg.get("token")),
+                                    int(msg.get("seq", -1)), arr)
         elif op == "prepare":
             # daemon + untracked, like the conn threads: one per
             # republish, replies through the conn's send lock
@@ -382,6 +439,10 @@ class ReplicaWorker:
                 had = self._staged is not None
                 self._staged = None
                 self._publish_token = None
+            with self._stream_lock:
+                # half-streamed snapshots of the aborted republish must
+                # not sit spooled on disk forever either
+                self._streams.clear()
             conn.send({"req_id": rid, "ok": True, "discarded": had})
         elif op == "shutdown":
             conn.send({"req_id": rid, "ok": True})
@@ -409,6 +470,9 @@ class ReplicaWorker:
             "apps": list(self.apps),
             "buckets": list(self.q_buckets),
             "max_queue": self._max_queue,
+            "max_frame_bytes": max_frame_bytes(),
+            "placement": self.placement.to_wire(),
+            "placement_host": self.placement_host,
         }
         if live is not None:
             out["live"] = True
@@ -766,9 +830,31 @@ class ReplicaWorker:
 
     def _op_prepare(self, conn: Conn, msg: dict) -> None:
         rid = msg.get("req_id")
-        path = msg.get("path")
-        gid = msg.get("graph_id") or str(path)
         token = str(msg.get("token") or rid)
+        spooled = None
+        if msg.get("stream"):
+            # wire-distributed snapshot: resolve the token's reassembled
+            # local copy (streamed into OUR tmpdir — no path the
+            # controller and this worker both see is ever required)
+            with self._stream_lock:
+                sink = self._streams.pop(token)
+            if sink is None:
+                self._reply_err(
+                    conn, msg, "error",
+                    err=f"no snapshot stream staged for token {token!r}"
+                        " (stream_begin/stream_chunk must precede a "
+                        "stream prepare)")
+                return
+            try:
+                spooled = sink.finalize(str(msg.get("sha256")))
+            except ValueError as e:
+                sink.abort()
+                self._reply_err(conn, msg, "error", err=str(e))
+                return
+            path = spooled
+        else:
+            path = msg.get("path")
+        gid = msg.get("graph_id") or str(msg.get("path") or path)
         base_gen = msg.get("base_generation")
         if self._live is not None and base_gen is None:
             # a live worker republished WITHOUT an epoch base would keep
@@ -810,6 +896,13 @@ class ReplicaWorker:
                         tolerance=self._live.tolerance)
                 cache = self._make_cache(shards, live=live2)
                 cache.prewarm()  # old cache serves throughout this
+                if spooled is not None:
+                    import os as _os
+
+                    try:  # spool is consumed; mmap'd views (POSIX)
+                        _os.unlink(spooled)  # survive the unlink
+                    except OSError:
+                        pass
             with self._lock:
                 if self._publish_token != token:
                     # a discard (abort) or a newer prepare happened
@@ -831,6 +924,13 @@ class ReplicaWorker:
             raise
         except Exception as e:  # noqa: BLE001 — a failed prepare is an
             # answer (controller aborts the republish), not a dead worker
+            if spooled is not None:
+                import os as _os
+
+                try:
+                    _os.unlink(spooled)
+                except OSError:
+                    pass
             with self._lock:
                 if self._publish_token == token:
                     self._publish_token = None

@@ -93,7 +93,7 @@ class LiveFleetController(FleetController):
         journal is BEHIND the worker's own (a wiped/wrong-dir
         controller must not re-sequence generations the fleet already
         acked)."""
-        return {"live": True,
+        return {**super()._hello_info(), "live": True,
                 "journal_generation": self.journal.generation()}
 
     # ------------------------------------------------------------------

@@ -432,7 +432,10 @@ def test_republish_mixed_prepare_failure_discards_staged(
 
         def crooked_send(handle, msg, pending):
             if msg.get("op") == "prepare" and handle.wid == "w1":
-                msg = {**msg, "path": str(tmp_path / "nope.lux")}
+                # snapshots stream over the wire now: corrupt the
+                # announced digest so w1's reassembly verification (and
+                # therefore its prepare) fails while w0's succeeds
+                msg = {**msg, "sha256": "0" * 64}
             return real_send(handle, msg, pending)
 
         monkeypatch.setattr(ctl, "_send", crooked_send)
@@ -767,3 +770,128 @@ def test_proc_mode_fleet_end_to_end(small, tmp_path):
     finally:
         fleet.close()
     assert fleet.procs[0].wait(timeout=30) is not None
+
+
+# ----------------------------------------------------------------------
+# ISSUE 19 satellites: frame-bound handshake, wire snapshot streaming,
+# the lease RPC
+# ----------------------------------------------------------------------
+
+
+def test_worker_refuses_controller_frame_bound_mismatch(
+        small, monkeypatch):
+    """One direction of the handshake guard: a controller advertising a
+    DIFFERENT payload bound is refused by the worker at hello, loudly,
+    naming the knob — not dropped mid-protocol on the first big frame."""
+    from lux_tpu.serve.fleet.controller import WorkerRefusedError
+
+    g, shards = small
+    w = ReplicaWorker(shards, "wf", graph_id="g").start()
+    try:
+        monkeypatch.setattr(FleetController, "_hello_info",
+                            lambda self: {"max_frame_bytes": 1 << 20})
+        ctl = FleetController(hb_interval_s=0.1)
+        try:
+            with pytest.raises(WorkerRefusedError,
+                               match="LUX_FLEET_MAX_FRAME_MB"):
+                ctl.add_worker("127.0.0.1", w.port)
+        finally:
+            ctl.close()
+    finally:
+        w.stop()
+
+
+def test_controller_refuses_worker_frame_bound_mismatch(
+        small, monkeypatch):
+    """The other direction: a worker advertising a different bound is
+    refused by add_worker (the controller mutes its own advertisement so
+    the worker-side guard doesn't fire first)."""
+    from lux_tpu.serve.fleet import worker as worker_mod
+
+    g, shards = small
+    monkeypatch.setattr(worker_mod, "max_frame_bytes",
+                        lambda: 1 << 20)
+    monkeypatch.setattr(FleetController, "_hello_info", lambda self: {})
+    w = ReplicaWorker(shards, "wf", graph_id="g").start()
+    try:
+        ctl = FleetController(hb_interval_s=0.1)
+        try:
+            with pytest.raises(FleetError,
+                               match="LUX_FLEET_MAX_FRAME_MB"):
+                ctl.add_worker("127.0.0.1", w.port)
+        finally:
+            ctl.close()
+    finally:
+        w.stop()
+
+
+def test_republish_streams_snapshot_no_shared_path(
+        small, tmp_path, monkeypatch):
+    """The no-shared-filesystem pin: prepare frames carry stream
+    metadata (token + sha256), NEVER a path — the snapshot bytes travel
+    as stream_begin/stream_chunk frames and each worker stages from its
+    own private spool dir."""
+    g, shards = small
+    snap = str(tmp_path / "snap.lux")
+    write_lux(snap, g)
+    ctl, workers = _mk_fleet(shards, 2, graph_id="snap.lux")
+    try:
+        seen = []
+        real_send = ctl._send
+
+        def spy(handle, msg, pending):
+            seen.append(msg)
+            return real_send(handle, msg, pending)
+
+        monkeypatch.setattr(ctl, "_send", spy)
+        rep = ctl.republish(snap, graph_id="snap.lux")
+        assert rep["generations"] == {"w0": 1, "w1": 1}
+        preps = [m for m in seen if m.get("op") == "prepare"]
+        assert len(preps) == 2
+        for m in preps:
+            assert "path" not in m, m
+            assert m["stream"] is True
+            assert len(m["sha256"]) == 64
+        begins = [m for m in seen if m.get("op") == "stream_begin"]
+        assert len(begins) == 2 and all(m["chunks"] >= 1
+                                        for m in begins)
+        # each worker reassembled under its OWN spool dir, disjoint
+        spools = {w.worker_id: w._streams.dirpath for w in workers}
+        assert len(set(spools.values())) == 2
+        for w in workers:
+            hb = w.heartbeat()
+            assert hb["generation"] == 1 and not hb["staged"]
+        f = ctl.submit(3)
+        assert np.array_equal(f.result(timeout=60), bfs_reference(g, 3))
+    finally:
+        _teardown(ctl, workers)
+
+
+def test_serve_lease_rpc_and_wire_incumbent(small):
+    """ping() IS a lease grant: a WireIncumbent dialing serve_lease()
+    learns the incarnation and heartbeat terms from the first renewal,
+    renews over the wire, and sees controller death as a raised probe
+    (the dropped/silent lease port) — the Standby duck type across a
+    process boundary."""
+    from lux_tpu.serve.autopilot.election import WireIncumbent
+
+    g, shards = small
+    ctl, workers = _mk_fleet(shards, 1)
+    inc = None
+    try:
+        port = ctl.serve_lease()
+        assert ctl.serve_lease() == port  # idempotent
+        inc = WireIncumbent("127.0.0.1", port)
+        assert inc.incarnation == ctl.incarnation
+        assert inc.hb_interval_s == pytest.approx(ctl.hb_interval_s)
+        assert inc.hb_timeout_s == pytest.approx(ctl.hb_timeout_s)
+        grant = inc.ping()
+        assert grant["workers_alive"] == 1
+        ctl.kill()  # fault drill: the lease port goes dark
+        with pytest.raises(Exception):
+            inc.ping()
+            inc.ping()  # first probe may see the close as a reply EOF
+    finally:
+        if inc is not None:
+            inc.close()
+        _teardown(ctl, workers)

@@ -1,0 +1,251 @@
+"""CPU virtual-mesh twins of the version-guarded test_multihost trio.
+
+jax 0.4.x XLA:CPU cannot run multi-process collectives, so the three
+real two-OS-process tests in test_multihost.py skip on this pin (see
+its version guard).  These twins run the SAME engine programs — the
+same graphs, parts, iteration counts, and oracles as tests/mh_worker.py
+— on the suite's single-process 8-device virtual mesh, with the host
+split simulated through the PlacementTree a real launch uses: per-host
+partial file loads and subset bucket builds (``placement=tree,
+host=h``), stitched in part order, driven through the same
+dist/ring/scatter/feat/push entry points.  A twin cannot exercise a
+real process boundary; what it DOES pin is every piece of host-local
+arithmetic the multihost path composes (the tree split, the partial
+loads, the subset builds, the per-host carry init), so when the jax pin
+moves past 0.5 the guarded tests come back to host-split logic that
+never rotted.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine import pull
+from lux_tpu.graph import generate, sharded_load
+from lux_tpu.graph.format import write_lux
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models.pagerank import PageRankProgram, pagerank_reference
+from lux_tpu.parallel import dist, ring
+from lux_tpu.parallel import scatter as scatter_mod
+from lux_tpu.parallel.mesh import make_mesh_for_parts, shard_stacked
+from lux_tpu.parallel.placement import PlacementTree
+from lux_tpu.parallel.ring import bucket_counts
+
+P = 8       # parts = virtual devices, like the 2 x 4-device real pair
+HOSTS = 2   # the simulated host count
+
+
+def _check_parts(out, cuts, want, assert_fn):
+    """Validate a (P, V)-stacked result part by part against the global
+    oracle (the single-process analog of mh_worker.check_local)."""
+    got = np.asarray(out)
+    for p in range(got.shape[0]):
+        lo, hi = int(cuts[p]), int(cuts[p + 1])
+        assert_fn(got[p][: hi - lo], want[lo:hi])
+
+
+def _stitch(parts_arrays, cls):
+    """Concatenate per-host stacked arrays in host (= part) order into
+    the full (P, ...) layout — the np twin of multihost.assemble_global.
+    """
+    return cls(*(
+        np.concatenate([np.asarray(getattr(a, n)) for a in parts_arrays])
+        for n in cls._fields))
+
+
+def test_twin_pull_sharded_load_dist_ring_scatter(tmp_path):
+    """Twin of test_two_process_distributed_pagerank: per-host partial
+    .lux loads + tree-placed subset bucket builds, then the dist
+    (all_gather), ring (ppermute) and scatter (psum_scatter) engines on
+    the stitched arrays, each vs the pagerank reference."""
+    g = generate.rmat(9, 8, seed=55)
+    shards = build_pull_shards(g, P)
+    tree = PlacementTree.build(P, HOSTS)
+    mesh = make_mesh_for_parts(P)
+    prog = PageRankProgram(nv=shards.spec.nv)
+    close = lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-5)  # noqa: E731
+    want = pagerank_reference(g, 5)
+
+    lux_path = str(tmp_path / "mh.lux")
+    write_lux(lux_path, g)
+    # per-host PARTIAL file load: host h reads only its parts' byte
+    # ranges, and the streamed subset equals the in-memory build's rows
+    locals_ = [
+        sharded_load.load_pull_shards(
+            lux_path, P, parts_subset=list(tree.parts_of(h)))
+        for h in range(HOSTS)
+    ]
+    for h, loc in enumerate(locals_):
+        mine = list(tree.parts_of(h))
+        for name in loc.arrays._fields:
+            np.testing.assert_array_equal(
+                getattr(loc.arrays, name),
+                getattr(shards.arrays, name)[mine], err_msg=name)
+    arrays_np = _stitch([loc.arrays for loc in locals_],
+                        type(shards.arrays))
+    # per-host state init on the loaded subset, stitched in part order
+    state0 = np.concatenate([
+        np.asarray(pull.init_state(prog, loc.arrays))
+        for loc in locals_
+    ])
+    np.testing.assert_array_equal(
+        state0, np.asarray(pull.init_state(prog, shards.arrays)))
+
+    arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, arrays_np))
+    st0 = shard_stacked(mesh, jnp.asarray(state0))
+    out = dist.run_pull_fixed_dist(prog, shards.spec, arrays, st0, 5,
+                                   mesh)
+    _check_parts(out, shards.cuts, want, close)
+
+    # ring + scatter bucket exchanges from PER-HOST placement-derived
+    # subset builds (each host materializes only its rows), stitched
+    counts = bucket_counts(g, shards.cuts, P)
+
+    def stitched(build, field, cls):
+        per_host = [build(g, P, pull=shards, counts=counts,
+                          placement=tree, host=h) for h in range(HOSTS)]
+        assert len({hb.e_bucket_pad for hb in per_host}) == 1
+        arrs = _stitch([getattr(hb, field) for hb in per_host],
+                       type(getattr(per_host[0], field)))
+        return cls(pull=shards, e_bucket_pad=per_host[0].e_bucket_pad,
+                   parts_subset=list(range(P)), **{field: arrs})
+
+    full_ring = stitched(ring.build_ring_shards, "rarrays",
+                         ring.RingShards)
+    r_out = ring.run_pull_fixed_ring(prog, full_ring, st0, 5, mesh)
+    _check_parts(r_out, shards.cuts, want, close)
+
+    full_scatter = stitched(scatter_mod.build_scatter_shards, "sarrays",
+                            scatter_mod.ScatterShards)
+    s_out = scatter_mod.run_pull_fixed_scatter(prog, full_scatter, st0,
+                                               5, mesh)
+    _check_parts(s_out, shards.cuts, want, close)
+
+
+def test_twin_feat_cf_two_meshes_and_ring():
+    """Twin of test_two_process_feat_cf: the 2-D (parts x feat) CF
+    engine on the default and interleaved mesh layouts, plus ring-feat
+    with tree-placed subset bucket builds."""
+    from jax.sharding import Mesh
+
+    from lux_tpu.models import colfilter as cf_model
+    from lux_tpu.parallel import feat
+    from lux_tpu.parallel.mesh import FEAT_AXIS, PARTS_AXIS
+
+    gw = generate.bipartite_ratings(96, 64, 800, seed=5)
+    fsh = build_pull_shards(gw, 4)
+    fmesh = feat.make_mesh_feat(4, 2)
+    cfp = cf_model.CFProgram(gamma=1e-3)
+    want = cf_model.colfilter_reference(gw, 3, gamma=1e-3)
+
+    def check_feat(out):
+        got = np.asarray(out)
+        for p in range(got.shape[0]):
+            lo, hi = int(fsh.cuts[p]), int(fsh.cuts[p + 1])
+            np.testing.assert_allclose(got[p][: hi - lo], want[lo:hi],
+                                       rtol=5e-4, atol=1e-6)
+
+    s0 = feat.init_state_feat(cfp, fsh.arrays, fmesh)
+    check_feat(feat.run_cf_feat_dist(cfp, fsh.spec, fsh.arrays, s0, 3,
+                                     fmesh))
+    # interleaved mesh: each feat column pairs device i with device
+    # i+4 — the layout that puts the cross-feat psum on DCN for real
+    devs = np.asarray(jax.devices())
+    imesh = Mesh(np.stack([devs[:4], devs[4:]], axis=1),
+                 (PARTS_AXIS, FEAT_AXIS))
+    i_s0 = feat.init_state_feat(cfp, fsh.arrays, imesh)
+    check_feat(feat.run_cf_feat_dist(cfp, fsh.spec, fsh.arrays, i_s0, 3,
+                                     imesh))
+    # ring x feat from per-host placement-derived subset builds
+    tree = PlacementTree.build(4, HOSTS)
+    per_host = [ring.build_ring_shards(gw, 4, pull=fsh, placement=tree,
+                                       host=h) for h in range(HOSTS)]
+    assert len({hb.e_bucket_pad for hb in per_host}) == 1
+    frs = ring.RingShards(
+        pull=fsh, e_bucket_pad=per_host[0].e_bucket_pad,
+        parts_subset=list(range(4)),
+        rarrays=_stitch([hb.rarrays for hb in per_host],
+                        type(per_host[0].rarrays)))
+    check_feat(feat.run_cf_feat_ring(cfp, frs, s0, 3, fmesh))
+
+
+def test_twin_push_dist_phase_split_delta():
+    """Twin of test_two_process_distributed_push: push to convergence
+    from a STITCHED per-host carry init, the 3-phase fenced split, and
+    distributed delta-stepping vs the single-device bucket run."""
+    from lux_tpu.engine import delta as delta_mod
+    from lux_tpu.engine import push
+    from lux_tpu.graph.push_shards import build_push_shards
+    from lux_tpu.models.sssp import (
+        SSSPProgram,
+        WeightedSSSPProgram,
+        bfs_reference,
+    )
+
+    g = generate.rmat(9, 8, seed=55)
+    mesh = make_mesh_for_parts(P)
+    tree = PlacementTree.build(P, HOSTS)
+    psh = build_push_shards(g, P)
+    sp = SSSPProgram(nv=psh.spec.nv, start=0)
+    want = bfs_reference(g, 0)
+
+    # per-host carry init on each host's tree slice, stitched in part
+    # order: must equal the full init bitwise (the assemble_carry
+    # contract a real multihost launch relies on)
+    full_carry = push._init_carry(
+        sp, psh.pspec, jax.tree.map(jnp.asarray, psh.arrays))
+    host_carries = [
+        push._init_carry(sp, psh.pspec, jax.tree.map(
+            lambda a, _m=list(tree.parts_of(h)): jnp.asarray(a[_m]),
+            push.vertex_view(psh.arrays)))
+        for h in range(HOSTS)
+    ]
+    # the sharded/replicated field split assemble_carry keeps in one
+    # place: per-part arrays concatenate, scalar fields (it, active,
+    # edges, dense_rounds) are process-identical by construction
+    sharded = {"state", "q_vid", "q_val", "count", "sp_work"}
+    stitched = push.PushCarry(*(
+        np.concatenate([np.asarray(getattr(c, f)) for c in host_carries])
+        if f in sharded else np.asarray(getattr(host_carries[0], f))
+        for f in push.PushCarry._fields))
+    for f in push.PushCarry._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(stitched, f)),
+            np.asarray(getattr(full_carry, f)), err_msg=f)
+
+    arrays = shard_stacked(mesh, jax.tree.map(jnp.asarray, psh.arrays))
+    parrays = shard_stacked(mesh,
+                            jax.tree.map(jnp.asarray, psh.parrays))
+    run = push._compile_push_dist(sp, mesh, psh.pspec, psh.spec, "scan")
+    out = run(arrays, parrays,
+              push.shard_carry(mesh, jax.tree.map(jnp.asarray,
+                                                  stitched)),
+              jnp.int32(1000))
+    _check_parts(out.state, psh.cuts, want,
+                 np.testing.assert_array_equal)
+
+    # the 3-phase fenced split converges to the same fixpoint
+    pl, pc, pu = push.compile_push_phases_dist(sp, mesh, psh.pspec,
+                                               psh.spec, "scan")
+    carry2 = push.shard_carry(
+        mesh, push._init_carry(sp, psh.pspec,
+                               jax.tree.map(jnp.asarray, psh.arrays)))
+    it = 0
+    while int(carry2.active) > 0 and it < 64:
+        plan = pl(parrays, carry2)
+        carry2 = pu(arrays, carry2, pc(arrays, parrays, carry2, plan),
+                    plan)
+        it += 1
+    _check_parts(carry2.state, psh.cuts, want,
+                 np.testing.assert_array_equal)
+
+    # distributed delta-stepping vs the single-device bucket run
+    gd = generate.rmat(9, 8, seed=57, weighted=True, max_weight=15)
+    dsh = build_push_shards(gd, P)
+    dp = WeightedSSSPProgram(nv=dsh.spec.nv, start=1)
+    d_state, _it, d_edges = delta_mod.run_push_delta_dist(
+        dp, dsh, 4, mesh, method="scan")
+    st_s, _, e_s = delta_mod.run_push_delta(dp, dsh, 4, method="scan")
+    _check_parts(d_state, dsh.cuts,
+                 dsh.scatter_to_global(np.asarray(st_s)),
+                 np.testing.assert_array_equal)
+    assert push.edges_total(d_edges) == push.edges_total(e_s)

@@ -1,0 +1,319 @@
+"""PlacementTree + snapshot streaming units (ISSUE 19).
+
+The tree is the ONE partition->host map shared by the dist engines and
+the fleet wire layer; these tests pin (a) the balanced split against the
+historical ``multihost.local_part_range`` arithmetic for every small
+(parts x hosts) shape, (b) wire roundtrip + construction validation so a
+tree received over TCP cannot describe gapped/overlapping ownership,
+(c) the two halo collective legs against plain numpy on the virtual
+8-device mesh, and (d) the stream.py reassembly contract (ordering,
+overflow, digest — errors latch, never a silent half-file).
+"""
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lux_tpu.parallel.placement import (
+    HostSlice,
+    PlacementTree,
+    halo_all_gather,
+    halo_reduce_scatter,
+    local_tree,
+)
+from lux_tpu.serve.fleet.stream import (
+    FRAME_SLACK,
+    MIN_CHUNK,
+    StreamSink,
+    StreamTable,
+    file_chunks,
+    negotiate_chunk_bytes,
+    stream_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- tree
+
+
+def _legacy_local_part_range(num_parts, num_hosts, h):
+    """The arithmetic multihost.local_part_range always used — now
+    defined once in PlacementTree.build; this copy is the oracle."""
+    base, extra = divmod(num_parts, num_hosts)
+    lo = h * base + min(h, extra)
+    hi = lo + base + (1 if h < extra else 0)
+    return lo, hi
+
+
+def test_build_matches_historical_split_exhaustive():
+    for P in range(1, 33):
+        for H in range(1, 9):
+            tree = PlacementTree.build(P, H)
+            assert tree.num_hosts == H and tree.num_parts == P
+            covered = []
+            for h in range(H):
+                lo, hi = _legacy_local_part_range(P, H, h)
+                s = tree.slice_of(h)
+                assert (s.lo, s.hi) == (lo, hi), (P, H, h)
+                assert list(tree.parts_of(h)) == list(range(lo, hi))
+                covered.extend(tree.parts_of(h))
+            assert covered == list(range(P)), (P, H)
+            for p in range(P):
+                h = tree.host_of(p)
+                assert p in tree.parts_of(h), (P, H, p, h)
+
+
+def test_build_small_graph_on_big_fleet_leaves_empty_slices():
+    tree = PlacementTree.build(2, 5)
+    assert [s.num_parts for s in tree.slices] == [1, 1, 0, 0, 0]
+    assert tree.host_of(1) == 1
+
+
+def test_single_host_and_local_tree():
+    tree = PlacementTree.single_host(8, devices=8)
+    assert tree.num_hosts == 1
+    assert tree.parts_of(0) == range(0, 8)
+    # no jax.distributed in the suite: the runtime tree IS single-host
+    lt = local_tree(8)
+    assert lt.num_hosts == jax.process_count() == 1
+    assert lt.slices[0].devices == jax.local_device_count()
+
+
+def test_wire_roundtrip_through_json():
+    tree = PlacementTree.build(13, 4, devices_per_host=8)
+    wired = json.loads(json.dumps(tree.to_wire()))
+    assert PlacementTree.from_wire(wired) == tree
+    wired["version"] = 99
+    with pytest.raises(ValueError, match="wire version"):
+        PlacementTree.from_wire(wired)
+
+
+def test_construction_rejects_bad_trees():
+    with pytest.raises(ValueError, match="bad part range"):
+        HostSlice(host=0, lo=3, hi=1)
+    with pytest.raises(ValueError, match="num_parts"):
+        PlacementTree.build(0, 1)
+    with pytest.raises(ValueError, match="num_hosts"):
+        PlacementTree.build(4, 0)
+    with pytest.raises(ValueError, match="at least one host"):
+        PlacementTree(num_parts=4, slices=())
+    # gap: [0,2) then [3,4)
+    with pytest.raises(ValueError, match="contiguously"):
+        PlacementTree(num_parts=4, slices=(
+            HostSlice(0, 0, 2), HostSlice(1, 3, 4)))
+    # overlap: [0,2) then [1,4)
+    with pytest.raises(ValueError, match="contiguously"):
+        PlacementTree(num_parts=4, slices=(
+            HostSlice(0, 0, 2), HostSlice(1, 1, 4)))
+    # under-coverage
+    with pytest.raises(ValueError, match="num_parts=4"):
+        PlacementTree(num_parts=4, slices=(HostSlice(0, 0, 3),))
+    # non-dense host ids
+    with pytest.raises(ValueError, match="dense"):
+        PlacementTree(num_parts=4, slices=(
+            HostSlice(1, 0, 4),))
+    with pytest.raises(IndexError):
+        PlacementTree.build(4, 2).host_of(4)
+
+
+def test_placement_and_stream_are_jax_free():
+    """The fleet side holds and ships trees without an accelerator
+    runtime: placement/stream/launcher import under the bare-package
+    stub with a jax import tripwire armed."""
+    code = (
+        "import builtins, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "real = builtins.__import__\n"
+        "def guard(name, *a, **k):\n"
+        "    assert not name.startswith('jax'), name\n"
+        "    return real(name, *a, **k)\n"
+        "builtins.__import__ = guard\n"
+        "import _jaxfree\n"
+        "pl = _jaxfree.load('lux_tpu.parallel.placement')\n"
+        "st = _jaxfree.load('lux_tpu.serve.fleet.stream')\n"
+        "_jaxfree.load('lux_tpu.serve.fleet.launcher')\n"
+        "t = pl.PlacementTree.build(13, 4)\n"
+        "assert pl.PlacementTree.from_wire(t.to_wire()) == t\n"
+        "assert st.negotiate_chunk_bytes(2**24, None) > 0\n"
+        "print('JAXFREE-OK')\n" % os.path.join(REPO, "tools")
+    )
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "JAXFREE-OK" in proc.stdout
+
+
+# ---------------------------------------------------------------- halo
+
+
+def _parts_mesh(n):
+    from lux_tpu.parallel.mesh import make_mesh_for_parts
+
+    return make_mesh_for_parts(n)
+
+
+@pytest.mark.parametrize("P", [8, 16])  # k = 1 and k = 2 per device
+def test_halo_all_gather_flattens_in_global_part_order(P):
+    from jax.sharding import PartitionSpec as Ps
+
+    from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
+
+    mesh = _parts_mesh(P)
+    V, F = 4, 3
+    x = jnp.arange(P * V * F, dtype=jnp.float32).reshape(P, V, F)
+
+    run = jax.jit(jax.shard_map(
+        halo_all_gather, mesh=mesh,
+        in_specs=(Ps(PARTS_AXIS),), out_specs=Ps()))
+    out = np.asarray(run(shard_stacked(mesh, x)))
+    np.testing.assert_array_equal(out, np.asarray(x).reshape(P * V, F))
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_halo_reduce_scatter_sums_per_destination(k):
+    """Each device contributes a full (P, V) partials matrix; device d
+    must come back with the summed columns of ITS k resident parts —
+    i.e. the global result is x.sum(over contributors) in part order."""
+    from jax.sharding import PartitionSpec as Ps
+
+    from lux_tpu.parallel.mesh import PARTS_AXIS, shard_stacked
+
+    D = 8
+    P, V = D * k, 4
+    mesh = _parts_mesh(P)
+    rng = np.random.default_rng(7)
+    # axis 0 = contributing device (sharded), then that device's (P, V)
+    x = jnp.asarray(rng.integers(0, 100, (D, P, V)).astype(np.float32))
+
+    run = jax.jit(jax.shard_map(
+        lambda blk: halo_reduce_scatter(blk[0], k),
+        mesh=mesh, in_specs=(Ps(PARTS_AXIS),),
+        out_specs=Ps(PARTS_AXIS)))
+    out = np.asarray(run(shard_stacked(mesh, x)))
+    np.testing.assert_array_equal(out, np.asarray(x).sum(axis=0))
+
+
+# -------------------------------------------------------------- stream
+
+
+def test_negotiate_chunk_bytes():
+    mb = 1024 * 1024
+    assert negotiate_chunk_bytes(64 * mb, None) == 64 * mb - FRAME_SLACK
+    assert negotiate_chunk_bytes(64 * mb, 8 * mb) == 8 * mb - FRAME_SLACK
+    assert negotiate_chunk_bytes(8 * mb, 64 * mb) == 8 * mb - FRAME_SLACK
+    # a pathological bound cannot degrade below the chunk floor
+    assert negotiate_chunk_bytes(1024, 512) == MIN_CHUNK
+
+
+def _spool(tmp_path, nbytes, seed=0):
+    data = np.random.default_rng(seed).integers(
+        0, 256, nbytes).astype(np.uint8).tobytes()
+    path = tmp_path / "snap.lux"
+    path.write_bytes(data)
+    return str(path), data
+
+
+def test_file_chunks_and_sink_roundtrip(tmp_path):
+    path, data = _spool(tmp_path, 700 * 1024)
+    chunk = 256 * 1024
+    nbytes, nchunks, it = file_chunks(path, chunk)
+    assert nbytes == len(data) and nchunks == 3
+    sink = StreamSink("t0", str(tmp_path), nbytes, nchunks)
+    for seq, arr in enumerate(it):
+        sink.add(seq, arr)
+    out = sink.finalize(hashlib.sha256(data).hexdigest())
+    assert open(out, "rb").read() == data
+
+
+def test_sink_errors_latch_and_surface_at_finalize(tmp_path):
+    path, data = _spool(tmp_path, 300 * 1024, seed=1)
+    sha = hashlib.sha256(data).hexdigest()
+    chunks = list(file_chunks(path, 128 * 1024)[2])
+
+    # reordered frames
+    sink = StreamSink("t1", str(tmp_path), len(data), len(chunks))
+    sink.add(1, chunks[1])
+    assert "out of order" in sink.error
+    sink.add(0, chunks[0])  # latched: later good frames don't unlatch
+    with pytest.raises(ValueError, match="out of order"):
+        sink.finalize(sha)
+    sink.abort()
+
+    # overflow past the announced byte count
+    sink = StreamSink("t2", str(tmp_path), 10, len(chunks))
+    sink.add(0, chunks[0])
+    with pytest.raises(ValueError, match="overflow"):
+        sink.finalize(sha)
+    sink.abort()
+
+    # digest mismatch on an otherwise perfect stream
+    sink = StreamSink("t3", str(tmp_path), len(data), len(chunks))
+    for seq, arr in enumerate(chunks):
+        sink.add(seq, arr)
+    with pytest.raises(ValueError, match="digest mismatch"):
+        sink.finalize("0" * 64)
+
+    # truncated stream (a chunk never arrived)
+    sink = StreamSink("t4", str(tmp_path), len(data), len(chunks))
+    sink.add(0, chunks[0])
+    with pytest.raises(ValueError, match="incomplete"):
+        sink.finalize(sha)
+    sink.abort()
+
+    # non-uint8 payload
+    sink = StreamSink("t5", str(tmp_path), len(data), len(chunks))
+    sink.add(0, np.zeros(4, np.float32))
+    assert "no uint8 payload" in sink.error
+    sink.abort()
+
+
+def test_stream_table_supersede_and_unknown_token():
+    tbl = StreamTable(prefix="lux-test-stream-")
+    try:
+        first = tbl.begin("tok", 8, 1)
+        second = tbl.begin("tok", 8, 1)  # restart supersedes
+        # the superseded sink was aborted (closed); the restarted stream
+        # owns the token's spool file from byte 0
+        assert first._f.closed
+        tbl.chunk("nope", 0, np.zeros(4, np.uint8))  # dropped, no raise
+        tbl.chunk("tok", 0, np.arange(8, dtype=np.uint8))
+        assert tbl.pop("tok") is second and second.received == 8
+        assert tbl.pop("tok") is None
+    finally:
+        tbl.clear()
+    assert tbl._dir is None
+
+
+def test_stream_file_end_to_end(tmp_path):
+    """Sender (stream_file) against a receiver StreamTable wired through
+    a fake conn — the exact op sequence the pod/fleet receivers run."""
+    path, data = _spool(tmp_path, 600 * 1024, seed=2)
+    tbl = StreamTable(prefix="lux-test-stream-")
+
+    class FakeConn:
+        def send(self, msg, arr=None):
+            assert msg["op"] == "stream_chunk"
+            tbl.chunk(msg["token"], msg["seq"], arr)
+
+    def rpc(msg):
+        assert msg["op"] == "stream_begin"
+        tbl.begin(msg["token"], msg["nbytes"], msg["chunks"])
+        return {"ok": True}
+
+    try:
+        meta = stream_file(FakeConn(), path, "tok", 256 * 1024, rpc=rpc)
+        assert meta["nbytes"] == len(data) and meta["chunks"] == 3
+        assert meta["sha256"] == hashlib.sha256(data).hexdigest()
+        sink = tbl.pop("tok")
+        out = sink.finalize(meta["sha256"])
+        assert open(out, "rb").read() == data
+    finally:
+        tbl.clear()

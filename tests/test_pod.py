@@ -1,0 +1,313 @@
+"""luxpod: fleet workers that ARE mesh slices (ISSUE 19 tentpole).
+
+A pod is N worker processes holding ONE PlacementTree-sharded graph;
+the snapshot reaches each worker as a wire byte stream (no shared
+filesystem), each worker partial-loads only its own parts, and per
+round every worker runs the pull engine's exact per-part step.  The
+acceptance bar these tests pin: pod answers are BITWISE equal to the
+single-host engine for every tested (parts x hosts) shape — including
+the uneven H=3 split of P=8 and under live mutation overlays.
+
+The in-process tests (PodWorker threads over loopback) are tier-1; the
+real-subprocess tests — private-tmpdir isolation and the process-mode
+lease failover drill — are ``slow`` (they fork python+jax processes)
+and also run in the ci_check ``pod_smoke`` stage.
+"""
+import os
+import tempfile
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine import pull
+from lux_tpu.graph import generate
+from lux_tpu.graph.format import write_lux
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models.sssp import SSSPProgram
+from lux_tpu.parallel.placement import PlacementTree
+from lux_tpu.program.spec import active_changed
+from lux_tpu.serve.fleet.pod import (
+    PodError,
+    PodWorker,
+    _rpc,
+    run_pull_pod,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+P = 8  # parts — H=3 exercises the uneven 3/3/2 slice split
+
+
+@pytest.fixture(scope="module")
+def pod_graph(tmp_path_factory):
+    """Graph + snapshot + single-host sssp oracle, built once: start at
+    the hub vertex so convergence takes several rounds (a fixed start 0
+    can be isolated on an RMAT draw and converge instantly)."""
+    g = generate.rmat(10, 8, seed=3)
+    snap = str(tmp_path_factory.mktemp("pod") / "g.lux")
+    write_lux(snap, g)
+    shards = build_pull_shards(g, P)
+    start = int(np.argmax(g.out_degrees()))
+    prog = SSSPProgram(nv=shards.spec.nv, start=start)
+    s0 = pull.init_state(prog, shards.arrays)
+    oracle, iters = pull.run_pull_until(
+        prog, shards.spec, shards.arrays, s0, 10_000, active_changed,
+        method="auto")
+    return {"g": g, "snap": snap, "shards": shards, "start": start,
+            "oracle": np.asarray(oracle), "iters": int(iters)}
+
+
+def _pod(n):
+    return [PodWorker(f"p{i}").start() for i in range(n)]
+
+
+@pytest.mark.parametrize("hosts", [1, 2, 3])
+def test_pod_bitwise_matches_single_host(pod_graph, hosts):
+    ws = _pod(hosts)
+    res = run_pull_pod([(w.host, w.port) for w in ws],
+                       pod_graph["snap"], P, app="sssp",
+                       start=pod_graph["start"])
+    assert res["iters"] == pod_graph["iters"]
+    np.testing.assert_array_equal(res["state"], pod_graph["oracle"])
+    # every worker owns exactly its tree slice, tiling [0, P)
+    tree = PlacementTree.build(P, hosts)
+    spans = sorted((w["lo"], w["hi"]) for w in res["workers"].values())
+    assert spans == [(s.lo, s.hi) for s in tree.slices]
+    # the standard phase attribution is present and sane
+    assert set(res["phases"]) == {"plan", "exchange", "converge"}
+    assert all(v >= 0.0 for v in res["phases"].values())
+
+
+def test_pod_overlay_bitwise(pod_graph):
+    """Live-mutation overlays ride the wire: rows sliced per worker by
+    the same tree, answers bitwise vs the single-host overlay run."""
+    from lux_tpu.mutate import overlay as ovl
+    from lux_tpu.mutate.graph import DeltaLog
+
+    g, shards = pod_graph["g"], pod_graph["shards"]
+    rng = np.random.default_rng(0)
+    dlog = DeltaLog(g)
+    dele = rng.choice(g.ne, 25, replace=False)
+    dlog.apply(g.col_idx[dele], g.dst_of_edges()[dele],
+               np.zeros(25, np.int8))
+    dlog.apply(rng.integers(0, g.nv, 25), rng.integers(0, g.nv, 25),
+               np.ones(25, np.int8))
+    ostatic = ovl.OverlayStatic(cap=ovl.delta_cap(256),
+                                weighted=shards.spec.weighted)
+    _, oarr = ovl.build_pull_overlay(shards, dlog, cap=256)
+
+    prog = SSSPProgram(nv=shards.spec.nv, start=pod_graph["start"])
+    s0 = pull.init_state(prog, shards.arrays)
+    oracle, iters = pull.run_pull_until(
+        prog, shards.spec, shards.arrays, s0, 10_000, active_changed,
+        overlay=(ostatic, oarr))
+
+    ws = _pod(2)
+    res = run_pull_pod([(w.host, w.port) for w in ws],
+                       pod_graph["snap"], P, app="sssp",
+                       start=pod_graph["start"],
+                       overlay=(ostatic, oarr))
+    assert res["iters"] == int(iters)
+    np.testing.assert_array_equal(res["state"], np.asarray(oracle))
+
+
+def test_pod_pagerank_fixed_iters(pod_graph):
+    """Non-quiescent app: pagerank runs exactly num_iters rounds and is
+    bitwise equal to the single-host fixed driver."""
+    from lux_tpu.models.pagerank import PageRankProgram
+
+    shards = pod_graph["shards"]
+    prog = PageRankProgram(nv=shards.spec.nv)
+    s0 = pull.init_state(prog, shards.arrays)
+    oracle = pull.run_pull_fixed(prog, shards.spec, shards.arrays, s0, 3)
+
+    ws = _pod(2)
+    res = run_pull_pod([(w.host, w.port) for w in ws],
+                       pod_graph["snap"], P, app="pagerank",
+                       num_iters=3)
+    assert res["iters"] == 3
+    np.testing.assert_array_equal(res["state"], np.asarray(oracle))
+
+
+def test_pod_rejects_corrupt_stream_then_recovers(pod_graph):
+    """A digest mismatch can never be staged: pod_build errors loudly,
+    and a re-stream on the SAME connection (token supersede) succeeds."""
+    from lux_tpu.serve.fleet.stream import stream_file
+    from lux_tpu.serve.fleet.wire import Conn
+
+    w = PodWorker("px").start()
+    try:
+        conn = Conn.connect(w.host, w.port, timeout_s=10.0,
+                            peer="pod", owner="test")
+        try:
+            def rpc(m):
+                return _rpc(conn, m)[0]
+
+            meta = stream_file(conn, pod_graph["snap"], "t", 256 * 1024,
+                               rpc=rpc)
+            build = {"op": "pod_build", "token": "t",
+                     "num_parts": P,
+                     "placement": PlacementTree.build(P, 1).to_wire(),
+                     "host": 0, "app": "sssp",
+                     "start": pod_graph["start"]}
+            with pytest.raises(PodError, match="digest mismatch"):
+                _rpc(conn, {**build, "sha256": "0" * 64})
+            # the sink is consumed either way — a second build without
+            # a fresh stream must say so, not stage garbage
+            with pytest.raises(PodError, match="no snapshot stream"):
+                _rpc(conn, {**build, "sha256": meta["sha256"]})
+            meta = stream_file(conn, pod_graph["snap"], "t", 256 * 1024,
+                               rpc=rpc)
+            reply, state0 = _rpc(conn, {**build,
+                                        "sha256": meta["sha256"]})
+            assert (reply["lo"], reply["hi"]) == (0, P)
+            assert state0.shape[0] == P
+        finally:
+            conn.close()
+    finally:
+        w.stop()
+
+
+def test_pod_tree_shape_mismatches_error(pod_graph):
+    ws = _pod(2)
+    try:
+        with pytest.raises(PodError, match="names 1 hosts"):
+            run_pull_pod([(w.host, w.port) for w in ws],
+                         pod_graph["snap"], P,
+                         tree=PlacementTree.build(P, 1), shutdown=False)
+    finally:
+        for w in ws:
+            w.stop()
+    # a tree that disagrees with the graph's cut count is refused by
+    # the WORKER (the tree travels on the wire; the check is remote)
+    ws = _pod(1)
+    with pytest.raises(PodError, match="covers 4 parts"):
+        run_pull_pod([(w.host, w.port) for w in ws],
+                     pod_graph["snap"], P,
+                     tree=PlacementTree.build(4, 1))
+
+
+# ----------------------------------------------------------------------
+# real processes (slow tier + ci_check pod_smoke stage)
+# ----------------------------------------------------------------------
+
+
+def _child_env():
+    from conftest import forced_cpu_env
+
+    return forced_cpu_env()
+
+
+@pytest.mark.slow
+def test_pod_subprocess_private_tmpdirs(pod_graph):
+    """2 real worker processes, DISJOINT private tmpdirs (the launcher
+    enforces no-shared-filesystem by construction), snapshot over the
+    wire, answers bitwise — and each spool lived under its own tmpdir."""
+    from lux_tpu.serve.fleet.launcher import launch_pod_worker
+
+    hs = [launch_pod_worker(f"pp{i}", env=_child_env())
+          for i in range(2)]
+    try:
+        tmps = [h.tmpdir for h in hs]
+        assert len(set(tmps)) == 2 and all(tmps)
+        res = run_pull_pod([("127.0.0.1", h.port) for h in hs],
+                           pod_graph["snap"], P, app="sssp",
+                           start=pod_graph["start"])
+        assert res["iters"] == pod_graph["iters"]
+        np.testing.assert_array_equal(res["state"],
+                                      pod_graph["oracle"])
+        # the driver's shutdown op makes each worker self-exit cleanly
+        for h in hs:
+            assert h.proc.wait(timeout=30.0) == 0
+    finally:
+        for h in hs:
+            h.terminate()
+    # teardown reclaimed both private tmpdirs
+    assert not any(os.path.exists(t) for t in tmps)
+
+
+@pytest.mark.slow
+def test_process_mode_lease_failover():
+    """The ISSUE 19 failover drill, all real processes: a fleet worker
+    and an incumbent controller each in their own process; a standby in
+    THIS process renews the lease over the wire; SIGKILL the incumbent
+    — silence on the lease port IS the death signal — and the standby
+    wins the fenced election and SERVES through the surviving worker."""
+    from lux_tpu.serve.autopilot.election import (
+        Standby,
+        StandbyGroup,
+        WireIncumbent,
+    )
+    from lux_tpu.serve.fleet.launcher import (
+        launch_fleet_worker,
+        launch_script,
+    )
+
+    env = _child_env()
+    w = launch_fleet_worker(
+        "fw0", extra_args=["--rmat", "9,8", "--parts", "2"], env=env)
+    ctl_proc = sb = inc = ctl2 = None
+    try:
+        script = os.path.join(tempfile.mkdtemp(prefix="lux-failover-"),
+                              "incumbent.py")
+        with open(script, "w") as f:
+            f.write(textwrap.dedent(f"""
+                import json, os, time
+                os.environ.setdefault("JAX_PLATFORMS", "cpu")
+                from lux_tpu.serve.fleet.controller import FleetController
+                ctl = FleetController(hb_interval_s=0.05,
+                                      hb_timeout_s=0.5)
+                ctl.add_worker("127.0.0.1", {w.port})
+                lease = ctl.serve_lease()
+                print(json.dumps({{"ready": True, "worker_id": "ctl0",
+                                   "port": lease, "pid": os.getpid(),
+                                   "incarnation": ctl.incarnation}}),
+                      flush=True)
+                while True:
+                    time.sleep(0.2)
+            """))
+        ctl_proc = launch_script(script, env=env)
+
+        inc = WireIncumbent("127.0.0.1", ctl_proc.port)
+        assert inc.incarnation == ctl_proc.ready["incarnation"]
+        # the lease grant carried the incumbent's heartbeat terms
+        assert inc.hb_interval_s == pytest.approx(0.05)
+        assert inc.hb_timeout_s == pytest.approx(0.5)
+
+        group = StandbyGroup()
+
+        def _promote(tc=None):
+            from lux_tpu.serve.fleet.controller import FleetController
+
+            c2 = FleetController(hb_interval_s=0.05, hb_timeout_s=1.0)
+            wid = c2.add_worker("127.0.0.1", w.port)
+            return c2, {"joined": [wid]}
+
+        sb = Standby(group, 0, inc, _promote, hb_interval_s=0.05,
+                     death_after_s=0.4, seed=0).start()
+        deadline = time.monotonic() + 10.0
+        while sb.probes_ok == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sb.probes_ok > 0, "standby never renewed the lease"
+
+        ctl_proc.kill()  # SIGKILL: no goodbye, the port just goes dark
+        got = group.wait_promoted(timeout_s=60.0)
+        assert got is not None, "standby never promoted"
+        ctl2, rep = got
+        assert sb.outcome == "won"
+        assert rep["joined"] == ["fw0"]
+        assert ctl2.incarnation != inc.incarnation
+
+        out = ctl2.submit(0, app="sssp").result(timeout=120.0)
+        assert isinstance(out, np.ndarray) and out.size > 0
+    finally:
+        if sb is not None:
+            sb.stop()
+        if inc is not None:
+            inc.close()
+        if ctl2 is not None:
+            ctl2.close(shutdown_workers=False)
+        if ctl_proc is not None and ctl_proc.alive():
+            ctl_proc.kill()
+        w.terminate()

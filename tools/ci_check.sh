@@ -472,6 +472,58 @@ echo "$out" | grep -q "\[PASS\] autopilot smoke" || { echo "autopilot smoke fail
 echo "$out"
 '
 
+# 3d4) pod smoke (ISSUE 19): a 2-PROCESS pod over loopback TCP — each
+#      worker in its own OS process with a PRIVATE launcher tmpdir (no
+#      shared filesystem by construction), the snapshot streamed to
+#      both over the bounded-frame wire, each worker loading only its
+#      PlacementTree slice, and the sharded sssp answer BITWISE equal
+#      to the single-host run — the placement-tree distribution path
+#      end to end, [PASS]-gated
+stage pod_smoke 600 bash -c '
+set -e
+out=$(JAX_PLATFORMS=cpu python -c "
+import numpy as np, os, tempfile
+from lux_tpu.engine import pull
+from lux_tpu.graph import generate
+from lux_tpu.graph.format import write_lux
+from lux_tpu.graph.shards import build_pull_shards
+from lux_tpu.models.sssp import SSSPProgram
+from lux_tpu.program.spec import active_changed
+from lux_tpu.serve.fleet.launcher import launch_pod_worker
+from lux_tpu.serve.fleet.pod import run_pull_pod
+g = generate.rmat(9, 8, seed=3)
+snap = tempfile.mktemp(suffix=\".lux\"); write_lux(snap, g)
+P = 4
+sh = build_pull_shards(g, P)
+start = int(np.argmax(g.out_degrees()))
+prog = SSSPProgram(nv=sh.spec.nv, start=start)
+s0 = pull.init_state(prog, sh.arrays)
+want, iters = pull.run_pull_until(
+    prog, sh.spec, sh.arrays, s0, 10_000, active_changed,
+    method=\"auto\")
+hs = [launch_pod_worker(f\"ci{i}\") for i in range(2)]
+try:
+    tmps = [h.tmpdir for h in hs]
+    assert len(set(tmps)) == 2 and all(tmps), tmps
+    res = run_pull_pod([(\"127.0.0.1\", h.port) for h in hs], snap, P,
+                       app=\"sssp\", start=start)
+    assert res[\"iters\"] == int(iters), (res[\"iters\"], int(iters))
+    assert np.array_equal(res[\"state\"], np.asarray(want)), \"pod != single-host\"
+    spans = sorted((w[\"lo\"], w[\"hi\"]) for w in res[\"workers\"].values())
+    assert spans == [(0, 2), (2, 4)], spans
+    for h in hs:
+        assert h.proc.wait(timeout=30.0) == 0
+finally:
+    for h in hs:
+        h.terminate()
+assert not any(os.path.exists(t) for t in tmps), tmps
+print(\"[PASS] pod smoke: 2 processes, private tmpdirs, snapshot over\",
+      \"the wire, sssp bitwise in\", res[\"iters\"], \"iters\")
+")
+echo "$out" | grep -q "\[PASS\] pod smoke" || { echo "pod smoke failed"; exit 1; }
+echo "$out"
+'
+
 # 3e) program smoke (ISSUE 13): one spec-only workload end-to-end
 #     through the GENERIC driver on a tiny graph — the declarative
 #     compiler's whole path (spec -> program -> engine -> [PASS] check)
@@ -500,7 +552,7 @@ stage tier1_fast 1200 env JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_determinism.py tests/test_serve_scheduler.py \
     tests/test_fleet.py tests/test_mutate.py tests/test_live.py \
     tests/test_fault.py tests/test_dtrace.py tests/test_autopilot.py \
-    tests/test_merge_tree.py
+    tests/test_merge_tree.py tests/test_placement.py tests/test_pod.py
 
 if [ "$FAILED" -ne 0 ]; then
   echo "ci_check: FAILED (see $LOG)"; exit 1
