@@ -2088,17 +2088,27 @@ def _spawn_worker(env, out_path, nice=0):
     # driver reading it to EOF hangs.  start_new_session keeps a group-kill
     # of the orchestrator from SIGKILLing a tunnel-claim-holder.
     out = open(out_path, "wb")
-    err = open(out_path + ".err", "wb")
+    try:
+        err = open(out_path + ".err", "wb")
+    except OSError:
+        out.close()
+        raise
     preexec = (lambda: os.nice(nice)) if nice else None
-    return subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--worker"],
-        stdout=out,
-        stderr=err,
-        env=env,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-        start_new_session=True,
-        preexec_fn=preexec,
-    )
+    try:
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            stdout=out,
+            stderr=err,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            start_new_session=True,
+            preexec_fn=preexec,
+        )
+    finally:
+        # Popen dup'd both descriptors into the child; the parent's
+        # copies would otherwise leak one fd pair per spawned worker
+        out.close()
+        err.close()
 
 
 def _wait(proc, deadline):

@@ -1,6 +1,6 @@
 """lux_tpu.analysis — luxcheck, the repo-native static-analysis suite.
 
-Six checker families encode the invariants that have actually bitten
+Eight checker families encode the invariants that have actually bitten
 this codebase (see each module's docstring for the incident history):
 
 * tracing-safety (LUX-T*) — Python control flow / host concretization on
@@ -18,7 +18,15 @@ this codebase (see each module's docstring for the incident history):
 * lock-order    (LUX-L*) — the fleet's lock discipline: acquisition-
   graph cycles, AB/BA order inversions, blocking calls under a held
   lock, acquire/release split across helpers (docs/ANALYSIS.md's
-  protocol tier; the dynamic side is ``lux_tpu.analysis.proto``).
+  protocol tier; the dynamic side is ``lux_tpu.analysis.proto``);
+* guarded-by    (LUX-G*) — inferred field→lock maps: guarded fields
+  accessed outside their guard from second-thread-reachable methods,
+  mixed-guard fields, check-then-act across separate acquisitions
+  (the lock-*discipline* bugs LUX-L's order graph cannot see);
+* resource-lifecycle (LUX-R*) — acquire/release pairing for the four
+  leak-prone kinds: un-joined threads, close()-without-shutdown() on
+  parked sockets (the PR 16 stall), unreclaimed or happy-path-only
+  tmpdirs, file handles opened outside ``with``.
 
 Meta findings (LUX-X*) keep the suppression machinery itself honest:
 X000 unparsable file, X001 inline suppression without a justification,
@@ -46,9 +54,11 @@ from lux_tpu.analysis.core import (  # noqa: F401
     repo_root,
 )
 from lux_tpu.analysis.determinism import DeterminismChecker
+from lux_tpu.analysis.guards import GuardedByChecker
 from lux_tpu.analysis.locks import LockOrderChecker
 from lux_tpu.analysis.obs import ObsChecker
 from lux_tpu.analysis.policy import PolicyChecker
+from lux_tpu.analysis.resources import ResourceLifecycleChecker
 from lux_tpu.analysis.threads import ThreadSafetyChecker
 from lux_tpu.analysis.tracing import TracingSafetyChecker
 
@@ -60,6 +70,8 @@ ALL_CHECKERS = (
     PolicyChecker(),
     ObsChecker(),
     LockOrderChecker(),
+    GuardedByChecker(),
+    ResourceLifecycleChecker(),
 )
 
 FAMILIES = tuple(c.family for c in ALL_CHECKERS)

@@ -1,0 +1,446 @@
+"""LUX-G: guarded-by race inference (jax-free, AST only).
+
+The fleet's shared-state discipline is *conventions*: every serving
+class pairs its mutable fields with a ``threading.Lock`` and every
+access is supposed to happen under ``with self._lock``.  LUX-L checks
+the locks' *order*; nothing checked that guarded fields are actually
+*accessed under their guard* — the discipline bug class that shipped
+twice (PR 16, PR 19) before this family existed.
+
+Inference model (deliberately lexical — see docs/ANALYSIS.md):
+
+* per-class scope: a guard map is inferred for each ``class`` in
+  isolation; fields and locks are lexical identities (``Cls._field``).
+* a ``self._x`` that is *assigned* (plain, augmented, or through a
+  subscript) at least once inside ``with self._lock:`` in any method
+  other than ``__init__`` is **guarded by** ``_lock``.  Only the
+  *innermost* held lock at the write attributes the guard, so nested
+  acquisitions do not fabricate mixed-guard findings.
+* ``threading.Condition(self._lock)`` ALIASES its lock: acquiring the
+  condition is acquiring ``_lock``, so a field written under the
+  condition and read under the lock is one coherent guard, not two.
+* init window: ``__init__`` runs before any thread exists, so its
+  writes neither establish nor violate a guard.
+* ``*_locked`` naming convention: a method whose name ends in
+  ``_locked`` declares "my caller holds the lock" (the repo-wide idiom:
+  ``_op_commit_locked``); its accesses are exempt from G001 — the
+  CALLER's with-block is the checked site.
+
+Rules:
+
+* G001 — read or write of a guarded field outside its guard, in a
+  method reachable by a second thread (thread targets plus the
+  transitive closure of same-class ``self.m()`` calls and bound-method
+  references — dispatcher tables, RPC handlers, heartbeat loops).
+* G002 — mixed guards: one field written under two DIFFERENT locks;
+  whichever lock a reader picks, the other writer races it.
+* G003 — compound check-then-act: within one method, a guarded field
+  is read under one ``with`` block and written under a LATER, separate
+  one — the guard was dropped across the read-modify-write.
+
+Stated limits: identities are lexical (a lock reached through a
+helper object is invisible), scope is per-class (a second thread
+driving this class from ANOTHER class's loop is not discovered), and
+reachability is per-module.  Those are the same limits LUX-L carries,
+documented in docs/ANALYSIS.md; the suppression contract covers the
+deliberate exceptions (single-reference reads that ride the GIL, etc).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, Module, call_name
+from .locks import _ctor_kind
+from .threads import _thread_target_names
+
+#: entry-point call shapes whose callable argument runs on a new thread
+#: (mirrors threads._thread_target_names, plus the Attribute form —
+#: ``Thread(target=self._run)`` — that per-class analysis needs)
+_SPAWN_LAST = {"Thread", "submit", "Timer"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'_x' for a ``self._x`` attribute node, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _ClassGuards:
+    """Guard inference for ONE class: lock fields (alias-resolved),
+    per-access held-lock sets, the second-thread-reachable method set."""
+
+    def __init__(self, mod: Module, cls: ast.ClassDef):
+        self.mod = mod
+        self.cls = cls
+        #: methods defined directly on the class body
+        self.methods: Dict[str, ast.FunctionDef] = {
+            s.name: s for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        #: lock field -> canonical lock field (Condition(self._l) -> _l)
+        self.lock_alias: Dict[str, str] = {}
+        self._collect_locks()
+        #: (attr node, field, is_write, innermost held guard or None,
+        #:  full held set, method name)
+        self.accesses: List[Tuple[ast.AST, str, bool, Optional[str],
+                                  Set[str], str]] = []
+        self._collect_accesses()
+        self.reachable: Set[str] = self._reachable_methods()
+
+    # -- lock fields ----------------------------------------------------
+
+    def _collect_locks(self) -> None:
+        raw: Dict[str, Optional[str]] = {}  # field -> aliased field|None
+        for node in ast.walk(self.cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = _ctor_kind(node.value)
+            if not kind:
+                continue
+            alias = None
+            if kind == "Condition" and node.value.args:
+                alias = _self_attr(node.value.args[0])
+            for t in node.targets:
+                f = _self_attr(t)
+                if f:
+                    raw[f] = alias
+        for f in raw:
+            seen = {f}
+            cur = f
+            while raw.get(cur) in raw and raw[cur] not in seen:
+                cur = raw[cur]
+                seen.add(cur)
+            self.lock_alias[f] = raw[cur] or cur
+
+    def canonical(self, field: str) -> str:
+        return self.lock_alias.get(field, field)
+
+    # -- accesses -------------------------------------------------------
+
+    def _held_at(self, node: ast.AST, method: ast.AST
+                 ) -> Tuple[Optional[str], Set[str]]:
+        """(innermost guard, all guards) lexically held at ``node``,
+        walking ancestors up to (not past) the method def."""
+        innermost: Optional[str] = None
+        held: Set[str] = set()
+        for anc in self.mod.ancestors(node):
+            if anc is method:
+                break
+            if not isinstance(anc, (ast.With, ast.AsyncWith)):
+                continue
+            for item in anc.items:
+                g = self._guard_of(item.context_expr)
+                if g:
+                    held.add(g)
+                    if innermost is None:
+                        innermost = g
+        return innermost, held
+
+    def _guard_of(self, expr: ast.AST) -> Optional[str]:
+        f = _self_attr(expr)
+        if f is not None:
+            if f in self.lock_alias:
+                return self.canonical(f)
+            low = f.lower()
+            if any(k in low for k in ("lock", "mutex", "cond", "wake")):
+                return self.canonical(f)
+            return None
+        src = ast.unparse(expr).lower()
+        if any(k in src for k in ("lock", "mutex", "cond", "flock",
+                                  "wake")):
+            return ast.unparse(expr)
+        return None
+
+    def _is_write(self, node: ast.Attribute) -> bool:
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        # self._x[k] = v / self._x[k] += v mutate the guarded object;
+        # follow nested subscripts (self._x[i][j] = v) up the chain
+        cur: ast.AST = node
+        p = self.mod.parent(node)
+        while isinstance(p, ast.Subscript) and p.value is cur:
+            if isinstance(p.ctx, (ast.Store, ast.Del)):
+                return True
+            cur = p
+            p = self.mod.parent(p)
+        return False
+
+    def _collect_accesses(self) -> None:
+        for name, meth in self.methods.items():
+            for node in ast.walk(meth):
+                f = _self_attr(node)
+                if f is None or f in self.lock_alias:
+                    continue
+                inner, held = self._held_at(node, meth)
+                self.accesses.append(
+                    (node, f, self._is_write(node), inner, held, name))
+
+    # -- reachability ---------------------------------------------------
+
+    def _seed_methods(self) -> Set[str]:
+        seeds: Set[str] = set()
+        nested_defs: Dict[str, ast.AST] = {}
+        for meth in self.methods.values():
+            for n in ast.walk(meth):
+                if (isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))
+                        and n is not meth):
+                    nested_defs[n.name] = n
+
+        def seed_refs_in(scope: ast.AST) -> None:
+            for n in ast.walk(scope):
+                g = _self_attr(n)
+                if g and g in self.methods:
+                    seeds.add(g)
+
+        def note_callable(expr: ast.AST, spawner: ast.AST) -> None:
+            f = _self_attr(expr)
+            if f and f in self.methods:
+                seeds.add(f)
+            elif isinstance(expr, ast.Name) and expr.id in nested_defs:
+                # a nested def run on a thread: its self.* references
+                # seed reachability (``Thread(target=loop)`` where
+                # ``loop`` calls ``self.step()``)
+                seed_refs_in(nested_defs[expr.id])
+            elif isinstance(expr, ast.Name):
+                # target bound through a local we cannot resolve (a loop
+                # variable over ``(self._accept_loop, self._respond_loop)``
+                # tuples, a conditional alias): seed every self-method
+                # the SPAWNING method references — conservative toward
+                # checking, since one of those references is the target
+                seed_refs_in(spawner)
+
+        for meth in self.methods.values():
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.Call):
+                    continue
+                last = call_name(node).split(".")[-1]
+                if last in ("Thread", "Timer"):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            note_callable(kw.value, meth)
+                elif last == "submit" and node.args:
+                    note_callable(node.args[0], meth)
+        # module-level spawns targeting this class's methods by bare
+        # name (reuse the LUX-C discovery so both families agree)
+        for name in _thread_target_names(self.mod):
+            if name in self.methods:
+                seeds.add(name)
+        return seeds
+
+    def _reachable_methods(self) -> Set[str]:
+        reach = self._seed_methods()
+        work = list(reach)
+        while work:
+            m = work.pop()
+            meth = self.methods.get(m)
+            if meth is None:
+                continue
+            for n in ast.walk(meth):
+                f = _self_attr(n)
+                # ANY reference counts: dispatcher dicts hold bound
+                # methods (``{"step": self._op_step}``), so a bare
+                # ``self._op_step`` in thread context marks it reachable
+                if f and f in self.methods and f not in reach:
+                    reach.add(f)
+                    work.append(f)
+        reach.discard("__init__")
+        return reach
+
+
+class GuardedByChecker(Checker):
+    family = "guarded-by"
+    name = "guards"
+
+    def run(self, mod: Module) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(mod.tree):
+            if isinstance(cls, ast.ClassDef):
+                out.extend(self._check_class(mod, cls))
+        return out
+
+    def _check_class(self, mod: Module, cls: ast.ClassDef
+                     ) -> Iterable[Finding]:
+        cg = _ClassGuards(mod, cls)
+        if not cg.lock_alias:
+            return []
+
+        # guard inference: locked writes outside the init window
+        guards: Dict[str, Set[str]] = {}
+        guard_site: Dict[Tuple[str, str], ast.AST] = {}
+        for node, field, is_write, inner, _held, meth in cg.accesses:
+            if not is_write or inner is None or meth == "__init__":
+                continue
+            guards.setdefault(field, set()).add(inner)
+            guard_site.setdefault((field, inner), node)
+
+        out: List[Finding] = []
+
+        # G002: one field, two guards — report at the second guard's
+        # write site, naming both
+        mixed: Set[str] = set()
+        for field, gset in sorted(guards.items()):
+            if len(gset) < 2:
+                continue
+            mixed.add(field)
+            names = sorted(gset)
+            site = guard_site[(field, names[1])]
+            out.append(self.finding(
+                mod, site, "LUX-G002",
+                f"field '{cls.name}.{field}' is written under "
+                f"{len(names)} different locks ({', '.join(names)}) — "
+                "readers holding either one race the other writer"))
+
+        single = {f: next(iter(g)) for f, g in guards.items()
+                  if len(g) == 1 and f not in mixed}
+
+        # G001: unguarded access from a second-thread-reachable method
+        flagged: Set[Tuple[str, str, int]] = set()
+        for node, field, is_write, _inner, held, meth in cg.accesses:
+            guard = single.get(field)
+            if guard is None or meth == "__init__":
+                continue
+            if meth not in cg.reachable or meth.endswith("_locked"):
+                continue
+            if guard in held:
+                continue
+            key = (meth, field, getattr(node, "lineno", 0))
+            if key in flagged:
+                continue
+            flagged.add(key)
+            kind = "write" if is_write else "read"
+            out.append(self.finding(
+                mod, node, "LUX-G001",
+                f"{kind} of '{cls.name}.{field}' (guarded by "
+                f"'{guard}') outside the lock in thread-reachable "
+                f"method '{meth}'"))
+
+        # G003: read under one with-block, write under a later separate
+        # one — the guard was dropped mid read-modify-write
+        out.extend(self._check_then_act(mod, cls, cg, single))
+        return out
+
+    def _check_then_act(self, mod: Module, cls: ast.ClassDef,
+                        cg: _ClassGuards,
+                        single: Dict[str, str]) -> Iterable[Finding]:
+        # per (method, field): accesses keyed by their innermost
+        # with-block NODE; a block that both reads and writes the field
+        # is an atomic RMW and absolves the method for that field
+        per: Dict[Tuple[str, str],
+                  Dict[int, List[Tuple[bool, int, ast.AST]]]] = {}
+        writing_blocks: Set[int] = set()
+        for node, field, is_write, inner, _held, meth in cg.accesses:
+            if meth == "__init__" or single.get(field) != inner \
+                    or inner is None:
+                continue
+            w = self._with_block(mod, node, cg.methods[meth])
+            if w is None:
+                continue
+            if is_write:
+                # a block that writes ANY guarded field commits its
+                # decision inside the acquisition — its reads are a
+                # check-AND-act, not a stale check (``if token_ok:
+                # self._staged = ...`` must not flag on the token read)
+                writing_blocks.add(id(w))
+            per.setdefault((meth, field), {}).setdefault(
+                id(w), []).append(
+                    (is_write, getattr(node, "lineno", 0), node))
+        out: List[Finding] = []
+        for (meth, field), by_block in sorted(per.items()):
+            if len(by_block) < 2:
+                continue
+            reads = [(ln, n) for wid, acc in by_block.items()
+                     if wid not in writing_blocks
+                     for w, ln, n in acc if not w]
+            writes = [(ln, n) for acc in by_block.values()
+                      for w, ln, n in acc if w]
+            for rln, _rn in sorted(reads):
+                later = [(wln, wn) for wln, wn in sorted(writes)
+                         if wln > rln]
+                if later:
+                    wln, wn = later[0]
+                    out.append(self.finding(
+                        mod, wn, "LUX-G003",
+                        f"check-then-act on '{cls.name}.{field}': read "
+                        f"under the lock at line {rln}, write under a "
+                        f"SEPARATE acquisition here — the guard was "
+                        "dropped mid read-modify-write"))
+                    break
+        return out
+
+    @staticmethod
+    def _with_block(mod: Module, node: ast.AST,
+                    method: ast.AST) -> Optional[ast.AST]:
+        for anc in mod.ancestors(node):
+            if anc is method:
+                return None
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                return anc
+        return None
+
+
+#: synthetic positives — each MUST fire (tools/luxcheck.py --twins and
+#: tests/test_luxguard.py keep the family honest: a checker edit that
+#: silently stops firing fails the suite, same as luxproto's twins)
+TWINS = (
+    ("g001_unlocked_read", """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def run(self):
+        while self._n < 10:
+            self.bump()
+
+    def start(self):
+        threading.Thread(target=self.run).start()
+""", ("LUX-G001",)),
+    ("g002_mixed_guards", """
+import threading
+
+class Split:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+        self._n = 0
+
+    def a(self):
+        with self._lock:
+            self._n = 1
+
+    def b(self):
+        with self._aux_lock:
+            self._n = 2
+""", ("LUX-G002",)),
+    ("g003_check_then_act", """
+import threading
+
+class Bank:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bal = 0
+
+    def set(self, v):
+        with self._lock:
+            self._bal = v
+
+    def withdraw(self, amount):
+        with self._lock:
+            ok = self._bal >= amount
+        if ok:
+            with self._lock:
+                self._bal = self._bal - amount
+        return ok
+""", ("LUX-G003",)),
+)

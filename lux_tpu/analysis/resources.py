@@ -1,0 +1,589 @@
+"""LUX-R: resource-lifecycle analysis (jax-free, AST only).
+
+The fleet's four leak-prone resource kinds, each with an acquire that
+the code must pair with a release ON EVERY EXIT — a release that only
+runs on the happy path is a finding, because the exception path is
+exactly where a pod turns flaky:
+
+* R001 — threads.  A ``threading.Thread`` stored on ``self`` and
+  ``start()``-ed must be ``join()``-ed somewhere in the class, and a
+  join on a stop/close path must carry ``timeout=`` (an unbounded join
+  turns one wedged worker into a wedged fleet).  A LOCAL thread that is
+  started, never stored, never joined, and not ``daemon=True`` outlives
+  its function with nothing holding a handle to it.  Deliberate
+  fire-and-forget daemon threads (the worker's per-connection loops)
+  are exempt BY the ``daemon=True`` in their constructor — the
+  constructor states the contract.
+* R002 — sockets.  ``shutdown(SHUT_RDWR)`` must precede ``close()`` on
+  any socket another thread may be parked in ``accept``/``recv`` on:
+  on Linux ``close()`` alone does NOT wake the blocked thread, so every
+  stop eats the full join timeout — the PR 16 bug, now a checker.  The
+  park is recognized lexically: the same socket identity is accepted/
+  received on in a DIFFERENT function than the one closing it.
+* R003 — tmpdirs.  Every ``tempfile.mkdtemp`` needs a matching
+  ``shutil.rmtree`` on the same identity somewhere in the module, and
+  a local-scope reclaim must be exception-safe (``finally``/handler),
+  not tail-of-function.
+* R004 — file handles.  ``open()`` outside a ``with`` leaks its fd on
+  any exception between open and close.  Exempt shapes: the handle is
+  immediately the subject of ``with f:``, closed inside a ``finally``/
+  handler, returned to a caller that owns it, or stored on ``self``
+  with a ``close()`` elsewhere in the class (a lifecycle-managed
+  member, e.g. the flight recorder's event log).
+
+Identities are lexical base names (``self._srv`` and a local ``srv``
+swapped out of it unify through simple-assignment aliasing, including
+tuple swaps); see docs/ANALYSIS.md for the stated limits.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, Module, call_name
+
+#: methods whose name marks a stop/close path — joins here must bound
+#: their wait, or one wedged thread wedges every caller up the stack
+_STOP_NAMES = {"stop", "close", "kill", "shutdown", "terminate",
+               "__exit__", "__del__"}
+
+#: receiver method names that park the calling thread on a socket
+_PARK_ATTRS = {"accept", "recv", "recv_into", "recv_exact"}
+
+
+def _base_name(expr: ast.AST) -> Optional[str]:
+    """Lexical identity: 'x' for ``x``, '_f' for ``self._f`` (or any
+    single-attribute access), None for anything deeper."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                      ast.Name):
+        return expr.attr
+    return None
+
+
+def _unwrap(expr: ast.AST) -> ast.AST:
+    """Peel ``list(x)`` / ``x[:]`` wrappers so ``for t in list(self._ts)``
+    still aliases the container."""
+    while True:
+        if (isinstance(expr, ast.Call) and call_name(expr) in
+                ("list", "tuple", "sorted", "reversed")
+                and len(expr.args) == 1):
+            expr = expr.args[0]
+        elif isinstance(expr, ast.Subscript) and isinstance(
+                expr.slice, ast.Slice):
+            expr = expr.value
+        else:
+            return expr
+
+
+class _Aliases:
+    """Module-wide union of lexical identities through simple
+    assignments (``a = b``, tuple swaps, for-loop iteration)."""
+
+    def __init__(self, tree: ast.AST):
+        self._parent: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            pairs: List[Tuple[ast.AST, ast.AST]] = []
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t, v = node.targets[0], node.value
+                if isinstance(t, ast.Tuple) and isinstance(v, ast.Tuple) \
+                        and len(t.elts) == len(v.elts):
+                    pairs = list(zip(t.elts, v.elts))
+                else:
+                    pairs = [(t, v)]
+            elif isinstance(node, ast.For):
+                pairs = [(node.target, _unwrap(node.iter))]
+            for t, v in pairs:
+                a, b = _base_name(t), _base_name(_unwrap(v))
+                if a and b and a != b:
+                    self.union(a, b)
+
+    def find(self, n: str) -> str:
+        while n in self._parent:
+            n = self._parent[n]
+        return n
+
+    def union(self, a: str, b: str) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self._parent[ra] = rb
+
+
+def _in_cleanup(mod: Module, node: ast.AST) -> bool:
+    """True when ``node`` sits in a ``finally`` block or an ``except``
+    handler — i.e. it runs on the exception path."""
+    for anc in mod.ancestors(node):
+        if not isinstance(anc, ast.Try):
+            continue
+        stmts = list(anc.finalbody)
+        for h in anc.handlers:
+            stmts.extend(h.body)
+        for stmt in stmts:
+            if node is stmt or any(node is d for d in ast.walk(stmt)):
+                return True
+    return False
+
+
+def _receiver_calls(tree: ast.AST) -> Iterable[Tuple[ast.Call, str,
+                                                     str]]:
+    """(call node, receiver base name, method attr) for every
+    ``<recv>.<attr>(...)`` call in ``tree``."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            base = _base_name(node.func.value)
+            if base:
+                yield node, base, node.func.attr
+
+
+class ResourceLifecycleChecker(Checker):
+    family = "resource-lifecycle"
+    name = "resources"
+
+    def run(self, mod: Module) -> Iterable[Finding]:
+        out: List[Finding] = []
+        aliases = _Aliases(mod.tree)
+        out.extend(self._sockets(mod, aliases))
+        out.extend(self._tmpdirs(mod, aliases))
+        out.extend(self._files(mod))
+        for cls in ast.walk(mod.tree):
+            if isinstance(cls, ast.ClassDef):
+                out.extend(self._class_threads(mod, cls))
+        out.extend(self._local_threads(mod))
+        return out
+
+    # -- R001: threads --------------------------------------------------
+
+    def _class_threads(self, mod: Module, cls: ast.ClassDef
+                       ) -> Iterable[Finding]:
+        methods = [s for s in cls.body
+                   if isinstance(s, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        #: field -> the Thread() ctor (or start) node to report at
+        fields: Dict[str, ast.AST] = {}
+        for meth in methods:
+            # locals holding a Thread in this method
+            local_threads: Set[str] = set()
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Call) and \
+                        call_name(node).split(".")[-1] == "Thread":
+                    p = mod.parent(node)
+                    if isinstance(p, ast.Assign):
+                        for t in p.targets:
+                            b = _base_name(t)
+                            if b is None:
+                                continue
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self":
+                                fields.setdefault(b, node)
+                            elif isinstance(t, ast.Name):
+                                local_threads.add(b)
+                    elif isinstance(p, ast.Call) and isinstance(
+                            p.func, ast.Attribute) and \
+                            p.func.attr == "append":
+                        b = _base_name(p.func.value)
+                        if b:
+                            fields.setdefault(b, node)
+            for node, base, attr in _receiver_calls(meth):
+                if attr == "append" and node.args and \
+                        isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id in local_threads:
+                    tgt = _base_name(node.func.value)
+                    if tgt:
+                        fields.setdefault(tgt, node)
+
+        if not fields:
+            return []
+
+        # join evidence, with intra-class aliasing (t = self._thread,
+        # tuple swaps, for t in self._threads)
+        aliases = _Aliases(cls)
+        joined: Set[str] = set()
+        out: List[Finding] = []
+        for meth in methods:
+            for node, base, attr in _receiver_calls(meth):
+                if attr != "join":
+                    continue
+                root = aliases.find(base)
+                for f in fields:
+                    if aliases.find(f) == root:
+                        joined.add(f)
+                        if meth.name in _STOP_NAMES and not (
+                                node.args or node.keywords):
+                            out.append(self.finding(
+                                mod, node, "LUX-R001",
+                                f"unbounded join of '{cls.name}.{f}' "
+                                f"on the stop path '{meth.name}' — "
+                                "pass timeout=... so one wedged "
+                                "thread cannot wedge every caller"))
+        for f, site in sorted(fields.items()):
+            if f not in joined:
+                out.append(self.finding(
+                    mod, site, "LUX-R001",
+                    f"thread stored on '{cls.name}.{f}' is started "
+                    "but never joined on any stop/close path — a "
+                    "stop() that does not join leaks the thread (or "
+                    "races its last writes)"))
+        return out
+
+    def _local_threads(self, mod: Module) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            ctors: Dict[str, ast.Call] = {}
+            daemon: Set[str] = set()
+            consumed: Set[str] = set()
+            started: Set[str] = set()
+            joined: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        call_name(node).split(".")[-1] == "Thread":
+                    is_daemon = any(
+                        kw.arg == "daemon" and
+                        isinstance(kw.value, ast.Constant) and
+                        kw.value.value is True
+                        for kw in node.keywords)
+                    p = mod.parent(node)
+                    if isinstance(p, ast.Assign) and len(p.targets) == 1 \
+                            and isinstance(p.targets[0], ast.Name):
+                        n = p.targets[0].id
+                        ctors[n] = node
+                        if is_daemon:
+                            daemon.add(n)
+                    elif isinstance(p, ast.Attribute) and \
+                            p.attr == "start" and not is_daemon:
+                        # chained Thread(...).start(): nothing can ever
+                        # join it — fine only when declared daemon
+                        out.append(self.finding(
+                            mod, node, "LUX-R001",
+                            "Thread(...).start() drops the only "
+                            "handle — join it, store it, or "
+                            "declare daemon=True"))
+            for node, base, attr in _receiver_calls(fn):
+                if base in ctors:
+                    if attr == "start":
+                        started.add(base)
+                    elif attr == "join":
+                        joined.add(base)
+            for node in ast.walk(fn):
+                # any OTHER use of the name (argument, append, return,
+                # attribute store) transfers ownership out of this rule
+                if isinstance(node, ast.Name) and node.id in ctors:
+                    p = mod.parent(node)
+                    if isinstance(p, (ast.Call, ast.Return, ast.Tuple,
+                                      ast.List, ast.Dict)) or (
+                            isinstance(p, ast.Assign)
+                            and node is p.value):
+                        if not (isinstance(p, ast.Call)
+                                and p.func is node):
+                            consumed.add(node.id)
+                if isinstance(node, ast.Attribute) and isinstance(
+                        node.value, ast.Name) and \
+                        node.value.id in ctors and \
+                        node.attr not in ("start", "join", "daemon",
+                                          "name", "is_alive", "ident"):
+                    consumed.add(node.value.id)
+            for n in sorted(started - joined - consumed - daemon):
+                out.append(self.finding(
+                    mod, ctors[n], "LUX-R001",
+                    f"local thread '{n}' is started but neither "
+                    "joined, stored, nor daemon=True — it outlives "
+                    f"'{fn.name}' with no handle left to stop it"))
+        return out
+
+    # -- R002: sockets --------------------------------------------------
+
+    def _sockets(self, mod: Module, aliases: _Aliases
+                 ) -> Iterable[Finding]:
+        socket_roots: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                last = call_name(node).split(".")[-1]
+                if last in ("socket", "create_connection",
+                            "create_server"):
+                    p = mod.parent(node)
+                    if isinstance(p, ast.Assign):
+                        for t in p.targets:
+                            b = _base_name(t)
+                            if b:
+                                socket_roots.add(aliases.find(b))
+
+        parked: Dict[str, Set[str]] = {}    # root -> fn names parking
+        shut: Set[str] = set()
+        closes: List[Tuple[ast.Call, str, str]] = []
+        for node, base, attr in _receiver_calls(mod.tree):
+            root = aliases.find(base)
+            if root not in socket_roots:
+                continue
+            fn = mod.enclosing_function(node)
+            fname = fn.name if fn else "<module>"
+            if attr in _PARK_ATTRS:
+                parked.setdefault(root, set()).add(fname)
+            elif attr == "shutdown":
+                shut.add(root)
+            elif attr == "close":
+                closes.append((node, root, fname))
+
+        out: List[Finding] = []
+        for node, root, fname in closes:
+            park_fns = parked.get(root, set()) - {fname}
+            if park_fns and root not in shut:
+                out.append(self.finding(
+                    mod, node, "LUX-R002",
+                    f"socket '{root}' is closed here while "
+                    f"'{sorted(park_fns)[0]}' may be blocked in "
+                    "accept/recv on it — call "
+                    "shutdown(socket.SHUT_RDWR) first; close() alone "
+                    "does not wake a parked thread on Linux (the "
+                    "PR 16 stall)"))
+        return out
+
+    # -- R003: tmpdirs --------------------------------------------------
+
+    def _tmpdirs(self, mod: Module, aliases: _Aliases
+                 ) -> Iterable[Finding]:
+        reclaimed: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    call_name(node).split(".")[-1] == "rmtree" and \
+                    node.args:
+                b = _base_name(_unwrap(node.args[0]))
+                if b:
+                    reclaimed.add(aliases.find(b))
+
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and
+                    call_name(node).split(".")[-1] == "mkdtemp"):
+                continue
+            p = mod.parent(node)
+            target: Optional[str] = None
+            local = False
+            if isinstance(p, ast.Assign) and len(p.targets) == 1:
+                target = _base_name(p.targets[0])
+                local = isinstance(p.targets[0], ast.Name)
+            if target is None:
+                out.append(self.finding(
+                    mod, node, "LUX-R003",
+                    "mkdtemp result is not bound to a reclaimable "
+                    "name — nothing can ever rmtree it"))
+                continue
+            root = aliases.find(target)
+            if local and self._transfers_ownership(mod, node, target):
+                # returned / stored on self / handed to a constructor:
+                # the new owner owes the rmtree, not this function
+                continue
+            if root not in reclaimed:
+                out.append(self.finding(
+                    mod, node, "LUX-R003",
+                    f"tmpdir '{target}' from mkdtemp has no rmtree "
+                    "reclamation anywhere in this module — every "
+                    "call leaks a directory"))
+                continue
+            if local:
+                out.extend(self._tmpdir_exception_path(
+                    mod, node, target))
+        return out
+
+    @staticmethod
+    def _transfers_ownership(mod: Module, site: ast.AST,
+                             name: str) -> bool:
+        """True when the local tmpdir name escapes its function with an
+        owner attached: returned to the caller, stored on an attribute,
+        or passed to a constructor (Uppercase-initial callee — the
+        launcher's ProcHandle shape).  A plain lowercase call merely
+        USES the dir; the opener still owes the reclaim."""
+        fn = mod.enclosing_function(site)
+        if fn is None:
+            return False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None \
+                    and name in {n.id for n in ast.walk(node.value)
+                                 if isinstance(n, ast.Name)}:
+                return True
+            if isinstance(node, ast.Assign):
+                if any(isinstance(t, ast.Attribute)
+                       for t in node.targets) and isinstance(
+                           node.value, ast.Name) and \
+                        node.value.id == name:
+                    return True
+            if isinstance(node, ast.Call) and any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in node.args):
+                last = call_name(node).split(".")[-1]
+                if last[:1].isupper():
+                    return True
+        return False
+
+    def _tmpdir_exception_path(self, mod: Module, site: ast.AST,
+                               name: str) -> Iterable[Finding]:
+        """A local-scope reclaim must survive an exception between
+        mkdtemp and rmtree (ownership transfers were already excused
+        by ``_transfers_ownership`` before this runs)."""
+        fn = mod.enclosing_function(site)
+        if fn is None:
+            return []
+        rmtree_sites: List[ast.AST] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    call_name(node).split(".")[-1] == "rmtree" and \
+                    node.args and _base_name(
+                        _unwrap(node.args[0])) == name:
+                rmtree_sites.append(node)
+        if not rmtree_sites:
+            return []
+        if any(_in_cleanup(mod, r) for r in rmtree_sites):
+            return []
+        return [self.finding(
+            mod, rmtree_sites[0], "LUX-R003",
+            f"tmpdir '{name}' is reclaimed only on the happy path — "
+            "an exception between mkdtemp and this rmtree leaks the "
+            "directory; move the rmtree into try/finally")]
+
+    # -- R004: file handles ---------------------------------------------
+
+    def _files(self, mod: Module) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and
+                    call_name(node) in ("open", "io.open")):
+                continue
+            p = mod.parent(node)
+            if isinstance(p, ast.withitem):
+                continue
+            if isinstance(p, ast.Return):
+                continue  # caller owns the handle
+            if isinstance(p, ast.Assign) and len(p.targets) == 1:
+                t = p.targets[0]
+                if isinstance(t, ast.Name) and self._name_is_managed(
+                        mod, node, t.id):
+                    continue
+                if isinstance(t, ast.Attribute) and isinstance(
+                        t.value, ast.Name) and t.value.id == "self" \
+                        and self._field_is_closed(mod, node, t.attr):
+                    continue
+            out.append(self.finding(
+                mod, node, "LUX-R004",
+                "open() outside a with block leaks the handle on any "
+                "exception before close — use 'with open(...)', close "
+                "in try/finally, or return the handle to a caller "
+                "that does"))
+        return out
+
+    @staticmethod
+    def _name_is_managed(mod: Module, site: ast.AST,
+                         name: str) -> bool:
+        fn = mod.enclosing_function(site) or mod.tree
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Name) and \
+                            item.context_expr.id == name:
+                        return True
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and \
+                    node.func.attr == "close" and isinstance(
+                        node.func.value, ast.Name) and \
+                    node.func.value.id == name:
+                if _in_cleanup(mod, node):
+                    return True
+        return False
+
+    @staticmethod
+    def _field_is_closed(mod: Module, site: ast.AST,
+                         field: str) -> bool:
+        cls = None
+        for anc in mod.ancestors(site):
+            if isinstance(anc, ast.ClassDef):
+                cls = anc
+                break
+        if cls is None:
+            return False
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and \
+                    node.func.attr == "close":
+                b = _base_name(node.func.value)
+                if b == field:
+                    return True
+        return False
+
+
+#: synthetic positives — each MUST fire (tools/luxcheck.py --twins and
+#: tests/test_luxguard.py; a silently-pacified rule fails the suite)
+TWINS = (
+    ("r001_never_joined", """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        pass
+""", ("LUX-R001",)),
+    ("r001_unbounded_stop_join", """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._thread.join()
+""", ("LUX-R001",)),
+    ("r002_close_without_shutdown", """
+import socket
+import threading
+
+class Server:
+    def __init__(self):
+        self._srv = socket.socket()
+        self._thread = threading.Thread(target=self._accept_loop)
+
+    def _accept_loop(self):
+        while True:
+            sock, _ = self._srv.accept()
+
+    def stop(self):
+        self._srv.close()
+        self._thread.join(timeout=5.0)
+""", ("LUX-R002",)),
+    ("r003_no_reclaim", """
+import tempfile
+
+def scratch():
+    d = tempfile.mkdtemp(prefix="twin_")
+    return None
+""", ("LUX-R003",)),
+    ("r003_happy_path_only", """
+import shutil
+import tempfile
+
+def scratch(work):
+    d = tempfile.mkdtemp(prefix="twin_")
+    work(d)
+    shutil.rmtree(d)
+""", ("LUX-R003",)),
+    ("r004_bare_open", """
+def head(path):
+    f = open(path)
+    line = f.readline()
+    f.close()
+    return line
+""", ("LUX-R004",)),
+)

@@ -1,0 +1,44 @@
+"""Synthetic-positive twins for the LUX-G/LUX-R families (jax-free).
+
+Same philosophy as luxproto's broken twins: a checker that silently
+stops firing is worse than no checker, because the repo-clean gate
+keeps passing while the invariant rots.  Every entry here is a minimal
+KNOWN-BAD snippet paired with the code(s) it must produce; ``run_twins``
+re-checks each through the real pipeline and a twin that comes back
+clean is a FAILURE — of the checker, not the snippet.
+
+Gated three ways: ``tools/luxcheck.py --twins`` (ci_check guard_smoke,
+chip_day step -3d) and ``tests/test_luxguard.py`` (tier-1).
+"""
+from __future__ import annotations
+
+import textwrap
+from typing import List, Tuple
+
+from lux_tpu.analysis.core import Module, check_module
+from lux_tpu.analysis.guards import GuardedByChecker
+from lux_tpu.analysis.guards import TWINS as _GUARD_TWINS
+from lux_tpu.analysis.resources import ResourceLifecycleChecker
+from lux_tpu.analysis.resources import TWINS as _RESOURCE_TWINS
+
+#: (name, source, codes that MUST fire) across both new families
+ALL_TWINS: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
+    _GUARD_TWINS + _RESOURCE_TWINS
+)
+
+_CHECKERS = (GuardedByChecker(), ResourceLifecycleChecker())
+
+
+def run_twins() -> List[Tuple[str, Tuple[str, ...], frozenset, bool]]:
+    """[(twin name, expected codes, fired codes, ok)] — ``ok`` means
+    every expected code fired (extra codes are fine; a twin may well be
+    broken in more ways than the one it pins)."""
+    results = []
+    for name, source, expected in ALL_TWINS:
+        mod = Module(path=f"<twin:{name}>",
+                     relpath=f"twins/{name}.py",
+                     source=textwrap.dedent(source))
+        fired = frozenset(f.code for f in check_module(mod, _CHECKERS))
+        results.append((name, expected, fired,
+                        all(c in fired for c in expected)))
+    return results

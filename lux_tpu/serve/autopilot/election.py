@@ -338,6 +338,7 @@ class WireIncumbent:
                 owner="standby")
         with self._lock:
             if self._conn is None:
+                # luxcheck: disable=LUX-G003 -- deliberate CAS: the dial ran unlocked (holding _lock across connect() is the PR 19 wedge), and this second acquisition RE-CHECKS before installing; the losing racer is closed below
                 self._conn = conn
             elif self._conn is not conn:
                 # lost a dial race to another probe; keep the installed

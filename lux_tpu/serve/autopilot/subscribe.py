@@ -198,7 +198,7 @@ class SubscriptionHub:
             if self._pending_gen is not None:
                 if generation < self._pending_gen:
                     return  # late notify for an already-superseded gen
-                self._count("sub_coalesced")
+                self._count(self._ctl, "sub_coalesced")
             self._pending_gen = max(generation,
                                     self._pending_gen or 0)
             self._pending_tc = tc
@@ -220,15 +220,29 @@ class SubscriptionHub:
                 if old._sub_hub is self:
                     old._sub_hub = None
         with controller._lock:
+            displaced = getattr(controller, "_sub_hub", None)
             controller._sub_hub = self
+        if displaced is not None and displaced is not self:
+            # the successor's OWN lazily-built hub just lost its
+            # controller binding: nothing would ever notify it again,
+            # so its dispatcher thread idles forever and its
+            # subscribers hang silently.  close() wakes them with
+            # SubscriptionClosed and JOINS the dispatcher — a clean
+            # end beats a leaked thread plus a silent hang.
+            displaced.close()
         try:
             gen = int(controller.generation())
         except Exception:  # noqa: BLE001 — static controller adoption
             gen = 0
         self.notify(gen, refreshed=True)
 
-    def _count(self, key: str, n: int = 1) -> None:
-        ctl = self._ctl
+    def _count(self, ctl, key: str, n: int = 1) -> None:
+        """Route a counter increment to ``ctl`` — passed in, never read
+        from ``self._ctl`` here: ``_cond``'s lock is non-reentrant, so a
+        caller already under it reads the field itself, and the
+        dispatcher passes the snapshot it took under the lock (the
+        controller that actually served the dispatch, not whatever a
+        concurrent ``rebind`` swapped in mid-push)."""
         if ctl is not None:
             try:
                 ctl._pilot_count(key, n)
@@ -283,7 +297,7 @@ class SubscriptionHub:
                     if s._push(dict(update)):
                         pushed += 1
                 if pushed:
-                    self._count("sub_pushes", pushed)
+                    self._count(ctl, "sub_pushes", pushed)
                 dtrace.emit_span("pilot.subscribe.push", ctx, t0,
                                  time.monotonic(), ok=True, app=app,
                                  generation=update["generation"],

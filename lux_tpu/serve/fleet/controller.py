@@ -494,10 +494,20 @@ class FleetController:
         conn.close()
 
     def _close_lease(self) -> None:
+        import socket as _socket
+
         with self._lock:
             srv, self._lease_listener = self._lease_listener, None
             conns, self._lease_conns = self._lease_conns, []
         if srv is not None:
+            # shutdown() first: close() alone does not wake the lease
+            # accept thread parked in accept() on Linux — it would sit
+            # on the dead fd forever, one leaked thread per controller
+            # lifetime (LUX-R002, the PR 16 stall shape)
+            try:
+                srv.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass  # never connected / already down
             try:
                 srv.close()
             except OSError:

@@ -51,24 +51,31 @@ class ProcHandle:
 
     def kill(self) -> None:
         """SIGKILL: the fault-drill shape — no flush, no goodbye."""
-        if self.alive():
-            self.proc.kill()
-        self.proc.wait(timeout=30.0)
-        self._cleanup()
+        try:
+            if self.alive():
+                self.proc.kill()
+            self.proc.wait(timeout=30.0)
+        finally:
+            # wait() raising TimeoutExpired (an unreapable child) must
+            # not leak the private tmpdir on top of the stuck process
+            self._cleanup()
 
     def terminate(self, timeout_s: float = 30.0) -> int:
         """SIGTERM + wait (the entrypoints translate it to a clean
         stop); escalates to SIGKILL past the deadline."""
-        if self.alive():
-            self.proc.terminate()
-            try:
-                self.proc.wait(timeout=timeout_s)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.proc.wait(timeout=30.0)
-        rc = self.proc.returncode
-        self._cleanup()
-        return rc
+        try:
+            if self.alive():
+                self.proc.terminate()
+                try:
+                    self.proc.wait(timeout=timeout_s)
+                except subprocess.TimeoutExpired:
+                    self.proc.kill()
+                    self.proc.wait(timeout=30.0)
+            return self.proc.returncode
+        finally:
+            # as in kill(): reclaim the tmpdir even when the post-KILL
+            # wait itself times out
+            self._cleanup()
 
     def _cleanup(self) -> None:
         tmp, self.tmpdir = self.tmpdir, None
@@ -160,13 +167,22 @@ def _launch_argv(argv: Sequence[str], private_tmp: bool,
         start_new_session=True)  # SIGINT to the parent never strays
     try:
         ready = _read_ready_line(proc, ready_timeout_s)
-    except LaunchError:
+        return ProcHandle(proc, str(ready["worker_id"]),
+                          int(ready["port"]), int(ready["pid"]),
+                          tmpdir, ready)
+    except BaseException:
+        # ANY exit without a handle orphans the child and its tmpdir:
+        # a malformed READY line (KeyError/ValueError building the
+        # ProcHandle above) is just as much a failed launch as a
+        # missing one, and nobody else holds a reference to reap
+        try:
+            proc.kill()
+            proc.wait(timeout=30.0)
+        except Exception:  # noqa: BLE001 — never mask the launch error
+            pass
         if tmpdir is not None:
             shutil.rmtree(tmpdir, ignore_errors=True)
         raise
-    return ProcHandle(proc, str(ready["worker_id"]),
-                      int(ready["port"]), int(ready["pid"]),
-                      tmpdir, ready)
 
 
 def launch_pod_worker(worker_id: str, host: str = "127.0.0.1",

@@ -150,6 +150,14 @@ class PodWorker:
 
     def stop(self) -> None:
         self._running = False
+        # shutdown() first: close() alone does not wake a thread blocked
+        # in accept() on Linux, so _accept_loop would sit parked until
+        # its join below burned the whole timeout (LUX-R002 — the PR 16
+        # stall, recurred here and caught by the checker this time)
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # never connected / already down
         try:
             self._srv.close()
         except OSError:
@@ -227,8 +235,10 @@ class PodWorker:
         elif op == "pod_step":
             self._op_step(conn, msg, arr)
         elif op == "stats":
+            with self._lock:
+                lo, hi, counts = self._lo, self._hi, dict(self.counts)
             self._reply(conn, msg, worker_id=self.worker_id,
-                        lo=self._lo, hi=self._hi, **self.counts)
+                        lo=lo, hi=hi, **counts)
         elif op == "shutdown":
             self._reply(conn, msg)
             self.stop()
@@ -291,20 +301,25 @@ class PodWorker:
             self._host_index = host_index
             self._lo, self._hi = parts.start, parts.stop
             self._overlay = None
-            self._step_fn = self._make_step(None)
+            self._step_fn = self._make_step_locked(None)
             state0 = pull.init_state(prog, shards.arrays)
             self.counts["builds"] += 1
+            lo, hi = self._lo, self._hi
         plan_s = time.perf_counter() - t0
-        self.counts["plan_s"] += plan_s
+        with self._lock:
+            self.counts["plan_s"] += plan_s
         self._reply(conn, msg, np.asarray(jax.device_get(state0)),
-                    lo=self._lo, hi=self._hi, nv=shards.spec.nv,
+                    lo=lo, hi=hi, nv=shards.spec.nv,
                     nv_pad=shards.spec.nv_pad, plan_s=plan_s)
 
-    def _make_step(self, ostatic):
+    def _make_step_locked(self, ostatic):
         """Jit the per-round step over MY resident parts: vmapped
         local_pull_step against the driver-assembled full gathered
         state — literally engine/pull._pull_iteration restricted to the
-        rows this host owns, so pod math IS single-host math."""
+        rows this host owns, so pod math IS single-host math.  Callers
+        hold ``self._lock`` (the ``_locked`` suffix is the LUX-G
+        contract: the reads of ``_prog``/``_method`` below are covered
+        by the caller's acquisition)."""
         import jax
         import jax.numpy as jnp
 
@@ -337,17 +352,20 @@ class PodWorker:
 
         from lux_tpu.mutate.overlay import OverlayStatic
 
-        if self._shards is None:
+        with self._lock:
+            built = self._shards is not None
+            lo, hi = self._lo, self._hi
+        if not built:
             self._reply_err(conn, msg, "pod_overlay before pod_build")
             return
         if blob is None:
             with self._lock:
                 self._overlay = None
-                self._step_fn = self._make_step(None)
+                self._step_fn = self._make_step_locked(None)
             self._reply(conn, msg)
             return
         oarrays = _unpack_overlay(blob)
-        k = self._hi - self._lo
+        k = hi - lo
         if oarrays.del_val.shape[0] != k:
             self._reply_err(conn, msg,
                             f"overlay rows {oarrays.del_val.shape[0]} "
@@ -358,14 +376,19 @@ class PodWorker:
         with self._lock:
             self._overlay = (ostatic,
                              jax.tree.map(jnp.asarray, oarrays))
-            self._step_fn = self._make_step(ostatic)
+            self._step_fn = self._make_step_locked(ostatic)
         self._reply(conn, msg)
 
     def _op_step(self, conn: Conn, msg: dict, full) -> None:
         import jax
         import jax.numpy as jnp
 
-        if self._step_fn is None:
+        with self._lock:
+            shards = self._shards
+            step = self._step_fn
+            ovl = self._overlay
+            lo, hi = self._lo, self._hi
+        if step is None:
             self._reply_err(conn, msg, "pod_step before pod_build")
             return
         if full is None:
@@ -373,11 +396,6 @@ class PodWorker:
                             "pod_step carries no gathered-state payload")
             return
         t0 = time.perf_counter()
-        with self._lock:
-            shards = self._shards
-            step = self._step_fn
-            ovl = self._overlay
-            lo, hi = self._lo, self._hi
         V = shards.spec.nv_pad
         full = jnp.asarray(full)
         local = full.reshape((shards.spec.num_parts, V)
@@ -387,8 +405,9 @@ class PodWorker:
         new = np.asarray(jax.device_get(new))
         active = int(active)
         compute_s = time.perf_counter() - t0
-        self.counts["steps"] += 1
-        self.counts["compute_s"] += compute_s
+        with self._lock:
+            self.counts["steps"] += 1
+            self.counts["compute_s"] += compute_s
         self._reply(conn, msg, new, active=active, compute_s=compute_s)
 
 

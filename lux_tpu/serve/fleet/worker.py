@@ -271,6 +271,7 @@ class ReplicaWorker:
     # ------------------------------------------------------------------
 
     def _accept_loop(self) -> None:
+        # luxcheck: disable=LUX-G001 -- _running is a monotonic shutdown latch (set True once before this thread exists, cleared once); a stale True costs one accept() that _close_sockets' shutdown() interrupts
         while self._running and self._listener is not None:
             try:
                 sock, _addr = self._listener.accept()
@@ -291,6 +292,7 @@ class ReplicaWorker:
 
     def _conn_loop(self, conn: Conn) -> None:
         with fault.owner(self.worker_id):
+            # luxcheck: disable=LUX-G001 -- monotonic shutdown latch, as in _accept_loop: a stale True costs one recv() that the conn close interrupts; holding _lock here would serialize every connection
             while self._running:
                 try:
                     msg, arr = conn.recv()
@@ -610,6 +612,7 @@ class ReplicaWorker:
             still: List[tuple] = []
             for conn, rid, fut, bound, wtc, t_recv in pending:
                 if not fut.done():
+                    # luxcheck: disable=LUX-G001 -- monotonic shutdown latch: a stale True re-queues the future for ONE extra poll; the locked re-read at the loop top settles it
                     if self._running:
                         still.append((conn, rid, fut, bound, wtc,
                                       t_recv))

@@ -650,7 +650,8 @@ def test_luxcheck_cli_clean_and_jax_free():
 def test_every_family_has_a_checker():
     fams = {c.family for c in ALL_CHECKERS}
     assert fams == {"tracing-safety", "determinism", "thread-safety",
-                    "policy", "observability", "lock-order"}
+                    "policy", "observability", "lock-order",
+                    "guarded-by", "resource-lifecycle"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,695 @@
+"""luxguard (ISSUE 20): the guarded-by (LUX-G) and resource-lifecycle
+(LUX-R) checker families.
+
+Three layers, mirroring how the families are gated in CI:
+
+* inference units — the field→lock guard map, its exemptions (init
+  window, ``Condition(self._lock)`` aliasing, the ``*_locked`` caller-
+  holds-lock naming convention), and thread-entry reachability
+  (including targets bound through loop variables, the ReplicaWorker
+  ``start()`` shape);
+* the synthetic-positive twins — every known-bad snippet MUST fire
+  (``tools/luxcheck.py --twins``; a clean twin means the checker
+  rotted), plus the named pre-fix fixtures for the PR 16 socket stall
+  and the PR 19 dial-under-lock wedge;
+* regressions for the real findings this family's first sweep caught
+  (launcher tmpdir reclaim on exception exits, subscribe dispatcher
+  leak on hub rebind).
+"""
+import io
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from lux_tpu.analysis.core import Module, check_module
+from lux_tpu.analysis.guards import GuardedByChecker
+from lux_tpu.analysis.locks import LockOrderChecker
+from lux_tpu.analysis.resources import ResourceLifecycleChecker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(source, checkers, relpath="lux_tpu/serve/fleet/fixture.py"):
+    mod = Module(path=f"<{relpath}>", relpath=relpath,
+                 source=textwrap.dedent(source))
+    return check_module(mod, checkers)
+
+
+def _guard(source):
+    return [f.code for f in _run(source, (GuardedByChecker(),))]
+
+
+def _res(source):
+    return [f.code for f in _run(source, (ResourceLifecycleChecker(),))]
+
+
+# ---------------------------------------------------------------------------
+# guard-map inference
+# ---------------------------------------------------------------------------
+
+
+def test_g001_guarded_field_read_outside_lock():
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                threading.Thread(target=self.peek).start()
+                return self._n
+        """)
+    assert "LUX-G001" in codes
+
+
+def test_locked_reads_and_unguarded_fields_are_clean():
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._free = 0  # never written under a lock: unguarded
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._n += 1
+                self._free += 1
+
+            def peek(self):
+                threading.Thread(target=self.peek).start()
+                with self._lock:
+                    n = self._n
+                return n + self._free
+        """)
+    assert codes == []
+
+
+def test_init_window_exemption():
+    """``__init__`` writes neither establish a guard nor violate one —
+    no second thread can exist before construction finishes."""
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # unlocked write: the init window
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._n += 1
+        """)
+    assert codes == []
+
+
+def test_condition_alias_is_the_same_guard():
+    """``Condition(self._lock)`` shares the underlying lock: holding
+    either side guards the field — no G001, no G002 mixed-guard."""
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._wake = threading.Condition(self._lock)
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._wake:
+                    self._n += 1
+                    self._wake.notify_all()
+
+            def drain(self):
+                threading.Thread(target=self.drain).start()
+                with self._lock:
+                    return self._n
+        """)
+    assert codes == []
+
+
+def test_locked_suffix_convention_means_caller_holds():
+    """A ``*_locked`` method accesses guarded fields bare — the suffix
+    IS the contract that every caller already holds the lock (the
+    lexical inference cannot see callers' frames)."""
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._bump_locked()
+                    self._n += 1
+
+            def _bump_locked(self):
+                self._n += 1
+        """)
+    assert codes == []
+
+
+def test_unreachable_method_is_not_flagged():
+    """Reachability gates G001: a method no thread entry can reach only
+    ever runs on the constructing thread."""
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def _locked_write(self):
+                with self._lock:
+                    self._n += 1
+
+            def main_thread_only(self):
+                return self._n
+        """)
+    assert codes == []
+
+
+def test_loop_variable_thread_target_seeds_reachability():
+    """The ReplicaWorker ``start()`` shape: targets bound through a
+    loop variable over ``(self._a, self._b)`` tuples still seed the
+    reachable set (the spawner's self-method references are taken when
+    the target Name cannot be resolved)."""
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                for fn, name in ((self._loop_a, "a"),
+                                 (self._loop_b, "b")):
+                    threading.Thread(target=fn, name=name,
+                                     daemon=True).start()
+
+            def _loop_a(self):
+                with self._lock:
+                    self._n += 1
+
+            def _loop_b(self):
+                return self._n  # unlocked read on a second thread
+        """)
+    assert codes == ["LUX-G001"]
+
+
+def test_g002_mixed_guards():
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._n += 1
+                with self._other:
+                    self._n += 1
+        """)
+    assert "LUX-G002" in codes
+
+
+def test_g003_requires_separate_acquisitions():
+    """Check-then-act across two ``with`` blocks fires; the same
+    decide-and-write inside ONE acquisition is the fix shape and is
+    clean."""
+    bad = _guard("""\
+        import threading
+
+        class Bank:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._bal = 0
+
+            def start(self):
+                threading.Thread(target=self.withdraw).start()
+
+            def withdraw(self, amount=1):
+                with self._lock:
+                    ok = self._bal >= amount
+                if ok:
+                    with self._lock:
+                        self._bal -= amount
+                return ok
+        """)
+    good = _guard("""\
+        import threading
+
+        class Bank:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._bal = 0
+
+            def start(self):
+                threading.Thread(target=self.withdraw).start()
+
+            def withdraw(self, amount=1):
+                with self._lock:
+                    ok = self._bal >= amount
+                    if ok:
+                        self._bal -= amount
+                return ok
+        """)
+    assert "LUX-G003" in bad
+    assert good == []
+
+
+# ---------------------------------------------------------------------------
+# resource-lifecycle units
+# ---------------------------------------------------------------------------
+
+
+def test_r001_joined_stop_path_with_timeout_is_clean():
+    codes = _res("""\
+        import threading
+
+        class Svc:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                pass
+
+            def stop(self):
+                self._t.join(timeout=5.0)
+        """)
+    assert codes == []
+
+
+def test_r001_unbounded_join_in_stop_path():
+    codes = _res("""\
+        import threading
+
+        class Svc:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                pass
+
+            def stop(self):
+                self._t.join()
+        """)
+    assert "LUX-R001" in codes
+
+
+def test_r002_shutdown_before_close_is_clean():
+    codes = _res("""\
+        import socket
+
+        class Srv:
+            def start(self):
+                self._srv = socket.socket()
+                self._srv.listen(8)
+
+            def _accept_loop(self):
+                conn, _ = self._srv.accept()
+
+            def stop(self):
+                try:
+                    self._srv.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self._srv.close()
+        """)
+    assert codes == []
+
+
+def test_r003_ownership_transfer_is_clean():
+    """Returning the tmpdir or handing it to a constructor transfers
+    reclaim responsibility — no finding at the mkdtemp site."""
+    codes = _res("""\
+        import shutil
+        import tempfile
+
+        class Handle:
+            def __init__(self, tmpdir):
+                self.tmpdir = tmpdir
+
+            def close(self):
+                shutil.rmtree(self.tmpdir, ignore_errors=True)
+
+        def launch():
+            d = tempfile.mkdtemp(prefix="x-")
+            return Handle(d)
+        """)
+    assert codes == []
+
+
+def test_r004_with_and_class_managed_are_clean():
+    codes = _res("""\
+        class Sink:
+            def __init__(self, path):
+                self._f = open(path, "wb")
+
+            def close(self):
+                self._f.close()
+
+        def read_all(path):
+            with open(path, "rb") as f:
+                return f.read()
+        """)
+    assert codes == []
+
+
+# ---------------------------------------------------------------------------
+# the twins: known-bad snippets MUST fire
+# ---------------------------------------------------------------------------
+
+
+def test_every_twin_fires():
+    from lux_tpu.analysis.twins import run_twins
+
+    results = run_twins()
+    assert results, "no twins registered"
+    silent = [(name, expected, sorted(fired))
+              for name, expected, fired, ok in results if not ok]
+    assert silent == [], f"twins came back CLEAN: {silent}"
+
+
+def test_silent_twin_is_reported_as_failure(monkeypatch):
+    """The harness itself: a twin whose expected code does not fire
+    must come back ok=False (this is the tripwire that makes checker
+    rot visible — see luxproto's broken twins)."""
+    import lux_tpu.analysis.twins as tw
+
+    monkeypatch.setattr(tw, "ALL_TWINS",
+                        (("clean_decoy", "x = 1\n", ("LUX-G001",)),))
+    (name, expected, fired, ok), = tw.run_twins()
+    assert name == "clean_decoy" and not ok and not fired
+
+
+def test_pr16_fixture_close_without_shutdown():
+    """The PR 16 stall, as a checker finding: ``close()`` alone does
+    not wake a thread parked in ``accept()`` on Linux, so the pre-fix
+    ``stop()`` burned the full join timeout.  This is the exact shape
+    pod.py/controller.py shipped with before this PR's fix."""
+    codes = _res("""\
+        import socket
+        import threading
+
+        class PodWorker:
+            def start(self):
+                self._srv = socket.socket()
+                self._srv.listen(8)
+                self._t = threading.Thread(target=self._accept_loop,
+                                           daemon=True)
+                self._t.start()
+
+            def _accept_loop(self):
+                while self._running:
+                    conn, _ = self._srv.accept()
+
+            def stop(self):
+                self._running = False
+                self._srv.close()  # pre-fix: no shutdown() first
+                self._t.join(timeout=5.0)
+        """)
+    assert "LUX-R002" in codes
+
+
+def test_pr19_fixture_dial_under_lock():
+    """The PR 19 wedge (caught then by LUX-L003, pinned here forever):
+    dialing the incumbent while holding the probe lock let a hung
+    connect() to a dead address wedge ``close()`` behind it."""
+    findings = _run("""\
+        import threading
+
+        class WireIncumbent:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._conn = None
+
+            def ping(self):
+                from lux_tpu.serve.fleet.wire import Conn
+
+                with self._lock:
+                    if self._conn is None:
+                        self._conn = Conn.connect("h", 1)  # pre-fix
+                    self._conn.send({"op": "lease"})
+        """, (LockOrderChecker(),),
+        relpath="lux_tpu/serve/autopilot/fixture.py")
+    assert "LUX-L003" in [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# suppression round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_with_reason_silences():
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                threading.Thread(target=self.peek).start()
+                # luxcheck: disable=LUX-G001 -- monotonic counter, a stale read is fine here
+                return self._n
+        """)
+    assert codes == []
+
+
+def test_inline_suppression_without_reason_is_a_finding():
+    codes = _guard("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                threading.Thread(target=self.peek).start()
+                return self._n  # luxcheck: disable=LUX-G001
+        """)
+    assert "LUX-X001" in codes
+
+
+# ---------------------------------------------------------------------------
+# the CLI gates, jax-free
+# ---------------------------------------------------------------------------
+
+
+def _run_cli_jax_free(flag, must_print):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    code = (
+        "import builtins, runpy, sys\n"
+        "real = builtins.__import__\n"
+        "def guard(name, *a, **k):\n"
+        "    assert not name.startswith('jax'), 'luxcheck imported jax'\n"
+        "    return real(name, *a, **k)\n"
+        "builtins.__import__ = guard\n"
+        "sys.argv = ['luxcheck.py', %r]\n"
+        "try:\n"
+        "    runpy.run_path(%r, run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    sys.exit(e.code)\n"
+        % (flag, os.path.join(REPO, "tools", "luxcheck.py"))
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, cwd=REPO,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert must_print in proc.stdout, proc.stdout
+
+
+def test_cli_twins_jax_free():
+    _run_cli_jax_free("--twins", "[PASS] luxcheck twins")
+
+
+def test_cli_check_baselines_jax_free():
+    _run_cli_jax_free("--check-baselines", "[PASS] baselines")
+
+
+# ---------------------------------------------------------------------------
+# regressions for the findings the first sweep caught
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    """subprocess.Popen stand-in for launcher teardown paths."""
+
+    def __init__(self, wait_raises=0):
+        self.killed = False
+        self.terminated = False
+        self.returncode = None
+        self._wait_raises = wait_raises
+
+    def poll(self):
+        return self.returncode
+
+    def kill(self):
+        self.killed = True
+
+    def terminate(self):
+        self.terminated = True
+
+    def wait(self, timeout=None):
+        if self._wait_raises > 0:
+            self._wait_raises -= 1
+            raise subprocess.TimeoutExpired(cmd="worker", timeout=timeout)
+        self.returncode = 0
+        return 0
+
+
+def test_launcher_kill_reclaims_tmpdir_on_wait_timeout(tmp_path):
+    """ProcHandle.kill(): an unreapable child (wait() raising
+    TimeoutExpired) must not leak the private tmpdir on top of the
+    stuck process — the reclaim runs on the exception path too."""
+    from lux_tpu.serve.fleet.launcher import ProcHandle
+
+    d = tmp_path / "scratch"
+    d.mkdir()
+    h = ProcHandle(_FakeProc(wait_raises=1), "w0", 1, 2, str(d), {})
+    with pytest.raises(subprocess.TimeoutExpired):
+        h.kill()
+    assert not d.exists()
+    assert h.tmpdir is None
+
+
+def test_launcher_terminate_reclaims_tmpdir_on_wait_timeout(tmp_path):
+    """terminate(): both waits timing out (TERM ignored, then the
+    post-KILL reap hanging) still reclaims the tmpdir."""
+    from lux_tpu.serve.fleet.launcher import ProcHandle
+
+    d = tmp_path / "scratch"
+    d.mkdir()
+    proc = _FakeProc(wait_raises=2)
+    h = ProcHandle(proc, "w0", 1, 2, str(d), {})
+    with pytest.raises(subprocess.TimeoutExpired):
+        h.terminate(timeout_s=0.01)
+    assert proc.terminated and proc.killed
+    assert not d.exists()
+
+
+def test_launch_malformed_ready_reclaims_tmpdir_and_child(monkeypatch,
+                                                          tmp_path):
+    """_launch_argv: a READY line missing a required key raises while
+    building the ProcHandle — the pre-fix code only reclaimed on
+    LaunchError, orphaning both the child and its tmpdir."""
+    from lux_tpu.serve.fleet import launcher
+
+    spawned = []
+
+    class _ReadyProc(_FakeProc):
+        def __init__(self, *a, **k):
+            super().__init__()
+            # ready, but no "port": ProcHandle construction raises
+            self.stdout = io.StringIO(
+                '{"ready": true, "worker_id": "w9", "pid": 7}\n')
+            spawned.append(self)
+
+    made = []
+    real_mkdtemp = launcher.tempfile.mkdtemp
+
+    def _mkdtemp(prefix=""):
+        d = real_mkdtemp(prefix=prefix, dir=str(tmp_path))
+        made.append(d)
+        return d
+
+    monkeypatch.setattr(launcher.subprocess, "Popen", _ReadyProc)
+    monkeypatch.setattr(launcher.tempfile, "mkdtemp", _mkdtemp)
+    with pytest.raises(KeyError):
+        launcher.launch("lux_tpu.serve.fleet.pod", [],
+                        ready_timeout_s=5.0)
+    assert made and not os.path.exists(made[0])
+    assert spawned and spawned[0].killed
+
+
+def test_rebind_closes_displaced_hub():
+    """SubscriptionHub.rebind: adopting a hub onto a successor that
+    already built its OWN hub must close the displaced one — pre-fix,
+    its dispatcher thread idled forever and its subscribers hung with
+    nothing left to notify them."""
+    from lux_tpu.serve.autopilot.subscribe import (
+        SubscriptionClosed, SubscriptionHub,
+    )
+
+    class _Ctl:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._sub_hub = None
+
+        def generation(self):
+            return 0
+
+        def _pilot_count(self, key, n=1):
+            pass
+
+    a, b = _Ctl(), _Ctl()
+    hub_b = SubscriptionHub(b)
+    b._sub_hub = hub_b
+    sub = hub_b.subscribe("pr")  # starts hub_b's dispatcher thread
+    assert hub_b._thread is not None and hub_b._thread.is_alive()
+
+    hub_a = SubscriptionHub(a)
+    a._sub_hub = hub_a
+    hub_a.rebind(b)
+
+    assert b._sub_hub is hub_a
+    hub_b._thread.join(timeout=5.0)
+    assert not hub_b._thread.is_alive()
+    with pytest.raises(SubscriptionClosed):
+        sub.get(timeout_s=1.0)

@@ -140,6 +140,25 @@ fi
 [ -n "$SID" ] && python tools/obs_span.py end "$SID" 2>/dev/null
 tail -1 "$LOG/luxproto.out"
 
+# -3d) guard preflight: the LUX-G/LUX-R twins (known-bad snippets that
+#      MUST fire — a clean twin means the guarded-by/resource checkers
+#      rotted while step -3 kept passing) plus the baseline staleness
+#      tripwire for both suppression files.  The families' real sweep
+#      already ran inside step -3's luxcheck --all; this pins the
+#      checkers themselves.  Jax-free, sub-second.
+echo "=== luxguard preflight ($(date +%H:%M:%S))"
+SID=$(python tools/obs_span.py begin step.luxguard 2>/dev/null)
+if ! { fg_to 120 python tools/luxcheck.py --twins && \
+       fg_to 120 python tools/luxcheck.py --check-baselines; } \
+    > "$LOG/luxguard.out" 2>&1; then
+  [ -n "$SID" ] && python tools/obs_span.py end "$SID" --rc 1 2>/dev/null
+  tail -15 "$LOG/luxguard.out" | sed 's/^/    /'
+  echo "luxguard twins/baselines failed (full list: $LOG/luxguard.out) — aborting battery"
+  exit 1
+fi
+[ -n "$SID" ] && python tools/obs_span.py end "$SID" 2>/dev/null
+tail -1 "$LOG/luxguard.out"
+
 # -2) routed-plan prewarm in the BACKGROUND (host cores only, no chip
 #     needed): builds/refreshes the headline-scale expand+fused plan
 #     caches so no battery step pays plan construction inside a TPU

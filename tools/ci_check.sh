@@ -48,6 +48,21 @@ echo "$out" | grep -q "\[PASS\] luxproto" || { echo "luxproto failed"; exit 1; }
 echo "$out"
 '
 
+# 1d) luxguard smoke: the LUX-G/LUX-R synthetic-positive twins MUST
+#     fire (a known-bad snippet coming back clean means the checker
+#     rotted, not the code), and both suppression baselines must be
+#     well-formed and stale-free.  The families' repo-wide sweep itself
+#     runs inside stage 1's luxcheck --all.  Jax-free, [PASS]-gated.
+stage guard_smoke 120 bash -c '
+set -e
+out=$(python tools/luxcheck.py --twins)
+echo "$out" | grep -q "\[PASS\] luxcheck twins" || { echo "twins failed"; exit 1; }
+echo "$out"
+out=$(python tools/luxcheck.py --check-baselines)
+echo "$out" | grep -q "\[PASS\] baselines" || { echo "baselines failed"; exit 1; }
+echo "$out"
+'
+
 # 2) native sanitizer smoke: TSan (the multithreaded colorer, bitwise
 #    vs serial), ASan + UBSan (lux_io's pread64 offset arithmetic).
 #    Skipped quietly when the toolchain can't build them (the pytest

@@ -6,6 +6,8 @@ Usage:
     python tools/luxcheck.py lux_tpu/ops        # specific paths
     python tools/luxcheck.py --list-checkers
     python tools/luxcheck.py --all --fingerprints   # baseline-entry form
+    python tools/luxcheck.py --twins            # known-bad twins must fire
+    python tools/luxcheck.py --check-baselines  # both baselines, jax-free
 
 Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = usage.
 
@@ -34,10 +36,68 @@ import _jaxfree  # noqa: E402
 REPO = _jaxfree.bare_package()
 
 from lux_tpu.analysis import (  # noqa: E402
-    ALL_CHECKERS, DEFAULT_TARGETS, check_paths,
+    ALL_CHECKERS, DEFAULT_TARGETS, check_paths, load_baseline,
 )
 
 DEFAULT_BASELINE = os.path.join("tools", "luxcheck_baseline.txt")
+AUDIT_BASELINE = os.path.join("tools", "luxaudit_baseline.txt")
+
+
+def _run_twins() -> int:
+    from lux_tpu.analysis.twins import run_twins
+
+    results = run_twins()
+    silent = [r for r in results if not r[3]]
+    for name, expected, fired, ok in results:
+        mark = "ok" if ok else "SILENT"
+        print(f"  twin {name:28s} expect={','.join(expected)} "
+              f"fired={','.join(sorted(fired)) or '-'} [{mark}]")
+    if silent:
+        print(f"luxcheck --twins: {len(silent)} known-bad twin(s) came "
+              "back clean — the CHECKER stopped firing, not the snippet",
+              file=sys.stderr)
+        return 1
+    print(f"[PASS] luxcheck twins: {len(results)}/{len(results)} fired")
+    return 0
+
+
+def _check_baselines() -> int:
+    """Staleness tripwire for BOTH baseline files, jax-free.
+
+    luxcheck's baseline gets the real treatment: a full sweep with the
+    baseline applied surfaces malformed entries (LUX-X002) and entries
+    matching no current finding (LUX-X003).  luxaudit's sweep needs jax
+    (it traces the real engines), so its baseline gets the checks that
+    don't: entry structure, justification presence, and whether the
+    file each entry names still exists — an entry for a deleted file is
+    stale whatever the fingerprints say.
+    """
+    problems = []
+    lc = os.path.join(REPO, DEFAULT_BASELINE)
+    meta = [f for f in check_paths(list(DEFAULT_TARGETS), REPO,
+                                   baseline_path=lc)
+            if f.code in ("LUX-X002", "LUX-X003")]
+    problems.extend(f.format() for f in meta)
+    lc_entries, _ = load_baseline(lc)
+
+    la = os.path.join(REPO, AUDIT_BASELINE)
+    la_entries, bad = load_baseline(la)
+    problems.extend(f.format() for f in bad)
+    for e in la_entries:
+        if not os.path.exists(os.path.join(REPO, e.path)):
+            problems.append(
+                f"{os.path.basename(la)}:{e.lineno}: entry names "
+                f"'{e.path}' which no longer exists — stale")
+    if problems:
+        for p in problems:
+            print(p)
+        print(f"luxcheck --check-baselines: {len(problems)} problem(s)",
+              file=sys.stderr)
+        return 1
+    print(f"[PASS] baselines: luxcheck={len(lc_entries)} "
+          f"luxaudit={len(la_entries)} entr(ies), none stale or "
+          "malformed")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -55,6 +115,13 @@ def main(argv=None) -> int:
     ap.add_argument("--fingerprints", action="store_true",
                     help="print findings as ready-to-paste baseline "
                          "entries instead of human-readable lines")
+    ap.add_argument("--twins", action="store_true",
+                    help="run the LUX-G/LUX-R synthetic-positive twins: "
+                         "known-bad snippets that MUST fire (a clean "
+                         "twin means the checker rotted)")
+    ap.add_argument("--check-baselines", action="store_true",
+                    help="staleness tripwire for the luxcheck AND "
+                         "luxaudit baseline files (jax-free)")
     args = ap.parse_args(argv)
 
     if args.list_checkers:
@@ -62,6 +129,10 @@ def main(argv=None) -> int:
             print(f"{ch.name:14s} family={ch.family}  "
                   f"({type(ch).__module__})")
         return 0
+    if args.twins:
+        return _run_twins()
+    if args.check_baselines:
+        return _check_baselines()
 
     paths = list(args.paths)
     if args.all:

@@ -308,25 +308,27 @@ def main(argv=None):
     rows: dict[str, dict] = {}
     for v in args.variants:
         out_path = os.path.join(args.outdir, f"gp_{v}.out")
-        out = open(out_path, "wb")
-        err = open(out_path + ".err", "wb")
         cmd = [sys.executable, os.path.abspath(__file__), "--worker",
                "--variant", v, "--scale", str(args.scale),
                "--rb", str(args.rb),
                "--reps", *[str(r) for r in args.reps]]
         t0 = time.monotonic()
-        proc = subprocess.Popen(cmd, stdout=out, stderr=err,
-                                cwd=os.path.dirname(os.path.abspath(__file__)),
-                                start_new_session=True)
-        while time.monotonic() - t0 < args.per_variant_s:
-            if proc.poll() is not None:
-                break
-            time.sleep(1)
-        abandoned = proc.poll() is None
-        out.close()
-        err.close()
-        for line in (open(out_path, "rb").read()
-                     .decode("utf8", "replace").splitlines()):
+        # Popen dups the descriptors into the child, so the with block
+        # may close ours even when the worker is abandoned mid-write
+        with open(out_path, "wb") as out, \
+                open(out_path + ".err", "wb") as err:
+            proc = subprocess.Popen(cmd, stdout=out, stderr=err,
+                                    cwd=os.path.dirname(
+                                        os.path.abspath(__file__)),
+                                    start_new_session=True)
+            while time.monotonic() - t0 < args.per_variant_s:
+                if proc.poll() is not None:
+                    break
+                time.sleep(1)
+            abandoned = proc.poll() is None
+        with open(out_path, "rb") as f:
+            text = f.read().decode("utf8", "replace")
+        for line in text.splitlines():
             line = line.strip()
             if line.startswith("{"):
                 try:
